@@ -1,0 +1,95 @@
+#!/usr/bin/env python3
+"""Benchmark entrypoint — run by the driver on real TPU hardware.
+
+Prints ONE JSON line:
+    {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+Metric: sustained 1080p60 encode FPS on one TPU chip (BASELINE.md north
+star: sustain 60 fps / <16 ms per frame). vs_baseline is achieved_fps / 60,
+so 1.0 == reference parity.
+
+The bench measures the flagship path available at the current milestone:
+the full tpuh264enc frame step once it exists, otherwise the capture→I420
+conversion stage alone (clearly labelled).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_FPS = 60.0
+H, W = 1080, 1920
+WARMUP = 3
+ITERS = 30
+
+
+def _result(metric: str, fps: float) -> None:
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(fps, 2),
+                "unit": "fps@1080p",
+                "vs_baseline": round(fps / BASELINE_FPS, 3),
+            }
+        )
+    )
+
+
+def _synth_frames(n: int = 4) -> list[np.ndarray]:
+    rng = np.random.default_rng(42)
+    frames = []
+    base = rng.integers(0, 256, size=(H // 8, W // 8, 4), dtype=np.uint8)
+    for i in range(n):
+        f = np.kron(np.roll(base, i, axis=1), np.ones((8, 8, 1), dtype=np.uint8))
+        frames.append(np.ascontiguousarray(f))
+    return frames
+
+
+def bench_full_encoder() -> float | None:
+    try:
+        from selkies_tpu.models.h264.encoder import TPUH264Encoder
+    except ImportError:
+        return None
+    enc = TPUH264Encoder(W, H, qp=28)
+    frames = _synth_frames()
+    for f in frames[:WARMUP]:
+        enc.encode_frame(f)
+    t0 = time.perf_counter()
+    for i in range(ITERS):
+        enc.encode_frame(frames[i % len(frames)])
+    dt = time.perf_counter() - t0
+    return ITERS / dt
+
+
+def bench_convert_only() -> float:
+    import jax
+
+    from selkies_tpu.ops.colorspace import bgrx_to_i420
+
+    frames = [jax.device_put(f) for f in _synth_frames()]
+    out = bgrx_to_i420(frames[0])
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for i in range(ITERS):
+        out = bgrx_to_i420(frames[i % len(frames)])
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    return ITERS / dt
+
+
+def main() -> int:
+    fps = bench_full_encoder()
+    if fps is not None:
+        _result("tpuh264enc 1080p intra encode fps (1 chip)", fps)
+    else:
+        _result("capture->I420 convert fps (encoder pending)", bench_convert_only())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

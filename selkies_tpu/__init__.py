@@ -1,0 +1,28 @@
+"""selkies-tpu — TPU-native low-latency remote desktop / game streaming framework.
+
+A ground-up re-design of the capabilities of Selkies-GStreamer
+(reference: maksgranko/selkies) for Google TPU hardware:
+
+- Video encoding (H.264 / VP9 / AV1) runs as JAX/XLA + Pallas kernels on TPU
+  (``tpuh264enc`` and friends) instead of NVENC / VA-API / x264
+  (reference: gstwebrtc_app.py:260-783, the encoder matrix).
+- The pipeline builder, signalling, input injection, congestion control, and
+  observability layers are asyncio-native Python (reference layer map:
+  SURVEY.md §1), with hot host-side byte work (CAVLC bit packing) in C++.
+- Multi-session scale-out maps one 1080p60 stream per TPU chip over a
+  ``jax.sharding.Mesh`` (reference's K8s fleet concern, re-imagined as
+  SPMD session placement).
+
+Package layout:
+  models/    codec "model families": h264 (flagship), vp9, av1
+  ops/       JAX/Pallas compute ops (colorspace, transforms, prediction)
+  parallel/  device-mesh session placement and intra-frame sharding
+  pipeline/  asyncio pipeline framework + TPUWebRTCApp app core
+  signalling/ WebRTC signalling server + in-process client
+  transport/ RTP payloaders, WebSocket media transport, data channels
+  input_host/ keyboard/mouse/gamepad/clipboard injection into X11
+  monitoring/ Prometheus metrics, system/TPU monitors
+  utils/     bitstream writers, misc helpers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,5 @@
+"""Host input injection: keyboard/mouse/gamepad/clipboard/resize into X11.
+
+Parity with the reference's webrtc_input.py/gamepad.py/resize.py via ctypes
+bindings against libX11/libXtst/libXfixes/libXrandr (no python-xlib dep).
+"""

@@ -1,0 +1,6 @@
+"""Codec "model families": the encoder implementations.
+
+The flagship is models.h264 (``tpuh264enc``); vp9 and av1 mirror the
+reference's encoder matrix (gstwebrtc_app.py:260-783) in later milestones.
+Encoder selection goes through models.registry.
+"""

@@ -1,0 +1,6 @@
+"""TPU-native H.264 encoder (``tpuh264enc``).
+
+Replaces the reference's nvh264enc/vah264enc/x264enc/openh264enc family
+(gstwebrtc_app.py:260-367,475-508,609-665) with a JAX/Pallas encode core and
+a host-side CAVLC bit packer.
+"""

@@ -1,0 +1,193 @@
+"""H.264 (ISO 14496-10) high-level bitstream syntax: SPS, PPS, slice headers.
+
+Host-side, tiny, and cold — headers are written once per stream / per frame.
+The hot per-macroblock entropy coding lives in cavlc.py (Python reference)
+and native/cavlc_pack.cc (production C++).
+
+Profile choices (mirroring the reference's browser-compatible settings,
+gstwebrtc_app.py:788-804 — constrained-baseline, byte-stream):
+  * profile_idc 66 (Baseline), constraint_set0+1 → Constrained Baseline,
+    which every browser hardware decoder accepts.
+  * CAVLC entropy coding, frame MBs only, POC type 2, 1 reference frame.
+  * Deblocking disabled via slice header for bit-exact encoder/decoder
+    reconstruction (re-enabled once the Pallas deblock kernel lands).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from selkies_tpu.utils.bits import BitWriter, annexb_nal
+
+__all__ = ["StreamParams", "write_sps", "write_pps", "write_slice_header", "ipcm_frame"]
+
+NAL_SLICE_NON_IDR = 1
+NAL_SLICE_IDR = 5
+NAL_SPS = 7
+NAL_PPS = 8
+
+LOG2_MAX_FRAME_NUM = 8  # MaxFrameNum = 256
+
+# Slice types (all-slices-in-pic variants)
+SLICE_P = 5
+SLICE_I = 7
+
+
+# (level_idc, MaxMBPS, MaxFS) from table A-1, ascending.
+_LEVELS = (
+    (10, 1485, 99), (11, 3000, 396), (12, 6000, 396), (13, 11880, 396),
+    (20, 11880, 396), (21, 19800, 792), (22, 20250, 1620), (30, 40500, 1620),
+    (31, 108000, 3600), (32, 216000, 5120), (40, 245760, 8192), (41, 245760, 8192),
+    (42, 522240, 8704), (50, 589824, 22080), (51, 983040, 36864), (52, 2073600, 36864),
+)
+
+
+@dataclass(frozen=True)
+class StreamParams:
+    width: int
+    height: int
+    qp: int = 28
+    fps: int = 60
+    disable_deblocking: bool = True
+
+    def __post_init__(self) -> None:
+        if self.width % 2 or self.height % 2:
+            raise ValueError(f"{self.width}x{self.height}: 4:2:0 requires even dimensions")
+        if self.width <= 0 or self.height <= 0:
+            raise ValueError("dimensions must be positive")
+
+    @property
+    def mb_width(self) -> int:
+        return (self.width + 15) // 16
+
+    @property
+    def mb_height(self) -> int:
+        return (self.height + 15) // 16
+
+    @property
+    def level_idc(self) -> int:
+        """Smallest level whose MaxFS and MaxMBPS cover this stream (A-1)."""
+        fs = self.mb_width * self.mb_height
+        mbps = fs * self.fps
+        for level, max_mbps, max_fs in _LEVELS:
+            if fs <= max_fs and mbps <= max_mbps:
+                return level
+        return 62
+
+
+def write_sps(p: StreamParams) -> bytes:
+    w = BitWriter()
+    w.write_bits(66, 8)  # profile_idc: Baseline
+    w.write_bits(0b11000000, 8)  # constraint_set0+1 (constrained baseline)
+    w.write_bits(p.level_idc, 8)
+    w.write_ue(0)  # seq_parameter_set_id
+    w.write_ue(LOG2_MAX_FRAME_NUM - 4)
+    w.write_ue(2)  # pic_order_cnt_type: POC from frame_num (no B frames)
+    w.write_ue(1)  # max_num_ref_frames
+    w.write_bit(0)  # gaps_in_frame_num_value_allowed_flag
+    w.write_ue(p.mb_width - 1)
+    w.write_ue(p.mb_height - 1)
+    w.write_bit(1)  # frame_mbs_only_flag
+    w.write_bit(1)  # direct_8x8_inference_flag
+    crop_r = p.mb_width * 16 - p.width
+    crop_b = p.mb_height * 16 - p.height
+    if crop_r or crop_b:
+        w.write_bit(1)
+        w.write_ue(0)  # left
+        w.write_ue(crop_r // 2)
+        w.write_ue(0)  # top
+        w.write_ue(crop_b // 2)
+    else:
+        w.write_bit(0)
+    w.write_bit(0)  # vui_parameters_present_flag
+    w.rbsp_trailing_bits()
+    return annexb_nal(3, NAL_SPS, w.get_bytes())
+
+
+def write_pps(p: StreamParams) -> bytes:
+    w = BitWriter()
+    w.write_ue(0)  # pic_parameter_set_id
+    w.write_ue(0)  # seq_parameter_set_id
+    w.write_bit(0)  # entropy_coding_mode_flag: CAVLC
+    w.write_bit(0)  # bottom_field_pic_order_in_frame_present_flag
+    w.write_ue(0)  # num_slice_groups_minus1
+    w.write_ue(0)  # num_ref_idx_l0_default_active_minus1
+    w.write_ue(0)  # num_ref_idx_l1_default_active_minus1
+    w.write_bit(0)  # weighted_pred_flag
+    w.write_bits(0, 2)  # weighted_bipred_idc
+    w.write_se(p.qp - 26)  # pic_init_qp_minus26
+    w.write_se(0)  # pic_init_qs_minus26
+    w.write_se(0)  # chroma_qp_index_offset
+    w.write_bit(1)  # deblocking_filter_control_present_flag
+    w.write_bit(0)  # constrained_intra_pred_flag
+    w.write_bit(0)  # redundant_pic_cnt_present_flag
+    w.rbsp_trailing_bits()
+    return annexb_nal(3, NAL_PPS, w.get_bytes())
+
+
+def write_slice_header(
+    w: BitWriter,
+    p: StreamParams,
+    slice_type: int,
+    frame_num: int,
+    idr: bool,
+    idr_pic_id: int = 0,
+    first_mb: int = 0,
+    slice_qp: int | None = None,
+) -> None:
+    """Write the slice header into an open BitWriter (slice data follows)."""
+    w.write_ue(first_mb)
+    w.write_ue(slice_type)
+    w.write_ue(0)  # pic_parameter_set_id
+    w.write_bits(frame_num % (1 << LOG2_MAX_FRAME_NUM), LOG2_MAX_FRAME_NUM)
+    if idr:
+        w.write_ue(idr_pic_id)
+    # pic_order_cnt_type == 2: nothing to write
+    if slice_type in (SLICE_P, 0):
+        w.write_bit(0)  # num_ref_idx_active_override_flag
+        w.write_bit(0)  # ref_pic_list_modification_flag_l0
+    if idr:
+        w.write_bit(0)  # no_output_of_prior_pics_flag
+        w.write_bit(0)  # long_term_reference_flag
+    else:
+        # dec_ref_pic_marking is present whenever nal_ref_idc != 0 (7.3.3);
+        # every slice we emit is a reference (annexb_nal ref_idc=3).
+        w.write_bit(0)  # adaptive_ref_pic_marking_mode_flag
+    qp = p.qp if slice_qp is None else slice_qp
+    w.write_se(qp - p.qp)  # slice_qp_delta relative to pic_init_qp
+    if p.disable_deblocking:
+        w.write_ue(1)  # disable_deblocking_filter_idc = 1 (off)
+    else:
+        w.write_ue(0)
+        w.write_se(0)  # slice_alpha_c0_offset_div2
+        w.write_se(0)  # slice_beta_offset_div2
+
+
+def ipcm_frame(p: StreamParams, y, u, v, frame_num: int = 0, idr: bool = True) -> bytes:
+    """Encode one frame entirely as I_PCM macroblocks (lossless, huge).
+
+    Exists to (a) prove NAL/SPS/PPS/slice framing against a reference
+    decoder independently of transform/entropy code, and (b) serve as an
+    escape hatch for pathological content. y/u/v are numpy uint8 planes
+    padded to macroblock multiples.
+    """
+    w = BitWriter()
+    write_slice_header(w, p, SLICE_I, frame_num, idr=idr)
+    mbw, mbh = p.mb_width, p.mb_height
+    for mby in range(mbh):
+        for mbx in range(mbw):
+            w.write_ue(25)  # mb_type I_PCM
+            w.byte_align(0)  # pcm_alignment_zero_bit
+            yb = y[mby * 16 : mby * 16 + 16, mbx * 16 : mbx * 16 + 16]
+            ub = u[mby * 8 : mby * 8 + 8, mbx * 8 : mbx * 8 + 8]
+            vb = v[mby * 8 : mby * 8 + 8, mbx * 8 : mbx * 8 + 8]
+            for row in yb:
+                for s in row:
+                    w.write_bits(int(s), 8)
+            for blk in (ub, vb):
+                for row in blk:
+                    for s in row:
+                        w.write_bits(int(s), 8)
+    w.rbsp_trailing_bits()
+    nal_type = NAL_SLICE_IDR if idr else NAL_SLICE_NON_IDR
+    return annexb_nal(3, nal_type, w.get_bytes())

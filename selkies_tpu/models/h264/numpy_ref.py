@@ -1,0 +1,323 @@
+"""Numpy golden-model H.264 intra encoder: transform, quant, predict, recon.
+
+This is the bit-exact reference the TPU path (encoder.py, JAX/Pallas) and
+the C++ CAVLC packer are validated against, and the authority for
+conformance tests (FFmpeg must reconstruct exactly these pixels).
+
+Scope (first milestone): Intra16x16 luma + Intra8x8 chroma, CAVLC, single
+slice per frame, deblocking disabled. Prediction-mode policy is chosen for
+TPU-friendliness (see encoder.py): vertical prediction everywhere the top
+neighbour exists (dependencies run down rows only, so a row of MBs is a
+single data-parallel batch), DC prediction on the first row (left-to-right
+chain, one scan per frame).
+
+The quantization/rescale math follows ISO/IEC 14496-10 §8.5; integer
+shifts are arithmetic (numpy's >> on signed ints), matching the spec.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from selkies_tpu.models.h264.tables import chroma_qp, mf_matrix, v_matrix
+
+# Forward core transform matrix Cf (8.5.12 inverse's encoder-side dual).
+CF = np.array([[1, 1, 1, 1], [2, 1, -1, -2], [1, -1, -1, 1], [1, -2, 2, -1]], dtype=np.int64)
+# 4x4 Hadamard for Intra16x16 luma DC.
+H4 = np.array([[1, 1, 1, 1], [1, 1, -1, -1], [1, -1, -1, 1], [1, -1, 1, -1]], dtype=np.int64)
+# 2x2 Hadamard for chroma DC.
+H2 = np.array([[1, 1], [1, -1]], dtype=np.int64)
+
+# Intra16x16 luma prediction modes (coded in mb_type).
+I16_VERTICAL = 0
+I16_HORIZONTAL = 1
+I16_DC = 2
+I16_PLANE = 3
+
+# Chroma prediction modes (intra_chroma_pred_mode syntax element).
+CHROMA_DC = 0
+CHROMA_HORIZONTAL = 1
+CHROMA_VERTICAL = 2
+CHROMA_PLANE = 3
+
+
+def fdct4(blocks: np.ndarray) -> np.ndarray:
+    """Forward 4x4 core transform over (..., 4, 4) int blocks."""
+    return CF @ blocks.astype(np.int64) @ CF.T
+
+
+def idct4(coeffs: np.ndarray) -> np.ndarray:
+    """Inverse 4x4 core transform (8.5.12.2), bit-exact with >> semantics.
+
+    Input: dequantized coefficients (..., 4, 4). Output: residual (..., 4, 4)
+    after the final (x + 32) >> 6 rounding.
+    """
+    d = coeffs.astype(np.int64)
+    # horizontal first (8.5.12.2): mix columns within each row
+    e0 = d[..., 0] + d[..., 2]
+    e1 = d[..., 0] - d[..., 2]
+    e2 = (d[..., 1] >> 1) - d[..., 3]
+    e3 = d[..., 1] + (d[..., 3] >> 1)
+    g = np.stack([e0 + e3, e1 + e2, e1 - e2, e0 - e3], axis=-1)
+    # then vertical: mix rows
+    e0 = g[..., 0, :] + g[..., 2, :]
+    e1 = g[..., 0, :] - g[..., 2, :]
+    e2 = (g[..., 1, :] >> 1) - g[..., 3, :]
+    e3 = g[..., 1, :] + (g[..., 3, :] >> 1)
+    out = np.stack([e0 + e3, e1 + e2, e1 - e2, e0 - e3], axis=-2)
+    return (out + 32) >> 6
+
+
+def quant4(coeffs: np.ndarray, qp: int, intra: bool = True) -> np.ndarray:
+    """Quantize (..., 4, 4) transform coefficients (AC path incl. DC pos)."""
+    qbits = 15 + qp // 6
+    f = (1 << qbits) // 3 if intra else (1 << qbits) // 6
+    mf = mf_matrix(qp)
+    c = coeffs.astype(np.int64)
+    level = (np.abs(c) * mf + f) >> qbits
+    return np.where(c < 0, -level, level)
+
+
+def dequant4(levels: np.ndarray, qp: int) -> np.ndarray:
+    """Rescale (..., 4, 4) levels (AC path); feeds idct4."""
+    return levels.astype(np.int64) * v_matrix(qp) * (1 << (qp // 6))
+
+
+def quant_luma_dc(dc: np.ndarray, qp: int) -> np.ndarray:
+    """Forward Hadamard + quant for the (..., 4, 4) luma DC block."""
+    t = (H4 @ dc.astype(np.int64) @ H4) >> 1
+    qbits = 15 + qp // 6
+    f = (1 << qbits) // 3
+    mf00 = mf_matrix(qp)[0, 0]
+    level = (np.abs(t) * mf00 + 2 * f) >> (qbits + 1)
+    return np.where(t < 0, -level, level)
+
+
+def dequant_luma_dc(levels: np.ndarray, qp: int) -> np.ndarray:
+    """Inverse Hadamard + rescale; returns DC values to substitute into
+    each 4x4 block before idct4 (8.5.10)."""
+    f = H4 @ levels.astype(np.int64) @ H4
+    v00 = v_matrix(qp)[0, 0]
+    qp_per = qp // 6
+    if qp_per >= 2:
+        return (f * v00) << (qp_per - 2)
+    return (f * v00 + (1 << (1 - qp_per))) >> (2 - qp_per)
+
+
+def quant_chroma_dc(dc: np.ndarray, qp: int, intra: bool = True) -> np.ndarray:
+    """Forward 2x2 Hadamard + quant for (..., 2, 2) chroma DC (qp = chroma QP)."""
+    t = H2 @ dc.astype(np.int64) @ H2
+    qbits = 15 + qp // 6
+    f = (1 << qbits) // 3 if intra else (1 << qbits) // 6
+    mf00 = mf_matrix(qp)[0, 0]
+    level = (np.abs(t) * mf00 + 2 * f) >> (qbits + 1)
+    return np.where(t < 0, -level, level)
+
+
+def dequant_chroma_dc(levels: np.ndarray, qp: int) -> np.ndarray:
+    """8.5.11 with the default flat scaling list (LevelScale = 16·V):
+    dcC = ((f · 16·V00) << (qP/6)) >> 5  ==  ((f · V00) << (qP/6)) >> 1,
+    validated empirically against FFmpeg (tools/cavlc_probe.py)."""
+    f = H2 @ levels.astype(np.int64) @ H2
+    v00 = v_matrix(qp)[0, 0]
+    return ((f * v00) << (qp // 6)) >> 1
+
+
+def split_blocks(mb: np.ndarray, n: int) -> np.ndarray:
+    """(N*n, M*n) -> (N, M, n, n) grid of nxn blocks."""
+    h, w = mb.shape
+    return mb.reshape(h // n, n, w // n, n).swapaxes(1, 2)
+
+
+def merge_blocks(blocks: np.ndarray) -> np.ndarray:
+    """(N, M, n, n) -> (N*n, M*n)."""
+    nby, nbx, n, _ = blocks.shape
+    return blocks.swapaxes(1, 2).reshape(nby * n, nbx * n)
+
+
+@dataclass
+class FrameCoeffs:
+    """Stacked per-MB quantized coefficients for one frame.
+
+    This is the contract between the encode core (numpy golden model /
+    JAX TPU path) and the entropy packers (cavlc.py, native/cavlc_pack.cc):
+      luma_mode / chroma_mode: (mbh, mbw) int32 prediction modes
+      luma_dc:   (mbh, mbw, 4, 4)        quantized Hadamard DC levels
+      luma_ac:   (mbh, mbw, 4, 4, 4, 4)  [by][bx][i][j]; DC position ignored
+      chroma_dc: (mbh, mbw, 2, 2, 2)     [comp][i][j] (comp 0=Cb, 1=Cr)
+      chroma_ac: (mbh, mbw, 2, 2, 2, 4, 4) [comp][by][bx][i][j]
+    """
+
+    luma_mode: np.ndarray
+    chroma_mode: np.ndarray
+    luma_dc: np.ndarray
+    luma_ac: np.ndarray
+    chroma_dc: np.ndarray
+    chroma_ac: np.ndarray
+    qp: int
+
+
+def encode_mb_luma(orig: np.ndarray, pred: np.ndarray, qp: int):
+    """Intra16x16 luma: transform+quant+recon for one (16, 16) MB.
+
+    Returns (dc_levels (4,4), ac_levels (4,4,4,4), recon (16,16) uint8).
+    """
+    resid = orig.astype(np.int64) - pred.astype(np.int64)
+    blocks = split_blocks(resid, 4)  # (4,4,4,4)
+    w = fdct4(blocks)
+    dc = w[..., 0, 0]  # (4,4) raster of block DCs
+    dc_levels = quant_luma_dc(dc, qp)
+    ac_levels = quant4(w, qp, intra=True)
+    # Reconstruction: dequant AC, substitute dequantized DC, inverse transform.
+    deq = dequant4(ac_levels, qp)
+    deq[..., 0, 0] = dequant_luma_dc(dc_levels, qp)
+    r = idct4(deq)
+    recon = np.clip(merge_blocks(r) + pred.astype(np.int64), 0, 255).astype(np.uint8)
+    return dc_levels, ac_levels, recon
+
+
+def encode_mb_chroma(orig: np.ndarray, pred: np.ndarray, qp_c: int):
+    """One chroma component (8, 8): returns (dc (2,2), ac (2,2,4,4), recon)."""
+    resid = orig.astype(np.int64) - pred.astype(np.int64)
+    blocks = split_blocks(resid, 4)  # (2,2,4,4)
+    w = fdct4(blocks)
+    dc = w[..., 0, 0]  # (2,2)
+    dc_levels = quant_chroma_dc(dc, qp_c)
+    ac_levels = quant4(w, qp_c, intra=True)
+    deq = dequant4(ac_levels, qp_c)
+    deq[..., 0, 0] = dequant_chroma_dc(dc_levels, qp_c)
+    r = idct4(deq)
+    recon = np.clip(merge_blocks(r) + pred.astype(np.int64), 0, 255).astype(np.uint8)
+    return dc_levels, ac_levels, recon
+
+
+def _dc_pred_luma(top: np.ndarray | None, left: np.ndarray | None) -> np.ndarray:
+    if top is not None and left is not None:
+        dc = (int(top.sum()) + int(left.sum()) + 16) >> 5
+    elif left is not None:
+        dc = (int(left.sum()) + 8) >> 4
+    elif top is not None:
+        dc = (int(top.sum()) + 8) >> 4
+    else:
+        dc = 128
+    return np.full((16, 16), dc, dtype=np.int64)
+
+
+def _dc_pred_chroma(top: np.ndarray | None, left: np.ndarray | None) -> np.ndarray:
+    """8.3.4.1 chroma DC prediction: per-4x4 rules."""
+    pred = np.empty((8, 8), dtype=np.int64)
+    for by in (0, 1):
+        for bx in (0, 1):
+            t = top[bx * 4 : bx * 4 + 4] if top is not None else None
+            l = left[by * 4 : by * 4 + 4] if left is not None else None
+            if bx == by:  # corner blocks (0,0) and (1,1): use both if avail
+                if t is not None and l is not None:
+                    dc = (int(t.sum()) + int(l.sum()) + 4) >> 3
+                elif l is not None:
+                    dc = (int(l.sum()) + 2) >> 2
+                elif t is not None:
+                    dc = (int(t.sum()) + 2) >> 2
+                else:
+                    dc = 128
+            elif by == 0:  # block (1,0): prefer top
+                if t is not None:
+                    dc = (int(t.sum()) + 2) >> 2
+                elif l is not None:
+                    dc = (int(l.sum()) + 2) >> 2
+                else:
+                    dc = 128
+            else:  # block (0,1): prefer left
+                if l is not None:
+                    dc = (int(l.sum()) + 2) >> 2
+                elif t is not None:
+                    dc = (int(t.sum()) + 2) >> 2
+                else:
+                    dc = 128
+            pred[by * 4 : by * 4 + 4, bx * 4 : bx * 4 + 4] = dc
+    return pred
+
+
+@dataclass
+class FrameEncoding:
+    """Output of the frame encoder: coefficients + reconstruction."""
+
+    coeffs: FrameCoeffs
+    recon_y: np.ndarray
+    recon_u: np.ndarray
+    recon_v: np.ndarray
+
+
+def pad_planes(y: np.ndarray, u: np.ndarray, v: np.ndarray):
+    """Edge-pad planes to macroblock multiples (the SPS crops them back)."""
+    h, w = y.shape
+    hp, wp = (h + 15) // 16 * 16, (w + 15) // 16 * 16
+    if (hp, wp) == (h, w):
+        return y, u, v
+    y = np.pad(y, ((0, hp - h), (0, wp - w)), mode="edge")
+    u = np.pad(u, ((0, hp // 2 - u.shape[0]), (0, wp // 2 - u.shape[1])), mode="edge")
+    v = np.pad(v, ((0, hp // 2 - v.shape[0]), (0, wp // 2 - v.shape[1])), mode="edge")
+    return y, u, v
+
+
+def encode_frame_i16(y: np.ndarray, u: np.ndarray, v: np.ndarray, qp: int) -> FrameEncoding:
+    """Encode planes (padded to MB multiples) as an all-Intra16x16 frame.
+
+    Prediction policy (mirrors the TPU row-scan in encoder.py):
+      row 0:  luma DC (left/none), chroma DC  — serial left-to-right
+      row>0:  luma vertical, chroma vertical  — rows depend only on the row above
+    """
+    h, w = y.shape
+    if h % 16 or w % 16:
+        raise ValueError(f"luma plane {w}x{h} must be padded to multiples of 16 (see pad_planes)")
+    if u.shape != (h // 2, w // 2) or v.shape != (h // 2, w // 2):
+        raise ValueError("chroma planes must be (h/2, w/2) for 4:2:0")
+    if not 0 <= qp <= 51:
+        raise ValueError(f"qp {qp} out of range [0, 51]")
+    mbh, mbw = h // 16, w // 16
+    qp_c = chroma_qp(qp)
+    recon_y = np.zeros_like(y)
+    recon_u = np.zeros_like(u)
+    recon_v = np.zeros_like(v)
+    fc = FrameCoeffs(
+        luma_mode=np.zeros((mbh, mbw), np.int32),
+        chroma_mode=np.zeros((mbh, mbw), np.int32),
+        luma_dc=np.zeros((mbh, mbw, 4, 4), np.int32),
+        luma_ac=np.zeros((mbh, mbw, 4, 4, 4, 4), np.int32),
+        chroma_dc=np.zeros((mbh, mbw, 2, 2, 2), np.int32),
+        chroma_ac=np.zeros((mbh, mbw, 2, 2, 2, 4, 4), np.int32),
+        qp=qp,
+    )
+    for mby in range(mbh):
+        for mbx in range(mbw):
+            ys, xs = mby * 16, mbx * 16
+            cys, cxs = mby * 8, mbx * 8
+            if mby == 0:
+                left_y = recon_y[ys : ys + 16, xs - 1] if mbx > 0 else None
+                pred_y = _dc_pred_luma(None, left_y)
+                luma_mode = I16_DC
+                left_u = recon_u[cys : cys + 8, cxs - 1] if mbx > 0 else None
+                left_v = recon_v[cys : cys + 8, cxs - 1] if mbx > 0 else None
+                pred_u = _dc_pred_chroma(None, left_u)
+                pred_v = _dc_pred_chroma(None, left_v)
+                chroma_mode = CHROMA_DC
+            else:
+                pred_y = np.broadcast_to(recon_y[ys - 1, xs : xs + 16].astype(np.int64), (16, 16))
+                luma_mode = I16_VERTICAL
+                pred_u = np.broadcast_to(recon_u[cys - 1, cxs : cxs + 8].astype(np.int64), (8, 8))
+                pred_v = np.broadcast_to(recon_v[cys - 1, cxs : cxs + 8].astype(np.int64), (8, 8))
+                chroma_mode = CHROMA_VERTICAL
+            dc_y, ac_y, rec_y = encode_mb_luma(y[ys : ys + 16, xs : xs + 16], pred_y, qp)
+            dc_u, ac_u, rec_u = encode_mb_chroma(u[cys : cys + 8, cxs : cxs + 8], pred_u, qp_c)
+            dc_v, ac_v, rec_v = encode_mb_chroma(v[cys : cys + 8, cxs : cxs + 8], pred_v, qp_c)
+            recon_y[ys : ys + 16, xs : xs + 16] = rec_y
+            recon_u[cys : cys + 8, cxs : cxs + 8] = rec_u
+            recon_v[cys : cys + 8, cxs : cxs + 8] = rec_v
+            fc.luma_mode[mby, mbx] = luma_mode
+            fc.chroma_mode[mby, mbx] = chroma_mode
+            fc.luma_dc[mby, mbx] = dc_y
+            fc.luma_ac[mby, mbx] = ac_y
+            fc.chroma_dc[mby, mbx] = np.stack([dc_u, dc_v])
+            fc.chroma_ac[mby, mbx] = np.stack([ac_u, ac_v])
+    return FrameEncoding(coeffs=fc, recon_y=recon_y, recon_u=recon_u, recon_v=recon_v)

@@ -1,0 +1,4 @@
+"""Observability: Prometheus metrics, WebRTC stats CSV, system/TPU monitors.
+
+Parity with metrics.py / system_monitor.py / gpu_monitor.py (SURVEY.md §2.1).
+"""

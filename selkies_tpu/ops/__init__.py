@@ -1,0 +1,9 @@
+"""JAX/XLA/Pallas compute ops for the TPU media path.
+
+These replace the reference's GStreamer native convert/encode elements
+(cudaconvert / vapostproc / videoconvert and the encoder internals,
+/root/reference/src/selkies_gstreamer/gstwebrtc_app.py:263-783) with
+functional, jit-compilable TPU ops.
+"""
+
+from selkies_tpu.ops.colorspace import bgrx_to_i420, rgb_to_i420  # noqa: F401

@@ -1,0 +1,72 @@
+"""Color conversion: packed BGRx/RGB capture frames → planar YUV 4:2:0.
+
+Replaces the reference's colorspace elements (``cudaupload→cudaconvert``,
+``vapostproc``, ``videoconvert``; gstwebrtc_app.py:263-284,477-487,611-617)
+with a jit-compiled XLA op. Output is BT.601 limited-range I420, the format
+every H.264/VP9 baseline decoder expects.
+
+Integer-exact formulation (matches the widely used fixed-point matrix):
+    Y = (( 66 R + 129 G +  25 B + 128) >> 8) + 16
+    U = ((-38 R -  74 G + 112 B + 128) >> 8) + 128
+    V = ((112 R -  94 G -  18 B + 128) >> 8) + 128
+Chroma is subsampled by 2x2 mean (rounded), computed from the full-res U/V
+planes. Elementwise + tiny reductions — XLA fuses this into a single pass
+over HBM; a Pallas fusion with the downstream DCT is a later optimization.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["bgrx_to_i420", "rgb_to_i420", "i420_to_rgb"]
+
+
+def _mix(r: jax.Array, g: jax.Array, b: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
+    y = jnp.right_shift(66 * r + 129 * g + 25 * b + 128, 8) + 16
+    u = jnp.right_shift(-38 * r - 74 * g + 112 * b + 128, 8) + 128
+    v = jnp.right_shift(112 * r - 94 * g - 18 * b + 128, 8) + 128
+    return y, u, v
+
+
+def _subsample(plane: jax.Array) -> jax.Array:
+    """2x2 mean with rounding; plane is int32 (H, W), H and W even."""
+    h, w = plane.shape
+    q = plane.reshape(h // 2, 2, w // 2, 2)
+    return jnp.right_shift(q.sum(axis=(1, 3)) + 2, 2)
+
+
+def _to_i420(r: jax.Array, g: jax.Array, b: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
+    y, u, v = _mix(r, g, b)
+    y = jnp.clip(y, 16, 235).astype(jnp.uint8)
+    u = _subsample(jnp.clip(u, 16, 240))
+    v = _subsample(jnp.clip(v, 16, 240))
+    return y, u.astype(jnp.uint8), v.astype(jnp.uint8)
+
+
+@jax.jit
+def bgrx_to_i420(frame: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """(H, W, 4) uint8 BGRx (X11 ZPixmap layout) → (y, u, v) planes."""
+    f = frame.astype(jnp.int32)
+    return _to_i420(f[..., 2], f[..., 1], f[..., 0])
+
+
+@jax.jit
+def rgb_to_i420(frame: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """(H, W, 3) uint8 RGB → (y, u, v) planes."""
+    f = frame.astype(jnp.int32)
+    return _to_i420(f[..., 0], f[..., 1], f[..., 2])
+
+
+@jax.jit
+def i420_to_rgb(y: jax.Array, u: jax.Array, v: jax.Array) -> jax.Array:
+    """Inverse (approximate; for tests/preview only)."""
+    yf = (y.astype(jnp.float32) - 16.0) * (255.0 / 219.0)
+    up = jnp.repeat(jnp.repeat(u.astype(jnp.float32) - 128.0, 2, 0), 2, 1)
+    vp = jnp.repeat(jnp.repeat(v.astype(jnp.float32) - 128.0, 2, 0), 2, 1)
+    up = up[: y.shape[0], : y.shape[1]] * (255.0 / 224.0)
+    vp = vp[: y.shape[0], : y.shape[1]] * (255.0 / 224.0)
+    r = yf + 1.402 * vp
+    g = yf - 0.344136 * up - 0.714136 * vp
+    b = yf + 1.772 * up
+    return jnp.clip(jnp.stack([r, g, b], axis=-1) + 0.5, 0, 255).astype(jnp.uint8)

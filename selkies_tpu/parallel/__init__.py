@@ -1,0 +1,7 @@
+"""Device-mesh parallelism: multi-session placement and intra-frame sharding.
+
+The reference scales out with one process per session plus K8s fleet
+discovery (SURVEY.md §2.6). Here, 8x 1080p60 sessions map onto a v5e-8 slice
+as a jax.sharding.Mesh with one stream per chip; 4K frames can band-split
+across chips as independent slices.
+"""

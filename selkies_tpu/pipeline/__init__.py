@@ -1,0 +1,6 @@
+"""Asyncio pipeline framework + app core (TPUWebRTCApp).
+
+Re-imagines the reference's GStreamer element graph + GSTWebRTCApp
+(gstwebrtc_app.py:67) as a small asyncio-native pipeline with the compute
+plane on TPU.
+"""

@@ -1,0 +1,5 @@
+"""WebRTC signalling: server (HTTP+WS+/turn) and in-process client.
+
+Protocol parity with the reference: HELLO/SESSION/SESSION_OK/ROOM plus JSON
+sdp/ice relay (signalling_web.py:374-473, webrtc_signalling.py:155-210).
+"""

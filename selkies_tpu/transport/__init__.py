@@ -1,0 +1,4 @@
+"""Media transport: RTP payloaders, WebSocket media transport, data channels.
+
+The byte plane (RTP/ICE/DTLS) is host-side; only encode runs on TPU.
+"""

@@ -1,0 +1,1 @@
+"""Shared utilities: bitstream writers/readers, small helpers."""

@@ -1,0 +1,42 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths are
+exercised without TPU hardware (the real chip is reserved for bench.py).
+
+This environment injects an axon TPU PJRT plugin into every Python process
+via sitecustomize when PALLAS_AXON_POOL_IPS is set; the TPU tunnel is
+single-client and, once the plugin is registered, even JAX_PLATFORMS=cpu
+processes block on it. sitecustomize runs before pytest, so the only
+reliable opt-out is to re-exec the interpreter with a cleaned environment.
+The re-exec happens in pytest_configure (after capture starts) so we can
+restore the real stdout/stderr fds first — an execve while pytest's fd
+capture is active would write all output into a deleted tempfile.
+"""
+
+import os
+import sys
+
+_GUARD = "SELKIES_TPU_TEST_REEXEC"
+
+
+def _cpu_env(env: dict) -> dict:
+    env = dict(env)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env[_GUARD] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    return env
+
+
+def pytest_configure(config):
+    if not os.environ.get("PALLAS_AXON_POOL_IPS") or os.environ.get(_GUARD):
+        os.environ.update({k: v for k, v in _cpu_env(os.environ).items() if k != _GUARD})
+        return
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        capman.stop_global_capturing()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.execve(sys.executable, [sys.executable, "-m", "pytest", *sys.argv[1:]], _cpu_env(os.environ))

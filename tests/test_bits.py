@@ -1,0 +1,52 @@
+import pytest
+
+from selkies_tpu.utils.bits import BitReader, BitWriter, annexb_nal, emulation_prevent
+
+
+def test_bitwriter_basic():
+    w = BitWriter()
+    w.write_bits(0b101, 3)
+    w.write_bits(0b11111, 5)
+    assert w.get_bytes() == bytes([0b10111111])
+
+
+def test_ue_se_roundtrip():
+    w = BitWriter()
+    values = list(range(40)) + [255, 1023, 65535]
+    for v in values:
+        w.write_ue(v)
+    svalues = [0, 1, -1, 2, -2, 17, -17, 300, -300]
+    for v in svalues:
+        w.write_se(v)
+    w.byte_align()
+    r = BitReader(w.get_bytes())
+    assert [r.read_ue() for _ in values] == values
+    assert [r.read_se() for _ in svalues] == svalues
+
+
+def test_ue_known_codes():
+    # 0 -> '1', 1 -> '010', 2 -> '011', 3 -> '00100'
+    w = BitWriter()
+    w.write_ue(3)
+    w.write_bits(0, 3)  # pad to byte
+    assert w.get_bytes() == bytes([0b00100000])
+
+
+def test_unaligned_get_bytes_raises():
+    w = BitWriter()
+    w.write_bit(1)
+    with pytest.raises(ValueError):
+        w.get_bytes()
+
+
+def test_emulation_prevention():
+    assert emulation_prevent(b"\x00\x00\x00") == b"\x00\x00\x03\x00"
+    assert emulation_prevent(b"\x00\x00\x01") == b"\x00\x00\x03\x01"
+    assert emulation_prevent(b"\x00\x00\x04") == b"\x00\x00\x04"
+    # consecutive triggers
+    assert emulation_prevent(b"\x00\x00\x00\x00\x00") == b"\x00\x00\x03\x00\x00\x03\x00"
+
+
+def test_annexb_nal():
+    nal = annexb_nal(3, 7, b"\x42")
+    assert nal == b"\x00\x00\x00\x01\x67\x42"

@@ -1,0 +1,61 @@
+import numpy as np
+
+from selkies_tpu.ops.colorspace import bgrx_to_i420, i420_to_rgb, rgb_to_i420
+
+
+def _numpy_rgb_to_i420(rgb):
+    f = rgb.astype(np.int64)
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    y = ((66 * r + 129 * g + 25 * b + 128) >> 8) + 16
+    u = ((-38 * r - 74 * g + 112 * b + 128) >> 8) + 128
+    v = ((112 * r - 94 * g - 18 * b + 128) >> 8) + 128
+    y = np.clip(y, 16, 235).astype(np.uint8)
+
+    def sub(p):
+        p = np.clip(p, 16, 240)
+        h, w = p.shape
+        q = p.reshape(h // 2, 2, w // 2, 2).sum(axis=(1, 3))
+        return ((q + 2) >> 2).astype(np.uint8)
+
+    return y, sub(u), sub(v)
+
+
+def test_rgb_matches_numpy_golden():
+    rng = np.random.default_rng(0)
+    rgb = rng.integers(0, 256, size=(64, 96, 3), dtype=np.uint8)
+    y, u, v = rgb_to_i420(rgb)
+    gy, gu, gv = _numpy_rgb_to_i420(rgb)
+    np.testing.assert_array_equal(np.asarray(y), gy)
+    np.testing.assert_array_equal(np.asarray(u), gu)
+    np.testing.assert_array_equal(np.asarray(v), gv)
+
+
+def test_bgrx_channel_order():
+    rgb = np.zeros((16, 16, 3), dtype=np.uint8)
+    rgb[..., 0] = 200  # pure red
+    bgrx = np.zeros((16, 16, 4), dtype=np.uint8)
+    bgrx[..., 2] = 200
+    y1, u1, v1 = rgb_to_i420(rgb)
+    y2, u2, v2 = bgrx_to_i420(bgrx)
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+    np.testing.assert_array_equal(np.asarray(u1), np.asarray(u2))
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+
+
+def test_gray_flat():
+    rgb = np.full((32, 32, 3), 128, dtype=np.uint8)
+    y, u, v = rgb_to_i420(rgb)
+    assert np.all(np.asarray(u) == 128)
+    assert np.all(np.asarray(v) == 128)
+    # limited-range gray: (220*128+128)>>8 + 16 = 126
+    assert np.all(np.abs(np.asarray(y).astype(int) - 126) <= 1)
+
+
+def test_rgb_roundtrip_close():
+    rng = np.random.default_rng(1)
+    # smooth image so 4:2:0 subsampling loss is small
+    base = rng.integers(40, 216, size=(8, 8, 3), dtype=np.uint8)
+    rgb = np.kron(base, np.ones((8, 8, 1), dtype=np.uint8))
+    y, u, v = rgb_to_i420(rgb)
+    back = np.asarray(i420_to_rgb(y, u, v)).astype(int)
+    assert np.mean(np.abs(back - rgb.astype(int))) < 6.0

@@ -1,0 +1,85 @@
+"""Conformance: full-frame CAVLC intra encodes must decode bit-exactly.
+
+FFmpeg (via cv2) is the reference decoder. Decoded output only reaches us
+as BGR (swscale), so "bit-exact" is asserted as MAE < 1.5 / max diff <= 4
+against our own reconstruction converted with the same BT.601 limited-range
+matrix — a single coefficient or table error desyncs CAVLC and blows these
+bounds by an order of magnitude.
+
+The exhaustive per-table-slot validation lives in tools/cavlc_probe.py
+(run offline; it brute-forced every VLC table entry against FFmpeg).
+"""
+
+import numpy as np
+import pytest
+
+cv2 = pytest.importorskip("cv2")
+
+from selkies_tpu.models.h264.cavlc import encode_stream
+
+
+def _decode(path):
+    cap = cv2.VideoCapture(str(path))
+    frames = []
+    while True:
+        ok, f = cap.read()
+        if not ok:
+            break
+        frames.append(f)
+    cap.release()
+    return frames
+
+
+def _expected_bgr(enc):
+    ge = enc.recon_y.astype(int)
+    up = np.repeat(np.repeat(enc.recon_u.astype(int), 2, 0), 2, 1)
+    vp = np.repeat(np.repeat(enc.recon_v.astype(int), 2, 0), 2, 1)
+    yf = (ge - 16) * 1.164383
+    r = np.clip(yf + 1.596027 * (vp - 128) + 0.5, 0, 255).astype(int)
+    g = np.clip(yf - 0.391762 * (up - 128) - 0.812968 * (vp - 128) + 0.5, 0, 255).astype(int)
+    b = np.clip(yf + 2.017232 * (up - 128) + 0.5, 0, 255).astype(int)
+    return np.stack([b, g, r], -1)
+
+
+def _roundtrip(tmp_path, y, u, v, qp):
+    data, enc = encode_stream(y, u, v, qp=qp)
+    path = tmp_path / "s.h264"
+    path.write_bytes(data)
+    frames = _decode(path)
+    assert len(frames) == 1, f"decode failed at qp={qp}"
+    d = np.abs(frames[0].astype(int) - _expected_bgr(enc))
+    assert d.mean() < 1.5 and d.max() <= 4, f"qp={qp}: MAE={d.mean():.2f} max={d.max()}"
+    return enc, len(data)
+
+
+@pytest.mark.parametrize("qp", [0, 10, 24, 37, 51])
+def test_noise_roundtrip(tmp_path, qp):
+    rng = np.random.default_rng(9)
+    h, w = 48, 64
+    y = rng.integers(0, 256, (h, w)).astype(np.uint8)
+    u = rng.integers(0, 256, (h // 2, w // 2)).astype(np.uint8)
+    v = rng.integers(0, 256, (h // 2, w // 2)).astype(np.uint8)
+    _roundtrip(tmp_path, y, u, v, qp)
+
+
+def test_structured_content_quality(tmp_path):
+    rng = np.random.default_rng(5)
+    h, w = 64, 96
+    y = np.kron(rng.integers(16, 235, (h // 8, w // 8)), np.ones((8, 8))).astype(np.uint8)
+    u = np.full((h // 2, w // 2), 110, np.uint8)
+    v = np.full((h // 2, w // 2), 140, np.uint8)
+    enc, nbytes = _roundtrip(tmp_path, y, u, v, qp=24)
+    psnr = 10 * np.log10(255**2 / max(1e-9, np.mean((enc.recon_y.astype(float) - y) ** 2)))
+    assert psnr > 40.0
+    # flat-ish content should compress far below raw size
+    assert nbytes < h * w
+
+
+def test_rate_decreases_with_qp(tmp_path):
+    rng = np.random.default_rng(11)
+    h, w = 48, 48
+    y = rng.integers(0, 256, (h, w)).astype(np.uint8)
+    u = np.full((h // 2, w // 2), 128, np.uint8)
+    v = np.full((h // 2, w // 2), 128, np.uint8)
+    sizes = [_roundtrip(tmp_path, y, u, v, qp)[1] for qp in (10, 26, 42)]
+    assert sizes[0] > sizes[1] > sizes[2]

@@ -1,0 +1,81 @@
+"""Conformance: our NAL/SPS/PPS/slice framing must be decodable by FFmpeg.
+
+Uses I_PCM macroblocks (raw samples, no transform/entropy coding) so this
+test isolates the *framing* layer: if it fails, headers are wrong; CAVLC
+tests build on top of this foundation.
+"""
+
+import numpy as np
+import pytest
+
+cv2 = pytest.importorskip("cv2")
+
+from selkies_tpu.models.h264.bitstream import StreamParams, ipcm_frame, write_pps, write_sps
+
+
+def _decode_h264(path):
+    cap = cv2.VideoCapture(str(path))
+    frames = []
+    while True:
+        ok, frame = cap.read()
+        if not ok:
+            break
+        frames.append(frame)
+    cap.release()
+    return frames
+
+
+def _make_stream(tmp_path, y, u, v, n_frames=1):
+    p = StreamParams(width=y.shape[1], height=y.shape[0])
+    data = write_sps(p) + write_pps(p)
+    for i in range(n_frames):
+        data += ipcm_frame(p, y, u, v, frame_num=0, idr=True, )
+    path = tmp_path / "test.h264"
+    path.write_bytes(data)
+    return path
+
+
+def test_ipcm_flat_gray_decodes(tmp_path):
+    h, w = 48, 64
+    y = np.full((h, w), 126, np.uint8)
+    u = np.full((h // 2, w // 2), 128, np.uint8)
+    v = np.full((h // 2, w // 2), 128, np.uint8)
+    frames = _decode_h264(_make_stream(tmp_path, y, u, v))
+    assert len(frames) == 1
+    f = frames[0]
+    assert f.shape == (h, w, 3)
+    # Y=126 limited range ≈ 128 in RGB, U=V=128 → gray
+    assert abs(int(f.mean()) - 128) <= 2
+    assert f.std() < 1.5
+
+
+def test_ipcm_pattern_roundtrip(tmp_path):
+    rng = np.random.default_rng(7)
+    h, w = 32, 48
+    # smooth-ish luma pattern, neutral chroma → decoded BGR should be gray levels
+    base = rng.integers(30, 220, size=(h // 8, w // 8), dtype=np.uint8)
+    y = np.kron(base, np.ones((8, 8), dtype=np.uint8))
+    u = np.full((h // 2, w // 2), 128, np.uint8)
+    v = np.full((h // 2, w // 2), 128, np.uint8)
+    frames = _decode_h264(_make_stream(tmp_path, y, u, v))
+    assert len(frames) == 1
+    got = frames[0][..., 0].astype(int)  # B channel; gray so B=G=R
+    # limited-range Y → full-range RGB: rgb = (y - 16) * 255/219
+    expected = np.clip((y.astype(int) - 16) * 255.0 / 219.0 + 0.5, 0, 255).astype(int)
+    assert np.abs(got - expected).mean() < 2.0
+
+
+def test_ipcm_crop_non_multiple_of_16(tmp_path):
+    # 50x34 → padded to 64x48 with cropping in SPS
+    h, w = 34, 50
+    hp, wp = 48, 64
+    y = np.full((hp, wp), 90, np.uint8)
+    u = np.full((hp // 2, wp // 2), 128, np.uint8)
+    v = np.full((hp // 2, wp // 2), 128, np.uint8)
+    p = StreamParams(width=w, height=h)
+    data = write_sps(p) + write_pps(p) + ipcm_frame(p, y, u, v)
+    path = tmp_path / "crop.h264"
+    path.write_bytes(data)
+    frames = _decode_h264(path)
+    assert len(frames) == 1
+    assert frames[0].shape == (h, w, 3)
