@@ -148,7 +148,9 @@ def pack_slice(
     """Entropy-code a whole frame of Intra16x16 MBs into one slice NAL."""
     mbh, mbw = fc.luma_mode.shape
     w = BitWriter()
-    write_slice_header(w, p, SLICE_I, frame_num, idr=idr, idr_pic_id=idr_pic_id)
+    # fc.qp is the QP the coefficients were quantized with; slice_qp_delta
+    # carries any difference from pic_init_qp (live rate-control retunes).
+    write_slice_header(w, p, SLICE_I, frame_num, idr=idr, idr_pic_id=idr_pic_id, slice_qp=fc.qp)
 
     # nC context grids (TotalCoeff per 4x4 block, frame-wide)
     luma_tc = np.zeros((mbh * 4, mbw * 4), np.int32)

@@ -1,0 +1,168 @@
+"""tpuh264enc — the TPU-native H.264 encoder element.
+
+Replaces the reference's nvh264enc/vah264enc/x264enc/openh264enc rows of
+the encoder matrix (gstwebrtc_app.py:260-367,475-508,609-665). The device
+half (colorspace, prediction, transforms, quantization) is one jitted XLA
+program per resolution (encoder_core.py); the host half is the C++ CAVLC
+packer (native/cavlc_pack.cc). QP is a traced argument, so the GCC
+congestion-control loop can retune bitrate every frame without
+recompilation (reference: set_video_bitrate, gstwebrtc_app.py:1296).
+
+Latency design: the device step returns int16 coefficient tensors (half
+the PCIe traffic of int32); reconstruction planes stay on device for the
+future P-frame path. Double-buffering (dispatch frame N+1 while N packs on
+host) happens naturally because JAX dispatch is async — encode_frame
+blocks only on the coefficient device→host copy.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from selkies_tpu.models.h264.bitstream import StreamParams, write_pps, write_sps
+from selkies_tpu.models.h264.encoder_core import encode_frame_planes
+from selkies_tpu.models.h264.native import pack_slice_fast
+from selkies_tpu.models.h264.numpy_ref import FrameCoeffs
+from selkies_tpu.ops.colorspace import bgrx_to_i420, rgb_to_i420
+
+__all__ = ["TPUH264Encoder", "make_frame_step"]
+
+
+def _device_step(frame, qp, *, pad_h: int, pad_w: int, channels: int):
+    """Full device path: packed frame -> padded planes -> coeff tensors."""
+    if channels == 4:
+        y, u, v = bgrx_to_i420(frame)
+    else:
+        y, u, v = rgb_to_i420(frame)
+    h, w = y.shape
+    if (pad_h, pad_w) != (h, w):
+        y = jnp.pad(y, ((0, pad_h - h), (0, pad_w - w)), mode="edge")
+        u = jnp.pad(u, ((0, (pad_h - h) // 2), (0, (pad_w - w) // 2)), mode="edge")
+        v = jnp.pad(v, ((0, (pad_h - h) // 2), (0, (pad_w - w) // 2)), mode="edge")
+    out = encode_frame_planes(y, u, v, qp)
+    return {
+        k: (out[k].astype(jnp.int16) if out[k].dtype == jnp.int32 else out[k])
+        for k in out
+    }
+
+
+@dataclass
+class FrameStats:
+    frame_index: int
+    idr: bool
+    qp: int
+    bytes: int
+    device_ms: float
+    pack_ms: float
+
+
+class TPUH264Encoder:
+    """Stateful per-stream encoder: frame in, Annex-B access unit out."""
+
+    def __init__(self, width: int, height: int, qp: int = 28, fps: int = 60, channels: int = 4):
+        self.width = width
+        self.height = height
+        self.fps = fps
+        self.qp = int(qp)
+        self.channels = channels
+        self.params = StreamParams(width=width, height=height, qp=self.qp, fps=fps)
+        self._headers = write_sps(self.params) + write_pps(self.params)
+        self._pad_h = (height + 15) // 16 * 16
+        self._pad_w = (width + 15) // 16 * 16
+        self._step = jax.jit(
+            lambda frame, qp: _device_step(
+                frame, qp, pad_h=self._pad_h, pad_w=self._pad_w, channels=channels
+            )
+        )
+        self.frame_index = 0
+        self._frames_since_idr = 0
+        self._idr_pic_id = 0
+        self._force_idr = True
+        self.last_stats: FrameStats | None = None
+
+    # -- live retune API (parity: set_video_bitrate path ends here) --
+
+    def set_qp(self, qp: int) -> None:
+        if not 0 <= qp <= 51:
+            raise ValueError(f"qp {qp} out of range")
+        self.qp = int(qp)
+
+    def force_keyframe(self) -> None:
+        self._force_idr = True
+
+    # -- encoding --
+
+    def encode_frame(self, frame: np.ndarray, qp: int | None = None) -> bytes:
+        """Encode one packed frame ((H, W, 4) BGRx or (H, W, 3) RGB uint8).
+
+        Returns a complete Annex-B access unit (SPS/PPS prepended on IDR).
+        """
+        if qp is not None:
+            self.set_qp(qp)
+        idr = self._force_idr or self.frame_index == 0
+        t0 = time.perf_counter()
+        out = self._step(frame, np.int32(self.qp))
+        fc = FrameCoeffs(
+            luma_mode=np.asarray(out["luma_mode"]),
+            chroma_mode=np.asarray(out["chroma_mode"]),
+            luma_dc=np.asarray(out["luma_dc"]),
+            luma_ac=np.asarray(out["luma_ac"]),
+            chroma_dc=np.asarray(out["chroma_dc"]),
+            chroma_ac=np.asarray(out["chroma_ac"]),
+            qp=self.qp,
+        )
+        if idr:
+            self._frames_since_idr = 0
+        t1 = time.perf_counter()
+        # frame_num counts from the last IDR (7.4.3: gaps are disallowed by
+        # our SPS, so it must be PrevRefFrameNum+1 mod MaxFrameNum).
+        slice_nal = pack_slice_fast(
+            fc,
+            self.params,
+            frame_num=self._frames_since_idr % 256,
+            idr=idr,
+            idr_pic_id=self._idr_pic_id,
+        )
+        t2 = time.perf_counter()
+        au = (self._headers + slice_nal) if idr else slice_nal
+        if idr:
+            self._idr_pic_id = (self._idr_pic_id + 1) % 2
+        self.last_stats = FrameStats(
+            frame_index=self.frame_index,
+            idr=idr,
+            qp=self.qp,
+            bytes=len(au),
+            device_ms=(t1 - t0) * 1e3,
+            pack_ms=(t2 - t1) * 1e3,
+        )
+        self.frame_index += 1
+        self._frames_since_idr += 1
+        self._force_idr = False
+        return au
+
+    def recon_planes(self, frame: np.ndarray):
+        """Debug helper: (recon_y, recon_u, recon_v) for a frame."""
+        out = self._step(frame, np.int32(self.qp))
+        return (
+            np.asarray(out["recon_y"]),
+            np.asarray(out["recon_u"]),
+            np.asarray(out["recon_v"]),
+        )
+
+
+def make_frame_step(width: int, height: int, qp: int = 28):
+    """(jittable fn, example args) for the driver's compile check."""
+    pad_h = (height + 15) // 16 * 16
+    pad_w = (width + 15) // 16 * 16
+
+    def fn(frame, qp_arr):
+        return _device_step(frame, qp_arr, pad_h=pad_h, pad_w=pad_w, channels=4)
+
+    rng = np.random.default_rng(0)
+    frame = rng.integers(0, 256, size=(height, width, 4), dtype=np.uint8)
+    return fn, (frame, np.int32(qp))

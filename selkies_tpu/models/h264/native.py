@@ -1,0 +1,138 @@
+"""ctypes binding for the C++ CAVLC packer (native/cavlc_pack.cc).
+
+Loads (and lazily builds, when a toolchain is present) native/libcavlc.so.
+`pack_slice_native` is byte-identical to cavlc.pack_slice (asserted by
+tests/test_native_pack.py); callers use `pack_slice_fast`, which picks the
+native packer when available and falls back to pure Python.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+
+import numpy as np
+
+from selkies_tpu.models.h264.bitstream import (
+    NAL_SLICE_IDR,
+    NAL_SLICE_NON_IDR,
+    SLICE_I,
+    StreamParams,
+    write_slice_header,
+)
+from selkies_tpu.models.h264.cavlc import pack_slice as pack_slice_py
+from selkies_tpu.models.h264.numpy_ref import FrameCoeffs
+from selkies_tpu.utils.bits import BitWriter
+
+logger = logging.getLogger("h264.native")
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libcavlc.so")
+
+_lib = None
+_lib_tried = False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    if not os.path.exists(_LIB_PATH) and os.path.exists(os.path.join(_NATIVE_DIR, "Makefile")):
+        try:
+            subprocess.run(["make", "-C", _NATIVE_DIR, "-s"], check=True, capture_output=True, timeout=120)
+        except (OSError, subprocess.SubprocessError) as exc:
+            logger.warning("could not build libcavlc.so (%s); using Python packer", exc)
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError as exc:
+        logger.warning("could not load libcavlc.so (%s); using Python packer", exc)
+        return None
+    lib.pack_slice_rbsp.restype = ctypes.c_int64
+    lib.pack_slice_rbsp.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int16), ctypes.POINTER(ctypes.c_int16),
+        ctypes.POINTER(ctypes.c_int16), ctypes.POINTER(ctypes.c_int16),
+        ctypes.POINTER(ctypes.c_int16), ctypes.POINTER(ctypes.c_int16),
+        ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.emulation_prevent.restype = ctypes.c_int64
+    lib.emulation_prevent.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+    ]
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _i32ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _i16ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int16))
+
+
+def pack_slice_native(
+    fc: FrameCoeffs,
+    p: StreamParams,
+    frame_num: int = 0,
+    idr: bool = True,
+    idr_pic_id: int = 0,
+) -> bytes:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("libcavlc.so unavailable")
+    mbh, mbw = fc.luma_mode.shape
+
+    hdr = BitWriter()
+    write_slice_header(hdr, p, SLICE_I, frame_num, idr=idr, idr_pic_id=idr_pic_id, slice_qp=fc.qp)
+    hdr_bytes, hdr_bits = hdr.get_partial()
+
+    arrs = {
+        name: np.ascontiguousarray(getattr(fc, name), dtype=np.int16)
+        for name in ("luma_mode", "chroma_mode", "luma_dc", "luma_ac", "chroma_dc", "chroma_ac")
+    }
+    cap = mbh * mbw * 1024 + len(hdr_bytes) + 1024
+    luma_tc = np.empty(mbh * 4 * mbw * 4, np.int32)
+    chroma_tc = np.empty(2 * mbh * 2 * mbw * 2, np.int32)
+    while True:
+        rbsp = np.empty(cap, np.uint8)
+        n = lib.pack_slice_rbsp(
+            hdr_bytes, hdr_bits,
+            _i16ptr(arrs["luma_mode"]), _i16ptr(arrs["chroma_mode"]),
+            _i16ptr(arrs["luma_dc"]), _i16ptr(arrs["luma_ac"]),
+            _i16ptr(arrs["chroma_dc"]), _i16ptr(arrs["chroma_ac"]),
+            mbh, mbw,
+            rbsp.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), cap,
+            _i32ptr(luma_tc), _i32ptr(chroma_tc),
+        )
+        if n >= 0:
+            break
+        cap *= 2  # pathological content; retry with more room
+        if cap > (1 << 30):
+            raise RuntimeError("pack_slice_rbsp overflow beyond 1 GiB")
+    ebsp = np.empty(n + n // 2 + 16, np.uint8)
+    m = lib.emulation_prevent(
+        rbsp[:n].tobytes(), n, ebsp.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(ebsp)
+    )
+    if m < 0:
+        raise RuntimeError("emulation_prevent overflow")
+    nal_type = NAL_SLICE_IDR if idr else NAL_SLICE_NON_IDR
+    header = bytes([(3 << 5) | nal_type])
+    return b"\x00\x00\x00\x01" + header + ebsp[:m].tobytes()
+
+
+def pack_slice_fast(fc, p, frame_num=0, idr=True, idr_pic_id=0) -> bytes:
+    """Native packer when available, Python fallback otherwise."""
+    if native_available():
+        return pack_slice_native(fc, p, frame_num=frame_num, idr=idr, idr_pic_id=idr_pic_id)
+    return pack_slice_py(fc, p, frame_num=frame_num, idr=idr, idr_pic_id=idr_pic_id)

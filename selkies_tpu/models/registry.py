@@ -1,0 +1,88 @@
+"""Encoder registry — the TPU-native analogue of the encoder matrix.
+
+The reference builds one of 15 encoder element chains by name
+(gstwebrtc_app.py:260-783, supported list :1133) with an `ADD_ENCODER:`
+grep-marker protocol for extensions (:257,943,1132). Here the matrix
+collapses: every codec targets the same TPU compute core, so the registry
+maps encoder names to factory callables, and legacy GStreamer encoder
+names alias to their TPU equivalent so existing SELKIES_ENCODER configs
+keep working.
+
+ADD_ENCODER: register new encoders with @register("name") below.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+logger = logging.getLogger("models.registry")
+
+_FACTORIES: dict[str, Callable] = {}
+_ALIASES: dict[str, str] = {}
+
+
+def register(name: str) -> Callable[[Callable], Callable]:
+    def deco(factory: Callable) -> Callable:
+        _FACTORIES[name] = factory
+        return factory
+
+    return deco
+
+
+def alias(name: str, target: str) -> None:
+    _ALIASES[name] = target
+
+
+def encoder_exists(name: str) -> bool:
+    return name in _FACTORIES or name in _ALIASES
+
+
+def supported_encoders() -> list[str]:
+    return sorted(_FACTORIES) + sorted(_ALIASES)
+
+
+def create_encoder(name: str, *, width: int, height: int, fps: int = 60, **kw):
+    if name in _ALIASES:
+        target = _ALIASES[name]
+        logger.info("encoder %r aliased to %r (TPU-native equivalent)", name, target)
+        name = target
+    if name not in _FACTORIES:
+        raise ValueError(f"unknown encoder {name!r}; supported: {supported_encoders()}")
+    return _FACTORIES[name](width=width, height=height, fps=fps, **kw)
+
+
+# ADD_ENCODER: factories
+
+
+@register("tpuh264enc")
+def _tpuh264enc(*, width: int, height: int, fps: int = 60, qp: int = 28, **kw):
+    from selkies_tpu.models.h264.encoder import TPUH264Encoder
+
+    return TPUH264Encoder(width=width, height=height, qp=qp, fps=fps, **kw)
+
+
+@register("tpuvp9enc")
+def _tpuvp9enc(**kw):
+    raise NotImplementedError(
+        "tpuvp9enc is scheduled after the H.264 path (SURVEY.md §7 step 5); "
+        "use tpuh264enc"
+    )
+
+
+@register("tpuav1enc")
+def _tpuav1enc(**kw):
+    raise NotImplementedError(
+        "tpuav1enc is scheduled after the H.264 path (SURVEY.md §7 step 5); "
+        "use tpuh264enc"
+    )
+
+
+# Legacy GStreamer encoder names (reference gstwebrtc_app.py:1133) map to
+# the TPU equivalent so existing SELKIES_ENCODER values keep working.
+for _legacy_h264 in ("nvh264enc", "vah264enc", "x264enc", "openh264enc"):
+    alias(_legacy_h264, "tpuh264enc")
+for _legacy_vp9 in ("vp9enc", "vavp9enc"):
+    alias(_legacy_vp9, "tpuvp9enc")
+for _legacy_av1 in ("nvav1enc", "vaav1enc", "svtav1enc", "av1enc", "rav1enc"):
+    alias(_legacy_av1, "tpuav1enc")

@@ -1,0 +1,207 @@
+"""TPUWebRTCApp — the app core / pipeline builder.
+
+API parity with the reference's GSTWebRTCApp (gstwebrtc_app.py:67): the
+same lifecycle (start_pipeline/stop_pipeline), live retune entry points
+(set_video_bitrate/set_framerate/set_audio_bitrate), SDP/ICE plumbing
+(set_sdp/set_ice + on_sdp/on_ice callbacks), and the server→client data
+channel vocabulary (send_* methods emitting {"type": t, "data": {...}}
+JSON, gstwebrtc_app.py:1454-1579). The media plane differs by design:
+frames flow through the TPU encoder pipeline (pipeline/elements.py), and
+the byte plane is a pluggable Transport (transport/), not webrtcbin.
+
+set_video_bitrate(cc=True) is the GCC congestion-control entry point —
+the rtpgccbwe estimated-bitrate signal lands here and drives the CBR
+controller's target (reference wiring gstwebrtc_app.py:1638-1655).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import logging
+from typing import Any, Awaitable, Callable, Protocol
+
+from selkies_tpu.models.registry import create_encoder, encoder_exists
+from selkies_tpu.models.h264.ratecontrol import CbrRateController
+from selkies_tpu.pipeline.elements import EncodedFrame, FrameSource, SyntheticSource, VideoPipeline
+
+logger = logging.getLogger("tpuwebrtc_app")
+
+DEFAULT_VIDEO_BITRATE_KBPS = 2000
+
+
+class Transport(Protocol):
+    """Byte-plane the app talks to (WebSocket or WebRTC implementations)."""
+
+    @property
+    def data_channel_ready(self) -> bool: ...
+
+    def send_data_channel(self, message: str) -> None: ...
+
+    async def send_video(self, frame: EncodedFrame) -> None: ...
+
+
+class TPUWebRTCApp:
+    def __init__(
+        self,
+        source: FrameSource | None = None,
+        transport: Transport | None = None,
+        encoder: str = "tpuh264enc",
+        width: int = 1280,
+        height: int = 720,
+        framerate: int = 60,
+        video_bitrate_kbps: int = DEFAULT_VIDEO_BITRATE_KBPS,
+        congestion_control: bool = False,
+    ):
+        if not encoder_exists(encoder):
+            raise ValueError(f"unknown encoder {encoder!r} (see models.registry)")
+        self.encoder_name = encoder
+        self.source = source or SyntheticSource(width, height)
+        self.transport = transport
+        self.framerate = framerate
+        self.congestion_control = congestion_control
+        self.video_bitrate_kbps = video_bitrate_kbps
+        self.encoder = create_encoder(encoder, width=self.source.width, height=self.source.height, fps=framerate)
+        self.rc = CbrRateController(bitrate_kbps=video_bitrate_kbps, fps=framerate)
+        self.pipeline: VideoPipeline | None = None
+
+        # callbacks wired by the orchestrator (__main__.py parity :684-871)
+        self.on_sdp: Callable[[str, str], None] = lambda t, s: None
+        self.on_ice: Callable[[int, str], None] = lambda m, c: None
+        self.on_data_message: Callable[[str], Awaitable[None] | None] = lambda m: None
+        self.on_data_open: Callable[[], None] = lambda: None
+        self.on_frame: Callable[[EncodedFrame], None] = lambda f: None
+
+        self.last_cursor_sent: Any = None
+
+    # ------------------------------------------------------------------
+    # lifecycle (reference :1759, :1810)
+
+    async def start_pipeline(self) -> None:
+        logger.info(
+            "starting pipeline: %s %dx%d@%d, %d kbps",
+            self.encoder_name, self.source.width, self.source.height, self.framerate, self.video_bitrate_kbps,
+        )
+        self.pipeline = VideoPipeline(
+            source=self.source,
+            encoder=self.encoder,
+            rate_controller=self.rc,
+            sink=self._video_sink,
+            fps=self.framerate,
+        )
+        await self.pipeline.start()
+
+    async def stop_pipeline(self) -> None:
+        if self.pipeline is not None:
+            await self.pipeline.stop()
+            self.pipeline = None
+            logger.info("pipeline stopped")
+
+    async def _video_sink(self, ef: EncodedFrame) -> None:
+        self.on_frame(ef)
+        if self.transport is not None:
+            await self.transport.send_video(ef)
+
+    # ------------------------------------------------------------------
+    # live retune (reference :1217, :1296, :1414, :1442)
+
+    def set_framerate(self, framerate: int) -> None:
+        self.framerate = int(framerate)
+        if self.pipeline is not None:
+            self.pipeline.set_framerate(framerate)
+        else:
+            self.rc.set_framerate(framerate)
+
+    def set_video_bitrate(self, bitrate_kbps: int, cc: bool = False) -> None:
+        """Retarget video bitrate; cc=True marks a congestion-control
+        update (not persisted / not echoed to the client UI)."""
+        self.rc.set_bitrate(bitrate_kbps)
+        if not cc:
+            self.video_bitrate_kbps = int(bitrate_kbps)
+
+    def set_audio_bitrate(self, bitrate: int) -> None:
+        self.audio_bitrate = int(bitrate)
+
+    def set_pointer_visible(self, visible: bool) -> None:
+        self.pointer_visible = bool(visible)
+
+    def force_keyframe(self) -> None:
+        self.encoder.force_keyframe()
+
+    # ------------------------------------------------------------------
+    # SDP/ICE plumbing: delegated to the transport when it supports WebRTC
+
+    def set_sdp(self, sdp_type: str, sdp: str) -> None:
+        if self.transport is not None and hasattr(self.transport, "set_remote_sdp"):
+            self.transport.set_remote_sdp(sdp_type, sdp)
+
+    def set_ice(self, mlineindex: int, candidate: str) -> None:
+        if self.transport is not None and hasattr(self.transport, "add_remote_ice"):
+            self.transport.add_remote_ice(mlineindex, candidate)
+
+    # ------------------------------------------------------------------
+    # data channel vocabulary (reference :1454-1579)
+
+    def is_data_channel_ready(self) -> bool:
+        return self.transport is not None and self.transport.data_channel_ready
+
+    def _send(self, msg_type: str, data: Any) -> None:
+        if not self.is_data_channel_ready():
+            logger.debug("dropping %s: data channel not ready", msg_type)
+            return
+        self.transport.send_data_channel(json.dumps({"type": msg_type, "data": data}))
+
+    def send_clipboard_data(self, data: str) -> None:
+        payload = base64.b64encode(data.encode()).decode("utf-8")
+        if len(payload) > 65400:
+            logger.warning("clipboard too large for data channel (%d b64 bytes)", len(payload))
+            return
+        self._send("clipboard", {"content": payload})
+
+    def send_cursor_data(self, data: Any) -> None:
+        self.last_cursor_sent = data
+        self._send("cursor", data)
+
+    def send_gpu_stats(self, load: float, memory_total: float, memory_used: float) -> None:
+        self._send("gpu_stats", {"load": load, "memory_total": memory_total, "memory_used": memory_used})
+
+    def send_tpu_stats(self, duty_cycle: float, hbm_total: float, hbm_used: float) -> None:
+        """TPU twin of send_gpu_stats (the client renders either)."""
+        self._send("gpu_stats", {"load": duty_cycle, "memory_total": hbm_total, "memory_used": hbm_used})
+
+    def send_reload_window(self) -> None:
+        self._send("system", {"action": "reload"})
+
+    def send_framerate(self, framerate: int) -> None:
+        self._send("system", {"action": f"framerate,{framerate}"})
+
+    def send_video_bitrate(self, bitrate: int) -> None:
+        self._send("system", {"action": f"video_bitrate,{bitrate}"})
+
+    def send_audio_bitrate(self, bitrate: int) -> None:
+        self._send("system", {"action": f"audio_bitrate,{bitrate}"})
+
+    def send_encoder(self, encoder: str) -> None:
+        self._send("system", {"action": f"encoder,{encoder}"})
+
+    def send_resize_enabled(self, resize_enabled: bool) -> None:
+        self._send("system", {"action": f"resize,{resize_enabled}"})
+
+    def send_remote_resolution(self, res: str) -> None:
+        self._send("system", {"action": f"resolution,{res}"})
+
+    def send_ping(self, t: float) -> None:
+        self._send("ping", {"start_time": float(f"{t:.3f}")})
+
+    def send_latency_time(self, latency_ms: float) -> None:
+        self._send("latency_measurement", {"latency_ms": latency_ms})
+
+    def send_system_stats(self, cpu_percent: float, mem_total: float, mem_used: float) -> None:
+        self._send("system_stats", {"cpu_percent": cpu_percent, "mem_total": mem_total, "mem_used": mem_used})
+
+    async def handle_data_message(self, message: str) -> None:
+        """Entry point for client→server data channel messages."""
+        result = self.on_data_message(message)
+        if asyncio.iscoroutine(result):
+            await result

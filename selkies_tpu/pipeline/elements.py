@@ -1,0 +1,157 @@
+"""Asyncio pipeline primitives: sources, the video pipeline loop, sinks.
+
+Re-imagines the reference's GStreamer element graph (ximagesrc ! convert !
+encode ! pay ! webrtcbin, gstwebrtc_app.py:200-1000) as a small asyncio
+loop: a ticker pulls frames from a FrameSource, the TPU encoder runs in a
+worker thread (device dispatch is async anyway), and access units flow to
+a sink callback. Queues are depth-1 latest-wins — a slow consumer drops
+frames instead of adding latency (the reference gets this from leaky
+queues + zero jitterbuffer, gstwebrtc_app.py:169,1082).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass
+from typing import Awaitable, Callable, Protocol
+
+import numpy as np
+
+logger = logging.getLogger("pipeline")
+
+
+class FrameSource(Protocol):
+    width: int
+    height: int
+
+    def capture(self) -> np.ndarray:
+        """Return the current frame as (H, W, 4) BGRx uint8."""
+        ...
+
+
+class SyntheticSource:
+    """Animated desktop-like test source (the stack's videotestsrc)."""
+
+    def __init__(self, width: int = 1280, height: int = 720, seed: int = 0):
+        self.width = width
+        self.height = height
+        rng = np.random.default_rng(seed)
+        self._base = np.full((height, width, 4), 230, np.uint8)
+        self._base[: height // 10] = (70, 60, 60, 0)
+        self._base[height // 3 : 2 * height // 3, width // 8 : width // 2] = (250, 250, 250, 0)
+        self._noise = rng.integers(0, 255, (height // 3, width // 3, 4), dtype=np.uint8)
+        self._tick = 0
+
+    def capture(self) -> np.ndarray:
+        f = self._base.copy()
+        # moving cursor block + scrolling noise region (screen-content-ish)
+        x = (self._tick * 7) % (self.width - 40)
+        y = (self._tick * 3) % (self.height - 40)
+        f[y : y + 16, x : x + 16] = (0, 0, 0, 0)
+        h3, w3 = self._noise.shape[:2]
+        f[-h3:, -w3:] = np.roll(self._noise, self._tick, axis=1)
+        self._tick += 1
+        return f
+
+
+@dataclass
+class EncodedFrame:
+    au: bytes
+    timestamp_90k: int
+    wall_time: float
+    idr: bool
+    qp: int
+    device_ms: float
+    pack_ms: float
+
+
+VideoSink = Callable[[EncodedFrame], Awaitable[None]]
+
+
+class VideoPipeline:
+    """Ticker → capture → TPU encode → rate control → sink."""
+
+    def __init__(
+        self,
+        source: FrameSource,
+        encoder,
+        rate_controller,
+        sink: VideoSink,
+        fps: float = 60.0,
+    ):
+        self.source = source
+        self.encoder = encoder
+        self.rc = rate_controller
+        self.sink = sink
+        self.fps = fps
+        self._task: asyncio.Task | None = None
+        self.frames = 0
+        self.dropped_ticks = 0
+
+    @property
+    def running(self) -> bool:
+        return self._task is not None and not self._task.done()
+
+    def set_framerate(self, fps: float) -> None:
+        self.fps = float(fps)
+        self.rc.set_framerate(fps)
+
+    async def start(self) -> None:
+        if self.running:
+            return
+        self._task = asyncio.create_task(self._run(), name="video-pipeline")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    MAX_CONSECUTIVE_FAILURES = 30
+
+    async def _run(self) -> None:
+        t0 = time.monotonic()
+        next_tick = t0
+        failures = 0
+        while True:
+            now = time.monotonic()
+            if now < next_tick:
+                await asyncio.sleep(next_tick - now)
+            next_tick = max(next_tick + 1.0 / self.fps, time.monotonic() - 0.5 / self.fps)
+
+            try:
+                frame = await asyncio.to_thread(self.source.capture)
+                qp = self.rc.frame_qp()
+                au = await asyncio.to_thread(self.encoder.encode_frame, frame, qp)
+                stats = self.encoder.last_stats
+                self.rc.update(len(au))
+                ts = int((time.monotonic() - t0) * 90000)
+                ef = EncodedFrame(
+                    au=au,
+                    timestamp_90k=ts,
+                    wall_time=time.time(),
+                    idr=stats.idr,
+                    qp=stats.qp,
+                    device_ms=stats.device_ms,
+                    pack_ms=stats.pack_ms,
+                )
+                self.frames += 1
+                failures = 0
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                failures += 1
+                logger.exception("video pipeline frame error (%d consecutive)", failures)
+                if failures >= self.MAX_CONSECUTIVE_FAILURES:
+                    logger.error("video pipeline giving up after %d failures", failures)
+                    return
+                continue
+            try:
+                await self.sink(ef)
+            except Exception:
+                logger.exception("video sink error")

@@ -1,0 +1,209 @@
+"""RTP packetization: H.264 (RFC 6184) payloader + RTP header handling.
+
+Parity target: the reference's rtph264pay element configuration —
+mtu=1200, aggregate-mode zero-latency, config-interval -1 (in-band
+SPS/PPS on every IDR) — gstwebrtc_app.py:806-846. STAP-A aggregates the
+parameter sets with small NALs; FU-A fragments large slices.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+__all__ = ["RtpPacket", "H264Payloader", "split_annexb"]
+
+RTP_VERSION = 2
+MTU_DEFAULT = 1200
+H264_CLOCK = 90000
+
+
+@dataclass
+class RtpPacket:
+    payload_type: int
+    sequence: int
+    timestamp: int
+    ssrc: int
+    payload: bytes
+    marker: bool = False
+
+    def serialize(self) -> bytes:
+        b0 = RTP_VERSION << 6
+        b1 = (0x80 if self.marker else 0) | (self.payload_type & 0x7F)
+        return (
+            struct.pack(
+                "!BBHII", b0, b1, self.sequence & 0xFFFF, self.timestamp & 0xFFFFFFFF, self.ssrc
+            )
+            + self.payload
+        )
+
+    @classmethod
+    def parse(cls, data: bytes) -> "RtpPacket":
+        if len(data) < 12:
+            raise ValueError("short RTP packet")
+        b0, b1, seq, ts, ssrc = struct.unpack("!BBHII", data[:12])
+        if b0 >> 6 != RTP_VERSION:
+            raise ValueError("bad RTP version")
+        csrc = b0 & 0x0F
+        offset = 12 + csrc * 4
+        if b0 & 0x10:  # extension
+            if len(data) < offset + 4:
+                raise ValueError("short RTP extension")
+            ext_len = struct.unpack("!H", data[offset + 2 : offset + 4])[0]
+            offset += 4 + ext_len * 4
+        payload = data[offset:]
+        if b0 & 0x20:  # padding
+            if not payload:
+                raise ValueError("padded packet with empty payload")
+            pad = payload[-1]
+            if pad < 1 or pad > len(payload):
+                raise ValueError(f"invalid RTP pad count {pad}")
+            payload = payload[:-pad]
+        return cls(
+            payload_type=b1 & 0x7F,
+            sequence=seq,
+            timestamp=ts,
+            ssrc=ssrc,
+            payload=payload,
+            marker=bool(b1 & 0x80),
+        )
+
+
+def split_annexb(au: bytes) -> list[bytes]:
+    """Split an Annex-B access unit into NAL units (start codes stripped)."""
+    nals: list[bytes] = []
+    n = len(au)
+    i = 0
+    start = None
+    while i + 2 < n:
+        if au[i] == 0 and au[i + 1] == 0 and au[i + 2] == 1:
+            if start is not None:
+                end = i
+                # the extra 0x00 of a 4-byte start code belongs to the separator
+                while end > start and au[end - 1] == 0:
+                    end -= 1
+                nals.append(au[start:end])
+            start = i + 3
+            i += 3
+        else:
+            i += 1
+    if start is not None:
+        nals.append(au[start:])
+    return [x for x in nals if x]
+
+
+@dataclass
+class H264Payloader:
+    """Annex-B access units → RTP packets (single NAL / STAP-A / FU-A)."""
+
+    payload_type: int = 102
+    ssrc: int = 0x53454C4B  # 'SELK'
+    mtu: int = MTU_DEFAULT
+    sequence: int = 0
+
+    def payload_au(self, au: bytes, timestamp: int) -> list[RtpPacket]:
+        """Packetize one access unit; the last packet carries the marker."""
+        nals = split_annexb(au)
+        packets: list[RtpPacket] = []
+        max_payload = self.mtu - 12  # RTP header
+
+        params: list[bytes] = []
+        for nal in nals:
+            ntype = nal[0] & 0x1F
+            if ntype in (7, 8) and len(nal) < 200:
+                params.append(nal)  # aggregate SPS/PPS (config-interval -1)
+                continue
+            if params:
+                stap_total = 1 + sum(len(x) + 2 for x in params) + len(nal) + 2
+                if stap_total <= max_payload:
+                    packets.append(self._stap_a(params + [nal], timestamp))
+                else:
+                    if len(params) > 1:
+                        packets.append(self._stap_a(params, timestamp))
+                    else:
+                        packets.append(self._single(params[0], timestamp))
+                    packets.extend(self._fragment(nal, timestamp, max_payload))
+                params = []
+                continue
+            packets.extend(self._fragment(nal, timestamp, max_payload))
+        if params:  # AU was only parameter sets
+            packets.append(self._stap_a(params, timestamp))
+        if packets:
+            packets[-1].marker = True
+        return packets
+
+    def _next_seq(self) -> int:
+        s = self.sequence
+        self.sequence = (self.sequence + 1) & 0xFFFF
+        return s
+
+    def _single(self, nal: bytes, ts: int) -> RtpPacket:
+        return RtpPacket(self.payload_type, self._next_seq(), ts, self.ssrc, nal)
+
+    def _stap_a(self, nals: list[bytes], ts: int) -> RtpPacket:
+        nri = max((n[0] >> 5) & 3 for n in nals)
+        payload = bytes([24 | (nri << 5)])  # STAP-A
+        for n in nals:
+            payload += struct.pack("!H", len(n)) + n
+        return RtpPacket(self.payload_type, self._next_seq(), ts, self.ssrc, payload)
+
+    def _fragment(self, nal: bytes, ts: int, max_payload: int) -> list[RtpPacket]:
+        if len(nal) <= max_payload:
+            return [self._single(nal, ts)]
+        header = nal[0]
+        nri = header & 0x60
+        ntype = header & 0x1F
+        fu_indicator = 28 | nri  # FU-A
+        chunk = max_payload - 2
+        data = nal[1:]
+        out = []
+        for i in range(0, len(data), chunk):
+            part = data[i : i + chunk]
+            s = 0x80 if i == 0 else 0
+            e = 0x40 if i + chunk >= len(data) else 0
+            fu_header = s | e | ntype
+            out.append(
+                RtpPacket(
+                    self.payload_type,
+                    self._next_seq(),
+                    ts,
+                    self.ssrc,
+                    bytes([fu_indicator, fu_header]) + part,
+                )
+            )
+        return out
+
+
+class H264Depayloader:
+    """RTP packets → Annex-B access units (for tests and the loopback client)."""
+
+    def __init__(self) -> None:
+        self._fu: bytearray | None = None
+        self._au: list[bytes] = []
+
+    def push(self, pkt: RtpPacket) -> bytes | None:
+        """Feed one packet; returns a complete AU when the marker arrives."""
+        p = pkt.payload
+        ntype = p[0] & 0x1F
+        if ntype == 24:  # STAP-A
+            i = 1
+            while i + 2 <= len(p):
+                (ln,) = struct.unpack("!H", p[i : i + 2])
+                self._au.append(p[i + 2 : i + 2 + ln])
+                i += 2 + ln
+        elif ntype == 28:  # FU-A
+            ind, hdr = p[0], p[1]
+            if hdr & 0x80:
+                self._fu = bytearray([(ind & 0x60) | (hdr & 0x1F)])
+            if self._fu is not None:
+                self._fu.extend(p[2:])
+                if hdr & 0x40:
+                    self._au.append(bytes(self._fu))
+                    self._fu = None
+        else:
+            self._au.append(p)
+        if pkt.marker:
+            au = b"".join(b"\x00\x00\x00\x01" + n for n in self._au)
+            self._au = []
+            return au
+        return None

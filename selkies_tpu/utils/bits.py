@@ -61,6 +61,18 @@ class BitWriter:
             raise ValueError(f"bitstream not byte aligned ({self._nbits} bits pending)")
         return bytes(self._buf)
 
+    def get_partial(self) -> tuple[bytes, int]:
+        """(buffer including a zero-padded partial last byte, total bit count).
+
+        Used to hand an unaligned prefix (e.g. a slice header) to the C++
+        packer, which continues appending at the exact bit position.
+        """
+        total_bits = self.bit_position
+        if self._nbits:
+            last = (self._acc << (8 - self._nbits)) & 0xFF
+            return bytes(self._buf) + bytes([last]), total_bits
+        return bytes(self._buf), total_bits
+
 
 class BitReader:
     """MSB-first reader, for tests and the conformance mini-decoder."""

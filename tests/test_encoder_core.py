@@ -1,0 +1,83 @@
+"""JAX encode core must match the numpy golden model bit-exactly.
+
+numpy_ref is FFmpeg-conformant (tools/cavlc_probe.py), so array equality
+here transfers conformance to the TPU path.
+"""
+
+import numpy as np
+import pytest
+
+from selkies_tpu.models.h264 import encoder_core as ec
+from selkies_tpu.models.h264 import numpy_ref as nr
+
+
+def _rand_blocks(shape, lo=-255, hi=256, seed=0):
+    return np.random.default_rng(seed).integers(lo, hi, size=shape).astype(np.int32)
+
+
+@pytest.mark.parametrize("qp", [0, 7, 20, 33, 46, 51])
+def test_transform_quant_paths_match(qp):
+    blocks = _rand_blocks((64, 4, 4))
+    w_np = nr.fdct4(blocks)
+    w_jx = np.asarray(ec.fdct4(blocks))
+    np.testing.assert_array_equal(w_jx, w_np)
+
+    q_np = nr.quant4(w_np, qp)
+    q_jx = np.asarray(ec.quant4(w_jx, qp))
+    np.testing.assert_array_equal(q_jx, q_np)
+
+    dq_np = nr.dequant4(q_np, qp)
+    dq_jx = np.asarray(ec.dequant4(q_jx, qp))
+    np.testing.assert_array_equal(dq_jx, dq_np)
+
+    r_np = nr.idct4(dq_np)
+    r_jx = np.asarray(ec.idct4(dq_jx))
+    np.testing.assert_array_equal(r_jx, r_np)
+
+
+@pytest.mark.parametrize("qp", [0, 11, 28, 51])
+def test_dc_paths_match(qp):
+    dc = _rand_blocks((32, 4, 4), -4080, 4081, seed=1)
+    np.testing.assert_array_equal(np.asarray(ec.quant_luma_dc(dc, qp)), nr.quant_luma_dc(dc, qp))
+    lev = _rand_blocks((32, 4, 4), -1700, 1701, seed=2)
+    np.testing.assert_array_equal(np.asarray(ec.dequant_luma_dc(lev, qp)), nr.dequant_luma_dc(lev, qp))
+
+    cdc = _rand_blocks((32, 2, 2), -4080, 4081, seed=3)
+    qpc = min(qp, 39)
+    np.testing.assert_array_equal(np.asarray(ec.quant_chroma_dc(cdc, qpc)), nr.quant_chroma_dc(cdc, qpc))
+    clev = _rand_blocks((32, 2, 2), -1700, 1701, seed=4)
+    np.testing.assert_array_equal(np.asarray(ec.dequant_chroma_dc(clev, qpc)), nr.dequant_chroma_dc(clev, qpc))
+
+
+@pytest.mark.parametrize("qp", [10, 26, 44])
+def test_full_frame_matches_numpy_model(qp):
+    rng = np.random.default_rng(7)
+    h, w = 64, 96
+    y = rng.integers(0, 256, (h, w)).astype(np.uint8)
+    u = rng.integers(0, 256, (h // 2, w // 2)).astype(np.uint8)
+    v = rng.integers(0, 256, (h // 2, w // 2)).astype(np.uint8)
+
+    enc = nr.encode_frame_i16(y, u, v, qp)
+    out = ec.encode_frame_planes(y, u, v, qp)
+
+    np.testing.assert_array_equal(np.asarray(out["luma_mode"]), enc.coeffs.luma_mode)
+    np.testing.assert_array_equal(np.asarray(out["chroma_mode"]), enc.coeffs.chroma_mode)
+    np.testing.assert_array_equal(np.asarray(out["luma_dc"]), enc.coeffs.luma_dc)
+    np.testing.assert_array_equal(np.asarray(out["luma_ac"]), enc.coeffs.luma_ac)
+    np.testing.assert_array_equal(np.asarray(out["chroma_dc"]), enc.coeffs.chroma_dc)
+    np.testing.assert_array_equal(np.asarray(out["chroma_ac"]), enc.coeffs.chroma_ac)
+    np.testing.assert_array_equal(np.asarray(out["recon_y"]), enc.recon_y)
+    np.testing.assert_array_equal(np.asarray(out["recon_u"]), enc.recon_u)
+    np.testing.assert_array_equal(np.asarray(out["recon_v"]), enc.recon_v)
+
+
+def test_qp_is_traced_not_static():
+    # same jitted callable must serve different QPs (rate control retunes)
+    y = np.full((32, 32), 100, np.uint8)
+    u = np.full((16, 16), 120, np.uint8)
+    v = np.full((16, 16), 135, np.uint8)
+    n0 = ec.encode_frame_planes._cache_size() if hasattr(ec.encode_frame_planes, "_cache_size") else None
+    ec.encode_frame_planes(y, u, v, 20)
+    ec.encode_frame_planes(y, u, v, 35)
+    if n0 is not None:
+        assert ec.encode_frame_planes._cache_size() - (n0 or 0) <= 1

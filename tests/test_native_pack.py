@@ -1,0 +1,73 @@
+"""C++ CAVLC packer must be byte-identical to the Python packer."""
+
+import numpy as np
+import pytest
+
+from selkies_tpu.models.h264.bitstream import StreamParams
+from selkies_tpu.models.h264.cavlc import pack_slice
+from selkies_tpu.models.h264 import native
+from selkies_tpu.models.h264.numpy_ref import encode_frame_i16
+
+pytestmark = pytest.mark.skipif(not native.native_available(), reason="libcavlc.so not built")
+
+
+def _frame(seed, h, w, kind):
+    rng = np.random.default_rng(seed)
+    if kind == "noise":
+        y = rng.integers(0, 256, (h, w)).astype(np.uint8)
+        u = rng.integers(0, 256, (h // 2, w // 2)).astype(np.uint8)
+        v = rng.integers(0, 256, (h // 2, w // 2)).astype(np.uint8)
+    else:
+        y = np.kron(rng.integers(16, 235, (h // 8, w // 8)), np.ones((8, 8))).astype(np.uint8)
+        u = np.full((h // 2, w // 2), 119, np.uint8)
+        v = np.full((h // 2, w // 2), 141, np.uint8)
+    return y, u, v
+
+
+@pytest.mark.parametrize("kind", ["noise", "blocks"])
+@pytest.mark.parametrize("qp", [4, 22, 38, 51])
+def test_native_matches_python(kind, qp):
+    y, u, v = _frame(3, 48, 64, kind)
+    enc = encode_frame_i16(y, u, v, qp)
+    p = StreamParams(width=64, height=48, qp=qp)
+    a = pack_slice(enc.coeffs, p, frame_num=0, idr=True)
+    b = native.pack_slice_native(enc.coeffs, p, frame_num=0, idr=True)
+    assert a == b
+
+
+def test_native_matches_python_nonidr():
+    y, u, v = _frame(5, 32, 32, "blocks")
+    enc = encode_frame_i16(y, u, v, 28)
+    p = StreamParams(width=32, height=32, qp=28)
+    a = pack_slice(enc.coeffs, p, frame_num=3, idr=False)
+    b = native.pack_slice_native(enc.coeffs, p, frame_num=3, idr=False)
+    assert a == b
+
+
+def test_native_speed_1080p():
+    """Pack time at operationally realistic bitrates must fit the 16.7 ms
+    frame budget. Noise at QP42 is what rate control would actually emit
+    for pathological content (~2-4 MB/frame would blow any link); screen
+    content at QP26 is the common case."""
+    import time
+
+    y, u, v = _frame(1, 1088, 1920, "noise")
+    enc = encode_frame_i16(y, u, v, 42)
+    p = StreamParams(width=1920, height=1080, qp=42)
+    native.pack_slice_native(enc.coeffs, p)  # warm
+    t0 = time.perf_counter()
+    nbytes = len(native.pack_slice_native(enc.coeffs, p))
+    dt = time.perf_counter() - t0
+    # Pathological content (incompressible noise) costs ~50 ms/frame at
+    # ~0.5 Gbps output — degraded fps, same as the reference's CPU encoders
+    # on such content. Canary bound only; the operational case is below.
+    assert dt < 0.100, f"noise@qp42: {dt*1000:.1f} ms for {nbytes} B"
+
+    y, u, v = _frame(2, 1088, 1920, "blocks")
+    enc = encode_frame_i16(y, u, v, 26)
+    p = StreamParams(width=1920, height=1080, qp=26)
+    native.pack_slice_native(enc.coeffs, p)
+    t0 = time.perf_counter()
+    nbytes = len(native.pack_slice_native(enc.coeffs, p))
+    dt = time.perf_counter() - t0
+    assert dt < 0.010, f"screen@qp26: {dt*1000:.1f} ms for {nbytes} B"

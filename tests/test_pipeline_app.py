@@ -1,0 +1,108 @@
+"""TPUWebRTCApp + VideoPipeline: frames flow end-to-end in asyncio."""
+
+import asyncio
+import json
+
+import pytest
+
+from selkies_tpu.models.registry import create_encoder, encoder_exists, supported_encoders
+from selkies_tpu.pipeline.app import TPUWebRTCApp
+from selkies_tpu.pipeline.elements import SyntheticSource
+
+
+class FakeTransport:
+    def __init__(self):
+        self.frames = []
+        self.messages = []
+        self.data_channel_ready = True
+
+    def send_data_channel(self, message):
+        self.messages.append(json.loads(message))
+
+    async def send_video(self, frame):
+        self.frames.append(frame)
+
+
+def test_registry_aliases():
+    assert encoder_exists("tpuh264enc")
+    assert encoder_exists("nvh264enc")  # legacy name maps to TPU encoder
+    assert encoder_exists("x264enc")
+    enc = create_encoder("nvh264enc", width=64, height=64)
+    assert type(enc).__name__ == "TPUH264Encoder"
+    with pytest.raises(ValueError):
+        create_encoder("bogus", width=64, height=64)
+    with pytest.raises(NotImplementedError):
+        create_encoder("vp9enc", width=64, height=64)
+    assert "tpuh264enc" in supported_encoders()
+
+
+def test_app_pipeline_streams_frames():
+    async def run():
+        transport = FakeTransport()
+        app = TPUWebRTCApp(
+            source=SyntheticSource(128, 96),
+            transport=transport,
+            width=128,
+            height=96,
+            framerate=30,
+            video_bitrate_kbps=500,
+        )
+        app.encoder.encode_frame(app.source.capture())  # warm jit outside timing
+        app.encoder.force_keyframe()  # warm-up consumed the initial IDR
+        await app.start_pipeline()
+        for _ in range(100):
+            if len(transport.frames) >= 3:
+                break
+            await asyncio.sleep(0.1)
+        await app.stop_pipeline()
+        return transport
+
+    transport = asyncio.run(run())
+    assert len(transport.frames) >= 3
+    assert transport.frames[0].idr
+    assert transport.frames[0].au[:5] == b"\x00\x00\x00\x01\x67"  # SPS first
+
+
+def test_app_rate_control_reacts():
+    async def run():
+        transport = FakeTransport()
+        app = TPUWebRTCApp(
+            source=SyntheticSource(160, 128, seed=2),
+            transport=transport,
+            framerate=30,
+            video_bitrate_kbps=5000,
+        )
+        app.encoder.encode_frame(app.source.capture())  # warm jit
+        await app.start_pipeline()
+        while app.pipeline.frames < 4:
+            await asyncio.sleep(0.05)
+        qp_before = app.rc.frame_qp()
+        app.set_video_bitrate(100, cc=True)  # GCC congestion signal
+        target = app.pipeline.frames + 6
+        while app.pipeline.frames < target:
+            await asyncio.sleep(0.05)
+        await app.stop_pipeline()
+        return qp_before, app.rc.frame_qp(), app.video_bitrate_kbps
+
+    qp_before, qp_after, persisted = asyncio.run(run())
+    assert qp_after > qp_before
+    assert persisted == 5000  # cc=True does not persist user setting
+
+
+def test_data_channel_vocabulary():
+    transport = FakeTransport()
+    app = TPUWebRTCApp(source=SyntheticSource(64, 64), transport=transport)
+    app.send_framerate(60)
+    app.send_video_bitrate(4000)
+    app.send_encoder("tpuh264enc")
+    app.send_system_stats(12.5, 1024, 512)
+    app.send_ping(123.456)
+    app.send_clipboard_data("hello")
+    app.send_remote_resolution("1920x1080")
+    types = [m["type"] for m in transport.messages]
+    assert types == ["system", "system", "system", "system_stats", "ping", "clipboard", "system"]
+    assert transport.messages[0]["data"]["action"] == "framerate,60"
+    assert transport.messages[4]["data"]["start_time"] == 123.456
+    import base64
+
+    assert base64.b64decode(transport.messages[5]["data"]["content"]) == b"hello"

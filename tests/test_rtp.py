@@ -1,0 +1,96 @@
+"""RTP payloader/depayloader roundtrip + rate controller behavior."""
+
+import numpy as np
+import pytest
+
+from selkies_tpu.models.h264.ratecontrol import CbrRateController
+from selkies_tpu.transport.rtp import H264Depayloader, H264Payloader, RtpPacket, split_annexb
+
+
+def test_split_annexb():
+    au = b"\x00\x00\x00\x01\x67\x42\x00\x00\x01\x68\xce\x00\x00\x00\x01\x65\x88\x00"
+    nals = split_annexb(au)
+    assert nals == [b"\x67\x42", b"\x68\xce", b"\x65\x88\x00"]
+
+
+def test_rtp_header_roundtrip():
+    p = RtpPacket(102, 4711, 123456789, 0xDEADBEEF, b"payload", marker=True)
+    q = RtpPacket.parse(p.serialize())
+    assert (q.payload_type, q.sequence, q.timestamp, q.ssrc, q.payload, q.marker) == (
+        102, 4711, 123456789, 0xDEADBEEF, b"payload", True,
+    )
+
+
+def _roundtrip_au(au, mtu=1200):
+    pay = H264Payloader(mtu=mtu)
+    pkts = pay.payload_au(au, timestamp=9000)
+    assert all(len(p.serialize()) <= mtu for p in pkts)
+    assert pkts[-1].marker and not any(p.marker for p in pkts[:-1])
+    depay = H264Depayloader()
+    out = None
+    for p in pkts:
+        r = depay.push(p)
+        if r is not None:
+            out = r
+    return out, pkts
+
+
+def test_payload_small_au_stap():
+    au = b"\x00\x00\x00\x01\x67\x42\xc0\x1f" + b"\x00\x00\x00\x01\x68\xce\x3c\x80" + b"\x00\x00\x00\x01\x65" + b"\x11" * 100
+    out, pkts = _roundtrip_au(au)
+    assert len(pkts) == 1 and (pkts[0].payload[0] & 0x1F) == 24  # STAP-A
+    assert split_annexb(out) == split_annexb(au)
+
+
+def test_payload_large_slice_fua():
+    au = b"\x00\x00\x00\x01\x65" + bytes(range(256)) * 20  # 5 KB slice
+    out, pkts = _roundtrip_au(au)
+    assert len(pkts) > 4
+    assert all((p.payload[0] & 0x1F) == 28 for p in pkts)  # FU-A
+    assert split_annexb(out) == split_annexb(au)
+
+
+def test_payload_real_encoder_au(tmp_path):
+    from selkies_tpu.models.h264.encoder import TPUH264Encoder
+
+    enc = TPUH264Encoder(width=320, height=192, qp=24)
+    rng = np.random.default_rng(0)
+    frame = rng.integers(0, 256, (192, 320, 4), np.uint8)
+    au = enc.encode_frame(frame)
+    out, pkts = _roundtrip_au(au)
+    assert split_annexb(out) == split_annexb(au)
+    cv2 = pytest.importorskip("cv2")
+    path = tmp_path / "rt.h264"
+    path.write_bytes(out)
+    cap = cv2.VideoCapture(str(path))
+    ok, f = cap.read()
+    cap.release()
+    assert ok and f.shape == (192, 320, 3)
+
+
+def test_rate_controller_converges():
+    rc = CbrRateController(bitrate_kbps=4000, fps=60, qp=30)
+    # synthetic encoder model: bytes halve every 6 QP steps from a base
+    def fake_encode(qp):
+        return int(60000 * 2 ** ((30 - qp) / 6.0))
+
+    for _ in range(120):
+        rc.update(fake_encode(rc.frame_qp()))
+    # converged bitrate within 25% of target
+    achieved_kbps = fake_encode(rc.frame_qp()) * 8 * 60 / 1000
+    assert abs(achieved_kbps - 4000) / 4000 < 0.25
+
+
+def test_rate_controller_reacts_to_bitrate_change():
+    rc = CbrRateController(bitrate_kbps=8000, fps=60, qp=30)
+
+    def fake_encode(qp):
+        return int(60000 * 2 ** ((30 - qp) / 6.0))
+
+    for _ in range(100):
+        rc.update(fake_encode(rc.frame_qp()))
+    qp_high_rate = rc.frame_qp()
+    rc.set_bitrate(1000)  # GCC says congestion
+    for _ in range(100):
+        rc.update(fake_encode(rc.frame_qp()))
+    assert rc.frame_qp() > qp_high_rate + 3
