@@ -142,7 +142,10 @@ class TPUH264Encoder:
         )
         self.frame_index += 1
         self._frames_since_idr += 1
-        self._force_idr = False
+        if idr:
+            # Only clear when consumed: a force_keyframe() landing from the
+            # event loop mid-encode must still take effect next frame.
+            self._force_idr = False
         return au
 
     def recon_planes(self, frame: np.ndarray):

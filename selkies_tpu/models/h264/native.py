@@ -63,7 +63,7 @@ def _load() -> ctypes.CDLL | None:
     ]
     lib.emulation_prevent.restype = ctypes.c_int64
     lib.emulation_prevent.argtypes = [
-        ctypes.c_char_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
     ]
     _lib = lib
     return _lib
@@ -71,6 +71,24 @@ def _load() -> ctypes.CDLL | None:
 
 def native_available() -> bool:
     return _load() is not None
+
+
+# Per-geometry scratch buffers reused across frames (the packer runs every
+# 16 ms; per-frame multi-MB allocations would dominate small-slice cost).
+_scratch: dict[tuple[int, int], dict[str, np.ndarray]] = {}
+
+
+def _get_scratch(mbh: int, mbw: int, cap: int) -> dict[str, np.ndarray]:
+    s = _scratch.get((mbh, mbw))
+    if s is None or len(s["rbsp"]) < cap:
+        s = {
+            "rbsp": np.empty(cap, np.uint8),
+            "ebsp": np.empty(cap + cap // 2 + 16, np.uint8),
+            "luma_tc": np.empty(mbh * 4 * mbw * 4, np.int32),
+            "chroma_tc": np.empty(2 * mbh * 2 * mbw * 2, np.int32),
+        }
+        _scratch[(mbh, mbw)] = s
+    return s
 
 
 def _i32ptr(a: np.ndarray):
@@ -102,27 +120,27 @@ def pack_slice_native(
         for name in ("luma_mode", "chroma_mode", "luma_dc", "luma_ac", "chroma_dc", "chroma_ac")
     }
     cap = mbh * mbw * 1024 + len(hdr_bytes) + 1024
-    luma_tc = np.empty(mbh * 4 * mbw * 4, np.int32)
-    chroma_tc = np.empty(2 * mbh * 2 * mbw * 2, np.int32)
     while True:
-        rbsp = np.empty(cap, np.uint8)
+        s = _get_scratch(mbh, mbw, cap)
+        rbsp = s["rbsp"]
         n = lib.pack_slice_rbsp(
             hdr_bytes, hdr_bits,
             _i16ptr(arrs["luma_mode"]), _i16ptr(arrs["chroma_mode"]),
             _i16ptr(arrs["luma_dc"]), _i16ptr(arrs["luma_ac"]),
             _i16ptr(arrs["chroma_dc"]), _i16ptr(arrs["chroma_ac"]),
             mbh, mbw,
-            rbsp.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), cap,
-            _i32ptr(luma_tc), _i32ptr(chroma_tc),
+            rbsp.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(rbsp),
+            _i32ptr(s["luma_tc"]), _i32ptr(s["chroma_tc"]),
         )
         if n >= 0:
             break
-        cap *= 2  # pathological content; retry with more room
+        cap = len(rbsp) * 2  # pathological content; retry with more room
         if cap > (1 << 30):
             raise RuntimeError("pack_slice_rbsp overflow beyond 1 GiB")
-    ebsp = np.empty(n + n // 2 + 16, np.uint8)
+    ebsp = s["ebsp"]
     m = lib.emulation_prevent(
-        rbsp[:n].tobytes(), n, ebsp.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(ebsp)
+        rbsp.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n,
+        ebsp.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(ebsp),
     )
     if m < 0:
         raise RuntimeError("emulation_prevent overflow")

@@ -57,6 +57,12 @@ class TPUWebRTCApp:
         if not encoder_exists(encoder):
             raise ValueError(f"unknown encoder {encoder!r} (see models.registry)")
         self.encoder_name = encoder
+        if source is not None and (source.width, source.height) != (width, height) and (width, height) != (1280, 720):
+            # width/height args only size the default synthetic source; an
+            # explicit conflicting pair is a caller bug, not a silent crop.
+            raise ValueError(
+                f"source is {source.width}x{source.height} but width/height args say {width}x{height}"
+            )
         self.source = source or SyntheticSource(width, height)
         self.transport = transport
         self.framerate = framerate

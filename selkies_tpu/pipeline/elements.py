@@ -87,30 +87,40 @@ class VideoPipeline:
         self.sink = sink
         self.fps = fps
         self._task: asyncio.Task | None = None
+        self._sender: asyncio.Task | None = None
+        self._latest: EncodedFrame | None = None
+        self._frame_ready = asyncio.Event()
         self.frames = 0
         self.dropped_ticks = 0
+        self.dropped_frames = 0
 
     @property
     def running(self) -> bool:
         return self._task is not None and not self._task.done()
 
     def set_framerate(self, fps: float) -> None:
-        self.fps = float(fps)
+        fps = float(fps)
+        if not fps > 0:
+            raise ValueError(f"framerate must be positive, got {fps}")
         self.rc.set_framerate(fps)
+        self.fps = fps
 
     async def start(self) -> None:
         if self.running:
             return
         self._task = asyncio.create_task(self._run(), name="video-pipeline")
+        self._sender = asyncio.create_task(self._send_loop(), name="video-sender")
 
     async def stop(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
-            self._task = None
+        for attr in ("_task", "_sender"):
+            task = getattr(self, attr)
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+                setattr(self, attr, None)
 
     MAX_CONSECUTIVE_FAILURES = 30
 
@@ -151,7 +161,24 @@ class VideoPipeline:
                     logger.error("video pipeline giving up after %d failures", failures)
                     return
                 continue
+            # depth-1 latest-wins handoff to the sender task: a slow sink
+            # drops frames instead of back-pressuring capture/encode.
+            if self._latest is not None:
+                self.dropped_frames += 1
+            self._latest = ef
+            self._frame_ready.set()
+
+    async def _send_loop(self) -> None:
+        while True:
+            await self._frame_ready.wait()
+            self._frame_ready.clear()
+            ef = self._latest
+            self._latest = None
+            if ef is None:
+                continue
             try:
                 await self.sink(ef)
+            except asyncio.CancelledError:
+                raise
             except Exception:
                 logger.exception("video sink error")

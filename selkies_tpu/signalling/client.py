@@ -1,0 +1,152 @@
+"""In-process signalling client.
+
+Counterpart of the reference ``WebRTCSignalling`` (webrtc_signalling.py:59):
+connects to the local signalling server, registers with ``HELLO <id>``,
+calls a peer with ``SESSION <peer_id>``, then relays SDP/ICE JSON both ways
+via callbacks.  Two instances run per host process — one for the video+data
+connection and one for audio (reference __main__.py:568-579).
+
+Implemented on aiohttp's WebSocket client rather than the websockets
+package; retry/disconnect semantics match the reference (retry connect
+every 2 s, on_disconnect on closed socket).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import logging
+import ssl
+from typing import Any, Awaitable, Callable
+
+import aiohttp
+
+logger = logging.getLogger("signalling.client")
+
+
+class SignallingError(Exception):
+    pass
+
+
+class SignallingErrorNoPeer(SignallingError):
+    pass
+
+
+async def _maybe_await(result: Any) -> None:
+    if asyncio.iscoroutine(result):
+        await result
+
+
+class SignallingClient:
+    def __init__(
+        self,
+        server: str,
+        id: int | str,
+        peer_id: int | str,
+        enable_https: bool = False,
+        enable_basic_auth: bool = False,
+        basic_auth_user: str | None = None,
+        basic_auth_password: str | None = None,
+        retry_interval: float = 2.0,
+    ):
+        self.server = server
+        self.id = id
+        self.peer_id = peer_id
+        self.enable_https = enable_https
+        self.enable_basic_auth = enable_basic_auth
+        self.basic_auth_user = basic_auth_user
+        self.basic_auth_password = basic_auth_password
+        self.retry_interval = retry_interval
+
+        self._session: aiohttp.ClientSession | None = None
+        self._ws: aiohttp.ClientWebSocketResponse | None = None
+
+        # callbacks (any may be sync or async)
+        self.on_connect: Callable[[], Any] = lambda: logger.warning("unhandled on_connect")
+        self.on_session: Callable[[Any, dict], Any] = lambda peer_id, meta: logger.warning("unhandled on_session")
+        self.on_disconnect: Callable[[], Any] = lambda: logger.warning("unhandled on_disconnect")
+        self.on_error: Callable[[Exception], Any] = lambda e: logger.warning("unhandled on_error: %s", e)
+        self.on_sdp: Callable[[str, str], Any] = lambda t, s: logger.warning("unhandled on_sdp")
+        self.on_ice: Callable[[int, str], Any] = lambda m, c: logger.warning("unhandled on_ice")
+
+    async def connect(self) -> None:
+        """Connect (retrying forever) and send HELLO."""
+        sslctx: ssl.SSLContext | bool = False
+        if self.enable_https or self.server.startswith("wss:"):
+            sslctx = ssl.create_default_context(purpose=ssl.Purpose.SERVER_AUTH)
+            sslctx.check_hostname = False
+            sslctx.verify_mode = ssl.CERT_NONE
+        headers = None
+        if self.enable_basic_auth:
+            auth64 = base64.b64encode(
+                f"{self.basic_auth_user}:{self.basic_auth_password}".encode("ascii")
+            ).decode("ascii")
+            headers = {"Authorization": f"Basic {auth64}"}
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        while True:
+            try:
+                self._ws = await self._session.ws_connect(self.server, headers=headers, ssl=sslctx, heartbeat=None)
+                break
+            except (aiohttp.ClientConnectionError, OSError):
+                logger.info("connecting to signalling server...")
+                await asyncio.sleep(self.retry_interval)
+        await self._ws.send_str(f"HELLO {self.id}")
+
+    async def setup_call(self) -> None:
+        """Request a session with the configured peer (after server HELLO)."""
+        assert self._ws is not None
+        await self._ws.send_str(f"SESSION {self.peer_id}")
+
+    async def send_sdp(self, sdp_type: str, sdp: str) -> None:
+        assert self._ws is not None
+        logger.info("sending sdp type: %s", sdp_type)
+        await self._ws.send_str(json.dumps({"sdp": {"type": sdp_type, "sdp": sdp}}))
+
+    async def send_ice(self, mlineindex: int, candidate: str) -> None:
+        assert self._ws is not None
+        await self._ws.send_str(json.dumps({"ice": {"candidate": candidate, "sdpMLineIndex": mlineindex}}))
+
+    async def stop(self) -> None:
+        if self._ws is not None:
+            await self._ws.close()
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    async def start(self) -> None:
+        """Message loop: dispatches HELLO / SESSION_OK / ERROR / sdp / ice."""
+        assert self._ws is not None
+        async for msg in self._ws:
+            if msg.type != aiohttp.WSMsgType.TEXT:
+                continue
+            await self._dispatch(msg.data)
+        await _maybe_await(self.on_disconnect())
+
+    async def _dispatch(self, message: str) -> None:
+        if message == "HELLO":
+            logger.info("connected")
+            await _maybe_await(self.on_connect())
+        elif message.startswith("SESSION_OK"):
+            toks = message.split()
+            meta = json.loads(base64.b64decode(toks[1])) if len(toks) > 1 else {}
+            logger.info("session started with peer %s meta=%s", self.peer_id, meta)
+            await _maybe_await(self.on_session(self.peer_id, meta))
+        elif message.startswith("ERROR"):
+            if message == f"ERROR peer {str(self.peer_id)!r} not found":
+                await _maybe_await(self.on_error(SignallingErrorNoPeer(f"{self.peer_id!r} not found")))
+            else:
+                await _maybe_await(self.on_error(SignallingError(f"unhandled signalling message: {message}")))
+        else:
+            try:
+                data = json.loads(message)
+            except json.JSONDecodeError:
+                await _maybe_await(self.on_error(SignallingError(f"error parsing message as JSON: {message}")))
+                return
+            if data.get("sdp"):
+                await _maybe_await(self.on_sdp(data["sdp"].get("type"), data["sdp"].get("sdp")))
+            elif data.get("ice"):
+                await _maybe_await(self.on_ice(data["ice"].get("sdpMLineIndex"), data["ice"].get("candidate")))
+            else:
+                await _maybe_await(self.on_error(SignallingError(f"unhandled JSON message: {message}")))

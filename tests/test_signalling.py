@@ -1,0 +1,265 @@
+"""Signalling server + client integration tests over real localhost sockets.
+
+Covers the reference protocol behaviours: HELLO registration, SESSION relay,
+meta64 propagation, ERROR strings, rooms, /turn HMAC credentials, /health,
+CORS, static file serving with traversal protection, and basic auth
+(reference signalling_web.py + webrtc_signalling.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import hashlib
+import hmac as hmac_mod
+import json
+
+import aiohttp
+import pytest
+
+from selkies_tpu.signalling import (
+    SignallingClient,
+    SignallingOptions,
+    SignallingServer,
+    parse_rtc_config,
+)
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def test_hello_session_and_relay(loop, tmp_path):
+    async def scenario():
+        srv = SignallingServer(SignallingOptions(addr="127.0.0.1", port=0))
+        await srv.start()
+        port = srv.bound_port
+        url = f"ws://127.0.0.1:{port}/ws"
+
+        got_sdp = asyncio.Future()
+        got_session = asyncio.Future()
+
+        async with aiohttp.ClientSession() as http:
+            # browser-side peer registers with meta
+            meta64 = base64.b64encode(json.dumps({"res": "1920x1080", "scale": 1}).encode()).decode()
+            browser = await http.ws_connect(url)
+            await browser.send_str(f"HELLO 1 {meta64}")
+            assert (await browser.receive()).data == "HELLO"
+
+            # server-side python client calls peer 1
+            client = SignallingClient(url, id=0, peer_id=1)
+            client.on_connect = client.setup_call
+            client.on_session = lambda pid, meta: got_session.set_result((pid, meta))
+            client.on_sdp = lambda t, s: got_sdp.set_result((t, s))
+            await client.connect()
+            task = asyncio.ensure_future(client.start())
+
+            pid, meta = await asyncio.wait_for(got_session, 5)
+            assert pid == 1
+            assert meta == {"res": "1920x1080", "scale": 1}
+
+            # after session, messages relay verbatim both directions
+            await client.send_sdp("offer", "v=0\r\nFAKE")
+            offer = json.loads((await asyncio.wait_for(browser.receive(), 5)).data)
+            assert offer["sdp"]["type"] == "offer"
+
+            await browser.send_str(json.dumps({"sdp": {"type": "answer", "sdp": "v=0\r\nANS"}}))
+            t, s = await asyncio.wait_for(got_sdp, 5)
+            assert (t, s) == ("answer", "v=0\r\nANS")
+
+            task.cancel()
+            await client.stop()
+            await browser.close()
+        await srv.stop()
+
+    loop.run_until_complete(scenario())
+
+
+def test_session_errors_and_duplicate_uid(loop):
+    async def scenario():
+        srv = SignallingServer(SignallingOptions(addr="127.0.0.1", port=0))
+        await srv.start()
+        url = f"ws://127.0.0.1:{srv.bound_port}/ws"
+        async with aiohttp.ClientSession() as http:
+            ws = await http.ws_connect(url)
+            await ws.send_str("HELLO 10")
+            assert (await ws.receive()).data == "HELLO"
+            # peer not found error string must match the reference format
+            await ws.send_str("SESSION 99")
+            assert (await ws.receive()).data == "ERROR peer '99' not found"
+
+            # duplicate uid is rejected with close code 1002
+            dup = await http.ws_connect(url)
+            await dup.send_str("HELLO 10")
+            msg = await dup.receive()
+            assert msg.type == aiohttp.WSMsgType.CLOSE
+            assert msg.data == 1002
+            await ws.close()
+        await srv.stop()
+
+    loop.run_until_complete(scenario())
+
+
+def test_rooms(loop):
+    async def scenario():
+        srv = SignallingServer(SignallingOptions(addr="127.0.0.1", port=0))
+        await srv.start()
+        url = f"ws://127.0.0.1:{srv.bound_port}/ws"
+        async with aiohttp.ClientSession() as http:
+            a = await http.ws_connect(url)
+            await a.send_str("HELLO alice")
+            await a.receive()
+            b = await http.ws_connect(url)
+            await b.send_str("HELLO bob")
+            await b.receive()
+
+            await a.send_str("ROOM lobby")
+            assert (await a.receive()).data == "ROOM_OK "
+            await b.send_str("ROOM lobby")
+            assert (await b.receive()).data == "ROOM_OK alice"
+            assert (await a.receive()).data == "ROOM_PEER_JOINED bob"
+
+            await a.send_str("ROOM_PEER_MSG bob hi there")
+            assert (await b.receive()).data == "ROOM_PEER_MSG alice hi there"
+
+            await b.close()
+            assert (await a.receive()).data == "ROOM_PEER_LEFT bob"
+            await a.close()
+        await srv.stop()
+
+    loop.run_until_complete(scenario())
+
+
+def test_turn_hmac_health_and_cors(loop):
+    async def scenario():
+        srv = SignallingServer(SignallingOptions(
+            addr="127.0.0.1", port=0,
+            turn_shared_secret="s3cret", turn_host="turn.example.com", turn_port="3478",
+        ))
+        await srv.start()
+        base = f"http://127.0.0.1:{srv.bound_port}"
+        async with aiohttp.ClientSession() as http:
+            r = await http.get(base + "/health")
+            assert r.status == 200 and (await r.text()) == "OK\n"
+
+            r = await http.get(base + "/turn", headers={"x-auth-user": "tester", "Origin": "http://x"})
+            assert r.status == 200
+            assert r.headers["Access-Control-Allow-Origin"] == "http://x"
+            assert r.headers["Access-Control-Allow-Credentials"] == "true"
+            cfg = json.loads(await r.text())
+            turn_server = cfg["iceServers"][1]
+            username = turn_server["username"]
+            exp, _, user = username.partition(":")
+            assert user == "tester" and int(exp) > 0
+            expected = base64.b64encode(
+                hmac_mod.new(b"s3cret", username.encode(), hashlib.sha1).digest()
+            ).decode()
+            assert turn_server["credential"] == expected
+            assert turn_server["urls"] == ["turn:turn.example.com:3478?transport=udp"]
+
+            # parse_rtc_config embeds the credential in the turn uri
+            stun, turn, _ = parse_rtc_config(json.dumps(cfg))
+            assert "stun://" in stun and turn.startswith("turn://") and "@turn.example.com:3478" in turn
+
+            # OPTIONS preflight
+            r = await http.options(base + "/turn", headers={"Origin": "http://x"})
+            assert r.status == 200
+        await srv.stop()
+
+    loop.run_until_complete(scenario())
+
+
+def test_turn_stun_only_fallback(loop):
+    async def scenario():
+        srv = SignallingServer(SignallingOptions(addr="127.0.0.1", port=0))
+        await srv.start()
+        async with aiohttp.ClientSession() as http:
+            r = await http.get(f"http://127.0.0.1:{srv.bound_port}/turn")
+            cfg = json.loads(await r.text())
+            assert cfg["iceServers"][0]["urls"] == ["stun:stun.l.google.com:19302"]
+        await srv.stop()
+
+    loop.run_until_complete(scenario())
+
+
+def test_static_serving_and_traversal(loop, tmp_path):
+    async def scenario():
+        web_root = tmp_path / "web"
+        web_root.mkdir()
+        (web_root / "index.html").write_text("<html>hi</html>")
+        (web_root / "app.js").write_text("console.log(1)")
+        (tmp_path / "secret.txt").write_text("no")
+
+        srv = SignallingServer(SignallingOptions(addr="127.0.0.1", port=0, web_root=str(web_root)))
+        await srv.start()
+        base = f"http://127.0.0.1:{srv.bound_port}"
+        async with aiohttp.ClientSession() as http:
+            r = await http.get(base + "/")
+            assert r.status == 200 and "text/html" in r.headers["Content-Type"]
+            assert await r.text() == "<html>hi</html>"
+
+            r = await http.get(base + "/app.js")
+            assert r.status == 200 and "javascript" in r.headers["Content-Type"]
+
+            r = await http.get(base + "/../secret.txt")
+            assert r.status == 404
+
+            r = await http.get(base + "/nope.html")
+            assert r.status == 404
+        await srv.stop()
+
+    loop.run_until_complete(scenario())
+
+
+def test_basic_auth(loop):
+    async def scenario():
+        srv = SignallingServer(SignallingOptions(
+            addr="127.0.0.1", port=0,
+            enable_basic_auth=True, basic_auth_user="u", basic_auth_password="p",
+        ))
+        await srv.start()
+        base = f"http://127.0.0.1:{srv.bound_port}"
+        async with aiohttp.ClientSession() as http:
+            r = await http.get(base + "/health")
+            assert r.status == 401
+            assert "WWW-Authenticate" in r.headers
+
+            auth = base64.b64encode(b"u:p").decode()
+            r = await http.get(base + "/health", headers={"Authorization": f"Basic {auth}"})
+            assert r.status == 200
+
+            # /turn is exempt from basic auth (reference behaviour)
+            r = await http.get(base + "/turn")
+            assert r.status == 200
+        await srv.stop()
+
+    loop.run_until_complete(scenario())
+
+
+def test_session_teardown_closes_partner(loop):
+    async def scenario():
+        srv = SignallingServer(SignallingOptions(addr="127.0.0.1", port=0))
+        await srv.start()
+        url = f"ws://127.0.0.1:{srv.bound_port}/ws"
+        async with aiohttp.ClientSession() as http:
+            callee = await http.ws_connect(url)
+            await callee.send_str("HELLO 1")
+            await callee.receive()
+            caller = await http.ws_connect(url)
+            await caller.send_str("HELLO 0")
+            await caller.receive()
+            await caller.send_str("SESSION 1")
+            assert (await caller.receive()).data.startswith("SESSION_OK")
+
+            # callee drops; server must close the caller to reset its state
+            await callee.close()
+            msg = await asyncio.wait_for(caller.receive(), 5)
+            assert msg.type in (aiohttp.WSMsgType.CLOSE, aiohttp.WSMsgType.CLOSING, aiohttp.WSMsgType.CLOSED)
+            assert not srv.sessions and not srv.peers
+        await srv.stop()
+
+    loop.run_until_complete(scenario())
