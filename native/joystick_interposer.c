@@ -1,0 +1,257 @@
+/* Selkies-TPU joystick interposer.
+ *
+ * LD_PRELOAD shim giving containerised games a virtual joystick without
+ * kernel uinput: open("/dev/input/jsN") is redirected to a unix STREAM
+ * socket served by the Python GamepadServer (selkies_tpu/input_host/
+ * gamepad.py).  On connect the server sends one packed config blob
+ * (name[255], u16 num_btns, u16 num_axes, u16 btn_map[512],
+ * u8 axes_map[64]) and then kernel-format `struct js_event` packets.
+ * Joystick ioctls (magic 'j') are answered locally from the stored
+ * config.
+ *
+ * Behavioural counterpart of the reference addons/js-interposer/
+ * joystick_interposer.c; written against the protocol, not the code.
+ */
+
+#define _GNU_SOURCE
+#include <dlfcn.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <linux/joystick.h>
+#include <stdarg.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/ioctl.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#define SELKIES_MAX_JS 4
+#define SELKIES_MAX_BTNS 512
+#define SELKIES_MAX_AXES 64
+#define SELKIES_NAME_LEN 255
+
+/* Natural alignment on purpose: the server packs with Python native
+ * struct format "255sHH512H64B", which pads one byte after name[] so
+ * num_btns lands on offset 256 — exactly this struct's layout (1348 B). */
+typedef struct {
+    char name[SELKIES_NAME_LEN];
+    unsigned short num_btns;
+    unsigned short num_axes;
+    unsigned short btn_map[SELKIES_MAX_BTNS];
+    unsigned char axes_map[SELKIES_MAX_AXES];
+} js_config_t;
+
+typedef struct {
+    int fd;               /* socket fd handed to the app, -1 when free */
+    js_config_t config;
+} js_slot_t;
+
+static js_slot_t g_slots[SELKIES_MAX_JS] = {
+    {-1, {{0}, 0, 0, {0}, {0}}},
+    {-1, {{0}, 0, 0, {0}, {0}}},
+    {-1, {{0}, 0, 0, {0}, {0}}},
+    {-1, {{0}, 0, 0, {0}, {0}}},
+};
+
+static int (*real_open)(const char *, int, ...) = NULL;
+static int (*real_open64)(const char *, int, ...) = NULL;
+static int (*real_ioctl)(int, unsigned long, ...) = NULL;
+static int (*real_close)(int) = NULL;
+
+static void selkies_init(void)
+{
+    if (!real_open)   real_open = dlsym(RTLD_NEXT, "open");
+    if (!real_open64) real_open64 = dlsym(RTLD_NEXT, "open64");
+    if (!real_ioctl)  real_ioctl = dlsym(RTLD_NEXT, "ioctl");
+    if (!real_close)  real_close = dlsym(RTLD_NEXT, "close");
+}
+
+static void dbg(const char *fmt, ...)
+{
+    if (!getenv("SELKIES_INTERPOSER_DEBUG")) return;
+    va_list ap;
+    va_start(ap, fmt);
+    vfprintf(stderr, fmt, ap);
+    va_end(ap);
+    fputc('\n', stderr);
+}
+
+/* /dev/input/jsN -> N, else -1 */
+static int js_index(const char *path)
+{
+    static const char prefix[] = "/dev/input/js";
+    if (!path || strncmp(path, prefix, sizeof(prefix) - 1) != 0) return -1;
+    const char *num = path + sizeof(prefix) - 1;
+    if (num[0] < '0' || num[0] > '9' || num[1] != '\0') return -1;
+    int idx = num[0] - '0';
+    return idx < SELKIES_MAX_JS ? idx : -1;
+}
+
+static void socket_path_for(int idx, char *buf, size_t len)
+{
+    const char *base = getenv("SELKIES_INTERPOSER_SOCKET_PATH");
+    if (base && *base)
+        snprintf(buf, len, "%s/selkies_js%d.sock", base, idx);
+    else
+        snprintf(buf, len, "/tmp/selkies_js%d.sock", idx);
+}
+
+static ssize_t read_full(int fd, void *buf, size_t n)
+{
+    size_t got = 0;
+    while (got < n) {
+        ssize_t r = read(fd, (char *)buf + got, n - got);
+        if (r <= 0) {
+            if (r < 0 && (errno == EINTR)) continue;
+            return -1;
+        }
+        got += (size_t)r;
+    }
+    return (ssize_t)got;
+}
+
+/* Connect to the gamepad server and consume the config blob. */
+static int selkies_connect(int idx, int flags)
+{
+    char path[sizeof(((struct sockaddr_un *)0)->sun_path)];
+    socket_path_for(idx, path, sizeof(path));
+
+    int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+
+    struct sockaddr_un addr;
+    memset(&addr, 0, sizeof(addr));
+    addr.sun_family = AF_UNIX;
+    strncpy(addr.sun_path, path, sizeof(addr.sun_path) - 1);
+    if (connect(fd, (struct sockaddr *)&addr, sizeof(addr)) != 0) {
+        dbg("selkies-interposer: connect(%s) failed: %s", path, strerror(errno));
+        real_close(fd);
+        errno = ENODEV;
+        return -1;
+    }
+
+    js_slot_t *slot = &g_slots[idx];
+    if (read_full(fd, &slot->config, sizeof(slot->config)) < 0) {
+        dbg("selkies-interposer: short config read on %s", path);
+        real_close(fd);
+        errno = ENODEV;
+        return -1;
+    }
+    slot->fd = fd;
+    dbg("selkies-interposer: js%d -> %s (name=%s btns=%u axes=%u)", idx, path,
+        slot->config.name, slot->config.num_btns, slot->config.num_axes);
+
+    if (flags & O_NONBLOCK) {
+        int fl = fcntl(fd, F_GETFL, 0);
+        fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+    }
+    return fd;
+}
+
+static js_slot_t *slot_for_fd(int fd)
+{
+    if (fd < 0) return NULL;
+    for (int i = 0; i < SELKIES_MAX_JS; i++)
+        if (g_slots[i].fd == fd) return &g_slots[i];
+    return NULL;
+}
+
+int open(const char *path, int flags, ...)
+{
+    selkies_init();
+    mode_t mode = 0;
+    if (flags & O_CREAT) {
+        va_list ap;
+        va_start(ap, flags);
+        mode = va_arg(ap, mode_t);
+        va_end(ap);
+    }
+    int idx = js_index(path);
+    if (idx >= 0) return selkies_connect(idx, flags);
+    return real_open(path, flags, mode);
+}
+
+int open64(const char *path, int flags, ...)
+{
+    selkies_init();
+    mode_t mode = 0;
+    if (flags & O_CREAT) {
+        va_list ap;
+        va_start(ap, flags);
+        mode = va_arg(ap, mode_t);
+        va_end(ap);
+    }
+    int idx = js_index(path);
+    if (idx >= 0) return selkies_connect(idx, flags);
+    return real_open64 ? real_open64(path, flags, mode) : real_open(path, flags, mode);
+}
+
+int close(int fd)
+{
+    selkies_init();
+    js_slot_t *slot = slot_for_fd(fd);
+    if (slot) slot->fd = -1;
+    return real_close(fd);
+}
+
+int ioctl(int fd, unsigned long request, ...)
+{
+    selkies_init();
+    va_list ap;
+    va_start(ap, request);
+    void *arg = va_arg(ap, void *);
+    va_end(ap);
+
+    js_slot_t *slot = slot_for_fd(fd);
+    if (!slot || _IOC_TYPE(request) != 'j')
+        return real_ioctl(fd, request, arg);
+
+    const js_config_t *cfg = &slot->config;
+    unsigned nr = _IOC_NR(request);
+    size_t size = _IOC_SIZE(request);
+
+    switch (nr) {
+    case _IOC_NR(JSIOCGVERSION):
+        *(unsigned int *)arg = JS_VERSION;
+        return 0;
+    case _IOC_NR(JSIOCGAXES):
+        *(unsigned char *)arg = (unsigned char)cfg->num_axes;
+        return 0;
+    case _IOC_NR(JSIOCGBUTTONS):
+        *(unsigned char *)arg = (unsigned char)cfg->num_btns;
+        return 0;
+    case _IOC_NR(JSIOCGNAME(0)): {
+        size_t n = strnlen(cfg->name, SELKIES_NAME_LEN);
+        if (n >= size) n = size ? size - 1 : 0;
+        memcpy(arg, cfg->name, n);
+        ((char *)arg)[n] = '\0';
+        return (int)(n + 1);
+    }
+    case _IOC_NR(JSIOCGAXMAP): {
+        size_t n = cfg->num_axes < SELKIES_MAX_AXES ? cfg->num_axes : SELKIES_MAX_AXES;
+        if (n * sizeof(unsigned char) > size) n = size;
+        memcpy(arg, cfg->axes_map, n);
+        return 0;
+    }
+    case _IOC_NR(JSIOCGBTNMAP): {
+        size_t n = cfg->num_btns < SELKIES_MAX_BTNS ? cfg->num_btns : SELKIES_MAX_BTNS;
+        if (n * sizeof(unsigned short) > size) n = size / sizeof(unsigned short);
+        memcpy(arg, cfg->btn_map, n * sizeof(unsigned short));
+        return 0;
+    }
+    case _IOC_NR(JSIOCSAXMAP):
+    case _IOC_NR(JSIOCSBTNMAP):
+    case 0x21: /* JSIOCSCORR */
+        return 0; /* accept and ignore remap/correction writes */
+    case 0x22: { /* JSIOCGCORR: report no correction */
+        memset(arg, 0, size);
+        return 0;
+    }
+    default:
+        dbg("selkies-interposer: unhandled 'j' ioctl nr=0x%x size=%zu", nr, size);
+        errno = EINVAL;
+        return -1;
+    }
+}

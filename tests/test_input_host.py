@@ -1,0 +1,231 @@
+"""Input host tests: data-channel protocol parsing → backend effects,
+gamepad socket server wire format, and cursor/clipboard plumbing.
+
+Protocol reference: webrtc_input.py:558-736; gamepad wire format:
+gamepad.py:128-232 + joystick_interposer.c.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import socket
+import struct
+import time
+
+import pytest
+
+from selkies_tpu.input_host import (
+    FakeBackend,
+    GamepadServer,
+    HostInput,
+    MemoryClipboard,
+)
+from selkies_tpu.input_host.gamepad import (
+    ABS_MAX,
+    ABS_MIN,
+    CONFIG_STRUCT,
+    EVENT_STRUCT,
+    JS_EVENT_AXIS,
+    JS_EVENT_BUTTON,
+    XPAD_AXES_MAP,
+    XPAD_BTN_MAP,
+    map_w3c_axis,
+    map_w3c_button,
+)
+from selkies_tpu.input_host.x11 import CursorImage
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def make_input(**kwargs) -> tuple[HostInput, FakeBackend]:
+    backend = FakeBackend()
+    hi = HostInput(backend=backend, clipboard=MemoryClipboard(), **kwargs)
+    return hi, backend
+
+
+def test_key_events(loop):
+    hi, be = make_input()
+    loop.run_until_complete(hi.on_message("kd,65"))
+    loop.run_until_complete(hi.on_message("ku,65"))
+    assert ("key", 65, True) in be.events and ("key", 65, False) in be.events
+
+
+def test_keyboard_reset(loop):
+    hi, be = make_input()
+    loop.run_until_complete(hi.on_message("kr"))
+    keys = [e for e in be.events if e[0] == "key"]
+    assert all(down is False for _, _, down in keys)
+    assert ("key", 65307, False) in keys  # Escape cleared
+
+
+def test_mouse_abs_buttons_and_scroll(loop):
+    hi, be = make_input()
+    # press left button at 100,200
+    loop.run_until_complete(hi.on_message("m,100,200,1,0"))
+    assert ("pos", 100, 200) in be.events
+    assert ("button", 1, True) in be.events
+    # release
+    loop.run_until_complete(hi.on_message("m,100,200,0,0"))
+    assert ("button", 1, False) in be.events
+    # wheel up with magnitude 3 → 3 scroll events
+    be.events.clear()
+    loop.run_until_complete(hi.on_message("m,100,200,8,3"))
+    loop.run_until_complete(hi.on_message("m,100,200,0,0"))
+    assert [e for e in be.events if e == ("scroll", True)] == [("scroll", True)] * 3
+
+
+def test_mouse_relative(loop):
+    hi, be = make_input()
+    loop.run_until_complete(hi.on_message("m2,-5,7,0,0"))
+    assert ("move", -5, 7) in be.events
+
+
+def test_malformed_mouse_falls_back(loop):
+    hi, be = make_input()
+    loop.run_until_complete(hi.on_message("m,xx,yy"))
+    assert ("pos", 0, 0) in be.events  # absolute fallback, no raise
+
+
+def test_callbacks(loop):
+    hi, _ = make_input()
+    seen = {}
+    hi.on_video_encoder_bit_rate = lambda b: seen.setdefault("vb", b)
+    hi.on_audio_encoder_bit_rate = lambda b: seen.setdefault("ab", b)
+    hi.on_mouse_pointer_visible = lambda v: seen.setdefault("p", v)
+    hi.on_resize = lambda r: seen.setdefault("r", r)
+    hi.on_scaling_ratio = lambda s: seen.setdefault("s", s)
+    hi.on_set_fps = lambda f: seen.setdefault("fps", f)
+    hi.on_set_enable_resize = lambda e, r: seen.setdefault("er", (e, r))
+    hi.on_client_fps = lambda f: seen.setdefault("_f", f)
+    hi.on_client_latency = lambda l: seen.setdefault("_l", l)
+    hi.on_client_webrtc_stats = lambda t, s: seen.setdefault("stats", (t, s))
+
+    msgs = [
+        "vb,4000", "ab,128000", "p,1", "r,1921x1079", "s,1.25",
+        "_arg_fps,30", "_arg_resize,true,800x601", "_f,59", "_l,12",
+        '_stats_video,{"a":1},extra',
+    ]
+    for m in msgs:
+        loop.run_until_complete(hi.on_message(m))
+
+    assert seen["vb"] == 4000 and seen["ab"] == 128000 and seen["p"] is True
+    assert seen["r"] == "1922x1080"  # rounded up to even
+    assert seen["s"] == 1.25
+    assert seen["fps"] == 30
+    assert seen["er"] == (True, "800x602")
+    assert seen["_f"] == 59 and seen["_l"] == 12
+    assert seen["stats"] == ("_stats_video", '{"a":1},extra')
+
+
+def test_ping_pong(loop):
+    hi, _ = make_input()
+    got = []
+    hi.on_ping_response = got.append
+    hi.send_ping(time.time() - 0.1)
+    loop.run_until_complete(hi.on_message("pong,123"))
+    assert len(got) == 1 and 40 < got[0] < 500  # ~50ms one-way
+
+
+def test_clipboard_gating(loop):
+    hi, _ = make_input(enable_clipboard="true")
+    hi.clipboard.write("hello")
+    got = []
+    hi.on_clipboard_read = got.append
+    loop.run_until_complete(hi.on_message("cr"))
+    assert got == ["hello"]
+    payload = base64.b64encode("world".encode()).decode()
+    loop.run_until_complete(hi.on_message(f"cw,{payload}"))
+    assert hi.clipboard.read() == "world"
+
+    hi2, _ = make_input(enable_clipboard="false")
+    hi2.clipboard.write("secret")
+    got2 = []
+    hi2.on_clipboard_read = got2.append
+    loop.run_until_complete(hi2.on_message("cr"))
+    assert got2 == []
+
+
+def test_cursor_to_msg_shapes():
+    hi, _ = make_input()
+    cur = CursorImage(width=8, height=8, xhot=2, yhot=3, serial=42,
+                      argb=[0xFF00FF00] * 64)
+    msg = hi.cursor_to_msg(cur, cursor_size=16)
+    assert msg["handle"] == 42 and msg["override"] is None
+    assert msg["hotspot"] == {"x": 4, "y": 6}
+    png = base64.b64decode(msg["curdata"])
+    assert png[:8] == b"\x89PNG\r\n\x1a\n"
+    # all-transparent cursor → override none
+    blank = CursorImage(width=4, height=4, xhot=0, yhot=0, serial=7, argb=[0] * 16)
+    assert hi.cursor_to_msg(blank, cursor_size=4)["override"] == "none"
+
+
+# ----------------------------------------------------------------------
+# gamepad mapping + socket server
+
+
+def test_w3c_mapping_buttons():
+    # plain button passes through
+    ts, val, etype, num = EVENT_STRUCT.unpack(map_w3c_button(0, 1))
+    assert (val, etype, num) == (1, JS_EVENT_BUTTON, 0)
+    # select (8) remaps to xpad button 6
+    _, val, etype, num = EVENT_STRUCT.unpack(map_w3c_button(8, 1))
+    assert (val, etype, num) == (1, JS_EVENT_BUTTON, 6)
+    # trigger L2 (6) becomes full-range axis 2
+    _, val, etype, num = EVENT_STRUCT.unpack(map_w3c_button(6, 1.0))
+    assert (etype, num) == (JS_EVENT_AXIS, 2)
+    assert val == ABS_MAX
+    _, val, _, _ = EVENT_STRUCT.unpack(map_w3c_button(6, 0.0))
+    assert val == ABS_MIN
+    # dpad left (14) → hat0x negative
+    _, val, etype, num = EVENT_STRUCT.unpack(map_w3c_button(14, 1))
+    assert (etype, num) == (JS_EVENT_AXIS, 6) and val == ABS_MIN
+
+
+def test_w3c_mapping_axes():
+    # right stick X (w3c axis 2) → ABS_RX slot (axis 3)
+    _, val, etype, num = EVENT_STRUCT.unpack(map_w3c_axis(2, 1.0))
+    assert (etype, num) == (JS_EVENT_AXIS, 3) and val == ABS_MAX
+    _, val, _, num = EVENT_STRUCT.unpack(map_w3c_axis(0, 0.0))
+    assert num == 0 and val == 0
+
+
+def test_gamepad_server_config_and_events(loop, tmp_path):
+    async def scenario():
+        path = str(tmp_path / "selkies_js0.sock")
+        js = GamepadServer(path)
+        await js.start()
+
+        reader, writer = await asyncio.open_unix_connection(path)
+        cfg_raw = await asyncio.wait_for(reader.readexactly(CONFIG_STRUCT.size), 5)
+        unpacked = CONFIG_STRUCT.unpack(cfg_raw)
+        name = unpacked[0].rstrip(b"\x00").decode()
+        num_btns, num_axes = unpacked[1], unpacked[2]
+        assert name == "Selkies Controller"
+        assert num_btns == len(XPAD_BTN_MAP) and num_axes == len(XPAD_AXES_MAP)
+        btn_map = unpacked[3 : 3 + 512]
+        assert list(btn_map[:num_btns]) == XPAD_BTN_MAP
+
+        # neutral state burst: num_btns + num_axes events
+        for _ in range(num_btns + num_axes):
+            await asyncio.wait_for(reader.readexactly(EVENT_STRUCT.size), 5)
+
+        # live event
+        js.send_btn(0, 1)
+        ts, val, etype, num = EVENT_STRUCT.unpack(
+            await asyncio.wait_for(reader.readexactly(EVENT_STRUCT.size), 5)
+        )
+        assert (val, etype, num) == (1, JS_EVENT_BUTTON, 0)
+
+        writer.close()
+        await js.stop()
+        import os
+        assert not os.path.exists(path)
+
+    loop.run_until_complete(scenario())

@@ -1,0 +1,90 @@
+"""End-to-end LD_PRELOAD test of the C joystick interposer.
+
+A subprocess runs with the interposer preloaded and opens /dev/input/js0;
+the shim redirects it to our GamepadServer unix socket, consumes the config
+blob, emulates the joystick ioctls, and streams js_event packets
+(reference counterpart: addons/js-interposer/js-interposer-test.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import subprocess
+import sys
+
+import pytest
+
+from selkies_tpu.input_host.gamepad import GamepadServer
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+SO_PATH = os.path.join(NATIVE_DIR, "selkies_joystick_interposer.so")
+
+CLIENT_SCRIPT = r"""
+import fcntl, os, struct, sys
+
+fd = os.open("/dev/input/js0", os.O_RDONLY)
+
+# JSIOCGAXES / JSIOCGBUTTONS / JSIOCGVERSION / JSIOCGNAME
+buf = bytearray(1)
+fcntl.ioctl(fd, 0x80016a11, buf)  # JSIOCGAXES
+axes = buf[0]
+buf = bytearray(1)
+fcntl.ioctl(fd, 0x80016a12, buf)  # JSIOCGBUTTONS
+btns = buf[0]
+buf = bytearray(4)
+fcntl.ioctl(fd, 0x80046a01, buf)  # JSIOCGVERSION
+version = struct.unpack("I", buf)[0]
+name = bytearray(128)
+n = fcntl.ioctl(fd, (2 << 30) | (ord('j') << 8) | 0x13 | (128 << 16), name)  # JSIOCGNAME(128)
+name = name.rstrip(b"\x00").decode()
+btnmap = bytearray(btns * 2)
+fcntl.ioctl(fd, (2 << 30) | (ord('j') << 8) | 0x34 | (len(btnmap) << 16), btnmap)
+first_btn = struct.unpack_from("H", btnmap, 0)[0]
+
+print(f"CONFIG axes={axes} btns={btns} version={version:#x} name={name} first_btn={first_btn:#x}", flush=True)
+
+# read the neutral burst + one live event
+total = btns + axes + 1
+events = []
+for _ in range(total):
+    data = os.read(fd, 8)
+    while len(data) < 8:
+        data += os.read(fd, 8 - len(data))
+    events.append(struct.unpack("IhBB", data))
+last = events[-1]
+print(f"EVENT value={last[1]} type={last[2]} number={last[3]}", flush=True)
+os.close(fd)
+"""
+
+
+@pytest.mark.skipif(not os.path.exists(SO_PATH), reason="interposer not built")
+def test_interposer_end_to_end(tmp_path):
+    async def scenario():
+        js = GamepadServer(str(tmp_path / "selkies_js0.sock"))
+        await js.start()
+
+        env = dict(os.environ)
+        env["LD_PRELOAD"] = SO_PATH
+        env["SELKIES_INTERPOSER_SOCKET_PATH"] = str(tmp_path)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-c", CLIENT_SCRIPT,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+        )
+
+        # give the client time to connect + receive config + neutral burst,
+        # then send the live event it waits for
+        await asyncio.sleep(1.5)
+        js.send_btn(0, 1)
+
+        out, err = await asyncio.wait_for(proc.communicate(), 20)
+        text = out.decode()
+        assert proc.returncode == 0, f"client failed: {err.decode()}\n{text}"
+        assert "CONFIG axes=8 btns=11" in text
+        assert "name=Selkies Controller" in text
+        assert "first_btn=0x130" in text  # BTN_A
+        assert "EVENT value=1 type=1 number=0" in text
+        await js.stop()
+
+    asyncio.run(scenario())

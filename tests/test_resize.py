@@ -1,0 +1,96 @@
+"""Resize logic tests with an injected fake xrandr/cvt runner."""
+
+from __future__ import annotations
+
+import subprocess
+
+from selkies_tpu.input_host.resize import (
+    MAX_RES_DVI,
+    fit_res,
+    generate_modeline,
+    get_new_res,
+    parse_xrandr,
+    resize_display,
+)
+
+XRANDR_OUT = """\
+Screen 0: minimum 320 x 200, current 1920 x 1080, maximum 16384 x 16384
+eDP-1 connected primary 1920x1080+0+0 (normal left inverted) 344mm x 194mm
+   1920x1080     60.02*+  59.97
+   1680x1050     59.95
+   1280x720      60.00
+"""
+
+CVT_OUT = """\
+# 2560x1440 59.95 Hz (CVT 3.69M9-R) hsync: 88.79 kHz; pclk: 241.50 MHz
+Modeline "2560x1440R"  241.50  2560 2608 2640 2720  1440 1443 1448 1481 +hsync -vsync
+"""
+
+
+class FakeRunner:
+    def __init__(self):
+        self.calls: list[list[str]] = []
+
+    def __call__(self, cmd):
+        self.calls.append(cmd)
+        out = ""
+        if cmd[0] == "xrandr" and len(cmd) == 1:
+            out = XRANDR_OUT
+        elif cmd[0] == "cvt":
+            out = CVT_OUT
+        return subprocess.CompletedProcess(cmd, 0, stdout=out, stderr="")
+
+
+def test_fit_res():
+    assert fit_res(1920, 1080, 7680, 4320) == (1920, 1080)
+    w, h = fit_res(8000, 4500, 7680, 4320)
+    assert w <= 7680 and h <= 4320 and w % 2 == 0 and h % 2 == 0
+    assert fit_res(2561, 1601, *MAX_RES_DVI) <= MAX_RES_DVI
+
+
+def test_parse_xrandr():
+    name, current, modes = parse_xrandr(XRANDR_OUT)
+    assert name == "eDP-1"
+    assert current == "1920x1080"
+    assert "1280x720" in modes and len(modes) == 3
+
+
+def test_get_new_res_caps():
+    runner = FakeRunner()
+    curr, new, modes, max_res, screen = get_new_res("9000x5000", runner)
+    assert screen == "eDP-1" and curr == "1920x1080"
+    w, h = (int(v) for v in new.split("x"))
+    assert w <= 7680 and h <= 4320
+    assert max_res == "7680x4320"
+
+
+def test_generate_modeline():
+    runner = FakeRunner()
+    mode, modeline = generate_modeline("2560x1440", runner)
+    assert mode == "2560x1440"
+    assert modeline.startswith("241.50")
+    assert runner.calls[0][:2] == ["cvt", "-r"]
+
+
+def test_resize_creates_mode_and_applies():
+    runner = FakeRunner()
+    assert resize_display("2560x1440", runner) is True
+    cmds = [" ".join(c[:2]) for c in runner.calls]
+    assert "xrandr --newmode" in cmds
+    assert "xrandr --addmode" in cmds
+    assert "xrandr --output" in cmds
+
+
+def test_resize_skips_when_same():
+    runner = FakeRunner()
+    assert resize_display("1920x1080", runner) is False
+    # only the probe call, no mode changes
+    assert all(c == ["xrandr"] for c in runner.calls)
+
+
+def test_resize_existing_mode_no_newmode():
+    runner = FakeRunner()
+    assert resize_display("1280x720", runner) is True
+    cmds = [" ".join(c[:2]) for c in runner.calls]
+    assert "xrandr --newmode" not in cmds
+    assert "xrandr --output" in cmds
