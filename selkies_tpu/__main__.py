@@ -1,0 +1,6 @@
+"""``python -m selkies_tpu`` → the orchestrator entrypoint."""
+
+from selkies_tpu.orchestrator import entrypoint
+
+if __name__ == "__main__":
+    entrypoint()

@@ -1,0 +1,100 @@
+"""Audio frame sources: PulseAudio capture (gated) and a synthetic tone.
+
+Parity: the reference captures with ``pulsesrc`` (buffer-time 100 ms,
+latency-time 1 ms, gstwebrtc_app.py:1009-1028).  Without libpulse in this
+image we shell out to ``parec`` when present; otherwise the synthetic
+source keeps the pipeline exercised end-to-end.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+import shutil
+import struct
+from typing import Protocol
+
+from selkies_tpu.audio.opus import CHANNELS, FRAME_SAMPLES, SAMPLE_RATE
+
+logger = logging.getLogger("audio.sources")
+
+FRAME_BYTES = FRAME_SAMPLES * CHANNELS * 2
+
+
+class AudioSource(Protocol):
+    async def start(self) -> None: ...
+
+    async def read_frame(self) -> bytes:
+        """Return one 10 ms s16le stereo frame (FRAME_BYTES bytes)."""
+        ...
+
+    async def stop(self) -> None: ...
+
+
+class SyntheticAudioSource:
+    """440 Hz sine (quiet) — deterministic signal for tests and demos."""
+
+    def __init__(self, freq: float = 440.0, amplitude: float = 0.1):
+        self.freq = freq
+        self.amplitude = amplitude
+        self._phase = 0
+
+    async def start(self) -> None:
+        return None
+
+    async def read_frame(self) -> bytes:
+        out = bytearray()
+        amp = int(self.amplitude * 32767)
+        for i in range(FRAME_SAMPLES):
+            s = int(amp * math.sin(2 * math.pi * self.freq * (self._phase + i) / SAMPLE_RATE))
+            out += struct.pack("<hh", s, s)
+        self._phase += FRAME_SAMPLES
+        return bytes(out)
+
+    async def stop(self) -> None:
+        return None
+
+
+class PulseAudioSource:
+    """``parec`` subprocess capture from the default monitor device."""
+
+    def __init__(self, device: str | None = None):
+        self.device = device
+        self._proc: asyncio.subprocess.Process | None = None
+
+    @staticmethod
+    def available() -> bool:
+        return shutil.which("parec") is not None
+
+    async def start(self) -> None:
+        cmd = [
+            "parec", "--format=s16le", f"--rate={SAMPLE_RATE}", f"--channels={CHANNELS}",
+            f"--latency-msec=1",
+        ]
+        if self.device:
+            cmd += ["-d", self.device]
+        self._proc = await asyncio.create_subprocess_exec(
+            *cmd, stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.DEVNULL
+        )
+        logger.info("parec capture started (device=%s)", self.device or "default")
+
+    async def read_frame(self) -> bytes:
+        assert self._proc is not None and self._proc.stdout is not None
+        return await self._proc.stdout.readexactly(FRAME_BYTES)
+
+    async def stop(self) -> None:
+        if self._proc is not None:
+            try:
+                self._proc.terminate()
+                await self._proc.wait()
+            except ProcessLookupError:
+                pass
+            self._proc = None
+
+
+def open_best_audio_source() -> AudioSource:
+    if PulseAudioSource.available():
+        return PulseAudioSource()
+    logger.info("parec not found; using synthetic audio source")
+    return SyntheticAudioSource()

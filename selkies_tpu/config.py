@@ -130,6 +130,8 @@ FLAGS: tuple[Flag, ...] = (
     # legacy GPU flag kept for CLI compatibility; ignored by the TPU path
     _f("gpu_id", 0, "Legacy GPU index (ignored; present for CLI compatibility)."),
     # TPU-native additions
+    _f("capture_width", 1280, "Capture width when no X display drives resolution (synthetic source)."),
+    _f("capture_height", 720, "Capture height when no X display drives resolution (synthetic source)."),
     _f("tpu_device", 0, "TPU chip index this session's encode stream is placed on."),
     _f("tpu_sessions", 1, "Concurrent sessions to place across the TPU mesh (1 chip per stream)."),
     _f("transport", "auto", "Media transport: auto|webrtc|websocket."),

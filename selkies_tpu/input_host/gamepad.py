@@ -109,12 +109,11 @@ def map_w3c_axis(axis_num: int, axis_val: float) -> bytes | None:
 class GamepadServer:
     """One unix-socket server per virtual joystick (``/tmp/selkies_js{N}.sock``)."""
 
-    def __init__(self, socket_path: str, name: str = XPAD_NAME,
-                 client_num_btns: int = 17, client_num_axes: int = 4):
+    MAX_WRITE_BUFFER = 64 * 1024  # drop clients that stop reading events
+
+    def __init__(self, socket_path: str, name: str = XPAD_NAME):
         self.socket_path = socket_path
         self.name = name
-        self.client_num_btns = client_num_btns
-        self.client_num_axes = client_num_axes
         self._server: asyncio.base_events.Server | None = None
         self._writers: set[asyncio.StreamWriter] = set()
 
@@ -179,6 +178,12 @@ class GamepadServer:
             return
         for w in list(self._writers):
             try:
+                if w.transport.get_write_buffer_size() > self.MAX_WRITE_BUFFER:
+                    # client stopped reading; don't buffer events unboundedly
+                    logger.warning("gamepad client not reading; dropping it")
+                    self._writers.discard(w)
+                    w.close()
+                    continue
                 w.write(event)
             except (ConnectionError, RuntimeError):
                 self._writers.discard(w)

@@ -268,7 +268,10 @@ class HostInput:
             logger.error("no socket path for js%d", js_num)
             return
         logger.info("gamepad js%d connect: %r (%d btns, %d axes)", js_num, name, num_btns, num_axes)
-        js = GamepadServer(path, client_num_btns=num_btns, client_num_axes=num_axes)
+        old = self.gamepads.pop(js_num, None)
+        if old is not None:
+            await old.stop()
+        js = GamepadServer(path)
         await js.start()
         self.gamepads[js_num] = js
 

@@ -9,7 +9,6 @@ and set DPI / cursor size through xfconf.  All shell-outs run through one
 
 from __future__ import annotations
 
-import asyncio
 import logging
 import re
 import subprocess
@@ -148,7 +147,7 @@ def entrypoint() -> None:
     if len(sys.argv) < 2:
         print(f"USAGE: {sys.argv[0]} WxH")
         raise SystemExit(1)
-    print(asyncio.run(asyncio.to_thread(resize_display, sys.argv[1])))
+    print(resize_display(sys.argv[1]))
 
 
 if __name__ == "__main__":

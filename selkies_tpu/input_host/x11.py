@@ -118,6 +118,7 @@ class X11Display:
         x.XSync.argtypes = [vp, i]
         x.XPending.restype = i
         x.XPending.argtypes = [vp]
+        x.XNextEvent.argtypes = [vp, ctypes.c_char_p]
         x.XFree.argtypes = [vp]
         x.XCloseDisplay.argtypes = [vp]
         xtst.XTestFakeKeyEvent.argtypes = [vp, ui, i, ul]

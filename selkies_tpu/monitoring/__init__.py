@@ -2,3 +2,9 @@
 
 Parity with metrics.py / system_monitor.py / gpu_monitor.py (SURVEY.md §2.1).
 """
+
+from selkies_tpu.monitoring.metrics import Metrics
+from selkies_tpu.monitoring.system_monitor import SystemMonitor
+from selkies_tpu.monitoring.tpu_monitor import TPUMonitor
+
+__all__ = ["Metrics", "SystemMonitor", "TPUMonitor"]

@@ -1,0 +1,73 @@
+"""TPU device monitor — the accelerator twin of the reference GPUMonitor.
+
+Where the reference polls GPUtil for load / memoryTotal / memoryUsed
+(gpu_monitor.py:31-47) and feeds the ``gpu_stats`` data channel, we sample
+the JAX device: HBM occupancy from ``device.memory_stats()`` (available on
+TPU PJRT devices) and a load proxy derived from the encode pipeline's duty
+cycle (device_ms per frame interval), pushed in by the pipeline via
+``observe_encode``.  Stats arrive at the same ``on_stats(load,
+memory_total_mb, memory_used_mb)`` callback shape the orchestrator wires
+to ``send_gpu_stats``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+logger = logging.getLogger("tpu_monitor")
+
+
+class TPUMonitor:
+    def __init__(self, period: float = 1.0, enabled: bool = True):
+        self.period = period
+        self.enabled = enabled
+        self.running = False
+        self._busy_ms = 0.0  # encode device-time accumulated this period
+        self._window_start = time.monotonic()
+        self.on_stats = lambda load, memory_total, memory_used: logger.warning(
+            "unhandled on_stats"
+        )
+
+    # pipeline hook: called per encoded frame with device milliseconds
+    def observe_encode(self, device_ms: float) -> None:
+        self._busy_ms += device_ms
+
+    def _load(self) -> float:
+        now = time.monotonic()
+        elapsed_ms = (now - self._window_start) * 1e3
+        self._window_start = now
+        busy, self._busy_ms = self._busy_ms, 0.0
+        if elapsed_ms <= 0:
+            return 0.0
+        return min(1.0, busy / elapsed_ms)
+
+    @staticmethod
+    def _memory_mb() -> tuple[float, float]:
+        try:
+            import jax
+
+            dev = jax.local_devices()[0]
+            stats = dev.memory_stats() or {}
+            total = stats.get("bytes_limit") or stats.get("bytes_reservable_limit") or 0
+            used = stats.get("bytes_in_use", 0)
+            return total / 1e6, used / 1e6
+        except Exception as exc:
+            logger.debug("memory_stats unavailable: %s", exc)
+            return 0.0, 0.0
+
+    async def start(self) -> None:
+        self.running = True
+        while self.running:
+            if self.enabled:
+                total_mb, used_mb = await asyncio.to_thread(self._memory_mb)
+                try:
+                    self.on_stats(self._load(), total_mb, used_mb)
+                except Exception:
+                    logger.exception("on_stats callback failed")
+            await asyncio.sleep(self.period)
+        logger.info("TPU monitor stopped")
+
+    def stop(self) -> None:
+        self.running = False

@@ -1,0 +1,373 @@
+"""Orchestrator — the ``selkies-tpu`` entrypoint.
+
+Parity target: the reference __main__.py main() (:335-992): resolve config
+(flags ⇄ env ⇄ JSON overlay), resolve the TURN credential chain, start the
+combined signalling/web server, wire every callback between the app core,
+input host, monitors and metrics, then supervise sessions forever.
+
+Differences by design: one process hosts both the server and the app (the
+reference also runs them in-process but connects through a localhost
+WebSocket pair); the media plane is a pluggable Transport — the WebSocket
+transport is always available, the WebRTC transport engages when a browser
+negotiates SDP.  Session lifecycle follows the transport's connect /
+disconnect events.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import logging
+import os
+import time
+
+from selkies_tpu.audio import AudioPipeline, open_best_audio_source, opus_available
+from selkies_tpu.config import Config, parse_config
+from selkies_tpu.input_host import HostInput
+from selkies_tpu.input_host.resize import resize_display, set_cursor_size, set_dpi
+from selkies_tpu.monitoring import Metrics, SystemMonitor, TPUMonitor
+from selkies_tpu.pipeline.app import TPUWebRTCApp
+from selkies_tpu.signalling import (
+    SignallingOptions,
+    SignallingServer,
+    generate_rtc_config,
+    parse_rtc_config,
+    stun_only_rtc_config,
+)
+from selkies_tpu.signalling.rtc_monitors import (
+    HMACRTCMonitor,
+    RESTRTCMonitor,
+    RTCConfigFileMonitor,
+    fetch_cloudflare_turn,
+    fetch_turn_rest,
+    make_turn_rtc_config_json_legacy,
+)
+from selkies_tpu.transport.websocket import WebSocketTransport
+
+logger = logging.getLogger("orchestrator")
+
+DEFAULT_WEB_ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "web")
+
+
+async def wait_for_app_ready(ready_file: str, app_wait_ready: bool) -> None:
+    """Block until the sidecar app drops its ready file (reference :288-301)."""
+    logger.info("waiting for streaming app ready")
+    while app_wait_ready and not os.path.exists(ready_file):
+        await asyncio.sleep(0.2)
+
+
+async def resolve_rtc_config(cfg: Config) -> tuple[str, str, str]:
+    """TURN credential priority chain (reference __main__.py:617-656):
+    Cloudflare → rtc.json file → TURN REST → legacy long-term → HMAC →
+    STUN-only fallback.  Returns (stun_servers, turn_servers, rtc_config)."""
+    if cfg.enable_cloudflare_turn and cfg.cloudflare_turn_token_id:
+        try:
+            doc = await fetch_cloudflare_turn(
+                cfg.cloudflare_turn_token_id, cfg.cloudflare_turn_api_token
+            )
+            data = json.dumps({"lifetimeDuration": "86400s", "iceServers": [doc["iceServers"]]})
+            return parse_rtc_config(data)
+        except Exception as exc:
+            logger.warning("Cloudflare TURN failed (%s); falling through", exc)
+    if cfg.rtc_config_json and os.path.exists(cfg.rtc_config_json):
+        try:
+            with open(cfg.rtc_config_json) as f:
+                return parse_rtc_config(f.read())
+        except Exception as exc:
+            logger.warning("rtc_config_json unreadable (%s); falling through", exc)
+    if cfg.turn_rest_uri:
+        try:
+            return await fetch_turn_rest(
+                cfg.turn_rest_uri, cfg.turn_rest_username.replace(":", "-"),
+                cfg.turn_rest_username_auth_header, cfg.turn_protocol,
+                cfg.turn_rest_protocol_header, cfg.turn_tls, cfg.turn_rest_tls_header,
+            )
+        except Exception as exc:
+            logger.warning("TURN REST failed (%s); falling through", exc)
+    if cfg.turn_host and cfg.turn_port:
+        if cfg.turn_username and cfg.turn_password:
+            data = make_turn_rtc_config_json_legacy(
+                cfg.turn_host, cfg.turn_port, cfg.turn_username, cfg.turn_password,
+                cfg.turn_protocol, cfg.turn_tls, cfg.stun_host, cfg.stun_port,
+            )
+            return parse_rtc_config(data)
+        if cfg.turn_shared_secret:
+            data = generate_rtc_config(
+                cfg.turn_host, cfg.turn_port, cfg.turn_shared_secret,
+                cfg.turn_rest_username, cfg.turn_protocol, cfg.turn_tls,
+                cfg.stun_host, cfg.stun_port,
+            )
+            return parse_rtc_config(data)
+    return parse_rtc_config(stun_only_rtc_config(cfg.stun_host, cfg.stun_port))
+
+
+class Orchestrator:
+    def __init__(self, cfg: Config):
+        self.cfg = cfg
+        self.metrics = Metrics(
+            port=int(cfg.metrics_http_port),
+            using_webrtc_csv=bool(cfg.enable_webrtc_statistics),
+        )
+        self.transport = WebSocketTransport()
+        self.app = TPUWebRTCApp(
+            transport=self.transport,
+            encoder=cfg.encoder,
+            width=int(cfg.capture_width),
+            height=int(cfg.capture_height),
+            framerate=int(cfg.framerate),
+            video_bitrate_kbps=int(cfg.video_bitrate),
+            congestion_control=bool(cfg.congestion_control),
+        )
+        self.audio: AudioPipeline | None = None
+        if opus_available():
+            self.audio = AudioPipeline(
+                source=open_best_audio_source(),
+                sink=self.transport.send_audio,
+                bitrate_bps=int(cfg.audio_bitrate),
+            )
+        self.input = HostInput(
+            uinput_mouse_socket_path=cfg.uinput_mouse_socket,
+            js_socket_path=cfg.js_socket_path,
+            enable_clipboard=str(cfg.enable_clipboard).lower(),
+            enable_cursors=bool(cfg.enable_cursors),
+            cursor_size=int(cfg.cursor_size),
+            cursor_debug=bool(cfg.debug_cursors),
+        )
+        self.system_mon = SystemMonitor()
+        self.tpu_mon = TPUMonitor()
+        self.server = SignallingServer(SignallingOptions(
+            addr=cfg.addr,
+            port=int(cfg.port),
+            web_root=cfg.web_root or DEFAULT_WEB_ROOT,
+            turn_shared_secret=cfg.turn_shared_secret,
+            turn_host=cfg.turn_host,
+            turn_port=str(cfg.turn_port) if cfg.turn_host else "",
+            turn_protocol=cfg.turn_protocol,
+            turn_tls=bool(cfg.turn_tls),
+            stun_host=cfg.stun_host,
+            stun_port=str(cfg.stun_port),
+            rtc_config_file=cfg.rtc_config_json,
+            enable_basic_auth=bool(cfg.enable_basic_auth),
+            basic_auth_user=cfg.basic_auth_user,
+            basic_auth_password=cfg.basic_auth_password,
+            enable_https=bool(cfg.enable_https),
+            https_cert=cfg.https_cert,
+            https_key=cfg.https_key,
+        ))
+        self.server.ws_routes["/media"] = self.transport.handle_connection
+        self._tasks: list[asyncio.Task] = []
+        self._session_active = False
+        self.last_resize_success = True
+        self._wire_callbacks()
+
+    # ------------------------------------------------------------------
+
+    def _wire_callbacks(self) -> None:
+        """Reference wiring: __main__.py:684-871."""
+        cfg, app, inp = self.cfg, self.app, self.input
+
+        # transport session lifecycle (reference on_session_handler :700)
+        self.transport.on_connect = self._on_client_connected
+        self.transport.on_disconnect = self._on_client_disconnected
+        self.transport.on_data_message = inp.on_message
+        app.on_data_open = lambda: logger.info("data channel open")
+
+        # client → host settings
+        def on_video_bitrate(bitrate_kbps: int) -> None:
+            app.set_video_bitrate(bitrate_kbps)
+            cfg.set_json_setting("video_bitrate", int(bitrate_kbps))
+            app.send_video_bitrate(int(bitrate_kbps))
+
+        def on_audio_bitrate(bitrate_bps: int) -> None:
+            if self.audio is not None:
+                self.audio.set_bitrate(int(bitrate_bps))
+            cfg.set_json_setting("audio_bitrate", int(bitrate_bps))
+            app.send_audio_bitrate(int(bitrate_bps))
+
+        def on_set_fps(fps: int) -> None:
+            app.set_framerate(int(fps))
+            cfg.set_json_setting("framerate", int(fps))
+            app.send_framerate(int(fps))
+
+        def on_set_enable_resize(enabled: bool, res: str | None) -> None:
+            cfg.set_json_setting("enable_resize", bool(enabled))
+            app.send_resize_enabled(bool(enabled))
+            if enabled and res:
+                self._do_resize(res)
+
+        inp.on_video_encoder_bit_rate = on_video_bitrate
+        inp.on_audio_encoder_bit_rate = on_audio_bitrate
+        inp.on_set_fps = on_set_fps
+        inp.on_set_enable_resize = on_set_enable_resize
+        inp.on_mouse_pointer_visible = app.set_pointer_visible
+        inp.on_clipboard_read = app.send_clipboard_data
+        inp.on_cursor_change = app.send_cursor_data
+        inp.on_resize = self._on_resize
+        inp.on_scaling_ratio = self._on_scaling_ratio
+        inp.on_client_fps = self.metrics.set_fps
+        inp.on_client_latency = self.metrics.set_latency
+        inp.on_ping_response = self._on_ping_response
+        inp.on_client_webrtc_stats = self.metrics.set_webrtc_stats
+
+        # monitors → client stats channels
+        def on_timer(ts: float) -> None:
+            inp.send_ping(ts)
+            app.send_ping(ts)
+            app.send_system_stats(
+                self.system_mon.cpu_percent, self.system_mon.mem_total, self.system_mon.mem_used
+            )
+
+        self.system_mon.on_timer = on_timer
+        self.tpu_mon.on_stats = lambda load, total, used: (
+            self.metrics.set_tpu_utilization(load * 100),
+            app.send_tpu_stats(load, total, used),
+        )
+        app.on_frame = lambda ef: self.tpu_mon.observe_encode(ef.device_ms)
+
+    # ------------------------------------------------------------------
+    # resize plumbing (reference :771-823)
+
+    def _do_resize(self, res: str) -> None:
+        if not bool(self.cfg.enable_resize):
+            return
+        if not self.last_resize_success:
+            logger.warning("skipping resize because last resize failed")
+            return
+        try:
+            ok = resize_display(res)
+        except Exception as exc:
+            logger.warning("resize failed: %s", exc)
+            ok = False
+            self.last_resize_success = False
+        if ok:
+            self.app.send_remote_resolution(res)
+
+    def _on_resize(self, res: str) -> None:
+        self._do_resize(res)
+
+    def _on_scaling_ratio(self, scale: float) -> None:
+        dpi = int(96 * scale)
+        set_dpi(dpi)
+        cursor_size = int(16 * scale)
+        set_cursor_size(cursor_size)
+
+    def _on_ping_response(self, latency_ms: float) -> None:
+        self.metrics.set_latency(latency_ms)
+        self.app.send_latency_time(latency_ms)
+
+    # ------------------------------------------------------------------
+    # session lifecycle
+
+    def _on_client_connected(self) -> None:
+        logger.info("client connected; starting pipelines")
+        self._session_active = True
+        loop = asyncio.get_running_loop()
+        loop.create_task(self._start_session())
+
+    def _on_client_disconnected(self) -> None:
+        logger.info("client disconnected; stopping pipelines")
+        self._session_active = False
+        loop = asyncio.get_running_loop()
+        loop.create_task(self._stop_session())
+
+    async def _start_session(self) -> None:
+        if self.cfg.enable_webrtc_statistics:
+            self.metrics.initialize_webrtc_csv_file(self.cfg.webrtc_statistics_dir)
+        self.app.force_keyframe()
+        await self.app.start_pipeline()
+        if self.audio is not None:
+            await self.audio.start()
+
+    async def _stop_session(self) -> None:
+        await self.app.stop_pipeline()
+        if self.audio is not None:
+            await self.audio.stop()
+        await self.input.stop_js_server()
+        self.input.reset_keyboard()
+
+    # ------------------------------------------------------------------
+
+    async def run(self) -> None:
+        cfg = self.cfg
+        await wait_for_app_ready(cfg.app_ready_file, bool(cfg.app_wait_ready))
+
+        stun_servers, turn_servers, rtc_config = await resolve_rtc_config(cfg)
+        self.server.set_rtc_config(rtc_config)
+        logger.info("RTC config resolved: stun=%s turn=%s", stun_servers, bool(turn_servers))
+
+        await self.server.start()
+        await self.input.connect()
+
+        def on_rtc_config(stun: str, turn: str, config: str) -> None:
+            self.server.set_rtc_config(config)
+
+        monitors = []
+        if cfg.turn_shared_secret and cfg.turn_host and cfg.turn_port:
+            m = HMACRTCMonitor(
+                cfg.turn_host, cfg.turn_port, cfg.turn_shared_secret,
+                cfg.turn_rest_username, cfg.turn_protocol, bool(cfg.turn_tls),
+                cfg.stun_host, cfg.stun_port,
+            )
+            m.on_rtc_config = on_rtc_config
+            monitors.append(m)
+        if cfg.turn_rest_uri:
+            m = RESTRTCMonitor(
+                cfg.turn_rest_uri, cfg.turn_rest_username,
+                cfg.turn_rest_username_auth_header, cfg.turn_protocol,
+                cfg.turn_rest_protocol_header, bool(cfg.turn_tls), cfg.turn_rest_tls_header,
+            )
+            m.on_rtc_config = on_rtc_config
+            monitors.append(m)
+        if cfg.rtc_config_json:
+            m = RTCConfigFileMonitor(cfg.rtc_config_json, enabled=os.path.exists(cfg.rtc_config_json))
+            m.on_rtc_config = on_rtc_config
+            monitors.append(m)
+
+        spawn = asyncio.get_running_loop().create_task
+        self._tasks = [spawn(m.start()) for m in monitors]
+        self._tasks.append(spawn(self.system_mon.start()))
+        self._tasks.append(spawn(self.tpu_mon.start()))
+        self._tasks.append(spawn(self.input.start_clipboard()))
+        self._tasks.append(spawn(self.input.start_cursor_monitor()))
+        if cfg.enable_metrics_http:
+            self._tasks.append(spawn(self.metrics.start_http()))
+
+        logger.info(
+            "selkies-tpu ready on %s:%s (encoder=%s, transport=ws+webrtc)",
+            cfg.addr, cfg.port, cfg.encoder,
+        )
+        try:
+            await self.server.run()
+        finally:
+            await self.shutdown()
+
+    async def shutdown(self) -> None:
+        await self._stop_session()
+        self.system_mon.stop()
+        self.tpu_mon.stop()
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        await self.input.disconnect()
+        await self.server.stop()
+
+
+async def main(argv: list[str] | None = None) -> None:
+    cfg = parse_config(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if cfg.debug else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    await Orchestrator(cfg).run()
+
+
+def entrypoint() -> None:
+    try:
+        asyncio.run(main())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    entrypoint()

@@ -111,6 +111,9 @@ class SignallingServer:
 
     def __init__(self, options: SignallingOptions):
         self.options = options
+        # extra WebSocket endpoints (e.g. the /media transport) registered by
+        # the orchestrator: path-prefix -> async handler(request) -> response
+        self.ws_routes: dict[str, Any] = {}
         self.peers: dict[str, _Peer] = {}
         self.sessions: dict[str, str] = {}
         self.rooms: dict[str, set[str]] = {}
@@ -173,6 +176,10 @@ class SignallingServer:
 
         if request.method == "OPTIONS":
             return web.Response(status=200, headers=cors)
+
+        for prefix, handler in self.ws_routes.items():
+            if path == prefix or path.startswith(prefix.rstrip("/") + "/"):
+                return await handler(request)
 
         if _is_ws_path(path):
             return await self._handle_ws(request)

@@ -11,7 +11,7 @@ from __future__ import annotations
 import struct
 from dataclasses import dataclass, field
 
-__all__ = ["RtpPacket", "H264Payloader", "split_annexb"]
+__all__ = ["RtpPacket", "H264Payloader", "OpusPayloader", "split_annexb"]
 
 RTP_VERSION = 2
 MTU_DEFAULT = 1200
@@ -172,6 +172,33 @@ class H264Payloader:
                 )
             )
         return out
+
+
+@dataclass
+class OpusPayloader:
+    """Opus packets → RTP (RFC 7587: the payload is the raw Opus packet).
+
+    Parity: rtpopuspay (gstwebrtc_app.py:1069-1080); 48 kHz RTP clock,
+    marker set on the first packet of a talkspurt (we mark stream start).
+    """
+
+    payload_type: int = 111
+    ssrc: int = 0x53454C41  # 'SELA'
+    sequence: int = 0
+    _first: bool = True
+
+    def payload_packet(self, opus_packet: bytes, timestamp_48k: int) -> RtpPacket:
+        pkt = RtpPacket(
+            payload_type=self.payload_type,
+            sequence=self.sequence,
+            timestamp=timestamp_48k,
+            ssrc=self.ssrc,
+            payload=opus_packet,
+            marker=self._first,
+        )
+        self._first = False
+        self.sequence = (self.sequence + 1) & 0xFFFF
+        return pkt
 
 
 class H264Depayloader:

@@ -1,0 +1,138 @@
+"""WebSocket media transport.
+
+The reference's byte plane is webrtcbin (ICE+DTLS+SRTP+SCTP).  This
+transport is the framework's always-available fallback and test plane: one
+WebSocket carries both the media stream (binary messages) and the data
+channel (text messages), multiplexed by message type.  The browser client
+plays the video messages with WebCodecs (H.264 Annex-B) and treats text
+messages exactly like RTCDataChannel payloads, so every protocol above
+this layer (input vocabulary, stats, clipboard, cursor, system actions) is
+identical to the WebRTC path.
+
+Binary frame layout (network order):
+    u8  kind      1=video 2=audio
+    u8  flags     bit0 = keyframe (IDR)
+    u16 reserved
+    u32 timestamp video: 90 kHz clock; audio: 48 kHz sample clock
+    ... payload   video: Annex-B access unit; audio: Opus packet
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from typing import Any, Awaitable, Callable
+
+from aiohttp import WSMsgType, web
+
+logger = logging.getLogger("transport.ws")
+
+HEADER = struct.Struct("!BBHI")
+KIND_VIDEO = 1
+KIND_AUDIO = 2
+FLAG_KEYFRAME = 1
+
+
+def pack_media_frame(kind: int, flags: int, timestamp: int, payload: bytes) -> bytes:
+    return HEADER.pack(kind, flags, 0, timestamp & 0xFFFFFFFF) + payload
+
+
+def parse_media_frame(data: bytes) -> tuple[int, int, int, bytes]:
+    kind, flags, _, ts = HEADER.unpack_from(data)
+    return kind, flags, ts, data[HEADER.size :]
+
+
+class WebSocketTransport:
+    """Server side of the WS media plane; implements the app Transport
+    protocol (pipeline/app.py) and registers under /media on the web
+    server."""
+
+    def __init__(self) -> None:
+        self._ws: web.WebSocketResponse | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self.on_data_message: Callable[[str], Awaitable[None] | None] = lambda m: None
+        self.on_connect: Callable[[], Any] = lambda: None
+        self.on_disconnect: Callable[[], Any] = lambda: None
+        self.frames_sent = 0
+        self.bytes_sent = 0
+
+    # -- Transport protocol -------------------------------------------
+
+    @property
+    def data_channel_ready(self) -> bool:
+        return self._ws is not None and not self._ws.closed
+
+    def send_data_channel(self, message: str) -> None:
+        """Callable from the event loop or worker threads (reference
+        bridges with run_coroutine_threadsafe, gstwebrtc_app.py:1792)."""
+        ws, loop = self._ws, self._loop
+        if ws is None or ws.closed or loop is None:
+            return
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        coro = self._safe_send_str(ws, message)
+        if running is loop:
+            loop.create_task(coro)
+        else:
+            asyncio.run_coroutine_threadsafe(coro, loop)
+
+    @staticmethod
+    async def _safe_send_str(ws: web.WebSocketResponse, message: str) -> None:
+        try:
+            await ws.send_str(message)
+        except (ConnectionError, RuntimeError):
+            pass
+
+    async def send_video(self, ef) -> None:
+        """EncodedFrame (pipeline/elements.py) → binary WS message."""
+        flags = FLAG_KEYFRAME if ef.idr else 0
+        await self._send_binary(pack_media_frame(KIND_VIDEO, flags, ef.timestamp_90k, ef.au))
+
+    async def send_audio(self, ea) -> None:
+        """EncodedAudio (audio/pipeline.py) → binary WS message."""
+        await self._send_binary(pack_media_frame(KIND_AUDIO, 0, ea.timestamp_48k, ea.packet))
+
+    async def _send_binary(self, data: bytes) -> None:
+        ws = self._ws
+        if ws is None or ws.closed:
+            return
+        try:
+            await ws.send_bytes(data)
+            self.frames_sent += 1
+            self.bytes_sent += len(data)
+        except (ConnectionError, RuntimeError):
+            logger.info("media send failed; client gone")
+
+    # -- aiohttp endpoint ---------------------------------------------
+
+    async def handle_connection(self, request: web.Request) -> web.WebSocketResponse:
+        """Register under the web server's ws_routes as the /media path."""
+        ws = web.WebSocketResponse(heartbeat=20.0, max_msg_size=32 * 1024 * 1024)
+        await ws.prepare(request)
+        if self._ws is not None and not self._ws.closed:
+            logger.info("replacing existing media client")
+            await self._ws.close()
+        self._ws = ws
+        self._loop = asyncio.get_running_loop()
+        logger.info("media client connected from %s", request.remote)
+        try:
+            result = self.on_connect()
+            if asyncio.iscoroutine(result):
+                await result
+            async for msg in ws:
+                if msg.type == WSMsgType.TEXT:
+                    result = self.on_data_message(msg.data)
+                    if asyncio.iscoroutine(result):
+                        await result
+                # binary upstream messages are not part of the protocol
+        finally:
+            if self._ws is ws:
+                self._ws = None
+                result = self.on_disconnect()
+                if asyncio.iscoroutine(result):
+                    await result
+            logger.info("media client disconnected")
+        return ws

@@ -1,0 +1,125 @@
+/* App shell: wires media + input + the server→client message vocabulary.
+ *
+ * Counterpart of the reference app.js (addons/gst-web/src/app.js): handles
+ * pipeline/system/cursor/clipboard/ping/stats messages, uploads client
+ * metrics (_f fps, _l latency) every 5 s, answers ping with pong, fetches
+ * ./turn before connecting, persists settings in localStorage.
+ */
+"use strict";
+
+(function () {
+  const canvas = document.getElementById("screen");
+  const hud = document.getElementById("hud");
+  const statusEl = document.getElementById("status");
+
+  const appName = new URLSearchParams(location.search).get("app") || "selkies-tpu";
+  const store = {
+    get: (k, d) => localStorage.getItem(appName + ":" + k) ?? d,
+    set: (k, v) => localStorage.setItem(appName + ":" + k, v),
+  };
+
+  let serverLatency = 0;
+  let cursorStyleEl = null;
+
+  const media = new SelkiesMedia(canvas, onChannelMessage, onMediaEvent);
+  const input = new SelkiesInput(canvas, (msg) => media.send(msg));
+
+  function onMediaEvent(ev) {
+    statusEl.textContent = ev.event === "open" ? "connected" : "reconnecting…";
+    if (ev.event === "open") {
+      input.attach();
+      // initial client prefs (reference: _arg_fps/_arg_resize on connect)
+      const fps = store.get("framerate", null);
+      if (fps) media.send(`_arg_fps,${fps}`);
+    }
+  }
+
+  function onChannelMessage(obj) {
+    const d = obj.data;
+    switch (obj.type) {
+      case "ping":
+        media.send(`pong,${d.start_time}`);
+        break;
+      case "latency_measurement":
+        serverLatency = d.latency_ms;
+        break;
+      case "system":
+        onSystemAction(d.action);
+        break;
+      case "cursor":
+        onCursor(d);
+        break;
+      case "clipboard":
+        navigator.clipboard?.writeText(atob(d.content)).catch(() => {});
+        break;
+      case "system_stats":
+      case "gpu_stats":
+        updateHud(obj.type, d);
+        break;
+      case "pipeline":
+        statusEl.textContent = d.status || "";
+        break;
+      default:
+        console.debug("unhandled message", obj);
+    }
+  }
+
+  function onSystemAction(action) {
+    const [verb, value] = action.split(",");
+    switch (verb) {
+      case "reload": location.reload(); break;
+      case "framerate": store.set("framerate", value); break;
+      case "video_bitrate": store.set("videoBitRate", value); break;
+      case "audio_bitrate": store.set("audioBitRate", value); break;
+      case "encoder": store.set("encoder", value); break;
+      case "resize": store.set("resize", value); break;
+      case "resolution": {
+        const [w, h] = value.split("x").map(Number);
+        input.remoteWidth = w; input.remoteHeight = h;
+        break;
+      }
+    }
+  }
+
+  function onCursor(d) {
+    if (!cursorStyleEl) {
+      cursorStyleEl = document.createElement("style");
+      document.head.appendChild(cursorStyleEl);
+    }
+    if (d.override === "none" || !d.curdata) {
+      canvas.style.cursor = "none";
+      return;
+    }
+    const hot = d.hotspot || { x: 0, y: 0 };
+    canvas.style.cursor =
+      `url(data:image/png;base64,${d.curdata}) ${hot.x} ${hot.y}, auto`;
+  }
+
+  const hudState = {};
+  function updateHud(kind, d) {
+    hudState[kind] = d;
+    const s = hudState.system_stats, g = hudState.gpu_stats;
+    hud.textContent =
+      `fps ${fps.toFixed(0)}  latency ${serverLatency.toFixed(1)}ms\n` +
+      (s ? `cpu ${s.cpu_percent}%  mem ${(s.mem_used / 1e9).toFixed(1)}/${(s.mem_total / 1e9).toFixed(1)}G\n` : "") +
+      (g ? `tpu ${(g.load * 100).toFixed(0)}%  hbm ${(g.memory_used / 1e3).toFixed(1)}/${(g.memory_total / 1e3).toFixed(1)}G` : "");
+  }
+
+  // client-side fps measurement + 5 s metric uploads (reference app.js:604)
+  let fps = 0, lastFrames = 0;
+  setInterval(() => {
+    fps = (media.framesDecoded - lastFrames);
+    lastFrames = media.framesDecoded;
+  }, 1000);
+  setInterval(() => {
+    if (!media.connected) return;
+    media.send(`_f,${Math.round(fps)}`);
+    media.send(`_l,${Math.round(serverLatency)}`);
+  }, 5000);
+
+  const proto = location.protocol === "https:" ? "wss:" : "ws:";
+  fetch("./turn").catch(() => null).finally(() => {
+    media.connect(`${proto}//${location.host}/media`);
+  });
+  canvas.focus();
+})();

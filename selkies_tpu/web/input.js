@@ -1,0 +1,132 @@
+/* Input capture: DOM events → the selkies data-channel CSV protocol.
+ *
+ * Counterpart of the reference client's input.js (addons/gst-web/src/
+ * input.js): kd/ku keysyms, m/m2 mouse with 5-bit button mask + scroll
+ * magnitude, kr reset on focus changes, js gamepad messages from a 16 ms
+ * poll loop, r/s resize + scaling reports.
+ */
+"use strict";
+
+class SelkiesInput {
+  constructor(canvas, send) {
+    this.canvas = canvas;
+    this.send = send;          // (msg: string) => void
+    this.buttonMask = 0;
+    this.remoteWidth = 1280;
+    this.remoteHeight = 720;
+    this.pointerLock = false;
+    this._gamepadTimer = null;
+    this._attached = [];
+  }
+
+  attach() {
+    const c = this.canvas;
+    const on = (target, type, fn) => {
+      target.addEventListener(type, fn);
+      this._attached.push([target, type, fn]);
+    };
+    on(window, "keydown", (ev) => this._key(ev, true));
+    on(window, "keyup", (ev) => this._key(ev, false));
+    on(window, "blur", () => this.send("kr"));
+    on(c, "mousemove", (ev) => this._mouse(ev));
+    on(c, "mousedown", (ev) => this._button(ev, true));
+    on(c, "mouseup", (ev) => this._button(ev, false));
+    on(c, "wheel", (ev) => this._wheel(ev));
+    on(c, "contextmenu", (ev) => ev.preventDefault());
+    on(window, "gamepadconnected", (ev) => this._gamepadConnected(ev));
+    on(window, "gamepaddisconnected", (ev) => this._gamepadDisconnected(ev));
+    on(window, "resize", () => this._reportResize());
+    this._reportResize();
+  }
+
+  detach() {
+    for (const [target, type, fn] of this._attached) target.removeEventListener(type, fn);
+    this._attached = [];
+    if (this._gamepadTimer) clearInterval(this._gamepadTimer);
+  }
+
+  _key(ev, down) {
+    const keysym = keysymFromEvent(ev);
+    if (keysym === null) return;
+    ev.preventDefault();
+    this.send((down ? "kd," : "ku,") + keysym);
+  }
+
+  _coords(ev) {
+    const r = this.canvas.getBoundingClientRect();
+    const x = Math.round((ev.clientX - r.left) * (this.remoteWidth / r.width));
+    const y = Math.round((ev.clientY - r.top) * (this.remoteHeight / r.height));
+    return [Math.max(0, Math.min(this.remoteWidth, x)), Math.max(0, Math.min(this.remoteHeight, y))];
+  }
+
+  _sendMouse(ev, magnitude = 0) {
+    if (this.pointerLock && document.pointerLockElement) {
+      this.send(`m2,${ev.movementX},${ev.movementY},${this.buttonMask},${magnitude}`);
+    } else {
+      const [x, y] = this._coords(ev);
+      this.send(`m,${x},${y},${this.buttonMask},${magnitude}`);
+    }
+  }
+
+  _mouse(ev) { this._sendMouse(ev); }
+
+  _button(ev, down) {
+    ev.preventDefault();
+    const bit = 1 << ev.button;      // DOM button order matches mask LSB=left
+    if (down) this.buttonMask |= bit; else this.buttonMask &= ~bit;
+    this._sendMouse(ev);
+  }
+
+  _wheel(ev) {
+    ev.preventDefault();
+    // trackpad deltas are small/continuous; wheels jump — derive magnitude
+    const magnitude = Math.min(15, Math.max(1, Math.round(Math.abs(ev.deltaY) / 40)));
+    const bit = ev.deltaY < 0 ? 8 : 16;  // mask bits 3/4 = wheel up/down
+    this.buttonMask |= bit;
+    this._sendMouse(ev, magnitude);
+    this.buttonMask &= ~bit;
+    this._sendMouse(ev, 0);
+  }
+
+  _reportResize() {
+    const w = Math.round(window.innerWidth * window.devicePixelRatio);
+    const h = Math.round(window.innerHeight * window.devicePixelRatio);
+    this.send(`r,${w}x${h}`);
+    this.send(`s,${window.devicePixelRatio}`);
+  }
+
+  // -- gamepads (16 ms poll like the reference's gamepad.js) ------------
+
+  _gamepadConnected(ev) {
+    const gp = ev.gamepad;
+    const name64 = btoa(unescape(encodeURIComponent(gp.id)));
+    this.send(`js,c,${gp.index},${name64},${gp.axes.length},${gp.buttons.length}`);
+    if (!this._gamepadTimer) {
+      this._state = {};
+      this._gamepadTimer = setInterval(() => this._pollGamepads(), 16);
+    }
+  }
+
+  _gamepadDisconnected(ev) {
+    this.send(`js,d,${ev.gamepad.index}`);
+  }
+
+  _pollGamepads() {
+    for (const gp of navigator.getGamepads()) {
+      if (!gp) continue;
+      const st = this._state[gp.index] || (this._state[gp.index] = { b: [], a: [] });
+      gp.buttons.forEach((btn, i) => {
+        if (st.b[i] !== btn.value) {
+          st.b[i] = btn.value;
+          this.send(`js,b,${gp.index},${i},${btn.value}`);
+        }
+      });
+      gp.axes.forEach((v, i) => {
+        if (st.a[i] !== v) {
+          st.a[i] = v;
+          this.send(`js,a,${gp.index},${i},${v.toFixed(4)}`);
+        }
+      });
+    }
+  }
+}

@@ -1,0 +1,39 @@
+/* KeyboardEvent → X11 keysym translation.
+ *
+ * Compact replacement for the vendored guacamole-keyboard table in the
+ * reference client (addons/gst-web/src/lib/guacamole-keyboard-selkies.js):
+ * printable characters map through their Unicode codepoint (Latin-1 keysyms
+ * equal the codepoint; others use the 0x01000000+cp convention) and
+ * non-printable keys use the explicit KeyboardEvent.key table below.
+ */
+"use strict";
+
+const KEYSYMS_BY_KEY = {
+  "Backspace": 0xff08, "Tab": 0xff09, "Enter": 0xff0d, "Escape": 0xff1b,
+  "Delete": 0xffff, "Home": 0xff50, "End": 0xff57, "PageUp": 0xff55,
+  "PageDown": 0xff56, "ArrowLeft": 0xff51, "ArrowUp": 0xff52,
+  "ArrowRight": 0xff53, "ArrowDown": 0xff54, "Insert": 0xff63,
+  "Pause": 0xff13, "ScrollLock": 0xff14, "PrintScreen": 0xff61,
+  "CapsLock": 0xffe5, "NumLock": 0xff7f, "ContextMenu": 0xff67,
+  "Shift": 0xffe1, "Control": 0xffe3, "Alt": 0xffe9, "AltGraph": 0xfe03,
+  "Meta": 0xffe7, "OS": 0xffe7,
+  "F1": 0xffbe, "F2": 0xffbf, "F3": 0xffc0, "F4": 0xffc1, "F5": 0xffc2,
+  "F6": 0xffc3, "F7": 0xffc4, "F8": 0xffc5, "F9": 0xffc6, "F10": 0xffc7,
+  "F11": 0xffc8, "F12": 0xffc9,
+};
+
+const KEYSYMS_RIGHT = { "Shift": 0xffe2, "Control": 0xffe4, "Alt": 0xffea, "Meta": 0xffe8 };
+
+function keysymFromEvent(ev) {
+  const key = ev.key;
+  if (key === undefined) return null;
+  if (key.length === 1) {
+    const cp = key.codePointAt(0);
+    if (cp >= 0x20 && cp <= 0xff) return cp;          // Latin-1 direct
+    if (cp >= 0x100) return 0x01000000 + cp;          // Unicode keysym
+    return cp;
+  }
+  if (ev.location === 2 && KEYSYMS_RIGHT[key] !== undefined) return KEYSYMS_RIGHT[key];
+  const sym = KEYSYMS_BY_KEY[key];
+  return sym === undefined ? null : sym;
+}

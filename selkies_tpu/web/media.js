@@ -1,0 +1,133 @@
+/* Media plane: /media WebSocket → WebCodecs decode → canvas + audio.
+ *
+ * Replaces the reference client's RTCPeerConnection video path
+ * (addons/gst-web/src/webrtc.js) for the WS transport: binary messages are
+ * framed as [u8 kind][u8 flags][u16 rsvd][u32 ts] + payload (see
+ * selkies_tpu/transport/websocket.py).  Video is H.264 Annex-B decoded by
+ * VideoDecoder; audio is Opus decoded by AudioDecoder into WebAudio.
+ * Text messages carry the server→client data-channel JSON vocabulary.
+ */
+"use strict";
+
+const KIND_VIDEO = 1, KIND_AUDIO = 2, FLAG_KEYFRAME = 1;
+
+class SelkiesMedia {
+  constructor(canvas, onMessage, onStats) {
+    this.canvas = canvas;
+    this.ctx = canvas.getContext("2d");
+    this.onMessage = onMessage;   // (obj) => void  — data channel JSON
+    this.onStats = onStats || (() => {});
+    this.ws = null;
+    this.videoDecoder = null;
+    this.audioCtx = null;
+    this.audioDecoder = null;
+    this.framesDecoded = 0;
+    this.bytesReceived = 0;
+    this.lastFrameAt = 0;
+    this.connected = false;
+  }
+
+  connect(url) {
+    this.ws = new WebSocket(url);
+    this.ws.binaryType = "arraybuffer";
+    this.ws.onopen = () => { this.connected = true; this.onStats({ event: "open" }); };
+    this.ws.onclose = () => {
+      this.connected = false;
+      this.onStats({ event: "close" });
+      setTimeout(() => this.connect(url), 3000);   // reference: reconnect in 3 s
+    };
+    this.ws.onmessage = (ev) => {
+      if (typeof ev.data === "string") {
+        try { this.onMessage(JSON.parse(ev.data)); } catch (e) { console.warn(e); }
+      } else {
+        this._media(ev.data);
+      }
+    };
+  }
+
+  send(msg) {
+    if (this.ws && this.ws.readyState === WebSocket.OPEN) this.ws.send(msg);
+  }
+
+  _media(buf) {
+    const dv = new DataView(buf);
+    const kind = dv.getUint8(0), flags = dv.getUint8(1), ts = dv.getUint32(4);
+    const payload = new Uint8Array(buf, 8);
+    this.bytesReceived += buf.byteLength;
+    if (kind === KIND_VIDEO) this._video(payload, ts, (flags & FLAG_KEYFRAME) !== 0);
+    else if (kind === KIND_AUDIO) this._audio(payload, ts);
+  }
+
+  _ensureVideoDecoder() {
+    if (this.videoDecoder && this.videoDecoder.state !== "closed") return true;
+    if (typeof VideoDecoder === "undefined") return false;
+    this.videoDecoder = new VideoDecoder({
+      output: (frame) => this._paint(frame),
+      error: (e) => { console.error("video decode", e); this.videoDecoder = null; },
+    });
+    // Annex-B stream: no description; keyframes carry SPS/PPS in-band
+    this.videoDecoder.configure({ codec: "avc1.42E01F", optimizeForLatency: true });
+    return true;
+  }
+
+  _video(payload, ts, key) {
+    if (!this._ensureVideoDecoder()) return;
+    if (this.videoDecoder.state !== "configured") return;
+    if (this.framesDecoded === 0 && !key) return;  // wait for an IDR
+    this.videoDecoder.decode(new EncodedVideoChunk({
+      type: key ? "key" : "delta",
+      timestamp: Math.round(ts * 1000 / 90),        // 90 kHz → µs
+      data: payload,
+    }));
+  }
+
+  _paint(frame) {
+    if (this.canvas.width !== frame.displayWidth || this.canvas.height !== frame.displayHeight) {
+      this.canvas.width = frame.displayWidth;
+      this.canvas.height = frame.displayHeight;
+    }
+    this.ctx.drawImage(frame, 0, 0);
+    frame.close();
+    this.framesDecoded++;
+    this.lastFrameAt = performance.now();
+  }
+
+  _ensureAudio() {
+    if (this.audioDecoder && this.audioDecoder.state !== "closed") return true;
+    if (typeof AudioDecoder === "undefined") return false;
+    this.audioCtx = this.audioCtx || new AudioContext({ sampleRate: 48000 });
+    this._audioTime = 0;
+    this.audioDecoder = new AudioDecoder({
+      output: (data) => this._play(data),
+      error: (e) => { console.error("audio decode", e); this.audioDecoder = null; },
+    });
+    this.audioDecoder.configure({ codec: "opus", sampleRate: 48000, numberOfChannels: 2 });
+    return true;
+  }
+
+  _audio(payload, ts) {
+    if (!this._ensureAudio()) return;
+    this.audioDecoder.decode(new EncodedAudioChunk({
+      type: "key",
+      timestamp: Math.round(ts * 1000000 / 48000),
+      data: payload,
+    }));
+  }
+
+  _play(data) {
+    const buf = this.audioCtx.createBuffer(data.numberOfChannels, data.numberOfFrames, data.sampleRate);
+    for (let ch = 0; ch < data.numberOfChannels; ch++) {
+      const arr = new Float32Array(data.numberOfFrames);
+      data.copyTo(arr, { planeIndex: ch, format: "f32-planar" });
+      buf.copyToChannel(arr, ch);
+    }
+    data.close();
+    const src = this.audioCtx.createBufferSource();
+    src.buffer = buf;
+    src.connect(this.audioCtx.destination);
+    const now = this.audioCtx.currentTime;
+    if (this._audioTime < now) this._audioTime = now + 0.01;  // 10 ms playout floor
+    src.start(this._audioTime);
+    this._audioTime += buf.duration;
+  }
+}

@@ -1,0 +1,88 @@
+"""Audio plane tests: Opus round-trip via libopus, pipeline ticking,
+RTP opus payloading."""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+
+import numpy as np
+import pytest
+
+from selkies_tpu.audio import (
+    FRAME_SAMPLES,
+    CHANNELS,
+    AudioPipeline,
+    OpusDecoder,
+    OpusEncoder,
+    SyntheticAudioSource,
+    opus_available,
+)
+from selkies_tpu.transport.rtp import OpusPayloader, RtpPacket
+
+pytestmark = pytest.mark.skipif(not opus_available(), reason="libopus not present")
+
+
+def test_opus_roundtrip_sine():
+    enc = OpusEncoder(bitrate_bps=128000)
+    dec = OpusDecoder()
+    src = SyntheticAudioSource(freq=440, amplitude=0.5)
+    # prime the codec past its lookahead, then check energy survives
+    for _ in range(4):
+        pcm = asyncio.run(src.read_frame())
+        packet = enc.encode(pcm)
+        assert 0 < len(packet) < 1000
+        out = dec.decode(packet)
+    inp = np.frombuffer(pcm, np.int16).astype(np.float64)
+    outp = np.frombuffer(out, np.int16).astype(np.float64)
+    assert len(outp) == FRAME_SAMPLES * CHANNELS
+    in_rms = np.sqrt(np.mean(inp**2))
+    out_rms = np.sqrt(np.mean(outp**2))
+    assert out_rms > 0.5 * in_rms, f"decoded energy collapsed: {out_rms} vs {in_rms}"
+
+
+def test_opus_bitrate_retune_changes_size():
+    src = SyntheticAudioSource(freq=1000, amplitude=0.9)
+    frames = [asyncio.run(src.read_frame()) for _ in range(20)]
+
+    def avg_size(bps):
+        enc = OpusEncoder(bitrate_bps=bps)
+        sizes = [len(enc.encode(f)) for f in frames]
+        return sum(sizes[5:]) / len(sizes[5:])
+
+    assert avg_size(256000) > avg_size(32000) * 1.5
+
+
+def test_opus_rejects_wrong_frame_size():
+    enc = OpusEncoder()
+    with pytest.raises(ValueError):
+        enc.encode(b"\x00" * 100)
+
+
+def test_audio_pipeline_produces_packets():
+    async def scenario():
+        got = []
+
+        async def sink(ea):
+            got.append(ea)
+
+        p = AudioPipeline(source=SyntheticAudioSource(), sink=sink)
+        await p.start()
+        await asyncio.sleep(0.5)
+        await p.stop()
+        assert len(got) >= 10  # ~50 frames at 10ms, tolerate CI jitter
+        # timestamps advance by 480 samples per frame
+        deltas = {got[i + 1].timestamp_48k - got[i].timestamp_48k for i in range(len(got) - 1)}
+        assert all(d % 480 == 0 and d > 0 for d in deltas)
+
+    asyncio.run(scenario())
+
+
+def test_opus_payloader():
+    p = OpusPayloader()
+    pkt1 = p.payload_packet(b"\x01\x02", 0)
+    pkt2 = p.payload_packet(b"\x03", 480)
+    assert pkt1.marker and not pkt2.marker
+    assert pkt2.sequence == pkt1.sequence + 1
+    parsed = RtpPacket.parse(pkt1.serialize())
+    assert parsed.payload == b"\x01\x02" and parsed.payload_type == 111

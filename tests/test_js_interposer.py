@@ -20,6 +20,10 @@ from selkies_tpu.input_host.gamepad import GamepadServer
 NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
 SO_PATH = os.path.join(NATIVE_DIR, "selkies_joystick_interposer.so")
 
+if not os.path.exists(SO_PATH):  # build artifacts are not committed
+    subprocess.run(["make", "-C", NATIVE_DIR, "-s", "selkies_joystick_interposer.so"],
+                   check=False, capture_output=True, timeout=120)
+
 CLIENT_SCRIPT = r"""
 import fcntl, os, struct, sys
 

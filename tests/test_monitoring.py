@@ -1,0 +1,122 @@
+"""Monitoring tests: stats flattening, CSV dynamic schema, monitors."""
+
+from __future__ import annotations
+
+import asyncio
+import csv
+import json
+import time
+
+import pytest
+
+from selkies_tpu.monitoring import Metrics, SystemMonitor, TPUMonitor
+from selkies_tpu.monitoring.metrics import _CsvLog
+
+
+def _stats(n_extra: int = 0, **over):
+    report = {
+        "type": "inbound-rtp",
+        "id": "RTCInbound1",
+        "kind": "video",
+        "bytesReceived": 1000,
+        "packetsReceived": 10,
+        "packetsLost": 0,
+        "jitter": 0.001,
+        "framesDecoded": 60,
+        "framesPerSecond": 60,
+        "frameWidth": 1920,
+        "frameHeight": 1080,
+        "firCount": 0,
+        "pliCount": 0,
+        "nackCount": 0,
+    }
+    report.update(over)
+    for i in range(n_extra):
+        report[f"extra{i}"] = i
+    return [report]
+
+
+def test_sanitize_flattens_and_dedups():
+    reports = [
+        {"type": "transport", "id": "T1", "bytesSent": 5},
+        {"type": "transport", "id": "T2", "bytesSent": 7},
+    ]
+    flat = Metrics.sanitize_json_stats(reports)
+    assert flat["transport.bytesSent"] == "5"
+    assert flat["transport-T2.bytesSent"] == "7"
+
+
+def test_csv_dynamic_schema(tmp_path):
+    path = str(tmp_path / "stats.csv")
+    log = _CsvLog(path)
+    flat1 = Metrics.sanitize_json_stats(_stats())
+    log.append(flat1)
+    # schema grows: new fields appear mid-session
+    flat2 = Metrics.sanitize_json_stats(_stats(n_extra=2))
+    log.append(flat2)
+    with open(path) as f:
+        rows = list(csv.reader(f))
+    header, r1, r2 = rows
+    assert "inbound-rtp.extra0" in header
+    assert len(r1) == len(header) == len(r2)
+    # old row backfilled with NaN for the new columns
+    assert r1[header.index("inbound-rtp.extra0")] == "NaN"
+    assert r2[header.index("inbound-rtp.extra0")] == "0"
+
+
+def test_csv_discards_truncated(tmp_path):
+    log = _CsvLog(str(tmp_path / "s.csv"))
+    log.append(Metrics.sanitize_json_stats([{"type": "x", "id": "1"}]))
+    assert log.rows == []
+
+
+def test_set_webrtc_stats_roundtrip(tmp_path):
+    m = Metrics(using_webrtc_csv=True)
+    m.initialize_webrtc_csv_file(str(tmp_path))
+    asyncio.run(m.set_webrtc_stats("_stats_video", json.dumps(_stats())))
+    with open(m.stats_video_file_path) as f:
+        rows = list(csv.reader(f))
+    assert len(rows) == 2 and rows[0][0] == "timestamp"
+
+
+def test_system_monitor_ticks():
+    async def scenario():
+        mon = SystemMonitor(period=0.05)
+        ticks = []
+        mon.on_timer = ticks.append
+        task = asyncio.ensure_future(mon.start())
+        await asyncio.sleep(0.4)
+        mon.stop()
+        await task
+        assert len(ticks) >= 2
+        assert mon.mem_total > 0 and mon.cpu_percent >= 0
+
+    asyncio.run(scenario())
+
+
+def test_tpu_monitor_duty_cycle_math():
+    mon = TPUMonitor(period=0.1)
+    mon._window_start = time.monotonic() - 0.1  # pretend 100ms window
+    for _ in range(6):
+        mon.observe_encode(8.0)  # 48ms busy in a ~100ms window
+    load = mon._load()
+    assert 0.3 < load <= 1.0
+    # window resets: immediate second call sees ~no busy time
+    assert mon._load() <= 0.1
+
+
+def test_tpu_monitor_emits_stats():
+    async def scenario():
+        mon = TPUMonitor(period=0.05)
+        stats = []
+        mon.on_stats = lambda load, total, used: stats.append((load, total, used))
+        task = asyncio.ensure_future(mon.start())
+        for _ in range(40):
+            if stats:
+                break
+            await asyncio.sleep(0.25)
+        mon.stop()
+        await task
+        assert stats, "no stats emitted"
+
+    asyncio.run(scenario())
