@@ -19,10 +19,11 @@ from selkies_tpu.models.h264.bitstream import (
     NAL_SLICE_IDR,
     NAL_SLICE_NON_IDR,
     SLICE_I,
+    SLICE_P,
     StreamParams,
     write_slice_header,
 )
-from selkies_tpu.models.h264.numpy_ref import FrameCoeffs
+from selkies_tpu.models.h264.numpy_ref import FrameCoeffs, PFrameCoeffs, mv_pred_16x16
 from selkies_tpu.models.h264.tables import (
     CHROMA_BLOCK_ORDER,
     LUMA_BLOCK_ORDER,
@@ -33,7 +34,15 @@ from selkies_tpu.models.h264.tables import (
 )
 from selkies_tpu.utils.bits import BitWriter, annexb_nal
 
-__all__ = ["pack_slice", "encode_stream", "residual_block", "nc_context"]
+__all__ = ["pack_slice", "pack_slice_p", "encode_stream", "residual_block", "nc_context"]
+
+# Table 9-4 column for Inter MB prediction: coded_block_pattern -> codeNum
+# for the me(v) mapping (index = cbp value 0..47).
+INTER_CBP_TO_CODENUM = [
+    0, 2, 3, 7, 4, 8, 17, 13, 5, 18, 9, 14, 10, 15, 16, 11,
+    1, 32, 33, 36, 34, 37, 44, 40, 35, 45, 38, 41, 39, 42, 43, 19,
+    6, 24, 25, 20, 26, 21, 46, 28, 27, 47, 22, 29, 23, 30, 31, 12,
+]
 
 
 def residual_block(w: BitWriter, coeffs: np.ndarray, max_coeff: int, nc: int) -> int:
@@ -202,6 +211,83 @@ def pack_slice(
     w.rbsp_trailing_bits()
     nal_type = NAL_SLICE_IDR if idr else NAL_SLICE_NON_IDR
     return annexb_nal(3, nal_type, w.get_bytes())
+
+
+def pack_slice_p(
+    fc: PFrameCoeffs,
+    p: StreamParams,
+    frame_num: int,
+) -> bytes:
+    """Entropy-code one P frame (P_Skip / P_L0_16x16 MBs) into a slice NAL.
+
+    Syntax per 7.3.4 (slice data) + 7.3.5 (macroblock layer): mb_skip_run
+    before every coded MB, mb_type 0 (P_L0_16x16), no ref_idx (single
+    reference), mvd relative to the 8.4.1.3 predictor in quarter-pel units,
+    me(v)-mapped CBP, and 16-coefficient luma residual blocks (inter MBs
+    have no luma DC Hadamard).
+    """
+    mbh, mbw = fc.skip.shape
+    w = BitWriter()
+    write_slice_header(w, p, SLICE_P, frame_num, idr=False, slice_qp=fc.qp)
+
+    luma_tc = np.zeros((mbh * 4, mbw * 4), np.int32)
+    chroma_tc = np.zeros((2, mbh * 2, mbw * 2), np.int32)
+    luma_scan = fc.luma_ac.reshape(mbh, mbw, 4, 4, 16)[..., ZIGZAG_FLAT]
+    chroma_scan = fc.chroma_ac.reshape(mbh, mbw, 2, 2, 2, 16)[..., ZIGZAG_FLAT]
+
+    skip_run = 0
+    for mby in range(mbh):
+        for mbx in range(mbw):
+            if fc.skip[mby, mbx]:
+                skip_run += 1
+                continue  # TotalCoeff grids stay 0 for nC context
+            w.write_ue(skip_run)
+            skip_run = 0
+            w.write_ue(0)  # mb_type P_L0_16x16
+            px, py = mv_pred_16x16(fc.mvs, mbx, mby)
+            w.write_se(4 * (int(fc.mvs[mby, mbx, 0]) - px))  # mvd quarter-pel
+            w.write_se(4 * (int(fc.mvs[mby, mbx, 1]) - py))
+
+            cbp_luma = 0
+            for b8 in range(4):
+                y8, x8 = b8 >> 1, b8 & 1
+                if np.any(luma_scan[mby, mbx, y8 * 2 : y8 * 2 + 2, x8 * 2 : x8 * 2 + 2]):
+                    cbp_luma |= 1 << b8
+            if np.any(chroma_scan[mby, mbx, :, :, :, 1:]):
+                cbp_chroma = 2
+            elif np.any(fc.chroma_dc[mby, mbx]):
+                cbp_chroma = 1
+            else:
+                cbp_chroma = 0
+            cbp = cbp_luma | (cbp_chroma << 4)
+            w.write_ue(INTER_CBP_TO_CODENUM[cbp])
+            if cbp:
+                w.write_se(0)  # mb_qp_delta (constant QP per slice)
+
+            for x4, y4 in LUMA_BLOCK_ORDER:
+                b8 = (y4 >> 1) * 2 + (x4 >> 1)
+                if not cbp_luma & (1 << b8):
+                    continue
+                bx, by = mbx * 4 + x4, mby * 4 + y4
+                nc = nc_context(luma_tc, bx, by)
+                tc = residual_block(w, luma_scan[mby, mbx, y4, x4], 16, nc)
+                luma_tc[by, bx] = tc
+
+            if cbp_chroma:
+                for comp in range(2):
+                    residual_block(w, fc.chroma_dc[mby, mbx, comp].reshape(4), 4, -1)
+            if cbp_chroma == 2:
+                for comp in range(2):
+                    for x4, y4 in CHROMA_BLOCK_ORDER:
+                        bx, by = mbx * 2 + x4, mby * 2 + y4
+                        nc = nc_context(chroma_tc[comp], bx, by)
+                        tc = residual_block(w, chroma_scan[mby, mbx, comp, y4, x4, 1:], 15, nc)
+                        chroma_tc[comp, by, bx] = tc
+
+    if skip_run:
+        w.write_ue(skip_run)
+    w.rbsp_trailing_bits()
+    return annexb_nal(3, NAL_SLICE_NON_IDR, w.get_bytes())
 
 
 def encode_stream(y, u, v, qp: int, width: int | None = None, height: int | None = None):

@@ -261,6 +261,253 @@ def pad_planes(y: np.ndarray, u: np.ndarray, v: np.ndarray):
     return y, u, v
 
 
+# ---------------------------------------------------------------------------
+# Inter (P-frame) golden model
+# ---------------------------------------------------------------------------
+#
+# Partitioning policy: P_Skip / P_L0_16x16 only, one reference frame,
+# full-pel luma motion vectors (chroma lands on half-pel, bilinear per
+# 8.4.2.2.2). There is no intra prediction in P frames, so — unlike the
+# I-frame row scan — every macroblock is independent given the reference
+# frame: the TPU path (encoder_core.py) batches the whole frame as one
+# tensor op. The reference's encoders get this from NVENC silicon
+# (gstwebrtc_app.py:260-367); for remote-desktop content the dominant case
+# is a P_Skip carpet over unchanged screen regions.
+
+# Max motion-vector magnitude (full-pel); reference planes are edge-padded
+# by this much so unrestricted MVs never index out of bounds.
+MV_PAD = 16
+
+
+@dataclass
+class PFrameCoeffs:
+    """Per-MB data for one P frame (contract with the entropy packers).
+
+    mvs:       (mbh, mbw, 2) int32 full-pel motion vectors, [..., 0]=x, [..., 1]=y
+    skip:      (mbh, mbw) bool — MB coded as P_Skip (requires mv == skip MV
+               and all residual levels zero; enforced by encode_frame_p)
+    luma_ac:   (mbh, mbw, 4, 4, 4, 4) [by][bx][i][j] — all 16 coeffs coded
+               (inter MBs have no luma DC Hadamard)
+    chroma_dc: (mbh, mbw, 2, 2, 2) [comp][i][j]
+    chroma_ac: (mbh, mbw, 2, 2, 2, 4, 4)
+    """
+
+    mvs: np.ndarray
+    skip: np.ndarray
+    luma_ac: np.ndarray
+    chroma_dc: np.ndarray
+    chroma_ac: np.ndarray
+    qp: int
+
+
+@dataclass
+class PFrameEncoding:
+    coeffs: PFrameCoeffs
+    recon_y: np.ndarray
+    recon_u: np.ndarray
+    recon_v: np.ndarray
+
+
+def _median3(a: int, b: int, c: int) -> int:
+    return int(np.median([a, b, c]))
+
+
+def mv_pred_16x16(mvs: np.ndarray, mbx: int, mby: int) -> tuple[int, int]:
+    """8.4.1.3 motion-vector prediction for a 16x16 partition.
+
+    All coded MBs share refIdx 0 (single reference), so the "exactly one
+    neighbour matches refIdx" rule reduces to availability counting.
+    mvs holds the ACTUAL per-MB motion vectors (skip MBs included).
+    """
+    mbh, mbw = mvs.shape[:2]
+    a_avail = mbx > 0
+    b_avail = mby > 0
+    c_avail = mby > 0 and mbx + 1 < mbw
+    d_avail = mby > 0 and mbx > 0
+    # top-right substitution: C unavailable -> D takes its place
+    if not c_avail and d_avail:
+        c_mv, c_avail = mvs[mby - 1, mbx - 1], True
+    elif c_avail:
+        c_mv = mvs[mby - 1, mbx + 1]
+    else:
+        c_mv = np.zeros(2, np.int32)
+    a_mv = mvs[mby, mbx - 1] if a_avail else np.zeros(2, np.int32)
+    b_mv = mvs[mby - 1, mbx] if b_avail else np.zeros(2, np.int32)
+    # 8.4.1.3.1: B, C, D all unavailable and A available -> mvA
+    if a_avail and not b_avail and not c_avail:
+        return int(a_mv[0]), int(a_mv[1])
+    # exactly one available neighbour (refIdx match) -> its mv
+    n_avail = int(a_avail) + int(b_avail) + int(c_avail)
+    if n_avail == 1:
+        only = a_mv if a_avail else (b_mv if b_avail else c_mv)
+        return int(only[0]), int(only[1])
+    return (
+        _median3(int(a_mv[0]), int(b_mv[0]), int(c_mv[0])),
+        _median3(int(a_mv[1]), int(b_mv[1]), int(c_mv[1])),
+    )
+
+
+def skip_mv_16x16(mvs: np.ndarray, mbx: int, mby: int) -> tuple[int, int]:
+    """8.4.1.1 P_Skip motion-vector derivation."""
+    if mbx == 0 or mby == 0:
+        return 0, 0
+    a = mvs[mby, mbx - 1]
+    b = mvs[mby - 1, mbx]
+    if (a[0] == 0 and a[1] == 0) or (b[0] == 0 and b[1] == 0):
+        return 0, 0
+    return mv_pred_16x16(mvs, mbx, mby)
+
+
+def pad_ref(plane: np.ndarray, pad: int = MV_PAD) -> np.ndarray:
+    return np.pad(plane, pad, mode="edge")
+
+
+def mc_luma_16x16(ref_pad: np.ndarray, mbx: int, mby: int, mv) -> np.ndarray:
+    """Full-pel 16x16 luma motion compensation from an MV_PAD-padded ref."""
+    y0 = mby * 16 + int(mv[1]) + MV_PAD
+    x0 = mbx * 16 + int(mv[0]) + MV_PAD
+    return ref_pad[y0 : y0 + 16, x0 : x0 + 16].astype(np.int64)
+
+
+def mc_chroma_8x8(ref_pad: np.ndarray, mbx: int, mby: int, mv) -> np.ndarray:
+    """8x8 chroma MC (8.4.2.2.2). Full-pel luma MVs land chroma on
+    half-pel: frac ∈ {0, 4} eighths per axis -> bilinear with weights 4/4."""
+    mvx, mvy = int(mv[0]), int(mv[1])
+    x0 = mbx * 8 + (mvx >> 1) + MV_PAD
+    y0 = mby * 8 + (mvy >> 1) + MV_PAD
+    xf = 4 * (mvx & 1)
+    yf = 4 * (mvy & 1)
+    p = ref_pad.astype(np.int64)
+    a = p[y0 : y0 + 8, x0 : x0 + 8]
+    b = p[y0 : y0 + 8, x0 + 1 : x0 + 9]
+    c = p[y0 + 1 : y0 + 9, x0 : x0 + 8]
+    d = p[y0 + 1 : y0 + 9, x0 + 1 : x0 + 9]
+    return ((8 - xf) * (8 - yf) * a + xf * (8 - yf) * b + (8 - xf) * yf * c + xf * yf * d + 32) >> 6
+
+
+def encode_mb_inter_luma(orig: np.ndarray, pred: np.ndarray, qp: int):
+    """Inter 16x16 luma: plain 4x4 transform+quant (no DC Hadamard).
+
+    Returns (ac_levels (4,4,4,4) with all 16 coeffs live, recon (16,16))."""
+    resid = orig.astype(np.int64) - pred
+    w = fdct4(split_blocks(resid, 4))
+    ac_levels = quant4(w, qp, intra=False)
+    r = idct4(dequant4(ac_levels, qp))
+    recon = np.clip(merge_blocks(r) + pred, 0, 255).astype(np.uint8)
+    return ac_levels, recon
+
+
+def encode_mb_inter_chroma(orig: np.ndarray, pred: np.ndarray, qp_c: int):
+    """Inter 8x8 chroma: 2x2 DC Hadamard + AC, inter rounding."""
+    resid = orig.astype(np.int64) - pred
+    w = fdct4(split_blocks(resid, 4))
+    dc_levels = quant_chroma_dc(w[..., 0, 0], qp_c, intra=False)
+    ac_levels = quant4(w, qp_c, intra=False)
+    deq = dequant4(ac_levels, qp_c)
+    deq[..., 0, 0] = dequant_chroma_dc(dc_levels, qp_c)
+    r = idct4(deq)
+    recon = np.clip(merge_blocks(r) + pred, 0, 255).astype(np.uint8)
+    return dc_levels, ac_levels, recon
+
+
+def full_search_me(
+    y: np.ndarray, ref_y: np.ndarray, search: int = 8
+) -> np.ndarray:
+    """Exhaustive full-pel SAD search over ±search per MB (golden model).
+
+    Zero MV wins ties (preferred: cheaper to code, skip-eligible)."""
+    h, w = y.shape
+    mbh, mbw = h // 16, w // 16
+    ref_pad = pad_ref(ref_y)
+    cur = y.astype(np.int64)
+    best_sad = np.full((mbh, mbw), np.iinfo(np.int64).max)
+    best_mv = np.zeros((mbh, mbw, 2), np.int32)
+    cand = sorted(
+        ((dx, dy) for dy in range(-search, search + 1) for dx in range(-search, search + 1)),
+        key=lambda c: (c != (0, 0)),
+    )
+    for dx, dy in cand:
+        shifted = ref_pad[
+            MV_PAD + dy : MV_PAD + dy + h, MV_PAD + dx : MV_PAD + dx + w
+        ].astype(np.int64)
+        sad = (
+            np.abs(cur - shifted).reshape(mbh, 16, mbw, 16).sum(axis=(1, 3))
+        )
+        better = sad < best_sad
+        best_sad = np.where(better, sad, best_sad)
+        best_mv[better] = (dx, dy)
+    return best_mv
+
+
+def encode_frame_p(
+    y: np.ndarray,
+    u: np.ndarray,
+    v: np.ndarray,
+    ref_y: np.ndarray,
+    ref_u: np.ndarray,
+    ref_v: np.ndarray,
+    mvs: np.ndarray,
+    qp: int,
+) -> PFrameEncoding:
+    """Encode a P frame given per-MB full-pel motion vectors.
+
+    Planes must be pre-padded to MB multiples; ref_* are the previous
+    frame's reconstruction (decoder state), same shapes.
+    """
+    h, w = y.shape
+    mbh, mbw = h // 16, w // 16
+    if mvs.shape != (mbh, mbw, 2):
+        raise ValueError(f"mvs shape {mvs.shape} != {(mbh, mbw, 2)}")
+    if np.abs(mvs).max(initial=0) > MV_PAD:
+        raise ValueError(f"|mv| exceeds MV_PAD={MV_PAD}")
+    qp_c = chroma_qp(qp)
+    ry, ru, rv = pad_ref(ref_y), pad_ref(ref_u), pad_ref(ref_v)
+    recon_y = np.zeros_like(y)
+    recon_u = np.zeros_like(u)
+    recon_v = np.zeros_like(v)
+    fc = PFrameCoeffs(
+        mvs=mvs.astype(np.int32),
+        skip=np.zeros((mbh, mbw), bool),
+        luma_ac=np.zeros((mbh, mbw, 4, 4, 4, 4), np.int32),
+        chroma_dc=np.zeros((mbh, mbw, 2, 2, 2), np.int32),
+        chroma_ac=np.zeros((mbh, mbw, 2, 2, 2, 4, 4), np.int32),
+        qp=qp,
+    )
+    for mby in range(mbh):
+        for mbx in range(mbw):
+            mv = mvs[mby, mbx]
+            pred_y = mc_luma_16x16(ry, mbx, mby, mv)
+            pred_u = mc_chroma_8x8(ru, mbx, mby, mv)
+            pred_v = mc_chroma_8x8(rv, mbx, mby, mv)
+            ac_y, rec_y = encode_mb_inter_luma(
+                y[mby * 16 : mby * 16 + 16, mbx * 16 : mbx * 16 + 16], pred_y, qp
+            )
+            dc_u, ac_u, rec_u = encode_mb_inter_chroma(
+                u[mby * 8 : mby * 8 + 8, mbx * 8 : mbx * 8 + 8], pred_u, qp_c
+            )
+            dc_v, ac_v, rec_v = encode_mb_inter_chroma(
+                v[mby * 8 : mby * 8 + 8, mbx * 8 : mbx * 8 + 8], pred_v, qp_c
+            )
+            recon_y[mby * 16 : mby * 16 + 16, mbx * 16 : mbx * 16 + 16] = rec_y
+            recon_u[mby * 8 : mby * 8 + 8, mbx * 8 : mbx * 8 + 8] = rec_u
+            recon_v[mby * 8 : mby * 8 + 8, mbx * 8 : mbx * 8 + 8] = rec_v
+            fc.luma_ac[mby, mbx] = ac_y
+            fc.chroma_dc[mby, mbx] = np.stack([dc_u, dc_v])
+            fc.chroma_ac[mby, mbx] = np.stack([ac_u, ac_v])
+    # Skip pass: residual-free MBs whose mv equals the 8.4.1.1 skip MV.
+    # (Depends only on the final mv field, so order doesn't matter.)
+    for mby in range(mbh):
+        for mbx in range(mbw):
+            if (
+                not fc.luma_ac[mby, mbx].any()
+                and not fc.chroma_dc[mby, mbx].any()
+                and not fc.chroma_ac[mby, mbx].any()
+                and tuple(mvs[mby, mbx]) == skip_mv_16x16(mvs, mbx, mby)
+            ):
+                fc.skip[mby, mbx] = True
+    return PFrameEncoding(coeffs=fc, recon_y=recon_y, recon_u=recon_u, recon_v=recon_v)
+
+
 def encode_frame_i16(y: np.ndarray, u: np.ndarray, v: np.ndarray, qp: int) -> FrameEncoding:
     """Encode planes (padded to MB multiples) as an all-Intra16x16 frame.
 
