@@ -195,6 +195,55 @@ inline int NcContext(const int32_t* counts, int stride, int bx, int by) {
   return 0;
 }
 
+// Table 9-4 Inter column: coded_block_pattern -> codeNum for me(v).
+const uint8_t kInterCbpToCodeNum[48] = {
+    0, 2, 3, 7, 4, 8, 17, 13, 5, 18, 9, 14, 10, 15, 16, 11,
+    1, 32, 33, 36, 34, 37, 44, 40, 35, 45, 38, 41, 39, 42, 43, 19,
+    6, 24, 25, 20, 26, 21, 46, 28, 27, 47, 22, 29, 23, 30, 31, 12};
+
+inline int Median3(int a, int b, int c) {
+  int mx = a > b ? (a > c ? a : c) : (b > c ? b : c);
+  int mn = a < b ? (a < c ? a : c) : (b < c ? b : c);
+  return a + b + c - mx - mn;
+}
+
+// 8.4.1.3 motion-vector prediction for a 16x16 partition, single ref.
+// Mirrors numpy_ref.mv_pred_16x16.
+inline void MvPred16x16(const int16_t* mvs, int mbw, int mbx, int mby,
+                        int* px, int* py) {
+  const bool a_av = mbx > 0;
+  const bool b_av = mby > 0;
+  bool c_av = (mby > 0) && (mbx + 1 < mbw);
+  const bool d_av = (mby > 0) && (mbx > 0);
+  int ax = 0, ay = 0, bx = 0, by = 0, cx = 0, cy = 0;
+  if (a_av) {
+    const int16_t* m = mvs + ((int64_t)mby * mbw + mbx - 1) * 2;
+    ax = m[0]; ay = m[1];
+  }
+  if (b_av) {
+    const int16_t* m = mvs + ((int64_t)(mby - 1) * mbw + mbx) * 2;
+    bx = m[0]; by = m[1];
+  }
+  if (c_av) {
+    const int16_t* m = mvs + ((int64_t)(mby - 1) * mbw + mbx + 1) * 2;
+    cx = m[0]; cy = m[1];
+  } else if (d_av) {
+    const int16_t* m = mvs + ((int64_t)(mby - 1) * mbw + mbx - 1) * 2;
+    cx = m[0]; cy = m[1];
+    c_av = true;
+  }
+  if (a_av && !b_av && !c_av) { *px = ax; *py = ay; return; }
+  const int n_av = (int)a_av + (int)b_av + (int)c_av;
+  if (n_av == 1) {
+    if (a_av) { *px = ax; *py = ay; }
+    else if (b_av) { *px = bx; *py = by; }
+    else { *px = cx; *py = cy; }
+    return;
+  }
+  *px = Median3(ax, bx, cx);
+  *py = Median3(ay, by, cy);
+}
+
 }  // namespace
 
 extern "C" {
@@ -296,6 +345,112 @@ int64_t pack_slice_rbsp(
       }
     }
   }
+  w.RbspTrailing();
+  if (w.Overflowed()) return -1;
+  return w.BytePos();
+}
+
+// Pack one P slice (P_Skip / P_L0_16x16 MBs, single reference).
+// Arrays use the PFrameCoeffs layout (see numpy_ref.py), int16 contiguous:
+// mvs (mbh*mbw*2, [x,y] full-pel), skip (mbh*mbw uint8), luma_ac
+// (mbh*mbw*256 — all 16 coeffs live, no luma DC), chroma_dc (mbh*mbw*8),
+// chroma_ac (mbh*mbw*128). Returns RBSP length or -1 on overflow.
+int64_t pack_slice_p_rbsp(
+    const uint8_t* header_bytes, int64_t header_nbits,
+    const int16_t* mvs, const uint8_t* skip,
+    const int16_t* luma_ac, const int16_t* chroma_dc, const int16_t* chroma_ac,
+    int mbh, int mbw,
+    uint8_t* out, int64_t out_cap, int32_t* luma_tc_buf, int32_t* chroma_tc_buf) {
+  BitWriter w(out, out_cap);
+  int64_t full = header_nbits / 8;
+  for (int64_t i = 0; i < full; i++) w.PutBits(header_bytes[i], 8);
+  int rem = (int)(header_nbits % 8);
+  if (rem) w.PutBits((uint32_t)(header_bytes[full] >> (8 - rem)), rem);
+
+  const int lstride = mbw * 4, cstride = mbw * 2;
+  memset(luma_tc_buf, 0, sizeof(int32_t) * (size_t)(mbh * 4) * (size_t)lstride);
+  memset(chroma_tc_buf, 0, sizeof(int32_t) * 2 * (size_t)(mbh * 2) * (size_t)cstride);
+
+  int32_t scan[16];
+  uint32_t skip_run = 0;
+  for (int mby = 0; mby < mbh; mby++) {
+    for (int mbx = 0; mbx < mbw; mbx++) {
+      const int mb = mby * mbw + mbx;
+      if (skip[mb]) { skip_run++; continue; }  // TotalCoeff grids stay 0
+      w.PutUe(skip_run);
+      skip_run = 0;
+      w.PutUe(0);  // mb_type P_L0_16x16
+      int px, py;
+      MvPred16x16(mvs, mbw, mbx, mby, &px, &py);
+      w.PutSe(4 * ((int)mvs[mb * 2] - px));      // mvd, quarter-pel units
+      w.PutSe(4 * ((int)mvs[mb * 2 + 1] - py));
+
+      const int16_t* lac = luma_ac + (int64_t)mb * 256;
+      const int16_t* cdc = chroma_dc + (int64_t)mb * 8;
+      const int16_t* cac = chroma_ac + (int64_t)mb * 128;
+
+      int cbp_luma = 0;
+      for (int b8 = 0; b8 < 4; b8++) {
+        const int y8 = b8 >> 1, x8 = b8 & 1;
+        bool nz = false;
+        for (int sub = 0; sub < 4 && !nz; sub++) {
+          const int by4 = y8 * 2 + (sub >> 1), bx4 = x8 * 2 + (sub & 1);
+          const int16_t* blk = lac + (by4 * 4 + bx4) * 16;
+          for (int i = 0; i < 16; i++) {
+            if (blk[i]) { nz = true; break; }
+          }
+        }
+        if (nz) cbp_luma |= 1 << b8;
+      }
+      int cbp_chroma = 0;
+      for (int b = 0; b < 8 && cbp_chroma < 2; b++) {
+        const int16_t* blk = cac + b * 16;
+        for (int i = 1; i < 16; i++) {
+          if (blk[kZigzag[i]]) { cbp_chroma = 2; break; }
+        }
+      }
+      if (cbp_chroma == 0) {
+        for (int i = 0; i < 8; i++) {
+          if (cdc[i]) { cbp_chroma = 1; break; }
+        }
+      }
+      const int cbp = cbp_luma | (cbp_chroma << 4);
+      w.PutUe(kInterCbpToCodeNum[cbp]);
+      if (cbp) w.PutSe(0);  // mb_qp_delta
+
+      for (int blk = 0; blk < 16; blk++) {
+        const int x4 = kLumaBlockOrder[blk][0], y4 = kLumaBlockOrder[blk][1];
+        const int b8 = (y4 >> 1) * 2 + (x4 >> 1);
+        if (!(cbp_luma & (1 << b8))) continue;
+        const int16_t* src = lac + (y4 * 4 + x4) * 16;
+        for (int i = 0; i < 16; i++) scan[i] = src[kZigzag[i]];
+        const int bx = mbx * 4 + x4, by = mby * 4 + y4;
+        const int nc = NcContext(luma_tc_buf, lstride, bx, by);
+        luma_tc_buf[by * lstride + bx] = ResidualBlock(w, scan, 16, nc);
+      }
+
+      if (cbp_chroma) {
+        for (int comp = 0; comp < 2; comp++) {
+          for (int i = 0; i < 4; i++) scan[i] = cdc[comp * 4 + i];
+          ResidualBlock(w, scan, 4, -1);
+        }
+      }
+      if (cbp_chroma == 2) {
+        for (int comp = 0; comp < 2; comp++) {
+          int32_t* ctc = chroma_tc_buf + (int64_t)comp * (mbh * 2) * cstride;
+          for (int blk = 0; blk < 4; blk++) {
+            const int x4 = kChromaBlockOrder[blk][0], y4 = kChromaBlockOrder[blk][1];
+            const int16_t* src = cac + (comp * 4 + y4 * 2 + x4) * 16;
+            for (int i = 1; i < 16; i++) scan[i - 1] = src[kZigzag[i]];
+            const int bx = mbx * 2 + x4, by = mby * 2 + y4;
+            const int nc = NcContext(ctc, cstride, bx, by);
+            ctc[by * cstride + bx] = ResidualBlock(w, scan, 15, nc);
+          }
+        }
+      }
+    }
+  }
+  if (skip_run) w.PutUe(skip_run);
   w.RbspTrailing();
   if (w.Overflowed()) return -1;
   return w.BytePos();

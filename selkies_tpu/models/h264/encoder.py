@@ -25,16 +25,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from selkies_tpu.models.h264.bitstream import StreamParams, write_pps, write_sps
-from selkies_tpu.models.h264.encoder_core import encode_frame_planes
-from selkies_tpu.models.h264.native import pack_slice_fast
-from selkies_tpu.models.h264.numpy_ref import FrameCoeffs
+from selkies_tpu.models.h264.encoder_core import encode_frame_p_planes, encode_frame_planes
+from selkies_tpu.models.h264.native import pack_slice_fast, pack_slice_p_fast
+from selkies_tpu.models.h264.numpy_ref import FrameCoeffs, PFrameCoeffs
 from selkies_tpu.ops.colorspace import bgrx_to_i420, rgb_to_i420
 
 __all__ = ["TPUH264Encoder", "make_frame_step"]
 
 
-def _device_step(frame, qp, *, pad_h: int, pad_w: int, channels: int):
-    """Full device path: packed frame -> padded planes -> coeff tensors."""
+def _convert_pad(frame, *, pad_h: int, pad_w: int, channels: int):
+    """Packed frame -> padded I420 planes (device)."""
     if channels == 4:
         y, u, v = bgrx_to_i420(frame)
     else:
@@ -44,11 +44,28 @@ def _device_step(frame, qp, *, pad_h: int, pad_w: int, channels: int):
         y = jnp.pad(y, ((0, pad_h - h), (0, pad_w - w)), mode="edge")
         u = jnp.pad(u, ((0, (pad_h - h) // 2), (0, (pad_w - w) // 2)), mode="edge")
         v = jnp.pad(v, ((0, (pad_h - h) // 2), (0, (pad_w - w) // 2)), mode="edge")
-    out = encode_frame_planes(y, u, v, qp)
+    return y, u, v
+
+
+def _narrow(out):
+    """int32 coeff tensors -> int16 (halves the device->host copy)."""
     return {
         k: (out[k].astype(jnp.int16) if out[k].dtype == jnp.int32 else out[k])
         for k in out
     }
+
+
+def _device_step(frame, qp, *, pad_h: int, pad_w: int, channels: int):
+    """Full IDR device path: packed frame -> padded planes -> coeff tensors."""
+    y, u, v = _convert_pad(frame, pad_h=pad_h, pad_w=pad_w, channels=channels)
+    return _narrow(encode_frame_planes(y, u, v, qp))
+
+
+def _device_step_p(frame, qp, ref_y, ref_u, ref_v, *, pad_h: int, pad_w: int, channels: int, search: int):
+    """P-frame device path: convert, motion-search against the previous
+    reconstruction (which never leaves the device), encode inter residuals."""
+    y, u, v = _convert_pad(frame, pad_h=pad_h, pad_w=pad_w, channels=channels)
+    return _narrow(encode_frame_p_planes(y, u, v, ref_y, ref_u, ref_v, qp, search=search))
 
 
 @dataclass
@@ -59,17 +76,35 @@ class FrameStats:
     bytes: int
     device_ms: float
     pack_ms: float
+    skipped_mbs: int = 0
 
 
 class TPUH264Encoder:
-    """Stateful per-stream encoder: frame in, Annex-B access unit out."""
+    """Stateful per-stream encoder: frame in, Annex-B access unit out.
 
-    def __init__(self, width: int, height: int, qp: int = 28, fps: int = 60, channels: int = 4):
+    GOP policy mirrors the reference default (keyframe_distance=-1,
+    __main__.py:473-475): one IDR, then P frames forever; new IDRs only on
+    force_keyframe() (client PLI / stream restart) or an explicit
+    keyframe_interval. The previous frame's reconstruction stays on the
+    TPU between frames — only quantized coefficients cross PCIe.
+    """
+
+    def __init__(
+        self,
+        width: int,
+        height: int,
+        qp: int = 28,
+        fps: int = 60,
+        channels: int = 4,
+        keyframe_interval: int = 0,
+        search: int = 8,
+    ):
         self.width = width
         self.height = height
         self.fps = fps
         self.qp = int(qp)
         self.channels = channels
+        self.keyframe_interval = int(keyframe_interval)  # 0 = infinite GOP
         self.params = StreamParams(width=width, height=height, qp=self.qp, fps=fps)
         self._headers = write_sps(self.params) + write_pps(self.params)
         self._pad_h = (height + 15) // 16 * 16
@@ -79,6 +114,14 @@ class TPUH264Encoder:
                 frame, qp, pad_h=self._pad_h, pad_w=self._pad_w, channels=channels
             )
         )
+        self._step_p = jax.jit(
+            lambda frame, qp, ry, ru, rv: _device_step_p(
+                frame, qp, ry, ru, rv,
+                pad_h=self._pad_h, pad_w=self._pad_w, channels=channels, search=search,
+            ),
+            donate_argnums=(2, 3, 4),
+        )
+        self._ref = None  # (recon_y, recon_u, recon_v) device arrays
         self.frame_index = 0
         self._frames_since_idr = 0
         self._idr_pic_id = 0
@@ -104,30 +147,60 @@ class TPUH264Encoder:
         """
         if qp is not None:
             self.set_qp(qp)
-        idr = self._force_idr or self.frame_index == 0
+        idr = (
+            self._force_idr
+            or self.frame_index == 0
+            or self._ref is None
+            or (self.keyframe_interval > 0 and self._frames_since_idr >= self.keyframe_interval)
+        )
         t0 = time.perf_counter()
-        out = self._step(frame, np.int32(self.qp))
-        fc = FrameCoeffs(
-            luma_mode=np.asarray(out["luma_mode"]),
-            chroma_mode=np.asarray(out["chroma_mode"]),
-            luma_dc=np.asarray(out["luma_dc"]),
-            luma_ac=np.asarray(out["luma_ac"]),
-            chroma_dc=np.asarray(out["chroma_dc"]),
-            chroma_ac=np.asarray(out["chroma_ac"]),
-            qp=self.qp,
-        )
+        skipped = 0
         if idr:
+            out = self._step(frame, np.int32(self.qp))
+            fc = FrameCoeffs(
+                luma_mode=np.asarray(out["luma_mode"]),
+                chroma_mode=np.asarray(out["chroma_mode"]),
+                luma_dc=np.asarray(out["luma_dc"]),
+                luma_ac=np.asarray(out["luma_ac"]),
+                chroma_dc=np.asarray(out["chroma_dc"]),
+                chroma_ac=np.asarray(out["chroma_ac"]),
+                qp=self.qp,
+            )
             self._frames_since_idr = 0
-        t1 = time.perf_counter()
-        # frame_num counts from the last IDR (7.4.3: gaps are disallowed by
-        # our SPS, so it must be PrevRefFrameNum+1 mod MaxFrameNum).
-        slice_nal = pack_slice_fast(
-            fc,
-            self.params,
-            frame_num=self._frames_since_idr % 256,
-            idr=idr,
-            idr_pic_id=self._idr_pic_id,
-        )
+            t1 = time.perf_counter()
+            # frame_num counts from the last IDR (7.4.3: gaps are disallowed
+            # by our SPS, so it must be PrevRefFrameNum+1 mod MaxFrameNum).
+            slice_nal = pack_slice_fast(
+                fc,
+                self.params,
+                frame_num=0,
+                idr=True,
+                idr_pic_id=self._idr_pic_id,
+            )
+        else:
+            out = self._step_p(frame, np.int32(self.qp), *self._ref)
+            # reassign the reference IMMEDIATELY: _step_p donated the old
+            # buffers, so a packing exception below must not leave self._ref
+            # pointing at deleted arrays (every later frame would fail).
+            self._ref = (out["recon_y"], out["recon_u"], out["recon_v"])
+            skip = np.asarray(out["skip"])
+            skipped = int(skip.sum())
+            pfc = PFrameCoeffs(
+                mvs=np.asarray(out["mvs"]),
+                skip=skip,
+                luma_ac=np.asarray(out["luma_ac"]),
+                chroma_dc=np.asarray(out["chroma_dc"]),
+                chroma_ac=np.asarray(out["chroma_ac"]),
+                qp=self.qp,
+            )
+            t1 = time.perf_counter()
+            slice_nal = pack_slice_p_fast(
+                pfc, self.params, frame_num=self._frames_since_idr % 256
+            )
+        if idr:
+            # the reconstruction never leaves the device: it is the P-frame
+            # reference (donated into the next P step)
+            self._ref = (out["recon_y"], out["recon_u"], out["recon_v"])
         t2 = time.perf_counter()
         au = (self._headers + slice_nal) if idr else slice_nal
         if idr:
@@ -139,6 +212,7 @@ class TPUH264Encoder:
             bytes=len(au),
             device_ms=(t1 - t0) * 1e3,
             pack_ms=(t2 - t1) * 1e3,
+            skipped_mbs=skipped,
         )
         self.frame_index += 1
         self._frames_since_idr += 1
@@ -159,13 +233,19 @@ class TPUH264Encoder:
 
 
 def make_frame_step(width: int, height: int, qp: int = 28):
-    """(jittable fn, example args) for the driver's compile check."""
+    """(jittable fn, example args) for the driver's compile check: the
+    steady-state P-frame step (ME + MC + transform), the flagship path."""
     pad_h = (height + 15) // 16 * 16
     pad_w = (width + 15) // 16 * 16
 
-    def fn(frame, qp_arr):
-        return _device_step(frame, qp_arr, pad_h=pad_h, pad_w=pad_w, channels=4)
+    def fn(frame, qp_arr, ry, ru, rv):
+        return _device_step_p(
+            frame, qp_arr, ry, ru, rv, pad_h=pad_h, pad_w=pad_w, channels=4, search=8
+        )
 
     rng = np.random.default_rng(0)
     frame = rng.integers(0, 256, size=(height, width, 4), dtype=np.uint8)
-    return fn, (frame, np.int32(qp))
+    ry = rng.integers(0, 256, size=(pad_h, pad_w), dtype=np.uint8)
+    ru = rng.integers(0, 256, size=(pad_h // 2, pad_w // 2), dtype=np.uint8)
+    rv = rng.integers(0, 256, size=(pad_h // 2, pad_w // 2), dtype=np.uint8)
+    return fn, (frame, np.int32(qp), ry, ru, rv)

@@ -142,9 +142,9 @@ def dequant_luma_dc(levels, qp):
     return jnp.where(qp_per >= 2, hi, lo)
 
 
-def quant_chroma_dc(dc, qp_c):
+def quant_chroma_dc(dc, qp_c, intra: bool = True):
     t = _had2(dc)
-    qbits, f = _qparams(qp_c, True)
+    qbits, f = _qparams(qp_c, intra)
     mf00 = _MF_BY_REM[qp_c % 6, 0, 0]
     level = jnp.right_shift(jnp.abs(t) * mf00 + 2 * f, qbits + 1)
     return jnp.where(t < 0, -level, level)
@@ -303,4 +303,216 @@ def encode_frame_planes(y, u, v, qp):
         "recon_y": recon_y.astype(jnp.uint8),
         "recon_u": recon_u.astype(jnp.uint8),
         "recon_v": recon_v.astype(jnp.uint8),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Inter (P-frame) device path
+# ---------------------------------------------------------------------------
+#
+# Unlike the intra row scan above, P frames have NO spatial prediction
+# dependencies (P_Skip / P_L0_16x16 partitions only, prediction comes from
+# the previous frame's reconstruction), so everything below is a single
+# batched tensor program: full-search motion estimation, gather-based
+# motion compensation, transform+quant, and skip-mask derivation all run
+# over the whole macroblock grid at once. This is the steady-state hot
+# path — a remote-desktop stream is one IDR then P frames forever
+# (reference: keyframe_distance=-1 default, __main__.py:473-475).
+
+MV_PAD = 16  # must match numpy_ref.MV_PAD
+_ME_CHUNK = 17
+
+
+def _me_candidates(search: int) -> tuple[np.ndarray, np.ndarray]:
+    """Candidate (dx, dy) list in golden-model order: zero MV first, then
+    raster (dy outer) — rank breaks SAD ties identically to numpy_ref."""
+    cands = [(dx, dy) for dy in range(-search, search + 1) for dx in range(-search, search + 1)]
+    cands.sort(key=lambda c: c != (0, 0))
+    arr = np.array(cands, np.int32)
+    ranks = np.arange(len(arr), dtype=np.int32)
+    pad = (-len(arr)) % _ME_CHUNK
+    if pad:
+        # padding duplicates the zero MV at ranks beyond every real
+        # candidate: same SAD as the real zero but a worse tie-break, so a
+        # padded entry can never be selected (and ranks stay small enough
+        # that SAD·scale + rank fits int32)
+        arr = np.concatenate([arr, np.zeros((pad, 2), np.int32)])
+        ranks = np.concatenate([ranks, np.arange(len(ranks), len(ranks) + pad, dtype=np.int32)])
+    return arr, ranks
+
+
+def motion_search(cur, ref_pad, search: int = 8):
+    """Exhaustive full-pel SAD search: (H, W) planes -> (mbh, mbw, 2) MVs.
+
+    Cost = SAD·scale + candidate rank (scale = next power of two above the
+    candidate count), so ties resolve to the golden model's zero-first
+    raster order exactly (tests assert array equality).
+    Scanned in chunks of 17 candidates (vmap inside scan) to bound the
+    live intermediate to chunk×H×W while keeping dispatch count low.
+    """
+    if search > MV_PAD:
+        raise ValueError(f"search {search} exceeds MV_PAD={MV_PAD}")
+    h, w = cur.shape
+    mbh, mbw = h // 16, w // 16
+    cands, ranks = _me_candidates(search)
+    # tie-break scale: next power of two above the candidate count so
+    # rank never aliases into SAD units
+    scale = 1 << int(ranks.max()).bit_length()
+    cand_chunks = jnp.asarray(cands.reshape(-1, _ME_CHUNK, 2))
+    rank_chunks = jnp.asarray(ranks.reshape(-1, _ME_CHUNK))
+    cur = cur.astype(jnp.int32)
+
+    def sad_one(dxdy):
+        sh = jax.lax.dynamic_slice(
+            ref_pad, (MV_PAD + dxdy[1], MV_PAD + dxdy[0]), (h, w)
+        ).astype(jnp.int32)
+        return jnp.abs(cur - sh).reshape(mbh, 16, mbw, 16).sum(axis=(1, 3))
+
+    def step(carry, xs):
+        best_cost, best_mv = carry
+        cand, rank = xs
+        sads = jax.vmap(sad_one)(cand)  # (C, mbh, mbw)
+        cost = sads * scale + rank[:, None, None]
+        i = jnp.argmin(cost, axis=0)
+        c = jnp.take_along_axis(cost, i[None], 0)[0]
+        mv = cand[i]
+        better = c < best_cost
+        return (
+            jnp.where(better, c, best_cost),
+            jnp.where(better[..., None], mv, best_mv),
+        ), None
+
+    init = (
+        jnp.full((mbh, mbw), jnp.iinfo(jnp.int32).max, jnp.int32),
+        jnp.zeros((mbh, mbw, 2), jnp.int32),
+    )
+    (best_cost, best_mv), _ = jax.lax.scan(step, init, (cand_chunks, rank_chunks))
+    return best_mv
+
+
+def mc_luma(ref_pad, mvs):
+    """Full-pel luma MC: gather the per-MB-shifted reference plane."""
+    mbh, mbw = mvs.shape[:2]
+    h, w = mbh * 16, mbw * 16
+    mvx = jnp.repeat(jnp.repeat(mvs[..., 0], 16, 0), 16, 1)
+    mvy = jnp.repeat(jnp.repeat(mvs[..., 1], 16, 0), 16, 1)
+    iy = jnp.arange(h)[:, None] + mvy + MV_PAD
+    ix = jnp.arange(w)[None, :] + mvx + MV_PAD
+    return ref_pad[iy, ix].astype(jnp.int32)
+
+
+def mc_chroma(ref_pad, mvs):
+    """Chroma MC (8.4.2.2.2): full-pel luma MVs land chroma on half-pel;
+    bilinear blend of the 4 neighbours with weights from frac ∈ {0, 4}."""
+    mbh, mbw = mvs.shape[:2]
+    h, w = mbh * 8, mbw * 8
+    mvx = jnp.repeat(jnp.repeat(mvs[..., 0], 8, 0), 8, 1)
+    mvy = jnp.repeat(jnp.repeat(mvs[..., 1], 8, 0), 8, 1)
+    xf = 4 * (mvx & 1)
+    yf = 4 * (mvy & 1)
+    iy = jnp.arange(h)[:, None] + jnp.right_shift(mvy, 1) + MV_PAD
+    ix = jnp.arange(w)[None, :] + jnp.right_shift(mvx, 1) + MV_PAD
+    p = ref_pad.astype(jnp.int32)
+    a = p[iy, ix]
+    b = p[iy, ix + 1]
+    c = p[iy + 1, ix]
+    d = p[iy + 1, ix + 1]
+    return jnp.right_shift(
+        (8 - xf) * (8 - yf) * a + xf * (8 - yf) * b + (8 - xf) * yf * c + xf * yf * d + 32, 6
+    )
+
+
+def _plane_to_mb_blocks(plane, n: int):
+    """(mbh*n*4, mbw*n*4) -> (mbh, mbw, n, n, 4, 4) [by][bx][i][j]."""
+    h, w = plane.shape
+    mbh, mbw = h // (n * 4), w // (n * 4)
+    return plane.reshape(mbh, n, 4, mbw, n, 4).transpose(0, 3, 1, 4, 2, 5)
+
+
+def _mb_blocks_to_plane(blocks):
+    mbh, mbw, n = blocks.shape[0], blocks.shape[1], blocks.shape[2]
+    return blocks.transpose(0, 2, 4, 1, 3, 5).reshape(mbh * n * 4, mbw * n * 4)
+
+
+def _skip_mask(mvs, resid_zero):
+    """Vectorized 8.4.1.1 P_Skip eligibility: residual-free MBs whose MV
+    equals the skip-derived MV."""
+    mbh, mbw = mvs.shape[:2]
+    left = jnp.pad(mvs, ((0, 0), (1, 0), (0, 0)))[:, :-1]
+    top = jnp.pad(mvs, ((1, 0), (0, 0), (0, 0)))[:-1]
+    # C = top-right, replaced by D = top-left on the last column (both exist
+    # whenever the else-branch below is reached: mbx>0 and mby>0).
+    tr = jnp.pad(mvs, ((1, 0), (0, 1), (0, 0)))[:-1, 1:]
+    tl = jnp.pad(mvs, ((1, 0), (1, 0), (0, 0)))[:-1, :-1]
+    last_col = jnp.arange(mbw) == mbw - 1
+    cmv = jnp.where(last_col[None, :, None], tl, tr)
+    med = left + top + cmv - jnp.maximum(jnp.maximum(left, top), cmv) - jnp.minimum(
+        jnp.minimum(left, top), cmv
+    )
+    edge = (jnp.arange(mbw)[None, :] == 0) | (jnp.arange(mbh)[:, None] == 0)
+    left_zero = (left == 0).all(-1)
+    top_zero = (top == 0).all(-1)
+    zero_cond = edge | left_zero | top_zero
+    skipmv = jnp.where(zero_cond[..., None], 0, med)
+    return resid_zero & (mvs == skipmv).all(-1)
+
+
+def encode_frame_p_planes(y, u, v, ref_y, ref_u, ref_v, qp, search: int = 8):
+    """Jitted P-frame encode on padded planes against the previous recon.
+
+    Returns mvs/skip/coefficients (PFrameCoeffs layout) + recon planes.
+    One batched program, no scans except the ME candidate loop.
+    """
+    y = y.astype(jnp.int32)
+    u = u.astype(jnp.int32)
+    v = v.astype(jnp.int32)
+    qp = jnp.asarray(qp, jnp.int32)
+    qp_c = _CHROMA_QP[qp]
+
+    ry = jnp.pad(ref_y, MV_PAD, mode="edge")
+    ru = jnp.pad(ref_u, MV_PAD, mode="edge")
+    rv = jnp.pad(ref_v, MV_PAD, mode="edge")
+
+    mvs = motion_search(y, ry, search)
+    pred_y = mc_luma(ry, mvs)
+    pred_u = mc_chroma(ru, mvs)
+    pred_v = mc_chroma(rv, mvs)
+
+    # Luma: plain 4x4 transform, all 16 coeffs (no DC Hadamard in inter MBs)
+    yb = _plane_to_mb_blocks(y - pred_y, 4)
+    wy = fdct4(yb)
+    luma_ac = quant4(wy, qp, intra=False)
+    rec_y = jnp.clip(_mb_blocks_to_plane(idct4(dequant4(luma_ac, qp))) + pred_y, 0, 255)
+
+    def chroma(plane, pred):
+        cb = _plane_to_mb_blocks(plane - pred, 2)
+        wc = fdct4(cb)
+        dc = quant_chroma_dc(wc[..., 0, 0], qp_c, intra=False)
+        ac = quant4(wc, qp_c, intra=False)
+        deq = dequant4(ac, qp_c)
+        deq = deq.at[..., 0, 0].set(dequant_chroma_dc(dc, qp_c))
+        rec = jnp.clip(_mb_blocks_to_plane(idct4(deq)) + pred, 0, 255)
+        return dc, ac, rec
+
+    cb_dc, cb_ac, rec_u = chroma(u, pred_u)
+    cr_dc, cr_ac, rec_v = chroma(v, pred_v)
+
+    resid_zero = (
+        (luma_ac == 0).all((-4, -3, -2, -1))
+        & (cb_dc == 0).all((-2, -1))
+        & (cr_dc == 0).all((-2, -1))
+        & (cb_ac == 0).all((-4, -3, -2, -1))
+        & (cr_ac == 0).all((-4, -3, -2, -1))
+    )
+    skip = _skip_mask(mvs, resid_zero)
+
+    return {
+        "mvs": mvs,
+        "skip": skip,
+        "luma_ac": luma_ac,
+        "chroma_dc": jnp.stack([cb_dc, cr_dc], axis=2),
+        "chroma_ac": jnp.stack([cb_ac, cr_ac], axis=2),
+        "recon_y": rec_y.astype(jnp.uint8),
+        "recon_u": rec_u.astype(jnp.uint8),
+        "recon_v": rec_v.astype(jnp.uint8),
     }

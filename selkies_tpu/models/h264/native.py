@@ -19,11 +19,13 @@ from selkies_tpu.models.h264.bitstream import (
     NAL_SLICE_IDR,
     NAL_SLICE_NON_IDR,
     SLICE_I,
+    SLICE_P,
     StreamParams,
     write_slice_header,
 )
 from selkies_tpu.models.h264.cavlc import pack_slice as pack_slice_py
-from selkies_tpu.models.h264.numpy_ref import FrameCoeffs
+from selkies_tpu.models.h264.cavlc import pack_slice_p as pack_slice_p_py
+from selkies_tpu.models.h264.numpy_ref import FrameCoeffs, PFrameCoeffs
 from selkies_tpu.utils.bits import BitWriter
 
 logger = logging.getLogger("h264.native")
@@ -57,6 +59,16 @@ def _load() -> ctypes.CDLL | None:
         ctypes.POINTER(ctypes.c_int16), ctypes.POINTER(ctypes.c_int16),
         ctypes.POINTER(ctypes.c_int16), ctypes.POINTER(ctypes.c_int16),
         ctypes.POINTER(ctypes.c_int16), ctypes.POINTER(ctypes.c_int16),
+        ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.pack_slice_p_rbsp.restype = ctypes.c_int64
+    lib.pack_slice_p_rbsp.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int16), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int16), ctypes.POINTER(ctypes.c_int16),
+        ctypes.POINTER(ctypes.c_int16),
         ctypes.c_int, ctypes.c_int,
         ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
@@ -137,16 +149,7 @@ def pack_slice_native(
         cap = len(rbsp) * 2  # pathological content; retry with more room
         if cap > (1 << 30):
             raise RuntimeError("pack_slice_rbsp overflow beyond 1 GiB")
-    ebsp = s["ebsp"]
-    m = lib.emulation_prevent(
-        rbsp.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n,
-        ebsp.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(ebsp),
-    )
-    if m < 0:
-        raise RuntimeError("emulation_prevent overflow")
-    nal_type = NAL_SLICE_IDR if idr else NAL_SLICE_NON_IDR
-    header = bytes([(3 << 5) | nal_type])
-    return b"\x00\x00\x00\x01" + header + ebsp[:m].tobytes()
+    return _finish_nal(s, n, NAL_SLICE_IDR if idr else NAL_SLICE_NON_IDR)
 
 
 def pack_slice_fast(fc, p, frame_num=0, idr=True, idr_pic_id=0) -> bytes:
@@ -154,3 +157,57 @@ def pack_slice_fast(fc, p, frame_num=0, idr=True, idr_pic_id=0) -> bytes:
     if native_available():
         return pack_slice_native(fc, p, frame_num=frame_num, idr=idr, idr_pic_id=idr_pic_id)
     return pack_slice_py(fc, p, frame_num=frame_num, idr=idr, idr_pic_id=idr_pic_id)
+
+
+def _finish_nal(s: dict, n: int, nal_type: int) -> bytes:
+    lib = _load()
+    ebsp = s["ebsp"]
+    m = lib.emulation_prevent(
+        s["rbsp"].ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n,
+        ebsp.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(ebsp),
+    )
+    if m < 0:
+        raise RuntimeError("emulation_prevent overflow")
+    return b"\x00\x00\x00\x01" + bytes([(3 << 5) | nal_type]) + ebsp[:m].tobytes()
+
+
+def pack_slice_p_native(fc: PFrameCoeffs, p: StreamParams, frame_num: int) -> bytes:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("libcavlc.so unavailable")
+    mbh, mbw = fc.skip.shape
+
+    hdr = BitWriter()
+    write_slice_header(hdr, p, SLICE_P, frame_num, idr=False, slice_qp=fc.qp)
+    hdr_bytes, hdr_bits = hdr.get_partial()
+
+    mvs = np.ascontiguousarray(fc.mvs, dtype=np.int16)
+    skip = np.ascontiguousarray(fc.skip, dtype=np.uint8)
+    luma_ac = np.ascontiguousarray(fc.luma_ac, dtype=np.int16)
+    chroma_dc = np.ascontiguousarray(fc.chroma_dc, dtype=np.int16)
+    chroma_ac = np.ascontiguousarray(fc.chroma_ac, dtype=np.int16)
+    cap = mbh * mbw * 1024 + len(hdr_bytes) + 1024
+    while True:
+        s = _get_scratch(mbh, mbw, cap)
+        rbsp = s["rbsp"]
+        n = lib.pack_slice_p_rbsp(
+            hdr_bytes, hdr_bits,
+            _i16ptr(mvs), skip.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            _i16ptr(luma_ac), _i16ptr(chroma_dc), _i16ptr(chroma_ac),
+            mbh, mbw,
+            rbsp.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(rbsp),
+            _i32ptr(s["luma_tc"]), _i32ptr(s["chroma_tc"]),
+        )
+        if n >= 0:
+            break
+        cap = len(rbsp) * 2
+        if cap > (1 << 30):
+            raise RuntimeError("pack_slice_p_rbsp overflow beyond 1 GiB")
+    return _finish_nal(s, n, NAL_SLICE_NON_IDR)
+
+
+def pack_slice_p_fast(fc: PFrameCoeffs, p: StreamParams, frame_num: int) -> bytes:
+    """Native P-slice packer when available, Python fallback otherwise."""
+    if native_available():
+        return pack_slice_p_native(fc, p, frame_num)
+    return pack_slice_p_py(fc, p, frame_num)

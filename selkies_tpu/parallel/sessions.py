@@ -1,0 +1,132 @@
+"""Multi-session chip placement: N concurrent streams on an N-chip slice.
+
+The reference scales out by running one OS process per session and
+delegating fleet placement to Kubernetes (SURVEY §2.6: coturn-web
+informers, addons/example). The TPU-native design inverts this: ONE host
+process drives a whole slice (the v5e-8 scale target in BASELINE.md — 8x
+1080p60 sessions, one stream per chip) through a single jitted program
+sharded over a `session` mesh axis.
+
+There is no cross-session communication, so XLA partitions the batched
+encode step into per-chip programs with zero collectives — each chip holds
+its own session's reference frame (the P-frame state) in HBM between
+frames, and only quantized coefficients come back to the host for entropy
+packing (one CPU thread per session can pack concurrently; CAVLC packing
+is independent per stream).
+
+Frames enter as a (N, H, W, 4) batch sharded on axis 0; per-session QP
+comes in as an (N,) vector so each session's rate controller retunes
+independently without recompilation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from selkies_tpu.models.h264.encoder_core import (
+    encode_frame_p_planes,
+    encode_frame_planes,
+)
+from selkies_tpu.ops.colorspace import bgrx_to_i420
+
+__all__ = ["MultiSessionEncoder", "dryrun"]
+
+
+def _session_mesh(n: int, devices=None) -> Mesh:
+    devs = np.array(devices if devices is not None else jax.devices()[:n])
+    if len(devs) < n:
+        raise ValueError(f"need {n} devices, have {len(devs)}")
+    return Mesh(devs[:n], axis_names=("session",))
+
+
+class MultiSessionEncoder:
+    """Batched per-chip encode for N independent sessions.
+
+    All sessions share one geometry (the common fleet case: identical
+    1080p60 streams); heterogeneous fleets run one instance per geometry
+    group. The per-session reference frames live sharded in HBM.
+    """
+
+    def __init__(self, n_sessions: int, width: int, height: int, devices=None):
+        if width % 16 or height % 16:
+            raise ValueError("multi-session geometry must be MB-aligned")
+        self.n = n_sessions
+        self.width = width
+        self.height = height
+        self.mesh = _session_mesh(n_sessions, devices)
+        shard = NamedSharding(self.mesh, P("session"))
+
+        def one_i(frame, qp):
+            y, u, v = bgrx_to_i420(frame)
+            return encode_frame_planes(y, u, v, qp)
+
+        def one_p(frame, qp, ry, ru, rv):
+            y, u, v = bgrx_to_i420(frame)
+            return encode_frame_p_planes(y, u, v, ry, ru, rv, qp)
+
+        self._step_i = jax.jit(
+            jax.vmap(one_i),
+            in_shardings=(shard, shard),
+            out_shardings=shard,
+        )
+        self._step_p = jax.jit(
+            jax.vmap(one_p),
+            in_shardings=(shard,) * 5,
+            out_shardings=shard,
+            donate_argnums=(2, 3, 4),
+        )
+        self._shard = shard
+        self._ref = None
+
+    def put_frames(self, frames: np.ndarray):
+        """(N, H, W, 4) uint8 host batch -> session-sharded device array."""
+        return jax.device_put(frames, self._shard)
+
+    def _keep_ref(self, out):
+        # recon planes are internal decoder state: they are donated into the
+        # next P step, so they must NOT escape in the public return (a caller
+        # holding them would hit deleted-buffer errors one frame later)
+        self._ref = (
+            out.pop("recon_y"),
+            out.pop("recon_u"),
+            out.pop("recon_v"),
+        )
+        return out
+
+    def encode_idr(self, frames, qps: np.ndarray):
+        out = dict(self._step_i(self.put_frames(np.asarray(frames)), jnp.asarray(qps, jnp.int32)))
+        return self._keep_ref(out)
+
+    def encode_p(self, frames, qps: np.ndarray):
+        if self._ref is None:
+            raise RuntimeError("encode_idr must run first (no reference frames)")
+        out = dict(
+            self._step_p(
+                self.put_frames(np.asarray(frames)), jnp.asarray(qps, jnp.int32), *self._ref
+            )
+        )
+        return self._keep_ref(out)
+
+
+def dryrun(n_devices: int) -> None:
+    """Driver hook: compile + run the FULL multi-session step (IDR path and
+    steady-state P path with ME) over an n-device session mesh, tiny shapes."""
+    h = w = 64
+    rng = np.random.default_rng(0)
+    enc = MultiSessionEncoder(n_devices, w, h)
+    frames = rng.integers(0, 256, (n_devices, h, w, 4), dtype=np.uint8)
+    qps = np.full(n_devices, 28, np.int32)
+    out_i = enc.encode_idr(frames, qps)
+    jax.block_until_ready(out_i)
+    frames2 = np.roll(frames, 3, axis=2)
+    out_p = enc.encode_p(frames2, qps)
+    jax.block_until_ready(out_p)
+    assert out_p["mvs"].shape == (n_devices, h // 16, w // 16, 2)
+    assert enc._ref[0].shape == (n_devices, h, w)
+    # per-session coefficient tensors must be sharded one-session-per-chip
+    visible = {d for s in out_p["luma_ac"].addressable_shards for d in [s.device]}
+    assert len(visible) == n_devices

@@ -70,3 +70,51 @@ def test_non_mb_multiple_resolution(tmp_path):
     path.write_bytes(au)
     frames = _decode(path)
     assert len(frames) == 1 and frames[0].shape == (178, 322, 3)
+
+
+def test_p_frames_skip_static_content(tmp_path):
+    """Static desktop: steady-state P frames must be nearly all P_Skip and
+    orders of magnitude smaller than the IDR."""
+    enc = TPUH264Encoder(width=320, height=180, qp=26)
+    f = _desktop_frame(320, 180, seed=4)
+    data = enc.encode_frame(f)
+    idr_size = len(data)
+    p_sizes = []
+    for _ in range(3):
+        au = enc.encode_frame(f)
+        p_sizes.append(len(au))
+        data += au
+    stats = enc.last_stats
+    assert not stats.idr
+    total_mbs = (180 // 16 + 1) * (320 // 16)
+    assert stats.skipped_mbs > total_mbs * 0.9
+    assert max(p_sizes) < idr_size // 20
+    path = tmp_path / "s.h264"
+    path.write_bytes(data)
+    assert len(_decode(path)) == 4
+
+
+def test_force_keyframe_and_interval(tmp_path):
+    enc = TPUH264Encoder(width=160, height=96, qp=24, keyframe_interval=2)
+    f = _desktop_frame(160, 96)
+    enc.encode_frame(f)
+    assert enc.last_stats.idr
+    enc.encode_frame(f)
+    assert not enc.last_stats.idr
+    enc.encode_frame(f)  # interval reached
+    assert enc.last_stats.idr
+    enc.force_keyframe()
+    enc.encode_frame(f)
+    assert enc.last_stats.idr
+
+
+def test_moving_content_stays_decodable(tmp_path):
+    """Scrolling text region: exercises nonzero MVs through the full
+    encoder (ME on device, mvd coding on host)."""
+    enc = TPUH264Encoder(width=320, height=180, qp=24)
+    data = b""
+    for i in range(5):
+        data += enc.encode_frame(_desktop_frame(320, 180, shift=3 * i))
+    path = tmp_path / "s.h264"
+    path.write_bytes(data)
+    assert len(_decode(path)) == 5

@@ -1,0 +1,70 @@
+"""JAX P-frame device path vs numpy golden model: exact array equality.
+
+Any divergence (ME tie-break, MC rounding, inter quant rounding, skip
+derivation) breaks bitstream conformance, so everything is asserted
+element-exact, not approximately.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from selkies_tpu.models.h264 import encoder_core as core
+from selkies_tpu.models.h264.numpy_ref import (
+    encode_frame_p,
+    full_search_me,
+    pad_ref,
+)
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _frames(rng, h, w, kind):
+    if kind == "noise":
+        y1 = rng.integers(0, 256, (h, w)).astype(np.uint8)
+        y2 = rng.integers(0, 256, (h, w)).astype(np.uint8)
+    elif kind == "static":
+        y1 = np.kron(rng.integers(0, 256, (h // 8, w // 8)), np.ones((8, 8))).astype(np.uint8)
+        y2 = y1.copy()
+    else:  # shifted
+        big = rng.integers(0, 256, (h + 32, w + 32)).astype(np.uint8)
+        y1 = big[16 : 16 + h, 16 : 16 + w]
+        y2 = big[13 : 13 + h, 21 : 21 + w]
+    u1 = rng.integers(0, 256, (h // 2, w // 2)).astype(np.uint8)
+    v1 = rng.integers(0, 256, (h // 2, w // 2)).astype(np.uint8)
+    u2 = (u1 // 2 + 60).astype(np.uint8)
+    v2 = v1.copy()
+    return (y1, u1, v1), (y2, u2, v2)
+
+
+@pytest.mark.parametrize("kind", ["noise", "static", "shifted"])
+@pytest.mark.parametrize("qp", [8, 30, 48])
+def test_p_frame_parity(kind, qp):
+    rng = np.random.default_rng(hash((kind, qp)) % 2**32)
+    h, w = 48, 64
+    (ry, ru, rv), (y, u, v) = _frames(rng, h, w, kind)
+
+    mvs_np = full_search_me(y, ry)
+    gold = encode_frame_p(y, u, v, ry, ru, rv, mvs_np, qp)
+
+    out = jax.jit(core.encode_frame_p_planes)(y, u, v, ry, ru, rv, np.int32(qp))
+    np.testing.assert_array_equal(np.asarray(out["mvs"]), mvs_np)
+    np.testing.assert_array_equal(np.asarray(out["skip"]), gold.coeffs.skip)
+    np.testing.assert_array_equal(np.asarray(out["luma_ac"]), gold.coeffs.luma_ac)
+    np.testing.assert_array_equal(np.asarray(out["chroma_dc"]), gold.coeffs.chroma_dc)
+    np.testing.assert_array_equal(np.asarray(out["chroma_ac"]), gold.coeffs.chroma_ac)
+    np.testing.assert_array_equal(np.asarray(out["recon_y"]), gold.recon_y)
+    np.testing.assert_array_equal(np.asarray(out["recon_u"]), gold.recon_u)
+    np.testing.assert_array_equal(np.asarray(out["recon_v"]), gold.recon_v)
+
+
+def test_motion_search_parity_large_motion():
+    rng = np.random.default_rng(99)
+    h, w = 64, 96
+    ry = rng.integers(0, 256, (h, w)).astype(np.uint8)
+    y = np.asarray(pad_ref(ry))[16 - 7 : 16 - 7 + h, 16 + 8 : 16 + 8 + w]
+    mvs_np = full_search_me(y, ry)
+    mvs_j = jax.jit(lambda c, r: core.motion_search(c, r))(
+        y.astype(np.int32), np.pad(ry, core.MV_PAD, mode="edge").astype(np.int32)
+    )
+    np.testing.assert_array_equal(np.asarray(mvs_j), mvs_np)

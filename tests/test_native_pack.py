@@ -71,3 +71,29 @@ def test_native_speed_1080p():
     nbytes = len(native.pack_slice_native(enc.coeffs, p))
     dt = time.perf_counter() - t0
     assert dt < 0.010, f"screen@qp26: {dt*1000:.1f} ms for {nbytes} B"
+
+
+def test_p_slice_native_matches_python():
+    pytest.importorskip("ctypes")
+    from selkies_tpu.models.h264.cavlc import pack_slice_p
+    from selkies_tpu.models.h264.native import native_available, pack_slice_p_native
+    from selkies_tpu.models.h264.numpy_ref import encode_frame_i16, encode_frame_p, full_search_me
+
+    if not native_available():
+        pytest.skip("libcavlc.so unavailable")
+    rng = np.random.default_rng(77)
+    h, w = 64, 96
+    p = StreamParams(width=w, height=h, qp=30)
+    y1 = np.kron(rng.integers(0, 256, (h // 8, w // 8)), np.ones((8, 8))).astype(np.uint8)
+    u1 = rng.integers(0, 256, (h // 2, w // 2)).astype(np.uint8)
+    v1 = rng.integers(0, 256, (h // 2, w // 2)).astype(np.uint8)
+    enc0 = encode_frame_i16(y1, u1, v1, 30)
+    # frame 2: static background + noise patch -> mixed skip / coded MBs
+    y2 = enc0.recon_y.copy()
+    u1, v1 = enc0.recon_u.copy(), enc0.recon_v.copy()
+    y2[20:40, 30:50] = rng.integers(0, 256, (20, 20))
+    mvs = full_search_me(y2, enc0.recon_y)
+    pe = encode_frame_p(y2, u1, v1, enc0.recon_y, enc0.recon_u, enc0.recon_v, mvs, 30)
+    assert pe.coeffs.skip.any() and not pe.coeffs.skip.all()
+    for frame_num in (1, 7):
+        assert pack_slice_p_native(pe.coeffs, p, frame_num) == pack_slice_p(pe.coeffs, p, frame_num)
