@@ -98,6 +98,7 @@ class HostInput:
         self.on_set_fps: Callable[[int], Any] = lambda f: warn("unhandled on_set_fps")
         self.on_set_enable_resize: Callable[[bool, str | None], Any] = lambda e, r: warn("unhandled on_set_enable_resize")
         self.on_client_fps: Callable[[int], Any] = lambda f: warn("unhandled on_client_fps")
+        self.on_media_ack: Callable[[int, float], Any] = lambda seq, ms: None
         self.on_client_latency: Callable[[int], Any] = lambda l: warn("unhandled on_client_latency")
         self.on_resize: Callable[[str], Any] = lambda r: warn("unhandled on_resize")
         self.on_scaling_ratio: Callable[[float], Any] = lambda s: warn("unhandled on_scaling_ratio")
@@ -369,6 +370,8 @@ class HostInput:
                     w, h = (int(v) + int(v) % 2 for v in toks[2].split("x"))
                     res = f"{w}x{h}"
                 self.on_set_enable_resize(enabled, res)
+            elif cmd == "_ack":
+                self.on_media_ack(int(toks[1]), float(toks[2]))
             elif cmd == "_f":
                 self.on_client_fps(int(toks[1]))
             elif cmd == "_l":

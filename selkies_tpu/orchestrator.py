@@ -43,6 +43,7 @@ from selkies_tpu.signalling.rtc_monitors import (
     fetch_turn_rest,
     make_turn_rtc_config_json_legacy,
 )
+from selkies_tpu.transport.congestion import GccController
 from selkies_tpu.transport.websocket import WebSocketTransport
 
 logger = logging.getLogger("orchestrator")
@@ -102,6 +103,33 @@ async def resolve_rtc_config(cfg: Config) -> tuple[str, str, str]:
     return parse_rtc_config(stun_only_rtc_config(cfg.stun_host, cfg.stun_port))
 
 
+def _loss_counters(stats_json: str) -> tuple[float, float] | None:
+    """Extract cumulative (packetsLost, packetsReceived) from a client
+    RTCStats upload (inbound-rtp report). Returns None when the transport
+    doesn't report loss (the WS transport never does)."""
+    try:
+        reports = json.loads(stats_json)
+    except (ValueError, TypeError):
+        return None
+    if isinstance(reports, dict):
+        reports = [reports]
+    if not isinstance(reports, list):
+        return None
+    for report in reports:
+        if not isinstance(report, dict):
+            continue
+        if report.get("type") not in ("inbound-rtp", None):
+            continue
+        lost, received = report.get("packetsLost"), report.get("packetsReceived")
+        if lost is None or received is None:
+            continue
+        try:
+            return max(0.0, float(lost)), max(0.0, float(received))
+        except (TypeError, ValueError):
+            continue
+    return None
+
+
 class Orchestrator:
     def __init__(self, cfg: Config):
         self.cfg = cfg
@@ -158,6 +186,7 @@ class Orchestrator:
         self.server.ws_routes["/media"] = self.transport.handle_connection
         self._tasks: list[asyncio.Task] = []
         self._session_active = False
+        self._last_loss_counters = (0.0, 0.0)
         self.last_resize_success = True
         self._wire_callbacks()
 
@@ -176,6 +205,11 @@ class Orchestrator:
         # client → host settings
         def on_video_bitrate(bitrate_kbps: int) -> None:
             app.set_video_bitrate(bitrate_kbps)
+            if self.gcc is not None:
+                # the user's choice is the new cap AND the new probe point;
+                # without this the next GCC estimate (still bounded by the
+                # old cap) would silently revert the change
+                self.gcc.set_target(int(bitrate_kbps))
             cfg.set_json_setting("video_bitrate", int(bitrate_kbps))
             app.send_video_bitrate(int(bitrate_kbps))
 
@@ -208,7 +242,23 @@ class Orchestrator:
         inp.on_client_fps = self.metrics.set_fps
         inp.on_client_latency = self.metrics.set_latency
         inp.on_ping_response = self._on_ping_response
-        inp.on_client_webrtc_stats = self.metrics.set_webrtc_stats
+        inp.on_client_webrtc_stats = self._on_client_webrtc_stats
+
+        # GCC congestion control: per-frame transport feedback drives the
+        # encoder's CBR target (reference: rtpgccbwe notify::estimated-bitrate
+        # → set_video_bitrate(cc=True), gstwebrtc_app.py:1638-1655)
+        if bool(cfg.congestion_control):
+            audio_kbps = max(int(cfg.audio_bitrate) // 1000, 0)
+            self.gcc = GccController(
+                start_kbps=int(cfg.video_bitrate),
+                min_kbps=max(100 + audio_kbps, int(cfg.video_bitrate) // 10),
+                max_kbps=int(cfg.video_bitrate),
+                on_estimate=lambda kbps: app.set_video_bitrate(kbps, cc=True),
+            )
+            self.transport.on_video_sent = self.gcc.on_frame_sent
+            inp.on_media_ack = self.gcc.on_frame_ack
+        else:
+            self.gcc = None
 
         # monitors → client stats channels
         def on_timer(ts: float) -> None:
@@ -262,8 +312,26 @@ class Orchestrator:
     def _on_client_connected(self) -> None:
         logger.info("client connected; starting pipelines")
         self._session_active = True
+        if self.gcc is not None:
+            # the new client's receive clock has a fresh epoch
+            # (performance.now() restarts on reload): stale delay state
+            # would corrupt the trendline
+            self.gcc.reset()
         loop = asyncio.get_running_loop()
         loop.create_task(self._start_session())
+
+    async def _on_client_webrtc_stats(self, stat_type: str, stats_json: str) -> None:
+        await self.metrics.set_webrtc_stats(stat_type, stats_json)
+        if self.gcc is not None and stat_type == "_stats_video":
+            counters = _loss_counters(stats_json)
+            if counters is not None:
+                lost, received = counters
+                # stats counters are cumulative; GCC wants interval loss
+                p_lost, p_recv = self._last_loss_counters
+                d_lost, d_recv = lost - p_lost, received - p_recv
+                self._last_loss_counters = (lost, received)
+                if d_lost >= 0 and d_recv >= 0 and d_lost + d_recv > 0:
+                    self.gcc.on_loss_report(d_lost / (d_lost + d_recv))
 
     def _on_client_disconnected(self) -> None:
         logger.info("client disconnected; stopping pipelines")

@@ -12,7 +12,8 @@ identical to the WebRTC path.
 Binary frame layout (network order):
     u8  kind      1=video 2=audio
     u8  flags     bit0 = keyframe (IDR)
-    u16 reserved
+    u16 seq       video: per-frame sequence (congestion-control feedback
+                  key: the client echoes `_ack,<seq>,<recv_ms>`); audio: 0
     u32 timestamp video: 90 kHz clock; audio: 48 kHz sample clock
     ... payload   video: Annex-B access unit; audio: Opus packet
 """
@@ -22,6 +23,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import struct
+import time
 from typing import Any, Awaitable, Callable
 
 from aiohttp import WSMsgType, web
@@ -34,13 +36,17 @@ KIND_AUDIO = 2
 FLAG_KEYFRAME = 1
 
 
-def pack_media_frame(kind: int, flags: int, timestamp: int, payload: bytes) -> bytes:
-    return HEADER.pack(kind, flags, 0, timestamp & 0xFFFFFFFF) + payload
+def pack_media_frame(kind: int, flags: int, timestamp: int, payload: bytes, seq: int = 0) -> bytes:
+    return HEADER.pack(kind, flags, seq & 0xFFFF, timestamp & 0xFFFFFFFF) + payload
 
 
 def parse_media_frame(data: bytes) -> tuple[int, int, int, bytes]:
     kind, flags, _, ts = HEADER.unpack_from(data)
     return kind, flags, ts, data[HEADER.size :]
+
+
+def parse_media_frame_seq(data: bytes) -> int:
+    return HEADER.unpack_from(data)[2]
 
 
 class WebSocketTransport:
@@ -54,8 +60,11 @@ class WebSocketTransport:
         self.on_data_message: Callable[[str], Awaitable[None] | None] = lambda m: None
         self.on_connect: Callable[[], Any] = lambda: None
         self.on_disconnect: Callable[[], Any] = lambda: None
+        # congestion control taps (GccController.on_frame_sent wiring)
+        self.on_video_sent: Callable[[int, float, int], None] = lambda seq, ms, size: None
         self.frames_sent = 0
         self.bytes_sent = 0
+        self._video_seq = 0
 
     # -- Transport protocol -------------------------------------------
 
@@ -89,22 +98,32 @@ class WebSocketTransport:
     async def send_video(self, ef) -> None:
         """EncodedFrame (pipeline/elements.py) → binary WS message."""
         flags = FLAG_KEYFRAME if ef.idr else 0
-        await self._send_binary(pack_media_frame(KIND_VIDEO, flags, ef.timestamp_90k, ef.au))
+        seq = self._video_seq = (self._video_seq + 1) & 0xFFFF
+        # sample the send clock BEFORE the await: under TCP backpressure
+        # send_bytes blocks until the socket drains, and enqueue-time deltas
+        # are what let the trendline see the queue growing (congestion would
+        # otherwise inflate Δsend to match Δrecv and hide itself)
+        send_ms = time.monotonic() * 1000.0
+        sent = await self._send_binary(pack_media_frame(KIND_VIDEO, flags, ef.timestamp_90k, ef.au, seq))
+        if sent:
+            self.on_video_sent(seq, send_ms, len(ef.au) + HEADER.size)
 
     async def send_audio(self, ea) -> None:
         """EncodedAudio (audio/pipeline.py) → binary WS message."""
         await self._send_binary(pack_media_frame(KIND_AUDIO, 0, ea.timestamp_48k, ea.packet))
 
-    async def _send_binary(self, data: bytes) -> None:
+    async def _send_binary(self, data: bytes) -> bool:
         ws = self._ws
         if ws is None or ws.closed:
-            return
+            return False
         try:
             await ws.send_bytes(data)
             self.frames_sent += 1
             self.bytes_sent += len(data)
+            return True
         except (ConnectionError, RuntimeError):
             logger.info("media send failed; client gone")
+            return False
 
     # -- aiohttp endpoint ---------------------------------------------
 

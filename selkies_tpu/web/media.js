@@ -2,7 +2,7 @@
  *
  * Replaces the reference client's RTCPeerConnection video path
  * (addons/gst-web/src/webrtc.js) for the WS transport: binary messages are
- * framed as [u8 kind][u8 flags][u16 rsvd][u32 ts] + payload (see
+ * framed as [u8 kind][u8 flags][u16 seq][u32 ts] + payload (see
  * selkies_tpu/transport/websocket.py).  Video is H.264 Annex-B decoded by
  * VideoDecoder; audio is Opus decoded by AudioDecoder into WebAudio.
  * Text messages carry the server→client data-channel JSON vocabulary.
@@ -51,11 +51,15 @@ class SelkiesMedia {
 
   _media(buf) {
     const dv = new DataView(buf);
-    const kind = dv.getUint8(0), flags = dv.getUint8(1), ts = dv.getUint32(4);
+    const kind = dv.getUint8(0), flags = dv.getUint8(1), seq = dv.getUint16(2), ts = dv.getUint32(4);
     const payload = new Uint8Array(buf, 8);
     this.bytesReceived += buf.byteLength;
-    if (kind === KIND_VIDEO) this._video(payload, ts, (flags & FLAG_KEYFRAME) !== 0);
-    else if (kind === KIND_AUDIO) this._audio(payload, ts);
+    if (kind === KIND_VIDEO) {
+      // congestion-control feedback: echo seq + local receive time (the
+      // server only uses deltas, so clock offset cancels)
+      this.send(`_ack,${seq},${performance.now().toFixed(1)}`);
+      this._video(payload, ts, (flags & FLAG_KEYFRAME) !== 0);
+    } else if (kind === KIND_AUDIO) this._audio(payload, ts);
   }
 
   _ensureVideoDecoder() {

@@ -1,0 +1,96 @@
+"""GCC congestion controller: synthetic timelines, deterministic.
+
+Mirrors the behavioural contract of the reference's rtpgccbwe attachment
+(gstwebrtc_app.py:1638-1655): growing queueing delay must cut the
+estimate; a clean network must let it climb back; loss must bound it.
+"""
+
+
+from selkies_tpu.transport.congestion import GccController, TrendlineEstimator
+
+
+def drive(gcc, frames, fps=60.0, kbps=4000.0, delay_fn=lambda i: 5.0, start_seq=0):
+    """Send `frames` frames at fps/kbps with per-frame one-way delay
+    delay_fn(i) ms; acks arrive immediately after the delay."""
+    size = int(kbps * 1000 / 8 / fps)
+    for i in range(frames):
+        seq = start_seq + i
+        send = seq * 1000.0 / fps
+        gcc.on_frame_sent(seq, send, size)
+        gcc.on_frame_ack(seq, send + delay_fn(i))
+    return gcc
+
+
+def test_stable_network_increases_estimate():
+    est = []
+    gcc = GccController(start_kbps=2000, max_kbps=8000, on_estimate=est.append)
+    drive(gcc, 600, delay_fn=lambda i: 5.0 + (i % 3))  # jitter, no trend
+    assert gcc.estimate_kbps > 2000
+    assert est and est[-1] > 2000
+
+
+def test_queue_buildup_decreases_estimate():
+    est = []
+    gcc = GccController(start_kbps=4000, max_kbps=8000, on_estimate=est.append)
+    drive(gcc, 60, delay_fn=lambda i: 5.0)
+    before = gcc.estimate_kbps
+    # congested link: one-way delay grows 2 ms per frame (queue filling)
+    drive(gcc, 120, delay_fn=lambda i: 5.0 + 2.0 * i, start_seq=60)
+    assert gcc.estimate_kbps < before
+    assert min(est) < before
+
+
+def test_recovery_after_congestion():
+    gcc = GccController(start_kbps=4000, max_kbps=8000)
+    drive(gcc, 60)
+    drive(gcc, 120, delay_fn=lambda i: 5.0 + 2.0 * i, start_seq=60)
+    low = gcc.estimate_kbps
+    # drain + stable again: delay back to baseline for 10 seconds
+    drive(gcc, 600, delay_fn=lambda i: 5.0, start_seq=180)
+    assert gcc.estimate_kbps > low
+
+
+def test_loss_bounds_estimate():
+    gcc = GccController(start_kbps=4000, max_kbps=8000)
+    gcc.on_loss_report(0.2)
+    assert gcc.estimate_kbps < 4000
+    e = gcc.estimate_kbps
+    gcc.on_loss_report(0.0)
+    assert gcc.estimate_kbps >= e
+
+
+def test_estimate_clamped_to_bounds():
+    gcc = GccController(start_kbps=1000, min_kbps=500, max_kbps=2000)
+    for _ in range(50):
+        gcc.on_loss_report(0.5)
+    assert gcc.estimate_kbps == 500
+    for _ in range(500):
+        gcc.on_loss_report(0.0)
+    assert gcc.estimate_kbps <= 2000
+
+
+def test_trendline_states():
+    t = TrendlineEstimator()
+    for i in range(40):
+        t.add(i * 16.7, i * 16.7 + 5.0)
+    assert t.state == "normal"
+    for i in range(40, 80):
+        t.add(i * 16.7, i * 16.7 + 5.0 + (i - 40) * 3.0)
+    assert t.state == "overuse"
+    # queues draining: delay falling back
+    for i in range(80, 120):
+        t.add(i * 16.7, i * 16.7 + max(5.0, 125.0 - (i - 80) * 3.0))
+    assert t.state in ("underuse", "normal")
+
+
+def test_unacked_frames_bounded():
+    gcc = GccController()
+    for i in range(10000):
+        gcc.on_frame_sent(i, i * 16.7, 5000)
+    assert len(gcc._sent) <= 4096
+
+
+def test_ack_without_send_ignored():
+    gcc = GccController(start_kbps=3000)
+    gcc.on_frame_ack(123, 50.0)
+    assert gcc.estimate_kbps == 3000

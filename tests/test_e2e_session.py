@@ -171,3 +171,60 @@ def test_full_session(loop, tmp_path):
             run_task.cancel()
 
     loop.run_until_complete(scenario())
+
+
+def test_congestion_control_loop(loop, tmp_path):
+    """GCC e2e: client acks with growing delay must drive the encoder's
+    CBR target down via set_video_bitrate(cc=True) (SURVEY §3.5)."""
+
+    async def scenario():
+        orch = Orchestrator(make_config(tmp_path, congestion_control=True))
+        orch.input.backend = FakeBackend()
+        orch.input.clipboard = MemoryClipboard()
+        assert orch.gcc is not None
+        start_kbps = orch.app.rc.bitrate_kbps
+
+        run_task = asyncio.ensure_future(orch.run())
+        for _ in range(100):
+            if orch.server._runner is not None and orch.server._runner.addresses:
+                break
+            await asyncio.sleep(0.05)
+        base = f"http://127.0.0.1:{orch.server.bound_port}"
+
+        from selkies_tpu.transport.websocket import parse_media_frame_seq
+
+        async with aiohttp.ClientSession() as http:
+            ws = await http.ws_connect(base + "/media")
+            n = 0
+            recv_ms = 0.0
+            deadline = asyncio.get_event_loop().time() + 60
+            while n < 50 and asyncio.get_event_loop().time() < deadline:
+                msg = await asyncio.wait_for(ws.receive(), 30)
+                if msg.type == aiohttp.WSMsgType.BINARY:
+                    kind, _, _, _ = parse_media_frame(msg.data)
+                    if kind != KIND_VIDEO:
+                        continue
+                    seq = parse_media_frame_seq(msg.data)
+                    # synthetic congested link: inter-arrival grows 3 ms per
+                    # frame beyond the ~33 ms send cadence (queue building)
+                    recv_ms += 40.0 + 3.0 * n
+                    await ws.send_str(f"_ack,{seq},{recv_ms:.1f}")
+                    n += 1
+                elif msg.type == aiohttp.WSMsgType.TEXT:
+                    pass
+                else:
+                    break
+            await asyncio.sleep(0.3)
+            assert n >= 50, f"only {n} video frames"
+            assert orch.app.rc.bitrate_kbps < start_kbps, (
+                f"estimate did not drop: {orch.app.rc.bitrate_kbps} vs {start_kbps}"
+            )
+            await ws.close()
+
+        await orch.server.stop()
+        try:
+            await asyncio.wait_for(run_task, 10)
+        except (asyncio.TimeoutError, asyncio.CancelledError):
+            run_task.cancel()
+
+    loop.run_until_complete(scenario())
