@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from selkies_tpu.models.stats import FrameStats as _FrameStats
 from selkies_tpu.models.h264.bitstream import StreamParams, write_pps, write_sps
 from selkies_tpu.models.h264.encoder_core import encode_frame_p_planes, encode_frame_planes
 from selkies_tpu.models.h264.native import pack_slice_fast, pack_slice_p_fast
@@ -68,19 +69,14 @@ def _device_step_p(frame, qp, ref_y, ref_u, ref_v, *, pad_h: int, pad_w: int, ch
     return _narrow(encode_frame_p_planes(y, u, v, ref_y, ref_u, ref_v, qp, search=search))
 
 
-@dataclass
-class FrameStats:
-    frame_index: int
-    idr: bool
-    qp: int
-    bytes: int
-    device_ms: float
-    pack_ms: float
-    skipped_mbs: int = 0
+FrameStats = _FrameStats  # shared definition (models/stats.py)
 
 
 class TPUH264Encoder:
     """Stateful per-stream encoder: frame in, Annex-B access unit out.
+
+    `codec` identifies the bitstream for client decoder configuration
+    (media.js maps it to a WebCodecs codec string).
 
     GOP policy mirrors the reference default (keyframe_distance=-1,
     __main__.py:473-475): one IDR, then P frames forever; new IDRs only on
@@ -88,6 +84,8 @@ class TPUH264Encoder:
     keyframe_interval. The previous frame's reconstruction stays on the
     TPU between frames — only quantized coefficients cross PCIe.
     """
+
+    codec = "h264"
 
     def __init__(
         self,

@@ -66,8 +66,22 @@ def _tpuh264enc(*, width: int, height: int, fps: int = 60, qp: int = 28, **kw):
 def _tpuvp9enc(**kw):
     raise NotImplementedError(
         "tpuvp9enc is scheduled after the H.264 path (SURVEY.md §7 step 5); "
-        "use tpuh264enc"
+        "use tpuh264enc (TPU) or vp9enc (libvpx software)"
     )
+
+
+@register("vp9enc")
+def _vp9enc(*, width: int, height: int, fps: int = 60, bitrate_kbps: int = 2000, **kw):
+    from selkies_tpu.models.libvpx_enc import LibVpxEncoder
+
+    return LibVpxEncoder(width=width, height=height, fps=fps, bitrate_kbps=bitrate_kbps)
+
+
+@register("vp8enc")
+def _vp8enc(*, width: int, height: int, fps: int = 60, bitrate_kbps: int = 2000, **kw):
+    from selkies_tpu.models.libvpx_enc import LibVpxEncoder
+
+    return LibVpxEncoder(width=width, height=height, fps=fps, bitrate_kbps=bitrate_kbps, vp8=True)
 
 
 @register("tpuav1enc")
@@ -82,7 +96,6 @@ def _tpuav1enc(**kw):
 # the TPU equivalent so existing SELKIES_ENCODER values keep working.
 for _legacy_h264 in ("nvh264enc", "vah264enc", "x264enc", "openh264enc"):
     alias(_legacy_h264, "tpuh264enc")
-for _legacy_vp9 in ("vp9enc", "vavp9enc"):
-    alias(_legacy_vp9, "tpuvp9enc")
+alias("vavp9enc", "vp9enc")  # libvpx software row until tpuvp9enc lands
 for _legacy_av1 in ("nvav1enc", "vaav1enc", "svtav1enc", "av1enc", "rav1enc"):
     alias(_legacy_av1, "tpuav1enc")

@@ -1,0 +1,20 @@
+"""Per-frame encoder statistics — shared by every encoder row.
+
+One definition so pipeline/elements.py, monitoring, and tests consume a
+single type regardless of which encoder produced the frame.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class FrameStats:
+    frame_index: int
+    idr: bool
+    qp: int
+    bytes: int
+    device_ms: float
+    pack_ms: float
+    skipped_mbs: int = 0

@@ -343,6 +343,7 @@ class Orchestrator:
         if self.cfg.enable_webrtc_statistics:
             self.metrics.initialize_webrtc_csv_file(self.cfg.webrtc_statistics_dir)
         self.app.force_keyframe()
+        self.app.send_codec()  # client picks its WebCodecs decoder config
         await self.app.start_pipeline()
         if self.audio is not None:
             await self.audio.start()

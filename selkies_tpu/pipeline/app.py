@@ -123,6 +123,10 @@ class TPUWebRTCApp:
         """Retarget video bitrate; cc=True marks a congestion-control
         update (not persisted / not echoed to the client UI)."""
         self.rc.set_bitrate(bitrate_kbps)
+        if hasattr(self.encoder, "set_bitrate"):
+            # encoders that own their rate control (libvpx CBR) take the
+            # target directly, like the reference poking `target-bitrate`
+            self.encoder.set_bitrate(int(bitrate_kbps))
         if not cc:
             self.video_bitrate_kbps = int(bitrate_kbps)
 
@@ -190,6 +194,11 @@ class TPUWebRTCApp:
 
     def send_encoder(self, encoder: str) -> None:
         self._send("system", {"action": f"encoder,{encoder}"})
+
+    def send_codec(self) -> None:
+        """Tell the client which bitstream the media plane carries so it
+        can configure its WebCodecs decoder (h264 / vp9 / vp8)."""
+        self._send("codec", {"codec": getattr(self.encoder, "codec", "h264")})
 
     def send_resize_enabled(self, resize_enabled: bool) -> None:
         self._send("system", {"action": f"resize,{resize_enabled}"})

@@ -132,8 +132,9 @@ class GccController:
         max_kbps: int = 20000,
         on_estimate: Callable[[int], None] | None = None,
     ) -> None:
-        self.min_kbps = min_kbps
         self.max_kbps = max_kbps
+        self.min_kbps = min(min_kbps, max_kbps)
+        self._floor = self.min_kbps  # audio-headroom floor; survives retargets
         self.estimate_kbps = float(start_kbps)
         self.on_estimate = on_estimate or (lambda kbps: None)
         self._trend = TrendlineEstimator()
@@ -157,9 +158,10 @@ class GccController:
     def set_target(self, kbps: int) -> None:
         """User-chosen bitrate (UI 'vb' message): retarget the cap and
         restart the probe from it — GCC will cut back within a few frames
-        if the link can't actually carry it."""
+        if the link can't actually carry it. The audio-headroom floor set
+        at construction is preserved whenever the cap allows it."""
         self.max_kbps = int(kbps)
-        self.min_kbps = min(self.min_kbps, max(100, int(kbps) // 10))
+        self.min_kbps = min(self._floor, self.max_kbps)
         self.estimate_kbps = float(kbps)
         self._last_reported = float(kbps)
 

@@ -104,9 +104,12 @@ class WebSocketTransport:
         # are what let the trendline see the queue growing (congestion would
         # otherwise inflate Δsend to match Δrecv and hide itself)
         send_ms = time.monotonic() * 1000.0
-        sent = await self._send_binary(pack_media_frame(KIND_VIDEO, flags, ef.timestamp_90k, ef.au, seq))
-        if sent:
-            self.on_video_sent(seq, send_ms, len(ef.au) + HEADER.size)
+        # register with the estimator BEFORE the await: under backpressure
+        # the client's ack can arrive while send_bytes is still draining,
+        # and an ack for an unregistered seq would be dropped. A frame that
+        # fails to send leaves a stale entry, which simply ages out.
+        self.on_video_sent(seq, send_ms, len(ef.au) + HEADER.size)
+        await self._send_binary(pack_media_frame(KIND_VIDEO, flags, ef.timestamp_90k, ef.au, seq))
 
     async def send_audio(self, ea) -> None:
         """EncodedAudio (audio/pipeline.py) → binary WS message."""

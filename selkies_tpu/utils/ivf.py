@@ -1,0 +1,22 @@
+"""IVF container writer (VP8/VP9 elementary frames → decodable file).
+
+The WS media plane ships raw codec frames; IVF is the standard thin
+container for offline tooling and conformance tests (FFmpeg decodes it
+directly).
+"""
+
+from __future__ import annotations
+
+import struct
+
+_FOURCC = {"vp8": b"VP80", "vp9": b"VP90", "av1": b"AV01"}
+
+
+def ivf_file(frames: list[bytes], codec: str, width: int, height: int, fps: int) -> bytes:
+    fourcc = _FOURCC[codec]
+    out = struct.pack(
+        "<4sHH4sHHIIII", b"DKIF", 0, 32, fourcc, width, height, fps, 1, len(frames), 0
+    )
+    for i, f in enumerate(frames):
+        out += struct.pack("<IQ", len(f), i) + f
+    return out

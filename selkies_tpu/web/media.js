@@ -11,6 +11,12 @@
 
 const KIND_VIDEO = 1, KIND_AUDIO = 2, FLAG_KEYFRAME = 1;
 
+const CODEC_STRINGS = {
+  h264: "avc1.42E01F",         // constrained baseline (matches the SPS)
+  vp9: "vp09.00.10.08",        // profile 0, level 1.0, 8-bit
+  vp8: "vp8",
+};
+
 class SelkiesMedia {
   constructor(canvas, onMessage, onStats) {
     this.canvas = canvas;
@@ -18,6 +24,7 @@ class SelkiesMedia {
     this.onMessage = onMessage;   // (obj) => void  — data channel JSON
     this.onStats = onStats || (() => {});
     this.ws = null;
+    this.codec = "h264";
     this.videoDecoder = null;
     this.audioCtx = null;
     this.audioDecoder = null;
@@ -38,7 +45,11 @@ class SelkiesMedia {
     };
     this.ws.onmessage = (ev) => {
       if (typeof ev.data === "string") {
-        try { this.onMessage(JSON.parse(ev.data)); } catch (e) { console.warn(e); }
+        try {
+          const obj = JSON.parse(ev.data);
+          if (obj.type === "codec") this._setCodec(obj.data.codec);
+          else this.onMessage(obj);
+        } catch (e) { console.warn(e); }
       } else {
         this._media(ev.data);
       }
@@ -62,6 +73,16 @@ class SelkiesMedia {
     } else if (kind === KIND_AUDIO) this._audio(payload, ts);
   }
 
+  _setCodec(codec) {
+    if (!(codec in CODEC_STRINGS)) { console.warn("unknown codec", codec); return; }
+    if (codec !== this.codec && this.videoDecoder) {
+      try { this.videoDecoder.close(); } catch (e) { /* already closed */ }
+      this.videoDecoder = null;
+      this.framesDecoded = 0;
+    }
+    this.codec = codec;
+  }
+
   _ensureVideoDecoder() {
     if (this.videoDecoder && this.videoDecoder.state !== "closed") return true;
     if (typeof VideoDecoder === "undefined") return false;
@@ -69,8 +90,8 @@ class SelkiesMedia {
       output: (frame) => this._paint(frame),
       error: (e) => { console.error("video decode", e); this.videoDecoder = null; },
     });
-    // Annex-B stream: no description; keyframes carry SPS/PPS in-band
-    this.videoDecoder.configure({ codec: "avc1.42E01F", optimizeForLatency: true });
+    // Annex-B / raw VP9 frames: no description; keyframes are in-band
+    this.videoDecoder.configure({ codec: CODEC_STRINGS[this.codec], optimizeForLatency: true });
     return true;
   }
 

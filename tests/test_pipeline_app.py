@@ -32,8 +32,9 @@ def test_registry_aliases():
     with pytest.raises(ValueError):
         create_encoder("bogus", width=64, height=64)
     with pytest.raises(NotImplementedError):
-        create_encoder("vp9enc", width=64, height=64)
+        create_encoder("tpuav1enc", width=64, height=64)
     assert "tpuh264enc" in supported_encoders()
+    assert "vp9enc" in supported_encoders()
 
 
 def test_app_pipeline_streams_frames():
