@@ -176,7 +176,14 @@ class TPUH264Encoder:
                 idr_pic_id=self._idr_pic_id,
             )
         else:
-            out = self._step_p(frame, np.int32(self.qp), *self._ref)
+            try:
+                out = self._step_p(frame, np.int32(self.qp), *self._ref)
+            except Exception:
+                # _step_p donated the reference planes; a device error mid-step
+                # leaves them deleted. Drop the ref so the next frame
+                # self-heals as an IDR instead of failing forever.
+                self._ref = None
+                raise
             # reassign the reference IMMEDIATELY: _step_p donated the old
             # buffers, so a packing exception below must not leave self._ref
             # pointing at deleted arrays (every later frame would fail).

@@ -96,7 +96,8 @@ class _CxPkt(ctypes.Structure):
         ("sz", ctypes.c_size_t),
         ("pts", ctypes.c_int64),
         ("duration", ctypes.c_ulong),
-        ("flags", ctypes.c_int64),
+        ("flags", ctypes.c_uint32),  # vpx_codec_frame_flags_t is uint32
+        ("partition_id", ctypes.c_int32),
     ]
 
 

@@ -118,7 +118,7 @@ def _loss_counters(stats_json: str) -> tuple[float, float] | None:
     for report in reports:
         if not isinstance(report, dict):
             continue
-        if report.get("type") not in ("inbound-rtp", None):
+        if report.get("type") != "inbound-rtp":
             continue
         lost, received = report.get("packetsLost"), report.get("packetsReceived")
         if lost is None or received is None:
@@ -138,11 +138,18 @@ class Orchestrator:
             using_webrtc_csv=bool(cfg.enable_webrtc_statistics),
         )
         self.transport = WebSocketTransport()
+        # ximagesrc parity: capture the real X root window when a DISPLAY is
+        # reachable; otherwise the synthetic test source (headless rigs).
+        from selkies_tpu.pipeline.capture import make_frame_source
+
+        source = make_frame_source(int(cfg.capture_width), int(cfg.capture_height))
         self.app = TPUWebRTCApp(
             transport=self.transport,
+            source=source,
             encoder=cfg.encoder,
-            width=int(cfg.capture_width),
-            height=int(cfg.capture_height),
+            # the live X geometry wins over the configured capture size
+            width=source.width,
+            height=source.height,
             framerate=int(cfg.framerate),
             video_bitrate_kbps=int(cfg.video_bitrate),
             congestion_control=bool(cfg.congestion_control),

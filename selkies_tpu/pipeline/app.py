@@ -96,6 +96,7 @@ class TPUWebRTCApp:
             sink=self._video_sink,
             fps=self.framerate,
         )
+        self.pipeline.on_geometry_change = self._rebuild_encoder
         await self.pipeline.start()
 
     async def stop_pipeline(self) -> None:
@@ -103,6 +104,16 @@ class TPUWebRTCApp:
             await self.pipeline.stop()
             self.pipeline = None
             logger.info("pipeline stopped")
+
+    def _rebuild_encoder(self, width: int, height: int):
+        """Display geometry changed (xrandr resize): new encoder + SPS/PPS
+        at the new size (the reference tears down and rebuilds the whole
+        GStreamer pipeline for this; our encoder is the only sized stage)."""
+        logger.info("rebuilding %s for %dx%d", self.encoder_name, width, height)
+        self.encoder = create_encoder(
+            self.encoder_name, width=width, height=height, fps=self.framerate
+        )
+        return self.encoder
 
     async def _video_sink(self, ef: EncodedFrame) -> None:
         self.on_frame(ef)

@@ -86,6 +86,9 @@ class VideoPipeline:
         self.rc = rate_controller
         self.sink = sink
         self.fps = fps
+        # called with (width, height) when source geometry changes; returns
+        # a fresh encoder for the new size (wired by TPUWebRTCApp)
+        self.on_geometry_change: Callable[[int, int], object] | None = None
         self._task: asyncio.Task | None = None
         self._sender: asyncio.Task | None = None
         self._latest: EncodedFrame | None = None
@@ -136,6 +139,17 @@ class VideoPipeline:
 
             try:
                 frame = await asyncio.to_thread(self.source.capture)
+                if frame.shape[:2] != (self.encoder.height, self.encoder.width):
+                    # xrandr resize landed (capture.py re-arms its SHM at the
+                    # new geometry): rebuild the encoder for the new size —
+                    # the reference restarts the whole pipeline on resize.
+                    if self.on_geometry_change is None:
+                        logger.warning(
+                            "frame %dx%d != encoder %dx%d and no resize handler; dropping",
+                            frame.shape[1], frame.shape[0], self.encoder.width, self.encoder.height,
+                        )
+                        continue
+                    self.encoder = self.on_geometry_change(frame.shape[1], frame.shape[0])
                 qp = self.rc.frame_qp()
                 au = await asyncio.to_thread(self.encoder.encode_frame, frame, qp)
                 stats = self.encoder.last_stats

@@ -170,7 +170,10 @@ class GccController:
     def on_frame_sent(self, seq: int, send_ms: float, size: int) -> None:
         self._sent[seq] = _Sent(send_ms, size)
         if len(self._sent) > 4096:  # acks lost / client gone: bound memory
-            for k in sorted(self._sent)[: len(self._sent) - 2048]:
+            # evict by send time, not seq: seq is a 16-bit wrapping counter,
+            # so numeric order would evict the newest entries after wrap
+            stale = sorted(self._sent, key=lambda k: self._sent[k].send_ms)
+            for k in stale[: len(self._sent) - 2048]:
                 del self._sent[k]
 
     # -- feedback ------------------------------------------------------

@@ -13,7 +13,7 @@ const KIND_VIDEO = 1, KIND_AUDIO = 2, FLAG_KEYFRAME = 1;
 
 const CODEC_STRINGS = {
   h264: "avc1.42E01F",         // constrained baseline (matches the SPS)
-  vp9: "vp09.00.10.08",        // profile 0, level 1.0, 8-bit
+  vp9: "vp09.00.41.08",        // profile 0, level 4.1 (covers 1080p60), 8-bit
   vp8: "vp8",
 };
 

@@ -1,0 +1,94 @@
+#!/usr/bin/env python3
+"""Does the axon relay pipeline work? Measures whether jit dispatches,
+device_puts, and d2h fetches overlap or serialize — decides between a
+pipelined frame design vs frame-batched dispatch."""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    f = jax.jit(lambda v: (v * 2 + 1).sum())
+    x = jax.device_put(np.zeros((1024, 1024), np.float32), dev)
+    jax.block_until_ready(f(x))
+
+    # 1. sequential sync dispatches
+    t0 = time.perf_counter()
+    for _ in range(10):
+        jax.block_until_ready(f(x))
+    seq = time.perf_counter() - t0
+    print(f"10 sync dispatches: {seq*1e3:.0f} ms ({seq/10*1e3:.0f} ms each)")
+
+    # 2. async dispatch chain, one sync at the end
+    t0 = time.perf_counter()
+    ys = [f(x) for _ in range(10)]
+    jax.block_until_ready(ys)
+    asy = time.perf_counter() - t0
+    print(f"10 async dispatches + 1 sync: {asy*1e3:.0f} ms")
+
+    # 3. device_put overlap: sequential-sync vs batch-sync
+    bufs = [np.random.default_rng(i).integers(0, 255, (1 << 21,), np.uint8) for i in range(4)]
+    t0 = time.perf_counter()
+    for b in bufs:
+        jax.block_until_ready(jax.device_put(b, dev))
+    put_seq = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    xs = [jax.device_put(b, dev) for b in bufs]
+    jax.block_until_ready(xs)
+    put_asy = time.perf_counter() - t0
+    print(f"4x2MB device_put sync-each: {put_seq*1e3:.0f} ms, async-all: {put_asy*1e3:.0f} ms")
+
+    # 4. d2h: one 4MB vs 8 x 512KB
+    g = jax.jit(lambda v: v + 1)
+    big = jax.block_until_ready(g(jax.device_put(np.zeros(1 << 22, np.uint8), dev)))
+    smalls = [
+        jax.block_until_ready(g(jax.device_put(np.zeros(1 << 19, np.uint8), dev)))
+        for _ in range(8)
+    ]
+    t0 = time.perf_counter()
+    np.asarray(big)
+    one = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for s in smalls:
+        np.asarray(s)
+    many = time.perf_counter() - t0
+    print(f"d2h 1x4MB: {one*1e3:.0f} ms, 8x512KB: {many*1e3:.0f} ms")
+
+    # 5. does compute overlap with h2d? dispatch compute on resident x, then
+    # device_put while it runs
+    slow = jax.jit(lambda v: jnp.sin(jnp.cos(jnp.sin(v @ v))).sum())
+    m = jax.device_put(np.random.default_rng(0).random((4096, 4096), np.float32), dev)
+    jax.block_until_ready(slow(m))
+    t0 = time.perf_counter()
+    r = slow(m)
+    jax.block_until_ready(r)
+    compute_t = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    r = slow(m)
+    h = jax.device_put(bufs[0], dev)
+    jax.block_until_ready([r, h])
+    both = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    jax.block_until_ready(jax.device_put(bufs[0], dev))
+    put_t = time.perf_counter() - t0
+    print(f"compute {compute_t*1e3:.0f} ms, 2MB put {put_t*1e3:.0f} ms, overlapped {both*1e3:.0f} ms")
+
+    # 6. scan-batched dispatch: does one dispatch of 10x work cost ~1 RPC?
+    h10 = jax.jit(lambda v: jax.lax.scan(lambda c, _: (c * 2 + 1, c.sum()), v, None, length=10))
+    jax.block_until_ready(h10(x))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        jax.block_until_ready(h10(x))
+    print(f"batched scan(10) dispatch: {(time.perf_counter()-t0)/5*1e3:.0f} ms per call")
+
+
+if __name__ == "__main__":
+    main()
