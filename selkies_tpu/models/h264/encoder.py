@@ -62,11 +62,12 @@ def _device_step(frame, qp, *, pad_h: int, pad_w: int, channels: int):
     return _narrow(encode_frame_planes(y, u, v, qp))
 
 
-def _device_step_p(frame, qp, ref_y, ref_u, ref_v, *, pad_h: int, pad_w: int, channels: int, search: int):
-    """P-frame device path: convert, motion-search against the previous
-    reconstruction (which never leaves the device), encode inter residuals."""
+def _device_step_p(frame, qp, ref_y, ref_u, ref_v, *, pad_h: int, pad_w: int, channels: int):
+    """P-frame device path: convert, hierarchical motion search (±32)
+    against the previous reconstruction (which never leaves the device),
+    encode inter residuals."""
     y, u, v = _convert_pad(frame, pad_h=pad_h, pad_w=pad_w, channels=channels)
-    return _narrow(encode_frame_p_planes(y, u, v, ref_y, ref_u, ref_v, qp, search=search))
+    return _narrow(encode_frame_p_planes(y, u, v, ref_y, ref_u, ref_v, qp))
 
 
 FrameStats = _FrameStats  # shared definition (models/stats.py)
@@ -95,7 +96,6 @@ class TPUH264Encoder:
         fps: int = 60,
         channels: int = 4,
         keyframe_interval: int = 0,
-        search: int = 8,
     ):
         self.width = width
         self.height = height
@@ -115,7 +115,7 @@ class TPUH264Encoder:
         self._step_p = jax.jit(
             lambda frame, qp, ry, ru, rv: _device_step_p(
                 frame, qp, ry, ru, rv,
-                pad_h=self._pad_h, pad_w=self._pad_w, channels=channels, search=search,
+                pad_h=self._pad_h, pad_w=self._pad_w, channels=channels,
             ),
             donate_argnums=(2, 3, 4),
         )
@@ -245,7 +245,7 @@ def make_frame_step(width: int, height: int, qp: int = 28):
 
     def fn(frame, qp_arr, ry, ru, rv):
         return _device_step_p(
-            frame, qp_arr, ry, ru, rv, pad_h=pad_h, pad_w=pad_w, channels=4, search=8
+            frame, qp_arr, ry, ru, rv, pad_h=pad_h, pad_w=pad_w, channels=4
         )
 
     rng = np.random.default_rng(0)

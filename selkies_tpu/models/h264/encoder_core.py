@@ -319,7 +319,13 @@ def encode_frame_planes(y, u, v, qp):
 # path — a remote-desktop stream is one IDR then P frames forever
 # (reference: keyframe_distance=-1 default, __main__.py:473-475).
 
-MV_PAD = 16  # must match numpy_ref.MV_PAD
+# single source of truth for the ME geometry (the golden model owns it)
+from selkies_tpu.models.h264.numpy_ref import COARSE_DS, COARSE_R, MV_PAD, REFINE_R
+
+# JAX clamps out-of-bounds gathers silently (no IndexError like numpy), so
+# a reach that outgrows the pad would corrupt bitstreams without erroring.
+assert COARSE_DS * COARSE_R + REFINE_R <= MV_PAD, "ME reach exceeds MV_PAD"
+
 _ME_CHUNK = 17
 
 
@@ -390,6 +396,96 @@ def motion_search(cur, ref_pad, search: int = 8):
     return best_mv
 
 
+def _downsample4(plane):
+    """4x4 box downsample, round-half-up (mirrors numpy_ref.downsample4)."""
+    h, w = plane.shape
+    s = plane.astype(jnp.int32).reshape(h // 4, 4, w // 4, 4).sum(axis=(1, 3))
+    return jnp.right_shift(s + 8, 4)
+
+
+def _gather_sad(cur, ref_pad, mvs):
+    """Per-MB SAD of the motion-compensated prediction at per-MB MVs."""
+    h, w = cur.shape
+    mbh, mbw = h // 16, w // 16
+    mvx = jnp.repeat(jnp.repeat(mvs[..., 0], 16, 0), 16, 1)
+    mvy = jnp.repeat(jnp.repeat(mvs[..., 1], 16, 0), 16, 1)
+    iy = jnp.arange(h)[:, None] + mvy + MV_PAD
+    ix = jnp.arange(w)[None, :] + mvx + MV_PAD
+    pred = ref_pad[iy, ix].astype(jnp.int32)
+    return jnp.abs(cur - pred).reshape(mbh, 16, mbw, 16).sum(axis=(1, 3))
+
+
+def hier_motion_search(cur, ref, ref_pad):
+    """Two-level hierarchical ME (device mirror of numpy_ref.hier_search_me).
+
+    cur: (H, W) int32 luma; ref: (H, W) uint8 (unpadded recon);
+    ref_pad: the MV_PAD edge-padded ref (shared with MC). Returns
+    (mbh, mbw, 2) int32 full-pel MVs, element-exact vs the golden model.
+    Cost at 1080p ≈ 289 shifts on 1/16 pixels + 82 gather-SADs — ~6x less
+    arithmetic than a flat ±8 search while covering ±32 (the flat search
+    whiffed on fast scrolls >8 px/frame, leaving full-frame residuals).
+    """
+    h, w = cur.shape
+    mbh, mbw = h // 16, w // 16
+    yd = _downsample4(cur)
+    rd = _downsample4(ref.astype(jnp.int32))
+    hd, wd = yd.shape
+
+    # -- coarse: chunked global-shift scan on the downsampled planes --
+    cands, ranks = _me_candidates(COARSE_R)
+    scale = 1 << int(ranks.max()).bit_length()
+    cand_chunks = jnp.asarray(cands.reshape(-1, _ME_CHUNK, 2))
+    rank_chunks = jnp.asarray(ranks.reshape(-1, _ME_CHUNK))
+    rp = jnp.pad(rd, COARSE_R, mode="edge")
+
+    def sad_one(dxdy):
+        sh = jax.lax.dynamic_slice(rp, (COARSE_R + dxdy[1], COARSE_R + dxdy[0]), (hd, wd))
+        return jnp.abs(yd - sh).reshape(mbh, 4, mbw, 4).sum(axis=(1, 3))
+
+    def step(carry, xs):
+        best_cost, best_mv = carry
+        cand, rank = xs
+        sads = jax.vmap(sad_one)(cand)
+        cost = sads * scale + rank[:, None, None]
+        i = jnp.argmin(cost, axis=0)
+        c = jnp.take_along_axis(cost, i[None], 0)[0]
+        mv = cand[i]
+        better = c < best_cost
+        return (
+            jnp.where(better, c, best_cost),
+            jnp.where(better[..., None], mv, best_mv),
+        ), None
+
+    init = (
+        jnp.full((mbh, mbw), jnp.iinfo(jnp.int32).max, jnp.int32),
+        jnp.zeros((mbh, mbw, 2), jnp.int32),
+    )
+    (_, base), _ = jax.lax.scan(step, init, (cand_chunks, rank_chunks))
+    base = base * COARSE_DS
+
+    # -- refine: zero MV first (rank 0), then raster around the base --
+    zero = jnp.zeros((mbh, mbw, 2), jnp.int32)
+    best_sad = _gather_sad(cur, ref_pad, zero)
+    best_mv = zero
+    offs = np.array(
+        [(dx, dy) for dy in range(-REFINE_R, REFINE_R + 1) for dx in range(-REFINE_R, REFINE_R + 1)],
+        np.int32,
+    )
+
+    def refine_step(carry, d):
+        best_sad, best_mv = carry
+        mvs = base + d
+        sad = _gather_sad(cur, ref_pad, mvs)
+        better = sad < best_sad
+        return (
+            jnp.where(better, sad, best_sad),
+            jnp.where(better[..., None], mvs, best_mv),
+        ), None
+
+    (_, best_mv), _ = jax.lax.scan(refine_step, (best_sad, best_mv), jnp.asarray(offs))
+    return best_mv
+
+
 def mc_luma(ref_pad, mvs):
     """Full-pel luma MC: gather the per-MB-shifted reference plane."""
     mbh, mbw = mvs.shape[:2]
@@ -457,11 +553,16 @@ def _skip_mask(mvs, resid_zero):
     return resid_zero & (mvs == skipmv).all(-1)
 
 
-def encode_frame_p_planes(y, u, v, ref_y, ref_u, ref_v, qp, search: int = 8):
+def encode_frame_p_planes(y, u, v, ref_y, ref_u, ref_v, qp, search: int = 8, me: str = "hier"):
     """Jitted P-frame encode on padded planes against the previous recon.
 
+    me="hier" (default): two-level hierarchical search covering ±32 —
+    `search` is ignored on this path; me="full": flat exhaustive ±search
+    (the original golden contract). `me`/`search` are Python-level config,
+    not traceable values: close over them (functools.partial) when jitting
+    with a non-default choice.
     Returns mvs/skip/coefficients (PFrameCoeffs layout) + recon planes.
-    One batched program, no scans except the ME candidate loop.
+    One batched program, no scans except the ME candidate loops.
     """
     y = y.astype(jnp.int32)
     u = u.astype(jnp.int32)
@@ -473,7 +574,10 @@ def encode_frame_p_planes(y, u, v, ref_y, ref_u, ref_v, qp, search: int = 8):
     ru = jnp.pad(ref_u, MV_PAD, mode="edge")
     rv = jnp.pad(ref_v, MV_PAD, mode="edge")
 
-    mvs = motion_search(y, ry, search)
+    if me == "hier":
+        mvs = hier_motion_search(y, ref_y, ry)
+    else:
+        mvs = motion_search(y, ry, search)
     pred_y = mc_luma(ry, mvs)
     pred_u = mc_chroma(ru, mvs)
     pred_v = mc_chroma(rv, mvs)

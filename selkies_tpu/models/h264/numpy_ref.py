@@ -275,8 +275,14 @@ def pad_planes(y: np.ndarray, u: np.ndarray, v: np.ndarray):
 # is a P_Skip carpet over unchanged screen regions.
 
 # Max motion-vector magnitude (full-pel); reference planes are edge-padded
-# by this much so unrestricted MVs never index out of bounds.
-MV_PAD = 16
+# by this much so unrestricted MVs never index out of bounds. Sized for the
+# hierarchical search: COARSE_DS*COARSE_R + REFINE_R = 36, rounded up.
+MV_PAD = 40
+
+# Hierarchical ME geometry (hier_search_me / encoder_core.hier_motion_search)
+COARSE_DS = 4   # coarse level downsample factor
+COARSE_R = 8    # coarse search radius in downsampled pels (→ ±32 full-pel)
+REFINE_R = 4    # full-res refine radius around the upscaled coarse best
 
 
 @dataclass
@@ -436,6 +442,78 @@ def full_search_me(
         better = sad < best_sad
         best_sad = np.where(better, sad, best_sad)
         best_mv[better] = (dx, dy)
+    return best_mv
+
+
+def downsample4(plane: np.ndarray) -> np.ndarray:
+    """4x4 box downsample with round-half-up: ds[i,j] = (Σ 4x4 block + 8)>>4.
+
+    Exact integer arithmetic (the device mirror must match bit-for-bit —
+    the coarse ME level runs on these planes)."""
+    h, w = plane.shape
+    return (
+        plane.astype(np.int64).reshape(h // 4, 4, w // 4, 4).sum(axis=(1, 3)) + 8
+    ) >> 4
+
+
+def hier_search_me(y: np.ndarray, ref_y: np.ndarray) -> np.ndarray:
+    """Two-level hierarchical full-pel ME (golden model).
+
+    Level 1: exhaustive ±COARSE_R search on 4x-downsampled planes (each MB
+    is a 4x4 coarse block), zero-first raster tie-break — covers ±32
+    full-pel for the cost of a ±8 search at 1/16 the pixels.
+    Level 0: ±REFINE_R full-res refine around the upscaled coarse winner,
+    with the zero MV always evaluated first (rank 0) so static content
+    stays skip-eligible no matter what the coarse level hallucinated.
+
+    Deterministic total order: zero MV, then refine candidates in raster
+    (dy outer) order; ties resolve to the earlier rank. The device mirror
+    (encoder_core.hier_motion_search) must match element-exactly.
+    """
+    h, w = y.shape
+    mbh, mbw = h // 16, w // 16
+    yd = downsample4(y)
+    rd = downsample4(ref_y)
+
+    # -- coarse level: global-shift SAD over 4x4 coarse blocks --
+    pad = COARSE_R
+    rp = np.pad(rd, pad, mode="edge")
+    best_sad = np.full((mbh, mbw), np.iinfo(np.int64).max)
+    base = np.zeros((mbh, mbw, 2), np.int32)
+    cand = sorted(
+        ((dx, dy) for dy in range(-COARSE_R, COARSE_R + 1) for dx in range(-COARSE_R, COARSE_R + 1)),
+        key=lambda c: (c != (0, 0)),
+    )
+    hd, wd = yd.shape
+    for dx, dy in cand:
+        shifted = rp[pad + dy : pad + dy + hd, pad + dx : pad + dx + wd]
+        sad = np.abs(yd - shifted).reshape(mbh, 4, mbw, 4).sum(axis=(1, 3))
+        better = sad < best_sad
+        best_sad = np.where(better, sad, best_sad)
+        base[better] = (dx, dy)
+    base = base * COARSE_DS  # full-pel units
+
+    # -- full-res refine: zero MV first, then raster around the base --
+    ref_pad = pad_ref(ref_y)
+    cur = y.astype(np.int64)
+
+    def gather_sad(mvs):
+        mvx = np.repeat(np.repeat(mvs[..., 0], 16, 0), 16, 1)
+        mvy = np.repeat(np.repeat(mvs[..., 1], 16, 0), 16, 1)
+        iy = np.arange(h)[:, None] + mvy + MV_PAD
+        ix = np.arange(w)[None, :] + mvx + MV_PAD
+        pred = ref_pad[iy, ix].astype(np.int64)
+        return np.abs(cur - pred).reshape(mbh, 16, mbw, 16).sum(axis=(1, 3))
+
+    best_sad = gather_sad(np.zeros((mbh, mbw, 2), np.int32))
+    best_mv = np.zeros((mbh, mbw, 2), np.int32)
+    for dy in range(-REFINE_R, REFINE_R + 1):
+        for dx in range(-REFINE_R, REFINE_R + 1):
+            mvs = base + np.array([dx, dy], np.int32)
+            sad = gather_sad(mvs)
+            better = sad < best_sad
+            best_sad = np.where(better, sad, best_sad)
+            best_mv = np.where(better[..., None], mvs, best_mv)
     return best_mv
 
 

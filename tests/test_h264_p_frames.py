@@ -43,8 +43,10 @@ def _to_bgr(ry, ru, rv):
     return np.stack([b, g, r], -1)
 
 
-def _encode_ip(frames, qp, search=8, mvs_override=None):
+def _encode_ip(frames, qp, search=8, mvs_override=None, use_hier=False):
     """frames: list of (y, u, v). Returns (bytes, [recon (y,u,v)], [PFrameCoeffs])."""
+    from selkies_tpu.models.h264.numpy_ref import hier_search_me
+
     y0 = frames[0][0]
     p = StreamParams(width=y0.shape[1], height=y0.shape[0], qp=qp)
     enc0 = encode_frame_i16(*frames[0], qp)
@@ -55,6 +57,8 @@ def _encode_ip(frames, qp, search=8, mvs_override=None):
         ry, ru, rv = recons[-1]
         if mvs_override is not None:
             mvs = mvs_override[i]
+        elif use_hier:
+            mvs = hier_search_me(y, ry)
         else:
             mvs = full_search_me(y, ry, search)
         pe = encode_frame_p(y, u, v, ry, ru, rv, mvs, qp)
@@ -183,3 +187,30 @@ def test_skip_mv_derivation_rules():
     # both neighbours nonzero -> falls through to median prediction
     mvs[:, :] = (4, 2)
     assert skip_mv_16x16(mvs, 1, 1) == (4, 2)
+
+
+def test_fast_scroll_hier_me_roundtrip(tmp_path):
+    """24 px/frame scroll (beyond the old ±8 flat search): hier ME must
+    recover the shift, code large mvds correctly, and the stream must
+    decode — the VERDICT r1 fast-scroll failure mode."""
+    from selkies_tpu.models.h264.numpy_ref import hier_search_me
+
+    rng = np.random.default_rng(41)
+    h, w = 96, 128
+    big_y = np.kron(rng.integers(16, 235, ((h + 128) // 4, (w + 128) // 4)), np.ones((4, 4))).astype(np.uint8)
+    big_u = rng.integers(64, 192, ((h + 128) // 2, (w + 128) // 2)).astype(np.uint8)
+    big_v = rng.integers(64, 192, ((h + 128) // 2, (w + 128) // 2)).astype(np.uint8)
+
+    def crop(dx):
+        return (
+            big_y[64 : 64 + h, 64 + dx : 64 + dx + w],
+            big_u[32 : 32 + h // 2, 32 + dx // 2 : 32 + dx // 2 + w // 2],
+            big_v[32 : 32 + h // 2, 32 + dx // 2 : 32 + dx // 2 + w // 2],
+        )
+
+    frames = [crop(0), crop(24), crop(48)]
+    enc0 = encode_frame_i16(*frames[0], qp=22)
+    mvs1 = hier_search_me(frames[1][0], enc0.recon_y)
+    # interior MBs must see the 24px shift (mvd coding beyond ±8)
+    assert (np.abs(mvs1[..., 0]) > 8).any()
+    _roundtrip(tmp_path, frames, qp=22, use_hier=True)

@@ -1,0 +1,71 @@
+#!/usr/bin/env python3
+"""Per-op device timings for the P-frame step at 1080p on the real chip.
+Forces completion via a scalar reduce fetch; reports differential times."""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from selkies_tpu.models.h264.encoder_core import (
+        MV_PAD, fdct4, idct4, quant4, dequant4, mc_chroma, mc_luma,
+        motion_search, encode_frame_p_planes, encode_frame_planes,
+        _plane_to_mb_blocks, _mb_blocks_to_plane,
+    )
+
+    H, W = 1088, 1920
+    rng = np.random.default_rng(0)
+    y = jnp.asarray(rng.integers(0, 256, (H, W), np.uint8).astype(np.int32))
+    ry8 = jnp.asarray(rng.integers(0, 256, (H, W), np.uint8))
+    ry = jnp.pad(ry8, MV_PAD, mode="edge")
+    ru = jnp.pad(jnp.asarray(rng.integers(0, 256, (H // 2, W // 2), np.uint8)), MV_PAD, mode="edge")
+    mvs0 = jnp.asarray(rng.integers(-8, 9, (H // 16, W // 16, 2), np.int32))
+
+    def bench(name, jitfn, *args, iters=5):
+        out = jitfn(*args)
+        jax.block_until_ready(out)
+        # force a tiny fetch to pin completion semantics
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = jitfn(*args)
+            jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / iters * 1e3
+        print(f"{name:42s} {dt:8.1f} ms")
+        return dt
+
+    bench("motion_search +-8 (289 cand, chunked scan)", jax.jit(motion_search), y, ry)
+    bench("mc_luma (full-plane gather)", jax.jit(mc_luma), ry, mvs0)
+    bench("mc_chroma (bilinear gather)", jax.jit(mc_chroma), ru, mvs0)
+
+    def txq(yy, pred):
+        b = _plane_to_mb_blocks(yy - pred, 4)
+        w = fdct4(b)
+        lv = quant4(w, jnp.int32(28), intra=False)
+        rec = jnp.clip(_mb_blocks_to_plane(idct4(dequant4(lv, jnp.int32(28)))) + pred, 0, 255)
+        return lv, rec
+
+    pred = mc_luma(ry, mvs0)
+    jax.block_until_ready(pred)
+    bench("luma transform+quant+recon", jax.jit(txq), y, pred)
+
+    u = jnp.asarray(rng.integers(0, 256, (H // 2, W // 2), np.uint8).astype(np.int32))
+    v = u + 1
+    rv = ru
+    f32 = jax.jit(lambda a, b, c, d, e, f: encode_frame_p_planes(a, b, c, d, e, f, jnp.int32(28)))
+    bench("full P step (jit, device-resident inputs)", f32, y, u, v, ry8,
+          jnp.asarray(rng.integers(0, 256, (H // 2, W // 2), np.uint8)),
+          jnp.asarray(rng.integers(0, 256, (H // 2, W // 2), np.uint8)))
+
+    fi = jax.jit(lambda a, b, c: encode_frame_planes(a, b, c, jnp.int32(28)))
+    bench("full I step (row-scan intra)", fi, y, u, v)
+
+
+if __name__ == "__main__":
+    main()

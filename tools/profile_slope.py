@@ -1,0 +1,85 @@
+#!/usr/bin/env python3
+"""True device-time via slope: time(k chained steps + 1 scalar fetch) for
+k in {1, 5}; slope = per-step device time, intercept = RPC overhead.
+A scalar d2h fetch is the only reliable sync on the axon relay."""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def slope(name, chain_fn, fetch_fn, ks=(1, 5), reps=3):
+    ts = {}
+    for k in ks:
+        chain_fn(k)  # warm compile
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = chain_fn(k)
+            fetch_fn(out)
+        ts[k] = (time.perf_counter() - t0) / reps
+    k0, k1 = ks
+    per = (ts[k1] - ts[k0]) / (k1 - k0) * 1e3
+    rpc = (ts[k0] - per * k0 / 1e3) * 1e3
+    print(f"{name:38s} per-step {per:7.1f} ms   overhead {rpc:6.0f} ms")
+    return per
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from selkies_tpu.models.h264.encoder_core import (
+        MV_PAD, encode_frame_p_planes, encode_frame_planes, motion_search,
+    )
+
+    H, W = 1088, 1920
+    rng = np.random.default_rng(0)
+    y0 = jnp.asarray(rng.integers(0, 256, (H, W), np.uint8))
+    u0 = jnp.asarray(rng.integers(0, 256, (H // 2, W // 2), np.uint8))
+    v0 = jnp.asarray(rng.integers(0, 256, (H // 2, W // 2), np.uint8))
+
+    # P step chained: recon feeds next step's ref
+    @jax.jit
+    def pchain_body(carry, _):
+        ry, ru, rv = carry
+        out = encode_frame_p_planes(ry.astype(jnp.int32), ru.astype(jnp.int32),
+                                    rv.astype(jnp.int32), ry, ru, rv, jnp.int32(28))
+        return (out["recon_y"], out["recon_u"], out["recon_v"]), out["mvs"].sum()
+
+    def pchain(k):
+        carry = (y0, u0, v0)
+        s = jnp.int32(0)
+        for _ in range(k):
+            carry, t = jax.jit(lambda c: pchain_body(c, None))(carry)
+            s = s + t
+        return s
+
+    slope("P step (full)", pchain, lambda o: int(o))
+
+    ypad = jnp.pad(y0, MV_PAD, mode="edge")
+
+    def mechain(k):
+        s = jnp.int32(0)
+        cur = y0.astype(jnp.int32)
+        for i in range(k):
+            mv = jax.jit(motion_search)(cur + i, ypad)
+            s = s + mv.sum()
+        return s
+
+    slope("motion_search +-8", mechain, lambda o: int(o))
+
+    def ichain(k):
+        s = jnp.int32(0)
+        for i in range(k):
+            out = jax.jit(encode_frame_planes)(y0.astype(jnp.int32) + i, u0.astype(jnp.int32), v0.astype(jnp.int32), jnp.int32(28))
+            s = s + out["luma_ac"].sum()
+        return s
+
+    slope("I step (row scan)", ichain, lambda o: int(o))
+
+
+if __name__ == "__main__":
+    main()
