@@ -1,0 +1,155 @@
+"""Host-side frame preparation (ctypes binding for native/frameprep.cc).
+
+Converts captured BGRx frames to padded I420 planes on the host CPU and
+tracks per-band dirty state vs the previous capture. Rationale: the
+host↔device link (tunnel or PCIe) is the pipeline bottleneck
+(tools/profile_link.py) — uploading I420 is 2.7x less data than BGRx, and
+the dirty-band map feeds the encoder's static-frame fast path today (an
+unchanged capture encodes as an all-skip P slice with zero device work;
+partial-band uploads are the next step). The reference leans on
+ximagesrc's XDamage for the same effect (gstwebrtc_app.py:210-241).
+
+The conversion is bit-exact with the device path (ops/colorspace.py); a
+pure-numpy fallback keeps headless test environments working without the
+shared library.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+
+import numpy as np
+
+logger = logging.getLogger("models.frameprep")
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native"
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libframeprep.so")
+
+_lib = None
+_lib_tried = False
+
+BAND_ROWS = 16  # dirty-detection granularity = one MB row
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    if not os.path.exists(_LIB_PATH) and os.path.exists(os.path.join(_NATIVE_DIR, "Makefile")):
+        try:
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR, "-s", "libframeprep.so"],
+                check=True, capture_output=True, timeout=120,
+            )
+        except (OSError, subprocess.SubprocessError) as exc:
+            logger.warning("could not build libframeprep.so (%s); numpy fallback", exc)
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError as exc:
+        logger.warning("could not load libframeprep.so (%s); numpy fallback", exc)
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.bgrx_to_i420_pad.restype = None
+    lib.bgrx_to_i420_pad.argtypes = [u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                                     ctypes.c_int, u8p, u8p, u8p]
+    lib.band_diff.restype = ctypes.c_int
+    lib.band_diff.argtypes = [u8p, u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, u8p]
+    _lib = lib
+    return lib
+
+
+def _u8p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _numpy_convert_pad(frame: np.ndarray, ph: int, pw: int):
+    """Fallback mirror of bgrx_to_i420_pad (and of ops/colorspace.py)."""
+    f = frame.astype(np.int32)
+    r, g, b = f[..., 2], f[..., 1], f[..., 0]
+    y = np.clip(((66 * r + 129 * g + 25 * b + 128) >> 8) + 16, 16, 235)
+    u = np.clip(((-38 * r - 74 * g + 112 * b + 128) >> 8) + 128, 16, 240)
+    v = np.clip(((112 * r - 94 * g - 18 * b + 128) >> 8) + 128, 16, 240)
+    h, w = y.shape
+
+    def sub(p):
+        return (p.reshape(h // 2, 2, w // 2, 2).sum(axis=(1, 3)) + 2) >> 2
+
+    u, v = sub(u), sub(v)
+
+    def pad(p, th, tw):
+        return np.pad(p, ((0, th - p.shape[0]), (0, tw - p.shape[1])), mode="edge")
+
+    return (
+        pad(y, ph, pw).astype(np.uint8),
+        pad(u, ph // 2, pw // 2).astype(np.uint8),
+        pad(v, ph // 2, pw // 2).astype(np.uint8),
+    )
+
+
+class FramePrep:
+    """Per-stream host prep state: conversion buffers + previous frame."""
+
+    def __init__(self, width: int, height: int, pad_w: int, pad_h: int):
+        if width % 2 or height % 2:
+            raise ValueError(f"frame size {width}x{height} must be even")
+        self.width, self.height = width, height
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self._lib = _load()
+        self.y = np.empty((pad_h, pad_w), np.uint8)
+        self.u = np.empty((pad_h // 2, pad_w // 2), np.uint8)
+        self.v = np.empty((pad_h // 2, pad_w // 2), np.uint8)
+        self._prev: np.ndarray | None = None
+        self.nbands = (height + BAND_ROWS - 1) // BAND_ROWS
+        self._bands = np.empty(self.nbands, np.uint8)
+
+    @property
+    def native(self) -> bool:
+        return self._lib is not None
+
+    def convert(self, frame: np.ndarray):
+        """(H, W, 4) BGRx uint8 -> (y, u, v) padded planes (owned buffers,
+        overwritten on the next call)."""
+        if frame.shape != (self.height, self.width, 4):
+            raise ValueError(f"frame {frame.shape} != {(self.height, self.width, 4)}")
+        if not frame.flags["C_CONTIGUOUS"]:
+            frame = np.ascontiguousarray(frame)
+        if self._lib is not None:
+            self._lib.bgrx_to_i420_pad(
+                _u8p(frame), self.height, self.width, self.pad_h, self.pad_w,
+                _u8p(self.y), _u8p(self.u), _u8p(self.v),
+            )
+        else:
+            self.y, self.u, self.v = _numpy_convert_pad(frame, self.pad_h, self.pad_w)
+        return self.y, self.u, self.v
+
+    def dirty_bands(self, frame: np.ndarray) -> np.ndarray | None:
+        """Which 16-row bands changed vs the previous call's frame.
+
+        Returns a bool array of shape (nbands,), or None on the first frame
+        (everything dirty). Stores a copy of the frame as the new previous."""
+        if not frame.flags["C_CONTIGUOUS"]:
+            frame = np.ascontiguousarray(frame)
+        if self._prev is None:
+            self._prev = frame.copy()
+            return None
+        if self._lib is not None:
+            self._lib.band_diff(
+                _u8p(frame), _u8p(self._prev), self.height, self.width,
+                BAND_ROWS, _u8p(self._bands),
+            )
+            out = self._bands.astype(bool)
+        else:
+            nb = self.nbands
+            out = np.zeros(nb, bool)
+            for i in range(nb):
+                r0, r1 = i * BAND_ROWS, min((i + 1) * BAND_ROWS, self.height)
+                out[i] = not np.array_equal(frame[r0:r1], self._prev[r0:r1])
+        np.copyto(self._prev, frame)
+        return out

@@ -24,11 +24,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from selkies_tpu.models.h264.numpy_ref import PFrameCoeffs
+
+from selkies_tpu.models.frameprep import FramePrep
 from selkies_tpu.models.stats import FrameStats as _FrameStats
 from selkies_tpu.models.h264.bitstream import StreamParams, write_pps, write_sps
-from selkies_tpu.models.h264.encoder_core import encode_frame_p_planes, encode_frame_planes
+from selkies_tpu.models.h264.compact import unpack_i_compact, unpack_p_compact
+from selkies_tpu.models.h264.encoder_core import (
+    encode_frame_p_planes,
+    encode_frame_planes,
+    pack_i_compact,
+    pack_p_compact,
+)
 from selkies_tpu.models.h264.native import pack_slice_fast, pack_slice_p_fast
-from selkies_tpu.models.h264.numpy_ref import FrameCoeffs, PFrameCoeffs
 from selkies_tpu.ops.colorspace import bgrx_to_i420, rgb_to_i420
 
 __all__ = ["TPUH264Encoder", "make_frame_step"]
@@ -48,26 +56,49 @@ def _convert_pad(frame, *, pad_h: int, pad_w: int, channels: int):
     return y, u, v
 
 
-def _narrow(out):
-    """int32 coeff tensors -> int16 (halves the device->host copy)."""
-    return {
-        k: (out[k].astype(jnp.int16) if out[k].dtype == jnp.int32 else out[k])
-        for k in out
-    }
-
-
 def _device_step(frame, qp, *, pad_h: int, pad_w: int, channels: int):
-    """Full IDR device path: packed frame -> padded planes -> coeff tensors."""
+    """Full IDR device path: packed frame -> padded planes -> compacted
+    coefficient downlink (header, nonzero rows) + device-resident recon."""
     y, u, v = _convert_pad(frame, pad_h=pad_h, pad_w=pad_w, channels=channels)
-    return _narrow(encode_frame_planes(y, u, v, qp))
+    return _i_planes_step(y, u, v, qp)
+
+
+def _i_planes_step(y, u, v, qp):
+    out = encode_frame_planes(y, u, v, qp)
+    header, buf = pack_i_compact(out)
+    return header, buf, out["recon_y"], out["recon_u"], out["recon_v"]
 
 
 def _device_step_p(frame, qp, ref_y, ref_u, ref_v, *, pad_h: int, pad_w: int, channels: int):
     """P-frame device path: convert, hierarchical motion search (±32)
     against the previous reconstruction (which never leaves the device),
-    encode inter residuals."""
+    encode inter residuals, compact the downlink."""
     y, u, v = _convert_pad(frame, pad_h=pad_h, pad_w=pad_w, channels=channels)
-    return _narrow(encode_frame_p_planes(y, u, v, ref_y, ref_u, ref_v, qp))
+    return _p_planes_step(y, u, v, qp, ref_y, ref_u, ref_v)
+
+
+def _p_planes_step(y, u, v, qp, ref_y, ref_u, ref_v):
+    out = encode_frame_p_planes(y, u, v, ref_y, ref_u, ref_v, qp)
+    header, buf = pack_p_compact(out)
+    return header, buf, out["recon_y"], out["recon_u"], out["recon_v"]
+
+
+_MIN_FETCH_ROWS = 512
+
+
+def _fetch_prefix(buf, n: int) -> np.ndarray:
+    """Fetch the first n rows of the device data buffer, rounded up to a
+    power-of-two bucket so each resolution compiles a handful of slice
+    executables instead of one per distinct n."""
+    total = buf.shape[0]
+    if n <= 0:
+        return np.zeros((0, 16), np.int16)
+    bucket = _MIN_FETCH_ROWS
+    while bucket < n:
+        bucket <<= 1
+    if bucket >= total:
+        return np.asarray(buf)
+    return np.asarray(buf[:bucket])
 
 
 FrameStats = _FrameStats  # shared definition (models/stats.py)
@@ -96,6 +127,7 @@ class TPUH264Encoder:
         fps: int = 60,
         channels: int = 4,
         keyframe_interval: int = 0,
+        host_convert: bool = True,
     ):
         self.width = width
         self.height = height
@@ -107,19 +139,32 @@ class TPUH264Encoder:
         self._headers = write_sps(self.params) + write_pps(self.params)
         self._pad_h = (height + 15) // 16 * 16
         self._pad_w = (width + 15) // 16 * 16
-        self._step = jax.jit(
-            lambda frame, qp: _device_step(
-                frame, qp, pad_h=self._pad_h, pad_w=self._pad_w, channels=channels
+        # host_convert: BGRx->I420 on the host CPU (native/frameprep.cc) so
+        # the upload is 1.5 B/px instead of 4 — the link is the bottleneck
+        # (tools/profile_link.py). host_convert=False keeps conversion on
+        # device (better when the device is PCIe-local and link-rich).
+        self._prep: FramePrep | None = None
+        if host_convert and channels == 4:
+            self._prep = FramePrep(width, height, self._pad_w, self._pad_h)
+        if self._prep is not None:
+            self._step = jax.jit(_i_planes_step)
+            self._step_p = jax.jit(_p_planes_step, donate_argnums=(4, 5, 6))
+        else:
+            self._step = jax.jit(
+                lambda frame, qp: _device_step(
+                    frame, qp, pad_h=self._pad_h, pad_w=self._pad_w, channels=channels
+                )
             )
-        )
-        self._step_p = jax.jit(
-            lambda frame, qp, ry, ru, rv: _device_step_p(
-                frame, qp, ry, ru, rv,
-                pad_h=self._pad_h, pad_w=self._pad_w, channels=channels,
-            ),
-            donate_argnums=(2, 3, 4),
-        )
+            self._step_p = jax.jit(
+                lambda frame, qp, ry, ru, rv: _device_step_p(
+                    frame, qp, ry, ru, rv,
+                    pad_h=self._pad_h, pad_w=self._pad_w, channels=channels,
+                ),
+                donate_argnums=(2, 3, 4),
+            )
         self._ref = None  # (recon_y, recon_u, recon_v) device arrays
+        self._prev_frame: np.ndarray | None = None  # device-convert mode only
+        self._allskip: PFrameCoeffs | None = None
         self.frame_index = 0
         self._frames_since_idr = 0
         self._idr_pic_id = 0
@@ -136,7 +181,57 @@ class TPUH264Encoder:
     def force_keyframe(self) -> None:
         self._force_idr = True
 
+    # -- static-frame fast path ----------------------------------------
+
+    def _is_static(self, frame: np.ndarray) -> bool:
+        """True when the capture is byte-identical to the previous one —
+        the dominant remote-desktop case; it then costs zero device work.
+
+        Uses FramePrep's band memcmp when host conversion is on (early-exit
+        per 16-row band, collision-free); otherwise a full compare against
+        a kept copy. Either way the previous-frame state advances, which is
+        safe because any encode failure nulls self._ref and forces an IDR,
+        bypassing this path."""
+        if self._prep is not None:
+            bands = self._prep.dirty_bands(frame)
+            return bands is not None and not bands.any()
+        if self._prev_frame is None or self._prev_frame.shape != frame.shape:
+            self._prev_frame = frame.copy()
+            return False
+        if np.array_equal(self._prev_frame, frame):
+            return True
+        np.copyto(self._prev_frame, frame)
+        return False
+
+    def _allskip_slice(self, frame_num: int) -> bytes:
+        """P slice with every MB P_Skip: recon == ref exactly (zero MV,
+        full-pel, no residual), so the device reference stays valid."""
+        if self._allskip is None:
+            mbh, mbw = self._pad_h // 16, self._pad_w // 16
+            self._allskip = PFrameCoeffs(
+                mvs=np.zeros((mbh, mbw, 2), np.int32),
+                skip=np.ones((mbh, mbw), bool),
+                luma_ac=np.zeros((mbh, mbw, 4, 4, 4, 4), np.int32),
+                chroma_dc=np.zeros((mbh, mbw, 2, 2, 2), np.int32),
+                chroma_ac=np.zeros((mbh, mbw, 2, 2, 2, 4, 4), np.int32),
+                qp=self.qp,
+            )
+        self._allskip.qp = self.qp
+        return pack_slice_p_fast(self._allskip, self.params, frame_num=frame_num)
+
     # -- encoding --
+
+    def _run_step_i(self, frame: np.ndarray):
+        if self._prep is not None:
+            y, u, v = self._prep.convert(frame)
+            return self._step(y, u, v, np.int32(self.qp))
+        return self._step(frame, np.int32(self.qp))
+
+    def _run_step_p(self, frame: np.ndarray):
+        if self._prep is not None:
+            y, u, v = self._prep.convert(frame)
+            return self._step_p(y, u, v, np.int32(self.qp), *self._ref)
+        return self._step_p(frame, np.int32(self.qp), *self._ref)
 
     def encode_frame(self, frame: np.ndarray, qp: int | None = None) -> bytes:
         """Encode one packed frame ((H, W, 4) BGRx or (H, W, 3) RGB uint8).
@@ -153,59 +248,69 @@ class TPUH264Encoder:
         )
         t0 = time.perf_counter()
         skipped = 0
-        if idr:
-            out = self._step(frame, np.int32(self.qp))
-            fc = FrameCoeffs(
-                luma_mode=np.asarray(out["luma_mode"]),
-                chroma_mode=np.asarray(out["chroma_mode"]),
-                luma_dc=np.asarray(out["luma_dc"]),
-                luma_ac=np.asarray(out["luma_ac"]),
-                chroma_dc=np.asarray(out["chroma_dc"]),
-                chroma_ac=np.asarray(out["chroma_ac"]),
-                qp=self.qp,
-            )
-            self._frames_since_idr = 0
+        # evaluate on every frame (advances the previous-frame state even
+        # across IDRs) but only short-circuit on P frames
+        if self._is_static(frame) and not idr:
+            # unchanged capture: emit an all-skip P slice host-side — no
+            # upload, no device step, no downlink. The blinking-cursor /
+            # idle-desktop steady state costs microseconds.
             t1 = time.perf_counter()
-            # frame_num counts from the last IDR (7.4.3: gaps are disallowed
-            # by our SPS, so it must be PrevRefFrameNum+1 mod MaxFrameNum).
-            slice_nal = pack_slice_fast(
-                fc,
-                self.params,
-                frame_num=0,
-                idr=True,
-                idr_pic_id=self._idr_pic_id,
-            )
-        else:
-            try:
-                out = self._step_p(frame, np.int32(self.qp), *self._ref)
-            except Exception:
-                # _step_p donated the reference planes; a device error mid-step
-                # leaves them deleted. Drop the ref so the next frame
-                # self-heals as an IDR instead of failing forever.
-                self._ref = None
-                raise
-            # reassign the reference IMMEDIATELY: _step_p donated the old
-            # buffers, so a packing exception below must not leave self._ref
-            # pointing at deleted arrays (every later frame would fail).
-            self._ref = (out["recon_y"], out["recon_u"], out["recon_v"])
-            skip = np.asarray(out["skip"])
-            skipped = int(skip.sum())
-            pfc = PFrameCoeffs(
-                mvs=np.asarray(out["mvs"]),
-                skip=skip,
-                luma_ac=np.asarray(out["luma_ac"]),
-                chroma_dc=np.asarray(out["chroma_dc"]),
-                chroma_ac=np.asarray(out["chroma_ac"]),
+            slice_nal = self._allskip_slice(self._frames_since_idr % 256)
+            t2 = time.perf_counter()
+            mbs = (self._pad_h // 16) * (self._pad_w // 16)
+            self.last_stats = FrameStats(
+                frame_index=self.frame_index,
+                idr=False,
                 qp=self.qp,
+                bytes=len(slice_nal),
+                device_ms=(t1 - t0) * 1e3,
+                pack_ms=(t2 - t1) * 1e3,
+                skipped_mbs=mbs,
             )
-            t1 = time.perf_counter()
-            slice_nal = pack_slice_p_fast(
-                pfc, self.params, frame_num=self._frames_since_idr % 256
-            )
-        if idr:
-            # the reconstruction never leaves the device: it is the P-frame
-            # reference (donated into the next P step)
-            self._ref = (out["recon_y"], out["recon_u"], out["recon_v"])
+            self.frame_index += 1
+            self._frames_since_idr += 1
+            return slice_nal
+        # Any failure between here and a fully built slice nulls self._ref:
+        # the client never receives this frame, so encoding the NEXT frame
+        # against this frame's recon would silently desync the decoder.
+        # A nulled ref forces a clean IDR instead (and bypasses the static
+        # fast path, whose previous-frame state has already advanced).
+        try:
+            if idr:
+                header_d, buf_d, ry, ru, rv = self._run_step_i(frame)
+                # the reconstruction never leaves the device: it is the
+                # P-frame reference (donated into the next P step)
+                self._ref = (ry, ru, rv)
+                header = np.asarray(header_d)
+                data = _fetch_prefix(buf_d, int(header[0]))
+                fc = unpack_i_compact(header, data, self.qp)
+                self._frames_since_idr = 0
+                t1 = time.perf_counter()
+                # frame_num counts from the last IDR (7.4.3: gaps are
+                # disallowed by our SPS, so it must be PrevRefFrameNum+1
+                # mod MaxFrameNum).
+                slice_nal = pack_slice_fast(
+                    fc,
+                    self.params,
+                    frame_num=0,
+                    idr=True,
+                    idr_pic_id=self._idr_pic_id,
+                )
+            else:
+                header_d, buf_d, ry, ru, rv = self._run_step_p(frame)
+                # reassign IMMEDIATELY: _step_p donated the old buffers
+                self._ref = (ry, ru, rv)
+                header = np.asarray(header_d)
+                data = _fetch_prefix(buf_d, int(header[0]))
+                pfc = unpack_p_compact(header, data, self.qp)
+                skipped = int(pfc.skip.sum())
+                t1 = time.perf_counter()
+                slice_nal = pack_slice_p_fast(
+                    pfc, self.params, frame_num=self._frames_since_idr % 256
+                )
+        except Exception:
+            self._ref = None
+            raise
         t2 = time.perf_counter()
         au = (self._headers + slice_nal) if idr else slice_nal
         if idr:
@@ -229,12 +334,8 @@ class TPUH264Encoder:
 
     def recon_planes(self, frame: np.ndarray):
         """Debug helper: (recon_y, recon_u, recon_v) for a frame."""
-        out = self._step(frame, np.int32(self.qp))
-        return (
-            np.asarray(out["recon_y"]),
-            np.asarray(out["recon_u"]),
-            np.asarray(out["recon_v"]),
-        )
+        _, _, ry, ru, rv = self._run_step_i(frame)
+        return (np.asarray(ry), np.asarray(ru), np.asarray(rv))
 
 
 def make_frame_step(width: int, height: int, qp: int = 28):

@@ -620,3 +620,108 @@ def encode_frame_p_planes(y, u, v, ref_y, ref_u, ref_v, qp, search: int = 8, me:
         "recon_u": rec_u.astype(jnp.uint8),
         "recon_v": rec_v.astype(jnp.uint8),
     }
+
+
+# ---------------------------------------------------------------------------
+# Compact downlink
+# ---------------------------------------------------------------------------
+#
+# The coefficient tensors are the device->host traffic (the reference's
+# encoders emit final bitstreams on the GPU; ours entropy-codes on the
+# host). Dense P-frame coeffs at 1080p are ~6.4 MB/frame — far more than
+# the actual information content (desktop P frames are mostly zero blocks).
+# pack_*_compact runs INSIDE the frame jit and emits:
+#   * one int32 header: counts + packed MVs + per-MB nonzero-block bitmap
+#     + skip bitmask (+ intra modes for IDR) — ~65 KB at 1080p, fixed size;
+#   * one int16 data buffer whose first n rows are the nonzero 4x4 blocks
+#     in global scan order — the host fetches only that prefix.
+# The host scatters rows back into dense arrays (models/h264/compact.py)
+# and feeds the unchanged CAVLC packer, so bitstreams are bit-identical to
+# the dense path.
+
+# Row-layout constants — the ONLY definition; compact.py (host unpack)
+# imports these, so pack and unpack cannot drift apart.
+# P frame, per-MB rows: [0:16) luma AC, [16:24) chroma AC, [24:26) chroma DC.
+P_ROW_CHROMA = 16
+P_ROW_DC = 24
+P_ENTRIES = 26
+# IDR, per-MB rows: [0] luma DC, [1:17) luma AC, [17:25) chroma AC,
+# [25:27) chroma DC.
+I_ROW_LUMA = 1
+I_ROW_CHROMA = 17
+I_ROW_DC_C = 25
+I_ENTRIES = 27
+
+
+def _compact_rows(rows):
+    """rows: (M, E, 16) int16 -> (flags (M,E) bool, buf (M*E, 16) int16,
+    n int32). buf's first n rows are the nonzero rows in scan order."""
+    m, e, _ = rows.shape
+    flat = rows.reshape(m * e, 16)
+    fl = (flat != 0).any(-1)
+    pos = jnp.cumsum(fl) - 1
+    dest = jnp.where(fl, pos, m * e)  # sentinel row, dropped below
+    buf = jnp.zeros((m * e + 1, 16), jnp.int16).at[dest].set(flat)[: m * e]
+    return fl.reshape(m, e), buf, fl.sum().astype(jnp.int32)
+
+
+def _bitmap_words(flags):
+    """(M, E<=32) bool -> (M,) int32 per-MB bitmap."""
+    e = flags.shape[1]
+    return (flags.astype(jnp.int32) << jnp.arange(e, dtype=jnp.int32)).sum(-1)
+
+
+def _bitpack32(bits):
+    """(M,) bool -> (ceil(M/32),) int32."""
+    m = bits.shape[0]
+    pad = (-m) % 32
+    b = jnp.pad(bits.astype(jnp.int32), (0, pad)).reshape(-1, 32)
+    return (b << jnp.arange(32, dtype=jnp.int32)).sum(-1)
+
+
+def pack_p_compact(out):
+    """P-frame outputs -> (header int32, data int16 (M*26, 16)).
+
+    Header layout: [n, mbh, mbw, 0] ++ mv_words(M) ++ mbinfo(M) ++
+    skip_words(ceil(M/32)); mv_words = (mvx & 0xFFFF) | (mvy << 16)."""
+    mbh, mbw = out["mvs"].shape[:2]
+    m = mbh * mbw
+    luma = out["luma_ac"].reshape(m, 16, 16).astype(jnp.int16)
+    chroma = out["chroma_ac"].reshape(m, 8, 16).astype(jnp.int16)
+    dc = out["chroma_dc"].reshape(m, 2, 4).astype(jnp.int16)
+    dc_rows = jnp.pad(dc, ((0, 0), (0, 0), (0, 12)))
+    rows = jnp.concatenate([luma, chroma, dc_rows], axis=1)  # (M, 26, 16)
+    flags, buf, n = _compact_rows(rows)
+    mv = out["mvs"]
+    mv_words = (mv[..., 0] & 0xFFFF) | (mv[..., 1] << 16)
+    header = jnp.concatenate([
+        jnp.stack([n, jnp.int32(mbh), jnp.int32(mbw), jnp.int32(0)]),
+        mv_words.reshape(-1).astype(jnp.int32),
+        _bitmap_words(flags),
+        _bitpack32(out["skip"].reshape(-1)),
+    ])
+    return header, buf
+
+
+def pack_i_compact(out):
+    """IDR outputs -> (header int32, data int16 (M*27, 16)).
+
+    Header: [n, mbh, mbw, 0] ++ mbinfo(M) ++ mode_words(M)
+    (mode_words = luma_mode | chroma_mode << 8). Per-MB rows: 1 luma DC +
+    16 luma AC + 8 chroma AC + 2 chroma DC."""
+    mbh, mbw = out["luma_mode"].shape[:2]
+    m = mbh * mbw
+    luma_dc = out["luma_dc"].reshape(m, 1, 16).astype(jnp.int16)
+    luma = out["luma_ac"].reshape(m, 16, 16).astype(jnp.int16)
+    chroma = out["chroma_ac"].reshape(m, 8, 16).astype(jnp.int16)
+    dc = out["chroma_dc"].reshape(m, 2, 4).astype(jnp.int16)
+    dc_rows = jnp.pad(dc, ((0, 0), (0, 0), (0, 12)))
+    rows = jnp.concatenate([luma_dc, luma, chroma, dc_rows], axis=1)  # (M, 27, 16)
+    flags, buf, n = _compact_rows(rows)
+    modes = out["luma_mode"].reshape(-1) | (out["chroma_mode"].reshape(-1) << 8)
+    header = jnp.concatenate([
+        jnp.stack([n, jnp.int32(mbh), jnp.int32(mbw), jnp.int32(0)]),
+        _bitmap_words(flags),
+        modes.astype(jnp.int32),
+    ])
+    return header, buf

@@ -118,3 +118,52 @@ def test_moving_content_stays_decodable(tmp_path):
     path = tmp_path / "s.h264"
     path.write_bytes(data)
     assert len(_decode(path)) == 5
+
+
+def test_static_frames_take_allskip_fast_path(tmp_path):
+    """Identical consecutive captures must cost no device work and decode
+    as a frozen image (all-skip P slices, recon == ref)."""
+    import cv2
+
+    from selkies_tpu.models.h264.encoder import TPUH264Encoder
+
+    rng = np.random.default_rng(12)
+    h, w = 64, 96
+    f = np.ascontiguousarray(
+        np.kron(rng.integers(0, 256, (h // 8, w // 8, 1)), np.ones((8, 8, 4))).astype(np.uint8)
+    )
+    enc = TPUH264Encoder(w, h, qp=24)
+    aus = [enc.encode_frame(f) for _ in range(4)]
+    # frames 2..4: all-skip fast path — tiny slices, all MBs skipped
+    for au in aus[1:]:
+        assert len(au) < 32
+    assert enc.last_stats.skipped_mbs == (h // 16) * (w // 16)
+    assert enc.last_stats.device_ms < 5.0  # no device round trip
+    path = tmp_path / "static.h264"
+    path.write_bytes(b"".join(aus))
+    cap = cv2.VideoCapture(str(path))
+    n = 0
+    frames = []
+    while True:
+        ok, fr = cap.read()
+        if not ok:
+            break
+        frames.append(fr)
+        n += 1
+    assert n == 4
+    np.testing.assert_array_equal(frames[0], frames[3])
+
+
+def test_changed_frame_after_static_run_encodes():
+    from selkies_tpu.models.h264.encoder import TPUH264Encoder
+
+    rng = np.random.default_rng(13)
+    f1 = np.ascontiguousarray(rng.integers(0, 256, (64, 96, 4), dtype=np.uint8))
+    f2 = f1.copy()
+    f2[:16, :16] = 0
+    enc = TPUH264Encoder(96, 64, qp=24)
+    enc.encode_frame(f1)
+    au_static = enc.encode_frame(f1)
+    au_changed = enc.encode_frame(f2)
+    assert len(au_changed) > len(au_static)
+    assert enc.last_stats.skipped_mbs < (64 // 16) * (96 // 16)

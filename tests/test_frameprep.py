@@ -1,0 +1,68 @@
+"""Host frame prep: C++ conversion must be bit-exact with the device
+colorspace path (ops/colorspace.py) + encoder padding, and dirty-band
+detection must track real changes."""
+
+import numpy as np
+import pytest
+
+from selkies_tpu.models.frameprep import BAND_ROWS, FramePrep, _numpy_convert_pad
+
+
+def _ref_planes(frame, ph, pw):
+    import jax
+
+    from selkies_tpu.ops.colorspace import bgrx_to_i420
+
+    y, u, v = (np.asarray(p) for p in bgrx_to_i420(frame))
+
+    def pad(p, th, tw):
+        return np.pad(p, ((0, th - p.shape[0]), (0, tw - p.shape[1])), mode="edge")
+
+    return pad(y, ph, pw), pad(u, ph // 2, pw // 2), pad(v, ph // 2, pw // 2)
+
+
+@pytest.mark.parametrize("size", [(64, 96), (50, 70), (128, 192)])
+def test_convert_bit_exact_vs_device(size):
+    h, w = size
+    ph, pw = (h + 15) // 16 * 16, (w + 15) // 16 * 16
+    rng = np.random.default_rng(hash(size) % 2**32)
+    frame = rng.integers(0, 256, (h, w, 4), dtype=np.uint8)
+    prep = FramePrep(w, h, pw, ph)
+    y, u, v = prep.convert(frame)
+    ry, ru, rv = _ref_planes(frame, ph, pw)
+    np.testing.assert_array_equal(y, ry)
+    np.testing.assert_array_equal(u, ru)
+    np.testing.assert_array_equal(v, rv)
+
+
+def test_numpy_fallback_matches_native():
+    rng = np.random.default_rng(3)
+    frame = rng.integers(0, 256, (48, 64, 4), dtype=np.uint8)
+    prep = FramePrep(64, 48, 64, 48)
+    if not prep.native:
+        pytest.skip("native lib unavailable")
+    y, u, v = prep.convert(frame)
+    fy, fu, fv = _numpy_convert_pad(frame, 48, 64)
+    np.testing.assert_array_equal(y, fy)
+    np.testing.assert_array_equal(u, fu)
+    np.testing.assert_array_equal(v, fv)
+
+
+def test_dirty_bands():
+    rng = np.random.default_rng(5)
+    h, w = 80, 64  # 5 bands
+    f1 = rng.integers(0, 256, (h, w, 4), dtype=np.uint8)
+    prep = FramePrep(w, h, w, h + 0 if h % 16 == 0 else h)
+    assert prep.dirty_bands(f1) is None  # first frame: everything dirty
+    assert not prep.dirty_bands(f1).any()  # unchanged
+    f2 = f1.copy()
+    f2[BAND_ROWS * 2 + 3, 10] ^= 0xFF  # touch band 2 only
+    bands = prep.dirty_bands(f2)
+    assert bands.tolist() == [False, False, True, False, False]
+    # prev updated: same frame again is clean
+    assert not prep.dirty_bands(f2).any()
+
+
+def test_odd_size_rejected():
+    with pytest.raises(ValueError):
+        FramePrep(63, 48, 64, 48)
