@@ -80,7 +80,8 @@ def _synth_frames(n: int = 4) -> list[np.ndarray]:
 def bench_full_encoder() -> float | None:
     """Steady-state IP-GOP encode (IDR once, then P frames with on-device
     motion estimation over scrolling content — the reference's default
-    infinite-GOP desktop workload)."""
+    infinite-GOP desktop workload). Uses the pipelined submit/flush API
+    exactly like the live VideoPipeline does."""
     try:
         from selkies_tpu.models.h264.encoder import TPUH264Encoder
     except ImportError:
@@ -89,10 +90,13 @@ def bench_full_encoder() -> float | None:
     frames = _synth_frames()
     for f in frames[:WARMUP]:
         enc.encode_frame(f)  # compiles both the IDR and the P path
+    done = 0
     t0 = time.perf_counter()
     for i in range(ITERS):
-        enc.encode_frame(frames[i % len(frames)])
+        done += len(enc.submit(frames[i % len(frames)]))
+    done += len(enc.flush())
     dt = time.perf_counter() - t0
+    assert done == ITERS, f"pipeline lost frames: {done}/{ITERS}"
     return ITERS / dt
 
 

@@ -96,15 +96,26 @@ def _numpy_convert_pad(frame: np.ndarray, ph: int, pw: int):
 class FramePrep:
     """Per-stream host prep state: conversion buffers + previous frame."""
 
-    def __init__(self, width: int, height: int, pad_w: int, pad_h: int):
+    def __init__(self, width: int, height: int, pad_w: int, pad_h: int, nslots: int = 4):
         if width % 2 or height % 2:
             raise ValueError(f"frame size {width}x{height} must be even")
         self.width, self.height = width, height
         self.pad_w, self.pad_h = pad_w, pad_h
         self._lib = _load()
-        self.y = np.empty((pad_h, pad_w), np.uint8)
-        self.u = np.empty((pad_h // 2, pad_w // 2), np.uint8)
-        self.v = np.empty((pad_h // 2, pad_w // 2), np.uint8)
+        # rotating output buffers: the encoder pipelines dispatches, and an
+        # async h2d transfer may still be reading a plane when the next
+        # capture converts — each convert() writes a different slot, so
+        # nslots must cover every possibly-in-flight upload plus one
+        self._nslots = max(2, int(nslots))
+        self._bufs = [
+            (
+                np.empty((pad_h, pad_w), np.uint8),
+                np.empty((pad_h // 2, pad_w // 2), np.uint8),
+                np.empty((pad_h // 2, pad_w // 2), np.uint8),
+            )
+            for _ in range(self._nslots)
+        ]
+        self._slot = 0
         self._prev: np.ndarray | None = None
         self.nbands = (height + BAND_ROWS - 1) // BAND_ROWS
         self._bands = np.empty(self.nbands, np.uint8)
@@ -114,20 +125,25 @@ class FramePrep:
         return self._lib is not None
 
     def convert(self, frame: np.ndarray):
-        """(H, W, 4) BGRx uint8 -> (y, u, v) padded planes (owned buffers,
-        overwritten on the next call)."""
+        """(H, W, 4) BGRx uint8 -> (y, u, v) padded planes.
+
+        Buffers rotate over 4 slots, so up to 4 conversions can be in
+        flight (async device uploads) before a slot is overwritten."""
         if frame.shape != (self.height, self.width, 4):
             raise ValueError(f"frame {frame.shape} != {(self.height, self.width, 4)}")
         if not frame.flags["C_CONTIGUOUS"]:
             frame = np.ascontiguousarray(frame)
+        y, u, v = self._bufs[self._slot]
+        self._slot = (self._slot + 1) % self._nslots
         if self._lib is not None:
             self._lib.bgrx_to_i420_pad(
                 _u8p(frame), self.height, self.width, self.pad_h, self.pad_w,
-                _u8p(self.y), _u8p(self.u), _u8p(self.v),
+                _u8p(y), _u8p(u), _u8p(v),
             )
         else:
-            self.y, self.u, self.v = _numpy_convert_pad(frame, self.pad_h, self.pad_w)
-        return self.y, self.u, self.v
+            y2, u2, v2 = _numpy_convert_pad(frame, self.pad_h, self.pad_w)
+            y[:], u[:], v[:] = y2, u2, v2
+        return y, u, v
 
     def dirty_bands(self, frame: np.ndarray) -> np.ndarray | None:
         """Which 16-row bands changed vs the previous call's frame.

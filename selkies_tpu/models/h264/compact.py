@@ -24,6 +24,25 @@ from selkies_tpu.models.h264.encoder_core import (
 from selkies_tpu.models.h264.numpy_ref import FrameCoeffs, PFrameCoeffs
 
 
+def p_header_words(mbh: int, mbw: int) -> int:
+    m = mbh * mbw
+    return 4 + 2 * m + (m + 31) // 32
+
+
+def i_header_words(mbh: int, mbw: int) -> int:
+    return 4 + 2 * mbh * mbw
+
+
+def split_prefix(prefix: np.ndarray, header_words: int):
+    """Undo encoder_core.fuse_downlink: (header int32, data rows (cap, 16)
+    int16, n). The int32→int16 bit-cast is an in-memory reinterpretation,
+    so viewing the int16 pairs back as int32 is exact."""
+    hdr16 = np.ascontiguousarray(prefix[: 2 * header_words])
+    header = hdr16.view(np.int32)
+    data = prefix[2 * header_words :].reshape(-1, 16)
+    return header, data, int(header[0])
+
+
 def _flags_from_bitmap(words: np.ndarray, entries: int) -> np.ndarray:
     return ((words[:, None] >> np.arange(entries, dtype=np.int32)) & 1).astype(bool)
 

@@ -18,6 +18,8 @@ blocks only on the coefficient device→host copy.
 from __future__ import annotations
 
 import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 
 import jax
@@ -29,10 +31,17 @@ from selkies_tpu.models.h264.numpy_ref import PFrameCoeffs
 from selkies_tpu.models.frameprep import FramePrep
 from selkies_tpu.models.stats import FrameStats as _FrameStats
 from selkies_tpu.models.h264.bitstream import StreamParams, write_pps, write_sps
-from selkies_tpu.models.h264.compact import unpack_i_compact, unpack_p_compact
+from selkies_tpu.models.h264.compact import (
+    i_header_words,
+    p_header_words,
+    split_prefix,
+    unpack_i_compact,
+    unpack_p_compact,
+)
 from selkies_tpu.models.h264.encoder_core import (
     encode_frame_p_planes,
     encode_frame_planes,
+    fuse_downlink,
     pack_i_compact,
     pack_p_compact,
 )
@@ -56,6 +65,12 @@ def _convert_pad(frame, *, pad_h: int, pad_w: int, channels: int):
     return y, u, v
 
 
+# Data rows carried in the single-fetch prefix buffer. The relay prices
+# transfers per op (~200 ms, tools/profile_rpc.py), so typical frames must
+# complete in ONE fetch; frames with more nonzero rows pay a second fetch.
+CAP_ROWS = 4096
+
+
 def _device_step(frame, qp, *, pad_h: int, pad_w: int, channels: int):
     """Full IDR device path: packed frame -> padded planes -> compacted
     coefficient downlink (header, nonzero rows) + device-resident recon."""
@@ -66,7 +81,8 @@ def _device_step(frame, qp, *, pad_h: int, pad_w: int, channels: int):
 def _i_planes_step(y, u, v, qp):
     out = encode_frame_planes(y, u, v, qp)
     header, buf = pack_i_compact(out)
-    return header, buf, out["recon_y"], out["recon_u"], out["recon_v"]
+    prefix = fuse_downlink(header, buf, CAP_ROWS)
+    return prefix, buf, out["recon_y"], out["recon_u"], out["recon_v"]
 
 
 def _device_step_p(frame, qp, ref_y, ref_u, ref_v, *, pad_h: int, pad_w: int, channels: int):
@@ -80,28 +96,40 @@ def _device_step_p(frame, qp, ref_y, ref_u, ref_v, *, pad_h: int, pad_w: int, ch
 def _p_planes_step(y, u, v, qp, ref_y, ref_u, ref_v):
     out = encode_frame_p_planes(y, u, v, ref_y, ref_u, ref_v, qp)
     header, buf = pack_p_compact(out)
-    return header, buf, out["recon_y"], out["recon_u"], out["recon_v"]
+    prefix = fuse_downlink(header, buf, CAP_ROWS)
+    return prefix, buf, out["recon_y"], out["recon_u"], out["recon_v"]
 
 
-_MIN_FETCH_ROWS = 512
-
-
-def _fetch_prefix(buf, n: int) -> np.ndarray:
-    """Fetch the first n rows of the device data buffer, rounded up to a
-    power-of-two bucket so each resolution compiles a handful of slice
-    executables instead of one per distinct n."""
+def _fetch_rest(buf, n: int) -> np.ndarray:
+    """Overflow path: rows [CAP_ROWS, n) in power-of-two buckets."""
     total = buf.shape[0]
-    if n <= 0:
-        return np.zeros((0, 16), np.int16)
-    bucket = _MIN_FETCH_ROWS
+    bucket = CAP_ROWS
     while bucket < n:
         bucket <<= 1
     if bucket >= total:
-        return np.asarray(buf)
-    return np.asarray(buf[:bucket])
+        return np.asarray(buf)[CAP_ROWS:]
+    return np.asarray(buf[CAP_ROWS:bucket])
 
 
 FrameStats = _FrameStats  # shared definition (models/stats.py)
+
+
+@dataclass
+class _Pending:
+    """One in-flight frame in the encode pipeline."""
+
+    kind: str  # "static" | "i" | "p"
+    frame_index: int
+    qp: int
+    frame_num: int
+    idr_pic_id: int
+    t0: float
+    t1: float
+    meta: object = None
+    au: bytes | None = None  # static only
+    prefix_d: object = None
+    buf_d: object = None
+    future: object = None  # completion future (threaded fetch+unpack+pack)
 
 
 class TPUH264Encoder:
@@ -128,11 +156,12 @@ class TPUH264Encoder:
         channels: int = 4,
         keyframe_interval: int = 0,
         host_convert: bool = True,
+        pipeline_depth: int = 2,
     ):
         self.width = width
         self.height = height
         self.fps = fps
-        self.qp = int(qp)
+        self.set_qp(qp)
         self.channels = channels
         self.keyframe_interval = int(keyframe_interval)  # 0 = infinite GOP
         self.params = StreamParams(width=width, height=height, qp=self.qp, fps=fps)
@@ -143,9 +172,16 @@ class TPUH264Encoder:
         # the upload is 1.5 B/px instead of 4 — the link is the bottleneck
         # (tools/profile_link.py). host_convert=False keeps conversion on
         # device (better when the device is PCIe-local and link-rich).
+        self.pipeline_depth = max(0, int(pipeline_depth))
         self._prep: FramePrep | None = None
         if host_convert and channels == 4:
-            self._prep = FramePrep(width, height, self._pad_w, self._pad_h)
+            # one conversion slot per possibly-in-flight async upload plus
+            # one being written: depth+1 frames can be pipelined before
+            # submit() blocks on the oldest completion
+            self._prep = FramePrep(
+                width, height, self._pad_w, self._pad_h,
+                nslots=self.pipeline_depth + 2,
+            )
         if self._prep is not None:
             self._step = jax.jit(_i_planes_step)
             self._step_p = jax.jit(_p_planes_step, donate_argnums=(4, 5, 6))
@@ -164,6 +200,14 @@ class TPUH264Encoder:
             )
         self._ref = None  # (recon_y, recon_u, recon_v) device arrays
         self._prev_frame: np.ndarray | None = None  # device-convert mode only
+        self._inflight: deque = deque()
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(2, self.pipeline_depth + 1),
+            thread_name_prefix="h264-complete",
+        )
+        mbh, mbw = self._pad_h // 16, self._pad_w // 16
+        self._hdr_words_i = i_header_words(mbh, mbw)
+        self._hdr_words_p = p_header_words(mbh, mbw)
         self._allskip: PFrameCoeffs | None = None
         self.frame_index = 0
         self._frames_since_idr = 0
@@ -221,22 +265,34 @@ class TPUH264Encoder:
 
     # -- encoding --
 
+    @staticmethod
+    def _put(planes):
+        # Explicit async device_put: passing host numpy straight into the
+        # jitted call makes the runtime do a SYNCHRONOUS per-argument
+        # transfer (~140 ms each over the axon relay); an explicit
+        # device_put enqueues without a round trip (tools/profile_rpc.py).
+        return [jax.device_put(np.asarray(p)) for p in planes]
+
     def _run_step_i(self, frame: np.ndarray):
         if self._prep is not None:
-            y, u, v = self._prep.convert(frame)
+            y, u, v = self._put(self._prep.convert(frame))
             return self._step(y, u, v, np.int32(self.qp))
-        return self._step(frame, np.int32(self.qp))
+        return self._step(jax.device_put(frame), np.int32(self.qp))
 
     def _run_step_p(self, frame: np.ndarray):
         if self._prep is not None:
-            y, u, v = self._prep.convert(frame)
+            y, u, v = self._put(self._prep.convert(frame))
             return self._step_p(y, u, v, np.int32(self.qp), *self._ref)
-        return self._step_p(frame, np.int32(self.qp), *self._ref)
+        return self._step_p(jax.device_put(frame), np.int32(self.qp), *self._ref)
 
-    def encode_frame(self, frame: np.ndarray, qp: int | None = None) -> bytes:
-        """Encode one packed frame ((H, W, 4) BGRx or (H, W, 3) RGB uint8).
+    def submit(self, frame: np.ndarray, qp: int | None = None, meta=None) -> list:
+        """Dispatch one frame into the encode pipeline.
 
-        Returns a complete Annex-B access unit (SPS/PPS prepended on IDR).
+        Returns completed (au, stats, meta) tuples, oldest first — empty
+        while the pipeline (depth `pipeline_depth`) is filling. Device
+        dispatch is async, so frame N+1's upload/compute overlaps frame
+        N's downlink fetch and host CAVLC pack: the round-trip latency of
+        the host↔device link is hidden at steady state.
         """
         if qp is not None:
             self.set_qp(qp)
@@ -247,90 +303,144 @@ class TPUH264Encoder:
             or (self.keyframe_interval > 0 and self._frames_since_idr >= self.keyframe_interval)
         )
         t0 = time.perf_counter()
-        skipped = 0
         # evaluate on every frame (advances the previous-frame state even
         # across IDRs) but only short-circuit on P frames
         if self._is_static(frame) and not idr:
-            # unchanged capture: emit an all-skip P slice host-side — no
-            # upload, no device step, no downlink. The blinking-cursor /
-            # idle-desktop steady state costs microseconds.
-            t1 = time.perf_counter()
+            # unchanged capture: all-skip P slice host-side — no upload,
+            # no device step, no downlink (idle-desktop steady state)
             slice_nal = self._allskip_slice(self._frames_since_idr % 256)
-            t2 = time.perf_counter()
-            mbs = (self._pad_h // 16) * (self._pad_w // 16)
-            self.last_stats = FrameStats(
-                frame_index=self.frame_index,
-                idr=False,
-                qp=self.qp,
-                bytes=len(slice_nal),
-                device_ms=(t1 - t0) * 1e3,
-                pack_ms=(t2 - t1) * 1e3,
-                skipped_mbs=mbs,
+            rec = _Pending(
+                kind="static", frame_index=self.frame_index, qp=self.qp,
+                frame_num=self._frames_since_idr % 256, idr_pic_id=0,
+                t0=t0, t1=time.perf_counter(), meta=meta, au=slice_nal,
             )
-            self.frame_index += 1
-            self._frames_since_idr += 1
-            return slice_nal
-        # Any failure between here and a fully built slice nulls self._ref:
-        # the client never receives this frame, so encoding the NEXT frame
-        # against this frame's recon would silently desync the decoder.
-        # A nulled ref forces a clean IDR instead (and bypasses the static
-        # fast path, whose previous-frame state has already advanced).
-        try:
-            if idr:
-                header_d, buf_d, ry, ru, rv = self._run_step_i(frame)
-                # the reconstruction never leaves the device: it is the
-                # P-frame reference (donated into the next P step)
-                self._ref = (ry, ru, rv)
-                header = np.asarray(header_d)
-                data = _fetch_prefix(buf_d, int(header[0]))
-                fc = unpack_i_compact(header, data, self.qp)
-                self._frames_since_idr = 0
-                t1 = time.perf_counter()
-                # frame_num counts from the last IDR (7.4.3: gaps are
-                # disallowed by our SPS, so it must be PrevRefFrameNum+1
-                # mod MaxFrameNum).
-                slice_nal = pack_slice_fast(
-                    fc,
-                    self.params,
-                    frame_num=0,
-                    idr=True,
-                    idr_pic_id=self._idr_pic_id,
-                )
-            else:
-                header_d, buf_d, ry, ru, rv = self._run_step_p(frame)
-                # reassign IMMEDIATELY: _step_p donated the old buffers
-                self._ref = (ry, ru, rv)
-                header = np.asarray(header_d)
-                data = _fetch_prefix(buf_d, int(header[0]))
-                pfc = unpack_p_compact(header, data, self.qp)
-                skipped = int(pfc.skip.sum())
-                t1 = time.perf_counter()
-                slice_nal = pack_slice_p_fast(
-                    pfc, self.params, frame_num=self._frames_since_idr % 256
-                )
-        except Exception:
-            self._ref = None
-            raise
-        t2 = time.perf_counter()
-        au = (self._headers + slice_nal) if idr else slice_nal
-        if idr:
-            self._idr_pic_id = (self._idr_pic_id + 1) % 2
-        self.last_stats = FrameStats(
-            frame_index=self.frame_index,
-            idr=idr,
-            qp=self.qp,
-            bytes=len(au),
-            device_ms=(t1 - t0) * 1e3,
-            pack_ms=(t2 - t1) * 1e3,
-            skipped_mbs=skipped,
-        )
+        else:
+            try:
+                if idr:
+                    prefix_d, buf_d, ry, ru, rv = self._run_step_i(frame)
+                    # recon never leaves the device: it is the P-frame
+                    # reference (donated into the next P step)
+                    self._ref = (ry, ru, rv)
+                    rec = _Pending(
+                        kind="i", frame_index=self.frame_index, qp=self.qp,
+                        frame_num=0, idr_pic_id=self._idr_pic_id,
+                        t0=t0, t1=0.0, meta=meta,
+                        prefix_d=prefix_d, buf_d=buf_d,
+                    )
+                    self._frames_since_idr = 0
+                    self._idr_pic_id = (self._idr_pic_id + 1) % 2
+                    self._force_idr = False
+                else:
+                    prefix_d, buf_d, ry, ru, rv = self._run_step_p(frame)
+                    # reassign IMMEDIATELY: _step_p donated the old buffers
+                    self._ref = (ry, ru, rv)
+                    rec = _Pending(
+                        kind="p", frame_index=self.frame_index, qp=self.qp,
+                        frame_num=self._frames_since_idr % 256, idr_pic_id=0,
+                        t0=t0, t1=0.0, meta=meta,
+                        prefix_d=prefix_d, buf_d=buf_d,
+                    )
+                # start the downlink fetch + entropy pack on a worker NOW:
+                # fetch ops overlap across threads on the relay
+                # (tools/profile_rpc.py: 4 concurrent fetches ≈ cost of 1)
+                rec.future = self._pool.submit(self._complete_work, rec)
+            except Exception:
+                # device failure after donation: the old reference planes
+                # are gone. Null the ref so the next frame self-heals as a
+                # clean IDR instead of desyncing the decoder. Older frames
+                # already in flight stay queued — they were dispatched
+                # against an intact chain and remain deliverable.
+                self._ref = None
+                raise
         self.frame_index += 1
         self._frames_since_idr += 1
-        if idr:
-            # Only clear when consumed: a force_keyframe() landing from the
-            # event loop mid-encode must still take effect next frame.
-            self._force_idr = False
-        return au
+        self._inflight.append(rec)
+        out = []
+        # emit completions in submission order; block only beyond depth
+        while self._inflight and (
+            len(self._inflight) > self.pipeline_depth
+            or self._inflight[0].future is None
+            or self._inflight[0].future.done()
+        ):
+            out.append(self._emit(self._inflight.popleft()))
+        return out
+
+    def flush(self) -> list:
+        """Complete every in-flight frame (oldest first)."""
+        out = []
+        while self._inflight:
+            out.append(self._emit(self._inflight.popleft()))
+        return out
+
+    def _emit(self, rec: "_Pending"):
+        """Resolve one pending frame (waiting on its worker if needed)."""
+        if rec.kind == "static":
+            au = rec.au
+            stats = FrameStats(
+                frame_index=rec.frame_index, idr=False, qp=rec.qp,
+                bytes=len(au), device_ms=(rec.t1 - rec.t0) * 1e3,
+                pack_ms=0.0,
+                skipped_mbs=(self._pad_h // 16) * (self._pad_w // 16),
+            )
+            self.last_stats = stats
+            return au, stats, rec.meta
+        # A fetch/pack failure means the client never receives this frame:
+        # encoding successors against its recon would silently desync the
+        # decoder, so null the ref (forces IDR) and drop the pipeline.
+        try:
+            au, skipped, t1, t2 = rec.future.result()
+        except Exception:
+            self._ref = None
+            self._inflight.clear()
+            raise
+        stats = FrameStats(
+            frame_index=rec.frame_index, idr=rec.kind == "i", qp=rec.qp,
+            bytes=len(au), device_ms=(t1 - rec.t0) * 1e3,
+            pack_ms=(t2 - t1) * 1e3, skipped_mbs=skipped,
+        )
+        self.last_stats = stats
+        return au, stats, rec.meta
+
+    def _complete_work(self, rec: "_Pending"):
+        """Worker-thread half: single-fetch downlink + unpack + CAVLC."""
+        prefix = np.asarray(rec.prefix_d)
+        hdr_words = self._hdr_words_i if rec.kind == "i" else self._hdr_words_p
+        header, data, n = split_prefix(prefix, hdr_words)
+        if n > CAP_ROWS:  # rare: heavy frame spilled past the prefix
+            data = np.concatenate([data, _fetch_rest(rec.buf_d, n)])
+        t1 = time.perf_counter()
+        skipped = 0
+        if rec.kind == "i":
+            fc = unpack_i_compact(header, data, rec.qp)
+            # frame_num counts from the last IDR (7.4.3: gaps are
+            # disallowed by our SPS)
+            slice_nal = pack_slice_fast(
+                fc, self.params, frame_num=0, idr=True, idr_pic_id=rec.idr_pic_id
+            )
+            au = self._headers + slice_nal
+        else:
+            pfc = unpack_p_compact(header, data, rec.qp)
+            skipped = int(pfc.skip.sum())
+            au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num)
+        return au, skipped, t1, time.perf_counter()
+
+    def encode_frame(self, frame: np.ndarray, qp: int | None = None) -> bytes:
+        """Synchronous encode ((H, W, 4) BGRx or (H, W, 3) RGB uint8 in,
+        complete Annex-B access unit out; SPS/PPS prepended on IDR).
+        Equivalent to submit() + flush() — no pipelining."""
+        if self._inflight:
+            # mixing submit() and encode_frame() would silently drop the
+            # in-flight frames' access units (only this frame's AU is
+            # returned) — a decoder-visible frame_num gap. Refuse.
+            raise RuntimeError("encode_frame() called with frames in flight; use flush() first")
+        outs = self.submit(frame, qp)
+        outs.extend(self.flush())
+        return outs[-1][0]
+
+    def close(self) -> None:
+        """Discard in-flight frames and stop the completion workers."""
+        self._inflight.clear()
+        self._pool.shutdown(wait=False, cancel_futures=True)
 
     def recon_planes(self, frame: np.ndarray):
         """Debug helper: (recon_y, recon_u, recon_v) for a frame."""

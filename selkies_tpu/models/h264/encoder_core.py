@@ -320,7 +320,7 @@ def encode_frame_planes(y, u, v, qp):
 # (reference: keyframe_distance=-1 default, __main__.py:473-475).
 
 # single source of truth for the ME geometry (the golden model owns it)
-from selkies_tpu.models.h264.numpy_ref import COARSE_DS, COARSE_R, MV_PAD, REFINE_R
+from selkies_tpu.models.h264.numpy_ref import COARSE_DS, COARSE_R, MV_PAD, REFINE_R, TOPK
 
 # JAX clamps out-of-bounds gathers silently (no IndexError like numpy), so
 # a reach that outgrows the pad would corrupt bitstreams without erroring.
@@ -403,35 +403,15 @@ def _downsample4(plane):
     return jnp.right_shift(s + 8, 4)
 
 
-def _gather_sad(cur, ref_pad, mvs):
-    """Per-MB SAD of the motion-compensated prediction at per-MB MVs."""
-    h, w = cur.shape
-    mbh, mbw = h // 16, w // 16
-    mvx = jnp.repeat(jnp.repeat(mvs[..., 0], 16, 0), 16, 1)
-    mvy = jnp.repeat(jnp.repeat(mvs[..., 1], 16, 0), 16, 1)
-    iy = jnp.arange(h)[:, None] + mvy + MV_PAD
-    ix = jnp.arange(w)[None, :] + mvx + MV_PAD
-    pred = ref_pad[iy, ix].astype(jnp.int32)
-    return jnp.abs(cur - pred).reshape(mbh, 16, mbw, 16).sum(axis=(1, 3))
-
-
-def hier_motion_search(cur, ref, ref_pad):
-    """Two-level hierarchical ME (device mirror of numpy_ref.hier_search_me).
-
-    cur: (H, W) int32 luma; ref: (H, W) uint8 (unpadded recon);
-    ref_pad: the MV_PAD edge-padded ref (shared with MC). Returns
-    (mbh, mbw, 2) int32 full-pel MVs, element-exact vs the golden model.
-    Cost at 1080p ≈ 289 shifts on 1/16 pixels + 82 gather-SADs — ~6x less
-    arithmetic than a flat ±8 search while covering ±32 (the flat search
-    whiffed on fast scrolls >8 px/frame, leaving full-frame residuals).
-    """
+def coarse_vote_candidates_jnp(cur, ref):
+    """Device mirror of numpy_ref.coarse_vote_candidates: (TOPK, 2) int32
+    coarse MVs in downsampled units, element-exact with the golden model."""
     h, w = cur.shape
     mbh, mbw = h // 16, w // 16
     yd = _downsample4(cur)
     rd = _downsample4(ref.astype(jnp.int32))
     hd, wd = yd.shape
 
-    # -- coarse: chunked global-shift scan on the downsampled planes --
     cands, ranks = _me_candidates(COARSE_R)
     scale = 1 << int(ranks.max()).bit_length()
     cand_chunks = jnp.asarray(cands.reshape(-1, _ME_CHUNK, 2))
@@ -443,47 +423,116 @@ def hier_motion_search(cur, ref, ref_pad):
         return jnp.abs(yd - sh).reshape(mbh, 4, mbw, 4).sum(axis=(1, 3))
 
     def step(carry, xs):
-        best_cost, best_mv = carry
+        best_cost, = carry
         cand, rank = xs
         sads = jax.vmap(sad_one)(cand)
         cost = sads * scale + rank[:, None, None]
-        i = jnp.argmin(cost, axis=0)
-        c = jnp.take_along_axis(cost, i[None], 0)[0]
-        mv = cand[i]
+        c = jnp.min(cost, axis=0)
         better = c < best_cost
+        return (jnp.where(better, c, best_cost),), None
+
+    init = (jnp.full((mbh, mbw), jnp.iinfo(jnp.int32).max, jnp.int32),)
+    (best_cost,), _ = jax.lax.scan(step, init, (cand_chunks, rank_chunks))
+    best_rank = best_cost & (scale - 1)  # cost = sad*scale + rank
+
+    n_real = (2 * COARSE_R + 1) ** 2
+    # dense bincount (gather/scatter-free): votes[r] = #{MBs with rank r}
+    votes = (best_rank.reshape(-1, 1) == jnp.arange(n_real)[None, :]).sum(0)
+    # top-K by votes desc then rank asc; vote count <= mbh*mbw < 2^22
+    score = votes * 512 + (511 - jnp.arange(n_real))
+    _, top_idx = jax.lax.top_k(score, TOPK)
+    return jnp.asarray(cands[:n_real])[top_idx]  # (TOPK, 2) — tiny gather
+
+
+def _refine_cands_jnp(coarse):
+    """(TOPK, 2) coarse -> (1 + TOPK*(2R+1)^2, 2) full-res shift list,
+    zero MV first (mirrors numpy_ref.refine_candidate_list)."""
+    side = 2 * REFINE_R + 1
+    d = jnp.stack(
+        jnp.meshgrid(
+            jnp.arange(-REFINE_R, REFINE_R + 1),
+            jnp.arange(-REFINE_R, REFINE_R + 1),
+            indexing="ij",
+        ),
+        axis=-1,
+    )  # (side, side, 2) with [..., 0]=dy, [..., 1]=dx
+    grid = jnp.stack([d[..., 1], d[..., 0]], axis=-1).reshape(1, -1, 2)  # raster dy-outer
+    cands = (coarse[:, None, :] * COARSE_DS + grid).reshape(-1, 2)
+    return jnp.concatenate([jnp.zeros((1, 2), jnp.int32), cands.astype(jnp.int32)])
+
+
+def hier_me_mc(cur, ref_y, ry_pad, ru_pad, rv_pad):
+    """Global-candidate ME fused with motion compensation — gather-free.
+
+    One scan over ~1+TOPK*(2R+1)^2 global shifts; each step is a dynamic
+    slice + dense SAD + per-MB select of the running best luma/chroma
+    prediction. Returns (mvs (mbh,mbw,2) i32, pred_y, pred_u, pred_v i32).
+    Element-exact vs numpy_ref.hier_search_me + mc_luma/mc_chroma: the
+    chroma bilinear runs on the globally-shifted plane with the same
+    frac weights, so selected values match the per-MB gather formulation.
+    (Why no gathers: tools/profile_slope2.py measured 30 ms per full-plane
+    gather on v5e vs 0.26 ms per global-shift SAD map.)
+    """
+    h, w = cur.shape
+    mbh, mbw = h // 16, w // 16
+    ch, cw = h // 2, w // 2
+    cands = _refine_cands_jnp(coarse_vote_candidates_jnp(cur, ref_y))
+    ncand = cands.shape[0]
+    ranks = jnp.arange(ncand, dtype=jnp.int32)
+    scale = 1 << int(np.int64(ncand - 1)).bit_length()
+
+    def step(carry, xs):
+        best_cost, best_mv, py, pu, pv = carry
+        mv, rank = xs
+        dx, dy = mv[0], mv[1]
+        ys = jax.lax.dynamic_slice(ry_pad, (MV_PAD + dy, MV_PAD + dx), (h, w))
+        sad = jnp.abs(cur - ys.astype(jnp.int32)).reshape(mbh, 16, mbw, 16).sum(axis=(1, 3))
+        cost = sad * scale + rank
+        better = cost < best_cost
+
+        # chroma prediction for this global shift (8.4.2.2.2 on the whole
+        # plane): full-pel luma MV -> chroma half-pel bilinear
+        cx, cy = jnp.right_shift(dx, 1), jnp.right_shift(dy, 1)
+        xf, yf = 4 * (dx & 1), 4 * (dy & 1)
+
+        def chroma_shift(rp):
+            s = jax.lax.dynamic_slice(rp, (MV_PAD + cy, MV_PAD + cx), (ch + 1, cw + 1)).astype(jnp.int32)
+            a, b = s[:-1, :-1], s[:-1, 1:]
+            c, d = s[1:, :-1], s[1:, 1:]
+            return jnp.right_shift(
+                (8 - xf) * (8 - yf) * a + xf * (8 - yf) * b + (8 - xf) * yf * c + xf * yf * d + 32,
+                6,
+            )
+
+        us, vs = chroma_shift(ru_pad), chroma_shift(rv_pad)
+        m16 = jnp.repeat(jnp.repeat(better, 16, 0), 16, 1)
+        m8 = jnp.repeat(jnp.repeat(better, 8, 0), 8, 1)
         return (
-            jnp.where(better, c, best_cost),
+            jnp.where(better, cost, best_cost),
             jnp.where(better[..., None], mv, best_mv),
+            jnp.where(m16, ys.astype(jnp.int32), py),
+            jnp.where(m8, us, pu),
+            jnp.where(m8, vs, pv),
         ), None
 
     init = (
         jnp.full((mbh, mbw), jnp.iinfo(jnp.int32).max, jnp.int32),
         jnp.zeros((mbh, mbw, 2), jnp.int32),
+        jnp.zeros((h, w), jnp.int32),
+        jnp.zeros((ch, cw), jnp.int32),
+        jnp.zeros((ch, cw), jnp.int32),
     )
-    (_, base), _ = jax.lax.scan(step, init, (cand_chunks, rank_chunks))
-    base = base * COARSE_DS
+    (_, mvs, py, pu, pv), _ = jax.lax.scan(step, init, (cands, ranks))
+    return mvs, py, pu, pv
 
-    # -- refine: zero MV first (rank 0), then raster around the base --
-    zero = jnp.zeros((mbh, mbw, 2), jnp.int32)
-    best_sad = _gather_sad(cur, ref_pad, zero)
-    best_mv = zero
-    offs = np.array(
-        [(dx, dy) for dy in range(-REFINE_R, REFINE_R + 1) for dx in range(-REFINE_R, REFINE_R + 1)],
-        np.int32,
-    )
 
-    def refine_step(carry, d):
-        best_sad, best_mv = carry
-        mvs = base + d
-        sad = _gather_sad(cur, ref_pad, mvs)
-        better = sad < best_sad
-        return (
-            jnp.where(better, sad, best_sad),
-            jnp.where(better[..., None], mvs, best_mv),
-        ), None
-
-    (_, best_mv), _ = jax.lax.scan(refine_step, (best_sad, best_mv), jnp.asarray(offs))
-    return best_mv
+def hier_motion_search(cur, ref, ref_pad):
+    """MV-only wrapper over hier_me_mc (parity tests / tools). ref_pad is
+    the MV_PAD-padded luma; chroma planes are synthesized zeros."""
+    h, w = cur.shape
+    zero_c = jnp.zeros((h // 2 + 2 * MV_PAD, w // 2 + 2 * MV_PAD), jnp.uint8)
+    mvs, _, _, _ = hier_me_mc(cur, jnp.asarray(ref), ref_pad, zero_c, zero_c)
+    return mvs
 
 
 def mc_luma(ref_pad, mvs):
@@ -575,12 +624,14 @@ def encode_frame_p_planes(y, u, v, ref_y, ref_u, ref_v, qp, search: int = 8, me:
     rv = jnp.pad(ref_v, MV_PAD, mode="edge")
 
     if me == "hier":
-        mvs = hier_motion_search(y, ref_y, ry)
+        # fused gather-free ME+MC: predictions fall out of the same
+        # candidate scan that picks the MVs
+        mvs, pred_y, pred_u, pred_v = hier_me_mc(y, ref_y, ry, ru, rv)
     else:
         mvs = motion_search(y, ry, search)
-    pred_y = mc_luma(ry, mvs)
-    pred_u = mc_chroma(ru, mvs)
-    pred_v = mc_chroma(rv, mvs)
+        pred_y = mc_luma(ry, mvs)
+        pred_u = mc_chroma(ru, mvs)
+        pred_v = mc_chroma(rv, mvs)
 
     # Luma: plain 4x4 transform, all 16 coeffs (no DC Hadamard in inter MBs)
     yb = _plane_to_mb_blocks(y - pred_y, 4)
@@ -701,6 +752,20 @@ def pack_p_compact(out):
         _bitpack32(out["skip"].reshape(-1)),
     ])
     return header, buf
+
+
+def fuse_downlink(header, buf, cap_rows: int):
+    """Fuse header + the first cap_rows data rows into ONE int16 buffer.
+
+    The host↔device relay prices transfers per OPERATION (~200 ms each,
+    tools/profile_rpc.py), so the downlink must be a single fetch: the
+    prefix buffer carries the int32 header bit-cast to int16 pairs
+    followed by cap_rows nonzero rows. Frames whose row count exceeds
+    cap_rows pay one extra fetch from the full buffer (rare; sized for
+    typical P frames)."""
+    hdr16 = jax.lax.bitcast_convert_type(header, jnp.int16).reshape(-1)
+    prefix = jnp.concatenate([hdr16, buf[:cap_rows].reshape(-1)])
+    return prefix
 
 
 def pack_i_compact(out):

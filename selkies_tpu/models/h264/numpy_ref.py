@@ -282,7 +282,8 @@ MV_PAD = 40
 # Hierarchical ME geometry (hier_search_me / encoder_core.hier_motion_search)
 COARSE_DS = 4   # coarse level downsample factor
 COARSE_R = 8    # coarse search radius in downsampled pels (→ ±32 full-pel)
-REFINE_R = 4    # full-res refine radius around the upscaled coarse best
+REFINE_R = 3    # full-res refine radius around each upscaled global candidate
+TOPK = 3        # dominant global motion candidates carried to full-res refine
 
 
 @dataclass
@@ -456,64 +457,80 @@ def downsample4(plane: np.ndarray) -> np.ndarray:
     ) >> 4
 
 
-def hier_search_me(y: np.ndarray, ref_y: np.ndarray) -> np.ndarray:
-    """Two-level hierarchical full-pel ME (golden model).
+def coarse_vote_candidates(y: np.ndarray, ref_y: np.ndarray) -> np.ndarray:
+    """Level-1 ME: exhaustive ±COARSE_R search on 4x-downsampled planes,
+    then the TOPK most-voted coarse displacements across the frame.
 
-    Level 1: exhaustive ±COARSE_R search on 4x-downsampled planes (each MB
-    is a 4x4 coarse block), zero-first raster tie-break — covers ±32
-    full-pel for the cost of a ±8 search at 1/16 the pixels.
-    Level 0: ±REFINE_R full-res refine around the upscaled coarse winner,
-    with the zero MV always evaluated first (rank 0) so static content
-    stays skip-eligible no matter what the coarse level hallucinated.
-
-    Deterministic total order: zero MV, then refine candidates in raster
-    (dy outer) order; ties resolve to the earlier rank. The device mirror
-    (encoder_core.hier_motion_search) must match element-exactly.
+    Returns (TOPK, 2) int32 coarse MVs (downsampled units). Ties in the
+    vote count resolve to the lower candidate rank (zero-first raster),
+    mirrored exactly by the device path. Desktop motion is dominated by a
+    few global displacements (scroll/pan/drag), which is what makes a
+    frame-level candidate set competitive with per-MB search at a fraction
+    of the cost — and it keeps the device path free of gathers, which are
+    pathologically slow on TPU (tools/profile_slope2.py: 30 ms per
+    full-plane gather vs 0.26 ms per global-shift SAD map).
     """
     h, w = y.shape
     mbh, mbw = h // 16, w // 16
     yd = downsample4(y)
     rd = downsample4(ref_y)
-
-    # -- coarse level: global-shift SAD over 4x4 coarse blocks --
     pad = COARSE_R
     rp = np.pad(rd, pad, mode="edge")
-    best_sad = np.full((mbh, mbw), np.iinfo(np.int64).max)
-    base = np.zeros((mbh, mbw, 2), np.int32)
+    hd, wd = yd.shape
     cand = sorted(
         ((dx, dy) for dy in range(-COARSE_R, COARSE_R + 1) for dx in range(-COARSE_R, COARSE_R + 1)),
         key=lambda c: (c != (0, 0)),
     )
-    hd, wd = yd.shape
-    for dx, dy in cand:
+    best_sad = np.full((mbh, mbw), np.iinfo(np.int64).max)
+    best_rank = np.zeros((mbh, mbw), np.int32)
+    for rank, (dx, dy) in enumerate(cand):
         shifted = rp[pad + dy : pad + dy + hd, pad + dx : pad + dx + wd]
         sad = np.abs(yd - shifted).reshape(mbh, 4, mbw, 4).sum(axis=(1, 3))
         better = sad < best_sad
         best_sad = np.where(better, sad, best_sad)
-        base[better] = (dx, dy)
-    base = base * COARSE_DS  # full-pel units
+        best_rank = np.where(better, rank, best_rank)
+    votes = np.bincount(best_rank.reshape(-1), minlength=len(cand))
+    # deterministic top-K: score = votes desc, then rank asc
+    order = np.lexsort((np.arange(len(cand)), -votes))
+    return np.array([cand[i] for i in order[:TOPK]], np.int32)
 
-    # -- full-res refine: zero MV first, then raster around the base --
+
+def refine_candidate_list(coarse: np.ndarray) -> np.ndarray:
+    """Full-res candidate shift list: zero MV (rank 0), then for each
+    global candidate g the raster grid g*COARSE_DS + (dx, dy),
+    |dx|,|dy| <= REFINE_R. Duplicates are harmless (earlier rank wins)."""
+    out = [(0, 0)]
+    for g in coarse:
+        for dy in range(-REFINE_R, REFINE_R + 1):
+            for dx in range(-REFINE_R, REFINE_R + 1):
+                out.append((int(g[0]) * COARSE_DS + dx, int(g[1]) * COARSE_DS + dy))
+    return np.array(out, np.int32)
+
+
+def hier_search_me(y: np.ndarray, ref_y: np.ndarray) -> np.ndarray:
+    """Global-candidate hierarchical full-pel ME (golden model).
+
+    Level 1 picks TOPK dominant coarse displacements by per-MB vote;
+    level 0 evaluates global-shift SAD maps for every refine candidate
+    (zero MV first) and each MB takes the earliest-ranked minimum. All
+    full-res work is global shifts — the device mirror runs entirely on
+    dynamic slices + dense selects (no gathers).
+    """
+    h, w = y.shape
+    mbh, mbw = h // 16, w // 16
+    cands = refine_candidate_list(coarse_vote_candidates(y, ref_y))
     ref_pad = pad_ref(ref_y)
     cur = y.astype(np.int64)
-
-    def gather_sad(mvs):
-        mvx = np.repeat(np.repeat(mvs[..., 0], 16, 0), 16, 1)
-        mvy = np.repeat(np.repeat(mvs[..., 1], 16, 0), 16, 1)
-        iy = np.arange(h)[:, None] + mvy + MV_PAD
-        ix = np.arange(w)[None, :] + mvx + MV_PAD
-        pred = ref_pad[iy, ix].astype(np.int64)
-        return np.abs(cur - pred).reshape(mbh, 16, mbw, 16).sum(axis=(1, 3))
-
-    best_sad = gather_sad(np.zeros((mbh, mbw, 2), np.int32))
+    best_sad = np.full((mbh, mbw), np.iinfo(np.int64).max)
     best_mv = np.zeros((mbh, mbw, 2), np.int32)
-    for dy in range(-REFINE_R, REFINE_R + 1):
-        for dx in range(-REFINE_R, REFINE_R + 1):
-            mvs = base + np.array([dx, dy], np.int32)
-            sad = gather_sad(mvs)
-            better = sad < best_sad
-            best_sad = np.where(better, sad, best_sad)
-            best_mv = np.where(better[..., None], mvs, best_mv)
+    for dx, dy in cands:
+        shifted = ref_pad[
+            MV_PAD + dy : MV_PAD + dy + h, MV_PAD + dx : MV_PAD + dx + w
+        ].astype(np.int64)
+        sad = np.abs(cur - shifted).reshape(mbh, 16, mbw, 16).sum(axis=(1, 3))
+        better = sad < best_sad
+        best_sad = np.where(better, sad, best_sad)
+        best_mv[better] = (dx, dy)
     return best_mv
 
 

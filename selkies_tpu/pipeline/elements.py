@@ -14,6 +14,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Awaitable, Callable, Protocol
 
@@ -91,8 +92,14 @@ class VideoPipeline:
         self.on_geometry_change: Callable[[int, int], object] | None = None
         self._task: asyncio.Task | None = None
         self._sender: asyncio.Task | None = None
-        self._latest: EncodedFrame | None = None
+        # ordered handoff to the sender task: every ENCODED frame must be
+        # sent (dropping a P frame mid-chain would desync the decoder's
+        # reference chain); a slow sink instead backpressures pre-encode —
+        # capture ticks are skipped while the outbox is full, matching the
+        # reference's leaky queue upstream of the encoder.
+        self._outbox: deque[EncodedFrame] = deque()
         self._frame_ready = asyncio.Event()
+        self.outbox_depth = 4
         self.frames = 0
         self.dropped_ticks = 0
         self.dropped_frames = 0
@@ -137,6 +144,11 @@ class VideoPipeline:
                 await asyncio.sleep(next_tick - now)
             next_tick = max(next_tick + 1.0 / self.fps, time.monotonic() - 0.5 / self.fps)
 
+            if len(self._outbox) >= self.outbox_depth:
+                # sink can't keep up: skip this capture tick (pre-encode
+                # drop keeps the encoded P-chain gapless)
+                self.dropped_frames += 1
+                continue
             try:
                 frame = await asyncio.to_thread(self.source.capture)
                 if frame.shape[:2] != (self.encoder.height, self.encoder.width):
@@ -149,22 +161,47 @@ class VideoPipeline:
                             frame.shape[1], frame.shape[0], self.encoder.width, self.encoder.height,
                         )
                         continue
+                    old = self.encoder
                     self.encoder = self.on_geometry_change(frame.shape[1], frame.shape[0])
+                    if old is not self.encoder and hasattr(old, "close"):
+                        # drain + stop the old encoder's worker pool; its
+                        # in-flight frames are stale-geometry, discard them
+                        await asyncio.to_thread(old.close)
                 qp = self.rc.frame_qp()
-                au = await asyncio.to_thread(self.encoder.encode_frame, frame, qp)
-                stats = self.encoder.last_stats
-                self.rc.update(len(au))
                 ts = int((time.monotonic() - t0) * 90000)
-                ef = EncodedFrame(
-                    au=au,
-                    timestamp_90k=ts,
-                    wall_time=time.time(),
-                    idr=stats.idr,
-                    qp=stats.qp,
-                    device_ms=stats.device_ms,
-                    pack_ms=stats.pack_ms,
-                )
-                self.frames += 1
+                if hasattr(self.encoder, "submit"):
+                    # pipelined path: dispatch this frame, emit whichever
+                    # earlier frames completed (device latency hidden)
+                    done = await asyncio.to_thread(self.encoder.submit, frame, qp, ts)
+                    efs = [
+                        EncodedFrame(
+                            au=au,
+                            timestamp_90k=meta,
+                            wall_time=time.time(),
+                            idr=stats.idr,
+                            qp=stats.qp,
+                            device_ms=stats.device_ms,
+                            pack_ms=stats.pack_ms,
+                        )
+                        for au, stats, meta in done
+                    ]
+                else:
+                    au = await asyncio.to_thread(self.encoder.encode_frame, frame, qp)
+                    stats = self.encoder.last_stats
+                    efs = [
+                        EncodedFrame(
+                            au=au,
+                            timestamp_90k=ts,
+                            wall_time=time.time(),
+                            idr=stats.idr,
+                            qp=stats.qp,
+                            device_ms=stats.device_ms,
+                            pack_ms=stats.pack_ms,
+                        )
+                    ]
+                for ef in efs:
+                    self.rc.update(len(ef.au))
+                self.frames += len(efs)
                 failures = 0
             except asyncio.CancelledError:
                 raise
@@ -175,24 +212,19 @@ class VideoPipeline:
                     logger.error("video pipeline giving up after %d failures", failures)
                     return
                 continue
-            # depth-1 latest-wins handoff to the sender task: a slow sink
-            # drops frames instead of back-pressuring capture/encode.
-            if self._latest is not None:
-                self.dropped_frames += 1
-            self._latest = ef
-            self._frame_ready.set()
+            self._outbox.extend(efs)
+            if efs:
+                self._frame_ready.set()
 
     async def _send_loop(self) -> None:
         while True:
             await self._frame_ready.wait()
             self._frame_ready.clear()
-            ef = self._latest
-            self._latest = None
-            if ef is None:
-                continue
-            try:
-                await self.sink(ef)
-            except asyncio.CancelledError:
-                raise
-            except Exception:
-                logger.exception("video sink error")
+            while self._outbox:
+                ef = self._outbox.popleft()
+                try:
+                    await self.sink(ef)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    logger.exception("video sink error")

@@ -1,0 +1,98 @@
+#!/usr/bin/env python3
+"""Split the real encode loop's wall time: host convert / plane upload /
+dispatch+compute / header fetch / data fetch / CAVLC pack."""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+
+from selkies_tpu.models.h264.encoder import TPUH264Encoder, _fetch_prefix
+from selkies_tpu.models.h264.compact import unpack_p_compact
+from selkies_tpu.models.h264.native import pack_slice_p_fast
+
+H, W = 1080, 1920
+N = 10
+
+
+def frames():
+    rng = np.random.default_rng(42)
+    base = rng.integers(0, 256, size=(H // 8, W // 8, 4), dtype=np.uint8)
+    return [
+        np.ascontiguousarray(np.kron(np.roll(base, i, axis=1), np.ones((8, 8, 1), dtype=np.uint8)))
+        for i in range(4)
+    ]
+
+
+def main():
+    fs = frames()
+    enc = TPUH264Encoder(W, H, qp=28, pipeline_depth=0)
+    for f in fs[:3]:
+        enc.encode_frame(f)
+
+    # 1. sync end-to-end
+    t0 = time.perf_counter()
+    for i in range(N):
+        enc.encode_frame(fs[i % 4])
+    e2e = (time.perf_counter() - t0) / N * 1e3
+    print(f"sync encode_frame:            {e2e:7.1f} ms/frame")
+
+    # 2. host convert alone
+    t0 = time.perf_counter()
+    for i in range(N):
+        enc._prep.convert(fs[i % 4])
+    print(f"host convert:                 {(time.perf_counter()-t0)/N*1e3:7.1f} ms/frame")
+
+    # 3. plane upload alone (device_put + block)
+    y, u, v = enc._prep.convert(fs[0])
+    t0 = time.perf_counter()
+    for i in range(N):
+        arrs = [jax.device_put(p) for p in (y, u, v)]
+        jax.block_until_ready(arrs)
+    print(f"plane upload (sync):          {(time.perf_counter()-t0)/N*1e3:7.1f} ms/frame")
+
+    # 4. device-resident loop: no upload, full fetch+pack
+    yd, ud, vd = (jax.device_put(p) for p in (y, u, v))
+    jax.block_until_ready([yd, ud, vd])
+    qp = np.int32(28)
+    t0 = time.perf_counter()
+    for i in range(N):
+        header_d, buf_d, ry, ru, rv = enc._step_p(yd, ud, vd, qp, *enc._ref)
+        enc._ref = (ry, ru, rv)
+        header = np.asarray(header_d)
+        t_h = time.perf_counter()
+        data = _fetch_prefix(buf_d, int(header[0]))
+        t_d = time.perf_counter()
+        pfc = unpack_p_compact(header, data, 28)
+        nal = pack_slice_p_fast(pfc, enc.params, frame_num=1)
+    total = (time.perf_counter() - t0) / N * 1e3
+    print(f"device-resident loop:         {total:7.1f} ms/frame (n_rows={int(header[0])})")
+
+    # 5. split: header fetch vs data fetch within one iteration
+    hd_t = dd_t = pk_t = st_t = 0.0
+    for i in range(N):
+        s0 = time.perf_counter()
+        header_d, buf_d, ry, ru, rv = enc._step_p(yd, ud, vd, qp, *enc._ref)
+        enc._ref = (ry, ru, rv)
+        s1 = time.perf_counter()
+        header = np.asarray(header_d)
+        s2 = time.perf_counter()
+        data = _fetch_prefix(buf_d, int(header[0]))
+        s3 = time.perf_counter()
+        pfc = unpack_p_compact(header, data, 28)
+        nal = pack_slice_p_fast(pfc, enc.params, frame_num=1)
+        s4 = time.perf_counter()
+        st_t += s1 - s0
+        hd_t += s2 - s1
+        dd_t += s3 - s2
+        pk_t += s4 - s3
+    print(f"  dispatch: {st_t/N*1e3:6.1f}  header fetch: {hd_t/N*1e3:6.1f}  "
+          f"data fetch: {dd_t/N*1e3:6.1f}  unpack+pack: {pk_t/N*1e3:6.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

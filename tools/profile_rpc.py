@@ -1,0 +1,64 @@
+#!/usr/bin/env python3
+"""Relay RPC cost model: per-op overhead vs bandwidth, and whether RPCs
+overlap across Python threads (decides the pipelining strategy)."""
+
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+
+def t(f, n=5):
+    f()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        f()
+    return (time.perf_counter() - t0) / n * 1e3
+
+
+def main():
+    rng = np.random.default_rng(0)
+    one = rng.integers(0, 255, (3_110_400,), np.uint8)      # 3.1 MB
+    parts = [one[:2_073_600], one[2_073_600:2_592_000], one[2_592_000:]]
+
+    print(f"upload 1x3.1MB sync: {t(lambda: jax.block_until_ready(jax.device_put(one))):6.0f} ms")
+    print(f"upload 3 parts sync-each: {t(lambda: [jax.block_until_ready(jax.device_put(p)) for p in parts]):6.0f} ms")
+    print(f"upload 3 parts block-once: {t(lambda: jax.block_until_ready([jax.device_put(p) for p in parts])):6.0f} ms")
+
+    g = jax.jit(lambda v: v + 1)
+    small = [jax.block_until_ready(g(jax.device_put(np.zeros(65536, np.uint8)))) for _ in range(8)]
+
+    def fetch_serial():
+        for s in small[:4]:
+            np.asarray(g(s))
+
+    def fetch_parallel():
+        with ThreadPoolExecutor(4) as ex:
+            list(ex.map(lambda s: np.asarray(g(s)), small[:4]))
+
+    print(f"4x64KB fetch serial:   {t(fetch_serial, 3):6.0f} ms")
+    print(f"4x64KB fetch 4threads: {t(fetch_parallel, 3):6.0f} ms")
+
+    # does a fetch overlap with an async dispatch chain?
+    big = jax.device_put(np.zeros((2048, 2048), np.float32))
+    heavy = jax.jit(lambda v: jnp.sin(v @ v).sum())
+    jax.block_until_ready(heavy(big))
+
+    def fetch_while_compute():
+        r = heavy(big)          # async dispatch
+        np.asarray(g(small[0])) # fetch on same thread
+        jax.block_until_ready(r)
+
+    print(f"heavy compute alone:   {t(lambda: jax.block_until_ready(heavy(big)), 3):6.0f} ms")
+    print(f"fetch alone:           {t(lambda: np.asarray(g(small[0])), 3):6.0f} ms")
+    print(f"compute+fetch overlap: {t(fetch_while_compute, 3):6.0f} ms")
+
+
+if __name__ == "__main__":
+    main()

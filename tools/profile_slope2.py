@@ -1,0 +1,123 @@
+#!/usr/bin/env python3
+"""Slope-method device timing, done right: every jit is created ONCE,
+chains run k steps inside one jitted scan (one dispatch), and the only
+sync is a scalar fetch. per-step = (t(k2) - t(k1)) / (k2 - k1)."""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+from selkies_tpu.models.h264 import encoder_core as core
+
+H, W = 1088, 1920
+rng = np.random.default_rng(0)
+Y8 = rng.integers(0, 256, (H, W), np.uint8)
+U8 = rng.integers(0, 256, (H // 2, W // 2), np.uint8)
+V8 = rng.integers(0, 256, (H // 2, W // 2), np.uint8)
+
+
+def make_chain(body):
+    """body: (y_u8,) -> scalar-ish; chain: run body k times via scan."""
+
+    def chain(y, k):
+        def step(carry, _):
+            out = body(carry)
+            # perturb carry so steps aren't CSE'd away
+            return (carry + 1) % 251, out
+
+        _, outs = jax.lax.scan(step, y, None, length=k)
+        return outs[-1] if outs.ndim else outs
+
+    return jax.jit(chain, static_argnums=1)
+
+
+def timeit_chain(name, chain, arg, ks=(2, 10), reps=3):
+    for k in ks:
+        jax.block_until_ready(chain(arg, k))  # compile
+    ts = {}
+    for k in ks:
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            v = chain(arg, k)
+            float(np.asarray(v).ravel()[0])  # true sync: scalar d2h
+        ts[k] = (time.perf_counter() - t0) / reps
+    per = (ts[ks[1]] - ts[ks[0]]) / (ks[1] - ks[0]) * 1e3
+    print(f"{name:44s} {per:8.2f} ms/step   (t2={ts[ks[0]]*1e3:.0f}ms t10={ts[ks[1]]*1e3:.0f}ms)")
+
+
+def main():
+    print("device:", jax.devices()[0])
+    y32 = jnp.asarray(Y8.astype(np.int32))
+    ypad = jnp.asarray(np.pad(Y8, core.MV_PAD, mode="edge"))
+
+    # 1. hierarchical ME
+    timeit_chain(
+        "hier ME (coarse scan + 82 gather-SADs)",
+        make_chain(lambda c: core.hier_motion_search(c.astype(jnp.int32), Y8, ypad).sum()),
+        jnp.asarray(Y8.astype(jnp.int32)),
+    )
+
+    # 2. old flat ME
+    timeit_chain(
+        "flat ME +-8 (289-cand chunk scan)",
+        make_chain(lambda c: core.motion_search(c.astype(jnp.int32), ypad).sum()),
+        jnp.asarray(Y8.astype(jnp.int32)),
+    )
+
+    # 3. luma transform+quant+idct chain
+    def txq(c):
+        b = core._plane_to_mb_blocks(c.astype(jnp.int32), 4)
+        w = core.fdct4(b)
+        lv = core.quant4(w, jnp.int32(28), intra=False)
+        rec = core._mb_blocks_to_plane(core.idct4(core.dequant4(lv, jnp.int32(28))))
+        return rec.sum()
+
+    timeit_chain("luma fdct+quant+deq+idct (blocks layout)", make_chain(txq), y32)
+
+    # 4. MC gathers
+    mvs = jnp.asarray(rng.integers(-32, 33, (H // 16, W // 16, 2), np.int32))
+
+    def mc(c):
+        return core.mc_luma(ypad, mvs + (c[0, 0] % 2)).sum()
+
+    timeit_chain("mc_luma full-plane gather", make_chain(mc), y32)
+
+    # 5. compact pack alone (on a precomputed P output)
+    out = jax.jit(lambda a, b, c, d, e, f: core.encode_frame_p_planes(a, b, c, d, e, f, jnp.int32(28)))(
+        Y8, U8, V8, Y8, U8, V8
+    )
+    out = {k: jax.block_until_ready(v) for k, v in out.items()}
+
+    def packer(c):
+        o2 = dict(out)
+        o2["luma_ac"] = out["luma_ac"] + (c[0, 0] % 2)
+        h, b = core.pack_p_compact(o2)
+        return h[0] + b[0, 0].astype(jnp.int32)
+
+    timeit_chain("pack_p_compact (cumsum+scatter)", make_chain(packer), y32)
+
+    # 6. full P step
+    def pstep(c):
+        o = core.encode_frame_p_planes(c.astype(jnp.uint8), U8, V8, Y8, U8, V8, jnp.int32(28))
+        h, b = core.pack_p_compact(o)
+        return h[0]
+
+    timeit_chain("FULL P step + pack", make_chain(pstep), y32)
+
+    # 7. intra frame
+    def istep(c):
+        o = core.encode_frame_planes(c, U8, V8, jnp.int32(28))
+        h, b = core.pack_i_compact(o)
+        return h[0]
+
+    timeit_chain("FULL I step + pack (row scan)", make_chain(istep), y32)
+
+
+if __name__ == "__main__":
+    main()
