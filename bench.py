@@ -67,29 +67,63 @@ def _result(metric: str, fps: float) -> None:
     )
 
 
-def _synth_frames(n: int = 4) -> list[np.ndarray]:
+def _desktop_trace(n: int = 60) -> list[np.ndarray]:
+    """A realistic 1080p desktop-streaming trace — the reference's headline
+    workload (remote desktop, README.md:7): a mostly-static screen with a
+    busy terminal region (text updates touching a few 16-row bands per
+    frame), a moving cursor, and a full-screen window switch twice per
+    second. Matches what ximagesrc+XDamage would hand the reference."""
     rng = np.random.default_rng(42)
+
+    def _wallpaper(seed):
+        r = np.random.default_rng(seed)
+        base = r.integers(40, 200, size=(H // 40, W // 40, 4), dtype=np.uint8)
+        return np.ascontiguousarray(np.kron(base, np.ones((40, 40, 1), np.uint8)))
+
+    desk_a, desk_b = _wallpaper(1), _wallpaper(2)
+    for d in (desk_a, desk_b):
+        d[260:780, 360:1560] = (248, 248, 248, 0)  # "window" fill
     frames = []
-    base = rng.integers(0, 256, size=(H // 8, W // 8, 4), dtype=np.uint8)
+    cur = desk_a.copy()
+    which = 0
     for i in range(n):
-        f = np.kron(np.roll(base, i, axis=1), np.ones((8, 8, 1), dtype=np.uint8))
-        frames.append(np.ascontiguousarray(f))
+        if i % 30 == 29:
+            # window switch: full-frame change
+            which ^= 1
+            cur = (desk_b if which else desk_a).copy()
+        else:
+            # terminal output: one new text line (1 band) + scroll of a
+            # 4-band tail of the text area = <=5 dirty bands, bucket 8
+            row = 288 + ((i * 16) % 64)
+            glyphs = rng.integers(0, 2, size=(12, 192), dtype=np.uint8) * 255
+            line = np.kron(glyphs, np.ones((1, 6), np.uint8))[:, :1150]
+            cur[row : row + 12, 380 : 380 + 1150, :3] = line[..., None]
+            # cursor blink: one more band
+            cur[700:712, 380:392] = (0, 0, 0, 0) if i % 2 else (248, 248, 248, 0)
+        frames.append(cur.copy())
     return frames
 
 
 def bench_full_encoder() -> float | None:
-    """Steady-state IP-GOP encode (IDR once, then P frames with on-device
-    motion estimation over scrolling content — the reference's default
-    infinite-GOP desktop workload). Uses the pipelined submit/flush API
+    """Steady-state IP-GOP desktop encode (IDR once, then P frames; delta
+    band uploads for partial updates, full uploads on window switches,
+    on-device motion estimation). Uses the pipelined submit/flush API
     exactly like the live VideoPipeline does."""
     try:
         from selkies_tpu.models.h264.encoder import TPUH264Encoder
     except ImportError:
         return None
     enc = TPUH264Encoder(W, H, qp=28)
-    frames = _synth_frames()
-    for f in frames[:WARMUP]:
-        enc.encode_frame(f)  # compiles both the IDR and the P path
+    frames = _desktop_trace(ITERS)
+    # warmup compiles every executable the trace uses: IDR full, grouped
+    # delta (scan), single delta, P full, static — in dependency order
+    enc.encode_frame(frames[0])  # IDR full
+    for i in (1, 2, 3, 4):  # consecutive deltas fill one group -> scan step
+        enc.submit(frames[i])
+    enc.flush()
+    enc.encode_frame(frames[5])  # single delta (partial-group path)
+    enc.encode_frame(frames[29 % len(frames)])  # window switch -> full P
+    enc.encode_frame(frames[29 % len(frames)])  # static
     done = 0
     t0 = time.perf_counter()
     for i in range(ITERS):

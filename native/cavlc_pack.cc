@@ -474,4 +474,38 @@ int64_t emulation_prevent(const uint8_t* rbsp, int64_t n, uint8_t* out, int64_t 
   return o;
 }
 
+
+// Fill the motion vectors of P_Skip MBs in place (8.4.1.1). The sparse
+// downlink (encoder_core.pack_p_sparse) transmits MVs only for coded
+// MBs; a skip MB's MV is fully determined by its neighbors, so it is
+// re-derived here exactly as a decoder would, in raster order (every
+// neighbor an MB reads is already final). Mirrors
+// numpy_ref.skip_mv_16x16 / mv_pred_16x16.
+void derive_skip_mvs(int32_t* mvs /* (mbh, mbw, 2) */, const uint8_t* skip,
+                     int mbh, int mbw) {
+    for (int y = 0; y < mbh; ++y) {
+        for (int x = 0; x < mbw; ++x) {
+            if (!skip[y * mbw + x]) continue;
+            int32_t* out = mvs + 2 * (y * mbw + x);
+            if (x == 0 || y == 0) { out[0] = 0; out[1] = 0; continue; }
+            const int32_t* A = mvs + 2 * (y * mbw + x - 1);
+            const int32_t* B = mvs + 2 * ((y - 1) * mbw + x);
+            if ((A[0] == 0 && A[1] == 0) || (B[0] == 0 && B[1] == 0)) {
+                out[0] = 0; out[1] = 0;
+                continue;
+            }
+            // median prediction; C = top-right, or top-left when x is the
+            // last column (both neighbors exist here: x>0 and y>0)
+            const int32_t* C = (x + 1 < mbw) ? mvs + 2 * ((y - 1) * mbw + x + 1)
+                                             : mvs + 2 * ((y - 1) * mbw + x - 1);
+            for (int i = 0; i < 2; ++i) {
+                const int a = A[i], b = B[i], c = C[i];
+                int mx = a > b ? a : b; if (c > mx) mx = c;
+                int mn = a < b ? a : b; if (c < mn) mn = c;
+                out[i] = a + b + c - mx - mn;
+            }
+        }
+    }
+}
+
 }  // extern "C"

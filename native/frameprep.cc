@@ -83,6 +83,76 @@ void bgrx_to_i420_pad(const uint8_t* src, int h, int w, int ph, int pw,
     }
 }
 
+// Convert k 16-row bands of src ((h, w, 4) BGRx) to packed I420 band
+// buffers: yb (k, 16, pw), ub/vb (k, 8, pw/2). band_idx[i] selects the
+// band (luma rows 16*idx..16*idx+15 of the PADDED plane). Output is
+// bit-exact with the same rows of bgrx_to_i420_pad, including the
+// replicated right/bottom padding, so scattering a band into a
+// device-resident plane reproduces the full conversion. This is the
+// delta-upload path: only changed bands cross the host->device link
+// (the reference gets the analogous effect from ximagesrc's XDamage).
+void bgrx_to_i420_bands(const uint8_t* src, int h, int w, int pw,
+                        const int32_t* band_idx, int k,
+                        uint8_t* yb, uint8_t* ub, uint8_t* vb) {
+    const int cw = w / 2, ch = h / 2;
+    const int cpw = pw / 2;
+    for (int b = 0; b < k; ++b) {
+        const int g0 = band_idx[b] * 16;  // first luma row of the band
+        uint8_t* ybb = yb + static_cast<size_t>(b) * 16 * pw;
+        uint8_t* ubb = ub + static_cast<size_t>(b) * 8 * cpw;
+        uint8_t* vbb = vb + static_cast<size_t>(b) * 8 * cpw;
+        for (int p = 0; p < 8; ++p) {  // row pair: luma g0+2p, g0+2p+1
+            const int r = g0 + 2 * p;
+            uint8_t* y0 = ybb + static_cast<size_t>(2 * p) * pw;
+            uint8_t* y1 = y0 + pw;
+            uint8_t* ur = ubb + static_cast<size_t>(p) * cpw;
+            uint8_t* vr = vbb + static_cast<size_t>(p) * cpw;
+            if (r + 1 < h || r < h) {
+                // content pair (h is even, so r < h implies r+1 < h)
+                const uint8_t* row0 = src + static_cast<size_t>(r) * w * 4;
+                const uint8_t* row1 = row0 + static_cast<size_t>(w) * 4;
+                for (int c2 = 0; c2 < cw; ++c2) {
+                    int usum = 0, vsum = 0;
+                    const uint8_t* pr[2] = {row0 + 8 * c2, row1 + 8 * c2};
+                    for (int dy = 0; dy < 2; ++dy) {
+                        for (int dx = 0; dx < 2; ++dx) {
+                            const uint8_t* px = pr[dy] + 4 * dx;
+                            const int bb = px[0], gg = px[1], rr = px[2];
+                            const int yy = ((66 * rr + 129 * gg + 25 * bb + 128) >> 8) + 16;
+                            const int uu = ((-38 * rr - 74 * gg + 112 * bb + 128) >> 8) + 128;
+                            const int vv = ((112 * rr - 94 * gg - 18 * bb + 128) >> 8) + 128;
+                            (dy ? y1 : y0)[2 * c2 + dx] = clip_u8(yy, 16, 235);
+                            usum += uu < 16 ? 16 : (uu > 240 ? 240 : uu);
+                            vsum += vv < 16 ? 16 : (vv > 240 ? 240 : vv);
+                        }
+                    }
+                    ur[c2] = static_cast<uint8_t>((usum + 2) >> 2);
+                    vr[c2] = static_cast<uint8_t>((vsum + 2) >> 2);
+                }
+                for (int c = w; c < pw; ++c) {
+                    y0[c] = y0[w - 1];
+                    y1[c] = y1[w - 1];
+                }
+                for (int c = cw; c < cpw; ++c) {
+                    ur[c] = ur[cw - 1];
+                    vr[c] = vr[cw - 1];
+                }
+            } else {
+                // padding pair: replicate the plane's last content rows.
+                // Those rows live in THIS band (pad - h < 16), already
+                // converted by an earlier pair.
+                const uint8_t* ylast = ybb + static_cast<size_t>(h - 1 - g0) * pw;
+                std::memcpy(y0, ylast, pw);
+                std::memcpy(y1, ylast, pw);
+                const uint8_t* ulast = ubb + static_cast<size_t>(ch - 1 - g0 / 2) * cpw;
+                const uint8_t* vlast = vbb + static_cast<size_t>(ch - 1 - g0 / 2) * cpw;
+                std::memcpy(ur, ulast, cpw);
+                std::memcpy(vr, vlast, cpw);
+            }
+        }
+    }
+}
+
 // Compare cur vs prev (both (h, w, 4) BGRx) in bands of `band` rows.
 // out[i] = 1 if band i differs. Returns the number of changed bands.
 int band_diff(const uint8_t* cur, const uint8_t* prev, int h, int w, int band,

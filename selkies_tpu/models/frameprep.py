@@ -41,26 +41,35 @@ def _load() -> ctypes.CDLL | None:
     if _lib_tried:
         return _lib
     _lib_tried = True
-    if not os.path.exists(_LIB_PATH) and os.path.exists(os.path.join(_NATIVE_DIR, "Makefile")):
+    # always invoke make: it is a no-op when fresh and rebuilds a stale
+    # .so after a frameprep.cc change (new exported symbols). A build
+    # failure (no toolchain) is not fatal — a prebuilt .so may exist.
+    if os.path.exists(os.path.join(_NATIVE_DIR, "Makefile")):
         try:
             subprocess.run(
                 ["make", "-C", _NATIVE_DIR, "-s", "libframeprep.so"],
                 check=True, capture_output=True, timeout=120,
             )
         except (OSError, subprocess.SubprocessError) as exc:
-            logger.warning("could not build libframeprep.so (%s); numpy fallback", exc)
-            return None
+            logger.warning("could not (re)build libframeprep.so (%s); trying prebuilt", exc)
     try:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError as exc:
         logger.warning("could not load libframeprep.so (%s); numpy fallback", exc)
         return None
     u8p = ctypes.POINTER(ctypes.c_uint8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
     lib.bgrx_to_i420_pad.restype = None
     lib.bgrx_to_i420_pad.argtypes = [u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
                                      ctypes.c_int, u8p, u8p, u8p]
     lib.band_diff.restype = ctypes.c_int
     lib.band_diff.argtypes = [u8p, u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, u8p]
+    try:
+        lib.bgrx_to_i420_bands.restype = None
+        lib.bgrx_to_i420_bands.argtypes = [u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                                           i32p, ctypes.c_int, u8p, u8p, u8p]
+    except AttributeError:
+        pass  # stale .so without the band converter; numpy fallback used
     _lib = lib
     return lib
 
@@ -144,6 +153,34 @@ class FramePrep:
             y2, u2, v2 = _numpy_convert_pad(frame, self.pad_h, self.pad_w)
             y[:], u[:], v[:] = y2, u2, v2
         return y, u, v
+
+    def convert_bands(self, frame: np.ndarray, idx: np.ndarray):
+        """Convert only the 16-row bands listed in idx (int32, plane band
+        numbers) to packed I420 band buffers: (k, 16, pad_w) luma and
+        (k, 8, pad_w/2) chroma, bit-exact with the same rows of a full
+        convert(). Fresh arrays per call — safe to hand to an async
+        device upload with no slot-rotation hazard."""
+        if frame.shape != (self.height, self.width, 4):
+            raise ValueError(f"frame {frame.shape} != {(self.height, self.width, 4)}")
+        if not frame.flags["C_CONTIGUOUS"]:
+            frame = np.ascontiguousarray(frame)
+        idx = np.ascontiguousarray(idx, np.int32)
+        k = len(idx)
+        yb = np.empty((k, 16, self.pad_w), np.uint8)
+        ub = np.empty((k, 8, self.pad_w // 2), np.uint8)
+        vb = np.empty((k, 8, self.pad_w // 2), np.uint8)
+        if self._lib is not None and hasattr(self._lib, "bgrx_to_i420_bands"):
+            self._lib.bgrx_to_i420_bands(
+                _u8p(frame), self.height, self.width, self.pad_w,
+                idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), k,
+                _u8p(yb), _u8p(ub), _u8p(vb),
+            )
+        else:
+            y, u, v = _numpy_convert_pad(frame, self.pad_h, self.pad_w)
+            yb[:] = y.reshape(-1, 16, self.pad_w)[idx]
+            ub[:] = u.reshape(-1, 8, self.pad_w // 2)[idx]
+            vb[:] = v.reshape(-1, 8, self.pad_w // 2)[idx]
+        return yb, ub, vb
 
     def dirty_bands(self, frame: np.ndarray) -> np.ndarray | None:
         """Which 16-row bands changed vs the previous call's frame.

@@ -21,6 +21,7 @@ from selkies_tpu.models.h264.encoder_core import (
     P_ROW_CHROMA,
     P_ROW_DC,
 )
+from selkies_tpu.models.h264.native import derive_skip_mvs_fast
 from selkies_tpu.models.h264.numpy_ref import FrameCoeffs, PFrameCoeffs
 
 
@@ -81,6 +82,54 @@ def unpack_p_compact(header: np.ndarray, data: np.ndarray, qp: int) -> PFrameCoe
         luma_ac=luma_ac,
         chroma_dc=chroma_dc,
         chroma_ac=chroma_ac,
+        qp=qp,
+    )
+
+
+def p_sparse_header_words(mbh: int, mbw: int, nscap: int) -> int:
+    m = mbh * mbw
+    return 4 + (m + 31) // 32 + 2 * nscap
+
+
+def unpack_p_sparse(header: np.ndarray, data: np.ndarray, qp: int, nscap: int) -> PFrameCoeffs:
+    """Sparse header (encoder_core.pack_p_sparse) -> dense PFrameCoeffs.
+
+    Returns None when ns > nscap: the caller must fall back to fetching
+    the dense header (the device emits it alongside)."""
+    n, mbh, mbw, ns = (int(x) for x in header[:4])
+    m = mbh * mbw
+    if ns > nscap:
+        return None
+    if data.shape[0] < n:
+        raise ValueError(f"data has {data.shape[0]} rows, header says {n}")
+    sw = (m + 31) // 32
+    skip_words = header[4 : 4 + sw].astype(np.int64) & 0xFFFFFFFF
+    skip_bits = ((skip_words[:, None] >> np.arange(32)) & 1).astype(bool).reshape(-1)[:m]
+    mv_c = header[4 + sw : 4 + sw + nscap][:ns].astype(np.int32)
+    info_c = header[4 + sw + nscap : 4 + sw + 2 * nscap][:ns].astype(np.int32)
+    pos = np.flatnonzero(~skip_bits)
+    if len(pos) != ns:
+        raise ValueError(f"skip bitmap has {len(pos)} non-skip MBs, header says {ns}")
+    mv_words = np.zeros(m, np.int32)
+    mv_words[pos] = mv_c
+    mbinfo = np.zeros(m, np.int32)
+    mbinfo[pos] = info_c
+    mvx = (mv_words << 16) >> 16
+    mvy = mv_words >> 16
+    flags = _flags_from_bitmap(mbinfo, P_ENTRIES)
+    rows = _scatter_rows(flags, data)
+    skip = skip_bits.reshape(mbh, mbw)
+    mvs = np.ascontiguousarray(np.stack([mvx, mvy], -1).reshape(mbh, mbw, 2))
+    # skip MBs carry DERIVED (possibly nonzero) MVs that neighbor MV
+    # prediction depends on; the sparse downlink omits them, so re-derive
+    # exactly as a decoder would (8.4.1.1)
+    derive_skip_mvs_fast(mvs, skip)
+    return PFrameCoeffs(
+        mvs=mvs,
+        skip=skip,
+        luma_ac=rows[:, :P_ROW_CHROMA].reshape(mbh, mbw, 4, 4, 4, 4).astype(np.int32),
+        chroma_dc=rows[:, P_ROW_DC:P_ENTRIES, :4].reshape(mbh, mbw, 2, 2, 2).astype(np.int32),
+        chroma_ac=rows[:, P_ROW_CHROMA:P_ROW_DC].reshape(mbh, mbw, 2, 2, 2, 4, 4).astype(np.int32),
         qp=qp,
     )
 

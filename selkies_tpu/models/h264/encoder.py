@@ -20,6 +20,7 @@ from __future__ import annotations
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
+from functools import partial
 from dataclasses import dataclass
 
 import jax
@@ -34,9 +35,11 @@ from selkies_tpu.models.h264.bitstream import StreamParams, write_pps, write_sps
 from selkies_tpu.models.h264.compact import (
     i_header_words,
     p_header_words,
+    p_sparse_header_words,
     split_prefix,
     unpack_i_compact,
     unpack_p_compact,
+    unpack_p_sparse,
 )
 from selkies_tpu.models.h264.encoder_core import (
     encode_frame_p_planes,
@@ -44,6 +47,8 @@ from selkies_tpu.models.h264.encoder_core import (
     fuse_downlink,
     pack_i_compact,
     pack_p_compact,
+    pack_p_sparse,
+    scatter_bands,
 )
 from selkies_tpu.models.h264.native import pack_slice_fast, pack_slice_p_fast
 from selkies_tpu.ops.colorspace import bgrx_to_i420, rgb_to_i420
@@ -69,6 +74,14 @@ def _convert_pad(frame, *, pad_h: int, pad_w: int, channels: int):
 # transfers per op (~200 ms, tools/profile_rpc.py), so typical frames must
 # complete in ONE fetch; frames with more nonzero rows pay a second fetch.
 CAP_ROWS = 4096
+# Delta frames use a skip-aware sparse header: mv/mbinfo words for up to
+# NSCAP non-skip MBs instead of all M (64 KB dense at 1080p). NSCAP and
+# the row cap are sized to swallow the quantization-error decay tail that
+# follows a full-frame change in ONE fetch (ns up to ~4k for ~10 frames,
+# tools/ profiling) — a second fetch mid-pipeline costs more than the
+# larger prefix.
+CAP_ROWS_DELTA = 4096
+NSCAP = 4096
 
 
 def _device_step(frame, qp, *, pad_h: int, pad_w: int, channels: int):
@@ -100,15 +113,96 @@ def _p_planes_step(y, u, v, qp, ref_y, ref_u, ref_v):
     return prefix, buf, out["recon_y"], out["recon_u"], out["recon_v"]
 
 
-def _fetch_rest(buf, n: int) -> np.ndarray:
-    """Overflow path: rows [CAP_ROWS, n) in power-of-two buckets."""
+# Delta steps: only the dirty bands cross the link; the full frame is
+# assembled on device by scattering them into the resident source planes
+# (donated -> in-place). Each returns the updated source planes so the
+# encoder can keep them resident for the next frame's delta. The bands +
+# indices ride in ONE packed uint8 buffer: the relay prices host<->device
+# traffic per operation (tools/profile_rpc.py), so one upload beats four.
+
+
+def _unpack_delta(packed, w):
+    """packed: [idx int32 LE bytes (k,4)] ++ yb ++ ub ++ vb, k inferred."""
+    per_band = 4 + 24 * w  # 4 idx bytes + 16*w luma + 2*(8*(w//2)) chroma
+    k = packed.shape[0] // per_band
+    idx = jax.lax.bitcast_convert_type(packed[: 4 * k].reshape(k, 4), jnp.int32)
+    off = 4 * k
+    yb = jax.lax.dynamic_slice_in_dim(packed, off, k * 16 * w).reshape(k, 16, w)
+    off += k * 16 * w
+    ub = jax.lax.dynamic_slice_in_dim(packed, off, k * 8 * (w // 2)).reshape(k, 8, w // 2)
+    off += k * 8 * (w // 2)
+    vb = jax.lax.dynamic_slice_in_dim(packed, off, k * 8 * (w // 2)).reshape(k, 8, w // 2)
+    return yb, ub, vb, idx
+
+
+def _p_scatter_step(packed, qp, sy, su, sv, ref_y, ref_u, ref_v, *, nscap, cap):
+    yb, ub, vb, idx = _unpack_delta(packed, sy.shape[1])
+    y, u, v = scatter_bands(sy, su, sv, yb, ub, vb, idx)
+    out = encode_frame_p_planes(y, u, v, ref_y, ref_u, ref_v, qp)
+    sparse, dense, buf = pack_p_sparse(out, nscap)
+    prefix = fuse_downlink(sparse, buf, cap)
+    return prefix, dense, buf, out["recon_y"], out["recon_u"], out["recon_v"], y, u, v
+
+
+def _i_scatter_step(packed, qp, sy, su, sv):
+    yb, ub, vb, idx = _unpack_delta(packed, sy.shape[1])
+    y, u, v = scatter_bands(sy, su, sv, yb, ub, vb, idx)
+    out = encode_frame_planes(y, u, v, qp)
+    header, buf = pack_i_compact(out)
+    prefix = fuse_downlink(header, buf, CAP_ROWS)
+    return prefix, buf, out["recon_y"], out["recon_u"], out["recon_v"], y, u, v
+
+
+def _p_scatter_multi_step(packed, qps, sy, su, sv, ref_y, ref_u, ref_v, *, nscap, cap):
+    """K delta frames in ONE device round trip.
+
+    packed: (K, F) uint8 — K frames' band payloads (same bucket); qps:
+    (K,) int32 per-frame QP. The scan chains recon: frame k's motion
+    estimation references frame k-1's reconstruction, exactly as K
+    single steps would. One upload + one execute + one prefix fetch
+    instead of 3K relay operations — the relay prices per op, so this is
+    the difference between ~8 and ~30+ fps at 1080p
+    (tools/profile_rpc.py)."""
+    w = sy.shape[1]
+
+    def body(carry, xs):
+        pk, qp = xs
+        cy, cu, cv, ry, ru, rv = carry
+        yb, ub, vb, idx = _unpack_delta(pk, w)
+        y, u, v = scatter_bands(cy, cu, cv, yb, ub, vb, idx)
+        out = encode_frame_p_planes(y, u, v, ry, ru, rv, qp)
+        sparse, dense, buf = pack_p_sparse(out, nscap)
+        prefix = fuse_downlink(sparse, buf, cap)
+        return (
+            (y, u, v, out["recon_y"], out["recon_u"], out["recon_v"]),
+            (prefix, dense, buf),
+        )
+
+    carry, (prefixes, denses, bufs) = jax.lax.scan(
+        body, (sy, su, sv, ref_y, ref_u, ref_v), (packed, qps)
+    )
+    y, u, v, ry, ru, rv = carry
+    return prefixes, denses, bufs, ry, ru, rv, y, u, v
+
+
+def _i_resident_step(qp, sy, su, sv):
+    # IDR over unchanged content (e.g. PLI-forced keyframe on an idle
+    # desktop): zero upload, encode straight from the resident planes
+    out = encode_frame_planes(sy, su, sv, qp)
+    header, buf = pack_i_compact(out)
+    prefix = fuse_downlink(header, buf, CAP_ROWS)
+    return prefix, buf, out["recon_y"], out["recon_u"], out["recon_v"]
+
+
+def _fetch_rest(buf, n: int, base: int = CAP_ROWS) -> np.ndarray:
+    """Overflow path: rows [base, n) in power-of-two buckets."""
     total = buf.shape[0]
-    bucket = CAP_ROWS
+    bucket = base
     while bucket < n:
         bucket <<= 1
     if bucket >= total:
-        return np.asarray(buf)[CAP_ROWS:]
-    return np.asarray(buf[CAP_ROWS:bucket])
+        return np.asarray(buf)[base:]
+    return np.asarray(buf[base:bucket])
 
 
 FrameStats = _FrameStats  # shared definition (models/stats.py)
@@ -118,7 +212,7 @@ FrameStats = _FrameStats  # shared definition (models/stats.py)
 class _Pending:
     """One in-flight frame in the encode pipeline."""
 
-    kind: str  # "static" | "i" | "p"
+    kind: str  # "static" | "i" | "p" | "pd" (sparse-header delta P)
     frame_index: int
     qp: int
     frame_num: int
@@ -129,7 +223,9 @@ class _Pending:
     au: bytes | None = None  # static only
     prefix_d: object = None
     buf_d: object = None
+    hdr_d: object = None  # pd only: dense header for the ns>NSCAP fallback
     future: object = None  # completion future (threaded fetch+unpack+pack)
+    batch_slot: int = -1  # >=0: index into a shared batch future's result list
 
 
 class TPUH264Encoder:
@@ -157,10 +253,13 @@ class TPUH264Encoder:
         keyframe_interval: int = 0,
         host_convert: bool = True,
         pipeline_depth: int = 2,
+        frame_batch: int = 4,
     ):
         self.width = width
         self.height = height
         self.fps = fps
+        self._nscap = NSCAP
+        self._cap_delta = CAP_ROWS_DELTA
         self.set_qp(qp)
         self.channels = channels
         self.keyframe_interval = int(keyframe_interval)  # 0 = infinite GOP
@@ -185,6 +284,21 @@ class TPUH264Encoder:
         if self._prep is not None:
             self._step = jax.jit(_i_planes_step)
             self._step_p = jax.jit(_p_planes_step, donate_argnums=(4, 5, 6))
+            # delta-upload steps: source planes are donated (scatter is
+            # in-place) and returned updated; refs donated as usual
+            # nscap/cap ride in a partial (not read from module globals
+            # inside the traced body): jax's trace cache is keyed on the
+            # function object, so a global read would leak one encoder's
+            # constants into another's executable.
+            _consts = dict(nscap=self._nscap, cap=self._cap_delta)
+            self._step_scatter_p = jax.jit(
+                partial(_p_scatter_step, **_consts), donate_argnums=(2, 3, 4, 5, 6, 7)
+            )
+            self._step_scatter_pk = jax.jit(
+                partial(_p_scatter_multi_step, **_consts), donate_argnums=(2, 3, 4, 5, 6, 7)
+            )
+            self._step_scatter_i = jax.jit(_i_scatter_step, donate_argnums=(2, 3, 4))
+            self._step_resident_i = jax.jit(_i_resident_step)
         else:
             self._step = jax.jit(
                 lambda frame, qp: _device_step(
@@ -199,6 +313,19 @@ class TPUH264Encoder:
                 donate_argnums=(2, 3, 4),
             )
         self._ref = None  # (recon_y, recon_u, recon_v) device arrays
+        self._src = None  # device-resident source planes (delta-upload base)
+        # frame_batch > 1: consecutive delta frames are grouped into one
+        # scan-over-frames device step (one upload/execute/fetch per
+        # GROUP). Trades up to frame_batch-1 frame-times of latency for
+        # K-fold fewer relay round trips; on PCIe-local devices set 1.
+        self.frame_batch = max(1, int(frame_batch))
+        self._batch_pend: list = []  # (rec, yb, ub, vb, idx) to group-dispatch
+        # delta bucket sizes: dirty-band counts round up to one of these so
+        # each resolution compiles a handful of scatter executables; frames
+        # dirtier than the largest bucket use the full-upload path (the
+        # delta would save little and each bucket costs a compile)
+        nbands = self._pad_h // 16
+        self._delta_buckets = tuple(b for b in (4, 8, 16, 32) if b <= nbands // 2)
         self._prev_frame: np.ndarray | None = None  # device-convert mode only
         self._inflight: deque = deque()
         self._pool = ThreadPoolExecutor(
@@ -208,6 +335,7 @@ class TPUH264Encoder:
         mbh, mbw = self._pad_h // 16, self._pad_w // 16
         self._hdr_words_i = i_header_words(mbh, mbw)
         self._hdr_words_p = p_header_words(mbh, mbw)
+        self._hdr_words_pd = p_sparse_header_words(mbh, mbw, self._nscap)
         self._allskip: PFrameCoeffs | None = None
         self.frame_index = 0
         self._frames_since_idr = 0
@@ -225,27 +353,38 @@ class TPUH264Encoder:
     def force_keyframe(self) -> None:
         self._force_idr = True
 
-    # -- static-frame fast path ----------------------------------------
+    # -- frame classification (static / delta / full upload) -----------
 
-    def _is_static(self, frame: np.ndarray) -> bool:
-        """True when the capture is byte-identical to the previous one —
-        the dominant remote-desktop case; it then costs zero device work.
+    def _classify(self, frame: np.ndarray):
+        """-> ("static" | "delta" | "full", dirty_band_indices | None).
 
-        Uses FramePrep's band memcmp when host conversion is on (early-exit
-        per 16-row band, collision-free); otherwise a full compare against
-        a kept copy. Either way the previous-frame state advances, which is
-        safe because any encode failure nulls self._ref and forces an IDR,
-        bypassing this path."""
-        if self._prep is not None:
-            bands = self._prep.dirty_bands(frame)
-            return bands is not None and not bands.any()
-        if self._prev_frame is None or self._prev_frame.shape != frame.shape:
-            self._prev_frame = frame.copy()
-            return False
-        if np.array_equal(self._prev_frame, frame):
-            return True
-        np.copyto(self._prev_frame, frame)
-        return False
+        Compares against the previous capture (FramePrep's per-16-row-band
+        memcmp when host conversion is on). "static": byte-identical — the
+        dominant remote-desktop case, zero device work. "delta": few dirty
+        bands and the device holds resident source planes — upload only
+        the changed bands. "full": everything else. The previous-frame
+        state advances on every call; that is safe because any encode
+        failure nulls self._ref/_src, forcing a full-upload IDR that
+        bypasses the static and delta paths."""
+        if self._prep is None:
+            if self._prev_frame is None or self._prev_frame.shape != frame.shape:
+                self._prev_frame = frame.copy()
+                return "full", None
+            if np.array_equal(self._prev_frame, frame):
+                return "static", None
+            np.copyto(self._prev_frame, frame)
+            return "full", None
+        bands = self._prep.dirty_bands(frame)
+        if bands is None:
+            return "full", None
+        if not bands.any():
+            return "static", None
+        if self._src is None or not self._delta_buckets:
+            return "full", None
+        idx = np.nonzero(bands)[0].astype(np.int32)
+        if len(idx) > self._delta_buckets[-1]:
+            return "full", None
+        return "delta", idx
 
     def _allskip_slice(self, frame_num: int) -> bytes:
         """P slice with every MB P_Skip: recon == ref exactly (zero MV,
@@ -276,14 +415,129 @@ class TPUH264Encoder:
     def _run_step_i(self, frame: np.ndarray):
         if self._prep is not None:
             y, u, v = self._put(self._prep.convert(frame))
-            return self._step(y, u, v, np.int32(self.qp))
+            out = self._step(y, u, v, np.int32(self.qp))
+            # keep the uploaded planes resident: they are the delta base
+            # for the next frame (the I step does not donate them)
+            self._src = (y, u, v)
+            return out
         return self._step(jax.device_put(frame), np.int32(self.qp))
 
     def _run_step_p(self, frame: np.ndarray):
         if self._prep is not None:
             y, u, v = self._put(self._prep.convert(frame))
-            return self._step_p(y, u, v, np.int32(self.qp), *self._ref)
+            out = self._step_p(y, u, v, np.int32(self.qp), *self._ref)
+            self._src = (y, u, v)
+            return out
         return self._step_p(jax.device_put(frame), np.int32(self.qp), *self._ref)
+
+    @staticmethod
+    def _pack_bands(yb, ub, vb, idx, bucket: int) -> np.ndarray:
+        """Pad to `bucket` bands (repeating the last band — scattering a
+        band twice is idempotent) and pack into one upload buffer:
+        [idx int32 bytes] ++ yb ++ ub ++ vb (see _unpack_delta)."""
+        k = len(idx)
+        if k < bucket:
+            reps = bucket - k
+            yb = np.concatenate([yb, np.repeat(yb[-1:], reps, 0)])
+            ub = np.concatenate([ub, np.repeat(ub[-1:], reps, 0)])
+            vb = np.concatenate([vb, np.repeat(vb[-1:], reps, 0)])
+            idx = np.concatenate([idx, np.full(reps, idx[-1], np.int32)])
+        return np.concatenate([idx.view(np.uint8), yb.ravel(), ub.ravel(), vb.ravel()])
+
+    def _run_step_delta(self, frame: np.ndarray, idx: np.ndarray, idr: bool):
+        """Single-frame delta: upload only the dirty bands; scatter+encode
+        on device. Returns (prefix_d, hdr_d, buf_d, recon triple)."""
+        bucket = next(b for b in self._delta_buckets if b >= len(idx))
+        yb, ub, vb = self._prep.convert_bands(frame, idx)
+        packed_d = jax.device_put(self._pack_bands(yb, ub, vb, idx, bucket))
+        qp = np.int32(self.qp)
+        if idr:
+            prefix_d, buf_d, ry, ru, rv, sy, su, sv = self._step_scatter_i(
+                packed_d, qp, *self._src
+            )
+            hdr_d = None
+        else:
+            prefix_d, hdr_d, buf_d, ry, ru, rv, sy, su, sv = self._step_scatter_p(
+                packed_d, qp, *self._src, *self._ref
+            )
+        # reassign IMMEDIATELY: the old src (and refs on P) were donated
+        self._src = (sy, su, sv)
+        return prefix_d, hdr_d, buf_d, ry, ru, rv
+
+    # -- grouped delta dispatch (frame_batch > 1) -----------------------
+
+    BATCH_BUCKETS = (4, 16)
+
+    def _flush_batch(self) -> None:
+        """Dispatch the pending delta group (if any) as ONE device step.
+
+        Must run before any other dispatch so device-side src/ref state
+        advances in frame order."""
+        pend = self._batch_pend
+        if not pend:
+            return
+        self._batch_pend = []
+        try:
+            if len(pend) < self.frame_batch:
+                # partial group (interrupted by a non-groupable frame or a
+                # flush): dispatch as singles — only the K=frame_batch scan
+                # executable ever compiles, partial sizes don't
+                for rec, yb, ub, vb, idx in pend:
+                    bucket = next(b for b in self._delta_buckets if b >= len(idx))
+                    packed_d = jax.device_put(self._pack_bands(yb, ub, vb, idx, bucket))
+                    prefix_d, hdr_d, buf_d, ry, ru, rv, sy, su, sv = self._step_scatter_p(
+                        packed_d, np.int32(rec.qp), *self._src, *self._ref
+                    )
+                    self._src, self._ref = (sy, su, sv), (ry, ru, rv)
+                    rec.prefix_d, rec.hdr_d, rec.buf_d = prefix_d, hdr_d, buf_d
+                    rec.batch_slot = -1
+                    rec.future = self._pool.submit(self._complete_work, rec)
+                return
+            bucket = next(
+                b for b in self.BATCH_BUCKETS if b >= max(len(p[4]) for p in pend)
+            )
+            packed = np.stack(
+                [self._pack_bands(yb, ub, vb, idx, bucket) for _, yb, ub, vb, idx in pend]
+            )
+            qps = np.array([p[0].qp for p in pend], np.int32)
+            prefixes_d, denses_d, bufs_d, ry, ru, rv, sy, su, sv = self._step_scatter_pk(
+                jax.device_put(packed), jax.device_put(qps), *self._src, *self._ref
+            )
+            self._src, self._ref = (sy, su, sv), (ry, ru, rv)
+            recs = [p[0] for p in pend]
+            shared = self._pool.submit(
+                self._complete_batch, recs, prefixes_d, denses_d, bufs_d
+            )
+            for slot, rec in enumerate(recs):
+                rec.future = shared
+                rec.batch_slot = slot
+        except Exception:
+            # dispatch failed: these frames never produced AUs. Drop their
+            # queued records (frame_num gap is healed by the forced IDR
+            # that the nulled ref causes next frame).
+            dropped = {id(p[0]) for p in pend}
+            self._inflight = deque(r for r in self._inflight if id(r) not in dropped)
+            self._ref = None
+            self._src = None
+            raise
+
+    def _complete_batch(self, recs, prefixes_d, denses_d, bufs_d):
+        """Worker half for a delta group: ONE fetch of all K prefixes,
+        then per-frame unpack + CAVLC pack. Returns a list indexed by
+        batch_slot."""
+        prefixes = np.asarray(prefixes_d)  # (K, L)
+        results = []
+        for slot, rec in enumerate(recs):
+            header, data, n = split_prefix(prefixes[slot], self._hdr_words_pd)
+            if n > self._cap_delta:  # rare spill: extra fetch for this slot
+                data = np.concatenate([data, _fetch_rest(bufs_d[slot], n, self._cap_delta)])
+            t1 = time.perf_counter()
+            pfc = unpack_p_sparse(header, data, rec.qp, self._nscap)
+            if pfc is None:  # ns > NSCAP: dense-header fallback fetch
+                pfc = unpack_p_compact(np.asarray(denses_d[slot]), data, rec.qp)
+            au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num)
+            results.append((au, int(pfc.skip.sum()), t1, time.perf_counter()))
+        return results
 
     def submit(self, frame: np.ndarray, qp: int | None = None, meta=None) -> list:
         """Dispatch one frame into the encode pipeline.
@@ -303,21 +557,57 @@ class TPUH264Encoder:
             or (self.keyframe_interval > 0 and self._frames_since_idr >= self.keyframe_interval)
         )
         t0 = time.perf_counter()
-        # evaluate on every frame (advances the previous-frame state even
+        # classify on every frame (advances the previous-frame state even
         # across IDRs) but only short-circuit on P frames
-        if self._is_static(frame) and not idr:
+        kind, dirty_idx = self._classify(frame)
+        batch_full = False
+        if kind == "static" and not idr:
             # unchanged capture: all-skip P slice host-side — no upload,
-            # no device step, no downlink (idle-desktop steady state)
+            # no device step, no downlink (idle-desktop steady state).
+            # The screen just went idle, so stop waiting for more group
+            # members: dispatch any pending deltas now.
+            self._flush_batch()
             slice_nal = self._allskip_slice(self._frames_since_idr % 256)
             rec = _Pending(
                 kind="static", frame_index=self.frame_index, qp=self.qp,
                 frame_num=self._frames_since_idr % 256, idr_pic_id=0,
                 t0=t0, t1=time.perf_counter(), meta=meta, au=slice_nal,
             )
+        elif (
+            not idr
+            and kind == "delta"
+            and self.frame_batch > 1
+            and len(dirty_idx) <= self.BATCH_BUCKETS[-1]
+        ):
+            # group candidate: convert the bands NOW (the capture buffer
+            # may be reused before dispatch), dispatch when the group
+            # fills or a non-groupable frame arrives
+            yb, ub, vb = self._prep.convert_bands(frame, dirty_idx)
+            rec = _Pending(
+                kind="pd", frame_index=self.frame_index, qp=self.qp,
+                frame_num=self._frames_since_idr % 256, idr_pic_id=0,
+                t0=t0, t1=0.0, meta=meta,
+            )
+            self._batch_pend.append((rec, yb, ub, vb, dirty_idx))
+            batch_full = len(self._batch_pend) >= self.frame_batch
         else:
             try:
+                # dispatch order must match frame order: drain any pending
+                # delta group before this frame touches device state
+                self._flush_batch()
+                hdr_d = None
                 if idr:
-                    prefix_d, buf_d, ry, ru, rv = self._run_step_i(frame)
+                    if kind == "delta":
+                        prefix_d, hdr_d, buf_d, ry, ru, rv = self._run_step_delta(
+                            frame, dirty_idx, idr=True
+                        )
+                    elif kind == "static" and self._src is not None:
+                        # forced IDR over unchanged content: zero upload
+                        prefix_d, buf_d, ry, ru, rv = self._step_resident_i(
+                            np.int32(self.qp), *self._src
+                        )
+                    else:
+                        prefix_d, buf_d, ry, ru, rv = self._run_step_i(frame)
                     # recon never leaves the device: it is the P-frame
                     # reference (donated into the next P step)
                     self._ref = (ry, ru, rv)
@@ -331,42 +621,70 @@ class TPUH264Encoder:
                     self._idr_pic_id = (self._idr_pic_id + 1) % 2
                     self._force_idr = False
                 else:
-                    prefix_d, buf_d, ry, ru, rv = self._run_step_p(frame)
+                    if kind == "delta":
+                        prefix_d, hdr_d, buf_d, ry, ru, rv = self._run_step_delta(
+                            frame, dirty_idx, idr=False
+                        )
+                    else:
+                        prefix_d, buf_d, ry, ru, rv = self._run_step_p(frame)
                     # reassign IMMEDIATELY: _step_p donated the old buffers
                     self._ref = (ry, ru, rv)
                     rec = _Pending(
-                        kind="p", frame_index=self.frame_index, qp=self.qp,
+                        kind="pd" if kind == "delta" else "p",
+                        frame_index=self.frame_index, qp=self.qp,
                         frame_num=self._frames_since_idr % 256, idr_pic_id=0,
                         t0=t0, t1=0.0, meta=meta,
-                        prefix_d=prefix_d, buf_d=buf_d,
+                        prefix_d=prefix_d, buf_d=buf_d, hdr_d=hdr_d,
                     )
                 # start the downlink fetch + entropy pack on a worker NOW:
                 # fetch ops overlap across threads on the relay
                 # (tools/profile_rpc.py: 4 concurrent fetches ≈ cost of 1)
                 rec.future = self._pool.submit(self._complete_work, rec)
             except Exception:
-                # device failure after donation: the old reference planes
-                # are gone. Null the ref so the next frame self-heals as a
-                # clean IDR instead of desyncing the decoder. Older frames
-                # already in flight stay queued — they were dispatched
-                # against an intact chain and remain deliverable.
+                # device failure after donation: the old reference (and
+                # possibly source) planes are gone. Null both so the next
+                # frame self-heals as a full-upload IDR instead of
+                # desyncing the decoder. Older frames already in flight
+                # stay queued — they were dispatched against an intact
+                # chain and remain deliverable.
                 self._ref = None
+                self._src = None
                 raise
         self.frame_index += 1
         self._frames_since_idr += 1
         self._inflight.append(rec)
+        if batch_full:
+            self._flush_batch()
         out = []
-        # emit completions in submission order; block only beyond depth
-        while self._inflight and (
-            len(self._inflight) > self.pipeline_depth
-            or self._inflight[0].future is None
-            or self._inflight[0].future.done()
-        ):
-            out.append(self._emit(self._inflight.popleft()))
+        # emit completions in submission order; block only when the
+        # dispatched (device-side) pipeline is deeper than pipeline_depth
+        while self._inflight:
+            head = self._inflight[0]
+            if head.au is not None or (head.future is not None and head.future.done()):
+                out.append(self._emit(self._inflight.popleft()))
+                continue
+            # depth counts device ROUND TRIPS (distinct futures), not
+            # frames: a grouped dispatch of K frames is one round trip
+            dispatched = len({
+                id(r.future)
+                for r in self._inflight
+                if r.future is not None and not r.future.done()
+            })
+            if dispatched > self.pipeline_depth:
+                out.append(self._emit(self._inflight.popleft()))  # blocking wait
+                continue
+            if len(self._inflight) > self.pipeline_depth + self.frame_batch:
+                if head.future is None:
+                    self._flush_batch()  # give the stalled head a future
+                else:
+                    out.append(self._emit(self._inflight.popleft()))
+                continue
+            break
         return out
 
     def flush(self) -> list:
         """Complete every in-flight frame (oldest first)."""
+        self._flush_batch()
         out = []
         while self._inflight:
             out.append(self._emit(self._inflight.popleft()))
@@ -388,10 +706,15 @@ class TPUH264Encoder:
         # encoding successors against its recon would silently desync the
         # decoder, so null the ref (forces IDR) and drop the pipeline.
         try:
-            au, skipped, t1, t2 = rec.future.result()
+            if rec.batch_slot >= 0:
+                au, skipped, t1, t2 = rec.future.result()[rec.batch_slot]
+            else:
+                au, skipped, t1, t2 = rec.future.result()
         except Exception:
             self._ref = None
+            self._src = None
             self._inflight.clear()
+            self._batch_pend.clear()
             raise
         stats = FrameStats(
             frame_index=rec.frame_index, idr=rec.kind == "i", qp=rec.qp,
@@ -403,11 +726,14 @@ class TPUH264Encoder:
 
     def _complete_work(self, rec: "_Pending"):
         """Worker-thread half: single-fetch downlink + unpack + CAVLC."""
+        hdr_words = {
+            "i": self._hdr_words_i, "p": self._hdr_words_p, "pd": self._hdr_words_pd,
+        }[rec.kind]
+        cap = self._cap_delta if rec.kind == "pd" else CAP_ROWS
         prefix = np.asarray(rec.prefix_d)
-        hdr_words = self._hdr_words_i if rec.kind == "i" else self._hdr_words_p
         header, data, n = split_prefix(prefix, hdr_words)
-        if n > CAP_ROWS:  # rare: heavy frame spilled past the prefix
-            data = np.concatenate([data, _fetch_rest(rec.buf_d, n)])
+        if n > cap:  # rare: heavy frame spilled past the prefix
+            data = np.concatenate([data, _fetch_rest(rec.buf_d, n, cap)])
         t1 = time.perf_counter()
         skipped = 0
         if rec.kind == "i":
@@ -419,7 +745,14 @@ class TPUH264Encoder:
             )
             au = self._headers + slice_nal
         else:
-            pfc = unpack_p_compact(header, data, rec.qp)
+            if rec.kind == "pd":
+                pfc = unpack_p_sparse(header, data, rec.qp, self._nscap)
+                if pfc is None:
+                    # content burst: more non-skip MBs than the sparse
+                    # header carries — one extra fetch of the dense header
+                    pfc = unpack_p_compact(np.asarray(rec.hdr_d), data, rec.qp)
+            else:
+                pfc = unpack_p_compact(header, data, rec.qp)
             skipped = int(pfc.skip.sum())
             au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num)
         return au, skipped, t1, time.perf_counter()
@@ -440,6 +773,7 @@ class TPUH264Encoder:
     def close(self) -> None:
         """Discard in-flight frames and stop the completion workers."""
         self._inflight.clear()
+        self._batch_pend.clear()
         self._pool.shutdown(wait=False, cancel_futures=True)
 
     def recon_planes(self, frame: np.ndarray):

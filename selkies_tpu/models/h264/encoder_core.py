@@ -730,11 +730,7 @@ def _bitpack32(bits):
     return (b << jnp.arange(32, dtype=jnp.int32)).sum(-1)
 
 
-def pack_p_compact(out):
-    """P-frame outputs -> (header int32, data int16 (M*26, 16)).
-
-    Header layout: [n, mbh, mbw, 0] ++ mv_words(M) ++ mbinfo(M) ++
-    skip_words(ceil(M/32)); mv_words = (mvx & 0xFFFF) | (mvy << 16)."""
+def _p_components(out):
     mbh, mbw = out["mvs"].shape[:2]
     m = mbh * mbw
     luma = out["luma_ac"].reshape(m, 16, 16).astype(jnp.int16)
@@ -745,13 +741,59 @@ def pack_p_compact(out):
     flags, buf, n = _compact_rows(rows)
     mv = out["mvs"]
     mv_words = (mv[..., 0] & 0xFFFF) | (mv[..., 1] << 16)
+    return n, mbh, mbw, mv_words.reshape(-1).astype(jnp.int32), _bitmap_words(flags), buf
+
+
+def pack_p_compact(out):
+    """P-frame outputs -> (header int32, data int16 (M*26, 16)).
+
+    Header layout: [n, mbh, mbw, 0] ++ mv_words(M) ++ mbinfo(M) ++
+    skip_words(ceil(M/32)); mv_words = (mvx & 0xFFFF) | (mvy << 16)."""
+    n, mbh, mbw, mv_words, mbinfo, buf = _p_components(out)
     header = jnp.concatenate([
         jnp.stack([n, jnp.int32(mbh), jnp.int32(mbw), jnp.int32(0)]),
-        mv_words.reshape(-1).astype(jnp.int32),
-        _bitmap_words(flags),
+        mv_words,
+        mbinfo,
         _bitpack32(out["skip"].reshape(-1)),
     ])
     return header, buf
+
+
+def pack_p_sparse(out, nscap: int):
+    """Skip-aware P downlink for sparse frames (the delta-upload path).
+
+    Most desktop P frames are almost-all-skip, so the dense per-MB
+    mv/mbinfo words (2 words x 8160 MBs = 64 KB at 1080p) dominate the
+    fetch. Here only the first `nscap` NON-skip MBs carry their 2 words
+    (the host reconstructs positions from the dense skip bitmap, 1 KB):
+
+      sparse_header: [n, mbh, mbw, ns] ++ skip_words(ceil(M/32))
+                     ++ mv_words(nscap) ++ mbinfo(nscap)
+
+    Also returns the dense header: when ns > nscap (content burst after a
+    resident-plane IDR) the host falls back to one extra fetch of it."""
+    n, mbh, mbw, mv_words, mbinfo, buf = _p_components(out)
+    m = mbh * mbw
+    mask = ~out["skip"].reshape(-1)
+    ns = mask.sum().astype(jnp.int32)
+    pos = jnp.cumsum(mask) - 1
+    dest = jnp.where(mask & (pos < nscap), pos, nscap)  # sentinel dropped
+    mv_c = jnp.zeros((nscap + 1,), jnp.int32).at[dest].set(mv_words)[:nscap]
+    info_c = jnp.zeros((nscap + 1,), jnp.int32).at[dest].set(mbinfo)[:nscap]
+    skip_words = _bitpack32(out["skip"].reshape(-1))
+    sparse = jnp.concatenate([
+        jnp.stack([n, jnp.int32(mbh), jnp.int32(mbw), ns]),
+        skip_words,
+        mv_c,
+        info_c,
+    ])
+    dense = jnp.concatenate([
+        jnp.stack([n, jnp.int32(mbh), jnp.int32(mbw), jnp.int32(0)]),
+        mv_words,
+        mbinfo,
+        skip_words,
+    ])
+    return sparse, dense, buf
 
 
 def fuse_downlink(header, buf, cap_rows: int):
@@ -790,3 +832,28 @@ def pack_i_compact(out):
         modes.astype(jnp.int32),
     ])
     return header, buf
+
+
+# ---------------------------------------------------------------------------
+# Delta upload: dirty-band scatter into device-resident source planes
+# ---------------------------------------------------------------------------
+
+def scatter_bands(y, u, v, yb, ub, vb, idx):
+    """Write k dirty bands into the resident source planes.
+
+    yb: (k, 16, W) luma bands, ub/vb: (k, 8, W/2) chroma bands, idx: (k,)
+    int32 plane band numbers (duplicates allowed — writing the same band
+    twice is idempotent, which lets the host pad k up to a static bucket
+    size). With the planes donated into the jit this is an in-place
+    update: the steady-state host->device traffic is only what changed
+    on screen (the reference leans on ximagesrc's XDamage for the same
+    effect, gstwebrtc_app.py:210-241)."""
+
+    def body(i, planes):
+        py, pu, pv = planes
+        py = jax.lax.dynamic_update_slice(py, yb[i], (idx[i] * 16, 0))
+        pu = jax.lax.dynamic_update_slice(pu, ub[i], (idx[i] * 8, 0))
+        pv = jax.lax.dynamic_update_slice(pv, vb[i], (idx[i] * 8, 0))
+        return py, pu, pv
+
+    return jax.lax.fori_loop(0, yb.shape[0], body, (y, u, v))

@@ -77,8 +77,39 @@ def _load() -> ctypes.CDLL | None:
     lib.emulation_prevent.argtypes = [
         ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
     ]
+    try:
+        lib.derive_skip_mvs.restype = None
+        lib.derive_skip_mvs.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int, ctypes.c_int,
+        ]
+    except AttributeError:
+        pass  # stale .so; python fallback used
     _lib = lib
     return _lib
+
+
+def derive_skip_mvs_fast(mvs: np.ndarray, skip: np.ndarray) -> None:
+    """Fill P_Skip MBs' motion vectors in place (8.4.1.1) from the coded
+    MBs' MVs — the sparse downlink omits them. C when available, exact
+    python mirror otherwise."""
+    mbh, mbw = skip.shape
+    lib = _load()
+    if lib is not None and hasattr(lib, "derive_skip_mvs"):
+        assert mvs.dtype == np.int32 and mvs.flags["C_CONTIGUOUS"]
+        sk = np.ascontiguousarray(skip, np.uint8)
+        lib.derive_skip_mvs(
+            mvs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            sk.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            mbh, mbw,
+        )
+        return
+    from selkies_tpu.models.h264.numpy_ref import skip_mv_16x16
+
+    for y in range(mbh):
+        for x in range(mbw):
+            if skip[y, x]:
+                mvs[y, x] = skip_mv_16x16(mvs, x, y)
 
 
 def native_available() -> bool:

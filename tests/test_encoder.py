@@ -201,3 +201,153 @@ def test_pipelined_submit_order_and_conformance(tmp_path):
     enc2 = TPUH264Encoder(w, h, qp=24, pipeline_depth=0)
     for i, f in enumerate(frames):
         assert enc2.encode_frame(f) == outs[i][0], f"frame {i} differs"
+
+
+def test_delta_upload_bitexact_and_decodable(tmp_path):
+    """Frames differing in a few 16-row bands take the delta path and
+    produce the SAME bitstream as a full-upload encoder."""
+    w, h = 320, 192  # 12 bands -> buckets (4,) available
+    base = _desktop_frame(w, h, seed=5)
+    frames = [base]
+    for i in range(1, 5):
+        f = frames[-1].copy()
+        # touch two separated bands (rows 32..48 and 128..144)
+        f[32:48, 40 : 80 + 4 * i] = (i * 37 % 255, 200, 90, 0)
+        f[128:144, 10 : 60 + 4 * i] = (30, i * 53 % 255, 120, 0)
+        frames.append(f)
+
+    enc_d = TPUH264Encoder(width=w, height=h, qp=26)
+    enc_f = TPUH264Encoder(width=w, height=h, qp=26)
+    enc_f._delta_buckets = ()  # force full uploads
+    stream_d = b"".join(enc_d.encode_frame(f) for f in frames)
+    stream_f = b"".join(enc_f.encode_frame(f) for f in frames)
+    assert enc_d._delta_buckets, "expected delta buckets at this size"
+    assert stream_d == stream_f, "delta path altered the bitstream"
+    path = tmp_path / "delta.h264"
+    path.write_bytes(stream_d)
+    decoded = _decode(path)
+    assert len(decoded) == len(frames)
+
+
+def test_delta_then_static_then_delta(tmp_path):
+    """Interleave static, delta, and full frames; stream stays conformant."""
+    w, h = 320, 192
+    f0 = _desktop_frame(w, h, seed=8)
+    f1 = f0.copy()
+    f1[48:64, 100:200] = (255, 0, 0, 0)  # one band -> delta
+    f2 = f1  # static
+    f3 = _desktop_frame(w, h, seed=9, shift=4)  # full change
+    f4 = f3.copy()
+    f4[0:16, 0:50] = (0, 255, 0, 0)  # delta again
+    enc = TPUH264Encoder(width=w, height=h, qp=28)
+    stream = b"".join(enc.encode_frame(f) for f in (f0, f1, f2, f3, f4))
+    path = tmp_path / "mix.h264"
+    path.write_bytes(stream)
+    assert len(_decode(path)) == 5
+
+
+def test_forced_idr_on_static_content_zero_upload(tmp_path):
+    """force_keyframe() on unchanged content uses the resident-plane IDR."""
+    w, h = 320, 192
+    f = _desktop_frame(w, h, seed=11)
+    enc = TPUH264Encoder(width=w, height=h, qp=26)
+    a0 = enc.encode_frame(f)
+    enc.force_keyframe()
+    a1 = enc.encode_frame(f)  # static + idr -> resident-plane path
+    assert enc.last_stats.idr
+    path = tmp_path / "ridr.h264"
+    path.write_bytes(a0 + a1)
+    assert len(_decode(path)) == 2
+    # the resident-plane IDR must be byte-identical to what a full
+    # re-upload of the same content would produce (a0 != a1 because
+    # consecutive IDRs toggle idr_pic_id — compare like with like)
+    enc_full = TPUH264Encoder(width=w, height=h, qp=26)
+    enc_full._delta_buckets = ()
+    b0 = enc_full.encode_frame(f)
+    enc_full._src = None  # force the full-upload IDR path
+    enc_full.force_keyframe()
+    b1 = enc_full.encode_frame(f)
+    assert a0 == b0
+    assert a1 == b1
+
+
+def test_sparse_header_overflow_falls_back_to_dense(tmp_path, monkeypatch):
+    """A delta frame with more non-skip MBs than NSCAP triggers the
+    dense-header fallback fetch and still produces the exact stream."""
+    from selkies_tpu.models.h264 import encoder as enc_mod
+
+    monkeypatch.setattr(enc_mod, "NSCAP", 8)  # force overflow
+    w, h = 320, 192
+    f0 = _desktop_frame(w, h, seed=21)
+    f1 = f0.copy()
+    f1[32:64, :] = np.random.default_rng(4).integers(0, 255, (32, w, 4), np.uint8)
+    enc_s = enc_mod.TPUH264Encoder(width=w, height=h, qp=26)
+    s = enc_s.encode_frame(f0) + enc_s.encode_frame(f1)
+    enc_f = enc_mod.TPUH264Encoder(width=w, height=h, qp=26)
+    enc_f._delta_buckets = ()
+    t = enc_f.encode_frame(f0) + enc_f.encode_frame(f1)
+    assert s == t, "overflow fallback altered the bitstream"
+    path = tmp_path / "ovf.h264"
+    path.write_bytes(s)
+    assert len(_decode(path)) == 2
+
+
+def test_grouped_delta_batch_bitexact(tmp_path):
+    """Consecutive delta frames grouped into one scan step produce the
+    same bitstream as unbatched single-frame dispatches."""
+    w, h = 320, 192
+    frames = [_desktop_frame(w, h, seed=31)]
+    rng = np.random.default_rng(6)
+    for i in range(1, 10):
+        f = frames[-1].copy()
+        r0 = 16 * (i % 5)
+        f[r0 : r0 + 10, 20:180] = rng.integers(0, 255, (10, 160, 4), np.uint8)
+        frames.append(f)
+
+    enc_b = TPUH264Encoder(width=w, height=h, qp=26, frame_batch=4, pipeline_depth=2)
+    outs = []
+    for f in frames:
+        outs.extend(enc_b.submit(f))
+    outs.extend(enc_b.flush())
+    assert [s.frame_index for _, s, _ in outs] == list(range(len(frames)))
+    stream_b = b"".join(au for au, _, _ in outs)
+
+    enc_s = TPUH264Encoder(width=w, height=h, qp=26, frame_batch=1)
+    stream_s = b"".join(enc_s.encode_frame(f) for f in frames)
+    assert stream_b == stream_s, "grouped dispatch altered the bitstream"
+    path = tmp_path / "grp.h264"
+    path.write_bytes(stream_b)
+    assert len(_decode(path)) == len(frames)
+
+
+def test_delta_scroll_nonzero_skip_mvs_bitexact(tmp_path):
+    """Scrolling texture inside a few bands produces skip MBs with
+    NONZERO derived MVs; the sparse downlink must reconstruct them (the
+    neighbor MV prediction of coded MBs depends on skip-MB MVs)."""
+    w, h = 384, 192
+    rng = np.random.default_rng(44)
+    texture = rng.integers(0, 255, (64, w + 64, 4), np.uint8)
+    frames = []
+    for i in range(6):
+        f = _desktop_frame(w, h, seed=17)
+        # rows 64..128 (bands 4-7) scroll horizontally 4 px per frame
+        f[64:128, :] = texture[:, 4 * i : 4 * i + w]
+        frames.append(f)
+
+    enc_d = TPUH264Encoder(width=w, height=h, qp=26, frame_batch=1)
+    enc_f = TPUH264Encoder(width=w, height=h, qp=26, frame_batch=1)
+    enc_f._delta_buckets = ()
+    stream_d = b"".join(enc_d.encode_frame(f) for f in frames)
+    stream_f = b"".join(enc_f.encode_frame(f) for f in frames)
+    assert stream_d == stream_f, "sparse skip-MV reconstruction diverged"
+    path = tmp_path / "scroll.h264"
+    path.write_bytes(stream_d)
+    assert len(_decode(path)) == len(frames)
+
+    # batched grouping over the same scroll must also be bit-exact
+    enc_b = TPUH264Encoder(width=w, height=h, qp=26, frame_batch=4)
+    outs = []
+    for f in frames:
+        outs.extend(enc_b.submit(f))
+    outs.extend(enc_b.flush())
+    assert b"".join(au for au, _, _ in outs) == stream_f
