@@ -113,15 +113,24 @@ def bench_full_encoder() -> float | None:
         from selkies_tpu.models.h264.encoder import TPUH264Encoder
     except ImportError:
         return None
-    enc = TPUH264Encoder(W, H, qp=28)
+    from selkies_tpu.models.registry import default_frame_batch
+
+    # grouped-dispatch depth comes from the SAME deployment-aware default
+    # the live pipeline uses (registry.default_frame_batch, PERF.md)
+    enc = TPUH264Encoder(W, H, qp=28, frame_batch=min(12, default_frame_batch()))
     frames = _desktop_trace(ITERS)
     # warmup compiles every executable the trace uses: IDR full, grouped
-    # delta (scan), single delta, P full, static — in dependency order
+    # delta scans (K=8 and K=4), single delta, P full, static
     enc.encode_frame(frames[0])  # IDR full
-    for i in (1, 2, 3, 4):  # consecutive deltas fill one group -> scan step
-        enc.submit(frames[i])
+    fb = enc.frame_batch
+    i = 1
+    for _ in range(fb):  # consecutive deltas fill one group -> K=fb scan
+        enc.submit(frames[i]); i += 1
     enc.flush()
-    enc.encode_frame(frames[5])  # single delta (partial-group path)
+    for _ in range(max(2, fb // 2)):  # half group -> K=fb/2 scan
+        enc.submit(frames[i]); i += 1
+    enc.flush()
+    enc.encode_frame(frames[i])  # single delta (straggler path)
     enc.encode_frame(frames[29 % len(frames)])  # window switch -> full P
     enc.encode_frame(frames[29 % len(frames)])  # static
     done = 0
@@ -139,7 +148,7 @@ def bench_convert_only() -> float:
 
     from selkies_tpu.ops.colorspace import bgrx_to_i420
 
-    frames = [jax.device_put(f) for f in _synth_frames()]
+    frames = [jax.device_put(f) for f in _desktop_trace(4)]
     out = bgrx_to_i420(frames[0])
     jax.block_until_ready(out)
     t0 = time.perf_counter()

@@ -319,6 +319,12 @@ class TPUH264Encoder:
         # GROUP). Trades up to frame_batch-1 frame-times of latency for
         # K-fold fewer relay round trips; on PCIe-local devices set 1.
         self.frame_batch = max(1, int(frame_batch))
+        # scan executables compile for these group sizes only (greedy
+        # grouping in _flush_batch); a half group beats singles when a
+        # flush catches the accumulator mid-fill
+        self._batch_sizes = tuple(
+            sorted({self.frame_batch, max(2, self.frame_batch // 2)}, reverse=True)
+        ) if self.frame_batch > 1 else ()
         self._batch_pend: list = []  # (rec, yb, ub, vb, idx) to group-dispatch
         # delta bucket sizes: dirty-band counts round up to one of these so
         # each resolution compiles a handful of scatter executables; frames
@@ -469,20 +475,24 @@ class TPUH264Encoder:
     BATCH_BUCKETS = (4, 16)
 
     def _flush_batch(self) -> None:
-        """Dispatch the pending delta group (if any) as ONE device step.
+        """Dispatch the pending delta frames (if any) as device steps.
 
-        Must run before any other dispatch so device-side src/ref state
-        advances in frame order."""
+        Greedy grouping: full groups of frame_batch, then a half group,
+        then singles — only those scan sizes ever compile. Must run
+        before any other dispatch so device-side src/ref state advances
+        in frame order."""
         pend = self._batch_pend
         if not pend:
             return
         self._batch_pend = []
         try:
-            if len(pend) < self.frame_batch:
-                # partial group (interrupted by a non-groupable frame or a
-                # flush): dispatch as singles — only the K=frame_batch scan
-                # executable ever compiles, partial sizes don't
-                for rec, yb, ub, vb, idx in pend:
+            i = 0
+            while i < len(pend):
+                take = next((s for s in self._batch_sizes if len(pend) - i >= s), 1)
+                group = pend[i : i + take]
+                i += take
+                if take == 1:
+                    rec, yb, ub, vb, idx = group[0]
                     bucket = next(b for b in self._delta_buckets if b >= len(idx))
                     packed_d = jax.device_put(self._pack_bands(yb, ub, vb, idx, bucket))
                     prefix_d, hdr_d, buf_d, ry, ru, rv, sy, su, sv = self._step_scatter_p(
@@ -492,30 +502,31 @@ class TPUH264Encoder:
                     rec.prefix_d, rec.hdr_d, rec.buf_d = prefix_d, hdr_d, buf_d
                     rec.batch_slot = -1
                     rec.future = self._pool.submit(self._complete_work, rec)
-                return
-            bucket = next(
-                b for b in self.BATCH_BUCKETS if b >= max(len(p[4]) for p in pend)
-            )
-            packed = np.stack(
-                [self._pack_bands(yb, ub, vb, idx, bucket) for _, yb, ub, vb, idx in pend]
-            )
-            qps = np.array([p[0].qp for p in pend], np.int32)
-            prefixes_d, denses_d, bufs_d, ry, ru, rv, sy, su, sv = self._step_scatter_pk(
-                jax.device_put(packed), jax.device_put(qps), *self._src, *self._ref
-            )
-            self._src, self._ref = (sy, su, sv), (ry, ru, rv)
-            recs = [p[0] for p in pend]
-            shared = self._pool.submit(
-                self._complete_batch, recs, prefixes_d, denses_d, bufs_d
-            )
-            for slot, rec in enumerate(recs):
-                rec.future = shared
-                rec.batch_slot = slot
+                    continue
+                bucket = next(
+                    b for b in self.BATCH_BUCKETS if b >= max(len(g[4]) for g in group)
+                )
+                packed = np.stack(
+                    [self._pack_bands(yb, ub, vb, idx, bucket) for _, yb, ub, vb, idx in group]
+                )
+                qps = np.array([g[0].qp for g in group], np.int32)
+                prefixes_d, denses_d, bufs_d, ry, ru, rv, sy, su, sv = self._step_scatter_pk(
+                    jax.device_put(packed), jax.device_put(qps), *self._src, *self._ref
+                )
+                self._src, self._ref = (sy, su, sv), (ry, ru, rv)
+                recs = [g[0] for g in group]
+                shared = self._pool.submit(
+                    self._complete_batch, recs, prefixes_d, denses_d, bufs_d
+                )
+                for slot, rec in enumerate(recs):
+                    rec.future = shared
+                    rec.batch_slot = slot
         except Exception:
-            # dispatch failed: these frames never produced AUs. Drop their
-            # queued records (frame_num gap is healed by the forced IDR
-            # that the nulled ref causes next frame).
-            dropped = {id(p[0]) for p in pend}
+            # dispatch failed: frames not yet dispatched never produced
+            # AUs. Drop their queued records (the frame_num gap is healed
+            # by the forced IDR that the nulled ref causes next frame);
+            # already-dispatched groups stay deliverable.
+            dropped = {id(g[0]) for g in pend if g[0].future is None}
             self._inflight = deque(r for r in self._inflight if id(r) not in dropped)
             self._ref = None
             self._src = None

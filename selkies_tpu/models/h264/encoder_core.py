@@ -464,9 +464,14 @@ def _refine_cands_jnp(coarse):
 def hier_me_mc(cur, ref_y, ry_pad, ru_pad, rv_pad):
     """Global-candidate ME fused with motion compensation — gather-free.
 
-    One scan over ~1+TOPK*(2R+1)^2 global shifts; each step is a dynamic
-    slice + dense SAD + per-MB select of the running best luma/chroma
-    prediction. Returns (mvs (mbh,mbw,2) i32, pred_y, pred_u, pred_v i32).
+    Two scans over 1+TOPK*(2R+1)^2 global shifts. The COST scan carries
+    only (best_cost,) and does a dynamic slice + dense SAD per step; the
+    PRED scan re-walks the shifts carrying the luma/chroma prediction
+    planes, selecting where the step's rank equals the decoded winner
+    rank — no SAD recompute and no chroma math on losing steps' critical
+    path state. Splitting keeps the heavy chroma bilinear + 3 plane
+    selects out of the cost loop (~2x over the fused single scan).
+    Returns (mvs (mbh,mbw,2) i32, pred_y, pred_u, pred_v i32).
     Element-exact vs numpy_ref.hier_search_me + mc_luma/mc_chroma: the
     chroma bilinear runs on the globally-shifted plane with the same
     frac weights, so selected values match the per-MB gather formulation.
@@ -481,14 +486,23 @@ def hier_me_mc(cur, ref_y, ry_pad, ru_pad, rv_pad):
     ranks = jnp.arange(ncand, dtype=jnp.int32)
     scale = 1 << int(np.int64(ncand - 1)).bit_length()
 
-    def step(carry, xs):
-        best_cost, best_mv, py, pu, pv = carry
+    def cost_step(best_cost, xs):
         mv, rank = xs
-        dx, dy = mv[0], mv[1]
-        ys = jax.lax.dynamic_slice(ry_pad, (MV_PAD + dy, MV_PAD + dx), (h, w))
+        ys = jax.lax.dynamic_slice(ry_pad, (MV_PAD + mv[1], MV_PAD + mv[0]), (h, w))
         sad = jnp.abs(cur - ys.astype(jnp.int32)).reshape(mbh, 16, mbw, 16).sum(axis=(1, 3))
         cost = sad * scale + rank
-        better = cost < best_cost
+        return jnp.minimum(cost, best_cost), None
+
+    init_cost = jnp.full((mbh, mbw), jnp.iinfo(jnp.int32).max, jnp.int32)
+    best_cost, _ = jax.lax.scan(cost_step, init_cost, (cands, ranks))
+    best_rank = best_cost & (scale - 1)  # cost = sad*scale + rank
+
+    def pred_step(carry, xs):
+        best_mv, py, pu, pv = carry
+        mv, rank = xs
+        better = best_rank == rank  # exactly one step wins per MB
+        dx, dy = mv[0], mv[1]
+        ys = jax.lax.dynamic_slice(ry_pad, (MV_PAD + dy, MV_PAD + dx), (h, w))
 
         # chroma prediction for this global shift (8.4.2.2.2 on the whole
         # plane): full-pel luma MV -> chroma half-pel bilinear
@@ -508,21 +522,19 @@ def hier_me_mc(cur, ref_y, ry_pad, ru_pad, rv_pad):
         m16 = jnp.repeat(jnp.repeat(better, 16, 0), 16, 1)
         m8 = jnp.repeat(jnp.repeat(better, 8, 0), 8, 1)
         return (
-            jnp.where(better, cost, best_cost),
             jnp.where(better[..., None], mv, best_mv),
             jnp.where(m16, ys.astype(jnp.int32), py),
             jnp.where(m8, us, pu),
             jnp.where(m8, vs, pv),
         ), None
 
-    init = (
-        jnp.full((mbh, mbw), jnp.iinfo(jnp.int32).max, jnp.int32),
+    init_pred = (
         jnp.zeros((mbh, mbw, 2), jnp.int32),
         jnp.zeros((h, w), jnp.int32),
         jnp.zeros((ch, cw), jnp.int32),
         jnp.zeros((ch, cw), jnp.int32),
     )
-    (_, mvs, py, pu, pv), _ = jax.lax.scan(step, init, (cands, ranks))
+    (mvs, py, pu, pv), _ = jax.lax.scan(pred_step, init_pred, (cands, ranks))
     return mvs, py, pu, pv
 
 

@@ -276,13 +276,15 @@ def pad_planes(y: np.ndarray, u: np.ndarray, v: np.ndarray):
 
 # Max motion-vector magnitude (full-pel); reference planes are edge-padded
 # by this much so unrestricted MVs never index out of bounds. Sized for the
-# hierarchical search: COARSE_DS*COARSE_R + REFINE_R = 36, rounded up.
+# hierarchical search reach: COARSE_DS*COARSE_R + REFINE_R = 34 <= MV_PAD.
 MV_PAD = 40
 
 # Hierarchical ME geometry (hier_search_me / encoder_core.hier_motion_search)
 COARSE_DS = 4   # coarse level downsample factor
 COARSE_R = 8    # coarse search radius in downsampled pels (→ ±32 full-pel)
-REFINE_R = 3    # full-res refine radius around each upscaled global candidate
+REFINE_R = 2    # full-res refine radius around each upscaled global candidate
+                # (±2 exactly covers the COARSE_DS=4 grid; ±3 only added
+                # overlap and cost ~2x the refine-scan device time)
 TOPK = 3        # dominant global motion candidates carried to full-res refine
 
 

@@ -14,6 +14,7 @@ ADD_ENCODER: register new encoders with @register("name") below.
 from __future__ import annotations
 
 import logging
+import os
 from typing import Callable
 
 logger = logging.getLogger("models.registry")
@@ -55,10 +56,22 @@ def create_encoder(name: str, *, width: int, height: int, fps: int = 60, **kw):
 # ADD_ENCODER: factories
 
 
+def default_frame_batch() -> int:
+    """Deployment-aware grouped-dispatch depth (see PERF.md): on the axon
+    relay (per-operation link pricing) group 8 frames per device round
+    trip; on PCIe-local hosts favor latency. SELKIES_FRAME_BATCH
+    overrides either way — bench.py and the live pipeline share this."""
+    env = os.environ.get("SELKIES_FRAME_BATCH")
+    if env:
+        return max(1, min(16, int(env)))
+    return 8 if os.environ.get("PALLAS_AXON_POOL_IPS") else 4
+
+
 @register("tpuh264enc")
 def _tpuh264enc(*, width: int, height: int, fps: int = 60, qp: int = 28, **kw):
     from selkies_tpu.models.h264.encoder import TPUH264Encoder
 
+    kw.setdefault("frame_batch", default_frame_batch())
     return TPUH264Encoder(width=width, height=height, qp=qp, fps=fps, **kw)
 
 
