@@ -1,0 +1,25 @@
+#!/bin/bash
+# Build driver: wheel + web tarball + native artifacts -> dist/
+# (reference parity: /root/reference/build.sh, which drives the container
+# matrix; ours produces the artifacts the example Dockerfile consumes).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT=dist
+rm -rf "$OUT" && mkdir -p "$OUT"
+
+echo "== native libraries =="
+make -C native
+cp native/selkies_joystick_interposer.so native/libcavlc.so native/libframeprep.so "$OUT/"
+
+echo "== python wheel =="
+# --no-build-isolation: use the environment's setuptools (works in
+# air-gapped builds; CI installs `build`+`wheel` beforehand)
+python -m pip wheel --no-deps --no-build-isolation -w "$OUT" . >/dev/null
+ls "$OUT"/selkies_tpu-*.whl
+
+echo "== web client tarball =="
+tar -czf "$OUT/selkies-tpu-web.tar.gz" -C selkies_tpu/web .
+
+echo "== done =="
+ls -la "$OUT"

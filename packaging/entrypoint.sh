@@ -1,0 +1,85 @@
+#!/bin/bash
+# selkies-tpu streamer entrypoint (reference parity:
+# /root/reference/addons/example/selkies-gstreamer-entrypoint.sh — same
+# responsibilities, TPU streamer instead of GStreamer: joystick
+# interposer preload, self-hosted TURN fallback, nginx front config,
+# then the orchestrator).
+set -e
+
+export XDG_RUNTIME_DIR="${XDG_RUNTIME_DIR:-/tmp/runtime-selkies}"
+mkdir -pm700 "${XDG_RUNTIME_DIR}"
+
+# Joystick interposer: virtual /dev/input/js* via LD_PRELOAD
+export SELKIES_INTERPOSER="${SELKIES_INTERPOSER:-/usr/lib/selkies_joystick_interposer.so}"
+if [ -f "${SELKIES_INTERPOSER}" ]; then
+    export LD_PRELOAD="${SELKIES_INTERPOSER}${LD_PRELOAD:+:${LD_PRELOAD}}"
+    export SDL_JOYSTICK_DEVICE=/dev/input/js0
+fi
+
+export DISPLAY="${DISPLAY:-:20}"
+export PULSE_SERVER="${PULSE_SERVER:-unix:/run/user/$(id -u)/pulse/native}"
+export SELKIES_ENCODER="${SELKIES_ENCODER:-tpuh264enc}"
+export SELKIES_PORT="${SELKIES_PORT:-8081}"
+
+# Self-hosted TURN fallback: when no external TURN/relay is configured,
+# start coturn locally with a random shared secret and point the
+# streamer's HMAC credential chain at it.
+if [ -z "${SELKIES_TURN_REST_URI}" ] && [ -z "${SELKIES_TURN_SHARED_SECRET}" ] \
+   && { [ -z "${SELKIES_TURN_USERNAME}" ] || [ -z "${SELKIES_TURN_PASSWORD}" ]; }; then
+    export SELKIES_TURN_SHARED_SECRET="$(tr -dc 'A-Za-z0-9' < /dev/urandom | head -c 32)"
+    export SELKIES_TURN_HOST="${SELKIES_TURN_HOST:-$(hostname -I 2>/dev/null | awk '{print $1}' || echo 127.0.0.1)}"
+    export SELKIES_TURN_PORT="${SELKIES_TURN_PORT:-3478}"
+    /etc/selkies/start-turnserver.sh &
+fi
+
+# Wait for the X server
+echo 'waiting for X socket'
+until [ -S "/tmp/.X11-unix/X${DISPLAY#*:}" ]; do sleep 0.5; done
+
+# nginx front: static web client + websocket upgrade proxy to the
+# streamer (the reference's nginx template, minus gst-web paths)
+if [ "$(echo "${SELKIES_ENABLE_BASIC_AUTH:-true}" | tr '[:upper:]' '[:lower:]')" != "false" ]; then
+    htpasswd -bcm "${XDG_RUNTIME_DIR}/.htpasswd" \
+        "${SELKIES_BASIC_AUTH_USER:-${USER:-selkies}}" "${SELKIES_BASIC_AUTH_PASSWORD:-${PASSWD:-mypasswd}}"
+    AUTH_LINES="auth_basic \"selkies\"; auth_basic_user_file ${XDG_RUNTIME_DIR}/.htpasswd;"
+else
+    AUTH_LINES=""
+fi
+cat > /tmp/nginx.conf <<EOF
+worker_processes 2;
+pid /tmp/nginx.pid;
+error_log /dev/stderr;
+events { worker_connections 256; }
+http {
+  include /etc/nginx/mime.types;
+  access_log /dev/stdout;
+  client_body_temp_path /tmp/nginx-body;
+  proxy_temp_path /tmp/nginx-proxy;
+  fastcgi_temp_path /tmp/nginx-fcgi;
+  uwsgi_temp_path /tmp/nginx-uwsgi;
+  scgi_temp_path /tmp/nginx-scgi;
+  map \$http_upgrade \$connection_upgrade { default upgrade; '' close; }
+  server {
+    listen ${NGINX_PORT:-8080};
+    ${AUTH_LINES}
+    location / {
+      root /opt/selkies-web;
+      index index.html;
+    }
+    location ~ ^/(ws|media)\$ {
+      proxy_pass http://127.0.0.1:${SELKIES_PORT};
+      proxy_http_version 1.1;
+      proxy_set_header Upgrade \$http_upgrade;
+      proxy_set_header Connection \$connection_upgrade;
+      proxy_read_timeout 3600s;
+    }
+    location /turn { proxy_pass http://127.0.0.1:${SELKIES_PORT}; }
+    location /metrics { proxy_pass http://127.0.0.1:${SELKIES_PORT}; }
+  }
+}
+EOF
+
+exec /opt/venv/bin/python -m selkies_tpu \
+    --port "${SELKIES_PORT}" \
+    --encoder "${SELKIES_ENCODER}" \
+    "$@"

@@ -25,7 +25,7 @@ import numpy as np
 
 logger = logging.getLogger("models.frameprep")
 
-_NATIVE_DIR = os.path.join(
+_NATIVE_DIR = os.environ.get("SELKIES_NATIVE_DIR") or os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native"
 )
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libframeprep.so")

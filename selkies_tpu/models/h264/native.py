@@ -30,7 +30,9 @@ from selkies_tpu.utils.bits import BitWriter
 
 logger = logging.getLogger("h264.native")
 
-_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))), "native")
+_NATIVE_DIR = os.environ.get("SELKIES_NATIVE_DIR") or os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))), "native"
+)
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libcavlc.so")
 
 _lib = None

@@ -1,0 +1,116 @@
+"""Multi-session serving: N session streams from one sharded device step.
+
+Builds on parallel/sessions.MultiSessionEncoder (the v5e-8 placement:
+one 1080p60 stream per chip, BASELINE.md) and adds everything a serving
+path needs per session: GOP state (frame_num / idr_pic_id /
+force_keyframe), per-session QP, coefficient fetch, and concurrent
+host-side CAVLC packing — one worker per session, since entropy packing
+is independent per stream.
+
+Reference context: the reference scales out with one OS process per
+session and Kubernetes placement (SURVEY §2.6); here a single host
+process drives the whole slice and hands each transport its own Annex-B
+access units. Output streams are bit-identical to N solo TPUH264Encoder
+instances fed the same frames (tests/test_multi_session_serving.py).
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from selkies_tpu.models.h264.bitstream import StreamParams, write_pps, write_sps
+from selkies_tpu.models.h264.native import pack_slice_fast, pack_slice_p_fast
+from selkies_tpu.models.h264.numpy_ref import FrameCoeffs, PFrameCoeffs
+from selkies_tpu.parallel.sessions import MultiSessionEncoder
+
+__all__ = ["MultiSessionH264Service"]
+
+
+class _SessionState:
+    __slots__ = ("frames_since_idr", "idr_pic_id", "force_idr", "qp")
+
+    def __init__(self, qp: int):
+        self.frames_since_idr = 0
+        self.idr_pic_id = 0
+        self.force_idr = True
+        self.qp = qp
+
+
+class MultiSessionH264Service:
+    """N synchronized session streams; one batched sharded encode/tick.
+
+    The step ticks in lockstep (frames come in as a batch, one per
+    session). GOP policy is per-session EXCEPT that an IDR in any
+    session forces the batch onto the IDR executable for all sessions —
+    the common fleet case (infinite GOP, per-client PLI recovery) makes
+    batch-wide IDRs rare; per-session mixed I/P in one step is a
+    shard_map refinement left for the pallas round.
+    """
+
+    def __init__(self, n_sessions: int, width: int, height: int, *,
+                 qp: int = 28, fps: int = 60, devices=None):
+        self.enc = MultiSessionEncoder(n_sessions, width, height, devices=devices)
+        self.n = n_sessions
+        self.params = StreamParams(width=width, height=height, qp=qp, fps=fps)
+        self._headers = write_sps(self.params) + write_pps(self.params)
+        self.sessions = [_SessionState(qp) for _ in range(n_sessions)]
+        self._pool = ThreadPoolExecutor(max_workers=n_sessions, thread_name_prefix="ms-pack")
+
+    def set_qp(self, session: int, qp: int) -> None:
+        if not 0 <= qp <= 51:
+            raise ValueError(f"qp {qp} out of range")
+        self.sessions[session].qp = int(qp)
+
+    def force_keyframe(self, session: int) -> None:
+        self.sessions[session].force_idr = True
+
+    def encode_tick(self, frames: np.ndarray) -> list[bytes]:
+        """(N, H, W, 4) BGRx batch -> one Annex-B access unit per session."""
+        if frames.shape[0] != self.n:
+            raise ValueError(f"expected {self.n} frames, got {frames.shape[0]}")
+        idr = any(s.force_idr or s.frames_since_idr == 0 for s in self.sessions)
+        qps = np.array([s.qp for s in self.sessions], np.int32)
+        if idr:
+            out = self.enc.encode_idr(frames, qps)
+        else:
+            out = self.enc.encode_p(frames, qps)
+        # fetch the coefficient batch once, then pack per session in
+        # parallel (independent streams)
+        host = {k: np.asarray(v) for k, v in out.items()}
+        futures = [
+            self._pool.submit(self._pack_one, i, host, idr) for i in range(self.n)
+        ]
+        aus = [f.result() for f in futures]
+        for s in self.sessions:
+            if idr:
+                s.frames_since_idr = 1
+                s.idr_pic_id = (s.idr_pic_id + 1) % 2
+                s.force_idr = False
+            else:
+                s.frames_since_idr += 1
+        return aus
+
+    def _pack_one(self, i: int, host: dict, idr: bool) -> bytes:
+        s = self.sessions[i]
+        if idr:
+            fc = FrameCoeffs(
+                luma_mode=host["luma_mode"][i], chroma_mode=host["chroma_mode"][i],
+                luma_dc=host["luma_dc"][i], luma_ac=host["luma_ac"][i],
+                chroma_dc=host["chroma_dc"][i], chroma_ac=host["chroma_ac"][i],
+                qp=int(s.qp),
+            )
+            nal = pack_slice_fast(
+                fc, self.params, frame_num=0, idr=True, idr_pic_id=s.idr_pic_id
+            )
+            return self._headers + nal
+        pfc = PFrameCoeffs(
+            mvs=host["mvs"][i], skip=host["skip"][i], luma_ac=host["luma_ac"][i],
+            chroma_dc=host["chroma_dc"][i], chroma_ac=host["chroma_ac"][i],
+            qp=int(s.qp),
+        )
+        return pack_slice_p_fast(pfc, self.params, frame_num=s.frames_since_idr % 256)
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)

@@ -31,6 +31,11 @@
       // initial client prefs (reference: _arg_fps/_arg_resize on connect)
       const fps = store.get("framerate", null);
       if (fps) media.send(`_arg_fps,${fps}`);
+      const resizePref = store.get("resize", null);
+      if (resizePref !== null) {
+        const res = `${Math.round(innerWidth * devicePixelRatio)}x${Math.round(innerHeight * devicePixelRatio)}`;
+        media.send(`_arg_resize,${resizePref},${res}`);
+      }
     }
   }
 
@@ -49,9 +54,12 @@
       case "cursor":
         onCursor(d);
         break;
-      case "clipboard":
-        navigator.clipboard?.writeText(atob(d.content)).catch(() => {});
+      case "clipboard": {
+        const text = atob(d.content);
+        input.noteRemoteClipboard(text);  // don't echo it back on focus
+        navigator.clipboard?.writeText(text).catch(() => {});
         break;
+      }
       case "system_stats":
       case "gpu_stats":
         updateHud(obj.type, d);
@@ -115,7 +123,65 @@
     if (!media.connected) return;
     media.send(`_f,${Math.round(fps)}`);
     media.send(`_l,${Math.round(serverLatency)}`);
+    // full stats report (reference app.js:456-537 uploads getStats();
+    // the WS transport reports the decoder-side equivalents)
+    media.send("_stats_video," + JSON.stringify({
+      type: "inbound-rtp", kind: "video",
+      framesDecoded: media.framesDecoded,
+      framesDropped: media.framesDropped || 0,
+      bytesReceived: media.bytesReceived || 0,
+      keyFramesDecoded: media.keyFramesDecoded || 0,
+      latencyMs: serverLatency,
+    }));
   }, 5000);
+
+  // -- settings drawer (reference app.js:685-769 system-action loop) ---
+  const drawer = document.getElementById("drawer");
+  document.getElementById("gear").addEventListener("click", () => {
+    drawer.classList.toggle("open");
+  });
+  const fpsSel = document.getElementById("set-fps");
+  fpsSel.value = store.get("framerate", "60");
+  fpsSel.addEventListener("change", () => {
+    store.set("framerate", fpsSel.value);
+    media.send(`_arg_fps,${fpsSel.value}`);
+  });
+  const resizeChk = document.getElementById("set-resize");
+  resizeChk.checked = store.get("resize", "true") === "true";
+  resizeChk.addEventListener("change", () => {
+    store.set("resize", String(resizeChk.checked));
+    const res = `${Math.round(innerWidth * devicePixelRatio)}x${Math.round(innerHeight * devicePixelRatio)}`;
+    media.send(`_arg_resize,${resizeChk.checked},${res}`);
+  });
+  const vbSel = document.getElementById("set-vb");
+  vbSel.value = store.get("videoBitRate", "8000");
+  vbSel.addEventListener("change", () => {
+    store.set("videoBitRate", vbSel.value);
+    media.send(`vb,${vbSel.value}`);
+  });
+  const plChk = document.getElementById("set-pointerlock");
+  plChk.addEventListener("change", () => {
+    if (plChk.checked) input.requestPointerLock(); else input.exitPointerLock();
+  });
+  document.getElementById("btn-fullscreen").addEventListener("click", () => {
+    input.enterFullscreen();
+    drawer.classList.remove("open");
+  });
+  document.getElementById("btn-hud").addEventListener("click", () => {
+    hud.style.display = hud.style.display === "none" ? "" : "none";
+  });
+  // keyboard shortcut: Ctrl+Shift+F fullscreen (reference default)
+  window.addEventListener("keydown", (ev) => {
+    if (ev.ctrlKey && ev.shiftKey && ev.code === "KeyF") {
+      ev.preventDefault();
+      input.enterFullscreen();
+    }
+  }, true);
+
+  // PWA service worker (reference sw.js)
+  if ("serviceWorker" in navigator && location.protocol === "https:") {
+    navigator.serviceWorker.register("sw.js").catch(() => {});
+  }
 
   const proto = location.protocol === "https:" ? "wss:" : "ws:";
   fetch("./turn").catch(() => null).finally(() => {

@@ -28,15 +28,88 @@ class SelkiesInput {
     on(window, "keydown", (ev) => this._key(ev, true));
     on(window, "keyup", (ev) => this._key(ev, false));
     on(window, "blur", () => this.send("kr"));
+    on(window, "compositionend", (ev) => this._composition(ev));
+    on(window, "focus", () => this._uploadClipboard());
     on(c, "mousemove", (ev) => this._mouse(ev));
     on(c, "mousedown", (ev) => this._button(ev, true));
     on(c, "mouseup", (ev) => this._button(ev, false));
     on(c, "wheel", (ev) => this._wheel(ev));
     on(c, "contextmenu", (ev) => ev.preventDefault());
+    on(c, "click", () => this._maybePointerLock());
+    on(document, "pointerlockchange", () => this._pointerLockChanged());
+    on(document, "fullscreenchange", () => this._fullscreenChanged());
     on(window, "gamepadconnected", (ev) => this._gamepadConnected(ev));
     on(window, "gamepaddisconnected", (ev) => this._gamepadDisconnected(ev));
     on(window, "resize", () => this._reportResize());
     this._reportResize();
+    this._uploadClipboard();
+  }
+
+  /* Server pushed clipboard content: remember it so the focus-upload
+   * doesn't echo the same text straight back. */
+  noteRemoteClipboard(text) {
+    this._lastClipboard = text;
+  }
+
+  /* Local clipboard -> server on focus (the reference uploads on focus
+   * so the remote session always has the user's latest copy;
+   * input.js "cw" path). Gated on the async permission-aware API. */
+  _uploadClipboard() {
+    if (!navigator.clipboard?.readText) return;
+    navigator.clipboard.readText().then((text) => {
+      if (!text || text === this._lastClipboard) return;
+      this._lastClipboard = text;
+      this.send("cw," + btoa(unescape(encodeURIComponent(text))));
+    }).catch(() => {});  // permission denied / not focused
+  }
+
+  /* IME composition result: type each codepoint as press+release (the
+   * raw keydowns during composition were swallowed as "Process"). */
+  _composition(ev) {
+    for (const ch of ev.data || "") {
+      const sym = keysymFromCodepoint(ch.codePointAt(0));
+      this.send("kd," + sym);
+      this.send("ku," + sym);
+    }
+  }
+
+  /* -- pointer lock (relative mouse mode, reference input.js flow) --- */
+
+  requestPointerLock() {
+    this.pointerLock = true;
+    this.canvas.requestPointerLock?.();
+  }
+
+  exitPointerLock() {
+    this.pointerLock = false;
+    if (document.pointerLockElement) document.exitPointerLock();
+  }
+
+  _maybePointerLock() {
+    if (this.pointerLock && !document.pointerLockElement) {
+      this.canvas.requestPointerLock?.();
+    }
+  }
+
+  _pointerLockChanged() {
+    if (!document.pointerLockElement) this.send("kr");  // modifiers reset
+  }
+
+  /* -- fullscreen + keyboard lock ------------------------------------ */
+
+  async enterFullscreen() {
+    const el = this.canvas.parentElement || this.canvas;
+    await el.requestFullscreen?.();
+    // capture Escape / Meta / browser shortcuts while fullscreen
+    // (reference: input.js keyboard-lock block)
+    try { await navigator.keyboard?.lock?.(); } catch (e) { /* unsupported */ }
+  }
+
+  _fullscreenChanged() {
+    if (!document.fullscreenElement) {
+      navigator.keyboard?.unlock?.();
+      this.send("kr");
+    }
   }
 
   detach() {
@@ -46,6 +119,7 @@ class SelkiesInput {
   }
 
   _key(ev, down) {
+    if (ev.isComposing || ev.key === "Process") return;  // IME owns these
     const keysym = keysymFromEvent(ev);
     if (keysym === null) return;
     ev.preventDefault();

@@ -1,39 +1,112 @@
 /* KeyboardEvent → X11 keysym translation.
  *
- * Compact replacement for the vendored guacamole-keyboard table in the
- * reference client (addons/gst-web/src/lib/guacamole-keyboard-selkies.js):
- * printable characters map through their Unicode codepoint (Latin-1 keysyms
- * equal the codepoint; others use the 0x01000000+cp convention) and
- * non-printable keys use the explicit KeyboardEvent.key table below.
+ * Fresh implementation of what the reference client vendors guacamole
+ * for (addons/gst-web/src/lib/guacamole-keyboard-selkies.js): printable
+ * characters map through their Unicode codepoint (Latin-1 keysyms equal
+ * the codepoint; others use the 0x01000000+cp convention); everything
+ * else resolves through the tables below, with KeyboardEvent.location
+ * distinguishing left/right modifiers and the numpad. Keysym values are
+ * the standard X11 keysymdef constants.
  */
 "use strict";
 
 const KEYSYMS_BY_KEY = {
-  "Backspace": 0xff08, "Tab": 0xff09, "Enter": 0xff0d, "Escape": 0xff1b,
-  "Delete": 0xffff, "Home": 0xff50, "End": 0xff57, "PageUp": 0xff55,
-  "PageDown": 0xff56, "ArrowLeft": 0xff51, "ArrowUp": 0xff52,
-  "ArrowRight": 0xff53, "ArrowDown": 0xff54, "Insert": 0xff63,
-  "Pause": 0xff13, "ScrollLock": 0xff14, "PrintScreen": 0xff61,
-  "CapsLock": 0xffe5, "NumLock": 0xff7f, "ContextMenu": 0xff67,
+  // editing / navigation
+  "Backspace": 0xff08, "Tab": 0xff09, "Clear": 0xff0b, "Enter": 0xff0d,
+  "Escape": 0xff1b, "Delete": 0xffff, "Home": 0xff50, "End": 0xff57,
+  "PageUp": 0xff55, "PageDown": 0xff56, "ArrowLeft": 0xff51,
+  "ArrowUp": 0xff52, "ArrowRight": 0xff53, "ArrowDown": 0xff54,
+  "Insert": 0xff63, "Undo": 0xff65, "Redo": 0xff66, "Find": 0xff68,
+  "Cancel": 0xff69, "Help": 0xff6a, "Select": 0xff60, "Execute": 0xff62,
+  // locks / system
+  "Pause": 0xff13, "ScrollLock": 0xff14, "SysReq": 0xff15,
+  "PrintScreen": 0xff61, "CapsLock": 0xffe5, "NumLock": 0xff7f,
+  "ContextMenu": 0xff67,
+  // modifiers (left variants; location fixes the right side)
   "Shift": 0xffe1, "Control": 0xffe3, "Alt": 0xffe9, "AltGraph": 0xfe03,
-  "Meta": 0xffe7, "OS": 0xffe7,
+  "Meta": 0xffe7, "OS": 0xffe7, "Super": 0xffeb, "Hyper": 0xffed,
+  "ModeChange": 0xff7e,
+  // function keys
   "F1": 0xffbe, "F2": 0xffbf, "F3": 0xffc0, "F4": 0xffc1, "F5": 0xffc2,
   "F6": 0xffc3, "F7": 0xffc4, "F8": 0xffc5, "F9": 0xffc6, "F10": 0xffc7,
-  "F11": 0xffc8, "F12": 0xffc9,
+  "F11": 0xffc8, "F12": 0xffc9, "F13": 0xffca, "F14": 0xffcb,
+  "F15": 0xffcc, "F16": 0xffcd, "F17": 0xffce, "F18": 0xffcf,
+  "F19": 0xffd0, "F20": 0xffd1, "F21": 0xffd2, "F22": 0xffd3,
+  "F23": 0xffd4, "F24": 0xffd5,
+  // IME / language (W3C key values → X keysyms)
+  "Compose": 0xff20, "Convert": 0xff23, "NonConvert": 0xff22,
+  "KanaMode": 0xff2d, "HiraganaKatakana": 0xff27, "Hiragana": 0xff25,
+  "Katakana": 0xff26, "Zenkaku": 0xff28, "Hankaku": 0xff29,
+  "ZenkakuHankaku": 0xff2a, "Romaji": 0xff24, "KanjiMode": 0xff21,
+  "HangulMode": 0xff31, "HanjaMode": 0xff34, "Eisu": 0xff2f,
+  // dead keys (compositionend carries the final text; these cover the
+  // raw dead-key presses when composition is off)
+  "Dead": 0xfe50,
+  // media / browser keys (XF86 keysym block 0x1008ffxx)
+  "AudioVolumeMute": 0x1008ff12, "AudioVolumeDown": 0x1008ff11,
+  "AudioVolumeUp": 0x1008ff13, "MediaPlayPause": 0x1008ff14,
+  "MediaStop": 0x1008ff15, "MediaTrackPrevious": 0x1008ff16,
+  "MediaTrackNext": 0x1008ff17, "MediaPlay": 0x1008ff14,
+  "BrowserBack": 0x1008ff26, "BrowserForward": 0x1008ff27,
+  "BrowserRefresh": 0x1008ff29, "BrowserStop": 0x1008ff28,
+  "BrowserSearch": 0x1008ff1b, "BrowserFavorites": 0x1008ff30,
+  "BrowserHome": 0x1008ff18, "LaunchMail": 0x1008ff19,
+  "LaunchApplication1": 0x1008ff1c, "LaunchApplication2": 0x1008ff1d,
+  "Eject": 0x1008ff2c, "Sleep": 0x1008ff2f, "WakeUp": 0x1008ff2b,
+  "Power": 0x1008ff2a, "BrightnessUp": 0x1008ff02,
+  "BrightnessDown": 0x1008ff03, "Copy": 0x1008ff57, "Cut": 0x1008ff58,
+  "Paste": 0x1008ff6d, "Open": 0x1008ff6b, "Save": 0x1008ff77,
+  "Print": 0xff61, "ZoomIn": 0x1008ff8b, "ZoomOut": 0x1008ff8c,
 };
 
-const KEYSYMS_RIGHT = { "Shift": 0xffe2, "Control": 0xffe4, "Alt": 0xffea, "Meta": 0xffe8 };
+// location === 2 (right-hand modifiers)
+const KEYSYMS_RIGHT = {
+  "Shift": 0xffe2, "Control": 0xffe4, "Alt": 0xffea, "Meta": 0xffe8,
+  "OS": 0xffe8, "Super": 0xffec, "Hyper": 0xffee,
+};
+
+// location === 3 (numpad): KP_ keysyms keep applications that
+// distinguish the keypad (games, terminals with keypad modes) working.
+const KEYSYMS_NUMPAD = {
+  "0": 0xffb0, "1": 0xffb1, "2": 0xffb2, "3": 0xffb3, "4": 0xffb4,
+  "5": 0xffb5, "6": 0xffb6, "7": 0xffb7, "8": 0xffb8, "9": 0xffb9,
+  ".": 0xffae, ",": 0xffac, "+": 0xffab, "-": 0xffad, "*": 0xffaa,
+  "/": 0xffaf, "=": 0xffbd, "Enter": 0xff8d, "Home": 0xff95,
+  "End": 0xff9c, "PageUp": 0xff9a, "PageDown": 0xff9b,
+  "ArrowLeft": 0xff96, "ArrowUp": 0xff97, "ArrowRight": 0xff98,
+  "ArrowDown": 0xff99, "Insert": 0xff9e, "Delete": 0xff9f,
+  "Clear": 0xff9d, "Tab": 0xff89, " ": 0xff80,
+};
+
+// dead-key spellings (KeyboardEvent.key === "Dead" loses WHICH accent;
+// ev.code + keyboard layout would be needed — the composition handler in
+// input.js covers composed text, so the generic dead keysym suffices)
 
 function keysymFromEvent(ev) {
   const key = ev.key;
   if (key === undefined) return null;
+  if (ev.location === 3) {
+    const kp = KEYSYMS_NUMPAD[key];
+    if (kp !== undefined) return kp;
+  }
   if (key.length === 1) {
     const cp = key.codePointAt(0);
     if (cp >= 0x20 && cp <= 0xff) return cp;          // Latin-1 direct
     if (cp >= 0x100) return 0x01000000 + cp;          // Unicode keysym
     return cp;
   }
+  if (key.length === 2 && key.codePointAt(0) >= 0xd800) {
+    return 0x01000000 + key.codePointAt(0);           // astral plane pair
+  }
   if (ev.location === 2 && KEYSYMS_RIGHT[key] !== undefined) return KEYSYMS_RIGHT[key];
   const sym = KEYSYMS_BY_KEY[key];
   return sym === undefined ? null : sym;
+}
+
+/* Keysym for one Unicode codepoint (composition / clipboard typing). */
+function keysymFromCodepoint(cp) {
+  if (cp >= 0x20 && cp <= 0xff) return cp;
+  if (cp === 0x0a || cp === 0x0d) return 0xff0d;      // newline -> Return
+  if (cp === 0x09) return 0xff09;
+  return 0x01000000 + cp;
 }

@@ -29,6 +29,8 @@ class SelkiesMedia {
     this.audioCtx = null;
     this.audioDecoder = null;
     this.framesDecoded = 0;
+    this.framesDropped = 0;
+    this.keyFramesDecoded = 0;
     this.bytesReceived = 0;
     this.lastFrameAt = 0;
     this.connected = false;
@@ -97,8 +99,9 @@ class SelkiesMedia {
 
   _video(payload, ts, key) {
     if (!this._ensureVideoDecoder()) return;
-    if (this.videoDecoder.state !== "configured") return;
-    if (this.framesDecoded === 0 && !key) return;  // wait for an IDR
+    if (this.videoDecoder.state !== "configured") { this.framesDropped++; return; }
+    if (this.framesDecoded === 0 && !key) { this.framesDropped++; return; }  // wait for an IDR
+    if (key) this.keyFramesDecoded++;
     this.videoDecoder.decode(new EncodedVideoChunk({
       type: key ? "key" : "delta",
       timestamp: Math.round(ts * 1000 / 90),        // 90 kHz → µs

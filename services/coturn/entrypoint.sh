@@ -1,0 +1,47 @@
+#!/bin/bash
+# coturn launcher (reference parity: /root/reference/addons/coturn/
+# entrypoint.sh): starts turnserver with the HMAC shared-secret scheme
+# the streamer's /turn endpoint and services/turn_rest.py issue
+# credentials for. External IP discovery: env override, then cloud
+# metadata, then the first local address.
+set -e
+
+TURN_PORT="${TURN_PORT:-${SELKIES_TURN_PORT:-3478}}"
+TURN_SHARED_SECRET="${TURN_SHARED_SECRET:-${SELKIES_TURN_SHARED_SECRET:?TURN_SHARED_SECRET required}}"
+TURN_REALM="${TURN_REALM:-selkies.io}"
+TURN_MIN_PORT="${TURN_MIN_PORT:-49152}"
+TURN_MAX_PORT="${TURN_MAX_PORT:-65535}"
+
+detect_external_ip() {
+    if [ -n "${TURN_EXTERNAL_IP}" ]; then
+        echo "${TURN_EXTERNAL_IP}"
+        return
+    fi
+    # GCE / EC2 metadata (175 ms timeout keeps non-cloud startup fast)
+    for url in \
+        "http://metadata.google.internal/computeMetadata/v1/instance/network-interfaces/0/access-configs/0/external-ip" \
+        "http://169.254.169.254/latest/meta-data/public-ipv4"; do
+        ip=$(curl -sf -m 0.2 -H "Metadata-Flavor: Google" "$url" 2>/dev/null || true)
+        if [ -n "$ip" ]; then echo "$ip"; return; fi
+    done
+    hostname -I 2>/dev/null | awk '{print $1}' || echo 127.0.0.1
+}
+
+EXTERNAL_IP="$(detect_external_ip)"
+echo "coturn: external ip ${EXTERNAL_IP}, port ${TURN_PORT}"
+
+exec turnserver \
+    --verbose \
+    --listening-ip=0.0.0.0 \
+    --listening-port="${TURN_PORT}" \
+    --external-ip="${EXTERNAL_IP}" \
+    --realm="${TURN_REALM}" \
+    --use-auth-secret \
+    --static-auth-secret="${TURN_SHARED_SECRET}" \
+    --min-port="${TURN_MIN_PORT}" \
+    --max-port="${TURN_MAX_PORT}" \
+    --no-cli \
+    --no-tls \
+    --no-dtls \
+    --pidfile /tmp/turnserver.pid \
+    --log-file stdout

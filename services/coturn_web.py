@@ -1,0 +1,129 @@
+#!/usr/bin/env python3
+"""coturn-web: TURN discovery + credential HTTP service.
+
+Reference parity: /root/reference/addons/coturn-web/main.go — serves RTC
+configurations for a fleet of coturn instances. The Go original watches
+Kubernetes Endpoints/Nodes informers; this implementation supports the
+same three discovery modes with a poll loop instead of informers:
+
+  * static:   TURN_HOST env (single instance)
+  * list:     TURN_HOSTS env, comma-separated — round-robins per request
+  * kubectl:  TURN_ENDPOINTS_DISCOVERY=<service>, optional
+              TURN_ENDPOINTS_NAMESPACE — polls `kubectl get endpoints`
+              for ready addresses every TURN_DISCOVERY_INTERVAL seconds
+
+Endpoints:
+  GET /        RTC config JSON with a fresh HMAC credential (username
+               from X-Auth-User header, as behind an auth proxy)
+  GET /healthz
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import subprocess
+import sys
+import time
+
+from aiohttp import web
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from selkies_tpu.signalling.turn import generate_rtc_config  # noqa: E402
+
+logger = logging.getLogger("coturn-web")
+
+
+class TurnPool:
+    """Known TURN hosts + a rotating pick."""
+
+    def __init__(self) -> None:
+        self.hosts: list[str] = []
+        self._i = 0
+        static = os.environ.get("TURN_HOSTS") or os.environ.get("TURN_HOST", "")
+        if static:
+            self.hosts = [h.strip() for h in static.split(",") if h.strip()]
+
+    def pick(self) -> str | None:
+        if not self.hosts:
+            return None
+        h = self.hosts[self._i % len(self.hosts)]
+        self._i += 1
+        return h
+
+    async def discovery_loop(self) -> None:
+        """kubectl-based endpoints discovery (the Go informers' poll twin)."""
+        name = os.environ.get("TURN_ENDPOINTS_DISCOVERY")
+        if not name:
+            return
+        ns = os.environ.get("TURN_ENDPOINTS_NAMESPACE", "default")
+        interval = float(os.environ.get("TURN_DISCOVERY_INTERVAL", "15"))
+        while True:
+            try:
+                out = subprocess.run(
+                    ["kubectl", "get", "endpoints", name, "-n", ns, "-o", "json"],
+                    capture_output=True, timeout=10,
+                )
+                if out.returncode == 0:
+                    data = json.loads(out.stdout)
+                    hosts = [
+                        a["ip"]
+                        for ss in data.get("subsets", [])
+                        for a in ss.get("addresses", [])
+                    ]
+                    if hosts and hosts != self.hosts:
+                        logger.info("discovered TURN hosts: %s", hosts)
+                        self.hosts = hosts
+            except (OSError, subprocess.SubprocessError, ValueError) as exc:
+                logger.warning("endpoints discovery failed: %s", exc)
+            await asyncio.sleep(interval)
+
+
+def make_app() -> web.Application:
+    pool = TurnPool()
+
+    async def handle(request: web.Request) -> web.Response:
+        host = pool.pick()
+        if host is None:
+            return web.Response(status=503, text="no TURN hosts discovered")
+        user = (
+            request.headers.get("x-auth-user")
+            or request.query.get("username")
+            or "coturn-web"
+        ).lower()
+        rtc = generate_rtc_config(
+            turn_host=host,
+            turn_port=os.environ.get("TURN_PORT", "3478"),
+            shared_secret=os.environ.get("TURN_SHARED_SECRET", "changeme"),
+            user=user,
+            protocol=os.environ.get("TURN_PROTOCOL", "udp"),
+            turn_tls=os.environ.get("TURN_TLS", "false").lower() == "true",
+        )
+        return web.Response(text=rtc, content_type="application/json")
+
+    async def healthz(request: web.Request) -> web.Response:
+        if not pool.hosts:
+            return web.Response(text="no-hosts", status=503)
+        return web.Response(text="ok")
+
+    async def start_discovery(app: web.Application):
+        app["discovery"] = asyncio.create_task(pool.discovery_loop())
+
+    async def stop_discovery(app: web.Application):
+        app["discovery"].cancel()
+
+    app = web.Application()
+    app["pool"] = pool
+    app.router.add_get("/", handle)
+    app.router.add_get("/healthz", healthz)
+    app.on_startup.append(start_discovery)
+    app.on_cleanup.append(stop_discovery)
+    return app
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    web.run_app(make_app(), port=int(os.environ.get("PORT", "8009")))

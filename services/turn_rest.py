@@ -1,0 +1,77 @@
+#!/usr/bin/env python3
+"""Standalone TURN REST credential service.
+
+Reference parity: /root/reference/addons/turn-rest/app.py (Flask) — same
+HTTP contract, aiohttp implementation reusing the framework's HMAC
+credential helpers (selkies_tpu/signalling/turn.py). Deployable next to
+any coturn configured with --use-auth-secret.
+
+GET/POST /  (query/form/header inputs)
+  username:   also via X-Auth-User / X-Turn-Username headers
+  protocol:   udp (default) | tcp   (also X-Turn-Protocol)
+  tls:        "true" | "false"      (also X-Turn-TLS)
+Response: the standard RTC-configuration JSON (lifetimeDuration,
+iceServers with urls/username/credential) the web client consumes.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from aiohttp import web
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from selkies_tpu.signalling.turn import generate_rtc_config  # noqa: E402
+
+
+async def handle(request: web.Request) -> web.Response:
+    vals = dict(request.query)
+    if request.method == "POST":
+        vals.update({k: str(v) for k, v in (await request.post()).items()})
+    user = (
+        vals.get("username")
+        or request.headers.get("x-auth-user")
+        or request.headers.get("x-turn-username")
+        or "turn-rest"
+    ).lower()
+    protocol = (
+        vals.get("protocol")
+        or request.headers.get("x-turn-protocol")
+        or os.environ.get("TURN_PROTOCOL", "udp")
+    ).lower()
+    if protocol != "tcp":
+        protocol = "udp"
+    tls = (
+        vals.get("tls")
+        or request.headers.get("x-turn-tls")
+        or os.environ.get("TURN_TLS", "false")
+    ).lower() == "true"
+    rtc = generate_rtc_config(
+        turn_host=os.environ.get("TURN_HOST", "127.0.0.1").lower(),
+        turn_port=os.environ.get("TURN_PORT", "3478"),
+        shared_secret=os.environ.get("TURN_SHARED_SECRET", "changeme"),
+        user=user,
+        protocol=protocol,
+        turn_tls=tls,
+        stun_host=os.environ.get("STUN_HOST", "").lower() or None,
+        stun_port=os.environ.get("STUN_PORT", "") or None,
+    )
+    return web.Response(text=rtc, content_type="application/json")
+
+
+async def healthz(request: web.Request) -> web.Response:
+    return web.Response(text="ok")
+
+
+def make_app() -> web.Application:
+    app = web.Application()
+    app.router.add_route("GET", "/", handle)
+    app.router.add_route("POST", "/", handle)
+    app.router.add_get("/healthz", healthz)
+    return app
+
+
+if __name__ == "__main__":
+    web.run_app(make_app(), port=int(os.environ.get("PORT", "8008")))

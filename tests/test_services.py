@@ -1,0 +1,83 @@
+"""TURN ecosystem services: turn-rest + coturn-web HTTP contracts."""
+
+import asyncio
+import json
+import os
+import sys
+
+import pytest
+
+aiohttp = pytest.importorskip("aiohttp")
+from aiohttp.test_utils import TestClient, TestServer  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "services"))
+
+import coturn_web  # noqa: E402
+import turn_rest  # noqa: E402
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+@pytest.fixture
+def turn_env(monkeypatch):
+    monkeypatch.setenv("TURN_SHARED_SECRET", "s3cret")
+    monkeypatch.setenv("TURN_HOST", "turn.example.com")
+    monkeypatch.setenv("TURN_PORT", "3478")
+
+
+def test_turn_rest_contract(loop, turn_env):
+    async def run():
+        async with TestClient(TestServer(turn_rest.make_app())) as client:
+            r = await client.get("/", params={"username": "Alice", "protocol": "tcp"})
+            assert r.status == 200
+            cfg = json.loads(await r.text())
+            assert cfg["lifetimeDuration"].endswith("s")
+            turn = cfg["iceServers"][1]
+            assert turn["urls"] == ["turn:turn.example.com:3478?transport=tcp"]
+            # coturn REST credential: "<expiry>:<user>" + b64 HMAC
+            exp, user = turn["username"].split(":")
+            assert user == "alice" and int(exp) > 0
+            assert turn["credential"]
+            # header-based identity + default protocol
+            r = await client.get("/", headers={"x-auth-user": "Bob"})
+            cfg = json.loads(await r.text())
+            assert ":bob" in cfg["iceServers"][1]["username"]
+            assert "transport=udp" in cfg["iceServers"][1]["urls"][0]
+            r = await client.get("/healthz")
+            assert await r.text() == "ok"
+
+    loop.run_until_complete(run())
+
+
+def test_coturn_web_static_and_rotation(loop, turn_env, monkeypatch):
+    monkeypatch.setenv("TURN_HOSTS", "t1.example.com, t2.example.com")
+
+    async def run():
+        async with TestClient(TestServer(coturn_web.make_app())) as client:
+            seen = set()
+            for _ in range(2):
+                r = await client.get("/", headers={"x-auth-user": "u"})
+                assert r.status == 200
+                cfg = json.loads(await r.text())
+                seen.add(cfg["iceServers"][1]["urls"][0].split(":")[1])
+            assert seen == {"t1.example.com", "t2.example.com"}
+
+    loop.run_until_complete(run())
+
+
+def test_coturn_web_no_hosts(loop, monkeypatch):
+    monkeypatch.delenv("TURN_HOSTS", raising=False)
+    monkeypatch.delenv("TURN_HOST", raising=False)
+
+    async def run():
+        async with TestClient(TestServer(coturn_web.make_app())) as client:
+            r = await client.get("/")
+            assert r.status == 503
+
+    loop.run_until_complete(run())

@@ -1,0 +1,66 @@
+"""Static consistency of the web client bundle (no JS runtime in CI:
+cross-check the pieces against each other textually)."""
+
+import os
+import re
+
+WEB = os.path.join(os.path.dirname(__file__), "..", "selkies_tpu", "web")
+
+
+def _read(name):
+    with open(os.path.join(WEB, name)) as f:
+        return f.read()
+
+
+def test_bundle_complete():
+    for name in ("index.html", "app.js", "input.js", "media.js",
+                 "keysyms.js", "manifest.json", "sw.js"):
+        assert os.path.exists(os.path.join(WEB, name)), name
+
+
+def test_dom_ids_exist():
+    html = _read("index.html")
+    app = _read("app.js")
+    for el_id in set(re.findall(r"getElementById\(\"([^\"]+)\"\)", app)):
+        assert f'id="{el_id}"' in html, f"app.js references missing #{el_id}"
+
+
+def test_scripts_included_in_order():
+    html = _read("index.html")
+    order = [html.index(f'src="{s}"') for s in
+             ("keysyms.js", "input.js", "media.js", "app.js")]
+    assert order == sorted(order), "script load order broken"
+
+
+def test_sw_shell_matches_files():
+    sw = _read("sw.js")
+    shell = re.search(r"const SHELL = \[(.*?)\];", sw, re.S).group(1)
+    for name in re.findall(r'"([a-z.]+\.(?:js|json|html))"', shell):
+        assert os.path.exists(os.path.join(WEB, name)), f"sw.js caches missing {name}"
+
+
+def test_keysym_table_coverage():
+    ks = _read("keysyms.js")
+    # the protocol-critical groups the reference's guacamole table covers
+    for required in ("F24", "KEYSYMS_NUMPAD", "AudioVolumeMute",
+                     "BrowserBack", "Compose", "KanaMode", "HangulMode",
+                     "keysymFromCodepoint", "0xffe2"):
+        assert required in ks, f"keysym table lacks {required}"
+
+
+def test_input_protocol_verbs_match_host():
+    """Every verb the client sends must be handled by the input host."""
+    client = _read("input.js") + _read("app.js")
+    sent = set()
+    for m in re.findall(r'send\("([a-z_]+[a-z0-9_]*),', client):
+        sent.add(m)
+    for m in re.findall(r'send\(`([a-z_]+[a-z0-9_]*),', client):
+        sent.add(m)
+    sent.add("kr")  # bare verb (no comma)
+    with open(os.path.join(WEB, "..", "input_host", "handler.py")) as f:
+        host = f.read()
+    known = set(re.findall(r'cmd == "([^"]+)"', host))
+    known |= {m for grp in re.findall(r'cmd in \(([^)]+)\)', host)
+              for m in re.findall(r'"([^"]+)"', grp)}
+    missing = {v for v in sent if v not in known}
+    assert not missing, f"client sends unhandled verbs: {missing}"
