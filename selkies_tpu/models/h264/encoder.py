@@ -226,6 +226,7 @@ class _Pending:
     hdr_d: object = None  # pd only: dense header for the ns>NSCAP fallback
     future: object = None  # completion future (threaded fetch+unpack+pack)
     batch_slot: int = -1  # >=0: index into a shared batch future's result list
+    scene_cut: bool = False  # full-frame change transition (rate control)
 
 
 class TPUH264Encoder:
@@ -254,6 +255,7 @@ class TPUH264Encoder:
         host_convert: bool = True,
         pipeline_depth: int = 2,
         frame_batch: int = 4,
+        scene_qp_boost: int = 0,
     ):
         self.width = width
         self.height = height
@@ -318,6 +320,13 @@ class TPUH264Encoder:
         # scan-over-frames device step (one upload/execute/fetch per
         # GROUP). Trades up to frame_batch-1 frame-times of latency for
         # K-fold fewer relay round trips; on PCIe-local devices set 1.
+        # feed-forward scene-cut rate control: a full-frame change encoded
+        # at the steady-state QP blows the VBV budget (reference holds VBV
+        # at 1.5 frame-times); boost QP for that one frame — the decay
+        # frames after it re-sharpen within a few hundred ms. 0 = off
+        # (keeps delta-vs-full bit-exactness tests meaningful).
+        self.scene_qp_boost = int(scene_qp_boost)
+        self._prev_kind = "full"  # first frame is not a "scene cut"
         self.frame_batch = max(1, int(frame_batch))
         # scan executables compile for these group sizes only (greedy
         # grouping in _flush_batch); a half group beats singles when a
@@ -572,6 +581,14 @@ class TPUH264Encoder:
         # across IDRs) but only short-circuit on P frames
         kind, dirty_idx = self._classify(frame)
         batch_full = False
+        orig_qp = self.qp
+        # a scene CUT is the transition into a full-frame change; during
+        # sustained full-frame motion (video playback, scrolling) the
+        # rate controller owns QP and the boost must stay out of the loop
+        scene_cut = kind == "full" and self._src is not None and self._prev_kind != "full"
+        self._prev_kind = kind
+        if scene_cut and self.scene_qp_boost:
+            self.qp = min(51, self.qp + self.scene_qp_boost)
         if kind == "static" and not idr:
             # unchanged capture: all-skip P slice host-side — no upload,
             # no device step, no downlink (idle-desktop steady state).
@@ -646,6 +663,7 @@ class TPUH264Encoder:
                         frame_num=self._frames_since_idr % 256, idr_pic_id=0,
                         t0=t0, t1=0.0, meta=meta,
                         prefix_d=prefix_d, buf_d=buf_d, hdr_d=hdr_d,
+                        scene_cut=scene_cut,
                     )
                 # start the downlink fetch + entropy pack on a worker NOW:
                 # fetch ops overlap across threads on the relay
@@ -660,7 +678,9 @@ class TPUH264Encoder:
                 # chain and remain deliverable.
                 self._ref = None
                 self._src = None
+                self.qp = orig_qp
                 raise
+        self.qp = orig_qp
         self.frame_index += 1
         self._frames_since_idr += 1
         self._inflight.append(rec)
@@ -731,6 +751,7 @@ class TPUH264Encoder:
             frame_index=rec.frame_index, idr=rec.kind == "i", qp=rec.qp,
             bytes=len(au), device_ms=(t1 - rec.t0) * 1e3,
             pack_ms=(t2 - t1) * 1e3, skipped_mbs=skipped,
+            scene_cut=rec.scene_cut,
         )
         self.last_stats = stats
         return au, stats, rec.meta

@@ -27,6 +27,11 @@ class CbrRateController:
     min_qp: int = 10
     max_qp: int = 51
     qp: int = 30
+    # a keyframe (IDR or scene-cut P) legitimately spends several frame
+    # budgets; the allowance forgives overshoot up to this many frames —
+    # and ONLY overshoot, so a cheap keyframe is accounted like any
+    # other frame instead of wiping accumulated VBV debt
+    keyframe_budget_frames: float = 8.0
     _fullness: float = field(default=0.0, init=False)
 
     @property
@@ -52,13 +57,20 @@ class CbrRateController:
         """QP to use for the next frame."""
         return self.qp
 
-    def update(self, frame_bytes: int) -> int:
-        """Account an encoded frame; returns the QP for the next frame."""
+    def update(self, frame_bytes: int, idr: bool = False) -> int:
+        """Account an encoded frame; returns the QP for the next frame.
+        `idr` covers any keyframe-sized event: IDRs and scene-cut P
+        frames both receive the overshoot allowance."""
         bits = frame_bytes * 8.0
-        self._fullness += bits - self.frame_budget_bits
+        budget = self.frame_budget_bits
+        if idr:
+            # forgive overshoot up to the keyframe allowance; never
+            # reward a cheap keyframe (min against actual bits)
+            budget = max(budget, min(bits, self.keyframe_budget_frames * budget))
+        self._fullness += bits - budget
         self._fullness = max(-self.vbv_size_bits, min(self._fullness, 4 * self.vbv_size_bits))
 
-        ratio = bits / max(self.frame_budget_bits, 1.0)
+        ratio = bits / max(budget, 1.0)
         # proportional step on the instantaneous error
         if ratio > 4.0:
             step = 4

@@ -72,6 +72,7 @@ def _tpuh264enc(*, width: int, height: int, fps: int = 60, qp: int = 28, **kw):
     from selkies_tpu.models.h264.encoder import TPUH264Encoder
 
     kw.setdefault("frame_batch", default_frame_batch())
+    kw.setdefault("scene_qp_boost", 6)
     return TPUH264Encoder(width=width, height=height, qp=qp, fps=fps, **kw)
 
 

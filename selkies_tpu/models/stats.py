@@ -18,3 +18,4 @@ class FrameStats:
     device_ms: float
     pack_ms: float
     skipped_mbs: int = 0
+    scene_cut: bool = False  # full-frame change coded as P (keyframe-sized)

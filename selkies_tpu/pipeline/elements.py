@@ -66,6 +66,7 @@ class EncodedFrame:
     qp: int
     device_ms: float
     pack_ms: float
+    scene_cut: bool = False
 
 
 VideoSink = Callable[[EncodedFrame], Awaitable[None]]
@@ -182,6 +183,7 @@ class VideoPipeline:
                             qp=stats.qp,
                             device_ms=stats.device_ms,
                             pack_ms=stats.pack_ms,
+                            scene_cut=getattr(stats, "scene_cut", False),
                         )
                         for au, stats, meta in done
                     ]
@@ -200,7 +202,7 @@ class VideoPipeline:
                         )
                     ]
                 for ef in efs:
-                    self.rc.update(len(ef.au))
+                    self.rc.update(len(ef.au), idr=ef.idr or ef.scene_cut)
                 self.frames += len(efs)
                 failures = 0
             except asyncio.CancelledError:
