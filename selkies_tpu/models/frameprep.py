@@ -154,6 +154,11 @@ class FramePrep:
             y[:], u[:], v[:] = y2, u2, v2
         return y, u, v
 
+    def reset(self) -> None:
+        """Forget the previous frame: the next dirty_bands() reports
+        everything dirty (used by encoder prewarm / stream restart)."""
+        self._prev = None
+
     def convert_bands(self, frame: np.ndarray, idx: np.ndarray):
         """Convert only the 16-row bands listed in idx (int32, plane band
         numbers) to packed I420 band buffers: (k, 16, pad_w) luma and

@@ -1,0 +1,615 @@
+"""CAVLC entropy coding ON DEVICE: P-frame slice-data bits from XLA.
+
+The compact-coefficient downlink still ships multi-MB tensors for busy
+frames (a 1080p full-frame change is ~4.5 MB of nonzero rows — the
+dominant cost on a per-byte-priced link, PERF.md). This module moves the
+entire §9.2 entropy coder into the frame jit, so what crosses the link
+is the final slice-data bitstream (~50-300 KB), exactly like the
+reference's NVENC emits finished bitstreams on-GPU.
+
+Everything vectorizes: VLC tables become constant-array gathers; the
+per-level suffix-length adaptation and run_before chains are 16-step
+`lax.scan`s across ALL blocks at once; nC neighbour contexts are plain
+shifted-grid reads (TotalCoeff of every block is known before any bit is
+written); the serial-looking bit concatenation is two levels of
+prefix-sum offsets + shift/scatter-add (bit-disjoint, so add == or).
+
+The host prepends the slice header (variable length, so the device
+stream is bit-shifted to the header tail), appends the trailing
+skip_run + rbsp trailing bits, and runs emulation prevention (C++).
+Output is BIT-IDENTICAL to cavlc.pack_slice_p (tests/test_device_cavlc.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from selkies_tpu.models.h264 import tables as T
+from selkies_tpu.models.h264.cavlc import INTER_CBP_TO_CODENUM
+
+__all__ = ["pack_p_slice_bits", "WORD_CAP_DEFAULT"]
+
+# ---------------------------------------------------------------------------
+# VLC tables as dense arrays (generated from the FFmpeg-validated
+# functions in tables.py, so the two representations cannot drift)
+# ---------------------------------------------------------------------------
+
+# coeff_token: class 0..2 -> nc buckets [0,2) [2,4) [4,8); class 3 = nc>=8
+# (computed arithmetically); class 4 = chroma DC (nc == -1).
+_CT_VAL = np.zeros((5, 17, 4), np.int32)
+_CT_BITS = np.zeros((5, 17, 4), np.int32)
+for cls, nc_probe in enumerate((0, 2, 4, 8, -1)):
+    for total in range(17):
+        for t1 in range(min(total, 3) + 1):
+            if nc_probe == -1 and total > 4:
+                continue
+            v, b = T.coeff_token_code(nc_probe, total, t1)
+            _CT_VAL[cls, total, t1] = v
+            _CT_BITS[cls, total, t1] = b
+
+_TZ_VAL = np.zeros((17, 16), np.int32)
+_TZ_BITS = np.zeros((17, 16), np.int32)
+for total in range(1, 16):
+    for tz in range(0, 16 - total + 1):
+        v, b = T.total_zeros_code(total, tz, chroma_dc=False)
+        _TZ_VAL[total, tz] = v
+        _TZ_BITS[total, tz] = b
+_TZC_VAL = np.zeros((4, 4), np.int32)
+_TZC_BITS = np.zeros((4, 4), np.int32)
+for total in range(1, 4):
+    for tz in range(0, 4 - total + 1):
+        v, b = T.total_zeros_code(total, tz, chroma_dc=True)
+        _TZC_VAL[total, tz] = v
+        _TZC_BITS[total, tz] = b
+
+# run_before: zeros_left clamps at 7 in the spec table; run <= 14
+_RB_VAL = np.zeros((15, 15), np.int32)
+_RB_BITS = np.zeros((15, 15), np.int32)
+for zl in range(1, 15):
+    for run in range(0, zl + 1):
+        v, b = T.run_before_code(zl, run)
+        _RB_VAL[zl, run] = v
+        _RB_BITS[zl, run] = b
+
+_ZIGZAG = np.asarray(T.ZIGZAG_FLAT, np.int32)            # (16,)
+_CBP_CODENUM = np.asarray(INTER_CBP_TO_CODENUM, np.int32)
+
+# luma 4x4 blocks in coding order -> (x4, y4); block index within MB
+_LUMA_ORDER = np.asarray(
+    [[x4, y4] for x4, y4 in T.LUMA_BLOCK_ORDER], np.int32
+)  # (16, 2)
+_CHROMA_ORDER = np.asarray([[x, y] for x, y in T.CHROMA_BLOCK_ORDER], np.int32)
+
+WORD_CAP_DEFAULT = 1 << 17  # 512 KB frame bitstream capacity
+
+
+def _ue_bits(v):
+    """Exp-Golomb codeword for v (vectorized): (value, nbits)."""
+    v1 = v + 1
+    # floor(log2(v1)): count significant bits - 1
+    nb = 32 - jnp.clip(_clz32(v1), 0, 31)
+    return v1, 2 * nb - 1
+
+
+def _clz32(x):
+    """Count leading zeros of a positive int32 (vectorized)."""
+    x = x.astype(jnp.uint32)
+    n = jnp.zeros_like(x, jnp.int32)
+    for shift in (16, 8, 4, 2, 1):
+        big = x >= (1 << shift)
+        n = jnp.where(big, n + shift, n)
+        x = jnp.where(big, x >> shift, x)
+    return 31 - n
+
+
+def _se_bits(v):
+    """Signed Exp-Golomb: map se value -> ue codeword."""
+    code = jnp.where(v > 0, 2 * v - 1, -2 * v)
+    return _ue_bits(code)
+
+
+def _level_bits(level_code, suffix_len):
+    """Two (value, nbits) pairs — prefix codeword and suffix — for one
+    level (9.2.2.1), matching cavlc._write_level exactly. Split keeps
+    every emission slot <= 28 bits (a 64-bit pack lane covers any slot
+    start within a word)."""
+    lc0 = level_code
+    lc_adj = jnp.where((suffix_len == 0) & (lc0 >= 30), lc0 - 15, lc0)
+    sl = jnp.maximum(suffix_len, 0)
+    prefix = lc_adj >> sl
+    # regular: prefix zeros + 1, then sl suffix bits
+    v1 = jnp.ones_like(lc0)
+    b1 = prefix + 1
+    v2 = lc_adj & ((jnp.int32(1) << sl) - 1)
+    b2 = sl
+    # escape: prefix 15 (16-bit '...1'), 12-bit suffix
+    esc = lc_adj - (jnp.int32(15) << sl)
+    in_esc = (prefix >= 15) & (esc < (1 << 12))
+    b1 = jnp.where(in_esc, 16, b1)
+    v2 = jnp.where(in_esc, jnp.clip(esc, 0, (1 << 12) - 1), v2)
+    b2 = jnp.where(in_esc, 12, b2)
+    # extended prefixes 16+: suffix size = prefix-3
+    found = jnp.zeros_like(lc0, dtype=bool)
+    for pfx in range(16, 28):
+        base = (jnp.int32(15) << sl) + (1 << (pfx - 3)) - (1 << 12)
+        fit = (lc_adj - base) < (1 << (pfx - 3))
+        take = (prefix >= 15) & ~in_esc & fit & ~found
+        b1 = jnp.where(take, pfx + 1, b1)
+        v2 = jnp.where(take, lc_adj - base, v2)
+        b2 = jnp.where(take, pfx - 3, b2)
+        found = found | take
+    # suffix_len==0 specials
+    small = (suffix_len == 0) & (lc0 < 14)
+    b1 = jnp.where(small, lc0 + 1, b1)
+    v2 = jnp.where(small, 0, v2)
+    b2 = jnp.where(small, 0, b2)
+    mid = (suffix_len == 0) & (lc0 >= 14) & (lc0 < 30)
+    b1 = jnp.where(mid, 15, b1)
+    v2 = jnp.where(mid, lc0 - 14, v2)
+    b2 = jnp.where(mid, 4, b2)
+    return v1, b1, v2, b2
+
+
+def _encode_blocks(coeffs, nc, chroma_dc: bool):
+    """CAVLC-encode a batch of residual blocks.
+
+    coeffs: (B, L) int32 scan-order coefficients (L = 16, 15 or 4);
+    nc: (B,) int32 neighbour context (-1 for chroma DC).
+    Returns (vals (B, S), bits (B, S), total (B,)) — S emission slots in
+    order; bits==0 slots contribute nothing.
+    """
+    B, L = coeffs.shape
+    nz = coeffs != 0
+    total = nz.sum(-1).astype(jnp.int32)
+    # reverse-scan-order nonzero positions: sort key puts nonzeros first,
+    # highest position first
+    key = jnp.where(nz, L - 1 - jnp.arange(L, dtype=jnp.int32)[None, :], jnp.int32(1000))
+    order = jnp.argsort(key, axis=-1)  # (B, L): reverse-scan nz positions first
+    pos_rev = jnp.take_along_axis(
+        jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[None, :], (B, L)), order, -1
+    )
+    val_rev = jnp.take_along_axis(coeffs, order, -1)
+    idx = jnp.arange(L, dtype=jnp.int32)[None, :]
+    valid = idx < total[:, None]
+
+    # trailing ones: leading run of |1| in val_rev, capped at 3
+    is_one = (jnp.abs(val_rev) == 1) & valid
+    run1 = jnp.cumprod(is_one, axis=-1, dtype=jnp.int32)
+    t1 = jnp.minimum(run1.sum(-1), 3).astype(jnp.int32)
+
+    # coeff_token
+    cls = jnp.where(
+        nc < 0, 4, jnp.where(nc < 2, 0, jnp.where(nc < 4, 1, jnp.where(nc < 8, 2, 3)))
+    )
+    ct_val = jnp.asarray(_CT_VAL)[cls, total, t1]
+    ct_bits = jnp.asarray(_CT_BITS)[cls, total, t1]
+    # nc >= 8: arithmetic FLC (class 3 table rows were generated for nc=8;
+    # they ARE the FLC — generated from the same function, so no special
+    # case needed here)
+
+    S = 1 + 3 + 2 * L + 1 + (L - 1)  # token, t1s, level pairs, tz, runs
+    vals = jnp.zeros((B, S), jnp.int32)
+    bits = jnp.zeros((B, S), jnp.int32)
+    vals = vals.at[:, 0].set(ct_val)
+    bits = bits.at[:, 0].set(ct_bits)
+
+    # t1 signs (reverse order): slot 1..3
+    for k in range(3):
+        sign = (val_rev[:, k] < 0).astype(jnp.int32)
+        use = (k < t1) & (total > 0)
+        vals = vals.at[:, 1 + k].set(jnp.where(use, sign, 0))
+        bits = bits.at[:, 1 + k].set(jnp.where(use, 1, 0))
+
+    # levels after the trailing ones: sequential suffix_len adaptation
+    def level_step(carry, k):
+        suffix_len, first_done = carry
+        level = jnp.take_along_axis(val_rev, k[:, None], -1)[:, 0]
+        use = (k >= t1) & (k < total)
+        level_code = jnp.where(level > 0, 2 * level - 2, -2 * level - 1)
+        is_first = use & ~first_done
+        level_code = jnp.where(is_first & (t1 < 3), level_code - 2, level_code)
+        v1, b1, v2, b2 = _level_bits(level_code, suffix_len)
+        new_sl = jnp.where(suffix_len == 0, 1, suffix_len)
+        new_sl = jnp.where(
+            (jnp.abs(level) > (3 << jnp.maximum(new_sl - 1, 0))) & (new_sl < 6),
+            new_sl + 1,
+            new_sl,
+        )
+        suffix_len = jnp.where(use, new_sl, suffix_len)
+        first_done = first_done | is_first
+        return (suffix_len, first_done), (
+            jnp.where(use, v1, 0), jnp.where(use, b1, 0),
+            jnp.where(use, v2, 0), jnp.where(use, b2, 0),
+        )
+
+    init_sl = jnp.where((total > 10) & (t1 < 3), 1, 0)
+    ks = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[:, None], (L, B))
+    (_, _), (lv1, lb1, lv2, lb2) = jax.lax.scan(
+        level_step, (init_sl, jnp.zeros((B,), bool)), ks
+    )
+    vals = vals.at[:, 4 : 4 + 2 * L : 2].set(lv1.T)
+    bits = bits.at[:, 4 : 4 + 2 * L : 2].set(lb1.T)
+    vals = vals.at[:, 5 : 4 + 2 * L : 2].set(lv2.T)
+    bits = bits.at[:, 5 : 4 + 2 * L : 2].set(lb2.T)
+
+    # total_zeros
+    last_pos = pos_rev[:, 0]
+    tz = jnp.where(total > 0, last_pos + 1 - total, 0)
+    if chroma_dc:
+        tz_val = jnp.asarray(_TZC_VAL)[jnp.clip(total, 0, 3), jnp.clip(tz, 0, 3)]
+        tz_bits = jnp.asarray(_TZC_BITS)[jnp.clip(total, 0, 3), jnp.clip(tz, 0, 3)]
+    else:
+        tz_val = jnp.asarray(_TZ_VAL)[jnp.clip(total, 0, 16), jnp.clip(tz, 0, 15)]
+        tz_bits = jnp.asarray(_TZ_BITS)[jnp.clip(total, 0, 16), jnp.clip(tz, 0, 15)]
+    use_tz = (total > 0) & (total < L)
+    vals = vals.at[:, 4 + 2 * L].set(jnp.where(use_tz, tz_val, 0))
+    bits = bits.at[:, 4 + 2 * L].set(jnp.where(use_tz, tz_bits, 0))
+
+    # run_before chain (reverse order), zeros_left decreasing
+    def run_step(carry, k):
+        zeros_left = carry
+        p_k = jnp.take_along_axis(pos_rev, k[:, None], -1)[:, 0]
+        p_k1 = jnp.take_along_axis(pos_rev, (k + 1)[:, None], -1)[:, 0]
+        run = p_k - p_k1 - 1
+        use = (k < total - 1) & (zeros_left > 0)
+        zl_c = jnp.clip(zeros_left, 0, 14)
+        run_c = jnp.clip(run, 0, 14)
+        v = jnp.asarray(_RB_VAL)[zl_c, run_c]
+        b = jnp.asarray(_RB_BITS)[zl_c, run_c]
+        zeros_left = jnp.where(use, zeros_left - run, zeros_left)
+        return zeros_left, (jnp.where(use, v, 0), jnp.where(use, b, 0))
+
+    ks2 = jnp.broadcast_to(jnp.arange(L - 1, dtype=jnp.int32)[:, None], (L - 1, B))
+    _, (rv, rb) = jax.lax.scan(run_step, tz, ks2)
+    vals = vals.at[:, 5 + 2 * L :].set(rv.T)
+    bits = bits.at[:, 5 + 2 * L :].set(rb.T)
+    return vals, bits, total
+
+
+def _split2(val, start_in_word, bits):
+    """32-bit-only placement of a codeword (<= 28 bits) whose first bit
+    lands at `start_in_word` (0..31) of a word: returns (hi, lo) uint32
+    contributions to that word and the next. MSB-first."""
+    v = val.astype(jnp.uint32)
+    fits = start_in_word + bits <= 32
+    sh_hi = jnp.clip(32 - start_in_word - bits, 0, 31)
+    hi_fit = v << sh_hi
+    over = jnp.clip(start_in_word + bits - 32, 1, 31)  # valid in split case
+    hi_split = v >> over
+    lo_split = (v & ((jnp.uint32(1) << over) - 1)) << (32 - over)
+    hi = jnp.where(fits, hi_fit, hi_split)
+    lo = jnp.where(fits, 0, lo_split)
+    return hi, lo
+
+
+def _pack_pairs(vals, bits, nwords: int):
+    """Pack (U, S) (value, nbits) emission slots into per-unit bit
+    buffers: returns (words (U, nwords) uint32, nbits_total (U,)).
+    MSB-first within the stream; word 0 holds the first 32 bits.
+    32-bit ops only (jax default has no uint64)."""
+    U, S = vals.shape
+    offs = jnp.concatenate(
+        [jnp.zeros((U, 1), jnp.int32), jnp.cumsum(bits, -1)], -1
+    )  # (U, S+1)
+    total_bits = offs[:, -1]
+    words = jnp.zeros((U, nwords), jnp.uint32)
+    vmask = jnp.where(bits >= 32, jnp.uint32(0xFFFFFFFF),
+                      (jnp.uint32(1) << jnp.clip(bits, 0, 31)) - 1)
+    v = vals.astype(jnp.uint32) & vmask
+    start = offs[:, :-1]
+    w0 = start >> 5
+    hi, lo = _split2(v, start & 31, bits)
+    use = bits > 0
+    w0c = jnp.clip(w0, 0, nwords - 1)
+    w1c = jnp.clip(w0 + 1, 0, nwords - 1)
+    hi = jnp.where(use, hi, jnp.uint32(0))
+    lo = jnp.where(use & (w0 + 1 < nwords), lo, jnp.uint32(0))
+    rows = jnp.broadcast_to(jnp.arange(U, dtype=jnp.int32)[:, None], w0.shape)
+    words = words.at[rows, w0c].add(hi)
+    words = words.at[rows, w1c].add(lo)
+    return words, total_bits
+
+
+def _merge_streams(words, nbits, out_words: int):
+    """Concatenate U bit-buffers: (U, W) words + (U,) lengths ->
+    ((out_words,) uint32, total_bits). Same shift/scatter-add trick one
+    level up; adjacent units share at most the boundary word, and the
+    bits are disjoint, so add == or."""
+    U, W = words.shape
+    offs = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(nbits)])
+    starts = offs[:-1]
+    total = offs[-1]
+    sh = (starts & 31)[:, None]  # right-shift amount (0..31)
+    hi = jnp.where(sh > 0, words >> jnp.clip(sh, 0, 31).astype(jnp.uint32), words)
+    lo = jnp.where(
+        sh > 0,
+        (words & ((jnp.uint32(1) << jnp.clip(sh, 1, 31).astype(jnp.uint32)) - 1))
+        << jnp.clip(32 - sh, 1, 31).astype(jnp.uint32),
+        jnp.uint32(0),
+    )
+    base = (starts >> 5)[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+    # mask out words beyond each unit's length (they are zero already,
+    # but their lo-spill would land out of range)
+    nw_used = ((nbits + (starts & 31)) + 31) >> 5  # words touched incl shift
+    in_range = jnp.arange(W, dtype=jnp.int32)[None, :] < nw_used[:, None]
+    hi = jnp.where(in_range, hi, jnp.uint32(0))
+    lo = jnp.where(in_range, lo, jnp.uint32(0))
+    out = jnp.zeros((out_words,), jnp.uint32)
+    b0 = jnp.clip(base, 0, out_words - 1)
+    b1 = jnp.clip(base + 1, 0, out_words - 1)
+    out = out.at[b0.reshape(-1)].add(hi.reshape(-1))
+    out = out.at[b1.reshape(-1)].add(lo.reshape(-1))
+    return out, total
+
+
+def _mv_pred_grid(mvs, skip_unused):
+    """Vectorized 8.4.1.3 prediction for every MB (mirrors
+    numpy_ref.mv_pred_16x16 including availability cases)."""
+    mbh, mbw = mvs.shape[:2]
+    zeros = jnp.zeros_like(mvs)
+    left = jnp.pad(mvs, ((0, 0), (1, 0), (0, 0)))[:, :-1]
+    top = jnp.pad(mvs, ((1, 0), (0, 0), (0, 0)))[:-1]
+    tr = jnp.pad(mvs, ((1, 0), (0, 1), (0, 0)))[:-1, 1:]
+    tl = jnp.pad(mvs, ((1, 0), (1, 0), (0, 0)))[:-1, :-1]
+    col = jnp.arange(mbw)[None, :, None]
+    row = jnp.arange(mbh)[:, None, None]
+    a_avail = col > 0
+    b_avail = row > 0
+    c_avail = (row > 0) & (col + 1 < mbw)
+    d_avail = (row > 0) & (col > 0)
+    c_sub = jnp.where(c_avail, tr, jnp.where(d_avail, tl, zeros))
+    c_eff_avail = c_avail | d_avail
+    a = jnp.where(a_avail, left, zeros)
+    b = jnp.where(b_avail, top, zeros)
+    med = a + b + c_sub - jnp.maximum(jnp.maximum(a, b), c_sub) - jnp.minimum(
+        jnp.minimum(a, b), c_sub
+    )
+    n_avail = (
+        a_avail.astype(jnp.int32) + b_avail.astype(jnp.int32) + c_eff_avail.astype(jnp.int32)
+    )
+    only = jnp.where(a_avail, a, jnp.where(b_avail, b, c_sub))
+    pred = jnp.where(n_avail == 1, only, med)
+    # 8.4.1.3.1: only A available (B, C, D all unavailable) -> mvA
+    pred = jnp.where(a_avail & ~b_avail & ~c_eff_avail, a, pred)
+    return pred
+
+
+def pack_p_slice_bits(out, word_cap: int = WORD_CAP_DEFAULT):
+    """P-frame encode outputs -> slice-data bitstream on device.
+
+    Returns (words (word_cap,) uint32 big-endian bit order, nbits int32,
+    trailing_skip int32). The stream covers everything between the slice
+    header and the final skip_run — the host splices it after its own
+    header bits and finishes the NAL.
+    """
+    mvs = out["mvs"]
+    skip = out["skip"]
+    mbh, mbw = skip.shape
+    M = mbh * mbw
+    luma = out["luma_ac"].reshape(mbh, mbw, 4, 4, 16).astype(jnp.int32)
+    chroma = out["chroma_ac"].reshape(mbh, mbw, 2, 2, 2, 16).astype(jnp.int32)
+    cdc = out["chroma_dc"].reshape(mbh, mbw, 2, 4).astype(jnp.int32)
+
+    zig = jnp.asarray(_ZIGZAG)
+    luma_scan = luma[..., zig]                     # (mbh,mbw,4,4,16) scan order
+    chroma_scan = chroma[..., zig]
+
+    # ---- frame-wide structure ------------------------------------------
+    coded = ~skip
+    # cbp per MB
+    l8 = luma_scan.reshape(mbh, mbw, 2, 2, 2, 2, 16)  # (.., y8, y4, x8... ) careful below
+    # 8x8 group b8 = (y4>>1)*2 + (x4>>1): regroup (4,4) block grid into 2x2 of 2x2
+    lg = luma_scan.reshape(mbh, mbw, 2, 2, 2, 2, 16).transpose(0, 1, 2, 4, 3, 5, 6)
+    # lg[.., y8, x8, y4in, x4in, :]
+    grp_nz = (lg != 0).any((-3, -2, -1))           # (mbh, mbw, 2, 2) -> b8 grid
+    cbp_luma = (
+        grp_nz[..., 0, 0].astype(jnp.int32)
+        | (grp_nz[..., 0, 1].astype(jnp.int32) << 1)
+        | (grp_nz[..., 1, 0].astype(jnp.int32) << 2)
+        | (grp_nz[..., 1, 1].astype(jnp.int32) << 3)
+    )
+    chroma_ac_nz = (chroma_scan[..., 1:] != 0).any((-4, -3, -2, -1))
+    chroma_dc_nz = (cdc != 0).any((-2, -1))
+    cbp_chroma = jnp.where(chroma_ac_nz, 2, jnp.where(chroma_dc_nz, 1, 0))
+    cbp = cbp_luma | (cbp_chroma << 4)
+
+    # TotalCoeff context grids: block coded iff MB coded & its group in cbp
+    luma_total = (luma_scan != 0).sum(-1).astype(jnp.int32)  # (mbh,mbw,4,4) [y4][x4]
+    b8_of = (jnp.arange(4)[:, None] // 2) * 2 + (jnp.arange(4)[None, :] // 2)  # [y4][x4]
+    luma_gate = (
+        coded[..., None, None]
+        & ((cbp_luma[..., None, None] >> b8_of[None, None]) & 1).astype(bool)
+    )
+    luma_tc_grid = jnp.where(luma_gate, luma_total, 0)  # (mbh,mbw,4,4)
+    # flat (mbh*4, mbw*4) [by][bx]
+    luma_tc_flat = luma_tc_grid.transpose(0, 2, 1, 3).reshape(mbh * 4, mbw * 4)
+    ch_total = (chroma_scan[..., 1:] != 0).sum(-1).astype(jnp.int32)  # (mbh,mbw,2,2,2) [c][y][x]
+    ch_gate = coded[..., None, None, None] & (cbp_chroma[..., None, None, None] == 2)
+    ch_tc_grid = jnp.where(ch_gate, ch_total, 0)
+    ch_tc_flat = ch_tc_grid.transpose(2, 0, 3, 1, 4).reshape(2, mbh * 2, mbw * 2)
+
+    def nc_from(grid, flat_by, flat_bx, has_l, has_t):
+        # availability comes from the CALLER (a chroma component's row 0
+        # must not read the other component's bottom row in the stacked
+        # grid)
+        left = jnp.pad(grid, ((0, 0), (1, 0)))[:, :-1]
+        top = jnp.pad(grid, ((1, 0), (0, 0)))[:-1]
+        both = (left[flat_by, flat_bx] + top[flat_by, flat_bx] + 1) >> 1
+        nc = jnp.where(
+            has_l & has_t, both,
+            jnp.where(has_l, left[flat_by, flat_bx],
+                      jnp.where(has_t, top[flat_by, flat_bx], 0)),
+        )
+        return nc
+
+    # ---- per-block encodings -------------------------------------------
+    # luma: MBs x 16 blocks in coding order
+    ox, oy = jnp.asarray(_LUMA_ORDER)[:, 0], jnp.asarray(_LUMA_ORDER)[:, 1]
+    mby = jnp.broadcast_to(jnp.arange(mbh)[:, None, None], (mbh, mbw, 16))
+    mbx = jnp.broadcast_to(jnp.arange(mbw)[None, :, None], (mbh, mbw, 16))
+    oyb = jnp.broadcast_to(oy[None, None, :], (mbh, mbw, 16))
+    oxb = jnp.broadcast_to(ox[None, None, :], (mbh, mbw, 16))
+    by = (mby * 4 + oyb).reshape(-1)
+    bx = (mbx * 4 + oxb).reshape(-1)
+    nc_luma = nc_from(luma_tc_flat, by, bx, bx > 0, by > 0)
+    luma_blocks = luma_scan[
+        mby.reshape(-1), mbx.reshape(-1), oyb.reshape(-1), oxb.reshape(-1)
+    ]  # (M*16, 16)
+    lv, lb, _ = _encode_blocks(luma_blocks, nc_luma, chroma_dc=False)
+    # gate: block emitted iff MB coded & its b8 set
+    b8_idx = (oy // 2) * 2 + (ox // 2)
+    luma_emit = (
+        coded[..., None] & ((cbp_luma[..., None] >> b8_idx[None, None]) & 1).astype(bool)
+    ).reshape(-1)
+    lb = jnp.where(luma_emit[:, None], lb, 0)
+
+    # chroma DC: MBs x 2 comps (4-coeff blocks, nc = -1)
+    cdc_blocks = cdc.reshape(-1, 4)
+    dv, db, _ = _encode_blocks(cdc_blocks, jnp.full((M * 2,), -1, jnp.int32), chroma_dc=True)
+    cdc_emit = jnp.broadcast_to(
+        (coded & (cbp_chroma >= 1))[..., None], (mbh, mbw, 2)
+    ).reshape(-1)
+    db = jnp.where(cdc_emit[:, None], db, 0)
+
+    # chroma AC: MBs x 2 comps x 4 blocks in coding order, 15 coeffs
+    cox, coy = jnp.asarray(_CHROMA_ORDER)[:, 0], jnp.asarray(_CHROMA_ORDER)[:, 1]
+    cmby = jnp.broadcast_to(jnp.arange(mbh)[:, None, None, None], (mbh, mbw, 2, 4))
+    cmbx = jnp.broadcast_to(jnp.arange(mbw)[None, :, None, None], (mbh, mbw, 2, 4))
+    comp_b = jnp.broadcast_to(jnp.arange(2)[None, None, :, None], (mbh, mbw, 2, 4))
+    coyb = jnp.broadcast_to(coy[None, None, None, :], (mbh, mbw, 2, 4))
+    coxb = jnp.broadcast_to(cox[None, None, None, :], (mbh, mbw, 2, 4))
+    cby_b = (cmby * 2 + coyb).reshape(-1)
+    cbx_b = (cmbx * 2 + coxb).reshape(-1)
+    comp_f = comp_b.reshape(-1)
+    nc_ch = nc_from(
+        ch_tc_flat.reshape(2 * mbh * 2, mbw * 2),
+        comp_f * (mbh * 2) + cby_b, cbx_b,
+        cbx_b > 0, cby_b > 0,
+    )
+    ch_blocks = chroma_scan[
+        cmby.reshape(-1), cmbx.reshape(-1), comp_f, coyb.reshape(-1), coxb.reshape(-1), 1:
+    ]  # (M*8, 15)
+    cv, cb, _ = _encode_blocks(ch_blocks, nc_ch, chroma_dc=False)
+    ch_emit = jnp.broadcast_to(
+        (coded & (cbp_chroma == 2))[..., None, None], (mbh, mbw, 2, 4)
+    ).reshape(-1)
+    cb = jnp.where(ch_emit[:, None], cb, 0)
+
+    # ---- MB headers -----------------------------------------------------
+    # skip_run before each coded MB: # of consecutive skips immediately
+    # before it (raster order)
+    skip_flat = skip.reshape(-1).astype(jnp.int32)
+    csum_skip = jnp.cumsum(skip_flat)
+    coded_flat = 1 - skip_flat
+    # skip_run before coded MB i = skips since the previous coded MB:
+    # csum_skip[i] - csum_skip[prev_coded(i)], with prev_coded found by a
+    # running max over coded positions
+    idxs = jnp.arange(M, dtype=jnp.int32)
+    coded_pos = jnp.where(coded_flat.astype(bool), idxs, -1)
+    prev_coded_pos = jax.lax.associative_scan(jnp.maximum, coded_pos)  # running max incl self
+    prev_excl = jnp.concatenate([jnp.full(1, -1, jnp.int32), prev_coded_pos[:-1]])
+    csum_at = jnp.concatenate([jnp.zeros(1, jnp.int32), csum_skip])  # csum_at[p+1]=csum incl p
+    skip_run = csum_skip - jnp.where(prev_excl >= 0, csum_at[prev_excl + 1], 0)
+    # (only meaningful at coded positions)
+
+    pred = _mv_pred_grid(mvs, skip).reshape(-1, 2)
+    mvd = 4 * (mvs.reshape(-1, 2) - pred)
+    sr_v, sr_b = _ue_bits(skip_run)
+    mt_v, mt_b = jnp.ones_like(skip_run), jnp.ones_like(skip_run)  # ue(0) = '1'
+    mx_v, mx_b = _se_bits(mvd[:, 0])
+    my_v, my_b = _se_bits(mvd[:, 1])
+    cbp_flat = cbp.reshape(-1)
+    cb_v, cb_b = _ue_bits(jnp.asarray(_CBP_CODENUM)[cbp_flat])
+    qd_v = jnp.ones_like(skip_run)
+    qd_b = jnp.where(cbp_flat > 0, 1, 0)  # se(0) = '1'
+    hdr_vals = jnp.stack([sr_v, mt_v, mx_v, my_v, cb_v, qd_v], -1)
+    hdr_bits = jnp.stack([sr_b, mt_b, mx_b, my_b, cb_b, qd_b], -1)
+    emit_mb = coded_flat.astype(bool)
+    hdr_bits = jnp.where(emit_mb[:, None], hdr_bits, 0)
+
+    # ---- assemble: MB unit = header + 16 luma + 2 cdc + 8 cac ----------
+    HW = 4      # header words (6 codewords <= 78 bits)
+    BW = 32     # per-block words (hard bound: 16+3+16*52+9+14*11 = 1014 bits)
+    hdr_w, hdr_n = _pack_pairs(hdr_vals, hdr_bits, HW)
+    luma_w, luma_n = _pack_pairs(lv, lb, BW)
+    cdc_w, cdc_n = _pack_pairs(dv, db, BW)
+    cac_w, cac_n = _pack_pairs(cv, cb, BW)
+
+    # stitch each MB's 27 segments in syntax order:
+    # header, luma blocks 0..15, cdc 0..1, cac 0..7
+    seg_words = jnp.concatenate(
+        [
+            jnp.pad(hdr_w.reshape(M, 1, HW), ((0, 0), (0, 0), (0, BW - HW))),
+            luma_w.reshape(M, 16, BW),
+            cdc_w.reshape(M, 2, BW),
+            cac_w.reshape(M, 8, BW),
+        ],
+        axis=1,
+    ).reshape(M * 27, BW)
+    seg_bits = jnp.concatenate(
+        [hdr_n.reshape(M, 1), luma_n.reshape(M, 16), cdc_n.reshape(M, 2),
+         cac_n.reshape(M, 8)],
+        axis=1,
+    ).reshape(M * 27)
+    words, nbits = _merge_streams(seg_words, seg_bits, word_cap)
+
+    # trailing skip run (after the last coded MB)
+    last_coded = prev_coded_pos[-1]
+    trailing = jnp.where(last_coded >= 0, csum_skip[-1] - csum_at[last_coded + 1], csum_skip[-1])
+    return words, nbits, trailing
+
+
+# ---------------------------------------------------------------------------
+# Host half: splice header + device bits + trailing, NAL-wrap
+# ---------------------------------------------------------------------------
+
+
+def _or_bits(out: np.ndarray, src: np.ndarray, bit_off: int, nbits: int) -> None:
+    """OR `nbits` MSB-first bits of src into out at bit offset bit_off."""
+    if nbits <= 0:
+        return
+    nbytes = (nbits + 7) // 8
+    src = src[:nbytes]
+    sh = bit_off & 7
+    b0 = bit_off >> 3
+    # src may be zero-padded past nbits (whole device words): clamp every
+    # write to the output (the spilled-over bytes are zeros anyway)
+    n1 = min(len(src), len(out) - b0)
+    if sh == 0:
+        out[b0 : b0 + n1] |= src[:n1]
+        return
+    out[b0 : b0 + n1] |= (src >> sh)[:n1]
+    spill = ((src.astype(np.uint16) << (8 - sh)) & 0xFF).astype(np.uint8)
+    n2 = min(len(spill), len(out) - b0 - 1)
+    out[b0 + 1 : b0 + 1 + n2] |= spill[:n2]
+
+
+def assemble_p_nal(words: np.ndarray, nbits: int, trailing_skip: int,
+                   p, frame_num: int, qp: int) -> bytes:
+    """Finish a P slice from device bits: header + stream + trailing
+    skip_run + rbsp stop, emulation-prevented and Annex-B wrapped.
+    Byte-identical to cavlc.pack_slice_p for the same inputs."""
+    from selkies_tpu.models.h264.bitstream import SLICE_P, NAL_SLICE_NON_IDR, write_slice_header
+    from selkies_tpu.utils.bits import BitWriter, annexb_nal
+
+    w = BitWriter()
+    write_slice_header(w, p, SLICE_P, frame_num, idr=False, slice_qp=qp)
+    hdr_bytes, hdr_bits = w.get_partial()
+
+    dev_bytes = np.ascontiguousarray(words[: (nbits + 31) // 32]).astype(">u4").view(np.uint8)
+
+    tail = BitWriter()
+    if trailing_skip:
+        tail.write_ue(int(trailing_skip))
+    tail.write_bit(1)  # rbsp_stop_one_bit; byte-align zeros come from sizing
+    tail_bytes, tail_bits = tail.get_partial()
+
+    total_bits = hdr_bits + int(nbits) + tail_bits
+    out = np.zeros((total_bits + 7) // 8, np.uint8)
+    _or_bits(out, np.frombuffer(hdr_bytes, np.uint8), 0, hdr_bits)
+    _or_bits(out, dev_bytes, hdr_bits, int(nbits))
+    _or_bits(out, np.frombuffer(tail_bytes, np.uint8), hdr_bits + int(nbits), tail_bits)
+    return annexb_nal(3, NAL_SLICE_NON_IDR, out.tobytes())

@@ -41,6 +41,11 @@ from selkies_tpu.models.h264.compact import (
     unpack_p_compact,
     unpack_p_sparse,
 )
+from selkies_tpu.models.h264.device_cavlc import (
+    WORD_CAP_DEFAULT as BITS_WORD_CAP,
+    assemble_p_nal,
+    pack_p_slice_bits,
+)
 from selkies_tpu.models.h264.encoder_core import (
     encode_frame_p_planes,
     encode_frame_planes,
@@ -82,6 +87,12 @@ CAP_ROWS = 4096
 # larger prefix.
 CAP_ROWS_DELTA = 4096
 NSCAP = 4096
+# Device-entropy downlink (full P frames): the slice-data BITSTREAM is
+# produced on device (device_cavlc.py) and fetched instead of multi-MB
+# coefficient tensors. The prefix fetch carries [nbits, trailing, nskip]
+# + the first BITS_PREFIX_WORDS words; bigger frames spill one extra
+# fetch; frames overflowing the word cap fall back to the dense path.
+BITS_PREFIX_WORDS = 1 << 16  # 256 KB: covers typical full-P slices in ONE fetch
 
 
 def _device_step(frame, qp, *, pad_h: int, pad_w: int, channels: int):
@@ -111,6 +122,19 @@ def _p_planes_step(y, u, v, qp, ref_y, ref_u, ref_v):
     header, buf = pack_p_compact(out)
     prefix = fuse_downlink(header, buf, CAP_ROWS)
     return prefix, buf, out["recon_y"], out["recon_u"], out["recon_v"]
+
+
+def _p_bits_step(y, u, v, qp, ref_y, ref_u, ref_v):
+    """Full-P with ON-DEVICE entropy coding: what crosses the link is the
+    slice bitstream itself. Dense header/buf ride along device-side only,
+    as the overflow fallback (fetched on the rare nbits > cap frame)."""
+    out = encode_frame_p_planes(y, u, v, ref_y, ref_u, ref_v, qp)
+    words, nbits, trailing = pack_p_slice_bits(out, BITS_WORD_CAP)
+    nskip = out["skip"].sum().astype(jnp.int32)
+    meta = jnp.stack([nbits, trailing, nskip]).astype(jnp.uint32)
+    prefix = jnp.concatenate([meta, words[:BITS_PREFIX_WORDS]])
+    header, buf = pack_p_compact(out)
+    return prefix, words, header, buf, out["recon_y"], out["recon_u"], out["recon_v"]
 
 
 # Delta steps: only the dirty bands cross the link; the full frame is
@@ -195,9 +219,10 @@ def _i_resident_step(qp, sy, su, sv):
 
 
 def _fetch_rest(buf, n: int, base: int = CAP_ROWS) -> np.ndarray:
-    """Overflow path: rows [base, n) in power-of-two buckets."""
+    """Overflow path: rows [base, >=n) in power-of-two buckets (base=0
+    fetches from the start, bucketed from 4096)."""
     total = buf.shape[0]
-    bucket = base
+    bucket = max(base, 4096)
     while bucket < n:
         bucket <<= 1
     if bucket >= total:
@@ -212,7 +237,7 @@ FrameStats = _FrameStats  # shared definition (models/stats.py)
 class _Pending:
     """One in-flight frame in the encode pipeline."""
 
-    kind: str  # "static" | "i" | "p" | "pd" (sparse-header delta P)
+    kind: str  # "static" | "i" | "p" | "pd" (sparse delta P) | "pb" (device-entropy P)
     frame_index: int
     qp: int
     frame_num: int
@@ -223,7 +248,8 @@ class _Pending:
     au: bytes | None = None  # static only
     prefix_d: object = None
     buf_d: object = None
-    hdr_d: object = None  # pd only: dense header for the ns>NSCAP fallback
+    hdr_d: object = None  # pd/pb: dense header for the fallback fetch
+    words_d: object = None  # pb only: full bit-word buffer (spill fetch)
     future: object = None  # completion future (threaded fetch+unpack+pack)
     batch_slot: int = -1  # >=0: index into a shared batch future's result list
     scene_cut: bool = False  # full-frame change transition (rate control)
@@ -256,6 +282,7 @@ class TPUH264Encoder:
         pipeline_depth: int = 2,
         frame_batch: int = 4,
         scene_qp_boost: int = 0,
+        device_entropy: bool = True,
     ):
         self.width = width
         self.height = height
@@ -286,6 +313,7 @@ class TPUH264Encoder:
         if self._prep is not None:
             self._step = jax.jit(_i_planes_step)
             self._step_p = jax.jit(_p_planes_step, donate_argnums=(4, 5, 6))
+            self._step_pb = jax.jit(_p_bits_step, donate_argnums=(4, 5, 6))
             # delta-upload steps: source planes are donated (scatter is
             # in-place) and returned updated; refs donated as usual
             # nscap/cap ride in a partial (not read from module globals
@@ -326,6 +354,11 @@ class TPUH264Encoder:
         # frames after it re-sharpen within a few hundred ms. 0 = off
         # (keeps delta-vs-full bit-exactness tests meaningful).
         self.scene_qp_boost = int(scene_qp_boost)
+        # device_entropy: full-P frames emit their slice BITSTREAM on
+        # device (device_cavlc.py) — the downlink is the final bits, not
+        # coefficient tensors. Requires host conversion mode (the only
+        # production path); byte-identical either way.
+        self.device_entropy = bool(device_entropy)
         self._prev_kind = "full"  # first frame is not a "scene cut"
         self.frame_batch = max(1, int(frame_batch))
         # scan executables compile for these group sizes only (greedy
@@ -440,10 +473,18 @@ class TPUH264Encoder:
     def _run_step_p(self, frame: np.ndarray):
         if self._prep is not None:
             y, u, v = self._put(self._prep.convert(frame))
+            if self.device_entropy:
+                prefix_d, words_d, hdr_d, buf_d, ry, ru, rv = self._step_pb(
+                    y, u, v, np.int32(self.qp), *self._ref
+                )
+                self._src = (y, u, v)
+                return ("pb", prefix_d, words_d, hdr_d, buf_d, ry, ru, rv)
             out = self._step_p(y, u, v, np.int32(self.qp), *self._ref)
             self._src = (y, u, v)
-            return out
-        return self._step_p(jax.device_put(frame), np.int32(self.qp), *self._ref)
+            # (kind, prefix, words, hdr, buf, recon_y, recon_u, recon_v)
+            return ("p", out[0], None, None, out[1], out[2], out[3], out[4])
+        out = self._step_p(jax.device_put(frame), np.int32(self.qp), *self._ref)
+        return ("p", out[0], None, None, out[1], out[2], out[3], out[4])
 
     @staticmethod
     def _pack_bands(yb, ub, vb, idx, bucket: int) -> np.ndarray:
@@ -653,17 +694,20 @@ class TPUH264Encoder:
                         prefix_d, hdr_d, buf_d, ry, ru, rv = self._run_step_delta(
                             frame, dirty_idx, idr=False
                         )
+                        pk, words_d = "pd", None
                     else:
-                        prefix_d, buf_d, ry, ru, rv = self._run_step_p(frame)
+                        (pk, prefix_d, words_d, hdr_d, buf_d, ry, ru, rv) = (
+                            self._run_step_p(frame)
+                        )
                     # reassign IMMEDIATELY: _step_p donated the old buffers
                     self._ref = (ry, ru, rv)
                     rec = _Pending(
-                        kind="pd" if kind == "delta" else "p",
+                        kind=pk,
                         frame_index=self.frame_index, qp=self.qp,
                         frame_num=self._frames_since_idr % 256, idr_pic_id=0,
                         t0=t0, t1=0.0, meta=meta,
                         prefix_d=prefix_d, buf_d=buf_d, hdr_d=hdr_d,
-                        scene_cut=scene_cut,
+                        words_d=words_d, scene_cut=scene_cut,
                     )
                 # start the downlink fetch + entropy pack on a worker NOW:
                 # fetch ops overlap across threads on the relay
@@ -757,7 +801,9 @@ class TPUH264Encoder:
         return au, stats, rec.meta
 
     def _complete_work(self, rec: "_Pending"):
-        """Worker-thread half: single-fetch downlink + unpack + CAVLC."""
+        """Worker-thread half: single-fetch downlink + unpack/assemble."""
+        if rec.kind == "pb":
+            return self._complete_bits(rec)
         hdr_words = {
             "i": self._hdr_words_i, "p": self._hdr_words_p, "pd": self._hdr_words_pd,
         }[rec.kind]
@@ -789,6 +835,29 @@ class TPUH264Encoder:
             au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num)
         return au, skipped, t1, time.perf_counter()
 
+    def _complete_bits(self, rec: "_Pending"):
+        """Device-entropy P frame: fetch [meta ++ bit words], splice the
+        slice header, done — no coefficient unpack, no host CAVLC."""
+        arr = np.asarray(rec.prefix_d)  # uint32: nbits, trailing, nskip, words...
+        nbits, trailing, skipped = int(arr[0]), int(arr[1]), int(arr[2])
+        if nbits > BITS_WORD_CAP * 32:
+            # pathological frame overflowed the bit buffer: dense fallback
+            header = np.asarray(rec.hdr_d)
+            data = _fetch_rest(rec.buf_d, int(header[0]), 0)
+            t1 = time.perf_counter()
+            pfc = unpack_p_compact(header, data, rec.qp)
+            au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num)
+            return au, int(pfc.skip.sum()), t1, time.perf_counter()
+        need = (nbits + 31) // 32
+        words = arr[3 : 3 + min(need, BITS_PREFIX_WORDS)]
+        if need > BITS_PREFIX_WORDS:  # spill: one extra fetch
+            words = np.concatenate(
+                [words, _fetch_rest(rec.words_d, need, BITS_PREFIX_WORDS)]
+            )
+        t1 = time.perf_counter()
+        au = assemble_p_nal(words, nbits, trailing, self.params, rec.frame_num, rec.qp)
+        return au, skipped, t1, time.perf_counter()
+
     def encode_frame(self, frame: np.ndarray, qp: int | None = None) -> bytes:
         """Synchronous encode ((H, W, 4) BGRx or (H, W, 3) RGB uint8 in,
         complete Annex-B access unit out; SPS/PPS prepended on IDR).
@@ -801,6 +870,30 @@ class TPUH264Encoder:
         outs = self.submit(frame, qp)
         outs.extend(self.flush())
         return outs[-1][0]
+
+    def prewarm(self) -> None:
+        """Compile the hot executables (IDR full, P full) before the live
+        loop starts. The device-entropy P program in particular is a
+        large XLA build (~tens of seconds cold); paying it at session
+        start instead of on the first real frame keeps the stream from
+        stalling. Leaves the encoder in a fresh-GOP state."""
+        rng = np.random.default_rng(0)
+        shape = (self.height, self.width, self.channels)
+        f0 = rng.integers(0, 255, shape, np.uint8)
+        f1 = rng.integers(0, 255, shape, np.uint8)
+        self.encode_frame(f0)  # IDR full
+        self.encode_frame(f1)  # P full (device-entropy path)
+        # reset stream state: the next real frame starts a clean GOP
+        self._force_idr = True
+        self._ref = None
+        self._src = None
+        if self._prep is not None:
+            self._prep.reset()
+        self._prev_frame = None
+        self.frame_index = 0
+        self._frames_since_idr = 0
+        self._idr_pic_id = 0
+        self._prev_kind = "full"
 
     def close(self) -> None:
         """Discard in-flight frames and stop the completion workers."""

@@ -89,6 +89,11 @@ class TPUWebRTCApp:
             "starting pipeline: %s %dx%d@%d, %d kbps",
             self.encoder_name, self.source.width, self.source.height, self.framerate, self.video_bitrate_kbps,
         )
+        if hasattr(self.encoder, "prewarm"):
+            # compile the IDR + full-P executables before the first real
+            # frame (the device-entropy program is a large cold build)
+            logger.info("prewarming encoder executables")
+            await asyncio.to_thread(self.encoder.prewarm)
         self.pipeline = VideoPipeline(
             source=self.source,
             encoder=self.encoder,

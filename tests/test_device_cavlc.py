@@ -1,0 +1,154 @@
+"""Device CAVLC vs host packer: the slice NAL must be byte-identical."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from selkies_tpu.models.h264.bitstream import StreamParams
+from selkies_tpu.models.h264.cavlc import pack_slice_p
+from selkies_tpu.models.h264.device_cavlc import assemble_p_nal, pack_p_slice_bits
+from selkies_tpu.models.h264.numpy_ref import PFrameCoeffs
+
+
+def _roundtrip(fc: PFrameCoeffs, w: int, h: int):
+    p = StreamParams(width=w, height=h, qp=fc.qp)
+    ref = pack_slice_p(fc, p, frame_num=1)
+    out = {
+        "mvs": jnp.asarray(fc.mvs),
+        "skip": jnp.asarray(fc.skip),
+        "luma_ac": jnp.asarray(fc.luma_ac),
+        "chroma_dc": jnp.asarray(fc.chroma_dc),
+        "chroma_ac": jnp.asarray(fc.chroma_ac),
+    }
+    words, nbits, trailing = jax.jit(pack_p_slice_bits)(out)
+    nal = assemble_p_nal(np.asarray(words), int(nbits), int(trailing), p, 1, fc.qp)
+    assert nal == ref, (
+        f"device CAVLC diverged: {len(nal)} vs {len(ref)} bytes, "
+        f"first diff at {next((i for i in range(min(len(nal), len(ref))) if nal[i] != ref[i]), -1)}"
+    )
+
+
+def _random_fc(mbh, mbw, qp, seed, skip_p=0.6, mag=8, mv_range=8):
+    rng = np.random.default_rng(seed)
+    skip = rng.random((mbh, mbw)) < skip_p
+    mvs = rng.integers(-mv_range, mv_range + 1, (mbh, mbw, 2)).astype(np.int32)
+    # coefficients: sparse, mixed magnitudes (incl. |1| runs for t1 paths)
+    def coeffs(shape):
+        c = rng.integers(-mag, mag + 1, shape).astype(np.int32)
+        mask = rng.random(shape) < 0.8
+        c[mask] = 0
+        return c
+
+    luma = coeffs((mbh, mbw, 4, 4, 4, 4))
+    cac = coeffs((mbh, mbw, 2, 2, 2, 4, 4))
+    cac[..., 0, 0] = 0  # AC blocks: DC position unused
+    cdc = coeffs((mbh, mbw, 2, 2, 2))
+    # skip MBs carry no residual (encoder invariant)
+    luma[skip] = 0
+    cac[skip] = 0
+    cdc[skip] = 0
+    return PFrameCoeffs(mvs=mvs, skip=skip, luma_ac=luma, chroma_dc=cdc,
+                        chroma_ac=cac, qp=qp)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_random_sparse(seed):
+    _roundtrip(_random_fc(4, 6, 26, seed), 96, 64)
+
+
+def test_dense_no_skip():
+    fc = _random_fc(3, 5, 30, 7, skip_p=0.0, mag=3)
+    _roundtrip(fc, 80, 48)
+
+
+def test_all_skip():
+    fc = _random_fc(3, 4, 28, 9, skip_p=1.1)
+    _roundtrip(fc, 64, 48)
+
+
+def test_leading_and_trailing_skip_runs():
+    fc = _random_fc(2, 8, 24, 11, skip_p=0.5)
+    fc.skip[0, :5] = True   # leading run
+    fc.skip[-1, -4:] = True  # trailing run
+    for arr in (fc.luma_ac, fc.chroma_ac, fc.chroma_dc):
+        arr[fc.skip] = 0
+    _roundtrip(fc, 128, 32)
+
+
+def test_big_levels_escape_paths(monkeypatch):
+    """Large coefficients exercise level escape + extended prefixes."""
+    fc = _random_fc(2, 3, 4, 13, skip_p=0.2, mag=900)
+    _roundtrip(fc, 48, 32)
+
+
+def test_nonzero_mvs_prediction():
+    fc = _random_fc(4, 4, 26, 17, skip_p=0.3, mv_range=30)
+    _roundtrip(fc, 64, 64)
+
+
+def test_chroma_dc_only_cbp():
+    """cbp_chroma == 1: chroma DC coded, no chroma AC."""
+    fc = _random_fc(2, 2, 26, 19, skip_p=0.0, mag=4)
+    fc.chroma_ac[:] = 0
+    _roundtrip(fc, 32, 32)
+
+
+def test_matches_real_encoder_output():
+    """Full pipeline: real P-frame coefficients from the device encoder."""
+    from selkies_tpu.models.h264.encoder_core import encode_frame_p_planes
+
+    rng = np.random.default_rng(23)
+    h, w = 64, 96
+    y0 = rng.integers(0, 255, (h, w)).astype(np.uint8)
+    u0 = rng.integers(0, 255, (h // 2, w // 2)).astype(np.uint8)
+    v0 = rng.integers(0, 255, (h // 2, w // 2)).astype(np.uint8)
+    y1 = np.roll(y0, 3, axis=1)
+    u1 = np.roll(u0, 1, axis=1)
+    v1 = np.roll(v0, 1, axis=1)
+    out = jax.jit(encode_frame_p_planes)(
+        jnp.asarray(y1), jnp.asarray(u1), jnp.asarray(v1),
+        jnp.asarray(y0), jnp.asarray(u0), jnp.asarray(v0), jnp.int32(26),
+    )
+    fc = PFrameCoeffs(
+        mvs=np.asarray(out["mvs"]), skip=np.asarray(out["skip"]),
+        luma_ac=np.asarray(out["luma_ac"]), chroma_dc=np.asarray(out["chroma_dc"]),
+        chroma_ac=np.asarray(out["chroma_ac"]), qp=26,
+    )
+    _roundtrip(fc, w, h)
+
+
+def test_encoder_spill_and_overflow_fallbacks(monkeypatch, tmp_path):
+    """_complete_bits' spill fetch and dense-overflow fallback both
+    produce the exact stream (tiny caps force the rare branches)."""
+    import cv2
+
+    from selkies_tpu.models.h264 import encoder as enc_mod
+
+    rng = np.random.default_rng(41)
+    w, h = 96, 64
+    frames = [np.ascontiguousarray(rng.integers(0, 255, (h, w, 4), np.uint8))
+              for _ in range(3)]
+    ref_enc = enc_mod.TPUH264Encoder(w, h, qp=22, frame_batch=1, device_entropy=False)
+    ref = b"".join(ref_enc.encode_frame(f) for f in frames)
+
+    # spill: prefix carries only 8 words -> every P frame spill-fetches
+    monkeypatch.setattr(enc_mod, "BITS_PREFIX_WORDS", 8)
+    e1 = enc_mod.TPUH264Encoder(w, h, qp=22, frame_batch=1, device_entropy=True)
+    s1 = b"".join(e1.encode_frame(f) for f in frames)
+    assert s1 == ref, "spill-fetch path diverged"
+
+    # overflow: word cap smaller than the slice -> dense fallback
+    monkeypatch.setattr(enc_mod, "BITS_WORD_CAP", 64)
+    e2 = enc_mod.TPUH264Encoder(w, h, qp=22, frame_batch=1, device_entropy=True)
+    s2 = b"".join(e2.encode_frame(f) for f in frames)
+    assert s2 == ref, "overflow dense fallback diverged"
+
+    p = tmp_path / "fb.h264"
+    p.write_bytes(s2)
+    cap = cv2.VideoCapture(str(p))
+    n = 0
+    while cap.read()[0]:
+        n += 1
+    assert n == 3
