@@ -86,27 +86,55 @@ def unpack_p_compact(header: np.ndarray, data: np.ndarray, qp: int) -> PFrameCoe
     )
 
 
-def p_sparse_header_words(mbh: int, mbw: int, nscap: int) -> int:
+def p_sparse_var_words(mbh: int, mbw: int, nscap: int, cap_rows: int) -> int:
+    """Total int16 length of the variable-packed sparse buffer."""
+    sw = (mbh * mbw + 31) // 32
+    return 8 + 2 * sw + 4 * nscap + 16 * cap_rows
+
+
+def p_sparse_var_need(fused16: np.ndarray, mbh: int, mbw: int, nscap: int,
+                      cap_rows: int):
+    """(needed int16 length, n, ns) from a slice that covers the meta.
+
+    `needed` counts only what the fused buffer HOLDS (rows cap at
+    cap_rows — beyond that the caller spill-fetches from the full row
+    buffer). ns > nscap means dense fallback (rows then sit at the
+    full-pairs offset)."""
+    meta = np.ascontiguousarray(fused16[:8]).view(np.int32)
+    n, ns = int(meta[0]), int(meta[3])
+    sw = (mbh * mbw + 31) // 32
+    return 8 + 2 * sw + 4 * min(ns, nscap) + 16 * min(n, cap_rows), n, ns
+
+
+def unpack_p_sparse_var(
+    fused16: np.ndarray, qp: int, mbh: int, mbw: int, nscap: int,
+    cap_rows: int, extra_rows: np.ndarray | None = None,
+):
+    """Variable-packed sparse buffer (encoder_core.pack_p_sparse_var) ->
+    (PFrameCoeffs | None, rows): None means ns > nscap and the caller
+    must fall back to the dense header; `rows` (n, 16) int16 is returned
+    either way so the fallback reuses the already-fetched coefficients.
+    extra_rows supplies rows [cap_rows, n) when the frame spilled."""
     m = mbh * mbw
-    return 4 + (m + 31) // 32 + 2 * nscap
-
-
-def unpack_p_sparse(header: np.ndarray, data: np.ndarray, qp: int, nscap: int) -> PFrameCoeffs:
-    """Sparse header (encoder_core.pack_p_sparse) -> dense PFrameCoeffs.
-
-    Returns None when ns > nscap: the caller must fall back to fetching
-    the dense header (the device emits it alongside)."""
-    n, mbh, mbw, ns = (int(x) for x in header[:4])
-    m = mbh * mbw
-    if ns > nscap:
-        return None
-    if data.shape[0] < n:
-        raise ValueError(f"data has {data.shape[0]} rows, header says {n}")
     sw = (m + 31) // 32
-    skip_words = header[4 : 4 + sw].astype(np.int64) & 0xFFFFFFFF
+    need, n, ns = p_sparse_var_need(fused16, mbh, mbw, nscap, cap_rows)
+    if len(fused16) < need:
+        raise ValueError(f"slice has {len(fused16)} int16, need {need}")
+    base = 8 + 2 * sw
+    rows_off = base + 4 * min(ns, nscap)
+    held = min(n, cap_rows)
+    rows = fused16[rows_off : rows_off + 16 * held].reshape(held, 16)
+    if n > held:
+        rows = np.concatenate([rows, extra_rows[: n - held]])
+    if ns > nscap:
+        return None, rows
+    skip_words = (
+        np.ascontiguousarray(fused16[8 : 8 + 2 * sw]).view(np.int32).astype(np.int64)
+        & 0xFFFFFFFF
+    )
     skip_bits = ((skip_words[:, None] >> np.arange(32)) & 1).astype(bool).reshape(-1)[:m]
-    mv_c = header[4 + sw : 4 + sw + nscap][:ns].astype(np.int32)
-    info_c = header[4 + sw + nscap : 4 + sw + 2 * nscap][:ns].astype(np.int32)
+    pairs = np.ascontiguousarray(fused16[base : base + 4 * ns]).view(np.int32)
+    mv_c, info_c = pairs[0::2], pairs[1::2]
     pos = np.flatnonzero(~skip_bits)
     if len(pos) != ns:
         raise ValueError(f"skip bitmap has {len(pos)} non-skip MBs, header says {ns}")
@@ -117,20 +145,20 @@ def unpack_p_sparse(header: np.ndarray, data: np.ndarray, qp: int, nscap: int) -
     mvx = (mv_words << 16) >> 16
     mvy = mv_words >> 16
     flags = _flags_from_bitmap(mbinfo, P_ENTRIES)
-    rows = _scatter_rows(flags, data)
+    dense_rows = _scatter_rows(flags, rows)
     skip = skip_bits.reshape(mbh, mbw)
     mvs = np.ascontiguousarray(np.stack([mvx, mvy], -1).reshape(mbh, mbw, 2))
-    # skip MBs carry DERIVED (possibly nonzero) MVs that neighbor MV
-    # prediction depends on; the sparse downlink omits them, so re-derive
-    # exactly as a decoder would (8.4.1.1)
     derive_skip_mvs_fast(mvs, skip)
-    return PFrameCoeffs(
-        mvs=mvs,
-        skip=skip,
-        luma_ac=rows[:, :P_ROW_CHROMA].reshape(mbh, mbw, 4, 4, 4, 4).astype(np.int32),
-        chroma_dc=rows[:, P_ROW_DC:P_ENTRIES, :4].reshape(mbh, mbw, 2, 2, 2).astype(np.int32),
-        chroma_ac=rows[:, P_ROW_CHROMA:P_ROW_DC].reshape(mbh, mbw, 2, 2, 2, 4, 4).astype(np.int32),
-        qp=qp,
+    return (
+        PFrameCoeffs(
+            mvs=mvs,
+            skip=skip,
+            luma_ac=dense_rows[:, :P_ROW_CHROMA].reshape(mbh, mbw, 4, 4, 4, 4).astype(np.int32),
+            chroma_dc=dense_rows[:, P_ROW_DC:P_ENTRIES, :4].reshape(mbh, mbw, 2, 2, 2).astype(np.int32),
+            chroma_ac=dense_rows[:, P_ROW_CHROMA:P_ROW_DC].reshape(mbh, mbw, 2, 2, 2, 4, 4).astype(np.int32),
+            qp=qp,
+        ),
+        rows,
     )
 
 

@@ -164,14 +164,17 @@ def _encode_blocks(coeffs, nc, chroma_dc: bool):
     B, L = coeffs.shape
     nz = coeffs != 0
     total = nz.sum(-1).astype(jnp.int32)
-    # reverse-scan-order nonzero positions: sort key puts nonzeros first,
-    # highest position first
-    key = jnp.where(nz, L - 1 - jnp.arange(L, dtype=jnp.int32)[None, :], jnp.int32(1000))
-    order = jnp.argsort(key, axis=-1)  # (B, L): reverse-scan nz positions first
-    pos_rev = jnp.take_along_axis(
-        jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[None, :], (B, L)), order, -1
-    )
-    val_rev = jnp.take_along_axis(coeffs, order, -1)
+    # reverse-scan-order nonzero compaction WITHOUT argsort (sorts are
+    # ~30 ms at frame scale on TPU; this one-hot contraction is ~free):
+    # walking the reversed block, the k-th nonzero seen is slot k
+    rev = coeffs[:, ::-1]
+    nzr = rev != 0
+    rank = jnp.cumsum(nzr, -1, dtype=jnp.int32) - 1
+    oh = ((rank[:, :, None] == jnp.arange(L, dtype=jnp.int32)[None, None, :])
+          & nzr[:, :, None]).astype(jnp.int32)
+    val_rev = jnp.einsum("blk,bl->bk", oh, rev)
+    pos_of = jnp.broadcast_to((L - 1 - jnp.arange(L, dtype=jnp.int32))[None, :], (B, L))
+    pos_rev = jnp.einsum("blk,bl->bk", oh, pos_of)
     idx = jnp.arange(L, dtype=jnp.int32)[None, :]
     valid = idx < total[:, None]
 
@@ -203,10 +206,13 @@ def _encode_blocks(coeffs, nc, chroma_dc: bool):
         vals = vals.at[:, 1 + k].set(jnp.where(use, sign, 0))
         bits = bits.at[:, 1 + k].set(jnp.where(use, 1, 0))
 
-    # levels after the trailing ones: sequential suffix_len adaptation
-    def level_step(carry, k):
+    # levels after the trailing ones: sequential suffix_len adaptation.
+    # xs are pre-sliced (transposed) so each step is a native scan slice —
+    # a take_along_axis gather inside the body costs ~1 ms/step at frame
+    # scale.
+    def level_step(carry, xs):
         suffix_len, first_done = carry
-        level = jnp.take_along_axis(val_rev, k[:, None], -1)[:, 0]
+        level, k = xs
         use = (k >= t1) & (k < total)
         level_code = jnp.where(level > 0, 2 * level - 2, -2 * level - 1)
         is_first = use & ~first_done
@@ -226,9 +232,9 @@ def _encode_blocks(coeffs, nc, chroma_dc: bool):
         )
 
     init_sl = jnp.where((total > 10) & (t1 < 3), 1, 0)
-    ks = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32)[:, None], (L, B))
+    ks = jnp.arange(L, dtype=jnp.int32)
     (_, _), (lv1, lb1, lv2, lb2) = jax.lax.scan(
-        level_step, (init_sl, jnp.zeros((B,), bool)), ks
+        level_step, (init_sl, jnp.zeros((B,), bool)), (val_rev.T, ks)
     )
     vals = vals.at[:, 4 : 4 + 2 * L : 2].set(lv1.T)
     bits = bits.at[:, 4 : 4 + 2 * L : 2].set(lb1.T)
@@ -249,10 +255,9 @@ def _encode_blocks(coeffs, nc, chroma_dc: bool):
     bits = bits.at[:, 4 + 2 * L].set(jnp.where(use_tz, tz_bits, 0))
 
     # run_before chain (reverse order), zeros_left decreasing
-    def run_step(carry, k):
+    def run_step(carry, xs):
         zeros_left = carry
-        p_k = jnp.take_along_axis(pos_rev, k[:, None], -1)[:, 0]
-        p_k1 = jnp.take_along_axis(pos_rev, (k + 1)[:, None], -1)[:, 0]
+        p_k, p_k1, k = xs
         run = p_k - p_k1 - 1
         use = (k < total - 1) & (zeros_left > 0)
         zl_c = jnp.clip(zeros_left, 0, 14)
@@ -262,8 +267,10 @@ def _encode_blocks(coeffs, nc, chroma_dc: bool):
         zeros_left = jnp.where(use, zeros_left - run, zeros_left)
         return zeros_left, (jnp.where(use, v, 0), jnp.where(use, b, 0))
 
-    ks2 = jnp.broadcast_to(jnp.arange(L - 1, dtype=jnp.int32)[:, None], (L - 1, B))
-    _, (rv, rb) = jax.lax.scan(run_step, tz, ks2)
+    pos_t = pos_rev.T
+    _, (rv, rb) = jax.lax.scan(
+        run_step, tz, (pos_t[:-1], pos_t[1:], jnp.arange(L - 1, dtype=jnp.int32))
+    )
     vals = vals.at[:, 5 + 2 * L :].set(rv.T)
     bits = bits.at[:, 5 + 2 * L :].set(rb.T)
     return vals, bits, total
@@ -289,13 +296,18 @@ def _pack_pairs(vals, bits, nwords: int):
     """Pack (U, S) (value, nbits) emission slots into per-unit bit
     buffers: returns (words (U, nwords) uint32, nbits_total (U,)).
     MSB-first within the stream; word 0 holds the first 32 bits.
-    32-bit ops only (jax default has no uint64)."""
+    32-bit ops only (jax default has no uint64).
+
+    Formulation: a dense one-hot contraction over the output words.
+    Slot word-targets are data-dependent, which invites a scatter-add —
+    but TPU scatter runs ~20 ns/update (145 ms/frame at CAVLC scale)
+    while this where-sum fuses into ~4 ms. Bits are disjoint by
+    construction, so integer add == bitwise or."""
     U, S = vals.shape
     offs = jnp.concatenate(
         [jnp.zeros((U, 1), jnp.int32), jnp.cumsum(bits, -1)], -1
     )  # (U, S+1)
     total_bits = offs[:, -1]
-    words = jnp.zeros((U, nwords), jnp.uint32)
     vmask = jnp.where(bits >= 32, jnp.uint32(0xFFFFFFFF),
                       (jnp.uint32(1) << jnp.clip(bits, 0, 31)) - 1)
     v = vals.astype(jnp.uint32) & vmask
@@ -303,21 +315,38 @@ def _pack_pairs(vals, bits, nwords: int):
     w0 = start >> 5
     hi, lo = _split2(v, start & 31, bits)
     use = bits > 0
-    w0c = jnp.clip(w0, 0, nwords - 1)
-    w1c = jnp.clip(w0 + 1, 0, nwords - 1)
     hi = jnp.where(use, hi, jnp.uint32(0))
-    lo = jnp.where(use & (w0 + 1 < nwords), lo, jnp.uint32(0))
-    rows = jnp.broadcast_to(jnp.arange(U, dtype=jnp.int32)[:, None], w0.shape)
-    words = words.at[rows, w0c].add(hi)
-    words = words.at[rows, w1c].add(lo)
+    lo = jnp.where(use, lo, jnp.uint32(0))
+    wids = jnp.arange(nwords, dtype=jnp.int32)
+    oh_hi = w0[:, :, None] == wids[None, None, :]
+    oh_lo = (w0[:, :, None] + 1) == wids[None, None, :]
+    words = (
+        jnp.where(oh_hi, hi[:, :, None], jnp.uint32(0)).sum(1, dtype=jnp.uint32)
+        + jnp.where(oh_lo, lo[:, :, None], jnp.uint32(0)).sum(1, dtype=jnp.uint32)
+    )
     return words, total_bits
 
 
 def _merge_streams(words, nbits, out_words: int):
     """Concatenate U bit-buffers: (U, W) words + (U,) lengths ->
-    ((out_words,) uint32, total_bits). Same shift/scatter-add trick one
-    level up; adjacent units share at most the boundary word, and the
-    bits are disjoint, so add == or."""
+    ((out_words,) uint32, total_bits).
+
+    Scatter-adding every unit word (U*W elements) costs >100 ms/frame on
+    TPU, so the scatter is shrunk to the words that actually EXIST:
+
+    1. shift every unit to its final bit phase (elementwise, cheap);
+    2. count the output words each unit touches (nwp) and lay the used
+       words out compactly via cumsum; recover slot->unit with a marker
+       scatter (U unique updates) + prefix sum — no searchsorted (its
+       binary-search gathers cost more than the merge itself);
+    3. gather each used word and scatter-add into the stream — ~T
+       near-unique updates where T ≈ total_bits/32 + #nonempty units,
+       an order of magnitude under U*W.
+
+    Slots past T_CAP = 2U + out_words only exist when total_bits
+    overflows out_words*32, which the caller already treats as the
+    fall-back-to-host case. Adjacent units share at most boundary words
+    with disjoint bits, so add == or."""
     U, W = words.shape
     offs = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(nbits)])
     starts = offs[:-1]
@@ -330,19 +359,24 @@ def _merge_streams(words, nbits, out_words: int):
         << jnp.clip(32 - sh, 1, 31).astype(jnp.uint32),
         jnp.uint32(0),
     )
-    base = (starts >> 5)[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
-    # mask out words beyond each unit's length (they are zero already,
-    # but their lo-spill would land out of range)
-    nw_used = ((nbits + (starts & 31)) + 31) >> 5  # words touched incl shift
-    in_range = jnp.arange(W, dtype=jnp.int32)[None, :] < nw_used[:, None]
-    hi = jnp.where(in_range, hi, jnp.uint32(0))
-    lo = jnp.where(in_range, lo, jnp.uint32(0))
-    out = jnp.zeros((out_words,), jnp.uint32)
-    b0 = jnp.clip(base, 0, out_words - 1)
-    b1 = jnp.clip(base + 1, 0, out_words - 1)
-    out = out.at[b0.reshape(-1)].add(hi.reshape(-1))
-    out = out.at[b1.reshape(-1)].add(lo.reshape(-1))
-    return out, total
+    shifted = jnp.concatenate([hi, jnp.zeros((U, 1), jnp.uint32)], 1) + jnp.concatenate(
+        [jnp.zeros((U, 1), jnp.uint32), lo], 1
+    )  # (U, W+1): unit words at final bit phase
+    nwp = jnp.where(nbits > 0, (nbits + (starts & 31) + 31) >> 5, 0)  # words touched
+    woffs = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(nwp)])
+    T_CAP = 2 * U + out_words
+    mark = jnp.zeros((T_CAP + 1,), jnp.int32)
+    mark = mark.at[jnp.clip(woffs[:-1], 0, T_CAP)].add(1)
+    unit = jnp.cumsum(mark[:T_CAP]) - 1  # slot -> unit (empties map to none)
+    unitc = jnp.clip(unit, 0, U - 1)
+    slots = jnp.arange(T_CAP, dtype=jnp.int32)
+    win = slots - woffs[unitc]
+    valid = (unit >= 0) & (win >= 0) & (win < nwp[unitc])
+    vals = shifted[unitc, jnp.clip(win, 0, W)]
+    tgt = jnp.where(valid, (starts[unitc] >> 5) + win, out_words)
+    out = jnp.zeros((out_words + 1,), jnp.uint32)
+    out = out.at[jnp.clip(tgt, 0, out_words)].add(jnp.where(valid, vals, jnp.uint32(0)))
+    return out[:out_words], total
 
 
 def _mv_pred_grid(mvs, skip_unused):

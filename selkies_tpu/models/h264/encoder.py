@@ -17,6 +17,7 @@ blocks only on the coefficient device→host copy.
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
@@ -35,11 +36,12 @@ from selkies_tpu.models.h264.bitstream import StreamParams, write_pps, write_sps
 from selkies_tpu.models.h264.compact import (
     i_header_words,
     p_header_words,
-    p_sparse_header_words,
+    p_sparse_var_need,
+    p_sparse_var_words,
     split_prefix,
     unpack_i_compact,
     unpack_p_compact,
-    unpack_p_sparse,
+    unpack_p_sparse_var,
 )
 from selkies_tpu.models.h264.device_cavlc import (
     WORD_CAP_DEFAULT as BITS_WORD_CAP,
@@ -52,7 +54,7 @@ from selkies_tpu.models.h264.encoder_core import (
     fuse_downlink,
     pack_i_compact,
     pack_p_compact,
-    pack_p_sparse,
+    pack_p_sparse_var,
     scatter_bands,
 )
 from selkies_tpu.models.h264.native import pack_slice_fast, pack_slice_p_fast
@@ -79,12 +81,12 @@ def _convert_pad(frame, *, pad_h: int, pad_w: int, channels: int):
 # transfers per op (~200 ms, tools/profile_rpc.py), so typical frames must
 # complete in ONE fetch; frames with more nonzero rows pay a second fetch.
 CAP_ROWS = 4096
-# Delta frames use a skip-aware sparse header: mv/mbinfo words for up to
-# NSCAP non-skip MBs instead of all M (64 KB dense at 1080p). NSCAP and
-# the row cap are sized to swallow the quantization-error decay tail that
-# follows a full-frame change in ONE fetch (ns up to ~4k for ~10 frames,
-# tools/ profiling) — a second fetch mid-pipeline costs more than the
-# larger prefix.
+# Delta frames use the variable-packed sparse downlink
+# (encoder_core.pack_p_sparse_var): live fetch bytes track frame activity
+# (~11 KB for a typing update, ~60-130 KB through the decay tail that
+# follows a full-frame change — measured on the bench trace). NSCAP and
+# the row cap only bound the device buffer; they are sized so the decay
+# tail (ns up to ~3k, n up to ~3.5k) never triggers the fallback fetches.
 CAP_ROWS_DELTA = 4096
 NSCAP = 4096
 # Device-entropy downlink (full P frames): the slice-data BITSTREAM is
@@ -163,8 +165,7 @@ def _p_scatter_step(packed, qp, sy, su, sv, ref_y, ref_u, ref_v, *, nscap, cap):
     yb, ub, vb, idx = _unpack_delta(packed, sy.shape[1])
     y, u, v = scatter_bands(sy, su, sv, yb, ub, vb, idx)
     out = encode_frame_p_planes(y, u, v, ref_y, ref_u, ref_v, qp)
-    sparse, dense, buf = pack_p_sparse(out, nscap)
-    prefix = fuse_downlink(sparse, buf, cap)
+    prefix, dense, buf = pack_p_sparse_var(out, nscap, cap)
     return prefix, dense, buf, out["recon_y"], out["recon_u"], out["recon_v"], y, u, v
 
 
@@ -195,8 +196,7 @@ def _p_scatter_multi_step(packed, qps, sy, su, sv, ref_y, ref_u, ref_v, *, nscap
         yb, ub, vb, idx = _unpack_delta(pk, w)
         y, u, v = scatter_bands(cy, cu, cv, yb, ub, vb, idx)
         out = encode_frame_p_planes(y, u, v, ry, ru, rv, qp)
-        sparse, dense, buf = pack_p_sparse(out, nscap)
-        prefix = fuse_downlink(sparse, buf, cap)
+        prefix, dense, buf = pack_p_sparse_var(out, nscap, cap)
         return (
             (y, u, v, out["recon_y"], out["recon_u"], out["recon_v"]),
             (prefix, dense, buf),
@@ -247,6 +247,7 @@ class _Pending:
     meta: object = None
     au: bytes | None = None  # static only
     prefix_d: object = None
+    pfx_slice_d: object = None  # pd: hint-sized slice, dispatched with the step
     buf_d: object = None
     hdr_d: object = None  # pd/pb: dense header for the fallback fetch
     words_d: object = None  # pb only: full bit-word buffer (spill fetch)
@@ -383,7 +384,15 @@ class TPUH264Encoder:
         mbh, mbw = self._pad_h // 16, self._pad_w // 16
         self._hdr_words_i = i_header_words(mbh, mbw)
         self._hdr_words_p = p_header_words(mbh, mbw)
-        self._hdr_words_pd = p_sparse_header_words(mbh, mbw, self._nscap)
+        self._mbh, self._mbw = mbh, mbw
+        # adaptive delta-downlink fetch: full var-buffer length and the
+        # live slice hint (int16 words), grown/shrunk from recent frames
+        self._pfx_total = p_sparse_var_words(mbh, mbw, self._nscap, self._cap_delta)
+        self._pfx_hint = min(self.PFX_SMALL, self._pfx_total)
+        self._pfx_recent: deque = deque(maxlen=8)
+        # appended by completion workers and the submit thread; iterating
+        # a deque during a concurrent append raises RuntimeError
+        self._pfx_lock = threading.Lock()
         self._allskip: PFrameCoeffs | None = None
         self.frame_index = 0
         self._frames_since_idr = 0
@@ -550,6 +559,7 @@ class TPUH264Encoder:
                     )
                     self._src, self._ref = (sy, su, sv), (ry, ru, rv)
                     rec.prefix_d, rec.hdr_d, rec.buf_d = prefix_d, hdr_d, buf_d
+                    rec.pfx_slice_d = self._pfx_slice(prefix_d)
                     rec.batch_slot = -1
                     rec.future = self._pool.submit(self._complete_work, rec)
                     continue
@@ -565,8 +575,12 @@ class TPUH264Encoder:
                 )
                 self._src, self._ref = (sy, su, sv), (ry, ru, rv)
                 recs = [g[0] for g in group]
+                # per-slot full-row handles, dispatched NOW so a worker
+                # shortfall refetch is a pure transfer (no queued slice)
+                rows_d = [prefixes_d[i] for i in range(take)]
                 shared = self._pool.submit(
-                    self._complete_batch, recs, prefixes_d, denses_d, bufs_d
+                    self._complete_batch, recs, self._pfx_slice(prefixes_d),
+                    rows_d, denses_d, bufs_d,
                 )
                 for slot, rec in enumerate(recs):
                     rec.future = shared
@@ -582,22 +596,68 @@ class TPUH264Encoder:
             self._src = None
             raise
 
-    def _complete_batch(self, recs, prefixes_d, denses_d, bufs_d):
-        """Worker half for a delta group: ONE fetch of all K prefixes,
-        then per-frame unpack + CAVLC pack. Returns a list indexed by
-        batch_slot."""
-        prefixes = np.asarray(prefixes_d)  # (K, L)
+    # Small-slice length for the delta downlink fetch (int16 words =
+    # 32 KB): covers typical desktop deltas (~11 K live content). Exactly
+    # TWO fetch sizes exist — this and the full buffer — because every
+    # distinct slice shape is a fresh executable and this deployment
+    # compiles via a remote service (seconds, occasionally flaky); a
+    # finer-grained adaptive ladder stalls the steady state on compiles.
+    PFX_SMALL = 1 << 14
+
+    def _pfx_slice_len(self) -> int:
+        """Fetch length (int16) for the next delta downlink."""
+        with self._pfx_lock:
+            recent = list(self._pfx_recent)
+        want = max([2048] + [n * 3 // 2 for n in recent])
+        return self.PFX_SMALL if want <= self.PFX_SMALL else self._pfx_total
+
+    def _pfx_slice(self, prefix_d):
+        """Hint-sized view of a fused delta downlink, dispatched from the
+        MAIN thread right behind the step that produced it. Slicing is a
+        device op: doing it in the completion worker would enqueue it
+        after later groups' scans and stall the fetch behind them."""
+        L = self._pfx_hint
+        if prefix_d.ndim == 1:
+            return prefix_d[:L] if L < self._pfx_total else prefix_d
+        return prefix_d[:, :L] if L < self._pfx_total else prefix_d
+
+    def _unpack_sparse_var(self, fused, fused_d, buf_d, qp: int):
+        """One delta frame's fused slice -> PFrameCoeffs (handling slice
+        shortfall, row spill past the cap, and the dense fallback).
+
+        fused_d is a per-frame FULL-row handle created at dispatch time:
+        the shortfall refetch is then a pure transfer — slicing here (a
+        device op) would queue behind scans dispatched since."""
+        need, n, ns = p_sparse_var_need(fused, self._mbh, self._mbw, self._nscap,
+                                        self._cap_delta)
+        with self._pfx_lock:
+            self._pfx_recent.append(need)
+        if need > len(fused):  # hint too small: refetch the live content
+            fused = np.asarray(fused_d)
+        extra = None
+        if n > self._cap_delta:  # rows spilled past the fused buffer
+            extra = _fetch_rest(buf_d, n, self._cap_delta)
+        pfc, rows = unpack_p_sparse_var(
+            fused, qp, self._mbh, self._mbw, self._nscap, self._cap_delta, extra
+        )
+        return pfc, rows
+
+    def _complete_batch(self, recs, pfx_slice_d, pfx_rows_d, denses_d, bufs_d):
+        """Worker half for a delta group: ONE transfer of the pre-sliced
+        prefix stack, then per-frame unpack + CAVLC pack. Returns a list
+        indexed by batch_slot."""
+        prefixes = np.asarray(pfx_slice_d)
         results = []
         for slot, rec in enumerate(recs):
-            header, data, n = split_prefix(prefixes[slot], self._hdr_words_pd)
-            if n > self._cap_delta:  # rare spill: extra fetch for this slot
-                data = np.concatenate([data, _fetch_rest(bufs_d[slot], n, self._cap_delta)])
             t1 = time.perf_counter()
-            pfc = unpack_p_sparse(header, data, rec.qp, self._nscap)
+            pfc, rows = self._unpack_sparse_var(
+                prefixes[slot], pfx_rows_d[slot], bufs_d[slot], rec.qp
+            )
             if pfc is None:  # ns > NSCAP: dense-header fallback fetch
-                pfc = unpack_p_compact(np.asarray(denses_d[slot]), data, rec.qp)
+                pfc = unpack_p_compact(np.asarray(denses_d[slot]), rows, rec.qp)
             au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num)
             results.append((au, int(pfc.skip.sum()), t1, time.perf_counter()))
+        self._pfx_hint = self._pfx_slice_len()
         return results
 
     def submit(self, frame: np.ndarray, qp: int | None = None, meta=None) -> list:
@@ -709,6 +769,16 @@ class TPUH264Encoder:
                         prefix_d=prefix_d, buf_d=buf_d, hdr_d=hdr_d,
                         words_d=words_d, scene_cut=scene_cut,
                     )
+                    if pk == "pd":
+                        rec.pfx_slice_d = self._pfx_slice(prefix_d)
+                if kind == "full":
+                    # decay feed-forward: the frames after a full-frame
+                    # change carry a frame-wide quantization-error tail,
+                    # so the next delta fetches will be large — grow the
+                    # hint NOW instead of stalling on shortfall refetches
+                    with self._pfx_lock:
+                        self._pfx_recent.append(self._pfx_total // 2)
+                    self._pfx_hint = self._pfx_slice_len()
                 # start the downlink fetch + entropy pack on a worker NOW:
                 # fetch ops overlap across threads on the relay
                 # (tools/profile_rpc.py: 4 concurrent fetches ≈ cost of 1)
@@ -804,10 +874,17 @@ class TPUH264Encoder:
         """Worker-thread half: single-fetch downlink + unpack/assemble."""
         if rec.kind == "pb":
             return self._complete_bits(rec)
-        hdr_words = {
-            "i": self._hdr_words_i, "p": self._hdr_words_p, "pd": self._hdr_words_pd,
-        }[rec.kind]
-        cap = self._cap_delta if rec.kind == "pd" else CAP_ROWS
+        if rec.kind == "pd":
+            fused = np.asarray(rec.pfx_slice_d)
+            t1 = time.perf_counter()
+            pfc, rows = self._unpack_sparse_var(fused, rec.prefix_d, rec.buf_d, rec.qp)
+            if pfc is None:
+                pfc = unpack_p_compact(np.asarray(rec.hdr_d), rows, rec.qp)
+            au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num)
+            self._pfx_hint = self._pfx_slice_len()
+            return au, int(pfc.skip.sum()), t1, time.perf_counter()
+        hdr_words = self._hdr_words_i if rec.kind == "i" else self._hdr_words_p
+        cap = CAP_ROWS
         prefix = np.asarray(rec.prefix_d)
         header, data, n = split_prefix(prefix, hdr_words)
         if n > cap:  # rare: heavy frame spilled past the prefix
@@ -823,14 +900,7 @@ class TPUH264Encoder:
             )
             au = self._headers + slice_nal
         else:
-            if rec.kind == "pd":
-                pfc = unpack_p_sparse(header, data, rec.qp, self._nscap)
-                if pfc is None:
-                    # content burst: more non-skip MBs than the sparse
-                    # header carries — one extra fetch of the dense header
-                    pfc = unpack_p_compact(np.asarray(rec.hdr_d), data, rec.qp)
-            else:
-                pfc = unpack_p_compact(header, data, rec.qp)
+            pfc = unpack_p_compact(header, data, rec.qp)
             skipped = int(pfc.skip.sum())
             au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num)
         return au, skipped, t1, time.perf_counter()

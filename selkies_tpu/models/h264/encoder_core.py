@@ -771,19 +771,30 @@ def pack_p_compact(out):
     return header, buf
 
 
-def pack_p_sparse(out, nscap: int):
-    """Skip-aware P downlink for sparse frames (the delta-upload path).
+def pack_p_sparse_var(out, nscap: int, cap_rows: int):
+    """Skip-aware variable-density P downlink (the delta-upload path):
+    ONE int16 buffer whose live content is proportional to frame
+    activity, not to the caps.
 
-    Most desktop P frames are almost-all-skip, so the dense per-MB
-    mv/mbinfo words (2 words x 8160 MBs = 64 KB at 1080p) dominate the
-    fetch. Here only the first `nscap` NON-skip MBs carry their 2 words
-    (the host reconstructs positions from the dense skip bitmap, 1 KB):
+    Most desktop P frames are almost-all-skip, so only the first `nscap`
+    NON-skip MBs carry their mv/mbinfo words (the host reconstructs
+    positions from the dense skip bitmap). A fixed-layout prefix would
+    still fetch nscap pairs + cap_rows coefficient rows — 165 KB at
+    1080p even for a 2-band cursor blink, and the relay prices d2h at
+    ~0.4 ms/KB (tools/profile_bench_loop.py: the group fetch WAS the
+    steady-state bottleneck). Here the host fetches only a slice sized by
+    recent history (encoder._pfx_hint):
 
-      sparse_header: [n, mbh, mbw, ns] ++ skip_words(ceil(M/32))
-                     ++ mv_words(nscap) ++ mbinfo(nscap)
+      [meta: n, mbh, mbw, ns (4 int32)] ++ skip_words(ceil(M/32) int32)
+      ++ (mv, info) int32 pairs for the first ns non-skip MBs
+      ++ coefficient rows (n x 16 int16)  -- at dynamic offset 4*ns
 
-    Also returns the dense header: when ns > nscap (content burst after a
-    resident-plane IDR) the host falls back to one extra fetch of it."""
+    so live content = 8 + 2*ceil(M/32)*2 + 4*ns + 16*n int16 words. The
+    pair region is written at its nscap-sized static offset first, then
+    the rows overwrite its dead tail via a dynamic slice — content stays
+    contiguous without a device-side size branch. Returns
+    (fused int16 (p_sparse_var_words(...),), dense_header, buf); dense
+    header is the ns > nscap fallback, buf the n > cap_rows spill."""
     n, mbh, mbw, mv_words, mbinfo, buf = _p_components(out)
     m = mbh * mbw
     mask = ~out["skip"].reshape(-1)
@@ -793,19 +804,30 @@ def pack_p_sparse(out, nscap: int):
     mv_c = jnp.zeros((nscap + 1,), jnp.int32).at[dest].set(mv_words)[:nscap]
     info_c = jnp.zeros((nscap + 1,), jnp.int32).at[dest].set(mbinfo)[:nscap]
     skip_words = _bitpack32(out["skip"].reshape(-1))
-    sparse = jnp.concatenate([
-        jnp.stack([n, jnp.int32(mbh), jnp.int32(mbw), ns]),
-        skip_words,
-        mv_c,
-        info_c,
-    ])
+    sw = skip_words.shape[0]
+    pairs16 = jax.lax.bitcast_convert_type(
+        jnp.stack([mv_c, info_c], -1).reshape(-1), jnp.int16
+    ).reshape(-1)  # (4*nscap,)
+    head16 = jax.lax.bitcast_convert_type(
+        jnp.concatenate([jnp.stack([n, jnp.int32(mbh), jnp.int32(mbw), ns]), skip_words]),
+        jnp.int16,
+    ).reshape(-1)  # (8 + 2*sw,)
+    base = 8 + 2 * sw
+    total16 = base + 4 * nscap + 16 * cap_rows
+    fused = jnp.zeros((total16,), jnp.int16)
+    fused = jax.lax.dynamic_update_slice(fused, head16, (0,))
+    fused = jax.lax.dynamic_update_slice(fused, pairs16, (base,))
+    rows16 = buf[:cap_rows].reshape(-1)  # (16*cap_rows,) zero past n
+    fused = jax.lax.dynamic_update_slice(
+        fused, rows16, (base + 4 * jnp.clip(ns, 0, nscap),)
+    )
     dense = jnp.concatenate([
         jnp.stack([n, jnp.int32(mbh), jnp.int32(mbw), jnp.int32(0)]),
         mv_words,
         mbinfo,
         skip_words,
     ])
-    return sparse, dense, buf
+    return fused, dense, buf
 
 
 def fuse_downlink(header, buf, cap_rows: int):

@@ -85,3 +85,52 @@ def test_short_data_raises():
     if n > 1:
         with pytest.raises(ValueError):
             unpack_p_compact(header, buf[: n - 1], 20)
+
+
+@pytest.mark.parametrize("kind", ["noise", "flat", "structured"])
+@pytest.mark.parametrize("caps", [(4096, 4096), (8, 4), (2, 4096)])
+def test_p_sparse_var_roundtrip(kind, caps):
+    """Variable-packed sparse downlink == dense unpack, including the
+    row-spill (tiny cap_rows) and ns-overflow (tiny nscap) regimes."""
+    from selkies_tpu.models.h264.compact import (
+        p_sparse_var_need,
+        p_sparse_var_words,
+        unpack_p_sparse_var,
+    )
+    from selkies_tpu.models.h264.native import derive_skip_mvs_fast
+
+    nscap, cap_rows = caps
+    rng = np.random.default_rng(hash((kind, caps)) % 2**32)
+    h, w = 64, 96
+    mbh, mbw = h // 16, w // 16
+    y, u, v = _planes(rng, h, w, kind)
+    if kind == "flat":
+        ry, ru, rv = y, u, v
+    else:
+        ry, ru, rv = _planes(rng, h, w, "structured")
+    out = jax.jit(core.encode_frame_p_planes)(y, u, v, ry, ru, rv, np.int32(30))
+    fused, dense, buf = jax.jit(
+        lambda o: core.pack_p_sparse_var(o, nscap, cap_rows)
+    )(out)
+    fused, dense, buf = np.asarray(fused), np.asarray(dense), np.asarray(buf)
+    assert len(fused) == p_sparse_var_words(mbh, mbw, nscap, cap_rows)
+    need, n, ns = p_sparse_var_need(fused, mbh, mbw, nscap, cap_rows)
+    assert need <= len(fused)
+    extra = buf[cap_rows:n] if n > cap_rows else None
+    # short slice must raise; exact-need slice must round-trip
+    if need > 16:
+        with pytest.raises(ValueError):
+            unpack_p_sparse_var(fused[: need - 8], 30, mbh, mbw, nscap, cap_rows, extra)
+    pfc, rows = unpack_p_sparse_var(fused[:need], 30, mbh, mbw, nscap, cap_rows, extra)
+    mvs = np.asarray(out["mvs"]).copy()
+    derive_skip_mvs_fast(mvs, np.asarray(out["skip"]))
+    if ns > nscap:
+        assert pfc is None
+        # fallback path: dense header + the rows extracted from the slice
+        pfc = unpack_p_compact(dense, rows, 30)
+        mvs = np.asarray(out["mvs"])  # dense header carries every MB's mv
+    np.testing.assert_array_equal(pfc.mvs, mvs)
+    np.testing.assert_array_equal(pfc.skip, np.asarray(out["skip"]))
+    np.testing.assert_array_equal(pfc.luma_ac, np.asarray(out["luma_ac"]))
+    np.testing.assert_array_equal(pfc.chroma_dc, np.asarray(out["chroma_dc"]))
+    np.testing.assert_array_equal(pfc.chroma_ac, np.asarray(out["chroma_ac"]))

@@ -1,0 +1,39 @@
+#!/usr/bin/env python3
+"""Bench loop with per-submit wall timing + kind classification."""
+import sys, time
+import numpy as np
+sys.path.insert(0, ".")
+import importlib.util
+spec = importlib.util.spec_from_file_location("bench", "bench.py")
+bench = importlib.util.module_from_spec(spec); spec.loader.exec_module(bench)
+from selkies_tpu.models.h264.encoder import TPUH264Encoder
+from selkies_tpu.models.registry import default_frame_batch
+
+W, H, ITERS = bench.W, bench.H, 30
+enc = TPUH264Encoder(W, H, qp=28, frame_batch=min(12, default_frame_batch()))
+frames = bench._desktop_trace(ITERS)
+print("frame_batch =", enc.frame_batch)
+enc.encode_frame(frames[0])
+fb = enc.frame_batch
+i = 1
+for _ in range(fb): enc.submit(frames[i]); i += 1
+enc.flush()
+for _ in range(max(2, fb // 2)): enc.submit(frames[i]); i += 1
+enc.flush()
+enc.encode_frame(frames[i])
+enc.encode_frame(frames[29 % len(frames)])
+enc.encode_frame(frames[29 % len(frames)])
+
+t_all0 = time.perf_counter()
+prev = t_all0
+for i in range(ITERS):
+    outs = enc.submit(frames[i % len(frames)])
+    now = time.perf_counter()
+    kinds = [s.idr and "I" or (s.skipped_mbs == 8160 and "S" or "P") for _, s, _ in outs]
+    print(f"submit {i:2d}: {1e3*(now-prev):7.1f} ms  emitted={len(outs)} {kinds}")
+    prev = now
+outs = enc.flush()
+now = time.perf_counter()
+print(f"flush: {1e3*(now-prev):7.1f} ms emitted={len(outs)}")
+dt = now - t_all0
+print(f"total {dt*1e3:.0f} ms -> {ITERS/dt:.2f} fps")

@@ -1,0 +1,47 @@
+#!/usr/bin/env python3
+"""Re-profile new device CAVLC parts, calibrated."""
+import sys, time
+import numpy as np
+sys.path.insert(0, ".")
+import jax, jax.numpy as jnp
+from selkies_tpu.models.h264 import device_cavlc as dc
+
+MBH, MBW = 68, 120
+M = MBH * MBW
+rng = np.random.default_rng(0)
+coeffs = (rng.integers(-4, 5, (M * 16, 16), np.int32) * (rng.random((M * 16, 16)) < 0.08)).astype(np.int32)
+nc = rng.integers(0, 4, (M * 16,), np.int32)
+cj, ncj = jax.device_put(coeffs), jax.device_put(nc)
+
+enc_blocks = jax.jit(lambda c, n: dc._encode_blocks(c, n, chroma_dc=False))
+pack = jax.jit(lambda v, b: dc._pack_pairs(v, b, 32))
+tiny = jax.jit(lambda a: a.ravel()[:1])
+def sync(x): np.asarray(tiny(x[0] if isinstance(x, tuple) else x))
+def t(name, f, n=10):
+    sync(f()); t0 = time.perf_counter()
+    for _ in range(n): r = f()
+    sync(r); print(f"{name:30s} {(time.perf_counter()-t0)/n*1e3:8.1f} ms")
+
+noop = jax.jit(lambda a: a + 1)
+t("noop", lambda: noop(cj))
+t("encode_blocks M*16 (luma)", lambda: enc_blocks(cj, ncj))
+v, b, _ = enc_blocks(cj, ncj)
+v = jax.device_put(np.asarray(v)); b = jax.device_put(np.asarray(b))
+t("pack_pairs dense", lambda: pack(v, b))
+w, nb = pack(v, b)
+segw = jnp.tile(jnp.asarray(np.asarray(w))[: M], (2, 1))[: M * 27]
+segb = jnp.tile(jnp.asarray(np.asarray(nb))[: M], (2,))[: M * 27]
+segw = jax.device_put(np.asarray(segw)); segb = jax.device_put(np.asarray(segb))
+merge = jax.jit(lambda sw, sb: dc._merge_streams(sw, sb, dc.WORD_CAP_DEFAULT))
+t("merge_streams new", lambda: merge(segw, segb))
+
+# full pack on representative P output
+out = {
+    "mvs": jnp.zeros((MBH, MBW, 2), jnp.int32),
+    "skip": jnp.asarray(rng.random((MBH, MBW)) < 0.5),
+    "luma_ac": jnp.asarray(coeffs.reshape(MBH, MBW, 4, 4, 4, 4)),
+    "chroma_dc": jnp.asarray((rng.integers(-4, 5, (MBH, MBW, 2, 2, 2)) * (rng.random((MBH, MBW, 2, 2, 2)) < 0.2)).astype(np.int32)),
+    "chroma_ac": jnp.asarray((rng.integers(-4, 5, (MBH, MBW, 2, 2, 2, 4, 4)) * (rng.random((MBH, MBW, 2, 2, 2, 4, 4)) < 0.05)).astype(np.int32)),
+}
+full = jax.jit(lambda o: dc.pack_p_slice_bits(o))
+t("pack_p_slice_bits full", lambda: full(out), n=6)

@@ -1,0 +1,44 @@
+#!/usr/bin/env python3
+"""Isolate device compute: plain P step vs device-entropy pb step."""
+import sys, time
+import numpy as np
+sys.path.insert(0, ".")
+import importlib.util
+spec = importlib.util.spec_from_file_location("bench", "bench.py")
+bench = importlib.util.module_from_spec(spec); spec.loader.exec_module(bench)
+import jax
+from selkies_tpu.models.h264.encoder import TPUH264Encoder
+
+H, W = 1080, 1920
+frames = bench._desktop_trace(60)
+switch_a, switch_b = frames[28], frames[29]
+
+enc = TPUH264Encoder(W, H, qp=28, frame_batch=1, pipeline_depth=0)
+enc.encode_frame(switch_a); enc.encode_frame(switch_b); enc.encode_frame(switch_a)
+
+tiny = jax.jit(lambda a: a[:1])
+def sync(*arrs):
+    for a in arrs: np.asarray(tiny(a.ravel() if a.ndim > 1 else a))
+
+for it in range(3):
+    frame = [switch_b, switch_a][it % 2]
+    y, u, v = enc._prep.convert(frame)
+    yd, ud, vd = enc._put((y, u, v))
+    sync(yd)  # upload complete
+    ry, ru, rv = enc._ref
+    # plain P step (compute only, donate nothing via aot? _step_p donates refs —
+    # call with copies to keep ref alive)
+    ry2, ru2, rv2 = jax.device_put(np.asarray(ry)), jax.device_put(np.asarray(ru)), jax.device_put(np.asarray(rv))
+    sync(ry2)
+    t0 = time.perf_counter()
+    outp = enc._step_p(yd, ud, vd, np.int32(28), ry2, ru2, rv2)
+    sync(outp[0])
+    t1 = time.perf_counter()
+    ry3, ru3, rv3 = jax.device_put(np.asarray(ry)), jax.device_put(np.asarray(ru)), jax.device_put(np.asarray(rv))
+    sync(ry3)
+    t2 = time.perf_counter()
+    outb = enc._step_pb(yd, ud, vd, np.int32(28), ry3, ru3, rv3)
+    sync(outb[0])
+    t3 = time.perf_counter()
+    enc._ref = (outb[4], outb[5], outb[6]); enc._src = (yd, ud, vd)
+    print(f"iter{it}: plain_p_step {1e3*(t1-t0):7.1f} ms   pb_step {1e3*(t3-t2):7.1f} ms")

@@ -1,0 +1,58 @@
+#!/usr/bin/env python3
+"""A/B: full-frame (3.1 MB I420) upload strategies over the relay.
+
+Measures wall time from first device_put to a downstream 1-byte fetch
+that depends on every chunk (forces the transfers to complete without
+trusting block_until_ready under the relay)."""
+
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+H, W = 1088, 1920
+rng = np.random.default_rng(0)
+Y = rng.integers(0, 255, (H, W), np.uint8)
+U = rng.integers(0, 255, (H // 2, W // 2), np.uint8)
+V = rng.integers(0, 255, (H // 2, W // 2), np.uint8)
+
+sink = jax.jit(lambda *arrs: sum(a.sum(dtype=jnp.int32) for a in arrs) & 0xFF)
+
+
+def t(f, n=4):
+    f()
+    xs = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        f()
+        xs.append((time.perf_counter() - t0) * 1e3)
+    return min(xs), sum(xs) / n
+
+
+def serial3():
+    ds = [jax.device_put(p) for p in (Y, U, V)]
+    int(np.asarray(sink(*ds)))
+
+
+def chunks(n_y, pool):
+    rows = np.array_split(np.arange(H), n_y)
+    parts = [Y[r[0] : r[-1] + 1] for r in rows] + [U, V]
+    ds = list(pool.map(jax.device_put, parts))
+    int(np.asarray(sink(*ds)))
+
+
+with ThreadPoolExecutor(16) as pool:
+    for name, f in [
+        ("serial 3 puts", serial3),
+        ("4 Y-chunks + u,v (6 thr)", lambda: chunks(4, pool)),
+        ("8 Y-chunks + u,v (10 thr)", lambda: chunks(8, pool)),
+        ("14 Y-chunks + u,v (16 thr)", lambda: chunks(14, pool)),
+    ]:
+        mn, avg = t(f)
+        print(f"{name:28s} min {mn:7.0f} ms  avg {avg:7.0f} ms")
