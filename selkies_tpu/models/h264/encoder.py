@@ -139,6 +139,29 @@ def _p_bits_step(y, u, v, qp, ref_y, ref_u, ref_v):
     return prefix, words, header, buf, out["recon_y"], out["recon_u"], out["recon_v"]
 
 
+# Full-frame uploads ride in Y_CHUNKS+2 concurrent device_puts: h2d
+# transfers overlap ~2.5x across Python threads on the relay
+# (tools/profile_upload_chunks.py: 3.1 MB in 175 ms vs 264 serial; more
+# chunks lose to per-op overhead). The chunked steps re-join the planes
+# on device and return them so they stay resident as the delta base.
+Y_CHUNKS = 4
+
+
+def _i_planes_step_chunked(y0, y1, y2, y3, u, v, qp):
+    y = jnp.concatenate([y0, y1, y2, y3], 0)
+    return (*_i_planes_step(y, u, v, qp), y, u, v)
+
+
+def _p_bits_step_chunked(y0, y1, y2, y3, u, v, qp, ref_y, ref_u, ref_v):
+    y = jnp.concatenate([y0, y1, y2, y3], 0)
+    return (*_p_bits_step(y, u, v, qp, ref_y, ref_u, ref_v), y, u, v)
+
+
+def _p_planes_step_chunked(y0, y1, y2, y3, u, v, qp, ref_y, ref_u, ref_v):
+    y = jnp.concatenate([y0, y1, y2, y3], 0)
+    return (*_p_planes_step(y, u, v, qp, ref_y, ref_u, ref_v), y, u, v)
+
+
 # Delta steps: only the dirty bands cross the link; the full frame is
 # assembled on device by scattering them into the resident source planes
 # (donated -> in-place). Each returns the updated source planes so the
@@ -312,9 +335,9 @@ class TPUH264Encoder:
                 nslots=self.pipeline_depth + 2,
             )
         if self._prep is not None:
-            self._step = jax.jit(_i_planes_step)
-            self._step_p = jax.jit(_p_planes_step, donate_argnums=(4, 5, 6))
-            self._step_pb = jax.jit(_p_bits_step, donate_argnums=(4, 5, 6))
+            self._step = jax.jit(_i_planes_step_chunked)
+            self._step_p = jax.jit(_p_planes_step_chunked, donate_argnums=(7, 8, 9))
+            self._step_pb = jax.jit(_p_bits_step_chunked, donate_argnums=(7, 8, 9))
             # delta-upload steps: source planes are donated (scatter is
             # in-place) and returned updated; refs donated as usual
             # nscap/cap ride in a partial (not read from module globals
@@ -380,6 +403,9 @@ class TPUH264Encoder:
         self._pool = ThreadPoolExecutor(
             max_workers=max(2, self.pipeline_depth + 1),
             thread_name_prefix="h264-complete",
+        )
+        self._upload_pool = ThreadPoolExecutor(
+            max_workers=Y_CHUNKS + 2, thread_name_prefix="h264-upload",
         )
         mbh, mbw = self._pad_h // 16, self._pad_w // 16
         self._hdr_words_i = i_header_words(mbh, mbw)
@@ -461,19 +487,23 @@ class TPUH264Encoder:
 
     # -- encoding --
 
-    @staticmethod
-    def _put(planes):
-        # Explicit async device_put: passing host numpy straight into the
-        # jitted call makes the runtime do a SYNCHRONOUS per-argument
-        # transfer (~140 ms each over the axon relay); an explicit
-        # device_put enqueues without a round trip (tools/profile_rpc.py).
-        return [jax.device_put(np.asarray(p)) for p in planes]
+    def _put_chunked(self, y, u, v):
+        """Full-frame upload as Y_CHUNKS+2 concurrent transfers (h2d
+        overlaps ~2.5x across threads on the relay). Explicit device_put
+        (not passing numpy into the jit) keeps each transfer an async
+        enqueue instead of a synchronous ~140 ms round trip
+        (tools/profile_rpc.py)."""
+        rows = y.shape[0] // Y_CHUNKS
+        parts = [y[i * rows : (i + 1) * rows] if i < Y_CHUNKS - 1
+                 else y[(Y_CHUNKS - 1) * rows :] for i in range(Y_CHUNKS)]
+        parts += [u, v]
+        return list(self._upload_pool.map(jax.device_put, parts))
 
     def _run_step_i(self, frame: np.ndarray):
         if self._prep is not None:
-            y, u, v = self._put(self._prep.convert(frame))
-            out = self._step(y, u, v, np.int32(self.qp))
-            # keep the uploaded planes resident: they are the delta base
+            parts = self._put_chunked(*self._prep.convert(frame))
+            *out, y, u, v = self._step(*parts, np.int32(self.qp))
+            # keep the joined planes resident: they are the delta base
             # for the next frame (the I step does not donate them)
             self._src = (y, u, v)
             return out
@@ -481,15 +511,15 @@ class TPUH264Encoder:
 
     def _run_step_p(self, frame: np.ndarray):
         if self._prep is not None:
-            y, u, v = self._put(self._prep.convert(frame))
+            parts = self._put_chunked(*self._prep.convert(frame))
             if self.device_entropy:
-                prefix_d, words_d, hdr_d, buf_d, ry, ru, rv = self._step_pb(
-                    y, u, v, np.int32(self.qp), *self._ref
+                prefix_d, words_d, hdr_d, buf_d, ry, ru, rv, y, u, v = self._step_pb(
+                    *parts, np.int32(self.qp), *self._ref
                 )
                 self._src = (y, u, v)
                 return ("pb", prefix_d, words_d, hdr_d, buf_d, ry, ru, rv)
-            out = self._step_p(y, u, v, np.int32(self.qp), *self._ref)
-            self._src = (y, u, v)
+            out = self._step_p(*parts, np.int32(self.qp), *self._ref)
+            self._src = (out[5], out[6], out[7])
             # (kind, prefix, words, hdr, buf, recon_y, recon_u, recon_v)
             return ("p", out[0], None, None, out[1], out[2], out[3], out[4])
         out = self._step_p(jax.device_put(frame), np.int32(self.qp), *self._ref)
@@ -970,6 +1000,7 @@ class TPUH264Encoder:
         self._inflight.clear()
         self._batch_pend.clear()
         self._pool.shutdown(wait=False, cancel_futures=True)
+        self._upload_pool.shutdown(wait=False, cancel_futures=True)
 
     def recon_planes(self, frame: np.ndarray):
         """Debug helper: (recon_y, recon_u, recon_v) for a frame."""

@@ -29,8 +29,8 @@ v, b, _ = enc_blocks(cj, ncj)
 v = jax.device_put(np.asarray(v)); b = jax.device_put(np.asarray(b))
 t("pack_pairs dense", lambda: pack(v, b))
 w, nb = pack(v, b)
-segw = jnp.tile(jnp.asarray(np.asarray(w))[: M], (2, 1))[: M * 27]
-segb = jnp.tile(jnp.asarray(np.asarray(nb))[: M], (2,))[: M * 27]
+segw = jnp.tile(jnp.asarray(np.asarray(w))[: M], (27, 1))[: M * 27]
+segb = jnp.tile(jnp.asarray(np.asarray(nb))[: M], (27,))[: M * 27]
 segw = jax.device_put(np.asarray(segw)); segb = jax.device_put(np.asarray(segb))
 merge = jax.jit(lambda sw, sb: dc._merge_streams(sw, sb, dc.WORD_CAP_DEFAULT))
 t("merge_streams new", lambda: merge(segw, segb))

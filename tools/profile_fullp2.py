@@ -1,46 +1,33 @@
 #!/usr/bin/env python3
-"""Full-P stage timing with the BENCH trace's window-switch frames."""
+"""Full-P (device-entropy, chunked-upload) stage timing on the bench
+trace's window-switch frames."""
 import sys, time
 import numpy as np
 sys.path.insert(0, ".")
 import importlib.util
 spec = importlib.util.spec_from_file_location("bench", "bench.py")
 bench = importlib.util.module_from_spec(spec); spec.loader.exec_module(bench)
-import jax, jax.numpy as jnp
+import jax
 from selkies_tpu.models.h264.encoder import TPUH264Encoder, BITS_PREFIX_WORDS
 
 H, W = 1080, 1920
 frames = bench._desktop_trace(60)
-switch_a, switch_b = frames[28], frames[29]  # pre/post window switch
+switch_a, switch_b = frames[28], frames[29]
 
 enc = TPUH264Encoder(W, H, qp=28, frame_batch=1, pipeline_depth=0)
-enc.encode_frame(switch_a)
-enc.encode_frame(switch_b)
-enc.encode_frame(switch_a)
+enc.encode_frame(switch_a); enc.encode_frame(switch_b); enc.encode_frame(switch_a)
 
 tiny = jax.jit(lambda a: a[:1])
-
 for it in range(4):
     frame = [switch_b, switch_a][it % 2]
     t0 = time.perf_counter()
-    y, u, v = enc._prep.convert(frame)
+    kind, prefix_d, words_d, hdr_d, buf_d, ry, ru, rv = enc._run_step_p(frame)
+    enc._ref = (ry, ru, rv)
     t1 = time.perf_counter()
-    yd, ud, vd = enc._put((y, u, v))
+    first = np.asarray(tiny(prefix_d))
     t2 = time.perf_counter()
-    out = enc._step_pb(yd, ud, vd, np.int32(28), *enc._ref)
-    prefix_d, words_d, hdr_d, buf_d, ry, ru, rv = out
-    enc._ref = (ry, ru, rv); enc._src = (yd, ud, vd)
+    arr = np.asarray(prefix_d)
     t3 = time.perf_counter()
-    first = np.asarray(tiny(prefix_d))  # 4-byte fetch: waits for compute+upload
-    t4 = time.perf_counter()
-    arr = np.asarray(prefix_d)          # bulk 256KB fetch, compute already done
-    t5 = time.perf_counter()
-    nbits = int(arr[0]); need = (nbits + 31) // 32
-    extra = 0.0
-    if need > BITS_PREFIX_WORDS:
-        e0 = time.perf_counter()
-        _ = np.asarray(words_d[BITS_PREFIX_WORDS:need+1024])
-        extra = time.perf_counter() - e0
-    print(f"iter{it}: convert {1e3*(t1-t0):5.1f} put {1e3*(t2-t1):5.1f} "
-          f"dispatch {1e3*(t3-t2):4.1f} compute+upl_wait {1e3*(t4-t3):7.1f} "
-          f"bulk256KB {1e3*(t5-t4):6.1f} spill {1e3*extra:6.1f} nbits={nbits} need={need}")
+    nbits = int(arr[0])
+    print(f"iter{it}: dispatch {1e3*(t1-t0):5.1f}  upload+compute {1e3*(t2-t1):7.1f}  "
+          f"bulk256KB {1e3*(t3-t2):6.1f}  nbits={nbits}")

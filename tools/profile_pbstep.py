@@ -22,23 +22,22 @@ def sync(*arrs):
 
 for it in range(3):
     frame = [switch_b, switch_a][it % 2]
-    y, u, v = enc._prep.convert(frame)
-    yd, ud, vd = enc._put((y, u, v))
-    sync(yd)  # upload complete
+    parts = enc._put_chunked(*enc._prep.convert(frame))
+    sync(parts[0])  # upload complete
     ry, ru, rv = enc._ref
     # plain P step (compute only, donate nothing via aot? _step_p donates refs —
     # call with copies to keep ref alive)
     ry2, ru2, rv2 = jax.device_put(np.asarray(ry)), jax.device_put(np.asarray(ru)), jax.device_put(np.asarray(rv))
     sync(ry2)
     t0 = time.perf_counter()
-    outp = enc._step_p(yd, ud, vd, np.int32(28), ry2, ru2, rv2)
+    outp = enc._step_p(*parts, np.int32(28), ry2, ru2, rv2)
     sync(outp[0])
     t1 = time.perf_counter()
     ry3, ru3, rv3 = jax.device_put(np.asarray(ry)), jax.device_put(np.asarray(ru)), jax.device_put(np.asarray(rv))
     sync(ry3)
     t2 = time.perf_counter()
-    outb = enc._step_pb(yd, ud, vd, np.int32(28), ry3, ru3, rv3)
+    outb = enc._step_pb(*parts, np.int32(28), ry3, ru3, rv3)
     sync(outb[0])
     t3 = time.perf_counter()
-    enc._ref = (outb[4], outb[5], outb[6]); enc._src = (yd, ud, vd)
+    enc._ref = (outb[4], outb[5], outb[6]); enc._src = (outb[7], outb[8], outb[9])
     print(f"iter{it}: plain_p_step {1e3*(t1-t0):7.1f} ms   pb_step {1e3*(t3-t2):7.1f} ms")
