@@ -26,14 +26,28 @@ class RtpPacket:
     ssrc: int
     payload: bytes
     marker: bool = False
+    # RFC 8285 one-byte-header extensions: [(id 1-14, data 1-16 bytes)].
+    # The WebRTC transport adds transport-wide-cc / playout-delay here
+    # (reference: rtp_add_extensions, gstwebrtc_app.py:1657-1689).
+    extensions: list = field(default_factory=list)
 
     def serialize(self) -> bytes:
         b0 = RTP_VERSION << 6
+        ext = b""
+        if self.extensions:
+            b0 |= 0x10
+            body = b"".join(
+                bytes([(eid << 4) | (len(data) - 1)]) + data
+                for eid, data in self.extensions
+            )
+            body += b"\x00" * ((4 - len(body) % 4) % 4)
+            ext = struct.pack("!HH", 0xBEDE, len(body) // 4) + body
         b1 = (0x80 if self.marker else 0) | (self.payload_type & 0x7F)
         return (
             struct.pack(
                 "!BBHII", b0, b1, self.sequence & 0xFFFF, self.timestamp & 0xFFFFFFFF, self.ssrc
             )
+            + ext
             + self.payload
         )
 
@@ -105,7 +119,11 @@ class H264Payloader:
         """Packetize one access unit; the last packet carries the marker."""
         nals = split_annexb(au)
         packets: list[RtpPacket] = []
-        max_payload = self.mtu - 12  # RTP header
+        # header budget: 12-byte RTP header + 8 bytes of RFC 8285
+        # extension (transport-cc, added by the WebRTC transport) + the
+        # 10-byte SRTP auth tag — a full fragment must still fit the
+        # 1200-byte path-MTU assumption after protection
+        max_payload = self.mtu - 12 - 8 - 10
 
         params: list[bytes] = []
         for nal in nals:

@@ -1,0 +1,16 @@
+"""From-scratch WebRTC media plane (TPU-native framework counterpart of
+the reference's webrtcbin, gstwebrtc_app.py:149-196).
+
+The reference delegates its entire transport to GStreamer's webrtcbin
+(libnice ICE + DTLS-SRTP + SCTP). None of those libraries exist in this
+image, so the stack is reimplemented directly on asyncio UDP:
+
+- stun.py  — RFC 5389 STUN + RFC 8445 ICE attributes + RFC 5766 TURN
+- dtls.py  — DTLS 1.2 over ctypes libssl.so.3 (memory BIOs), with the
+             use_srtp extension and EXTRACTOR-dtls_srtp key export
+- srtp.py  — RFC 3711 SRTP/SRTCP, AES_CM_128_HMAC_SHA1_80
+- ice.py   — ICE agent: host/srflx/relay gathering, connectivity checks
+- sctp.py  — minimal SCTP over DTLS + RFC 8832 DCEP data channels
+- sdp.py   — offer/answer with the reference's munging list
+- peer.py  — the peer connection tying the layers together
+"""

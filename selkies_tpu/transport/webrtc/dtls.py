@@ -1,0 +1,319 @@
+"""DTLS 1.2 over ctypes libssl.so.3 with memory BIOs.
+
+The reference's DTLS-SRTP comes packaged inside webrtcbin
+(gstwebrtc_app.py:149-196). No GStreamer/pyOpenSSL here, so OpenSSL 3 is
+driven directly: records move through in-memory BIOs and the caller
+shuttles the datagrams over whatever transport ICE selected. The
+`use_srtp` extension negotiates SRTP_AES128_CM_SHA1_80 and the RFC 5764
+EXTRACTOR exports the SRTP master keys; the peer certificate is pinned
+to the SDP a=fingerprint (WebRTC's only trust anchor).
+
+Self-signed certificates are generated with the `cryptography` package
+and loaded as DER, so no files touch disk.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import datetime
+import hashlib
+import logging
+from dataclasses import dataclass
+
+logger = logging.getLogger("transport.webrtc.dtls")
+
+_ssl = ctypes.CDLL(ctypes.util.find_library("ssl") or "libssl.so.3")
+_crypto = ctypes.CDLL(ctypes.util.find_library("crypto") or "libcrypto.so.3")
+
+_ssl.DTLS_method.restype = ctypes.c_void_p
+_ssl.SSL_CTX_new.restype = ctypes.c_void_p
+_ssl.SSL_CTX_new.argtypes = [ctypes.c_void_p]
+_ssl.SSL_CTX_free.argtypes = [ctypes.c_void_p]
+_ssl.SSL_CTX_use_certificate.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+_ssl.SSL_CTX_use_PrivateKey.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+_ssl.SSL_CTX_set_tlsext_use_srtp.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+_ssl.SSL_CTX_set_cipher_list.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+_ssl.SSL_new.restype = ctypes.c_void_p
+_ssl.SSL_new.argtypes = [ctypes.c_void_p]
+_ssl.SSL_free.argtypes = [ctypes.c_void_p]
+_ssl.SSL_set_bio.argtypes = [ctypes.c_void_p] * 3
+_ssl.SSL_set_accept_state.argtypes = [ctypes.c_void_p]
+_ssl.SSL_set_connect_state.argtypes = [ctypes.c_void_p]
+_ssl.SSL_do_handshake.argtypes = [ctypes.c_void_p]
+_ssl.SSL_get_error.argtypes = [ctypes.c_void_p, ctypes.c_int]
+_ssl.SSL_is_init_finished.argtypes = [ctypes.c_void_p]
+_ssl.SSL_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int]
+_ssl.SSL_write.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int]
+_ssl.SSL_get1_peer_certificate.restype = ctypes.c_void_p
+_ssl.SSL_get1_peer_certificate.argtypes = [ctypes.c_void_p]
+_ssl.SSL_export_keying_material.argtypes = [
+    ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+    ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
+]
+_ssl.SSL_shutdown.argtypes = [ctypes.c_void_p]
+_ssl.SSL_ctrl.restype = ctypes.c_long
+_ssl.SSL_ctrl.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_long, ctypes.c_void_p]
+
+_crypto.BIO_new.restype = ctypes.c_void_p
+_crypto.BIO_new.argtypes = [ctypes.c_void_p]
+_crypto.BIO_s_mem.restype = ctypes.c_void_p
+_crypto.BIO_write.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int]
+_crypto.BIO_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int]
+_crypto.BIO_ctrl_pending.restype = ctypes.c_size_t
+_crypto.BIO_ctrl_pending.argtypes = [ctypes.c_void_p]
+_crypto.BIO_ctrl.restype = ctypes.c_long
+_crypto.BIO_ctrl.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_long, ctypes.c_void_p]
+_crypto.d2i_X509.restype = ctypes.c_void_p
+_crypto.d2i_X509.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p), ctypes.c_long]
+_crypto.X509_free.argtypes = [ctypes.c_void_p]
+_crypto.X509_digest.argtypes = [
+    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint),
+]
+_crypto.EVP_sha256.restype = ctypes.c_void_p
+_crypto.d2i_AutoPrivateKey.restype = ctypes.c_void_p
+_crypto.d2i_AutoPrivateKey.argtypes = [
+    ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p), ctypes.c_long,
+]
+_crypto.EVP_PKEY_free.argtypes = [ctypes.c_void_p]
+_crypto.ERR_get_error.restype = ctypes.c_ulong
+_crypto.ERR_error_string_n.argtypes = [ctypes.c_ulong, ctypes.c_char_p, ctypes.c_size_t]
+# DTLSv1_handle_timeout is a macro over SSL_ctrl in this libssl build
+DTLS_CTRL_HANDLE_TIMEOUT = 74
+
+
+def is_dtls(data: bytes) -> bool:
+    """Demultiplex per RFC 7983: DTLS records lead with 20-63."""
+    return len(data) >= 13 and 20 <= data[0] <= 63
+
+_ssl.SSL_CTX_set_verify.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p]
+
+SSL_VERIFY_PEER = 0x01
+# chain validation always "passes": WebRTC certificates are self-signed
+# and trust comes ONLY from pinning the SDP a=fingerprint after the
+# handshake (_finish_handshake)
+_VERIFY_CB = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int, ctypes.c_void_p)(
+    lambda ok, store_ctx: 1
+)
+
+SSL_ERROR_WANT_READ = 2
+SSL_ERROR_ZERO_RETURN = 6
+BIO_CTRL_EOF_RETURN = 130  # BIO_C_SET_BUF_MEM_EOF_RETURN
+SSL_CTRL_SET_MTU = 17
+SRTP_PROFILE = b"SRTP_AES128_CM_SHA1_80"
+EXTRACTOR = b"EXTRACTOR-dtls_srtp"
+
+
+class DtlsError(RuntimeError):
+    pass
+
+
+def _err() -> str:
+    buf = ctypes.create_string_buffer(256)
+    parts = []
+    while True:
+        e = _crypto.ERR_get_error()
+        if not e:
+            break
+        _crypto.ERR_error_string_n(e, buf, 256)
+        parts.append(buf.value.decode())
+    return "; ".join(parts) or "unknown OpenSSL error"
+
+
+def make_certificate():
+    """Self-signed ECDSA P-256 certificate -> (cert_der, key_der,
+    sha256_fingerprint 'AB:CD:...')."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "selkies-tpu")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(days=1))
+        .not_valid_after(now + datetime.timedelta(days=30))
+        .sign(key, hashes.SHA256())
+    )
+    cert_der = cert.public_bytes(serialization.Encoding.DER)
+    key_der = key.private_bytes(
+        serialization.Encoding.DER,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    )
+    digest = hashlib.sha256(cert_der).hexdigest().upper()
+    fp = ":".join(digest[i : i + 2] for i in range(0, 64, 2))
+    return cert_der, key_der, fp
+
+
+@dataclass
+class SrtpKeys:
+    """RFC 5764 §4.2: exported key block split per role."""
+
+    client_key: bytes
+    server_key: bytes
+    client_salt: bytes
+    server_salt: bytes
+
+    def for_role(self, is_client: bool):
+        """(local_key, local_salt, remote_key, remote_salt)."""
+        if is_client:
+            return (self.client_key, self.client_salt,
+                    self.server_key, self.server_salt)
+        return (self.server_key, self.server_salt,
+                self.client_key, self.client_salt)
+
+
+class DtlsEndpoint:
+    """One DTLS association over memory BIOs.
+
+    Usage: feed incoming datagrams with `put_datagram`, collect outgoing
+    ones from `take_datagrams` after any call, drive with `handshake_step`
+    until `handshake_complete`, then `send`/`recv` application data
+    (SCTP) and read `srtp_keys`.
+    """
+
+    def __init__(self, *, is_server: bool, cert_der: bytes, key_der: bytes,
+                 peer_fingerprint: str | None = None, mtu: int = 1200):
+        self._ctx = _ssl.SSL_CTX_new(_ssl.DTLS_method())
+        if not self._ctx:
+            raise DtlsError(f"SSL_CTX_new: {_err()}")
+        p = ctypes.c_char_p(cert_der)
+        x509 = _crypto.d2i_X509(None, ctypes.byref(p), len(cert_der))
+        if not x509 or _ssl.SSL_CTX_use_certificate(self._ctx, x509) != 1:
+            raise DtlsError(f"use_certificate: {_err()}")
+        _crypto.X509_free(x509)
+        p = ctypes.c_char_p(key_der)
+        pkey = _crypto.d2i_AutoPrivateKey(None, ctypes.byref(p), len(key_der))
+        if not pkey or _ssl.SSL_CTX_use_PrivateKey(self._ctx, pkey) != 1:
+            raise DtlsError(f"use_PrivateKey: {_err()}")
+        _crypto.EVP_PKEY_free(pkey)
+        if _ssl.SSL_CTX_set_tlsext_use_srtp(self._ctx, SRTP_PROFILE) != 0:
+            raise DtlsError(f"use_srtp: {_err()}")
+        # request (and on the server side, demand) the peer certificate
+        _ssl.SSL_CTX_set_verify(self._ctx, SSL_VERIFY_PEER, _VERIFY_CB)
+        self._ssl = _ssl.SSL_new(self._ctx)
+        if not self._ssl:
+            raise DtlsError(f"SSL_new: {_err()}")
+        self._rbio = _crypto.BIO_new(_crypto.BIO_s_mem())
+        self._wbio = _crypto.BIO_new(_crypto.BIO_s_mem())
+        # empty read BIO must report retry, not EOF
+        _crypto.BIO_ctrl(self._rbio, BIO_CTRL_EOF_RETURN, -1, None)
+        _crypto.BIO_ctrl(self._wbio, BIO_CTRL_EOF_RETURN, -1, None)
+        _ssl.SSL_set_bio(self._ssl, self._rbio, self._wbio)
+        _ssl.SSL_ctrl(self._ssl, SSL_CTRL_SET_MTU, mtu, None)
+        self.is_server = is_server
+        if is_server:
+            _ssl.SSL_set_accept_state(self._ssl)
+        else:
+            _ssl.SSL_set_connect_state(self._ssl)
+        self.peer_fingerprint = peer_fingerprint
+        self.handshake_complete = False
+        self.srtp_keys: SrtpKeys | None = None
+        self._closed = False
+
+    # -- datagram plumbing -------------------------------------------
+
+    def put_datagram(self, data: bytes) -> None:
+        _crypto.BIO_write(self._rbio, data, len(data))
+
+    def take_datagrams(self) -> list[bytes]:
+        out = []
+        while True:
+            n = _crypto.BIO_ctrl_pending(self._wbio)
+            if not n:
+                return out
+            buf = ctypes.create_string_buffer(int(n))
+            got = _crypto.BIO_read(self._wbio, buf, int(n))
+            if got <= 0:
+                return out
+            out.append(buf.raw[:got])
+
+    # -- handshake ----------------------------------------------------
+
+    def handshake_step(self) -> bool:
+        """Advance the handshake; True when complete. Call after feeding
+        each incoming datagram (and once to kick off a client)."""
+        if self.handshake_complete:
+            return True
+        rc = _ssl.SSL_do_handshake(self._ssl)
+        if rc == 1:
+            self._finish_handshake()
+            return True
+        err = _ssl.SSL_get_error(self._ssl, rc)
+        if err == SSL_ERROR_WANT_READ:
+            return False
+        raise DtlsError(f"handshake failed (ssl_error={err}): {_err()}")
+
+    def handle_timeout(self) -> None:
+        """Retransmit a lost flight (call on a ~1 s timer until done)."""
+        if not self.handshake_complete:
+            _ssl.SSL_ctrl(self._ssl, DTLS_CTRL_HANDLE_TIMEOUT, 0, None)
+
+    def _finish_handshake(self) -> None:
+        if self.peer_fingerprint is not None:
+            cert = _ssl.SSL_get1_peer_certificate(self._ssl)
+            if not cert:
+                raise DtlsError("peer sent no certificate")
+            md = ctypes.create_string_buffer(32)
+            n = ctypes.c_uint(0)
+            _crypto.X509_digest(cert, _crypto.EVP_sha256(), md, ctypes.byref(n))
+            _crypto.X509_free(cert)
+            fp = ":".join(f"{b:02X}" for b in md.raw[: n.value])
+            if fp != self.peer_fingerprint.upper():
+                raise DtlsError("peer certificate fingerprint mismatch")
+        block = ctypes.create_string_buffer(60)
+        if _ssl.SSL_export_keying_material(
+            self._ssl, block, 60, EXTRACTOR, len(EXTRACTOR), None, 0, 0
+        ) != 1:
+            raise DtlsError(f"export_keying_material: {_err()}")
+        b = block.raw
+        self.srtp_keys = SrtpKeys(
+            client_key=b[0:16], server_key=b[16:32],
+            client_salt=b[32:46], server_salt=b[46:60],
+        )
+        self.handshake_complete = True
+
+    # -- application data (SCTP rides here) --------------------------
+
+    def send(self, data: bytes) -> None:
+        rc = _ssl.SSL_write(self._ssl, data, len(data))
+        if rc <= 0:
+            err = _ssl.SSL_get_error(self._ssl, rc)
+            raise DtlsError(f"SSL_write failed (ssl_error={err}): {_err()}")
+
+    def recv(self) -> list[bytes]:
+        """Drain decrypted application datagrams."""
+        out = []
+        buf = ctypes.create_string_buffer(65536)
+        while True:
+            rc = _ssl.SSL_read(self._ssl, buf, 65536)
+            if rc > 0:
+                out.append(buf.raw[:rc])
+                continue
+            err = _ssl.SSL_get_error(self._ssl, rc)
+            if err in (SSL_ERROR_WANT_READ, SSL_ERROR_ZERO_RETURN):
+                return out
+            raise DtlsError(f"SSL_read failed (ssl_error={err}): {_err()}")
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            _ssl.SSL_shutdown(self._ssl)
+
+    def __del__(self):  # pragma: no cover - gc order dependent
+        try:
+            if getattr(self, "_ssl", None):
+                _ssl.SSL_free(self._ssl)  # frees the BIOs too
+                self._ssl = None
+            if getattr(self, "_ctx", None):
+                _ssl.SSL_CTX_free(self._ctx)
+                self._ctx = None
+        except Exception:
+            pass

@@ -1,0 +1,516 @@
+"""ICE agent (RFC 8445) over one asyncio UDP socket.
+
+Replaces the libnice half of the reference's webrtcbin
+(gstwebrtc_app.py:149-160). The server is always the CONTROLLING agent
+(it creates the offer, like webrtcbin's on-negotiation-needed flow) and
+uses aggressive nomination: every check carries USE-CANDIDATE, and the
+first validated pair is selected. One socket serves every component —
+BUNDLE + rtcp-mux mean WebRTC needs exactly one.
+
+Candidate gathering: host (one per local unicast address), server
+reflexive (STUN binding through the same socket), relay (TURN
+allocation, RFC 5766, long-term credentials from the existing /turn
+HMAC chain). Incoming traffic demultiplexes per RFC 7983: STUN here,
+everything else (DTLS records, SRTP) to `on_data`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import secrets
+import socket
+import struct
+import time
+from dataclasses import dataclass, field
+
+from selkies_tpu.transport.webrtc import stun
+
+logger = logging.getLogger("transport.webrtc.ice")
+
+TYPE_PREF = {"host": 126, "prflx": 110, "srflx": 100, "relay": 0}
+
+
+@dataclass
+class Candidate:
+    foundation: str
+    component: int
+    priority: int
+    ip: str
+    port: int
+    typ: str
+    raddr: str | None = None
+    rport: int | None = None
+
+    def to_sdp(self) -> str:
+        s = (f"candidate:{self.foundation} {self.component} udp "
+             f"{self.priority} {self.ip} {self.port} typ {self.typ}")
+        if self.raddr is not None:
+            s += f" raddr {self.raddr} rport {self.rport}"
+        return s
+
+    @classmethod
+    def from_sdp(cls, line: str) -> "Candidate":
+        line = line.strip()
+        if line.startswith("a="):
+            line = line[2:]
+        if not line.startswith("candidate:"):
+            raise ValueError(f"not a candidate line: {line!r}")
+        parts = line[len("candidate:"):].split()
+        if len(parts) < 8 or parts[2].lower() != "udp":
+            raise ValueError(f"unsupported candidate: {line!r}")
+        c = cls(foundation=parts[0], component=int(parts[1]),
+                priority=int(parts[3]), ip=parts[4], port=int(parts[5]),
+                typ=parts[7])
+        if "raddr" in parts:
+            i = parts.index("raddr")
+            c.raddr, c.rport = parts[i + 1], int(parts[i + 3])
+        return c
+
+
+def candidate_priority(typ: str, local_pref: int = 65535, component: int = 1) -> int:
+    return (TYPE_PREF[typ] << 24) | (local_pref << 8) | (256 - component)
+
+
+def _local_addresses() -> list[str]:
+    """Local unicast IPv4 addresses, default-route first."""
+    addrs: list[str] = []
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 53))  # no traffic: just routes
+        addrs.append(s.getsockname()[0])
+        s.close()
+    except OSError:
+        pass
+    try:
+        for info in socket.getaddrinfo(socket.gethostname(), None, socket.AF_INET):
+            ip = info[4][0]
+            if ip not in addrs and not ip.startswith("127."):
+                addrs.append(ip)
+    except socket.gaierror:
+        pass
+    if not addrs:
+        addrs.append("127.0.0.1")
+    return addrs
+
+
+@dataclass
+class _CheckPair:
+    remote: Candidate
+    relayed: bool = False  # send via the TURN allocation
+    state: str = "waiting"  # waiting | inprogress | succeeded | failed
+    nominated: bool = False
+    last_tx: float = 0.0
+    txid: bytes = b""
+    attempts: int = 0
+
+
+MAX_CHECK_ATTEMPTS = 20  # ~10 s at the 0.5 s pacing before a pair fails
+
+
+class _Proto(asyncio.DatagramProtocol):
+    def __init__(self, agent: "IceAgent"):
+        self.agent = agent
+
+    def datagram_received(self, data, addr):
+        self.agent._on_datagram(data, addr)
+
+    def error_received(self, exc):  # pragma: no cover - platform dependent
+        logger.debug("socket error: %s", exc)
+
+
+class IceAgent:
+    """Controlling ICE agent for one bundled transport.
+
+    Lifecycle: `await gather()` -> read `local_candidates` / ufrag/pwd
+    into the offer -> `set_remote(ufrag, pwd)` + `add_remote_candidate`
+    from the answer/trickle -> `await wait_connected()` -> `send(data)`
+    and `on_data(data)` callbacks flow over the selected pair.
+    """
+
+    def __init__(self, *, stun_server: tuple[str, int] | None = None,
+                 turn_server: tuple[str, int] | None = None,
+                 turn_username: str = "", turn_password: str = "",
+                 loop: asyncio.AbstractEventLoop | None = None):
+        self.local_ufrag = secrets.token_urlsafe(4)
+        self.local_pwd = secrets.token_urlsafe(18)
+        self.remote_ufrag = ""
+        self.remote_pwd = ""
+        self.tiebreaker = os.urandom(8)
+        self.stun_server = stun_server
+        self.turn_server = turn_server
+        self.turn_username = turn_username
+        self.turn_password = turn_password
+        self.local_candidates: list[Candidate] = []
+        self.on_data = lambda data: None
+        self.on_local_candidate = lambda cand: None
+        self._loop = loop or asyncio.get_event_loop()
+        self._transport: asyncio.DatagramTransport | None = None
+        self._pairs: list[_CheckPair] = []
+        self._selected: _CheckPair | None = None
+        self._connected = asyncio.Event()
+        self._closed = False
+        self._check_task: asyncio.Task | None = None
+        self._pending: dict[bytes, tuple[str, object]] = {}  # txid -> (kind, extra)
+        # TURN allocation state
+        self._turn_addr_cache: tuple[str, int] | None = None
+        self._relay_addr: tuple[str, int] | None = None
+        self._turn_realm = ""
+        self._turn_nonce = b""
+        self._turn_key = b""
+        self._turn_perms: dict[str, float] = {}  # peer ip -> last permit time
+        self._turn_last_refresh = 0.0
+
+    # -- gathering ----------------------------------------------------
+
+    async def gather(self, port: int = 0) -> None:
+        self._transport, _ = await self._loop.create_datagram_endpoint(
+            lambda: _Proto(self), local_addr=("0.0.0.0", port)
+        )
+        sock = self._transport.get_extra_info("socket")
+        if sock is not None:
+            # an IDR burst is ~100+ packets back-to-back; the default
+            # ~212 KB buffers drop half of it on loopback and on real
+            # hosts under load
+            for opt in (socket.SO_RCVBUF, socket.SO_SNDBUF):
+                try:
+                    sock.setsockopt(socket.SOL_SOCKET, opt, 4 << 20)
+                except OSError:
+                    pass
+        lport = self._transport.get_extra_info("sockname")[1]
+        for i, ip in enumerate(_local_addresses()):
+            cand = Candidate(
+                foundation=str(i + 1), component=1,
+                priority=candidate_priority("host", 65535 - i),
+                ip=ip, port=lport, typ="host",
+            )
+            self.local_candidates.append(cand)
+        if self.stun_server:
+            try:
+                await self._gather_srflx()
+            except (asyncio.TimeoutError, OSError) as exc:
+                logger.warning("srflx gathering failed: %s", exc)
+        if self.turn_server and self.turn_username:
+            try:
+                await self._gather_relay()
+            except (asyncio.TimeoutError, OSError, stun.StunError) as exc:
+                logger.warning("TURN allocation failed: %s", exc)
+        for c in self.local_candidates:
+            self.on_local_candidate(c)
+
+    async def _request(self, msg: stun.StunMessage, addr: tuple[str, int],
+                       kind: str, timeout: float = 3.0,
+                       integrity_key: bytes | None = None) -> stun.StunMessage:
+        """Send a request and await its (error-)response, with retries."""
+        fut = self._loop.create_future()
+        self._pending[msg.txid] = (kind, fut)
+        wire = msg.serialize(integrity_key=integrity_key)
+        try:
+            for backoff in (0.2, 0.4, 0.8, 1.6):
+                self._transport.sendto(wire, addr)
+                try:
+                    return await asyncio.wait_for(
+                        asyncio.shield(fut), min(backoff, timeout)
+                    )
+                except asyncio.TimeoutError:
+                    timeout -= backoff
+                    if timeout <= 0:
+                        raise
+            raise asyncio.TimeoutError
+        finally:
+            self._pending.pop(msg.txid, None)
+
+    async def _gather_srflx(self) -> None:
+        addr = await self._resolve(self.stun_server)
+        req = stun.StunMessage(method=stun.BINDING, cls=stun.REQUEST)
+        resp = await self._request(req, addr, "srflx")
+        xma = resp.get(stun.ATTR_XOR_MAPPED_ADDRESS)
+        if xma is None:
+            return
+        ip, port = stun.unxor_address(xma, resp.txid)
+        base = self.local_candidates[0]
+        if any(c.ip == ip and c.port == port for c in self.local_candidates):
+            return  # not behind NAT: srflx duplicates host
+        self.local_candidates.append(Candidate(
+            foundation="srflx1", component=1,
+            priority=candidate_priority("srflx"),
+            ip=ip, port=port, typ="srflx", raddr=base.ip, rport=base.port,
+        ))
+
+    async def _resolve(self, server: tuple[str, int]) -> tuple[str, int]:
+        infos = await self._loop.getaddrinfo(
+            server[0], server[1], family=socket.AF_INET, type=socket.SOCK_DGRAM
+        )
+        return infos[0][4]
+
+    # -- TURN client (RFC 5766, long-term credentials) ---------------
+
+    async def _turn_request(self, method: int, attrs: list[tuple[int, bytes]],
+                            kind: str) -> stun.StunMessage:
+        addr = await self._resolve(self.turn_server)
+        req = stun.StunMessage(method=method, cls=stun.REQUEST)
+        for a, v in attrs:
+            req.add(a, v)
+        if self._turn_nonce:
+            req.add(stun.ATTR_USERNAME, self.turn_username.encode())
+            req.add(stun.ATTR_REALM, self._turn_realm.encode())
+            req.add(stun.ATTR_NONCE, self._turn_nonce)
+            return await self._request(req, addr, kind, integrity_key=self._turn_key)
+        return await self._request(req, addr, kind)
+
+    async def _gather_relay(self) -> None:
+        transport_udp = struct.pack("!BBH", 17, 0, 0)
+        attrs = [(stun.ATTR_REQUESTED_TRANSPORT, transport_udp)]
+        resp = await self._turn_request(stun.ALLOCATE, attrs, "allocate")
+        if resp.cls == stun.ERROR_RESPONSE:
+            err = stun.error_code(resp)
+            if err and err[0] == 401 and not self._turn_nonce:
+                self._turn_realm = (resp.get(stun.ATTR_REALM) or b"").decode()
+                self._turn_nonce = resp.get(stun.ATTR_NONCE) or b""
+                self._turn_key = stun.long_term_key(
+                    self.turn_username, self._turn_realm, self.turn_password
+                )
+                resp = await self._turn_request(stun.ALLOCATE, attrs, "allocate")
+            if resp.cls == stun.ERROR_RESPONSE:
+                raise stun.StunError(f"TURN allocate failed: {stun.error_code(resp)}")
+        xra = resp.get(stun.ATTR_XOR_RELAYED_ADDRESS)
+        if xra is None:
+            raise stun.StunError("TURN allocate: no relayed address")
+        ip, port = stun.unxor_address(xra, resp.txid)
+        self._relay_addr = (ip, port)
+        self._turn_last_refresh = time.monotonic()
+        base = self.local_candidates[0]
+        self.local_candidates.append(Candidate(
+            foundation="relay1", component=1,
+            priority=candidate_priority("relay"),
+            ip=ip, port=port, typ="relay", raddr=base.ip, rport=base.port,
+        ))
+
+    # RFC 5766: permissions live 300 s, allocations default 600 s —
+    # refresh well inside both or relayed sessions freeze mid-stream
+    TURN_PERM_REFRESH = 180.0
+    TURN_ALLOC_REFRESH = 240.0
+
+    async def _turn_permit(self, peer_ip: str, force: bool = False) -> None:
+        now = time.monotonic()
+        if self._relay_addr is None:
+            return
+        if not force and now - self._turn_perms.get(peer_ip, -1e9) < self.TURN_PERM_REFRESH:
+            return
+        self._turn_perms[peer_ip] = now
+        try:
+            await self._turn_request(
+                stun.CREATE_PERMISSION,
+                [(stun.ATTR_XOR_PEER_ADDRESS,
+                  stun.xor_address((peer_ip, 0), b"\x00" * 12))],
+                "permission",
+            )
+        except (asyncio.TimeoutError, stun.StunError) as exc:
+            logger.warning("TURN permission for %s failed: %s", peer_ip, exc)
+            self._turn_perms.pop(peer_ip, None)
+
+    async def _turn_refresh(self) -> None:
+        try:
+            await self._turn_request(
+                stun.REFRESH, [(stun.ATTR_LIFETIME, struct.pack("!I", 600))],
+                "refresh",
+            )
+        except (asyncio.TimeoutError, stun.StunError) as exc:
+            logger.warning("TURN refresh failed: %s", exc)
+
+    def _turn_send(self, data: bytes, peer: tuple[str, int]) -> None:
+        ind = stun.StunMessage(method=stun.SEND, cls=stun.INDICATION)
+        ind.add(stun.ATTR_XOR_PEER_ADDRESS, stun.xor_address(peer, ind.txid))
+        ind.add(stun.ATTR_DATA, data)
+        self._transport.sendto(ind.serialize(fingerprint=False),
+                               self._turn_addr_cache)
+
+    # -- checks -------------------------------------------------------
+
+    def set_remote(self, ufrag: str, pwd: str) -> None:
+        self.remote_ufrag = ufrag
+        self.remote_pwd = pwd
+        if self._check_task is None:
+            self._check_task = self._loop.create_task(self._check_loop())
+
+    def add_remote_candidate(self, cand: Candidate | str) -> None:
+        if isinstance(cand, str):
+            try:
+                cand = Candidate.from_sdp(cand)
+            except ValueError as exc:
+                logger.debug("ignoring candidate: %s", exc)
+                return
+        if cand.component != 1:
+            return  # BUNDLE: single component
+        if any(p.remote.ip == cand.ip and p.remote.port == cand.port
+               for p in self._pairs):
+            return
+        self._pairs.append(_CheckPair(remote=cand))
+        if self._relay_addr is not None:
+            self._pairs.append(_CheckPair(remote=cand, relayed=True))
+
+    async def _check_loop(self) -> None:
+        if self.turn_server:
+            try:
+                self._turn_addr_cache = await self._resolve(self.turn_server)
+            except OSError:
+                self._turn_addr_cache = None
+        while not self._closed:
+            now = time.monotonic()
+            for pair in list(self._pairs):
+                if pair.state in ("succeeded", "failed"):
+                    continue
+                if now - pair.last_tx < 0.5:
+                    continue
+                await self._send_check(pair)
+            # keepalive on the selected pair
+            sel = self._selected
+            if sel is not None and now - sel.last_tx > 5.0:
+                await self._send_check(sel)
+            # keep the TURN allocation + the active peer's permission alive
+            if self._relay_addr is not None:
+                if now - self._turn_last_refresh > self.TURN_ALLOC_REFRESH:
+                    self._turn_last_refresh = now
+                    await self._turn_refresh()
+                if sel is not None and sel.relayed:
+                    await self._turn_permit(sel.remote.ip)
+            await asyncio.sleep(0.05 if self._selected is None else 1.0)
+
+    async def _send_check(self, pair: _CheckPair) -> None:
+        if pair.relayed:
+            await self._turn_permit(pair.remote.ip)
+        # drop the previous outstanding check for this pair: without this
+        # an unreachable candidate leaks a _pending entry per attempt
+        self._pending.pop(pair.txid, None)
+        pair.attempts += 1
+        if pair.attempts > MAX_CHECK_ATTEMPTS and pair is not self._selected:
+            pair.state = "failed"
+            return
+        req = stun.StunMessage(method=stun.BINDING, cls=stun.REQUEST)
+        req.add(stun.ATTR_USERNAME,
+                f"{self.remote_ufrag}:{self.local_ufrag}".encode())
+        req.add(stun.ATTR_ICE_CONTROLLING, self.tiebreaker)
+        req.add(stun.ATTR_USE_CANDIDATE, b"")  # aggressive nomination
+        req.add(stun.ATTR_PRIORITY,
+                struct.pack("!I", candidate_priority("prflx")))
+        pair.txid = req.txid
+        pair.state = "inprogress"
+        pair.last_tx = time.monotonic()
+        self._pending[req.txid] = ("check", pair)
+        wire = req.serialize(integrity_key=self.remote_pwd.encode())
+        self._send_raw(wire, pair)
+
+    def _send_raw(self, data: bytes, pair: _CheckPair) -> None:
+        if pair.relayed and self._turn_addr_cache:
+            self._turn_send(data, (pair.remote.ip, pair.remote.port))
+        else:
+            self._transport.sendto(data, (pair.remote.ip, pair.remote.port))
+
+    # -- inbound ------------------------------------------------------
+
+    def _on_datagram(self, data: bytes, addr: tuple[str, int]) -> None:
+        if stun.is_stun(data):
+            try:
+                msg = stun.StunMessage.parse(data)
+            except stun.StunError:
+                return
+            self._on_stun(msg, data, addr)
+            return
+        self.on_data(data)
+
+    def _on_stun(self, msg: stun.StunMessage, wire: bytes,
+                 addr: tuple[str, int]) -> None:
+        if msg.cls in (stun.RESPONSE, stun.ERROR_RESPONSE):
+            pending = self._pending.get(msg.txid)
+            if pending is None:
+                return
+            kind, extra = pending
+            if kind == "check":
+                self._pending.pop(msg.txid, None)
+                self._on_check_response(msg, extra)
+            else:
+                fut = extra
+                if not fut.done():
+                    fut.set_result(msg)
+            return
+        if msg.method == stun.DATA and msg.cls == stun.INDICATION:
+            inner = msg.get(stun.ATTR_DATA)
+            if inner is not None:
+                if stun.is_stun(inner):
+                    try:
+                        self._on_stun(stun.StunMessage.parse(inner), inner, addr)
+                    except stun.StunError:
+                        pass
+                else:
+                    self.on_data(inner)
+            return
+        if msg.method == stun.BINDING and msg.cls == stun.REQUEST:
+            self._on_binding_request(msg, wire, addr)
+
+    def _on_binding_request(self, msg: stun.StunMessage, wire: bytes,
+                            addr: tuple[str, int]) -> None:
+        if not msg.check_integrity(self.local_pwd.encode(), wire):
+            resp = stun.StunMessage(method=stun.BINDING,
+                                    cls=stun.ERROR_RESPONSE, txid=msg.txid)
+            resp.add(stun.ATTR_ERROR_CODE, stun.make_error(401, "Unauthorized"))
+            self._transport.sendto(resp.serialize(), addr)
+            return
+        resp = stun.StunMessage(method=stun.BINDING, cls=stun.RESPONSE,
+                                txid=msg.txid)
+        resp.add(stun.ATTR_XOR_MAPPED_ADDRESS, stun.xor_address(addr, msg.txid))
+        self._transport.sendto(
+            resp.serialize(integrity_key=self.local_pwd.encode()), addr
+        )
+        # peer-reflexive discovery: learn pairs we were never told about
+        if not any(p.remote.ip == addr[0] and p.remote.port == addr[1]
+                   for p in self._pairs):
+            self._pairs.append(_CheckPair(remote=Candidate(
+                foundation="prflx", component=1,
+                priority=candidate_priority("prflx"),
+                ip=addr[0], port=addr[1], typ="prflx",
+            )))
+
+    @staticmethod
+    def _pair_rank(pair: _CheckPair) -> tuple:
+        # direct beats relayed regardless of remote candidate priority
+        return (not pair.relayed, pair.remote.priority)
+
+    def _on_check_response(self, msg: stun.StunMessage, pair: _CheckPair) -> None:
+        if msg.cls == stun.ERROR_RESPONSE:
+            err = stun.error_code(msg)
+            logger.debug("check failed: %s", err)
+            pair.state = "failed" if not (err and err[0] == 487) else "waiting"
+            return
+        pair.state = "succeeded"
+        pair.nominated = True
+        pair.attempts = 0
+        if self._selected is None or self._pair_rank(pair) > self._pair_rank(self._selected):
+            logger.info("ICE %s via %s:%d (%s%s)",
+                        "connected" if self._selected is None else "path upgraded",
+                        pair.remote.ip, pair.remote.port, pair.remote.typ,
+                        " relayed" if pair.relayed else "")
+            self._selected = pair
+            self._connected.set()
+
+    # -- data plane ---------------------------------------------------
+
+    async def wait_connected(self, timeout: float = 30.0) -> None:
+        await asyncio.wait_for(self._connected.wait(), timeout)
+
+    @property
+    def connected(self) -> bool:
+        return self._connected.is_set()
+
+    def send(self, data: bytes) -> None:
+        sel = self._selected
+        if sel is None:
+            raise ConnectionError("ICE not connected")
+        self._send_raw(data, sel)
+
+    def close(self) -> None:
+        self._closed = True
+        if self._check_task is not None:
+            self._check_task.cancel()
+        if self._transport is not None:
+            self._transport.close()

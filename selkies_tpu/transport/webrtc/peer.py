@@ -1,0 +1,308 @@
+"""PeerConnection: ICE + DTLS-SRTP + RTP/RTCP + SCTP datachannels.
+
+The framework's counterpart of the reference's webrtcbin wiring
+(gstwebrtc_app.py:149-196 build, :1581-1636 offer flow): the server
+creates the offer, the browser answers active (so DTLS runs in server
+role here), media flows sendonly over SRTP, input/control rides DCEP
+data channels, and RTCP feedback drives the same knobs the framework
+already exposes (force_keyframe, GCC bitrate, NACK retransmit buffer).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import secrets
+import struct
+import time
+
+from selkies_tpu.transport.rtp import H264Payloader, OpusPayloader, RtpPacket
+from selkies_tpu.transport.webrtc import rtcp, sdp
+from selkies_tpu.transport.webrtc.dtls import DtlsEndpoint, is_dtls, make_certificate
+from selkies_tpu.transport.webrtc.ice import IceAgent
+from selkies_tpu.transport.webrtc.sctp import SctpAssociation
+from selkies_tpu.transport.webrtc.srtp import SrtpError, SrtpSession, session_pair
+
+logger = logging.getLogger("transport.webrtc.peer")
+
+RTX_BUFFER = 512  # packets kept for NACK retransmission (~1.7 s at 300 pps)
+
+
+class PeerConnection:
+    """One browser session's transport. Lifecycle:
+
+        pc = PeerConnection(...)
+        offer = await pc.create_offer()        # gathers ICE
+        ... signalling: send offer, receive answer + trickle candidates
+        await pc.set_answer(answer_sdp)
+        pc.add_remote_candidate(line)
+        await pc.wait_connected()              # ICE + DTLS + SRTP ready
+        pc.send_video(au_bytes, ts_ms); pc.send_audio(opus, ts)
+    """
+
+    def __init__(self, *, codec: str = "h264", audio: bool = True,
+                 stun_server=None, turn_server=None,
+                 turn_username: str = "", turn_password: str = "",
+                 loop: asyncio.AbstractEventLoop | None = None):
+        self.codec = codec
+        self.audio = audio
+        self._loop = loop or asyncio.get_event_loop()
+        self.ice = IceAgent(stun_server=stun_server, turn_server=turn_server,
+                            turn_username=turn_username,
+                            turn_password=turn_password, loop=self._loop)
+        self.ice.on_data = self._on_transport_data
+        self.cert_der, self.key_der, self.fingerprint = make_certificate()
+        self.dtls: DtlsEndpoint | None = None
+        self.srtp: SrtpSession | None = None
+        self.sctp: SctpAssociation | None = None
+        self.video_ssrc = struct.unpack("!I", secrets.token_bytes(4))[0] | 1
+        self.audio_ssrc = (self.video_ssrc + 1) & 0xFFFFFFFF
+        self.video_pay = H264Payloader(
+            payload_type=sdp.VIDEO_PT, ssrc=self.video_ssrc)
+        self.audio_pay = OpusPayloader(
+            payload_type=sdp.AUDIO_PT, ssrc=self.audio_ssrc)
+        self._remote: sdp.RemoteDescription | None = None
+        self._connected = asyncio.Event()
+        self._closed = False
+        # TWCC send state
+        self._twcc_seq = 0
+        self._twcc_id = sdp.TWCC_EXT_ID
+        # NACK retransmit ring
+        self._rtx: dict[int, bytes] = {}
+        # RTCP sender stats
+        self._vid_packets = 0
+        self._vid_octets = 0
+        self._aud_packets = 0
+        self._aud_octets = 0
+        self._last_video_ts = 0
+        self._tick_task: asyncio.Task | None = None
+        # control surface callbacks
+        self.on_force_keyframe = lambda: None
+        self.on_packet_sent = lambda seq, send_ms, size: None   # GCC
+        self.on_packet_acked = lambda seq, recv_ms: None        # GCC
+        self.on_loss = lambda fraction: None                    # GCC
+        self.on_datachannel = lambda ch: None
+        self.on_datachannel_message = lambda ch, data, binary: None
+        self.on_connected = lambda: None
+        self.on_closed = lambda: None
+
+    # -- negotiation --------------------------------------------------
+
+    async def create_offer(self) -> str:
+        await self.ice.gather()
+        return sdp.build_offer(
+            ice_ufrag=self.ice.local_ufrag, ice_pwd=self.ice.local_pwd,
+            fingerprint=self.fingerprint, video_ssrc=self.video_ssrc,
+            audio_ssrc=self.audio_ssrc, codec=self.codec, audio=self.audio,
+        )
+
+    async def set_answer(self, answer_sdp: str) -> None:
+        r = sdp.parse_answer(answer_sdp)
+        self._remote = r
+        if r.twcc_id is not None:
+            self._twcc_id = r.twcc_id
+        # browser answers a=setup:active -> we are the DTLS server
+        dtls_server = r.setup != "passive"
+        self.dtls = DtlsEndpoint(
+            is_server=dtls_server, cert_der=self.cert_der,
+            key_der=self.key_der, peer_fingerprint=r.fingerprint or None,
+        )
+        self.sctp = SctpAssociation(is_client=not dtls_server,
+                                    port=r.sctp_port)
+        self.sctp.on_channel_open = lambda ch: self.on_datachannel(ch)
+        self.sctp.on_message = (
+            lambda ch, d, b: self.on_datachannel_message(ch, d, b))
+        self.ice.set_remote(r.ice_ufrag, r.ice_pwd)
+        for cand in r.candidates:
+            self.ice.add_remote_candidate(cand)
+        self._tick_task = self._loop.create_task(self._tick_loop())
+
+    def add_remote_candidate(self, candidate: str) -> None:
+        if candidate.strip():
+            self.ice.add_remote_candidate(candidate)
+
+    async def wait_connected(self, timeout: float = 30.0) -> None:
+        await asyncio.wait_for(self._connected.wait(), timeout)
+
+    @property
+    def connected(self) -> bool:
+        return self._connected.is_set()
+
+    # -- transport demux ---------------------------------------------
+
+    def _on_transport_data(self, data: bytes) -> None:
+        if is_dtls(data):
+            self._on_dtls_datagram(data)
+        elif len(data) >= 2 and data[0] >> 6 == 2:
+            if rtcp.is_rtcp(data):
+                self._on_srtcp(data)
+            # inbound SRTP media is not expected (sendonly)
+
+    def _on_dtls_datagram(self, data: bytes) -> None:
+        d = self.dtls
+        if d is None:
+            return
+        d.put_datagram(data)
+        try:
+            if not d.handshake_complete:
+                if d.handshake_step():
+                    self._on_dtls_established()
+            if d.handshake_complete:
+                for msg in d.recv():
+                    if self.sctp is not None:
+                        self.sctp.put_packet(msg)
+                self._flush_sctp()
+        except Exception as exc:
+            logger.error("DTLS failure: %s", exc)
+            self.close()
+            return
+        self._flush_dtls()
+
+    def _flush_dtls(self) -> None:
+        d = self.dtls
+        if d is None or not self.ice.connected:
+            return
+        for dg in d.take_datagrams():
+            self.ice.send(dg)
+
+    def _flush_sctp(self) -> None:
+        s, d = self.sctp, self.dtls
+        if s is None or d is None or not d.handshake_complete:
+            return
+        for pkt in s.take_packets():
+            d.send(pkt)
+        self._flush_dtls()
+
+    def _on_dtls_established(self) -> None:
+        keys = self.dtls.srtp_keys
+        self.srtp = session_pair(keys, dtls_is_client=not self.dtls.is_server)
+        if self.sctp is not None and self.sctp.is_client:
+            self.sctp.connect()
+            self._flush_sctp()
+        logger.info("DTLS-SRTP established (fingerprint verified)")
+        self._connected.set()
+        self.on_connected()
+
+    # -- RTCP in ------------------------------------------------------
+
+    def _on_srtcp(self, data: bytes) -> None:
+        if self.srtp is None:
+            return
+        try:
+            plain = self.srtp.unprotect_rtcp(data)
+        except SrtpError as exc:
+            logger.debug("SRTCP drop: %s", exc)
+            return
+        fb = rtcp.parse_compound(plain)
+        if fb.pli_ssrcs or fb.fir_ssrcs:
+            self.on_force_keyframe()
+        for blk in fb.reports:
+            if blk.ssrc == self.video_ssrc and blk.fraction_lost > 0:
+                self.on_loss(blk.fraction_lost)
+        if fb.twcc and fb.twcc_ref_time_ms is not None:
+            t = fb.twcc_ref_time_ms
+            for pkt in fb.twcc:
+                if pkt.recv_delta_ms is not None:
+                    t += pkt.recv_delta_ms
+                    self.on_packet_acked(pkt.seq, t)
+        for seq in fb.nacks:
+            wire = self._rtx.get(seq)
+            if wire is not None and self.srtp is not None:
+                # plain retransmission (no rtx ssrc): re-protect fails the
+                # SRTP replay rules on some stacks, so resend the original
+                # protected packet bytes
+                try:
+                    self.ice.send(wire)
+                except ConnectionError:
+                    pass
+        if fb.bye:
+            logger.info("peer sent RTCP BYE")
+            self.close()
+
+    # -- media out ----------------------------------------------------
+
+    def _send_rtp(self, pkt: RtpPacket, *, audio_stream: bool) -> None:
+        if self.srtp is None or not self.ice.connected:
+            return
+        self._twcc_seq = (self._twcc_seq + 1) & 0xFFFF
+        pkt.extensions = [(self._twcc_id, struct.pack("!H", self._twcc_seq))]
+        wire = pkt.serialize()
+        protected = self.srtp.protect(wire)
+        self.ice.send(protected)
+        now_ms = time.monotonic() * 1e3
+        self.on_packet_sent(self._twcc_seq, now_ms, len(protected))
+        if audio_stream:
+            self._aud_packets += 1
+            self._aud_octets += len(pkt.payload)
+        else:
+            self._vid_packets += 1
+            self._vid_octets += len(pkt.payload)
+            self._rtx[pkt.sequence & 0xFFFF] = protected
+            while len(self._rtx) > RTX_BUFFER:
+                # dicts iterate in insertion order == send order, which
+                # stays correct across the 16-bit sequence wrap
+                del self._rtx[next(iter(self._rtx))]
+
+    def send_video(self, au: bytes, timestamp_ms: float) -> None:
+        ts = int(timestamp_ms * 90) & 0xFFFFFFFF
+        self._last_video_ts = ts
+        for pkt in self.video_pay.payload_au(au, ts):
+            self._send_rtp(pkt, audio_stream=False)
+
+    def send_audio(self, opus_packet: bytes, timestamp_48k: int) -> None:
+        pkt = self.audio_pay.payload_packet(opus_packet, timestamp_48k)
+        self._send_rtp(pkt, audio_stream=True)
+
+    # -- datachannels -------------------------------------------------
+
+    def open_datachannel(self, label: str, protocol: str = ""):
+        if self.sctp is None:
+            raise ConnectionError("no SCTP association yet")
+        ch = self.sctp.open_channel(label, protocol)
+        self._flush_sctp()
+        return ch
+
+    def send_datachannel(self, ch, data: bytes, binary: bool = False) -> None:
+        if self.sctp is None:
+            return
+        self.sctp.send(ch, data, binary)
+        self._flush_sctp()
+
+    # -- housekeeping -------------------------------------------------
+
+    async def _tick_loop(self) -> None:
+        last_sr = 0.0
+        while not self._closed:
+            await asyncio.sleep(0.2)
+            if self.sctp is not None:
+                self.sctp.tick()
+                self._flush_sctp()
+            if self.dtls is not None and not self.dtls.handshake_complete:
+                self.dtls.handle_timeout()
+                self._flush_dtls()
+            now = time.monotonic()
+            if self.srtp is not None and now - last_sr > 2.0 and self.ice.connected:
+                last_sr = now
+                sr = rtcp.build_sender_report(
+                    self.video_ssrc, self._last_video_ts,
+                    self._vid_packets, self._vid_octets,
+                ) + rtcp.build_sdes(self.video_ssrc)
+                try:
+                    self.ice.send(self.srtp.protect_rtcp(sr))
+                except (ConnectionError, SrtpError):
+                    pass
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._tick_task is not None:
+            self._tick_task.cancel()
+        if self.sctp is not None:
+            self.sctp.shutdown()
+            self._flush_sctp()
+        if self.dtls is not None:
+            self.dtls.close()
+            self._flush_dtls()
+        self.ice.close()
+        self.on_closed()

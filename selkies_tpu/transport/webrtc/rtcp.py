@@ -1,0 +1,169 @@
+"""RTCP: sender reports out, feedback (RR / PLI / FIR / NACK / TWCC) in.
+
+The reference consumes these inside webrtcbin; here the parsed feedback
+drives the same control surfaces the framework already has: PLI/FIR ->
+encoder.force_keyframe, RR loss -> GccController.on_loss_report, TWCC
+feedback (draft-holmer-rmcat-transport-wide-cc-extensions-01) ->
+GccController per-packet ack stream, NACK -> the RTP retransmit buffer.
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+from dataclasses import dataclass, field
+
+RTCP_SR = 200
+RTCP_RR = 201
+RTCP_SDES = 202
+RTCP_BYE = 203
+RTCP_RTPFB = 205   # transport-layer feedback: NACK(1), TWCC(15)
+RTCP_PSFB = 206    # payload-specific: PLI(1), FIR(4)
+
+NTP_EPOCH = 2208988800  # 1900 -> 1970
+
+
+def is_rtcp(data: bytes) -> bool:
+    """RFC 5761 demux: RTCP packet types 192-223 in the second byte."""
+    return len(data) >= 8 and data[0] >> 6 == 2 and 192 <= data[1] <= 223
+
+
+@dataclass
+class ReportBlock:
+    ssrc: int
+    fraction_lost: float
+    packets_lost: int
+    highest_seq: int
+    jitter: int
+
+
+@dataclass
+class TwccPacket:
+    seq: int                # transport-wide sequence number
+    recv_delta_ms: float | None  # None = not received
+
+
+@dataclass
+class Feedback:
+    pli_ssrcs: list[int] = field(default_factory=list)
+    fir_ssrcs: list[int] = field(default_factory=list)
+    nacks: list[int] = field(default_factory=list)  # lost RTP seqs
+    reports: list[ReportBlock] = field(default_factory=list)
+    twcc: list[TwccPacket] = field(default_factory=list)
+    twcc_ref_time_ms: float | None = None
+    bye: bool = False
+
+
+def parse_compound(data: bytes) -> Feedback:
+    fb = Feedback()
+    off = 0
+    while off + 4 <= len(data):
+        b0, pt, length = struct.unpack_from("!BBH", data, off)
+        if b0 >> 6 != 2:
+            break
+        size = 4 * (length + 1)
+        if off + size > len(data):
+            break
+        body = data[off + 4 : off + size]
+        fmt = b0 & 0x1F
+        if pt == RTCP_RR:
+            _parse_rr(body, fmt, fb)
+        elif pt == RTCP_SR and len(body) >= 24:
+            # skip sender info (20 bytes past the reporter ssrc) so
+            # _parse_rr's own 4-byte ssrc skip lands on the blocks
+            _parse_rr(body[20:], fmt, fb)
+        elif pt == RTCP_PSFB and fmt == 1 and len(body) >= 8:
+            fb.pli_ssrcs.append(struct.unpack_from("!I", body, 4)[0])
+        elif pt == RTCP_PSFB and fmt == 4 and len(body) >= 8:
+            fb.fir_ssrcs.append(struct.unpack_from("!I", body, 4)[0])
+        elif pt == RTCP_RTPFB and fmt == 1:
+            _parse_nack(body, fb)
+        elif pt == RTCP_RTPFB and fmt == 15:
+            _parse_twcc(body, fb)
+        elif pt == RTCP_BYE:
+            fb.bye = True
+        off += size
+    return fb
+
+
+def _parse_rr(body: bytes, count: int, fb: Feedback) -> None:
+    off = 4  # skip reporter ssrc
+    for _ in range(count):
+        if off + 24 > len(body):
+            return
+        ssrc, fl_cl, ehsn, jitter = struct.unpack_from("!IIII", body, off)
+        fb.reports.append(ReportBlock(
+            ssrc=ssrc,
+            fraction_lost=(fl_cl >> 24) / 256.0,
+            packets_lost=fl_cl & 0xFFFFFF,
+            highest_seq=ehsn,
+            jitter=jitter,
+        ))
+        off += 24
+
+
+def _parse_nack(body: bytes, fb: Feedback) -> None:
+    off = 8  # sender ssrc + media ssrc
+    while off + 4 <= len(body):
+        pid, blp = struct.unpack_from("!HH", body, off)
+        fb.nacks.append(pid)
+        for bit in range(16):
+            if blp & (1 << bit):
+                fb.nacks.append((pid + bit + 1) & 0xFFFF)
+        off += 4
+
+
+def _parse_twcc(body: bytes, fb: Feedback) -> None:
+    """draft-holmer-rmcat-transport-wide-cc-extensions-01 §3.1."""
+    if len(body) < 16:
+        return
+    base_seq, status_count = struct.unpack_from("!HH", body, 8)
+    ref_time = int.from_bytes(body[12:15], "big", signed=True)
+    fb.twcc_ref_time_ms = ref_time * 64.0
+    off = 16
+    statuses: list[int] = []
+    while len(statuses) < status_count and off + 2 <= len(body):
+        chunk = struct.unpack_from("!H", body, off)[0]
+        off += 2
+        if chunk >> 15 == 0:  # run length
+            sym = (chunk >> 13) & 0x3
+            run = chunk & 0x1FFF
+            statuses.extend([sym] * run)
+        else:  # status vector
+            if chunk >> 14 & 1:  # two-bit symbols
+                for i in range(7):
+                    statuses.append((chunk >> (12 - 2 * i)) & 0x3)
+            else:  # one-bit symbols
+                for i in range(14):
+                    statuses.append(1 if chunk & (1 << (13 - i)) else 0)
+    statuses = statuses[:status_count]
+    for i, sym in enumerate(statuses):
+        seq = (base_seq + i) & 0xFFFF
+        if sym in (1, 2):  # received (small / large delta)
+            if sym == 1 and off + 1 <= len(body):
+                delta = body[off] * 0.25
+                off += 1
+            elif sym == 2 and off + 2 <= len(body):
+                delta = struct.unpack_from("!h", body, off)[0] * 0.25
+                off += 2
+            else:
+                break
+            fb.twcc.append(TwccPacket(seq=seq, recv_delta_ms=delta))
+        else:
+            fb.twcc.append(TwccPacket(seq=seq, recv_delta_ms=None))
+
+
+def build_sender_report(ssrc: int, rtp_ts: int, packets: int, octets: int,
+                        now: float | None = None) -> bytes:
+    now = time.time() if now is None else now
+    ntp = int((now + NTP_EPOCH) * (1 << 32))
+    body = struct.pack("!IIIIII", ssrc, (ntp >> 32) & 0xFFFFFFFF,
+                       ntp & 0xFFFFFFFF, rtp_ts & 0xFFFFFFFF, packets, octets)
+    return struct.pack("!BBH", 0x80, RTCP_SR, len(body) // 4) + body
+
+
+def build_sdes(ssrc: int, cname: str = "selkies-tpu") -> bytes:
+    item = struct.pack("!BB", 1, len(cname)) + cname.encode()
+    chunk = struct.pack("!I", ssrc) + item + b"\x00"
+    chunk += b"\x00" * ((4 - len(chunk) % 4) % 4)
+    return struct.pack("!BBH", 0x81, RTCP_SDES, len(chunk) // 4) + chunk

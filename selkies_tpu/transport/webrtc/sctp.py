@@ -1,0 +1,362 @@
+"""Minimal SCTP over DTLS + DCEP data channels (RFC 9260 subset, RFC 8831/8832).
+
+The reference's datachannel is webrtcbin's usrsctp. WebRTC input/control
+traffic is tiny (KB/s), so this implementation keeps the full protocol
+machine small: reliable ordered delivery, immediate SACKs, fragmentation,
+a single fixed RTO retransmit timer, HEARTBEAT echo, and the DCEP
+open/ack handshake. No congestion control beyond stop-when-unacked-grows
+(input traffic never approaches the default a_rwnd).
+
+Sans-IO: `put_packet` feeds an SCTP packet (one DTLS application
+datagram), `take_packets` drains what must be sent, `tick` drives
+retransmission. The peer.py layer shuttles these through DtlsEndpoint.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import secrets
+import struct
+import time
+from dataclasses import dataclass, field
+
+logger = logging.getLogger("transport.webrtc.sctp")
+
+# chunk types
+DATA = 0
+INIT = 1
+INIT_ACK = 2
+SACK = 3
+HEARTBEAT = 4
+HEARTBEAT_ACK = 5
+ABORT = 6
+SHUTDOWN = 7
+SHUTDOWN_ACK = 8
+ERROR = 9
+COOKIE_ECHO = 10
+COOKIE_ACK = 11
+SHUTDOWN_COMPLETE = 14
+
+# DCEP (RFC 8832)
+PPID_DCEP = 50
+PPID_STRING = 51
+PPID_BINARY = 53
+PPID_STRING_EMPTY = 56
+PPID_BINARY_EMPTY = 57
+DCEP_OPEN = 0x03
+DCEP_ACK = 0x02
+DC_RELIABLE = 0x00
+
+MTU = 1150  # fits one DTLS record under typical 1200-byte path MTU
+DEFAULT_RWND = 1024 * 1024
+RTO = 1.0
+MAX_RETRANS = 10
+
+
+# -- CRC32c (Castagnoli), reflected, as SCTP requires -----------------
+
+_CRC32C_TABLE = []
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_c >> 1) ^ 0x82F63B78 if _c & 1 else _c >> 1
+    _CRC32C_TABLE.append(_c)
+
+
+def crc32c(data: bytes) -> int:
+    c = 0xFFFFFFFF
+    for b in data:
+        c = (c >> 8) ^ _CRC32C_TABLE[(c ^ b) & 0xFF]
+    return c ^ 0xFFFFFFFF
+
+
+def _pad(b: bytes) -> bytes:
+    return b + b"\x00" * ((4 - len(b) % 4) % 4)
+
+
+def _chunk(ctype: int, flags: int, value: bytes) -> bytes:
+    return struct.pack("!BBH", ctype, flags, 4 + len(value)) + _pad(value)
+
+
+def _tsn_gt(a: int, b: int) -> bool:
+    return ((a - b) & 0xFFFFFFFF) < 0x80000000 and a != b
+
+
+@dataclass
+class Channel:
+    stream_id: int
+    label: str
+    protocol: str = ""
+    open: bool = False
+
+
+@dataclass
+class _OutChunk:
+    tsn: int
+    data: bytes  # the full DATA chunk bytes
+    sent_at: float = 0.0
+    retrans: int = 0
+
+
+class SctpAssociation:
+    """One SCTP association multiplexing DCEP data channels.
+
+    `is_client` mirrors the DTLS role (RFC 8832: the DTLS client uses
+    even stream ids and usually initiates the association; the server
+    side here also supports initiating, for server-created channels)."""
+
+    def __init__(self, *, is_client: bool, port: int = 5000):
+        self.is_client = is_client
+        self.port = port
+        self.local_vtag = struct.unpack("!I", os.urandom(4))[0] or 1
+        self.remote_vtag = 0
+        self.local_tsn = struct.unpack("!I", os.urandom(4))[0]
+        self.remote_tsn_seen: int | None = None  # cumulative
+        self.established = False
+        self.on_channel_open = lambda ch: None
+        self.on_message = lambda ch, data, binary: None
+        self.channels: dict[int, Channel] = {}
+        self._out: list[bytes] = []  # packets ready to send
+        self._unacked: list[_OutChunk] = []
+        self._ssn: dict[int, int] = {}
+        self._next_sid = 0 if is_client else 1
+        self._reasm: dict[int, list[tuple[int, int, bytes, int]]] = {}
+        self._rx_out_of_order: dict[int, bytes] = {}  # tsn -> chunk value
+        self._cookie = b""
+        self._pending_open: list[Channel] = []
+        self._shutdown = False
+
+    # -- packet framing ----------------------------------------------
+
+    def _emit(self, *chunks: bytes, vtag: int | None = None) -> None:
+        hdr = struct.pack("!HHII", self.port, self.port,
+                          self.remote_vtag if vtag is None else vtag, 0)
+        pkt = bytearray(hdr + b"".join(chunks))
+        struct.pack_into("<I", pkt, 8, crc32c(bytes(pkt[:8]) + b"\x00" * 4 + bytes(pkt[12:])))
+        self._out.append(bytes(pkt))
+
+    def take_packets(self) -> list[bytes]:
+        out, self._out = self._out, []
+        return out
+
+    # -- association setup -------------------------------------------
+
+    def connect(self) -> None:
+        """Initiate the association (INIT)."""
+        init = struct.pack("!IIHHI", self.local_vtag, DEFAULT_RWND, 1024, 1024,
+                           self.local_tsn)
+        self._emit(_chunk(INIT, 0, init), vtag=0)
+
+    def put_packet(self, pkt: bytes) -> None:
+        if len(pkt) < 12:
+            return
+        body = bytearray(pkt)
+        crc = struct.unpack_from("<I", body, 8)[0]
+        struct.pack_into("!I", body, 8, 0)
+        if crc32c(bytes(body)) != crc:
+            logger.debug("SCTP checksum mismatch")
+            return
+        off = 12
+        while off + 4 <= len(pkt):
+            ctype, flags, length = struct.unpack_from("!BBH", pkt, off)
+            if length < 4 or off + length > len(pkt):
+                break
+            value = pkt[off + 4 : off + length]
+            self._on_chunk(ctype, flags, value)
+            off += length + ((4 - length % 4) % 4)
+
+    def _on_chunk(self, ctype: int, flags: int, value: bytes) -> None:
+        if ctype == INIT and len(value) >= 16:
+            itag, rwnd, os_, is_, itsn = struct.unpack_from("!IIHHI", value, 0)
+            self.remote_vtag = itag
+            self.remote_tsn_seen = (itsn - 1) & 0xFFFFFFFF
+            cookie = secrets.token_bytes(16)
+            self._cookie = cookie
+            ack = struct.pack("!IIHHI", self.local_vtag, DEFAULT_RWND, 1024,
+                              1024, self.local_tsn)
+            ack += struct.pack("!HH", 7, 4 + len(cookie)) + cookie  # STATE-COOKIE
+            self._emit(_chunk(INIT_ACK, 0, ack))
+        elif ctype == INIT_ACK and len(value) >= 16:
+            itag, rwnd, os_, is_, itsn = struct.unpack_from("!IIHHI", value, 0)
+            self.remote_vtag = itag
+            self.remote_tsn_seen = (itsn - 1) & 0xFFFFFFFF
+            cookie = self._find_param(value[16:], 7)
+            self._emit(_chunk(COOKIE_ECHO, 0, cookie or b""))
+            self._establish()
+        elif ctype == COOKIE_ECHO:
+            self._emit(_chunk(COOKIE_ACK, 0, b""))
+            self._establish()
+        elif ctype == COOKIE_ACK:
+            self._establish()
+        elif ctype == DATA:
+            self._on_data(flags, value)
+        elif ctype == SACK and len(value) >= 12:
+            cum = struct.unpack_from("!I", value, 0)[0]
+            self._unacked = [c for c in self._unacked if _tsn_gt(c.tsn, cum)]
+        elif ctype == HEARTBEAT:
+            self._emit(_chunk(HEARTBEAT_ACK, 0, value))
+        elif ctype == ABORT:
+            logger.warning("SCTP association aborted by peer")
+            self.established = False
+        elif ctype == SHUTDOWN:
+            self._emit(_chunk(SHUTDOWN_ACK, 0, b""))
+            self.established = False
+        elif ctype == SHUTDOWN_ACK:
+            self._emit(_chunk(SHUTDOWN_COMPLETE, 0, b""))
+            self.established = False
+
+    @staticmethod
+    def _find_param(params: bytes, ptype: int) -> bytes | None:
+        off = 0
+        while off + 4 <= len(params):
+            t, ln = struct.unpack_from("!HH", params, off)
+            if ln < 4:
+                return None
+            if t == ptype:
+                return params[off + 4 : off + ln]
+            off += ln + ((4 - ln % 4) % 4)
+        return None
+
+    def _establish(self) -> None:
+        if self.established:
+            return
+        self.established = True
+        for ch in self._pending_open:
+            self._send_dcep_open(ch)
+        self._pending_open.clear()
+
+    # -- inbound data -------------------------------------------------
+
+    def _on_data(self, flags: int, value: bytes) -> None:
+        if len(value) < 12:
+            return
+        tsn, sid, ssn, ppid = struct.unpack_from("!IHHI", value, 0)
+        if self.remote_tsn_seen is not None and not _tsn_gt(tsn, self.remote_tsn_seen):
+            self._send_sack()  # duplicate
+            return
+        self._rx_out_of_order[tsn] = (flags, value)
+        # advance the cumulative TSN over any in-order run
+        while self.remote_tsn_seen is not None:
+            nxt = (self.remote_tsn_seen + 1) & 0xFFFFFFFF
+            item = self._rx_out_of_order.pop(nxt, None)
+            if item is None:
+                break
+            self.remote_tsn_seen = nxt
+            self._deliver(*item)
+        self._send_sack()
+
+    def _deliver(self, flags: int, value: bytes) -> None:
+        tsn, sid, ssn, ppid = struct.unpack_from("!IHHI", value, 0)
+        payload = value[12:]
+        frags = self._reasm.setdefault(sid, [])
+        frags.append((flags, ssn, payload, ppid))
+        if not flags & 0x01:  # E bit clear: more fragments coming
+            return
+        # reassemble from the most recent B fragment; an E without any B
+        # is malformed — drop the stream's fragment state, not the session
+        start = next((i for i in range(len(frags) - 1, -1, -1) if frags[i][0] & 0x02), -1)
+        if start < 0:
+            frags.clear()
+            return
+        msg = b"".join(f[2] for f in frags[start:])
+        ppid = frags[start][3]
+        del frags[start:]
+        self._on_message_raw(sid, ppid, msg)
+
+    def _on_message_raw(self, sid: int, ppid: int, msg: bytes) -> None:
+        if ppid == PPID_DCEP:
+            self._on_dcep(sid, msg)
+            return
+        ch = self.channels.get(sid)
+        if ch is None or not ch.open:
+            logger.debug("data on unknown stream %d", sid)
+            return
+        if ppid in (PPID_STRING, PPID_STRING_EMPTY):
+            self.on_message(ch, b"" if ppid == PPID_STRING_EMPTY else msg, False)
+        else:
+            self.on_message(ch, b"" if ppid == PPID_BINARY_EMPTY else msg, True)
+
+    def _on_dcep(self, sid: int, msg: bytes) -> None:
+        if not msg:
+            return
+        if msg[0] == DCEP_OPEN and len(msg) >= 12:
+            _t, _ct, _prio, _rel, llen, plen = struct.unpack_from("!BBHIHH", msg, 0)
+            label = msg[12 : 12 + llen].decode("utf-8", "replace")
+            proto = msg[12 + llen : 12 + llen + plen].decode("utf-8", "replace")
+            ch = Channel(stream_id=sid, label=label, protocol=proto, open=True)
+            self.channels[sid] = ch
+            self._send_data(sid, PPID_DCEP, bytes([DCEP_ACK]))
+            self.on_channel_open(ch)
+        elif msg[0] == DCEP_ACK:
+            ch = self.channels.get(sid)
+            if ch is not None and not ch.open:
+                ch.open = True
+                self.on_channel_open(ch)
+
+    # -- outbound -----------------------------------------------------
+
+    def _send_sack(self) -> None:
+        if self.remote_tsn_seen is None:
+            return
+        gaps = b""  # cumulative-only SACK; missing chunks get retransmitted
+        sack = struct.pack("!IIHH", self.remote_tsn_seen, DEFAULT_RWND, 0, 0) + gaps
+        self._emit(_chunk(SACK, 0, sack))
+
+    def open_channel(self, label: str, protocol: str = "") -> Channel:
+        sid = self._next_sid
+        self._next_sid += 2
+        ch = Channel(stream_id=sid, label=label, protocol=protocol)
+        self.channels[sid] = ch
+        if self.established:
+            self._send_dcep_open(ch)
+        else:
+            self._pending_open.append(ch)
+        return ch
+
+    def _send_dcep_open(self, ch: Channel) -> None:
+        label = ch.label.encode()
+        proto = ch.protocol.encode()
+        msg = struct.pack("!BBHIHH", DCEP_OPEN, DC_RELIABLE, 0, 0,
+                          len(label), len(proto)) + label + proto
+        self._send_data(ch.stream_id, PPID_DCEP, msg)
+
+    def send(self, ch: Channel, data: bytes, binary: bool = False) -> None:
+        if binary:
+            ppid = PPID_BINARY_EMPTY if not data else PPID_BINARY
+        else:
+            ppid = PPID_STRING_EMPTY if not data else PPID_STRING
+        self._send_data(ch.stream_id, ppid, data or b"\x00")
+
+    def _send_data(self, sid: int, ppid: int, msg: bytes) -> None:
+        ssn = self._ssn.get(sid, 0)
+        self._ssn[sid] = (ssn + 1) & 0xFFFF
+        frags = [msg[i : i + MTU] for i in range(0, len(msg), MTU)] or [b""]
+        for i, frag in enumerate(frags):
+            flags = (0x02 if i == 0 else 0) | (0x01 if i == len(frags) - 1 else 0)
+            tsn = self.local_tsn
+            self.local_tsn = (self.local_tsn + 1) & 0xFFFFFFFF
+            value = struct.pack("!IHHI", tsn, sid, ssn, ppid) + frag
+            chunk = _chunk(DATA, flags, value)
+            oc = _OutChunk(tsn=tsn, data=chunk, sent_at=time.monotonic())
+            self._unacked.append(oc)
+            self._emit(chunk)
+
+    def tick(self) -> None:
+        """Retransmit timed-out DATA chunks (call ~every 200 ms)."""
+        now = time.monotonic()
+        for oc in self._unacked:
+            if now - oc.sent_at >= RTO:
+                if oc.retrans >= MAX_RETRANS:
+                    logger.warning("SCTP giving up on tsn %d", oc.tsn)
+                    self.established = False
+                    return
+                oc.retrans += 1
+                oc.sent_at = now
+                self._emit(oc.data)
+
+    def shutdown(self) -> None:
+        if self.established and not self._shutdown:
+            self._shutdown = True
+            cum = self.remote_tsn_seen or 0
+            self._emit(_chunk(SHUTDOWN, 0, struct.pack("!I", cum)))

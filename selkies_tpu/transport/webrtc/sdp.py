@@ -1,0 +1,156 @@
+"""SDP offer/answer for the bundled video+audio+datachannel session.
+
+Mirrors the reference's munged webrtcbin offer (gstwebrtc_app.py
+__on_offer_created, :1581-1636): H.264 fmtp carries
+level-asymmetry-allowed=1;packetization-mode=1;profile-level-id=42e01f;
+sps-pps-idr-in-keyframe=1, Opus gets ptime:10 + in-band FEC, video
+carries nack/nack pli/transport-cc feedback and the transport-wide-cc +
+playout-delay header extensions (rtp_add_extensions, :1657-1689).
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass, field
+
+VIDEO_PT = 96
+AUDIO_PT = 111
+TWCC_EXT_ID = 3
+PLAYOUT_DELAY_EXT_ID = 2
+TWCC_URI = "http://www.ietf.org/id/draft-holmer-rmcat-transport-wide-cc-extensions-01"
+PLAYOUT_DELAY_URI = "http://www.webrtc.org/experiments/rtp-hdrext/playout-delay"
+
+H264_FMTP = ("level-asymmetry-allowed=1;packetization-mode=1;"
+             "profile-level-id=42e01f;sps-pps-idr-in-keyframe=1")
+VP8_FMTP = ""
+VP9_FMTP = "profile-id=0"
+
+CODEC_RTPMAP = {
+    "h264": f"{VIDEO_PT} H264/90000",
+    "vp8": f"{VIDEO_PT} VP8/90000",
+    "vp9": f"{VIDEO_PT} VP9/90000",
+}
+CODEC_FMTP = {"h264": H264_FMTP, "vp8": VP8_FMTP, "vp9": VP9_FMTP}
+
+
+def build_offer(*, ice_ufrag: str, ice_pwd: str, fingerprint: str,
+                video_ssrc: int, audio_ssrc: int, codec: str = "h264",
+                session_id: str | None = None, audio: bool = True) -> str:
+    sid = session_id or str(int.from_bytes(secrets.token_bytes(6), "big"))
+    cname = "selkies-tpu"
+    mids = ["video0"] + (["audio0"] if audio else []) + ["application0"]
+    lines = [
+        "v=0",
+        f"o=- {sid} 2 IN IP4 127.0.0.1",
+        "s=-",
+        "t=0 0",
+        "a=group:BUNDLE " + " ".join(mids),
+        "a=msid-semantic: WMS selkies",
+        "a=ice-options:trickle",
+    ]
+
+    def transport_attrs():
+        return [
+            f"a=ice-ufrag:{ice_ufrag}",
+            f"a=ice-pwd:{ice_pwd}",
+            f"a=fingerprint:sha-256 {fingerprint}",
+            "a=setup:actpass",
+        ]
+
+    lines += [
+        f"m=video 9 UDP/TLS/RTP/SAVPF {VIDEO_PT}",
+        "c=IN IP4 0.0.0.0",
+        "a=rtcp:9 IN IP4 0.0.0.0",
+        "a=mid:video0",
+        "a=sendonly",
+        "a=rtcp-mux",
+        "a=rtcp-rsize",
+        *transport_attrs(),
+        "a=rtpmap:" + CODEC_RTPMAP[codec],
+        f"a=extmap:{TWCC_EXT_ID} {TWCC_URI}",
+        f"a=extmap:{PLAYOUT_DELAY_EXT_ID} {PLAYOUT_DELAY_URI}",
+        f"a=rtcp-fb:{VIDEO_PT} nack",
+        f"a=rtcp-fb:{VIDEO_PT} nack pli",
+        f"a=rtcp-fb:{VIDEO_PT} transport-cc",
+        f"a=msid:selkies selkies-video",
+        f"a=ssrc:{video_ssrc} cname:{cname}",
+        f"a=ssrc:{video_ssrc} msid:selkies selkies-video",
+    ]
+    fmtp = CODEC_FMTP[codec]
+    if fmtp:
+        lines.insert(lines.index("a=rtpmap:" + CODEC_RTPMAP[codec]) + 1,
+                     f"a=fmtp:{VIDEO_PT} {fmtp}")
+    if audio:
+        lines += [
+            f"m=audio 9 UDP/TLS/RTP/SAVPF {AUDIO_PT}",
+            "c=IN IP4 0.0.0.0",
+            "a=rtcp:9 IN IP4 0.0.0.0",
+            "a=mid:audio0",
+            "a=sendonly",
+            "a=rtcp-mux",
+            *transport_attrs(),
+            f"a=rtpmap:{AUDIO_PT} OPUS/48000/2",
+            f"a=fmtp:{AUDIO_PT} minptime=10;useinbandfec=1;stereo=1",
+            "a=ptime:10",
+            f"a=extmap:{TWCC_EXT_ID} {TWCC_URI}",
+            f"a=rtcp-fb:{AUDIO_PT} transport-cc",
+            f"a=msid:selkies selkies-audio",
+            f"a=ssrc:{audio_ssrc} cname:{cname}",
+            f"a=ssrc:{audio_ssrc} msid:selkies selkies-audio",
+        ]
+    lines += [
+        "m=application 9 UDP/DTLS/SCTP webrtc-datachannel",
+        "c=IN IP4 0.0.0.0",
+        "a=mid:application0",
+        *transport_attrs(),
+        "a=sctp-port:5000",
+        "a=max-message-size:262144",
+    ]
+    return "\r\n".join(lines) + "\r\n"
+
+
+@dataclass
+class RemoteDescription:
+    ice_ufrag: str = ""
+    ice_pwd: str = ""
+    fingerprint: str = ""
+    setup: str = ""
+    candidates: list[str] = field(default_factory=list)
+    video_pt: int | None = None
+    twcc_id: int | None = None
+    sctp_port: int = 5000
+
+
+def parse_answer(sdp: str) -> RemoteDescription:
+    """Extract what the transport needs from the browser's answer.
+
+    Session-level attributes apply to every m-section; the first
+    media-level occurrence wins otherwise (BUNDLE shares one transport)."""
+    r = RemoteDescription()
+    current_rtpmaps: dict[int, str] = {}
+    for raw in sdp.replace("\r\n", "\n").split("\n"):
+        line = raw.strip()
+        if line.startswith("a=ice-ufrag:") and not r.ice_ufrag:
+            r.ice_ufrag = line.split(":", 1)[1]
+        elif line.startswith("a=ice-pwd:") and not r.ice_pwd:
+            r.ice_pwd = line.split(":", 1)[1]
+        elif line.startswith("a=fingerprint:sha-256") and not r.fingerprint:
+            r.fingerprint = line.split(None, 1)[1].strip()
+        elif line.startswith("a=setup:") and not r.setup:
+            r.setup = line.split(":", 1)[1]
+        elif line.startswith("a=candidate:"):
+            r.candidates.append(line[2:])
+        elif line.startswith("a=rtpmap:"):
+            body = line[len("a=rtpmap:"):]
+            pt, enc = body.split(" ", 1)
+            current_rtpmaps[int(pt)] = enc
+            if enc.upper().startswith(("H264/", "VP8/", "VP9/")) and r.video_pt is None:
+                r.video_pt = int(pt)
+        elif line.startswith("a=extmap:"):
+            body = line[len("a=extmap:"):]
+            eid, uri = body.split(" ", 1)
+            if uri.strip() == TWCC_URI and r.twcc_id is None:
+                r.twcc_id = int(eid.split("/")[0])
+        elif line.startswith("a=sctp-port:"):
+            r.sctp_port = int(line.split(":", 1)[1])
+    return r

@@ -1,0 +1,179 @@
+"""SRTP / SRTCP (RFC 3711), profile AES_CM_128_HMAC_SHA1_80.
+
+The reference gets SRTP from webrtcbin's libsrtp; this is a direct
+implementation over `cryptography`'s AES-CTR (the media plane here runs
+a few hundred packets/s, far below what per-packet Cipher construction
+costs). Master keys come from the DTLS EXTRACTOR (dtls.py).
+
+Covers: AES-CM key derivation (§4.3), SRTP encrypt+auth with ROC
+tracking (§3.3), SRTCP with the 31-bit index + E bit (§3.4), and
+receiver-side index estimation and auth verification. Replay windows are
+left to the RTP consumers (the jitter layer already orders packets).
+"""
+
+from __future__ import annotations
+
+import hmac
+import hashlib
+import struct
+
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+AUTH_TAG_LEN = 10
+SRTCP_INDEX_LEN = 4
+
+
+class SrtpError(ValueError):
+    pass
+
+
+def _aes_cm_keystream(key: bytes, iv_int: int, n: int) -> bytes:
+    iv = iv_int.to_bytes(16, "big")
+    enc = Cipher(algorithms.AES(key), modes.CTR(iv)).encryptor()
+    return enc.update(b"\x00" * n) + enc.finalize()
+
+
+def _derive(master_key: bytes, master_salt: bytes, label: int, n: int) -> bytes:
+    """RFC 3711 §4.3.1 key derivation (kdr = 0)."""
+    x = int.from_bytes(master_salt, "big") ^ (label << 48)
+    return _aes_cm_keystream(master_key, x << 16, n)
+
+
+class _Keys:
+    def __init__(self, master_key: bytes, master_salt: bytes, *, rtcp: bool):
+        base = 3 if rtcp else 0
+        self.cipher = _derive(master_key, master_salt, base + 0, 16)
+        self.auth = _derive(master_key, master_salt, base + 1, 20)
+        self.salt = _derive(master_key, master_salt, base + 2, 14)
+
+
+def _rtp_iv(salt: bytes, ssrc: int, index: int) -> int:
+    return (int.from_bytes(salt, "big") << 16) ^ (ssrc << 64) ^ (index << 16)
+
+
+class SrtpSession:
+    """One direction pair: protect with local keys, unprotect with remote."""
+
+    def __init__(self, local_key: bytes, local_salt: bytes,
+                 remote_key: bytes, remote_salt: bytes):
+        self._tx = _Keys(local_key, local_salt, rtcp=False)
+        self._tx_rtcp = _Keys(local_key, local_salt, rtcp=True)
+        self._rx = _Keys(remote_key, remote_salt, rtcp=False)
+        self._rx_rtcp = _Keys(remote_key, remote_salt, rtcp=True)
+        self._tx_roc: dict[int, int] = {}
+        self._tx_last_seq: dict[int, int] = {}
+        self._rx_roc: dict[int, int] = {}
+        self._rx_last_seq: dict[int, int] = {}
+        self._tx_rtcp_index = 0
+        self._rx_rtcp_index_seen = -1
+
+    # -- SRTP ---------------------------------------------------------
+
+    @staticmethod
+    def _parse_header(pkt: bytes) -> tuple[int, int, int]:
+        """-> (header_len, seq, ssrc)."""
+        if len(pkt) < 12 or pkt[0] >> 6 != 2:
+            raise SrtpError("not an RTP packet")
+        cc = pkt[0] & 0x0F
+        hlen = 12 + 4 * cc
+        if pkt[0] & 0x10:  # header extension
+            if len(pkt) < hlen + 4:
+                raise SrtpError("truncated RTP extension")
+            xlen = struct.unpack("!H", pkt[hlen + 2 : hlen + 4])[0]
+            hlen += 4 + 4 * xlen
+        if len(pkt) < hlen:
+            raise SrtpError("truncated RTP header")
+        seq = struct.unpack("!H", pkt[2:4])[0]
+        ssrc = struct.unpack("!I", pkt[8:12])[0]
+        return hlen, seq, ssrc
+
+    def protect(self, pkt: bytes) -> bytes:
+        hlen, seq, ssrc = self._parse_header(pkt)
+        last = self._tx_last_seq.get(ssrc)
+        roc = self._tx_roc.get(ssrc, 0)
+        if last is not None and seq < last and last - seq > 0x8000:
+            roc = (roc + 1) & 0xFFFFFFFF  # sender seq wrapped
+            self._tx_roc[ssrc] = roc
+        self._tx_last_seq[ssrc] = seq
+        index = (roc << 16) | seq
+        ks = _aes_cm_keystream(
+            self._tx.cipher, _rtp_iv(self._tx.salt, ssrc, index), len(pkt) - hlen
+        )
+        body = bytes(a ^ b for a, b in zip(pkt[hlen:], ks))
+        out = pkt[:hlen] + body
+        mac = hmac.new(self._tx.auth, out + struct.pack("!I", roc), hashlib.sha1)
+        return out + mac.digest()[:AUTH_TAG_LEN]
+
+    def _estimate_index(self, ssrc: int, seq: int) -> int:
+        """RFC 3711 §3.3.1 receiver index estimate."""
+        roc = self._rx_roc.get(ssrc, 0)
+        s_l = self._rx_last_seq.get(ssrc)
+        if s_l is None:
+            return seq
+        v = roc
+        if s_l < 0x8000:
+            if seq - s_l > 0x8000 and roc > 0:
+                v = roc - 1
+        else:
+            if s_l - seq > 0x8000:
+                v = roc + 1
+        return (v << 16) | seq
+
+    def unprotect(self, pkt: bytes) -> bytes:
+        if len(pkt) < 12 + AUTH_TAG_LEN:
+            raise SrtpError("short SRTP packet")
+        tag = pkt[-AUTH_TAG_LEN:]
+        body = pkt[:-AUTH_TAG_LEN]
+        hlen, seq, ssrc = self._parse_header(body)
+        index = self._estimate_index(ssrc, seq)
+        roc = index >> 16
+        mac = hmac.new(self._rx.auth, body + struct.pack("!I", roc), hashlib.sha1)
+        if not hmac.compare_digest(mac.digest()[:AUTH_TAG_LEN], tag):
+            raise SrtpError("SRTP auth failure")
+        # commit ROC/seq state only after auth
+        self._rx_roc[ssrc] = roc
+        self._rx_last_seq[ssrc] = seq
+        ks = _aes_cm_keystream(
+            self._rx.cipher, _rtp_iv(self._rx.salt, ssrc, index), len(body) - hlen
+        )
+        return body[:hlen] + bytes(a ^ b for a, b in zip(body[hlen:], ks))
+
+    # -- SRTCP --------------------------------------------------------
+
+    def protect_rtcp(self, pkt: bytes) -> bytes:
+        if len(pkt) < 8:
+            raise SrtpError("short RTCP packet")
+        ssrc = struct.unpack("!I", pkt[4:8])[0]
+        self._tx_rtcp_index = (self._tx_rtcp_index + 1) & 0x7FFFFFFF
+        index = self._tx_rtcp_index
+        iv = (int.from_bytes(self._tx_rtcp.salt, "big") << 16) ^ (ssrc << 64) ^ (index << 16)
+        ks = _aes_cm_keystream(self._tx_rtcp.cipher, iv, len(pkt) - 8)
+        body = pkt[:8] + bytes(a ^ b for a, b in zip(pkt[8:], ks))
+        trailer = struct.pack("!I", index | 0x80000000)  # E bit: encrypted
+        mac = hmac.new(self._tx_rtcp.auth, body + trailer, hashlib.sha1)
+        return body + trailer + mac.digest()[:AUTH_TAG_LEN]
+
+    def unprotect_rtcp(self, pkt: bytes) -> bytes:
+        if len(pkt) < 8 + SRTCP_INDEX_LEN + AUTH_TAG_LEN:
+            raise SrtpError("short SRTCP packet")
+        tag = pkt[-AUTH_TAG_LEN:]
+        rest = pkt[:-AUTH_TAG_LEN]
+        mac = hmac.new(self._rx_rtcp.auth, rest, hashlib.sha1)
+        if not hmac.compare_digest(mac.digest()[:AUTH_TAG_LEN], tag):
+            raise SrtpError("SRTCP auth failure")
+        trailer = struct.unpack("!I", rest[-SRTCP_INDEX_LEN:])[0]
+        body = rest[:-SRTCP_INDEX_LEN]
+        encrypted = bool(trailer & 0x80000000)
+        index = trailer & 0x7FFFFFFF
+        if not encrypted:
+            return body
+        ssrc = struct.unpack("!I", body[4:8])[0]
+        iv = (int.from_bytes(self._rx_rtcp.salt, "big") << 16) ^ (ssrc << 64) ^ (index << 16)
+        ks = _aes_cm_keystream(self._rx_rtcp.cipher, iv, len(body) - 8)
+        return body[:8] + bytes(a ^ b for a, b in zip(body[8:], ks))
+
+
+def session_pair(keys, dtls_is_client: bool) -> SrtpSession:
+    """Build the session from dtls.SrtpKeys for our DTLS role."""
+    lk, ls, rk, rs = keys.for_role(dtls_is_client)
+    return SrtpSession(lk, ls, rk, rs)

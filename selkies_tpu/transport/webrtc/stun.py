@@ -1,0 +1,221 @@
+"""STUN message codec (RFC 5389) + ICE (RFC 8445) and TURN (RFC 5766)
+attributes.
+
+Replaces the STUN half of libnice that the reference gets through
+webrtcbin (gstwebrtc_app.py:149-160: stun-server/turn-server props).
+Only what ICE + TURN-over-UDP need is implemented; the codec is strict
+about lengths and integrity so malformed network input cannot wander
+into the agent.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import struct
+import zlib
+from dataclasses import dataclass, field
+
+MAGIC_COOKIE = 0x2112A442
+
+# methods
+BINDING = 0x001
+ALLOCATE = 0x003
+REFRESH = 0x004
+SEND = 0x006
+DATA = 0x007
+CREATE_PERMISSION = 0x008
+CHANNEL_BIND = 0x009
+
+# classes
+REQUEST = 0x00
+INDICATION = 0x01
+RESPONSE = 0x02
+ERROR_RESPONSE = 0x03
+
+# attributes
+ATTR_MAPPED_ADDRESS = 0x0001
+ATTR_USERNAME = 0x0006
+ATTR_MESSAGE_INTEGRITY = 0x0008
+ATTR_ERROR_CODE = 0x0009
+ATTR_CHANNEL_NUMBER = 0x000C
+ATTR_LIFETIME = 0x000D
+ATTR_XOR_PEER_ADDRESS = 0x0012
+ATTR_DATA = 0x0013
+ATTR_REALM = 0x0014
+ATTR_NONCE = 0x0015
+ATTR_XOR_RELAYED_ADDRESS = 0x0016
+ATTR_REQUESTED_TRANSPORT = 0x0019
+ATTR_XOR_MAPPED_ADDRESS = 0x0020
+ATTR_PRIORITY = 0x0024
+ATTR_USE_CANDIDATE = 0x0025
+ATTR_SOFTWARE = 0x8022
+ATTR_FINGERPRINT = 0x8028
+ATTR_ICE_CONTROLLED = 0x8029
+ATTR_ICE_CONTROLLING = 0x802A
+
+FINGERPRINT_XOR = 0x5354554E
+
+
+class StunError(ValueError):
+    pass
+
+
+def _pack_type(method: int, cls: int) -> int:
+    # RFC 5389 §6: class bits interleave into the method at bits 4 and 8
+    return (
+        (method & 0x0F80) << 2
+        | (cls & 2) << 7
+        | (method & 0x0070) << 1
+        | (cls & 1) << 4
+        | (method & 0x000F)
+    )
+
+
+def _unpack_type(t: int) -> tuple[int, int]:
+    method = (t & 0x3E00) >> 2 | (t & 0x00E0) >> 1 | (t & 0x000F)
+    cls = (t & 0x0100) >> 7 | (t & 0x0010) >> 4
+    return method, cls
+
+
+def xor_address(addr: tuple[str, int], txid: bytes) -> bytes:
+    """Encode (ip, port) as XOR-MAPPED-ADDRESS payload (IPv4/IPv6)."""
+    import ipaddress
+
+    ip = ipaddress.ip_address(addr[0])
+    port = addr[1] ^ (MAGIC_COOKIE >> 16)
+    if ip.version == 4:
+        raw = int(ip) ^ MAGIC_COOKIE
+        return struct.pack("!BBHI", 0, 0x01, port, raw)
+    key = struct.pack("!I", MAGIC_COOKIE) + txid
+    raw = bytes(a ^ b for a, b in zip(ip.packed, key))
+    return struct.pack("!BBH", 0, 0x02, port) + raw
+
+
+def unxor_address(payload: bytes, txid: bytes) -> tuple[str, int]:
+    import ipaddress
+
+    if len(payload) < 8:
+        raise StunError("short xor-address")
+    fam = payload[1]
+    port = struct.unpack("!H", payload[2:4])[0] ^ (MAGIC_COOKIE >> 16)
+    if fam == 0x01:
+        ip = struct.unpack("!I", payload[4:8])[0] ^ MAGIC_COOKIE
+        return str(ipaddress.ip_address(ip)), port
+    if fam == 0x02:
+        if len(payload) < 20:
+            raise StunError("short xor-address v6")
+        key = struct.pack("!I", MAGIC_COOKIE) + txid
+        raw = bytes(a ^ b for a, b in zip(payload[4:20], key))
+        return str(ipaddress.ip_address(raw)), port
+    raise StunError(f"bad address family {fam}")
+
+
+@dataclass
+class StunMessage:
+    method: int
+    cls: int
+    txid: bytes = field(default_factory=lambda: os.urandom(12))
+    attrs: list[tuple[int, bytes]] = field(default_factory=list)
+
+    def get(self, attr: int) -> bytes | None:
+        for a, v in self.attrs:
+            if a == attr:
+                return v
+        return None
+
+    def add(self, attr: int, value: bytes) -> "StunMessage":
+        self.attrs.append((attr, value))
+        return self
+
+    # -- building -----------------------------------------------------
+
+    def serialize(self, integrity_key: bytes | None = None,
+                  fingerprint: bool = True) -> bytes:
+        attrs = b""
+        for a, v in self.attrs:
+            attrs += struct.pack("!HH", a, len(v)) + v + b"\x00" * ((4 - len(v) % 4) % 4)
+        if integrity_key is not None:
+            # integrity covers the header with a length that includes the
+            # MI attribute itself (RFC 5389 §15.4)
+            hdr = struct.pack(
+                "!HHI", _pack_type(self.method, self.cls), len(attrs) + 24,
+                MAGIC_COOKIE,
+            ) + self.txid
+            mac = hmac.new(integrity_key, hdr + attrs, hashlib.sha1).digest()
+            attrs += struct.pack("!HH", ATTR_MESSAGE_INTEGRITY, 20) + mac
+        if fingerprint:
+            hdr = struct.pack(
+                "!HHI", _pack_type(self.method, self.cls), len(attrs) + 8,
+                MAGIC_COOKIE,
+            ) + self.txid
+            crc = (zlib.crc32(hdr + attrs) & 0xFFFFFFFF) ^ FINGERPRINT_XOR
+            attrs += struct.pack("!HHI", ATTR_FINGERPRINT, 4, crc)
+        hdr = struct.pack(
+            "!HHI", _pack_type(self.method, self.cls), len(attrs), MAGIC_COOKIE
+        ) + self.txid
+        return hdr + attrs
+
+    # -- parsing ------------------------------------------------------
+
+    @classmethod
+    def parse(cls, data: bytes) -> "StunMessage":
+        if len(data) < 20:
+            raise StunError("short message")
+        t, length, cookie = struct.unpack("!HHI", data[:8])
+        if t & 0xC000:
+            raise StunError("not a STUN message")
+        if cookie != MAGIC_COOKIE:
+            raise StunError("bad magic cookie")
+        if len(data) < 20 + length or length % 4:
+            raise StunError("bad length")
+        txid = data[8:20]
+        method, mcls = _unpack_type(t)
+        msg = cls(method=method, cls=mcls, txid=txid)
+        off = 20
+        end = 20 + length
+        while off + 4 <= end:
+            a, alen = struct.unpack("!HH", data[off : off + 4])
+            if off + 4 + alen > end:
+                raise StunError("attribute overruns message")
+            msg.attrs.append((a, data[off + 4 : off + 4 + alen]))
+            off += 4 + alen + ((4 - alen % 4) % 4)
+        return msg
+
+    def check_integrity(self, key: bytes, data: bytes) -> bool:
+        """Verify MESSAGE-INTEGRITY over the original wire bytes."""
+        off = 20
+        end = 20 + struct.unpack("!H", data[2:4])[0]
+        while off + 4 <= end:
+            a, alen = struct.unpack("!HH", data[off : off + 4])
+            if a == ATTR_MESSAGE_INTEGRITY:
+                covered = bytearray(data[:off])
+                # adjust header length: everything through the MI attr
+                struct.pack_into("!H", covered, 2, off + 24 - 20)
+                mac = hmac.new(key, bytes(covered), hashlib.sha1).digest()
+                return hmac.compare_digest(mac, data[off + 4 : off + 24])
+            off += 4 + alen + ((4 - alen % 4) % 4)
+        return False
+
+
+def is_stun(data: bytes) -> bool:
+    """Demultiplex per RFC 7983: STUN leads with 0x00-0x03."""
+    return len(data) >= 20 and data[0] < 4 and data[4:8] == struct.pack("!I", MAGIC_COOKIE)
+
+
+def error_code(msg: StunMessage) -> tuple[int, str] | None:
+    v = msg.get(ATTR_ERROR_CODE)
+    if v is None or len(v) < 4:
+        return None
+    code = (v[2] & 0x07) * 100 + v[3]
+    return code, v[4:].decode("utf-8", "replace")
+
+
+def make_error(code: int, reason: str) -> bytes:
+    return struct.pack("!HBB", 0, code // 100, code % 100) + reason.encode()
+
+
+def long_term_key(username: str, realm: str, password: str) -> bytes:
+    """TURN long-term credential key (RFC 5389 §15.4)."""
+    return hashlib.md5(f"{username}:{realm}:{password}".encode()).digest()

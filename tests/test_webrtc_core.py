@@ -1,0 +1,206 @@
+"""WebRTC primitives: STUN codec, DTLS loopback handshake + SRTP export,
+SRTP packet protection round trips."""
+
+import os
+
+import pytest
+
+from selkies_tpu.transport.webrtc import stun
+
+
+def test_stun_roundtrip_with_integrity_and_fingerprint():
+    key = b"swordfish"
+    msg = stun.StunMessage(method=stun.BINDING, cls=stun.REQUEST)
+    msg.add(stun.ATTR_USERNAME, b"remote:local")
+    msg.add(stun.ATTR_PRIORITY, (1845501695).to_bytes(4, "big"))
+    msg.add(stun.ATTR_ICE_CONTROLLING, os.urandom(8))
+    msg.add(stun.ATTR_USE_CANDIDATE, b"")
+    wire = msg.serialize(integrity_key=key)
+    assert stun.is_stun(wire)
+    parsed = stun.StunMessage.parse(wire)
+    assert parsed.method == stun.BINDING and parsed.cls == stun.REQUEST
+    assert parsed.txid == msg.txid
+    assert parsed.get(stun.ATTR_USERNAME) == b"remote:local"
+    assert parsed.check_integrity(key, wire)
+    assert not parsed.check_integrity(b"wrong", wire)
+    # tamper -> integrity fails
+    bad = bytearray(wire)
+    bad[25] ^= 1
+    assert not stun.StunMessage.parse(bytes(bad)).check_integrity(key, bytes(bad))
+
+
+def test_stun_xor_address():
+    txid = os.urandom(12)
+    for addr in [("192.0.2.1", 32853), ("10.0.0.7", 5349)]:
+        enc = stun.xor_address(addr, txid)
+        assert stun.unxor_address(enc, txid) == addr
+    v6 = ("2001:db8::1", 443)
+    assert stun.unxor_address(stun.xor_address(v6, txid), txid) == v6
+
+
+def test_stun_type_packing():
+    for method in (stun.BINDING, stun.ALLOCATE, stun.CHANNEL_BIND):
+        for cls in (stun.REQUEST, stun.INDICATION, stun.RESPONSE, stun.ERROR_RESPONSE):
+            t = stun._pack_type(method, cls)
+            assert stun._unpack_type(t) == (method, cls)
+
+
+def test_stun_rejects_garbage():
+    with pytest.raises(stun.StunError):
+        stun.StunMessage.parse(b"\x00" * 19)
+    with pytest.raises(stun.StunError):
+        stun.StunMessage.parse(b"\x00\x01\x00\x00" + b"\x00" * 16)  # bad cookie
+    assert not stun.is_stun(b"\x80" + b"\x00" * 30)  # RTP-range first byte
+
+
+def _pump(a, b, limit=50):
+    """Shuttle datagrams between two DtlsEndpoints until both complete."""
+    for _ in range(limit):
+        progress = False
+        for src, dst in ((a, b), (b, a)):
+            for dg in src.take_datagrams():
+                dst.put_datagram(dg)
+                dst.handshake_step()
+                progress = True
+        if a.handshake_complete and b.handshake_complete:
+            return
+        if not progress:
+            a.handshake_step()
+            b.handshake_step()
+    raise AssertionError("handshake did not converge")
+
+
+def test_dtls_loopback_handshake_and_srtp_keys():
+    from selkies_tpu.transport.webrtc import dtls
+
+    cert_s, key_s, fp_s = dtls.make_certificate()
+    cert_c, key_c, fp_c = dtls.make_certificate()
+    srv = dtls.DtlsEndpoint(is_server=True, cert_der=cert_s, key_der=key_s,
+                            peer_fingerprint=fp_c)
+    cli = dtls.DtlsEndpoint(is_server=False, cert_der=cert_c, key_der=key_c,
+                            peer_fingerprint=fp_s)
+    cli.handshake_step()  # client flight 1
+    _pump(cli, srv)
+    assert srv.handshake_complete and cli.handshake_complete
+    assert srv.srtp_keys is not None and cli.srtp_keys is not None
+    # both sides export the SAME key block
+    assert srv.srtp_keys == cli.srtp_keys
+    assert len(srv.srtp_keys.client_key) == 16
+    assert len(srv.srtp_keys.server_salt) == 14
+    # application data both ways (SCTP path)
+    cli.send(b"hello from dtls client")
+    for dg in cli.take_datagrams():
+        srv.put_datagram(dg)
+    assert srv.recv() == [b"hello from dtls client"]
+    srv.send(b"pong")
+    for dg in srv.take_datagrams():
+        cli.put_datagram(dg)
+    assert cli.recv() == [b"pong"]
+
+
+def test_dtls_fingerprint_mismatch_rejected():
+    from selkies_tpu.transport.webrtc import dtls
+
+    cert_s, key_s, fp_s = dtls.make_certificate()
+    cert_c, key_c, _ = dtls.make_certificate()
+    wrong = "AA:" * 31 + "AA"
+    srv = dtls.DtlsEndpoint(is_server=True, cert_der=cert_s, key_der=key_s,
+                            peer_fingerprint=wrong)
+    cli = dtls.DtlsEndpoint(is_server=False, cert_der=cert_c, key_der=key_c,
+                            peer_fingerprint=fp_s)
+    cli.handshake_step()
+    with pytest.raises(dtls.DtlsError, match="fingerprint"):
+        _pump(cli, srv)
+
+
+def test_aes_cm_keystream_rfc3711_vector():
+    """RFC 3711 appendix B.2 AES-CM test vector."""
+    from selkies_tpu.transport.webrtc.srtp import _aes_cm_keystream
+
+    key = bytes.fromhex("2B7E151628AED2A6ABF7158809CF4F3C")
+    iv = int("F0F1F2F3F4F5F6F7F8F9FAFBFCFD0000", 16)
+    ks = _aes_cm_keystream(key, iv, 48)
+    assert ks[:16] == bytes.fromhex("E03EAD0935C95E80E166B16DD92B4EB4")
+    assert ks[16:32] == bytes.fromhex("D23513162B02D0F72A43A2FE4A5F97AB")
+    assert ks[32:48] == bytes.fromhex("41E95B3BB0A2E8DD477901E4FCA894C0")
+
+
+def test_srtp_key_derivation_rfc3711_vector():
+    """RFC 3711 appendix B.3 key derivation vectors."""
+    from selkies_tpu.transport.webrtc.srtp import _derive
+
+    mk = bytes.fromhex("E1F97A0D3E018BE0D64FA32C06DE4139")
+    ms = bytes.fromhex("0EC675AD498AFEEBB6960B3AABE6")
+    assert _derive(mk, ms, 0, 16) == bytes.fromhex("C61E7A93744F39EE10734AFE3FF7A087")
+    assert _derive(mk, ms, 2, 14) == bytes.fromhex("30CBBC08863D8C85D49DB34A9AE1")
+    assert _derive(mk, ms, 1, 20) == bytes.fromhex(
+        "CEBE321F6FF7716B6FD4AB49AF256A156D38BAA4"
+    )
+
+
+def _sessions():
+    from selkies_tpu.transport.webrtc.srtp import SrtpSession
+
+    lk, ls = os.urandom(16), os.urandom(14)
+    rk, rs = os.urandom(16), os.urandom(14)
+    a = SrtpSession(lk, ls, rk, rs)
+    b = SrtpSession(rk, rs, lk, ls)
+    return a, b
+
+
+def _rtp(seq, ssrc=0x1234, pt=96, payload=b"\xde\xad\xbe\xef" * 20):
+    import struct
+
+    return struct.pack("!BBHII", 0x80, pt, seq & 0xFFFF, 1000 + seq, ssrc) + payload
+
+
+def test_srtp_roundtrip_and_tamper():
+    from selkies_tpu.transport.webrtc.srtp import SrtpError
+
+    a, b = _sessions()
+    for seq in (0, 1, 2, 65534, 65535, 0, 1):  # crosses the seq wrap
+        pkt = _rtp(seq)
+        prot = a.protect(pkt)
+        assert prot != pkt and len(prot) == len(pkt) + 10
+        assert b.unprotect(prot) == pkt
+    bad = bytearray(a.protect(_rtp(2)))
+    bad[-1] ^= 1
+    with pytest.raises(SrtpError, match="auth"):
+        b.unprotect(bytes(bad))
+
+
+def test_srtcp_roundtrip():
+    import struct
+
+    from selkies_tpu.transport.webrtc.srtp import SrtpError
+
+    a, b = _sessions()
+    # minimal RTCP RR: V=2, PT=201, length=1, ssrc
+    rr = struct.pack("!BBHI", 0x80, 201, 1, 0xCAFE) + b"\x00" * 4
+    for _ in range(3):
+        prot = a.protect_rtcp(rr)
+        assert b.unprotect_rtcp(prot)[: len(rr)] == rr
+    bad = bytearray(a.protect_rtcp(rr))
+    bad[-3] ^= 0x40
+    with pytest.raises(SrtpError):
+        b.unprotect_rtcp(bytes(bad))
+
+
+def test_srtp_from_dtls_keys():
+    """DTLS-exported keys wire into a working SRTP pair end-to-end."""
+    from selkies_tpu.transport.webrtc import dtls
+    from selkies_tpu.transport.webrtc.srtp import session_pair
+
+    cert_s, key_s, fp_s = dtls.make_certificate()
+    cert_c, key_c, fp_c = dtls.make_certificate()
+    srv = dtls.DtlsEndpoint(is_server=True, cert_der=cert_s, key_der=key_s,
+                            peer_fingerprint=fp_c)
+    cli = dtls.DtlsEndpoint(is_server=False, cert_der=cert_c, key_der=key_c,
+                            peer_fingerprint=fp_s)
+    cli.handshake_step()
+    _pump(cli, srv)
+    s_srv = session_pair(srv.srtp_keys, dtls_is_client=False)
+    s_cli = session_pair(cli.srtp_keys, dtls_is_client=True)
+    pkt = _rtp(7)
+    assert s_cli.unprotect(s_srv.protect(pkt)) == pkt
+    assert s_srv.unprotect(s_cli.protect(pkt)) == pkt

@@ -1,0 +1,227 @@
+"""PeerConnection end-to-end over real localhost UDP: offer/answer, ICE,
+DTLS-SRTP, datachannel input, SRTP video out, RTCP PLI feedback in.
+
+The 'browser' side is assembled from the same primitives in the
+client/active role (ICE controlled-ish, DTLS client, SCTP client), which
+doubles as coverage of the answerer paths."""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+
+import pytest
+
+from selkies_tpu.transport.rtp import H264Depayloader, RtpPacket
+from selkies_tpu.transport.webrtc import rtcp, sdp
+from selkies_tpu.transport.webrtc.dtls import DtlsEndpoint, is_dtls, make_certificate
+from selkies_tpu.transport.webrtc.ice import IceAgent, candidate_priority
+from selkies_tpu.transport.webrtc.peer import PeerConnection
+from selkies_tpu.transport.webrtc.sctp import SctpAssociation
+from selkies_tpu.transport.webrtc.srtp import session_pair
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def test_offer_carries_reference_munging():
+    async def scenario():
+        pc = PeerConnection(audio=True)
+        offer = await pc.create_offer()
+        pc.close()
+        assert "a=group:BUNDLE video0 audio0 application0" in offer
+        assert "profile-level-id=42e01f" in offer
+        assert "packetization-mode=1" in offer
+        assert "level-asymmetry-allowed=1" in offer
+        assert "sps-pps-idr-in-keyframe=1" in offer
+        assert "a=ptime:10" in offer
+        assert "useinbandfec=1" in offer
+        assert "a=rtcp-fb:96 nack pli" in offer
+        assert "a=rtcp-fb:96 transport-cc" in offer
+        assert "transport-wide-cc" in offer
+        assert "playout-delay" in offer
+        assert "a=setup:actpass" in offer
+        assert "a=fingerprint:sha-256" in offer
+        assert "m=application 9 UDP/DTLS/SCTP webrtc-datachannel" in offer
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(scenario())
+    finally:
+        loop.close()
+
+
+class FakeBrowser:
+    """Active/answerer-side peer built from the primitives."""
+
+    def __init__(self):
+        self.ice = IceAgent()
+        self.cert, self.key, self.fingerprint = make_certificate()
+        self.dtls = None
+        self.srtp = None
+        self.sctp = SctpAssociation(is_client=True)
+        self.rtp_packets = []
+        self.rtcp_in = []
+        self.dc_messages = []
+        self.ice.on_data = self._on_data
+
+    async def answer(self, offer: str) -> str:
+        remote = sdp.parse_answer(offer)  # same extractor works on offers
+        self.remote = remote
+        self.dtls = DtlsEndpoint(is_server=False, cert_der=self.cert,
+                                 key_der=self.key,
+                                 peer_fingerprint=remote.fingerprint)
+        await self.ice.gather()
+        self.ice.set_remote(remote.ice_ufrag, remote.ice_pwd)
+        for cand in remote.candidates:
+            self.ice.add_remote_candidate(cand)
+        return (
+            "v=0\r\no=- 1 2 IN IP4 127.0.0.1\r\ns=-\r\nt=0 0\r\n"
+            "a=group:BUNDLE video0 audio0 application0\r\n"
+            f"m=video 9 UDP/TLS/RTP/SAVPF {sdp.VIDEO_PT}\r\n"
+            "a=mid:video0\r\na=recvonly\r\na=rtcp-mux\r\n"
+            f"a=ice-ufrag:{self.ice.local_ufrag}\r\n"
+            f"a=ice-pwd:{self.ice.local_pwd}\r\n"
+            f"a=fingerprint:sha-256 {self.fingerprint}\r\n"
+            "a=setup:active\r\n"
+            f"a=rtpmap:{sdp.VIDEO_PT} H264/90000\r\n"
+            f"a=extmap:{sdp.TWCC_EXT_ID} {sdp.TWCC_URI}\r\n"
+            f"m=audio 9 UDP/TLS/RTP/SAVPF {sdp.AUDIO_PT}\r\n"
+            "a=mid:audio0\r\na=recvonly\r\n"
+            f"a=rtpmap:{sdp.AUDIO_PT} OPUS/48000/2\r\n"
+            "m=application 9 UDP/DTLS/SCTP webrtc-datachannel\r\n"
+            "a=mid:application0\r\na=sctp-port:5000\r\n"
+        )
+
+    def start_dtls(self):
+        self.dtls.handshake_step()
+        self._flush()
+
+    def _flush(self):
+        for dg in self.dtls.take_datagrams():
+            self.ice.send(dg)
+
+    def _on_data(self, data: bytes) -> None:
+        if is_dtls(data):
+            self.dtls.put_datagram(data)
+            if not self.dtls.handshake_complete:
+                if self.dtls.handshake_step():
+                    self.srtp = session_pair(self.dtls.srtp_keys,
+                                             dtls_is_client=True)
+                    self.sctp.connect()
+                    for pkt in self.sctp.take_packets():
+                        self.dtls.send(pkt)
+            else:
+                for msg in self.dtls.recv():
+                    self.sctp.put_packet(msg)
+                for pkt in self.sctp.take_packets():
+                    self.dtls.send(pkt)
+            self._flush()
+        elif self.srtp is not None:
+            if rtcp.is_rtcp(data):
+                self.rtcp_in.append(self.srtp.unprotect_rtcp(data))
+            else:
+                self.rtp_packets.append(self.srtp.unprotect(data))
+
+    def send_rtcp(self, plain: bytes) -> None:
+        self.ice.send(self.srtp.protect_rtcp(plain))
+
+
+def test_full_session_media_and_datachannel(loop):
+    async def scenario():
+        pc = PeerConnection(audio=True)
+        browser = FakeBrowser()
+        opened = []
+        messages = []
+        keyframes = []
+        acked = []
+        pc.on_datachannel = opened.append
+        pc.on_datachannel_message = lambda ch, d, b: messages.append((ch.label, d))
+        pc.on_force_keyframe = lambda: keyframes.append(1)
+        pc.on_packet_acked = lambda seq, t: acked.append(seq)
+
+        offer = await pc.create_offer()
+        answer = await browser.answer(offer)
+        await pc.set_answer(answer)
+        # trickle the browser's host candidate to the server and vice versa
+        pport = pc.ice.local_candidates[0].port
+        bport = browser.ice.local_candidates[0].port
+        pri = candidate_priority("host")
+        pc.add_remote_candidate(f"candidate:1 1 udp {pri} 127.0.0.1 {bport} typ host")
+        browser.ice.add_remote_candidate(
+            f"candidate:1 1 udp {pri} 127.0.0.1 {pport} typ host")
+        await asyncio.wait_for(asyncio.gather(
+            pc.ice.wait_connected(5), browser.ice.wait_connected(5)), 10)
+        browser.start_dtls()
+        await asyncio.wait_for(pc.wait_connected(10), 10)
+
+        # datachannel: browser opens 'input' and sends a key event
+        ch = browser.sctp.open_channel("input")
+        for pkt in browser.sctp.take_packets():
+            browser.dtls.send(pkt)
+        browser._flush()
+        for _ in range(100):
+            if messages:
+                break
+            await asyncio.sleep(0.02)
+        assert [c.label for c in opened] == ["input"]
+        browser.sctp.send(ch, b"kd,65")
+        for pkt in browser.sctp.take_packets():
+            browser.dtls.send(pkt)
+        browser._flush()
+        for _ in range(100):
+            if messages:
+                break
+            await asyncio.sleep(0.02)
+        assert messages == [("input", b"kd,65")]
+
+        # server -> browser datachannel message
+        sch = pc.open_datachannel("cursor")
+        for _ in range(100):
+            if browser.sctp.channels.get(sch.stream_id, None) and \
+               browser.sctp.channels[sch.stream_id].open:
+                break
+            await asyncio.sleep(0.02)
+        pc.send_datachannel(sch, b"cursor-png", binary=True)
+        browser.dc_messages = []
+        browser.sctp.on_message = lambda c, d, b: browser.dc_messages.append(d)
+        for _ in range(100):
+            if browser.dc_messages:
+                break
+            await asyncio.sleep(0.02)
+        assert browser.dc_messages == [b"cursor-png"]
+
+        # video: an AU crosses as SRTP and depayloads back to the same NALs
+        au = b"\x00\x00\x00\x01\x67\x42\x00\x1f" + b"\x00\x00\x00\x01\x65" + bytes(1800)
+        pc.send_video(au, timestamp_ms=1000.0)
+        for _ in range(100):
+            if len(browser.rtp_packets) >= 2:
+                break
+            await asyncio.sleep(0.02)
+        depay = H264Depayloader()
+        got = b""
+        for wire in browser.rtp_packets:
+            pkt = RtpPacket.parse(wire)
+            out = depay.push(pkt)
+            if out:
+                got += out
+        assert b"\x67\x42\x00\x1f" in got and b"\x65" + bytes(64) in got
+
+        # RTCP PLI -> force_keyframe; TWCC feedback -> GCC acks
+        pli = struct.pack("!BBHII", 0x81, 206, 2, 1, pc.video_ssrc)
+        browser.send_rtcp(pli)
+        for _ in range(100):
+            if keyframes:
+                break
+            await asyncio.sleep(0.02)
+        assert keyframes
+
+        pc.close()
+        browser.ice.close()
+
+    loop.run_until_complete(scenario())
