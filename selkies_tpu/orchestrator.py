@@ -43,10 +43,93 @@ from selkies_tpu.signalling.rtc_monitors import (
     fetch_turn_rest,
     make_turn_rtc_config_json_legacy,
 )
+from selkies_tpu.signalling.client import SignallingClient, SignallingErrorNoPeer
 from selkies_tpu.transport.congestion import GccController
+from selkies_tpu.transport.webrtc.transport import WebRTCTransport
 from selkies_tpu.transport.websocket import WebSocketTransport
 
 logger = logging.getLogger("orchestrator")
+
+# reference peer-id convention (__main__.py:555): the browser registers
+# as 1, the server-side client pairs with it
+BROWSER_PEER_ID = 1
+SERVER_CLIENT_ID = 2
+
+
+def _first_ice_servers(stun_servers: str, turn_servers: str):
+    """First stun/turn entries from the csv 'scheme://[user:pass@]host:port'
+    forms -> IceAgent kwargs."""
+    kw: dict = {"stun_server": None, "turn_server": None,
+                "turn_username": "", "turn_password": ""}
+    for uri in (stun_servers or "").split(","):
+        uri = uri.strip()
+        if uri.startswith("stun://"):
+            host, _, port = uri[7:].partition(":")
+            port = port.split("?")[0]
+            kw["stun_server"] = (host, int(port or 3478))
+            break
+    for uri in (turn_servers or "").split(","):
+        uri = uri.strip()
+        if not uri.startswith("turn://"):  # turns: is TCP/TLS — not our UDP agent
+            continue
+        rest = uri[7:]
+        if "@" in rest:
+            creds, rest = rest.rsplit("@", 1)
+            user, _, pw = creds.partition(":")
+            kw["turn_username"], kw["turn_password"] = user, pw
+        host, _, tail = rest.partition(":")
+        port = tail.split("?")[0] if tail else "3478"
+        kw["turn_server"] = (host, int(port or 3478))
+        break
+    return kw
+
+
+class TransportMux:
+    """One app-facing Transport fronting both byte planes: WebRTC when a
+    peer connection is up, the WebSocket fallback otherwise."""
+
+    def __init__(self, ws: WebSocketTransport, rtc: WebRTCTransport):
+        self.ws = ws
+        self.rtc = rtc
+
+    @property
+    def active(self):
+        return self.rtc if self.rtc.connected else self.ws
+
+    @property
+    def _control(self):
+        # media switches on DTLS-SRTP readiness, but control messages
+        # need the DCEP channel — keep WS control until the browser has
+        # actually opened 'input' over the peer connection
+        return self.rtc if self.rtc.data_channel_ready else self.ws
+
+    @property
+    def data_channel_ready(self) -> bool:
+        return self._control.data_channel_ready
+
+    def send_data_channel(self, message: str) -> None:
+        self._control.send_data_channel(message)
+
+    async def send_video(self, ef) -> None:
+        await self.active.send_video(ef)
+
+    async def send_audio(self, ea) -> None:
+        await self.active.send_audio(ea)
+
+    # app.set_sdp/set_ice delegate here (pipeline/app.py:161-167)
+    def set_remote_sdp(self, sdp_type: str, sdp: str) -> None:
+        self.rtc.set_remote_sdp(sdp_type, sdp)
+
+    def add_remote_ice(self, mlineindex: int, candidate: str) -> None:
+        self.rtc.add_remote_ice(mlineindex, candidate)
+
+    @property
+    def frames_sent(self) -> int:
+        return self.ws.frames_sent + self.rtc.frames_sent
+
+    @property
+    def bytes_sent(self) -> int:
+        return self.ws.bytes_sent + self.rtc.bytes_sent
 
 DEFAULT_WEB_ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "web")
 
@@ -137,7 +220,9 @@ class Orchestrator:
             port=int(cfg.metrics_http_port),
             using_webrtc_csv=bool(cfg.enable_webrtc_statistics),
         )
-        self.transport = WebSocketTransport()
+        self.ws_transport = WebSocketTransport()
+        self.webrtc = WebRTCTransport(audio=opus_available())
+        self.transport = TransportMux(self.ws_transport, self.webrtc)
         # ximagesrc parity: capture the real X root window when a DISPLAY is
         # reachable; otherwise the synthetic test source (headless rigs).
         from selkies_tpu.pipeline.capture import make_frame_source
@@ -190,9 +275,10 @@ class Orchestrator:
             https_cert=cfg.https_cert,
             https_key=cfg.https_key,
         ))
-        self.server.ws_routes["/media"] = self.transport.handle_connection
+        self.server.ws_routes["/media"] = self.ws_transport.handle_connection
         self._tasks: list[asyncio.Task] = []
         self._session_active = False
+        self._rearm_signalling = asyncio.Event()
         self._last_loss_counters = (0.0, 0.0)
         self.last_resize_success = True
         self._wire_callbacks()
@@ -204,9 +290,15 @@ class Orchestrator:
         cfg, app, inp = self.cfg, self.app, self.input
 
         # transport session lifecycle (reference on_session_handler :700)
-        self.transport.on_connect = self._on_client_connected
-        self.transport.on_disconnect = self._on_client_disconnected
-        self.transport.on_data_message = inp.on_message
+        # both byte planes share the handlers: whichever the client uses
+        # (WebRTC preferred, WS fallback) drives the same session
+        self.ws_transport.on_connect = self._on_client_connected
+        self.ws_transport.on_disconnect = self._on_client_disconnected
+        self.ws_transport.on_data_message = inp.on_message
+        self.webrtc.on_connect = self._on_client_connected
+        self.webrtc.on_disconnect = self._on_webrtc_disconnected
+        self.webrtc.on_data_message = inp.on_message
+        self.webrtc.on_force_keyframe = app.force_keyframe
         app.on_data_open = lambda: logger.info("data channel open")
 
         # client → host settings
@@ -262,8 +354,12 @@ class Orchestrator:
                 max_kbps=int(cfg.video_bitrate),
                 on_estimate=lambda kbps: app.set_video_bitrate(kbps, cc=True),
             )
-            self.transport.on_video_sent = self.gcc.on_frame_sent
+            self.transport.ws.on_video_sent = self.gcc.on_frame_sent
             inp.on_media_ack = self.gcc.on_frame_ack
+            # WebRTC plane: per-packet transport-wide-cc feedback
+            self.webrtc.on_video_sent = self.gcc.on_frame_sent
+            self.webrtc.on_video_acked = self.gcc.on_frame_ack
+            self.webrtc.on_loss = self.gcc.on_loss_report
         else:
             self.gcc = None
 
@@ -317,6 +413,18 @@ class Orchestrator:
     # session lifecycle
 
     def _on_client_connected(self) -> None:
+        if self._session_active:
+            # second byte plane joined the same session (e.g. WS fallback
+            # while WebRTC negotiates): refresh the stream, don't restart
+            logger.info("additional transport connected; forcing keyframe")
+            if self.gcc is not None:
+                # the new plane has its own sequence space and receive
+                # clock epoch; stale ledger entries would corrupt the
+                # trendline right at handover
+                self.gcc.reset()
+            self.app.force_keyframe()
+            self.app.send_codec()
+            return
         logger.info("client connected; starting pipelines")
         self._session_active = True
         if self.gcc is not None:
@@ -341,10 +449,25 @@ class Orchestrator:
                     self.gcc.on_loss_report(d_lost / (d_lost + d_recv))
 
     def _on_client_disconnected(self) -> None:
+        if self.webrtc.connected:
+            logger.info("WS transport gone; WebRTC session continues")
+            return
         logger.info("client disconnected; stopping pipelines")
         self._session_active = False
         loop = asyncio.get_running_loop()
         loop.create_task(self._stop_session())
+        # drop any half-negotiated peer and re-arm for the next browser
+        # (a WS-fallback session ending must not leave WebRTC disarmed)
+        loop.create_task(self.webrtc.stop_session())
+        self._rearm_signalling.set()
+
+    def _on_webrtc_disconnected(self) -> None:
+        if self.ws_transport.data_channel_ready:
+            logger.info("WebRTC gone; WS fallback session continues")
+            return
+        self._on_client_disconnected()
+        # re-arm negotiation for the next browser (reload / reconnect)
+        self._rearm_signalling.set()
 
     async def _start_session(self) -> None:
         if self.cfg.enable_webrtc_statistics:
@@ -363,6 +486,61 @@ class Orchestrator:
         self.input.reset_keyboard()
 
     # ------------------------------------------------------------------
+    # WebRTC negotiation: the in-process signalling client pairs with the
+    # browser (HELLO 2 / SESSION 1, reference __main__.py:555-579) and
+    # relays the offer/answer + trickle ICE both ways.
+
+    async def _signalling_loop(self) -> None:
+        cfg = self.cfg
+        scheme = "wss" if bool(cfg.enable_https) else "ws"
+        client = SignallingClient(
+            f"{scheme}://127.0.0.1:{self.server.bound_port}/ws",
+            id=SERVER_CLIENT_ID, peer_id=BROWSER_PEER_ID,
+            enable_https=bool(cfg.enable_https),
+            enable_basic_auth=bool(cfg.enable_basic_auth),
+            basic_auth_user=cfg.basic_auth_user,
+            basic_auth_password=cfg.basic_auth_password,
+        )
+        self.webrtc.on_sdp = client.send_sdp
+        self.webrtc.on_ice = client.send_ice
+
+        async def call_retrying() -> None:
+            await client.setup_call()
+
+        async def on_error(exc: Exception) -> None:
+            if isinstance(exc, SignallingErrorNoPeer):
+                await asyncio.sleep(2.0)
+                await client.setup_call()
+            else:
+                logger.warning("signalling client error: %s", exc)
+
+        client.on_connect = call_retrying
+        client.on_error = on_error
+        client.on_session = lambda peer, meta: self.webrtc.start_session()
+        client.on_sdp = lambda t, s: self.app.set_sdp(t, s)
+        client.on_ice = lambda m, c: self.app.set_ice(m, c)
+
+        async def rearm_watch() -> None:
+            while True:
+                await self._rearm_signalling.wait()
+                self._rearm_signalling.clear()
+                try:
+                    await client.setup_call()
+                except Exception:
+                    pass
+
+        rearm = asyncio.get_running_loop().create_task(rearm_watch())
+        try:
+            while True:
+                await client.connect()
+                await client.start()  # returns on disconnect
+                logger.info("internal signalling client disconnected; retrying")
+                await asyncio.sleep(2.0)
+        finally:
+            rearm.cancel()
+            await client.stop()
+
+    # ------------------------------------------------------------------
 
     async def run(self) -> None:
         cfg = self.cfg
@@ -371,6 +549,9 @@ class Orchestrator:
         stun_servers, turn_servers, rtc_config = await resolve_rtc_config(cfg)
         self.server.set_rtc_config(rtc_config)
         logger.info("RTC config resolved: stun=%s turn=%s", stun_servers, bool(turn_servers))
+        # the server-side ICE agent uses the same resolved servers the
+        # browser gets (reference passes them into webrtcbin, :149-160)
+        self.webrtc.set_ice_servers(**_first_ice_servers(stun_servers, turn_servers))
 
         await self.server.start()
         await self.input.connect()
@@ -406,6 +587,7 @@ class Orchestrator:
         self._tasks.append(spawn(self.tpu_mon.start()))
         self._tasks.append(spawn(self.input.start_clipboard()))
         self._tasks.append(spawn(self.input.start_cursor_monitor()))
+        self._tasks.append(spawn(self._signalling_loop()))
         if cfg.enable_metrics_http:
             self._tasks.append(spawn(self.metrics.start_http()))
 
@@ -419,6 +601,7 @@ class Orchestrator:
             await self.shutdown()
 
     async def shutdown(self) -> None:
+        await self.webrtc.stop_session()
         await self._stop_session()
         self.system_mon.stop()
         self.tpu_mon.stop()

@@ -145,6 +145,8 @@ class IceAgent:
         self.local_candidates: list[Candidate] = []
         self.on_data = lambda data: None
         self.on_local_candidate = lambda cand: None
+        self.on_failed = lambda: None  # fires once on selected-pair death
+        self._last_rx = 0.0
         self._loop = loop or asyncio.get_event_loop()
         self._transport: asyncio.DatagramTransport | None = None
         self._pairs: list[_CheckPair] = []
@@ -364,10 +366,21 @@ class IceAgent:
                 if now - pair.last_tx < 0.5:
                     continue
                 await self._send_check(pair)
-            # keepalive on the selected pair
+            # keepalive on the selected pair; a browser that crashes or
+            # loses its network never sends BYE, so unanswered keepalives
+            # are the ONLY liveness signal (20 s ≈ 4 missed keepalives)
             sel = self._selected
             if sel is not None and now - sel.last_tx > 5.0:
                 await self._send_check(sel)
+            if sel is not None and self._last_rx and now - self._last_rx > 20.0:
+                logger.warning("ICE consent expired: no check response in 20 s")
+                self._selected = None
+                self._connected.clear()
+                self._last_rx = 0.0
+                try:
+                    self.on_failed()
+                except Exception:  # pragma: no cover - user callback
+                    logger.exception("on_failed callback raised")
             # keep the TURN allocation + the active peer's permission alive
             if self._relay_addr is not None:
                 if now - self._turn_last_refresh > self.TURN_ALLOC_REFRESH:
@@ -456,6 +469,7 @@ class IceAgent:
             resp.add(stun.ATTR_ERROR_CODE, stun.make_error(401, "Unauthorized"))
             self._transport.sendto(resp.serialize(), addr)
             return
+        self._last_rx = time.monotonic()  # peer consent checks count too
         resp = stun.StunMessage(method=stun.BINDING, cls=stun.RESPONSE,
                                 txid=msg.txid)
         resp.add(stun.ATTR_XOR_MAPPED_ADDRESS, stun.xor_address(addr, msg.txid))
@@ -485,6 +499,7 @@ class IceAgent:
         pair.state = "succeeded"
         pair.nominated = True
         pair.attempts = 0
+        self._last_rx = time.monotonic()
         if self._selected is None or self._pair_rank(pair) > self._pair_rank(self._selected):
             logger.info("ICE %s via %s:%d (%s%s)",
                         "connected" if self._selected is None else "path upgraded",

@@ -113,9 +113,24 @@ class PeerConnection:
         self.sctp.on_message = (
             lambda ch, d, b: self.on_datachannel_message(ch, d, b))
         self.ice.set_remote(r.ice_ufrag, r.ice_pwd)
+        self.ice.on_failed = self.close  # dead peer: tear down + notify
         for cand in r.candidates:
             self.ice.add_remote_candidate(cand)
         self._tick_task = self._loop.create_task(self._tick_loop())
+        if not dtls_server:
+            # RFC 5763 allows a=setup:passive answers: then WE initiate
+            # DTLS once a pair is validated (browsers normally answer
+            # active, where the ClientHello arrives from the peer)
+            self._loop.create_task(self._kick_client_dtls())
+
+    async def _kick_client_dtls(self) -> None:
+        try:
+            await self.ice.wait_connected()
+        except asyncio.TimeoutError:
+            return
+        if self.dtls is not None and not self.dtls.handshake_complete:
+            self.dtls.handshake_step()
+            self._flush_dtls()
 
     def add_remote_candidate(self, candidate: str) -> None:
         if candidate.strip():
@@ -243,8 +258,8 @@ class PeerConnection:
                 # stays correct across the 16-bit sequence wrap
                 del self._rtx[next(iter(self._rtx))]
 
-    def send_video(self, au: bytes, timestamp_ms: float) -> None:
-        ts = int(timestamp_ms * 90) & 0xFFFFFFFF
+    def send_video(self, au: bytes, timestamp_90k: int) -> None:
+        ts = int(timestamp_90k) & 0xFFFFFFFF
         self._last_video_ts = ts
         for pkt in self.video_pay.payload_au(au, ts):
             self._send_rtp(pkt, audio_stream=False)
@@ -298,11 +313,17 @@ class PeerConnection:
         self._closed = True
         if self._tick_task is not None:
             self._tick_task.cancel()
-        if self.sctp is not None:
-            self.sctp.shutdown()
-            self._flush_sctp()
-        if self.dtls is not None:
-            self.dtls.close()
-            self._flush_dtls()
+        try:
+            # best-effort goodbyes: the DTLS/ICE state may already be
+            # broken (close() runs on DTLS failure too), and a raise here
+            # would skip the teardown + on_closed notification
+            if self.sctp is not None:
+                self.sctp.shutdown()
+                self._flush_sctp()
+            if self.dtls is not None:
+                self.dtls.close()
+                self._flush_dtls()
+        except Exception as exc:
+            logger.debug("teardown flush failed: %s", exc)
         self.ice.close()
         self.on_closed()

@@ -1,0 +1,165 @@
+"""WebRTCTransport — the app-facing Transport over a PeerConnection.
+
+Mirrors WebSocketTransport's surface (transport/websocket.py) so the
+pipeline app and orchestrator treat both byte planes identically:
+send_video/send_audio sinks, the data-channel string plane, connect /
+disconnect lifecycle, and GCC feedback taps. SDP/ICE flows through the
+on_sdp/on_ice callbacks (wired to the in-process SignallingClient) and
+set_remote_sdp/add_remote_ice (called by the app core, pipeline/app.py
+set_sdp/set_ice — the methods the round-1 review called dead stubs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Awaitable, Callable
+
+from selkies_tpu.transport.webrtc.peer import PeerConnection
+
+logger = logging.getLogger("transport.webrtc")
+
+
+class WebRTCTransport:
+    def __init__(self, *, codec: str = "h264", audio: bool = True,
+                 stun_server: tuple[str, int] | None = None,
+                 turn_server: tuple[str, int] | None = None,
+                 turn_username: str = "", turn_password: str = ""):
+        self._kw = dict(codec=codec, audio=audio, stun_server=stun_server,
+                        turn_server=turn_server, turn_username=turn_username,
+                        turn_password=turn_password)
+        self.pc: PeerConnection | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._input_ch = None
+        self.frames_sent = 0
+        self.bytes_sent = 0
+        # outgoing signalling
+        self.on_sdp: Callable[[str, str], Any] = lambda t, s: None
+        self.on_ice: Callable[[int, str], Any] = lambda m, c: None
+        # session lifecycle + data plane (same names as WebSocketTransport)
+        self.on_connect: Callable[[], Any] = lambda: None
+        self.on_disconnect: Callable[[], Any] = lambda: None
+        self.on_data_message: Callable[[str], Awaitable[None] | None] = lambda m: None
+        # GCC taps (per RTP packet, transport-wide-cc feedback)
+        self.on_video_sent: Callable[[int, float, int], None] = lambda seq, ms, size: None
+        self.on_video_acked: Callable[[int, float], None] = lambda seq, ms: None
+        self.on_loss: Callable[[float], None] = lambda fraction: None
+        self.on_force_keyframe: Callable[[], None] = lambda: None
+
+    @property
+    def connected(self) -> bool:
+        return self.pc is not None and self.pc.connected
+
+    def set_ice_servers(self, *, stun_server=None, turn_server=None,
+                        turn_username: str = "", turn_password: str = "") -> None:
+        """Late-bind the resolved STUN/TURN servers (the credential chain
+        resolves after construction); applies to the NEXT peer."""
+        self._kw.update(stun_server=stun_server, turn_server=turn_server,
+                        turn_username=turn_username, turn_password=turn_password)
+
+    # -- session lifecycle -------------------------------------------
+
+    async def start_session(self) -> None:
+        """Create the peer, gather, and emit the offer + candidates."""
+        await self.stop_session()
+        self._loop = asyncio.get_running_loop()
+        pc = PeerConnection(loop=self._loop, **self._kw)
+        self.pc = pc
+        pc.on_force_keyframe = lambda: self.on_force_keyframe()
+        pc.on_packet_sent = lambda seq, ms, size: self.on_video_sent(seq, ms, size)
+        pc.on_packet_acked = lambda seq, ms: self.on_video_acked(seq, ms)
+        pc.on_loss = lambda f: self.on_loss(f)
+        pc.on_datachannel = self._on_channel
+        pc.on_datachannel_message = self._on_dc_message
+        pc.on_closed = self._on_pc_closed
+        offer = await pc.create_offer()
+        await _maybe_await(self.on_sdp("offer", offer))
+        for cand in pc.ice.local_candidates:
+            await _maybe_await(self.on_ice(0, cand.to_sdp()))
+
+    async def stop_session(self) -> None:
+        if self.pc is not None:
+            pc, self.pc = self.pc, None
+            self._input_ch = None
+            pc.close()
+
+    def _on_pc_closed(self) -> None:
+        if self.pc is not None:  # unexpected teardown (DTLS failure, BYE)
+            self.pc = None
+            self._input_ch = None
+            _schedule(self._loop, self.on_disconnect)
+
+    # -- signalling in ------------------------------------------------
+
+    def set_remote_sdp(self, sdp_type: str, sdp: str) -> None:
+        if self.pc is None or sdp_type != "answer":
+            return
+        asyncio.ensure_future(self.pc.set_answer(sdp))
+
+    def add_remote_ice(self, mlineindex: int, candidate: str) -> None:
+        if self.pc is not None and candidate:
+            self.pc.add_remote_candidate(candidate)
+
+    # -- datachannel plane -------------------------------------------
+
+    def _on_channel(self, ch) -> None:
+        logger.info("datachannel %r open (stream %d)", ch.label, ch.stream_id)
+        if ch.label == "input" or self._input_ch is None:
+            self._input_ch = ch
+            _schedule(self._loop, self.on_connect)
+
+    def _on_dc_message(self, ch, data: bytes, binary: bool) -> None:
+        if binary:
+            return  # client control plane is text
+        result = self.on_data_message(data.decode("utf-8", "replace"))
+        if asyncio.iscoroutine(result):
+            asyncio.ensure_future(result)
+
+    @property
+    def data_channel_ready(self) -> bool:
+        return self.pc is not None and self._input_ch is not None and self.pc.connected
+
+    def send_data_channel(self, message: str) -> None:
+        pc, ch, loop = self.pc, self._input_ch, self._loop
+        if pc is None or ch is None or loop is None:
+            return
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is loop:
+            pc.send_datachannel(ch, message.encode())
+        else:  # worker threads (monitors) hop onto the loop
+            loop.call_soon_threadsafe(
+                lambda: pc.send_datachannel(ch, message.encode()))
+
+    # -- media sinks --------------------------------------------------
+
+    async def send_video(self, ef) -> None:
+        if self.pc is None or not self.pc.connected:
+            return
+        self.pc.send_video(ef.au, ef.timestamp_90k)
+        self.frames_sent += 1
+        self.bytes_sent += len(ef.au)
+
+    async def send_audio(self, ea) -> None:
+        if self.pc is None or not self.pc.connected:
+            return
+        self.pc.send_audio(ea.packet, ea.timestamp_48k)
+
+
+async def _maybe_await(result: Any) -> None:
+    if asyncio.iscoroutine(result):
+        await result
+
+
+def _schedule(loop: asyncio.AbstractEventLoop | None, cb: Callable[[], Any]) -> None:
+    def run() -> None:
+        result = cb()
+        if asyncio.iscoroutine(result):
+            asyncio.ensure_future(result)
+
+    if loop is not None:
+        loop.call_soon(run)
+    else:  # pragma: no cover - callbacks before start_session
+        run()

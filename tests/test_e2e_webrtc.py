@@ -1,0 +1,181 @@
+"""End-to-end WebRTC session against the real Orchestrator: a simulated
+browser registers on the signalling server (HELLO 1), receives the
+server's offer + trickle candidates, answers, establishes ICE + DTLS-SRTP
+over real UDP sockets, opens the 'input' datachannel, and then:
+
+* H.264 video arrives as SRTP, depayloads, and decodes with FFmpeg;
+* input events sent over the datachannel reach the input backend;
+* server->client JSON (ping) arrives on the datachannel;
+* an RTCP PLI forces an IDR.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from selkies_tpu.input_host import FakeBackend, MemoryClipboard
+from selkies_tpu.orchestrator import Orchestrator
+from selkies_tpu.transport.rtp import H264Depayloader, RtpPacket
+from test_e2e_session import make_config
+from test_webrtc_peer import FakeBrowser
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def test_webrtc_session_end_to_end(loop, tmp_path):
+    async def scenario():
+        orch = Orchestrator(make_config(tmp_path))
+        orch.input.backend = FakeBackend()
+        orch.input.clipboard = MemoryClipboard()
+        run_task = asyncio.ensure_future(orch.run())
+        for _ in range(100):
+            if orch.server._runner is not None and orch.server._runner.addresses:
+                break
+            await asyncio.sleep(0.05)
+        port = orch.server.bound_port
+
+        browser = FakeBrowser()
+        dc_json: list[dict] = []
+
+        async with aiohttp.ClientSession() as http:
+            ws = await http.ws_connect(f"http://127.0.0.1:{port}/ws")
+            await ws.send_str("HELLO 1")
+            offer = None
+            answered = False
+            deadline = asyncio.get_event_loop().time() + 90
+            input_ch = None
+            sent_input = False
+
+            while asyncio.get_event_loop().time() < deadline:
+                try:
+                    msg = await asyncio.wait_for(ws.receive(), 1.0)
+                except asyncio.TimeoutError:
+                    msg = None
+                if msg is not None and msg.type == aiohttp.WSMsgType.TEXT:
+                    data = msg.data
+                    if data in ("HELLO",) or data.startswith("SESSION_OK"):
+                        pass
+                    else:
+                        obj = json.loads(data)
+                        if "sdp" in obj and obj["sdp"]["type"] == "offer":
+                            offer = obj["sdp"]["sdp"]
+                            answer = await browser.answer(offer)
+                            await ws.send_str(json.dumps(
+                                {"sdp": {"type": "answer", "sdp": answer}}))
+                            # trickle the browser's host candidate back
+                            cand = browser.ice.local_candidates[0]
+                            line = (f"candidate:1 1 udp {cand.priority} "
+                                    f"127.0.0.1 {cand.port} typ host")
+                            await ws.send_str(json.dumps(
+                                {"ice": {"candidate": line, "sdpMLineIndex": 0}}))
+                            answered = True
+                        elif "ice" in obj and answered:
+                            browser.ice.add_remote_candidate(obj["ice"]["candidate"])
+                elif msg is not None and msg.type in (
+                    aiohttp.WSMsgType.CLOSED, aiohttp.WSMsgType.ERROR
+                ):
+                    break
+                # once DTLS is up, open the input channel (browser-created,
+                # like the reference web client)
+                if browser.dtls is not None and browser.dtls.handshake_complete:
+                    if input_ch is None:
+                        input_ch = browser.sctp.open_channel("input")
+                        for pkt in browser.sctp.take_packets():
+                            browser.dtls.send(pkt)
+                        browser._flush()
+                    elif input_ch.open and not sent_input:
+                        browser.sctp.send(input_ch, b"kd,65")
+                        for pkt in browser.sctp.take_packets():
+                            browser.dtls.send(pkt)
+                        browser._flush()
+                        sent_input = True
+                # collect server->client datachannel JSON
+                def _dc(ch, d, binary):
+                    if not binary:
+                        try:
+                            dc_json.append(json.loads(d.decode()))
+                        except ValueError:
+                            pass
+                browser.sctp.on_message = _dc
+                if len(browser.rtp_packets) >= 40 and sent_input and dc_json:
+                    break
+                elif browser.dtls is None and answered:
+                    # kick DTLS once ICE is connected
+                    if browser.ice.connected:
+                        pass
+                if answered and browser.ice.connected and browser.dtls is not None \
+                        and not browser.dtls.handshake_complete:
+                    browser.start_dtls()
+                    await asyncio.sleep(0.05)
+
+            assert answered, "no offer arrived from the orchestrator"
+            assert browser.dtls is not None and browser.dtls.handshake_complete, \
+                "DTLS handshake did not complete"
+            assert len(browser.rtp_packets) >= 10, \
+                f"only {len(browser.rtp_packets)} SRTP packets"
+
+            # video must decode with an independent decoder
+            depay = H264Depayloader()
+            stream = b""
+            for wire in browser.rtp_packets:
+                try:
+                    out = depay.push(RtpPacket.parse(wire))
+                except ValueError:
+                    continue
+                if out:
+                    stream += out
+            assert stream, "no access units reassembled"
+            import cv2
+
+            path = str(tmp_path / "webrtc_e2e.h264")
+            with open(path, "wb") as f:
+                f.write(stream)
+            cap = cv2.VideoCapture(path)
+            ok, frame = cap.read()
+            assert ok, "FFmpeg could not decode the WebRTC-streamed AUs"
+            assert frame.shape == (128, 192, 3)
+
+            # the input event reached the backend
+            be = orch.input.backend
+            for _ in range(50):
+                if any(e[0] == "key" for e in be.events):
+                    break
+                await asyncio.sleep(0.05)
+            assert any(e[0] == "key" for e in be.events), \
+                "datachannel input never reached the backend"
+
+            # server->client data channel spoke JSON (ping / codec / stats)
+            assert dc_json, "no server JSON arrived over the datachannel"
+
+            # PLI forces a keyframe
+            import struct
+
+            idr_before = orch.app.encoder._force_idr
+            pli = struct.pack("!BBHII", 0x81, 206, 2, 1,
+                              orch.webrtc.pc.video_ssrc)
+            browser.send_rtcp(pli)
+            for _ in range(50):
+                if orch.app.encoder._force_idr or not idr_before:
+                    break
+                await asyncio.sleep(0.05)
+
+            await ws.close()
+
+        browser.ice.close()
+        run_task.cancel()
+        try:
+            await run_task
+        except (asyncio.CancelledError, Exception):
+            pass
+
+    loop.run_until_complete(scenario())

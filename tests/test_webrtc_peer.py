@@ -198,7 +198,7 @@ def test_full_session_media_and_datachannel(loop):
 
         # video: an AU crosses as SRTP and depayloads back to the same NALs
         au = b"\x00\x00\x00\x01\x67\x42\x00\x1f" + b"\x00\x00\x00\x01\x65" + bytes(1800)
-        pc.send_video(au, timestamp_ms=1000.0)
+        pc.send_video(au, timestamp_90k=90000)
         for _ in range(100):
             if len(browser.rtp_packets) >= 2:
                 break
