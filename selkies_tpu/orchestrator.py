@@ -437,7 +437,10 @@ class Orchestrator:
 
     async def _on_client_webrtc_stats(self, stat_type: str, stats_json: str) -> None:
         await self.metrics.set_webrtc_stats(stat_type, stats_json)
-        if self.gcc is not None and stat_type == "_stats_video":
+        # RTCP receiver reports already feed loss on the WebRTC plane
+        # (webrtc.on_loss); counting the stats upload too would apply
+        # the multiplicative back-off twice for the same packets
+        if self.gcc is not None and stat_type == "_stats_video" and not self.webrtc.connected:
             counters = _loss_counters(stats_json)
             if counters is not None:
                 lost, received = counters

@@ -22,6 +22,8 @@ from typing import Any, Awaitable, Callable
 
 import aiohttp
 
+from selkies_tpu.utils.aio import maybe_await as _maybe_await
+
 logger = logging.getLogger("signalling.client")
 
 
@@ -31,11 +33,6 @@ class SignallingError(Exception):
 
 class SignallingErrorNoPeer(SignallingError):
     pass
-
-
-async def _maybe_await(result: Any) -> None:
-    if asyncio.iscoroutine(result):
-        await result
 
 
 class SignallingClient:

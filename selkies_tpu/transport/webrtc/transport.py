@@ -16,6 +16,7 @@ import logging
 from typing import Any, Awaitable, Callable
 
 from selkies_tpu.transport.webrtc.peer import PeerConnection
+from selkies_tpu.utils.aio import maybe_await as _maybe_await
 
 logger = logging.getLogger("transport.webrtc")
 
@@ -146,11 +147,6 @@ class WebRTCTransport:
         if self.pc is None or not self.pc.connected:
             return
         self.pc.send_audio(ea.packet, ea.timestamp_48k)
-
-
-async def _maybe_await(result: Any) -> None:
-    if asyncio.iscoroutine(result):
-        await result
 
 
 def _schedule(loop: asyncio.AbstractEventLoop | None, cb: Callable[[], Any]) -> None:

@@ -21,21 +21,77 @@
   let serverLatency = 0;
   let cursorStyleEl = null;
 
+  const videoEl = document.getElementById("screen-video");
+  // two byte planes, same protocol: WebRTC preferred (SRTP/UDP media +
+  // RTCDataChannel control), the /media WebSocket as fallback
   const media = new SelkiesMedia(canvas, onChannelMessage, onMediaEvent);
-  const input = new SelkiesInput(canvas, (msg) => media.send(msg));
+  let rtc = null;
+  let plane = media;            // where input/control messages go
+  let wsStarted = false;
+  const input = new SelkiesInput(canvas, (msg) => plane.send(msg));
+
+  function sendInitialPrefs() {
+    // initial client prefs (reference: _arg_fps/_arg_resize on connect)
+    const fps = store.get("framerate", null);
+    if (fps) plane.send(`_arg_fps,${fps}`);
+    const resizePref = store.get("resize", null);
+    if (resizePref !== null) {
+      const res = `${Math.round(innerWidth * devicePixelRatio)}x${Math.round(innerHeight * devicePixelRatio)}`;
+      plane.send(`_arg_resize,${resizePref},${res}`);
+    }
+  }
+
+  function useElement(el, other) {
+    el.style.display = "";
+    other.style.display = "none";
+    input.detach();
+    input.canvas = el;
+    input.attach();
+    el.focus && el.focus();
+  }
+
+  function startWs() {
+    if (wsStarted) return;
+    wsStarted = true;
+    const proto = location.protocol === "https:" ? "wss:" : "ws:";
+    media.connect(`${proto}//${location.host}/media`);
+  }
+
+  function startRtc() {
+    if (!window.RTCPeerConnection || !window.SelkiesWebRTC) { startWs(); return; }
+    rtc = new SelkiesWebRTC(videoEl, onChannelMessage, onRtcEvent);
+    rtc.connect();
+    const attempt = rtc;          // a stale timer must not kill a newer attempt
+    setTimeout(() => {
+      if (attempt === rtc && !attempt.connected) { attempt.close(); startWs(); }
+    }, 8000);
+  }
+
+  function onRtcEvent(ev) {
+    if (ev.event === "open") {
+      plane = rtc;
+      statusEl.textContent = "connected (webrtc)";
+      useElement(videoEl, canvas);
+      // autoplay policy forces muted playback; restore audio on the
+      // first user gesture (reference plays after interaction too)
+      const unmute = () => { videoEl.muted = false; };
+      window.addEventListener("pointerdown", unmute, { once: true });
+      window.addEventListener("keydown", unmute, { once: true });
+      sendInitialPrefs();
+    } else if (ev.event === "failed" || ev.event === "close") {
+      plane = media;
+      useElement(canvas, videoEl);
+      startWs();
+      setTimeout(startRtc, 3000);   // the server re-offers on reconnect
+    }
+  }
 
   function onMediaEvent(ev) {
+    if (plane !== media && ev.event !== "open") return;
     statusEl.textContent = ev.event === "open" ? "connected" : "reconnecting…";
-    if (ev.event === "open") {
+    if (ev.event === "open" && plane === media) {
       input.attach();
-      // initial client prefs (reference: _arg_fps/_arg_resize on connect)
-      const fps = store.get("framerate", null);
-      if (fps) media.send(`_arg_fps,${fps}`);
-      const resizePref = store.get("resize", null);
-      if (resizePref !== null) {
-        const res = `${Math.round(innerWidth * devicePixelRatio)}x${Math.round(innerHeight * devicePixelRatio)}`;
-        media.send(`_arg_resize,${resizePref},${res}`);
-      }
+      sendInitialPrefs();
     }
   }
 
@@ -144,20 +200,20 @@
   fpsSel.value = store.get("framerate", "60");
   fpsSel.addEventListener("change", () => {
     store.set("framerate", fpsSel.value);
-    media.send(`_arg_fps,${fpsSel.value}`);
+    plane.send(`_arg_fps,${fpsSel.value}`);
   });
   const resizeChk = document.getElementById("set-resize");
   resizeChk.checked = store.get("resize", "true") === "true";
   resizeChk.addEventListener("change", () => {
     store.set("resize", String(resizeChk.checked));
     const res = `${Math.round(innerWidth * devicePixelRatio)}x${Math.round(innerHeight * devicePixelRatio)}`;
-    media.send(`_arg_resize,${resizeChk.checked},${res}`);
+    plane.send(`_arg_resize,${resizeChk.checked},${res}`);
   });
   const vbSel = document.getElementById("set-vb");
   vbSel.value = store.get("videoBitRate", "8000");
   vbSel.addEventListener("change", () => {
     store.set("videoBitRate", vbSel.value);
-    media.send(`vb,${vbSel.value}`);
+    plane.send(`vb,${vbSel.value}`);
   });
   const plChk = document.getElementById("set-pointerlock");
   plChk.addEventListener("change", () => {
@@ -183,9 +239,6 @@
     navigator.serviceWorker.register("sw.js").catch(() => {});
   }
 
-  const proto = location.protocol === "https:" ? "wss:" : "ws:";
-  fetch("./turn").catch(() => null).finally(() => {
-    media.connect(`${proto}//${location.host}/media`);
-  });
+  startRtc();
   canvas.focus();
 })();

@@ -5,7 +5,7 @@
 
 const CACHE = "selkies-tpu-v1";
 const SHELL = [
-  ".", "index.html", "app.js", "input.js", "media.js", "keysyms.js",
+  ".", "index.html", "app.js", "input.js", "media.js", "webrtc.js", "keysyms.js",
   "manifest.json",
 ];
 

@@ -1,0 +1,174 @@
+/* WebRTC media plane: signalling (/ws) + RTCPeerConnection playback.
+ *
+ * Counterpart of the reference client's signalling.js + webrtc.js
+ * (addons/gst-web/src): registers as peer 1 (HELLO), answers the
+ * server's offer, trickles ICE both ways, renders the incoming video
+ * track into a <video> element, and carries the input/control protocol
+ * on an RTCDataChannel named "input".  Exposes the same facade as
+ * SelkiesMedia (connect/send/onMessage/onStats) so the app shell can
+ * fall back to the WS plane when negotiation fails.
+ */
+"use strict";
+
+class SelkiesWebRTC {
+  constructor(videoEl, onMessage, onStats) {
+    this.videoEl = videoEl;
+    this.onMessage = onMessage;
+    this.onStats = onStats || (() => {});
+    this.ws = null;
+    this.pc = null;
+    this.dc = null;
+    this.connected = false;
+    this.closed = false;
+    this.bytesReceived = 0;
+    this.framesDecoded = 0;
+    this.framesDropped = 0;
+    this._statsTimer = null;
+    this._pendingCandidates = [];
+  }
+
+  async connect() {
+    let iceServers = [];
+    try {
+      const cfg = await (await fetch("./turn")).json();
+      iceServers = cfg.iceServers || [];
+    } catch (e) { /* STUN-less LAN still works via host candidates */ }
+    const proto = location.protocol === "https:" ? "wss:" : "ws:";
+    this.ws = new WebSocket(`${proto}//${location.host}/ws`);
+    this.ws.onopen = () => {
+      const meta = {
+        res: `${Math.round(innerWidth * devicePixelRatio)}x${Math.round(innerHeight * devicePixelRatio)}`,
+        scale: devicePixelRatio,
+      };
+      this.ws.send(`HELLO 1 ${btoa(JSON.stringify(meta))}`);
+    };
+    this.ws.onclose = () => {
+      if (!this.closed && !this.connected) this._fail("signalling closed");
+    };
+    this.ws.onmessage = (ev) => this._signal(ev.data, iceServers);
+  }
+
+  _signal(data, iceServers) {
+    if (data === "HELLO" || data.startsWith("SESSION_OK")) return;
+    if (data.startsWith("ERROR")) { console.warn("signalling:", data); return; }
+    let obj;
+    try { obj = JSON.parse(data); } catch (e) { return; }
+    if (obj.sdp && obj.sdp.type === "offer") this._onOffer(obj.sdp, iceServers);
+    else if (obj.ice) this._onRemoteIce(obj.ice);
+  }
+
+  async _onOffer(desc, iceServers) {
+    if (this.pc) {
+      // detach the old peer's handlers first: its dc.onclose firing
+      // during close() must not tear down the replacement
+      if (this.dc) { this.dc.onclose = null; this.dc.onmessage = null; }
+      this.pc.onconnectionstatechange = null;
+      this.pc.ontrack = null;
+      this.pc.close();
+      this.connected = false;
+    }
+    const pc = new RTCPeerConnection({ iceServers });
+    this.pc = pc;
+    pc.ontrack = (ev) => {
+      if (ev.track.kind === "video" || !this.videoEl.srcObject) {
+        this.videoEl.srcObject = ev.streams[0] || new MediaStream([ev.track]);
+        this.videoEl.play().catch(() => {});
+      }
+    };
+    pc.onicecandidate = (ev) => {
+      if (ev.candidate && this.ws.readyState === WebSocket.OPEN) {
+        this.ws.send(JSON.stringify({ ice: {
+          candidate: ev.candidate.candidate,
+          sdpMLineIndex: ev.candidate.sdpMLineIndex || 0,
+        }}));
+      }
+    };
+    pc.onconnectionstatechange = () => {
+      if (pc.connectionState === "failed" || pc.connectionState === "closed") {
+        this._fail(`peer connection ${pc.connectionState}`);
+      }
+    };
+    const dc = pc.createDataChannel("input", { ordered: true });
+    this.dc = dc;
+    dc.onopen = () => {
+      this.connected = true;
+      this.onStats({ event: "open" });
+      this._startStats();
+    };
+    dc.onmessage = (ev) => {
+      try {
+        const obj = JSON.parse(ev.data);
+        if (obj.type === "codec") return;  // track decode is codec-agnostic
+        this.onMessage(obj);
+      } catch (e) { console.warn(e); }
+    };
+    dc.onclose = () => { if (this.connected) this._fail("datachannel closed"); };
+    await pc.setRemoteDescription(desc);
+    for (const c of this._pendingCandidates) await this._addIce(c);
+    this._pendingCandidates = [];
+    const answer = await pc.createAnswer();
+    await pc.setLocalDescription(answer);
+    this.ws.send(JSON.stringify({ sdp: { type: "answer", sdp: answer.sdp } }));
+  }
+
+  async _onRemoteIce(ice) {
+    if (!this.pc || !this.pc.remoteDescription) {
+      this._pendingCandidates.push(ice);
+      return;
+    }
+    await this._addIce(ice);
+  }
+
+  async _addIce(ice) {
+    try {
+      await this.pc.addIceCandidate({
+        candidate: ice.candidate, sdpMLineIndex: ice.sdpMLineIndex || 0, sdpMid: "video0",
+      });
+    } catch (e) { console.debug("addIceCandidate:", e); }
+  }
+
+  /* RTC stats upload loop (reference app.js:456-537): inbound-rtp
+   * reports feed the server's loss-based congestion controller. */
+  _startStats() {
+    this._statsTimer = setInterval(async () => {
+      if (!this.pc) return;
+      try {
+        const stats = await this.pc.getStats();
+        const reports = [];
+        stats.forEach((r) => {
+          // video-only: the server's loss-based controller reads the
+          // first inbound-rtp report, and audio counters would skew it
+          if ((r.type === "inbound-rtp" && r.kind === "video") ||
+              r.type === "candidate-pair") reports.push(r);
+          if (r.type === "inbound-rtp" && r.kind === "video") {
+            this.framesDecoded = r.framesDecoded || 0;
+            this.framesDropped = r.framesDropped || 0;
+            this.bytesReceived = r.bytesReceived || 0;
+          }
+        });
+        this.send(`_stats_video,${JSON.stringify(reports)}`);
+      } catch (e) { /* stats are best-effort */ }
+    }, 5000);
+  }
+
+  send(msg) {
+    if (this.dc && this.dc.readyState === "open") this.dc.send(msg);
+  }
+
+  _fail(reason) {
+    if (this.closed) return;
+    console.warn("webrtc plane failed:", reason);
+    const wasConnected = this.connected;
+    this.close();
+    this.onStats({ event: wasConnected ? "close" : "failed", reason });
+  }
+
+  close() {
+    this.closed = true;
+    this.connected = false;
+    if (this._statsTimer) clearInterval(this._statsTimer);
+    if (this.dc) try { this.dc.close(); } catch (e) {}
+    if (this.pc) try { this.pc.close(); } catch (e) {}
+    if (this.ws) try { this.ws.close(); } catch (e) {}
+  }
+}
