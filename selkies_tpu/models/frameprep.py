@@ -116,14 +116,10 @@ class FramePrep:
         # capture converts — each convert() writes a different slot, so
         # nslots must cover every possibly-in-flight upload plus one
         self._nslots = max(2, int(nslots))
-        self._bufs = [
-            (
-                np.empty((pad_h, pad_w), np.uint8),
-                np.empty((pad_h // 2, pad_w // 2), np.uint8),
-                np.empty((pad_h // 2, pad_w // 2), np.uint8),
-            )
-            for _ in range(self._nslots)
-        ]
+        # conversion slots allocate lazily: change-detection-only users
+        # (the VP9 hybrid row) never call convert() and would otherwise
+        # carry ~6 MB of dead plane buffers per encoder
+        self._bufs: list | None = None
         self._slot = 0
         self._prev: np.ndarray | None = None
         self.nbands = (height + BAND_ROWS - 1) // BAND_ROWS
@@ -142,6 +138,15 @@ class FramePrep:
             raise ValueError(f"frame {frame.shape} != {(self.height, self.width, 4)}")
         if not frame.flags["C_CONTIGUOUS"]:
             frame = np.ascontiguousarray(frame)
+        if self._bufs is None:
+            self._bufs = [
+                (
+                    np.empty((self.pad_h, self.pad_w), np.uint8),
+                    np.empty((self.pad_h // 2, self.pad_w // 2), np.uint8),
+                    np.empty((self.pad_h // 2, self.pad_w // 2), np.uint8),
+                )
+                for _ in range(self._nslots)
+            ]
         y, u, v = self._bufs[self._slot]
         self._slot = (self._slot + 1) % self._nslots
         if self._lib is not None:

@@ -115,7 +115,12 @@ def _level_bits(level_code, suffix_len):
     """Two (value, nbits) pairs — prefix codeword and suffix — for one
     level (9.2.2.1), matching cavlc._write_level exactly. Split keeps
     every emission slot <= 28 bits (a 64-bit pack lane covers any slot
-    start within a word)."""
+    start within a word).
+
+    Extended prefixes (16+) are solved arithmetically: with
+    x = lc_adj - (15 << sl) + 2^12, prefix p covers x in
+    [2^(p-3), 2^(p-2)), so p = floor(log2 x) + 3 — a 5-step clz instead
+    of a 12-iteration search (this runs on every level of every block)."""
     lc0 = level_code
     lc_adj = jnp.where((suffix_len == 0) & (lc0 >= 30), lc0 - 15, lc0)
     sl = jnp.maximum(suffix_len, 0)
@@ -131,16 +136,13 @@ def _level_bits(level_code, suffix_len):
     b1 = jnp.where(in_esc, 16, b1)
     v2 = jnp.where(in_esc, jnp.clip(esc, 0, (1 << 12) - 1), v2)
     b2 = jnp.where(in_esc, 12, b2)
-    # extended prefixes 16+: suffix size = prefix-3
-    found = jnp.zeros_like(lc0, dtype=bool)
-    for pfx in range(16, 28):
-        base = (jnp.int32(15) << sl) + (1 << (pfx - 3)) - (1 << 12)
-        fit = (lc_adj - base) < (1 << (pfx - 3))
-        take = (prefix >= 15) & ~in_esc & fit & ~found
-        b1 = jnp.where(take, pfx + 1, b1)
-        v2 = jnp.where(take, lc_adj - base, v2)
-        b2 = jnp.where(take, pfx - 3, b2)
-        found = found | take
+    # extended prefixes 16+
+    x = jnp.maximum(esc + (1 << 12), 1)
+    nb = 31 - _clz32(x)  # floor(log2 x)
+    ext = (prefix >= 15) & ~in_esc
+    b1 = jnp.where(ext, nb + 4, b1)          # pfx + 1 = (nb + 3) + 1
+    v2 = jnp.where(ext, x - (jnp.int32(1) << nb), v2)
+    b2 = jnp.where(ext, nb, b2)              # pfx - 3
     # suffix_len==0 specials
     small = (suffix_len == 0) & (lc0 < 14)
     b1 = jnp.where(small, lc0 + 1, b1)
@@ -206,36 +208,38 @@ def _encode_blocks(coeffs, nc, chroma_dc: bool):
         vals = vals.at[:, 1 + k].set(jnp.where(use, sign, 0))
         bits = bits.at[:, 1 + k].set(jnp.where(use, 1, 0))
 
-    # levels after the trailing ones: sequential suffix_len adaptation.
-    # xs are pre-sliced (transposed) so each step is a native scan slice —
-    # a take_along_axis gather inside the body costs ~1 ms/step at frame
-    # scale.
-    def level_step(carry, xs):
+    # levels after the trailing ones. The suffix-length adaptation is the
+    # only sequential dependency (~10 ops/step in a native-xs scan); the
+    # codeword construction (_level_bits with its escape/extended-prefix
+    # logic) depends only on (level, suffix_len_before, is_first), so it
+    # runs ONCE vectorized over all (L, B) slots outside the scan.
+    def sl_step(carry, xs):
         suffix_len, first_done = carry
         level, k = xs
         use = (k >= t1) & (k < total)
-        level_code = jnp.where(level > 0, 2 * level - 2, -2 * level - 1)
         is_first = use & ~first_done
-        level_code = jnp.where(is_first & (t1 < 3), level_code - 2, level_code)
-        v1, b1, v2, b2 = _level_bits(level_code, suffix_len)
         new_sl = jnp.where(suffix_len == 0, 1, suffix_len)
         new_sl = jnp.where(
             (jnp.abs(level) > (3 << jnp.maximum(new_sl - 1, 0))) & (new_sl < 6),
             new_sl + 1,
             new_sl,
         )
-        suffix_len = jnp.where(use, new_sl, suffix_len)
-        first_done = first_done | is_first
-        return (suffix_len, first_done), (
-            jnp.where(use, v1, 0), jnp.where(use, b1, 0),
-            jnp.where(use, v2, 0), jnp.where(use, b2, 0),
-        )
+        out = (suffix_len, is_first, use)
+        return (jnp.where(use, new_sl, suffix_len), first_done | is_first), out
 
     init_sl = jnp.where((total > 10) & (t1 < 3), 1, 0)
     ks = jnp.arange(L, dtype=jnp.int32)
-    (_, _), (lv1, lb1, lv2, lb2) = jax.lax.scan(
-        level_step, (init_sl, jnp.zeros((B,), bool)), (val_rev.T, ks)
+    val_t = val_rev.T  # (L, B)
+    (_, _), (sls, firsts, uses) = jax.lax.scan(
+        sl_step, (init_sl, jnp.zeros((B,), bool)), (val_t, ks)
     )
+    level_code = jnp.where(val_t > 0, 2 * val_t - 2, -2 * val_t - 1)
+    level_code = jnp.where(firsts & (t1[None, :] < 3), level_code - 2, level_code)
+    lv1, lb1, lv2, lb2 = _level_bits(level_code, sls)
+    lv1 = jnp.where(uses, lv1, 0)
+    lb1 = jnp.where(uses, lb1, 0)
+    lv2 = jnp.where(uses, lv2, 0)
+    lb2 = jnp.where(uses, lb2, 0)
     vals = vals.at[:, 4 : 4 + 2 * L : 2].set(lv1.T)
     bits = bits.at[:, 4 : 4 + 2 * L : 2].set(lb1.T)
     vals = vals.at[:, 5 : 4 + 2 * L : 2].set(lv2.T)

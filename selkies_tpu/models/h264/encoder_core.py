@@ -485,48 +485,64 @@ def hier_me_mc(cur, ref_y, ry_pad, ru_pad, rv_pad):
     ncand = cands.shape[0]
     ranks = jnp.arange(ncand, dtype=jnp.int32)
     scale = 1 << int(np.int64(ncand - 1)).bit_length()
+    # statically unrolled chunks. NOT a vmap: batched dynamic_slice
+    # lowers to a gather (~30 ms per full plane on v5e,
+    # tools/profile_slope2.py); the unrolled Python loop keeps every
+    # shift a cheap DynamicSlice. Measured at 1080p/ncand=76: chunk=4
+    # ~= chunk=19 ~= unchunked within the tunnel's noise floor (the
+    # arithmetic, not step launches, bounds this scan) — 4 is kept for
+    # its smaller compiled body.
+    chunk = next(c for c in (4, 19, 13, 11, 7, 5, 3, 2, 1) if ncand % c == 0)
+    cands_c = cands.reshape(-1, chunk, 2)
+    ranks_c = ranks.reshape(-1, chunk)
 
     def cost_step(best_cost, xs):
-        mv, rank = xs
-        ys = jax.lax.dynamic_slice(ry_pad, (MV_PAD + mv[1], MV_PAD + mv[0]), (h, w))
-        sad = jnp.abs(cur - ys.astype(jnp.int32)).reshape(mbh, 16, mbw, 16).sum(axis=(1, 3))
-        cost = sad * scale + rank
-        return jnp.minimum(cost, best_cost), None
+        mvs_k, ranks_k = xs
+        for k in range(chunk):
+            mv = mvs_k[k]
+            ys = jax.lax.dynamic_slice(ry_pad, (MV_PAD + mv[1], MV_PAD + mv[0]), (h, w))
+            sad = jnp.abs(cur - ys.astype(jnp.int32)).reshape(mbh, 16, mbw, 16).sum(axis=(1, 3))
+            best_cost = jnp.minimum(sad * scale + ranks_k[k], best_cost)
+        return best_cost, None
 
     init_cost = jnp.full((mbh, mbw), jnp.iinfo(jnp.int32).max, jnp.int32)
-    best_cost, _ = jax.lax.scan(cost_step, init_cost, (cands, ranks))
+    best_cost, _ = jax.lax.scan(cost_step, init_cost, (cands_c, ranks_c))
     best_rank = best_cost & (scale - 1)  # cost = sad*scale + rank
 
     def pred_step(carry, xs):
         best_mv, py, pu, pv = carry
-        mv, rank = xs
-        better = best_rank == rank  # exactly one step wins per MB
-        dx, dy = mv[0], mv[1]
-        ys = jax.lax.dynamic_slice(ry_pad, (MV_PAD + dy, MV_PAD + dx), (h, w))
+        mvs_k, ranks_k = xs
+        for k in range(chunk):
+            mv, rank = mvs_k[k], ranks_k[k]
+            better = best_rank == rank  # exactly one (step, k) wins per MB
+            dx, dy = mv[0], mv[1]
+            ys = jax.lax.dynamic_slice(ry_pad, (MV_PAD + dy, MV_PAD + dx), (h, w))
 
-        # chroma prediction for this global shift (8.4.2.2.2 on the whole
-        # plane): full-pel luma MV -> chroma half-pel bilinear
-        cx, cy = jnp.right_shift(dx, 1), jnp.right_shift(dy, 1)
-        xf, yf = 4 * (dx & 1), 4 * (dy & 1)
+            # chroma prediction for this global shift (8.4.2.2.2 on the
+            # whole plane): full-pel luma MV -> chroma half-pel bilinear
+            cx, cy = jnp.right_shift(dx, 1), jnp.right_shift(dy, 1)
+            xf, yf = 4 * (dx & 1), 4 * (dy & 1)
 
-        def chroma_shift(rp):
-            s = jax.lax.dynamic_slice(rp, (MV_PAD + cy, MV_PAD + cx), (ch + 1, cw + 1)).astype(jnp.int32)
-            a, b = s[:-1, :-1], s[:-1, 1:]
-            c, d = s[1:, :-1], s[1:, 1:]
-            return jnp.right_shift(
-                (8 - xf) * (8 - yf) * a + xf * (8 - yf) * b + (8 - xf) * yf * c + xf * yf * d + 32,
-                6,
-            )
+            def chroma_shift(rp):
+                s = jax.lax.dynamic_slice(
+                    rp, (MV_PAD + cy, MV_PAD + cx), (ch + 1, cw + 1)
+                ).astype(jnp.int32)
+                a, b = s[:-1, :-1], s[:-1, 1:]
+                c, d = s[1:, :-1], s[1:, 1:]
+                return jnp.right_shift(
+                    (8 - xf) * (8 - yf) * a + xf * (8 - yf) * b
+                    + (8 - xf) * yf * c + xf * yf * d + 32,
+                    6,
+                )
 
-        us, vs = chroma_shift(ru_pad), chroma_shift(rv_pad)
-        m16 = jnp.repeat(jnp.repeat(better, 16, 0), 16, 1)
-        m8 = jnp.repeat(jnp.repeat(better, 8, 0), 8, 1)
-        return (
-            jnp.where(better[..., None], mv, best_mv),
-            jnp.where(m16, ys.astype(jnp.int32), py),
-            jnp.where(m8, us, pu),
-            jnp.where(m8, vs, pv),
-        ), None
+            us, vs = chroma_shift(ru_pad), chroma_shift(rv_pad)
+            m16 = jnp.repeat(jnp.repeat(better, 16, 0), 16, 1)
+            m8 = jnp.repeat(jnp.repeat(better, 8, 0), 8, 1)
+            best_mv = jnp.where(better[..., None], mv, best_mv)
+            py = jnp.where(m16, ys.astype(jnp.int32), py)
+            pu = jnp.where(m8, us, pu)
+            pv = jnp.where(m8, vs, pv)
+        return (best_mv, py, pu, pv), None
 
     init_pred = (
         jnp.zeros((mbh, mbw, 2), jnp.int32),
@@ -534,7 +550,7 @@ def hier_me_mc(cur, ref_y, ry_pad, ru_pad, rv_pad):
         jnp.zeros((ch, cw), jnp.int32),
         jnp.zeros((ch, cw), jnp.int32),
     )
-    (mvs, py, pu, pv), _ = jax.lax.scan(pred_step, init_pred, (cands, ranks))
+    (mvs, py, pu, pv), _ = jax.lax.scan(pred_step, init_pred, (cands_c, ranks_c))
     return mvs, py, pu, pv
 
 

@@ -77,11 +77,14 @@ def _tpuh264enc(*, width: int, height: int, fps: int = 60, qp: int = 28, **kw):
 
 
 @register("tpuvp9enc")
-def _tpuvp9enc(**kw):
-    raise NotImplementedError(
-        "tpuvp9enc is scheduled after the H.264 path (SURVEY.md §7 step 5); "
-        "use tpuh264enc (TPU) or vp9enc (libvpx software)"
-    )
+def _tpuvp9enc(*, width: int, height: int, fps: int = 60, bitrate_kbps: int = 2000, **kw):
+    """VP9 row with the framework's capture-delta front-end: unchanged
+    frames short-circuit to 1-byte show_existing_frame headers, changed
+    frames go through libvpx (see models/vp9/encoder.py for why VP9's
+    entropy back-end cannot be rebuilt from scratch in this image)."""
+    from selkies_tpu.models.vp9.encoder import TPUVP9Encoder
+
+    return TPUVP9Encoder(width=width, height=height, fps=fps, bitrate_kbps=bitrate_kbps)
 
 
 @register("vp9enc")
@@ -101,8 +104,10 @@ def _vp8enc(*, width: int, height: int, fps: int = 60, bitrate_kbps: int = 2000,
 @register("tpuav1enc")
 def _tpuav1enc(**kw):
     raise NotImplementedError(
-        "tpuav1enc is scheduled after the H.264 path (SURVEY.md §7 step 5); "
-        "use tpuh264enc"
+        "tpuav1enc: AV1's adaptive CDF entropy coder depends on normative "
+        "default tables (spec data, not derivable) and no AV1 library "
+        "exists in this image — use tpuh264enc (from-scratch TPU) or "
+        "tpuvp9enc (delta front-end + libvpx)"
     )
 
 
@@ -110,6 +115,6 @@ def _tpuav1enc(**kw):
 # the TPU equivalent so existing SELKIES_ENCODER values keep working.
 for _legacy_h264 in ("nvh264enc", "vah264enc", "x264enc", "openh264enc"):
     alias(_legacy_h264, "tpuh264enc")
-alias("vavp9enc", "vp9enc")  # libvpx software row until tpuvp9enc lands
+alias("vavp9enc", "tpuvp9enc")  # silicon VP9 row maps to the hybrid
 for _legacy_av1 in ("nvav1enc", "vaav1enc", "svtav1enc", "av1enc", "rav1enc"):
     alias(_legacy_av1, "tpuav1enc")

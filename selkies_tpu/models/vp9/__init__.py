@@ -1,0 +1,1 @@
+from selkies_tpu.models.vp9.encoder import TPUVP9Encoder, show_existing_frame  # noqa: F401

@@ -78,8 +78,12 @@ def test_leading_and_trailing_skip_runs():
 
 
 def test_big_levels_escape_paths(monkeypatch):
-    """Large coefficients exercise level escape + extended prefixes."""
+    """Large coefficients exercise level escape + extended prefixes
+    (mag 5000 pushes level_code past the esc >= 4096 threshold where the
+    clz-based extended-prefix arithmetic takes over)."""
     fc = _random_fc(2, 3, 4, 13, skip_p=0.2, mag=900)
+    _roundtrip(fc, 48, 32)
+    fc = _random_fc(2, 3, 2, 29, skip_p=0.1, mag=5000)
     _roundtrip(fc, 48, 32)
 
 

@@ -1,0 +1,102 @@
+"""Quality guard: tpuh264enc vs the software encoder row (libvpx VP9
+realtime — the reference's software fallback and the only software
+encoder in this image; x264 is absent) at matched bitrate on a desktop
+clip.
+
+This is a REGRESSION GUARD with honest margins, not a codec contest:
+VP9 typically outperforms H.264 constrained baseline by 2-4 dB at equal
+rate, so the assertion is that the TPU encoder stays within that
+expected band (and above an absolute floor) — a quantization or
+prediction regression would blow through both long before the margin.
+"""
+
+import numpy as np
+import pytest
+
+from selkies_tpu.models.libvpx_enc import libvpx_available
+
+pytestmark = pytest.mark.skipif(not libvpx_available(), reason="libvpx not present")
+
+
+def _desktop_clip(n=16, w=320, h=192):
+    """Wallpaper + text window + scrolling updates (bench.py's workload
+    at test scale)."""
+    rng = np.random.default_rng(11)
+    base = np.kron(rng.integers(40, 200, (h // 8, w // 8, 4), np.uint8),
+                   np.ones((8, 8, 1), np.uint8))
+    base[30:160, 40:280] = (246, 246, 246, 0)
+    frames = []
+    cur = base.copy()
+    for i in range(n):
+        row = 40 + (i * 12) % 100
+        glyphs = rng.integers(0, 2, (10, 40), np.uint8) * 200
+        cur[row : row + 10, 48 : 48 + 200, :3] = np.kron(
+            glyphs, np.ones((1, 5), np.uint8))[:, :200, None]
+        frames.append(cur.copy())
+    return frames
+
+
+def _psnr_seq(frames, decoded):
+    vals = []
+    for src, dec in zip(frames, decoded):
+        mse = np.mean((src[..., :3].astype(float) - dec.astype(float)) ** 2)
+        vals.append(10 * np.log10(255**2 / max(mse, 1e-9)))
+    return float(np.mean(vals))
+
+
+def _decode(path):
+    import cv2
+
+    cap = cv2.VideoCapture(path)
+    out = []
+    while True:
+        ok, f = cap.read()
+        if not ok:
+            break
+        out.append(f)
+    return out
+
+
+def test_tpuh264enc_tracks_software_vp9_quality(tmp_path):
+    from selkies_tpu.models.h264.encoder import TPUH264Encoder
+    from selkies_tpu.models.libvpx_enc import LibVpxEncoder
+    from selkies_tpu.utils.ivf import ivf_file
+
+    w, h, fps = 320, 192, 30
+    frames = _desktop_clip(16, w, h)
+
+    enc = TPUH264Encoder(w, h, qp=28, fps=fps, frame_batch=1)
+    h264 = [enc.encode_frame(f) for f in frames]
+    enc.close()
+    h264_bytes = sum(len(a) for a in h264)
+    h264_kbps = h264_bytes * 8 * fps / len(frames) / 1000
+
+    # libvpx VP9 realtime at the SAME achieved bitrate
+    vpx = LibVpxEncoder(w, h, fps=fps, bitrate_kbps=max(int(h264_kbps), 50))
+    vp9 = [vpx.encode_frame(f) for f in frames]
+    vpx.close()
+    vp9_bytes = sum(len(a) for a in vp9)
+
+    p264 = str(tmp_path / "tpu.h264")
+    with open(p264, "wb") as f:
+        f.write(b"".join(h264))
+    pvp9 = str(tmp_path / "sw.ivf")
+    with open(pvp9, "wb") as f:
+        f.write(ivf_file(vp9, "vp9", w, h, fps))
+
+    d264 = _decode(p264)
+    dvp9 = _decode(pvp9)
+    assert len(d264) == len(frames)
+    psnr_264 = _psnr_seq(frames, d264)
+    psnr_vp9 = _psnr_seq(frames, dvp9) if len(dvp9) == len(frames) else 0.0
+
+    print(f"\ntpuh264enc: {h264_bytes} B ({h264_kbps:.0f} kbps), {psnr_264:.1f} dB; "
+          f"vp9 realtime: {vp9_bytes} B, {psnr_vp9:.1f} dB")
+    # absolute floor for desktop content at this rate
+    assert psnr_264 > 33.0, f"tpuh264enc quality floor broken: {psnr_264:.1f} dB"
+    # stay within the expected H.264-baseline-vs-VP9 band at equal rate
+    if psnr_vp9 > 0:
+        assert psnr_264 > psnr_vp9 - 6.0, (
+            f"tpuh264enc {psnr_264:.1f} dB vs vp9 {psnr_vp9:.1f} dB at "
+            f"matched rate — regression beyond the codec-generation gap"
+        )

@@ -1,0 +1,93 @@
+"""tpuvp9enc hybrid: static frames become 1-byte show_existing_frame
+headers and the mixed stream stays FFmpeg-decodable and pixel-correct."""
+
+import numpy as np
+import pytest
+
+from selkies_tpu.models.libvpx_enc import libvpx_available
+from selkies_tpu.utils.ivf import ivf_file
+
+pytestmark = pytest.mark.skipif(not libvpx_available(), reason="libvpx not present")
+
+
+def _trace(n=8, w=320, h=192):
+    rng = np.random.default_rng(5)
+    base = np.kron(rng.integers(40, 200, (h // 16, w // 16, 4), np.uint8),
+                   np.ones((16, 16, 1), np.uint8))
+    frames = []
+    cur = base.copy()
+    for i in range(n):
+        if i in (2, 3, 6):
+            pass  # static frames
+        else:
+            cur[40:56, 40:200, :3] = rng.integers(0, 255, (16, 160, 1), np.uint8)
+        frames.append(cur.copy())
+    return frames
+
+
+def test_show_existing_frame_byte():
+    from selkies_tpu.models.vp9.encoder import show_existing_frame
+
+    assert show_existing_frame(0) == b"\x88"
+    assert show_existing_frame(3) == b"\x8b"
+    with pytest.raises(ValueError):
+        show_existing_frame(8)
+
+
+def test_static_frames_one_byte_and_decode(tmp_path):
+    import cv2
+
+    from selkies_tpu.models.vp9.encoder import TPUVP9Encoder
+
+    w, h = 320, 192
+    frames = _trace(8, w, h)
+    enc = TPUVP9Encoder(w, h, fps=30, bitrate_kbps=1500)
+    aus = [enc.encode_frame(f) for f in frames]
+    enc.close()
+    assert enc.static_frames == 3
+    for i in (2, 3, 6):
+        assert aus[i] == b"\x88", f"frame {i} should be show_existing_frame"
+    assert all(len(aus[i]) > 50 for i in (0, 1, 4, 5, 7))
+
+    path = str(tmp_path / "hybrid.ivf")
+    with open(path, "wb") as f:
+        f.write(ivf_file(aus, "vp9", w, h, 30))
+    cap = cv2.VideoCapture(path)
+    decoded = []
+    while True:
+        ok, f = cap.read()
+        if not ok:
+            break
+        decoded.append(f)
+    assert len(decoded) == len(frames), f"decoded {len(decoded)}/{len(frames)}"
+    # re-shown frames are pixel-identical to their predecessor
+    for i in (2, 3, 6):
+        np.testing.assert_array_equal(decoded[i], decoded[i - 1])
+    # coded frames track the source
+    for i in (0, 5):
+        src = frames[i][..., :3].astype(float)
+        psnr = 10 * np.log10(255**2 / max(1e-9, np.mean((src - decoded[i].astype(float)) ** 2)))
+        assert psnr > 25, f"frame {i} psnr {psnr:.1f}"
+
+
+def test_force_keyframe_breaks_static_run():
+    from selkies_tpu.models.vp9.encoder import TPUVP9Encoder
+
+    w, h = 320, 192
+    frames = _trace(4, w, h)
+    enc = TPUVP9Encoder(w, h, fps=30)
+    enc.encode_frame(frames[0])
+    enc.encode_frame(frames[1])
+    enc.force_keyframe()
+    au = enc.encode_frame(frames[1])  # unchanged, but a KF was demanded
+    enc.close()
+    assert len(au) > 1 and enc.static_frames == 0
+
+
+def test_registry_row():
+    from selkies_tpu.models.registry import create_encoder
+
+    enc = create_encoder("tpuvp9enc", width=320, height=192, fps=30)
+    assert enc.codec == "vp9"
+    assert type(enc).__name__ == "TPUVP9Encoder"
+    enc.close()

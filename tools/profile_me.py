@@ -1,0 +1,54 @@
+#!/usr/bin/env python3
+"""ME stage timing at 1080p: coarse vote, refine cost scan, pred scan."""
+import sys, time
+import numpy as np
+sys.path.insert(0, ".")
+import jax, jax.numpy as jnp
+from selkies_tpu.models.h264 import encoder_core as core
+from selkies_tpu.models.h264.numpy_ref import MV_PAD
+
+H, W = 1088, 1920
+rng = np.random.default_rng(0)
+cur = rng.integers(0, 255, (H, W)).astype(np.int32)
+ref = rng.integers(0, 255, (H, W)).astype(np.uint8)
+ry_pad = np.pad(ref, MV_PAD, mode="edge")
+ru_pad = np.pad(rng.integers(0, 255, (H//2, W//2), dtype=np.uint8), MV_PAD, mode="edge")
+rv_pad = np.pad(rng.integers(0, 255, (H//2, W//2), dtype=np.uint8), MV_PAD, mode="edge")
+
+curj = jax.device_put(cur); refj = jax.device_put(ref)
+ryj = jax.device_put(ry_pad); ruj = jax.device_put(ru_pad); rvj = jax.device_put(rv_pad)
+
+coarse = jax.jit(core.coarse_vote_candidates_jnp)
+full = jax.jit(core.hier_me_mc)
+
+@jax.jit
+def cost_only(cur, ry_pad, cands):
+    h, w = cur.shape
+    mbh, mbw = h // 16, w // 16
+    ncand = cands.shape[0]
+    ranks = jnp.arange(ncand, dtype=jnp.int32)
+    scale = 1 << int(np.int64(75)).bit_length()
+    def cost_step(best_cost, xs):
+        mv, rank = xs
+        ys = jax.lax.dynamic_slice(ry_pad, (MV_PAD + mv[1], MV_PAD + mv[0]), (h, w))
+        sad = jnp.abs(cur - ys.astype(jnp.int32)).reshape(mbh, 16, mbw, 16).sum(axis=(1, 3))
+        return jnp.minimum(sad * scale + rank, best_cost), None
+    init = jnp.full((mbh, mbw), jnp.iinfo(jnp.int32).max, jnp.int32)
+    bc, _ = jax.lax.scan(cost_step, init, (cands, ranks))
+    return bc
+
+tiny = jax.jit(lambda a: a.ravel()[:1])
+def sync(x):
+    if isinstance(x, tuple): x = x[0]
+    np.asarray(tiny(x))
+def t(name, f, n=10):
+    sync(f()); t0 = time.perf_counter()
+    for _ in range(n): r = f()
+    sync(r); print(f"{name:26s} {(time.perf_counter()-t0)/n*1e3:8.1f} ms")
+
+noop = jax.jit(lambda a: a + 1)
+t("noop", lambda: noop(curj))
+t("coarse_vote (289 cand)", lambda: coarse(curj, refj))
+cands = jax.device_put(np.asarray(core._refine_cands_jnp(coarse(curj, refj))))
+t("refine cost scan (76)", lambda: cost_only(curj, ryj, cands))
+t("hier_me_mc full", lambda: full(curj, refj, ryj, ruj, rvj))
