@@ -201,16 +201,19 @@ def _i_scatter_step(packed, qp, sy, su, sv):
     return prefix, buf, out["recon_y"], out["recon_u"], out["recon_v"], y, u, v
 
 
-def _p_scatter_multi_step(packed, qps, sy, su, sv, ref_y, ref_u, ref_v, *, nscap, cap):
+def _p_scatter_multi_step(packed_a, packed_b, qps, sy, su, sv, ref_y, ref_u, ref_v,
+                          *, nscap, cap):
     """K delta frames in ONE device round trip.
 
-    packed: (K, F) uint8 — K frames' band payloads (same bucket); qps:
-    (K,) int32 per-frame QP. The scan chains recon: frame k's motion
-    estimation references frame k-1's reconstruction, exactly as K
-    single steps would. One upload + one execute + one prefix fetch
-    instead of 3K relay operations — the relay prices per op, so this is
-    the difference between ~8 and ~30+ fps at 1080p
-    (tools/profile_rpc.py)."""
+    packed_a/packed_b: two (K/2, F) uint8 halves of the K frames' band
+    payloads (same bucket), uploaded CONCURRENTLY (h2d overlaps ~2.5x
+    across threads on the relay) and re-joined here; qps: (K,) int32
+    per-frame QP. The scan chains recon: frame k's motion estimation
+    references frame k-1's reconstruction, exactly as K single steps
+    would. One execute + one prefix fetch instead of 2K relay
+    operations — the relay prices per op, so this is the difference
+    between ~8 and ~30+ fps at 1080p (tools/profile_rpc.py)."""
+    packed = jnp.concatenate([packed_a, packed_b], 0)
     w = sy.shape[1]
 
     def body(carry, xs):
@@ -349,7 +352,7 @@ class TPUH264Encoder:
                 partial(_p_scatter_step, **_consts), donate_argnums=(2, 3, 4, 5, 6, 7)
             )
             self._step_scatter_pk = jax.jit(
-                partial(_p_scatter_multi_step, **_consts), donate_argnums=(2, 3, 4, 5, 6, 7)
+                partial(_p_scatter_multi_step, **_consts), donate_argnums=(3, 4, 5, 6, 7, 8)
             )
             self._step_scatter_i = jax.jit(_i_scatter_step, donate_argnums=(2, 3, 4))
             self._step_resident_i = jax.jit(_i_resident_step)
@@ -600,8 +603,13 @@ class TPUH264Encoder:
                     [self._pack_bands(yb, ub, vb, idx, bucket) for _, yb, ub, vb, idx in group]
                 )
                 qps = np.array([g[0].qp for g in group], np.int32)
+                # two concurrent half uploads (h2d overlaps across threads)
+                half = take // 2
+                pa, pb = self._upload_pool.map(
+                    jax.device_put, (packed[:half], packed[half:])
+                )
                 prefixes_d, denses_d, bufs_d, ry, ru, rv, sy, su, sv = self._step_scatter_pk(
-                    jax.device_put(packed), jax.device_put(qps), *self._src, *self._ref
+                    pa, pb, jax.device_put(qps), *self._src, *self._ref
                 )
                 self._src, self._ref = (sy, su, sv), (ry, ru, rv)
                 recs = [g[0] for g in group]
