@@ -120,10 +120,14 @@ class H264Payloader:
         nals = split_annexb(au)
         packets: list[RtpPacket] = []
         # header budget: 12-byte RTP header + 8 bytes of RFC 8285
-        # extension (transport-cc, added by the WebRTC transport) + the
-        # 10-byte SRTP auth tag — a full fragment must still fit the
-        # 1200-byte path-MTU assumption after protection
-        max_payload = self.mtu - 12 - 8 - 10
+        # extension (transport-cc) + 1-byte RED encapsulation + the
+        # 10-byte SRTP auth tag, PLUS enough slack that a ULP FEC parity
+        # packet covering a full fragment (14-byte FEC header over the
+        # ext+RED+payload region) still fits: the largest wire packet
+        # must stay inside the 1200-byte path-MTU assumption after
+        # protection. 12+8+1+10 = 31 for media; the parity packet adds
+        # 14+13 more over the protected span -> reserve 54.
+        max_payload = self.mtu - 54
 
         params: list[bytes] = []
         for nal in nals:

@@ -17,7 +17,7 @@ import struct
 import time
 
 from selkies_tpu.transport.rtp import H264Payloader, OpusPayloader, RtpPacket
-from selkies_tpu.transport.webrtc import rtcp, sdp
+from selkies_tpu.transport.webrtc import fec, rtcp, sdp
 from selkies_tpu.transport.webrtc.dtls import DtlsEndpoint, is_dtls, make_certificate
 from selkies_tpu.transport.webrtc.ice import IceAgent
 from selkies_tpu.transport.webrtc.sctp import SctpAssociation
@@ -41,6 +41,7 @@ class PeerConnection:
     """
 
     def __init__(self, *, codec: str = "h264", audio: bool = True,
+                 fec_percentage: int = 20,
                  stun_server=None, turn_server=None,
                  turn_username: str = "", turn_password: str = "",
                  loop: asyncio.AbstractEventLoop | None = None):
@@ -62,6 +63,12 @@ class PeerConnection:
         self.audio_pay = OpusPayloader(
             payload_type=sdp.AUDIO_PT, ssrc=self.audio_ssrc)
         self._remote: sdp.RemoteDescription | None = None
+        # RED/ULP FEC (reference fec-percentage=20): armed when the
+        # answer accepts both payload types
+        self.fec_percentage = int(fec_percentage)
+        self._fec: fec.FecEncoder | None = None
+        self._red_pt = sdp.RED_PT
+        self._ulpfec_pt = sdp.ULPFEC_PT
         self._connected = asyncio.Event()
         self._closed = False
         # TWCC send state
@@ -101,6 +108,9 @@ class PeerConnection:
         self._remote = r
         if r.twcc_id is not None:
             self._twcc_id = r.twcc_id
+        if self.fec_percentage > 0 and r.red_pt is not None and r.ulpfec_pt is not None:
+            self._fec = fec.FecEncoder(self.fec_percentage)
+            self._red_pt, self._ulpfec_pt = r.red_pt, r.ulpfec_pt
         # browser answers a=setup:active -> we are the DTLS server
         dtls_server = r.setup != "passive"
         self.dtls = DtlsEndpoint(
@@ -236,9 +246,11 @@ class PeerConnection:
 
     # -- media out ----------------------------------------------------
 
-    def _send_rtp(self, pkt: RtpPacket, *, audio_stream: bool) -> None:
+    def _send_rtp(self, pkt: RtpPacket, *, audio_stream: bool) -> bytes | None:
+        """Protect + send one packet; returns the pre-SRTP wire bytes
+        (what ULP FEC protects) or None when the transport isn't up."""
         if self.srtp is None or not self.ice.connected:
-            return
+            return None
         self._twcc_seq = (self._twcc_seq + 1) & 0xFFFF
         pkt.extensions = [(self._twcc_id, struct.pack("!H", self._twcc_seq))]
         wire = pkt.serialize()
@@ -257,12 +269,35 @@ class PeerConnection:
                 # dicts iterate in insertion order == send order, which
                 # stays correct across the 16-bit sequence wrap
                 del self._rtx[next(iter(self._rtx))]
+        return wire
 
     def send_video(self, au: bytes, timestamp_90k: int) -> None:
         ts = int(timestamp_90k) & 0xFFFFFFFF
         self._last_video_ts = ts
         for pkt in self.video_pay.payload_au(au, ts):
-            self._send_rtp(pkt, audio_stream=False)
+            if self._fec is not None:
+                # RED-encapsulate the media (single block, inner PT = codec)
+                pkt.payload = fec.red_wrap(sdp.VIDEO_PT, pkt.payload)
+                pkt.payload_type = self._red_pt
+            wire = self._send_rtp(pkt, audio_stream=False)
+            if self._fec is not None and wire is not None:
+                parity = self._fec.push(wire)
+                if parity is not None:
+                    self._send_fec(parity, ts)
+        if self._fec is not None:
+            parity = self._fec.flush()  # bound recovery latency to 1 frame
+            if parity is not None:
+                self._send_fec(parity, ts)
+
+    def _send_fec(self, parity: bytes, ts: int) -> None:
+        pkt = RtpPacket(
+            payload_type=self._red_pt,
+            sequence=self.video_pay._next_seq(),
+            timestamp=ts,
+            ssrc=self.video_ssrc,
+            payload=fec.red_wrap(self._ulpfec_pt, parity),
+        )
+        self._send_rtp(pkt, audio_stream=False)
 
     def send_audio(self, opus_packet: bytes, timestamp_48k: int) -> None:
         pkt = self.audio_pay.payload_packet(opus_packet, timestamp_48k)

@@ -14,6 +14,8 @@ import secrets
 from dataclasses import dataclass, field
 
 VIDEO_PT = 96
+RED_PT = 98
+ULPFEC_PT = 99
 AUDIO_PT = 111
 TWCC_EXT_ID = 3
 PLAYOUT_DELAY_EXT_ID = 2
@@ -58,7 +60,7 @@ def build_offer(*, ice_ufrag: str, ice_pwd: str, fingerprint: str,
         ]
 
     lines += [
-        f"m=video 9 UDP/TLS/RTP/SAVPF {VIDEO_PT}",
+        f"m=video 9 UDP/TLS/RTP/SAVPF {VIDEO_PT} {RED_PT} {ULPFEC_PT}",
         "c=IN IP4 0.0.0.0",
         "a=rtcp:9 IN IP4 0.0.0.0",
         "a=mid:video0",
@@ -72,6 +74,8 @@ def build_offer(*, ice_ufrag: str, ice_pwd: str, fingerprint: str,
         f"a=rtcp-fb:{VIDEO_PT} nack",
         f"a=rtcp-fb:{VIDEO_PT} nack pli",
         f"a=rtcp-fb:{VIDEO_PT} transport-cc",
+        f"a=rtpmap:{RED_PT} red/90000",
+        f"a=rtpmap:{ULPFEC_PT} ulpfec/90000",
         f"a=msid:selkies selkies-video",
         f"a=ssrc:{video_ssrc} cname:{cname}",
         f"a=ssrc:{video_ssrc} msid:selkies selkies-video",
@@ -117,6 +121,8 @@ class RemoteDescription:
     setup: str = ""
     candidates: list[str] = field(default_factory=list)
     video_pt: int | None = None
+    red_pt: int | None = None
+    ulpfec_pt: int | None = None
     twcc_id: int | None = None
     sctp_port: int = 5000
 
@@ -146,6 +152,10 @@ def parse_answer(sdp: str) -> RemoteDescription:
             current_rtpmaps[int(pt)] = enc
             if enc.upper().startswith(("H264/", "VP8/", "VP9/")) and r.video_pt is None:
                 r.video_pt = int(pt)
+            elif enc.lower().startswith("red/") and r.red_pt is None:
+                r.red_pt = int(pt)
+            elif enc.lower().startswith("ulpfec/") and r.ulpfec_pt is None:
+                r.ulpfec_pt = int(pt)
         elif line.startswith("a=extmap:"):
             body = line[len("a=extmap:"):]
             eid, uri = body.split(" ", 1)

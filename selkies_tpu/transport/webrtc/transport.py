@@ -23,10 +23,13 @@ logger = logging.getLogger("transport.webrtc")
 
 class WebRTCTransport:
     def __init__(self, *, codec: str = "h264", audio: bool = True,
+                 fec_percentage: int = 20,
                  stun_server: tuple[str, int] | None = None,
                  turn_server: tuple[str, int] | None = None,
                  turn_username: str = "", turn_password: str = ""):
-        self._kw = dict(codec=codec, audio=audio, stun_server=stun_server,
+        self._kw = dict(codec=codec, audio=audio,
+                        fec_percentage=fec_percentage,
+                        stun_server=stun_server,
                         turn_server=turn_server, turn_username=turn_username,
                         turn_password=turn_password)
         self.pc: PeerConnection | None = None

@@ -204,3 +204,55 @@ def test_srtp_from_dtls_keys():
     pkt = _rtp(7)
     assert s_cli.unprotect(s_srv.protect(pkt)) == pkt
     assert s_srv.unprotect(s_cli.protect(pkt)) == pkt
+
+
+def test_fec_group_recovery():
+    """ULP FEC parity recovers any single lost packet of a group."""
+    import struct
+
+    from selkies_tpu.transport.webrtc import fec
+
+    def rtp(seq, payload):
+        return struct.pack("!BBHII", 0x80, 96, seq, 9000 + seq * 3000, 0xABC) + payload
+
+    rng = __import__("random").Random(4)
+    group = [rtp(100 + i, bytes(rng.randrange(256) for _ in range(40 + 17 * i)))
+             for i in range(5)]
+    parity = fec.build_fec(group)
+    for lost in range(5):
+        received = {100 + i: p for i, p in enumerate(group) if i != lost}
+        rec = fec.recover(parity, received, ssrc=0xABC)
+        assert rec == group[lost], f"packet {lost} not recovered"
+    # complete group or double loss -> no recovery claim
+    assert fec.recover(parity, {100 + i: p for i, p in enumerate(group)}, 0xABC) is None
+    assert fec.recover(parity, {100: group[0], 101: group[1]}, 0xABC) is None
+
+
+def test_fec_encoder_grouping_and_red():
+    from selkies_tpu.transport.webrtc import fec
+
+    enc = fec.FecEncoder(20)  # one parity per 5 packets
+    assert enc.group_size == 5
+    import struct
+
+    pkts = [struct.pack("!BBHII", 0x80, 96, i, 0, 1) + bytes([i]) * 8 for i in range(7)]
+    outs = [enc.push(p) for p in pkts]
+    assert [o is not None for o in outs] == [False] * 4 + [True, False, False]
+    tail = enc.flush()  # partial group of 2 still gets parity
+    assert tail is not None
+    assert enc.flush() is None
+    pt, inner = fec.red_unwrap(fec.red_wrap(99, b"parity"))
+    assert pt == 99 and inner == b"parity"
+
+
+def test_fec_sequence_wrap():
+    import struct
+
+    from selkies_tpu.transport.webrtc import fec
+
+    group = [struct.pack("!BBHII", 0x80, 96, (65534 + i) & 0xFFFF, i, 7) + bytes(20)
+             for i in range(4)]
+    parity = fec.build_fec(group)
+    received = {(65534 + i) & 0xFFFF: p for i, p in enumerate(group) if i != 2}
+    rec = fec.recover(parity, received, ssrc=7)
+    assert rec == group[2]

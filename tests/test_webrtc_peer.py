@@ -225,3 +225,83 @@ def test_full_session_media_and_datachannel(loop):
         browser.ice.close()
 
     loop.run_until_complete(scenario())
+
+
+def test_fec_end_to_end_recovers_dropped_srtp_packet(loop):
+    """With red/ulpfec negotiated, a dropped media packet is rebuilt from
+    the parity packet and the AU depayloads intact."""
+    async def scenario():
+        from selkies_tpu.transport.webrtc import fec
+
+        pc = PeerConnection(audio=False)
+        browser = FakeBrowser()
+        offer = await pc.create_offer()
+        assert "red/90000" in offer and "ulpfec/90000" in offer
+        answer = await browser.answer(offer)
+        answer = answer.replace(
+            "a=rtpmap:96 H264/90000\r\n",
+            "a=rtpmap:96 H264/90000\r\n"
+            "a=rtpmap:98 red/90000\r\na=rtpmap:99 ulpfec/90000\r\n",
+        )
+        await pc.set_answer(answer)
+        assert pc._fec is not None, "FEC did not arm from the answer"
+        pri = candidate_priority("host")
+        pc.add_remote_candidate(
+            f"candidate:1 1 udp {pri} 127.0.0.1 {browser.ice.local_candidates[0].port} typ host")
+        browser.ice.add_remote_candidate(
+            f"candidate:1 1 udp {pri} 127.0.0.1 {pc.ice.local_candidates[0].port} typ host")
+        await asyncio.wait_for(asyncio.gather(
+            pc.ice.wait_connected(5), browser.ice.wait_connected(5)), 10)
+        browser.start_dtls()
+        await asyncio.wait_for(pc.wait_connected(10), 10)
+
+        au = b"\x00\x00\x00\x01\x65" + bytes(range(256)) * 14  # ~3.6 KB -> 4+ packets
+        pc.send_video(au, timestamp_90k=3000)
+        for _ in range(100):
+            if len(browser.rtp_packets) >= 5:
+                break
+            await asyncio.sleep(0.02)
+
+        media, parity = {}, []
+        for wire in browser.rtp_packets:
+            pkt = RtpPacket.parse(wire)
+            bpt, inner = fec.red_unwrap(pkt.payload)
+            if bpt == 99:
+                parity.append(inner)
+            else:
+                assert bpt == 96
+                media[pkt.sequence] = wire
+        assert parity, "no FEC packet arrived"
+        assert len(media) >= 4
+
+        def depayload(media_map):
+            depay = H264Depayloader()
+            out = b""
+            for seq in sorted(media_map):
+                pkt = RtpPacket.parse(media_map[seq])
+                _, inner = fec.red_unwrap(pkt.payload)
+                pkt.payload = inner
+                pkt.payload_type = 96
+                got = depay.push(pkt)
+                if got:
+                    out += got
+            return out
+
+        intact = depayload(media)
+        assert b"\x65" + bytes(range(64)) in intact
+
+        # drop one media packet; FEC rebuilds the exact wire bytes
+        lost_seq = sorted(media)[1]
+        lost_wire = media.pop(lost_seq)
+        rebuilt = fec.recover(parity[0], media, ssrc=pc.video_ssrc)
+        if rebuilt is None and len(parity) > 1:  # packet was in a later group
+            rebuilt = fec.recover(parity[1], media, ssrc=pc.video_ssrc)
+        assert rebuilt is not None, "FEC failed to rebuild the lost packet"
+        assert rebuilt == lost_wire
+        media[lost_seq] = rebuilt
+        assert depayload(media) == intact
+
+        pc.close()
+        browser.ice.close()
+
+    loop.run_until_complete(scenario())
