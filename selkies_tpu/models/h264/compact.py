@@ -10,7 +10,16 @@ replaces on the tunnel/PCIe.
 
 from __future__ import annotations
 
+import sys
+
 import numpy as np
+
+# The int32 views over device-bitcast int16 streams below assume the host
+# lane order matches TPU bitcast_convert_type (little-endian). Fail loudly
+# on an exotic platform instead of decoding garbage lengths (a plain
+# assert would vanish under python -O).
+if sys.byteorder != "little":
+    raise RuntimeError("compact downlink decode requires a little-endian host")
 
 from selkies_tpu.models.h264.encoder_core import (
     I_ENTRIES,

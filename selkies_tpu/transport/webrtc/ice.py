@@ -440,8 +440,14 @@ class IceAgent:
                 return
             kind, extra = pending
             if kind == "check":
+                # verify integrity BEFORE consuming the txid: a forged
+                # response must not eat the pending slot and cause the
+                # peer's genuine signed response to be dropped
+                if not msg.check_integrity(self.remote_pwd.encode(), wire):
+                    logger.debug("check response failed integrity; ignoring")
+                    return
                 self._pending.pop(msg.txid, None)
-                self._on_check_response(msg, extra)
+                self._on_check_response(msg, extra, wire)
             else:
                 fut = extra
                 if not fut.done():
@@ -490,7 +496,10 @@ class IceAgent:
         # direct beats relayed regardless of remote candidate priority
         return (not pair.relayed, pair.remote.priority)
 
-    def _on_check_response(self, msg: stun.StunMessage, pair: _CheckPair) -> None:
+    def _on_check_response(self, msg: stun.StunMessage, pair: _CheckPair,
+                           wire: bytes) -> None:
+        # Integrity already verified in _on_stun (RFC 8445 §7.2.5.2.2),
+        # before the txid was consumed.
         if msg.cls == stun.ERROR_RESPONSE:
             err = stun.error_code(msg)
             logger.debug("check failed: %s", err)

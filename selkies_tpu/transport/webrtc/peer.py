@@ -105,6 +105,15 @@ class PeerConnection:
 
     async def set_answer(self, answer_sdp: str) -> None:
         r = sdp.parse_answer(answer_sdp)
+        # An answer without ICE credentials can never connect, and one
+        # without a DTLS fingerprint could never be authenticated: fail
+        # loudly now (the transport turns this into a clean teardown)
+        # instead of hanging the session until the client's retry timer.
+        missing = [name for name, val in (("ice-ufrag", r.ice_ufrag),
+                                          ("ice-pwd", r.ice_pwd),
+                                          ("fingerprint", r.fingerprint)) if not val]
+        if missing:
+            raise ValueError(f"SDP answer missing required attributes: {missing}")
         self._remote = r
         if r.twcc_id is not None:
             self._twcc_id = r.twcc_id

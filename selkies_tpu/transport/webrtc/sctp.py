@@ -157,6 +157,31 @@ class SctpAssociation:
         if crc32c(bytes(body)) != crc:
             logger.debug("SCTP checksum mismatch")
             return
+        # RFC 9260 §8.5: packets for this association must carry our tag.
+        # INIT rides vtag 0 by definition, and ABORT/SHUTDOWN-COMPLETE with
+        # the T bit reflect OUR outgoing tag (§8.5.1) — a restarted peer
+        # with no association state aborts that way. Everything else with a
+        # wrong tag (e.g. a peer restarting mid-stream) is dropped rather
+        # than being allowed to corrupt TSN state.
+        vtag = struct.unpack_from("!I", pkt, 4)[0]
+        first_type = pkt[12] if len(pkt) > 12 else None
+        first_flags = pkt[13] if len(pkt) > 13 else 0
+        # INIT is only exempt as §8.5.1 defines it: vtag 0, sole chunk —
+        # an INIT-first bundle with a stale tag must not smuggle DATA past
+        # the check or clobber remote_vtag on a live association.
+        if first_type == INIT:
+            init_len = struct.unpack_from("!H", pkt, 14)[0] if len(pkt) >= 16 else 0
+            padded = init_len + ((4 - init_len % 4) % 4)
+            if vtag != 0 or 12 + padded < len(pkt):
+                logger.debug("SCTP malformed INIT packet (vtag=%#x); dropping", vtag)
+                return
+        elif vtag != self.local_vtag:
+            reflected = (first_type in (ABORT, SHUTDOWN_COMPLETE)
+                         and (first_flags & 1) and vtag == self.remote_vtag)
+            if not reflected:
+                logger.debug("SCTP vtag mismatch (%#x != %#x); dropping",
+                             vtag, self.local_vtag)
+                return
         off = 12
         while off + 4 <= len(pkt):
             ctype, flags, length = struct.unpack_from("!BBH", pkt, off)

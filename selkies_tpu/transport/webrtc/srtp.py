@@ -6,9 +6,10 @@ a few hundred packets/s, far below what per-packet Cipher construction
 costs). Master keys come from the DTLS EXTRACTOR (dtls.py).
 
 Covers: AES-CM key derivation (§4.3), SRTP encrypt+auth with ROC
-tracking (§3.3), SRTCP with the 31-bit index + E bit (§3.4), and
-receiver-side index estimation and auth verification. Replay windows are
-left to the RTP consumers (the jitter layer already orders packets).
+tracking (§3.3), SRTCP with the 31-bit index + E bit (§3.4),
+receiver-side index estimation and auth verification, and §3.3.2
+sliding replay windows for both SRTP and SRTCP (RTCP especially: a
+replayed BYE/PLI otherwise acts on the session forever).
 """
 
 from __future__ import annotations
@@ -25,6 +26,34 @@ SRTCP_INDEX_LEN = 4
 
 class SrtpError(ValueError):
     pass
+
+
+class ReplayWindow:
+    """RFC 3711 §3.3.2 sliding window over packet indices (64 deep)."""
+
+    SIZE = 64
+
+    def __init__(self) -> None:
+        self._top = -1  # highest index that passed authentication
+        self._mask = 0  # bit k set => (top - k) was seen
+
+    def check(self, index: int) -> bool:
+        """True if `index` is new (not replayed, not below the window)."""
+        if index > self._top:
+            return True
+        delta = self._top - index
+        if delta >= self.SIZE:
+            return False
+        return not (self._mask >> delta) & 1
+
+    def commit(self, index: int) -> None:
+        """Record an index after its packet authenticated."""
+        if index > self._top:
+            shift = index - self._top if self._top >= 0 else self.SIZE
+            self._mask = ((self._mask << min(shift, self.SIZE)) | 1) & ((1 << self.SIZE) - 1)
+            self._top = index
+        else:
+            self._mask |= 1 << (self._top - index)
 
 
 def _aes_cm_keystream(key: bytes, iv_int: int, n: int) -> bytes:
@@ -65,7 +94,8 @@ class SrtpSession:
         self._rx_roc: dict[int, int] = {}
         self._rx_last_seq: dict[int, int] = {}
         self._tx_rtcp_index = 0
-        self._rx_rtcp_index_seen = -1
+        self._rx_replay: dict[int, ReplayWindow] = {}
+        self._rx_rtcp_replay: dict[int, ReplayWindow] = {}
 
     # -- SRTP ---------------------------------------------------------
 
@@ -127,10 +157,15 @@ class SrtpSession:
         hlen, seq, ssrc = self._parse_header(body)
         index = self._estimate_index(ssrc, seq)
         roc = index >> 16
+        window = self._rx_replay.get(ssrc)
+        if window is not None and not window.check(index):
+            raise SrtpError("SRTP replay")
         mac = hmac.new(self._rx.auth, body + struct.pack("!I", roc), hashlib.sha1)
         if not hmac.compare_digest(mac.digest()[:AUTH_TAG_LEN], tag):
             raise SrtpError("SRTP auth failure")
-        # commit ROC/seq state only after auth
+        # commit ROC/seq/replay state only after auth (window creation too:
+        # spoofed SSRCs must not grow the dict)
+        self._rx_replay.setdefault(ssrc, ReplayWindow()).commit(index)
         self._rx_roc[ssrc] = roc
         self._rx_last_seq[ssrc] = seq
         ks = _aes_cm_keystream(
@@ -158,13 +193,18 @@ class SrtpSession:
             raise SrtpError("short SRTCP packet")
         tag = pkt[-AUTH_TAG_LEN:]
         rest = pkt[:-AUTH_TAG_LEN]
+        trailer = struct.unpack("!I", rest[-SRTCP_INDEX_LEN:])[0]
+        index = trailer & 0x7FFFFFFF
+        rtcp_ssrc = struct.unpack("!I", rest[4:8])[0]
+        window = self._rx_rtcp_replay.get(rtcp_ssrc)
+        if window is not None and not window.check(index):
+            raise SrtpError("SRTCP replay")
         mac = hmac.new(self._rx_rtcp.auth, rest, hashlib.sha1)
         if not hmac.compare_digest(mac.digest()[:AUTH_TAG_LEN], tag):
             raise SrtpError("SRTCP auth failure")
-        trailer = struct.unpack("!I", rest[-SRTCP_INDEX_LEN:])[0]
+        self._rx_rtcp_replay.setdefault(rtcp_ssrc, ReplayWindow()).commit(index)
         body = rest[:-SRTCP_INDEX_LEN]
         encrypted = bool(trailer & 0x80000000)
-        index = trailer & 0x7FFFFFFF
         if not encrypted:
             return body
         ssrc = struct.unpack("!I", body[4:8])[0]

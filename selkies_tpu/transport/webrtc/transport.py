@@ -98,7 +98,20 @@ class WebRTCTransport:
     def set_remote_sdp(self, sdp_type: str, sdp: str) -> None:
         if self.pc is None or sdp_type != "answer":
             return
-        asyncio.ensure_future(self.pc.set_answer(sdp))
+        asyncio.ensure_future(self._apply_answer(self.pc, sdp))
+
+    async def _apply_answer(self, pc, sdp: str) -> None:
+        # A malformed answer must tear the session down loudly, not leave
+        # it hanging until the client's fallback timer.
+        try:
+            await pc.set_answer(sdp)
+        except Exception:
+            logger.exception("failed to apply remote answer; closing session")
+            if self.pc is not pc:  # a newer session replaced this pc already
+                pc.close()
+                return
+            await self.stop_session()
+            await _maybe_await(self.on_disconnect())
 
     def add_remote_ice(self, mlineindex: int, candidate: str) -> None:
         if self.pc is not None and candidate:

@@ -256,3 +256,51 @@ def test_fec_sequence_wrap():
     received = {(65534 + i) & 0xFFFF: p for i, p in enumerate(group) if i != 2}
     rec = fec.recover(parity, received, ssrc=7)
     assert rec == group[2]
+
+
+def test_srtp_replay_rejected():
+    """A captured SRTP packet must not unprotect twice (RFC 3711 §3.3.2)."""
+    from selkies_tpu.transport.webrtc.srtp import SrtpError
+
+    a, b = _sessions()
+    prot5 = a.protect(_rtp(5))
+    prot6 = a.protect(_rtp(6))
+    assert b.unprotect(prot6) == _rtp(6)
+    assert b.unprotect(prot5) == _rtp(5)  # out-of-order within window is fine
+    for replay in (prot5, prot6):
+        with pytest.raises(SrtpError, match="replay"):
+            b.unprotect(replay)
+
+
+def test_srtcp_replay_rejected():
+    """A replayed authenticated SRTCP compound (e.g. BYE) must be dropped."""
+    import struct
+
+    from selkies_tpu.transport.webrtc.srtp import SrtpError
+
+    a, b = _sessions()
+    rr = struct.pack("!BBHI", 0x80, 201, 1, 0xCAFE) + b"\x00" * 4
+    prot = a.protect_rtcp(rr)
+    assert b.unprotect_rtcp(prot)[: len(rr)] == rr
+    with pytest.raises(SrtpError, match="replay"):
+        b.unprotect_rtcp(prot)
+    # fresh packets keep flowing after the rejected replay
+    assert b.unprotect_rtcp(a.protect_rtcp(rr))[: len(rr)] == rr
+
+
+def test_replay_window_semantics():
+    from selkies_tpu.transport.webrtc.srtp import ReplayWindow
+
+    w = ReplayWindow()
+    assert w.check(0)
+    w.commit(0)
+    assert not w.check(0)
+    w.commit(100)
+    assert not w.check(100)
+    assert w.check(99) and w.check(100 - 63)
+    assert not w.check(100 - 64)  # below the window => rejected
+    w.commit(99)
+    assert not w.check(99)
+    # big forward jump clears history
+    w.commit(10_000)
+    assert not w.check(10_000) and w.check(9_999)
