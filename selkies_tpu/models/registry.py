@@ -102,19 +102,36 @@ def _vp8enc(*, width: int, height: int, fps: int = 60, bitrate_kbps: int = 2000,
 
 
 @register("tpuav1enc")
-def _tpuav1enc(**kw):
-    raise NotImplementedError(
-        "tpuav1enc: AV1's adaptive CDF entropy coder depends on normative "
-        "default tables (spec data, not derivable) and no AV1 library "
-        "exists in this image — use tpuh264enc (from-scratch TPU) or "
-        "tpuvp9enc (delta front-end + libvpx)"
+def _tpuav1enc(*, width: int, height: int, fps: int = 60, **kw):
+    """Codec-fallback row. AV1's adaptive CDF entropy coder depends on
+    normative default tables (spec data, not derivable from first
+    principles) and no AV1 library exists in this image, so a conformant
+    from-scratch AV1 encoder is unbuildable here. The AV1 *transport*
+    (transport/rtp_av1.py, the rtpav1pay/depay equivalent) is real; the
+    encode falls back to the from-scratch TPU H.264 encoder so a config
+    asking for AV1 gets a working session instead of a crash — the
+    reference's own policy when an encoder is missing is to fail the
+    pipeline (gstwebrtc_app.py:1123-1140); we degrade instead and log."""
+    logger.warning(
+        "tpuav1enc: no conformant AV1 encode is buildable in this image "
+        "(normative CDF tables unavailable); falling back to tpuh264enc — "
+        "the session will negotiate H.264"
     )
+    kw.pop("bitrate_kbps", None)
+    return _FACTORIES["tpuh264enc"](width=width, height=height, fps=fps, **kw)
 
 
 # Legacy GStreamer encoder names (reference gstwebrtc_app.py:1133) map to
 # the TPU equivalent so existing SELKIES_ENCODER values keep working.
 for _legacy_h264 in ("nvh264enc", "vah264enc", "x264enc", "openh264enc"):
     alias(_legacy_h264, "tpuh264enc")
+# H.265 rows (reference gstwebrtc_app.py:369-424,510-542,667-683): HEVC's
+# CABAC-only entropy coding has the same unbuildable-from-scratch problem
+# as AV1's CDF coder and no HEVC library exists in this image, so the
+# names resolve to the TPU H.264 row (same latency envelope, same RTP
+# stack) rather than crashing config parsing.
+for _legacy_h265 in ("nvh265enc", "vah265enc", "x265enc"):
+    alias(_legacy_h265, "tpuh264enc")
 alias("vavp9enc", "tpuvp9enc")  # silicon VP9 row maps to the hybrid
 for _legacy_av1 in ("nvav1enc", "vaav1enc", "svtav1enc", "av1enc", "rav1enc"):
     alias(_legacy_av1, "tpuav1enc")

@@ -1,0 +1,247 @@
+"""RTP payload format for AV1 (AOM "RTP Payload Format For AV1" v1.0).
+
+The reference gets this from gst-plugins-rs `rtpav1pay` / `rtpav1depay`
+(gstwebrtc_app.py:917-938, addons/gstreamer/Dockerfile:90). This is a
+from-scratch implementation of the same wire format so the AV1 transport
+layer exists independently of which AV1 encoder produces the OBUs:
+
+* 1-byte aggregation header: Z (first element is a continuation),
+  Y (last element continues in the next packet), W (element count, the
+  last element then omits its length), N (first packet of a new coded
+  video sequence);
+* OBU elements with LEB128 length prefixes, obu_has_size_field stripped
+  (the RTP framing carries sizes, §4.4 of the payload spec);
+* temporal-delimiter OBUs dropped (§5);
+* fragmentation of large OBUs across packets via Z/Y.
+
+The depayloader reassembles temporal units and restores size fields so
+the output is a valid low-overhead bitstream ("Section 5" / .obu) frame.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from selkies_tpu.transport.rtp import MTU_DEFAULT, RtpPacket
+
+__all__ = ["Av1Payloader", "Av1Depayloader", "leb128_encode", "leb128_decode",
+           "split_obus", "obu_type"]
+
+OBU_SEQUENCE_HEADER = 1
+OBU_TEMPORAL_DELIMITER = 2
+OBU_FRAME = 6
+
+AV1_CLOCK = 90000
+
+
+def leb128_encode(value: int) -> bytes:
+    out = bytearray()
+    while True:
+        byte = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(byte | 0x80)
+        else:
+            out.append(byte)
+            return bytes(out)
+
+
+def leb128_decode(data: bytes, offset: int = 0) -> tuple[int, int]:
+    """-> (value, bytes consumed). Raises ValueError on truncation."""
+    value = 0
+    for i in range(8):
+        if offset + i >= len(data):
+            raise ValueError("truncated LEB128")
+        byte = data[offset + i]
+        value |= (byte & 0x7F) << (7 * i)
+        if not byte & 0x80:
+            return value, i + 1
+    raise ValueError("LEB128 too long")
+
+
+def obu_type(obu: bytes) -> int:
+    return (obu[0] >> 3) & 0x0F
+
+
+def _header_len(obu: bytes) -> int:
+    return 2 if obu[0] & 0x04 else 1  # extension flag adds one byte
+
+
+def _strip_size_field(obu: bytes) -> bytes:
+    """Return the OBU with obu_has_size_field cleared and the field removed."""
+    if not obu[0] & 0x02:
+        return obu
+    hl = _header_len(obu)
+    size, n = leb128_decode(obu, hl)
+    body = obu[hl + n : hl + n + size]
+    return bytes([obu[0] & ~0x02]) + obu[1:hl] + body
+
+
+def _add_size_field(obu: bytes) -> bytes:
+    """Return the OBU with obu_has_size_field set and the field inserted."""
+    if obu[0] & 0x02:
+        return obu
+    hl = _header_len(obu)
+    body = obu[hl:]
+    return bytes([obu[0] | 0x02]) + obu[1:hl] + leb128_encode(len(body)) + body
+
+
+def split_obus(tu: bytes) -> list[bytes]:
+    """Split a low-overhead-bitstream temporal unit into OBUs (size fields
+    must be present, as in .obu files and encoder output)."""
+    obus: list[bytes] = []
+    i = 0
+    while i < len(tu):
+        first = tu[i]
+        if first & 0x80:
+            raise ValueError("forbidden bit set in OBU header")
+        hl = 2 if first & 0x04 else 1
+        if not first & 0x02:
+            raise ValueError("OBU without size field in temporal unit")
+        size, n = leb128_decode(tu, i + hl)
+        end = i + hl + n + size
+        if end > len(tu):
+            raise ValueError("truncated OBU")
+        obus.append(tu[i:end])
+        i = end
+    return obus
+
+
+def _agg_header(z: bool, y: bool, w: int, n: bool) -> bytes:
+    return bytes([(0x80 if z else 0) | (0x40 if y else 0)
+                  | ((w & 3) << 4) | (0x08 if n else 0)])
+
+
+@dataclass
+class Av1Payloader:
+    """OBU temporal units → RTP packets (rtpav1pay equivalent)."""
+
+    payload_type: int = 45
+    ssrc: int = 0x53454C56  # 'SELV'
+    mtu: int = MTU_DEFAULT
+    sequence: int = 0
+
+    def _next_seq(self) -> int:
+        s = self.sequence
+        self.sequence = (self.sequence + 1) & 0xFFFF
+        return s
+
+    def payload_tu(self, tu: bytes, timestamp: int,
+                   new_sequence: bool = False) -> list[RtpPacket]:
+        """Packetize one temporal unit (low-overhead bitstream bytes).
+
+        `new_sequence` sets the N bit on the first packet — use it on the
+        first TU of a coded video sequence (keyframe with sequence header).
+        The last packet carries the RTP marker.
+        """
+        obus = [_strip_size_field(o) for o in split_obus(tu)
+                if obu_type(o) != OBU_TEMPORAL_DELIMITER]
+        if not obus:
+            return []
+        # same wire-overhead reserve as the H.264 payloader: RTP header,
+        # TWCC/playout-delay extensions, RED byte, SRTP tag, FEC slack
+        max_payload = self.mtu - 54
+
+        packets: list[RtpPacket] = []
+        # elements for the packet being built: (data, is_continuation)
+        elems: list[bytes] = []
+        z = False  # first element of the current packet is a continuation
+        used = 1  # aggregation header
+
+        def flush(y: bool) -> None:
+            nonlocal elems, z, used
+            if not elems:
+                return
+            w = len(elems) if len(elems) <= 3 else 0
+            body = b""
+            for i, el in enumerate(elems):
+                last = i == len(elems) - 1
+                if w and last:
+                    body += el  # W>0: last element length is implicit
+                else:
+                    body += leb128_encode(len(el)) + el
+            n_bit = new_sequence and not packets
+            packets.append(RtpPacket(
+                self.payload_type, self._next_seq(), timestamp, self.ssrc,
+                _agg_header(z, y, w, n_bit) + body,
+            ))
+            elems = []
+            z = False
+            used = 1
+
+        for obu in obus:
+            data = obu
+            while True:
+                room = max_payload - used - len(leb128_encode(len(data))) - len(data)
+                if room >= 0:
+                    elems.append(data)
+                    used += len(leb128_encode(len(data))) + len(data)
+                    break
+                # fragment: fill this packet, continue in the next (Y/Z)
+                space = max_payload - used - 2  # ≥ length prefix worst case
+                if space < 16 and elems:
+                    flush(False)  # not worth a tiny fragment; start fresh
+                    continue
+                head, data = data[:space], data[space:]
+                elems.append(head)
+                flush(True)
+                z = True
+        flush(False)
+        if packets:
+            packets[-1].marker = True
+        return packets
+
+
+class Av1Depayloader:
+    """RTP packets → temporal units (rtpav1depay equivalent; for tests
+    and the loopback client). Output OBUs carry restored size fields."""
+
+    def __init__(self) -> None:
+        self._obus: list[bytes] = []
+        self._frag: bytearray | None = None
+
+    def push(self, pkt: RtpPacket) -> bytes | None:
+        p = pkt.payload
+        if not p:
+            return None
+        b0 = p[0]
+        z, y, w = bool(b0 & 0x80), bool(b0 & 0x40), (b0 >> 4) & 3
+        i = 1
+        elements: list[bytes] = []
+        count = 0
+        while i < len(p):
+            count += 1
+            if w and count == w:
+                elements.append(p[i:])
+                i = len(p)
+            else:
+                try:
+                    ln, n = leb128_decode(p, i)
+                except ValueError:
+                    break
+                elements.append(p[i + n : i + n + ln])
+                i += n + ln
+        for j, el in enumerate(elements):
+            first, last = j == 0, j == len(elements) - 1
+            if first and z:
+                if self._frag is None:
+                    continue  # continuation of a packet we never saw
+                self._frag.extend(el)
+                if last and y:
+                    return self._finish(pkt.marker)
+                self._obus.append(bytes(self._frag))
+                self._frag = None
+            elif last and y:
+                self._frag = bytearray(el)
+            else:
+                self._obus.append(el)
+        return self._finish(pkt.marker)
+
+    def _finish(self, marker: bool) -> bytes | None:
+        if not marker:
+            return None
+        self._frag = None
+        obus, self._obus = self._obus, []
+        if not obus:
+            return None
+        return b"".join(_add_size_field(o) for o in obus)

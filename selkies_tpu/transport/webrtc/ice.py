@@ -447,7 +447,7 @@ class IceAgent:
                     logger.debug("check response failed integrity; ignoring")
                     return
                 self._pending.pop(msg.txid, None)
-                self._on_check_response(msg, extra, wire)
+                self._on_check_response(msg, extra)
             else:
                 fut = extra
                 if not fut.done():
@@ -496,8 +496,7 @@ class IceAgent:
         # direct beats relayed regardless of remote candidate priority
         return (not pair.relayed, pair.remote.priority)
 
-    def _on_check_response(self, msg: stun.StunMessage, pair: _CheckPair,
-                           wire: bytes) -> None:
+    def _on_check_response(self, msg: stun.StunMessage, pair: _CheckPair) -> None:
         # Integrity already verified in _on_stun (RFC 8445 §7.2.5.2.2),
         # before the txid was consumed.
         if msg.cls == stun.ERROR_RESPONSE:

@@ -175,6 +175,13 @@ class SctpAssociation:
             if vtag != 0 or 12 + padded < len(pkt):
                 logger.debug("SCTP malformed INIT packet (vtag=%#x); dropping", vtag)
                 return
+            if self.established:
+                # RFC 9260 §5.2.2 restart handling is not implemented (the
+                # DTLS tunnel makes a true restart a new association at a
+                # higher layer); letting the INIT through would clobber
+                # remote_vtag/TSN state on the live association.
+                logger.warning("SCTP INIT on established association; dropping")
+                return
         elif vtag != self.local_vtag:
             reflected = (first_type in (ABORT, SHUTDOWN_COMPLETE)
                          and (first_flags & 1) and vtag == self.remote_vtag)

@@ -150,7 +150,7 @@ def parse_answer(sdp: str) -> RemoteDescription:
             body = line[len("a=rtpmap:"):]
             pt, enc = body.split(" ", 1)
             current_rtpmaps[int(pt)] = enc
-            if enc.upper().startswith(("H264/", "VP8/", "VP9/")) and r.video_pt is None:
+            if enc.upper().startswith(("H264/", "VP8/", "VP9/", "AV1/")) and r.video_pt is None:
                 r.video_pt = int(pt)
             elif enc.lower().startswith("red/") and r.red_pt is None:
                 r.red_pt = int(pt)

@@ -1,0 +1,144 @@
+"""AV1 RTP payload format (transport/rtp_av1.py — rtpav1pay equivalent).
+
+Exercises LEB128, OBU size-field strip/restore, aggregation-header
+packing (W counts, Z/Y fragmentation, N bit), MTU compliance, and
+payloader→depayloader roundtrips including large-OBU fragmentation and
+multi-OBU temporal units. Reference rows: gstwebrtc_app.py:917-938.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from selkies_tpu.transport.rtp_av1 import (
+    Av1Depayloader,
+    Av1Payloader,
+    leb128_decode,
+    leb128_encode,
+    obu_type,
+    split_obus,
+)
+
+
+def _obu(otype: int, body: bytes) -> bytes:
+    """Build an OBU with obu_has_size_field set (low-overhead bitstream)."""
+    return bytes([(otype << 3) | 0x02]) + leb128_encode(len(body)) + body
+
+
+def _tu(*obus: bytes) -> bytes:
+    return b"".join(obus)
+
+
+def test_leb128_roundtrip():
+    for v in (0, 1, 127, 128, 300, 16383, 16384, 2**32 - 1):
+        enc = leb128_encode(v)
+        dec, n = leb128_decode(enc)
+        assert dec == v and n == len(enc)
+    with pytest.raises(ValueError):
+        leb128_decode(b"\x80\x80")  # truncated
+
+
+def test_split_obus_and_types():
+    td = _obu(2, b"")
+    seq = _obu(1, b"\x01\x02")
+    frame = _obu(6, bytes(range(50)))
+    obus = split_obus(_tu(td, seq, frame))
+    assert [obu_type(o) for o in obus] == [2, 1, 6]
+    with pytest.raises(ValueError):
+        split_obus(_tu(seq)[:-1])  # truncated
+
+
+def test_single_packet_tu_roundtrip():
+    pay = Av1Payloader()
+    depay = Av1Depayloader()
+    seq = _obu(1, b"\x0a\x0b\x0c")
+    frame = _obu(6, bytes(range(100)))
+    tu = _tu(_obu(2, b""), seq, frame)  # temporal delimiter must be dropped
+    pkts = pay.payload_tu(tu, timestamp=3000, new_sequence=True)
+    assert len(pkts) == 1
+    assert pkts[0].marker
+    assert pkts[0].payload[0] & 0x08  # N bit on new sequence
+    out = depay.push(pkts[0])
+    # TD dropped; size fields restored on the rest
+    assert out == _tu(seq, frame)
+
+
+def test_fragmentation_roundtrip_and_mtu():
+    pay = Av1Payloader(mtu=1200)
+    depay = Av1Depayloader()
+    frame = _obu(6, bytes(i % 251 for i in range(10_000)))
+    tu = _tu(_obu(1, b"\x55" * 8), frame)
+    pkts = pay.payload_tu(tu, timestamp=9000, new_sequence=True)
+    assert len(pkts) > 5
+    for p in pkts[:-1]:
+        assert not p.marker
+    assert pkts[-1].marker
+    # wire MTU compliance with the same overhead reserve as H.264
+    for p in pkts:
+        assert len(p.payload) <= 1200 - 54 + 1
+    # middle packets of a fragmented OBU carry Z (continuation) bits
+    assert any(p.payload[0] & 0x80 for p in pkts[1:])
+    out = None
+    for p in pkts:
+        got = depay.push(p)
+        if got is not None:
+            out = got
+    assert out == tu[:]  # TU had no TD, so roundtrip is exact
+
+
+def test_multi_tu_stream():
+    pay = Av1Payloader()
+    depay = Av1Depayloader()
+    tus = [
+        _tu(_obu(1, b"\x11" * 4), _obu(6, bytes(range(200)))),
+        _tu(_obu(6, bytes(range(40)))),
+        _tu(_obu(6, bytes(i % 7 for i in range(5000)))),
+    ]
+    seqs = []
+    for k, tu in enumerate(tus):
+        outs = []
+        for p in pay.payload_tu(tu, timestamp=1000 * k, new_sequence=(k == 0)):
+            seqs.append(p.sequence)
+            got = depay.push(p)
+            if got is not None:
+                outs.append(got)
+        assert outs == [tu]
+    assert seqs == list(range(len(seqs)))  # contiguous RTP sequence space
+
+
+def test_lost_continuation_discarded():
+    """A continuation arriving without its start must not emit garbage."""
+    pay = Av1Payloader()
+    frame = _obu(6, bytes(2000))
+    pkts = pay.payload_tu(_tu(frame), timestamp=0)
+    assert len(pkts) >= 2
+    depay = Av1Depayloader()
+    out = [depay.push(p) for p in pkts[1:]]  # first packet lost
+    assert all(o in (None, b"") or b"" == o for o in out if o is not None) or \
+        all(o is None for o in out[:-1])
+    # the TU must not equal the original (its head is gone)
+    assert out[-1] != _tu(frame)
+
+
+def test_registry_h265_and_av1_names_resolve(monkeypatch):
+    """Every name in the reference's supported list resolves functionally
+    (gstwebrtc_app.py:1133): H.265 and AV1 rows degrade to the TPU H.264
+    encoder instead of crashing config parsing."""
+    from selkies_tpu.models import registry
+
+    for name in ("nvh265enc", "vah265enc", "x265enc", "tpuav1enc",
+                 "nvav1enc", "vaav1enc", "svtav1enc", "av1enc", "rav1enc"):
+        assert registry.encoder_exists(name), name
+
+    created = {}
+
+    def fake_h264(**kw):
+        created.update(kw)
+        return "H264ENC"
+
+    monkeypatch.setitem(registry._FACTORIES, "tpuh264enc", fake_h264)
+    enc = registry.create_encoder("x265enc", width=640, height=360, fps=30)
+    assert enc == "H264ENC" and created["width"] == 640
+    enc = registry.create_encoder("nvav1enc", width=320, height=240, fps=15,
+                                  bitrate_kbps=900)
+    assert enc == "H264ENC"
