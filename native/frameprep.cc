@@ -172,4 +172,111 @@ int band_diff(const uint8_t* cur, const uint8_t* prev, int h, int w, int band,
     return changed;
 }
 
+// Refine a dirty-band map to dirty TILES of tile_px columns: for band i
+// with band_dirty[i], out[i*ntiles + t] = 1 iff any BGRx byte in the
+// 16-row x tile_px-col region changed. Tiles shrink the delta upload by
+// the width fraction that actually changed (a cursor blink is one tile,
+// not a full-width band). Returns the changed-tile count.
+int tile_diff(const uint8_t* cur, const uint8_t* prev, int h, int w,
+              int band, int tile_px, const uint8_t* band_dirty, uint8_t* out) {
+    const size_t row_bytes = static_cast<size_t>(w) * 4;
+    const int nbands = (h + band - 1) / band;
+    const int ntiles = (w + tile_px - 1) / tile_px;
+    int changed = 0;
+    for (int i = 0; i < nbands; ++i) {
+        uint8_t* orow = out + static_cast<size_t>(i) * ntiles;
+        if (!band_dirty[i]) {
+            std::memset(orow, 0, ntiles);
+            continue;
+        }
+        const int r0 = i * band;
+        const int rows = (r0 + band <= h) ? band : (h - r0);
+        for (int t = 0; t < ntiles; ++t) {
+            const int c0 = t * tile_px;
+            const size_t seg = static_cast<size_t>(
+                ((c0 + tile_px <= w) ? tile_px : (w - c0))) * 4;
+            int diff = 0;
+            for (int r = r0; r < r0 + rows && !diff; ++r) {
+                const size_t off = static_cast<size_t>(r) * row_bytes + static_cast<size_t>(c0) * 4;
+                diff = std::memcmp(cur + off, prev + off, seg) != 0;
+            }
+            orow[t] = static_cast<uint8_t>(diff);
+            changed += diff;
+        }
+    }
+    return changed;
+}
+
+// Convert k 16-row x tw-col tiles of src to packed I420 tile buffers:
+// yb (k, 16, tw), ub/vb (k, 8, tw/2). idx[i] = band*1024 + tile selects
+// luma rows 16*band.. and cols tw*tile.. of the PADDED plane; tw must
+// divide pw and be a multiple of 16. Bit-exact with the same region of
+// bgrx_to_i420_pad, including replicated right/bottom padding.
+void bgrx_to_i420_tiles(const uint8_t* src, int h, int w, int pw, int tw,
+                        const int32_t* idx, int k,
+                        uint8_t* yb, uint8_t* ub, uint8_t* vb) {
+    const int ch = h / 2;
+    const int ctw = tw / 2;
+    for (int b = 0; b < k; ++b) {
+        const int band = idx[b] / 1024;
+        const int tile = idx[b] % 1024;
+        const int g0 = band * 16;      // first luma row
+        const int c0 = tile * tw;      // first luma col
+        uint8_t* ybb = yb + static_cast<size_t>(b) * 16 * tw;
+        uint8_t* ubb = ub + static_cast<size_t>(b) * 8 * ctw;
+        uint8_t* vbb = vb + static_cast<size_t>(b) * 8 * ctw;
+        const int content_cols2 = (c0 + tw <= w ? tw : (w > c0 ? w - c0 : 0)) / 2;
+        for (int p = 0; p < 8; ++p) {  // row pair: luma g0+2p, g0+2p+1
+            const int r = g0 + 2 * p;
+            uint8_t* y0 = ybb + static_cast<size_t>(2 * p) * tw;
+            uint8_t* y1 = y0 + tw;
+            uint8_t* ur = ubb + static_cast<size_t>(p) * ctw;
+            uint8_t* vr = vbb + static_cast<size_t>(p) * ctw;
+            if (r < h) {
+                const uint8_t* row0 = src + static_cast<size_t>(r) * w * 4;
+                const uint8_t* row1 = row0 + static_cast<size_t>(w) * 4;
+                for (int c2 = 0; c2 < content_cols2; ++c2) {
+                    const int cc = c0 + 2 * c2;
+                    int usum = 0, vsum = 0;
+                    const uint8_t* pr[2] = {row0 + 4 * cc, row1 + 4 * cc};
+                    for (int dy = 0; dy < 2; ++dy) {
+                        for (int dx = 0; dx < 2; ++dx) {
+                            const uint8_t* px = pr[dy] + 4 * dx;
+                            const int bb = px[0], gg = px[1], rr = px[2];
+                            const int yy = ((66 * rr + 129 * gg + 25 * bb + 128) >> 8) + 16;
+                            const int uu = ((-38 * rr - 74 * gg + 112 * bb + 128) >> 8) + 128;
+                            const int vv = ((112 * rr - 94 * gg - 18 * bb + 128) >> 8) + 128;
+                            (dy ? y1 : y0)[2 * c2 + dx] = clip_u8(yy, 16, 235);
+                            usum += uu < 16 ? 16 : (uu > 240 ? 240 : uu);
+                            vsum += vv < 16 ? 16 : (vv > 240 ? 240 : vv);
+                        }
+                    }
+                    ur[c2] = static_cast<uint8_t>((usum + 2) >> 2);
+                    vr[c2] = static_cast<uint8_t>((vsum + 2) >> 2);
+                }
+                // horizontal padding: replicate col w-1 (always inside
+                // this tile when padding cols exist here: pw - w < 16 <= tw)
+                for (int c = 2 * content_cols2; c < tw; ++c) {
+                    y0[c] = y0[2 * content_cols2 - 1];
+                    y1[c] = y1[2 * content_cols2 - 1];
+                }
+                for (int c = content_cols2; c < ctw; ++c) {
+                    ur[c] = ur[content_cols2 - 1];
+                    vr[c] = vr[content_cols2 - 1];
+                }
+            } else {
+                // bottom padding pair: replicate the last content rows,
+                // which live earlier in THIS tile (pad - h < 16)
+                const uint8_t* ylast = ybb + static_cast<size_t>(h - 1 - g0) * tw;
+                std::memcpy(y0, ylast, tw);
+                std::memcpy(y1, ylast, tw);
+                const uint8_t* ulast = ubb + static_cast<size_t>(ch - 1 - g0 / 2) * ctw;
+                const uint8_t* vlast = vbb + static_cast<size_t>(ch - 1 - g0 / 2) * ctw;
+                std::memcpy(ur, ulast, ctw);
+                std::memcpy(vr, vlast, ctw);
+            }
+        }
+    }
+}
+
 }  // extern "C"

@@ -68,8 +68,14 @@ def _load() -> ctypes.CDLL | None:
         lib.bgrx_to_i420_bands.restype = None
         lib.bgrx_to_i420_bands.argtypes = [u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
                                            i32p, ctypes.c_int, u8p, u8p, u8p]
+        lib.tile_diff.restype = ctypes.c_int
+        lib.tile_diff.argtypes = [u8p, u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                                  ctypes.c_int, u8p, u8p]
+        lib.bgrx_to_i420_tiles.restype = None
+        lib.bgrx_to_i420_tiles.argtypes = [u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                                           ctypes.c_int, i32p, ctypes.c_int, u8p, u8p, u8p]
     except AttributeError:
-        pass  # stale .so without the band converter; numpy fallback used
+        pass  # stale .so without the band/tile converters; numpy fallback used
     _lib = lib
     return lib
 
@@ -191,6 +197,70 @@ class FramePrep:
             ub[:] = u.reshape(-1, 8, self.pad_w // 2)[idx]
             vb[:] = v.reshape(-1, 8, self.pad_w // 2)[idx]
         return yb, ub, vb
+
+    def convert_tiles(self, frame: np.ndarray, idx: np.ndarray, tile_w: int):
+        """Convert only the 16-row x tile_w-col tiles listed in idx
+        (int32, band*1024 + tile) to packed I420 tile buffers:
+        (k, 16, tile_w) luma and (k, 8, tile_w/2) chroma, bit-exact with
+        the same region of a full convert(). tile_w must divide pad_w and
+        be a multiple of 16; tile_w == pad_w degenerates to bands."""
+        if frame.shape != (self.height, self.width, 4):
+            raise ValueError(f"frame {frame.shape} != {(self.height, self.width, 4)}")
+        if tile_w % 16 or self.pad_w % tile_w:
+            raise ValueError(f"tile_w {tile_w} must be a 16-multiple dividing {self.pad_w}")
+        if not frame.flags["C_CONTIGUOUS"]:
+            frame = np.ascontiguousarray(frame)
+        idx = np.ascontiguousarray(idx, np.int32)
+        k = len(idx)
+        yb = np.empty((k, 16, tile_w), np.uint8)
+        ub = np.empty((k, 8, tile_w // 2), np.uint8)
+        vb = np.empty((k, 8, tile_w // 2), np.uint8)
+        if self._lib is not None and hasattr(self._lib, "bgrx_to_i420_tiles"):
+            self._lib.bgrx_to_i420_tiles(
+                _u8p(frame), self.height, self.width, self.pad_w, tile_w,
+                idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), k,
+                _u8p(yb), _u8p(ub), _u8p(vb),
+            )
+        else:
+            y, u, v = _numpy_convert_pad(frame, self.pad_h, self.pad_w)
+            ctw = tile_w // 2
+            for i, t in enumerate(idx):
+                band, tile = int(t) // 1024, int(t) % 1024
+                yb[i] = y[band * 16:band * 16 + 16, tile * tile_w:(tile + 1) * tile_w]
+                ub[i] = u[band * 8:band * 8 + 8, tile * ctw:(tile + 1) * ctw]
+                vb[i] = v[band * 8:band * 8 + 8, tile * ctw:(tile + 1) * ctw]
+        return yb, ub, vb
+
+    def dirty_tiles(self, frame: np.ndarray, tile_w: int) -> np.ndarray | None:
+        """Which 16-row x tile_w-col tiles changed vs the previous call's
+        frame: (nbands, ntiles) bool, or None on the first frame. tile_w
+        is in LUMA columns; detection compares the 4*tile_w BGRx bytes.
+        Advances the previous-frame state (same contract as dirty_bands)."""
+        if not frame.flags["C_CONTIGUOUS"]:
+            frame = np.ascontiguousarray(frame)
+        ntiles = (self.width + tile_w - 1) // tile_w
+        if self._prev is None:
+            self._prev = frame.copy()
+            return None
+        out = np.empty((self.nbands, ntiles), np.uint8)
+        if self._lib is not None and hasattr(self._lib, "tile_diff"):
+            self._lib.band_diff(
+                _u8p(frame), _u8p(self._prev), self.height, self.width,
+                BAND_ROWS, _u8p(self._bands),
+            )
+            self._lib.tile_diff(
+                _u8p(frame), _u8p(self._prev), self.height, self.width,
+                BAND_ROWS, tile_w, _u8p(self._bands), _u8p(out),
+            )
+        else:
+            for i in range(self.nbands):
+                r0, r1 = i * BAND_ROWS, min((i + 1) * BAND_ROWS, self.height)
+                for t in range(ntiles):
+                    c0, c1 = t * tile_w, min((t + 1) * tile_w, self.width)
+                    out[i, t] = not np.array_equal(
+                        frame[r0:r1, c0:c1], self._prev[r0:r1, c0:c1])
+        np.copyto(self._prev, frame)
+        return out.astype(bool)
 
     def dirty_bands(self, frame: np.ndarray) -> np.ndarray | None:
         """Which 16-row bands changed vs the previous call's frame.

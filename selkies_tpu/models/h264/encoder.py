@@ -55,7 +55,7 @@ from selkies_tpu.models.h264.encoder_core import (
     pack_i_compact,
     pack_p_compact,
     pack_p_sparse_var,
-    scatter_bands,
+    scatter_tiles,
 )
 from selkies_tpu.models.h264.native import pack_slice_fast, pack_slice_p_fast
 from selkies_tpu.ops.colorspace import bgrx_to_i420, rgb_to_i420
@@ -171,7 +171,8 @@ def _p_planes_step_chunked(y0, y1, y2, y3, u, v, qp, ref_y, ref_u, ref_v):
 
 
 def _unpack_delta(packed, w):
-    """packed: [idx int32 LE bytes (k,4)] ++ yb ++ ub ++ vb, k inferred."""
+    """packed: [idx int32 LE bytes (k,4)] ++ yb ++ ub ++ vb, k inferred.
+    w is the TILE width in luma columns (== plane width for full bands)."""
     per_band = 4 + 24 * w  # 4 idx bytes + 16*w luma + 2*(8*(w//2)) chroma
     k = packed.shape[0] // per_band
     idx = jax.lax.bitcast_convert_type(packed[: 4 * k].reshape(k, 4), jnp.int32)
@@ -184,17 +185,17 @@ def _unpack_delta(packed, w):
     return yb, ub, vb, idx
 
 
-def _p_scatter_step(packed, qp, sy, su, sv, ref_y, ref_u, ref_v, *, nscap, cap):
-    yb, ub, vb, idx = _unpack_delta(packed, sy.shape[1])
-    y, u, v = scatter_bands(sy, su, sv, yb, ub, vb, idx)
+def _p_scatter_step(packed, qp, sy, su, sv, ref_y, ref_u, ref_v, *, nscap, cap, tile_w):
+    yb, ub, vb, idx = _unpack_delta(packed, tile_w)
+    y, u, v = scatter_tiles(sy, su, sv, yb, ub, vb, idx, tile_w)
     out = encode_frame_p_planes(y, u, v, ref_y, ref_u, ref_v, qp)
     prefix, dense, buf = pack_p_sparse_var(out, nscap, cap)
     return prefix, dense, buf, out["recon_y"], out["recon_u"], out["recon_v"], y, u, v
 
 
-def _i_scatter_step(packed, qp, sy, su, sv):
-    yb, ub, vb, idx = _unpack_delta(packed, sy.shape[1])
-    y, u, v = scatter_bands(sy, su, sv, yb, ub, vb, idx)
+def _i_scatter_step(packed, qp, sy, su, sv, *, tile_w):
+    yb, ub, vb, idx = _unpack_delta(packed, tile_w)
+    y, u, v = scatter_tiles(sy, su, sv, yb, ub, vb, idx, tile_w)
     out = encode_frame_planes(y, u, v, qp)
     header, buf = pack_i_compact(out)
     prefix = fuse_downlink(header, buf, CAP_ROWS)
@@ -202,10 +203,10 @@ def _i_scatter_step(packed, qp, sy, su, sv):
 
 
 def _p_scatter_multi_step(packed_a, packed_b, qps, sy, su, sv, ref_y, ref_u, ref_v,
-                          *, nscap, cap):
+                          *, nscap, cap, tile_w):
     """K delta frames in ONE device round trip.
 
-    packed_a/packed_b: two (K/2, F) uint8 halves of the K frames' band
+    packed_a/packed_b: two (K/2, F) uint8 halves of the K frames' tile
     payloads (same bucket), uploaded CONCURRENTLY (h2d overlaps ~2.5x
     across threads on the relay) and re-joined here; qps: (K,) int32
     per-frame QP. The scan chains recon: frame k's motion estimation
@@ -214,13 +215,12 @@ def _p_scatter_multi_step(packed_a, packed_b, qps, sy, su, sv, ref_y, ref_u, ref
     operations — the relay prices per op, so this is the difference
     between ~8 and ~30+ fps at 1080p (tools/profile_rpc.py)."""
     packed = jnp.concatenate([packed_a, packed_b], 0)
-    w = sy.shape[1]
 
     def body(carry, xs):
         pk, qp = xs
         cy, cu, cv, ry, ru, rv = carry
-        yb, ub, vb, idx = _unpack_delta(pk, w)
-        y, u, v = scatter_bands(cy, cu, cv, yb, ub, vb, idx)
+        yb, ub, vb, idx = _unpack_delta(pk, tile_w)
+        y, u, v = scatter_tiles(cy, cu, cv, yb, ub, vb, idx, tile_w)
         out = encode_frame_p_planes(y, u, v, ry, ru, rv, qp)
         prefix, dense, buf = pack_p_sparse_var(out, nscap, cap)
         return (
@@ -328,6 +328,14 @@ class TPUH264Encoder:
         # (tools/profile_link.py). host_convert=False keeps conversion on
         # device (better when the device is PCIe-local and link-rich).
         self.pipeline_depth = max(0, int(pipeline_depth))
+        # delta granularity: 16-row x _tile_w-col tiles. Column tiling
+        # shrinks the upload by the width fraction that changed (a cursor
+        # blink is one ~3 KB tile, not a ~46 KB full-width band); the
+        # largest power-of-two width that divides pad_w keeps device
+        # shapes static (pad_w itself => full bands, the old behavior).
+        self._tile_w = next(
+            (t for t in (128, 64, 32, 16) if self._pad_w % t == 0), self._pad_w
+        )
         self._prep: FramePrep | None = None
         if host_convert and channels == 4:
             # one conversion slot per possibly-in-flight async upload plus
@@ -347,14 +355,16 @@ class TPUH264Encoder:
             # inside the traced body): jax's trace cache is keyed on the
             # function object, so a global read would leak one encoder's
             # constants into another's executable.
-            _consts = dict(nscap=self._nscap, cap=self._cap_delta)
+            _consts = dict(nscap=self._nscap, cap=self._cap_delta, tile_w=self._tile_w)
             self._step_scatter_p = jax.jit(
                 partial(_p_scatter_step, **_consts), donate_argnums=(2, 3, 4, 5, 6, 7)
             )
             self._step_scatter_pk = jax.jit(
                 partial(_p_scatter_multi_step, **_consts), donate_argnums=(3, 4, 5, 6, 7, 8)
             )
-            self._step_scatter_i = jax.jit(_i_scatter_step, donate_argnums=(2, 3, 4))
+            self._step_scatter_i = jax.jit(
+                partial(_i_scatter_step, tile_w=self._tile_w), donate_argnums=(2, 3, 4)
+            )
             self._step_resident_i = jax.jit(_i_resident_step)
         else:
             self._step = jax.jit(
@@ -395,12 +405,20 @@ class TPUH264Encoder:
             sorted({self.frame_batch, max(2, self.frame_batch // 2)}, reverse=True)
         ) if self.frame_batch > 1 else ()
         self._batch_pend: list = []  # (rec, yb, ub, vb, idx) to group-dispatch
-        # delta bucket sizes: dirty-band counts round up to one of these so
+        nbands = self._pad_h // 16
+        ntx = self._pad_w // self._tile_w
+        ntiles = nbands * ntx
+        # delta bucket sizes: dirty-tile counts round up to one of these so
         # each resolution compiles a handful of scatter executables; frames
         # dirtier than the largest bucket use the full-upload path (the
         # delta would save little and each bucket costs a compile)
-        nbands = self._pad_h // 16
-        self._delta_buckets = tuple(b for b in (4, 8, 16, 32) if b <= nbands // 2)
+        self._delta_buckets = tuple(
+            b for b in (8, 16, 32, 64, 128, 256, 512) if b <= ntiles // 2
+        ) or ((ntiles // 2,) if ntiles >= 2 else ())
+        # grouped-dispatch buckets: small sparse-update group, then the
+        # area equivalents of the old 4- and 16-band group limits
+        self.BATCH_BUCKETS = tuple(sorted({16, 4 * ntx, 16 * ntx} | (
+            {self._delta_buckets[0]} if self._delta_buckets else set())))
         self._prev_frame: np.ndarray | None = None  # device-convert mode only
         self._inflight: deque = deque()
         self._pool = ThreadPoolExecutor(
@@ -442,16 +460,17 @@ class TPUH264Encoder:
     # -- frame classification (static / delta / full upload) -----------
 
     def _classify(self, frame: np.ndarray):
-        """-> ("static" | "delta" | "full", dirty_band_indices | None).
+        """-> ("static" | "delta" | "full", dirty_tile_indices | None).
 
-        Compares against the previous capture (FramePrep's per-16-row-band
-        memcmp when host conversion is on). "static": byte-identical — the
-        dominant remote-desktop case, zero device work. "delta": few dirty
-        bands and the device holds resident source planes — upload only
-        the changed bands. "full": everything else. The previous-frame
-        state advances on every call; that is safe because any encode
-        failure nulls self._ref/_src, forcing a full-upload IDR that
-        bypasses the static and delta paths."""
+        Compares against the previous capture (FramePrep's per-tile
+        memcmp when host conversion is on; tiles are 16 rows x _tile_w
+        cols). "static": byte-identical — the dominant remote-desktop
+        case, zero device work. "delta": few dirty tiles and the device
+        holds resident source planes — upload only the changed tiles
+        (idx encodes band*1024 + tile). "full": everything else. The
+        previous-frame state advances on every call; that is safe because
+        any encode failure nulls self._ref/_src, forcing a full-upload
+        IDR that bypasses the static and delta paths."""
         if self._prep is None:
             if self._prev_frame is None or self._prev_frame.shape != frame.shape:
                 self._prev_frame = frame.copy()
@@ -460,16 +479,17 @@ class TPUH264Encoder:
                 return "static", None
             np.copyto(self._prev_frame, frame)
             return "full", None
-        bands = self._prep.dirty_bands(frame)
-        if bands is None:
+        tiles = self._prep.dirty_tiles(frame, self._tile_w)
+        if tiles is None:
             return "full", None
-        if not bands.any():
+        if not tiles.any():
             return "static", None
         if self._src is None or not self._delta_buckets:
             return "full", None
-        idx = np.nonzero(bands)[0].astype(np.int32)
-        if len(idx) > self._delta_buckets[-1]:
+        band_i, tile_i = np.nonzero(tiles)
+        if len(band_i) > self._delta_buckets[-1]:
             return "full", None
+        idx = (band_i * 1024 + tile_i).astype(np.int32)
         return "delta", idx
 
     def _allskip_slice(self, frame_num: int) -> bytes:
@@ -529,10 +549,11 @@ class TPUH264Encoder:
         return ("p", out[0], None, None, out[1], out[2], out[3], out[4])
 
     @staticmethod
-    def _pack_bands(yb, ub, vb, idx, bucket: int) -> np.ndarray:
-        """Pad to `bucket` bands (repeating the last band — scattering a
-        band twice is idempotent) and pack into one upload buffer:
-        [idx int32 bytes] ++ yb ++ ub ++ vb (see _unpack_delta)."""
+    def _pack_tiles(yb, ub, vb, idx, bucket: int) -> np.ndarray:
+        """Pad to `bucket` tiles (repeating the last tile — rewriting a
+        tile is idempotent) and pack into one upload buffer:
+        [idx int32 bytes (band*1024 + tile)] ++ yb ++ ub ++ vb
+        (see _unpack_delta; element width is _tile_w luma cols)."""
         k = len(idx)
         if k < bucket:
             reps = bucket - k
@@ -543,11 +564,11 @@ class TPUH264Encoder:
         return np.concatenate([idx.view(np.uint8), yb.ravel(), ub.ravel(), vb.ravel()])
 
     def _run_step_delta(self, frame: np.ndarray, idx: np.ndarray, idr: bool):
-        """Single-frame delta: upload only the dirty bands; scatter+encode
+        """Single-frame delta: upload only the dirty tiles; scatter+encode
         on device. Returns (prefix_d, hdr_d, buf_d, recon triple)."""
         bucket = next(b for b in self._delta_buckets if b >= len(idx))
-        yb, ub, vb = self._prep.convert_bands(frame, idx)
-        packed_d = jax.device_put(self._pack_bands(yb, ub, vb, idx, bucket))
+        yb, ub, vb = self._prep.convert_tiles(frame, idx, self._tile_w)
+        packed_d = jax.device_put(self._pack_tiles(yb, ub, vb, idx, bucket))
         qp = np.int32(self.qp)
         if idr:
             prefix_d, buf_d, ry, ru, rv, sy, su, sv = self._step_scatter_i(
@@ -564,7 +585,6 @@ class TPUH264Encoder:
 
     # -- grouped delta dispatch (frame_batch > 1) -----------------------
 
-    BATCH_BUCKETS = (4, 16)
 
     def _flush_batch(self) -> None:
         """Dispatch the pending delta frames (if any) as device steps.
@@ -586,7 +606,7 @@ class TPUH264Encoder:
                 if take == 1:
                     rec, yb, ub, vb, idx = group[0]
                     bucket = next(b for b in self._delta_buckets if b >= len(idx))
-                    packed_d = jax.device_put(self._pack_bands(yb, ub, vb, idx, bucket))
+                    packed_d = jax.device_put(self._pack_tiles(yb, ub, vb, idx, bucket))
                     prefix_d, hdr_d, buf_d, ry, ru, rv, sy, su, sv = self._step_scatter_p(
                         packed_d, np.int32(rec.qp), *self._src, *self._ref
                     )
@@ -600,7 +620,7 @@ class TPUH264Encoder:
                     b for b in self.BATCH_BUCKETS if b >= max(len(g[4]) for g in group)
                 )
                 packed = np.stack(
-                    [self._pack_bands(yb, ub, vb, idx, bucket) for _, yb, ub, vb, idx in group]
+                    [self._pack_tiles(yb, ub, vb, idx, bucket) for _, yb, ub, vb, idx in group]
                 )
                 qps = np.array([g[0].qp for g in group], np.int32)
                 # two concurrent half uploads (h2d overlaps across threads)
@@ -746,10 +766,10 @@ class TPUH264Encoder:
             and self.frame_batch > 1
             and len(dirty_idx) <= self.BATCH_BUCKETS[-1]
         ):
-            # group candidate: convert the bands NOW (the capture buffer
-            # may be reused before dispatch), dispatch when the group
-            # fills or a non-groupable frame arrives
-            yb, ub, vb = self._prep.convert_bands(frame, dirty_idx)
+            # group candidate: convert the dirty tiles NOW (the capture
+            # buffer may be reused before dispatch), dispatch when the
+            # group fills or a non-groupable frame arrives
+            yb, ub, vb = self._prep.convert_tiles(frame, dirty_idx, self._tile_w)
             rec = _Pending(
                 kind="pd", frame_index=self.frame_index, qp=self.qp,
                 frame_num=self._frames_since_idr % 256, idr_pic_id=0,
@@ -856,7 +876,10 @@ class TPUH264Encoder:
             if dispatched > self.pipeline_depth:
                 out.append(self._emit(self._inflight.popleft()))  # blocking wait
                 continue
-            if len(self._inflight) > self.pipeline_depth + self.frame_batch:
+            # frame-count backstop: pipeline_depth ROUND TRIPS of grouped
+            # dispatches plus the group being accumulated (with
+            # frame_batch=1 this is the old depth+1 frame bound)
+            if len(self._inflight) > (self.pipeline_depth + 1) * self.frame_batch:
                 if head.future is None:
                     self._flush_batch()  # give the stalled head a future
                 else:

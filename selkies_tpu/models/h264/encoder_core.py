@@ -630,6 +630,25 @@ def _skip_mask(mvs, resid_zero):
     return resid_zero & (mvs == skipmv).all(-1)
 
 
+def _use_pallas_me(width: int) -> bool:
+    """Pallas ME dispatch: on by default on real TPU backends (interpret
+    mode on CPU is far slower than the XLA scan), off above the kernel's
+    128-MB row width, SELKIES_PALLAS_ME=0/1 overrides."""
+    import os
+
+    env = os.environ.get("SELKIES_PALLAS_ME")
+    if env == "0":
+        return False
+    if width // 16 > 128:
+        return False
+    if env == "1":
+        return True
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
 def encode_frame_p_planes(y, u, v, ref_y, ref_u, ref_v, qp, search: int = 8, me: str = "hier"):
     """Jitted P-frame encode on padded planes against the previous recon.
 
@@ -653,8 +672,16 @@ def encode_frame_p_planes(y, u, v, ref_y, ref_u, ref_v, qp, search: int = 8, me:
 
     if me == "hier":
         # fused gather-free ME+MC: predictions fall out of the same
-        # candidate scan that picks the MVs
-        mvs, pred_y, pred_u, pred_v = hier_me_mc(y, ref_y, ry, ru, rv)
+        # candidate scan that picks the MVs. On TPU the Pallas kernel
+        # (pallas_me.py) runs the same search ~3x faster by keeping each
+        # MB row's reference window in VMEM; outputs are bit-identical
+        # (tests/test_pallas_me.py), so this is purely a speed dispatch.
+        if _use_pallas_me(y.shape[1]):
+            from selkies_tpu.models.h264.pallas_me import hier_me_mc_pallas
+
+            mvs, pred_y, pred_u, pred_v = hier_me_mc_pallas(y, ref_y, ry, ru, rv)
+        else:
+            mvs, pred_y, pred_u, pred_v = hier_me_mc(y, ref_y, ry, ru, rv)
     else:
         mvs = motion_search(y, ry, search)
         pred_y = mc_luma(ry, mvs)
@@ -904,6 +931,28 @@ def scatter_bands(y, u, v, yb, ub, vb, idx):
         py = jax.lax.dynamic_update_slice(py, yb[i], (idx[i] * 16, 0))
         pu = jax.lax.dynamic_update_slice(pu, ub[i], (idx[i] * 8, 0))
         pv = jax.lax.dynamic_update_slice(pv, vb[i], (idx[i] * 8, 0))
+        return py, pu, pv
+
+    return jax.lax.fori_loop(0, yb.shape[0], body, (y, u, v))
+
+def scatter_tiles(y, u, v, yb, ub, vb, idx, tile_w: int):
+    """Scatter uploaded I420 TILES into device-resident planes.
+
+    yb: (k, 16, tile_w) luma, ub/vb: (k, 8, tile_w/2) chroma, idx: (k,)
+    int32 encoded band*1024 + tile (duplicates allowed — rewriting a
+    tile is idempotent, which lets the host pad k to a static bucket).
+    tile_w == plane width degenerates to scatter_bands. Column tiling
+    shrinks the host->device delta traffic by the width fraction that
+    actually changed (a cursor blink is one tile, not a full-width band)."""
+    ctw = tile_w // 2
+
+    def body(i, planes):
+        py, pu, pv = planes
+        band = idx[i] // 1024
+        tile = idx[i] % 1024
+        py = jax.lax.dynamic_update_slice(py, yb[i], (band * 16, tile * tile_w))
+        pu = jax.lax.dynamic_update_slice(pu, ub[i], (band * 8, tile * ctw))
+        pv = jax.lax.dynamic_update_slice(pv, vb[i], (band * 8, tile * ctw))
         return py, pu, pv
 
     return jax.lax.fori_loop(0, yb.shape[0], body, (y, u, v))

@@ -199,11 +199,18 @@ class Av1Depayloader:
     def __init__(self) -> None:
         self._obus: list[bytes] = []
         self._frag: bytearray | None = None
+        self._last_seq: int | None = None
+        self._broken = False  # loss detected: drop the TU at its marker
 
     def push(self, pkt: RtpPacket) -> bytes | None:
         p = pkt.payload
         if not p:
             return None
+        # a sequence gap means part of this TU is gone: a truncated TU
+        # must be dropped at the marker, not emitted as if complete
+        if self._last_seq is not None and pkt.sequence != (self._last_seq + 1) & 0xFFFF:
+            self._broken = True
+        self._last_seq = pkt.sequence
         b0 = p[0]
         z, y, w = bool(b0 & 0x80), bool(b0 & 0x40), (b0 >> 4) & 3
         i = 1
@@ -225,7 +232,8 @@ class Av1Depayloader:
             first, last = j == 0, j == len(elements) - 1
             if first and z:
                 if self._frag is None:
-                    continue  # continuation of a packet we never saw
+                    self._broken = True  # continuation of a lost start
+                    continue
                 self._frag.extend(el)
                 if last and y:
                     return self._finish(pkt.marker)
@@ -242,6 +250,7 @@ class Av1Depayloader:
             return None
         self._frag = None
         obus, self._obus = self._obus, []
-        if not obus:
+        broken, self._broken = self._broken, False
+        if broken or not obus:
             return None
         return b"".join(_add_size_field(o) for o in obus)

@@ -125,6 +125,9 @@ class RemoteDescription:
     ulpfec_pt: int | None = None
     twcc_id: int | None = None
     sctp_port: int = 5000
+    # AV1 rtpmap matched video_pt only as a fallback (no preferred codec
+    # seen yet); a later H264/VP8/VP9 line overrides it
+    _video_is_av1: bool = False
 
 
 def parse_answer(sdp: str) -> RemoteDescription:
@@ -150,8 +153,15 @@ def parse_answer(sdp: str) -> RemoteDescription:
             body = line[len("a=rtpmap:"):]
             pt, enc = body.split(" ", 1)
             current_rtpmaps[int(pt)] = enc
-            if enc.upper().startswith(("H264/", "VP8/", "VP9/", "AV1/")) and r.video_pt is None:
+            if enc.upper().startswith(("H264/", "VP8/", "VP9/")):
+                if r.video_pt is None or r._video_is_av1:
+                    r.video_pt = int(pt)
+                    r._video_is_av1 = False
+            elif enc.upper().startswith("AV1/") and r.video_pt is None:
+                # fallback only: the transport pays H.264/VP8/VP9 today;
+                # an answer listing AV1 first must not shadow those PTs
                 r.video_pt = int(pt)
+                r._video_is_av1 = True
             elif enc.lower().startswith("red/") and r.red_pt is None:
                 r.red_pt = int(pt)
             elif enc.lower().startswith("ulpfec/") and r.ulpfec_pt is None:

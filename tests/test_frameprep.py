@@ -66,3 +66,60 @@ def test_dirty_bands():
 def test_odd_size_rejected():
     with pytest.raises(ValueError):
         FramePrep(63, 48, 64, 48)
+
+
+def test_dirty_tiles_and_convert_tiles_bit_exact():
+    """Tile diff localizes changes in both axes, and convert_tiles is
+    bit-exact with the same region of a full convert (incl. the
+    replicated right/bottom padding of edge tiles)."""
+    rng = np.random.default_rng(9)
+    h, w = 70, 180  # pad 80x192, tile_w 64 -> 3 tiles x 5 bands
+    ph, pw, tw = 80, 192, 64
+    f1 = rng.integers(0, 256, (h, w, 4), dtype=np.uint8)
+    prep = FramePrep(w, h, pw, ph)
+    assert prep.dirty_tiles(f1, tw) is None
+    assert not prep.dirty_tiles(f1, tw).any()
+    f2 = f1.copy()
+    f2[BAND_ROWS * 2 + 3, 70] ^= 0xFF   # band 2, tile 1
+    f2[67, 175] ^= 0xFF                 # band 4 (bottom), tile 2 (edge)
+    tiles = prep.dirty_tiles(f2, tw)
+    expect = np.zeros_like(tiles)
+    expect[2, 1] = True
+    expect[4, 2] = True
+    np.testing.assert_array_equal(tiles, expect)
+
+    band_i, tile_i = np.nonzero(tiles)
+    idx = (band_i * 1024 + tile_i).astype(np.int32)
+    yb, ub, vb = prep.convert_tiles(f2, idx, tw)
+    fy, fu, fv = _numpy_convert_pad(f2, ph, pw)
+    for i, t in enumerate(idx):
+        band, tile = int(t) // 1024, int(t) % 1024
+        np.testing.assert_array_equal(
+            yb[i], fy[band * 16:band * 16 + 16, tile * tw:(tile + 1) * tw])
+        np.testing.assert_array_equal(
+            ub[i], fu[band * 8:band * 8 + 8, tile * 32:(tile + 1) * 32])
+        np.testing.assert_array_equal(
+            vb[i], fv[band * 8:band * 8 + 8, tile * 32:(tile + 1) * 32])
+
+
+def test_convert_tiles_full_cover_matches_convert():
+    """Converting EVERY tile reassembles the full padded planes exactly
+    (covers edge replication at the right/bottom paths)."""
+    rng = np.random.default_rng(10)
+    h, w = 34, 100  # pad 48x112 -> tile_w 16, 7 tiles x 3 bands
+    ph, pw, tw = 48, 112, 16
+    frame = rng.integers(0, 256, (h, w, 4), dtype=np.uint8)
+    prep = FramePrep(w, h, pw, ph)
+    nb, nt = ph // 16, pw // tw
+    idx = np.array([b * 1024 + t for b in range(nb) for t in range(nt)], np.int32)
+    yb, ub, vb = prep.convert_tiles(frame, idx, tw)
+    fy, fu, fv = _numpy_convert_pad(frame, ph, pw)
+    ry = np.zeros_like(fy); ru = np.zeros_like(fu); rv = np.zeros_like(fv)
+    for i, t in enumerate(idx):
+        b, tl = int(t) // 1024, int(t) % 1024
+        ry[b * 16:b * 16 + 16, tl * tw:(tl + 1) * tw] = yb[i]
+        ru[b * 8:b * 8 + 8, tl * 8:(tl + 1) * 8] = ub[i]
+        rv[b * 8:b * 8 + 8, tl * 8:(tl + 1) * 8] = vb[i]
+    np.testing.assert_array_equal(ry, fy)
+    np.testing.assert_array_equal(ru, fu)
+    np.testing.assert_array_equal(rv, fv)

@@ -106,18 +106,29 @@ def test_multi_tu_stream():
     assert seqs == list(range(len(seqs)))  # contiguous RTP sequence space
 
 
-def test_lost_continuation_discarded():
-    """A continuation arriving without its start must not emit garbage."""
+def test_lost_packet_drops_truncated_tu():
+    """Loss anywhere in a TU (detected by continuation-without-start or a
+    sequence gap) must drop the whole TU, never emit a truncated one —
+    and the next intact TU must still come through."""
     pay = Av1Payloader()
     frame = _obu(6, bytes(2000))
-    pkts = pay.payload_tu(_tu(frame), timestamp=0)
+    meta = _obu(5, b"\x01\x02\x03")
+    pkts = pay.payload_tu(_tu(frame, meta), timestamp=0)
     assert len(pkts) >= 2
     depay = Av1Depayloader()
-    out = [depay.push(p) for p in pkts[1:]]  # first packet lost
-    assert all(o in (None, b"") or b"" == o for o in out if o is not None) or \
-        all(o is None for o in out[:-1])
-    # the TU must not equal the original (its head is gone)
-    assert out[-1] != _tu(frame)
+    outs = [depay.push(p) for p in pkts[1:]]  # first packet lost
+    assert all(o is None for o in outs), outs
+    # intact follow-up TU decodes despite the preceding loss
+    tu2 = _tu(_obu(6, bytes(range(100))))
+    outs = [depay.push(p) for p in pay.payload_tu(tu2, timestamp=3000)]
+    assert outs[-1] == tu2
+
+    # middle-packet loss of a multi-packet TU also drops it
+    pay2, depay2 = Av1Payloader(), Av1Depayloader()
+    pkts = pay2.payload_tu(_tu(_obu(6, bytes(5000))), timestamp=0)
+    assert len(pkts) >= 3
+    outs = [depay2.push(p) for p in (pkts[0], *pkts[2:])]
+    assert all(o is None for o in outs), outs
 
 
 def test_registry_h265_and_av1_names_resolve(monkeypatch):

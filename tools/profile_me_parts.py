@@ -1,0 +1,101 @@
+#!/usr/bin/env python3
+"""Split the P-step device time into ME cost scan / pred scan / coarse
+vote / residual+transform, each timed as its own jitted program on the
+real chip (timings by np.asarray sync; subtract the ~dispatch floor
+printed as 'noop')."""
+import sys, time
+import numpy as np
+
+sys.path.insert(0, ".")
+import jax
+import jax.numpy as jnp
+
+from selkies_tpu.models.h264 import encoder_core as core
+
+H, W = 1088, 1920
+rng = np.random.default_rng(7)
+cur = rng.integers(0, 255, (H, W), np.uint8)
+ref = np.roll(cur, (3, -5), (0, 1))
+cu = rng.integers(0, 255, (H // 2, W // 2), np.uint8)
+
+cur_j = jnp.asarray(cur.astype(np.int32))
+ry_pad = jnp.asarray(np.pad(ref, core.MV_PAD, mode="edge"))
+ru_pad = jnp.asarray(np.pad(cu, core.MV_PAD, mode="edge"))
+rv_pad = jnp.asarray(np.pad(cu, core.MV_PAD, mode="edge"))
+ref_j = jnp.asarray(ref)
+
+
+_tiny = jax.jit(lambda a: a.ravel()[:1])
+
+
+def _sync(out):
+    """Force completion via a 1-element fetch (FIFO device queue) so the
+    timing excludes bulk d2h; see profile_pbstep.py."""
+    leaves = jax.tree_util.tree_leaves(out)
+    np.asarray(_tiny(leaves[0]))
+
+
+def timed(name, fn, *args, reps=5):
+    out = fn(*args)
+    _sync(out)  # warm compile
+    best = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        _sync(out)
+        best.append(time.perf_counter() - t0)
+    print(f"{name:28s} {1e3 * min(best):8.2f} ms (min of {reps})")
+    return out
+
+
+noop = jax.jit(lambda a: a[:8, :128] + 1)
+timed("noop (dispatch+fetch floor)", noop, cur_j)
+
+coarse = jax.jit(core.coarse_vote_candidates_jnp)
+timed("coarse_vote", coarse, cur_j, ref_j)
+
+
+@jax.jit
+def cost_only(cur, ry_pad, ref):
+    cands = core._refine_cands_jnp(core.coarse_vote_candidates_jnp(cur, ref))
+    ncand = cands.shape[0]
+    h, w = cur.shape
+    mbh, mbw = h // 16, w // 16
+    ranks = jnp.arange(ncand, dtype=jnp.int32)
+    scale = 1 << int(np.int64(ncand - 1)).bit_length()
+    chunk = 4
+    cands_c = cands.reshape(-1, chunk, 2)
+    ranks_c = ranks.reshape(-1, chunk)
+
+    def cost_step(best_cost, xs):
+        mvs_k, ranks_k = xs
+        for k in range(chunk):
+            mv = mvs_k[k]
+            ys = jax.lax.dynamic_slice(ry_pad, (core.MV_PAD + mv[1], core.MV_PAD + mv[0]), (h, w))
+            sad = jnp.abs(cur - ys.astype(jnp.int32)).reshape(mbh, 16, mbw, 16).sum(axis=(1, 3))
+            best_cost = jnp.minimum(sad * scale + ranks_k[k], best_cost)
+        return best_cost, None
+
+    init = jnp.full((mbh, mbw), jnp.iinfo(jnp.int32).max, jnp.int32)
+    best, _ = jax.lax.scan(cost_step, init, (cands_c, ranks_c))
+    return best
+
+
+timed("coarse+cost scan", cost_only, cur_j, ry_pad, ref_j)
+
+full = jax.jit(core.hier_me_mc)
+timed("hier_me_mc (full ME+MC)", full, cur_j, ref_j, ry_pad, ru_pad, rv_pad)
+
+
+@jax.jit
+def p_planes(y, u, v, ry, ru, rv):
+    return core.encode_frame_p_planes(y, u, v, ry, ru, rv, jnp.int32(28))
+
+
+y = jnp.asarray(cur)
+u = jnp.asarray(cu)
+v = jnp.asarray(cu)
+ryf = jnp.asarray(ref)
+ruf = jnp.asarray(cu)
+rvf = jnp.asarray(cu)
+timed("encode_frame_p_planes", p_planes, y, u, v, ryf, ruf, rvf)
