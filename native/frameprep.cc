@@ -28,6 +28,32 @@ inline uint8_t clip_u8(int v, int lo, int hi) {
     return static_cast<uint8_t>(v < lo ? lo : (v > hi ? hi : v));
 }
 
+// Convert one 2x2 BGRx quad (rows row0/row1, luma cols 2*c2, 2*c2+1) to
+// two Y pairs + one averaged U/V sample — the single definition of the
+// BT.601 matrix and chroma averaging every converter shares (the tile
+// path advertises bit-exactness against the full-plane path; one body
+// makes that structural).
+inline void quad_to_i420(const uint8_t* row0, const uint8_t* row1, int c2,
+                         uint8_t* y0, uint8_t* y1, int yo,
+                         uint8_t* ur, uint8_t* vr, int co) {
+    int usum = 0, vsum = 0;
+    const uint8_t* p[2] = {row0 + 8 * c2, row1 + 8 * c2};
+    for (int dy = 0; dy < 2; ++dy) {
+        for (int dx = 0; dx < 2; ++dx) {
+            const uint8_t* px = p[dy] + 4 * dx;
+            const int b = px[0], g = px[1], r = px[2];
+            const int yy = ((66 * r + 129 * g + 25 * b + 128) >> 8) + 16;
+            const int uu = ((-38 * r - 74 * g + 112 * b + 128) >> 8) + 128;
+            const int vv = ((112 * r - 94 * g - 18 * b + 128) >> 8) + 128;
+            (dy ? y1 : y0)[yo + dx] = clip_u8(yy, 16, 235);
+            usum += uu < 16 ? 16 : (uu > 240 ? 240 : uu);
+            vsum += vv < 16 ? 16 : (vv > 240 ? 240 : vv);
+        }
+    }
+    ur[co] = static_cast<uint8_t>((usum + 2) >> 2);
+    vr[co] = static_cast<uint8_t>((vsum + 2) >> 2);
+}
+
 }  // namespace
 
 extern "C" {
@@ -46,24 +72,8 @@ void bgrx_to_i420_pad(const uint8_t* src, int h, int w, int ph, int pw,
         uint8_t* y1 = y0 + pw;
         uint8_t* ur = u + static_cast<size_t>(r2) * cpw;
         uint8_t* vr = v + static_cast<size_t>(r2) * cpw;
-        for (int c2 = 0; c2 < cw; ++c2) {
-            int usum = 0, vsum = 0;
-            const uint8_t* p[2] = {row0 + 8 * c2, row1 + 8 * c2};
-            for (int dy = 0; dy < 2; ++dy) {
-                for (int dx = 0; dx < 2; ++dx) {
-                    const uint8_t* px = p[dy] + 4 * dx;
-                    const int b = px[0], g = px[1], r = px[2];
-                    const int yy = ((66 * r + 129 * g + 25 * b + 128) >> 8) + 16;
-                    const int uu = ((-38 * r - 74 * g + 112 * b + 128) >> 8) + 128;
-                    const int vv = ((112 * r - 94 * g - 18 * b + 128) >> 8) + 128;
-                    (dy ? y1 : y0)[2 * c2 + dx] = clip_u8(yy, 16, 235);
-                    usum += uu < 16 ? 16 : (uu > 240 ? 240 : uu);
-                    vsum += vv < 16 ? 16 : (vv > 240 ? 240 : vv);
-                }
-            }
-            ur[c2] = static_cast<uint8_t>((usum + 2) >> 2);
-            vr[c2] = static_cast<uint8_t>((vsum + 2) >> 2);
-        }
+        for (int c2 = 0; c2 < cw; ++c2)
+            quad_to_i420(row0, row1, c2, y0, y1, 2 * c2, ur, vr, c2);
         // edge-replicate horizontal padding
         for (int c = w; c < pw; ++c) {
             y0[c] = y0[w - 1];
@@ -80,76 +90,6 @@ void bgrx_to_i420_pad(const uint8_t* src, int h, int w, int ph, int pw,
     for (int r = ch; r < cph; ++r) {
         std::memcpy(u + static_cast<size_t>(r) * cpw, u + static_cast<size_t>(ch - 1) * cpw, cpw);
         std::memcpy(v + static_cast<size_t>(r) * cpw, v + static_cast<size_t>(ch - 1) * cpw, cpw);
-    }
-}
-
-// Convert k 16-row bands of src ((h, w, 4) BGRx) to packed I420 band
-// buffers: yb (k, 16, pw), ub/vb (k, 8, pw/2). band_idx[i] selects the
-// band (luma rows 16*idx..16*idx+15 of the PADDED plane). Output is
-// bit-exact with the same rows of bgrx_to_i420_pad, including the
-// replicated right/bottom padding, so scattering a band into a
-// device-resident plane reproduces the full conversion. This is the
-// delta-upload path: only changed bands cross the host->device link
-// (the reference gets the analogous effect from ximagesrc's XDamage).
-void bgrx_to_i420_bands(const uint8_t* src, int h, int w, int pw,
-                        const int32_t* band_idx, int k,
-                        uint8_t* yb, uint8_t* ub, uint8_t* vb) {
-    const int cw = w / 2, ch = h / 2;
-    const int cpw = pw / 2;
-    for (int b = 0; b < k; ++b) {
-        const int g0 = band_idx[b] * 16;  // first luma row of the band
-        uint8_t* ybb = yb + static_cast<size_t>(b) * 16 * pw;
-        uint8_t* ubb = ub + static_cast<size_t>(b) * 8 * cpw;
-        uint8_t* vbb = vb + static_cast<size_t>(b) * 8 * cpw;
-        for (int p = 0; p < 8; ++p) {  // row pair: luma g0+2p, g0+2p+1
-            const int r = g0 + 2 * p;
-            uint8_t* y0 = ybb + static_cast<size_t>(2 * p) * pw;
-            uint8_t* y1 = y0 + pw;
-            uint8_t* ur = ubb + static_cast<size_t>(p) * cpw;
-            uint8_t* vr = vbb + static_cast<size_t>(p) * cpw;
-            if (r + 1 < h || r < h) {
-                // content pair (h is even, so r < h implies r+1 < h)
-                const uint8_t* row0 = src + static_cast<size_t>(r) * w * 4;
-                const uint8_t* row1 = row0 + static_cast<size_t>(w) * 4;
-                for (int c2 = 0; c2 < cw; ++c2) {
-                    int usum = 0, vsum = 0;
-                    const uint8_t* pr[2] = {row0 + 8 * c2, row1 + 8 * c2};
-                    for (int dy = 0; dy < 2; ++dy) {
-                        for (int dx = 0; dx < 2; ++dx) {
-                            const uint8_t* px = pr[dy] + 4 * dx;
-                            const int bb = px[0], gg = px[1], rr = px[2];
-                            const int yy = ((66 * rr + 129 * gg + 25 * bb + 128) >> 8) + 16;
-                            const int uu = ((-38 * rr - 74 * gg + 112 * bb + 128) >> 8) + 128;
-                            const int vv = ((112 * rr - 94 * gg - 18 * bb + 128) >> 8) + 128;
-                            (dy ? y1 : y0)[2 * c2 + dx] = clip_u8(yy, 16, 235);
-                            usum += uu < 16 ? 16 : (uu > 240 ? 240 : uu);
-                            vsum += vv < 16 ? 16 : (vv > 240 ? 240 : vv);
-                        }
-                    }
-                    ur[c2] = static_cast<uint8_t>((usum + 2) >> 2);
-                    vr[c2] = static_cast<uint8_t>((vsum + 2) >> 2);
-                }
-                for (int c = w; c < pw; ++c) {
-                    y0[c] = y0[w - 1];
-                    y1[c] = y1[w - 1];
-                }
-                for (int c = cw; c < cpw; ++c) {
-                    ur[c] = ur[cw - 1];
-                    vr[c] = vr[cw - 1];
-                }
-            } else {
-                // padding pair: replicate the plane's last content rows.
-                // Those rows live in THIS band (pad - h < 16), already
-                // converted by an earlier pair.
-                const uint8_t* ylast = ybb + static_cast<size_t>(h - 1 - g0) * pw;
-                std::memcpy(y0, ylast, pw);
-                std::memcpy(y1, ylast, pw);
-                const uint8_t* ulast = ubb + static_cast<size_t>(ch - 1 - g0 / 2) * cpw;
-                const uint8_t* vlast = vbb + static_cast<size_t>(ch - 1 - g0 / 2) * cpw;
-                std::memcpy(ur, ulast, cpw);
-                std::memcpy(vr, vlast, cpw);
-            }
-        }
     }
 }
 
@@ -235,25 +175,8 @@ void bgrx_to_i420_tiles(const uint8_t* src, int h, int w, int pw, int tw,
             if (r < h) {
                 const uint8_t* row0 = src + static_cast<size_t>(r) * w * 4;
                 const uint8_t* row1 = row0 + static_cast<size_t>(w) * 4;
-                for (int c2 = 0; c2 < content_cols2; ++c2) {
-                    const int cc = c0 + 2 * c2;
-                    int usum = 0, vsum = 0;
-                    const uint8_t* pr[2] = {row0 + 4 * cc, row1 + 4 * cc};
-                    for (int dy = 0; dy < 2; ++dy) {
-                        for (int dx = 0; dx < 2; ++dx) {
-                            const uint8_t* px = pr[dy] + 4 * dx;
-                            const int bb = px[0], gg = px[1], rr = px[2];
-                            const int yy = ((66 * rr + 129 * gg + 25 * bb + 128) >> 8) + 16;
-                            const int uu = ((-38 * rr - 74 * gg + 112 * bb + 128) >> 8) + 128;
-                            const int vv = ((112 * rr - 94 * gg - 18 * bb + 128) >> 8) + 128;
-                            (dy ? y1 : y0)[2 * c2 + dx] = clip_u8(yy, 16, 235);
-                            usum += uu < 16 ? 16 : (uu > 240 ? 240 : uu);
-                            vsum += vv < 16 ? 16 : (vv > 240 ? 240 : vv);
-                        }
-                    }
-                    ur[c2] = static_cast<uint8_t>((usum + 2) >> 2);
-                    vr[c2] = static_cast<uint8_t>((vsum + 2) >> 2);
-                }
+                for (int c2 = 0; c2 < content_cols2; ++c2)
+                    quad_to_i420(row0, row1, (c0 / 2) + c2, y0, y1, 2 * c2, ur, vr, c2);
                 // horizontal padding: replicate col w-1 (always inside
                 // this tile when padding cols exist here: pw - w < 16 <= tw)
                 for (int c = 2 * content_cols2; c < tw; ++c) {

@@ -65,9 +65,6 @@ def _load() -> ctypes.CDLL | None:
     lib.band_diff.restype = ctypes.c_int
     lib.band_diff.argtypes = [u8p, u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, u8p]
     try:
-        lib.bgrx_to_i420_bands.restype = None
-        lib.bgrx_to_i420_bands.argtypes = [u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-                                           i32p, ctypes.c_int, u8p, u8p, u8p]
         lib.tile_diff.restype = ctypes.c_int
         lib.tile_diff.argtypes = [u8p, u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
                                   ctypes.c_int, u8p, u8p]
@@ -75,7 +72,7 @@ def _load() -> ctypes.CDLL | None:
         lib.bgrx_to_i420_tiles.argtypes = [u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
                                            ctypes.c_int, i32p, ctypes.c_int, u8p, u8p, u8p]
     except AttributeError:
-        pass  # stale .so without the band/tile converters; numpy fallback used
+        pass  # stale .so without the tile converters; numpy fallback used
     _lib = lib
     return lib
 
@@ -169,34 +166,6 @@ class FramePrep:
         """Forget the previous frame: the next dirty_bands() reports
         everything dirty (used by encoder prewarm / stream restart)."""
         self._prev = None
-
-    def convert_bands(self, frame: np.ndarray, idx: np.ndarray):
-        """Convert only the 16-row bands listed in idx (int32, plane band
-        numbers) to packed I420 band buffers: (k, 16, pad_w) luma and
-        (k, 8, pad_w/2) chroma, bit-exact with the same rows of a full
-        convert(). Fresh arrays per call — safe to hand to an async
-        device upload with no slot-rotation hazard."""
-        if frame.shape != (self.height, self.width, 4):
-            raise ValueError(f"frame {frame.shape} != {(self.height, self.width, 4)}")
-        if not frame.flags["C_CONTIGUOUS"]:
-            frame = np.ascontiguousarray(frame)
-        idx = np.ascontiguousarray(idx, np.int32)
-        k = len(idx)
-        yb = np.empty((k, 16, self.pad_w), np.uint8)
-        ub = np.empty((k, 8, self.pad_w // 2), np.uint8)
-        vb = np.empty((k, 8, self.pad_w // 2), np.uint8)
-        if self._lib is not None and hasattr(self._lib, "bgrx_to_i420_bands"):
-            self._lib.bgrx_to_i420_bands(
-                _u8p(frame), self.height, self.width, self.pad_w,
-                idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), k,
-                _u8p(yb), _u8p(ub), _u8p(vb),
-            )
-        else:
-            y, u, v = _numpy_convert_pad(frame, self.pad_h, self.pad_w)
-            yb[:] = y.reshape(-1, 16, self.pad_w)[idx]
-            ub[:] = u.reshape(-1, 8, self.pad_w // 2)[idx]
-            vb[:] = v.reshape(-1, 8, self.pad_w // 2)[idx]
-        return yb, ub, vb
 
     def convert_tiles(self, frame: np.ndarray, idx: np.ndarray, tile_w: int):
         """Convert only the 16-row x tile_w-col tiles listed in idx

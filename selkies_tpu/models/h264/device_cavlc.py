@@ -86,6 +86,28 @@ _CHROMA_ORDER = np.asarray([[x, y] for x, y in T.CHROMA_BLOCK_ORDER], np.int32)
 WORD_CAP_DEFAULT = 1 << 17  # 512 KB frame bitstream capacity
 
 
+def _lut(idx, pair: np.ndarray):
+    """(value, bits) VLC lookup via a one-hot f32 matmul.
+
+    pair: (N, 2) np table. Per-element gathers price ~17 ns on v5e — a
+    (B, 15) run_before lookup pair costs 30+ ms as a gather and ~1 ms as
+    an MXU contraction (tools/profile_cavlc_device.py). f32 is exact for
+    every VLC value (< 2^24)."""
+    n = pair.shape[0]
+    flat = idx.reshape(-1)
+    oh = (flat[:, None] == jnp.arange(n, dtype=jnp.int32)[None, :]).astype(jnp.float32)
+    out = jnp.dot(oh, jnp.asarray(pair, jnp.float32),
+                  preferred_element_type=jnp.float32)
+    return (out[:, 0].reshape(idx.shape).astype(jnp.int32),
+            out[:, 1].reshape(idx.shape).astype(jnp.int32))
+
+
+_RB_PAIR = np.stack([_RB_VAL.reshape(-1), _RB_BITS.reshape(-1)], 1).astype(np.float32)
+_TZ_PAIR = np.stack([_TZ_VAL.reshape(-1), _TZ_BITS.reshape(-1)], 1).astype(np.float32)
+_TZC_PAIR = np.stack([_TZC_VAL.reshape(-1), _TZC_BITS.reshape(-1)], 1).astype(np.float32)
+_CT_PAIR = np.stack([_CT_VAL.reshape(-1), _CT_BITS.reshape(-1)], 1).astype(np.float32)
+
+
 def _ue_bits(v):
     """Exp-Golomb codeword for v (vectorized): (value, nbits)."""
     v1 = v + 1
@@ -189,33 +211,38 @@ def _encode_blocks(coeffs, nc, chroma_dc: bool):
     cls = jnp.where(
         nc < 0, 4, jnp.where(nc < 2, 0, jnp.where(nc < 4, 1, jnp.where(nc < 8, 2, 3)))
     )
-    ct_val = jnp.asarray(_CT_VAL)[cls, total, t1]
-    ct_bits = jnp.asarray(_CT_BITS)[cls, total, t1]
+    ct_val, ct_bits = _lut(cls * 68 + total * 4 + t1, _CT_PAIR)
     # nc >= 8: arithmetic FLC (class 3 table rows were generated for nc=8;
     # they ARE the FLC — generated from the same function, so no special
     # case needed here)
 
-    S = 1 + 3 + 2 * L + 1 + (L - 1)  # token, t1s, level pairs, tz, runs
-    vals = jnp.zeros((B, S), jnp.int32)
-    bits = jnp.zeros((B, S), jnp.int32)
-    vals = vals.at[:, 0].set(ct_val)
-    bits = bits.at[:, 0].set(ct_bits)
-
-    # t1 signs (reverse order): slot 1..3
+    # Slot layout (emission order): token, 3 t1 signs, 2L interleaved
+    # level (prefix, suffix) pairs, total_zeros, L-1 run_befores. The
+    # segments are built separately and CONCATENATED once — strided
+    # .at[].set() column writes into a (B, S) buffer relayout the whole
+    # array per write on TPU.
+    sign_v, sign_b = [], []
     for k in range(3):
         sign = (val_rev[:, k] < 0).astype(jnp.int32)
         use = (k < t1) & (total > 0)
-        vals = vals.at[:, 1 + k].set(jnp.where(use, sign, 0))
-        bits = bits.at[:, 1 + k].set(jnp.where(use, 1, 0))
+        sign_v.append(jnp.where(use, sign, 0))
+        sign_b.append(jnp.where(use, 1, 0))
 
     # levels after the trailing ones. The suffix-length adaptation is the
-    # only sequential dependency (~10 ops/step in a native-xs scan); the
-    # codeword construction (_level_bits with its escape/extended-prefix
-    # logic) depends only on (level, suffix_len_before, is_first), so it
-    # runs ONCE vectorized over all (L, B) slots outside the scan.
-    def sl_step(carry, xs):
-        suffix_len, first_done = carry
-        level, k = xs
+    # only sequential dependency (~10 ops/step); the codeword
+    # construction (_level_bits with its escape/extended-prefix logic)
+    # depends only on (level, suffix_len_before, is_first), so it runs
+    # ONCE vectorized over all (L, B) slots. The L-step walk is UNROLLED
+    # in Python: a lax.scan at this width pays ~1.5 ms of per-step launch
+    # overhead on v5e (tools/profile_cavlc_device.py) while the unrolled
+    # form fuses into a handful of kernels.
+    init_sl = jnp.where((total > 10) & (t1 < 3), 1, 0)
+    val_t = val_rev.T  # (L, B)
+    sls_l, firsts_l, uses_l = [], [], []
+    suffix_len = init_sl
+    first_done = jnp.zeros((B,), bool)
+    for k in range(L):
+        level = val_t[k]
         use = (k >= t1) & (k < total)
         is_first = use & ~first_done
         new_sl = jnp.where(suffix_len == 0, 1, suffix_len)
@@ -224,15 +251,14 @@ def _encode_blocks(coeffs, nc, chroma_dc: bool):
             new_sl + 1,
             new_sl,
         )
-        out = (suffix_len, is_first, use)
-        return (jnp.where(use, new_sl, suffix_len), first_done | is_first), out
-
-    init_sl = jnp.where((total > 10) & (t1 < 3), 1, 0)
-    ks = jnp.arange(L, dtype=jnp.int32)
-    val_t = val_rev.T  # (L, B)
-    (_, _), (sls, firsts, uses) = jax.lax.scan(
-        sl_step, (init_sl, jnp.zeros((B,), bool)), (val_t, ks)
-    )
+        sls_l.append(suffix_len)
+        firsts_l.append(is_first)
+        uses_l.append(use)
+        suffix_len = jnp.where(use, new_sl, suffix_len)
+        first_done = first_done | is_first
+    sls = jnp.stack(sls_l)
+    firsts = jnp.stack(firsts_l)
+    uses = jnp.stack(uses_l)
     level_code = jnp.where(val_t > 0, 2 * val_t - 2, -2 * val_t - 1)
     level_code = jnp.where(firsts & (t1[None, :] < 3), level_code - 2, level_code)
     lv1, lb1, lv2, lb2 = _level_bits(level_code, sls)
@@ -240,43 +266,37 @@ def _encode_blocks(coeffs, nc, chroma_dc: bool):
     lb1 = jnp.where(uses, lb1, 0)
     lv2 = jnp.where(uses, lv2, 0)
     lb2 = jnp.where(uses, lb2, 0)
-    vals = vals.at[:, 4 : 4 + 2 * L : 2].set(lv1.T)
-    bits = bits.at[:, 4 : 4 + 2 * L : 2].set(lb1.T)
-    vals = vals.at[:, 5 : 4 + 2 * L : 2].set(lv2.T)
-    bits = bits.at[:, 5 : 4 + 2 * L : 2].set(lb2.T)
+    lev_v = jnp.stack([lv1.T, lv2.T], -1).reshape(B, 2 * L)
+    lev_b = jnp.stack([lb1.T, lb2.T], -1).reshape(B, 2 * L)
 
     # total_zeros
     last_pos = pos_rev[:, 0]
     tz = jnp.where(total > 0, last_pos + 1 - total, 0)
     if chroma_dc:
-        tz_val = jnp.asarray(_TZC_VAL)[jnp.clip(total, 0, 3), jnp.clip(tz, 0, 3)]
-        tz_bits = jnp.asarray(_TZC_BITS)[jnp.clip(total, 0, 3), jnp.clip(tz, 0, 3)]
+        tz_val, tz_bits = _lut(jnp.clip(total, 0, 3) * 4 + jnp.clip(tz, 0, 3), _TZC_PAIR)
     else:
-        tz_val = jnp.asarray(_TZ_VAL)[jnp.clip(total, 0, 16), jnp.clip(tz, 0, 15)]
-        tz_bits = jnp.asarray(_TZ_BITS)[jnp.clip(total, 0, 16), jnp.clip(tz, 0, 15)]
+        tz_val, tz_bits = _lut(jnp.clip(total, 0, 16) * 16 + jnp.clip(tz, 0, 15), _TZ_PAIR)
     use_tz = (total > 0) & (total < L)
-    vals = vals.at[:, 4 + 2 * L].set(jnp.where(use_tz, tz_val, 0))
-    bits = bits.at[:, 4 + 2 * L].set(jnp.where(use_tz, tz_bits, 0))
+    tz_v = jnp.where(use_tz, tz_val, 0)
+    tz_b = jnp.where(use_tz, tz_bits, 0)
 
-    # run_before chain (reverse order), zeros_left decreasing
-    def run_step(carry, xs):
-        zeros_left = carry
-        p_k, p_k1, k = xs
-        run = p_k - p_k1 - 1
-        use = (k < total - 1) & (zeros_left > 0)
-        zl_c = jnp.clip(zeros_left, 0, 14)
-        run_c = jnp.clip(run, 0, 14)
-        v = jnp.asarray(_RB_VAL)[zl_c, run_c]
-        b = jnp.asarray(_RB_BITS)[zl_c, run_c]
-        zeros_left = jnp.where(use, zeros_left - run, zeros_left)
-        return zeros_left, (jnp.where(use, v, 0), jnp.where(use, b, 0))
+    # run_before chain (reverse order). The zeros_left recurrence has a
+    # CLOSED FORM (telescoping): zeros_left at step k
+    #   = tz - sum_{j<k} run_j = tz - (pos_0 - pos_k - k)
+    #   = pos_k + k + 1 - total          (since tz = pos_0 + 1 - total)
+    # so the whole chain vectorizes — no scan.
+    ks_col = jnp.arange(L - 1, dtype=jnp.int32)[None, :]
+    run = pos_rev[:, :-1] - pos_rev[:, 1:] - 1            # (B, L-1)
+    zl = pos_rev[:, :-1] + ks_col + 1 - total[:, None]    # zeros_left before step k
+    use_r = (ks_col < total[:, None] - 1) & (zl > 0)
+    rv, rb = _lut(jnp.clip(zl, 0, 14) * 15 + jnp.clip(run, 0, 14), _RB_PAIR)
 
-    pos_t = pos_rev.T
-    _, (rv, rb) = jax.lax.scan(
-        run_step, tz, (pos_t[:-1], pos_t[1:], jnp.arange(L - 1, dtype=jnp.int32))
-    )
-    vals = vals.at[:, 5 + 2 * L :].set(rv.T)
-    bits = bits.at[:, 5 + 2 * L :].set(rb.T)
+    vals = jnp.concatenate(
+        [ct_val[:, None], jnp.stack(sign_v, -1), lev_v, tz_v[:, None],
+         jnp.where(use_r, rv, 0)], axis=1)
+    bits = jnp.concatenate(
+        [ct_bits[:, None], jnp.stack(sign_b, -1), lev_b, tz_b[:, None],
+         jnp.where(use_r, rb, 0)], axis=1)
     return vals, bits, total
 
 
@@ -493,9 +513,15 @@ def pack_p_slice_bits(out, word_cap: int = WORD_CAP_DEFAULT):
     by = (mby * 4 + oyb).reshape(-1)
     bx = (mbx * 4 + oxb).reshape(-1)
     nc_luma = nc_from(luma_tc_flat, by, bx, bx > 0, by > 0)
-    luma_blocks = luma_scan[
-        mby.reshape(-1), mbx.reshape(-1), oyb.reshape(-1), oxb.reshape(-1)
-    ]  # (M*16, 16)
+    # block reorder as a STATIC take over the 16-block axis: the
+    # equivalent multi-array fancy gather lowers to a general gather
+    # that costs ~200 ms/frame on v5e (tools/profile_cavlc_device.py)
+    luma_perm = jnp.asarray(
+        np.asarray(_LUMA_ORDER)[:, 1] * 4 + np.asarray(_LUMA_ORDER)[:, 0]
+    )
+    luma_blocks = jnp.take(
+        luma_scan.reshape(mbh, mbw, 16, 16), luma_perm, axis=2
+    ).reshape(-1, 16)  # (M*16, 16) in coding order
     lv, lb, _ = _encode_blocks(luma_blocks, nc_luma, chroma_dc=False)
     # gate: block emitted iff MB coded & its b8 set
     b8_idx = (oy // 2) * 2 + (ox // 2)
@@ -527,9 +553,12 @@ def pack_p_slice_bits(out, word_cap: int = WORD_CAP_DEFAULT):
         comp_f * (mbh * 2) + cby_b, cbx_b,
         cbx_b > 0, cby_b > 0,
     )
-    ch_blocks = chroma_scan[
-        cmby.reshape(-1), cmbx.reshape(-1), comp_f, coyb.reshape(-1), coxb.reshape(-1), 1:
-    ]  # (M*8, 15)
+    ch_perm = jnp.asarray(
+        np.asarray(_CHROMA_ORDER)[:, 1] * 2 + np.asarray(_CHROMA_ORDER)[:, 0]
+    )
+    ch_blocks = jnp.take(
+        chroma_scan.reshape(mbh, mbw, 2, 4, 16), ch_perm, axis=3
+    ).reshape(-1, 16)[:, 1:]  # (M*8, 15) in coding order
     cv, cb, _ = _encode_blocks(ch_blocks, nc_ch, chroma_dc=False)
     ch_emit = jnp.broadcast_to(
         (coded & (cbp_chroma == 2))[..., None, None], (mbh, mbw, 2, 4)

@@ -914,34 +914,13 @@ def pack_i_compact(out):
 # ---------------------------------------------------------------------------
 # Delta upload: dirty-band scatter into device-resident source planes
 # ---------------------------------------------------------------------------
-
-def scatter_bands(y, u, v, yb, ub, vb, idx):
-    """Write k dirty bands into the resident source planes.
-
-    yb: (k, 16, W) luma bands, ub/vb: (k, 8, W/2) chroma bands, idx: (k,)
-    int32 plane band numbers (duplicates allowed — writing the same band
-    twice is idempotent, which lets the host pad k up to a static bucket
-    size). With the planes donated into the jit this is an in-place
-    update: the steady-state host->device traffic is only what changed
-    on screen (the reference leans on ximagesrc's XDamage for the same
-    effect, gstwebrtc_app.py:210-241)."""
-
-    def body(i, planes):
-        py, pu, pv = planes
-        py = jax.lax.dynamic_update_slice(py, yb[i], (idx[i] * 16, 0))
-        pu = jax.lax.dynamic_update_slice(pu, ub[i], (idx[i] * 8, 0))
-        pv = jax.lax.dynamic_update_slice(pv, vb[i], (idx[i] * 8, 0))
-        return py, pu, pv
-
-    return jax.lax.fori_loop(0, yb.shape[0], body, (y, u, v))
-
 def scatter_tiles(y, u, v, yb, ub, vb, idx, tile_w: int):
     """Scatter uploaded I420 TILES into device-resident planes.
 
     yb: (k, 16, tile_w) luma, ub/vb: (k, 8, tile_w/2) chroma, idx: (k,)
     int32 encoded band*1024 + tile (duplicates allowed — rewriting a
     tile is idempotent, which lets the host pad k to a static bucket).
-    tile_w == plane width degenerates to scatter_bands. Column tiling
+    tile_w == plane width degenerates to full-width bands. Column tiling
     shrinks the host->device delta traffic by the width fraction that
     actually changed (a cursor blink is one tile, not a full-width band)."""
     ctw = tile_w // 2

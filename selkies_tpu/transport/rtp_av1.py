@@ -203,14 +203,16 @@ class Av1Depayloader:
         self._broken = False  # loss detected: drop the TU at its marker
 
     def push(self, pkt: RtpPacket) -> bytes | None:
-        p = pkt.payload
-        if not p:
-            return None
         # a sequence gap means part of this TU is gone: a truncated TU
-        # must be dropped at the marker, not emitted as if complete
+        # must be dropped at the marker, not emitted as if complete.
+        # (Checked before the empty-payload return so keepalive/padding
+        # packets still advance the expected sequence.)
         if self._last_seq is not None and pkt.sequence != (self._last_seq + 1) & 0xFFFF:
             self._broken = True
         self._last_seq = pkt.sequence
+        p = pkt.payload
+        if not p:
+            return None
         b0 = p[0]
         z, y, w = bool(b0 & 0x80), bool(b0 & 0x40), (b0 >> 4) & 3
         i = 1

@@ -196,7 +196,7 @@ def test_congestion_control_loop(loop, tmp_path):
         async with aiohttp.ClientSession() as http:
             ws = await http.ws_connect(base + "/media")
             n = 0
-            recv_ms = 0.0
+            queue_ms = 0.0
             deadline = asyncio.get_event_loop().time() + 60
             while n < 50 and asyncio.get_event_loop().time() < deadline:
                 msg = await asyncio.wait_for(ws.receive(), 30)
@@ -205,9 +205,12 @@ def test_congestion_control_loop(loop, tmp_path):
                     if kind != KIND_VIDEO:
                         continue
                     seq = parse_media_frame_seq(msg.data)
-                    # synthetic congested link: inter-arrival grows 3 ms per
-                    # frame beyond the ~33 ms send cadence (queue building)
-                    recv_ms += 40.0 + 3.0 * n
+                    # synthetic congested link: a queue that deepens 3 ms
+                    # per frame rides on top of the REAL receive clock, so
+                    # the one-way delay gradient is positive regardless of
+                    # the encoder's emission cadence in this environment
+                    queue_ms += 3.0 * (n + 1)
+                    recv_ms = asyncio.get_event_loop().time() * 1000.0 + queue_ms
                     await ws.send_str(f"_ack,{seq},{recv_ms:.1f}")
                     n += 1
                 elif msg.type == aiohttp.WSMsgType.TEXT:

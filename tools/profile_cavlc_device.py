@@ -1,0 +1,73 @@
+#!/usr/bin/env python3
+"""Per-component timing of the device CAVLC (pack_p_slice_bits) at 1080p:
+which op eats the ~250 ms. Pipelined x10 timing, tiny-slice sync."""
+import sys, time
+import numpy as np
+
+sys.path.insert(0, ".")
+import jax
+import jax.numpy as jnp
+
+from selkies_tpu.models.h264 import device_cavlc as dc
+from selkies_tpu.models.h264 import encoder_core as core
+
+mbh, mbw = 68, 120
+M = mbh * mbw
+rng = np.random.default_rng(1)
+
+# realistic full-P content: ~40% nonzero blocks, small coeffs
+def sparse_blocks(n, L):
+    x = rng.integers(-4, 5, (n, L)).astype(np.int32)
+    x[rng.random((n, L)) < 0.7] = 0
+    x[rng.random(n) < 0.6] = 0
+    return x
+
+out = {
+    "mvs": jnp.asarray(rng.integers(-8, 9, (mbh, mbw, 2)).astype(np.int32)),
+    "skip": jnp.asarray(rng.random((mbh, mbw)) < 0.5),
+    "luma_ac": jnp.asarray(sparse_blocks(M * 16, 16).reshape(mbh, mbw, 4, 4, 4, 4)),
+    "chroma_dc": jnp.asarray(sparse_blocks(M * 2, 4).reshape(mbh, mbw, 2, 2, 2)),
+    "chroma_ac": jnp.asarray(
+        np.concatenate([np.zeros((M * 8, 1), np.int32), sparse_blocks(M * 8, 15)], 1)
+        .reshape(mbh, mbw, 2, 2, 2, 4, 4)),
+}
+
+_tiny = jax.jit(lambda a: a.ravel()[:1])
+def sync(o): np.asarray(_tiny(jax.tree_util.tree_leaves(o)[0]))
+
+def timed(name, fn, *args, n=10):
+    sync(fn(*args))
+    reps = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(n):
+            o = fn(*args)
+        sync(o)
+        reps.append((time.perf_counter() - t0) / n)
+    print(f"{name:28s} {1e3*min(reps):8.2f} ms/iter")
+
+noop = jax.jit(lambda a: a[:1] + 1)
+timed("noop", noop, out["luma_ac"].ravel()[:128])
+
+full = jax.jit(lambda o: dc.pack_p_slice_bits(o))
+timed("pack_p_slice_bits (full)", full, out)
+
+# components
+luma_blocks = jnp.asarray(sparse_blocks(M * 16, 16))
+nc = jnp.asarray(rng.integers(0, 8, M * 16).astype(np.int32))
+enc_blocks = jax.jit(lambda b, n: dc._encode_blocks(b, n, chroma_dc=False))
+timed("_encode_blocks luma (M*16)", enc_blocks, luma_blocks, nc)
+
+lv, lb, _ = enc_blocks(luma_blocks, nc)
+pack_pairs = jax.jit(lambda v, b: dc._pack_pairs(v, b, 32))
+timed("_pack_pairs luma (M*16,52)", pack_pairs, lv, lb)
+
+lw, ln = pack_pairs(lv, lb)
+seg_words = jnp.tile(lw[: M * 27 // 16 * 16].reshape(-1, 32), (1, 1))[: M * 27]
+seg_bits = jnp.tile(ln[: M * 27], (1,))[: M * 27]
+merge = jax.jit(lambda w, b: dc._merge_streams(w, b, dc.WORD_CAP_DEFAULT))
+timed("_merge_streams (M*27)", merge, seg_words, seg_bits)
+
+mvp = jax.jit(lambda m, s: dc._mv_pred_grid(m, s))
+timed("_mv_pred_grid", mvp, out["mvs"], out["skip"])
