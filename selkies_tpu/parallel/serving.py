@@ -42,11 +42,12 @@ class MultiSessionH264Service:
     """N synchronized session streams; one batched sharded encode/tick.
 
     The step ticks in lockstep (frames come in as a batch, one per
-    session). GOP policy is per-session EXCEPT that an IDR in any
-    session forces the batch onto the IDR executable for all sessions —
-    the common fleet case (infinite GOP, per-client PLI recovery) makes
-    batch-wide IDRs rare; per-session mixed I/P in one step is a
-    shard_map refinement left for the pallas round.
+    session) but GOP policy is fully per-session: the mixed tick is a
+    shard_map whose per-chip lax.cond picks the IDR or P branch from
+    that session's own force_keyframe/GOP state, so one client's PLI
+    recovery no longer drags every session onto the IDR executable.
+    Only the very first tick (no reference planes exist yet) uses the
+    batch-wide IDR step.
     """
 
     def __init__(self, n_sessions: int, width: int, height: int, *,
@@ -70,20 +71,32 @@ class MultiSessionH264Service:
         """(N, H, W, 4) BGRx batch -> one Annex-B access unit per session."""
         if frames.shape[0] != self.n:
             raise ValueError(f"expected {self.n} frames, got {frames.shape[0]}")
-        idr = any(s.force_idr or s.frames_since_idr == 0 for s in self.sessions)
+        idrs = np.array(
+            [s.force_idr or s.frames_since_idr == 0 for s in self.sessions], bool
+        )
         qps = np.array([s.qp for s in self.sessions], np.int32)
-        if idr:
+        if self.enc._ref is None:
+            # first tick: no reference planes exist, everyone starts a GOP
+            idrs[:] = True
             out = self.enc.encode_idr(frames, qps)
         else:
-            out = self.enc.encode_p(frames, qps)
+            out = self.enc.encode_mixed(frames, qps, idrs)
         # fetch the coefficient batch once, then pack per session in
-        # parallel (independent streams)
-        host = {k: np.asarray(v) for k, v in out.items()}
+        # parallel (independent streams). Branch-filler fields are
+        # skipped when no session took that branch — the all-zero
+        # luma_dc/mode tensors alone are ~0.5 MB/session/tick of dead
+        # d2h on a per-byte-priced link.
+        i_only = {"luma_mode", "chroma_mode", "luma_dc"}
+        p_only = {"mvs", "skip"}
+        skip_keys = (i_only if not idrs.any() else set()) | (
+            p_only if idrs.all() else set())
+        host = {k: np.asarray(v) for k, v in out.items() if k not in skip_keys}
         futures = [
-            self._pool.submit(self._pack_one, i, host, idr) for i in range(self.n)
+            self._pool.submit(self._pack_one, i, host, bool(idrs[i]))
+            for i in range(self.n)
         ]
         aus = [f.result() for f in futures]
-        for s in self.sessions:
+        for s, idr in zip(self.sessions, idrs):
             if idr:
                 s.frames_since_idr = 1
                 s.idr_pic_id = (s.idr_pic_id + 1) % 2

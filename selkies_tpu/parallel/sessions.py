@@ -79,6 +79,49 @@ class MultiSessionEncoder:
             out_shardings=shard,
             donate_argnums=(2, 3, 4),
         )
+
+        # mixed per-session I/P tick: shard_map gives each chip a REAL
+        # lax.cond on its own is_idr scalar (SPMD code, device-varying
+        # predicate), so one session forcing an IDR no longer drags the
+        # whole batch onto the IDR executable. Branch outputs are unified
+        # to one tree (zeros for the other branch's fields); compute per
+        # chip is one branch only.
+        mbh, mbw = height // 16, width // 16
+
+        def one_mixed(frame, qp, idr, ry, ru, rv):
+            y, u, v = bgrx_to_i420(frame)
+
+            def branch_i(_):
+                out = encode_frame_planes(y, u, v, qp)
+                out["mvs"] = jnp.zeros((mbh, mbw, 2), jnp.int32)
+                out["skip"] = jnp.zeros((mbh, mbw), bool)
+                return out
+
+            def branch_p(_):
+                out = encode_frame_p_planes(y, u, v, ry, ru, rv, qp)
+                out["luma_mode"] = jnp.zeros((mbh, mbw), jnp.int32)
+                out["chroma_mode"] = jnp.zeros((mbh, mbw), jnp.int32)
+                out["luma_dc"] = jnp.zeros((mbh, mbw, 4, 4), jnp.int32)
+                return out
+
+            return jax.lax.cond(idr, branch_i, branch_p, None)
+
+        def mixed(frames, qps, idrs, ry, ru, rv):
+            out = one_mixed(frames[0], qps[0], idrs[0], ry[0], ru[0], rv[0])
+            return jax.tree_util.tree_map(lambda a: a[None], out)
+
+        spec = P("session")
+        self._step_mixed = jax.jit(
+            jax.shard_map(
+                mixed, mesh=self.mesh,
+                in_specs=(spec,) * 6, out_specs=spec,
+                # the encode scans carry replicated-initialized state that
+                # becomes device-varying after one step; skip the varying-
+                # axis type check (every input/output is fully sharded)
+                check_vma=False,
+            ),
+            donate_argnums=(3, 4, 5),
+        )
         self._shard = shard
         self._ref = None
 
@@ -111,6 +154,20 @@ class MultiSessionEncoder:
         )
         return self._keep_ref(out)
 
+    def encode_mixed(self, frames, qps: np.ndarray, idrs: np.ndarray):
+        """Per-session I/P in ONE device tick: idrs (N,) bool selects the
+        branch per chip. Requires an established reference (first tick
+        goes through encode_idr)."""
+        if self._ref is None:
+            raise RuntimeError("encode_idr must run first (no reference frames)")
+        out = dict(
+            self._step_mixed(
+                self.put_frames(np.asarray(frames)), jnp.asarray(qps, jnp.int32),
+                jnp.asarray(np.asarray(idrs, bool)), *self._ref
+            )
+        )
+        return self._keep_ref(out)
+
 
 def dryrun(n_devices: int) -> None:
     """Driver hook: compile + run the FULL multi-session step (IDR path and
@@ -130,3 +187,12 @@ def dryrun(n_devices: int) -> None:
     # per-session coefficient tensors must be sharded one-session-per-chip
     visible = {d for s in out_p["luma_ac"].addressable_shards for d in [s.device]}
     assert len(visible) == n_devices
+    # the PRODUCTION serving tick is the mixed shard_map step (per-chip
+    # lax.cond on is_idr) — compile and run it with a heterogeneous
+    # branch vector so a lowering break can't slip past the dryrun
+    idrs = np.zeros(n_devices, bool)
+    idrs[:: max(1, n_devices // 2)] = True
+    out_m = enc.encode_mixed(np.roll(frames2, 2, axis=1), qps, idrs)
+    jax.block_until_ready(out_m)
+    assert out_m["mvs"].shape == (n_devices, h // 16, w // 16, 2)
+    assert out_m["luma_mode"].shape == (n_devices, h // 16, w // 16)

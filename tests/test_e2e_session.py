@@ -205,10 +205,14 @@ def test_congestion_control_loop(loop, tmp_path):
                     if kind != KIND_VIDEO:
                         continue
                     seq = parse_media_frame_seq(msg.data)
-                    # synthetic congested link: a queue that deepens 3 ms
-                    # per frame rides on top of the REAL receive clock, so
-                    # the one-way delay gradient is positive regardless of
-                    # the encoder's emission cadence in this environment
+                    # synthetic congested link: an ACCELERATING queue
+                    # (backlog grows by 3*(n+1) ms each frame, as when
+                    # send rate exceeds capacity by a widening margin)
+                    # rides on top of the REAL receive clock, so the
+                    # one-way delay gradient is strongly positive
+                    # regardless of the encoder's emission cadence in
+                    # this environment — a constant few-ms/frame build
+                    # would sit under the trendline's adaptive threshold
                     queue_ms += 3.0 * (n + 1)
                     recv_ms = asyncio.get_event_loop().time() * 1000.0 + queue_ms
                     await ws.send_str(f"_ack,{seq},{recv_ms:.1f}")

@@ -53,17 +53,37 @@ def test_two_sessions_bit_identical_to_solo(tmp_path):
         assert k == n_frames
 
 
-def test_forced_keyframe_batchwide():
+def test_forced_keyframe_mixed_tick():
+    """One session's PLI recovery must NOT drag the others onto the IDR
+    path: the mixed shard_map tick branches per chip, and the P session's
+    stream stays bit-identical to a solo encoder that never saw an IDR."""
     if len(jax.devices()) < 2:
         pytest.skip("needs >=2 devices")
     h = w = 64
-    frames = _frames(5, 3, h, w)
+    frames = _frames(5, 4, h, w)
     svc = MultiSessionH264Service(2, w, h, qp=28)
     svc.encode_tick(np.stack([frames[0], frames[0]]))
     svc.encode_tick(np.stack([frames[1], frames[1]]))
     svc.force_keyframe(1)
     aus = svc.encode_tick(np.stack([frames[2], frames[2]]))
+    # session 1 re-keyframed (SPS NAL first), session 0 stayed P (type 1)
+    assert aus[1][4] & 0x1F == 7, "forced session did not IDR"
+    assert aus[0][4] & 0x1F == 1, "unforced session was dragged onto the IDR path"
+
+    # continue: both sessions keep decodable, solo-identical streams
+    aus2 = svc.encode_tick(np.stack([frames[3], frames[3]]))
+    assert all(au[4] & 0x1F == 1 for au in aus2)
     svc.close()
-    # IDR AUs start with SPS (NAL type 7 after the start code)
-    for au in aus:
-        assert au[4] & 0x1F == 7, "expected batch-wide IDR after force_keyframe"
+
+    # bit-identity of the never-IDR'd session vs a solo encoder
+    from selkies_tpu.models.h264.encoder import TPUH264Encoder
+
+    solo = TPUH264Encoder(w, h, qp=28, host_convert=False,
+                          frame_batch=1, pipeline_depth=0, device_entropy=False)
+    solo_aus = []
+    for f in frames[:4]:
+        for au, _, _ in solo.submit(f):
+            solo_aus.append(au)
+        solo_aus.extend(au for au, _, _ in solo.flush())
+    solo.close()
+    assert aus2[0] == solo_aus[3], "P session diverged from solo stream"
