@@ -5,7 +5,7 @@ ARE libvpx behind GObject properties — wrapping the same library gives
 exact behavioural parity for the software VP9/VP8 rows of the encoder
 matrix while the TPU-native tpuvp9enc is built. Tuning mirrors the
 reference's zero-latency settings: CBR, no lag, dropframes allowed,
-cpu-used 8 realtime deadline, keyframes only on request (infinite GOP,
+cpu-used 9 realtime deadline, keyframes only on request (infinite GOP,
 keyframe_distance=-1 semantics).
 
 ABI notes: built against libvpx.so.7 (v1.12, Debian). Struct offsets for
@@ -20,6 +20,7 @@ from __future__ import annotations
 import ctypes
 import ctypes.util
 import logging
+import os
 import time
 
 import numpy as np
@@ -56,8 +57,11 @@ _VPX_IMG_FMT_I420 = 0x102
 _VPX_EFLAG_FORCE_KF = 1
 _VPX_FRAME_IS_KEY = 1
 _VPX_DL_REALTIME = 1
+_VP8E_SET_ACTIVEMAP = 9
 _VP8E_SET_CPUUSED = 13
 _VP8E_GET_LAST_QUANTIZER_64 = 20
+_VP9E_SET_TILE_COLUMNS = 33
+_VP9E_SET_FRAME_PARALLEL_DECODING = 35
 _ENCODER_ABI_VERSION = 5
 _CFG_BYTES = 4096
 _CTX_BYTES = 512
@@ -85,6 +89,16 @@ class _VpxImage(ctypes.Structure):
         ("img_data_owner", ctypes.c_int),
         ("self_allocd", ctypes.c_int),
         ("fb_priv", ctypes.c_void_p),
+    ]
+
+
+class _VpxActiveMap(ctypes.Structure):
+    # vpx_active_map_t (vpx/vpx_encoder.h): per-16x16-MB activity mask;
+    # libvpx encodes inactive MBs as skip-from-reference
+    _fields_ = [
+        ("active_map", ctypes.POINTER(ctypes.c_uint8)),
+        ("rows", ctypes.c_uint),
+        ("cols", ctypes.c_uint),
     ]
 
 
@@ -189,7 +203,7 @@ class LibVpxEncoder:
         w = self._cfg_words
         w[_OFF_G_W], w[_OFF_G_H] = width, height
         w[_OFF_TB_NUM], w[_OFF_TB_DEN] = 1, fps
-        w[_OFF_G_THREADS] = 4
+        w[_OFF_G_THREADS] = min(8, max(1, (os.cpu_count() or 4) - 1))
         w[_OFF_LAG_IN_FRAMES] = 0           # zero latency
         w[_OFF_END_USAGE] = _VPX_CBR
         w[_OFF_TARGET_BITRATE] = bitrate_kbps
@@ -213,8 +227,19 @@ class LibVpxEncoder:
             raise RuntimeError(f"vpx_codec_enc_init_ver: {err}")
         # realtime speed preset (reference: deadline=1 + cpu-used,
         # gstwebrtc_app.py:695-722)
-        if lib.vpx_codec_control_(self._ctx, _VP8E_SET_CPUUSED, ctypes.c_int(8 if not vp8 else 12)):
+        if lib.vpx_codec_control_(self._ctx, _VP8E_SET_CPUUSED, ctypes.c_int(9 if not vp8 else 12)):
             logger.warning("VP8E_SET_CPUUSED rejected")
+        if not vp8:
+            # reference vp9enc row parity (gstwebrtc_app.py:699-703):
+            # frame-parallel-decoding + threaded tile columns make the
+            # g_threads above actually engage at 1080p. (row-mt exists in
+            # this libvpx but its control id can't be verified without
+            # headers — a wrong id segfaults — so tiles carry the
+            # threading instead.)
+            if lib.vpx_codec_control_(self._ctx, _VP9E_SET_TILE_COLUMNS, ctypes.c_int(2)):
+                logger.warning("VP9E_SET_TILE_COLUMNS rejected")
+            if lib.vpx_codec_control_(self._ctx, _VP9E_SET_FRAME_PARALLEL_DECODING, ctypes.c_int(1)):
+                logger.warning("VP9E_SET_FRAME_PARALLEL_DECODING rejected")
         self._img = lib.vpx_img_alloc(None, _VPX_IMG_FMT_I420, width, height, 16)
         if not self._img:
             raise RuntimeError("vpx_img_alloc failed")
@@ -239,6 +264,28 @@ class LibVpxEncoder:
             pass
 
     # -- live retune ---------------------------------------------------
+
+    def set_active_map(self, active: np.ndarray | None) -> bool:
+        """Per-MB activity mask: (mb_rows, mb_cols) with nonzero = encode,
+        0 = skip-from-reference. None clears the map (everything active).
+        The delta front-end feeds the dirty-tile map here so libvpx never
+        runs ME/RD on unchanged macroblocks. Returns False if rejected."""
+        mb_rows = (self.height + 15) // 16
+        mb_cols = (self.width + 15) // 16
+        m = _VpxActiveMap()
+        if active is None:
+            m.active_map = None
+            m.rows, m.cols = mb_rows, mb_cols
+            buf = None
+        else:
+            if active.shape != (mb_rows, mb_cols):
+                raise ValueError(f"active map {active.shape} != {(mb_rows, mb_cols)}")
+            buf = np.ascontiguousarray(active != 0).astype(np.uint8)
+            m.active_map = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+            m.rows, m.cols = mb_rows, mb_cols
+        rc = self._lib.vpx_codec_control_(self._ctx, _VP8E_SET_ACTIVEMAP, ctypes.byref(m))
+        del buf
+        return rc == 0
 
     def set_bitrate(self, bitrate_kbps: int) -> None:
         """Thread-safe: records the target; the encode thread applies it

@@ -191,7 +191,7 @@ def dryrun(n_devices: int) -> None:
     # lax.cond on is_idr) — compile and run it with a heterogeneous
     # branch vector so a lowering break can't slip past the dryrun
     idrs = np.zeros(n_devices, bool)
-    idrs[:: max(1, n_devices // 2)] = True
+    idrs[::2] = True  # heterogeneous for any n >= 2: branch divergence real
     out_m = enc.encode_mixed(np.roll(frames2, 2, axis=1), qps, idrs)
     jax.block_until_ready(out_m)
     assert out_m["mvs"].shape == (n_devices, h // 16, w // 16, 2)
