@@ -74,6 +74,7 @@ class PeerConnection:
         # TWCC send state
         self._twcc_seq = 0
         self._twcc_id = sdp.TWCC_EXT_ID
+        self._playout_delay_id: int | None = None
         # NACK retransmit ring
         self._rtx: dict[int, bytes] = {}
         # RTCP sender stats
@@ -117,6 +118,7 @@ class PeerConnection:
         self._remote = r
         if r.twcc_id is not None:
             self._twcc_id = r.twcc_id
+        self._playout_delay_id = r.playout_delay_id
         if self.fec_percentage > 0 and r.red_pt is not None and r.ulpfec_pt is not None:
             self._fec = fec.FecEncoder(self.fec_percentage)
             self._red_pt, self._ulpfec_pt = r.red_pt, r.ulpfec_pt
@@ -262,6 +264,14 @@ class PeerConnection:
             return None
         self._twcc_seq = (self._twcc_seq + 1) & 0xFFFF
         pkt.extensions = [(self._twcc_id, struct.pack("!H", self._twcc_seq))]
+        if not audio_stream and self._playout_delay_id is not None:
+            # playout-delay min=max=0 (two 12-bit fields): tells the
+            # browser to render with ZERO playout buffering — the other
+            # half of the latency recipe next to jitterBufferTarget=0
+            # (reference: PlayoutDelayExtension on every video packet,
+            # gstwebrtc_app.py:1827-1863). Only sent when the answer
+            # negotiated the extmap, with the answer's id.
+            pkt.extensions.append((self._playout_delay_id, b"\x00\x00\x00"))
         wire = pkt.serialize()
         protected = self.srtp.protect(wire)
         self.ice.send(protected)

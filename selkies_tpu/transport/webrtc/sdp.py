@@ -124,6 +124,7 @@ class RemoteDescription:
     red_pt: int | None = None
     ulpfec_pt: int | None = None
     twcc_id: int | None = None
+    playout_delay_id: int | None = None
     sctp_port: int = 5000
     # AV1 rtpmap matched video_pt only as a fallback (no preferred codec
     # seen yet); a later H264/VP8/VP9 line overrides it
@@ -171,6 +172,8 @@ def parse_answer(sdp: str) -> RemoteDescription:
             eid, uri = body.split(" ", 1)
             if uri.strip() == TWCC_URI and r.twcc_id is None:
                 r.twcc_id = int(eid.split("/")[0])
+            elif uri.strip() == PLAYOUT_DELAY_URI and r.playout_delay_id is None:
+                r.playout_delay_id = int(eid.split("/")[0])
         elif line.startswith("a=sctp-port:"):
             r.sctp_port = int(line.split(":", 1)[1])
     return r

@@ -24,6 +24,8 @@ class SelkiesWebRTC {
     this.framesDecoded = 0;
     this.framesDropped = 0;
     this._statsTimer = null;
+    this._jbTimer = null;
+    this._probe = null;
     this._pendingCandidates = [];
   }
 
@@ -94,6 +96,7 @@ class SelkiesWebRTC {
       this.connected = true;
       this.onStats({ event: "open" });
       this._startStats();
+      this._startJitterBufferLoop();
     };
     dc.onmessage = (ev) => {
       try {
@@ -151,6 +154,78 @@ class SelkiesWebRTC {
     }, 5000);
   }
 
+  /* jitterBufferTarget=0 enforcement loop (reference app.js:542-551):
+   * the browser resets its receive jitter buffer target whenever the
+   * network wobbles, so a one-shot assignment drifts back up; poking
+   * every receiver every 15 ms pins playout at minimum latency. The
+   * legacy playoutDelayHint is set too for pre-M106 engines. */
+  _startJitterBufferLoop() {
+    if (this._jbTimer) clearInterval(this._jbTimer);
+    this._jbTimer = setInterval(() => {
+      if (!this.pc) return;
+      for (const receiver of this.pc.getReceivers()) {
+        try {
+          // guard: the setter posts cross-thread work in Chromium, so
+          // only re-pin when something actually moved it off zero
+          if ("jitterBufferTarget" in receiver && receiver.jitterBufferTarget !== 0) {
+            receiver.jitterBufferTarget = 0;
+          }
+          if ("playoutDelayHint" in receiver && receiver.playoutDelayHint !== 0) {
+            receiver.playoutDelayHint = 0;
+          }
+        } catch (e) { /* per-spec the setter may throw mid-renegotiation */ }
+      }
+    }, 15);
+  }
+
+  /* Glass-to-glass latency probe (reference webrtc.js fun()/capture(),
+   * :763-824): samples the bottom-left 1% of each rendered video frame
+   * and reports per-frame brightness + inter-frame interval. Trigger a
+   * visible change in that corner (e.g. a terminal cursor) and read the
+   * timestamps to measure capture->encode->network->decode->render.
+   * Returns a stop() function; results stream to onSample. */
+  startLatencyProbe(onSample) {
+    this.stopLatencyProbe();
+    const video = this.videoEl;
+    const canvas = document.createElement("canvas");
+    const ctx = canvas.getContext("2d", { willReadFrequently: true });
+    let last = performance.now();
+    const tick = () => {
+      if (!this._probe) return;
+      const w = Math.max(1, Math.floor(video.videoWidth / 10));
+      const h = Math.max(1, Math.floor(video.videoHeight / 10));
+      if (w > 1 && h > 1) {
+        canvas.width = w; canvas.height = h;
+        // bottom-left corner of the frame
+        ctx.drawImage(video, 0, video.videoHeight - h, w, h, 0, 0, w, h);
+        const d = ctx.getImageData(0, 0, w, h).data;
+        let sum = 0;
+        for (let i = 0; i < d.length; i += 4) sum += d[i] + d[i + 1] + d[i + 2];
+        const now = performance.now();
+        onSample({ brightness: sum / (d.length / 4) / 3, intervalMs: now - last, t: now });
+        last = now;
+      }
+      this._probe = video.requestVideoFrameCallback
+        ? video.requestVideoFrameCallback(tick)
+        : requestAnimationFrame(tick);
+    };
+    this._probe = video.requestVideoFrameCallback
+      ? video.requestVideoFrameCallback(tick)
+      : requestAnimationFrame(tick);
+    return () => this.stopLatencyProbe();
+  }
+
+  stopLatencyProbe() {
+    if (this._probe) {
+      if (this.videoEl.cancelVideoFrameCallback) {
+        this.videoEl.cancelVideoFrameCallback(this._probe);
+      } else {
+        cancelAnimationFrame(this._probe);
+      }
+      this._probe = null;
+    }
+  }
+
   send(msg) {
     if (this.dc && this.dc.readyState === "open") this.dc.send(msg);
   }
@@ -167,6 +242,8 @@ class SelkiesWebRTC {
     this.closed = true;
     this.connected = false;
     if (this._statsTimer) clearInterval(this._statsTimer);
+    if (this._jbTimer) clearInterval(this._jbTimer);
+    this.stopLatencyProbe();
     if (this.dc) try { this.dc.close(); } catch (e) {}
     if (this.pc) try { this.pc.close(); } catch (e) {}
     if (this.ws) try { this.ws.close(); } catch (e) {}

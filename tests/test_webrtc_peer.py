@@ -91,6 +91,7 @@ class FakeBrowser:
             "a=setup:active\r\n"
             f"a=rtpmap:{sdp.VIDEO_PT} H264/90000\r\n"
             f"a=extmap:{sdp.TWCC_EXT_ID} {sdp.TWCC_URI}\r\n"
+            f"a=extmap:{sdp.PLAYOUT_DELAY_EXT_ID} {sdp.PLAYOUT_DELAY_URI}\r\n"
             f"m=audio 9 UDP/TLS/RTP/SAVPF {sdp.AUDIO_PT}\r\n"
             "a=mid:audio0\r\na=recvonly\r\n"
             f"a=rtpmap:{sdp.AUDIO_PT} OPUS/48000/2\r\n"
@@ -301,6 +302,75 @@ def test_fec_end_to_end_recovers_dropped_srtp_packet(loop):
         media[lost_seq] = rebuilt
         assert depayload(media) == intact
 
+        pc.close()
+        browser.ice.close()
+
+    loop.run_until_complete(scenario())
+
+
+def _parse_ext_block(wire: bytes) -> dict[int, bytes]:
+    """RFC 8285 one-byte-header extensions of an RTP packet -> {id: data}."""
+    import struct as _s
+
+    b0 = wire[0]
+    assert b0 >> 6 == 2
+    off = 12 + 4 * (b0 & 0x0F)
+    out = {}
+    if b0 & 0x10:
+        profile, words = _s.unpack("!HH", wire[off:off + 4])
+        assert profile == 0xBEDE, hex(profile)
+        body = wire[off + 4: off + 4 + 4 * words]
+        i = 0
+        while i < len(body):
+            byte = body[i]
+            if byte == 0:
+                i += 1
+                continue
+            eid, ln = byte >> 4, (byte & 0x0F) + 1
+            out[eid] = body[i + 1: i + 1 + ln]
+            i += 1 + ln
+    return out
+
+
+def test_video_packets_carry_playout_delay_and_twcc(loop):
+    """Every video RTP packet carries transport-wide-cc AND a zero
+    playout-delay extension (min=max=0 -> 3 zero bytes): the reference's
+    latency recipe (PlayoutDelayExtension, gstwebrtc_app.py:1827-1863)."""
+
+    async def scenario():
+        pc = PeerConnection(audio=True)
+        browser = FakeBrowser()
+        offer = await pc.create_offer()
+        assert sdp.PLAYOUT_DELAY_URI in offer
+        answer = await browser.answer(offer)
+        await pc.set_answer(answer)
+        pri = candidate_priority("host")
+        pc.add_remote_candidate(
+            f"candidate:1 1 udp {pri} 127.0.0.1 {browser.ice.local_candidates[0].port} typ host")
+        browser.ice.add_remote_candidate(
+            f"candidate:1 1 udp {pri} 127.0.0.1 {pc.ice.local_candidates[0].port} typ host")
+        await asyncio.wait_for(asyncio.gather(
+            pc.ice.wait_connected(5), browser.ice.wait_connected(5)), 10)
+        browser.start_dtls()
+        await asyncio.wait_for(pc.wait_connected(10), 10)
+
+        pc.send_video(b"\x00\x00\x00\x01\x65" + bytes(400), 0)
+        pc.send_audio(b"\x01\x02\x03", 0)
+        for _ in range(100):
+            if browser.rtp_packets:
+                break
+            await asyncio.sleep(0.02)
+        assert browser.rtp_packets, "no media arrived"
+        n_checked = 0
+        for wire in browser.rtp_packets:
+            exts = _parse_ext_block(wire)
+            pt = wire[1] & 0x7F
+            if pt == sdp.AUDIO_PT:
+                continue
+            assert sdp.TWCC_EXT_ID in exts and len(exts[sdp.TWCC_EXT_ID]) == 2
+            assert exts.get(sdp.PLAYOUT_DELAY_EXT_ID) == b"\x00\x00\x00", exts
+            n_checked += 1
+        assert n_checked >= 1, "no video packets checked"
         pc.close()
         browser.ice.close()
 
