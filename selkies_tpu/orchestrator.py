@@ -60,7 +60,8 @@ def _first_ice_servers(stun_servers: str, turn_servers: str):
     """First stun/turn entries from the csv 'scheme://[user:pass@]host:port'
     forms -> IceAgent kwargs."""
     kw: dict = {"stun_server": None, "turn_server": None,
-                "turn_username": "", "turn_password": ""}
+                "turn_username": "", "turn_password": "",
+                "turn_transport": "udp"}
     for uri in (stun_servers or "").split(","):
         uri = uri.strip()
         if uri.startswith("stun://"):
@@ -70,16 +71,30 @@ def _first_ice_servers(stun_servers: str, turn_servers: str):
             break
     for uri in (turn_servers or "").split(","):
         uri = uri.strip()
-        if not uri.startswith("turn://"):  # turns: is TCP/TLS — not our UDP agent
+        if uri.startswith("turn://"):
+            rest, tls = uri[7:], False
+        elif uri.startswith("turns://"):
+            rest, tls = uri[8:], True
+        else:
             continue
-        rest = uri[7:]
         if "@" in rest:
             creds, rest = rest.rsplit("@", 1)
             user, _, pw = creds.partition(":")
             kw["turn_username"], kw["turn_password"] = user, pw
         host, _, tail = rest.partition(":")
-        port = tail.split("?")[0] if tail else "3478"
-        kw["turn_server"] = (host, int(port or 3478))
+        host, q_sep, host_query = host.partition("?")  # no-port form: ?query glues to host
+        port, _, query = (tail or "").partition("?")
+        if q_sep and not query:
+            query = host_query
+        # reference chain parity (__main__.py:617-656): ?transport= picks
+        # udp/tcp; turns:// is TLS over TCP (default port 5349)
+        transport = "tls" if tls else "udp"
+        for kv in query.split("&"):
+            k, _, v = kv.partition("=")
+            if k == "transport" and v == "tcp" and not tls:
+                transport = "tcp"
+        kw["turn_server"] = (host, int(port or (5349 if tls else 3478)))
+        kw["turn_transport"] = transport
         break
     return kw
 

@@ -132,6 +132,7 @@ class IceAgent:
     def __init__(self, *, stun_server: tuple[str, int] | None = None,
                  turn_server: tuple[str, int] | None = None,
                  turn_username: str = "", turn_password: str = "",
+                 turn_transport: str = "udp", turn_tls_insecure: bool = False,
                  loop: asyncio.AbstractEventLoop | None = None):
         self.local_ufrag = secrets.token_urlsafe(4)
         self.local_pwd = secrets.token_urlsafe(18)
@@ -142,6 +143,10 @@ class IceAgent:
         self.turn_server = turn_server
         self.turn_username = turn_username
         self.turn_password = turn_password
+        if turn_transport not in ("udp", "tcp", "tls"):
+            raise ValueError(f"turn_transport {turn_transport!r}")
+        self.turn_transport = turn_transport
+        self.turn_tls_insecure = turn_tls_insecure
         self.local_candidates: list[Candidate] = []
         self.on_data = lambda data: None
         self.on_local_candidate = lambda cand: None
@@ -163,6 +168,11 @@ class IceAgent:
         self._turn_key = b""
         self._turn_perms: dict[str, float] = {}  # peer ip -> last permit time
         self._turn_last_refresh = 0.0
+        # TURN over TCP/TLS (RFC 5766 §2.1 / turns:): STUN messages ride
+        # a stream with their natural header framing; the relayed
+        # transport stays UDP toward the peer
+        self._turn_writer: asyncio.StreamWriter | None = None
+        self._turn_reader_task: asyncio.Task | None = None
 
     # -- gathering ----------------------------------------------------
 
@@ -195,22 +205,33 @@ class IceAgent:
                 logger.warning("srflx gathering failed: %s", exc)
         if self.turn_server and self.turn_username:
             try:
+                if self.turn_transport != "udp":
+                    await self._turn_connect()
                 await self._gather_relay()
             except (asyncio.TimeoutError, OSError, stun.StunError) as exc:
-                logger.warning("TURN allocation failed: %s", exc)
+                logger.warning("TURN allocation failed (%s): %s",
+                               self.turn_transport, exc)
         for c in self.local_candidates:
             self.on_local_candidate(c)
 
     async def _request(self, msg: stun.StunMessage, addr: tuple[str, int],
                        kind: str, timeout: float = 3.0,
-                       integrity_key: bytes | None = None) -> stun.StunMessage:
+                       integrity_key: bytes | None = None,
+                       send=None) -> stun.StunMessage:
         """Send a request and await its (error-)response, with retries."""
         fut = self._loop.create_future()
         self._pending[msg.txid] = (kind, fut)
         wire = msg.serialize(integrity_key=integrity_key)
+        sendfn = send or (lambda w, a: self._transport.sendto(w, a))
         try:
+            if send is not None and self._turn_writer is not None:
+                # reliable stream transport: RFC 5389 §7.2.2 — send once,
+                # no retransmit schedule (a duplicate authenticated
+                # ALLOCATE can draw 437 Allocation Mismatch)
+                sendfn(wire, addr)
+                return await asyncio.wait_for(asyncio.shield(fut), timeout)
             for backoff in (0.2, 0.4, 0.8, 1.6):
-                self._transport.sendto(wire, addr)
+                sendfn(wire, addr)
                 try:
                     return await asyncio.wait_for(
                         asyncio.shield(fut), min(backoff, timeout)
@@ -246,11 +267,63 @@ class IceAgent:
         )
         return infos[0][4]
 
-    # -- TURN client (RFC 5766, long-term credentials) ---------------
+    # -- TURN client (RFC 5766, long-term credentials; udp/tcp/tls) ---
+
+    async def _turn_connect(self) -> None:
+        """Open the turns://-style stream to the TURN server and start
+        the reader that feeds its STUN traffic into _on_stun."""
+        import ssl as _ssl
+
+        host, port = self.turn_server
+        ctx = None
+        if self.turn_transport == "tls":
+            ctx = _ssl.create_default_context()
+            if self.turn_tls_insecure:
+                ctx.check_hostname = False
+                ctx.verify_mode = _ssl.CERT_NONE
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port, ssl=ctx), 10.0
+        )
+        self._turn_writer = writer
+        self._turn_reader_task = self._loop.create_task(self._turn_read_loop(reader))
+
+    async def _turn_read_loop(self, reader: asyncio.StreamReader) -> None:
+        """STUN-over-stream framing: each message is its 20-byte header
+        plus the (already 4-aligned) attribute length it declares."""
+        addr = self.turn_server
+        try:
+            while not self._closed:
+                hdr = await reader.readexactly(20)
+                alen = struct.unpack("!H", hdr[2:4])[0]
+                wire = hdr + (await reader.readexactly(alen) if alen else b"")
+                try:
+                    msg = stun.StunMessage.parse(wire)
+                except stun.StunError:
+                    continue
+                self._on_stun(msg, wire, addr)
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            # stream died: stop routing relayed sends into a closed
+            # writer (the relay candidates are dead; direct pairs and the
+            # consent timer take it from here)
+            if not self._closed and self._turn_writer is not None:
+                logger.warning("TURN %s stream lost; relay path down",
+                               self.turn_transport)
+            self._turn_writer = None
+
+    def _turn_send_wire(self, wire: bytes, addr) -> None:
+        if self._turn_writer is not None:
+            self._turn_writer.write(wire)
+        elif self.turn_transport == "udp":
+            self._transport.sendto(wire, addr)
+        # stream mode with a dead writer: drop — UDP datagrams to a
+        # TCP/TLS TURN port are never valid
 
     async def _turn_request(self, method: int, attrs: list[tuple[int, bytes]],
                             kind: str) -> stun.StunMessage:
-        addr = await self._resolve(self.turn_server)
+        addr = await self._resolve(self.turn_server) \
+            if self._turn_writer is None else self.turn_server
         req = stun.StunMessage(method=method, cls=stun.REQUEST)
         for a, v in attrs:
             req.add(a, v)
@@ -258,8 +331,10 @@ class IceAgent:
             req.add(stun.ATTR_USERNAME, self.turn_username.encode())
             req.add(stun.ATTR_REALM, self._turn_realm.encode())
             req.add(stun.ATTR_NONCE, self._turn_nonce)
-            return await self._request(req, addr, kind, integrity_key=self._turn_key)
-        return await self._request(req, addr, kind)
+            return await self._request(req, addr, kind,
+                                       integrity_key=self._turn_key,
+                                       send=self._turn_send_wire)
+        return await self._request(req, addr, kind, send=self._turn_send_wire)
 
     async def _gather_relay(self) -> None:
         transport_udp = struct.pack("!BBH", 17, 0, 0)
@@ -325,8 +400,8 @@ class IceAgent:
         ind = stun.StunMessage(method=stun.SEND, cls=stun.INDICATION)
         ind.add(stun.ATTR_XOR_PEER_ADDRESS, stun.xor_address(peer, ind.txid))
         ind.add(stun.ATTR_DATA, data)
-        self._transport.sendto(ind.serialize(fingerprint=False),
-                               self._turn_addr_cache)
+        self._turn_send_wire(ind.serialize(fingerprint=False),
+                             self._turn_addr_cache)
 
     # -- checks -------------------------------------------------------
 
@@ -415,7 +490,7 @@ class IceAgent:
         self._send_raw(wire, pair)
 
     def _send_raw(self, data: bytes, pair: _CheckPair) -> None:
-        if pair.relayed and self._turn_addr_cache:
+        if pair.relayed and (self._turn_addr_cache or self._turn_writer):
             self._turn_send(data, (pair.remote.ip, pair.remote.port))
         else:
             self._transport.sendto(data, (pair.remote.ip, pair.remote.port))
@@ -535,5 +610,13 @@ class IceAgent:
         self._closed = True
         if self._check_task is not None:
             self._check_task.cancel()
+        if self._turn_reader_task is not None:
+            self._turn_reader_task.cancel()
+        if self._turn_writer is not None:
+            try:
+                self._turn_writer.close()
+            except Exception:
+                pass
+            self._turn_writer = None
         if self._transport is not None:
             self._transport.close()

@@ -44,13 +44,15 @@ class PeerConnection:
                  fec_percentage: int = 20,
                  stun_server=None, turn_server=None,
                  turn_username: str = "", turn_password: str = "",
+                 turn_transport: str = "udp",
                  loop: asyncio.AbstractEventLoop | None = None):
         self.codec = codec
         self.audio = audio
         self._loop = loop or asyncio.get_event_loop()
         self.ice = IceAgent(stun_server=stun_server, turn_server=turn_server,
                             turn_username=turn_username,
-                            turn_password=turn_password, loop=self._loop)
+                            turn_password=turn_password,
+                            turn_transport=turn_transport, loop=self._loop)
         self.ice.on_data = self._on_transport_data
         self.cert_der, self.key_der, self.fingerprint = make_certificate()
         self.dtls: DtlsEndpoint | None = None

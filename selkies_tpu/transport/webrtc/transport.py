@@ -26,12 +26,14 @@ class WebRTCTransport:
                  fec_percentage: int = 20,
                  stun_server: tuple[str, int] | None = None,
                  turn_server: tuple[str, int] | None = None,
-                 turn_username: str = "", turn_password: str = ""):
+                 turn_username: str = "", turn_password: str = "",
+                 turn_transport: str = "udp"):
         self._kw = dict(codec=codec, audio=audio,
                         fec_percentage=fec_percentage,
                         stun_server=stun_server,
                         turn_server=turn_server, turn_username=turn_username,
-                        turn_password=turn_password)
+                        turn_password=turn_password,
+                        turn_transport=turn_transport)
         self.pc: PeerConnection | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
         self._input_ch = None
@@ -55,11 +57,13 @@ class WebRTCTransport:
         return self.pc is not None and self.pc.connected
 
     def set_ice_servers(self, *, stun_server=None, turn_server=None,
-                        turn_username: str = "", turn_password: str = "") -> None:
+                        turn_username: str = "", turn_password: str = "",
+                        turn_transport: str = "udp") -> None:
         """Late-bind the resolved STUN/TURN servers (the credential chain
         resolves after construction); applies to the NEXT peer."""
         self._kw.update(stun_server=stun_server, turn_server=turn_server,
-                        turn_username=turn_username, turn_password=turn_password)
+                        turn_username=turn_username, turn_password=turn_password,
+                        turn_transport=turn_transport)
 
     # -- session lifecycle -------------------------------------------
 
