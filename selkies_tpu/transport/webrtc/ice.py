@@ -304,17 +304,36 @@ class IceAgent:
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             pass
         finally:
-            # stream died: stop routing relayed sends into a closed
-            # writer (the relay candidates are dead; direct pairs and the
-            # consent timer take it from here)
+            # stream died: the allocation died with it. Tear the relay
+            # path down completely so the check loop stops burning 3 s
+            # timeouts on refresh/permit requests that can never be sent
+            # (direct pairs and the consent timer take it from here).
             if not self._closed and self._turn_writer is not None:
                 logger.warning("TURN %s stream lost; relay path down",
                                self.turn_transport)
             self._turn_writer = None
+            self._relay_addr = None
+            self._turn_perms.clear()
+            for pair in self._pairs:
+                if pair.relayed:
+                    pair.state = "failed"
+
+    # relayed-media backpressure cap: a stalled TCP/TLS path to the TURN
+    # server must DROP packets (like UDP would), not buffer megabits/s
+    # until the process OOMs — writes come from sync code, so asyncio's
+    # drain() flow control can't engage
+    TURN_STREAM_BUFFER_CAP = 4 << 20
 
     def _turn_send_wire(self, wire: bytes, addr) -> None:
-        if self._turn_writer is not None:
-            self._turn_writer.write(wire)
+        w = self._turn_writer
+        if w is not None:
+            transport = w.transport
+            if transport.is_closing() or (
+                transport.get_write_buffer_size() + len(wire)
+                > self.TURN_STREAM_BUFFER_CAP
+            ):
+                return  # drop under backpressure / during teardown
+            w.write(wire)
         elif self.turn_transport == "udp":
             self._transport.sendto(wire, addr)
         # stream mode with a dead writer: drop — UDP datagrams to a

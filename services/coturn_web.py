@@ -1,33 +1,48 @@
 #!/usr/bin/env python3
-"""coturn-web: TURN discovery + credential HTTP service.
+"""coturn-web: TURN fleet discovery + credential HTTP service.
 
-Reference parity: /root/reference/addons/coturn-web/main.go — serves RTC
-configurations for a fleet of coturn instances. The Go original watches
-Kubernetes Endpoints/Nodes informers; this implementation supports the
-same three discovery modes with a poll loop instead of informers:
+Reference parity: /root/reference/addons/coturn-web (main.go 602 LoC,
+informers.go, mig_disco.go). Serves RTC configurations with HMAC
+credentials for a fleet of coturn instances, discovering the fleet via:
 
-  * static:   TURN_HOST env (single instance)
-  * list:     TURN_HOSTS env, comma-separated — round-robins per request
-  * kubectl:  TURN_ENDPOINTS_DISCOVERY=<service>, optional
-              TURN_ENDPOINTS_NAMESPACE — polls `kubectl get endpoints`
-              for ready addresses every TURN_DISCOVERY_INTERVAL seconds
+  * static:    TURN_HOST / TURN_HOSTS env (single / comma list)
+  * kubernetes: informer-style WATCH streams on the coturn service's
+               Endpoints and on Nodes (main.go:187-334, informers.go):
+               ready endpoint addresses name their node, nodes map to
+               ExternalIPs — the TURN hosts clients can actually reach.
+               Plain K8s REST API over aiohttp (no client library in
+               this image); reconnecting watches with resourceVersion
+               bookmarks are the informer pattern without the SDK.
+  * gce-mig:   GCE managed-instance-group discovery (mig_disco.go:33-99):
+               service-account token from the metadata server (or
+               ACCESS_TOKEN env), instance groups matched by filter
+               pattern, instance external IPs, exponential backoff
+               (0.1 s -> 30 s, factor 2) and a 60 s update damper.
+
+Auth (main.go:336-372), selected by AUTH_HEADER_NAME:
+  * authorization: HTTP Basic against an htpasswd file (bcrypt/{SHA}/
+               plain entries)
+  * x-goog-authenticated-user-email: GCP IAP ('accounts.google.com:a@b'
+               -> 'a@b')
+  * anything else: the header's value is the username
 
 Endpoints:
-  GET /        RTC config JSON with a fresh HMAC credential (username
-               from X-Auth-User header, as behind an auth proxy)
+  GET /         RTC config JSON with a fresh HMAC credential
   GET /healthz
 """
 
 from __future__ import annotations
 
 import asyncio
+import base64
+import hashlib
 import json
 import logging
 import os
-import subprocess
 import sys
 import time
 
+import aiohttp
 from aiohttp import web
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
@@ -35,6 +50,11 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 from selkies_tpu.signalling.turn import generate_rtc_config  # noqa: E402
 
 logger = logging.getLogger("coturn-web")
+
+K8S_TOKEN_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/token"
+K8S_CA_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
+METADATA_BASE = "http://metadata.google.internal/computeMetadata/v1"
+COMPUTE_BASE = "https://compute.googleapis.com/compute/v1"
 
 
 class TurnPool:
@@ -54,51 +74,325 @@ class TurnPool:
         self._i += 1
         return h
 
-    async def discovery_loop(self) -> None:
-        """kubectl-based endpoints discovery (the Go informers' poll twin)."""
-        name = os.environ.get("TURN_ENDPOINTS_DISCOVERY")
+    def replace(self, hosts: list[str]) -> None:
+        if hosts != self.hosts:
+            logger.info("TURN hosts: %s", hosts)
+            self.hosts = hosts
+
+
+# ---------------------------------------------------------------------------
+# Kubernetes informer-style discovery (Endpoints + Nodes watches)
+# ---------------------------------------------------------------------------
+
+
+class K8sInformer:
+    """Watch the coturn service's Endpoints and the cluster's Nodes;
+    publish the ExternalIPs of nodes hosting ready coturn endpoints.
+
+    The Go original uses client-go shared informers (informers.go:21-106)
+    to keep Endpoints/Nodes caches in sync and recomputes the host list
+    on every event (main.go:187-334). Here each resource gets a
+    reconnecting LIST+WATCH loop against the REST API — the same
+    level-triggered cache semantics without the SDK.
+    """
+
+    def __init__(self, pool: TurnPool, service: str, namespace: str = "default",
+                 *, api_base: str | None = None, token: str | None = None,
+                 ssl=None):
+        self.pool = pool
+        self.service = service
+        self.namespace = namespace
+        host = os.environ.get("KUBERNETES_SERVICE_HOST", "")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        self.api_base = api_base or f"https://{host}:{port}"
+        if token is None and os.path.exists(K8S_TOKEN_PATH):
+            with open(K8S_TOKEN_PATH) as f:
+                token = f.read().strip()
+        self.token = token or ""
+        if ssl is None and os.path.exists(K8S_CA_PATH):
+            # in-cluster: the apiserver cert chains to the serviceaccount
+            # CA, not the system store (client-go loads this implicitly)
+            import ssl as _ssl
+
+            ssl = _ssl.create_default_context(cafile=K8S_CA_PATH)
+        self.ssl = ssl
+        # caches (the informer stores)
+        self.node_ips: dict[str, str] = {}     # node name -> ExternalIP
+        self.endpoint_nodes: set[str] = set()  # nodes with ready coturn pods
+
+    def _headers(self) -> dict:
+        h = {"Accept": "application/json"}
+        if self.token:
+            h["Authorization"] = f"Bearer {self.token}"
+        return h
+
+    def _recompute(self) -> None:
+        # publish even an EMPTY list: a fleet scaled to zero must turn
+        # into 503s, not credentials pointing at dead servers (the Go
+        # original errors when no IPs remain, main.go:422)
+        self.pool.replace(sorted(
+            self.node_ips[n] for n in self.endpoint_nodes if n in self.node_ips
+        ))
+
+    def _apply_endpoints(self, ev_type: str, obj: dict) -> None:
+        if ev_type == "__RESET__":
+            self.endpoint_nodes = set()
+            return
+        if obj.get("metadata", {}).get("name") != self.service:
+            return
+        if ev_type == "DELETED":
+            self.endpoint_nodes = set()
+        else:
+            nodes = set()
+            for ss in obj.get("subsets") or []:
+                for addr in ss.get("addresses") or []:  # ready addresses only
+                    if addr.get("nodeName"):
+                        nodes.add(addr["nodeName"])
+            self.endpoint_nodes = nodes
+        self._recompute()
+
+    def _apply_node(self, ev_type: str, obj: dict) -> None:
+        if ev_type == "__RESET__":
+            self.node_ips.clear()
+            return
+        name = obj.get("metadata", {}).get("name")
         if not name:
             return
-        ns = os.environ.get("TURN_ENDPOINTS_NAMESPACE", "default")
-        interval = float(os.environ.get("TURN_DISCOVERY_INTERVAL", "15"))
+        if ev_type == "DELETED":
+            self.node_ips.pop(name, None)
+        else:
+            ext = next(
+                (a["address"] for a in obj.get("status", {}).get("addresses", [])
+                 if a.get("type") == "ExternalIP"), None)
+            if ext:
+                self.node_ips[name] = ext
+            else:
+                self.node_ips.pop(name, None)
+        self._recompute()
+
+    async def _informer(self, session: aiohttp.ClientSession, path: str,
+                        apply) -> None:
+        """LIST to seed the cache, then WATCH from the list's
+        resourceVersion; reconnect (re-list) on stream end or error."""
         while True:
             try:
-                out = subprocess.run(
-                    ["kubectl", "get", "endpoints", name, "-n", ns, "-o", "json"],
-                    capture_output=True, timeout=10,
+                async with session.get(
+                    f"{self.api_base}{path}", headers=self._headers(),
+                    ssl=self.ssl,
+                ) as resp:
+                    resp.raise_for_status()
+                    listing = await resp.json()
+                # informer semantics: a re-list REPLACES the store —
+                # objects deleted while the watch was down must not linger
+                apply("__RESET__", {})
+                for item in listing.get("items", []):
+                    apply("ADDED", item)
+                rv = listing.get("metadata", {}).get("resourceVersion", "")
+                async with session.get(
+                    f"{self.api_base}{path}",
+                    params={"watch": "1", "resourceVersion": rv,
+                            "allowWatchBookmarks": "true"},
+                    headers=self._headers(), ssl=self.ssl,
+                    timeout=aiohttp.ClientTimeout(total=None, sock_read=330),
+                ) as resp:
+                    resp.raise_for_status()
+                    async for line in resp.content:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        ev = json.loads(line)
+                        if ev.get("type") == "BOOKMARK":
+                            continue
+                        apply(ev.get("type", ""), ev.get("object", {}))
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:
+                logger.warning("informer %s: %s; re-listing in 2s", path, exc)
+                await asyncio.sleep(2)
+
+    async def run(self) -> None:
+        async with aiohttp.ClientSession() as session:
+            await asyncio.gather(
+                self._informer(
+                    session,
+                    f"/api/v1/namespaces/{self.namespace}/endpoints",
+                    self._apply_endpoints,
+                ),
+                self._informer(session, "/api/v1/nodes", self._apply_node),
+            )
+
+
+# ---------------------------------------------------------------------------
+# GCE managed-instance-group discovery
+# ---------------------------------------------------------------------------
+
+
+class MigDiscovery:
+    """mig_disco.go: instance groups matching FILTER_PATTERN -> instance
+    external IPs; SA token from the metadata server (ACCESS_TOKEN env
+    wins); exponential backoff 0.1->30 s on errors; 60 s update damper."""
+
+    def __init__(self, pool: TurnPool, project: str, filter_pattern: str,
+                 *, compute_base: str = COMPUTE_BASE,
+                 metadata_base: str = METADATA_BASE,
+                 interval: float = 60.0):
+        self.pool = pool
+        self.project = project
+        self.filter_pattern = filter_pattern
+        self.compute_base = compute_base
+        self.metadata_base = metadata_base
+        self.interval = interval
+        self.last_update = 0.0
+
+    async def _token(self, session: aiohttp.ClientSession) -> str:
+        env = os.environ.get("ACCESS_TOKEN")
+        if env:
+            return env
+        async with session.get(
+            f"{self.metadata_base}/instance/service-accounts/default/token",
+            headers={"Metadata-Flavor": "Google"},
+        ) as resp:
+            resp.raise_for_status()
+            return (await resp.json())["access_token"]
+
+    async def _get(self, session, url, token, **params):
+        async with session.get(
+            url, headers={"Authorization": f"Bearer {token}"}, params=params
+        ) as resp:
+            resp.raise_for_status()
+            return await resp.json()
+
+    async def _discover_once(self, session: aiohttp.ClientSession) -> list[str]:
+        token = await self._token(session)
+        groups = await self._get(
+            session,
+            f"{self.compute_base}/projects/{self.project}/aggregated/instanceGroups",
+            token, filter=f"name eq {self.filter_pattern}",
+        )
+        hosts: list[str] = []
+        for scope in (groups.get("items") or {}).values():
+            for group in scope.get("instanceGroups") or []:
+                zone = group["zone"].rsplit("/", 1)[-1]
+                insts = await self._get(
+                    session,
+                    f"{self.compute_base}/projects/{self.project}/zones/{zone}"
+                    f"/instanceGroups/{group['name']}/listInstances",
+                    token,
                 )
-                if out.returncode == 0:
-                    data = json.loads(out.stdout)
-                    hosts = [
-                        a["ip"]
-                        for ss in data.get("subsets", [])
-                        for a in ss.get("addresses", [])
-                    ]
-                    if hosts and hosts != self.hosts:
-                        logger.info("discovered TURN hosts: %s", hosts)
-                        self.hosts = hosts
-            except (OSError, subprocess.SubprocessError, ValueError) as exc:
-                logger.warning("endpoints discovery failed: %s", exc)
-            await asyncio.sleep(interval)
+                for inst in insts.get("items") or []:
+                    iname = inst["instance"].rsplit("/", 1)[-1]
+                    detail = await self._get(
+                        session,
+                        f"{self.compute_base}/projects/{self.project}/zones/{zone}"
+                        f"/instances/{iname}",
+                        token,
+                    )
+                    for nic in detail.get("networkInterfaces") or []:
+                        for ac in nic.get("accessConfigs") or []:
+                            if ac.get("natIP"):
+                                hosts.append(ac["natIP"])
+        return sorted(set(hosts))
+
+    async def run(self) -> None:
+        async with aiohttp.ClientSession() as session:
+            while True:
+                backoff = 0.1
+                while True:
+                    try:
+                        hosts = await self._discover_once(session)
+                        self.pool.replace(hosts)
+                        self.last_update = time.monotonic()
+                        break
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as exc:
+                        logger.warning("MIG discovery failed: %s (retry in %.1fs)",
+                                       exc, backoff)
+                        await asyncio.sleep(backoff)
+                        backoff = min(backoff * 2, 30.0)
+                await asyncio.sleep(self.interval)
+
+
+# ---------------------------------------------------------------------------
+# Auth (main.go:336-372)
+# ---------------------------------------------------------------------------
+
+
+def htpasswd_match(path: str, username: str, password: str) -> bool:
+    """htpasswd verification: bcrypt ($2y$/$2a$/$2b$), {SHA}, or plain."""
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return False
+    for line in lines:
+        if ":" not in line:
+            continue
+        user, hashed = line.split(":", 1)
+        if user != username:
+            continue
+        if hashed.startswith(("$2a$", "$2b$", "$2y$")):
+            try:
+                import bcrypt
+
+                return bcrypt.checkpw(password.encode(), hashed.encode())
+            except ImportError:
+                logger.warning("bcrypt entry but no bcrypt module")
+                return False
+        if hashed.startswith("{SHA}"):
+            digest = base64.b64encode(
+                hashlib.sha1(password.encode()).digest()).decode()
+            return hashed[5:] == digest
+        return hashed == password  # plain entry
+    return False
+
+
+def authenticate(request: web.Request, auth_header: str,
+                 htpasswd_path: str | None) -> str | None:
+    """-> username, or None (unauthorized). Mirrors main.go:336-372."""
+    value = request.headers.get(auth_header, "")
+    if auth_header == "authorization":
+        if not value.startswith("Basic "):
+            return None
+        try:
+            decoded = base64.b64decode(value[6:]).decode()
+            username, _, password = decoded.partition(":")
+        except Exception:
+            return None
+        if not htpasswd_path or not htpasswd_match(htpasswd_path, username, password):
+            return None
+        return username
+    if auth_header == "x-goog-authenticated-user-email":
+        # IAP prefixes 'accounts.google.com:'; the email is the last token
+        return value.split(":")[-1] or None
+    return value or None
+
+
+# ---------------------------------------------------------------------------
+# HTTP app
+# ---------------------------------------------------------------------------
 
 
 def make_app() -> web.Application:
     pool = TurnPool()
+    auth_header = os.environ.get("AUTH_HEADER_NAME", "x-auth-user").lower()
+    htpasswd_path = os.environ.get("HTPASSWD_FILE") or None
 
     async def handle(request: web.Request) -> web.Response:
+        user = authenticate(request, auth_header, htpasswd_path)
+        if user is None:
+            hdrs = {}
+            if auth_header == "authorization":
+                hdrs["WWW-Authenticate"] = 'Basic realm="restricted", charset="UTF-8"'
+            return web.Response(status=401, text="Unauthorized", headers=hdrs)
         host = pool.pick()
         if host is None:
             return web.Response(status=503, text="no TURN hosts discovered")
-        user = (
-            request.headers.get("x-auth-user")
-            or request.query.get("username")
-            or "coturn-web"
-        ).lower()
         rtc = generate_rtc_config(
             turn_host=host,
             turn_port=os.environ.get("TURN_PORT", "3478"),
             shared_secret=os.environ.get("TURN_SHARED_SECRET", "changeme"),
-            user=user,
+            user=user.lower(),
             protocol=os.environ.get("TURN_PROTOCOL", "udp"),
             turn_tls=os.environ.get("TURN_TLS", "false").lower() == "true",
         )
@@ -110,10 +404,25 @@ def make_app() -> web.Application:
         return web.Response(text="ok")
 
     async def start_discovery(app: web.Application):
-        app["discovery"] = asyncio.create_task(pool.discovery_loop())
+        tasks = []
+        svc = os.environ.get("TURN_ENDPOINTS_DISCOVERY")
+        if svc:
+            informer = K8sInformer(
+                pool, svc, os.environ.get("TURN_ENDPOINTS_NAMESPACE", "default")
+            )
+            tasks.append(asyncio.create_task(informer.run()))
+        project = os.environ.get("MIG_DISCO_PROJECT")
+        if project:
+            mig = MigDiscovery(
+                pool, project,
+                os.environ.get("MIG_DISCO_FILTER", ".*turn.*"),
+            )
+            tasks.append(asyncio.create_task(mig.run()))
+        app["discovery"] = tasks
 
     async def stop_discovery(app: web.Application):
-        app["discovery"].cancel()
+        for t in app["discovery"]:
+            t.cancel()
 
     app = web.Application()
     app["pool"] = pool

@@ -78,6 +78,204 @@ def test_coturn_web_no_hosts(loop, monkeypatch):
     async def run():
         async with TestClient(TestServer(coturn_web.make_app())) as client:
             r = await client.get("/")
-            assert r.status == 503
+            assert r.status == 401  # no user from the auth header (main.go:373)
+            r = await client.get("/", headers={"x-auth-user": "u"})
+            assert r.status == 503  # authenticated but no hosts discovered
+
+    loop.run_until_complete(run())
+
+
+# ---------------------------------------------------------------------------
+# coturn-web fleet discovery parity (reference addons/coturn-web:
+# informers.go K8s Endpoints+Nodes, mig_disco.go GCE MIG, main.go auth)
+# ---------------------------------------------------------------------------
+
+
+def test_k8s_informer_endpoints_nodes_watch(loop):
+    """Informer-style discovery against a FAKE K8s API: LIST seeds the
+    caches, WATCH events update them, and the published hosts are the
+    ExternalIPs of nodes carrying READY coturn endpoints."""
+    from aiohttp import web
+
+    events_eps = asyncio.Queue()
+    events_nodes = asyncio.Queue()
+
+    def node(name, ip):
+        return {"metadata": {"name": name},
+                "status": {"addresses": [{"type": "InternalIP", "address": "10.0.0.9"},
+                                         {"type": "ExternalIP", "address": ip}]}}
+
+    def endpoints(nodes, not_ready=()):
+        return {"metadata": {"name": "coturn", "resourceVersion": "5"},
+                "subsets": [{
+                    "addresses": [{"ip": "10.1.0.1", "nodeName": n} for n in nodes],
+                    "notReadyAddresses": [{"ip": "10.1.0.9", "nodeName": n}
+                                          for n in not_ready],
+                }]}
+
+    async def api(request):
+        path = request.path
+        watching = request.query.get("watch") == "1"
+        if path.endswith("/endpoints"):
+            if not watching:
+                return web.json_response({
+                    "items": [endpoints(["node-a"], not_ready=["node-c"])],
+                    "metadata": {"resourceVersion": "5"}})
+            resp = web.StreamResponse()
+            await resp.prepare(request)
+            while True:
+                ev = await events_eps.get()
+                await resp.write((json.dumps(ev) + "\n").encode())
+        if path.endswith("/nodes"):
+            if not watching:
+                return web.json_response({
+                    "items": [node("node-a", "203.0.113.1"),
+                              node("node-b", "203.0.113.2"),
+                              node("node-c", "203.0.113.3")],
+                    "metadata": {"resourceVersion": "7"}})
+            resp = web.StreamResponse()
+            await resp.prepare(request)
+            while True:
+                ev = await events_nodes.get()
+                await resp.write((json.dumps(ev) + "\n").encode())
+        return web.Response(status=404)
+
+    async def run():
+        app = web.Application()
+        app.router.add_get("/api/v1/namespaces/default/endpoints", api)
+        app.router.add_get("/api/v1/nodes", api)
+        server = TestServer(app)
+        await server.start_server()
+        pool = coturn_web.TurnPool()
+        informer = coturn_web.K8sInformer(
+            pool, "coturn", "default",
+            api_base=f"http://{server.host}:{server.port}", token="t", ssl=None)
+        task = asyncio.ensure_future(informer.run())
+        for _ in range(100):
+            if pool.hosts:
+                break
+            await asyncio.sleep(0.02)
+        # node-a ready -> its ExternalIP; node-c only notReady -> excluded
+        assert pool.hosts == ["203.0.113.1"], pool.hosts
+
+        # WATCH event: coturn pod lands on node-b too
+        await events_eps.put({"type": "MODIFIED",
+                              "object": endpoints(["node-a", "node-b"])})
+        for _ in range(100):
+            if len(pool.hosts) == 2:
+                break
+            await asyncio.sleep(0.02)
+        assert pool.hosts == ["203.0.113.1", "203.0.113.2"]
+
+        # node-a deleted -> host drops out
+        await events_nodes.put({"type": "DELETED", "object": node("node-a", "203.0.113.1")})
+        for _ in range(100):
+            if pool.hosts == ["203.0.113.2"]:
+                break
+            await asyncio.sleep(0.02)
+        assert pool.hosts == ["203.0.113.2"]
+        task.cancel()
+        await server.close()
+
+    loop.run_until_complete(run())
+
+
+def test_mig_discovery_with_backoff(loop, monkeypatch):
+    """GCE MIG discovery against FAKE metadata + compute APIs: SA token
+    from the metadata server, group filter, instance external IPs; the
+    first compute call fails once to exercise the backoff path."""
+    from aiohttp import web
+
+    monkeypatch.delenv("ACCESS_TOKEN", raising=False)
+    calls = {"groups": 0}
+
+    async def token(request):
+        assert request.headers["Metadata-Flavor"] == "Google"
+        return web.json_response({"access_token": "sa-token", "expires_in": 600})
+
+    async def groups(request):
+        calls["groups"] += 1
+        if calls["groups"] == 1:
+            return web.Response(status=500, text="transient")
+        assert request.headers["Authorization"] == "Bearer sa-token"
+        assert "turn" in request.query["filter"]
+        return web.json_response({"items": {"zones/us-x1-a": {"instanceGroups": [
+            {"name": "coturn-mig", "zone": "projects/p/zones/us-x1-a"}]}}})
+
+    async def list_instances(request):
+        return web.json_response({"items": [
+            {"instance": "projects/p/zones/us-x1-a/instances/coturn-1"}]})
+
+    async def instance(request):
+        return web.json_response({"networkInterfaces": [
+            {"accessConfigs": [{"natIP": "198.51.100.44"}]}]})
+
+    async def run():
+        app = web.Application()
+        app.router.add_get(
+            "/computeMetadata/v1/instance/service-accounts/default/token", token)
+        app.router.add_get("/compute/projects/p/aggregated/instanceGroups", groups)
+        app.router.add_get(
+            "/compute/projects/p/zones/us-x1-a/instanceGroups/coturn-mig/listInstances",
+            list_instances)
+        app.router.add_get("/compute/projects/p/zones/us-x1-a/instances/coturn-1",
+                           instance)
+        server = TestServer(app)
+        await server.start_server()
+        base = f"http://{server.host}:{server.port}"
+        pool = coturn_web.TurnPool()
+        mig = coturn_web.MigDiscovery(
+            pool, "p", ".*turn.*",
+            compute_base=f"{base}/compute", metadata_base=f"{base}/computeMetadata/v1")
+        task = asyncio.ensure_future(mig.run())
+        for _ in range(200):
+            if pool.hosts:
+                break
+            await asyncio.sleep(0.02)
+        assert pool.hosts == ["198.51.100.44"]
+        assert calls["groups"] >= 2  # backoff retried after the 500
+        task.cancel()
+        await server.close()
+
+    loop.run_until_complete(run())
+
+
+def test_auth_modes(tmp_path, loop, turn_env, monkeypatch):
+    """main.go:336-372 parity: htpasswd basic auth, IAP email header,
+    plain header — wrong credentials get 401 + WWW-Authenticate."""
+    import base64
+    import hashlib
+
+    sha = base64.b64encode(hashlib.sha1(b"pw1").digest()).decode()
+    htp = tmp_path / "htpasswd"
+    htp.write_text(f"alice:{{SHA}}{sha}\nbob:plainpw\n")
+    monkeypatch.setenv("TURN_HOSTS", "t.example")
+
+    async def run():
+        # basic auth against htpasswd
+        monkeypatch.setenv("AUTH_HEADER_NAME", "authorization")
+        monkeypatch.setenv("HTPASSWD_FILE", str(htp))
+        async with TestClient(TestServer(coturn_web.make_app())) as client:
+            r = await client.get("/")
+            assert r.status == 401 and "WWW-Authenticate" in r.headers
+            cred = base64.b64encode(b"alice:pw1").decode()
+            r = await client.get("/", headers={"Authorization": f"Basic {cred}"})
+            assert r.status == 200
+            assert "alice" in json.loads(await r.text())["iceServers"][1]["username"]
+            bad = base64.b64encode(b"alice:nope").decode()
+            r = await client.get("/", headers={"Authorization": f"Basic {bad}"})
+            assert r.status == 401
+            cred2 = base64.b64encode(b"bob:plainpw").decode()
+            r = await client.get("/", headers={"Authorization": f"Basic {cred2}"})
+            assert r.status == 200
+
+        # IAP header: the accounts.google.com: prefix is stripped
+        monkeypatch.setenv("AUTH_HEADER_NAME", "x-goog-authenticated-user-email")
+        monkeypatch.delenv("HTPASSWD_FILE", raising=False)
+        async with TestClient(TestServer(coturn_web.make_app())) as client:
+            r = await client.get("/", headers={
+                "x-goog-authenticated-user-email": "accounts.google.com:a@b.c"})
+            assert r.status == 200
+            assert "a@b.c" in json.loads(await r.text())["iceServers"][1]["username"]
 
     loop.run_until_complete(run())
