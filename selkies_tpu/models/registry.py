@@ -101,6 +101,19 @@ def _vp8enc(*, width: int, height: int, fps: int = 60, bitrate_kbps: int = 2000,
     return LibVpxEncoder(width=width, height=height, fps=fps, bitrate_kbps=bitrate_kbps, vp8=True)
 
 
+@register("x264enc")
+def _x264enc(*, width: int, height: int, fps: int = 60, bitrate_kbps: int = 2000, **kw):
+    """The REAL x264 software row (ctypes libx264, reference tuning —
+    gstwebrtc_app.py:609-639); degrades to the TPU encoder when the
+    library/ABI probe fails (models/x264enc.py)."""
+    from selkies_tpu.models.x264enc import X264Encoder, x264_available
+
+    if not x264_available():
+        logger.warning("libx264 unavailable; x264enc falls back to tpuh264enc")
+        return _FACTORIES["tpuh264enc"](width=width, height=height, fps=fps, **kw)
+    return X264Encoder(width=width, height=height, fps=fps, bitrate_kbps=bitrate_kbps)
+
+
 @register("tpuav1enc")
 def _tpuav1enc(*, width: int, height: int, fps: int = 60, **kw):
     """Codec-fallback row. AV1's adaptive CDF entropy coder depends on
@@ -123,7 +136,8 @@ def _tpuav1enc(*, width: int, height: int, fps: int = 60, **kw):
 
 # Legacy GStreamer encoder names (reference gstwebrtc_app.py:1133) map to
 # the TPU equivalent so existing SELKIES_ENCODER values keep working.
-for _legacy_h264 in ("nvh264enc", "vah264enc", "x264enc", "openh264enc"):
+# (x264enc is a REAL row above, not an alias.)
+for _legacy_h264 in ("nvh264enc", "vah264enc", "openh264enc"):
     alias(_legacy_h264, "tpuh264enc")
 # H.265 rows (reference gstwebrtc_app.py:369-424,510-542,667-683): HEVC's
 # CABAC-only entropy coding has the same unbuildable-from-scratch problem

@@ -351,3 +351,62 @@ def test_delta_scroll_nonzero_skip_mvs_bitexact(tmp_path):
         outs.extend(enc_b.submit(f))
     outs.extend(enc_b.flush())
     assert b"".join(au for au, _, _ in outs) == stream_f
+
+
+def test_nscap_dense_fallback_and_row_spill(monkeypatch, tmp_path):
+    """Delta frames driven past NSCAP (non-skip MB cap) and CAP_ROWS_DELTA
+    (coefficient-row cap) must engage the dense-header fallback and the
+    row spill fetch, producing the EXACT stream of an uncapped encoder."""
+    import cv2
+
+    from selkies_tpu.models.h264 import encoder as enc_mod
+
+    rng = np.random.default_rng(17)
+    w, h = 96, 64
+    base = np.ascontiguousarray(rng.integers(0, 255, (h, w, 4), np.uint8))
+    frames = [base]
+    for i in range(3):
+        f = base.copy()
+        # busy DELTA: 2 bands x full width (6 of 12 tiles -> inside the
+        # delta bucket) of noise = 12 non-skip MBs, past a tiny NSCAP
+        f[:32, :, :3] = rng.integers(0, 255, (32, w, 3), np.uint8)
+        frames.append(f)
+        base = f
+
+    def run(**caps):
+        for k, v in caps.items():
+            monkeypatch.setattr(enc_mod, k, v)
+        enc = enc_mod.TPUH264Encoder(w, h, qp=24, frame_batch=1, pipeline_depth=0,
+                                     device_entropy=False)
+        deltas = [0]
+        orig = enc._run_step_delta
+        def counting(frame, idx, idr):
+            deltas[0] += 1
+            return orig(frame, idx, idr)
+        enc._run_step_delta = counting
+        out = []
+        for f in frames:
+            for au, s, _ in enc.submit(f):
+                out.append((au, s))
+            out.extend((au, s) for au, s, _ in enc.flush())
+        enc.close()
+        return out, deltas[0]
+
+    ref, n_delta = run()  # default caps: no fallback engaged
+    assert n_delta == 3, f"delta path ran {n_delta}x, want every P frame"
+    assert any(not s.idr and s.skipped_mbs < (h // 16) * (w // 16)
+               for _, s in ref), "trace produced no real P frames"
+
+    # tiny caps: every busy delta exceeds NSCAP=4 and spills rows past 8
+    capped, n_delta2 = run(NSCAP=4, CAP_ROWS_DELTA=8)
+    assert n_delta2 == 3
+    assert [a for a, _ in capped] == [a for a, _ in ref], (
+        "dense fallback / row spill diverged from the uncapped stream")
+
+    p = tmp_path / "nscap.h264"
+    p.write_bytes(b"".join(a for a, _ in capped))
+    cap = cv2.VideoCapture(str(p))
+    n = 0
+    while cap.read()[0]:
+        n += 1
+    assert n == len(frames)
