@@ -21,5 +21,15 @@ ls "$OUT"/selkies_tpu-*.whl
 echo "== web client tarball =="
 tar -czf "$OUT/selkies-tpu-web.tar.gz" -C selkies_tpu/web .
 
-echo "== done =="
+echo "== portable dist =="
+bash packaging/portable.sh "$OUT"
+
+echo "== js-interposer .deb =="
+if command -v dpkg-deb >/dev/null; then
+    bash packaging/build_deb.sh "$OUT"
+else
+    echo "dpkg-deb not found; skipping .deb (non-Debian host)"
+fi
+
+echo "== all artifacts =="
 ls -la "$OUT"

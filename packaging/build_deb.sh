@@ -1,0 +1,42 @@
+#!/bin/bash
+# js-interposer .deb (reference parity: addons/js-interposer/build_deb.sh
+# + Dockerfile.debpkg): packages the LD_PRELOAD joystick interposer as
+# /usr/lib/<multiarch>/selkies_joystick_interposer.so so containerized
+# games see /dev/input/jsN without kernel uinput.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PKG_NAME="${PKG_NAME:-selkies-js-interposer}"
+PKG_VERSION="${PKG_VERSION:-$(python -c 'import tomllib;print(tomllib.load(open("pyproject.toml","rb"))["project"]["version"])')}"
+OUT="${1:-dist}"
+mkdir -p "$OUT"
+
+STAGE="$(mktemp -d)"
+trap 'rm -rf "$STAGE"' EXIT
+PKG_DIR="$STAGE/${PKG_NAME}_${PKG_VERSION}"
+mkdir -p "$PKG_DIR/DEBIAN"
+
+DEST_DIR="$PKG_DIR/usr/lib/$(gcc -print-multiarch)"
+mkdir -p "$DEST_DIR"
+# one canonical build: the Makefile owns the compile flags
+make -C native -s selkies_joystick_interposer.so
+cp native/selkies_joystick_interposer.so "$DEST_DIR/selkies_joystick_interposer.so"
+
+PKG_SIZE="$(du -s "$PKG_DIR/usr" | awk '{print $1}')"
+cat > "$PKG_DIR/DEBIAN/control" <<EOF
+Package: ${PKG_NAME}
+Version: ${PKG_VERSION}
+Section: custom
+Priority: optional
+Architecture: $(dpkg --print-architecture)
+Essential: no
+Installed-Size: ${PKG_SIZE}
+Maintainer: selkies-tpu maintainers <noreply@localhost>
+Description: Joystick device interposer for containerized gamepad support
+ LD_PRELOAD library redirecting /dev/input/jsN opens to the selkies
+ gamepad unix sockets (/tmp/selkies_jsN.sock).
+EOF
+
+dpkg-deb --build --root-owner-group "$PKG_DIR" \
+    "$OUT/${PKG_NAME}_${PKG_VERSION}_$(dpkg --print-architecture).deb"
+echo "built: $OUT/${PKG_NAME}_${PKG_VERSION}_$(dpkg --print-architecture).deb"

@@ -1,0 +1,39 @@
+#!/bin/bash
+# Portable distribution (reference parity: addons/conda — a relocatable
+# tarball with launchers that auto-start Xvfb/PulseAudio). The conda
+# original bundles a whole GStreamer+Python runtime; this framework's
+# runtime is jax/the Python env, so the portable dist bundles everything
+# ABOVE the interpreter: wheel, web assets, native libraries, and the
+# selkies-tpu-run launcher (addons/conda/build/selkies-gstreamer-run
+# behavior: Xvfb auto-start with the full extension list, PulseAudio
+# auto-start, resize, then exec the orchestrator).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT="${1:-dist}"
+STAGE="$(mktemp -d)"
+trap 'rm -rf "$STAGE"' EXIT
+ROOT="$STAGE/selkies-tpu-portable"
+mkdir -p "$ROOT"/{bin,wheels,native,web}
+
+# reuse artifacts already in $OUT when build.sh produced them; build
+# only when run standalone
+if ls "$OUT"/selkies_tpu-*.whl >/dev/null 2>&1; then
+    cp "$OUT"/selkies_tpu-*.whl "$ROOT/wheels/"
+else
+    python -m pip wheel --no-deps --no-build-isolation -w "$ROOT/wheels" . >/dev/null
+fi
+if [ -f "$OUT/libframeprep.so" ]; then
+    cp "$OUT"/selkies_joystick_interposer.so "$OUT"/libcavlc.so "$OUT"/libframeprep.so "$ROOT/native/"
+else
+    make -C native -s
+    cp native/selkies_joystick_interposer.so native/libcavlc.so native/libframeprep.so "$ROOT/native/"
+fi
+cp -r selkies_tpu/web/. "$ROOT/web/"
+cp packaging/selkies-tpu-run "$ROOT/bin/selkies-tpu-run"
+cp packaging/selkies-tpu-resize-run "$ROOT/bin/selkies-tpu-resize-run"
+chmod +x "$ROOT/bin/"*
+
+mkdir -p "$OUT"
+tar -czf "$OUT/selkies-tpu-portable.tar.gz" -C "$STAGE" selkies-tpu-portable
+echo "built: $OUT/selkies-tpu-portable.tar.gz"
