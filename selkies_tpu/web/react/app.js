@@ -1,0 +1,219 @@
+// @ts-check
+/**
+ * Typed client variant — the gst-web-react counterpart (App.tsx).
+ *
+ * Same wire protocol as ../app.js through the shared planes
+ * (SelkiesMedia / SelkiesWebRTC / SelkiesInput, classic scripts), with
+ * the React client's distinguishing features rebuilt on the local
+ * component runtime (ui.js): URL-parameter connection config
+ * (config.js), an in-page DEBUG OVERLAY with live log capture toggled
+ * without reload (App.tsx:1052-1064 parity), a stats panel, and a
+ * settings drawer driving the same _arg_/vb/s control vocabulary.
+ */
+"use strict";
+
+import { h, mount, useState } from "./ui.js";
+import { baseUrls, getConnectionConfig } from "./config.js";
+
+const cfg = getConnectionConfig();
+const urls = baseUrls(cfg);
+
+/** localStorage persistence per app name (reference app.js:190-212). */
+const store = {
+  /** @param {string} k @param {string | null} d */
+  get: (k, d) => localStorage.getItem(`${cfg.appName}:${k}`) ?? d,
+  /** @param {string} k @param {string} v */
+  set: (k, v) => localStorage.setItem(`${cfg.appName}:${k}`, v),
+};
+
+// ---------------------------------------------------------------------------
+// Shared state outside the component tree (media elements must survive
+// re-renders) + a tiny pub/sub the components subscribe to via props.
+// ---------------------------------------------------------------------------
+
+const state = {
+  status: "connecting…",
+  plane: "ws",
+  debug: cfg.debug,
+  serverLatencyMs: 0,
+  fps: 0,
+  system: /** @type {Record<string, unknown> | null} */ (null),
+  /** @type {string[]} */
+  logs: [],
+  renderUi: () => {},
+};
+
+/** @param {string} line */
+function logDebug(line) {
+  state.logs.push(`${new Date().toISOString().slice(11, 19)} ${line}`);
+  if (state.logs.length > 200) state.logs.shift();
+  if (state.debug) state.renderUi();
+}
+
+const canvas = /** @type {HTMLCanvasElement} */ (
+  document.getElementById("screen"));
+const videoEl = /** @type {HTMLVideoElement} */ (
+  document.getElementById("screen-video"));
+
+/** @type {SelkiesMedia} */
+const media = new SelkiesMedia(canvas, onServerMessage, onPlaneEvent);
+/** @type {SelkiesWebRTC | null} */
+let rtc = null;
+/** @type {{send: (m: string) => void}} */
+let plane = media;
+const input = new SelkiesInput(canvas, (m) => plane.send(m));
+
+let framesThisSecond = 0;
+let lastDecoded = 0;
+
+/** @param {SelkiesServerMessage} msg */
+function onServerMessage(msg) {
+  logDebug(`<- ${JSON.stringify(msg).slice(0, 120)}`);
+  if (msg.type === "ping") {
+    plane.send(`pong,${Date.now() / 1000}`);
+  } else if (msg.type === "system_stats" || msg.type === "system") {
+    state.system = /** @type {Record<string, unknown>} */ (msg);
+    state.renderUi();
+  } else if (msg.type === "latency_measurement") {
+    state.serverLatencyMs = Number(msg.latency_ms || 0);
+    state.renderUi();
+  } else if (msg.type === "clipboard") {
+    const text = typeof msg.data === "string" ? atob(msg.data) : "";
+    navigator.clipboard?.writeText(text).catch(() => {});
+  }
+}
+
+/** @param {SelkiesStatsEvent} ev */
+function onPlaneEvent(ev) {
+  if (ev.event) logDebug(`plane ${state.plane}: ${ev.event} ${ev.reason || ""}`);
+  if (ev.event === "open") {
+    state.status = `streaming (${state.plane})`;
+    sendInitialPrefs();
+    state.renderUi();
+  } else if (ev.event === "failed" && state.plane === "rtc") {
+    // WebRTC plane failed: fall back to the WS plane (same policy as
+    // the default client shell)
+    state.plane = "ws";
+    plane = media;
+    videoEl.style.display = "none";
+    canvas.style.display = "";
+    media.connect(`${urls.ws}/media`);
+    state.renderUi();
+  } else if (ev.event === "close") {
+    state.status = "disconnected — retrying";
+    setTimeout(start, 2000);
+    state.renderUi();
+  }
+}
+
+function sendInitialPrefs() {
+  const fps = store.get("framerate", null);
+  if (fps) plane.send(`_arg_fps,${fps}`);
+  const resize = store.get("resize", null);
+  if (resize !== null) {
+    const res = `${Math.round(innerWidth * devicePixelRatio)}x${Math.round(innerHeight * devicePixelRatio)}`;
+    plane.send(`_arg_resize,${resize},${res}`);
+  }
+}
+
+let started = false;
+function start() {
+  if (started) return;
+  started = true;
+  state.plane = "rtc";
+  rtc = new SelkiesWebRTC(videoEl, onServerMessage, onPlaneEvent);
+  plane = /** @type {{send: (m: string) => void}} */ (rtc);
+  input.detach();
+  input.canvas = videoEl;
+  input.attach();
+  videoEl.style.display = "";
+  canvas.style.display = "none";
+  rtc.connect().catch((e) => {
+    logDebug(`rtc connect error: ${e}`);
+    onPlaneEvent({ event: "failed", reason: String(e) });
+  });
+  started = false;
+}
+
+// client metrics upload every 5 s (_f fps, _l latency — reference
+// app.js:604-607)
+setInterval(() => {
+  const src = state.plane === "rtc" && rtc ? rtc : media;
+  const decoded = src.framesDecoded;
+  framesThisSecond = (decoded - lastDecoded) / 5;
+  lastDecoded = decoded;
+  state.fps = Math.max(0, Math.round(framesThisSecond));
+  if (plane && /** @type {{connected?: boolean}} */ (src).connected) {
+    plane.send(`_f,${state.fps}`);
+    plane.send(`_l,${Math.round(state.serverLatencyMs)}`);
+  }
+  state.renderUi();
+}, 5000);
+
+// ---------------------------------------------------------------------------
+// Components
+// ---------------------------------------------------------------------------
+
+/** @param {{state: typeof state}} props */
+function StatusBar({ state: s }) {
+  return h("div", { class: "rx-status" },
+    `${s.status}  ·  plane ${s.plane}  ·  ${s.fps} fps  ·  ` +
+    `${s.serverLatencyMs.toFixed(0)} ms`);
+}
+
+/** @param {{state: typeof state}} props */
+function DebugOverlay({ state: s }) {
+  if (!s.debug) return h("span", null);
+  const sys = s.system ? JSON.stringify(s.system).slice(0, 300) : "-";
+  return h("div", { class: "rx-debug" },
+    h("div", null, `app=${cfg.appName} server=${urls.http}`),
+    h("div", null, `system: ${sys}`),
+    h("pre", null, s.logs.slice(-14).join("\n")));
+}
+
+function SettingsDrawer() {
+  const [open, setOpen] = useState(false);
+  const drawer = h("div", { class: "rx-drawer" + (open ? " open" : "") },
+    h("label", null, "Frames per second ",
+      h("select", {
+        onChange: (/** @type {Event} */ e) => {
+          const v = /** @type {HTMLSelectElement} */ (e.target).value;
+          store.set("framerate", v);
+          plane.send(`_arg_fps,${v}`);
+        },
+      }, ...["15", "30", "60", "120"].map((v) =>
+        h("option", v === store.get("framerate", "60") ? { selected: "" } : null, v)))),
+    h("label", null, "Bitrate (kbit/s) ",
+      h("select", {
+        onChange: (/** @type {Event} */ e) =>
+          plane.send(`vb,${/** @type {HTMLSelectElement} */ (e.target).value}`),
+      }, ...["2000", "4000", "8000", "12000"].map((v) => h("option", null, v)))),
+    h("button", {
+      onClick: () => {
+        state.debug = !state.debug;   // no-reload debug toggle
+        logDebug(`debug ${state.debug ? "on" : "off"}`);
+        state.renderUi();
+      },
+    }, "Toggle debug overlay"),
+    h("button", {
+      onClick: () => document.documentElement.requestFullscreen?.(),
+    }, "Fullscreen"));
+  return h("div", null,
+    h("div", {
+      class: "rx-gear", title: "settings",
+      onClick: () => setOpen(!open),
+    }, "⚙"),
+    drawer);
+}
+
+/** @param {{state: typeof state}} props */
+function App({ state: s }) {
+  return h("div", null,
+    StatusBar({ state: s }),
+    DebugOverlay({ state: s }),
+    SettingsDrawer());
+}
+
+const uiRoot = /** @type {HTMLElement} */ (document.getElementById("ui"));
+state.renderUi = mount(App, { state }, uiRoot);
+start();

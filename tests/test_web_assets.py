@@ -64,3 +64,54 @@ def test_input_protocol_verbs_match_host():
               for m in re.findall(r'"([^"]+)"', grp)}
     missing = {v for v in sent if v not in known}
     assert not missing, f"client sends unhandled verbs: {missing}"
+
+
+# ---------------------------------------------------------------------------
+# Typed client variant (web/react/ — the gst-web-react counterpart)
+# ---------------------------------------------------------------------------
+
+
+def test_react_variant_bundle_complete():
+    for name in ("index.html", "app.js", "ui.js", "config.js",
+                 "types.d.ts", "tsconfig.json"):
+        assert os.path.exists(os.path.join(WEB, "react", name)), name
+
+
+def test_react_variant_dom_and_classes():
+    html = _read(os.path.join("react", "index.html"))
+    app = _read(os.path.join("react", "app.js"))
+    for el_id in set(re.findall(r"getElementById\(\"([^\"]+)\"\)", app)):
+        assert f'id="{el_id}"' in html, f"react/app.js references missing #{el_id}"
+    # every CSS class the components emit has a style rule
+    for cls in set(re.findall(r'class: "(rx-[a-z]+)"', app)):
+        assert f".{cls}" in html, f"react/index.html missing style for .{cls}"
+
+
+def test_react_variant_shares_protocol_planes():
+    html = _read(os.path.join("react", "index.html"))
+    # shared classic-script planes load before the module app
+    order = [html.index(s) for s in
+             ("../keysyms.js", "../input.js", "../media.js", "../webrtc.js", '"app.js"')]
+    assert order == sorted(order)
+    app = _read(os.path.join("react", "app.js"))
+    for sym in ("SelkiesMedia", "SelkiesWebRTC", "SelkiesInput"):
+        assert sym in app, f"variant does not use shared plane {sym}"
+    # the typed surface covers each shared plane
+    dts = _read(os.path.join("react", "types.d.ts"))
+    for sym in ("SelkiesMedia", "SelkiesWebRTC", "SelkiesInput"):
+        assert f"declare class {sym}" in dts
+
+
+def test_react_variant_url_config_parity():
+    cfgjs = _read(os.path.join("react", "config.js"))
+    # reference config.ts:50-121 parameter set
+    for param in ("server", "port", "app", "secure", "turn_host", "turn_port",
+                  "turn_username", "turn_password", "turn_protocol", "debug"):
+        assert f'"{param}"' in cfgjs, f"config.js missing ?{param}= support"
+
+
+def test_react_variant_brace_balance():
+    for name in ("app.js", "ui.js", "config.js"):
+        src = _read(os.path.join("react", name))
+        for a, b in (("{", "}"), ("(", ")"), ("[", "]")):
+            assert src.count(a) == src.count(b), f"{name}: unbalanced {a}{b}"
