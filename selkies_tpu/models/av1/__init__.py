@@ -1,0 +1,4 @@
+"""AV1 encoder row: hybrid capture-delta front-end over ctypes libaom,
+with ctypes libdav1d as the independent conformance decoder."""
+
+from selkies_tpu.models.av1.encoder import TPUAV1Encoder  # noqa: F401

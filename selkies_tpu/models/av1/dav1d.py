@@ -1,0 +1,155 @@
+"""ctypes wrapper for libdav1d: AV1 conformance decoding.
+
+The AV1 row's conformance tests need a decoder that is independent of
+the encoder (the same role FFmpeg plays for the H.264/VP9 rows — but
+this image's OpenCV/FFmpeg build has no software AV1 decoder, only a
+hwaccel stub). dav1d 1.0.0 is in the image; this wraps just enough of
+its API to decode temporal units into Y/U/V numpy planes.
+
+ABI notes (dav1d 1.0.0, verified empirically — see the picture-layout
+check in _load): Dav1dPicture is {seq_hdr*, frame_hdr*, data[3] @16,
+stride[2] @40, p{w @56, h @60, layout @64, bpc @68}, ...}. Dav1dData is
+{data*, sz, ref*, props} and Dav1dSettings is filled entirely by
+dav1d_default_settings — the wrapper never pokes either beyond what
+the API functions write.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import struct as _struct
+
+import numpy as np
+
+logger = logging.getLogger("models.av1.dav1d")
+
+_SETTINGS_BYTES = 512   # sizeof(Dav1dSettings) ~ 96; headroom deliberate
+_DATA_BYTES = 128       # sizeof(Dav1dData) = 72
+_PIC_BYTES = 1024       # sizeof(Dav1dPicture) ~ 240
+_PIC_DATA_OFF = 16
+_PIC_STRIDE_OFF = 40
+_PIC_W_OFF = 56
+_PIC_H_OFF = 60
+_PIC_LAYOUT_OFF = 64
+_PIC_BPC_OFF = 68
+_EAGAIN = -11
+_LAYOUT_I420 = 1
+
+_lib = None
+_lib_tried = False
+
+
+def _load():
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    for name in ("libdav1d.so.6", "libdav1d.so", "dav1d"):
+        try:
+            lib = ctypes.CDLL(name)
+            break
+        except OSError:
+            continue
+    else:
+        logger.info("libdav1d not found; AV1 conformance decode unavailable")
+        return None
+    lib.dav1d_data_create.restype = ctypes.c_void_p
+    lib.dav1d_version.restype = ctypes.c_char_p
+    _lib = lib
+    return _lib
+
+
+def dav1d_available() -> bool:
+    return _load() is not None
+
+
+class Dav1dDecoder:
+    """Feed AV1 temporal units, get (Y, U, V) uint8 planes back."""
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("libdav1d unavailable")
+        self._lib = lib
+        settings = (ctypes.c_uint8 * _SETTINGS_BYTES)()
+        lib.dav1d_default_settings(settings)
+        self._ctx = ctypes.c_void_p()
+        rc = lib.dav1d_open(ctypes.byref(self._ctx), settings)
+        if rc:
+            raise RuntimeError(f"dav1d_open: {rc}")
+
+    def close(self) -> None:
+        if getattr(self, "_ctx", None) and self._ctx.value:
+            self._lib.dav1d_close(ctypes.byref(self._ctx))
+            self._ctx = ctypes.c_void_p()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _get_picture(self):
+        pic = (ctypes.c_uint8 * _PIC_BYTES)()
+        rc = self._lib.dav1d_get_picture(self._ctx, pic)
+        if rc == _EAGAIN:
+            return None
+        if rc:
+            raise RuntimeError(f"dav1d_get_picture: {rc}")
+        raw = bytes(pic)
+        d0, d1, d2 = _struct.unpack_from("<3Q", raw, _PIC_DATA_OFF)
+        s0, s1 = _struct.unpack_from("<2q", raw, _PIC_STRIDE_OFF)
+        w, h, layout, bpc = _struct.unpack_from("<4i", raw, _PIC_W_OFF)
+        if bpc != 8 or layout != _LAYOUT_I420:
+            self._lib.dav1d_picture_unref(pic)
+            raise RuntimeError(f"unexpected picture layout={layout} bpc={bpc}")
+
+        def plane(ptr, stride, rows, cols):
+            a = np.ctypeslib.as_array(
+                ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint8)), (rows, stride))
+            return a[:, :cols].copy()
+
+        y = plane(d0, s0, h, w)
+        u = plane(d1, s1, (h + 1) // 2, (w + 1) // 2)
+        v = plane(d2, s1, (h + 1) // 2, (w + 1) // 2)
+        self._lib.dav1d_picture_unref(pic)
+        return y, u, v
+
+    def decode(self, tu: bytes) -> list[tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        """Decode one temporal unit; returns all pictures it produced
+        (normally exactly one for a realtime stream)."""
+        lib = self._lib
+        data = (ctypes.c_uint8 * _DATA_BYTES)()
+        ptr = lib.dav1d_data_create(data, len(tu))
+        if not ptr:
+            raise RuntimeError("dav1d_data_create failed")
+        ctypes.memmove(ptr, tu, len(tu))
+        out = []
+        while True:
+            rc = lib.dav1d_send_data(self._ctx, data)
+            if rc == 0:
+                break
+            if rc == _EAGAIN:
+                pic = self._get_picture()
+                if pic is None:
+                    raise RuntimeError("dav1d stalled: EAGAIN on both ends")
+                out.append(pic)
+                continue
+            lib.dav1d_data_unref(data)
+            raise RuntimeError(f"dav1d_send_data: {rc}")
+        while True:
+            pic = self._get_picture()
+            if pic is None:
+                break
+            out.append(pic)
+        return out
+
+    def flush(self) -> list[tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        """Drain any delayed pictures (realtime streams have none)."""
+        out = []
+        while True:
+            pic = self._get_picture()
+            if pic is None:
+                return out
+            out.append(pic)

@@ -1,0 +1,361 @@
+"""ctypes wrapper for libaom: the real `av1enc` software encoder row.
+
+The reference's av1enc GStreamer element (gstwebrtc_app.py:741-783) IS
+libaom behind GObject properties — wrapping the same library gives the
+encoder matrix a REAL AV1 row (round 3 shipped an H.264 fallback on the
+false claim that no AV1 library existed in this image; libaom.so.3 is
+right there). Tuning mirrors the reference's realtime row: usage=
+realtime, CBR, zero lag, cpu-used 10, threads, keyframes only on demand.
+
+ABI notes: built against libaom.so.3 (v3.6.0, Debian). libaom inherited
+libvpx's encoder API shape, so the wrapper follows models/libvpx_enc.py:
+aom_codec_enc_cfg offsets (uint32 words) were probed empirically against
+aom_codec_enc_config_default's known realtime defaults (g_usage=1,
+g_w=320, g_h=240, timebase 1/30, rc_end_usage=CBR, rc_target_bitrate=
+256, kf_mode=AUTO, kf_max_dist=9999) and are re-verified at load time —
+a mismatched build disables the row instead of corrupting memory. The
+encoder ABI version (25) is probed by aom_codec_enc_init_ver returning
+ABI_MISMATCH for wrong values.
+
+Footgun note (verified by bisection on v3.6.0): kf_mode=AOM_KF_DISABLED
+segfaults libaom's realtime path on content that trips its scene-change
+detector. Infinite-GOP semantics (keyframe_distance=-1) are therefore
+expressed as AOM_KF_AUTO with kf_max_dist=2^30 + AOM_EFLAG_FORCE_KF on
+demand, which is behaviourally identical and stays on the tested path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import struct as _struct
+import time
+
+import numpy as np
+
+from selkies_tpu.models.libvpx_enc import _bgrx_to_i420_np
+from selkies_tpu.models.stats import FrameStats
+
+logger = logging.getLogger("models.libaom")
+
+# aom_codec_enc_cfg word offsets (uint32 units), probed + verified in _load
+_OFF_G_USAGE = 0
+_OFF_G_THREADS = 1
+_OFF_G_W = 3
+_OFF_G_H = 4
+_OFF_TB_NUM = 10
+_OFF_TB_DEN = 11
+_OFF_ERROR_RESILIENT = 12
+_OFF_LAG_IN_FRAMES = 14
+_OFF_RC_DROPFRAME = 15
+_OFF_RC_END_USAGE = 24
+_OFF_TARGET_BITRATE = 34
+_OFF_MIN_Q = 35
+_OFF_MAX_Q = 36
+_OFF_UNDERSHOOT = 37
+_OFF_OVERSHOOT = 38
+_OFF_BUF_SZ = 39
+_OFF_BUF_INITIAL = 40
+_OFF_BUF_OPTIMAL = 41
+_OFF_KF_MODE = 46
+_OFF_KF_MIN_DIST = 47
+_OFF_KF_MAX_DIST = 48
+
+_AOM_USAGE_REALTIME = 1
+_AOM_CBR = 1
+_AOM_KF_AUTO = 1
+_KF_NEVER = 1 << 30  # kf_max_dist "infinite GOP" (see footgun note)
+_AOM_IMG_FMT_I420 = 0x102
+_AOM_EFLAG_FORCE_KF = 1
+_AOM_FRAME_IS_KEY = 1
+_AOME_SET_ACTIVEMAP = 9
+_AOME_SET_CPUUSED = 13
+_ENCODER_ABI_VERSION = 25  # probed; init returns ABI_MISMATCH(3) otherwise
+_ABI_MISMATCH = 3
+_CFG_BYTES = 8192
+_CTX_BYTES = 4096  # aom_codec_ctx_t is far smaller; headroom is deliberate
+
+# aom_image_t byte offsets (probed + verified in _load):
+#   fmt u32 @0, w/h @28/32, d_w/d_h @40/44, planes[3] @64, stride[3] @88
+_IMG_FMT_OFF = 0
+_IMG_DW_OFF = 40
+_IMG_DH_OFF = 44
+_IMG_PLANES_OFF = 64
+_IMG_STRIDE_OFF = 88
+
+# aom_codec_cx_pkt_t byte offsets: kind @0, frame.buf @8, frame.sz @16,
+# frame.pts @24, frame.duration @32 (unsigned long), frame.flags @40
+_PKT_KIND_OFF = 0
+_PKT_BUF_OFF = 8
+_PKT_SZ_OFF = 16
+_PKT_FLAGS_OFF = 40
+_PKT_READ = 48
+
+
+class _AomActiveMap(ctypes.Structure):
+    # aom_active_map_t (aom/aom_encoder.h): per-16x16-block activity mask;
+    # inactive blocks are forced to skip-from-reference (same contract as
+    # vpx_active_map_t — libaom kept the struct)
+    _fields_ = [
+        ("active_map", ctypes.POINTER(ctypes.c_uint8)),
+        ("rows", ctypes.c_uint),
+        ("cols", ctypes.c_uint),
+    ]
+
+
+_lib = None
+_lib_tried = False
+
+
+def _load_and_verify():
+    """Load libaom and verify every struct offset this wrapper pokes."""
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    for name in ("libaom.so.3", "libaom.so", "aom"):
+        try:
+            lib = ctypes.CDLL(name)
+            break
+        except OSError:
+            continue
+    else:
+        logger.info("libaom not found; av1enc row unavailable")
+        return None
+    lib.aom_codec_av1_cx.restype = ctypes.c_void_p
+    lib.aom_img_alloc.restype = ctypes.c_void_p
+    lib.aom_codec_get_cx_data.restype = ctypes.c_void_p
+    lib.aom_codec_encode.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_ulong, ctypes.c_long,
+    ]
+
+    # --- offset verification against config_default ground truth ------
+    iface = lib.aom_codec_av1_cx()
+    cfg = (ctypes.c_uint8 * _CFG_BYTES)()
+    if lib.aom_codec_enc_config_default(ctypes.c_void_p(iface), cfg, _AOM_USAGE_REALTIME):
+        logger.warning("aom_codec_enc_config_default failed; av1enc row disabled")
+        return None
+    w = ctypes.cast(cfg, ctypes.POINTER(ctypes.c_uint32))
+    ok = (
+        w[_OFF_G_USAGE] == _AOM_USAGE_REALTIME
+        and w[_OFF_G_W] == 320 and w[_OFF_G_H] == 240
+        and w[_OFF_TB_NUM] == 1 and w[_OFF_TB_DEN] == 30
+        and w[_OFF_LAG_IN_FRAMES] == 0          # realtime default
+        and w[_OFF_RC_END_USAGE] == _AOM_CBR    # realtime default
+        and w[_OFF_TARGET_BITRATE] == 256
+        and w[_OFF_MAX_Q] == 63
+        and w[_OFF_KF_MODE] == _AOM_KF_AUTO
+        and w[_OFF_KF_MAX_DIST] == 9999
+    )
+    if ok:
+        # verify the encoder ABI version and the aom_image_t layout with
+        # a real allocation instead of trusting the header transcription
+        ctx = (ctypes.c_uint8 * _CTX_BYTES)()
+        err = lib.aom_codec_enc_init_ver(
+            ctx, ctypes.c_void_p(iface), cfg, 0, _ENCODER_ABI_VERSION)
+        if err == 0:
+            lib.aom_codec_destroy(ctx)
+        else:
+            # ABI_MISMATCH(3) or any other init failure: the row must
+            # degrade (registry falls back to tpuh264enc), not crash the
+            # orchestrator later in LibAomEncoder.__init__
+            logger.warning("aom_codec_enc_init_ver failed (%d); av1enc row "
+                           "disabled", err)
+            ok = False
+        img = lib.aom_img_alloc(None, _AOM_IMG_FMT_I420, 320, 240, 16) if ok else None
+        if ok and img:
+            raw = ctypes.string_at(img, _IMG_STRIDE_OFF + 12)
+            fmt = _struct.unpack_from("<I", raw, _IMG_FMT_OFF)[0]
+            dw = _struct.unpack_from("<I", raw, _IMG_DW_OFF)[0]
+            dh = _struct.unpack_from("<I", raw, _IMG_DH_OFF)[0]
+            planes = _struct.unpack_from("<3Q", raw, _IMG_PLANES_OFF)
+            strides = _struct.unpack_from("<3i", raw, _IMG_STRIDE_OFF)
+            ok = (fmt == _AOM_IMG_FMT_I420 and dw == 320 and dh == 240
+                  and all(planes) and strides[0] >= 320
+                  and strides[1] >= 160 and strides[1] == strides[2])
+            lib.aom_img_free(ctypes.c_void_p(img))
+        elif ok:
+            ok = False
+    if not ok:
+        logger.warning("libaom struct layout mismatch; av1enc row disabled")
+        return None
+    _lib = lib
+    return _lib
+
+
+def libaom_available() -> bool:
+    return _load_and_verify() is not None
+
+
+class LibAomEncoder:
+    """av1enc: frame in, AV1 temporal unit (OBU stream) out.
+
+    Interface-compatible with TPUH264Encoder (pipeline/elements.py calls
+    encode_frame(frame, qp) and reads last_stats). libaom runs its own
+    CBR rate control; bitrate retunes go through set_bitrate() exactly
+    like the libvpx rows (the reference pokes `target-bitrate` the same
+    way, gstwebrtc_app.py:1370).
+    """
+
+    codec = "av1"
+
+    def __init__(self, width: int, height: int, fps: int = 60,
+                 bitrate_kbps: int = 2000, cpu_used: int = 10):
+        lib = _load_and_verify()
+        if lib is None:
+            raise RuntimeError("libaom unavailable")
+        if width % 2 or height % 2:
+            raise ValueError("4:2:0 requires even dimensions")
+        self._lib = lib
+        self.width, self.height, self.fps = width, height, fps
+        iface = lib.aom_codec_av1_cx()
+        self._cfg = (ctypes.c_uint8 * _CFG_BYTES)()
+        err = lib.aom_codec_enc_config_default(
+            ctypes.c_void_p(iface), self._cfg, _AOM_USAGE_REALTIME)
+        if err:
+            raise RuntimeError(f"aom_codec_enc_config_default: {err}")
+        w = ctypes.cast(self._cfg, ctypes.POINTER(ctypes.c_uint32))
+        self._cfg_words = w
+        w[_OFF_G_W], w[_OFF_G_H] = width, height
+        w[_OFF_TB_NUM], w[_OFF_TB_DEN] = 1, fps
+        w[_OFF_G_THREADS] = min(8, max(1, (os.cpu_count() or 4) - 1))
+        w[_OFF_LAG_IN_FRAMES] = 0
+        w[_OFF_RC_END_USAGE] = _AOM_CBR
+        w[_OFF_TARGET_BITRATE] = bitrate_kbps
+        w[_OFF_MIN_Q], w[_OFF_MAX_Q] = 2, 56
+        w[_OFF_UNDERSHOOT], w[_OFF_OVERSHOOT] = 25, 25
+        # VBV ≈ 1.5 frame-times, the reference's latency budget
+        # (gstwebrtc_app.py:100-105); libaom buf sizes are in milliseconds
+        frame_ms = 1000 // fps
+        w[_OFF_BUF_SZ] = max(frame_ms * 3 // 2, 1)
+        w[_OFF_BUF_INITIAL] = max(frame_ms, 1)
+        w[_OFF_BUF_OPTIMAL] = max(frame_ms * 5 // 4, 1)
+        # infinite GOP without AOM_KF_DISABLED (see module docstring)
+        w[_OFF_KF_MODE] = _AOM_KF_AUTO
+        w[_OFF_KF_MIN_DIST] = 0
+        w[_OFF_KF_MAX_DIST] = _KF_NEVER
+        w[_OFF_ERROR_RESILIENT] = 0
+        self._ctx = (ctypes.c_uint8 * _CTX_BYTES)()
+        err = lib.aom_codec_enc_init_ver(
+            self._ctx, ctypes.c_void_p(iface), self._cfg, 0, _ENCODER_ABI_VERSION)
+        if err:
+            raise RuntimeError(f"aom_codec_enc_init_ver: {err}")
+        # realtime speed preset (reference row's cpu-used knob)
+        if lib.aom_codec_control(self._ctx, _AOME_SET_CPUUSED,
+                                 ctypes.c_int(cpu_used)):
+            logger.warning("AOME_SET_CPUUSED rejected")
+        self._img = lib.aom_img_alloc(None, _AOM_IMG_FMT_I420, width, height, 16)
+        if not self._img:
+            raise RuntimeError("aom_img_alloc failed")
+        raw = ctypes.string_at(self._img, _IMG_STRIDE_OFF + 12)
+        self._planes = _struct.unpack_from("<3Q", raw, _IMG_PLANES_OFF)
+        self._strides = _struct.unpack_from("<3i", raw, _IMG_STRIDE_OFF)
+        self.frame_index = 0
+        self._force_idr = True
+        self._pending_bitrate: int | None = None
+        self.last_stats: FrameStats | None = None
+        self.qp = 0
+
+    def close(self) -> None:
+        if getattr(self, "_img", None):
+            self._lib.aom_img_free(ctypes.c_void_p(self._img))
+            self._img = None
+        if getattr(self, "_ctx", None) is not None:
+            self._lib.aom_codec_destroy(self._ctx)
+            self._ctx = None
+
+    def __del__(self):  # best-effort; close() is the real API
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- live retune ---------------------------------------------------
+
+    def set_active_map(self, active: np.ndarray | None) -> bool:
+        """Per-16x16-block activity mask: nonzero = encode, 0 = skip-from-
+        reference. None clears the map. The delta front-end feeds dirty
+        tiles here so libaom never runs ME/RD on unchanged blocks."""
+        mb_rows = (self.height + 15) // 16
+        mb_cols = (self.width + 15) // 16
+        m = _AomActiveMap()
+        if active is None:
+            m.active_map = None
+            m.rows, m.cols = mb_rows, mb_cols
+            buf = None
+        else:
+            if active.shape != (mb_rows, mb_cols):
+                raise ValueError(f"active map {active.shape} != {(mb_rows, mb_cols)}")
+            buf = np.ascontiguousarray(active != 0).astype(np.uint8)
+            m.active_map = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+            m.rows, m.cols = mb_rows, mb_cols
+        rc = self._lib.aom_codec_control(self._ctx, _AOME_SET_ACTIVEMAP, ctypes.byref(m))
+        del buf
+        return rc == 0
+
+    def set_bitrate(self, bitrate_kbps: int) -> None:
+        """Thread-safe: records the target; the encode thread applies it
+        before the next frame (enc_config_set must never run concurrently
+        with aom_codec_encode on the same context)."""
+        self._pending_bitrate = max(int(bitrate_kbps), 1)
+
+    def set_qp(self, qp: int) -> None:
+        """Accepted for interface parity; libaom owns its rate control."""
+
+    def force_keyframe(self) -> None:
+        self._force_idr = True
+
+    # -- encoding ------------------------------------------------------
+
+    def encode_frame(self, frame: np.ndarray, qp: int | None = None) -> bytes:
+        t0 = time.perf_counter()
+        pending = self._pending_bitrate
+        if pending is not None:
+            self._pending_bitrate = None
+            self._cfg_words[_OFF_TARGET_BITRATE] = pending
+            err = self._lib.aom_codec_enc_config_set(self._ctx, self._cfg)
+            if err:
+                logger.warning("aom_codec_enc_config_set: %d", err)
+        y, u, v = _bgrx_to_i420_np(np.asarray(frame))
+        for plane, arr, stride, rows in (
+            (self._planes[0], y, self._strides[0], self.height),
+            (self._planes[1], u, self._strides[1], self.height // 2),
+            (self._planes[2], v, self._strides[2], self.height // 2),
+        ):
+            buf = np.ctypeslib.as_array(
+                ctypes.cast(plane, ctypes.POINTER(ctypes.c_uint8)), (rows, stride))
+            buf[:, : arr.shape[1]] = arr
+        flags = _AOM_EFLAG_FORCE_KF if self._force_idr else 0
+        t1 = time.perf_counter()
+        err = self._lib.aom_codec_encode(
+            self._ctx, ctypes.c_void_p(self._img), self.frame_index, 1, flags)
+        if err:
+            raise RuntimeError(f"aom_codec_encode: {err}")
+        out = b""
+        idr = False
+        it = ctypes.c_void_p(None)
+        while True:
+            pkt = self._lib.aom_codec_get_cx_data(self._ctx, ctypes.byref(it))
+            if not pkt:
+                break
+            raw = ctypes.string_at(pkt, _PKT_READ)
+            if _struct.unpack_from("<i", raw, _PKT_KIND_OFF)[0] == 0:  # CX_FRAME
+                buf, sz = _struct.unpack_from("<QQ", raw, _PKT_BUF_OFF)
+                out += ctypes.string_at(buf, sz)
+                idr = bool(_struct.unpack_from("<I", raw, _PKT_FLAGS_OFF)[0]
+                           & _AOM_FRAME_IS_KEY)
+        t2 = time.perf_counter()
+        if idr:
+            self._force_idr = False
+        self.last_stats = FrameStats(
+            frame_index=self.frame_index,
+            idr=idr,
+            qp=self.qp,
+            bytes=len(out),
+            device_ms=(t2 - t1) * 1e3,  # "device" = libaom encode on CPU
+            pack_ms=(t1 - t0) * 1e3,    # colorspace conversion
+        )
+        self.frame_index += 1
+        return out

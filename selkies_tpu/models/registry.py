@@ -115,37 +115,71 @@ def _x264enc(*, width: int, height: int, fps: int = 60, bitrate_kbps: int = 2000
 
 
 @register("tpuav1enc")
-def _tpuav1enc(*, width: int, height: int, fps: int = 60, **kw):
-    """Codec-fallback row. AV1's adaptive CDF entropy coder depends on
-    normative default tables (spec data, not derivable from first
-    principles) and no AV1 library exists in this image, so a conformant
-    from-scratch AV1 encoder is unbuildable here. The AV1 *transport*
-    (transport/rtp_av1.py, the rtpav1pay/depay equivalent) is real; the
-    encode falls back to the from-scratch TPU H.264 encoder so a config
-    asking for AV1 gets a working session instead of a crash — the
-    reference's own policy when an encoder is missing is to fail the
-    pipeline (gstwebrtc_app.py:1123-1140); we degrade instead and log."""
-    logger.warning(
-        "tpuav1enc: no conformant AV1 encode is buildable in this image "
-        "(normative CDF tables unavailable); falling back to tpuh264enc — "
-        "the session will negotiate H.264"
-    )
-    kw.pop("bitrate_kbps", None)
-    return _FACTORIES["tpuh264enc"](width=width, height=height, fps=fps, **kw)
+def _tpuav1enc(*, width: int, height: int, fps: int = 60, bitrate_kbps: int = 2000, **kw):
+    """AV1 row with the framework's capture-delta front-end: unchanged
+    frames encode with an all-inactive active map (every block skips from
+    reference), changed frames restrict libaom's per-block work to dirty
+    tiles (see models/av1/encoder.py). Degrades to the from-scratch TPU
+    H.264 encoder only if the libaom ABI probe fails — the reference's
+    own policy when an encoder is missing is to fail the pipeline
+    (gstwebrtc_app.py:1123-1140); we degrade instead and log."""
+    from selkies_tpu.models.libaom_enc import libaom_available
+
+    if not libaom_available():
+        logger.warning("libaom unavailable; tpuav1enc falls back to tpuh264enc "
+                       "— the session will negotiate H.264")
+        kw.pop("cpu_used", None)
+        return _FACTORIES["tpuh264enc"](width=width, height=height, fps=fps, **kw)
+    from selkies_tpu.models.av1.encoder import TPUAV1Encoder
+
+    return TPUAV1Encoder(width=width, height=height, fps=fps,
+                         bitrate_kbps=bitrate_kbps, **kw)
+
+
+@register("av1enc")
+def _av1enc(*, width: int, height: int, fps: int = 60, bitrate_kbps: int = 2000, **kw):
+    """The REAL libaom software row (ctypes, reference tuning —
+    gstwebrtc_app.py:741-783); degrades to tpuav1enc's fallback chain
+    when the library/ABI probe fails (models/libaom_enc.py)."""
+    from selkies_tpu.models.libaom_enc import LibAomEncoder, libaom_available
+
+    if not libaom_available():
+        logger.warning("libaom unavailable; av1enc falls back to tpuh264enc")
+        kw.pop("cpu_used", None)  # AV1-only knob; TPUH264Encoder rejects it
+        return _FACTORIES["tpuh264enc"](width=width, height=height, fps=fps, **kw)
+    return LibAomEncoder(width=width, height=height, fps=fps,
+                         bitrate_kbps=bitrate_kbps, **kw)
+
+
+@register("x265enc")
+def _x265enc(*, width: int, height: int, fps: int = 60, bitrate_kbps: int = 2000, **kw):
+    """The REAL x265 HEVC software row (ctypes libx265, reference tuning —
+    gstwebrtc_app.py:667-683); degrades to the TPU encoder when the
+    library/ABI probe fails (models/x265enc.py)."""
+    from selkies_tpu.models.x265enc import X265Encoder, x265_available
+
+    if not x265_available():
+        logger.warning("libx265 unavailable; x265enc falls back to tpuh264enc")
+        kw.pop("preset", None)  # x265-only knob; TPUH264Encoder rejects it
+        return _FACTORIES["tpuh264enc"](width=width, height=height, fps=fps, **kw)
+    return X265Encoder(width=width, height=height, fps=fps,
+                       bitrate_kbps=bitrate_kbps,
+                       preset=kw.get("preset", "ultrafast"))
 
 
 # Legacy GStreamer encoder names (reference gstwebrtc_app.py:1133) map to
 # the TPU equivalent so existing SELKIES_ENCODER values keep working.
-# (x264enc is a REAL row above, not an alias.)
+# (x264enc / x265enc / av1enc are REAL rows above, not aliases.)
 for _legacy_h264 in ("nvh264enc", "vah264enc", "openh264enc"):
     alias(_legacy_h264, "tpuh264enc")
-# H.265 rows (reference gstwebrtc_app.py:369-424,510-542,667-683): HEVC's
-# CABAC-only entropy coding has the same unbuildable-from-scratch problem
-# as AV1's CDF coder and no HEVC library exists in this image, so the
-# names resolve to the TPU H.264 row (same latency envelope, same RTP
-# stack) rather than crashing config parsing.
-for _legacy_h265 in ("nvh265enc", "vah265enc", "x265enc"):
-    alias(_legacy_h265, "tpuh264enc")
+# H.265 silicon rows (reference gstwebrtc_app.py:369-424,510-542) map to
+# the libx265 software row — HEVC's CABAC-only entropy coding can't be
+# rebuilt from scratch here (normative context tables), so the library
+# the reference's own x265enc wraps carries the codec.
+for _legacy_h265 in ("nvh265enc", "vah265enc"):
+    alias(_legacy_h265, "x265enc")
 alias("vavp9enc", "tpuvp9enc")  # silicon VP9 row maps to the hybrid
-for _legacy_av1 in ("nvav1enc", "vaav1enc", "svtav1enc", "av1enc", "rav1enc"):
+# AV1 silicon/alternative-library rows map to the hybrid libaom row
+# (av1enc above is the REAL plain-libaom row, not an alias)
+for _legacy_av1 in ("nvav1enc", "vaav1enc", "svtav1enc", "rav1enc"):
     alias(_legacy_av1, "tpuav1enc")

@@ -254,6 +254,9 @@ class Orchestrator:
             video_bitrate_kbps=int(cfg.video_bitrate),
             congestion_control=bool(cfg.congestion_control),
         )
+        # the encoder row decides what the WebRTC plane negotiates
+        # (an AV1 row must offer AV1/90000, not H.264)
+        self.webrtc.set_codec(getattr(self.app.encoder, "codec", "h264"))
         self.audio: AudioPipeline | None = None
         if opus_available():
             self.audio = AudioPipeline(

@@ -106,8 +106,35 @@ def split_annexb(au: bytes) -> list[bytes]:
     return [x for x in nals if x]
 
 
+MTU_FLOOR = 128
+
+
+class RtpSequenceMixin:
+    """Shared payloader invariants — every codec payloader (H.264 here,
+    H.265/AV1/VP8/VP9 in their modules) draws the 16-bit sequence
+    counter and the MTU floor from this one implementation so policy
+    changes land once.
+
+    The MTU floor exists because every payloader sizes fragments as
+    `mtu - reserve - descriptor` with no lower bound; a toy MTU would
+    drive that non-positive and mis-slice (RFC 3550 transports never go
+    below ~576 anyway)."""
+
+    sequence: int
+    mtu: int
+
+    def __post_init__(self) -> None:
+        if self.mtu < MTU_FLOOR:
+            raise ValueError(f"mtu {self.mtu} below the {MTU_FLOOR}-byte floor")
+
+    def _next_seq(self) -> int:
+        s = self.sequence
+        self.sequence = (self.sequence + 1) & 0xFFFF
+        return s
+
+
 @dataclass
-class H264Payloader:
+class H264Payloader(RtpSequenceMixin):
     """Annex-B access units → RTP packets (single NAL / STAP-A / FU-A)."""
 
     payload_type: int = 102
@@ -153,11 +180,6 @@ class H264Payloader:
         if packets:
             packets[-1].marker = True
         return packets
-
-    def _next_seq(self) -> int:
-        s = self.sequence
-        self.sequence = (self.sequence + 1) & 0xFFFF
-        return s
 
     def _single(self, nal: bytes, ts: int) -> RtpPacket:
         return RtpPacket(self.payload_type, self._next_seq(), ts, self.ssrc, nal)

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-from selkies_tpu.transport.rtp import MTU_DEFAULT, RtpPacket
+from selkies_tpu.transport.rtp import MTU_DEFAULT, RtpPacket, RtpSequenceMixin
 
 __all__ = ["Av1Payloader", "Av1Depayloader", "leb128_encode", "leb128_decode",
            "split_obus", "obu_type"]
@@ -113,7 +113,7 @@ def _agg_header(z: bool, y: bool, w: int, n: bool) -> bytes:
 
 
 @dataclass
-class Av1Payloader:
+class Av1Payloader(RtpSequenceMixin):
     """OBU temporal units → RTP packets (rtpav1pay equivalent)."""
 
     payload_type: int = 45
@@ -121,10 +121,13 @@ class Av1Payloader:
     mtu: int = MTU_DEFAULT
     sequence: int = 0
 
-    def _next_seq(self) -> int:
-        s = self.sequence
-        self.sequence = (self.sequence + 1) & 0xFFFF
-        return s
+    def payload_au(self, au: bytes, timestamp: int) -> list[RtpPacket]:
+        """H264Payloader-compatible facade (peer.py calls payload_au on
+        whatever payloader the codec selected): a TU carrying a sequence
+        header OBU starts a new coded video sequence -> N bit set."""
+        raw = split_obus(au)
+        new_seq = any(obu_type(o) == OBU_SEQUENCE_HEADER for o in raw)
+        return self._payload(raw, timestamp, new_seq)
 
     def payload_tu(self, tu: bytes, timestamp: int,
                    new_sequence: bool = False) -> list[RtpPacket]:
@@ -134,7 +137,11 @@ class Av1Payloader:
         first TU of a coded video sequence (keyframe with sequence header).
         The last packet carries the RTP marker.
         """
-        obus = [_strip_size_field(o) for o in split_obus(tu)
+        return self._payload(split_obus(tu), timestamp, new_sequence)
+
+    def _payload(self, raw_obus: list[bytes], timestamp: int,
+                 new_sequence: bool) -> list[RtpPacket]:
+        obus = [_strip_size_field(o) for o in raw_obus
                 if obu_type(o) != OBU_TEMPORAL_DELIMITER]
         if not obus:
             return []

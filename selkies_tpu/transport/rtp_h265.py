@@ -1,0 +1,146 @@
+"""RTP packetization for HEVC (RFC 7798) — the rtph265pay/depay
+equivalent (reference chain: x265enc ! h265parse ! rtph265pay,
+gstwebrtc_app.py:848-871; mtu=1200, config-interval -1 semantics come
+from the encoder's repeat-headers, so VPS/SPS/PPS ride every IDR AU).
+
+HEVC NAL units carry a 2-byte header — F(1) Type(6) LayerId(6) TID(3) —
+so aggregation packets (AP, type 48) and fragmentation units (FU, type
+49) differ from RFC 6184's STAP-A/FU-A in header layout but not shape.
+The wire-overhead reserve matches transport/rtp.py's H.264 payloader
+(RTP header + TWCC extension + RED byte + SRTP tag + ULP FEC slack).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+from selkies_tpu.transport.rtp import (
+    MTU_DEFAULT, RtpPacket, RtpSequenceMixin, split_annexb,
+)
+
+__all__ = ["H265Payloader", "H265Depayloader"]
+
+NAL_VPS, NAL_SPS, NAL_PPS = 32, 33, 34
+NAL_AP, NAL_FU = 48, 49
+
+
+def nal_type(nal: bytes) -> int:
+    return (nal[0] >> 1) & 0x3F
+
+
+def _is_param_set(nal: bytes) -> bool:
+    return nal_type(nal) in (NAL_VPS, NAL_SPS, NAL_PPS)
+
+
+@dataclass
+class H265Payloader(RtpSequenceMixin):
+    """Annex-B HEVC access units → RTP packets (single NAL / AP / FU)."""
+
+    payload_type: int = 103
+    ssrc: int = 0x53454C48  # 'SELH'
+    mtu: int = MTU_DEFAULT
+    sequence: int = 0
+
+    def payload_au(self, au: bytes, timestamp: int) -> list[RtpPacket]:
+        """Packetize one access unit; the last packet carries the marker."""
+        nals = split_annexb(au)
+        packets: list[RtpPacket] = []
+        max_payload = self.mtu - 54  # same reserve as rtp.py (FEC-safe)
+
+        params: list[bytes] = []
+        for nal in nals:
+            if _is_param_set(nal) and len(nal) < 200:
+                params.append(nal)  # aggregate VPS/SPS/PPS onto the IDR
+                continue
+            if params:
+                ap_total = 2 + sum(len(x) + 2 for x in params) + len(nal) + 2
+                if ap_total <= max_payload:
+                    packets.append(self._ap(params + [nal], timestamp))
+                else:
+                    if len(params) > 1:
+                        packets.append(self._ap(params, timestamp))
+                    else:
+                        packets.append(self._single(params[0], timestamp))
+                    packets.extend(self._fragment(nal, timestamp, max_payload))
+                params = []
+                continue
+            packets.extend(self._fragment(nal, timestamp, max_payload))
+        if params:  # AU was only parameter sets
+            packets.append(self._ap(params, timestamp) if len(params) > 1
+                           else self._single(params[0], timestamp))
+        if packets:
+            packets[-1].marker = True
+        return packets
+
+    def _single(self, nal: bytes, ts: int) -> RtpPacket:
+        return RtpPacket(self.payload_type, self._next_seq(), ts, self.ssrc, nal)
+
+    def _ap(self, nals: list[bytes], ts: int) -> RtpPacket:
+        # AP PayloadHdr: type=48; LayerId/TID take the minimum across the
+        # aggregated NALs (RFC 7798 §4.4.2)
+        layer_tid = min(struct.unpack("!H", n[:2])[0] & 0x01FF for n in nals)
+        hdr = struct.pack("!H", (NAL_AP << 9) | layer_tid)
+        payload = hdr + b"".join(
+            struct.pack("!H", len(n)) + n for n in nals)
+        return RtpPacket(self.payload_type, self._next_seq(), ts, self.ssrc, payload)
+
+    def _fragment(self, nal: bytes, ts: int, max_payload: int) -> list[RtpPacket]:
+        if len(nal) <= max_payload:
+            return [self._single(nal, ts)]
+        first_word = struct.unpack("!H", nal[:2])[0]
+        ntype = (first_word >> 9) & 0x3F
+        fu_payload_hdr = struct.pack(
+            "!H", (first_word & ~(0x3F << 9)) | (NAL_FU << 9))
+        chunk = max_payload - 3  # 2-byte PayloadHdr + 1-byte FU header
+        data = nal[2:]
+        out = []
+        for i in range(0, len(data), chunk):
+            part = data[i: i + chunk]
+            s = 0x80 if i == 0 else 0
+            e = 0x40 if i + chunk >= len(data) else 0
+            out.append(RtpPacket(
+                self.payload_type, self._next_seq(), ts, self.ssrc,
+                fu_payload_hdr + bytes([s | e | ntype]) + part,
+            ))
+        return out
+
+
+class H265Depayloader:
+    """RTP packets → Annex-B access units (for tests and the loopback
+    client; rtph265depay equivalent)."""
+
+    def __init__(self) -> None:
+        self._fu: bytearray | None = None
+        self._au: list[bytes] = []
+
+    def push(self, pkt: RtpPacket) -> bytes | None:
+        """Feed one packet; returns a complete AU when the marker arrives."""
+        p = pkt.payload
+        if len(p) < 2:
+            return None
+        ntype = (p[0] >> 1) & 0x3F
+        if ntype == NAL_AP:
+            i = 2
+            while i + 2 <= len(p):
+                (ln,) = struct.unpack("!H", p[i: i + 2])
+                self._au.append(p[i + 2: i + 2 + ln])
+                i += 2 + ln
+        elif ntype == NAL_FU:
+            fu_hdr = p[2]
+            if fu_hdr & 0x80:  # start: rebuild the original NAL header
+                word = struct.unpack("!H", p[:2])[0]
+                orig = (word & ~(0x3F << 9)) | ((fu_hdr & 0x3F) << 9)
+                self._fu = bytearray(struct.pack("!H", orig))
+            if self._fu is not None:
+                self._fu.extend(p[3:])
+                if fu_hdr & 0x40:  # end
+                    self._au.append(bytes(self._fu))
+                    self._fu = None
+        else:
+            self._au.append(p)
+        if pkt.marker:
+            au = b"".join(b"\x00\x00\x00\x01" + n for n in self._au)
+            self._au = []
+            return au if au else None
+        return None

@@ -1,0 +1,180 @@
+"""RTP packetization for VP8 (RFC 7741) and VP9
+(draft-ietf-payload-vp9) — the rtpvp8pay/rtpvp9pay equivalents
+(reference chain: vp8enc/vp9enc ! rtpvp8pay/rtpvp9pay,
+gstwebrtc_app.py:685-722, 873-915).
+
+Both codecs ship whole frames (no NAL structure): the payloader
+fragments the frame across packets behind a small payload descriptor.
+Keyframe detection reads the codec's own uncompressed header — VP8's
+first byte carries frame_type in bit 0 (keyframe=0); VP9's carries
+frame_marker/profile/show_existing/frame_type bits (see _vp9_is_key).
+The wire-overhead reserve matches transport/rtp.py's H.264 payloader.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+from selkies_tpu.transport.rtp import MTU_DEFAULT, RtpPacket, RtpSequenceMixin
+
+__all__ = ["Vp8Payloader", "Vp9Payloader", "Vp8Depayloader", "Vp9Depayloader"]
+
+
+def vp8_is_keyframe(frame: bytes) -> bool:
+    # VP8 frame tag (RFC 6386 §9.1): bit 0 of byte 0 is frame_type,
+    # 0 = key frame
+    return bool(frame) and not frame[0] & 0x01
+
+
+def vp9_is_keyframe(frame: bytes) -> bool:
+    """VP9 uncompressed header (spec 6.2): frame_marker(2)=0b10,
+    profile_low(1), profile_high(1), then (profile<3):
+    show_existing_frame(1), frame_type(1) with 0 = key."""
+    if not frame:
+        return False
+    b0 = frame[0]
+    if b0 >> 6 != 0b10:
+        return False
+    profile = ((b0 >> 5) & 1) | (((b0 >> 4) & 1) << 1)
+    if profile == 3:
+        # reserved bit shifts the layout; profile 3 is 4:4:4 12-bit —
+        # not produced by this framework's rows
+        return False
+    if (b0 >> 3) & 1:  # show_existing_frame
+        return False
+    return not (b0 >> 2) & 1
+
+
+@dataclass
+class Vp8Payloader(RtpSequenceMixin):
+    """VP8 frames → RTP packets (RFC 7741).
+
+    Descriptor: X=1 with a 15-bit PictureID (libwebrtc's jitter buffer
+    uses it for frame continuity across loss), S=1 on the first packet
+    of a frame, PID(partition)=0 — the non-aggregated layout every
+    browser accepts."""
+
+    payload_type: int = 97
+    ssrc: int = 0x53454C38  # 'SEL8'
+    mtu: int = MTU_DEFAULT
+    sequence: int = 0
+    picture_id: int = 0
+
+    def payload_au(self, frame: bytes, timestamp: int) -> list[RtpPacket]:
+        if not frame:
+            return []
+        max_payload = self.mtu - 54 - 4  # descriptor: 1 + X byte + 2 PID
+        pid = self.picture_id
+        self.picture_id = (self.picture_id + 1) & 0x7FFF
+        out = []
+        for i in range(0, len(frame), max_payload):
+            first = i == 0
+            desc = bytes([0x80 | (0x10 if first else 0)])  # X=1, S, PID=0
+            desc += bytes([0x80])                          # I=1
+            desc += struct.pack("!H", 0x8000 | pid)        # M=1, 15-bit ID
+            out.append(RtpPacket(
+                self.payload_type, self._next_seq(), timestamp, self.ssrc,
+                desc + frame[i: i + max_payload]))
+        out[-1].marker = True
+        return out
+
+
+@dataclass
+class Vp9Payloader(RtpSequenceMixin):
+    """VP9 frames → RTP packets (draft-ietf-payload-vp9, non-flexible
+    mode): I=1 15-bit PictureID, P set on inter frames, B/E mark frame
+    boundaries."""
+
+    payload_type: int = 98
+    ssrc: int = 0x53454C39  # 'SEL9'
+    mtu: int = MTU_DEFAULT
+    sequence: int = 0
+    picture_id: int = 0
+
+    def payload_au(self, frame: bytes, timestamp: int) -> list[RtpPacket]:
+        if not frame:
+            return []
+        max_payload = self.mtu - 54 - 3  # descriptor: 1 + 2-byte PID
+        inter = 0x40 if not vp9_is_keyframe(frame) else 0
+        pid = self.picture_id
+        self.picture_id = (self.picture_id + 1) & 0x7FFF
+        chunks = [frame[i: i + max_payload]
+                  for i in range(0, len(frame), max_payload)]
+        out = []
+        for i, chunk in enumerate(chunks):
+            b = 0x08 if i == 0 else 0                 # B: frame start
+            e = 0x04 if i == len(chunks) - 1 else 0   # E: frame end
+            desc = bytes([0x80 | inter | b | e])      # I=1
+            desc += struct.pack("!H", 0x8000 | pid)   # M=1, 15-bit ID
+            out.append(RtpPacket(
+                self.payload_type, self._next_seq(), timestamp, self.ssrc,
+                desc + chunk))
+        out[-1].marker = True
+        return out
+
+
+class _VpxDepayloader:
+    """Common fragment reassembly: descriptor length is codec-specific."""
+
+    def __init__(self) -> None:
+        self._frame = bytearray()
+
+    def _desc_len(self, p: bytes) -> int:
+        raise NotImplementedError
+
+    def push(self, pkt: RtpPacket) -> bytes | None:
+        p = pkt.payload
+        if not p:
+            return None
+        self._frame.extend(p[self._desc_len(p):])
+        if pkt.marker:
+            frame = bytes(self._frame)
+            self._frame = bytearray()
+            return frame
+        return None
+
+
+class Vp8Depayloader(_VpxDepayloader):
+    def _desc_len(self, p: bytes) -> int:
+        n = 1
+        if p[0] & 0x80:  # X
+            x = p[n]
+            n += 1
+            if x & 0x80:  # I: PictureID
+                n += 2 if p[n] & 0x80 else 1
+            if x & 0x40:  # L: TL0PICIDX
+                n += 1
+            if x & 0x30:  # T/K: TID/KEYIDX byte
+                n += 1
+        return n
+
+
+class Vp9Depayloader(_VpxDepayloader):
+    def _desc_len(self, p: bytes) -> int:
+        b0 = p[0]
+        n = 1
+        if b0 & 0x80:  # I: PictureID
+            n += 2 if p[n] & 0x80 else 1
+        if b0 & 0x20:  # L: layer indices (non-flexible adds TL0PICIDX)
+            n += 1
+            if not b0 & 0x10:  # F=0
+                n += 1
+        if b0 & 0x10 and b0 & 0x40:  # F and P: P_DIFF chain
+            while p[n] & 0x01:
+                n += 1
+            n += 1
+        if b0 & 0x02:  # V: scalability structure — parse and skip
+            ss = p[n]
+            n += 1
+            n_s = (ss >> 5) + 1
+            if ss & 0x10:  # Y: each layer has W/H
+                n += 4 * n_s
+            if ss & 0x08:  # G: picture group
+                n_g = p[n]
+                n += 1
+                for _ in range(n_g):
+                    g = p[n]
+                    n += 1
+                    n += (g >> 2) & 0x3  # R reference indices
+        return n

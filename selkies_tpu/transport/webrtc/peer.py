@@ -60,8 +60,27 @@ class PeerConnection:
         self.sctp: SctpAssociation | None = None
         self.video_ssrc = struct.unpack("!I", secrets.token_bytes(4))[0] | 1
         self.audio_ssrc = (self.video_ssrc + 1) & 0xFFFFFFFF
-        self.video_pay = H264Payloader(
-            payload_type=sdp.VIDEO_PT, ssrc=self.video_ssrc)
+        if codec == "av1":
+            # rtpav1pay equivalent (reference gstwebrtc_app.py:917-938)
+            from selkies_tpu.transport.rtp_av1 import Av1Payloader
+
+            self.video_pay = Av1Payloader(
+                payload_type=sdp.VIDEO_PT, ssrc=self.video_ssrc)
+        elif codec == "h265":
+            # rtph265pay equivalent (reference gstwebrtc_app.py:848-871)
+            from selkies_tpu.transport.rtp_h265 import H265Payloader
+
+            self.video_pay = H265Payloader(
+                payload_type=sdp.VIDEO_PT, ssrc=self.video_ssrc)
+        elif codec in ("vp8", "vp9"):
+            # rtpvp8pay/rtpvp9pay equivalents (gstwebrtc_app.py:873-915)
+            from selkies_tpu.transport.rtp_vpx import Vp8Payloader, Vp9Payloader
+
+            cls = Vp8Payloader if codec == "vp8" else Vp9Payloader
+            self.video_pay = cls(payload_type=sdp.VIDEO_PT, ssrc=self.video_ssrc)
+        else:
+            self.video_pay = H264Payloader(
+                payload_type=sdp.VIDEO_PT, ssrc=self.video_ssrc)
         self.audio_pay = OpusPayloader(
             payload_type=sdp.AUDIO_PT, ssrc=self.audio_ssrc)
         self._remote: sdp.RemoteDescription | None = None
@@ -107,7 +126,7 @@ class PeerConnection:
         )
 
     async def set_answer(self, answer_sdp: str) -> None:
-        r = sdp.parse_answer(answer_sdp)
+        r = sdp.parse_answer(answer_sdp, prefer=self.codec)
         # An answer without ICE credentials can never connect, and one
         # without a DTLS fingerprint could never be authenticated: fail
         # loudly now (the transport turns this into a clean teardown)
@@ -117,6 +136,28 @@ class PeerConnection:
                                           ("fingerprint", r.fingerprint)) if not val]
         if missing:
             raise ValueError(f"SDP answer missing required attributes: {missing}")
+        if r.video_codec is not None and r.video_codec != self.codec:
+            # the browser refused the offered codec (e.g. H.265 in a
+            # browser without HEVC WebRTC support): streaming our codec
+            # into its decoder would yield a silently black session —
+            # fail now so the orchestrator can tear down / fall back
+            raise ValueError(
+                f"browser answered codec {r.video_codec!r}, offer was "
+                f"{self.codec!r}; refusing mismatched media session")
+        if r.video_pt is None and "m=video" in answer_sdp:
+            # rejected video m-line (JSEP port 0 — parse_answer ignores
+            # rtpmaps echoed inside a rejected section — or no rtpmap at
+            # all): same black session by a different route
+            reason = ("rejected the video section (port 0)"
+                      if r.video_rejected else "carries no video codec")
+            raise ValueError(
+                f"answer {reason} for offered {self.codec!r}; "
+                "refusing media session")
+        if r.video_pt is not None:
+            # pay with the PT the answer actually negotiated, not the
+            # static offer PT (browsers normally echo it, but RFC 3264
+            # lets the answer re-number)
+            self.video_pay.payload_type = r.video_pt
         self._remote = r
         if r.twcc_id is not None:
             self._twcc_id = r.twcc_id
@@ -297,8 +338,9 @@ class PeerConnection:
         self._last_video_ts = ts
         for pkt in self.video_pay.payload_au(au, ts):
             if self._fec is not None:
-                # RED-encapsulate the media (single block, inner PT = codec)
-                pkt.payload = fec.red_wrap(sdp.VIDEO_PT, pkt.payload)
+                # RED-encapsulate the media (single block, inner PT = the
+                # negotiated codec PT, which set_answer may have renumbered)
+                pkt.payload = fec.red_wrap(self.video_pay.payload_type, pkt.payload)
                 pkt.payload_type = self._red_pt
             wire = self._send_rtp(pkt, audio_stream=False)
             if self._fec is not None and wire is not None:

@@ -27,12 +27,23 @@ H264_FMTP = ("level-asymmetry-allowed=1;packetization-mode=1;"
 VP8_FMTP = ""
 VP9_FMTP = "profile-id=0"
 
+# AV1 level 5.1 (seq_level_idx 13): MaxDisplayRate covers 1080p60
+# (124.4 Mpx/s needs ≥ 5.0) and 4K30 (248 Mpx/s needs 5.1)
+AV1_FMTP = "level-idx=13;profile=0;tier=0"
+# RFC 7798 §7.1: level-id 123 = level 4.1 (max luma rate 133.7 Ms/s ≥
+# 1080p60's 124.4); sprop parameter sets ride in-band (repeat-headers),
+# matching the H.264 row's sps-pps-idr-in-keyframe approach
+H265_FMTP = "level-id=123;tx-mode=SRST"
+
 CODEC_RTPMAP = {
     "h264": f"{VIDEO_PT} H264/90000",
     "vp8": f"{VIDEO_PT} VP8/90000",
     "vp9": f"{VIDEO_PT} VP9/90000",
+    "av1": f"{VIDEO_PT} AV1/90000",
+    "h265": f"{VIDEO_PT} H265/90000",
 }
-CODEC_FMTP = {"h264": H264_FMTP, "vp8": VP8_FMTP, "vp9": VP9_FMTP}
+CODEC_FMTP = {"h264": H264_FMTP, "vp8": VP8_FMTP, "vp9": VP9_FMTP,
+              "av1": AV1_FMTP, "h265": H265_FMTP}
 
 
 def build_offer(*, ice_ufrag: str, ice_pwd: str, fingerprint: str,
@@ -126,20 +137,44 @@ class RemoteDescription:
     twcc_id: int | None = None
     playout_delay_id: int | None = None
     sctp_port: int = 5000
-    # AV1 rtpmap matched video_pt only as a fallback (no preferred codec
-    # seen yet); a later H264/VP8/VP9 line overrides it
-    _video_is_av1: bool = False
+    # lowercase codec name of the chosen video_pt ("h264"/"vp8"/"vp9"/
+    # "av1"/"h265"); peer.py compares it against the offered codec and
+    # fails the session loudly on a mismatch
+    video_codec: str | None = None
+    # JSEP rejection: the answer carried "m=video 0 ..." (libwebrtc still
+    # echoes the offered rtpmaps inside a rejected section, so video_pt
+    # stays None and peer.py refuses the session)
+    video_rejected: bool = False
 
 
-def parse_answer(sdp: str) -> RemoteDescription:
+def parse_answer(sdp: str, prefer: str = "h264") -> RemoteDescription:
     """Extract what the transport needs from the browser's answer.
 
     Session-level attributes apply to every m-section; the first
-    media-level occurrence wins otherwise (BUNDLE shares one transport)."""
+    media-level occurrence wins otherwise (BUNDLE shares one transport).
+    `prefer` is the codec the offer carried: an AV1/H.265 session must
+    pick that payload type even if the answer also lists H.264/VP8/VP9
+    (and vice versa — an answer listing AV1 first must not shadow an
+    H.264 session's PT)."""
     r = RemoteDescription()
+    prefer_token = {
+        "h264": "H264/", "vp8": "VP8/", "vp9": "VP9/",
+        "av1": "AV1/", "h265": "H265/",
+    }.get(prefer.lower(), "H264/")
+    video_tokens = ("H264/", "VP8/", "VP9/", "AV1/", "H265/")
+    preferred_seen = False
+    in_rejected_video = False
     current_rtpmaps: dict[int, str] = {}
     for raw in sdp.replace("\r\n", "\n").split("\n"):
         line = raw.strip()
+        if line.startswith("m="):
+            # JSEP rejects an m-section by setting its port to 0; any
+            # rtpmaps echoed inside it must not negotiate the codec
+            parts = line.split()
+            in_rejected_video = (line.startswith("m=video")
+                                 and len(parts) >= 2 and parts[1] == "0")
+            if in_rejected_video:
+                r.video_rejected = True
         if line.startswith("a=ice-ufrag:") and not r.ice_ufrag:
             r.ice_ufrag = line.split(":", 1)[1]
         elif line.startswith("a=ice-pwd:") and not r.ice_pwd:
@@ -154,15 +189,19 @@ def parse_answer(sdp: str) -> RemoteDescription:
             body = line[len("a=rtpmap:"):]
             pt, enc = body.split(" ", 1)
             current_rtpmaps[int(pt)] = enc
-            if enc.upper().startswith(("H264/", "VP8/", "VP9/")):
-                if r.video_pt is None or r._video_is_av1:
+            token = next((t for t in video_tokens
+                          if enc.upper().startswith(t)), None)
+            if token is not None and not in_rejected_video:
+                if token == prefer_token and not preferred_seen:
                     r.video_pt = int(pt)
-                    r._video_is_av1 = False
-            elif enc.upper().startswith("AV1/") and r.video_pt is None:
-                # fallback only: the transport pays H.264/VP8/VP9 today;
-                # an answer listing AV1 first must not shadow those PTs
-                r.video_pt = int(pt)
-                r._video_is_av1 = True
+                    r.video_codec = token[:-1].lower()
+                    preferred_seen = True
+                elif r.video_pt is None:
+                    # fallback: the offered codec is missing from the
+                    # answer; record what the browser gave us so the
+                    # peer can refuse the session with a clear error
+                    r.video_pt = int(pt)
+                    r.video_codec = token[:-1].lower()
             elif enc.lower().startswith("red/") and r.red_pt is None:
                 r.red_pt = int(pt)
             elif enc.lower().startswith("ulpfec/") and r.ulpfec_pt is None:

@@ -56,6 +56,12 @@ class WebRTCTransport:
     def connected(self) -> bool:
         return self.pc is not None and self.pc.connected
 
+    def set_codec(self, codec: str) -> None:
+        """Pick the negotiated codec (and thereby the RTP payloader) for
+        future sessions — the orchestrator calls this once the encoder
+        row is built, so an AV1 encoder negotiates AV1, not H.264."""
+        self._kw["codec"] = codec
+
     def set_ice_servers(self, *, stun_server=None, turn_server=None,
                         turn_username: str = "", turn_password: str = "",
                         turn_transport: str = "udp") -> None:

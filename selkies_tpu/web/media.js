@@ -15,6 +15,8 @@ const CODEC_STRINGS = {
   h264: "avc1.42E01F",         // constrained baseline (matches the SPS)
   vp9: "vp09.00.41.08",        // profile 0, level 4.1 (covers 1080p60), 8-bit
   vp8: "vp8",
+  av1: "av01.0.13M.08",        // profile 0, level 5.1 (1080p60 + 4K30), 8-bit
+  h265: "hvc1.1.6.L123.00",    // Main profile, level 4.1 (1080p60)
 };
 
 class SelkiesMedia {

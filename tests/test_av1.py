@@ -1,0 +1,212 @@
+"""The REAL AV1 rows: ctypes libaom encode, capture-delta hybrid front-end,
+and conformance via ctypes libdav1d — an independent decoder (this image's
+FFmpeg has no software AV1 decode). Also drives transport/rtp_av1.py with
+real OBU streams so the payloader is exercised by production bits, not
+synthetic fixtures (reference chain: av1enc ! rtpav1pay,
+gstwebrtc_app.py:741-783, 917-938)."""
+
+import numpy as np
+import pytest
+
+from selkies_tpu.models.libaom_enc import libaom_available
+
+pytestmark = pytest.mark.skipif(not libaom_available(), reason="libaom not present")
+
+W, H = 320, 192
+
+
+def _dav1d():
+    from selkies_tpu.models.av1.dav1d import dav1d_available
+
+    if not dav1d_available():
+        pytest.skip("libdav1d not present")
+    from selkies_tpu.models.av1.dav1d import Dav1dDecoder
+
+    return Dav1dDecoder()
+
+
+def _trace(n=8, w=W, h=H, static=(2, 3, 6)):
+    rng = np.random.default_rng(5)
+    base = np.kron(rng.integers(40, 200, (h // 16, w // 16, 4), np.uint8),
+                   np.ones((16, 16, 1), np.uint8))
+    frames = []
+    cur = base.copy()
+    for i in range(n):
+        if i not in static:
+            cur = cur.copy()
+            cur[40:56, 40:200, :3] = rng.integers(0, 255, (16, 160, 1), np.uint8)
+        frames.append(cur)
+    return frames
+
+
+def _luma(frame_bgrx: np.ndarray) -> np.ndarray:
+    from selkies_tpu.models.libvpx_enc import _bgrx_to_i420_np
+
+    return _bgrx_to_i420_np(frame_bgrx)[0].astype(float)
+
+
+def test_libaom_round_trip_decodes_and_tracks_source():
+    from selkies_tpu.models.libaom_enc import LibAomEncoder
+
+    frames = _trace(6, static=())
+    enc = LibAomEncoder(W, H, fps=30, bitrate_kbps=3000)
+    aus = [enc.encode_frame(f) for f in frames]
+    assert enc.last_stats is not None and enc.last_stats.bytes == len(aus[-1])
+    enc.close()
+    assert all(aus), "every frame must produce a temporal unit"
+
+    dec = _dav1d()
+    decoded = []
+    for au in aus:
+        decoded += dec.decode(au)
+    decoded += dec.flush()
+    dec.close()
+    assert len(decoded) == len(frames)
+    for f, (y, u, v) in zip(frames, decoded):
+        assert y.shape == (H, W)
+        src = _luma(f)
+        psnr = 10 * np.log10(255**2 / max(1e-9, np.mean((src - y.astype(float)) ** 2)))
+        assert psnr > 28, f"PSNR {psnr:.1f} too low for 3 Mbps"
+
+
+def test_forced_keyframe_mid_stream():
+    from selkies_tpu.models.libaom_enc import LibAomEncoder
+
+    frames = _trace(6, static=())
+    enc = LibAomEncoder(W, H, fps=30, bitrate_kbps=2000)
+    stats = []
+    for i, f in enumerate(frames):
+        if i == 3:
+            enc.force_keyframe()
+        enc.encode_frame(f)
+        stats.append(enc.last_stats.idr)
+    enc.close()
+    assert stats[0] is True
+    assert stats[3] is True
+    assert stats[1] is False and stats[2] is False
+
+
+def test_hybrid_static_frames_cheap_and_do_not_drift():
+    from selkies_tpu.models.av1.encoder import TPUAV1Encoder
+
+    frames = _trace(8)
+    enc = TPUAV1Encoder(W, H, fps=30, bitrate_kbps=3000)
+    aus = [enc.encode_frame(f) for f in frames]
+    enc.close()
+    assert enc.static_frames == 3
+    assert enc.active_map_frames >= 1
+    # frame 1 is a real inter frame, so frames 2/3/6 ride the 5-byte
+    # show_existing_frame path (TD OBU + 1-byte frame header OBU)
+    for i in (2, 3, 6):
+        assert len(aus[i]) == 5, (
+            f"static frame {i} ({len(aus[i])}B) should be a re-show TU")
+
+    dec = _dav1d()
+    decoded = []
+    for au in aus:
+        decoded += dec.decode(au)
+    decoded += dec.flush()
+    dec.close()
+    assert len(decoded) == len(frames)
+    for i in (2, 3, 6):
+        # static frames must be pixel-identical to their predecessor
+        np.testing.assert_array_equal(decoded[i][0], decoded[i - 1][0])
+    # active-map frames must track the source in the dirty region
+    for i in (1, 4, 5, 7):
+        src = _luma(frames[i])[40:56, 40:200]
+        got = decoded[i][0][40:56, 40:200].astype(float)
+        psnr = 10 * np.log10(255**2 / max(1e-9, np.mean((src - got) ** 2)))
+        assert psnr > 24, f"frame {i} dirty-region PSNR {psnr:.1f}"
+    # ...and must not drift in the untouched region
+    for i in (1, 4, 5, 7):
+        still = decoded[i][0][100:, :]
+        prev = decoded[i - 1][0][100:, :]
+        assert float(np.abs(still.astype(int) - prev.astype(int)).mean()) < 2.0
+
+
+def test_hybrid_keyframe_resets_delta_state():
+    from selkies_tpu.models.av1.encoder import TPUAV1Encoder
+
+    frames = _trace(4, static=(1, 2, 3))
+    enc = TPUAV1Encoder(W, H, fps=30, bitrate_kbps=2000)
+    enc.encode_frame(frames[0])
+    enc.encode_frame(frames[1])
+    assert enc.static_frames == 1
+    enc.force_keyframe()
+    au = enc.encode_frame(frames[2])  # unchanged capture, but IDR forced
+    assert enc.last_stats.idr is True
+    assert len(au) > 500, "forced IDR must re-encode, not skip"
+    enc.close()
+
+
+def test_rtp_av1_payloader_carries_real_stream():
+    """transport/rtp_av1.py fed by production libaom output: payload,
+    depayload, decode — the full rtpav1pay/depay path on real bits."""
+    from selkies_tpu.models.av1.encoder import TPUAV1Encoder
+    from selkies_tpu.transport.rtp_av1 import Av1Depayloader, Av1Payloader
+
+    frames = _trace(6)
+    enc = TPUAV1Encoder(W, H, fps=30, bitrate_kbps=3000)
+    aus = [enc.encode_frame(f) for f in frames]
+    enc.close()
+
+    pay = Av1Payloader(payload_type=45, ssrc=0xABC)
+    depay = Av1Depayloader()
+    out = []
+    for i, au in enumerate(aus):
+        pkts = pay.payload_tu(au, timestamp=i * 3000, new_sequence=(i == 0))
+        assert pkts, f"TU {i} produced no packets"
+        assert pkts[-1].marker
+        for p in pkts:
+            tu = depay.push(p)
+            if tu is not None:
+                out.append(tu)
+    assert len(out) == len(aus)
+
+    dec = _dav1d()
+    decoded = []
+    for tu in out:
+        decoded += dec.decode(tu)
+    decoded += dec.flush()
+    dec.close()
+    assert len(decoded) == len(frames)
+    src = _luma(frames[-1])
+    y = decoded[-1][0].astype(float)
+    psnr = 10 * np.log10(255**2 / max(1e-9, np.mean((src - y) ** 2)))
+    assert psnr > 28
+
+
+def test_registry_av1_rows_are_real():
+    from selkies_tpu.models.registry import create_encoder, supported_encoders
+
+    assert "av1enc" in supported_encoders()
+    assert "tpuav1enc" in supported_encoders()
+    enc = create_encoder("tpuav1enc", width=W, height=H, fps=30)
+    try:
+        assert enc.codec == "av1"
+        au = enc.encode_frame(_trace(1)[0])
+        assert len(au) > 100
+    finally:
+        enc.close()
+    # legacy silicon names keep resolving
+    enc2 = create_encoder("svtav1enc", width=W, height=H, fps=30)
+    try:
+        assert enc2.codec == "av1"
+    finally:
+        enc2.close()
+
+
+def test_bitrate_retune_applies():
+    from selkies_tpu.models.libaom_enc import LibAomEncoder
+
+    frames = _trace(12, static=())
+    lo = LibAomEncoder(W, H, fps=30, bitrate_kbps=400)
+    hi_bytes, lo_bytes = 0, 0
+    lo.set_bitrate(6000)
+    for f in frames[:6]:
+        hi_bytes += len(lo.encode_frame(f))
+    lo.set_bitrate(300)
+    for f in frames[6:]:
+        lo_bytes += len(lo.encode_frame(f))
+    lo.close()
+    assert hi_bytes > lo_bytes, (hi_bytes, lo_bytes)

@@ -133,8 +133,9 @@ def test_lost_packet_drops_truncated_tu():
 
 def test_registry_h265_and_av1_names_resolve(monkeypatch):
     """Every name in the reference's supported list resolves functionally
-    (gstwebrtc_app.py:1133): H.265 and AV1 rows degrade to the TPU H.264
-    encoder instead of crashing config parsing."""
+    (gstwebrtc_app.py:1133). The H.265 and AV1 rows are REAL since round
+    4 (ctypes libx265 / libaom — tests/test_h265.py, test_av1.py); they
+    degrade to the TPU H.264 encoder only when the library probe fails."""
     from selkies_tpu.models import registry
 
     for name in ("nvh265enc", "vah265enc", "x265enc", "tpuav1enc",
@@ -147,7 +148,16 @@ def test_registry_h265_and_av1_names_resolve(monkeypatch):
         created.update(kw)
         return "H264ENC"
 
+    # simulate both library probes failing: the rows must fall back to
+    # the TPU encoder instead of crashing config parsing
+    import selkies_tpu.models.libaom_enc as libaom_enc
+    import selkies_tpu.models.x265enc as x265enc
+
     monkeypatch.setitem(registry._FACTORIES, "tpuh264enc", fake_h264)
+    monkeypatch.setattr(x265enc, "_lib", None)
+    monkeypatch.setattr(x265enc, "_lib_tried", True)
+    monkeypatch.setattr(libaom_enc, "_lib", None)
+    monkeypatch.setattr(libaom_enc, "_lib_tried", True)
     enc = registry.create_encoder("x265enc", width=640, height=360, fps=30)
     assert enc == "H264ENC" and created["width"] == 640
     enc = registry.create_encoder("nvav1enc", width=320, height=240, fps=15,

@@ -1,0 +1,126 @@
+"""VP8/VP9 RTP payloaders (RFC 7741 / draft-ietf-payload-vp9) driven by
+real libvpx output, plus the peer-level codec-mismatch guard the review
+asked for (an answer refusing the offered codec must fail loudly, not
+stream into a black session)."""
+
+import numpy as np
+import pytest
+
+from selkies_tpu.models.libvpx_enc import libvpx_available
+from selkies_tpu.transport.rtp_vpx import (
+    Vp8Depayloader, Vp8Payloader, Vp9Depayloader, Vp9Payloader,
+    vp8_is_keyframe, vp9_is_keyframe,
+)
+
+
+def _frames(n=4, w=320, h=192):
+    rng = np.random.default_rng(3)
+    cur = np.kron(rng.integers(40, 200, (h // 16, w // 16, 4), np.uint8),
+                  np.ones((16, 16, 1), np.uint8))
+    out = []
+    for _ in range(n):
+        cur = cur.copy()
+        cur[40:56, 40:200, :3] = rng.integers(0, 255, (16, 160, 1), np.uint8)
+        out.append(cur)
+    return out
+
+
+@pytest.mark.skipif(not libvpx_available(), reason="libvpx not present")
+@pytest.mark.parametrize("vp8", [True, False])
+def test_vpx_payloader_round_trip_real_stream(vp8):
+    from selkies_tpu.models.libvpx_enc import LibVpxEncoder
+
+    enc = LibVpxEncoder(320, 192, fps=30, bitrate_kbps=3000, vp8=vp8)
+    aus = [enc.encode_frame(f) for f in _frames()]
+    enc.close()
+    is_key = vp8_is_keyframe if vp8 else vp9_is_keyframe
+    assert is_key(aus[0]) and not is_key(aus[1])
+
+    pay = Vp8Payloader() if vp8 else Vp9Payloader()
+    depay = Vp8Depayloader() if vp8 else Vp9Depayloader()
+    out = []
+    for i, au in enumerate(aus):
+        pkts = pay.payload_au(au, i * 3000)
+        assert pkts and pkts[-1].marker
+        for p in pkts:
+            assert len(p.payload) <= pay.mtu - 54
+            r = depay.push(p)
+            if r is not None:
+                out.append(r)
+    assert out == aus, "depayloaded frames must be bit-identical"
+
+
+def test_vp9_descriptor_bits():
+    # 6 KB synthetic inter frame (frame_marker=0b10, frame_type=inter)
+    frame = bytes([0b10000100]) + bytes(6000)
+    pkts = Vp9Payloader().payload_au(frame, 0)
+    assert len(pkts) > 1
+    assert pkts[0].payload[0] & 0x08      # B on first
+    assert not pkts[0].payload[0] & 0x04  # no E on first
+    assert pkts[-1].payload[0] & 0x04     # E on last
+    assert pkts[0].payload[0] & 0x40      # P: inter
+    key = bytes([0b10000000]) + bytes(100)
+    kp = Vp9Payloader().payload_au(key, 0)
+    assert not kp[0].payload[0] & 0x40    # no P on keyframe
+
+
+def test_vp8_descriptor_bits():
+    frame = bytes([0x01]) + bytes(6000)   # inter (bit0 = 1)
+    pkts = Vp8Payloader().payload_au(frame, 0)
+    assert pkts[0].payload[0] & 0x10      # S on first
+    assert not pkts[1].payload[0] & 0x10  # not on continuation
+    # picture id advances per frame, constant within one
+    pid0 = pkts[0].payload[2:4]
+    assert all(p.payload[2:4] == pid0 for p in pkts)
+
+
+def test_peer_rejects_codec_mismatch(event_loop_or_new=None):
+    import asyncio
+
+    from selkies_tpu.transport.webrtc.peer import PeerConnection
+
+    async def scenario():
+        loop = asyncio.get_event_loop()
+        pc = PeerConnection(codec="h265", audio=False, loop=loop)
+        answer = "\r\n".join([
+            "v=0", "o=- 1 2 IN IP4 127.0.0.1", "s=-",
+            "a=ice-ufrag:u", "a=ice-pwd:p",
+            "a=fingerprint:sha-256 AA:BB", "a=setup:active",
+            "m=video 9 UDP/TLS/RTP/SAVPF 96",
+            "a=rtpmap:96 H264/90000",      # browser refused H.265
+        ]) + "\r\n"
+        with pytest.raises(ValueError, match="answered codec"):
+            await pc.set_answer(answer)
+        pc.close()
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(scenario())
+    finally:
+        loop.close()
+
+
+def test_peer_adopts_renumbered_pt():
+    import asyncio
+
+    from selkies_tpu.transport.webrtc.peer import PeerConnection
+
+    async def scenario():
+        loop = asyncio.get_event_loop()
+        pc = PeerConnection(codec="av1", audio=False, loop=loop)
+        answer = "\r\n".join([
+            "v=0", "o=- 1 2 IN IP4 127.0.0.1", "s=-",
+            "a=ice-ufrag:u", "a=ice-pwd:p",
+            "a=fingerprint:sha-256 AA:BB", "a=setup:active",
+            "m=video 9 UDP/TLS/RTP/SAVPF 45",
+            "a=rtpmap:45 AV1/90000",
+        ]) + "\r\n"
+        await pc.set_answer(answer)
+        assert pc.video_pay.payload_type == 45
+        pc.close()
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(scenario())
+    finally:
+        loop.close()

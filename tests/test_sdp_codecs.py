@@ -1,0 +1,69 @@
+"""SDP offer/answer for the AV1 and H.265 rows (reference munging:
+gstwebrtc_app.py __on_offer_created :1581-1636; AV1/H.265 caps
+:741-783, :848-871)."""
+
+from selkies_tpu.transport.webrtc import sdp
+
+
+def _answer(rtpmaps: list[str]) -> str:
+    lines = [
+        "v=0", "o=- 1 2 IN IP4 127.0.0.1", "s=-",
+        "a=ice-ufrag:u", "a=ice-pwd:p",
+        "a=fingerprint:sha-256 AA:BB", "a=setup:active",
+        "m=video 9 UDP/TLS/RTP/SAVPF 96 98",
+    ] + [f"a=rtpmap:{r}" for r in rtpmaps]
+    return "\r\n".join(lines) + "\r\n"
+
+
+def test_offer_carries_av1_rtpmap_and_fmtp():
+    offer = sdp.build_offer(
+        ice_ufrag="u", ice_pwd="p", fingerprint="AA", video_ssrc=1,
+        audio_ssrc=2, codec="av1")
+    assert f"a=rtpmap:{sdp.VIDEO_PT} AV1/90000" in offer
+    assert f"a=fmtp:{sdp.VIDEO_PT} {sdp.AV1_FMTP}" in offer
+
+
+def test_offer_carries_h265_rtpmap_and_fmtp():
+    offer = sdp.build_offer(
+        ice_ufrag="u", ice_pwd="p", fingerprint="AA", video_ssrc=1,
+        audio_ssrc=2, codec="h265")
+    assert f"a=rtpmap:{sdp.VIDEO_PT} H265/90000" in offer
+    assert f"a=fmtp:{sdp.VIDEO_PT} {sdp.H265_FMTP}" in offer
+
+
+def test_answer_prefers_offered_codec_over_listing_order():
+    # AV1 session: H.264 listed first must not shadow the AV1 PT
+    r = sdp.parse_answer(_answer(["96 H264/90000", "45 AV1/90000"]),
+                         prefer="av1")
+    assert r.video_pt == 45
+    # H.264 session: AV1 listed first must not shadow the H.264 PT
+    r = sdp.parse_answer(_answer(["45 AV1/90000", "96 H264/90000"]),
+                         prefer="h264")
+    assert r.video_pt == 96
+    # H.265 session picks H265
+    r = sdp.parse_answer(_answer(["96 H264/90000", "97 H265/90000"]),
+                         prefer="h265")
+    assert r.video_pt == 97
+
+
+def test_answer_without_offered_codec_falls_back():
+    r = sdp.parse_answer(_answer(["96 H264/90000"]), prefer="av1")
+    assert r.video_pt == 96
+    r = sdp.parse_answer(_answer(["45 AV1/90000"]), prefer="h264")
+    assert r.video_pt == 45
+
+
+def test_rejected_video_section_ignores_echoed_rtpmaps():
+    """JSEP rejection is port 0 — libwebrtc still echoes the offered
+    rtpmaps inside the rejected m-section; they must not negotiate."""
+    ans = "\r\n".join([
+        "v=0", "o=- 1 2 IN IP4 127.0.0.1", "s=-",
+        "a=ice-ufrag:u", "a=ice-pwd:p",
+        "a=fingerprint:sha-256 AA:BB", "a=setup:active",
+        "m=video 0 UDP/TLS/RTP/SAVPF 102",
+        "a=rtpmap:102 H265/90000",
+        "m=application 9 UDP/DTLS/SCTP webrtc-datachannel",
+    ]) + "\r\n"
+    r = sdp.parse_answer(ans, prefer="h265")
+    assert r.video_pt is None
+    assert r.video_rejected is True
