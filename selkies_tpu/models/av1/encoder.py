@@ -91,7 +91,9 @@ class TPUAV1Encoder(LibAomEncoder):
         """Parse our own bitstream: which slot can re-show this frame?"""
         try:
             self._seq, fh = headers.scan_temporal_unit(au, self._seq)
-        except ValueError as exc:
+        except (ValueError, IndexError) as exc:
+            # IndexError: truncated OBU drives the bit reader past the
+            # end — same degrade as a malformed header
             logger.warning("AV1 header parse failed (%s); re-show disabled", exc)
             self._show_slot = None
             return

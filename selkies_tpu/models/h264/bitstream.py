@@ -83,7 +83,13 @@ def write_sps(p: StreamParams) -> bytes:
     w.write_ue(0)  # seq_parameter_set_id
     w.write_ue(LOG2_MAX_FRAME_NUM - 4)
     w.write_ue(2)  # pic_order_cnt_type: POC from frame_num (no B frames)
-    w.write_ue(1)  # max_num_ref_frames
+    # 3 reference frames: 1 short-term (the previous frame — the only
+    # default prediction source) + 2 long-term scene slots for the
+    # alt-tab LTR cache (encoder.py: window switches back to a
+    # remembered scene encode as a tiny delta against its LTR instead
+    # of a full-frame round trip). At 1080p a 3-frame DPB needs
+    # MaxDpbMbs >= 24480, within level 4.0's 32768.
+    w.write_ue(3)  # max_num_ref_frames
     w.write_bit(0)  # gaps_in_frame_num_value_allowed_flag
     w.write_ue(p.mb_width - 1)
     w.write_ue(p.mb_height - 1)
@@ -134,8 +140,33 @@ def write_slice_header(
     idr_pic_id: int = 0,
     first_mb: int = 0,
     slice_qp: int | None = None,
+    ltr_ref: int | None = None,
+    mark_ltr: int | None = None,
+    mmco_evict: tuple = (),
 ) -> None:
-    """Write the slice header into an open BitWriter (slice data follows)."""
+    """Write the slice header into an open BitWriter (slice data follows).
+
+    LTR scene-cache syntax (encoder.py's alt-tab optimization):
+      * ltr_ref=j — predict this P slice from long-term reference j
+        instead of the previous frame (ref_pic_list_modification with
+        long_term_pic_num, 7.3.3.1). Used ONLY by scene-restore frames;
+        the frame after one predicts the restore's recon through the
+        default ref list (the restore is still short-term when that
+        frame's ref list is built — MMCO marking applies post-decode).
+      * mark_ltr=k — mark the PREVIOUS frame as long-term index k
+        (adaptive dec_ref_pic_marking: MMCO 4 sizes the LT set to 2,
+        MMCO 3 with difference_of_pic_nums_minus1=0 targets
+        CurrPicNum-1, 7.4.3.3 / 8.2.5.4). Emitted one frame after a
+        scene cut so the cut frame's recon is remembered while it is
+        still resident short-term.
+      * mmco_evict=(d, ...) — MMCO 1 operations (short-term → unused,
+        difference_of_pic_nums_minus1 values) emitted alongside
+        mark_ltr. Adaptive marking REPLACES the sliding window (8.2.5),
+        so any extra short-term refs that accumulated while the DPB had
+        slack must be evicted explicitly or the marked frame would push
+        the DPB past max_num_ref_frames. The encoder mirrors the DPB
+        and passes the stale picNum diffs here.
+    """
     w.write_ue(first_mb)
     w.write_ue(slice_type)
     w.write_ue(0)  # pic_parameter_set_id
@@ -145,10 +176,27 @@ def write_slice_header(
     # pic_order_cnt_type == 2: nothing to write
     if slice_type in (SLICE_P, 0):
         w.write_bit(0)  # num_ref_idx_active_override_flag
-        w.write_bit(0)  # ref_pic_list_modification_flag_l0
+        if ltr_ref is not None:
+            w.write_bit(1)  # ref_pic_list_modification_flag_l0
+            w.write_ue(2)   # modification_of_pic_nums_idc: long_term_pic_num
+            w.write_ue(ltr_ref)
+            w.write_ue(3)   # end of modification list
+        else:
+            w.write_bit(0)  # ref_pic_list_modification_flag_l0
     if idr:
         w.write_bit(0)  # no_output_of_prior_pics_flag
         w.write_bit(0)  # long_term_reference_flag
+    elif mark_ltr is not None:
+        w.write_bit(1)  # adaptive_ref_pic_marking_mode_flag
+        for diff in mmco_evict:
+            w.write_ue(1)   # MMCO 1: stale short-term -> unused
+            w.write_ue(diff)
+        w.write_ue(4)   # MMCO 4: size the long-term set
+        w.write_ue(2)   # max_long_term_frame_idx_plus1: LT indices {0,1}
+        w.write_ue(3)   # MMCO 3: short-term -> long-term
+        w.write_ue(0)   # difference_of_pic_nums_minus1: previous frame
+        w.write_ue(mark_ltr)  # long_term_frame_idx
+        w.write_ue(0)   # MMCO 0: end
     else:
         # dec_ref_pic_marking is present whenever nal_ref_idc != 0 (7.3.3);
         # every slice we emit is a reference (annexb_nal ref_idc=3).

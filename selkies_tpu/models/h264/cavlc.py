@@ -217,6 +217,9 @@ def pack_slice_p(
     fc: PFrameCoeffs,
     p: StreamParams,
     frame_num: int,
+    ltr_ref: int | None = None,
+    mark_ltr: int | None = None,
+    mmco_evict: tuple = (),
 ) -> bytes:
     """Entropy-code one P frame (P_Skip / P_L0_16x16 MBs) into a slice NAL.
 
@@ -228,7 +231,9 @@ def pack_slice_p(
     """
     mbh, mbw = fc.skip.shape
     w = BitWriter()
-    write_slice_header(w, p, SLICE_P, frame_num, idr=False, slice_qp=fc.qp)
+    write_slice_header(w, p, SLICE_P, frame_num, idr=False, slice_qp=fc.qp,
+                       ltr_ref=ltr_ref, mark_ltr=mark_ltr,
+                       mmco_evict=mmco_evict)
 
     luma_tc = np.zeros((mbh * 4, mbw * 4), np.int32)
     chroma_tc = np.zeros((2, mbh * 2, mbw * 2), np.int32)

@@ -655,7 +655,10 @@ def _or_bits(out: np.ndarray, src: np.ndarray, bit_off: int, nbits: int) -> None
 
 
 def assemble_p_nal(words: np.ndarray, nbits: int, trailing_skip: int,
-                   p, frame_num: int, qp: int) -> bytes:
+                   p, frame_num: int, qp: int,
+                   ltr_ref: int | None = None,
+                   mark_ltr: int | None = None,
+                   mmco_evict: tuple = ()) -> bytes:
     """Finish a P slice from device bits: header + stream + trailing
     skip_run + rbsp stop, emulation-prevented and Annex-B wrapped.
     Byte-identical to cavlc.pack_slice_p for the same inputs."""
@@ -663,7 +666,9 @@ def assemble_p_nal(words: np.ndarray, nbits: int, trailing_skip: int,
     from selkies_tpu.utils.bits import BitWriter, annexb_nal
 
     w = BitWriter()
-    write_slice_header(w, p, SLICE_P, frame_num, idr=False, slice_qp=qp)
+    write_slice_header(w, p, SLICE_P, frame_num, idr=False, slice_qp=qp,
+                       ltr_ref=ltr_ref, mark_ltr=mark_ltr,
+                       mmco_evict=mmco_evict)
     hdr_bytes, hdr_bits = w.get_partial()
 
     dev_bytes = np.ascontiguousarray(words[: (nbits + 31) // 32]).astype(">u4").view(np.uint8)

@@ -280,6 +280,10 @@ class _Pending:
     future: object = None  # completion future (threaded fetch+unpack+pack)
     batch_slot: int = -1  # >=0: index into a shared batch future's result list
     scene_cut: bool = False  # full-frame change transition (rate control)
+    # LTR scene cache slice-header flags (bitstream.write_slice_header):
+    ltr_ref: int | None = None   # predict from long-term reference j
+    mark_ltr: int | None = None  # mark the previous frame as LT index k
+    mmco_evict: tuple = ()       # MMCO 1 diffs for stale short-terms
 
 
 class TPUH264Encoder:
@@ -310,6 +314,7 @@ class TPUH264Encoder:
         frame_batch: int = 4,
         scene_qp_boost: int = 0,
         device_entropy: bool = True,
+        ltr_scenes: bool = True,
     ):
         self.width = width
         self.height = height
@@ -366,6 +371,14 @@ class TPUH264Encoder:
                 partial(_i_scatter_step, tile_w=self._tile_w), donate_argnums=(2, 3, 4)
             )
             self._step_resident_i = jax.jit(_i_resident_step)
+            # LTR scene restore: same scatter+encode step but NON-donating
+            # — the long-term slot's planes must survive the step (they
+            # are the stash, not the working chain)
+            self._step_scatter_ltr = jax.jit(partial(_p_scatter_step, **_consts))
+            # device-side plane snapshot for the scene stash (six ~1 MB
+            # HBM copies, dispatched once per scene cut)
+            self._copy_planes = jax.jit(
+                lambda *arrs: tuple(jnp.copy(a) for a in arrs))
         else:
             self._step = jax.jit(
                 lambda frame, qp: _device_step(
@@ -441,6 +454,41 @@ class TPUH264Encoder:
         # a deque during a concurrent append raises RuntimeError
         self._pfx_lock = threading.Lock()
         self._allskip: PFrameCoeffs | None = None
+        # LTR scene cache (the alt-tab optimization): window switches
+        # back to a remembered scene encode as a tiny delta against that
+        # scene's long-term reference instead of a full-frame upload +
+        # encode round trip — on this deployment's link that is the
+        # difference between ~30 ms and ~400 ms for the switch frame.
+        # Two slots, LRU replacement; each holds device copies of a
+        # scene-cut frame's source+recon planes plus the host capture
+        # for match detection. H.264 side: SPS max_num_ref_frames=3
+        # (1 short-term + 2 long-term), the frame AFTER a scene cut
+        # marks the cut frame long-term (MMCO 3 — it is still resident
+        # short-term then, so no ref-list games are needed in between),
+        # and restore frames select the slot via ref_pic_list
+        # modification (write_slice_header ltr_ref/mark_ltr).
+        self.ltr_scenes = bool(ltr_scenes) and self._prep is not None
+        self._ltr_slots: list[dict | None] = [None, None]
+        # MRU protection: new-scene candidates always target the slot
+        # that was NOT most recently matched/stashed-by-restore, so
+        # sustained full-frame motion (every frame becomes a candidate)
+        # thrashes one slot and can never evict the last restored scene
+        self._ltr_mru = 1
+        self._ltr_candidate: dict | None = None
+        # consecutive-full-frame run length: window switches arrive as
+        # runs of 1-2 full frames, sustained motion (video playback) as
+        # long runs — stash candidates only for the first two frames of
+        # a run, so motion doesn't pay per-frame plane/capture copies or
+        # thrash a slot with scenes that can never be restored mid-run
+        self._full_run = 0
+        self.ltr_restores = 0  # stats: scene switches served from cache
+        # decoder-DPB mirror (short-term ref frame_nums, decode order):
+        # slices that carry MMCO marking replace the sliding window
+        # (8.2.5), so they must explicitly evict any short-terms that
+        # accumulated while the DPB had slack — this list is what the
+        # decoder's ST set contains, letting submit() compute the MMCO 1
+        # diffs (see write_slice_header mmco_evict)
+        self._dpb_st: list[int] = []
         self.frame_index = 0
         self._frames_since_idr = 0
         self._idr_pic_id = 0
@@ -492,7 +540,8 @@ class TPUH264Encoder:
         idx = (band_i * 1024 + tile_i).astype(np.int32)
         return "delta", idx
 
-    def _allskip_slice(self, frame_num: int) -> bytes:
+    def _allskip_slice(self, frame_num: int, mark_ltr: int | None = None,
+                       mmco_evict: tuple = ()) -> bytes:
         """P slice with every MB P_Skip: recon == ref exactly (zero MV,
         full-pel, no residual), so the device reference stays valid."""
         if self._allskip is None:
@@ -506,7 +555,8 @@ class TPUH264Encoder:
                 qp=self.qp,
             )
         self._allskip.qp = self.qp
-        return pack_slice_p_fast(self._allskip, self.params, frame_num=frame_num)
+        return pack_slice_p_fast(self._allskip, self.params, frame_num=frame_num,
+                                 mark_ltr=mark_ltr, mmco_evict=mmco_evict)
 
     # -- encoding --
 
@@ -582,6 +632,82 @@ class TPUH264Encoder:
         # reassign IMMEDIATELY: the old src (and refs on P) were donated
         self._src = (sy, su, sv)
         return prefix_d, hdr_d, buf_d, ry, ru, rv
+
+    # -- LTR scene cache (alt-tab restore) ------------------------------
+
+    def _dirty_vs(self, frame: np.ndarray, cap: np.ndarray) -> np.ndarray:
+        """Per-tile inequality of two captures in FramePrep's geometry
+        (16-row bands x _tile_w luma cols). Runs only on scene cuts."""
+        d = (frame != cap).any(axis=2)
+        h, w = d.shape
+        pb = np.zeros((self._pad_h, self._pad_w), bool)
+        pb[:h, :w] = d
+        nb, nt = self._pad_h // 16, self._pad_w // self._tile_w
+        return pb.reshape(nb, 16, nt, self._tile_w).any(axis=(1, 3))
+
+    @staticmethod
+    def _ltr_quick_reject(frame: np.ndarray, cap: np.ndarray) -> bool:
+        """Sampled pre-filter (~8 K pixels) so sustained full-frame motion
+        (video playback) rejects candidate scenes in microseconds instead
+        of paying the full per-tile compare every frame. A genuine scene
+        restore differs only in its dirty region (bounded by the delta
+        buckets at ~25% of tiles), so a >35% sampled mismatch can never
+        be a match."""
+        s1, s2 = frame[8::48, 16::128], cap[8::48, 16::128]
+        return float((s1 != s2).any(axis=-1).mean()) > 0.35
+
+    def _ltr_match(self, frame: np.ndarray):
+        """-> (slot, dirty_idx) of the best-matching remembered scene, or
+        None when no slot matches within the delta-bucket budget."""
+        if not self._delta_buckets:
+            return None
+        best = None
+        for j, s in enumerate(self._ltr_slots):
+            if s is None or s["cap"].shape != frame.shape:
+                continue
+            if self._ltr_quick_reject(frame, s["cap"]):
+                continue
+            tiles = self._dirty_vs(frame, s["cap"])
+            band_i, tile_i = np.nonzero(tiles)
+            if len(band_i) > self._delta_buckets[-1]:
+                continue
+            if best is None or len(band_i) < len(best[1]):
+                best = (j, (band_i * 1024 + tile_i).astype(np.int32))
+        if best is None:
+            return None
+        j, idx = best
+        if len(idx) == 0:
+            # capture identical to the stash: rewrite tile 0 with its own
+            # content (idempotent) so the scatter step has a real input
+            idx = np.zeros(1, np.int32)
+        return j, idx
+
+    def _run_step_ltr(self, frame: np.ndarray, idx: np.ndarray, stash: dict):
+        """Scene restore: scatter the (few) tiles that differ from the
+        remembered scene into a fresh copy of its source planes and
+        encode against its recon — the stash planes survive untouched."""
+        bucket = next(b for b in self._delta_buckets if b >= len(idx))
+        yb, ub, vb = self._prep.convert_tiles(frame, idx, self._tile_w)
+        packed_d = jax.device_put(self._pack_tiles(yb, ub, vb, idx, bucket))
+        prefix_d, hdr_d, buf_d, ry, ru, rv, sy, su, sv = self._step_scatter_ltr(
+            packed_d, np.int32(self.qp), *stash["src"], *stash["ref"]
+        )
+        self._src = (sy, su, sv)
+        return prefix_d, hdr_d, buf_d, ry, ru, rv
+
+    def _stash_candidate(self, frame: np.ndarray, slot: int) -> None:
+        """Snapshot this scene-cut frame as the pending LTR candidate.
+        Device copies are dispatched NOW (before any later step donates
+        the planes); the slot commits when the next frame emits MMCO 3."""
+        if self._src is None or self._ref is None:
+            return
+        copies = self._copy_planes(*self._src, *self._ref)
+        self._ltr_candidate = {
+            "slot": int(slot),
+            "src": tuple(copies[:3]),
+            "ref": tuple(copies[3:]),
+            "cap": np.array(frame, copy=True),
+        }
 
     # -- grouped delta dispatch (frame_batch > 1) -----------------------
 
@@ -713,7 +839,9 @@ class TPUH264Encoder:
             )
             if pfc is None:  # ns > NSCAP: dense-header fallback fetch
                 pfc = unpack_p_compact(np.asarray(denses_d[slot]), rows, rec.qp)
-            au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num)
+            au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num,
+                                   ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
+                                   mmco_evict=rec.mmco_evict)
             results.append((au, int(pfc.skip.sum()), t1, time.perf_counter()))
         self._pfx_hint = self._pfx_slice_len()
         return results
@@ -746,7 +874,50 @@ class TPUH264Encoder:
         # rate controller owns QP and the boost must stay out of the loop
         scene_cut = kind == "full" and self._src is not None and self._prev_kind != "full"
         self._prev_kind = kind
-        if scene_cut and self.scene_qp_boost:
+        self._full_run = self._full_run + 1 if kind == "full" else 0
+        # LTR scene cache: look for a remembered scene on ANY full frame
+        # (window-switch pairs arrive back-to-back, so the second switch
+        # is not a `scene_cut` transition; the sampled quick-reject keeps
+        # this out of the sustained-motion hot path). Match against the
+        # CURRENT slot table — the pending candidate commits below, which
+        # matches the decoder applying this slice's MMCO only after
+        # decoding it.
+        ltr_hit = None
+        ltr_stash = None
+        if self.ltr_scenes and not idr and kind == "full" and self._src is not None:
+            hit = self._ltr_match(frame)
+            if hit is not None:
+                ltr_hit = hit
+                ltr_stash = self._ltr_slots[hit[0]]
+        # commit the pending scene candidate: this slice emits the MMCO 3
+        # that marks the previous full frame as long-term
+        mark_ltr = None
+        if self.ltr_scenes and not idr and self._ltr_candidate is not None:
+            cand = self._ltr_candidate
+            self._ltr_candidate = None
+            self._ltr_slots[cand["slot"]] = cand
+            mark_ltr = cand["slot"]
+        # decoder-DPB mirror: marking slices bypass the sliding window,
+        # so they must evict stale short-terms themselves (MMCO 1) or the
+        # DPB would exceed max_num_ref_frames=3
+        mmco_evict: tuple = ()
+        if idr:
+            self._dpb_st = [0]
+        else:
+            cur_fn = self._frames_since_idr % 256
+            if mark_ltr is not None:
+                prev_fn = (cur_fn - 1) % 256
+                if prev_fn in self._dpb_st:
+                    self._dpb_st.remove(prev_fn)  # it becomes long-term
+                mmco_evict = tuple(sorted(
+                    ((cur_fn - s) % 256) - 1 for s in self._dpb_st))
+                self._dpb_st = [cur_fn]
+            else:
+                lt_count = sum(1 for s in self._ltr_slots if s is not None)
+                if len(self._dpb_st) + lt_count >= 3:  # sliding window
+                    self._dpb_st.pop(0)
+                self._dpb_st.append(cur_fn)
+        if scene_cut and self.scene_qp_boost and ltr_hit is None:
             self.qp = min(51, self.qp + self.scene_qp_boost)
         if kind == "static" and not idr:
             # unchanged capture: all-skip P slice host-side — no upload,
@@ -754,11 +925,14 @@ class TPUH264Encoder:
             # The screen just went idle, so stop waiting for more group
             # members: dispatch any pending deltas now.
             self._flush_batch()
-            slice_nal = self._allskip_slice(self._frames_since_idr % 256)
+            slice_nal = self._allskip_slice(self._frames_since_idr % 256,
+                                            mark_ltr=mark_ltr,
+                                            mmco_evict=mmco_evict)
             rec = _Pending(
                 kind="static", frame_index=self.frame_index, qp=self.qp,
                 frame_num=self._frames_since_idr % 256, idr_pic_id=0,
                 t0=t0, t1=time.perf_counter(), meta=meta, au=slice_nal,
+                mark_ltr=mark_ltr, mmco_evict=mmco_evict,
             )
         elif (
             not idr
@@ -773,7 +947,8 @@ class TPUH264Encoder:
             rec = _Pending(
                 kind="pd", frame_index=self.frame_index, qp=self.qp,
                 frame_num=self._frames_since_idr % 256, idr_pic_id=0,
-                t0=t0, t1=0.0, meta=meta,
+                t0=t0, t1=0.0, meta=meta, mark_ltr=mark_ltr,
+                mmco_evict=mmco_evict,
             )
             self._batch_pend.append((rec, yb, ub, vb, dirty_idx))
             batch_full = len(self._batch_pend) >= self.frame_batch
@@ -808,7 +983,18 @@ class TPUH264Encoder:
                     self._idr_pic_id = (self._idr_pic_id + 1) % 2
                     self._force_idr = False
                 else:
-                    if kind == "delta":
+                    ltr_ref = None
+                    if ltr_hit is not None:
+                        # scene restore: a few tiles against the slot's
+                        # long-term reference instead of a full-frame
+                        # upload + encode
+                        prefix_d, hdr_d, buf_d, ry, ru, rv = self._run_step_ltr(
+                            frame, ltr_hit[1], ltr_stash
+                        )
+                        pk, words_d = "pd", None
+                        ltr_ref = ltr_hit[0]
+                        self.ltr_restores += 1
+                    elif kind == "delta":
                         prefix_d, hdr_d, buf_d, ry, ru, rv = self._run_step_delta(
                             frame, dirty_idx, idr=False
                         )
@@ -826,10 +1012,30 @@ class TPUH264Encoder:
                         t0=t0, t1=0.0, meta=meta,
                         prefix_d=prefix_d, buf_d=buf_d, hdr_d=hdr_d,
                         words_d=words_d, scene_cut=scene_cut,
+                        ltr_ref=ltr_ref, mark_ltr=mark_ltr,
+                        mmco_evict=mmco_evict,
                     )
                     if pk == "pd":
                         rec.pfx_slice_d = self._pfx_slice(prefix_d)
-                if kind == "full":
+                # scene-stash bookkeeping: every full frame (IDR, full-P,
+                # or restore) becomes the pending LTR candidate — window
+                # switches arrive back-to-back, so mid-run frames are
+                # boundaries too; the next slice's MMCO 3 commits it.
+                # Restores refresh their own slot and become MRU; new
+                # scenes go to the unprotected slot.
+                if self.ltr_scenes:
+                    if idr:
+                        # DPB reset: the decoder dropped every reference
+                        self._ltr_slots = [None, None]
+                        self._ltr_candidate = None
+                        self._ltr_mru = 0  # protect the IDR's scene slot
+                        self._stash_candidate(frame, 0)
+                    elif ltr_hit is not None:
+                        self._ltr_mru = ltr_hit[0]
+                        self._stash_candidate(frame, ltr_hit[0])
+                    elif kind == "full" and self._full_run <= 2:
+                        self._stash_candidate(frame, 1 - self._ltr_mru)
+                if kind == "full" and ltr_hit is None:
                     # decay feed-forward: the frames after a full-frame
                     # change carry a frame-wide quantization-error tail,
                     # so the next delta fetches will be large — grow the
@@ -850,6 +1056,7 @@ class TPUH264Encoder:
                 # chain and remain deliverable.
                 self._ref = None
                 self._src = None
+                self._ltr_candidate = None  # forced IDR will clear slots
                 self.qp = orig_qp
                 raise
         self.qp = orig_qp
@@ -941,7 +1148,9 @@ class TPUH264Encoder:
             pfc, rows = self._unpack_sparse_var(fused, rec.prefix_d, rec.buf_d, rec.qp)
             if pfc is None:
                 pfc = unpack_p_compact(np.asarray(rec.hdr_d), rows, rec.qp)
-            au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num)
+            au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num,
+                                   ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
+                                   mmco_evict=rec.mmco_evict)
             self._pfx_hint = self._pfx_slice_len()
             return au, int(pfc.skip.sum()), t1, time.perf_counter()
         hdr_words = self._hdr_words_i if rec.kind == "i" else self._hdr_words_p
@@ -963,7 +1172,9 @@ class TPUH264Encoder:
         else:
             pfc = unpack_p_compact(header, data, rec.qp)
             skipped = int(pfc.skip.sum())
-            au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num)
+            au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num,
+                                   ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
+                                   mmco_evict=rec.mmco_evict)
         return au, skipped, t1, time.perf_counter()
 
     def _complete_bits(self, rec: "_Pending"):
@@ -977,7 +1188,9 @@ class TPUH264Encoder:
             data = _fetch_rest(rec.buf_d, int(header[0]), 0)
             t1 = time.perf_counter()
             pfc = unpack_p_compact(header, data, rec.qp)
-            au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num)
+            au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num,
+                                   ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
+                                   mmco_evict=rec.mmco_evict)
             return au, int(pfc.skip.sum()), t1, time.perf_counter()
         need = (nbits + 31) // 32
         words = arr[3 : 3 + min(need, BITS_PREFIX_WORDS)]
@@ -986,7 +1199,9 @@ class TPUH264Encoder:
                 [words, _fetch_rest(rec.words_d, need, BITS_PREFIX_WORDS)]
             )
         t1 = time.perf_counter()
-        au = assemble_p_nal(words, nbits, trailing, self.params, rec.frame_num, rec.qp)
+        au = assemble_p_nal(words, nbits, trailing, self.params, rec.frame_num,
+                            rec.qp, ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
+                            mmco_evict=rec.mmco_evict)
         return au, skipped, t1, time.perf_counter()
 
     def encode_frame(self, frame: np.ndarray, qp: int | None = None) -> bytes:

@@ -204,14 +204,19 @@ def _finish_nal(s: dict, n: int, nal_type: int) -> bytes:
     return b"\x00\x00\x00\x01" + bytes([(3 << 5) | nal_type]) + ebsp[:m].tobytes()
 
 
-def pack_slice_p_native(fc: PFrameCoeffs, p: StreamParams, frame_num: int) -> bytes:
+def pack_slice_p_native(fc: PFrameCoeffs, p: StreamParams, frame_num: int,
+                        ltr_ref: int | None = None,
+                        mark_ltr: int | None = None,
+                        mmco_evict: tuple = ()) -> bytes:
     lib = _load()
     if lib is None:
         raise RuntimeError("libcavlc.so unavailable")
     mbh, mbw = fc.skip.shape
 
     hdr = BitWriter()
-    write_slice_header(hdr, p, SLICE_P, frame_num, idr=False, slice_qp=fc.qp)
+    write_slice_header(hdr, p, SLICE_P, frame_num, idr=False, slice_qp=fc.qp,
+                       ltr_ref=ltr_ref, mark_ltr=mark_ltr,
+                       mmco_evict=mmco_evict)
     hdr_bytes, hdr_bits = hdr.get_partial()
 
     mvs = np.ascontiguousarray(fc.mvs, dtype=np.int16)
@@ -239,8 +244,13 @@ def pack_slice_p_native(fc: PFrameCoeffs, p: StreamParams, frame_num: int) -> by
     return _finish_nal(s, n, NAL_SLICE_NON_IDR)
 
 
-def pack_slice_p_fast(fc: PFrameCoeffs, p: StreamParams, frame_num: int) -> bytes:
+def pack_slice_p_fast(fc: PFrameCoeffs, p: StreamParams, frame_num: int,
+                      ltr_ref: int | None = None,
+                      mark_ltr: int | None = None,
+                      mmco_evict: tuple = ()) -> bytes:
     """Native P-slice packer when available, Python fallback otherwise."""
     if native_available():
-        return pack_slice_p_native(fc, p, frame_num)
-    return pack_slice_p_py(fc, p, frame_num)
+        return pack_slice_p_native(fc, p, frame_num, ltr_ref=ltr_ref,
+                                   mark_ltr=mark_ltr, mmco_evict=mmco_evict)
+    return pack_slice_p_py(fc, p, frame_num, ltr_ref=ltr_ref,
+                           mark_ltr=mark_ltr, mmco_evict=mmco_evict)

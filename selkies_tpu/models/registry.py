@@ -67,11 +67,29 @@ def default_frame_batch() -> int:
     return 8 if os.environ.get("PALLAS_AXON_POOL_IPS") else 4
 
 
+def default_pipeline_depth() -> int:
+    """Deployment-aware in-flight round-trip cap. The relay's d2h fetch
+    costs ~140 ms RTT + per-byte; fetches overlap across worker threads
+    (PERF.md), so the steady state is fetch-bound unless 3+ group
+    round trips are in flight. PCIe-local hosts keep the shallow
+    pipeline (RTT is microseconds; depth only adds latency).
+    SELKIES_PIPELINE_DEPTH overrides either way."""
+    env = os.environ.get("SELKIES_PIPELINE_DEPTH")
+    if env:
+        return max(0, min(8, int(env)))
+    # depth 3 measured faster on the relay when the tunnel is healthy,
+    # but two runs stalled during a tunnel degradation with 3 groups of
+    # fetches outstanding — hold the default at 2 until that is
+    # attributable; SELKIES_PIPELINE_DEPTH=3 opts in
+    return 2
+
+
 @register("tpuh264enc")
 def _tpuh264enc(*, width: int, height: int, fps: int = 60, qp: int = 28, **kw):
     from selkies_tpu.models.h264.encoder import TPUH264Encoder
 
     kw.setdefault("frame_batch", default_frame_batch())
+    kw.setdefault("pipeline_depth", default_pipeline_depth())
     kw.setdefault("scene_qp_boost", 6)
     return TPUH264Encoder(width=width, height=height, qp=qp, fps=fps, **kw)
 

@@ -40,3 +40,31 @@ def pytest_configure(config):
     sys.stdout.flush()
     sys.stderr.flush()
     os.execve(sys.executable, [sys.executable, "-m", "pytest", *sys.argv[1:]], _cpu_env(os.environ))
+
+
+# -- shared codec-test fixtures ---------------------------------------------
+
+def codec_trace(n=8, w=320, h=192, static=(), seed=5):
+    """Desktop-like BGRX trace shared by the codec row tests: a kron block
+    wallpaper with a randomized 16x160 'typing' region; frames listed in
+    `static` repeat their predecessor exactly."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    cur = np.kron(rng.integers(40, 200, (h // 16, w // 16, 4), np.uint8),
+                  np.ones((16, 16, 1), np.uint8))
+    frames = []
+    for i in range(n):
+        if i not in static:
+            cur = cur.copy()
+            cur[40:56, 40:200, :3] = rng.integers(0, 255, (16, 160, 1), np.uint8)
+        frames.append(cur)
+    return frames
+
+
+def bgrx_luma(frame_bgrx):
+    """Luma plane of a BGRX frame via the software encoders' exact
+    conversion (float, for PSNR math)."""
+    from selkies_tpu.models.libvpx_enc import _bgrx_to_i420_np
+
+    return _bgrx_to_i420_np(frame_bgrx)[0].astype(float)

@@ -26,23 +26,15 @@ def _dav1d():
 
 
 def _trace(n=8, w=W, h=H, static=(2, 3, 6)):
-    rng = np.random.default_rng(5)
-    base = np.kron(rng.integers(40, 200, (h // 16, w // 16, 4), np.uint8),
-                   np.ones((16, 16, 1), np.uint8))
-    frames = []
-    cur = base.copy()
-    for i in range(n):
-        if i not in static:
-            cur = cur.copy()
-            cur[40:56, 40:200, :3] = rng.integers(0, 255, (16, 160, 1), np.uint8)
-        frames.append(cur)
-    return frames
+    from conftest import codec_trace
+
+    return codec_trace(n, w, h, static=static)
 
 
 def _luma(frame_bgrx: np.ndarray) -> np.ndarray:
-    from selkies_tpu.models.libvpx_enc import _bgrx_to_i420_np
+    from conftest import bgrx_luma
 
-    return _bgrx_to_i420_np(frame_bgrx)[0].astype(float)
+    return bgrx_luma(frame_bgrx)
 
 
 def test_libaom_round_trip_decodes_and_tracks_source():

@@ -216,8 +216,12 @@ def test_delta_upload_bitexact_and_decodable(tmp_path):
         f[128:144, 10 : 60 + 4 * i] = (30, i * 53 % 255, 120, 0)
         frames.append(f)
 
-    enc_d = TPUH264Encoder(width=w, height=h, qp=26)
-    enc_f = TPUH264Encoder(width=w, height=h, qp=26)
+    # ltr_scenes off: full frames become LTR candidates and carry MMCO
+    # marking bits the delta path legitimately lacks — the invariant
+    # under test is scatter-vs-full equivalence (LTR conformance is
+    # tests/test_h264_ltr.py)
+    enc_d = TPUH264Encoder(width=w, height=h, qp=26, ltr_scenes=False)
+    enc_f = TPUH264Encoder(width=w, height=h, qp=26, ltr_scenes=False)
     enc_f._delta_buckets = ()  # force full uploads
     stream_d = b"".join(enc_d.encode_frame(f) for f in frames)
     stream_f = b"".join(enc_f.encode_frame(f) for f in frames)
@@ -250,7 +254,7 @@ def test_forced_idr_on_static_content_zero_upload(tmp_path):
     """force_keyframe() on unchanged content uses the resident-plane IDR."""
     w, h = 320, 192
     f = _desktop_frame(w, h, seed=11)
-    enc = TPUH264Encoder(width=w, height=h, qp=26)
+    enc = TPUH264Encoder(width=w, height=h, qp=26, ltr_scenes=False)
     a0 = enc.encode_frame(f)
     enc.force_keyframe()
     a1 = enc.encode_frame(f)  # static + idr -> resident-plane path
@@ -261,7 +265,7 @@ def test_forced_idr_on_static_content_zero_upload(tmp_path):
     # the resident-plane IDR must be byte-identical to what a full
     # re-upload of the same content would produce (a0 != a1 because
     # consecutive IDRs toggle idr_pic_id — compare like with like)
-    enc_full = TPUH264Encoder(width=w, height=h, qp=26)
+    enc_full = TPUH264Encoder(width=w, height=h, qp=26, ltr_scenes=False)
     enc_full._delta_buckets = ()
     b0 = enc_full.encode_frame(f)
     enc_full._src = None  # force the full-upload IDR path
@@ -281,9 +285,9 @@ def test_sparse_header_overflow_falls_back_to_dense(tmp_path, monkeypatch):
     f0 = _desktop_frame(w, h, seed=21)
     f1 = f0.copy()
     f1[32:64, :] = np.random.default_rng(4).integers(0, 255, (32, w, 4), np.uint8)
-    enc_s = enc_mod.TPUH264Encoder(width=w, height=h, qp=26)
+    enc_s = enc_mod.TPUH264Encoder(width=w, height=h, qp=26, ltr_scenes=False)
     s = enc_s.encode_frame(f0) + enc_s.encode_frame(f1)
-    enc_f = enc_mod.TPUH264Encoder(width=w, height=h, qp=26)
+    enc_f = enc_mod.TPUH264Encoder(width=w, height=h, qp=26, ltr_scenes=False)
     enc_f._delta_buckets = ()
     t = enc_f.encode_frame(f0) + enc_f.encode_frame(f1)
     assert s == t, "overflow fallback altered the bitstream"
@@ -334,8 +338,10 @@ def test_delta_scroll_nonzero_skip_mvs_bitexact(tmp_path):
         f[64:128, :] = texture[:, 4 * i : 4 * i + w]
         frames.append(f)
 
-    enc_d = TPUH264Encoder(width=w, height=h, qp=26, frame_batch=1)
-    enc_f = TPUH264Encoder(width=w, height=h, qp=26, frame_batch=1)
+    enc_d = TPUH264Encoder(width=w, height=h, qp=26, frame_batch=1,
+                           ltr_scenes=False)
+    enc_f = TPUH264Encoder(width=w, height=h, qp=26, frame_batch=1,
+                           ltr_scenes=False)
     enc_f._delta_buckets = ()
     stream_d = b"".join(enc_d.encode_frame(f) for f in frames)
     stream_f = b"".join(enc_f.encode_frame(f) for f in frames)
@@ -345,7 +351,8 @@ def test_delta_scroll_nonzero_skip_mvs_bitexact(tmp_path):
     assert len(_decode(path)) == len(frames)
 
     # batched grouping over the same scroll must also be bit-exact
-    enc_b = TPUH264Encoder(width=w, height=h, qp=26, frame_batch=4)
+    enc_b = TPUH264Encoder(width=w, height=h, qp=26, frame_batch=4,
+                           ltr_scenes=False)
     outs = []
     for f in frames:
         outs.extend(enc_b.submit(f))

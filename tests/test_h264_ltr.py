@@ -1,0 +1,161 @@
+"""LTR scene cache (the alt-tab optimization): window switches back to a
+remembered scene must encode as tiny deltas against a long-term
+reference — and the resulting bitstream (MMCO 3 marking + ref-list
+modification, bitstream.py write_slice_header) must decode correctly in
+an independent decoder across multiple scene flips."""
+
+import numpy as np
+import pytest
+
+from selkies_tpu.models.h264.encoder import TPUH264Encoder
+
+W, H = 320, 192
+
+
+def _scene(seed):
+    rng = np.random.default_rng(seed)
+    return np.kron(rng.integers(40, 200, (H // 16, W // 16, 4), np.uint8),
+                   np.ones((16, 16, 1), np.uint8))
+
+
+def _type_line(frame, rng):
+    f = frame.copy()
+    f[64:80, 40:280, :3] = rng.integers(0, 255, (16, 240, 1), np.uint8)
+    return f
+
+
+def _flip_trace():
+    """A0(IDR) A1 A2 | B0(cut) B1 | A?(restore) A | B(restore) Bstatic"""
+    rng = np.random.default_rng(7)
+    a, b = _scene(1), _scene(2)
+    a1 = _type_line(a, rng)
+    a2 = _type_line(a1, rng)
+    b1 = _type_line(b, rng)
+    frames = [a, a1, a2, b, b1, a2, _type_line(a2, rng), b1, b1]
+    #         0  1   2   3  4   5       6                7   8(static)
+    return frames
+
+
+def _decode(stream: bytes, tmp_path):
+    import cv2
+
+    path = str(tmp_path / "ltr.h264")
+    with open(path, "wb") as f:
+        f.write(stream)
+    cap = cv2.VideoCapture(path)
+    out = []
+    while True:
+        ok, fr = cap.read()
+        if not ok:
+            break
+        out.append(fr)
+    return out
+
+
+def _luma(frame_bgrx):
+    from selkies_tpu.models.libvpx_enc import _bgrx_to_i420_np
+
+    return _bgrx_to_i420_np(frame_bgrx)[0].astype(float)
+
+
+def _psnr(src, dec_bgr):
+    got = (0.114 * dec_bgr[..., 0] + 0.587 * dec_bgr[..., 1]
+           + 0.299 * dec_bgr[..., 2]) * (235 - 16) / 255 + 16
+    return 10 * np.log10(255**2 / max(1e-9, np.mean((src - got) ** 2)))
+
+
+@pytest.mark.parametrize("frame_batch", [1, 4])
+def test_scene_restore_is_cheap_and_decodes(tmp_path, frame_batch):
+    enc = TPUH264Encoder(W, H, qp=28, frame_batch=frame_batch,
+                         scene_qp_boost=0, pipeline_depth=0)
+    frames = _flip_trace()
+    aus, stats = [], []
+    for f in frames:
+        for au, st, _ in enc.submit(f):
+            aus.append(au)
+            stats.append(st)
+    for au, st, _ in enc.flush():
+        aus.append(au)
+        stats.append(st)
+    assert len(aus) == len(frames)
+    # frames 5 and 7 flip back to remembered scenes -> served from cache
+    assert enc.ltr_restores >= 2, f"restores={enc.ltr_restores}"
+    # a restore must be far smaller than the cold scene cut (frame 3) —
+    # it re-encodes only the lines typed since the scene was stashed
+    cut_bytes = stats[3].bytes
+    restore_bytes = stats[5].bytes
+    assert restore_bytes < cut_bytes // 2, (restore_bytes, cut_bytes)
+
+    decoded = _decode(b"".join(aus), tmp_path)
+    assert len(decoded) == len(frames), "LTR bitstream must decode fully"
+    for i, (src, dec) in enumerate(zip(frames, decoded)):
+        p = _psnr(_luma(src), dec)
+        assert p > 30, f"frame {i} PSNR {p:.1f}"
+    enc.close()
+
+
+def test_restore_to_identical_capture_is_tiny(tmp_path):
+    """Alt-tab straight back with nothing changed: the restore re-sends
+    one idempotent tile and the decoder shows the remembered scene."""
+    enc = TPUH264Encoder(W, H, qp=28, frame_batch=1, scene_qp_boost=0,
+                         pipeline_depth=0)
+    a, b = _scene(1), _scene(2)
+    frames = [a, b, a, b]
+    aus = []
+    for f in frames:
+        aus += [x[0] for x in enc.submit(f)]
+    aus += [x[0] for x in enc.flush()]
+    assert enc.ltr_restores == 2  # both flips back hit the cache
+    sizes = [len(x) for x in aus]
+    assert sizes[2] < sizes[1] // 4, sizes
+    assert sizes[3] < sizes[1] // 4, sizes
+    decoded = _decode(b"".join(aus), tmp_path)
+    assert len(decoded) == 4
+    assert _psnr(_luma(a), decoded[2]) > 30
+    assert _psnr(_luma(b), decoded[3]) > 30
+    enc.close()
+
+
+def test_static_frame_after_cut_carries_the_marking(tmp_path):
+    """The MMCO 3 marking rides whatever slice follows the cut — here an
+    all-skip static slice — and the later restore still decodes."""
+    enc = TPUH264Encoder(W, H, qp=28, frame_batch=1, scene_qp_boost=0,
+                         pipeline_depth=0)
+    a, b = _scene(1), _scene(2)
+    frames = [a, a, b, b, b, a]  # IDR, static, cut, static, static, restore
+    aus = []
+    for f in frames:
+        aus += [x[0] for x in enc.submit(f)]
+    aus += [x[0] for x in enc.flush()]
+    assert enc.ltr_restores == 1
+    decoded = _decode(b"".join(aus), tmp_path)
+    assert len(decoded) == len(frames)
+    assert _psnr(_luma(a), decoded[5]) > 30
+    enc.close()
+
+
+def test_forced_idr_clears_the_scene_cache():
+    enc = TPUH264Encoder(W, H, qp=28, frame_batch=1, scene_qp_boost=0,
+                         pipeline_depth=0)
+    a, b = _scene(1), _scene(2)
+    for f in (a, b):
+        enc.submit(f)
+    enc.force_keyframe()
+    enc.submit(a)  # IDR: decoder DPB reset -> cache must not be trusted
+    assert enc._ltr_slots == [None, None] or enc._ltr_slots[1] is None
+    enc.submit(b)  # would be a restore only if stale state survived
+    enc.flush()
+    # b was forgotten at the IDR; no restore may have happened for it
+    assert enc.ltr_restores <= 1
+    enc.close()
+
+
+def test_ltr_disabled_never_restores():
+    enc = TPUH264Encoder(W, H, qp=28, frame_batch=1, scene_qp_boost=0,
+                         pipeline_depth=0, ltr_scenes=False)
+    a, b = _scene(1), _scene(2)
+    for f in (a, b, a, b):
+        enc.submit(f)
+    enc.flush()
+    assert enc.ltr_restores == 0
+    enc.close()

@@ -14,15 +14,9 @@ from selkies_tpu.transport.rtp_vpx import (
 
 
 def _frames(n=4, w=320, h=192):
-    rng = np.random.default_rng(3)
-    cur = np.kron(rng.integers(40, 200, (h // 16, w // 16, 4), np.uint8),
-                  np.ones((16, 16, 1), np.uint8))
-    out = []
-    for _ in range(n):
-        cur = cur.copy()
-        cur[40:56, 40:200, :3] = rng.integers(0, 255, (16, 160, 1), np.uint8)
-        out.append(cur)
-    return out
+    from conftest import codec_trace
+
+    return codec_trace(n, w, h, seed=3)
 
 
 @pytest.mark.skipif(not libvpx_available(), reason="libvpx not present")
@@ -74,7 +68,7 @@ def test_vp8_descriptor_bits():
     assert all(p.payload[2:4] == pid0 for p in pkts)
 
 
-def test_peer_rejects_codec_mismatch(event_loop_or_new=None):
+def test_peer_rejects_codec_mismatch():
     import asyncio
 
     from selkies_tpu.transport.webrtc.peer import PeerConnection

@@ -23,6 +23,8 @@ enc.flush()
 enc.encode_frame(frames[i])
 enc.encode_frame(frames[29 % len(frames)])
 enc.encode_frame(frames[29 % len(frames)])
+enc.encode_frame(frames[0])  # LTR restore path (compiles scatter_ltr)
+enc.encode_frame(frames[1])
 
 t_all0 = time.perf_counter()
 prev = t_all0

@@ -1,0 +1,73 @@
+#!/usr/bin/env python3
+"""Frontend dev echo server — serve web/ and echo the signalling+input
+wire protocols without a real session behind them (the reference's
+`web.py` dev harness, re-pointed at this tree).
+
+    python tools/web_echo.py [--port 8081]
+
+What it does:
+  * serves selkies_tpu/web/ as static files;
+  * accepts /ws signalling connections, answers HELLO, and echoes every
+    other message back (so client-side protocol handling can be
+    exercised in the browser console);
+  * accepts /media and /input WebSocket connections and logs + echoes
+    frames, letting the client's reconnect/backoff paths run.
+
+No encoder, no TPU, no X server — purely a client dev loop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import pathlib
+
+from aiohttp import WSMsgType, web
+
+logging.basicConfig(level=logging.INFO, format="%(name)s %(message)s")
+logger = logging.getLogger("web_echo")
+
+WEB_ROOT = pathlib.Path(__file__).resolve().parent.parent / "selkies_tpu" / "web"
+
+
+async def ws_echo(request: web.Request) -> web.WebSocketResponse:
+    ws = web.WebSocketResponse()
+    await ws.prepare(request)
+    name = request.path
+    logger.info("%s connected", name)
+    async for msg in ws:
+        if msg.type == WSMsgType.TEXT:
+            logger.info("%s <- %s", name, msg.data[:120])
+            if msg.data.startswith("HELLO"):
+                await ws.send_str("HELLO")
+            else:
+                await ws.send_str(msg.data)
+        elif msg.type == WSMsgType.BINARY:
+            logger.info("%s <- %d bytes", name, len(msg.data))
+            await ws.send_bytes(msg.data)
+    logger.info("%s closed", name)
+    return ws
+
+
+def make_app() -> web.Application:
+    app = web.Application()
+    app.router.add_get("/ws", ws_echo)
+    app.router.add_get("/media", ws_echo)
+    app.router.add_get("/input", ws_echo)
+    app.router.add_get(
+        "/", lambda r: web.FileResponse(WEB_ROOT / "index.html"))
+    app.router.add_static("/", WEB_ROOT)
+    return app
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=8081)
+    args = ap.parse_args()
+    logger.info("serving %s on http://0.0.0.0:%d", WEB_ROOT, args.port)
+    web.run_app(make_app(), port=args.port, print=None)
+
+
+if __name__ == "__main__":
+    main()
