@@ -87,6 +87,9 @@ FLAGS: tuple[Flag, ...] = (
     _f("turn_port", 3478, "TURN server port."),
     _f("turn_protocol", "udp", "TURN transport protocol: udp or tcp."),
     _f("turn_tls", False, "Use TURN over TLS."),
+    _f("turn_tls_insecure", False,
+       "Skip TLS certificate verification for turns:// (self-signed coturn "
+       "fleets / raw-IP TURN hosts whose certs cannot verify)."),
     _f("turn_username", "", "Legacy long-term TURN username."),
     _f("turn_password", "", "Legacy long-term TURN password."),
     _f("turn_shared_secret", "", "HMAC shared secret for short-term TURN credentials."),

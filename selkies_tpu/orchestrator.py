@@ -236,7 +236,8 @@ class Orchestrator:
             using_webrtc_csv=bool(cfg.enable_webrtc_statistics),
         )
         self.ws_transport = WebSocketTransport()
-        self.webrtc = WebRTCTransport(audio=opus_available())
+        self.webrtc = WebRTCTransport(audio=opus_available(),
+                                      turn_tls_insecure=bool(cfg.turn_tls_insecure))
         self.transport = TransportMux(self.ws_transport, self.webrtc)
         # ximagesrc parity: capture the real X root window when a DISPLAY is
         # reachable; otherwise the synthetic test source (headless rigs).

@@ -45,6 +45,7 @@ class PeerConnection:
                  stun_server=None, turn_server=None,
                  turn_username: str = "", turn_password: str = "",
                  turn_transport: str = "udp",
+                 turn_tls_insecure: bool = False,
                  loop: asyncio.AbstractEventLoop | None = None):
         self.codec = codec
         self.audio = audio
@@ -52,7 +53,8 @@ class PeerConnection:
         self.ice = IceAgent(stun_server=stun_server, turn_server=turn_server,
                             turn_username=turn_username,
                             turn_password=turn_password,
-                            turn_transport=turn_transport, loop=self._loop)
+                            turn_transport=turn_transport,
+                            turn_tls_insecure=turn_tls_insecure, loop=self._loop)
         self.ice.on_data = self._on_transport_data
         self.cert_der, self.key_der, self.fingerprint = make_certificate()
         self.dtls: DtlsEndpoint | None = None

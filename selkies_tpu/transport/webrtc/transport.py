@@ -27,13 +27,15 @@ class WebRTCTransport:
                  stun_server: tuple[str, int] | None = None,
                  turn_server: tuple[str, int] | None = None,
                  turn_username: str = "", turn_password: str = "",
-                 turn_transport: str = "udp"):
+                 turn_transport: str = "udp",
+                 turn_tls_insecure: bool = False):
         self._kw = dict(codec=codec, audio=audio,
                         fec_percentage=fec_percentage,
                         stun_server=stun_server,
                         turn_server=turn_server, turn_username=turn_username,
                         turn_password=turn_password,
-                        turn_transport=turn_transport)
+                        turn_transport=turn_transport,
+                        turn_tls_insecure=turn_tls_insecure)
         self.pc: PeerConnection | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
         self._input_ch = None
@@ -64,12 +66,16 @@ class WebRTCTransport:
 
     def set_ice_servers(self, *, stun_server=None, turn_server=None,
                         turn_username: str = "", turn_password: str = "",
-                        turn_transport: str = "udp") -> None:
+                        turn_transport: str = "udp",
+                        turn_tls_insecure: bool | None = None) -> None:
         """Late-bind the resolved STUN/TURN servers (the credential chain
-        resolves after construction); applies to the NEXT peer."""
+        resolves after construction); applies to the NEXT peer.
+        turn_tls_insecure=None keeps the constructor-time setting."""
         self._kw.update(stun_server=stun_server, turn_server=turn_server,
                         turn_username=turn_username, turn_password=turn_password,
                         turn_transport=turn_transport)
+        if turn_tls_insecure is not None:
+            self._kw["turn_tls_insecure"] = turn_tls_insecure
 
     # -- session lifecycle -------------------------------------------
 

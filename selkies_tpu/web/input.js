@@ -17,6 +17,7 @@ class SelkiesInput {
     this.pointerLock = false;
     this._gamepadTimer = null;
     this._attached = [];
+    this._keys = new KeyTracker();
   }
 
   attach() {
@@ -27,13 +28,23 @@ class SelkiesInput {
     };
     on(window, "keydown", (ev) => this._key(ev, true));
     on(window, "keyup", (ev) => this._key(ev, false));
-    on(window, "blur", () => this.send("kr"));
+    on(window, "blur", () => {
+      // release every held key BEFORE the kr reset: the server clears
+      // its modifier state, but explicit ku for remembered keysyms
+      // keeps applications that track keys themselves consistent
+      for (const sym of this._keys.releaseAll()) this.send("ku," + sym);
+      this.send("kr");
+    });
     on(window, "compositionend", (ev) => this._composition(ev));
     on(window, "focus", () => this._uploadClipboard());
     on(c, "mousemove", (ev) => this._mouse(ev));
     on(c, "mousedown", (ev) => this._button(ev, true));
     on(c, "mouseup", (ev) => this._button(ev, false));
     on(c, "wheel", (ev) => this._wheel(ev));
+    on(c, "touchstart", (ev) => this._touchStart(ev));
+    on(c, "touchmove", (ev) => this._touchMove(ev));
+    on(c, "touchend", (ev) => this._touchEnd(ev));
+    on(c, "touchcancel", (ev) => this._touchCancel(ev));
     on(c, "contextmenu", (ev) => ev.preventDefault());
     on(c, "click", () => this._maybePointerLock());
     on(document, "pointerlockchange", () => this._pointerLockChanged());
@@ -120,7 +131,9 @@ class SelkiesInput {
 
   _key(ev, down) {
     if (ev.isComposing || ev.key === "Process") return;  // IME owns these
-    const keysym = keysymFromEvent(ev);
+    // KeyTracker releases the keysym that was PRESSED for this physical
+    // key even if modifiers/layout changed mid-hold (stuck-key bug)
+    const keysym = down ? this._keys.down(ev) : this._keys.up(ev);
     if (keysym === null) return;
     ev.preventDefault();
     this.send((down ? "kd," : "ku,") + keysym);
@@ -153,13 +166,150 @@ class SelkiesInput {
 
   _wheel(ev) {
     ev.preventDefault();
-    // trackpad deltas are small/continuous; wheels jump — derive magnitude
-    const magnitude = Math.min(15, Math.max(1, Math.round(Math.abs(ev.deltaY) / 40)));
-    const bit = ev.deltaY < 0 ? 8 : 16;  // mask bits 3/4 = wheel up/down
+    // trackpad-vs-mouse heuristic (reference input.js:270-325): mouse
+    // wheels report large discrete deltas (~100-120 px or LINE mode);
+    // trackpads stream many small pixel-mode deltas. Discrete wheels
+    // emit scaled ticks directly; trackpad streams ACCUMULATE and emit
+    // one tick per threshold crossing so smooth scrolling doesn't
+    // machine-gun the server with max-rate wheel events.
+    let dy = ev.deltaY;
+    if (ev.deltaMode === 1) dy *= 40;        // DOM_DELTA_LINE
+    else if (ev.deltaMode === 2) dy *= 400;  // DOM_DELTA_PAGE
+    if (dy === 0) return;  // horizontal-only (tilt wheel): no vertical tick
+    const discrete = ev.deltaMode !== 0 || Math.abs(dy) >= 100;
+    let ticks;
+    if (discrete) {
+      this._wheelAcc = 0;
+      ticks = Math.sign(dy) * Math.min(15, Math.max(1, Math.round(Math.abs(dy) / 100)));
+    } else {
+      const SMOOTH_THRESHOLD = 53;  // px per emitted tick
+      this._wheelAcc = (this._wheelAcc || 0) + dy;
+      ticks = Math.trunc(this._wheelAcc / SMOOTH_THRESHOLD);
+      if (ticks === 0) return;
+      this._wheelAcc -= ticks * SMOOTH_THRESHOLD;
+    }
+    const bit = ticks < 0 ? 8 : 16;  // mask bits 3/4 = wheel up/down
     this.buttonMask |= bit;
-    this._sendMouse(ev, magnitude);
+    this._sendMouse(ev, Math.min(15, Math.abs(ticks)));
     this.buttonMask &= ~bit;
     this._sendMouse(ev, 0);
+  }
+
+  // -- touch (touchscreen → pointer protocol) ---------------------------
+
+  _touchPoint(t) {
+    // Touch objects carry the same clientX/clientY the mouse helper reads
+    return this._coords(t);
+  }
+
+  _touchStart(ev) {
+    ev.preventDefault();
+    if (ev.touches.length === 1) {
+      // single finger: move there, press left (press happens on a short
+      // delay so a two-finger gesture can cancel it into a right-click
+      // or scroll — the reference's long-press/tap model simplified)
+      const [x, y] = this._touchPoint(ev.touches[0]);
+      this._touchXY = [x, y];
+      this.send(`m,${x},${y},${this.buttonMask},0`);
+      this._touchTimer = setTimeout(() => {
+        this.buttonMask |= 1;
+        this.send(`m,${x},${y},${this.buttonMask},0`);
+        this._touchTimer = null;
+      }, 60);
+    } else if (ev.touches.length === 2) {
+      // second finger joined: cancel the pending left press; this is a
+      // scroll (moves) or right-click (tap) gesture
+      if (this._touchTimer) { clearTimeout(this._touchTimer); this._touchTimer = null; }
+      if (this.buttonMask & 1) {
+        this.buttonMask &= ~1;
+        const [x, y] = this._touchXY || [0, 0];
+        this.send(`m,${x},${y},${this.buttonMask},0`);
+      }
+      this._twoFingerY = (ev.touches[0].clientY + ev.touches[1].clientY) / 2;
+      this._twoFingerMoved = false;
+    }
+  }
+
+  _touchMove(ev) {
+    ev.preventDefault();
+    if (this._touchGhost) return;  // straggler finger after 2-finger lift
+    if (ev.touches.length === 1) {
+      const [x, y] = this._touchPoint(ev.touches[0]);
+      this._touchXY = [x, y];
+      this.send(`m,${x},${y},${this.buttonMask},0`);
+    } else if (ev.touches.length === 2 && this._twoFingerY !== undefined) {
+      // two-finger drag scrolls like a trackpad (accumulate px → ticks)
+      const y = (ev.touches[0].clientY + ev.touches[1].clientY) / 2;
+      const dy = this._twoFingerY - y;
+      this._twoFingerY = y;
+      if (Math.abs(dy) > 2) this._twoFingerMoved = true;
+      this._wheelAcc = (this._wheelAcc || 0) + dy * (window.devicePixelRatio || 1);
+      const ticks = Math.trunc(this._wheelAcc / 53);
+      if (ticks !== 0) {
+        this._wheelAcc -= ticks * 53;
+        const bit = ticks < 0 ? 8 : 16;
+        const [px, py] = this._touchXY || this._touchPoint(ev.touches[0]);
+        this.buttonMask |= bit;
+        this.send(`m,${px},${py},${this.buttonMask},${Math.min(15, Math.abs(ticks))}`);
+        this.buttonMask &= ~bit;
+        this.send(`m,${px},${py},${this.buttonMask},0`);
+      }
+    }
+  }
+
+  _touchEnd(ev) {
+    ev.preventDefault();
+    if (this._touchTimer) {
+      // finger lifted before the press timer: emit a full click
+      clearTimeout(this._touchTimer);
+      this._touchTimer = null;
+      const [x, y] = this._touchXY || [0, 0];
+      this.buttonMask |= 1;
+      this.send(`m,${x},${y},${this.buttonMask},0`);
+      this.buttonMask &= ~1;
+      this.send(`m,${x},${y},${this.buttonMask},0`);
+      return;
+    }
+    if (this._twoFingerY !== undefined && ev.touches.length < 2) {
+      // staggered lift: tear the gesture down as soon as the SECOND
+      // finger is gone, and swallow the remaining finger's events so a
+      // trailing single touch doesn't teleport the cursor mid-scroll
+      if (!this._twoFingerMoved && ev.touches.length === 0) {
+        // two-finger tap: right click
+        const [x, y] = this._touchXY || [0, 0];
+        this.buttonMask |= 4;
+        this.send(`m,${x},${y},${this.buttonMask},0`);
+        this.buttonMask &= ~4;
+        this.send(`m,${x},${y},${this.buttonMask},0`);
+      }
+      this._twoFingerY = undefined;
+      this._touchGhost = ev.touches.length > 0;  // ignore the straggler
+    }
+    if (ev.touches.length === 0) {
+      this._touchGhost = false;
+      if (this.buttonMask & 1) {
+        const [x, y] = this._touchXY || [0, 0];
+        this.buttonMask &= ~1;
+        this.send(`m,${x},${y},${this.buttonMask},0`);
+      }
+    }
+  }
+
+  _touchCancel(ev) {
+    // the platform aborted the touch (edge swipe, palm rejection,
+    // notification shade): release state WITHOUT synthesizing a click
+    ev.preventDefault();
+    if (this._touchTimer) {
+      clearTimeout(this._touchTimer);
+      this._touchTimer = null;
+    }
+    this._twoFingerY = undefined;
+    this._touchGhost = false;
+    if (this.buttonMask & 1) {
+      const [x, y] = this._touchXY || [0, 0];
+      this.buttonMask &= ~1;
+      this.send(`m,${x},${y},${this.buttonMask},0`);
+    }
   }
 
   _reportResize() {

@@ -91,8 +91,9 @@ function onPlaneEvent(ev) {
     sendInitialPrefs();
     state.renderUi();
   } else if (ev.event === "failed" && state.plane === "rtc") {
-    // WebRTC plane failed: fall back to the WS plane (same policy as
-    // the default client shell)
+    // WebRTC plane failed: release the start guard, fall back to the
+    // WS plane (same policy as the default client shell)
+    started = false;
     state.plane = "ws";
     plane = media;
     videoEl.style.display = "none";
@@ -100,6 +101,7 @@ function onPlaneEvent(ev) {
     media.connect(`${urls.ws}/media`);
     state.renderUi();
   } else if (ev.event === "close") {
+    started = false; // terminal for this attempt: allow the retry
     state.status = "disconnected — retrying";
     setTimeout(start, 2000);
     state.renderUi();
@@ -118,8 +120,16 @@ function sendInitialPrefs() {
 
 let started = false;
 function start() {
+  // reentrancy guard: every plane "close" schedules a retry, and
+  // repeated failure cycles must not stack live SelkiesWebRTC
+  // instances (leaked peer connections + timers). The guard holds
+  // until the attempt terminally fails or closes (onPlaneEvent
+  // clears it); the previous instance is closed before replacement.
   if (started) return;
   started = true;
+  if (rtc && rtc.close) {
+    try { rtc.close(); } catch (e) { logDebug(`rtc close: ${e}`); }
+  }
   state.plane = "rtc";
   rtc = new SelkiesWebRTC(videoEl, onServerMessage, onPlaneEvent);
   plane = /** @type {{send: (m: string) => void}} */ (rtc);
@@ -132,7 +142,6 @@ function start() {
     logDebug(`rtc connect error: ${e}`);
     onPlaneEvent({ event: "failed", reason: String(e) });
   });
-  started = false;
 }
 
 // client metrics upload every 5 s (_f fps, _l latency — reference

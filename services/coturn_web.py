@@ -36,6 +36,7 @@ from __future__ import annotations
 import asyncio
 import base64
 import hashlib
+import hmac
 import json
 import logging
 import os
@@ -196,14 +197,26 @@ class K8sInformer:
                     timeout=aiohttp.ClientTimeout(total=None, sock_read=330),
                 ) as resp:
                     resp.raise_for_status()
-                    async for line in resp.content:
-                        line = line.strip()
-                        if not line:
-                            continue
-                        ev = json.loads(line)
-                        if ev.get("type") == "BOOKMARK":
-                            continue
-                        apply(ev.get("type", ""), ev.get("object", {}))
+                    # manual newline framing: aiohttp's per-line iterator
+                    # enforces a ~64 KiB line limit, and Node watch events
+                    # (managedFields) routinely exceed it — tripping it
+                    # would permanently degrade the informer into a 2 s
+                    # LIST re-poll loop hammering the apiserver
+                    pending = bytearray()
+                    async for chunk in resp.content.iter_any():
+                        pending.extend(chunk)
+                        while True:
+                            nl = pending.find(b"\n")
+                            if nl < 0:
+                                break
+                            line = bytes(pending[:nl]).strip()
+                            del pending[:nl + 1]
+                            if not line:
+                                continue
+                            ev = json.loads(line)
+                            if ev.get("type") == "BOOKMARK":
+                                continue
+                            apply(ev.get("type", ""), ev.get("object", {}))
             except asyncio.CancelledError:
                 raise
             except Exception as exc:
@@ -342,8 +355,11 @@ def htpasswd_match(path: str, username: str, password: str) -> bool:
         if hashed.startswith("{SHA}"):
             digest = base64.b64encode(
                 hashlib.sha1(password.encode()).digest()).decode()
-            return hashed[5:] == digest
-        return hashed == password  # plain entry
+            # constant-time: == on the digest would give a timing oracle
+            # on the credential check. Compare BYTES — compare_digest on
+            # str raises TypeError for non-ASCII passwords
+            return hmac.compare_digest(hashed[5:].encode(), digest.encode())
+        return hmac.compare_digest(hashed.encode(), password.encode())  # plain
     return False
 
 

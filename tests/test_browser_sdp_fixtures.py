@@ -1,0 +1,118 @@
+"""Negotiation against full-size browser-format SDP (tests/fixtures/):
+libwebrtc- and Gecko-shaped answers/offers with the complete codec
+matrices, rtx/apt pairings, msid and extension sets — the messy
+documents a real session hands parse_answer, not this framework's own
+minimal shapes. See fixtures/README.md for provenance."""
+
+import os
+
+import pytest
+
+from selkies_tpu.transport.webrtc import sdp
+
+FIX = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _load(name: str) -> str:
+    with open(os.path.join(FIX, name)) as f:
+        return f.read()
+
+
+def test_chrome_style_h264_answer_negotiates():
+    r = sdp.parse_answer(_load("chrome_answer_h264.sdp"), prefer="h264")
+    assert r.ice_ufrag == "Yh7K"
+    assert r.ice_pwd.startswith("pD3xLmQ9")
+    assert r.fingerprint.startswith("7B:8B:F0:65")
+    assert r.setup == "active"
+    assert r.video_pt == 96 and r.video_codec == "h264"
+    assert r.red_pt == 98 and r.ulpfec_pt == 99
+    assert r.twcc_id == 3 and r.playout_delay_id == 2
+    assert r.sctp_port == 5000
+    assert not r.video_rejected
+
+
+def test_chrome_style_av1_answer_negotiates():
+    r = sdp.parse_answer(_load("chrome_answer_av1.sdp"), prefer="av1")
+    assert r.video_pt == 45 and r.video_codec == "av1"
+    assert r.red_pt == 98 and r.ulpfec_pt == 99
+
+
+def test_rejected_h265_answer_fails_loudly():
+    """A browser without HEVC rejects the m-line JSEP-style (port 0,
+    echoed rtpmap) — peer.set_answer must refuse the session."""
+    import asyncio
+
+    from selkies_tpu.transport.webrtc.peer import PeerConnection
+
+    answer = _load("chrome_answer_no_h265.sdp")
+    r = sdp.parse_answer(answer, prefer="h265")
+    assert r.video_rejected and r.video_pt is None
+
+    async def scenario():
+        pc = PeerConnection(codec="h265", audio=False,
+                            loop=asyncio.get_event_loop())
+        with pytest.raises(ValueError, match="rejected the video section"):
+            await pc.set_answer(answer)
+        pc.close()
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(scenario())
+    finally:
+        loop.close()
+
+
+def test_full_browser_offer_parses_robustly():
+    """The same extractor must swallow a complete unified-plan browser
+    OFFER (the ~30-PT matrix with rtx/red/ulpfec rows, 11 extensions,
+    actpass setup) without tripping on any line."""
+    r = sdp.parse_answer(_load("chrome_offer_full.sdp"), prefer="h264")
+    assert r.setup == "actpass"
+    # first H264 rtpmap in the matrix wins for an h264 session
+    assert r.video_pt == 102 and r.video_codec == "h264"
+    assert r.twcc_id == 4
+    assert r.playout_delay_id == 5
+    # red 47 is video RED; audio red/48000 must not be confused with it
+    assert r.red_pt == 47
+    assert r.ulpfec_pt == 114
+    r2 = sdp.parse_answer(_load("chrome_offer_full.sdp"), prefer="vp9")
+    assert r2.video_pt == 98 and r2.video_codec == "vp9"
+    r3 = sdp.parse_answer(_load("chrome_offer_full.sdp"), prefer="av1")
+    assert r3.video_pt == 41 and r3.video_codec == "av1"
+
+
+def test_firefox_style_answer_negotiates():
+    r = sdp.parse_answer(_load("firefox_answer_h264.sdp"), prefer="h264")
+    assert r.video_pt == 96 and r.video_codec == "h264"
+    assert r.ice_ufrag == "8ac417de"
+    assert r.setup == "active"
+    assert r.twcc_id == 3
+    assert r.playout_delay_id is None  # Gecko doesn't offer playout-delay
+
+
+def test_trickled_candidate_lines_parse():
+    """Browser trickle candidates carry trailing libwebrtc attributes
+    (generation/ufrag/network-id/network-cost) the parser must ignore;
+    the TCP candidate is legitimately rejected (UDP-only agent)."""
+    from selkies_tpu.transport.webrtc.ice import Candidate, IceAgent
+
+    lines = [ln for ln in _load("chrome_candidates.txt").splitlines() if ln]
+    assert len(lines) == 5
+    parsed = []
+    for ln in lines:
+        try:
+            parsed.append(Candidate.from_sdp(ln))
+        except ValueError:
+            assert " tcp " in ln, f"only the TCP line may be rejected: {ln}"
+    kinds = sorted(c.typ for c in parsed)
+    assert kinds.count("host") == 2
+    assert "srflx" in kinds and "relay" in kinds
+    srflx = next(c for c in parsed if c.typ == "srflx")
+    assert srflx.ip == "203.0.113.57" and srflx.port == 58712
+    assert srflx.raddr == "192.168.1.34" and srflx.rport == 58712
+    # the agent accepts them as remote pairs
+    agent = IceAgent()
+    for ln in lines:
+        agent.add_remote_candidate(ln)
+    assert len(agent._pairs) == 4
+    agent.close()

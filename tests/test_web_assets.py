@@ -39,13 +39,89 @@ def test_sw_shell_matches_files():
         assert os.path.exists(os.path.join(WEB, name)), f"sw.js caches missing {name}"
 
 
+def _parse_js_table(src: str, name: str) -> dict:
+    """Parse a `const NAME = { "Key": 0x.., ... };` JS literal."""
+    body = re.search(rf"const {name} = \{{(.*?)\n\}};", src, re.S).group(1)
+    out = {}
+    for key, val in re.findall(r'"([^"]+)":\s*(0x[0-9a-fA-F]+|\d+)', body):
+        out[key] = int(val, 0)
+    return out
+
+
+# W3C UI Events key values -> X11 keysymdef constants (the coverage the
+# reference's vendored guacamole-keyboard table provides, keyed by the
+# standard instead of by its code). Values from X11/keysymdef.h +
+# XF86keysym.h — public constant data.
+KEYSYM_FIXTURE = {
+    "Backspace": 0xFF08, "Tab": 0xFF09, "Enter": 0xFF0D, "Escape": 0xFF1B,
+    "Delete": 0xFFFF, "Home": 0xFF50, "End": 0xFF57, "PageUp": 0xFF55,
+    "PageDown": 0xFF56, "ArrowLeft": 0xFF51, "ArrowUp": 0xFF52,
+    "ArrowRight": 0xFF53, "ArrowDown": 0xFF54, "Insert": 0xFF63,
+    "Pause": 0xFF13, "ScrollLock": 0xFF14, "PrintScreen": 0xFF61,
+    "CapsLock": 0xFFE5, "NumLock": 0xFF7F, "ContextMenu": 0xFF67,
+    "Shift": 0xFFE1, "Control": 0xFFE3, "Alt": 0xFFE9, "AltGraph": 0xFE03,
+    "Meta": 0xFFE7, "Super": 0xFFEB, "Hyper": 0xFFED,
+    "F1": 0xFFBE, "F12": 0xFFC9, "F24": 0xFFD5,
+    "Compose": 0xFF20, "Convert": 0xFF23, "NonConvert": 0xFF22,
+    "KanaMode": 0xFF2D, "HiraganaKatakana": 0xFF27, "Hiragana": 0xFF25,
+    "Katakana": 0xFF26, "ZenkakuHankaku": 0xFF2A, "Romaji": 0xFF24,
+    "HangulMode": 0xFF31, "HanjaMode": 0xFF34, "Eisu": 0xFF2F,
+    "AllCandidates": 0xFF3D, "PreviousCandidate": 0xFF3E,
+    "CodeInput": 0xFF37,
+    "Undo": 0xFF65, "Redo": 0xFF66, "Find": 0xFF68, "Help": 0xFF6A,
+    "Select": 0xFF60, "Execute": 0xFF62, "Attn": 0xFD0E, "CrSel": 0xFD1C,
+    "ExSel": 0xFD1D, "EraseEof": 0xFD06, "Play": 0xFD16,
+    "AudioVolumeMute": 0x1008FF12, "AudioVolumeDown": 0x1008FF11,
+    "AudioVolumeUp": 0x1008FF13, "MediaPlayPause": 0x1008FF14,
+    "MediaStop": 0x1008FF15, "MediaTrackPrevious": 0x1008FF16,
+    "MediaTrackNext": 0x1008FF17, "BrowserBack": 0x1008FF26,
+    "BrowserForward": 0x1008FF27, "BrowserRefresh": 0x1008FF29,
+    "BrowserHome": 0x1008FF18, "BrowserSearch": 0x1008FF1B,
+    "Eject": 0x1008FF2C, "Sleep": 0x1008FF2F, "WakeUp": 0x1008FF2B,
+    "Copy": 0x1008FF57, "Cut": 0x1008FF58, "Paste": 0x1008FF6D,
+}
+
+RIGHT_FIXTURE = {"Shift": 0xFFE2, "Control": 0xFFE4, "Alt": 0xFFEA,
+                 "Meta": 0xFFE8, "Super": 0xFFEC, "Hyper": 0xFFEE}
+
+NUMPAD_FIXTURE = {"0": 0xFFB0, "9": 0xFFB9, ".": 0xFFAE, "+": 0xFFAB,
+                  "-": 0xFFAD, "*": 0xFFAA, "/": 0xFFAF, "Enter": 0xFF8D,
+                  "Home": 0xFF95, "Delete": 0xFF9F}
+
+# X11 dead_* keysyms the dead-key code table must be able to produce
+DEAD_KEYSYMS = {0xFE50, 0xFE51, 0xFE52, 0xFE53, 0xFE57}
+
+
 def test_keysym_table_coverage():
+    """The translation tables must carry the keysymdef-correct value for
+    every key the reference's vendored guacamole table covers."""
     ks = _read("keysyms.js")
-    # the protocol-critical groups the reference's guacamole table covers
-    for required in ("F24", "KEYSYMS_NUMPAD", "AudioVolumeMute",
-                     "BrowserBack", "Compose", "KanaMode", "HangulMode",
-                     "keysymFromCodepoint", "0xffe2"):
-        assert required in ks, f"keysym table lacks {required}"
+    table = _parse_js_table(ks, "KEYSYMS_BY_KEY")
+    for key, expect in KEYSYM_FIXTURE.items():
+        assert table.get(key) == expect, (
+            f"{key}: {hex(table.get(key, 0))} != keysymdef {hex(expect)}")
+    right = _parse_js_table(ks, "KEYSYMS_RIGHT")
+    for key, expect in RIGHT_FIXTURE.items():
+        assert right.get(key) == expect, key
+    numpad = _parse_js_table(ks, "KEYSYMS_NUMPAD")
+    for key, expect in NUMPAD_FIXTURE.items():
+        assert numpad.get(key) == expect, key
+    for required in ("keysymFromCodepoint", "keysymFromLegacy",
+                     "DEAD_BY_CODE", "class KeyTracker", "releaseAll"):
+        assert required in ks, f"keysyms.js lacks {required}"
+    dead_vals = {int(v, 0) for v in re.findall(r"(0xfe5[0-9a-f])", ks)}
+    assert DEAD_KEYSYMS <= dead_vals, "dead-key table incomplete"
+
+
+def test_input_uses_key_tracker_and_touch():
+    """input.js must route keys through the tracker (stuck-key fix),
+    release held keys on blur, and carry the touch + trackpad-wheel
+    handlers (reference input.js:270-325 parity)."""
+    src = _read("input.js")
+    for required in ("KeyTracker", "releaseAll", "_touchStart",
+                     "_touchMove", "_touchEnd", "touchstart",
+                     "deltaMode", "_wheelAcc"):
+        assert required in src, f"input.js lacks {required}"
 
 
 def test_input_protocol_verbs_match_host():
