@@ -7,6 +7,8 @@
  */
 "use strict";
 
+const SMOOTH_SCROLL_PX = 53;  // px of smooth scroll per wheel tick
+
 class SelkiesInput {
   constructor(canvas, send) {
     this.canvas = canvas;
@@ -182,17 +184,24 @@ class SelkiesInput {
       this._wheelAcc = 0;
       ticks = Math.sign(dy) * Math.min(15, Math.max(1, Math.round(Math.abs(dy) / 100)));
     } else {
-      const SMOOTH_THRESHOLD = 53;  // px per emitted tick
       this._wheelAcc = (this._wheelAcc || 0) + dy;
-      ticks = Math.trunc(this._wheelAcc / SMOOTH_THRESHOLD);
+      ticks = Math.trunc(this._wheelAcc / SMOOTH_SCROLL_PX);
       if (ticks === 0) return;
-      this._wheelAcc -= ticks * SMOOTH_THRESHOLD;
+      this._wheelAcc -= ticks * SMOOTH_SCROLL_PX;
     }
+    const [x, y] = this._coords(ev);
+    this._emitWheelTicks(ticks, x, y);
+  }
+
+  /* Emit |ticks| wheel scrolls at (x, y): shared by the wheel handler
+   * and the two-finger touch scroll so the bit/pair protocol lives in
+   * one place. */
+  _emitWheelTicks(ticks, x, y) {
     const bit = ticks < 0 ? 8 : 16;  // mask bits 3/4 = wheel up/down
     this.buttonMask |= bit;
-    this._sendMouse(ev, Math.min(15, Math.abs(ticks)));
+    this.send(`m,${x},${y},${this.buttonMask},${Math.min(15, Math.abs(ticks))}`);
     this.buttonMask &= ~bit;
-    this._sendMouse(ev, 0);
+    this.send(`m,${x},${y},${this.buttonMask},0`);
   }
 
   // -- touch (touchscreen → pointer protocol) ---------------------------
@@ -212,8 +221,10 @@ class SelkiesInput {
       this._touchXY = [x, y];
       this.send(`m,${x},${y},${this.buttonMask},0`);
       this._touchTimer = setTimeout(() => {
+        // read the CURRENT position: a fast touch-drag has moved since
+        const [px, py] = this._touchXY;
         this.buttonMask |= 1;
-        this.send(`m,${x},${y},${this.buttonMask},0`);
+        this.send(`m,${px},${py},${this.buttonMask},0`);
         this._touchTimer = null;
       }, 60);
     } else if (ev.touches.length === 2) {
@@ -227,6 +238,7 @@ class SelkiesInput {
       }
       this._twoFingerY = (ev.touches[0].clientY + ev.touches[1].clientY) / 2;
       this._twoFingerMoved = false;
+      if (!this._touchXY) this._touchXY = this._touchPoint(ev.touches[0]);
     }
   }
 
@@ -243,16 +255,14 @@ class SelkiesInput {
       const dy = this._twoFingerY - y;
       this._twoFingerY = y;
       if (Math.abs(dy) > 2) this._twoFingerMoved = true;
-      this._wheelAcc = (this._wheelAcc || 0) + dy * (window.devicePixelRatio || 1);
-      const ticks = Math.trunc(this._wheelAcc / 53);
+      // separate accumulator from the wheel path: residue from one
+      // modality must not bias the other's first tick
+      this._touchScrollAcc = (this._touchScrollAcc || 0) + dy * (window.devicePixelRatio || 1);
+      const ticks = Math.trunc(this._touchScrollAcc / SMOOTH_SCROLL_PX);
       if (ticks !== 0) {
-        this._wheelAcc -= ticks * 53;
-        const bit = ticks < 0 ? 8 : 16;
+        this._touchScrollAcc -= ticks * SMOOTH_SCROLL_PX;
         const [px, py] = this._touchXY || this._touchPoint(ev.touches[0]);
-        this.buttonMask |= bit;
-        this.send(`m,${px},${py},${this.buttonMask},${Math.min(15, Math.abs(ticks))}`);
-        this.buttonMask &= ~bit;
-        this.send(`m,${px},${py},${this.buttonMask},0`);
+        this._emitWheelTicks(ticks, px, py);
       }
     }
   }
@@ -271,11 +281,13 @@ class SelkiesInput {
       return;
     }
     if (this._twoFingerY !== undefined && ev.touches.length < 2) {
-      // staggered lift: tear the gesture down as soon as the SECOND
-      // finger is gone, and swallow the remaining finger's events so a
-      // trailing single touch doesn't teleport the cursor mid-scroll
-      if (!this._twoFingerMoved && ev.touches.length === 0) {
-        // two-finger tap: right click
+      // staggered lift: tear the gesture down (and fire the tap) as
+      // soon as the FIRST finger leaves — browsers deliver one touchend
+      // per finger, so waiting for length 0 would drop the gesture.
+      // Swallow the remaining finger's events afterwards so a trailing
+      // single touch doesn't teleport the cursor mid-scroll.
+      if (!this._twoFingerMoved) {
+        // two-finger tap: right click at the gesture position
         const [x, y] = this._touchXY || [0, 0];
         this.buttonMask |= 4;
         this.send(`m,${x},${y},${this.buttonMask},0`);
@@ -283,6 +295,7 @@ class SelkiesInput {
         this.send(`m,${x},${y},${this.buttonMask},0`);
       }
       this._twoFingerY = undefined;
+      this._touchScrollAcc = 0;
       this._touchGhost = ev.touches.length > 0;  // ignore the straggler
     }
     if (ev.touches.length === 0) {

@@ -98,6 +98,11 @@ function onPlaneEvent(ev) {
     plane = media;
     videoEl.style.display = "none";
     canvas.style.display = "";
+    // input must follow the visible surface: a display:none element
+    // receives no mouse/touch events
+    input.detach();
+    input.canvas = canvas;
+    input.attach();
     media.connect(`${urls.ws}/media`);
     state.renderUi();
   } else if (ev.event === "close") {

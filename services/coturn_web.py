@@ -205,6 +205,13 @@ class K8sInformer:
                     pending = bytearray()
                     async for chunk in resp.content.iter_any():
                         pending.extend(chunk)
+                        if len(pending) > 32 << 20:
+                            # replaces the 64 KiB guard this framing
+                            # bypassed: a newline-free stream (middlebox
+                            # error body) must not grow without bound
+                            raise RuntimeError(
+                                "watch stream exceeded 32 MiB without a "
+                                "newline; re-listing")
                         while True:
                             nl = pending.find(b"\n")
                             if nl < 0:

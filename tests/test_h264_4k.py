@@ -1,0 +1,65 @@
+"""4K30 geometry (BASELINE.json configs row 4): tpuh264enc at 3840x2160
+— SPS level derivation, delta buckets, downlink caps, and FFmpeg decode
+all scale past the 1080p envelope.
+
+Gated behind SELKIES_TEST_4K=1: a 4K frame costs ~5 s on the CPU
+backend, which would dominate the suite; tools/profile_4k.py runs the
+same sequence on the chip for PERF.md numbers. The ungated part checks
+the host-side geometry math (levels, buckets) which is instant."""
+
+import os
+
+import numpy as np
+import pytest
+
+from selkies_tpu.models.h264.bitstream import StreamParams
+
+W, H = 3840, 2160
+
+
+def test_4k_level_derivation():
+    p = StreamParams(width=W, height=H, fps=30)
+    assert p.mb_width == 240 and p.mb_height == 135
+    # 32400 MBs @30fps needs level 5.1 (MaxFS 36864, MaxMBPS 983040)
+    assert p.level_idc == 51
+
+
+def test_4k_encoder_geometry_scales():
+    from selkies_tpu.models.h264.encoder import TPUH264Encoder
+
+    enc = TPUH264Encoder(W, H, qp=30, frame_batch=1, pipeline_depth=0)
+    try:
+        # tile buckets and the sparse-downlink sizing must scale with the
+        # 4x MB count, not stay pinned at 1080p assumptions
+        ntiles = (enc._pad_h // 16) * (enc._pad_w // enc._tile_w)
+        assert ntiles >= 4000
+        assert enc._delta_buckets and enc._delta_buckets[-1] <= ntiles // 2
+        assert enc._pfx_total > 0
+    finally:
+        enc.close()
+
+
+@pytest.mark.skipif(not os.environ.get("SELKIES_TEST_4K"),
+                    reason="4K CPU encode ~5 s/frame; SELKIES_TEST_4K=1 enables")
+def test_4k_sequence_encodes_and_decodes(tmp_path):
+    import cv2
+
+    from selkies_tpu.models.h264.encoder import TPUH264Encoder
+
+    rng = np.random.default_rng(1)
+    base = np.kron(rng.integers(40, 200, (H // 40, W // 40, 4), np.uint8),
+                   np.ones((40, 40, 1), np.uint8))
+    f1 = base.copy()
+    f1[512:528, 600:1750, :3] = rng.integers(0, 255, (16, 1150, 1), np.uint8)
+    enc = TPUH264Encoder(W, H, qp=30, frame_batch=1, pipeline_depth=0)
+    aus = [enc.encode_frame(f) for f in (base, f1, f1)]
+    enc.close()
+    assert len(aus[2]) < 100  # static all-skip
+    path = str(tmp_path / "k4.h264")
+    with open(path, "wb") as f:
+        f.write(b"".join(aus))
+    cap = cv2.VideoCapture(path)
+    n = 0
+    while cap.read()[0]:
+        n += 1
+    assert n == 3

@@ -1,0 +1,203 @@
+#!/usr/bin/env python3
+"""B-frames vs IP-only at equal bitrate on the desktop trace — the
+measurement behind PERF.md's GOP-structure decision (BASELINE.json row 4
+names "B-frames + rate-control stress"; the reference's own rows all run
+bframes=0 zerolatency).
+
+Uses libx264 for BOTH arms so the comparison isolates GOP structure from
+encoder implementation: arm A is the production zerolatency tuning
+(bframes=0), arm B enables 2 B-frames with lookahead. Reports encoder
+delay (frames in before the first AU emerges — the latency floor B-frame
+reordering imposes), achieved bitrate, and decoded PSNR vs source.
+
+    python tools/measure_bframes.py [--width 960] [--height 540]
+"""
+
+from __future__ import annotations
+
+import argparse
+import ctypes
+import struct as _struct
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from selkies_tpu.models.libvpx_enc import _bgrx_to_i420_np  # noqa: E402
+from selkies_tpu.models.x264enc import (  # noqa: E402
+    _CSP_I420, _NAL_PAYLOAD_PTR_OFF, _OFF_CSP, _OFF_HEIGHT, _OFF_IMG_PLANES,
+    _OFF_PLANES, _OFF_PTS, _OFF_STRIDES, _OFF_WIDTH, _PARAM_BYTES, _PIC_BYTES,
+    _load_and_verify,
+)
+
+
+def desktop_trace(w, h, n=60):
+    rng = np.random.default_rng(42)
+    base = np.kron(rng.integers(40, 200, (h // 20, w // 20, 4), np.uint8),
+                   np.ones((20, 20, 1), np.uint8))
+    alt = np.kron(rng.integers(40, 200, (h // 20, w // 20, 4), np.uint8),
+                  np.ones((20, 20, 1), np.uint8))
+    frames, cur, which = [], base.copy(), 0
+    for i in range(n):
+        if i % 30 == 29:
+            which ^= 1
+            cur = (alt if which else base).copy()
+        else:
+            row = (h // 4) + ((i * 16) % 64)
+            line = rng.integers(0, 2, (12, w // 3), np.uint8) * 255
+            cur = cur.copy()
+            cur[row:row + 12, 40:40 + w // 3, :3] = line[..., None]
+        frames.append(cur)
+    return frames
+
+
+class Arm:
+    """One libx264 configuration, measured."""
+
+    def __init__(self, w, h, fps, kbps, bframes: int, lookahead: bool = True):
+        lib = _load_and_verify()
+        assert lib is not None, "libx264 required"
+        lib.x264_encoder_delayed_frames.restype = ctypes.c_int
+        lib.x264_encoder_delayed_frames.argtypes = [ctypes.c_void_p]
+        self.lib, self.w, self.h = lib, w, h
+        param = (ctypes.c_uint8 * _PARAM_BYTES)()
+        if bframes == 0:
+            assert lib.x264_param_default_preset(param, b"ultrafast", b"zerolatency") == 0
+        else:
+            # B-frame arm: same speed class, lookahead enabled (B-frames
+            # are useless without it — the encoder must see the future)
+            assert lib.x264_param_default_preset(param, b"ultrafast", b"") == 0
+
+        def p(k, v):
+            assert lib.x264_param_parse(param, k.encode(), v.encode()) == 0, k
+
+        p("bitrate", str(kbps)); p("vbv-maxrate", str(kbps))
+        p("vbv-bufsize", str(max(1, int(kbps * (1.5 if bframes == 0 else 30) / fps))))
+        p("fps", f"{fps}/1"); p("keyint", "infinite")
+        p("repeat-headers", "1"); p("annexb", "1"); p("threads", "4")
+        p("bframes", str(bframes))
+        if bframes and lookahead:
+            p("b-adapt", "1"); p("rc-lookahead", "20")
+        elif bframes:
+            # minimal-latency B config: fixed B placement, no lookahead —
+            # isolates the irreducible reorder delay B-frames impose
+            p("b-adapt", "0"); p("rc-lookahead", "0")
+            p("sync-lookahead", "0"); p("mbtree", "0")
+        else:
+            p("rc-lookahead", "0"); p("sync-lookahead", "0"); p("mbtree", "0")
+        _struct.pack_into("<i", param, _OFF_WIDTH, w)
+        _struct.pack_into("<i", param, _OFF_HEIGHT, h)
+        _struct.pack_into("<i", param, _OFF_CSP, _CSP_I420)
+        self.h264 = lib._open(param)
+        assert self.h264
+        self.pic = (ctypes.c_uint8 * _PIC_BYTES)()
+        assert lib.x264_picture_alloc(self.pic, _CSP_I420, w, h) == 0
+        pb = bytes(self.pic)
+        self.strides = _struct.unpack_from("<3i", pb, _OFF_STRIDES)
+        self.planes = _struct.unpack_from("<3Q", pb, _OFF_PLANES)
+        self.pic_out = (ctypes.c_uint8 * _PIC_BYTES)()
+        self.pts = 0
+
+    def encode(self, frame):
+        y, u, v = _bgrx_to_i420_np(frame)
+        for plane, arr, stride in zip(self.planes, (y, u, v), self.strides):
+            hh, ww = arr.shape
+            src = np.ascontiguousarray(arr)
+            if stride == ww:
+                ctypes.memmove(plane, src.ctypes.data, hh * ww)
+            else:
+                for r in range(hh):
+                    ctypes.memmove(plane + r * stride, src.ctypes.data + r * ww, ww)
+        _struct.pack_into("<q", self.pic, _OFF_PTS, self.pts)
+        _struct.pack_into("<i", self.pic, 0, 0)  # X264_TYPE_AUTO
+        self.pts += 1
+        nal_ptr = ctypes.c_void_p(); n_nal = ctypes.c_int()
+        size = self.lib.x264_encoder_encode(
+            self.h264, ctypes.byref(nal_ptr), ctypes.byref(n_nal),
+            self.pic, self.pic_out)
+        if size > 0 and n_nal.value > 0:
+            payload = ctypes.cast(nal_ptr.value + _NAL_PAYLOAD_PTR_OFF,
+                                  ctypes.POINTER(ctypes.c_uint64))[0]
+            return ctypes.string_at(payload, size)
+        return b""
+
+    def flush(self):
+        out = []
+        while self.lib.x264_encoder_delayed_frames(self.h264) > 0:
+            nal_ptr = ctypes.c_void_p(); n_nal = ctypes.c_int()
+            size = self.lib.x264_encoder_encode(
+                self.h264, ctypes.byref(nal_ptr), ctypes.byref(n_nal),
+                None, self.pic_out)
+            if size > 0 and n_nal.value > 0:
+                payload = ctypes.cast(nal_ptr.value + _NAL_PAYLOAD_PTR_OFF,
+                                      ctypes.POINTER(ctypes.c_uint64))[0]
+                out.append(ctypes.string_at(payload, size))
+            elif size <= 0:
+                break
+        return out
+
+
+def run_arm(name, frames, w, h, fps, kbps, bframes, lookahead=True):
+    import cv2
+
+    arm = Arm(w, h, fps, kbps, bframes, lookahead)
+    delay = None
+    aus = []
+    t0 = time.perf_counter()
+    for i, f in enumerate(frames):
+        au = arm.encode(f)
+        if au:
+            if delay is None:
+                delay = i  # frames buffered before the first AU emerged
+            aus.append(au)
+    aus += arm.flush()
+    wall = time.perf_counter() - t0
+    stream = b"".join(aus)
+    path = f"/tmp/bf_{name}.h264"
+    open(path, "wb").write(stream)
+    cap = cv2.VideoCapture(path)
+    decoded = []
+    while True:
+        ok, fr = cap.read()
+        if not ok:
+            break
+        decoded.append(fr)
+    psnrs = []
+    for src_f, dec in zip(frames, decoded):
+        sl = _bgrx_to_i420_np(src_f)[0].astype(float)
+        got = (0.114 * dec[..., 0] + 0.587 * dec[..., 1]
+               + 0.299 * dec[..., 2]) * (235 - 16) / 255 + 16
+        psnrs.append(10 * np.log10(255**2 / max(1e-9, np.mean((sl - got) ** 2))))
+    kbps_real = len(stream) * 8 * fps / len(frames) / 1000
+    print(f"{name:>12}: delay={delay} frames ({delay * 1000 // fps} ms), "
+          f"rate={kbps_real:.0f} kbps, mean PSNR={np.mean(psnrs):.2f} dB "
+          f"(min {np.min(psnrs):.2f}), {len(decoded)} decoded, "
+          f"{len(frames)/wall:.0f} fps encode")
+    return delay, kbps_real, float(np.mean(psnrs))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--width", type=int, default=960)
+    ap.add_argument("--height", type=int, default=540)
+    ap.add_argument("--kbps", type=int, default=2500)
+    ap.add_argument("--fps", type=int, default=30)
+    args = ap.parse_args()
+    frames = desktop_trace(args.width, args.height)
+    d0, r0, p0 = run_arm("IP (prod)", frames, args.width, args.height,
+                         args.fps, args.kbps, 0)
+    dm, rm, pm = run_arm("IPB minimal", frames, args.width, args.height,
+                         args.fps, args.kbps, 2, lookahead=False)
+    d2, r2, p2 = run_arm("IPB+lookahd", frames, args.width, args.height,
+                         args.fps, args.kbps, 2)
+    print(f"\nminimal B-frames: {pm - p0:+.2f} dB at rate {rm:.0f} vs {r0:.0f} kbps, "
+          f"+{(dm - d0) * 1000 // args.fps} ms encoder latency")
+    print(f"lookahead B-frames: {p2 - p0:+.2f} dB at rate {r2:.0f} kbps, "
+          f"+{(d2 - d0) * 1000 // args.fps} ms encoder latency "
+          f"(plus decoder reorder delay on the client)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+#!/usr/bin/env python3
+"""4K30 on the chip: IDR, full-P, delta, static, and LTR restore at
+3840x2160 — the PERF.md numbers for BASELINE.json configs row 4.
+
+Run ALONE (owns the TPU): python tools/profile_4k.py
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from selkies_tpu.models.h264.encoder import TPUH264Encoder  # noqa: E402
+
+W, H = 3840, 2160
+
+
+def trace():
+    rng = np.random.default_rng(1)
+    a = np.kron(rng.integers(40, 200, (H // 40, W // 40, 4), np.uint8),
+                np.ones((40, 40, 1), np.uint8))
+    b = np.kron(rng.integers(40, 200, (H // 40, W // 40, 4), np.uint8),
+                np.ones((40, 40, 1), np.uint8))
+    frames = []
+    cur = a.copy()
+    for i in range(30):
+        if i == 20:
+            cur = b.copy()          # window switch
+        elif i == 25:
+            cur = frames[19].copy()  # switch BACK -> LTR restore
+        elif i % 7 in (3, 4):
+            pass                     # static
+        else:
+            cur = cur.copy()
+            row = 512 + (i * 16) % 128
+            cur[row:row + 12, 600:1750, :3] = rng.integers(
+                0, 255, (12, 1150, 1), np.uint8)
+        frames.append(cur)
+    return frames
+
+
+def main():
+    frames = trace()
+    enc = TPUH264Encoder(W, H, qp=30)
+    print(f"frame_batch={enc.frame_batch} depth={enc.pipeline_depth}")
+    t0 = time.perf_counter()
+    enc.encode_frame(frames[0])
+    print(f"IDR compile+run: {time.perf_counter() - t0:.1f}s")
+    # warm every executable the loop uses
+    i = 1
+    for _ in range(enc.frame_batch):
+        enc.submit(frames[i]); i += 1
+    enc.flush()
+    enc.encode_frame(frames[20])  # full-P (scene cut)
+    enc.encode_frame(frames[25])  # restore path
+    enc.encode_frame(frames[1])
+
+    done = 0
+    t0 = time.perf_counter()
+    for i in range(30):
+        done += len(enc.submit(frames[i]))
+    done += len(enc.flush())
+    dt = time.perf_counter() - t0
+    print(f"4K30 trace: {done} frames in {dt:.2f}s -> {done / dt:.1f} fps "
+          f"(target 30); restores={enc.ltr_restores}")
+    enc.close()
+
+
+if __name__ == "__main__":
+    main()
