@@ -31,6 +31,7 @@ import numpy as np
 from selkies_tpu.models.h264.numpy_ref import PFrameCoeffs
 
 from selkies_tpu.models.frameprep import FramePrep
+from selkies_tpu.monitoring.tracing import tracer
 from selkies_tpu.models.stats import FrameStats as _FrameStats
 from selkies_tpu.models.h264.bitstream import StreamParams, write_pps, write_sps
 from selkies_tpu.models.h264.compact import (
@@ -1143,14 +1144,16 @@ class TPUH264Encoder:
         if rec.kind == "pb":
             return self._complete_bits(rec)
         if rec.kind == "pd":
-            fused = np.asarray(rec.pfx_slice_d)
+            with tracer.span("fetch"):
+                fused = np.asarray(rec.pfx_slice_d)
             t1 = time.perf_counter()
             pfc, rows = self._unpack_sparse_var(fused, rec.prefix_d, rec.buf_d, rec.qp)
             if pfc is None:
                 pfc = unpack_p_compact(np.asarray(rec.hdr_d), rows, rec.qp)
-            au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num,
-                                   ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
-                                   mmco_evict=rec.mmco_evict)
+            with tracer.span("pack"):
+                au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num,
+                                       ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
+                                       mmco_evict=rec.mmco_evict)
             self._pfx_hint = self._pfx_slice_len()
             return au, int(pfc.skip.sum()), t1, time.perf_counter()
         hdr_words = self._hdr_words_i if rec.kind == "i" else self._hdr_words_p

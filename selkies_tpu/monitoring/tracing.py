@@ -1,0 +1,170 @@
+"""First-party in-pipeline tracing — the GStreamer coretracers analogue
+(SURVEY §5: the reference leans on GST_TRACERS=latency/stats; this
+framework owns its pipeline, so it owns the tracer too).
+
+Design: a process-global span recorder with near-zero cost when
+disabled (one attribute read per span). Hot-path stages (capture,
+classify, upload, device step, fetch, entropy pack, payload, send) wrap
+themselves in `with tracer.span("stage"):`; each completed span lands
+in a fixed ring buffer and folds into per-name aggregates (count /
+total / min / max / EWMA). Two export surfaces:
+
+* `summary()` — per-stage aggregate dict (the stats-tracer view),
+  served by the signalling server's `/trace` endpoint and printable
+  from tools/.
+* `chrome_trace()` — Chrome trace-event JSON (the latency-tracer
+  view): load the dump straight into chrome://tracing / Perfetto and
+  see the pipeline's stage overlap on a timeline, worker threads
+  included.
+
+Enable with SELKIES_TRACING=1 (or tracer.enable()); the ring holds the
+most recent `capacity` spans (default 8192 ≈ 2-3 s of a busy 1080p60
+pipeline across ~5 stages).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import threading
+import time
+from collections import deque
+
+__all__ = ["Tracer", "tracer", "span"]
+
+
+class _Span:
+    """Context manager recording one stage execution."""
+
+    __slots__ = ("t", "name", "t0")
+
+    def __init__(self, t: "Tracer", name: str):
+        self.t = t
+        self.name = name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.t._record(self.name, self.t0, time.perf_counter())
+        return False
+
+
+class _Noop:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _Noop()
+
+
+class Tracer:
+    def __init__(self, capacity: int = 8192):
+        self.enabled = bool(os.environ.get("SELKIES_TRACING"))
+        self.capacity = capacity
+        self._ring: deque = deque(maxlen=capacity)
+        self._agg: dict[str, list] = {}  # name -> [count, total, min, max, ewma]
+        self._lock = threading.Lock()
+        self._epoch = time.perf_counter()
+
+    # -- control -------------------------------------------------------
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._agg.clear()
+            self._epoch = time.perf_counter()
+
+    # -- recording -----------------------------------------------------
+
+    def span(self, name: str):
+        """`with tracer.span("encode"):` — no-op object when disabled."""
+        if not self.enabled:
+            return _NOOP
+        return _Span(self, name)
+
+    def instant(self, name: str) -> None:
+        """Zero-duration marker (frame drops, forced IDRs, reconnects)."""
+        if self.enabled:
+            now = time.perf_counter()
+            self._record(name, now, now)
+
+    def _record(self, name: str, t0: float, t1: float) -> None:
+        dur = t1 - t0
+        # lane id: the asyncio task when inside one (concurrent sessions
+        # on one loop must not share a chrome-trace track — overlapping
+        # sibling events render as bogus nesting), the thread otherwise.
+        # Async spans measure await-INCLUSIVE wall time by design.
+        try:
+            task = asyncio.current_task()
+        except RuntimeError:
+            task = None
+        tid = id(task) if task is not None else threading.get_ident()
+        with self._lock:
+            self._ring.append((name, t0 - self._epoch, dur, tid))
+            a = self._agg.get(name)
+            if a is None:
+                self._agg[name] = [1, dur, dur, dur, dur]
+            else:
+                a[0] += 1
+                a[1] += dur
+                if dur < a[2]:
+                    a[2] = dur
+                if dur > a[3]:
+                    a[3] = dur
+                a[4] += 0.05 * (dur - a[4])  # EWMA, ~20-sample horizon
+
+    # -- export --------------------------------------------------------
+
+    def summary(self) -> dict:
+        """Per-stage aggregates in milliseconds (stats-tracer view)."""
+        with self._lock:
+            return {
+                name: {
+                    "count": a[0],
+                    "mean_ms": round(a[1] / a[0] * 1e3, 3),
+                    "min_ms": round(a[2] * 1e3, 3),
+                    "max_ms": round(a[3] * 1e3, 3),
+                    "ewma_ms": round(a[4] * 1e3, 3),
+                }
+                for name, a in self._agg.items()
+            }
+
+    def chrome_trace(self) -> str:
+        """Trace-event JSON for chrome://tracing / Perfetto (latency-
+        tracer view: stage overlap across threads on a timeline)."""
+        with self._lock:
+            events = [
+                {
+                    "name": name,
+                    "ph": "X",
+                    "ts": round(rel * 1e6, 1),   # microseconds
+                    "dur": round(dur * 1e6, 1),
+                    "pid": 1,
+                    "tid": tid % 100000,
+                }
+                for name, rel, dur, tid in self._ring
+            ]
+        return json.dumps({"traceEvents": events, "displayTimeUnit": "ms"})
+
+
+# the process-global tracer every stage uses
+tracer = Tracer()
+
+
+def span(name: str):
+    """Module-level convenience: `with tracing.span("pack"):`."""
+    return tracer.span(name)

@@ -20,6 +20,7 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from selkies_tpu.models.frameprep import FramePrep
 from selkies_tpu.models.h264.bitstream import StreamParams, write_pps, write_sps
 from selkies_tpu.models.h264.native import pack_slice_fast, pack_slice_p_fast
 from selkies_tpu.models.h264.numpy_ref import FrameCoeffs, PFrameCoeffs
@@ -58,6 +59,19 @@ class MultiSessionH264Service:
         self._headers = write_sps(self.params) + write_pps(self.params)
         self.sessions = [_SessionState(qp) for _ in range(n_sessions)]
         self._pool = ThreadPoolExecutor(max_workers=n_sessions, thread_name_prefix="ms-pack")
+        # host-side BGRx->I420 (the solo encoder's production path): one
+        # native converter per session, run concurrently on the pack pool
+        # — removes the ~14 ms/tick on-device colorspace + padded-frame
+        # cost that held the mixed tick at ~43 fps/session (PERF.md)
+        self._preps = [FramePrep(width, height, width, height, nslots=2)
+                       for _ in range(n_sessions)]
+        # persistent batch planes: workers copy each session's converted
+        # planes into its slice, avoiding a fresh np.stack allocation
+        # every tick (~4.5 MB/session of alloc+copy at 1080p); the
+        # remaining host->device copy is the sharded device_put itself
+        self._batch_y = np.empty((n_sessions, height, width), np.uint8)
+        self._batch_u = np.empty((n_sessions, height // 2, width // 2), np.uint8)
+        self._batch_v = np.empty((n_sessions, height // 2, width // 2), np.uint8)
 
     def set_qp(self, session: int, qp: int) -> None:
         if not 0 <= qp <= 51:
@@ -75,12 +89,21 @@ class MultiSessionH264Service:
             [s.force_idr or s.frames_since_idr == 0 for s in self.sessions], bool
         )
         qps = np.array([s.qp for s in self.sessions], np.int32)
+        # concurrent per-session host conversion (native frameprep)
+        def _convert_into(i: int) -> None:
+            y, u, v = self._preps[i].convert(frames[i])
+            np.copyto(self._batch_y[i], y)
+            np.copyto(self._batch_u[i], u)
+            np.copyto(self._batch_v[i], v)
+
+        list(self._pool.map(_convert_into, range(self.n)))
+        batch = (self._batch_y, self._batch_u, self._batch_v)
         if self.enc._ref is None:
             # first tick: no reference planes exist, everyone starts a GOP
             idrs[:] = True
-            out = self.enc.encode_idr(frames, qps)
+            out = self.enc.encode_idr(batch, qps)
         else:
-            out = self.enc.encode_mixed(frames, qps, idrs)
+            out = self.enc.encode_mixed(batch, qps, idrs)
         # fetch the coefficient batch once, then pack per session in
         # parallel (independent streams). Branch-filler fields are
         # skipped when no session took that branch — the all-zero

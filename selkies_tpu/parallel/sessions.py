@@ -51,33 +51,53 @@ class MultiSessionEncoder:
     group. The per-session reference frames live sharded in HBM.
     """
 
-    def __init__(self, n_sessions: int, width: int, height: int, devices=None):
+    def __init__(self, n_sessions: int, width: int, height: int, devices=None,
+                 host_convert: bool = True):
         if width % 16 or height % 16:
             raise ValueError("multi-session geometry must be MB-aligned")
         self.n = n_sessions
         self.width = width
         self.height = height
+        # host_convert (production default): BGRx->I420 runs on the host
+        # (native frameprep, one worker per session) and the device tick
+        # is pure encode — the on-device colorspace + padded-frame
+        # handling cost ~14 ms/tick of the 8x1080p60 envelope (PERF.md
+        # round-3 measurement); the serving layer owns the conversion.
+        # host_convert=False keeps conversion in the jit (link-rich
+        # PCIe-local hosts that prefer 4 B/px uploads of raw BGRx).
+        self.host_convert = bool(host_convert)
         self.mesh = _session_mesh(n_sessions, devices)
         shard = NamedSharding(self.mesh, P("session"))
 
-        def one_i(frame, qp):
-            y, u, v = bgrx_to_i420(frame)
-            return encode_frame_planes(y, u, v, qp)
+        if self.host_convert:
+            def one_i(y, u, v, qp):
+                return encode_frame_planes(y, u, v, qp)
 
-        def one_p(frame, qp, ry, ru, rv):
-            y, u, v = bgrx_to_i420(frame)
-            return encode_frame_p_planes(y, u, v, ry, ru, rv, qp)
+            def one_p(y, u, v, qp, ry, ru, rv):
+                return encode_frame_p_planes(y, u, v, ry, ru, rv, qp)
+
+            n_in_i, n_in_p = 4, 7
+        else:
+            def one_i(frame, qp):
+                y, u, v = bgrx_to_i420(frame)
+                return encode_frame_planes(y, u, v, qp)
+
+            def one_p(frame, qp, ry, ru, rv):
+                y, u, v = bgrx_to_i420(frame)
+                return encode_frame_p_planes(y, u, v, ry, ru, rv, qp)
+
+            n_in_i, n_in_p = 2, 5
 
         self._step_i = jax.jit(
             jax.vmap(one_i),
-            in_shardings=(shard, shard),
+            in_shardings=(shard,) * n_in_i,
             out_shardings=shard,
         )
         self._step_p = jax.jit(
             jax.vmap(one_p),
-            in_shardings=(shard,) * 5,
+            in_shardings=(shard,) * n_in_p,
             out_shardings=shard,
-            donate_argnums=(2, 3, 4),
+            donate_argnums=tuple(range(n_in_p - 3, n_in_p)),
         )
 
         # mixed per-session I/P tick: shard_map gives each chip a REAL
@@ -88,8 +108,12 @@ class MultiSessionEncoder:
         # chip is one branch only.
         mbh, mbw = height // 16, width // 16
 
-        def one_mixed(frame, qp, idr, ry, ru, rv):
-            y, u, v = bgrx_to_i420(frame)
+        def one_mixed(*args):
+            if self.host_convert:
+                y, u, v, qp, idr, ry, ru, rv = args
+            else:
+                frame, qp, idr, ry, ru, rv = args
+                y, u, v = bgrx_to_i420(frame)
 
             def branch_i(_):
                 out = encode_frame_planes(y, u, v, qp)
@@ -106,21 +130,22 @@ class MultiSessionEncoder:
 
             return jax.lax.cond(idr, branch_i, branch_p, None)
 
-        def mixed(frames, qps, idrs, ry, ru, rv):
-            out = one_mixed(frames[0], qps[0], idrs[0], ry[0], ru[0], rv[0])
+        def mixed(*arrs):
+            out = one_mixed(*(a[0] for a in arrs))
             return jax.tree_util.tree_map(lambda a: a[None], out)
 
         spec = P("session")
+        n_in_m = 8 if self.host_convert else 6
         self._step_mixed = jax.jit(
             jax.shard_map(
                 mixed, mesh=self.mesh,
-                in_specs=(spec,) * 6, out_specs=spec,
+                in_specs=(spec,) * n_in_m, out_specs=spec,
                 # the encode scans carry replicated-initialized state that
                 # becomes device-varying after one step; skip the varying-
                 # axis type check (every input/output is fully sharded)
                 check_vma=False,
             ),
-            donate_argnums=(3, 4, 5),
+            donate_argnums=tuple(range(n_in_m - 3, n_in_m)),
         )
         self._shard = shard
         self._ref = None
@@ -128,6 +153,15 @@ class MultiSessionEncoder:
     def put_frames(self, frames: np.ndarray):
         """(N, H, W, 4) uint8 host batch -> session-sharded device array."""
         return jax.device_put(frames, self._shard)
+
+    def _put_inputs(self, frames_or_planes):
+        """host_convert: (y, u, v) batched plane arrays; else BGRx batch."""
+        if self.host_convert:
+            y, u, v = frames_or_planes
+            return (jax.device_put(np.asarray(y), self._shard),
+                    jax.device_put(np.asarray(u), self._shard),
+                    jax.device_put(np.asarray(v), self._shard))
+        return (self.put_frames(np.asarray(frames_or_planes)),)
 
     def _keep_ref(self, out):
         # recon planes are internal decoder state: they are donated into the
@@ -141,7 +175,7 @@ class MultiSessionEncoder:
         return out
 
     def encode_idr(self, frames, qps: np.ndarray):
-        out = dict(self._step_i(self.put_frames(np.asarray(frames)), jnp.asarray(qps, jnp.int32)))
+        out = dict(self._step_i(*self._put_inputs(frames), jnp.asarray(qps, jnp.int32)))
         return self._keep_ref(out)
 
     def encode_p(self, frames, qps: np.ndarray):
@@ -149,7 +183,7 @@ class MultiSessionEncoder:
             raise RuntimeError("encode_idr must run first (no reference frames)")
         out = dict(
             self._step_p(
-                self.put_frames(np.asarray(frames)), jnp.asarray(qps, jnp.int32), *self._ref
+                *self._put_inputs(frames), jnp.asarray(qps, jnp.int32), *self._ref
             )
         )
         return self._keep_ref(out)
@@ -157,30 +191,46 @@ class MultiSessionEncoder:
     def encode_mixed(self, frames, qps: np.ndarray, idrs: np.ndarray):
         """Per-session I/P in ONE device tick: idrs (N,) bool selects the
         branch per chip. Requires an established reference (first tick
-        goes through encode_idr)."""
+        goes through encode_idr). `frames` is (y, u, v) plane batches in
+        host_convert mode, a BGRx batch otherwise."""
         if self._ref is None:
             raise RuntimeError("encode_idr must run first (no reference frames)")
         out = dict(
             self._step_mixed(
-                self.put_frames(np.asarray(frames)), jnp.asarray(qps, jnp.int32),
+                *self._put_inputs(frames), jnp.asarray(qps, jnp.int32),
                 jnp.asarray(np.asarray(idrs, bool)), *self._ref
             )
         )
         return self._keep_ref(out)
 
 
+def _host_planes(frames: np.ndarray):
+    """Batched host BGRx->I420 through the PRODUCTION converter
+    (FramePrep — the same native path serving.py runs per session), so
+    the dryrun validates the conversion that actually ships."""
+    from selkies_tpu.models.frameprep import FramePrep
+
+    n, h, w, _ = frames.shape
+    prep = FramePrep(w, h, w, h, nslots=1)
+    ys, us, vs = zip(*(tuple(np.array(p, copy=True) for p in prep.convert(f))
+                       for f in frames))
+    return np.stack(ys), np.stack(us), np.stack(vs)
+
+
 def dryrun(n_devices: int) -> None:
     """Driver hook: compile + run the FULL multi-session step (IDR path and
-    steady-state P path with ME) over an n-device session mesh, tiny shapes."""
+    steady-state P path with ME) over an n-device session mesh, tiny
+    shapes — the PRODUCTION host-convert mode plus the device-convert
+    variant."""
     h = w = 64
     rng = np.random.default_rng(0)
-    enc = MultiSessionEncoder(n_devices, w, h)
+    enc = MultiSessionEncoder(n_devices, w, h)  # host_convert production mode
     frames = rng.integers(0, 256, (n_devices, h, w, 4), dtype=np.uint8)
     qps = np.full(n_devices, 28, np.int32)
-    out_i = enc.encode_idr(frames, qps)
+    out_i = enc.encode_idr(_host_planes(frames), qps)
     jax.block_until_ready(out_i)
     frames2 = np.roll(frames, 3, axis=2)
-    out_p = enc.encode_p(frames2, qps)
+    out_p = enc.encode_p(_host_planes(frames2), qps)
     jax.block_until_ready(out_p)
     assert out_p["mvs"].shape == (n_devices, h // 16, w // 16, 2)
     assert enc._ref[0].shape == (n_devices, h, w)
@@ -192,7 +242,11 @@ def dryrun(n_devices: int) -> None:
     # branch vector so a lowering break can't slip past the dryrun
     idrs = np.zeros(n_devices, bool)
     idrs[::2] = True  # heterogeneous for any n >= 2: branch divergence real
-    out_m = enc.encode_mixed(np.roll(frames2, 2, axis=1), qps, idrs)
+    out_m = enc.encode_mixed(_host_planes(np.roll(frames2, 2, axis=1)), qps, idrs)
     jax.block_until_ready(out_m)
     assert out_m["mvs"].shape == (n_devices, h // 16, w // 16, 2)
     assert out_m["luma_mode"].shape == (n_devices, h // 16, w // 16)
+    # device-convert variant stays compilable (PCIe-local deployments)
+    enc2 = MultiSessionEncoder(n_devices, w, h, host_convert=False)
+    out2 = enc2.encode_idr(frames, qps)
+    jax.block_until_ready(out2)

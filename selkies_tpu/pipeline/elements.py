@@ -20,6 +20,8 @@ from typing import Awaitable, Callable, Protocol
 
 import numpy as np
 
+from selkies_tpu.monitoring.tracing import tracer
+
 logger = logging.getLogger("pipeline")
 
 
@@ -149,9 +151,11 @@ class VideoPipeline:
                 # sink can't keep up: skip this capture tick (pre-encode
                 # drop keeps the encoded P-chain gapless)
                 self.dropped_frames += 1
+                tracer.instant("frame-drop")
                 continue
             try:
-                frame = await asyncio.to_thread(self.source.capture)
+                with tracer.span("capture"):
+                    frame = await asyncio.to_thread(self.source.capture)
                 if frame.shape[:2] != (self.encoder.height, self.encoder.width):
                     # xrandr resize landed (capture.py re-arms its SHM at the
                     # new geometry): rebuild the encoder for the new size —
@@ -173,7 +177,8 @@ class VideoPipeline:
                 if hasattr(self.encoder, "submit"):
                     # pipelined path: dispatch this frame, emit whichever
                     # earlier frames completed (device latency hidden)
-                    done = await asyncio.to_thread(self.encoder.submit, frame, qp, ts)
+                    with tracer.span("submit"):
+                        done = await asyncio.to_thread(self.encoder.submit, frame, qp, ts)
                     efs = [
                         EncodedFrame(
                             au=au,
@@ -188,7 +193,8 @@ class VideoPipeline:
                         for au, stats, meta in done
                     ]
                 else:
-                    au = await asyncio.to_thread(self.encoder.encode_frame, frame, qp)
+                    with tracer.span("encode"):
+                        au = await asyncio.to_thread(self.encoder.encode_frame, frame, qp)
                     stats = self.encoder.last_stats
                     efs = [
                         EncodedFrame(
@@ -225,7 +231,8 @@ class VideoPipeline:
             while self._outbox:
                 ef = self._outbox.popleft()
                 try:
-                    await self.sink(ef)
+                    with tracer.span("send"):
+                        await self.sink(ef)
                 except asyncio.CancelledError:
                     raise
                 except Exception:

@@ -197,7 +197,33 @@ class SignallingServer:
         if path.rstrip("/") == "/turn":
             return self._serve_turn(request, cors)
 
+        if path.rstrip("/") == "/trace":
+            return self._serve_trace(request, cors)
+
         return await self._serve_static(request, cors)
+
+    def _serve_trace(self, request: web.Request, cors: dict[str, str]) -> web.Response:
+        """First-party pipeline tracer dump (monitoring/tracing.py):
+        default is the per-stage summary; ?format=chrome returns a
+        chrome://tracing / Perfetto-loadable trace-event document;
+        ?reset=1 clears the ring after the dump. Requires tracing to be
+        enabled (SELKIES_TRACING=1), else 404s like any unknown path."""
+        from selkies_tpu.monitoring.tracing import tracer
+
+        headers = dict(cors)
+        if not tracer.enabled:
+            headers["Content-Type"] = "text/plain"
+            return web.Response(
+                status=404, headers=headers,
+                text="tracing disabled (set SELKIES_TRACING=1)\n")
+        headers["Content-Type"] = "application/json"
+        if request.query.get("format") == "chrome":
+            body = tracer.chrome_trace()
+        else:
+            body = json.dumps(tracer.summary(), indent=2)
+        if request.query.get("reset") in ("1", "true"):
+            tracer.reset()
+        return web.Response(status=200, text=body, headers=headers)
 
     def _serve_turn(self, request: web.Request, cors: dict[str, str]) -> web.Response:
         opts = self.options

@@ -110,9 +110,17 @@ def test_trickled_candidate_lines_parse():
     srflx = next(c for c in parsed if c.typ == "srflx")
     assert srflx.ip == "203.0.113.57" and srflx.port == 58712
     assert srflx.raddr == "192.168.1.34" and srflx.rport == 58712
-    # the agent accepts them as remote pairs
-    agent = IceAgent()
-    for ln in lines:
-        agent.add_remote_candidate(ln)
-    assert len(agent._pairs) == 4
-    agent.close()
+    # the agent accepts them as remote pairs (explicit loop: the agent
+    # grabs the current event loop at construction, and a prior test may
+    # have closed this thread's)
+    import asyncio
+
+    loop = asyncio.new_event_loop()
+    try:
+        agent = IceAgent(loop=loop)
+        for ln in lines:
+            agent.add_remote_candidate(ln)
+        assert len(agent._pairs) == 4
+        agent.close()
+    finally:
+        loop.close()

@@ -24,9 +24,11 @@ def test_dryrun_8_sessions():
     dryrun(8)
 
 
-def test_sessions_match_single_chip():
+@pytest.mark.parametrize("host_convert", [True, False])
+def test_sessions_match_single_chip(host_convert):
     """Sharded batch must produce bit-identical coefficients to running
-    each session alone — placement must never change the bitstream."""
+    each session alone — placement (and the host-vs-device conversion
+    mode) must never change the bitstream."""
     _need(4)
     h = w = 48
     rng = np.random.default_rng(42)
@@ -35,9 +37,15 @@ def test_sessions_match_single_chip():
     f2[:, 16:32, 16:32] = rng.integers(0, 256, (4, 16, 16, 4))
     qps = np.array([20, 26, 30, 40], np.int32)
 
-    enc = MultiSessionEncoder(4, w, h)
-    out_i = enc.encode_idr(f1, qps)
-    out_p = enc.encode_p(f2, qps)
+    enc = MultiSessionEncoder(4, w, h, host_convert=host_convert)
+    if host_convert:
+        from selkies_tpu.parallel.sessions import _host_planes
+
+        out_i = enc.encode_idr(_host_planes(f1), qps)
+        out_p = enc.encode_p(_host_planes(f2), qps)
+    else:
+        out_i = enc.encode_idr(f1, qps)
+        out_p = enc.encode_p(f2, qps)
 
     for s in range(4):
         y, u, v = bgrx_to_i420(f1[s])

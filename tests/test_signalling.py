@@ -263,3 +263,41 @@ def test_session_teardown_closes_partner(loop):
         await srv.stop()
 
     loop.run_until_complete(scenario())
+
+
+def test_trace_endpoint(loop):
+    """/trace serves the first-party tracer: 404 while disabled, summary
+    + chrome-trace JSON + reset once enabled (monitoring/tracing.py)."""
+    from selkies_tpu.monitoring.tracing import tracer
+
+    async def scenario():
+        srv = SignallingServer(SignallingOptions(addr="127.0.0.1", port=0))
+        await srv.start()
+        base = f"http://127.0.0.1:{srv.bound_port}"
+        was_enabled = tracer.enabled
+        try:
+            async with aiohttp.ClientSession() as http:
+                tracer.disable()
+                r = await http.get(base + "/trace")
+                assert r.status == 404
+
+                tracer.enable()
+                tracer.reset()
+                with tracer.span("encode"):
+                    pass
+                r = await http.get(base + "/trace")
+                assert r.status == 200
+                summary = json.loads(await r.text())
+                assert summary["encode"]["count"] == 1
+
+                r = await http.get(base + "/trace?format=chrome&reset=1")
+                doc = json.loads(await r.text())
+                assert doc["traceEvents"][0]["name"] == "encode"
+                r = await http.get(base + "/trace")
+                assert json.loads(await r.text()) == {}  # reset took
+        finally:
+            tracer.enabled = was_enabled
+            tracer.reset()
+        await srv.stop()
+
+    loop.run_until_complete(scenario())

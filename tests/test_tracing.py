@@ -1,0 +1,81 @@
+"""First-party pipeline tracer (monitoring/tracing.py): span recording,
+aggregates, Chrome trace export, and the disabled-path no-op."""
+
+import json
+import threading
+import time
+
+from selkies_tpu.monitoring.tracing import Tracer
+
+
+def test_disabled_is_noop():
+    t = Tracer()
+    t.disable()
+    with t.span("encode"):
+        pass
+    t.instant("drop")
+    assert t.summary() == {}
+    assert json.loads(t.chrome_trace())["traceEvents"] == []
+
+
+def test_spans_aggregate_and_export():
+    t = Tracer()
+    t.enable()
+    for _ in range(5):
+        with t.span("encode"):
+            time.sleep(0.002)
+    with t.span("pack"):
+        time.sleep(0.001)
+    t.instant("forced-idr")
+    s = t.summary()
+    assert s["encode"]["count"] == 5
+    assert 1.0 < s["encode"]["mean_ms"] < 50
+    assert s["encode"]["min_ms"] <= s["encode"]["mean_ms"] <= s["encode"]["max_ms"]
+    assert s["pack"]["count"] == 1
+    assert s["forced-idr"]["count"] == 1
+
+    doc = json.loads(t.chrome_trace())
+    events = doc["traceEvents"]
+    assert len(events) == 7
+    enc = [e for e in events if e["name"] == "encode"]
+    assert all(e["ph"] == "X" and e["dur"] > 1000 for e in enc)  # µs
+    # timestamps monotone within the ring
+    ts = [e["ts"] for e in events]
+    assert ts == sorted(ts)
+
+
+def test_ring_capacity_bounds_memory():
+    t = Tracer(capacity=16)
+    t.enable()
+    for i in range(100):
+        t.instant(f"e{i % 4}")
+    assert len(json.loads(t.chrome_trace())["traceEvents"]) == 16
+    # aggregates keep counting past the ring
+    assert sum(v["count"] for v in t.summary().values()) == 100
+
+
+def test_thread_ids_distinguish_workers():
+    t = Tracer()
+    t.enable()
+
+    def worker():
+        with t.span("fetch"):
+            time.sleep(0.001)
+
+    threads = [threading.Thread(target=worker) for _ in range(3)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    with t.span("fetch"):
+        pass
+    tids = {e["tid"] for e in json.loads(t.chrome_trace())["traceEvents"]}
+    assert len(tids) >= 2  # worker spans carry distinct thread lanes
+
+
+def test_reset_clears_state():
+    t = Tracer()
+    t.enable()
+    t.instant("x")
+    t.reset()
+    assert t.summary() == {}
