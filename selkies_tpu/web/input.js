@@ -20,6 +20,10 @@ class SelkiesInput {
     this._gamepadTimer = null;
     this._attached = [];
     this._keys = new KeyTracker();
+    // when false, window resizes do NOT push r/s to the server — the
+    // user pinned a manual remote resolution / scaling in the UI and
+    // automatic reports would silently clobber it
+    this.autoResize = true;
   }
 
   attach() {
@@ -325,7 +329,20 @@ class SelkiesInput {
     }
   }
 
+  /* Force-push the local clipboard to the server (UI button path);
+   * shares the cw encoding and the _lastClipboard dedup with the
+   * focus-upload so the next focus doesn't re-send the same text. */
+  pushClipboard() {
+    if (!navigator.clipboard?.readText) return;
+    navigator.clipboard.readText().then((text) => {
+      if (!text) return;
+      this._lastClipboard = text;
+      this.send("cw," + btoa(unescape(encodeURIComponent(text))));
+    }).catch(() => {});
+  }
+
   _reportResize() {
+    if (!this.autoResize) return;
     const w = Math.round(window.innerWidth * window.devicePixelRatio);
     const h = Math.round(window.innerHeight * window.devicePixelRatio);
     this.send(`r,${w}x${h}`);

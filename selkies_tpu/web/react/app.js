@@ -40,6 +40,10 @@ const state = {
   system: /** @type {Record<string, unknown> | null} */ (null),
   /** @type {string[]} */
   logs: [],
+  /** live plane counters (reference getConnectionStats() subset) */
+  stats: { framesDecoded: 0, framesDropped: 0, keyFrames: 0,
+           mbitRate: 0, gamepads: 0 },
+  statsOpen: false,
   renderUi: () => {},
 };
 
@@ -151,12 +155,25 @@ function start() {
 
 // client metrics upload every 5 s (_f fps, _l latency — reference
 // app.js:604-607)
+let lastBytes = 0;
 setInterval(() => {
   const src = state.plane === "rtc" && rtc ? rtc : media;
   const decoded = src.framesDecoded;
   framesThisSecond = (decoded - lastDecoded) / 5;
   lastDecoded = decoded;
   state.fps = Math.max(0, Math.round(framesThisSecond));
+  const bytes = src.bytesReceived || 0;
+  state.stats = {
+    framesDecoded: decoded,
+    framesDropped: src.framesDropped || 0,
+    keyFrames: /** @type {{keyFramesDecoded?: number}} */ (src).keyFramesDecoded || 0,
+    mbitRate: Math.max(0, (bytes - lastBytes) * 8 / 5 / 1e6),
+    gamepads: (() => {
+      try { return [...(navigator.getGamepads?.() || [])].filter(Boolean).length; }
+      catch (e) { return 0; }  // SecurityError in permission-less iframes
+    })(),
+  };
+  lastBytes = bytes;
   if (plane && /** @type {{connected?: boolean}} */ (src).connected) {
     plane.send(`_f,${state.fps}`);
     plane.send(`_l,${Math.round(state.serverLatencyMs)}`);
@@ -185,9 +202,59 @@ function DebugOverlay({ state: s }) {
     h("pre", null, s.logs.slice(-14).join("\n")));
 }
 
+/** @param {{state: typeof state}} props */
+function StatsPanel({ state: s }) {
+  if (!s.statsOpen) return h("span", null);
+  const row = (/** @type {string} */ k, /** @type {string | number} */ v) =>
+    h("div", null, `${k}: ${v}`);
+  return h("div", { class: "rx-stats" },
+    row("plane", s.plane),
+    row("fps", s.fps),
+    row("bitrate", `${s.stats.mbitRate.toFixed(2)} Mbit/s`),
+    row("frames decoded", s.stats.framesDecoded),
+    row("frames dropped", s.stats.framesDropped),
+    row("key frames", s.stats.keyFrames),
+    row("latency", `${s.serverLatencyMs.toFixed(0)} ms`),
+    row("gamepads", s.stats.gamepads));
+}
+
 function SettingsDrawer() {
   const [open, setOpen] = useState(false);
+  const resolutions = ["auto", "1280x720", "1920x1080", "2560x1440", "3840x2160"];
   const drawer = h("div", { class: "rx-drawer" + (open ? " open" : "") },
+    h("label", null, "Remote resolution ",
+      h("select", {
+        onChange: (/** @type {Event} */ e) => {
+          const v = /** @type {HTMLSelectElement} */ (e.target).value;
+          store.set("resolution", v);
+          if (v === "auto") {
+            // follow the window: remote resizing on, auto reports on
+            input.autoResize = true;
+            store.set("resize", "true");
+            const res = `${Math.round(innerWidth * devicePixelRatio)}x${Math.round(innerHeight * devicePixelRatio)}`;
+            plane.send(`_arg_resize,true,${res}`);
+          } else {
+            // pin a manual resolution: remote resizing stays ENABLED on
+            // the server (the resize path is gated on it) but window
+            // resizes must stop pushing r/s or they'd clobber the pin
+            input.autoResize = false;
+            store.set("resize", "true");
+            plane.send(`_arg_resize,true,${v}`);
+            plane.send(`r,${v}`);
+          }
+        },
+      }, ...resolutions.map((v) =>
+        h("option", v === store.get("resolution", "auto") ? { selected: "" } : null, v)))),
+    h("label", null, "UI scaling ",
+      h("select", {
+        onChange: (/** @type {Event} */ e) => {
+          const v = /** @type {HTMLSelectElement} */ (e.target).value;
+          store.set("scaling", v);
+          input.autoResize = false;  // a pinned DPI must survive resizes
+          plane.send(`s,${v}`);
+        },
+      }, ...["0.75", "1", "1.25", "1.5", "2"].map((v) =>
+        h("option", v === store.get("scaling", "1") ? { selected: "" } : null, v)))),
     h("label", null, "Frames per second ",
       h("select", {
         onChange: (/** @type {Event} */ e) => {
@@ -210,8 +277,25 @@ function SettingsDrawer() {
       },
     }, "Toggle debug overlay"),
     h("button", {
-      onClick: () => document.documentElement.requestFullscreen?.(),
-    }, "Fullscreen"));
+      onClick: () => {
+        state.statsOpen = !state.statsOpen;
+        state.renderUi();
+      },
+    }, "Toggle stats"),
+    h("button", {
+      onClick: () => input.enterFullscreen(),
+    }, "Fullscreen"),
+    h("button", {
+      onClick: () => {
+        if (input.pointerLock) input.exitPointerLock();
+        else input.requestPointerLock();
+        state.renderUi();
+      },
+      title: "relative mouse mode (games)",
+    }, input.pointerLock ? "Release pointer" : "Pointer lock"),
+    h("button", {
+      onClick: () => input.pushClipboard(),
+    }, "Paste clipboard to remote"));
   return h("div", null,
     h("div", {
       class: "rx-gear", title: "settings",
@@ -225,6 +309,7 @@ function App({ state: s }) {
   return h("div", null,
     StatusBar({ state: s }),
     DebugOverlay({ state: s }),
+    StatsPanel({ state: s }),
     SettingsDrawer());
 }
 

@@ -28,6 +28,7 @@ declare class SelkiesMedia {
   close(): void;
   connected: boolean;
   framesDecoded: number;
+  keyFramesDecoded?: number;
   framesDropped: number;
   bytesReceived: number;
 }
@@ -54,9 +55,17 @@ declare class SelkiesWebRTC {
 
 /** Input plane (input.js): keyboard/mouse/wheel/gamepad -> CSV protocol. */
 declare class SelkiesInput {
-  constructor(canvas: HTMLElement, send: (msg: string) => void);
+  constructor(canvas: HTMLElement, send: (m: string) => void);
   canvas: HTMLElement;
+  pointerLock: boolean;
+  autoResize: boolean;
+  remoteWidth: number;
+  remoteHeight: number;
   attach(): void;
   detach(): void;
-  setPointerLock(enabled: boolean): void;
+  requestPointerLock(): void;
+  exitPointerLock(): void;
+  enterFullscreen(): Promise<void>;
+  pushClipboard(): void;
+  noteRemoteClipboard(text: string): void;
 }

@@ -147,6 +147,7 @@ class SelkiesWebRTC {
             this.framesDecoded = r.framesDecoded || 0;
             this.framesDropped = r.framesDropped || 0;
             this.bytesReceived = r.bytesReceived || 0;
+            this.keyFramesDecoded = r.keyFramesDecoded || 0;
           }
         });
         this.send(`_stats_video,${JSON.stringify(reports)}`);
