@@ -70,7 +70,7 @@ class FakeBrowser:
         self.dc_messages = []
         self.ice.on_data = self._on_data
 
-    async def answer(self, offer: str) -> str:
+    async def answer(self, offer: str, codec: str = "H264") -> str:
         remote = sdp.parse_answer(offer)  # same extractor works on offers
         self.remote = remote
         self.dtls = DtlsEndpoint(is_server=False, cert_der=self.cert,
@@ -89,7 +89,7 @@ class FakeBrowser:
             f"a=ice-pwd:{self.ice.local_pwd}\r\n"
             f"a=fingerprint:sha-256 {self.fingerprint}\r\n"
             "a=setup:active\r\n"
-            f"a=rtpmap:{sdp.VIDEO_PT} H264/90000\r\n"
+            f"a=rtpmap:{sdp.VIDEO_PT} {codec}/90000\r\n"
             f"a=extmap:{sdp.TWCC_EXT_ID} {sdp.TWCC_URI}\r\n"
             f"a=extmap:{sdp.PLAYOUT_DELAY_EXT_ID} {sdp.PLAYOUT_DELAY_URI}\r\n"
             f"m=audio 9 UDP/TLS/RTP/SAVPF {sdp.AUDIO_PT}\r\n"
