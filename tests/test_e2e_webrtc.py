@@ -181,27 +181,41 @@ def test_webrtc_session_end_to_end(loop, tmp_path):
     loop.run_until_complete(scenario())
 
 
-def test_webrtc_av1_session_end_to_end(loop, tmp_path):
-    """SELKIES_ENCODER=tpuav1enc over a full WebRTC session: the offer
-    carries AV1/90000, real libaom temporal units ride SRTP through the
-    AOM RTP payload format, and the depayloaded stream decodes with
-    ctypes libdav1d (reference chain: av1enc ! rtpav1pay,
-    gstwebrtc_app.py:741-783, 917-938)."""
-    from selkies_tpu.models.libaom_enc import libaom_available
-    from selkies_tpu.models.av1.dav1d import dav1d_available
+@pytest.mark.parametrize("codec_case", ["av1", "h265", "vp9"])
+def test_webrtc_codec_session_end_to_end(loop, tmp_path, codec_case):
+    """SELKIES_ENCODER={tpuav1enc,x265enc} over a full WebRTC session:
+    the offer carries the codec's rtpmap, real encoder output rides SRTP
+    through the codec's RTP payload format, and the depayloaded stream
+    decodes with an independent decoder — ctypes libdav1d for AV1, FFmpeg
+    for HEVC (reference chains: av1enc ! rtpav1pay, x265enc ! rtph265pay;
+    gstwebrtc_app.py:667-683, 741-783, 848-938)."""
+    if codec_case == "av1":
+        from selkies_tpu.models.libaom_enc import libaom_available
+        from selkies_tpu.models.av1.dav1d import dav1d_available
 
-    if not (libaom_available() and dav1d_available()):
-        pytest.skip("libaom/libdav1d not present")
-    from selkies_tpu.models.av1.dav1d import Dav1dDecoder
-    from selkies_tpu.transport.rtp_av1 import Av1Depayloader
+        if not (libaom_available() and dav1d_available()):
+            pytest.skip("libaom/libdav1d not present")
+        encoder_name, sdp_codec = "tpuav1enc", "AV1"
+    elif codec_case == "h265":
+        from selkies_tpu.models.x265enc import x265_available
+
+        if not x265_available():
+            pytest.skip("libx265 not present")
+        encoder_name, sdp_codec = "x265enc", "H265"
+    else:
+        from selkies_tpu.models.libvpx_enc import libvpx_available
+
+        if not libvpx_available():
+            pytest.skip("libvpx not present")
+        encoder_name, sdp_codec = "tpuvp9enc", "VP9"
 
     async def scenario():
         cfg = make_config(tmp_path)
-        cfg.encoder = "tpuav1enc"
+        cfg.encoder = encoder_name
         orch = Orchestrator(cfg)
         orch.input.backend = FakeBackend()
         orch.input.clipboard = MemoryClipboard()
-        assert orch.webrtc._kw["codec"] == "av1"
+        assert orch.webrtc._kw["codec"] == codec_case
         run_task = asyncio.ensure_future(orch.run())
         for _ in range(100):
             if orch.server._runner is not None and orch.server._runner.addresses:
@@ -230,7 +244,7 @@ def test_webrtc_av1_session_end_to_end(loop, tmp_path):
                         obj = json.loads(data)
                         if "sdp" in obj and obj["sdp"]["type"] == "offer":
                             offer_sdp = obj["sdp"]["sdp"]
-                            answer = await browser.answer(offer_sdp, codec="AV1")
+                            answer = await browser.answer(offer_sdp, codec=sdp_codec)
                             await ws.send_str(json.dumps(
                                 {"sdp": {"type": "answer", "sdp": answer}}))
                             cand = browser.ice.local_candidates[0]
@@ -261,36 +275,70 @@ def test_webrtc_av1_session_end_to_end(loop, tmp_path):
                     break
 
             assert answered, "no offer arrived"
-            assert offer_sdp is not None and "AV1/90000" in offer_sdp, \
-                "offer must advertise AV1"
+            assert offer_sdp is not None and f"{sdp_codec}/90000" in offer_sdp, \
+                f"offer must advertise {sdp_codec}"
             assert browser.dtls is not None and browser.dtls.handshake_complete
             assert len(browser.rtp_packets) >= 10, \
                 f"only {len(browser.rtp_packets)} SRTP packets"
 
             from selkies_tpu.transport.webrtc import sdp as sdp_mod
 
-            depay = Av1Depayloader()
-            tus = []
+            if codec_case == "av1":
+                from selkies_tpu.models.av1.dav1d import Dav1dDecoder
+                from selkies_tpu.transport.rtp_av1 import Av1Depayloader
+
+                depay = Av1Depayloader()
+            elif codec_case == "h265":
+                from selkies_tpu.transport.rtp_h265 import H265Depayloader
+
+                depay = H265Depayloader()
+            else:
+                from selkies_tpu.transport.rtp_vpx import Vp9Depayloader
+
+                depay = Vp9Depayloader()
+            units = []
             for wire in browser.rtp_packets:
                 try:
                     pkt = RtpPacket.parse(wire)
                 except ValueError:
                     continue
                 if pkt.payload_type != sdp_mod.VIDEO_PT:
-                    continue  # interleaved Opus packets are not AV1 TUs
-                tu = depay.push(pkt)
-                if tu:
-                    tus.append(tu)
-            assert tus, "no temporal units reassembled"
-            dec = Dav1dDecoder()
-            pics = []
-            for tu in tus:
-                pics += dec.decode(tu)
-            pics += dec.flush()
-            dec.close()
-            assert pics, "libdav1d decoded no pictures from the session stream"
-            y, u, v = pics[-1]
-            assert y.shape == (128, 192), y.shape
+                    continue  # interleaved Opus packets are not video
+                unit = depay.push(pkt)
+                if unit:
+                    units.append(unit)
+            assert units, "no access/temporal units reassembled"
+            if codec_case == "av1":
+                dec = Dav1dDecoder()
+                pics = []
+                for tu in units:
+                    pics += dec.decode(tu)
+                pics += dec.flush()
+                dec.close()
+                assert pics, "libdav1d decoded no pictures"
+                assert pics[-1][0].shape == (128, 192)
+            elif codec_case == "h265":
+                import cv2
+
+                path = str(tmp_path / "webrtc_e2e.h265")
+                with open(path, "wb") as f:
+                    f.write(b"".join(units))
+                cap = cv2.VideoCapture(path)
+                ok, frame = cap.read()
+                assert ok, "FFmpeg could not decode the streamed HEVC"
+                assert frame.shape == (128, 192, 3)
+            else:
+                import cv2
+
+                from selkies_tpu.utils.ivf import ivf_file
+
+                path = str(tmp_path / "webrtc_e2e.ivf")
+                with open(path, "wb") as f:
+                    f.write(ivf_file(units, "vp9", 192, 128, 60))
+                cap = cv2.VideoCapture(path)
+                ok, frame = cap.read()
+                assert ok, "FFmpeg could not decode the streamed VP9"
+                assert frame.shape == (128, 192, 3)
             await ws.close()
 
         await orch.shutdown()
