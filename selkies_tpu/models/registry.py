@@ -88,6 +88,9 @@ def default_pipeline_depth() -> int:
 def _tpuh264enc(*, width: int, height: int, fps: int = 60, qp: int = 28, **kw):
     from selkies_tpu.models.h264.encoder import TPUH264Encoder
 
+    # the TPU row is QP-driven (the app's CbrRateController owns the
+    # rate loop via set_qp); the library rows consume bitrate_kbps
+    kw.pop("bitrate_kbps", None)
     kw.setdefault("frame_batch", default_frame_batch())
     kw.setdefault("pipeline_depth", default_pipeline_depth())
     kw.setdefault("scene_qp_boost", 6)

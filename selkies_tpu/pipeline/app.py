@@ -68,7 +68,13 @@ class TPUWebRTCApp:
         self.framerate = framerate
         self.congestion_control = congestion_control
         self.video_bitrate_kbps = video_bitrate_kbps
-        self.encoder = create_encoder(encoder, width=self.source.width, height=self.source.height, fps=framerate)
+        # the configured bitrate reaches library-RC rows (x264/x265/
+        # libvpx/libaom run their own CBR) at construction — without it
+        # they'd start at their registry default until the first GCC
+        # retune, streaming minutes at the wrong rate on lossless links
+        self.encoder = create_encoder(encoder, width=self.source.width,
+                                      height=self.source.height, fps=framerate,
+                                      bitrate_kbps=int(video_bitrate_kbps))
         self.rc = CbrRateController(bitrate_kbps=video_bitrate_kbps, fps=framerate)
         self.pipeline: VideoPipeline | None = None
 
@@ -116,7 +122,8 @@ class TPUWebRTCApp:
         GStreamer pipeline for this; our encoder is the only sized stage)."""
         logger.info("rebuilding %s for %dx%d", self.encoder_name, width, height)
         self.encoder = create_encoder(
-            self.encoder_name, width=width, height=height, fps=self.framerate
+            self.encoder_name, width=width, height=height, fps=self.framerate,
+            bitrate_kbps=int(self.video_bitrate_kbps),
         )
         return self.encoder
 
