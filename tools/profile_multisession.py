@@ -28,23 +28,27 @@ _tiny = jax.jit(lambda a: a.ravel()[:1])
 
 
 def device_tick_ms(svc, frame, n=10):
-    """Device-only mixed-tick time: the frame is PRE-uploaded and the
-    step driven directly, so neither the 8 MB BGRx h2d nor the bulk
+    """Device-only mixed-tick time: the planes are PRE-uploaded (the
+    serving layer converts BGRx->I420 host-side since round 4) and the
+    step driven directly, so neither the h2d upload nor the bulk
     coefficient d2h (both absorbed at ~GB/s by a PCIe-local host) sit in
     the timed loop; sync is a 1-element fetch on the FIFO queue."""
+    import jax
     import jax.numpy as jnp
     enc = svc.enc
-    frames_d = enc.put_frames(frame[None])
+    y, u, v = svc._preps[0].convert(frame)  # the production converter
+    planes_d = tuple(jax.device_put(np.asarray(p)[None], enc._shard)
+                     for p in (y, u, v))
     qps_d = jnp.asarray(np.array([28], np.int32))
     idrs_d = jnp.asarray(np.array([False]))
     ref = enc._ref
     enc._ref = None  # we manage donation manually below
-    out = dict(enc._step_mixed(frames_d, qps_d, idrs_d, *ref))
+    out = dict(enc._step_mixed(*planes_d, qps_d, idrs_d, *ref))
     ref = (out.pop("recon_y"), out.pop("recon_u"), out.pop("recon_v"))
     np.asarray(_tiny(out["luma_ac"]))
     t0 = time.perf_counter()
     for _ in range(n):
-        out = dict(enc._step_mixed(frames_d, qps_d, idrs_d, *ref))
+        out = dict(enc._step_mixed(*planes_d, qps_d, idrs_d, *ref))
         ref = (out.pop("recon_y"), out.pop("recon_u"), out.pop("recon_v"))
     np.asarray(_tiny(out["luma_ac"]))
     dt = 1e3 * (time.perf_counter() - t0) / n
