@@ -34,9 +34,10 @@ def trace():
             pass                     # static
         else:
             cur = cur.copy()
-            row = 512 + (i * 16) % 128
-            cur[row:row + 12, 600:1750, :3] = rng.integers(
-                0, 255, (12, 1150, 1), np.uint8)
+            row = H // 4 + (i * 16) % 128
+            c0, c1 = W // 6, W // 6 + (W // 3)
+            cur[row:row + 12, c0:c1, :3] = rng.integers(
+                0, 255, (12, c1 - c0, 1), np.uint8)
         frames.append(cur)
     return frames
 
