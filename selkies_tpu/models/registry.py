@@ -63,7 +63,11 @@ def default_frame_batch() -> int:
     overrides either way — bench.py and the live pipeline share this."""
     env = os.environ.get("SELKIES_FRAME_BATCH")
     if env:
-        return max(1, min(16, int(env)))
+        try:
+            return max(1, min(16, int(env)))
+        except ValueError:
+            logger.warning(
+                "SELKIES_FRAME_BATCH=%r is not an integer; using default", env)
     return 8 if os.environ.get("PALLAS_AXON_POOL_IPS") else 4
 
 
@@ -76,7 +80,11 @@ def default_pipeline_depth() -> int:
     SELKIES_PIPELINE_DEPTH overrides either way."""
     env = os.environ.get("SELKIES_PIPELINE_DEPTH")
     if env:
-        return max(0, min(8, int(env)))
+        try:
+            return max(0, min(8, int(env)))
+        except ValueError:
+            logger.warning(
+                "SELKIES_PIPELINE_DEPTH=%r is not an integer; using default", env)
     # depth 3 measured faster on the relay when the tunnel is healthy,
     # but two runs stalled during a tunnel degradation with 3 groups of
     # fetches outstanding — hold the default at 2 until that is

@@ -218,7 +218,14 @@ class X265Encoder:
         lib = self._lib
         for k, v in (("bitrate", str(kbps)), ("vbv-maxrate", str(kbps)),
                      ("vbv-bufsize", str(max(1, int(kbps * 1.5 / self.fps))))):
-            lib.x265_param_parse(self._param, k.encode(), v.encode())
+            rc = lib.x265_param_parse(self._param, k.encode(), v.encode())
+            if rc != 0:
+                # a rejected value would re-open with partially stale rate
+                # params — keep the running encoder instead
+                logger.warning(
+                    "x265_param_parse(%s=%s) rc=%d during retune; keeping "
+                    "old encoder", k, v, rc)
+                return
         new_h = lib._open(self._param)
         if not new_h:
             logger.warning("x265 re-open for bitrate %s failed; keeping old", kbps)

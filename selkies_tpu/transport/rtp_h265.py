@@ -77,10 +77,13 @@ class H265Payloader(RtpSequenceMixin):
         return RtpPacket(self.payload_type, self._next_seq(), ts, self.ssrc, nal)
 
     def _ap(self, nals: list[bytes], ts: int) -> RtpPacket:
-        # AP PayloadHdr: type=48; LayerId/TID take the minimum across the
-        # aggregated NALs (RFC 7798 §4.4.2)
-        layer_tid = min(struct.unpack("!H", n[:2])[0] & 0x01FF for n in nals)
-        hdr = struct.pack("!H", (NAL_AP << 9) | layer_tid)
+        # AP PayloadHdr: type=48; LayerId and TID each take their own
+        # minimum across the aggregated NALs (RFC 7798 §4.4.2 — the two
+        # fields are minimized independently, not as one 9-bit value)
+        words = [struct.unpack("!H", n[:2])[0] for n in nals]
+        layer = min((w >> 3) & 0x3F for w in words)
+        tid = min(w & 0x07 for w in words)
+        hdr = struct.pack("!H", (NAL_AP << 9) | (layer << 3) | tid)
         payload = hdr + b"".join(
             struct.pack("!H", len(n)) + n for n in nals)
         return RtpPacket(self.payload_type, self._next_seq(), ts, self.ssrc, payload)

@@ -176,3 +176,31 @@ def test_registry_h265_rows_are_real():
         assert enc2.codec == "h265"
     finally:
         enc2.close()
+
+
+def test_ap_header_minimizes_layerid_and_tid_independently():
+    # RFC 7798 §4.4.2: the AP PayloadHdr carries the lowest LayerId and
+    # the lowest TID across aggregated NALs, minimized per-field — a mix
+    # of (LayerId 0, TID 2) and (LayerId 1, TID 1) must yield (0, 1).
+    import struct
+
+    from selkies_tpu.transport.rtp_h265 import H265Payloader
+
+    def nal(ntype, layer, tid, body=b"\x00" * 8):
+        return struct.pack("!H", (ntype << 9) | (layer << 3) | tid) + body
+
+    pay = H265Payloader()
+    pkt = pay._ap([nal(32, 0, 2), nal(33, 1, 1)], ts=0)
+    word = struct.unpack("!H", pkt.payload[:2])[0]
+    assert (word >> 9) & 0x3F == 48  # AP
+    assert (word >> 3) & 0x3F == 0   # min LayerId
+    assert word & 0x07 == 1          # min TID, taken independently
+
+
+def test_pipeline_depth_env_tolerates_garbage(monkeypatch):
+    from selkies_tpu.models import registry
+
+    monkeypatch.setenv("SELKIES_PIPELINE_DEPTH", "auto")
+    assert registry.default_pipeline_depth() == 2
+    monkeypatch.setenv("SELKIES_PIPELINE_DEPTH", "5")
+    assert registry.default_pipeline_depth() == 5
