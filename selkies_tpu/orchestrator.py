@@ -149,6 +149,30 @@ class TransportMux:
 DEFAULT_WEB_ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "web")
 
 
+def make_signalling_server(cfg: Config) -> SignallingServer:
+    """The combined web/signalling/TURN server, from config (shared by the
+    solo Orchestrator and the fleet path, parallel/fleet.py)."""
+    return SignallingServer(SignallingOptions(
+        addr=cfg.addr,
+        port=int(cfg.port),
+        web_root=cfg.web_root or DEFAULT_WEB_ROOT,
+        turn_shared_secret=cfg.turn_shared_secret,
+        turn_host=cfg.turn_host,
+        turn_port=str(cfg.turn_port) if cfg.turn_host else "",
+        turn_protocol=cfg.turn_protocol,
+        turn_tls=bool(cfg.turn_tls),
+        stun_host=cfg.stun_host,
+        stun_port=str(cfg.stun_port),
+        rtc_config_file=cfg.rtc_config_json,
+        enable_basic_auth=bool(cfg.enable_basic_auth),
+        basic_auth_user=cfg.basic_auth_user,
+        basic_auth_password=cfg.basic_auth_password,
+        enable_https=bool(cfg.enable_https),
+        https_cert=cfg.https_cert,
+        https_key=cfg.https_key,
+    ))
+
+
 async def wait_for_app_ready(ready_file: str, app_wait_ready: bool) -> None:
     """Block until the sidecar app drops its ready file (reference :288-301)."""
     logger.info("waiting for streaming app ready")
@@ -199,6 +223,37 @@ async def resolve_rtc_config(cfg: Config) -> tuple[str, str, str]:
             )
             return parse_rtc_config(data)
     return parse_rtc_config(stun_only_rtc_config(cfg.stun_host, cfg.stun_port))
+
+
+def make_rtc_monitors(cfg: Config, on_rtc_config) -> list:
+    """The live TURN-credential refreshers (reference __main__.py:919-947):
+    HMAC re-mint, REST re-fetch, rtc.json file watch. Shared by the solo
+    Orchestrator and the fleet path — without them /turn hands browsers
+    expired credentials after the 24 h TTL."""
+    monitors = []
+    if cfg.turn_shared_secret and cfg.turn_host and cfg.turn_port:
+        m = HMACRTCMonitor(
+            cfg.turn_host, cfg.turn_port, cfg.turn_shared_secret,
+            cfg.turn_rest_username, cfg.turn_protocol, bool(cfg.turn_tls),
+            cfg.stun_host, cfg.stun_port,
+        )
+        m.on_rtc_config = on_rtc_config
+        monitors.append(m)
+    if cfg.turn_rest_uri:
+        m = RESTRTCMonitor(
+            cfg.turn_rest_uri, cfg.turn_rest_username,
+            cfg.turn_rest_username_auth_header, cfg.turn_protocol,
+            cfg.turn_rest_protocol_header, bool(cfg.turn_tls),
+            cfg.turn_rest_tls_header,
+        )
+        m.on_rtc_config = on_rtc_config
+        monitors.append(m)
+    if cfg.rtc_config_json:
+        m = RTCConfigFileMonitor(
+            cfg.rtc_config_json, enabled=os.path.exists(cfg.rtc_config_json))
+        m.on_rtc_config = on_rtc_config
+        monitors.append(m)
+    return monitors
 
 
 def _loss_counters(stats_json: str) -> tuple[float, float] | None:
@@ -275,25 +330,7 @@ class Orchestrator:
         )
         self.system_mon = SystemMonitor()
         self.tpu_mon = TPUMonitor()
-        self.server = SignallingServer(SignallingOptions(
-            addr=cfg.addr,
-            port=int(cfg.port),
-            web_root=cfg.web_root or DEFAULT_WEB_ROOT,
-            turn_shared_secret=cfg.turn_shared_secret,
-            turn_host=cfg.turn_host,
-            turn_port=str(cfg.turn_port) if cfg.turn_host else "",
-            turn_protocol=cfg.turn_protocol,
-            turn_tls=bool(cfg.turn_tls),
-            stun_host=cfg.stun_host,
-            stun_port=str(cfg.stun_port),
-            rtc_config_file=cfg.rtc_config_json,
-            enable_basic_auth=bool(cfg.enable_basic_auth),
-            basic_auth_user=cfg.basic_auth_user,
-            basic_auth_password=cfg.basic_auth_password,
-            enable_https=bool(cfg.enable_https),
-            https_cert=cfg.https_cert,
-            https_key=cfg.https_key,
-        ))
+        self.server = make_signalling_server(cfg)
         self.server.ws_routes["/media"] = self.ws_transport.handle_connection
         self._tasks: list[asyncio.Task] = []
         self._session_active = False
@@ -581,27 +618,7 @@ class Orchestrator:
         def on_rtc_config(stun: str, turn: str, config: str) -> None:
             self.server.set_rtc_config(config)
 
-        monitors = []
-        if cfg.turn_shared_secret and cfg.turn_host and cfg.turn_port:
-            m = HMACRTCMonitor(
-                cfg.turn_host, cfg.turn_port, cfg.turn_shared_secret,
-                cfg.turn_rest_username, cfg.turn_protocol, bool(cfg.turn_tls),
-                cfg.stun_host, cfg.stun_port,
-            )
-            m.on_rtc_config = on_rtc_config
-            monitors.append(m)
-        if cfg.turn_rest_uri:
-            m = RESTRTCMonitor(
-                cfg.turn_rest_uri, cfg.turn_rest_username,
-                cfg.turn_rest_username_auth_header, cfg.turn_protocol,
-                cfg.turn_rest_protocol_header, bool(cfg.turn_tls), cfg.turn_rest_tls_header,
-            )
-            m.on_rtc_config = on_rtc_config
-            monitors.append(m)
-        if cfg.rtc_config_json:
-            m = RTCConfigFileMonitor(cfg.rtc_config_json, enabled=os.path.exists(cfg.rtc_config_json))
-            m.on_rtc_config = on_rtc_config
-            monitors.append(m)
+        monitors = make_rtc_monitors(cfg, on_rtc_config)
 
         spawn = asyncio.get_running_loop().create_task
         self._tasks = [spawn(m.start()) for m in monitors]
@@ -640,6 +657,13 @@ async def main(argv: list[str] | None = None) -> None:
         level=logging.DEBUG if cfg.debug else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    if int(cfg.tpu_sessions) > 1:
+        # fleet mode: N sessions off one sharded device step (the v5e-8
+        # scale path, parallel/fleet.py)
+        from selkies_tpu.parallel.fleet import FleetOrchestrator
+
+        await FleetOrchestrator(cfg).run()
+        return
     await Orchestrator(cfg).run()
 
 

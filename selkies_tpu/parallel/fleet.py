@@ -1,0 +1,566 @@
+"""Fleet serving — the ``--tpu_sessions N`` product path.
+
+One host process serves N concurrent browser sessions off ONE sharded
+device step (parallel/serving.MultiSessionH264Service): session k's
+browser connects to the same web/signalling server as solo mode, speaks
+the same protocol, and gets its own media transport (WebRTC preferred,
+``/media/<k>`` WebSocket fallback), its own input host, and its own
+rate-control loop — while every encode tick runs all N sessions as a
+single jitted program over the ``session`` mesh axis (one 1080p60 stream
+per chip on v5e-8, BASELINE.md).
+
+Reference contrast: the reference scales out with one OS process per
+session plus Kubernetes fleet discovery (addons/coturn-web/main.go:
+187-334, infra/gke); here the slice is one process and "placement" is a
+jax.sharding mesh. Peer-id convention extends the reference's browser=1/
+server=2 pair (reference __main__.py:555): session k uses browser
+``1+10k`` / server ``2+10k``, so session 0 remains exactly the reference
+convention and a stock client needs no changes for it.
+
+Session fan-in/fan-out per tick:
+
+    [slot 0 source] ─┐                       ┌─► slot 0 transport
+    [slot 1 source] ─┼─► (N,H,W,4) batch ──► │   (per-slot AU)
+        ...          │   MultiSessionH264    └─► slot k transport
+    [slot N source] ─┘   Service.encode_tick
+
+Per-session divergence (QP, force-IDR) rides the service's per-chip
+lax.cond; per-session *geometry/framerate* cannot diverge — the batch is
+lockstep — so client fps/resize requests are acknowledged but pinned to
+the fleet configuration (documented in docs/).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+
+import numpy as np
+
+from selkies_tpu.config import Config
+from selkies_tpu.input_host import HostInput
+from selkies_tpu.models.h264.ratecontrol import CbrRateController
+from selkies_tpu.monitoring import Metrics, SystemMonitor, TPUMonitor
+from selkies_tpu.pipeline.elements import EncodedFrame, SyntheticSource
+from selkies_tpu.signalling.client import SignallingClient, SignallingErrorNoPeer
+from selkies_tpu.transport.congestion import GccController
+from selkies_tpu.transport.webrtc.transport import WebRTCTransport
+from selkies_tpu.transport.websocket import WebSocketTransport
+
+logger = logging.getLogger("fleet")
+
+__all__ = ["SessionSlot", "SessionFleet", "FleetOrchestrator"]
+
+
+def browser_peer_id(session: int) -> int:
+    """Session k's browser registers as this signalling peer id."""
+    return 1 + 10 * session
+
+
+def server_client_id(session: int) -> int:
+    return 2 + 10 * session
+
+
+class SessionSlot:
+    """Per-session serving state: both byte planes, input host, RC."""
+
+    def __init__(self, index: int, *, bitrate_kbps: int, fps: int,
+                 codec: str = "h264", webrtc_audio: bool = False,
+                 turn_tls_insecure: bool = False):
+        self.index = index
+        self.ws = WebSocketTransport()
+        self.webrtc = WebRTCTransport(audio=webrtc_audio,
+                                      turn_tls_insecure=turn_tls_insecure)
+        self.webrtc.set_codec(codec)
+        # import here to avoid a module cycle (orchestrator imports fleet
+        # lazily from main(); fleet needs only the mux class)
+        from selkies_tpu.orchestrator import TransportMux
+
+        self.transport = TransportMux(self.ws, self.webrtc)
+        self.rc = CbrRateController(bitrate_kbps=bitrate_kbps, fps=fps)
+        self.gcc: GccController | None = None
+        self.input: HostInput | None = None
+        self.connected = False
+        self.frames = 0
+
+    # -- server→client control vocabulary (the TPUWebRTCApp subset a
+    #    fleet slot needs; same wire format, gstwebrtc_app.py:1454-1579)
+
+    def _send(self, msg_type: str, data) -> None:
+        if self.transport.data_channel_ready:
+            self.transport.send_data_channel(
+                json.dumps({"type": msg_type, "data": data}))
+
+    def send_codec(self, codec: str) -> None:
+        self._send("codec", {"codec": codec})
+
+    def send_ping(self, t: float) -> None:
+        self._send("ping", {"start_time": float(f"{t:.3f}")})
+
+    def send_system_stats(self, cpu: float, total: float, used: float) -> None:
+        self._send("system_stats",
+                   {"cpu_percent": cpu, "mem_total": total, "mem_used": used})
+
+    def send_cursor_data(self, data) -> None:
+        self._send("cursor", data)
+
+    def send_clipboard_data(self, text: str) -> None:
+        import base64
+
+        payload = base64.b64encode(text.encode()).decode()
+        if len(payload) <= 65400:
+            self._send("clipboard", {"content": payload})
+
+    def send_latency_time(self, ms: float) -> None:
+        self._send("latency_measurement", {"latency_ms": ms})
+
+
+class SessionFleet:
+    """Media core for N sessions: one device tick, N output streams.
+
+    ``sources`` is a list of per-session FrameSources (defaults to
+    distinct SyntheticSources). The tick loop skips device work while no
+    session has a client — an idle fleet costs no TPU time.
+    """
+
+    def __init__(self, slots: list[SessionSlot], *, width: int, height: int,
+                 fps: int, qp: int = 28, sources=None, devices=None,
+                 service=None):
+        from selkies_tpu.parallel.serving import MultiSessionH264Service
+
+        self.slots = slots
+        self.n = len(slots)
+        self.width, self.height, self.fps = width, height, fps
+        self.service = service or MultiSessionH264Service(
+            self.n, width, height, qp=qp, fps=fps, devices=devices)
+        self.sources = sources or [
+            SyntheticSource(width, height, seed=k) for k in range(self.n)]
+        self._batch = np.empty((self.n, height, width, 4), np.uint8)
+        self._task: asyncio.Task | None = None
+        self.ticks = 0
+        self.last_tick_ms = 0.0
+        self.on_tick = lambda device_ms: None  # monitoring tap
+
+    # -- per-session controls (wired to slot transports/input) ---------
+
+    def force_keyframe(self, session: int) -> None:
+        self.service.force_keyframe(session)
+
+    def set_session_bitrate(self, session: int, kbps: int) -> None:
+        self.slots[session].rc.set_bitrate(int(kbps))
+
+    # -- lifecycle -----------------------------------------------------
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        self.service.close()
+
+    def _capture_batch(self) -> None:
+        for k, src in enumerate(self.sources):
+            self._batch[k] = src.capture()
+
+    def _encode_tick(self) -> tuple[list[bytes], list[bool], float]:
+        t0 = time.perf_counter()
+        for k, slot in enumerate(self.slots):
+            self.service.set_qp(k, slot.rc.frame_qp())
+        aus = self.service.encode_tick(self._batch)
+        return aus, list(self.service.last_idrs), (time.perf_counter() - t0) * 1e3
+
+    async def _run(self) -> None:
+        next_tick = time.monotonic()
+        t0 = next_tick
+        failures = 0
+        while True:
+            now = time.monotonic()
+            if now < next_tick:
+                await asyncio.sleep(next_tick - now)
+            next_tick = max(next_tick + 1.0 / self.fps,
+                            time.monotonic() - 0.5 / self.fps)
+            if not any(s.connected for s in self.slots):
+                continue  # idle fleet: no capture, no device work
+            try:
+                await asyncio.to_thread(self._capture_batch)
+                aus, idrs, tick_ms = await asyncio.to_thread(self._encode_tick)
+                self.ticks += 1
+                self.last_tick_ms = tick_ms
+                self.on_tick(tick_ms)
+                ts = int((time.monotonic() - t0) * 90000)
+                wall = time.time()
+                sends = []
+                for slot, au, idr in zip(self.slots, aus, idrs):
+                    slot.rc.update(len(au), idr=idr)
+                    if not slot.connected:
+                        continue
+                    ef = EncodedFrame(
+                        au=au, timestamp_90k=ts, wall_time=wall, idr=idr,
+                        qp=slot.rc.frame_qp(), device_ms=tick_ms,
+                        pack_ms=0.0,
+                    )
+                    slot.frames += 1
+                    sends.append(slot.transport.send_video(ef))
+                if sends:
+                    await asyncio.gather(*sends, return_exceptions=True)
+                failures = 0
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                failures += 1
+                logger.exception("fleet tick error (%d consecutive)", failures)
+                if failures >= 30:
+                    logger.error("fleet loop giving up after %d failures", failures)
+                    return
+
+
+def dryrun(n_devices: int) -> None:
+    """Driver hook (called via __graft_entry__.dryrun_multichip): build
+    the PRODUCT serving core — SessionSlots + SessionFleet over the
+    sharded MultiSessionH264Service — on an n-device mesh and run real
+    ticks: the all-IDR first tick, then a mixed tick with one session
+    forcing a keyframe and a diverged QP."""
+    slots = [SessionSlot(k, bitrate_kbps=2000, fps=60)
+             for k in range(n_devices)]
+    fleet = SessionFleet(slots, width=64, height=64, fps=60)
+    try:
+        fleet._capture_batch()
+        aus, idrs, _ = fleet._encode_tick()
+        assert len(aus) == n_devices and all(idrs)
+        for au in aus:
+            assert au.startswith(b"\x00\x00\x00\x01") and len(au) > 50
+        # steady state with per-session divergence: slot 1 (if present)
+        # forces an IDR while others ride the P branch; slot 0 retunes
+        fleet.force_keyframe(min(1, n_devices - 1))
+        fleet.set_session_bitrate(0, 900)
+        fleet._capture_batch()
+        aus2, idrs2, _ = fleet._encode_tick()
+        assert len(aus2) == n_devices
+        if n_devices > 1:
+            assert idrs2[1] and not idrs2[0]
+        # streams must be distinct per session (distinct sources)
+        assert len({bytes(a) for a in aus2}) == n_devices
+    finally:
+        fleet.service.close()
+
+
+class FleetOrchestrator:
+    """The ``selkies-tpu --tpu_sessions N`` entrypoint.
+
+    Shares the solo Orchestrator's server construction and TURN chain
+    (orchestrator.make_signalling_server / resolve_rtc_config); differs
+    in the media core (SessionFleet) and in wiring one transport pair +
+    input host per session. Fleet mode serves the TPU H.264 row only —
+    the sharded step is the tpuh264enc program (parallel/sessions.py).
+    """
+
+    def __init__(self, cfg: Config, *, devices=None, service=None):
+        self.cfg = cfg
+        self.n = int(cfg.tpu_sessions)
+        if self.n < 2:
+            raise ValueError("FleetOrchestrator requires tpu_sessions >= 2")
+        if str(cfg.encoder) != "tpuh264enc":
+            logger.warning(
+                "fleet mode serves the sharded tpuh264enc step; ignoring "
+                "encoder=%s", cfg.encoder)
+        from selkies_tpu.orchestrator import make_signalling_server
+
+        self.metrics = Metrics(
+            port=int(cfg.metrics_http_port),
+            using_webrtc_csv=bool(cfg.enable_webrtc_statistics),
+        )
+        width, height = int(cfg.capture_width), int(cfg.capture_height)
+        # one parse for both the frame sources and the input backends —
+        # the two must agree on which session owns which display
+        self.displays = [d.strip() for d in str(
+            cfg.session_displays or "").split(",") if d.strip()]
+        self.slots = [
+            SessionSlot(
+                k, bitrate_kbps=int(cfg.video_bitrate), fps=int(cfg.framerate),
+                turn_tls_insecure=bool(cfg.turn_tls_insecure),
+            )
+            for k in range(self.n)
+        ]
+        sources = self._make_sources(width, height)
+        self.fleet = SessionFleet(
+            self.slots, width=width, height=height, fps=int(cfg.framerate),
+            sources=sources, devices=devices, service=service,
+        )
+        self.server = make_signalling_server(cfg)
+        # /media/<k> per session; bare /media aliases session 0 so the
+        # stock solo client works against a fleet server
+        for k, slot in enumerate(self.slots):
+            self.server.ws_routes[f"/media/{k}"] = slot.ws.handle_connection
+        self.server.ws_routes["/media"] = self.slots[0].ws.handle_connection
+        self.system_mon = SystemMonitor()
+        self.tpu_mon = TPUMonitor()
+        self.fleet.on_tick = lambda ms: self.tpu_mon.observe_encode(ms)
+        self.tpu_mon.on_stats = self._broadcast_tpu_stats
+        self._tasks: list[asyncio.Task] = []
+        self._rearm: dict[int, asyncio.Event] = {}
+        self._wire_slots()
+
+    def _make_sources(self, width: int, height: int):
+        """Per-session displays from ``--session_displays`` (csv of X
+        DISPLAY names, e.g. ':10,:11'); sessions beyond the list — and
+        sessions whose display is unreachable or mis-sized — get a
+        synthetic source seeded per-session, so streams stay distinct
+        even when every display fails (headless / test rigs)."""
+        from selkies_tpu.pipeline.capture import make_frame_source
+
+        sources = []
+        for k in range(self.n):
+            src = None
+            if k < len(self.displays):
+                src = make_frame_source(width, height, display=self.displays[k])
+                if isinstance(src, SyntheticSource):
+                    src = None  # display unreachable; re-seed below
+                elif (src.width, src.height) != (width, height):
+                    logger.warning(
+                        "session %d display %s is %dx%d; fleet geometry is "
+                        "%dx%d (lockstep batch) — using synthetic source",
+                        k, self.displays[k], src.width, src.height, width, height)
+                    src = None
+            sources.append(src if src is not None
+                           else SyntheticSource(width, height, seed=k))
+        return sources
+
+    def _make_input(self, k: int) -> HostInput:
+        """Session k's input host. Slots with a configured display inject
+        into that X server; others record into the fake backend (a fleet
+        host runs one Xvfb per session, packaging/Dockerfile)."""
+        from selkies_tpu.input_host.backends import FakeBackend, X11Backend
+        from selkies_tpu.input_host.x11 import X11Display
+
+        cfg = self.cfg
+        backend = None
+        if k < len(self.displays):
+            try:
+                backend = X11Backend(X11Display.open(self.displays[k]))
+            except Exception as exc:
+                logger.warning("session %d: X input on %s unavailable (%s)",
+                               k, self.displays[k], exc)
+        if backend is None:
+            backend = FakeBackend()
+        return HostInput(
+            backend=backend,
+            js_socket_path=str(cfg.js_socket_path),
+            enable_clipboard=str(cfg.enable_clipboard).lower(),
+            enable_cursors=False,  # cursor monitor is per-X-display; fleet
+            # slots share the host cursor only when a display is configured
+        )
+
+    def _wire_slots(self) -> None:
+        cfg = self.cfg
+        for k, slot in enumerate(self.slots):
+            slot.input = self._make_input(k)
+            inp = slot.input
+
+            def on_connect(k=k, slot=slot):
+                first = not slot.connected
+                slot.connected = True
+                if slot.gcc is not None:
+                    slot.gcc.reset()
+                self.fleet.force_keyframe(k)
+                slot.send_codec("h264")
+                logger.info("session %d client connected%s", k,
+                            "" if first else " (additional plane)")
+
+            def on_ws_disconnect(k=k, slot=slot):
+                if slot.webrtc.connected:
+                    return
+                self._slot_disconnected(k, slot)
+
+            def on_rtc_disconnect(k=k, slot=slot):
+                if slot.ws.data_channel_ready:
+                    return
+                self._slot_disconnected(k, slot)
+
+            slot.ws.on_connect = on_connect
+            slot.ws.on_disconnect = on_ws_disconnect
+            slot.ws.on_data_message = inp.on_message
+            slot.webrtc.on_connect = on_connect
+            slot.webrtc.on_disconnect = on_rtc_disconnect
+            slot.webrtc.on_data_message = inp.on_message
+            slot.webrtc.on_force_keyframe = (
+                lambda k=k: self.fleet.force_keyframe(k))
+
+            # per-session rate loop: client vb → cap + probe point; GCC
+            # estimates → this session's CBR target only
+            if bool(cfg.congestion_control):
+                audio_kbps = max(int(cfg.audio_bitrate) // 1000, 0)
+                slot.gcc = GccController(
+                    start_kbps=int(cfg.video_bitrate),
+                    min_kbps=max(100 + audio_kbps, int(cfg.video_bitrate) // 10),
+                    max_kbps=int(cfg.video_bitrate),
+                    on_estimate=lambda kbps, k=k: self.fleet.set_session_bitrate(k, kbps),
+                )
+                slot.ws.on_video_sent = slot.gcc.on_frame_sent
+                inp.on_media_ack = slot.gcc.on_frame_ack
+                slot.webrtc.on_video_sent = slot.gcc.on_frame_sent
+                slot.webrtc.on_video_acked = slot.gcc.on_frame_ack
+                slot.webrtc.on_loss = slot.gcc.on_loss_report
+
+            def on_video_bitrate(kbps: int, k=k, slot=slot):
+                self.fleet.set_session_bitrate(k, int(kbps))
+                if slot.gcc is not None:
+                    slot.gcc.set_target(int(kbps))
+
+            inp.on_video_encoder_bit_rate = on_video_bitrate
+            # lockstep batch: fps/resize are fleet configuration, not
+            # per-session — acknowledge without applying (docs/fleet.md)
+            inp.on_set_fps = lambda fps, k=k: logger.info(
+                "session %d requested fps=%s; fleet tick is %s (lockstep)",
+                k, fps, self.fleet.fps)
+            inp.on_set_enable_resize = lambda en, res, k=k: logger.info(
+                "session %d resize request ignored (fleet geometry is fixed)", k)
+            inp.on_clipboard_read = slot.send_clipboard_data
+            inp.on_cursor_change = slot.send_cursor_data
+            inp.on_client_fps = self.metrics.set_fps
+            inp.on_client_latency = self.metrics.set_latency
+            inp.on_ping_response = slot.send_latency_time
+            inp.on_client_webrtc_stats = (
+                lambda t, s: self.metrics.set_webrtc_stats(t, s))
+
+        def on_timer(ts: float) -> None:
+            for slot in self.slots:
+                if slot.connected:
+                    slot.input.send_ping(ts)
+                    slot.send_ping(ts)
+                    slot.send_system_stats(
+                        self.system_mon.cpu_percent,
+                        self.system_mon.mem_total, self.system_mon.mem_used)
+
+        self.system_mon.on_timer = on_timer
+
+    def _broadcast_tpu_stats(self, load: float, total: float, used: float) -> None:
+        self.metrics.set_tpu_utilization(load * 100)
+        for slot in self.slots:
+            if slot.connected:
+                slot._send("gpu_stats", {
+                    "load": load, "memory_total": total, "memory_used": used})
+
+    def _slot_disconnected(self, k: int, slot: SessionSlot) -> None:
+        if not slot.connected:
+            return
+        slot.connected = False
+        logger.info("session %d client disconnected", k)
+        slot.input.reset_keyboard()
+        loop = asyncio.get_running_loop()
+        loop.create_task(slot.webrtc.stop_session())
+        if k in self._rearm:
+            self._rearm[k].set()
+
+    # -- per-slot WebRTC negotiation (solo _signalling_loop × N) -------
+
+    async def _slot_signalling_loop(self, k: int) -> None:
+        cfg, slot = self.cfg, self.slots[k]
+        scheme = "wss" if bool(cfg.enable_https) else "ws"
+        client = SignallingClient(
+            f"{scheme}://127.0.0.1:{self.server.bound_port}/ws",
+            id=server_client_id(k), peer_id=browser_peer_id(k),
+            enable_https=bool(cfg.enable_https),
+            enable_basic_auth=bool(cfg.enable_basic_auth),
+            basic_auth_user=cfg.basic_auth_user,
+            basic_auth_password=cfg.basic_auth_password,
+        )
+        slot.webrtc.on_sdp = client.send_sdp
+        slot.webrtc.on_ice = client.send_ice
+
+        async def on_error(exc: Exception) -> None:
+            if isinstance(exc, SignallingErrorNoPeer):
+                await asyncio.sleep(2.0)
+                await client.setup_call()
+            else:
+                logger.warning("session %d signalling error: %s", k, exc)
+
+        client.on_connect = client.setup_call
+        client.on_error = on_error
+        client.on_session = lambda peer, meta: slot.webrtc.start_session()
+        client.on_sdp = slot.webrtc.set_remote_sdp
+        client.on_ice = slot.webrtc.add_remote_ice
+
+        async def rearm_watch() -> None:
+            while True:
+                await self._rearm[k].wait()
+                self._rearm[k].clear()
+                try:
+                    await client.setup_call()
+                except Exception:
+                    pass
+
+        rearm = asyncio.get_running_loop().create_task(rearm_watch())
+        try:
+            while True:
+                await client.connect()
+                await client.start()
+                await asyncio.sleep(2.0)
+        finally:
+            rearm.cancel()
+            await client.stop()
+
+    # ------------------------------------------------------------------
+
+    async def run(self) -> None:
+        from selkies_tpu.orchestrator import (
+            _first_ice_servers,
+            resolve_rtc_config,
+            wait_for_app_ready,
+        )
+
+        cfg = self.cfg
+        await wait_for_app_ready(cfg.app_ready_file, bool(cfg.app_wait_ready))
+        stun, turn, rtc_config = await resolve_rtc_config(cfg)
+        self.server.set_rtc_config(rtc_config)
+        ice_kw = _first_ice_servers(stun, turn)
+        for slot in self.slots:
+            slot.webrtc.set_ice_servers(**ice_kw)
+        await self.server.start()
+        self._rearm.update({k: asyncio.Event() for k in range(self.n)})
+        for slot in self.slots:
+            await slot.input.connect()
+        # live TURN credential refresh, same chain as solo mode
+        from selkies_tpu.orchestrator import make_rtc_monitors
+
+        monitors = make_rtc_monitors(
+            cfg, lambda stun_s, turn_s, config: self.server.set_rtc_config(config))
+        spawn = asyncio.get_running_loop().create_task
+        self._tasks = [spawn(self._slot_signalling_loop(k))
+                       for k in range(self.n)]
+        self._tasks.extend(spawn(m.start()) for m in monitors)
+        self._tasks.append(spawn(self.system_mon.start()))
+        self._tasks.append(spawn(self.tpu_mon.start()))
+        for slot in self.slots:
+            self._tasks.append(spawn(slot.input.start_clipboard()))
+        if cfg.enable_metrics_http:
+            self._tasks.append(spawn(self.metrics.start_http()))
+        await self.fleet.start()
+        logger.info("selkies-tpu fleet ready on %s:%s (%d sessions %dx%d@%d)",
+                    cfg.addr, cfg.port, self.n, self.fleet.width,
+                    self.fleet.height, self.fleet.fps)
+        try:
+            await self.server.run()
+        finally:
+            await self.shutdown()
+
+    async def shutdown(self) -> None:
+        await self.fleet.stop()
+        self.system_mon.stop()
+        self.tpu_mon.stop()
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        for slot in self.slots:
+            await slot.webrtc.stop_session()
+            await slot.input.stop_js_server()
+            await slot.input.disconnect()
+        await self.server.stop()

@@ -55,6 +55,10 @@ class MultiSessionH264Service:
                  qp: int = 28, fps: int = 60, devices=None):
         self.enc = MultiSessionEncoder(n_sessions, width, height, devices=devices)
         self.n = n_sessions
+        # per-session IDR flags of the most recent tick (the serving loop
+        # needs them for keyframe framing + VBV accounting, mirroring the
+        # solo encoder's last_stats pattern)
+        self.last_idrs: list[bool] = [True] * n_sessions
         self.params = StreamParams(width=width, height=height, qp=qp, fps=fps)
         self._headers = write_sps(self.params) + write_pps(self.params)
         self.sessions = [_SessionState(qp) for _ in range(n_sessions)]
@@ -119,6 +123,7 @@ class MultiSessionH264Service:
             for i in range(self.n)
         ]
         aus = [f.result() for f in futures]
+        self.last_idrs = [bool(x) for x in idrs]
         for s, idr in zip(self.sessions, idrs):
             if idr:
                 s.frames_since_idr = 1

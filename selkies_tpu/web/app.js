@@ -12,7 +12,11 @@
   const hud = document.getElementById("hud");
   const statusEl = document.getElementById("status");
 
-  const appName = new URLSearchParams(location.search).get("app") || "selkies-tpu";
+  const urlParams = new URLSearchParams(location.search);
+  const appName = urlParams.get("app") || "selkies-tpu";
+  // fleet mode (--tpu_sessions N): ?session=k targets session k's media
+  // plane and signalling peer pair (parallel/fleet.py)
+  const session = Math.max(0, parseInt(urlParams.get("session") || "0", 10) || 0);
   const store = {
     get: (k, d) => localStorage.getItem(appName + ":" + k) ?? d,
     set: (k, v) => localStorage.setItem(appName + ":" + k, v),
@@ -54,12 +58,13 @@
     if (wsStarted) return;
     wsStarted = true;
     const proto = location.protocol === "https:" ? "wss:" : "ws:";
-    media.connect(`${proto}//${location.host}/media`);
+    const path = session > 0 ? `/media/${session}` : "/media";
+    media.connect(`${proto}//${location.host}${path}`);
   }
 
   function startRtc() {
     if (!window.RTCPeerConnection || !window.SelkiesWebRTC) { startWs(); return; }
-    rtc = new SelkiesWebRTC(videoEl, onChannelMessage, onRtcEvent);
+    rtc = new SelkiesWebRTC(videoEl, onChannelMessage, onRtcEvent, session);
     rtc.connect();
     const attempt = rtc;          // a stale timer must not kill a newer attempt
     setTimeout(() => {

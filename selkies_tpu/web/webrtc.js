@@ -11,10 +11,14 @@
 "use strict";
 
 class SelkiesWebRTC {
-  constructor(videoEl, onMessage, onStats) {
+  constructor(videoEl, onMessage, onStats, session) {
     this.videoEl = videoEl;
     this.onMessage = onMessage;
     this.onStats = onStats || (() => {});
+    // fleet peer-id convention (parallel/fleet.py): session k's browser
+    // registers as 1+10k; session 0 is the reference's plain peer 1
+    this.session = session | 0;
+    this.peerId = 1 + 10 * this.session;
     this.ws = null;
     this.pc = null;
     this.dc = null;
@@ -42,7 +46,7 @@ class SelkiesWebRTC {
         res: `${Math.round(innerWidth * devicePixelRatio)}x${Math.round(innerHeight * devicePixelRatio)}`,
         scale: devicePixelRatio,
       };
-      this.ws.send(`HELLO 1 ${btoa(JSON.stringify(meta))}`);
+      this.ws.send(`HELLO ${this.peerId} ${btoa(JSON.stringify(meta))}`);
     };
     this.ws.onclose = () => {
       if (!this.closed && !this.connected) this._fail("signalling closed");
