@@ -130,6 +130,14 @@ def _load_and_verify():
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
         ctypes.c_ulong, ctypes.c_long,
     ]
+    # string-keyed option API (aom >= 3.0): lets us set row-mt/tiles
+    # without guessing control-enum values across library builds
+    try:
+        lib.aom_codec_set_option.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p]
+        lib.aom_codec_set_option.restype = ctypes.c_int
+    except AttributeError:
+        lib.aom_codec_set_option = None
 
     # --- offset verification against config_default ground truth ------
     iface = lib.aom_codec_av1_cx()
@@ -220,7 +228,9 @@ class LibAomEncoder:
         self._cfg_words = w
         w[_OFF_G_W], w[_OFF_G_H] = width, height
         w[_OFF_TB_NUM], w[_OFF_TB_DEN] = 1, fps
-        w[_OFF_G_THREADS] = min(8, max(1, (os.cpu_count() or 4) - 1))
+        # reference av1enc row: threads up to 24 (gstwebrtc_app.py:764);
+        # row-mt + tiles below make them actually engage at 1080p
+        w[_OFF_G_THREADS] = min(24, max(1, (os.cpu_count() or 4) - 1))
         w[_OFF_LAG_IN_FRAMES] = 0
         w[_OFF_RC_END_USAGE] = _AOM_CBR
         w[_OFF_TARGET_BITRATE] = bitrate_kbps
@@ -246,6 +256,18 @@ class LibAomEncoder:
         if lib.aom_codec_control(self._ctx, _AOME_SET_CPUUSED,
                                  ctypes.c_int(cpu_used)):
             logger.warning("AOME_SET_CPUUSED rejected")
+        # threading parity with the reference av1enc row
+        # (gstwebrtc_app.py:759-763: row-mt + tile-columns 2 + tile-rows
+        # 2) via the string option API — g_threads alone does not engage
+        # at 1080p without intra-frame parallelism units
+        if getattr(lib, "aom_codec_set_option", None):
+            for opt, val in (("row-mt", "1"),
+                             ("tile-columns", "2"), ("tile-rows", "2")):
+                rc = lib.aom_codec_set_option(
+                    self._ctx, opt.encode(), val.encode())
+                if rc:
+                    logger.warning("aom option %s=%s rejected (rc=%d)",
+                                   opt, val, rc)
         self._img = lib.aom_img_alloc(None, _AOM_IMG_FMT_I420, width, height, 16)
         if not self._img:
             raise RuntimeError("aom_img_alloc failed")

@@ -62,6 +62,15 @@ _VP8E_SET_CPUUSED = 13
 _VP8E_GET_LAST_QUANTIZER_64 = 20
 _VP9E_SET_TILE_COLUMNS = 33
 _VP9E_SET_FRAME_PARALLEL_DECODING = 35
+# VP9E_SET_ROW_MT: enum slot 55 in this build (Debian libvpx 1.12;
+# found by a crash-isolated id scan — mainline's nominal 53 is a GET
+# here and segfaults). Headers are absent from this image, so
+# _row_mt_available() validates the id in a subprocess before the
+# in-process encoder uses it: control(id,1) must be accepted and
+# control(id,7) must fail with the library's own range-check message
+# "row_mt out of range [0..1]" — an exact-name fingerprint no other
+# control produces.
+_VP9E_SET_ROW_MT = 55
 _ENCODER_ABI_VERSION = 5
 _CFG_BYTES = 4096
 _CTX_BYTES = 512
@@ -145,6 +154,61 @@ def _load():
     return _lib
 
 
+_row_mt_state: bool | None = None
+
+
+def _row_mt_available() -> bool:
+    """One-time crash-isolated validation of _VP9E_SET_ROW_MT.
+
+    A child process initializes a tiny VP9 encoder and checks the control
+    id's semantic fingerprint: row_mt is RANGE_CHECK'd to {0,1} in
+    vp9_cx_iface.c, so (id,1) must return OK while (id,7) must be
+    rejected with error detail naming "row_mt". A shifted enum hits
+    either a different setter (fingerprint fails) or a GET control that
+    writes through the int argument (child segfaults) — both fall back
+    cleanly to tile-column threading only.
+    SELKIES_VP9_ROW_MT=0/1 overrides the probe either way."""
+    global _row_mt_state
+    if _row_mt_state is not None:
+        return _row_mt_state
+    env = os.environ.get("SELKIES_VP9_ROW_MT")
+    if env in ("0", "1"):
+        _row_mt_state = env == "1"
+        return _row_mt_state
+    import subprocess
+    import sys
+
+    code = (
+        "import ctypes, sys\n"
+        "from selkies_tpu.models import libvpx_enc as m\n"
+        "lib = m._load()\n"
+        "sys.exit(2) if lib is None else None\n"
+        "cfg = (ctypes.c_uint8 * m._CFG_BYTES)()\n"
+        "iface = lib.vpx_codec_vp9_cx()\n"
+        "assert not lib.vpx_codec_enc_config_default(ctypes.c_void_p(iface), cfg, 0)\n"
+        "ctx = (ctypes.c_uint8 * m._CTX_BYTES)()\n"
+        "assert not lib.vpx_codec_enc_init_ver(ctx, ctypes.c_void_p(iface), cfg, 0, m._ENCODER_ABI_VERSION)\n"
+        "ok = lib.vpx_codec_control_(ctx, m._VP9E_SET_ROW_MT, ctypes.c_int(1))\n"
+        "bad = lib.vpx_codec_control_(ctx, m._VP9E_SET_ROW_MT, ctypes.c_int(7))\n"
+        "lib.vpx_codec_error_detail.restype = ctypes.c_char_p\n"
+        "det = lib.vpx_codec_error_detail(ctx) or b''\n"
+        "lib.vpx_codec_destroy(ctx)\n"
+        "sys.exit(0 if (ok == 0 and bad != 0 and b'row_mt' in det) else 1)\n"
+    )
+    try:
+        rc = subprocess.run(
+            [sys.executable, "-c", code], timeout=30,
+            capture_output=True).returncode
+        _row_mt_state = rc == 0
+    except Exception as exc:
+        logger.warning("row-mt probe failed to run (%s); disabled", exc)
+        _row_mt_state = False
+    if not _row_mt_state:
+        logger.info("VP9 row-mt control not validated (probe rc!=0); "
+                    "tile-column threading only")
+    return _row_mt_state
+
+
 def libvpx_available() -> bool:
     return _load() is not None
 
@@ -203,7 +267,9 @@ class LibVpxEncoder:
         w = self._cfg_words
         w[_OFF_G_W], w[_OFF_G_H] = width, height
         w[_OFF_TB_NUM], w[_OFF_TB_DEN] = 1, fps
-        w[_OFF_G_THREADS] = min(8, max(1, (os.cpu_count() or 4) - 1))
+        # reference vp9enc row threads up to 16 (gstwebrtc_app.py:703);
+        # row-mt + tile columns below make them engage at 1080p
+        w[_OFF_G_THREADS] = min(16, max(1, (os.cpu_count() or 4) - 1))
         w[_OFF_LAG_IN_FRAMES] = 0           # zero latency
         w[_OFF_END_USAGE] = _VPX_CBR
         w[_OFF_TARGET_BITRATE] = bitrate_kbps
@@ -231,15 +297,17 @@ class LibVpxEncoder:
             logger.warning("VP8E_SET_CPUUSED rejected")
         if not vp8:
             # reference vp9enc row parity (gstwebrtc_app.py:699-703):
-            # frame-parallel-decoding + threaded tile columns make the
-            # g_threads above actually engage at 1080p. (row-mt exists in
-            # this libvpx but its control id can't be verified without
-            # headers — a wrong id segfaults — so tiles carry the
-            # threading instead.)
+            # frame-parallel-decoding + threaded tile columns + row-mt
+            # make the g_threads above actually engage at 1080p. The
+            # row-mt control id is validated once in a crash-isolated
+            # subprocess (headers absent from this image).
             if lib.vpx_codec_control_(self._ctx, _VP9E_SET_TILE_COLUMNS, ctypes.c_int(2)):
                 logger.warning("VP9E_SET_TILE_COLUMNS rejected")
             if lib.vpx_codec_control_(self._ctx, _VP9E_SET_FRAME_PARALLEL_DECODING, ctypes.c_int(1)):
                 logger.warning("VP9E_SET_FRAME_PARALLEL_DECODING rejected")
+            if _row_mt_available():
+                if lib.vpx_codec_control_(self._ctx, _VP9E_SET_ROW_MT, ctypes.c_int(1)):
+                    logger.warning("VP9E_SET_ROW_MT rejected at init")
         self._img = lib.vpx_img_alloc(None, _VPX_IMG_FMT_I420, width, height, 16)
         if not self._img:
             raise RuntimeError("vpx_img_alloc failed")
