@@ -101,6 +101,30 @@ class Metrics:
         self.using_webrtc_csv = using_webrtc_csv
         self._video_log: _CsvLog | None = None
         self._audio_log: _CsvLog | None = None
+        self._session_fps: Gauge | None = None
+        self._session_latency: Gauge | None = None
+
+    def session_setters(self, session: int):
+        """(set_fps, set_latency) for one fleet session, exported as
+        ``session_fps{session=k}`` / ``session_latency{session=k}`` —
+        scalar last-writer-wins gauges would lose the per-session signal
+        on a multi-session host. The aggregate fps histogram still
+        observes every sample."""
+        if self._session_fps is None:
+            self._session_fps = Gauge(
+                "session_fps", "Client-observed fps per fleet session",
+                ["session"], registry=self.registry)
+            self._session_latency = Gauge(
+                "session_latency", "Client latency (ms) per fleet session",
+                ["session"], registry=self.registry)
+        fps_g = self._session_fps.labels(session=str(session))
+        lat_g = self._session_latency.labels(session=str(session))
+
+        def set_fps(fps: float) -> None:
+            fps_g.set(fps)
+            self.fps_hist.observe(fps)
+
+        return set_fps, lat_g.set
 
     # -- setters -------------------------------------------------------
 

@@ -35,6 +35,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import time
 
 import numpy as np
@@ -84,6 +85,9 @@ class SessionSlot:
         self.input: HostInput | None = None
         self.connected = False
         self.frames = 0
+        # cumulative (packetsLost, packetsReceived) from the last client
+        # stats upload — interval loss for GCC on the WS plane
+        self.last_loss_counters = (0.0, 0.0)
 
     # -- server→client control vocabulary (the TPUWebRTCApp subset a
     #    fleet slot needs; same wire format, gstwebrtc_app.py:1454-1579)
@@ -138,6 +142,7 @@ class SessionFleet:
         self.sources = sources or [
             SyntheticSource(width, height, seed=k) for k in range(self.n)]
         self._batch = np.empty((self.n, height, width, 4), np.uint8)
+        self._geometry_warned: set[int] = set()
         self._task: asyncio.Task | None = None
         self.ticks = 0
         self.last_tick_ms = 0.0
@@ -168,15 +173,33 @@ class SessionFleet:
         self.service.close()
 
     def _capture_batch(self) -> None:
+        h, w = self.height, self.width
         for k, src in enumerate(self.sources):
-            self._batch[k] = src.capture()
+            frame = src.capture()
+            if frame.shape[:2] == (h, w):
+                self._batch[k] = frame
+                continue
+            # a runtime xrandr resize on one display must not take the
+            # whole lockstep batch down: fit the capture to the fleet
+            # geometry (crop / zero-pad) and keep streaming
+            if k not in self._geometry_warned:
+                self._geometry_warned.add(k)
+                logger.warning(
+                    "session %d capture is %dx%d but fleet geometry is "
+                    "%dx%d; fitting (fleet geometry is fixed per run)",
+                    k, frame.shape[1], frame.shape[0], w, h)
+            fh, fw = min(h, frame.shape[0]), min(w, frame.shape[1])
+            self._batch[k] = 0
+            self._batch[k, :fh, :fw] = frame[:fh, :fw]
 
-    def _encode_tick(self) -> tuple[list[bytes], list[bool], float]:
+    def _encode_tick(self) -> tuple[list[bytes], list[bool], list[int], float]:
         t0 = time.perf_counter()
-        for k, slot in enumerate(self.slots):
-            self.service.set_qp(k, slot.rc.frame_qp())
+        qps = [slot.rc.frame_qp() for slot in self.slots]
+        for k, qp in enumerate(qps):
+            self.service.set_qp(k, qp)
         aus = self.service.encode_tick(self._batch)
-        return aus, list(self.service.last_idrs), (time.perf_counter() - t0) * 1e3
+        return (aus, list(self.service.last_idrs), qps,
+                (time.perf_counter() - t0) * 1e3)
 
     async def _run(self) -> None:
         next_tick = time.monotonic()
@@ -192,20 +215,22 @@ class SessionFleet:
                 continue  # idle fleet: no capture, no device work
             try:
                 await asyncio.to_thread(self._capture_batch)
-                aus, idrs, tick_ms = await asyncio.to_thread(self._encode_tick)
+                aus, idrs, qps, tick_ms = await asyncio.to_thread(self._encode_tick)
                 self.ticks += 1
                 self.last_tick_ms = tick_ms
                 self.on_tick(tick_ms)
                 ts = int((time.monotonic() - t0) * 90000)
                 wall = time.time()
                 sends = []
-                for slot, au, idr in zip(self.slots, aus, idrs):
+                for slot, au, idr, qp in zip(self.slots, aus, idrs, qps):
                     slot.rc.update(len(au), idr=idr)
                     if not slot.connected:
                         continue
                     ef = EncodedFrame(
                         au=au, timestamp_90k=ts, wall_time=wall, idr=idr,
-                        qp=slot.rc.frame_qp(), device_ms=tick_ms,
+                        # the QP this frame was actually encoded at (rc
+                        # .update above may already have moved the next)
+                        qp=qp, device_ms=tick_ms,
                         pack_ms=0.0,
                     )
                     slot.frames += 1
@@ -234,7 +259,7 @@ def dryrun(n_devices: int) -> None:
     fleet = SessionFleet(slots, width=64, height=64, fps=60)
     try:
         fleet._capture_batch()
-        aus, idrs, _ = fleet._encode_tick()
+        aus, idrs, _, _ = fleet._encode_tick()
         assert len(aus) == n_devices and all(idrs)
         for au in aus:
             assert au.startswith(b"\x00\x00\x00\x01") and len(au) > 50
@@ -243,7 +268,7 @@ def dryrun(n_devices: int) -> None:
         fleet.force_keyframe(min(1, n_devices - 1))
         fleet.set_session_bitrate(0, 900)
         fleet._capture_batch()
-        aus2, idrs2, _ = fleet._encode_tick()
+        aus2, idrs2, _, _ = fleet._encode_tick()
         assert len(aus2) == n_devices
         if n_devices > 1:
             assert idrs2[1] and not idrs2[0]
@@ -349,14 +374,23 @@ class FleetOrchestrator:
             except Exception as exc:
                 logger.warning("session %d: X input on %s unavailable (%s)",
                                k, self.displays[k], exc)
+        has_display = backend is not None
         if backend is None:
             backend = FakeBackend()
+        # per-session gamepad socket directory: the selkies_js{0-3}.sock
+        # names are fixed, so sessions sharing one directory would steal
+        # each other's bound sockets (gamepad cross-wiring)
+        js_dir = os.path.join(str(cfg.js_socket_path), f"session-{k}")
+        os.makedirs(js_dir, exist_ok=True)
         return HostInput(
             backend=backend,
-            js_socket_path=str(cfg.js_socket_path),
+            js_socket_path=js_dir,
             enable_clipboard=str(cfg.enable_clipboard).lower(),
-            enable_cursors=False,  # cursor monitor is per-X-display; fleet
-            # slots share the host cursor only when a display is configured
+            # cursor monitoring is per-X-display (XFixes events); only
+            # slots driving a real display can observe cursor changes
+            enable_cursors=bool(cfg.enable_cursors) and has_display,
+            cursor_size=int(cfg.cursor_size),
+            cursor_debug=bool(cfg.debug_cursors),
         )
 
     def _wire_slots(self) -> None:
@@ -425,11 +459,14 @@ class FleetOrchestrator:
                 "session %d resize request ignored (fleet geometry is fixed)", k)
             inp.on_clipboard_read = slot.send_clipboard_data
             inp.on_cursor_change = slot.send_cursor_data
-            inp.on_client_fps = self.metrics.set_fps
-            inp.on_client_latency = self.metrics.set_latency
+            # per-session labeled gauges: N clients writing one scalar
+            # gauge would be last-writer-wins noise
+            set_fps, set_latency = self.metrics.session_setters(k)
+            inp.on_client_fps = set_fps
+            inp.on_client_latency = set_latency
             inp.on_ping_response = slot.send_latency_time
             inp.on_client_webrtc_stats = (
-                lambda t, s: self.metrics.set_webrtc_stats(t, s))
+                lambda t, s, k=k, slot=slot: self._on_slot_stats(slot, t, s))
 
         def on_timer(ts: float) -> None:
             for slot in self.slots:
@@ -441,6 +478,29 @@ class FleetOrchestrator:
                         self.system_mon.mem_total, self.system_mon.mem_used)
 
         self.system_mon.on_timer = on_timer
+
+    async def _on_slot_stats(self, slot: SessionSlot, stat_type: str,
+                             stats_json: str) -> None:
+        """Client RTCStats upload: record + feed interval loss into this
+        session's GCC when the WS fallback plane carries the media (the
+        WebRTC plane reports loss via RTCP instead — counting the upload
+        too would double the multiplicative back-off; solo parity:
+        orchestrator._on_client_webrtc_stats)."""
+        from selkies_tpu.orchestrator import _loss_counters
+
+        await self.metrics.set_webrtc_stats(stat_type, stats_json)
+        if (slot.gcc is None or stat_type != "_stats_video"
+                or slot.webrtc.connected):
+            return
+        counters = _loss_counters(stats_json)
+        if counters is None:
+            return
+        lost, received = counters
+        p_lost, p_recv = slot.last_loss_counters
+        d_lost, d_recv = lost - p_lost, received - p_recv
+        slot.last_loss_counters = (lost, received)
+        if d_lost >= 0 and d_recv >= 0 and d_lost + d_recv > 0:
+            slot.gcc.on_loss_report(d_lost / (d_lost + d_recv))
 
     def _broadcast_tpu_stats(self, load: float, total: float, used: float) -> None:
         self.metrics.set_tpu_utilization(load * 100)
@@ -541,6 +601,7 @@ class FleetOrchestrator:
         self._tasks.append(spawn(self.tpu_mon.start()))
         for slot in self.slots:
             self._tasks.append(spawn(slot.input.start_clipboard()))
+            self._tasks.append(spawn(slot.input.start_cursor_monitor()))
         if cfg.enable_metrics_http:
             self._tasks.append(spawn(self.metrics.start_http()))
         await self.fleet.start()

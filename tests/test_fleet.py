@@ -230,7 +230,7 @@ def test_fleet_streams_bit_exact_vs_service(loop, tmp_path):
             ref_sources = [SyntheticSource(W, H, seed=k) for k in range(n)]
             for tick in range(3):
                 fleet._capture_batch()
-                aus, idrs, _ = fleet._encode_tick()
+                aus, idrs, _, _ = fleet._encode_tick()
                 ref_batch = np.stack([s.capture() for s in ref_sources])
                 for k, slot in enumerate(slots):
                     ref.set_qp(k, slot.rc.frame_qp())
@@ -240,5 +240,53 @@ def test_fleet_streams_bit_exact_vs_service(loop, tmp_path):
         finally:
             fleet.service.close()
             ref.close()
+
+    loop.run_until_complete(scenario())
+
+
+def test_fleet_ws_loss_feeds_session_gcc(loop, tmp_path):
+    """A WS-plane client's RTCStats loss upload must back off that
+    session's GCC only (solo parity: orchestrator loss extraction)."""
+
+    async def scenario():
+        from selkies_tpu.parallel.fleet import FleetOrchestrator
+
+        orch = FleetOrchestrator(make_config(tmp_path, n=2,
+                                             congestion_control=True))
+        try:
+            s0, s1 = orch.slots
+            assert s0.gcc is not None
+            before0 = s0.gcc.estimate_kbps
+            before1 = s1.gcc.estimate_kbps
+            stats = json.dumps([{  # 20% interval loss -> multiplicative cut
+                "type": "inbound-rtp", "packetsLost": 20,
+                "packetsReceived": 80}])
+            await orch._on_slot_stats(s0, "_stats_video", stats)
+            assert s0.gcc.estimate_kbps < before0
+            assert s1.gcc.estimate_kbps == before1
+        finally:
+            await orch.fleet.stop()
+
+    loop.run_until_complete(scenario())
+
+
+def test_fleet_capture_geometry_mismatch_survives(loop, tmp_path):
+    """A source returning the wrong geometry (runtime xrandr resize)
+    must be fitted, not crash the lockstep batch."""
+
+    async def scenario():
+        from selkies_tpu.parallel.fleet import SessionFleet, SessionSlot
+        from selkies_tpu.pipeline.elements import SyntheticSource
+
+        slots = [SessionSlot(k, bitrate_kbps=2000, fps=30) for k in range(2)]
+        fleet = SessionFleet(slots, width=W, height=H, fps=30)
+        try:
+            fleet.sources[1] = SyntheticSource(W // 2, H // 2, seed=9)
+            fleet._capture_batch()
+            aus, idrs, qps, _ = fleet._encode_tick()
+            assert len(aus) == 2 and all(len(a) > 50 for a in aus)
+            assert qps == [s.rc.frame_qp() for s in slots]
+        finally:
+            fleet.service.close()
 
     loop.run_until_complete(scenario())
