@@ -11,8 +11,10 @@ those from closed-form rules). The entropy back-end is therefore libaom
 is the ctypes row). What the framework adds is the same front-end the
 TPU H.264 path proved out:
 
-* per-tile change classification against the previous capture
-  (FramePrep's native memcmp — the XDamage analogue);
+* per-MB change classification against the previous capture — ON DEVICE
+  (models/hybrid_frontend.py: jitted dirty-MB step + the H.264 path's
+  coarse ME voting for scroll hints) on PCIe-local accelerators, or
+  FramePrep's native memcmp (the XDamage analogue) on the relay;
 * UNCHANGED frames never reach libaom at all: they encode as a 5-byte
   show_existing_frame temporal unit (spec 5.9.2) re-showing the slot
   the previous frame landed in. Which slot that is comes from parsing
@@ -42,29 +44,26 @@ import time
 
 import numpy as np
 
-from selkies_tpu.models import frameprep
 from selkies_tpu.models.av1 import headers
+from selkies_tpu.models.hybrid_frontend import HybridFrontendMixin
 from selkies_tpu.models.libaom_enc import LibAomEncoder
 from selkies_tpu.models.stats import FrameStats
 
 logger = logging.getLogger("models.av1")
 
 
-class TPUAV1Encoder(LibAomEncoder):
-    """LibAomEncoder plus the capture-delta fast path."""
+class TPUAV1Encoder(HybridFrontendMixin, LibAomEncoder):
+    """LibAomEncoder plus the capture-delta front-end (device or host —
+    models/hybrid_frontend.py)."""
 
     codec = "av1"
 
     def __init__(self, width: int, height: int, fps: int = 60,
-                 bitrate_kbps: int = 2000, cpu_used: int = 10):
+                 bitrate_kbps: int = 2000, cpu_used: int = 10,
+                 frontend: str | None = None):
         super().__init__(width=width, height=height, fps=fps,
                          bitrate_kbps=bitrate_kbps, cpu_used=cpu_used)
-        pad_w = (width + 15) // 16 * 16
-        pad_h = (height + 15) // 16 * 16
-        self._prep = frameprep.FramePrep(width, height, pad_w, pad_h, nslots=2)
-        self._tile_w = next(
-            (t for t in (128, 64, 32, 16) if pad_w % t == 0), pad_w
-        )
+        self._init_frontend(width, height, frontend)
         self._have_ref = False
         self._map_active = False
         self._seq: headers.SequenceHeader | None = None
@@ -77,15 +76,6 @@ class TPUAV1Encoder(LibAomEncoder):
         # the next capture must re-encode even if unchanged
         self._have_ref = False
         self._show_slot = None
-
-    def _mb_active_from_tiles(self, tiles: np.ndarray) -> np.ndarray:
-        """(nbands, ntiles) dirty tiles -> (mb_rows, mb_cols) activity.
-        Bands are 16 rows == one 16x16 block row; tiles are _tile_w luma
-        cols, so block col c maps to tile (c*16)//tile_w."""
-        mb_rows = (self.height + 15) // 16
-        mb_cols = (self.width + 15) // 16
-        cols = (np.arange(mb_cols) * 16) // self._tile_w
-        return tiles[:mb_rows][:, cols]
 
     def _track_output(self, au: bytes) -> None:
         """Parse our own bitstream: which slot can re-show this frame?"""
@@ -105,8 +95,8 @@ class TPUAV1Encoder(LibAomEncoder):
             self._show_slot = None
 
     def encode_frame(self, frame: np.ndarray, qp: int | None = None) -> bytes:
-        tiles = self._prep.dirty_tiles(np.asarray(frame), self._tile_w)
-        unchanged = tiles is not None and not tiles.any()
+        dirty = self._classify_mbs(np.asarray(frame))
+        unchanged = dirty is not None and not dirty.any()
         if (unchanged and self._have_ref and not self._force_idr
                 and self._show_slot is not None):
             t0 = time.perf_counter()
@@ -114,7 +104,9 @@ class TPUAV1Encoder(LibAomEncoder):
             self.static_frames += 1
             self.last_stats = FrameStats(
                 frame_index=self.frame_index, idr=False, qp=self.qp,
-                bytes=len(au), device_ms=(time.perf_counter() - t0) * 1e3,
+                bytes=len(au),
+                device_ms=self.frontend_device_ms or
+                (time.perf_counter() - t0) * 1e3,
                 pack_ms=0.0,
                 skipped_mbs=(self.height // 16) * (self.width // 16),
             )
@@ -128,9 +120,9 @@ class TPUAV1Encoder(LibAomEncoder):
             restrict = np.zeros(((self.height + 15) // 16,
                                  (self.width + 15) // 16), np.uint8)
             self.static_frames += 1
-        elif (tiles is not None and self._have_ref and not self._force_idr
-              and tiles.any() and not tiles.all()):
-            restrict = self._mb_active_from_tiles(tiles)
+        elif (dirty is not None and self._have_ref and not self._force_idr
+              and dirty.any() and not dirty.all()):
+            restrict = dirty
             self.active_map_frames += 1
         if restrict is not None and self.set_active_map(restrict):
             self._map_active = True
@@ -143,5 +135,7 @@ class TPUAV1Encoder(LibAomEncoder):
                 self.set_active_map(None)
                 self._map_active = False
         self._track_output(au)
+        if self.last_stats is not None and self.frontend_device_ms:
+            self.last_stats.device_ms += self.frontend_device_ms
         self._have_ref = True
         return au

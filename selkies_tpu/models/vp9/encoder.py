@@ -12,18 +12,21 @@ entropy back-end is libvpx (exactly what the reference's vp9enc element
 wraps). What the framework adds on top is the same front-end the TPU
 H.264 path proved out:
 
-* per-tile change classification against the previous capture
-  (FramePrep's native memcmp — the XDamage analogue);
+* per-MB change classification against the previous capture — ON DEVICE
+  (models/hybrid_frontend.py: a jitted dirty-MB step plus the H.264
+  path's coarse_vote_candidates_jnp ME voting for scroll hints) on
+  PCIe-local accelerators, or FramePrep's native memcmp (the XDamage
+  analogue) on the relay, where frame upload is per-byte priced;
 * UNCHANGED frames never reach libvpx at all: they encode as a ONE-BYTE
   VP9 `show_existing_frame` header (uncompressed header only, no
   compressed data, so no bool coder involved) re-showing the last
   reference slot. The dominant idle-desktop case costs zero encode CPU
   and one byte of bitstream, mirroring the H.264 path's all-skip slice;
-* PARTIALLY-changed frames hand libvpx a per-MB ACTIVE MAP derived from
-  the dirty-tile classification (VP8E_SET_ACTIVEMAP): unchanged
-  macroblocks are forced to skip-from-reference, so libvpx's motion
-  search / RD / transform run only over the pixels that moved —
-  front-end analysis decides per-MB work, the bool coder stays libvpx's.
+* PARTIALLY-changed frames hand libvpx a per-MB ACTIVE MAP from the
+  classification (VP8E_SET_ACTIVEMAP): unchanged macroblocks are forced
+  to skip-from-reference, so libvpx's motion search / RD / transform run
+  only over the pixels that moved — front-end analysis decides per-MB
+  work, the bool coder stays libvpx's.
   Measured (PERF.md): ~4.4x less encode CPU on an idle desktop (static
   frames ~free); only ~1.05x on a busy trace, where libvpx's per-frame
   fixed costs (loopfilter, frame setup) dominate.
@@ -40,7 +43,7 @@ import time
 
 import numpy as np
 
-from selkies_tpu.models.frameprep import FramePrep
+from selkies_tpu.models.hybrid_frontend import HybridFrontendMixin
 from selkies_tpu.models.libvpx_enc import LibVpxEncoder
 from selkies_tpu.models.stats import FrameStats
 
@@ -57,21 +60,17 @@ def show_existing_frame(map_idx: int = 0) -> bytes:
     return bytes([0b10001000 | map_idx])
 
 
-class TPUVP9Encoder(LibVpxEncoder):
-    """LibVpxEncoder plus the capture-delta fast path."""
+class TPUVP9Encoder(HybridFrontendMixin, LibVpxEncoder):
+    """LibVpxEncoder plus the capture-delta front-end (device or host —
+    models/hybrid_frontend.py)."""
 
     codec = "vp9"
 
     def __init__(self, width: int, height: int, fps: int = 60,
-                 bitrate_kbps: int = 2000):
+                 bitrate_kbps: int = 2000, frontend: str | None = None):
         super().__init__(width=width, height=height, fps=fps,
                          bitrate_kbps=bitrate_kbps, vp8=False)
-        pad_w = (width + 15) // 16 * 16
-        pad_h = (height + 15) // 16 * 16
-        self._prep = FramePrep(width, height, pad_w, pad_h, nslots=2)
-        self._tile_w = next(
-            (t for t in (128, 64, 32, 16) if pad_w % t == 0), pad_w
-        )
+        self._init_frontend(width, height, frontend)
         self._have_ref = False
         self._map_active = False  # whether a restrictive map is installed
         self.static_frames = 0
@@ -82,38 +81,31 @@ class TPUVP9Encoder(LibVpxEncoder):
         # the next capture must re-encode even if unchanged
         self._have_ref = False
 
-    def _mb_active_from_tiles(self, tiles: np.ndarray) -> np.ndarray:
-        """(nbands, ntiles) dirty tiles -> (mb_rows, mb_cols) activity.
-        Bands are 16 rows == one MB row; tiles are _tile_w luma cols, so
-        MB col c maps to tile (c*16)//tile_w."""
-        mb_rows = (self.height + 15) // 16
-        mb_cols = (self.width + 15) // 16
-        cols = (np.arange(mb_cols) * 16) // self._tile_w
-        return tiles[:mb_rows][:, cols]
-
     def encode_frame(self, frame: np.ndarray, qp: int | None = None) -> bytes:
-        tiles = self._prep.dirty_tiles(np.asarray(frame), self._tile_w)
-        unchanged = tiles is not None and not tiles.any()
+        dirty = self._classify_mbs(np.asarray(frame))
+        unchanged = dirty is not None and not dirty.any()
         if unchanged and self._have_ref and not self._force_idr:
             t0 = time.perf_counter()
             au = show_existing_frame(0)
             self.static_frames += 1
             self.last_stats = FrameStats(
                 frame_index=self.frame_index, idr=False, qp=self.qp,
-                bytes=len(au), device_ms=(time.perf_counter() - t0) * 1e3,
+                bytes=len(au),
+                device_ms=self.frontend_device_ms or
+                (time.perf_counter() - t0) * 1e3,
                 pack_ms=0.0,
                 skipped_mbs=(self.height // 16) * (self.width // 16),
             )
             self.frame_index += 1
             return au
         partial = (
-            tiles is not None and self._have_ref and not self._force_idr
-            and tiles.any() and not tiles.all()
+            dirty is not None and self._have_ref and not self._force_idr
+            and dirty.any() and not dirty.all()
         )
         if partial:
             # front-end decides per-MB work: unchanged MBs become
             # skip-from-reference inside libvpx (no ME/RD/transform)
-            if self.set_active_map(self._mb_active_from_tiles(tiles)):
+            if self.set_active_map(dirty):
                 self._map_active = True
                 self.active_map_frames += 1
         try:
@@ -124,5 +116,7 @@ class TPUVP9Encoder(LibVpxEncoder):
                 # error paths: correctness beats the tiny per-frame call
                 self.set_active_map(None)
                 self._map_active = False
+        if self.last_stats is not None and self.frontend_device_ms:
+            self.last_stats.device_ms += self.frontend_device_ms
         self._have_ref = True
         return au

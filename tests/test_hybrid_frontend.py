@@ -1,0 +1,166 @@
+"""Device front-end for the hybrid VP9/AV1 rows (models/hybrid_frontend.py).
+
+The delta-classification/ME-hint front-end the rows previously ran as a
+host memcmp now also runs on device, sharing the H.264 path's coarse
+motion voting (encoder_core.coarse_vote_candidates_jnp). These tests run
+it on the CPU jax backend: classification parity with the host
+classifier, per-MB granularity, scroll hint detection, and the full rows
+streaming with frontend="device".
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+W, H = 256, 192  # MB- and tile-aligned
+
+
+def _trace(n=6, seed=4):
+    rng = np.random.default_rng(seed)
+    base = np.kron(rng.integers(40, 200, (H // 16, W // 16, 4), np.uint8),
+                   np.ones((16, 16, 1), np.uint8))
+    return base, rng
+
+
+def test_device_dirty_map_matches_host_semantics():
+    from selkies_tpu.models.frameprep import FramePrep
+    from selkies_tpu.models.hybrid_frontend import DeviceDeltaFrontend
+
+    fe = DeviceDeltaFrontend(W, H)
+    prep = FramePrep(W, H, W, H, nslots=2)
+    base, rng = _trace()
+
+    assert fe.step(base)[0] is None           # first frame: no reference
+    prep.dirty_tiles(base, 128)
+
+    # change exactly one 16x16 MB: device map marks exactly that MB
+    f2 = base.copy()
+    f2[32:48, 64:80] = 255
+    dirty, hints = fe.step(f2)
+    assert dirty.shape == (H // 16, W // 16)
+    assert dirty[2, 4] and dirty.sum() == 1
+    # host tile classifier agrees at its coarser granularity
+    tiles = prep.dirty_tiles(f2, 128)
+    assert tiles[2].any() and not tiles[0].any()
+
+    # unchanged frame: all clean on both
+    dirty2, _ = fe.step(f2)
+    assert not dirty2.any()
+    assert not prep.dirty_tiles(f2, 128).any()
+
+    # single-byte chroma-channel change is caught (all 4 channels compared)
+    f3 = f2.copy()
+    f3[100, 200, 2] ^= 1
+    dirty3, _ = fe.step(f3)
+    assert dirty3[100 // 16, 200 // 16] and dirty3.sum() == 1
+
+
+def test_device_hints_detect_scroll():
+    from selkies_tpu.models.hybrid_frontend import DeviceDeltaFrontend
+
+    fe = DeviceDeltaFrontend(W, H)
+    base, rng = _trace(seed=9)
+    noise = rng.integers(0, 255, (H, W, 4), np.uint8)
+    fe.step(noise)
+    rolled = np.roll(noise, 8, axis=1)  # global scroll +8 px in x
+    dirty, hints = fe.step(rolled)
+    assert dirty.any()
+    # MV convention is cur[p] ~ prev[p + mv] (H.264 path parity), so a
+    # +8 px scroll appears as the dominant candidate (-8, 0)
+    assert any(tuple(h) == (-8, 0) for h in hints.tolist()), hints.tolist()
+
+
+@pytest.mark.parametrize("row", ["vp9", "av1"])
+def test_hybrid_rows_stream_with_device_frontend(row):
+    if row == "vp9":
+        from selkies_tpu.models.libvpx_enc import libvpx_available
+
+        if not libvpx_available():
+            pytest.skip("libvpx absent")
+        from selkies_tpu.models.vp9.encoder import TPUVP9Encoder as Enc
+    else:
+        from selkies_tpu.models.libaom_enc import libaom_available
+
+        if not libaom_available():
+            pytest.skip("libaom absent")
+        from selkies_tpu.models.av1.encoder import TPUAV1Encoder as Enc
+
+    enc = Enc(width=W, height=H, fps=30, bitrate_kbps=1500,
+              frontend="device")
+    base, rng = _trace(seed=7)
+    aus = [enc.encode_frame(base)]          # keyframe
+    aus.append(enc.encode_frame(base))      # static -> fast path
+    moved = base.copy()
+    moved[64:96, 64:160] = rng.integers(0, 255, (32, 96, 4), np.uint8)
+    aus.append(enc.encode_frame(moved))     # partial -> active map
+    aus.append(enc.encode_frame(moved))     # static again
+    stats = enc.last_stats
+    assert enc.static_frames >= 1
+    assert enc.active_map_frames >= 1
+    # device time is visible in the stats surface (the VERDICT "profile
+    # shows device time inside a tpuvp9enc/tpuav1enc encode" contract)
+    assert enc.frontend_device_ms > 0.0
+    assert stats.device_ms > 0.0
+    assert len(aus[3]) < len(aus[0]) // 10  # repeat rides the tiny path
+    enc.close()
+
+
+def test_vp9_device_stream_decodes():
+    import struct
+
+    from selkies_tpu.models.libvpx_enc import libvpx_available
+
+    if not libvpx_available():
+        pytest.skip("libvpx absent")
+    import cv2
+
+    from selkies_tpu.models.vp9.encoder import TPUVP9Encoder
+
+    enc = TPUVP9Encoder(width=W, height=H, fps=30, bitrate_kbps=1500,
+                        frontend="device")
+    base, rng = _trace(seed=3)
+    payloads = []
+    cur = base
+    for i in range(5):
+        if i in (2, 4):
+            cur = cur.copy()
+            cur[16 * i: 16 * i + 16, :64] = rng.integers(
+                0, 255, (16, 64, 4), np.uint8)
+        payloads.append(enc.encode_frame(cur))
+    enc.close()
+    hdr = b"DKIF" + struct.pack("<HH4sHHIIII", 0, 32, b"VP90", W, H,
+                                30, 1, len(payloads), 0)
+    out = bytearray(hdr)
+    for i, p in enumerate(payloads):
+        out += struct.pack("<IQ", len(p), i) + p
+    path = "/tmp/hybrid_device_vp9.ivf"
+    open(path, "wb").write(bytes(out))
+    cap = cv2.VideoCapture(path)
+    n = 0
+    while True:
+        ok, img = cap.read()
+        if not ok:
+            break
+        assert img.shape[:2] == (H, W)
+        n += 1
+    assert n == 5
+
+
+def test_frontend_auto_resolves(monkeypatch):
+    """frontend='auto' must resolve through default_frontend_mode, not
+    literally compare equal to 'device' and silently force host."""
+    from selkies_tpu.models import hybrid_frontend as hf
+
+    monkeypatch.setenv("SELKIES_HYBRID_FRONTEND", "device")
+
+    class Probe(hf.HybridFrontendMixin):
+        width, height = W, H
+
+    p = Probe()
+    p._init_frontend(W, H, "auto")
+    assert p.frontend_mode == "device" and p._device_fe is not None
+    monkeypatch.setenv("SELKIES_HYBRID_FRONTEND", "host")
+    p2 = Probe()
+    p2._init_frontend(W, H, "auto")
+    assert p2.frontend_mode == "host" and p2._prep is not None

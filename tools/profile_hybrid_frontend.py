@@ -1,0 +1,83 @@
+#!/usr/bin/env python3
+"""Device time inside a tpuvp9enc/tpuav1enc encode (round-5 VERDICT
+item 5 'Done' contract): run the hybrid rows with the DEVICE front-end
+(models/hybrid_frontend.py — per-MB dirty classification + coarse ME
+hints shared with the H.264 path) on the 1080p desktop trace and print
+per-frame totals split into front-end device ms vs library encode ms.
+
+Uses the TPU when the tunnel is up (one jax process, etiquette per
+.claude/skills/verify); falls back to the CPU jax backend with an
+honest label otherwise.
+"""
+import os
+import socket
+import sys
+import time
+import importlib.util
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def tunnel_up() -> bool:
+    try:
+        socket.create_connection(("127.0.0.1", 8083), timeout=3).close()
+        return True
+    except OSError:
+        return False
+
+
+# sitecustomize registers the axon PJRT plugin at interpreter start when
+# PALLAS_AXON_POOL_IPS is set, and the plugin wins over JAX_PLATFORMS=cpu
+# — with the tunnel down, jax init then blocks forever. The only reliable
+# opt-out is a fresh interpreter with a cleaned env (bench.py pattern).
+if (os.environ.get("PALLAS_AXON_POOL_IPS")
+        and not os.environ.get("SELKIES_PROFILE_REEXEC")):
+    if not tunnel_up():
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["SELKIES_PROFILE_REEXEC"] = "cpu-fallback(tunnel down)"
+        os.execve(sys.executable, [sys.executable, *sys.argv], env)
+
+BACKEND = os.environ.get("SELKIES_PROFILE_REEXEC", "tpu")
+
+spec = importlib.util.spec_from_file_location("bench", "bench.py")
+bench = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(bench)
+
+frames = bench._desktop_trace(40)
+W, H = bench.W, bench.H
+
+
+def run(enc, label):
+    fe_ms, lib_ms, n = 0.0, 0.0, 0
+    enc.encode_frame(frames[0])  # keyframe + front-end warmup/compile
+    enc.encode_frame(frames[1])  # steady-state executable
+    for f in frames[2:]:
+        t0 = time.perf_counter()
+        enc.encode_frame(f)
+        total = (time.perf_counter() - t0) * 1e3
+        fe_ms += enc.frontend_device_ms
+        lib_ms += total - enc.frontend_device_ms
+        n += 1
+    print(f"{label}: frontend(device)={fe_ms / n:6.2f} ms/f  "
+          f"library={lib_ms / n:7.2f} ms/f  "
+          f"static={enc.static_frames} active_map={enc.active_map_frames}")
+    enc.close()
+
+
+print(f"backend={BACKEND}  geometry={W}x{H}  frames={len(frames)}")
+from selkies_tpu.models.vp9.encoder import TPUVP9Encoder
+
+run(TPUVP9Encoder(width=W, height=H, fps=60, bitrate_kbps=3000,
+                  frontend="device"), "tpuvp9enc")
+
+from selkies_tpu.models.libaom_enc import libaom_available
+
+if libaom_available():
+    from selkies_tpu.models.av1.encoder import TPUAV1Encoder
+
+    run(TPUAV1Encoder(width=W, height=H, fps=60, bitrate_kbps=3000,
+                      frontend="device"), "tpuav1enc")
