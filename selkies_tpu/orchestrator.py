@@ -533,6 +533,13 @@ class Orchestrator:
             self.metrics.initialize_webrtc_csv_file(self.cfg.webrtc_statistics_dir)
         self.app.force_keyframe()
         self.app.send_codec()  # client picks its WebCodecs decoder config
+        # push current server settings so the client drawer reflects them
+        # (reference system-action loop, app.js:685-769)
+        self.app.send_encoder(self.cfg.encoder)
+        self.app.send_framerate(int(self.app.framerate))
+        self.app.send_video_bitrate(int(self.app.video_bitrate_kbps))
+        self.app.send_audio_bitrate(int(self.cfg.audio_bitrate))
+        self.app.send_resize_enabled(bool(self.cfg.enable_resize))
         await self.app.start_pipeline()
         if self.audio is not None:
             await self.audio.start()

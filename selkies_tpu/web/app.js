@@ -38,6 +38,16 @@
     // initial client prefs (reference: _arg_fps/_arg_resize on connect)
     const fps = store.get("framerate", null);
     if (fps) plane.send(`_arg_fps,${fps}`);
+    const manualRes = store.get("manualResolution", "");
+    if (manualRes) {
+      // a pinned manual resolution survives reloads: remote resizing
+      // stays enabled server-side (the resize path is gated on it) but
+      // auto window reports must not clobber the pin
+      input.autoResize = false;
+      plane.send(`_arg_resize,true,${manualRes}`);
+      plane.send(`r,${manualRes}`);
+      return;
+    }
     const resizePref = store.get("resize", null);
     if (resizePref !== null) {
       const res = `${Math.round(innerWidth * devicePixelRatio)}x${Math.round(innerHeight * devicePixelRatio)}`;
@@ -137,11 +147,31 @@
     const [verb, value] = action.split(",");
     switch (verb) {
       case "reload": location.reload(); break;
-      case "framerate": store.set("framerate", value); break;
-      case "video_bitrate": store.set("videoBitRate", value); break;
-      case "audio_bitrate": store.set("audioBitRate", value); break;
-      case "encoder": store.set("encoder", value); break;
-      case "resize": store.set("resize", value); break;
+      case "framerate":
+        store.set("framerate", value);
+        fpsSel.value = value;
+        break;
+      case "video_bitrate":
+        store.set("videoBitRate", value);
+        if ([...vbSel.options].some((o) => o.value === value)) vbSel.value = value;
+        break;
+      case "audio_bitrate": {
+        store.set("audioBitRate", value);
+        const kb = String(Math.round(Number(value) / 1000));
+        if ([...abSel.options].some((o) => o.value === kb)) abSel.value = kb;
+        break;
+      }
+      case "encoder":
+        store.set("encoder", value);
+        // reference labels hardware vs software rows (app.js:761-766);
+        // tpu* rows are the accelerator class here
+        document.getElementById("enc-name").textContent =
+          (value.startsWith("tpu") ? "tpu (" : "software (") + value + ")";
+        break;
+      case "resize":
+        store.set("resize", value);
+        resizeChk.checked = value === "True" || value === "true";
+        break;
       case "resolution": {
         const [w, h] = value.split("x").map(Number);
         input.remoteWidth = w; input.remoteHeight = h;
@@ -175,11 +205,50 @@
   }
 
   // client-side fps measurement + 5 s metric uploads (reference app.js:604)
-  let fps = 0, lastFrames = 0;
+  let fps = 0, lastFrames = 0, lastBytes = 0, rxKbps = 0, lastSrc = null;
   setInterval(() => {
-    fps = (media.framesDecoded - lastFrames);
-    lastFrames = media.framesDecoded;
+    const src = (plane === rtc && rtc) ? rtc : media;
+    if (src !== lastSrc) {
+      // plane failover: each plane has its own counters; differencing
+      // across the switch would produce a huge negative sample that the
+      // 5 s uploader would forward to the server
+      lastSrc = src;
+      lastFrames = src.framesDecoded || 0;
+      lastBytes = src.bytesReceived || 0;
+      return;
+    }
+    fps = Math.max(0, src.framesDecoded - lastFrames);
+    lastFrames = src.framesDecoded;
+    rxKbps = Math.max(0, Math.round(((src.bytesReceived || 0) - lastBytes) * 8 / 1000));
+    lastBytes = src.bytesReceived || 0;
+    updateStatsPanel(src);
   }, 1000);
+
+  // live connection-stats panel in the drawer (reference drawer stats,
+  // app.js getConnectionStats surface)
+  function updateStatsPanel(src) {
+    const panel = document.getElementById("stats-panel");
+    if (!drawer.classList.contains("open")) return;
+    const cs = (src === rtc && rtc) ? (rtc.connectionStats || {}) : {};
+    const lines = [
+      `plane        ${src === rtc ? "webrtc" : "websocket"}`,
+      `fps          ${fps}`,
+      `bitrate      ${rxKbps} kbit/s`,
+      `latency      ${serverLatency.toFixed(1)} ms`,
+      `frames       ${src.framesDecoded || 0} decoded, ${src.framesDropped || 0} dropped`,
+      `received     ${((src.bytesReceived || 0) / 1e6).toFixed(1)} MB`,
+    ];
+    if (cs.videoCodec) lines.push(`codec        ${cs.videoCodec}${cs.audioCodec ? " + " + cs.audioCodec : ""}`);
+    if (cs.resolution) lines.push(`resolution   ${cs.resolution}`);
+    if (cs.packetsLost !== undefined) lines.push(`packets      ${cs.packetsReceived || 0} rx, ${cs.packetsLost} lost`);
+    if (cs.jitterMs !== undefined) lines.push(`jitter       ${cs.jitterMs.toFixed(1)} ms`);
+    if (cs.jitterBufferMs !== undefined) lines.push(`jitter buf   ${cs.jitterBufferMs.toFixed(1)} ms`);
+    if (cs.rttMs !== undefined) lines.push(`ice rtt      ${cs.rttMs.toFixed(1)} ms`);
+    if (cs.availableKbps) lines.push(`available    ${cs.availableKbps} kbit/s`);
+    if (cs.candidateType) lines.push(`route        ${cs.candidateType}`);
+    if (cs.decoder) lines.push(`decoder      ${cs.decoder}`);
+    panel.textContent = lines.join("\n");
+  }
   setInterval(() => {
     if (!media.connected) return;
     media.send(`_f,${Math.round(fps)}`);
@@ -219,6 +288,31 @@
   vbSel.addEventListener("change", () => {
     store.set("videoBitRate", vbSel.value);
     plane.send(`vb,${vbSel.value}`);
+  });
+  const abSel = document.getElementById("set-ab");
+  abSel.value = String(Math.round(Number(store.get("audioBitRate", "128000")) / 1000));
+  abSel.addEventListener("change", () => {
+    const bps = Number(abSel.value) * 1000;
+    store.set("audioBitRate", String(bps));
+    plane.send(`ab,${bps}`);
+  });
+  const resSel = document.getElementById("set-res");
+  resSel.value = store.get("manualResolution", "");
+  resSel.addEventListener("change", () => {
+    store.set("manualResolution", resSel.value);
+    if (resSel.value) {
+      // pin a manual remote resolution: remote resizing stays ENABLED
+      // on the server (the resize path is gated on it) but auto window
+      // reports stop so they don't clobber the pin (react-variant
+      // semantics; survives reload via sendInitialPrefs)
+      input.autoResize = false;
+      plane.send(`_arg_resize,true,${resSel.value}`);
+      plane.send(`r,${resSel.value}`);
+    } else {
+      input.autoResize = true;
+      const res = `${Math.round(innerWidth * devicePixelRatio)}x${Math.round(innerHeight * devicePixelRatio)}`;
+      plane.send(`_arg_resize,${store.get("resize", "true")},${res}`);
+    }
   });
   const plChk = document.getElementById("set-pointerlock");
   plChk.addEventListener("change", () => {

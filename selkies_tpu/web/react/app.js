@@ -37,6 +37,7 @@ const state = {
   debug: cfg.debug,
   serverLatencyMs: 0,
   fps: 0,
+  encoder: "",
   system: /** @type {Record<string, unknown> | null} */ (null),
   /** @type {string[]} */
   logs: [],
@@ -77,13 +78,22 @@ function onServerMessage(msg) {
     plane.send(`pong,${Date.now() / 1000}`);
   } else if (msg.type === "system_stats" || msg.type === "system") {
     state.system = /** @type {Record<string, unknown>} */ (msg);
+    const action = msg.data && /** @type {{action?: string}} */ (msg.data).action;
+    if (typeof action === "string" && action.startsWith("encoder,")) {
+      state.encoder = action.slice("encoder,".length);
+    }
     state.renderUi();
   } else if (msg.type === "latency_measurement") {
-    state.serverLatencyMs = Number(msg.latency_ms || 0);
+    // payload shape is {type, data: {latency_ms}} (pipeline/app.py
+    // send_latency_time) — reading msg.latency_ms pinned this at 0
+    const d = /** @type {{latency_ms?: number}} */ (msg.data || {});
+    state.serverLatencyMs = Number(d.latency_ms || 0);
     state.renderUi();
   } else if (msg.type === "clipboard") {
-    const text = typeof msg.data === "string" ? atob(msg.data) : "";
-    navigator.clipboard?.writeText(text).catch(() => {});
+    // payload shape is {type, data: {content: b64}} (send_clipboard_data)
+    const d = /** @type {{content?: string}} */ (msg.data || {});
+    const text = typeof d.content === "string" ? atob(d.content) : "";
+    if (text) navigator.clipboard?.writeText(text).catch(() => {});
   }
 }
 
@@ -268,7 +278,16 @@ function SettingsDrawer() {
       h("select", {
         onChange: (/** @type {Event} */ e) =>
           plane.send(`vb,${/** @type {HTMLSelectElement} */ (e.target).value}`),
-      }, ...["2000", "4000", "8000", "12000"].map((v) => h("option", null, v)))),
+      }, ...["2000", "4000", "8000", "12000", "20000", "40000"].map(
+        (v) => h("option", null, v)))),
+    h("label", null, "Audio bitrate (kbit/s) ",
+      h("select", {
+        onChange: (/** @type {Event} */ e) =>
+          plane.send(`ab,${Number(/** @type {HTMLSelectElement} */ (e.target).value) * 1000}`),
+      }, ...["32", "64", "96", "128", "256", "320"].map(
+        (v) => h("option", null, v)))),
+    state.encoder !== "" &&
+      h("div", { class: "rx-row" }, `encoder: ${state.encoder}`),
     h("button", {
       onClick: () => {
         state.debug = !state.debug;   // no-reload debug toggle

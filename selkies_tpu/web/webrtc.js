@@ -134,29 +134,80 @@ class SelkiesWebRTC {
     } catch (e) { console.debug("addIceCandidate:", e); }
   }
 
-  /* RTC stats upload loop (reference app.js:456-537): inbound-rtp
-   * reports feed the server's loss-based congestion controller. */
+  /* RTC stats loop (reference webrtc.js getConnectionStats :494-684 +
+   * app.js upload loop :456-537): a full extraction every second feeds
+   * the drawer's live panel via this.connectionStats; the video report
+   * list is uploaded as _stats_video (the server's loss-based congestion
+   * controller reads the first inbound-rtp entry) and the audio reports
+   * as _stats_audio every 5th tick. */
   _startStats() {
+    let tick = 0;
     this._statsTimer = setInterval(async () => {
       if (!this.pc) return;
       try {
         const stats = await this.pc.getStats();
-        const reports = [];
+        const videoReports = [], audioReports = [];
+        const codecs = {}, candidates = {};
+        let selectedPair = null;
+        const cs = this.connectionStats = this.connectionStats || {};
         stats.forEach((r) => {
-          // video-only: the server's loss-based controller reads the
-          // first inbound-rtp report, and audio counters would skew it
-          if ((r.type === "inbound-rtp" && r.kind === "video") ||
-              r.type === "candidate-pair") reports.push(r);
+          if (r.type === "codec") codecs[r.id] = r.mimeType;
           if (r.type === "inbound-rtp" && r.kind === "video") {
+            videoReports.push(r);
             this.framesDecoded = r.framesDecoded || 0;
             this.framesDropped = r.framesDropped || 0;
             this.bytesReceived = r.bytesReceived || 0;
             this.keyFramesDecoded = r.keyFramesDecoded || 0;
+            cs.packetsReceived = r.packetsReceived;
+            cs.packetsLost = r.packetsLost;
+            cs.jitterMs = (r.jitter || 0) * 1000;
+            if (r.jitterBufferDelay && r.jitterBufferEmittedCount) {
+              cs.jitterBufferMs = r.jitterBufferDelay / r.jitterBufferEmittedCount * 1000;
+            }
+            if (r.frameWidth) cs.resolution = `${r.frameWidth}x${r.frameHeight}`;
+            cs.videoCodecId = r.codecId;
+            cs.decoder = r.decoderImplementation;
+          }
+          if (r.type === "inbound-rtp" && r.kind === "audio") {
+            audioReports.push(r);
+            cs.audioCodecId = r.codecId;
+            cs.audioPacketsLost = r.packetsLost;
+          }
+          if (r.type === "candidate-pair" &&
+              (r.nominated || r.state === "succeeded")) {
+            videoReports.push(r);
+            selectedPair = r;
+            if (r.currentRoundTripTime !== undefined) {
+              cs.rttMs = r.currentRoundTripTime * 1000;
+            }
+            if (r.availableIncomingBitrate) {
+              cs.availableKbps = Math.round(r.availableIncomingBitrate / 1000);
+            }
+          }
+          if (r.type === "remote-candidate" || r.type === "local-candidate") {
+            candidates[r.id] = r.candidateType;
           }
         });
-        this.send(`_stats_video,${JSON.stringify(reports)}`);
+        cs.videoCodec = codecs[cs.videoCodecId];
+        cs.audioCodec = codecs[cs.audioCodecId];
+        if (selectedPair) {
+          // classify the route from the SELECTED pair's candidates —
+          // gathered-but-unused relay candidates must not label a
+          // direct connection as TURN
+          const local = candidates[selectedPair.localCandidateId];
+          const remote = candidates[selectedPair.remoteCandidateId];
+          cs.candidateType = (local === "relay" || remote === "relay")
+            ? "relay (TURN)" : (local || remote);
+        }
+        if (tick % 5 === 0) {
+          this.send(`_stats_video,${JSON.stringify(videoReports)}`);
+          if (audioReports.length) {
+            this.send(`_stats_audio,${JSON.stringify(audioReports)}`);
+          }
+        }
+        tick += 1;
       } catch (e) { /* stats are best-effort */ }
-    }, 5000);
+    }, 1000);
   }
 
   /* jitterBufferTarget=0 enforcement loop (reference app.js:542-551):

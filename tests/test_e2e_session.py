@@ -85,6 +85,7 @@ def test_full_session(loop, tmp_path):
 
             video_frames: list[tuple[int, int, bytes]] = []
             audio_frames: list[bytes] = []
+            system_actions: list[str] = []
             pings = 0
             deadline = asyncio.get_event_loop().time() + 60
             while (len(video_frames) < 8 or pings < 1) and asyncio.get_event_loop().time() < deadline:
@@ -100,12 +101,21 @@ def test_full_session(loop, tmp_path):
                     if obj["type"] == "ping":
                         pings += 1
                         await ws.send_str(f"pong,{obj['data']['start_time']}")
+                    elif obj["type"] == "system":
+                        system_actions.append(obj["data"]["action"])
                 else:
                     break
 
             assert len(video_frames) >= 8, f"only {len(video_frames)} video frames"
             assert video_frames[0][0] & FLAG_KEYFRAME, "first frame must be IDR"
             assert pings >= 1, "no ping over the data channel"
+
+            # initial settings push so the drawer reflects the server
+            # (reference system-action loop, app.js:685-769)
+            verbs = {a.split(",")[0] for a in system_actions}
+            for verb in ("encoder", "framerate", "video_bitrate",
+                         "audio_bitrate", "resize"):
+                assert verb in verbs, f"no initial {verb} action: {system_actions}"
 
             # the AU stream must decode with an independent decoder
             import cv2
