@@ -117,6 +117,7 @@ FLAGS: tuple[Flag, ...] = (
     _f("keyframe_distance", -1.0, "Keyframe distance in seconds (-1 = infinite GOP)."),
     # input / desktop integration
     _f("enable_clipboard", "true", "Clipboard sync: true|false|in|out."),
+    _f("audio_device", "", "PulseAudio source device to capture (empty = server default monitor)."),
     _f("enable_cursors", True, "Forward X cursor changes to the client."),
     _f("cursor_size", -1, "XFCE cursor size."),
     _f("debug_cursors", False, "Log cursor change events."),

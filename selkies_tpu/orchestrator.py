@@ -316,7 +316,7 @@ class Orchestrator:
         self.audio: AudioPipeline | None = None
         if opus_available():
             self.audio = AudioPipeline(
-                source=open_best_audio_source(),
+                source=open_best_audio_source(cfg.audio_device or None),
                 sink=self.transport.send_audio,
                 bitrate_bps=int(cfg.audio_bitrate),
             )

@@ -224,7 +224,9 @@ class TPUWebRTCApp:
         self._send("codec", {"codec": getattr(self.encoder, "codec", "h264")})
 
     def send_resize_enabled(self, resize_enabled: bool) -> None:
-        self._send("system", {"action": f"resize,{resize_enabled}"})
+        # lowercase on the wire: clients persist the token and compare
+        # against "true" (a Python-cased "True" broke checkbox restore)
+        self._send("system", {"action": f"resize,{str(resize_enabled).lower()}"})
 
     def send_remote_resolution(self, res: str) -> None:
         self._send("system", {"action": f"resolution,{res}"})

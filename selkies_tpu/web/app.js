@@ -168,10 +168,12 @@
         document.getElementById("enc-name").textContent =
           (value.startsWith("tpu") ? "tpu (" : "software (") + value + ")";
         break;
-      case "resize":
-        store.set("resize", value);
-        resizeChk.checked = value === "True" || value === "true";
+      case "resize": {
+        const on = value.toLowerCase() === "true";
+        store.set("resize", String(on));
+        resizeChk.checked = on;
         break;
+      }
       case "resolution": {
         const [w, h] = value.split("x").map(Number);
         input.remoteWidth = w; input.remoteHeight = h;

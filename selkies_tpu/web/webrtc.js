@@ -148,7 +148,7 @@ class SelkiesWebRTC {
         const stats = await this.pc.getStats();
         const videoReports = [], audioReports = [];
         const codecs = {}, candidates = {};
-        let selectedPair = null;
+        let nominatedPair = null, succeededPair = null;
         const cs = this.connectionStats = this.connectionStats || {};
         stats.forEach((r) => {
           if (r.type === "codec") codecs[r.id] = r.mimeType;
@@ -173,16 +173,11 @@ class SelkiesWebRTC {
             cs.audioCodecId = r.codecId;
             cs.audioPacketsLost = r.packetsLost;
           }
-          if (r.type === "candidate-pair" &&
-              (r.nominated || r.state === "succeeded")) {
-            videoReports.push(r);
-            selectedPair = r;
-            if (r.currentRoundTripTime !== undefined) {
-              cs.rttMs = r.currentRoundTripTime * 1000;
-            }
-            if (r.availableIncomingBitrate) {
-              cs.availableKbps = Math.round(r.availableIncomingBitrate / 1000);
-            }
+          if (r.type === "candidate-pair") {
+            // several pairs can be 'succeeded' (ICE restarts, kept-alive
+            // relay paths); the nominated one is the route in use
+            if (r.nominated) nominatedPair = r;
+            else if (r.state === "succeeded" && !succeededPair) succeededPair = r;
           }
           if (r.type === "remote-candidate" || r.type === "local-candidate") {
             candidates[r.id] = r.candidateType;
@@ -190,7 +185,15 @@ class SelkiesWebRTC {
         });
         cs.videoCodec = codecs[cs.videoCodecId];
         cs.audioCodec = codecs[cs.audioCodecId];
+        const selectedPair = nominatedPair || succeededPair;
         if (selectedPair) {
+          videoReports.push(selectedPair);
+          if (selectedPair.currentRoundTripTime !== undefined) {
+            cs.rttMs = selectedPair.currentRoundTripTime * 1000;
+          }
+          if (selectedPair.availableIncomingBitrate) {
+            cs.availableKbps = Math.round(selectedPair.availableIncomingBitrate / 1000);
+          }
           // classify the route from the SELECTED pair's candidates —
           // gathered-but-unused relay candidates must not label a
           // direct connection as TURN

@@ -86,3 +86,57 @@ def test_opus_payloader():
     assert pkt2.sequence == pkt1.sequence + 1
     parsed = RtpPacket.parse(pkt1.serialize())
     assert parsed.payload == b"\x01\x02" and parsed.payload_type == 111
+
+
+def test_native_pulse_source_load_and_fallback():
+    """libpulse-simple binds over ctypes (this image vendors one inside
+    pygame.libs); with no daemon running the selection probe must fall
+    through to parec/synthetic instead of handing the pipeline a source
+    that fails at start()."""
+    from selkies_tpu.audio.sources import (
+        NativePulseSource,
+        PulseAudioSource,
+        SyntheticAudioSource,
+        open_best_audio_source,
+    )
+
+    if not NativePulseSource.available():
+        pytest.skip("no loadable libpulse-simple on this host")
+    src = open_best_audio_source("some.device.monitor")
+    assert isinstance(src, (NativePulseSource, PulseAudioSource,
+                            SyntheticAudioSource))
+    # device selection reaches whichever pulse backend was picked
+    if not isinstance(src, SyntheticAudioSource):
+        assert src.device == "some.device.monitor"
+    # ground truth for "is a daemon answering" is the probe itself
+    # (PATH heuristics misfire on pipewire-pulse hosts): native wins
+    # exactly when a stream can actually be opened
+    probe = NativePulseSource("some.device.monitor")
+    try:
+        s = probe._open_sync()
+        daemon_up = True
+        from selkies_tpu.audio.sources import _load_pa_simple
+
+        _load_pa_simple().pa_simple_free(s)
+    except RuntimeError:
+        daemon_up = False
+    assert isinstance(src, NativePulseSource) == daemon_up
+
+
+def test_native_pulse_struct_layout():
+    """pa_simple_new argtypes: sample spec and buffer attr sizes match
+    the libpulse ABI (s16le stereo 48 kHz, one-frame fragsize)."""
+    import ctypes
+
+    from selkies_tpu.audio.sources import (
+        FRAME_BYTES,
+        _PaBufferAttr,
+        _PaSampleSpec,
+    )
+
+    assert ctypes.sizeof(_PaSampleSpec) == 12  # int + uint32 + uint8 (padded)
+    assert ctypes.sizeof(_PaBufferAttr) == 20  # 5 x uint32
+    attr = _PaBufferAttr(maxlength=FRAME_BYTES * 10, tlength=0xFFFFFFFF,
+                         prebuf=0xFFFFFFFF, minreq=0xFFFFFFFF,
+                         fragsize=FRAME_BYTES)
+    assert attr.fragsize == FRAME_BYTES
