@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Feature installer: system packages + the selkies-tpu wheel.
+# Runs at image build time with feature options in the environment
+# (XSERVER / DESKTOP / WEB_PORT / ENCODER, uppercased by the spec).
+set -euo pipefail
+
+export DEBIAN_FRONTEND=noninteractive
+apt-get update
+apt-get install -y --no-install-recommends \
+    xvfb dbus-x11 x11-utils x11-xserver-utils xsel \
+    libx11-6 libxtst6 libxfixes3 \
+    libx264-164 libx265-199 libvpx7 libaom3 libopus0 libdav1d6 \
+    pulseaudio pulseaudio-utils
+if [ "${DESKTOP:-xfce}" = "xfce" ]; then
+    apt-get install -y --no-install-recommends xfce4 xfce4-terminal
+fi
+rm -rf /var/lib/apt/lists/*
+
+python3 -m pip install --no-cache-dir selkies-tpu || \
+    echo "selkies-tpu wheel not on an index; install from source (pip install -e .)"
+
+install -m 0755 "$(dirname "$0")/start-selkies-tpu.sh" /usr/local/bin/start-selkies-tpu.sh
+
+# persist feature options for the entrypoint
+cat > /etc/selkies-tpu-feature.env <<EOF
+SELKIES_XSERVER=${XSERVER:-xvfb}
+SELKIES_DESKTOP=${DESKTOP:-xfce}
+SELKIES_PORT=${WEB_PORT:-8080}
+SELKIES_ENCODER=${ENCODER:-tpuh264enc}
+EOF

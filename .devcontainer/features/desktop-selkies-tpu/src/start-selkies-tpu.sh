@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Feature entrypoint: X server + desktop + selkies-tpu, forever.
+# Mirrors what packaging/entrypoint.sh does in the runtime image, scaled
+# down for a dev container (no nginx, no supervisord).
+set -u
+
+[ -f /etc/selkies-tpu-feature.env ] && . /etc/selkies-tpu-feature.env
+: "${SELKIES_XSERVER:=xvfb}"
+: "${SELKIES_DESKTOP:=xfce}"
+: "${SELKIES_PORT:=8080}"
+: "${SELKIES_ENCODER:=tpuh264enc}"
+export DISPLAY="${DISPLAY:-:20}"
+
+if [ "$SELKIES_XSERVER" = "xvfb" ] && ! xdpyinfo >/dev/null 2>&1; then
+    Xvfb "$DISPLAY" -screen 0 1920x1080x24 +extension MIT-SHM \
+         +extension XFIXES +extension XTEST &
+    for _ in $(seq 1 50); do xdpyinfo >/dev/null 2>&1 && break; sleep 0.2; done
+fi
+
+if [ "$SELKIES_DESKTOP" = "xfce" ] && ! pgrep -x xfce4-session >/dev/null; then
+    dbus-launch startxfce4 >/tmp/xfce.log 2>&1 &
+fi
+
+if command -v pulseaudio >/dev/null && ! pactl info >/dev/null 2>&1; then
+    pulseaudio --start --exit-idle-time=-1 || true
+fi
+
+exec selkies-tpu --addr 0.0.0.0 --port "$SELKIES_PORT" \
+     --encoder "$SELKIES_ENCODER" --enable_resize true

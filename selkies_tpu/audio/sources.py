@@ -203,12 +203,23 @@ class NativePulseSource:
     async def stop(self) -> None:
         if self._s is not None:
             def _free():
-                # the lock waits out any read still blocked in the
-                # native call before the handle is freed
-                with self._io_lock:
+                # wait out any read still blocked in the native call —
+                # but bounded: a suspended/corked source can park
+                # pa_simple_read forever, and shutdown must not hang on
+                # it. On timeout the handle is deliberately leaked (one
+                # small native object) instead of freed under the read
+                # (use-after-free) or waited on (hung shutdown).
+                if not self._io_lock.acquire(timeout=2.0):
+                    logger.warning(
+                        "pulse read stalled >2s; leaking pa_simple handle")
+                    self._s = None
+                    return
+                try:
                     s, self._s = self._s, None
                     if s is not None:
                         _load_pa_simple().pa_simple_free(s)
+                finally:
+                    self._io_lock.release()
 
             await asyncio.to_thread(_free)
 
@@ -260,7 +271,7 @@ def open_best_audio_source(device: str | None = None) -> AudioSource:
         try:
             s = probe._open_sync()
             _load_pa_simple().pa_simple_free(s)
-            return NativePulseSource(device)
+            return probe  # probe never kept a handle; it IS the source
         except Exception as exc:
             logger.info("native pulse probe failed (%s); trying parec", exc)
     if PulseAudioSource.available():

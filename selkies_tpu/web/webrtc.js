@@ -175,8 +175,10 @@ class SelkiesWebRTC {
           }
           if (r.type === "candidate-pair") {
             // several pairs can be 'succeeded' (ICE restarts, kept-alive
-            // relay paths); the nominated one is the route in use
-            if (r.nominated) nominatedPair = r;
+            // relay paths); the route in use is the nominated pair that
+            // is still succeeding — a stale nominated pair lingers in
+            // getStats as 'failed' after a network change
+            if (r.nominated && r.state === "succeeded") nominatedPair = r;
             else if (r.state === "succeeded" && !succeededPair) succeededPair = r;
           }
           if (r.type === "remote-candidate" || r.type === "local-candidate") {
