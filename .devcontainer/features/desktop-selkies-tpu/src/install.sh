@@ -16,8 +16,18 @@ if [ "${DESKTOP:-xfce}" = "xfce" ]; then
 fi
 rm -rf /var/lib/apt/lists/*
 
-python3 -m pip install --no-cache-dir selkies-tpu || \
-    echo "selkies-tpu wheel not on an index; install from source (pip install -e .)"
+# fail the BUILD if nothing installs — a missing wheel must not surface
+# as command-not-found at container start. INSTALL_FROM_SOURCE=skip lets
+# devcontainer.json's postCreateCommand own the (editable) install.
+if [ "${INSTALL_FROM_SOURCE:-}" != "skip" ]; then
+    python3 -m pip install --no-cache-dir selkies-tpu || {
+        echo "ERROR: selkies-tpu wheel not installable; either publish" \
+             "the wheel, bake it into the image, or set the feature" \
+             "option install_from_source=skip and pip install -e the" \
+             "source in postCreateCommand" >&2
+        exit 1
+    }
+fi
 
 install -m 0755 "$(dirname "$0")/start-selkies-tpu.sh" /usr/local/bin/start-selkies-tpu.sh
 

@@ -25,5 +25,11 @@ if command -v pulseaudio >/dev/null && ! pactl info >/dev/null 2>&1; then
     pulseaudio --start --exit-idle-time=-1 || true
 fi
 
+if ! command -v selkies-tpu >/dev/null; then
+    echo "selkies-tpu is not installed (pip install selkies-tpu, or" \
+         "pip install -e . from a source checkout); idling" >&2
+    exec sleep infinity   # keep the entrypoint alive for debugging
+fi
+
 exec selkies-tpu --addr 0.0.0.0 --port "$SELKIES_PORT" \
      --encoder "$SELKIES_ENCODER" --enable_resize true

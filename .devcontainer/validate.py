@@ -1,0 +1,38 @@
+#!/usr/bin/env python3
+"""Devcontainer feature validation — the single source of truth run by
+both tests/test_services.py::test_devcontainer_feature_metadata and
+.github/workflows/devcontainer_feature_validate.yaml (reference parity:
+devcontainer_feature_validate.yaml)."""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def main() -> int:
+    raw = open(os.path.join(ROOT, "devcontainer.json")).read()
+    # devcontainer.json allows // comments (whitespace-preceded so URLs
+    # inside strings survive); strip before parsing
+    doc = json.loads(re.sub(r"(^|\s)//.*$", r"\1", raw, flags=re.M))
+    assert 8080 in doc["forwardPorts"], "web port not forwarded"
+    assert doc.get("postStartCommand"), "desktop never starts"
+
+    feat_dir = os.path.join(ROOT, "features", "desktop-selkies-tpu", "src")
+    feat = json.load(open(os.path.join(feat_dir, "devcontainer-feature.json")))
+    assert feat["id"] == "desktop-selkies-tpu" and feat["version"]
+    assert feat["entrypoint"].startswith("/usr/local/bin/")
+    assert feat["options"]["xserver"]["default"] == "xvfb"
+
+    for script in ("install.sh", "start-selkies-tpu.sh"):
+        subprocess.run(["bash", "-n", os.path.join(feat_dir, script)],
+                       check=True)
+    print("devcontainer feature metadata ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

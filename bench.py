@@ -51,20 +51,22 @@ WARMUP = 3
 ITERS = 30
 
 
-def _result(metric: str, fps: float) -> None:
+def _result(metric: str, fps: float, **extra: float) -> None:
     device = os.environ.get("SELKIES_BENCH_DEVICE")
     if device:
         metric = f"{metric} [{device}]"
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(fps, 2),
-                "unit": "fps@1080p",
-                "vs_baseline": round(fps / BASELINE_FPS, 3),
-            }
-        )
-    )
+    doc = {
+        "metric": metric,
+        "value": round(fps, 2),
+        "unit": "fps@1080p",
+        "vs_baseline": round(fps / BASELINE_FPS, 3),
+    }
+    # per-stage means ride along so the record isn't hostage to tunnel
+    # weather: device_stage_latency_ms is each frame's dispatch->resolve
+    # time through the device stage (queueing in its group + execute +
+    # fetch) observed during the SAME single timed pass — no extra runs
+    doc.update({k: round(v, 2) for k, v in extra.items()})
+    print(json.dumps(doc))
 
 
 def _desktop_trace(n: int = 60) -> list[np.ndarray]:
@@ -104,7 +106,7 @@ def _desktop_trace(n: int = 60) -> list[np.ndarray]:
     return frames
 
 
-def bench_full_encoder() -> float | None:
+def bench_full_encoder() -> tuple[float, float, float] | None:
     """Steady-state IP-GOP desktop encode (IDR once, then P frames; delta
     band uploads for partial updates, full uploads on window switches,
     on-device motion estimation). Uses the pipelined submit/flush API
@@ -144,13 +146,20 @@ def bench_full_encoder() -> float | None:
     # fast, not the luckiest one; the trace includes the window-switch
     # full-frame changes)
     done = 0
+    device_ms = pack_ms = 0.0
     t0 = time.perf_counter()
     for i in range(ITERS):
-        done += len(enc.submit(frames[i % len(frames)]))
-    done += len(enc.flush())
+        for _, stats, _ in enc.submit(frames[i % len(frames)]):
+            done += 1
+            device_ms += stats.device_ms
+            pack_ms += stats.pack_ms
+    for _, stats, _ in enc.flush():
+        done += 1
+        device_ms += stats.device_ms
+        pack_ms += stats.pack_ms
     dt = time.perf_counter() - t0
     assert done == ITERS, f"pipeline lost frames: {done}/{ITERS}"
-    return ITERS / dt
+    return ITERS / dt, device_ms / done, pack_ms / done
 
 
 def bench_convert_only() -> float:
@@ -171,9 +180,11 @@ def bench_convert_only() -> float:
 
 def main() -> int:
     _reexec_cpu_if_tunnel_down()
-    fps = bench_full_encoder()
-    if fps is not None:
-        _result("tpuh264enc 1080p IP-GOP encode fps (1 chip)", fps)
+    out = bench_full_encoder()
+    if out is not None:
+        fps, device_ms, pack_ms = out
+        _result("tpuh264enc 1080p IP-GOP encode fps (1 chip)", fps,
+                device_stage_latency_ms=device_ms, pack_ms=pack_ms)
     else:
         _result("capture->I420 convert fps (encoder pending)", bench_convert_only())
     return 0
