@@ -290,3 +290,103 @@ def test_fleet_capture_geometry_mismatch_survives(loop, tmp_path):
             fleet.service.close()
 
     loop.run_until_complete(scenario())
+
+
+def test_fleet_webrtc_plane_session_k(loop, tmp_path):
+    """The preferred plane, fleet edition: two fake browsers register as
+    peers 1 and 11 (sessions 0 and 1), answer their slot's offer,
+    complete ICE + DTLS-SRTP over real UDP sockets, and receive distinct
+    H.264 streams. 'A browser can connect to session k of N' on the
+    WebRTC plane, not just the WS fallback."""
+    from selkies_tpu.parallel.fleet import browser_peer_id
+    from selkies_tpu.transport.rtp import H264Depayloader, RtpPacket
+    from test_webrtc_peer import FakeBrowser
+
+    async def drive_browser(http, port, session, min_packets=12):
+        browser = FakeBrowser()
+        ws = await http.ws_connect(f"http://127.0.0.1:{port}/ws")
+        await ws.send_str(f"HELLO {browser_peer_id(session)}")
+        answered = False
+        input_ch = None
+        deadline = asyncio.get_event_loop().time() + 90
+        while asyncio.get_event_loop().time() < deadline:
+            try:
+                msg = await asyncio.wait_for(ws.receive(), 1.0)
+            except asyncio.TimeoutError:
+                msg = None
+            if msg is not None and msg.type == aiohttp.WSMsgType.TEXT:
+                data = msg.data
+                if not (data == "HELLO" or data.startswith("SESSION_OK")):
+                    obj = json.loads(data)
+                    if "sdp" in obj and obj["sdp"]["type"] == "offer":
+                        answer = await browser.answer(obj["sdp"]["sdp"])
+                        await ws.send_str(json.dumps(
+                            {"sdp": {"type": "answer", "sdp": answer}}))
+                        cand = browser.ice.local_candidates[0]
+                        line = (f"candidate:1 1 udp {cand.priority} "
+                                f"127.0.0.1 {cand.port} typ host")
+                        await ws.send_str(json.dumps(
+                            {"ice": {"candidate": line, "sdpMLineIndex": 0}}))
+                        answered = True
+            elif msg is not None and msg.type in (
+                    aiohttp.WSMsgType.CLOSED, aiohttp.WSMsgType.ERROR):
+                break
+            if (answered and browser.ice.connected
+                    and browser.dtls is not None
+                    and not browser.dtls.handshake_complete):
+                browser.start_dtls()
+                await asyncio.sleep(0.05)
+            if (browser.dtls is not None and browser.dtls.handshake_complete
+                    and input_ch is None):
+                # opening the 'input' channel is what marks the session
+                # connected server-side (the web client does the same)
+                input_ch = browser.sctp.open_channel("input")
+                for pkt in browser.sctp.take_packets():
+                    browser.dtls.send(pkt)
+                browser._flush()
+            if len(browser.rtp_packets) >= min_packets:
+                break
+        await ws.close()
+        assert answered, f"session {session}: no offer"
+        assert browser.dtls is not None and browser.dtls.handshake_complete, \
+            f"session {session}: DTLS incomplete"
+        assert len(browser.rtp_packets) >= min_packets, \
+            f"session {session}: {len(browser.rtp_packets)} SRTP packets"
+        depay = H264Depayloader()
+        stream = b""
+        for wire in browser.rtp_packets:
+            try:
+                out = depay.push(RtpPacket.parse(wire))
+            except ValueError:
+                continue
+            if out:
+                stream += out
+        browser.ice.close()
+        return stream
+
+    async def scenario():
+        orch, run_task = await _boot(tmp_path, n=2)
+        port = orch.server.bound_port
+        try:
+            async with aiohttp.ClientSession() as http:
+                s0, s1 = await asyncio.gather(
+                    drive_browser(http, port, 0), drive_browser(http, port, 1))
+            assert s0 and s1, "no access units reassembled"
+            assert s0[:2000] != s1[:2000], "sessions streamed identical bytes"
+            import cv2
+            for k, stream in enumerate((s0, s1)):
+                path = str(tmp_path / f"fleet_rtc_{k}.h264")
+                with open(path, "wb") as f:
+                    f.write(stream)
+                ok, frame = cv2.VideoCapture(path).read()
+                assert ok, f"session {k}: stream does not decode"
+                assert frame.shape[:2] == (H, W)
+        finally:
+            run_task.cancel()
+            try:
+                await run_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            await orch.shutdown()
+
+    loop.run_until_complete(scenario())
