@@ -373,14 +373,10 @@ def test_fleet_webrtc_plane_session_k(loop, tmp_path):
                     drive_browser(http, port, 0), drive_browser(http, port, 1))
             assert s0 and s1, "no access units reassembled"
             assert s0[:2000] != s1[:2000], "sessions streamed identical bytes"
-            import cv2
             for k, stream in enumerate((s0, s1)):
-                path = str(tmp_path / f"fleet_rtc_{k}.h264")
-                with open(path, "wb") as f:
-                    f.write(stream)
-                ok, frame = cv2.VideoCapture(path).read()
-                assert ok, f"session {k}: stream does not decode"
-                assert frame.shape[:2] == (H, W)
+                frames = _decode_all([(0, stream)])
+                assert frames, f"session {k}: stream does not decode"
+                assert frames[0].shape[:2] == (H, W)
         finally:
             run_task.cancel()
             try:

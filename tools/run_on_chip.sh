@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# On-chip measurement playbook — run when the axon tunnel is UP.
+#
+# Captures, in priority order and strictly one jax process at a time
+# (the tunnel is single-client), everything PERF.md is waiting on:
+#   1. bench.py            — the headline number (single pass; also
+#                            emits device_stage_latency_ms / pack_ms)
+#   2. profile_multisession — the 8x1080p60 serving-tick evidence
+#   3. profile_hybrid_frontend — device ms inside tpuvp9enc/tpuav1enc
+#   4. profile_4k          — the 4K30 path
+# Each step's output is appended to tools/onchip-<date>.log. A step that
+# fails (tunnel weather) does not stop the next; NEVER run this
+# concurrently with the test suite (CPU contention skews conversion/pack
+# threads — measured 29.7 fps solo vs 17.9 concurrent, round 4).
+set -u
+cd "$(dirname "$0")/.."
+
+log="tools/onchip-$(date +%Y%m%d-%H%M%S).log"
+probe() {
+  python - <<'EOF'
+import socket, sys
+try:
+    socket.create_connection(("127.0.0.1", 8083), timeout=3).close()
+except OSError:
+    sys.exit(1)
+EOF
+}
+
+if ! probe; then
+  echo "tunnel DOWN; aborting (nothing written)" >&2
+  exit 1
+fi
+
+run() {
+  echo "== $* ==" | tee -a "$log"
+  # SIGTERM-only timeout; never kill -9 a process holding the tunnel
+  timeout 1200 "$@" 2>&1 | tee -a "$log"
+  echo "-- rc=${PIPESTATUS[0]} --" | tee -a "$log"
+  probe || { echo "tunnel dropped; stopping" | tee -a "$log"; exit 1; }
+}
+
+run python bench.py
+run python tools/profile_multisession.py
+run python tools/profile_hybrid_frontend.py
+run python tools/profile_4k.py
+echo "done; results in $log"
