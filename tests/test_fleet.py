@@ -386,3 +386,45 @@ def test_fleet_webrtc_plane_session_k(loop, tmp_path):
             await orch.shutdown()
 
     loop.run_until_complete(scenario())
+
+
+def test_fleet_tick_survives_capture_failures(loop, tmp_path):
+    """A session source that throws (X server dying mid-session) must
+    not kill the other sessions' streams: the tick loop logs, counts,
+    and keeps serving (failure-detection parity, SURVEY §5)."""
+
+    async def scenario():
+        from selkies_tpu.parallel.fleet import SessionFleet, SessionSlot
+        from selkies_tpu.pipeline.elements import SyntheticSource
+
+        class FlakySource(SyntheticSource):
+            def __init__(self):
+                super().__init__(W, H, seed=5)
+                self.calls = 0
+
+            def capture(self):
+                self.calls += 1
+                if self.calls in (3, 4):
+                    raise RuntimeError("X connection lost")
+                return super().capture()
+
+        slots = [SessionSlot(k, bitrate_kbps=2000, fps=60) for k in range(2)]
+        fleet = SessionFleet(slots, width=W, height=H, fps=60)
+        flaky = FlakySource()
+        fleet.sources[1] = flaky
+        slots[0].connected = True  # fleet only ticks with a client
+        try:
+            await fleet.start()
+            # generous deadline: the first ticks pay jit compile on the
+            # CPU backend
+            for _ in range(1800):
+                if fleet.ticks >= 6 and flaky.calls >= 5:
+                    break
+                await asyncio.sleep(0.05)
+            assert fleet.ticks >= 6, (fleet.ticks, flaky.calls)
+            # both failure ticks were absorbed; the loop kept going
+            assert flaky.calls >= 5
+        finally:
+            await fleet.stop()
+
+    loop.run_until_complete(scenario())
