@@ -139,6 +139,7 @@ FLAGS: tuple[Flag, ...] = (
     _f("tpu_device", 0, "TPU chip index this session's encode stream is placed on."),
     _f("tpu_sessions", 1, "Concurrent sessions to place across the TPU mesh (1 chip per stream)."),
     _f("session_displays", "", "Fleet mode: csv of X DISPLAY names, one per session (e.g. ':10,:11'); sessions beyond the list use synthetic sources."),
+    _f("session_audio_devices", "", "Fleet mode: csv of PulseAudio source devices, one per session (e.g. 'sink10.monitor,sink11.monitor'); sessions with an empty entry or beyond the list get NO audio (a shared default monitor would leak audio across users)."),
     _f("transport", "auto", "Media transport: auto|webrtc|websocket."),
     _f("debug", False, "Verbose debug logging."),
 )

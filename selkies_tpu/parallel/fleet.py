@@ -83,6 +83,8 @@ class SessionSlot:
         self.rc = CbrRateController(bitrate_kbps=bitrate_kbps, fps=fps)
         self.gcc: GccController | None = None
         self.input: HostInput | None = None
+        self.audio = None  # per-session AudioPipeline (fleet._wire_audio)
+        self.audio_lock = asyncio.Lock()  # serializes audio start/stop
         self.connected = False
         self.frames = 0
         # cumulative (packetsLost, packetsReceived) from the last client
@@ -308,9 +310,26 @@ class FleetOrchestrator:
         # the two must agree on which session owns which display
         self.displays = [d.strip() for d in str(
             cfg.session_displays or "").split(",") if d.strip()]
+        self.audio_devices = [d.strip() for d in str(
+            cfg.session_audio_devices or "").split(",")]
+        from selkies_tpu.audio import opus_available
+
+        self._opus = opus_available()
+        if any(self.audio_devices) and not self._opus:
+            logger.warning(
+                "session_audio_devices configured but libopus is not "
+                "available; fleet audio disabled")
+
+        def _has_audio(k: int) -> bool:
+            return (self._opus and k < len(self.audio_devices)
+                    and bool(self.audio_devices[k]))
+
         self.slots = [
             SessionSlot(
                 k, bitrate_kbps=int(cfg.video_bitrate), fps=int(cfg.framerate),
+                # the SDP offer must carry an audio m-line exactly when
+                # this session will actually stream audio
+                webrtc_audio=_has_audio(k),
                 turn_tls_insecure=bool(cfg.turn_tls_insecure),
             )
             for k in range(self.n)
@@ -320,6 +339,7 @@ class FleetOrchestrator:
             self.slots, width=width, height=height, fps=int(cfg.framerate),
             sources=sources, devices=devices, service=service,
         )
+        self._wire_audio()
         self.server = make_signalling_server(cfg)
         # /media/<k> per session; bare /media aliases session 0 so the
         # stock solo client works against a fleet server
@@ -358,6 +378,38 @@ class FleetOrchestrator:
             sources.append(src if src is not None
                            else SyntheticSource(width, height, seed=k))
         return sources
+
+    def _wire_audio(self) -> None:
+        """Per-session audio: each fleet session's desktop pairs with its
+        own PulseAudio monitor (``--session_audio_devices``). Sessions
+        with a listed device get an Opus pipeline into their own
+        transport; without one, fleet stays video+input for that session
+        (one shared default monitor would leak audio across users)."""
+        from selkies_tpu.audio import AudioPipeline, open_best_audio_source
+
+        for k, slot in enumerate(self.slots):
+            slot.audio = None
+            if (self._opus and k < len(self.audio_devices)
+                    and self.audio_devices[k]):
+                slot.audio = AudioPipeline(
+                    source=open_best_audio_source(self.audio_devices[k]),
+                    sink=slot.transport.send_audio,
+                    bitrate_bps=int(self.cfg.audio_bitrate),
+                )
+
+    async def _apply_audio_state(self, slot: SessionSlot) -> None:
+        """Converge the slot's audio pipeline to its connect state.
+        Serialized per slot: fire-and-forget stop()/start() from a fast
+        reconnect can interleave (start early-returns while the
+        cancelled task is still unwinding) and leave a connected client
+        silent; under the lock the LAST task applies the latest state."""
+        if slot.audio is None:
+            return
+        async with slot.audio_lock:
+            if slot.connected and not slot.audio.running:
+                await slot.audio.start()
+            elif not slot.connected and slot.audio.running:
+                await slot.audio.stop()
 
     def _make_input(self, k: int) -> HostInput:
         """Session k's input host. Slots with a configured display inject
@@ -406,6 +458,9 @@ class FleetOrchestrator:
                     slot.gcc.reset()
                 self.fleet.force_keyframe(k)
                 slot.send_codec("h264")
+                if first and slot.audio is not None:
+                    asyncio.get_running_loop().create_task(
+                        self._apply_audio_state(slot))
                 logger.info("session %d client connected%s", k,
                             "" if first else " (additional plane)")
 
@@ -450,6 +505,12 @@ class FleetOrchestrator:
                     slot.gcc.set_target(int(kbps))
 
             inp.on_video_encoder_bit_rate = on_video_bitrate
+
+            def on_audio_bitrate(bps: int, slot=slot):
+                if slot.audio is not None:
+                    slot.audio.set_bitrate(int(bps))
+
+            inp.on_audio_encoder_bit_rate = on_audio_bitrate
             # lockstep batch: fps/resize are fleet configuration, not
             # per-session — acknowledge without applying (docs/fleet.md)
             inp.on_set_fps = lambda fps, k=k: logger.info(
@@ -517,6 +578,8 @@ class FleetOrchestrator:
         slot.input.reset_keyboard()
         loop = asyncio.get_running_loop()
         loop.create_task(slot.webrtc.stop_session())
+        if slot.audio is not None:
+            loop.create_task(self._apply_audio_state(slot))
         if k in self._rearm:
             self._rearm[k].set()
 
@@ -622,6 +685,8 @@ class FleetOrchestrator:
         await asyncio.gather(*self._tasks, return_exceptions=True)
         for slot in self.slots:
             await slot.webrtc.stop_session()
+            if slot.audio is not None:
+                await slot.audio.stop()
             await slot.input.stop_js_server()
             await slot.input.disconnect()
         await self.server.stop()

@@ -428,3 +428,73 @@ def test_fleet_tick_survives_capture_failures(loop, tmp_path):
             await fleet.stop()
 
     loop.run_until_complete(scenario())
+
+
+def test_fleet_per_session_audio(loop, tmp_path):
+    """--session_audio_devices gives a session its own Opus stream; a
+    session without a listed device stays video-only (a shared default
+    monitor would leak audio across users)."""
+    from selkies_tpu.audio import opus_available
+
+    if not opus_available():
+        pytest.skip("libopus absent")
+    from selkies_tpu.transport.websocket import KIND_AUDIO
+
+    async def scenario():
+        from selkies_tpu.parallel.fleet import FleetOrchestrator
+
+        orch = FleetOrchestrator(make_config(
+            tmp_path, n=2, session_audio_devices="dev0.monitor"))
+        assert orch.slots[0].audio is not None
+        assert orch.slots[1].audio is None
+        # the WebRTC offer must carry an audio m-line exactly for the
+        # session that streams audio
+        assert orch.slots[0].webrtc._kw["audio"] is True
+        assert orch.slots[1].webrtc._kw["audio"] is False
+        run_task = asyncio.ensure_future(orch.run())
+        for _ in range(200):
+            if orch.server._runner is not None and orch.server._runner.addresses:
+                break
+            await asyncio.sleep(0.05)
+        base = f"http://127.0.0.1:{orch.server.bound_port}"
+        try:
+            async with aiohttp.ClientSession() as http:
+                ws0 = await http.ws_connect(base + "/media/0")
+                audio0 = 0
+                async with asyncio.timeout(60):
+                    async for msg in ws0:
+                        if msg.type != aiohttp.WSMsgType.BINARY:
+                            continue
+                        kind, _, _, payload = parse_media_frame(msg.data)
+                        if kind == KIND_AUDIO:
+                            audio0 += 1
+                            if audio0 >= 5:
+                                break
+                assert audio0 >= 5
+                await ws0.close()
+
+                ws1 = await http.ws_connect(base + "/media/1")
+                aus = []
+                audio1 = 0
+                async with asyncio.timeout(60):
+                    async for msg in ws1:
+                        if msg.type != aiohttp.WSMsgType.BINARY:
+                            continue
+                        kind, _, _, payload = parse_media_frame(msg.data)
+                        if kind == KIND_AUDIO:
+                            audio1 += 1
+                        else:
+                            aus.append(payload)
+                        if len(aus) >= 6:
+                            break
+                assert audio1 == 0 and len(aus) >= 6
+                await ws1.close()
+        finally:
+            run_task.cancel()
+            try:
+                await run_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            await orch.shutdown()
+
+    loop.run_until_complete(scenario())
