@@ -292,3 +292,28 @@ def test_devcontainer_feature_metadata():
     script = os.path.join(os.path.dirname(__file__), "..",
                           ".devcontainer", "validate.py")
     subprocess.run([sys.executable, script], check=True)
+
+
+def test_runtime_entrypoint_fleet_support():
+    """The packaged runtime entrypoint provisions fleet sessions (one
+    Xvfb + one null sink per session) and nginx proxies the per-session
+    /media/<k> websocket paths."""
+    import os
+    import re
+    import subprocess
+
+    path = os.path.join(os.path.dirname(__file__), "..", "packaging",
+                        "entrypoint.sh")
+    subprocess.run(["bash", "-n", path], check=True)
+    src = open(path).read()
+    assert "SELKIES_TPU_SESSIONS" in src
+    assert "SELKIES_SESSION_DISPLAYS" in src
+    assert "module-null-sink" in src
+    m = re.search(r"location ~ \^/\((.*)\)\\\$", src)
+    assert m, "no websocket location block"
+    # the location regex must match both /media and /media/<k>
+    pattern = re.compile("^/(" + m.group(1).replace("\\$", "") + ")$")
+    assert pattern.match("/media")
+    assert pattern.match("/media/5")
+    assert pattern.match("/ws")
+    assert not pattern.match("/mediaX")
