@@ -36,36 +36,12 @@ fi
 echo 'waiting for X socket'
 until [ -S "/tmp/.X11-unix/X${DISPLAY#*:}" ]; do sleep 0.5; done
 
-# Fleet mode (SELKIES_TPU_SESSIONS > 1): one Xvfb display and one
-# PulseAudio null sink per session, then hand the maps to the
-# orchestrator (docs/fleet.md). Desktops per display are the
-# deployment's choice (start one xfce4-session per DISPLAY).
+# Fleet mode (SELKIES_TPU_SESSIONS > 1): provision one Xvfb display and
+# one PulseAudio null sink per session (packaging/fleet-provision.sh);
+# an explicit SELKIES_SESSION_DISPLAYS override skips provisioning.
 SESSIONS="${SELKIES_TPU_SESSIONS:-1}"
-if [ "${SESSIONS}" -gt 1 ] 2>/dev/null; then
-    geometry="${SELKIES_FLEET_GEOMETRY:-1920x1080}"
-    base_disp="${SELKIES_FLEET_BASE_DISPLAY:-30}"
-    displays=""
-    adevs=""
-    for i in $(seq 0 $((SESSIONS - 1))); do
-        d=":$((base_disp + i))"
-        if [ ! -S "/tmp/.X11-unix/X$((base_disp + i))" ]; then
-            Xvfb "$d" -screen 0 "${geometry}x24" +extension RANDR \
-                 +extension XFIXES +extension SHM -dpi 96 \
-                 -nolisten tcp -noreset &
-        fi
-        displays="${displays:+${displays},}${d}"
-        if pactl info >/dev/null 2>&1; then
-            pactl load-module module-null-sink sink_name="selkies${i}" \
-                >/dev/null 2>&1 || true
-            adevs="${adevs:+${adevs},}selkies${i}.monitor"
-        fi
-    done
-    export SELKIES_SESSION_DISPLAYS="${SELKIES_SESSION_DISPLAYS:-${displays}}"
-    if [ -n "${adevs}" ]; then
-        export SELKIES_SESSION_AUDIO_DEVICES="${SELKIES_SESSION_AUDIO_DEVICES:-${adevs}}"
-    fi
-    export SELKIES_CAPTURE_WIDTH="${SELKIES_CAPTURE_WIDTH:-${geometry%x*}}"
-    export SELKIES_CAPTURE_HEIGHT="${SELKIES_CAPTURE_HEIGHT:-${geometry#*x}}"
+if [ "${SESSIONS}" -gt 1 ] 2>/dev/null && [ -z "${SELKIES_SESSION_DISPLAYS:-}" ]; then
+    . "$(dirname "$0")/fleet-provision.sh"
 fi
 
 # nginx front: static web client + websocket upgrade proxy to the

@@ -1,0 +1,55 @@
+# Fleet provisioning — sourced by entrypoint.sh when SELKIES_TPU_SESSIONS
+# > 1 and no explicit SELKIES_SESSION_DISPLAYS override is set: one Xvfb
+# display and one PulseAudio null sink per session, then the maps are
+# exported for the orchestrator (docs/fleet.md). Desktops per display are
+# the deployment's choice (one xfce4-session per DISPLAY, with
+# PULSE_SINK=selkies<k> so the monitor carries that desktop's audio).
+#
+# Kept in its own file so the suite can execute it against stubbed
+# Xvfb/pactl binaries (tests/test_services.py). Runs under the caller's
+# `set -e`: conditionals use if-form, never bare &&-lists.
+
+geometry="${SELKIES_FLEET_GEOMETRY:-1920x1080}"
+base_disp="${SELKIES_FLEET_BASE_DISPLAY:-30}"
+x11_dir="${SELKIES_X11_SOCKET_DIR:-/tmp/.X11-unix}"
+
+# pulse readiness races supervisord's pulseaudio program: probe ONCE
+# (with a grace period) before the loop — a mid-loop flip would
+# misalign the positional device map and cross-wire session audio
+pulse_up=false
+for _ in $(seq 1 "${SELKIES_FLEET_PULSE_WAIT:-20}"); do
+    if pactl info >/dev/null 2>&1; then pulse_up=true; break; fi
+    sleep 0.5
+done
+
+displays=""
+adevs=""
+for i in $(seq 0 $((SESSIONS - 1))); do
+    d=":$((base_disp + i))"
+    if [ ! -S "${x11_dir}/X$((base_disp + i))" ]; then
+        Xvfb "$d" -screen 0 "${geometry}x24" +extension RANDR \
+             +extension XFIXES +extension SHM -dpi 96 \
+             -nolisten tcp -noreset &
+    fi
+    displays="${displays:+${displays},}${d}"
+    # unconditional separator keeps the csv positional (entry k must
+    # stay session k's) even when an early sink fails to load
+    if [ "${i}" -gt 0 ]; then adevs="${adevs},"; fi
+    if [ "${pulse_up}" = true ] && pactl load-module module-null-sink \
+            sink_name="selkies${i}" >/dev/null 2>&1; then
+        adevs="${adevs}selkies${i}.monitor"
+    fi
+done
+
+# the orchestrator probes each display once at startup; losing the
+# spawn race would silently downgrade a session to the synthetic source
+for i in $(seq 0 $((SESSIONS - 1))); do
+    until [ -S "${x11_dir}/X$((base_disp + i))" ]; do sleep 0.2; done
+done
+
+export SELKIES_SESSION_DISPLAYS="${displays}"
+if [ "${pulse_up}" = true ]; then
+    export SELKIES_SESSION_AUDIO_DEVICES="${SELKIES_SESSION_AUDIO_DEVICES:-${adevs}}"
+fi
+export SELKIES_CAPTURE_WIDTH="${SELKIES_CAPTURE_WIDTH:-${geometry%x*}}"
+export SELKIES_CAPTURE_HEIGHT="${SELKIES_CAPTURE_HEIGHT:-${geometry#*x}}"

@@ -317,3 +317,57 @@ def test_runtime_entrypoint_fleet_support():
     assert pattern.match("/media/5")
     assert pattern.match("/ws")
     assert not pattern.match("/mediaX")
+
+
+def test_fleet_provisioning_script(tmp_path):
+    """Execute packaging/fleet-provision.sh against stubbed Xvfb/pactl:
+    displays and audio monitors come out positional (entry k = session
+    k) even when a sink fails to load, and the no-pulse host exports
+    displays only."""
+    import os
+    import stat
+    import subprocess
+
+    root = os.path.join(os.path.dirname(__file__), "..", "packaging")
+    bindir = tmp_path / "bin"
+    x11 = tmp_path / "x11"
+    bindir.mkdir()
+    x11.mkdir()
+    # stub Xvfb: create the display socket file; stub pactl: info ok,
+    # sink selkies1 fails to load (positional-alignment case)
+    (bindir / "Xvfb").write_text(
+        "#!/bin/bash\nd=${1#:}\ntouch \"$SELKIES_X11_SOCKET_DIR/X$d\"\n"
+        "python3 -c \"import socket,sys,os; s=socket.socket(socket.AF_UNIX);"
+        "os.unlink(os.environ['SELKIES_X11_SOCKET_DIR']+'/X'+sys.argv[1])"
+        " if os.path.exists(os.environ['SELKIES_X11_SOCKET_DIR']+'/X'+sys.argv[1]) else None;"
+        "s.bind(os.environ['SELKIES_X11_SOCKET_DIR']+'/X'+sys.argv[1])\" \"$d\"\n"
+        "sleep 5\n")
+    (bindir / "pactl").write_text(
+        "#!/bin/bash\n"
+        "if [ \"$1\" = info ]; then exit 0; fi\n"
+        "if [ \"$1\" = load-module ] && [ \"$3\" = sink_name=selkies1 ]; then exit 1; fi\n"
+        "exit 0\n")
+    for f in ("Xvfb", "pactl"):
+        p = bindir / f
+        p.chmod(p.stat().st_mode | stat.S_IEXEC)
+
+    harness = tmp_path / "run.sh"
+    harness.write_text(
+        "#!/bin/bash\nset -e\nSESSIONS=3\n"
+        f". {root}/fleet-provision.sh\n"
+        "echo \"DISPLAYS=$SELKIES_SESSION_DISPLAYS\"\n"
+        "echo \"ADEVS=$SELKIES_SESSION_AUDIO_DEVICES\"\n"
+        "echo \"GEOM=$SELKIES_CAPTURE_WIDTH x $SELKIES_CAPTURE_HEIGHT\"\n")
+    env = dict(os.environ,
+               PATH=f"{bindir}:{os.environ['PATH']}",
+               SELKIES_X11_SOCKET_DIR=str(x11),
+               SELKIES_FLEET_BASE_DISPLAY="40",
+               SELKIES_FLEET_PULSE_WAIT="1")
+    out = subprocess.run(["bash", str(harness)], env=env, timeout=60,
+                         capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    lines = dict(l.split("=", 1) for l in out.stdout.strip().splitlines())
+    assert lines["DISPLAYS"] == ":40,:41,:42"
+    # sink 1 failed: its entry is EMPTY, sinks 0/2 keep their positions
+    assert lines["ADEVS"] == "selkies0.monitor,,selkies2.monitor"
+    assert lines["GEOM"] == "1920 x 1080"
