@@ -307,8 +307,9 @@ def test_runtime_entrypoint_fleet_support():
     subprocess.run(["bash", "-n", path], check=True)
     src = open(path).read()
     assert "SELKIES_TPU_SESSIONS" in src
-    assert "SELKIES_SESSION_DISPLAYS" in src
-    assert "module-null-sink" in src
+    assert "fleet-provision.sh" in src
+    prov = open(os.path.join(os.path.dirname(path), "fleet-provision.sh")).read()
+    assert "module-null-sink" in prov and "SELKIES_SESSION_DISPLAYS" in prov
     m = re.search(r"location ~ \^/\((.*)\)\\\$", src)
     assert m, "no websocket location block"
     # the location regex must match both /media and /media/<k>
