@@ -167,12 +167,12 @@ class HybridFrontendMixin:
         self.frontend_mode = mode
         self.last_hints: np.ndarray | None = None
         self.frontend_device_ms = 0.0
-        pad_w = (width + 15) // 16 * 16
-        pad_h = (height + 15) // 16 * 16
         if self.frontend_mode == "device":
             self._device_fe = DeviceDeltaFrontend(width, height)
             self._prep = None
         else:
+            pad_w = (width + 15) // 16 * 16
+            pad_h = (height + 15) // 16 * 16
             self._device_fe = None
             self._prep = frameprep.FramePrep(width, height, pad_w, pad_h,
                                              nslots=2)

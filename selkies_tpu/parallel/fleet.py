@@ -320,16 +320,12 @@ class FleetOrchestrator:
                 "session_audio_devices configured but libopus is not "
                 "available; fleet audio disabled")
 
-        def _has_audio(k: int) -> bool:
-            return (self._opus and k < len(self.audio_devices)
-                    and bool(self.audio_devices[k]))
-
         self.slots = [
             SessionSlot(
                 k, bitrate_kbps=int(cfg.video_bitrate), fps=int(cfg.framerate),
                 # the SDP offer must carry an audio m-line exactly when
                 # this session will actually stream audio
-                webrtc_audio=_has_audio(k),
+                webrtc_audio=self._has_audio(k),
                 turn_tls_insecure=bool(cfg.turn_tls_insecure),
             )
             for k in range(self.n)
@@ -379,6 +375,12 @@ class FleetOrchestrator:
                            else SyntheticSource(width, height, seed=k))
         return sources
 
+    def _has_audio(self, k: int) -> bool:
+        """Whether session k streams audio — the ONE predicate behind
+        both the SDP audio m-line and the pipeline construction."""
+        return (self._opus and k < len(self.audio_devices)
+                and bool(self.audio_devices[k]))
+
     def _wire_audio(self) -> None:
         """Per-session audio: each fleet session's desktop pairs with its
         own PulseAudio monitor (``--session_audio_devices``). Sessions
@@ -389,8 +391,7 @@ class FleetOrchestrator:
 
         for k, slot in enumerate(self.slots):
             slot.audio = None
-            if (self._opus and k < len(self.audio_devices)
-                    and self.audio_devices[k]):
+            if self._has_audio(k):
                 slot.audio = AudioPipeline(
                     source=open_best_audio_source(self.audio_devices[k]),
                     sink=slot.transport.send_audio,

@@ -22,6 +22,9 @@
     set: (k, v) => localStorage.setItem(appName + ":" + k, v),
   };
 
+  const windowRes = () =>
+    `${Math.round(innerWidth * devicePixelRatio)}x${Math.round(innerHeight * devicePixelRatio)}`;
+
   let serverLatency = 0;
   let cursorStyleEl = null;
 
@@ -50,7 +53,7 @@
     }
     const resizePref = store.get("resize", null);
     if (resizePref !== null) {
-      const res = `${Math.round(innerWidth * devicePixelRatio)}x${Math.round(innerHeight * devicePixelRatio)}`;
+      const res = windowRes();
       plane.send(`_arg_resize,${resizePref},${res}`);
     }
   }
@@ -282,7 +285,7 @@
   resizeChk.checked = store.get("resize", "true") === "true";
   resizeChk.addEventListener("change", () => {
     store.set("resize", String(resizeChk.checked));
-    const res = `${Math.round(innerWidth * devicePixelRatio)}x${Math.round(innerHeight * devicePixelRatio)}`;
+    const res = windowRes();
     plane.send(`_arg_resize,${resizeChk.checked},${res}`);
   });
   const vbSel = document.getElementById("set-vb");
@@ -312,7 +315,7 @@
       plane.send(`r,${resSel.value}`);
     } else {
       input.autoResize = true;
-      const res = `${Math.round(innerWidth * devicePixelRatio)}x${Math.round(innerHeight * devicePixelRatio)}`;
+      const res = windowRes();
       plane.send(`_arg_resize,${store.get("resize", "true")},${res}`);
     }
   });

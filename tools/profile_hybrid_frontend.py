@@ -10,7 +10,6 @@ Uses the TPU when the tunnel is up (one jax process, etiquette per
 honest label otherwise.
 """
 import os
-import socket
 import sys
 import time
 import importlib.util
@@ -19,33 +18,15 @@ import numpy as np
 
 sys.path.insert(0, ".")
 
-
-def tunnel_up() -> bool:
-    try:
-        socket.create_connection(("127.0.0.1", 8083), timeout=3).close()
-        return True
-    except OSError:
-        return False
-
-
 # sitecustomize registers the axon PJRT plugin at interpreter start when
 # PALLAS_AXON_POOL_IPS is set, and the plugin wins over JAX_PLATFORMS=cpu
-# — with the tunnel down, jax init then blocks forever. The only reliable
-# opt-out is a fresh interpreter with a cleaned env (bench.py pattern).
-if (os.environ.get("PALLAS_AXON_POOL_IPS")
-        and not os.environ.get("SELKIES_PROFILE_REEXEC")):
-    if not tunnel_up():
-        env = dict(os.environ)
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env["JAX_PLATFORMS"] = "cpu"
-        env["SELKIES_PROFILE_REEXEC"] = "cpu-fallback(tunnel down)"
-        os.execve(sys.executable, [sys.executable, *sys.argv], env)
-
-BACKEND = os.environ.get("SELKIES_PROFILE_REEXEC", "tpu")
-
+# — with the tunnel down, jax init then blocks forever. bench.py owns the
+# canonical probe+reexec (importing it is cheap: no jax at import time).
 spec = importlib.util.spec_from_file_location("bench", "bench.py")
 bench = importlib.util.module_from_spec(spec)
 spec.loader.exec_module(bench)
+bench._reexec_cpu_if_tunnel_down()
+BACKEND = os.environ.get("SELKIES_BENCH_DEVICE", "tpu")
 
 frames = bench._desktop_trace(40)
 W, H = bench.W, bench.H
