@@ -43,4 +43,5 @@ run python bench.py
 run python tools/profile_multisession.py
 run python tools/profile_hybrid_frontend.py
 run python tools/profile_4k.py
+run python tools/profile_fleet_glue.py
 echo "done; results in $log"
