@@ -210,5 +210,22 @@ for _legacy_h265 in ("nvh265enc", "vah265enc"):
 alias("vavp9enc", "tpuvp9enc")  # silicon VP9 row maps to the hybrid
 # AV1 silicon/alternative-library rows map to the hybrid libaom row
 # (av1enc above is the REAL plain-libaom row, not an alias)
-for _legacy_av1 in ("nvav1enc", "vaav1enc", "svtav1enc", "rav1enc"):
+for _legacy_av1 in ("nvav1enc", "vaav1enc", "rav1enc"):
     alias(_legacy_av1, "tpuav1enc")
+
+
+@register("svtav1enc")
+def _svtav1enc(*, width: int, height: int, fps: int = 60,
+               bitrate_kbps: int = 2000, **kw):
+    """REAL SVT-AV1 row when libSvtAv1Enc passes ABI validation
+    (models/svt_av1_enc.py — the same library the reference's svtav1enc
+    element wraps, gstwebrtc_app.py:724-739); otherwise the hybrid
+    libaom row serves the name, as the silicon aliases do."""
+    from selkies_tpu.models.svt_av1_enc import SvtAv1Encoder, svt_av1_available
+
+    if svt_av1_available():
+        return SvtAv1Encoder(width=width, height=height, fps=fps,
+                             bitrate_kbps=int(bitrate_kbps),
+                             preset=int(kw.get("preset", 10)))
+    return create_encoder("tpuav1enc", width=width, height=height, fps=fps,
+                          bitrate_kbps=bitrate_kbps, **kw)
