@@ -284,9 +284,13 @@ class SvtAv1Encoder:
         self._force_idr = True
 
     def _reopen(self) -> None:
-        """Bitrate retune: SVT 1.4 has no public mid-stream rate-change
-        API, so re-open (a few ms) — the next frame is a keyframe, which
-        the GCC retune cadence absorbs (same stance as the x265 row)."""
+        """Bitrate retune AND forced mid-stream keyframes re-open the
+        encoder (a few ms): SVT 1.4 has no public rate-change API, and
+        per-picture KEY forcing is RA-CRF/CQP-only ('Force key frame is
+        only supported with RA CRF/CQP mode') — unavailable in the
+        low-delay CBR mode this row runs. A fresh stream starts with a
+        keyframe, which is exactly what PLI recovery needs; the GCC
+        retune cadence absorbs the cost (same stance as the x265 row)."""
         self.bitrate_kbps = self._pending_bitrate or self.bitrate_kbps
         self._pending_bitrate = None
         self._teardown()
@@ -332,6 +336,10 @@ class SvtAv1Encoder:
     def encode_frame(self, frame: np.ndarray, qp: int | None = None) -> bytes:
         t0 = time.perf_counter()
         if self._pending_bitrate is not None:
+            self._reopen()
+        elif self._force_idr and self._primed:
+            # mid-stream keyframe (PLI recovery): restart the stream —
+            # see _reopen for why per-picture forcing can't work here
             self._reopen()
         y, u, v = _bgrx_to_i420_np(np.asarray(frame))
         planes = tuple(np.ascontiguousarray(p) for p in (y, u, v))
