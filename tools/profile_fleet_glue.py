@@ -69,6 +69,25 @@ def fleet_ms() -> float:
 
 import jax
 
+if len(jax.devices()) < N:
+    # the tunnel exposes ONE real chip; a 2-session mesh needs 2. The
+    # glue term is host-side python fan-out, so the CPU mesh measures it
+    # just as well — reexec there rather than dying mid-playbook.
+    if jax.default_backend() == "cpu":
+        sys.exit(f"cpu mesh already active but has {len(jax.devices())} "
+                 f"< {N} devices — refusing to reexec in a loop")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={max(8, N)}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    print(f"backend={jax.default_backend()} has {len(jax.devices())} device(s) "
+          f"< {N} sessions; reexec on the {max(8, N)}-device CPU mesh (the "
+          f"glue term is host-side)")
+    os.execve(sys.executable, [sys.executable, *sys.argv], env)
+
 print(f"backend={jax.default_backend()}  sessions={N}  geometry={W}x{H}")
 b = bare_ms()
 f = fleet_ms()
