@@ -50,6 +50,8 @@ DC_RELIABLE = 0x00
 
 MTU = 1150  # fits one DTLS record under typical 1200-byte path MTU
 DEFAULT_RWND = 1024 * 1024
+RX_WINDOW_CHUNKS = 2048  # max TSN distance held in the reorder buffer
+RX_BUFFER_BYTES = 4 * 1024 * 1024  # reorder-buffer byte budget
 RTO = 1.0
 MAX_RETRANS = 10
 
@@ -122,10 +124,12 @@ class SctpAssociation:
         self._ssn: dict[int, int] = {}
         self._next_sid = 0 if is_client else 1
         self._reasm: dict[int, list[tuple[int, int, bytes, int]]] = {}
-        self._rx_out_of_order: dict[int, bytes] = {}  # tsn -> chunk value
+        self._rx_out_of_order: dict[int, tuple[int, bytes]] = {}  # tsn -> (flags, chunk value)
+        self._rx_buffered = 0  # bytes currently held in _rx_out_of_order
         self._cookie = b""
         self._pending_open: list[Channel] = []
         self._shutdown = False
+        self._init_sent = False  # COOKIE-WAIT gate for INIT_ACK (RFC 9260 §5.2.3)
 
     # -- packet framing ----------------------------------------------
 
@@ -144,6 +148,7 @@ class SctpAssociation:
 
     def connect(self) -> None:
         """Initiate the association (INIT)."""
+        self._init_sent = True
         init = struct.pack("!IIHHI", self.local_vtag, DEFAULT_RWND, 1024, 1024,
                            self.local_tsn)
         self._emit(_chunk(INIT, 0, init), vtag=0)
@@ -195,7 +200,15 @@ class SctpAssociation:
             if length < 4 or off + length > len(pkt):
                 break
             value = pkt[off + 4 : off + length]
-            self._on_chunk(ctype, flags, value)
+            # RFC 9260 §4.3: INIT MUST be the only chunk in its packet.
+            # The first-chunk case was validated above (vtag 0, sole
+            # chunk); an INIT smuggled later in a bundle would bypass
+            # that and let _on_chunk clobber remote_vtag/remote_tsn_seen
+            # on a live association.
+            if ctype == INIT and off != 12:
+                logger.debug("SCTP bundled INIT; dropping chunk")
+            else:
+                self._on_chunk(ctype, flags, value)
             off += length + ((4 - length % 4) % 4)
 
     def _on_chunk(self, ctype: int, flags: int, value: bytes) -> None:
@@ -210,6 +223,13 @@ class SctpAssociation:
             ack += struct.pack("!HH", 7, 4 + len(cookie)) + cookie  # STATE-COOKIE
             self._emit(_chunk(INIT_ACK, 0, ack))
         elif ctype == INIT_ACK and len(value) >= 16:
+            # RFC 9260 §5.2.3: an INIT ACK outside COOKIE-WAIT is
+            # discarded — processing it on an established association (or
+            # on a side that never sent INIT) would let the peer clobber
+            # remote_vtag/remote_tsn_seen and silently break delivery
+            if self.established or not self._init_sent:
+                logger.debug("SCTP INIT_ACK outside COOKIE-WAIT; dropping")
+                return
             itag, rwnd, os_, is_, itsn = struct.unpack_from("!IIHHI", value, 0)
             self.remote_vtag = itag
             self.remote_tsn_seen = (itsn - 1) & 0xFFFFFFFF
@@ -231,12 +251,18 @@ class SctpAssociation:
         elif ctype == ABORT:
             logger.warning("SCTP association aborted by peer")
             self.established = False
+            # an ABORT during COOKIE-WAIT also ends COOKIE-WAIT: without
+            # this a later INIT_ACK would pass the §5.2.3 gate and
+            # establish the aborted association with peer-chosen state
+            self._init_sent = False
         elif ctype == SHUTDOWN:
             self._emit(_chunk(SHUTDOWN_ACK, 0, b""))
             self.established = False
+            self._init_sent = False
         elif ctype == SHUTDOWN_ACK:
             self._emit(_chunk(SHUTDOWN_COMPLETE, 0, b""))
             self.established = False
+            self._init_sent = False
 
     @staticmethod
     def _find_param(params: bytes, ptype: int) -> bytes | None:
@@ -253,6 +279,11 @@ class SctpAssociation:
     def _establish(self) -> None:
         if self.established:
             return
+        # COOKIE-WAIT is left for good: without this, an INIT_ACK arriving
+        # after ABORT/SHUTDOWN (established=False again, _init_sent still
+        # True) would pass the §5.2.3 gate and resurrect the dead
+        # association with attacker-chosen remote_vtag/TSN state.
+        self._init_sent = False
         self.established = True
         for ch in self._pending_open:
             self._send_dcep_open(ch)
@@ -264,16 +295,40 @@ class SctpAssociation:
         if len(value) < 12:
             return
         tsn, sid, ssn, ppid = struct.unpack_from("!IHHI", value, 0)
-        if self.remote_tsn_seen is not None and not _tsn_gt(tsn, self.remote_tsn_seen):
+        if self.remote_tsn_seen is None:
+            # no reference TSN yet (COOKIE-WAIT): the drain loop could
+            # never release these, so buffering would be an unbounded
+            # sink for a peer that sends DATA before handshaking. Any
+            # legitimate flow sets remote_tsn_seen via INIT/INIT_ACK
+            # before its first DATA can arrive.
+            logger.debug("SCTP DATA before handshake; dropping")
+            return
+        if not _tsn_gt(tsn, self.remote_tsn_seen):
             self._send_sack()  # duplicate
             return
+        # receive-window bound: serial arithmetic calls half the 32-bit
+        # space "greater", so without a cap a peer could park unbounded
+        # far-future TSNs in the reorder buffer (memory DoS). The count
+        # cap bounds the TSN distance; the byte budget bounds the actual
+        # memory (a DTLS record can carry a ~16 KB chunk, so count alone
+        # would still allow ~32 MB parked behind a never-filled gap).
+        if ((tsn - self.remote_tsn_seen) & 0xFFFFFFFF) > RX_WINDOW_CHUNKS:
+            logger.debug("SCTP DATA tsn %d outside rx window; dropping", tsn)
+            return
+        if tsn in self._rx_out_of_order:
+            return  # duplicate of an already-buffered out-of-order chunk
+        if self._rx_buffered + len(value) > RX_BUFFER_BYTES:
+            logger.debug("SCTP reorder buffer over byte budget; dropping tsn %d", tsn)
+            return
+        self._rx_buffered += len(value)
         self._rx_out_of_order[tsn] = (flags, value)
         # advance the cumulative TSN over any in-order run
-        while self.remote_tsn_seen is not None:
+        while True:
             nxt = (self.remote_tsn_seen + 1) & 0xFFFFFFFF
             item = self._rx_out_of_order.pop(nxt, None)
             if item is None:
                 break
+            self._rx_buffered -= len(item[1])
             self.remote_tsn_seen = nxt
             self._deliver(*item)
         self._send_sack()

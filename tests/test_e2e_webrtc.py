@@ -32,6 +32,57 @@ def loop():
     loop.close()
 
 
+class SignallingPump:
+    """One step of the browser-side signalling choreography shared by the
+    e2e tests: receive a websocket message (1 s timeout), answer the
+    server's offer and trickle our host candidate, feed remote ICE, and
+    kick DTLS once ICE connects. ``step()`` returns False when the
+    websocket closed or errored, so the caller's loop fails fast instead
+    of spinning until its deadline."""
+
+    def __init__(self, ws, browser, codec=None):
+        self.ws, self.browser, self.codec = ws, browser, codec
+        self.answered = False
+        self.offer_sdp = None
+
+    async def step(self) -> bool:
+        ws, browser = self.ws, self.browser
+        try:
+            msg = await asyncio.wait_for(ws.receive(), 1.0)
+        except asyncio.TimeoutError:
+            msg = None
+        if msg is not None and msg.type == aiohttp.WSMsgType.TEXT:
+            data = msg.data
+            if data in ("HELLO",) or data.startswith("SESSION_OK"):
+                pass
+            else:
+                obj = json.loads(data)
+                if "sdp" in obj and obj["sdp"]["type"] == "offer":
+                    self.offer_sdp = obj["sdp"]["sdp"]
+                    kw = {"codec": self.codec} if self.codec else {}
+                    answer = await browser.answer(self.offer_sdp, **kw)
+                    await ws.send_str(json.dumps(
+                        {"sdp": {"type": "answer", "sdp": answer}}))
+                    # trickle the browser's host candidate back
+                    cand = browser.ice.local_candidates[0]
+                    line = (f"candidate:1 1 udp {cand.priority} "
+                            f"127.0.0.1 {cand.port} typ host")
+                    await ws.send_str(json.dumps(
+                        {"ice": {"candidate": line, "sdpMLineIndex": 0}}))
+                    self.answered = True
+                elif "ice" in obj and self.answered:
+                    browser.ice.add_remote_candidate(obj["ice"]["candidate"])
+        elif msg is not None and msg.type in (
+            aiohttp.WSMsgType.CLOSED, aiohttp.WSMsgType.ERROR
+        ):
+            return False
+        if self.answered and browser.ice.connected and browser.dtls is not None \
+                and not browser.dtls.handshake_complete:
+            browser.start_dtls()
+            await asyncio.sleep(0.05)
+        return True
+
+
 def test_webrtc_session_end_to_end(loop, tmp_path):
     async def scenario():
         orch = Orchestrator(make_config(tmp_path))
@@ -50,40 +101,13 @@ def test_webrtc_session_end_to_end(loop, tmp_path):
         async with aiohttp.ClientSession() as http:
             ws = await http.ws_connect(f"http://127.0.0.1:{port}/ws")
             await ws.send_str("HELLO 1")
-            offer = None
-            answered = False
             deadline = asyncio.get_event_loop().time() + 90
             input_ch = None
             sent_input = False
+            pump = SignallingPump(ws, browser)
 
             while asyncio.get_event_loop().time() < deadline:
-                try:
-                    msg = await asyncio.wait_for(ws.receive(), 1.0)
-                except asyncio.TimeoutError:
-                    msg = None
-                if msg is not None and msg.type == aiohttp.WSMsgType.TEXT:
-                    data = msg.data
-                    if data in ("HELLO",) or data.startswith("SESSION_OK"):
-                        pass
-                    else:
-                        obj = json.loads(data)
-                        if "sdp" in obj and obj["sdp"]["type"] == "offer":
-                            offer = obj["sdp"]["sdp"]
-                            answer = await browser.answer(offer)
-                            await ws.send_str(json.dumps(
-                                {"sdp": {"type": "answer", "sdp": answer}}))
-                            # trickle the browser's host candidate back
-                            cand = browser.ice.local_candidates[0]
-                            line = (f"candidate:1 1 udp {cand.priority} "
-                                    f"127.0.0.1 {cand.port} typ host")
-                            await ws.send_str(json.dumps(
-                                {"ice": {"candidate": line, "sdpMLineIndex": 0}}))
-                            answered = True
-                        elif "ice" in obj and answered:
-                            browser.ice.add_remote_candidate(obj["ice"]["candidate"])
-                elif msg is not None and msg.type in (
-                    aiohttp.WSMsgType.CLOSED, aiohttp.WSMsgType.ERROR
-                ):
+                if not await pump.step():
                     break
                 # once DTLS is up, open the input channel (browser-created,
                 # like the reference web client)
@@ -109,16 +133,8 @@ def test_webrtc_session_end_to_end(loop, tmp_path):
                 browser.sctp.on_message = _dc
                 if len(browser.rtp_packets) >= 40 and sent_input and dc_json:
                     break
-                elif browser.dtls is None and answered:
-                    # kick DTLS once ICE is connected
-                    if browser.ice.connected:
-                        pass
-                if answered and browser.ice.connected and browser.dtls is not None \
-                        and not browser.dtls.handshake_complete:
-                    browser.start_dtls()
-                    await asyncio.sleep(0.05)
 
-            assert answered, "no offer arrived from the orchestrator"
+            assert pump.answered, "no offer arrived from the orchestrator"
             assert browser.dtls is not None and browser.dtls.handshake_complete, \
                 "DTLS handshake did not complete"
             assert len(browser.rtp_packets) >= 10, \
@@ -227,42 +243,12 @@ def test_webrtc_codec_session_end_to_end(loop, tmp_path, codec_case):
         async with aiohttp.ClientSession() as http:
             ws = await http.ws_connect(f"http://127.0.0.1:{port}/ws")
             await ws.send_str("HELLO 1")
-            answered = False
             deadline = asyncio.get_event_loop().time() + 90
-            offer_sdp = None
             input_ch = None
+            pump = SignallingPump(ws, browser, codec=sdp_codec)
             while asyncio.get_event_loop().time() < deadline:
-                try:
-                    msg = await asyncio.wait_for(ws.receive(), 1.0)
-                except asyncio.TimeoutError:
-                    msg = None
-                if msg is not None and msg.type == aiohttp.WSMsgType.TEXT:
-                    data = msg.data
-                    if data in ("HELLO",) or data.startswith("SESSION_OK"):
-                        pass
-                    else:
-                        obj = json.loads(data)
-                        if "sdp" in obj and obj["sdp"]["type"] == "offer":
-                            offer_sdp = obj["sdp"]["sdp"]
-                            answer = await browser.answer(offer_sdp, codec=sdp_codec)
-                            await ws.send_str(json.dumps(
-                                {"sdp": {"type": "answer", "sdp": answer}}))
-                            cand = browser.ice.local_candidates[0]
-                            line = (f"candidate:1 1 udp {cand.priority} "
-                                    f"127.0.0.1 {cand.port} typ host")
-                            await ws.send_str(json.dumps(
-                                {"ice": {"candidate": line, "sdpMLineIndex": 0}}))
-                            answered = True
-                        elif "ice" in obj and answered:
-                            browser.ice.add_remote_candidate(obj["ice"]["candidate"])
-                elif msg is not None and msg.type in (
-                    aiohttp.WSMsgType.CLOSED, aiohttp.WSMsgType.ERROR
-                ):
+                if not await pump.step():
                     break
-                if answered and browser.ice.connected and browser.dtls is not None \
-                        and not browser.dtls.handshake_complete:
-                    browser.start_dtls()
-                    await asyncio.sleep(0.05)
                 # the session (and its video pipeline) starts when the
                 # input datachannel opens — same as the real client
                 if browser.dtls is not None and browser.dtls.handshake_complete \
@@ -274,8 +260,8 @@ def test_webrtc_codec_session_end_to_end(loop, tmp_path, codec_case):
                 if len(browser.rtp_packets) >= 30:
                     break
 
-            assert answered, "no offer arrived"
-            assert offer_sdp is not None and f"{sdp_codec}/90000" in offer_sdp, \
+            assert pump.answered, "no offer arrived"
+            assert pump.offer_sdp is not None and f"{sdp_codec}/90000" in pump.offer_sdp, \
                 f"offer must advertise {sdp_codec}"
             assert browser.dtls is not None and browser.dtls.handshake_complete
             assert len(browser.rtp_packets) >= 10, \
@@ -339,6 +325,92 @@ def test_webrtc_codec_session_end_to_end(loop, tmp_path, codec_case):
                 ok, frame = cap.read()
                 assert ok, "FFmpeg could not decode the streamed VP9"
                 assert frame.shape == (128, 192, 3)
+            await ws.close()
+
+        await orch.shutdown()
+        run_task.cancel()
+        try:
+            await run_task
+        except (asyncio.CancelledError, Exception):
+            pass
+
+    loop.run_until_complete(scenario())
+
+
+def test_webrtc_session_survives_hostile_sctp(loop, tmp_path):
+    """The authenticated DTLS peer injects the hostile SCTP classes the
+    hardening addressed — INIT_ACK outside COOKIE-WAIT (RFC 9260 §5.2.3),
+    an INIT bundled behind a benign chunk (§4.3), and a far-future-TSN
+    DATA chunk (reorder-buffer DoS) — through the real DTLS tunnel
+    mid-session; datachannel input sent AFTERWARD must still reach the
+    host backend and video must keep flowing."""
+    import struct
+
+    from selkies_tpu.transport.webrtc import sctp as S
+
+    def hostile_frames(sctp):
+        from test_webrtc_sctp import raw_sctp_frame
+
+        bad_init_body = struct.pack("!IIHHI", 0xDEAD, 1 << 20, 4, 4, 0xBEEF)
+        far = (sctp.local_tsn + S.RX_WINDOW_CHUNKS + 999) & 0xFFFFFFFF
+        far_data = struct.pack("!IHHI", far, 0, 0, S.PPID_STRING) + b"x"
+        chunk_sets = [
+            S._chunk(S.INIT_ACK, 0, bad_init_body),
+            S._chunk(S.HEARTBEAT, 0, b"\x00\x01\x00\x08ping")
+            + S._chunk(S.INIT, 0, bad_init_body),
+            S._chunk(S.DATA, 3, far_data),
+        ]
+        return [raw_sctp_frame(sctp.remote_vtag, chunks)
+                for chunks in chunk_sets]
+
+    async def scenario():
+        orch = Orchestrator(make_config(tmp_path))
+        be = FakeBackend()
+        orch.input.backend = be
+        orch.input.clipboard = MemoryClipboard()
+        run_task = asyncio.ensure_future(orch.run())
+        for _ in range(100):
+            if orch.server._runner is not None and orch.server._runner.addresses:
+                break
+            await asyncio.sleep(0.05)
+        port = orch.server.bound_port
+        browser = FakeBrowser()
+        injected = sent_after = False
+        input_ch = None
+
+        async with aiohttp.ClientSession() as http:
+            ws = await http.ws_connect(f"http://127.0.0.1:{port}/ws")
+            await ws.send_str("HELLO 1")
+            deadline = asyncio.get_event_loop().time() + 90
+            pump = SignallingPump(ws, browser)
+            while asyncio.get_event_loop().time() < deadline:
+                if not await pump.step():
+                    break
+                if browser.dtls is not None and browser.dtls.handshake_complete:
+                    if input_ch is None:
+                        input_ch = browser.sctp.open_channel("input")
+                        for pkt in browser.sctp.take_packets():
+                            browser.dtls.send(pkt)
+                        browser._flush()
+                    elif input_ch.open and not injected:
+                        for pkt in hostile_frames(browser.sctp):
+                            browser.dtls.send(pkt)
+                        browser._flush()
+                        injected = True
+                    elif injected and not sent_after:
+                        browser.sctp.send(input_ch, b"kd,65")
+                        for pkt in browser.sctp.take_packets():
+                            browser.dtls.send(pkt)
+                        browser._flush()
+                        sent_after = True
+                if (sent_after and any(e == ("key", 65, True) for e in be.events)
+                        and len(browser.rtp_packets) >= 10):
+                    break
+
+            assert injected, "hostile packets were never injected"
+            assert any(e == ("key", 65, True) for e in be.events), \
+                "input sent after hostile injection did not reach the host"
+            assert len(browser.rtp_packets) >= 10, "video stalled"
             await ws.close()
 
         await orch.shutdown()

@@ -1,8 +1,11 @@
 """SCTP association + DCEP loopback: handshake, channels both ways,
 fragmentation, loss recovery, checksum rejection."""
 
+import struct
+
 import pytest
 
+from selkies_tpu.transport.webrtc import sctp as S
 from selkies_tpu.transport.webrtc.sctp import Channel, SctpAssociation, crc32c
 
 
@@ -118,15 +121,120 @@ def test_corrupt_packet_ignored():
 
 def test_heartbeat_echo():
     cli, srv = _pair()
-    import struct
-
-    from selkies_tpu.transport.webrtc import sctp as S
-
     hb_info = b"\x00\x01\x00\x08ping"
-    hdr = struct.pack("!HHII", 5000, 5000, srv.local_vtag, 0)
-    pkt = bytearray(hdr + S._chunk(S.HEARTBEAT, 0, hb_info))
-    struct.pack_into("<I", pkt, 8, crc32c(bytes(pkt)))
-    srv.put_packet(bytes(pkt))
+    srv.put_packet(raw_sctp_frame(srv.local_vtag, S._chunk(S.HEARTBEAT, 0, hb_info)))
     out = srv.take_packets()
     assert out and out[0][12] == S.HEARTBEAT_ACK
     assert hb_info in out[0]
+
+
+def raw_sctp_frame(vtag, chunks):
+    """Well-formed SCTP envelope (ports, vtag, valid crc32c) around
+    arbitrary chunk bytes — shared by the fuzz and e2e hostile-peer
+    tests, which import it from here."""
+    hdr = struct.pack("!HHII", 5000, 5000, vtag, 0)
+    pkt = bytearray(hdr + chunks)
+    struct.pack_into("<I", pkt, 8, crc32c(bytes(pkt)))
+    return bytes(pkt)
+
+
+def test_init_ack_outside_cookie_wait_dropped():
+    """RFC 9260 §5.2.3: INIT_ACK on an established association (or on a
+    side that never sent INIT) must not clobber remote_vtag/TSN state."""
+    cli, srv = _pair()
+    vtag_before, tsn_before = srv.remote_vtag, srv.remote_tsn_seen
+    hostile = struct.pack("!IIHHI", 0xDEAD, 1 << 20, 4, 4, 0xBEEF)
+    srv.put_packet(raw_sctp_frame(srv.local_vtag, S._chunk(S.INIT_ACK, 0, hostile)))
+    assert (srv.remote_vtag, srv.remote_tsn_seen) == (vtag_before, tsn_before)
+    # delivery still works
+    got = []
+    srv.on_message = lambda ch, d, b: got.append(d)
+    ch = cli.open_channel("input")
+    _pump(cli, srv)
+    cli.send(ch, b"ok")
+    _pump(cli, srv)
+    assert got == [b"ok"]
+
+
+def test_bundled_init_dropped():
+    """RFC 9260 §4.3: INIT must be the sole chunk — one smuggled behind a
+    benign chunk in the same packet must not reset association state."""
+    cli, srv = _pair()
+    vtag_before, tsn_before = srv.remote_vtag, srv.remote_tsn_seen
+    init = struct.pack("!IIHHI", 0xDEAD, 1 << 20, 4, 4, 0xBEEF)
+    bundle = S._chunk(S.HEARTBEAT, 0, b"\x00\x01\x00\x08ping") + S._chunk(S.INIT, 0, init)
+    srv.put_packet(raw_sctp_frame(srv.local_vtag, bundle))
+    assert (srv.remote_vtag, srv.remote_tsn_seen) == (vtag_before, tsn_before)
+
+
+def test_far_future_tsn_not_buffered():
+    """A DATA chunk parked half the TSN space ahead must be dropped, not
+    held in the reorder buffer forever (memory DoS)."""
+    cli, srv = _pair()
+    far = (srv.remote_tsn_seen + S.RX_WINDOW_CHUNKS + 100) & 0xFFFFFFFF
+    data = struct.pack("!IHHI", far, 0, 0, S.PPID_STRING) + b"x"
+    srv.put_packet(raw_sctp_frame(srv.local_vtag, S._chunk(S.DATA, 3, data)))
+    assert far not in srv._rx_out_of_order
+    near = (srv.remote_tsn_seen + 5) & 0xFFFFFFFF
+    data = struct.pack("!IHHI", near, 0, 0, S.PPID_STRING) + b"x"
+    srv.put_packet(raw_sctp_frame(srv.local_vtag, S._chunk(S.DATA, 3, data)))
+    assert near in srv._rx_out_of_order  # in-window reorder still buffers
+
+
+def test_data_before_handshake_dropped():
+    """DATA arriving in COOKIE-WAIT (no reference TSN yet) must be
+    dropped, not parked in the reorder buffer it could never leave."""
+    cli = SctpAssociation(is_client=True)
+    cli.connect()  # local_vtag now known to the (hostile) peer
+    data = struct.pack("!IHHI", 12345, 0, 0, S.PPID_STRING) + b"x"
+    cli.put_packet(raw_sctp_frame(cli.local_vtag, S._chunk(S.DATA, 3, data)))
+    assert cli._rx_out_of_order == {}
+
+
+def test_init_ack_after_abort_does_not_resurrect():
+    """ABORT closes the association for good: a later INIT_ACK must not
+    pass the COOKIE-WAIT gate and flip it back to established."""
+    cli, srv = _pair()
+    cli.put_packet(raw_sctp_frame(cli.local_vtag, S._chunk(S.ABORT, 1, b"")))
+    assert not cli.established
+    vtag_before = cli.remote_vtag
+    hostile = struct.pack("!IIHHI", 0xDEAD, 1 << 20, 4, 4, 0xBEEF)
+    cli.put_packet(raw_sctp_frame(cli.local_vtag, S._chunk(S.INIT_ACK, 0, hostile)))
+    assert not cli.established, "dead association resurrected by INIT_ACK"
+    assert cli.remote_vtag == vtag_before
+
+
+def test_init_ack_after_cookie_wait_abort_does_not_resurrect():
+    """An ABORT received during COOKIE-WAIT (T-bit, vtag 0 — remote_vtag
+    is still 0 then) ends COOKIE-WAIT too: a later INIT_ACK must not
+    establish the aborted association with peer-chosen state."""
+    cli = SctpAssociation(is_client=True)
+    cli.connect()
+    cli.put_packet(raw_sctp_frame(0, S._chunk(S.ABORT, 1, b"")))
+    hostile = struct.pack("!IIHHI", 0xDEAD, 1 << 20, 4, 4, 0xBEEF)
+    cli.put_packet(raw_sctp_frame(cli.local_vtag, S._chunk(S.INIT_ACK, 0, hostile)))
+    assert not cli.established, "COOKIE-WAIT abort did not stick"
+    assert cli.remote_vtag != 0xDEAD
+
+
+def test_reorder_buffer_byte_budget():
+    """Large in-window chunks parked behind a never-filled gap must stop
+    accumulating at the byte budget, and the budget must be released as
+    the gap fills and chunks deliver."""
+    cli, srv = _pair()
+    base = srv.remote_tsn_seen
+    big = b"z" * 16000  # one DTLS record can carry a ~16 KB chunk
+    n_fit = S.RX_BUFFER_BYTES // (len(big) + 12)
+    for i in range(n_fit + 20):  # leave base+1 missing: nothing delivers
+        tsn = (base + 2 + i) & 0xFFFFFFFF
+        data = struct.pack("!IHHI", tsn, 0, 0, S.PPID_STRING) + big
+        srv.put_packet(raw_sctp_frame(srv.local_vtag, S._chunk(S.DATA, 3, data)))
+    assert srv._rx_buffered <= S.RX_BUFFER_BYTES
+    assert len(srv._rx_out_of_order) <= n_fit + 1
+    # filling the gap drains the buffer and releases the budget
+    got = []
+    srv._on_message_raw = lambda sid, ppid, msg: got.append(len(msg))
+    data = struct.pack("!IHHI", (base + 1) & 0xFFFFFFFF, 0, 0, S.PPID_STRING) + b"y"
+    srv.put_packet(raw_sctp_frame(srv.local_vtag, S._chunk(S.DATA, 3, data)))
+    assert srv._rx_out_of_order == {}
+    assert srv._rx_buffered == 0
