@@ -63,8 +63,17 @@ class Candidate:
         c = cls(foundation=parts[0], component=int(parts[1]),
                 priority=int(parts[3]), ip=parts[4], port=int(parts[5]),
                 typ=parts[7])
-        if "raddr" in parts:
-            i = parts.index("raddr")
+        if "raddr" in parts[8:]:
+            # search past the 8 fixed fields: "raddr" is a legal
+            # foundation token (RFC 8839 ice-char), so scanning from 0
+            # could match the wrong position
+            i = parts.index("raddr", 8)
+            # a malformed tail ("... raddr" truncated, or some other
+            # attribute where "rport" belongs) must fail like every other
+            # malformed candidate: add_remote_candidate catches ValueError
+            # (this line arrives from the remote browser)
+            if i + 3 >= len(parts) or parts[i + 2] != "rport":
+                raise ValueError(f"malformed raddr/rport in candidate: {line!r}")
             c.raddr, c.rport = parts[i + 1], int(parts[i + 3])
         return c
 

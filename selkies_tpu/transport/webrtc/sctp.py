@@ -317,7 +317,13 @@ class SctpAssociation:
             return
         if tsn in self._rx_out_of_order:
             return  # duplicate of an already-buffered out-of-order chunk
-        if self._rx_buffered + len(value) > RX_BUFFER_BYTES:
+        # the budget must never drop the gap-filling chunk (tsn == next
+        # expected): it delivers immediately and DRAINS the buffer below,
+        # while dropping it would deadlock a full buffer — every
+        # retransmission would bounce the same way until the sender's
+        # retry cap tears the association down
+        if (tsn != ((self.remote_tsn_seen + 1) & 0xFFFFFFFF)
+                and self._rx_buffered + len(value) > RX_BUFFER_BYTES):
             logger.debug("SCTP reorder buffer over byte budget; dropping tsn %d", tsn)
             return
         self._rx_buffered += len(value)

@@ -13,6 +13,9 @@ and asserts the documented failure contract:
 | StunMessage.parse | StunError (a ValueError) only |
 | SctpAssociation.put_packet | never raises; association survives |
 | sdp.parse_answer | ValueError only |
+| Candidate.from_sdp | ValueError only (add_remote_candidate catches it) |
+| DtlsEndpoint datagrams | garbage silently discarded (RFC 6347 §4.1.2.7) |
+| signalling ws text protocol | ERROR reply / disconnect, server survives |
 
 Reference analogue: none — the reference delegates all of this to
 GStreamer/libnice and ships no fuzzing (SURVEY §4); these tests are the
@@ -262,3 +265,157 @@ def test_sdp_parse_answer_valueerror_only():
             assert isinstance(r, sdp.RemoteDescription)
         except ValueError:
             pass
+
+
+# -------------------------------------------------------- ICE candidates
+
+def test_candidate_from_sdp_valueerror_only():
+    """Candidate lines arrive from the remote browser via signalling and
+    add_remote_candidate only catches ValueError — nothing else may
+    escape. (A truncated 'raddr' tail used to raise IndexError.)"""
+    from selkies_tpu.transport.webrtc.ice import Candidate
+
+    valid = "candidate:1 1 udp 2122260223 192.0.2.1 54321 typ srflx raddr 10.0.0.1 rport 9"
+    parsed = Candidate.from_sdp(valid)
+    assert parsed.raddr == "10.0.0.1" and parsed.rport == 9
+    tokens = valid.split()
+    for _ in range(N_MUTATED):
+        op = int(RNG.integers(0, 3))
+        if op == 0:  # truncate token list (covers the bare-raddr tail)
+            line = " ".join(tokens[: int(RNG.integers(0, len(tokens)))])
+        elif op == 1:  # replace random tokens with garbage
+            toks = [(_rand_token() or "x") if RNG.random() < 0.4 else t
+                    for t in tokens]
+            line = " ".join(toks)
+        else:
+            line = _rand_token()
+        try:
+            Candidate.from_sdp(line)
+        except ValueError:
+            pass
+
+
+# ----------------------------------------------------------------- DTLS
+
+def test_dtls_garbage_does_not_break_handshake_or_session():
+    """RFC 6347 §4.1.2.7: invalid records are silently discarded. An
+    off-path spoofer who knows the 4-tuple must not be able to kill the
+    handshake or an established session by injecting garbage datagrams
+    (peer.py closes the session on any DTLS exception, so an exception
+    here IS a remote DoS)."""
+    from selkies_tpu.transport.webrtc import dtls
+
+    cert_s, key_s, fp_s = dtls.make_certificate()
+    cert_c, key_c, fp_c = dtls.make_certificate()
+    srv = dtls.DtlsEndpoint(is_server=True, cert_der=cert_s, key_der=key_s,
+                            peer_fingerprint=fp_c)
+    cli = dtls.DtlsEndpoint(is_server=False, cert_der=cert_c, key_der=key_c,
+                            peer_fingerprint=fp_s)
+    cli.handshake_step()  # client flight 1
+    # interleave garbage with the real flights
+    for _ in range(30):
+        progress = False
+        for src, dst in ((cli, srv), (srv, cli)):
+            for dg in src.take_datagrams():
+                dst.put_datagram(RNG.integers(0, 256, size=int(
+                    RNG.integers(1, 100)), dtype=np.uint8).tobytes())
+                dst.handshake_step()
+                dst.put_datagram(dg)
+                dst.handshake_step()
+                progress = True
+        if cli.handshake_complete and srv.handshake_complete:
+            break
+        if not progress:
+            cli.handshake_step()
+    assert cli.handshake_complete and srv.handshake_complete, \
+        "garbage datagrams broke the DTLS handshake"
+    # established session: garbage must neither raise nor deliver
+    for _ in range(N_RANDOM):
+        srv.put_datagram(_rand_bytes(120))
+        assert srv.recv() == []
+    # real traffic still flows
+    cli.send(b"after the storm")
+    for dg in cli.take_datagrams():
+        srv.put_datagram(dg)
+    assert srv.recv() == [b"after the storm"]
+
+
+# ------------------------------------------------------------- signalling
+
+def test_signalling_server_survives_garbage_lines():
+    """The websocket text protocol (HELLO/SESSION/ROOM lines) comes from
+    arbitrary internet clients pre-auth: garbage must draw ERROR replies
+    or disconnects, never kill the server — a fresh legitimate peer must
+    still register afterward."""
+    import aiohttp
+
+    from selkies_tpu.signalling import SignallingOptions, SignallingServer
+
+    async def scenario():
+        srv = SignallingServer(SignallingOptions(addr="127.0.0.1", port=0))
+        await srv.start()
+        port = srv.bound_port
+        url = f"ws://127.0.0.1:{port}/ws"
+        async with aiohttp.ClientSession() as http:
+            for _ in range(40):
+                ws = await http.ws_connect(url)
+                for _ in range(int(RNG.integers(1, 6))):
+                    kind = int(RNG.integers(0, 4))
+                    if kind == 0:
+                        line = " ".join(filter(None, (
+                            _rand_token() for _ in range(int(RNG.integers(0, 5))))))
+                    elif kind == 1:
+                        line = "HELLO " + _rand_token()
+                    elif kind == 2:
+                        line = "SESSION " + _rand_token()
+                    else:
+                        line = "ROOM " + _rand_token()
+                    try:
+                        await ws.send_str(line or "x")
+                        msg = await asyncio.wait_for(ws.receive(), 2.0)
+                        if msg.type in (aiohttp.WSMsgType.CLOSED,
+                                        aiohttp.WSMsgType.CLOSE,
+                                        aiohttp.WSMsgType.ERROR):
+                            break
+                    except (ConnectionResetError, asyncio.TimeoutError):
+                        break
+                if not ws.closed:
+                    await ws.close()
+            # the server must still serve a legitimate peer
+            ws = await http.ws_connect(url)
+            await ws.send_str("HELLO 1")
+            msg = await asyncio.wait_for(ws.receive(), 5.0)
+            assert msg.data == "HELLO", f"server broken after fuzz: {msg!r}"
+            await ws.close()
+            async with http.get(f"http://127.0.0.1:{port}/health") as resp:
+                assert resp.status == 200
+        await srv.stop()
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(scenario())
+    finally:
+        loop.close()
+
+
+def test_candidate_rport_keyword_verified():
+    """'raddr X <something-else> Y' must be rejected, not silently parse
+    Y (e.g. a 'generation' value) as the rport."""
+    from selkies_tpu.transport.webrtc.ice import Candidate
+
+    bad = "candidate:1 1 udp 1 192.0.2.1 54321 typ srflx raddr 10.0.0.1 generation 0"
+    try:
+        Candidate.from_sdp(bad)
+        raise AssertionError("malformed rport keyword accepted")
+    except ValueError:
+        pass
+
+
+def test_candidate_raddr_foundation_token():
+    """'raddr' is a legal foundation token (RFC 8839 ice-char): a host
+    candidate named that way must parse, not be rejected by the
+    raddr-attribute scan."""
+    from selkies_tpu.transport.webrtc.ice import Candidate
+
+    c = Candidate.from_sdp("candidate:raddr 1 udp 2122260223 192.0.2.1 54321 typ host")
+    assert c.foundation == "raddr" and c.raddr is None

@@ -238,3 +238,24 @@ def test_reorder_buffer_byte_budget():
     srv.put_packet(raw_sctp_frame(srv.local_vtag, S._chunk(S.DATA, 3, data)))
     assert srv._rx_out_of_order == {}
     assert srv._rx_buffered == 0
+
+
+def test_gap_fill_exempt_from_byte_budget():
+    """The chunk that fills the cumulative gap must be accepted even at
+    a full byte budget — it drains the buffer; dropping it would bounce
+    every retransmission and deadlock a legitimate flow."""
+    cli, srv = _pair()
+    base = srv.remote_tsn_seen
+    big = b"z" * 16000
+    n_fit = S.RX_BUFFER_BYTES // (len(big) + 12)
+    for i in range(n_fit + 5):
+        tsn = (base + 2 + i) & 0xFFFFFFFF
+        data = struct.pack("!IHHI", tsn, 0, 0, S.PPID_STRING) + big
+        srv.put_packet(raw_sctp_frame(srv.local_vtag, S._chunk(S.DATA, 3, data)))
+    assert srv._rx_buffered > S.RX_BUFFER_BYTES - (len(big) + 12)  # effectively full
+    delivered = []
+    srv._on_message_raw = lambda sid, ppid, msg: delivered.append(len(msg))
+    gap = struct.pack("!IHHI", (base + 1) & 0xFFFFFFFF, 0, 0, S.PPID_STRING) + big
+    srv.put_packet(raw_sctp_frame(srv.local_vtag, S._chunk(S.DATA, 3, gap)))
+    assert delivered, "gap-filling chunk was dropped at full budget"
+    assert srv._rx_buffered == 0 and srv._rx_out_of_order == {}
