@@ -229,3 +229,39 @@ def test_gamepad_server_config_and_events(loop, tmp_path):
         assert not os.path.exists(path)
 
     loop.run_until_complete(scenario())
+
+
+def test_uinput_mouse_proxy_wire_format(tmp_path):
+    """The uinput proxy must emit the reference's msgpack datagram shape
+    ({"args": [(etype, code), value], "kwargs": {"syn": bool}}) so the
+    same uinput helper binaries work unchanged
+    (reference webrtc_input.py:159-164 __mouse_emit)."""
+    import msgpack
+
+    from selkies_tpu.input_host.backends import UinputMouseProxy
+    from selkies_tpu.input_host import input_codes as codes
+
+    path = str(tmp_path / "mouse.sock")
+    rx = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+    rx.bind(path)
+    rx.settimeout(2)
+    proxy = UinputMouseProxy(path)
+    try:
+        proxy.pointer_motion(-5, 7)
+        proxy.button(1, True)   # X11 left button -> BTN_LEFT press
+        proxy.scroll(up=False)
+        msgs = [msgpack.unpackb(rx.recv(4096), raw=False) for _ in range(4)]
+    finally:
+        proxy.close()
+        rx.close()
+    assert msgs[0] == {"args": [[codes.EV_REL, codes.REL_X], -5],
+                       "kwargs": {"syn": False}}
+    assert msgs[1] == {"args": [[codes.EV_REL, codes.REL_Y], 7],
+                       "kwargs": {"syn": True}}
+    assert msgs[2]["args"][1] == 1 and msgs[2]["args"][0][0] == codes.EV_KEY
+    assert msgs[3] == {"args": [[codes.EV_REL, codes.REL_WHEEL], -1],
+                       "kwargs": {"syn": True}}
+    # losing the receiver must not raise (container helper restarts)
+    proxy2 = UinputMouseProxy(str(tmp_path / "gone.sock"))
+    proxy2.pointer_motion(1, 1)
+    proxy2.close()

@@ -1,0 +1,215 @@
+"""TURN credential sources and periodic monitors (signalling/rtc_monitors.py).
+
+Parity: the reference orchestrator's in-process credential chain
+(__main__.py:62-160) — HMAC shared-secret refresh, TURN REST refresh,
+and the rtc.json file watcher. These are the pieces that rotate
+credentials under live sessions before the 24 h HMAC expiry; until now
+they were only exercised indirectly through orchestrator wiring.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import hashlib
+import hmac as hmac_mod
+import json
+import os
+import socket
+
+import pytest
+from aiohttp import web
+
+
+async def _stub_site(handler):
+    """Start an aiohttp stub on an OS-bound socket (no private-attr port
+    discovery) -> (runner, port)."""
+    app = web.Application()
+    app.router.add_get("/", handler)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    sock = socket.create_server(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    site = web.SockSite(runner, sock)
+    await site.start()
+    return runner, port
+
+
+def _touch_later(path, bump):
+    """Force a strictly increasing mtime so the file monitor's
+    `mtime > last` check fires even on coarse-granularity filesystems
+    (each write in a test passes a strictly larger bump)."""
+    st = os.stat(path)
+    os.utime(path, (st.st_atime, st.st_mtime + bump))
+
+from selkies_tpu.signalling.rtc_monitors import (
+    HMACRTCMonitor,
+    RESTRTCMonitor,
+    RTCConfigFileMonitor,
+    fetch_turn_rest,
+    make_turn_rtc_config_json_legacy,
+)
+from selkies_tpu.signalling.turn import parse_rtc_config
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def test_legacy_config_shape():
+    doc = json.loads(make_turn_rtc_config_json_legacy(
+        "turn.example.com", 3478, "user", "pass",
+        protocol="tcp", turn_tls=True))
+    assert doc["lifetimeDuration"] == "86400s"
+    stun, turn = doc["iceServers"]
+    assert "stun:turn.example.com:3478" in stun["urls"]
+    assert turn["urls"] == ["turns:turn.example.com:3478?transport=tcp"]
+    assert turn["username"] == "user" and turn["credential"] == "pass"
+    # the produced document round-trips through the shared parser
+    stun_csv, turn_csv, _ = parse_rtc_config(
+        make_turn_rtc_config_json_legacy("h", 1, "u", "p"))
+    assert "stun://h:1" in stun_csv and "turn://u:p@h:1" in turn_csv
+
+
+def test_hmac_monitor_pushes_valid_credentials(loop):
+    """The refreshed config must carry coturn-style HMAC short-term
+    credentials: username '<expiry>:<user>' (expiry in the future) and
+    credential == b64(HMAC_SHA1(secret, username))."""
+    mon = HMACRTCMonitor(
+        "turn.example.com", 3478, "s3cret", "alice", period=0.01)
+    got = []
+    mon.on_rtc_config = lambda stun, turn, cfg: got.append((stun, turn, cfg))
+    loop.run_until_complete(mon._refresh())
+    assert got, "no config pushed"
+    stun, turn, cfg = got[0]
+    doc = json.loads(cfg)
+    turn_entry = next(s for s in doc["iceServers"] if "username" in s)
+    user = turn_entry["username"]  # coturn REST convention: "<expiry>:<user>"
+    expiry = int(user.split(":")[0])
+    import time as _time
+    assert expiry > _time.time(), "credential already expired"
+    mac = hmac_mod.new(b"s3cret", user.encode(), hashlib.sha1).digest()
+    assert turn_entry["credential"] == base64.b64encode(mac).decode()
+    assert "turn.example.com" in turn
+
+
+def test_hmac_monitor_periodic_loop_fires_and_stops(loop):
+    mon = HMACRTCMonitor("h", 3478, "s", "u", period=0.05)
+    got = []
+    mon.on_rtc_config = lambda *a: got.append(a)
+
+    async def scenario():
+        task = asyncio.ensure_future(mon.start())
+        for _ in range(100):
+            if got:
+                break
+            await asyncio.sleep(0.05)
+        await mon.stop()
+        await asyncio.wait_for(task, 5)
+
+    loop.run_until_complete(scenario())
+    assert got, "periodic loop never refreshed"
+
+
+def test_rest_monitor_against_stub_server(loop):
+    """RESTRTCMonitor + fetch_turn_rest against a local stub implementing
+    the turn-rest HTTP contract (headers in, RTC config JSON out)."""
+    seen = []
+
+    async def handler(request):
+        seen.append(dict(request.headers))
+        return web.json_response(json.loads(
+            make_turn_rtc_config_json_legacy("1.2.3.4", 3478, "u", "p")))
+
+    async def scenario():
+        runner, port = await _stub_site(handler)
+        uri = f"http://127.0.0.1:{port}/"
+
+        # the fetcher resolves the documented header contract
+        stun, turn, cfg = await fetch_turn_rest(
+            uri, "alice:bob", protocol="tcp", turn_tls=True)
+        assert "turn://u:p@1.2.3.4:3478" in turn
+
+        mon = RESTRTCMonitor(uri, "alice:bob", turn_protocol="tcp",
+                             period=0.01)
+        got = []
+        mon.on_rtc_config = lambda *a: got.append(a)
+        await mon._refresh()
+        assert got
+        await runner.cleanup()
+
+    loop.run_until_complete(scenario())
+    # direct fetch passes the user verbatim; the MONITOR sanitizes ':'
+    # to '-' (reference parity: coturn rejects ':' in REST usernames)
+    assert seen[0]["x-auth-user"] == "alice:bob"
+    assert seen[0]["x-turn-protocol"] == "tcp"
+    assert seen[0]["x-turn-tls"] == "true"
+    assert seen[1]["x-auth-user"] == "alice-bob"
+
+
+def test_rest_monitor_error_body_raises(loop):
+    async def handler(request):
+        return web.Response(status=503, text="overloaded")
+
+    async def scenario():
+        runner, port = await _stub_site(handler)
+        with pytest.raises(RuntimeError, match="503"):
+            await fetch_turn_rest(f"http://127.0.0.1:{port}/", "u")
+        await runner.cleanup()
+
+    loop.run_until_complete(scenario())
+
+
+def test_file_monitor_detects_change_and_survives_garbage(loop, tmp_path):
+    rtc = tmp_path / "rtc.json"
+    rtc.write_text(make_turn_rtc_config_json_legacy("h1", 1, "u", "p"))
+    mon = RTCConfigFileMonitor(str(rtc), poll_interval=0.05)
+    got = []
+    mon.on_rtc_config = lambda stun, turn, cfg: got.append(turn)
+
+    async def scenario():
+        task = asyncio.ensure_future(mon.start())
+        await asyncio.sleep(0.2)  # initial mtime recorded, no push yet
+        assert got == []
+        # garbage write: must be logged, not raised, and not crash the loop
+        rtc.write_text("{not json")
+        _touch_later(rtc, 2)
+        await asyncio.sleep(0.3)
+        # a real change after the garbage still propagates
+        rtc.write_text(make_turn_rtc_config_json_legacy("h2", 2, "u", "p"))
+        _touch_later(rtc, 4)
+        for _ in range(100):
+            if any("h2" in t for t in got):
+                break
+            await asyncio.sleep(0.05)
+        await mon.stop()
+        await asyncio.wait_for(task, 5)
+
+    loop.run_until_complete(scenario())
+    assert any("turn://u:p@h2:2" in t for t in got), got
+
+
+def test_file_monitor_missing_file_keeps_polling(loop, tmp_path):
+    rtc = tmp_path / "rtc.json"  # does not exist yet
+    mon = RTCConfigFileMonitor(str(rtc), poll_interval=0.05)
+    got = []
+    mon.on_rtc_config = lambda stun, turn, cfg: got.append(turn)
+
+    async def scenario():
+        task = asyncio.ensure_future(mon.start())
+        await asyncio.sleep(0.2)
+        rtc.write_text(make_turn_rtc_config_json_legacy("late", 9, "u", "p"))
+        _touch_later(rtc, 2)
+        for _ in range(100):
+            if got:
+                break
+            await asyncio.sleep(0.05)
+        await mon.stop()
+        await asyncio.wait_for(task, 5)
+
+    loop.run_until_complete(scenario())
+    assert any("late" in t for t in got), "file created after start never detected"
