@@ -160,6 +160,10 @@ class TPUWebRTCApp:
         self.pointer_visible = bool(visible)
 
     def force_keyframe(self) -> None:
+        # unthrottled on purpose: internal callers (transport handover,
+        # session start) are never retried, so they must always land.
+        # The PLI/FIR flood floor lives in the transport
+        # (webrtc/peer.py _on_srtcp), shared with the fleet path.
         self.encoder.force_keyframe()
 
     # ------------------------------------------------------------------

@@ -40,6 +40,10 @@ class PeerConnection:
         pc.send_video(au_bytes, ts_ms); pc.send_audio(opus, ts)
     """
 
+    # min spacing between PLI/FIR-honored keyframes (libwebrtc applies
+    # the same ~300 ms floor); see _on_srtcp
+    KEYFRAME_MIN_INTERVAL = 0.3
+
     def __init__(self, *, codec: str = "h264", audio: bool = True,
                  fec_percentage: int = 20,
                  stun_server=None, turn_server=None,
@@ -107,6 +111,9 @@ class PeerConnection:
         self._aud_octets = 0
         self._last_video_ts = 0
         self._tick_task: asyncio.Task | None = None
+        # -inf so the FIRST PLI is always honored regardless of the
+        # monotonic clock's epoch
+        self._last_pli_keyframe = float("-inf")
         # control surface callbacks
         self.on_force_keyframe = lambda: None
         self.on_packet_sent = lambda seq, send_ms, size: None   # GCC
@@ -276,7 +283,20 @@ class PeerConnection:
             return
         fb = rtcp.parse_compound(plain)
         if fb.pli_ssrcs or fb.fir_ssrcs:
-            self.on_force_keyframe()
+            # libwebrtc-style keyframe floor: a broken/hostile peer
+            # flooding PLIs must not turn every frame into an IDR
+            # (~10-30x bandwidth + a slower device step). Dropping is
+            # safe HERE because browsers re-send PLI until a keyframe
+            # arrives; internal keyframe requests (transport handover)
+            # bypass this path and are always honored. Shared by the
+            # single-session app and the fleet (both wire
+            # on_force_keyframe off this peer).
+            now = time.monotonic()
+            if now - self._last_pli_keyframe >= self.KEYFRAME_MIN_INTERVAL:
+                self._last_pli_keyframe = now
+                self.on_force_keyframe()
+            else:
+                logger.debug("PLI keyframe throttled")
         for blk in fb.reports:
             if blk.ssrc == self.video_ssrc and blk.fraction_lost > 0:
                 self.on_loss(blk.fraction_lost)

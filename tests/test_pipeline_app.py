@@ -115,3 +115,50 @@ def test_data_channel_vocabulary():
     import base64
 
     assert base64.b64decode(transport.messages[5]["data"]["content"]) == b"hello"
+
+
+def test_pli_flood_keyframe_floor():
+    """A PLI flood must not turn every frame into an IDR: the peer's
+    RTCP handler keeps the libwebrtc-style ~300 ms floor (shared by the
+    single-session app and the fleet, which both wire on_force_keyframe
+    off this path), the floor expires for later legitimate PLIs, and the
+    app-layer force_keyframe stays UNTHROTTLED for internal callers
+    (transport handover is never retried)."""
+    import struct
+
+    from selkies_tpu.transport.webrtc.peer import PeerConnection
+
+    pc = PeerConnection.__new__(PeerConnection)  # RTCP state only
+    pc.video_ssrc = 1
+    pc._last_pli_keyframe = float("-inf")
+    forced = []
+    pc.on_force_keyframe = lambda: forced.append(1)
+    pc.on_loss = lambda fraction: None
+    pli = struct.pack("!BBHII", 0x81, 206, 2, 99, 1)
+
+    class _PassthroughSrtp:
+        def unprotect_rtcp(self, data):
+            return data
+
+    pc.srtp = _PassthroughSrtp()
+    for _ in range(50):
+        pc._on_srtcp(pli)
+    assert len(forced) == 1, "PLI flood not throttled"
+    pc._last_pli_keyframe -= PeerConnection.KEYFRAME_MIN_INTERVAL + 0.01
+    pc._on_srtcp(pli)
+    assert len(forced) == 2, "PLI after the floor must be honored"
+
+    # internal keyframe requests bypass the floor entirely
+    from selkies_tpu.pipeline.app import TPUWebRTCApp
+
+    class CountingEncoder:
+        forced = 0
+
+        def force_keyframe(self):
+            self.forced += 1
+
+    app = TPUWebRTCApp.__new__(TPUWebRTCApp)
+    app.encoder = CountingEncoder()
+    for _ in range(5):
+        app.force_keyframe()
+    assert app.encoder.forced == 5
