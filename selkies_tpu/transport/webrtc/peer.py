@@ -26,6 +26,12 @@ from selkies_tpu.transport.webrtc.srtp import SrtpError, SrtpSession, session_pa
 logger = logging.getLogger("transport.webrtc.peer")
 
 RTX_BUFFER = 512  # packets kept for NACK retransmission (~1.7 s at 300 pps)
+# NACK-retransmit abuse bounds: a small RTCP compound can request
+# hundreds of full-MTU retransmits (amplification), and re-NACKing the
+# same seq in a tight loop replays it forever. Legit recovery stays far
+# below both bounds (8 Mbit/s at 20% burst loss ≈ 0.2 MB/s of rtx).
+RTX_SEQ_FLOOR = 0.04       # s between retransmits of the SAME seq (~RTT/2)
+RTX_BUDGET_BYTES = 1_000_000  # token bucket: max rtx bytes per second
 
 
 class PeerConnection:
@@ -114,6 +120,9 @@ class PeerConnection:
         # -inf so the FIRST PLI is always honored regardless of the
         # monotonic clock's epoch
         self._last_pli_keyframe = float("-inf")
+        self._rtx_last: dict[int, float] = {}   # seq -> last retransmit time
+        self._rtx_tokens = float(RTX_BUDGET_BYTES)
+        self._rtx_refill_at = time.monotonic()
         # control surface callbacks
         self.on_force_keyframe = lambda: None
         self.on_packet_sent = lambda seq, send_ms, size: None   # GCC
@@ -306,9 +315,25 @@ class PeerConnection:
                 if pkt.recv_delta_ms is not None:
                     t += pkt.recv_delta_ms
                     self.on_packet_acked(pkt.seq, t)
+        if fb.nacks:
+            now = time.monotonic()
+            self._rtx_tokens = min(
+                float(RTX_BUDGET_BYTES),
+                self._rtx_tokens + (now - self._rtx_refill_at) * RTX_BUDGET_BYTES)
+            self._rtx_refill_at = now
         for seq in fb.nacks:
             wire = self._rtx.get(seq)
             if wire is not None and self.srtp is not None:
+                # abuse bounds (see RTX_SEQ_FLOOR/RTX_BUDGET_BYTES): skip
+                # a seq retransmitted within the floor (the rtx is likely
+                # still in flight) and stop when the byte budget is dry
+                if now - self._rtx_last.get(seq, float("-inf")) < RTX_SEQ_FLOOR:
+                    continue
+                if self._rtx_tokens < len(wire):
+                    logger.debug("rtx budget exhausted; dropping NACKs")
+                    break
+                self._rtx_last[seq] = now
+                self._rtx_tokens -= len(wire)
                 # plain retransmission (no rtx ssrc): re-protect fails the
                 # SRTP replay rules on some stacks, so resend the original
                 # protected packet bytes
@@ -316,6 +341,11 @@ class PeerConnection:
                     self.ice.send(wire)
                 except ConnectionError:
                     pass
+        if len(self._rtx_last) > 2 * RTX_BUFFER:
+            # keep the floor map aligned with the live ring (seqs wrap at
+            # 65536, so without pruning a long session pins every seq)
+            self._rtx_last = {s: t for s, t in self._rtx_last.items()
+                              if s in self._rtx}
         if fb.bye:
             logger.info("peer sent RTCP BYE")
             self.close()

@@ -131,6 +131,8 @@ def test_pli_flood_keyframe_floor():
     pc = PeerConnection.__new__(PeerConnection)  # RTCP state only
     pc.video_ssrc = 1
     pc._last_pli_keyframe = float("-inf")
+    pc._rtx, pc._rtx_last = {}, {}
+    pc._rtx_tokens, pc._rtx_refill_at = 0.0, 0.0
     forced = []
     pc.on_force_keyframe = lambda: forced.append(1)
     pc.on_loss = lambda fraction: None
@@ -162,3 +164,62 @@ def test_pli_flood_keyframe_floor():
     for _ in range(5):
         app.force_keyframe()
     assert app.encoder.forced == 5
+
+
+def test_nack_rtx_abuse_bounds(monkeypatch):
+    """NACK retransmission is an amplification primitive (a small RTCP
+    compound can request hundreds of full-MTU resends): the same seq is
+    not retransmitted within the per-seq floor, and total rtx bytes are
+    capped by a token bucket — while distinct first-time NACKs within
+    budget are all honored."""
+    import struct
+
+    from selkies_tpu.transport.webrtc import peer as peer_mod
+    from selkies_tpu.transport.webrtc.peer import PeerConnection
+
+    # freeze the clock: real elapsed time would refill the bucket
+    # mid-loop and admit extra packets (flaky under CI load)
+    monkeypatch.setattr(peer_mod.time, "monotonic", lambda: 1000.0)
+
+    pc = PeerConnection.__new__(PeerConnection)
+    pc.video_ssrc = 1
+    pc._last_pli_keyframe = float("-inf")
+    pc._rtx_last = {}
+    pc._rtx_tokens = float(peer_mod.RTX_BUDGET_BYTES)
+    pc._rtx_refill_at = 0.0
+    pc.on_force_keyframe = lambda: None
+    pc.on_loss = lambda fraction: None
+    sent = []
+
+    class _Ice:
+        @staticmethod
+        def send(wire):
+            sent.append(wire)
+
+    class _PassthroughSrtp:
+        def unprotect_rtcp(self, data):
+            return data
+
+    pc.ice = _Ice()
+    pc.srtp = _PassthroughSrtp()
+    pc._rtx = {seq: b"x" * 1200 for seq in range(200)}
+
+    def nack(pid, blp=0):
+        return struct.pack("!BBHIIHH", 0x81, 205, 3, 99, 1, pid, blp)
+
+    # same-seq flood: one resend only within the floor
+    for _ in range(50):
+        pc._on_srtcp(nack(7))
+    assert len(sent) == 1, "same-seq NACK flood not floored"
+
+    # distinct seqs are honored until the byte budget runs dry
+    sent.clear()
+    pc._rtx_tokens = 10 * 1200 + 100  # room for ~10 packets
+    for seq in range(100):
+        if seq == 7:
+            continue
+        pc._on_srtcp(nack(seq))
+    assert len(sent) == 10, f"budget not enforced: {len(sent)} sent"
+
+    # the floor map stays aligned with the rtx ring (no unbounded growth)
+    assert len(pc._rtx_last) <= 2 * peer_mod.RTX_BUFFER
