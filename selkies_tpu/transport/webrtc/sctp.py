@@ -52,6 +52,12 @@ MTU = 1150  # fits one DTLS record under typical 1200-byte path MTU
 DEFAULT_RWND = 1024 * 1024
 RX_WINDOW_CHUNKS = 2048  # max TSN distance held in the reorder buffer
 RX_BUFFER_BYTES = 4 * 1024 * 1024  # reorder-buffer byte budget
+# max bytes of in-progress fragmented messages PER ASSOCIATION (summed
+# over all stream ids — sids are attacker-chosen 16-bit values, so a
+# per-stream cap would multiply by 65536): browsers cap datachannel
+# messages well below this (256 KB typical); a peer streaming
+# B-fragments with no E bit must not grow memory unboundedly
+REASM_MAX_BYTES = 16 * 1024 * 1024
 RTO = 1.0
 MAX_RETRANS = 10
 
@@ -124,6 +130,7 @@ class SctpAssociation:
         self._ssn: dict[int, int] = {}
         self._next_sid = 0 if is_client else 1
         self._reasm: dict[int, list[tuple[int, int, bytes, int]]] = {}
+        self._reasm_total = 0  # in-progress fragment bytes, all streams
         self._rx_out_of_order: dict[int, tuple[int, bytes]] = {}  # tsn -> (flags, chunk value)
         self._rx_buffered = 0  # bytes currently held in _rx_out_of_order
         self._cookie = b""
@@ -344,17 +351,31 @@ class SctpAssociation:
         payload = value[12:]
         frags = self._reasm.setdefault(sid, [])
         frags.append((flags, ssn, payload, ppid))
+        self._reasm_total += len(payload)
         if not flags & 0x01:  # E bit clear: more fragments coming
+            if self._reasm_total > REASM_MAX_BYTES:
+                # over the association budget: drop THIS stream's state
+                # (repeat offenders clear themselves fragment by fragment,
+                # so the total stays pinned at the cap)
+                logger.warning("reassembly over %d bytes (stream %d); "
+                               "dropping its fragment state",
+                               REASM_MAX_BYTES, sid)
+                self._reasm_total -= sum(len(f[2]) for f in frags)
+                del self._reasm[sid]  # empty-list entries would pile up over 64k sids
             return
         # reassemble from the most recent B fragment; an E without any B
         # is malformed — drop the stream's fragment state, not the session
         start = next((i for i in range(len(frags) - 1, -1, -1) if frags[i][0] & 0x02), -1)
         if start < 0:
-            frags.clear()
+            self._reasm_total -= sum(len(f[2]) for f in frags)
+            del self._reasm[sid]
             return
         msg = b"".join(f[2] for f in frags[start:])
         ppid = frags[start][3]
         del frags[start:]
+        if not frags:
+            del self._reasm[sid]
+        self._reasm_total -= len(msg)
         self._on_message_raw(sid, ppid, msg)
 
     def _on_message_raw(self, sid: int, ppid: int, msg: bytes) -> None:

@@ -259,3 +259,39 @@ def test_gap_fill_exempt_from_byte_budget():
     srv.put_packet(raw_sctp_frame(srv.local_vtag, S._chunk(S.DATA, 3, gap)))
     assert delivered, "gap-filling chunk was dropped at full budget"
     assert srv._rx_buffered == 0 and srv._rx_out_of_order == {}
+
+
+def test_reassembly_byte_cap():
+    """A peer streaming B-fragments with no E bit must not grow memory
+    unboundedly: per-stream in-progress reassembly is capped, and a
+    legitimate fragmented message still delivers afterward."""
+    cli, srv = _pair()
+    got = []
+    srv.on_message = lambda ch, d, b: got.append(d)
+    ch = cli.open_channel("clipboard")
+    _pump(cli, srv)
+
+    # hostile: endless begin fragments, never an E bit, ROTATING the
+    # stream id every fragment (sids are attacker-chosen 16-bit values,
+    # so a per-stream cap would multiply by 65536 — the budget is per
+    # association)
+    chunk = b"f" * 60000
+    base = srv.remote_tsn_seen
+    n = S.REASM_MAX_BYTES // len(chunk) + 20
+    for i in range(n):
+        tsn = (base + 1 + i) & 0xFFFFFFFF
+        sid = i % 4096
+        data = struct.pack("!IHHI", tsn, sid, 0, S.PPID_BINARY) + chunk
+        srv.put_packet(raw_sctp_frame(srv.local_vtag, S._chunk(S.DATA, 0x02, data)))
+        srv.take_packets()
+    assert srv._reasm_total <= S.REASM_MAX_BYTES + len(chunk), \
+        "fragment state grew past the association budget"
+
+    # a normal fragmented message still delivers end-to-end. The hostile
+    # fragments came from "cli" (the authenticated peer IS the sender),
+    # so its TSN counter must account for them like a real sender's would
+    cli.local_tsn = (base + 1 + n) & 0xFFFFFFFF
+    blob = bytes(range(256)) * 50
+    cli.send(ch, blob, binary=True)
+    _pump(cli, srv)
+    assert blob in got, "legitimate fragmented message lost after the cap"
