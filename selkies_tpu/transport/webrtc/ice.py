@@ -116,6 +116,7 @@ class _CheckPair:
 
 
 MAX_CHECK_ATTEMPTS = 20  # ~10 s at the 0.5 s pacing before a pair fails
+MAX_CHECK_PAIRS = 64  # remote-candidate cap; see add_remote_candidate
 
 
 class _Proto(asyncio.DatagramProtocol):
@@ -451,6 +452,15 @@ class IceAgent:
         if any(p.remote.ip == cand.ip and p.remote.port == cand.port
                for p in self._pairs):
             return
+        # candidate lines arrive from the remote peer over signalling and
+        # every accepted one makes this host send STUN checks to the
+        # named address: an unbounded flood is both a memory leak and a
+        # traffic-reflection primitive (the classic "ICE as port scanner")
+        # — real browsers gather far fewer (libwebrtc stays under ~32)
+        if len(self._pairs) >= MAX_CHECK_PAIRS:
+            logger.warning("remote candidate limit reached; ignoring %s:%d",
+                           cand.ip, cand.port)
+            return
         self._pairs.append(_CheckPair(remote=cand))
         if self._relay_addr is not None:
             self._pairs.append(_CheckPair(remote=cand, relayed=True))
@@ -585,9 +595,13 @@ class IceAgent:
         self._transport.sendto(
             resp.serialize(integrity_key=self.local_pwd.encode()), addr
         )
-        # peer-reflexive discovery: learn pairs we were never told about
-        if not any(p.remote.ip == addr[0] and p.remote.port == addr[1]
-                   for p in self._pairs):
+        # peer-reflexive discovery: learn pairs we were never told about.
+        # Same cap as add_remote_candidate — the peer knows local_pwd, so
+        # binding requests from thousands of source ports would otherwise
+        # grow _pairs (and the 0.5 s check traffic) without bound.
+        if (len(self._pairs) < MAX_CHECK_PAIRS
+                and not any(p.remote.ip == addr[0] and p.remote.port == addr[1]
+                            for p in self._pairs)):
             self._pairs.append(_CheckPair(remote=Candidate(
                 foundation="prflx", component=1,
                 priority=candidate_priority("prflx"),

@@ -419,3 +419,21 @@ def test_candidate_raddr_foundation_token():
 
     c = Candidate.from_sdp("candidate:raddr 1 udp 2122260223 192.0.2.1 54321 typ host")
     assert c.foundation == "raddr" and c.raddr is None
+
+
+def test_ice_candidate_flood_capped():
+    """Every accepted remote candidate makes this host send STUN checks
+    to the named address — a flood must be capped (memory + traffic
+    reflection), and the cap must not break earlier candidates."""
+    from selkies_tpu.transport.webrtc import ice as ice_mod
+    from selkies_tpu.transport.webrtc.ice import IceAgent
+
+    agent = IceAgent.__new__(IceAgent)
+    agent._pairs = []
+    agent._relay_addr = None
+    for i in range(500):
+        line = (f"candidate:1 1 udp 2122260223 10.{(i >> 8) & 255}.{i & 255}.1 "
+                f"{1000 + i} typ host")
+        agent.add_remote_candidate(line)
+    assert len(agent._pairs) <= ice_mod.MAX_CHECK_PAIRS
+    assert agent._pairs[0].remote.ip == "10.0.0.1"  # early ones kept
