@@ -139,7 +139,11 @@ class GccController:
         self.on_estimate = on_estimate or (lambda kbps: None)
         self._trend = TrendlineEstimator()
         self._sent: dict[int, _Sent] = {}
-        self._recv_window: deque[tuple[float, int]] = deque()  # (recv_ms, bytes)
+        # (recv_ms, bytes); maxlen backstops the time-window prune below —
+        # hostile TWCC whose receive clock never advances would otherwise
+        # grow this forever (one entry per acked packet). 4096 >> the ~300
+        # entries a real 1 s window holds at 300 pps.
+        self._recv_window: deque[tuple[float, int]] = deque(maxlen=4096)
         self._last_decrease_throughput: float | None = None
         self._last_increase_ms: float | None = None
         self._last_reported = float(start_kbps)

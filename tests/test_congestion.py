@@ -94,3 +94,33 @@ def test_ack_without_send_ignored():
     gcc = GccController(start_kbps=3000)
     gcc.on_frame_ack(123, 50.0)
     assert gcc.estimate_kbps == 3000
+
+
+def test_hostile_feedback_bounded():
+    """Adversarial TWCC feedback (random/backward receive clocks, random
+    sizes and loss fractions) must keep the estimate inside [min, max]
+    and all internal ledgers bounded — the estimate drives the encoder
+    bitrate, so an escape here poisons the video pipeline."""
+    import numpy as np
+
+    from selkies_tpu.transport.congestion import GccController
+
+    rng = np.random.default_rng(0xACC)
+    gcc = GccController(start_kbps=2000, min_kbps=100, max_kbps=20000)
+    estimates = []
+    gcc.on_estimate = estimates.append
+    for i in range(20000):
+        op = int(rng.integers(0, 3))
+        if op == 0:
+            gcc.on_frame_sent(int(rng.integers(0, 65536)),
+                              float(rng.normal() * 1e7), int(rng.integers(0, 10**6)))
+        elif op == 1:
+            gcc.on_frame_ack(int(rng.integers(0, 65536)),
+                             float(rng.normal() * 1e7))
+        else:
+            gcc.on_loss_report(float(rng.random()))
+        assert gcc.min_kbps <= gcc.estimate_kbps <= gcc.max_kbps
+        assert gcc.estimate_kbps == gcc.estimate_kbps  # not NaN
+    assert len(gcc._sent) <= 4096
+    assert len(gcc._recv_window) <= 4096
+    assert all(100 <= e <= 20000 for e in estimates)
