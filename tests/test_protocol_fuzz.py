@@ -16,6 +16,7 @@ and asserts the documented failure contract:
 | Candidate.from_sdp | ValueError only (add_remote_candidate catches it) |
 | DtlsEndpoint datagrams | garbage silently discarded (RFC 6347 §4.1.2.7) |
 | signalling ws text protocol | ERROR reply / disconnect, server survives |
+| SrtpSession.unprotect/_rtcp | SrtpError only (peer.py catches it) |
 
 Reference analogue: none — the reference delegates all of this to
 GStreamer/libnice and ships no fuzzing (SURVEY §4); these tests are the
@@ -437,3 +438,38 @@ def test_ice_candidate_flood_capped():
         agent.add_remote_candidate(line)
     assert len(agent._pairs) <= ice_mod.MAX_CHECK_PAIRS
     assert agent._pairs[0].remote.ip == "10.0.0.1"  # early ones kept
+
+
+# ------------------------------------------------------------------- SRTP
+
+def test_srtp_unprotect_srtperror_only():
+    """Post-DTLS media-plane input: unprotect/unprotect_rtcp must reject
+    garbage and mutated-authentic packets with SrtpError only (peer.py
+    catches exactly that), and a legitimate packet still round-trips."""
+    from selkies_tpu.transport.webrtc.srtp import SrtpError, SrtpSession
+
+    lk, ls = bytes(range(16)), bytes(range(14))
+    rk, rs = bytes(range(16, 32)), bytes(range(14, 28))
+    tx = SrtpSession(lk, ls, rk, rs)
+    rx = SrtpSession(rk, rs, lk, ls)
+    wire = RtpPacket(payload_type=96, sequence=1, timestamp=0, ssrc=7,
+                     payload=b"p" * 100).serialize()
+    protected = tx.protect(wire)
+    for _ in range(N_RANDOM):
+        try:
+            rx.unprotect(_rand_bytes())
+        except SrtpError:
+            pass
+        try:
+            rx.unprotect_rtcp(_rand_bytes())
+        except SrtpError:
+            pass
+    for _ in range(N_MUTATED):
+        try:
+            rx.unprotect(_mutate(protected))
+        except SrtpError:
+            pass
+    # an untouched authentic packet still decodes after the storm
+    wire2 = RtpPacket(payload_type=96, sequence=2, timestamp=90,
+                      ssrc=7, payload=b"q" * 100).serialize()
+    assert rx.unprotect(tx.protect(wire2)) == wire2
