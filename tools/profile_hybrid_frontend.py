@@ -78,17 +78,20 @@ def compute_only(n=30):
     assert np.asarray(dirty).any(), "timed path must exercise the vote branch"
     print(f"frontend compute-only (frame resident, dirty+hints fetched): "
           f"{dt:.2f} ms/f")
-    # same step PIPELINED (fetch only at the end): separates the chip's
+    # same step PIPELINED (one drain at the end): separates the chip's
     # execute time from the per-round-trip dispatch+fetch latency, which
-    # on the relay is ~100+ ms but on a PCIe-local host is microseconds
+    # on the relay is ~100+ ms but on a PCIe-local host is microseconds.
+    # Drain with np.asarray, NOT block_until_ready — the latter returns
+    # early under the relay (PERF.md cost model) and once measured this
+    # stage at a fictitious 0.15 ms/f.
     t0 = time.perf_counter()
     for i in range(n):
         dirty, hints, prev, prev_luma = fe._step(
             f_b if i % 2 else f_a, prev, prev_luma)
-    jax.block_until_ready((dirty, hints))
+    np.asarray(dirty)  # forces the chained queue to drain
     dt = (time.perf_counter() - t0) * 1e3 / n
     assert np.asarray(dirty).any(), "timed path must exercise the vote branch"
-    print(f"frontend execute-only (pipelined x{n}, one final fetch): "
+    print(f"frontend execute-only (pipelined x{n}, np.asarray drain): "
           f"{dt:.2f} ms/f")
 
 
