@@ -417,3 +417,35 @@ def test_nscap_dense_fallback_and_row_spill(monkeypatch, tmp_path):
     while cap.read()[0]:
         n += 1
     assert n == len(frames)
+
+
+def test_long_run_state_returns_to_baseline():
+    """Hundreds of pipelined frames must leave no residue in the
+    encoder's bookkeeping: in-flight queues empty after flush, pack-pool
+    futures resolved, the pfx hint bounded, and the source/ref chains
+    still a single live generation (leaks here grow for hours in a real
+    session before anyone notices)."""
+    rng = np.random.default_rng(11)
+    enc = TPUH264Encoder(width=160, height=96, qp=26, frame_batch=4,
+                         pipeline_depth=2)
+    base = rng.integers(0, 255, (96, 160, 4), np.uint8)
+    n_aus = 0
+    for i in range(300):
+        f = base.copy()
+        # typing-like delta + periodic window switch
+        f[(i * 7) % 80 : (i * 7) % 80 + 8, 0:64] = int(rng.integers(0, 255))
+        if i % 60 == 59:
+            base = rng.integers(0, 255, (96, 160, 4), np.uint8)
+            f = base.copy()
+        for au, stats, _ in enc.submit(f):
+            n_aus += 1
+            assert au  # every completed frame produced bytes
+    for au, stats, _ in enc.flush():
+        n_aus += 1
+        assert au
+    assert n_aus == 300, f"pipeline lost frames: {n_aus}/300"
+    assert not enc._inflight
+    assert not enc._batch_pend
+    with enc._pfx_lock:
+        assert len(enc._pfx_recent) <= 64
+    enc.close()
