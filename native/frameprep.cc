@@ -202,4 +202,39 @@ void bgrx_to_i420_tiles(const uint8_t* src, int h, int w, int pw, int tw,
     }
 }
 
+namespace {
+
+// splitmix64 mix — must match tilecache.py _splitmix64 exactly (the
+// numpy fallback and this path feed the same host-side hash index).
+inline uint64_t splitmix64(uint64_t x) {
+    x += 0x9E3779B97F4A7C15ULL;
+    x ^= x >> 30;
+    x *= 0xBF58476D1CE4E5B9ULL;
+    x ^= x >> 27;
+    x *= 0x94D049BB133111EBULL;
+    return x ^ (x >> 31);
+}
+
+}  // namespace
+
+// Content hash of k contiguous tile byte rows (nbytes each, a multiple
+// of 8) for the uplink tile cache: XOR-fold of each 8-byte lane times a
+// per-position splitmix64-derived odd multiplier, then a splitmix64
+// avalanche. Identical values to tilecache.tile_hash_np (tests compare
+// the two); the hash only nominates a pool slot — the cache verifies
+// with a full memcmp before emitting a remap.
+void tile_hash(const uint8_t* data, int k, int nbytes, uint64_t* out) {
+    const int nwords = nbytes / 8;
+    for (int i = 0; i < k; ++i) {
+        const uint8_t* p = data + static_cast<size_t>(i) * nbytes;
+        uint64_t h = 0;
+        for (int w = 0; w < nwords; ++w) {
+            uint64_t word;
+            std::memcpy(&word, p + 8 * w, 8);
+            h ^= word * (splitmix64(static_cast<uint64_t>(w)) | 1ULL);
+        }
+        out[i] = splitmix64(h);
+    }
+}
+
 }  // extern "C"

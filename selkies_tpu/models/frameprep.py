@@ -36,6 +36,30 @@ _lib_tried = False
 BAND_ROWS = 16  # dirty-detection granularity = one MB row
 
 
+def tile_width_for(width: int) -> int:
+    """The delta-tile column width tpuh264enc uses for `width`: the
+    largest power-of-two tile that divides the padded plane (pad_w
+    itself degenerates to full bands). Single definition — the encoder,
+    the trace generators (pipeline/elements.py), and the link-byte
+    profiler all derive geometry from here."""
+    pad_w = (width + 15) // 16 * 16
+    return next((t for t in (128, 64, 32, 16) if pad_w % t == 0), pad_w)
+
+
+def delta_buckets_for(width: int, height: int) -> tuple[int, ...]:
+    """tpuh264enc's delta bucket ladder for a geometry: dirty-tile
+    counts round up to one of these; frames dirtier than the largest
+    bucket take the full-upload path. Single definition (see
+    tile_width_for) so tools/tests sizing content to 'fits the delta
+    path' cannot drift from the encoder."""
+    pad_h = (height + 15) // 16 * 16
+    pad_w = (width + 15) // 16 * 16
+    ntiles = (pad_h // 16) * (pad_w // tile_width_for(width))
+    return tuple(
+        b for b in (8, 16, 32, 64, 128, 256, 512) if b <= ntiles // 2
+    ) or ((ntiles // 2,) if ntiles >= 2 else ())
+
+
 def _load() -> ctypes.CDLL | None:
     global _lib, _lib_tried
     if _lib_tried:
@@ -71,6 +95,9 @@ def _load() -> ctypes.CDLL | None:
         lib.bgrx_to_i420_tiles.restype = None
         lib.bgrx_to_i420_tiles.argtypes = [u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
                                            ctypes.c_int, i32p, ctypes.c_int, u8p, u8p, u8p]
+        lib.tile_hash.restype = None
+        lib.tile_hash.argtypes = [u8p, ctypes.c_int, ctypes.c_int,
+                                  ctypes.POINTER(ctypes.c_uint64)]
     except AttributeError:
         pass  # stale .so without the tile converters; numpy fallback used
     _lib = lib

@@ -143,6 +143,90 @@ def unpack_p_sparse_var(
     )
     skip_bits = ((skip_words[:, None] >> np.arange(32)) & 1).astype(bool).reshape(-1)[:m]
     pairs = np.ascontiguousarray(fused16[base : base + 4 * ns]).view(np.int32)
+    return _finish_sparse_p(pairs, skip_bits, rows, ns, qp, mbh, mbw)
+
+
+def p_sparse_packed_words(mbh: int, mbw: int, nscap: int, cap_rows: int) -> int:
+    """Total int16 length of the bit-packed sparse buffer
+    (encoder_core.pack_p_sparse_packed)."""
+    sw = (mbh * mbw + 31) // 32
+    return 12 + 2 * sw + 4 * nscap + cap_rows + 16 * cap_rows
+
+
+def p_sparse_packed_need(fused16: np.ndarray, mbh: int, mbw: int, nscap: int,
+                         cap_rows: int):
+    """(needed int16 length, n, ns) for a bit-packed sparse buffer, from
+    a slice that covers the 12-word meta. Mirrors p_sparse_var_need:
+    `needed` counts only what the fused buffer HOLDS (rows past cap_rows
+    spill-fetch from the full row buffer, always dense)."""
+    meta = np.ascontiguousarray(fused16[:12]).view(np.int32)
+    n, ns, nw, dense = int(meta[0]), int(meta[3]), int(meta[4]), int(meta[5])
+    sw = (mbh * mbw + 31) // 32
+    held = min(n, cap_rows)
+    rows_words = 16 * held if dense else held + nw
+    return 12 + 2 * sw + 4 * min(ns, nscap) + rows_words, n, ns
+
+
+def _expand_packed_rows(bitmaps: np.ndarray, vals: np.ndarray) -> np.ndarray:
+    """bitmaps (held,) int16 + packed values -> dense rows (held, 16).
+
+    Values for row r start at 4*sum(ceil(popcount/4)) over earlier rows
+    (each row's nonzeros pad to int16 QUADS — int64 lanes on device) and
+    appear in scan-lane order."""
+    bm = bitmaps.astype(np.int32) & 0xFFFF
+    bits = ((bm[:, None] >> np.arange(16)) & 1).astype(bool)
+    counts = bits.sum(-1)
+    width = 4 * ((counts + 3) // 4)
+    off = np.cumsum(width) - width
+    rows = np.zeros((len(bm), 16), np.int16)
+    rr, cc = np.nonzero(bits)
+    if len(rr):
+        rank = (np.cumsum(bits, axis=1) - 1)[rr, cc]
+        rows[rr, cc] = vals[off[rr] + rank]
+    return rows
+
+
+def unpack_p_sparse_packed(
+    fused16: np.ndarray, qp: int, mbh: int, mbw: int, nscap: int,
+    cap_rows: int, extra_rows: np.ndarray | None = None,
+):
+    """Bit-packed sparse buffer (encoder_core.pack_p_sparse_packed) ->
+    (PFrameCoeffs | None, rows) with the same contract as
+    unpack_p_sparse_var: None means ns > nscap (dense-header fallback),
+    `rows` is returned either way, extra_rows covers a cap_rows spill."""
+    m = mbh * mbw
+    sw = (m + 31) // 32
+    need, n, ns = p_sparse_packed_need(fused16, mbh, mbw, nscap, cap_rows)
+    if len(fused16) < need:
+        raise ValueError(f"slice has {len(fused16)} int16, need {need}")
+    meta = np.ascontiguousarray(fused16[:12]).view(np.int32)
+    nw, dense_flag = int(meta[4]), int(meta[5])
+    base = 12 + 2 * sw
+    rows_off = base + 4 * min(ns, nscap)
+    held = min(n, cap_rows)
+    if dense_flag:
+        rows = fused16[rows_off : rows_off + 16 * held].reshape(held, 16)
+    else:
+        bitmaps = fused16[rows_off : rows_off + held]
+        vals = fused16[rows_off + held : rows_off + held + nw]
+        rows = _expand_packed_rows(bitmaps, vals)
+    if n > held:
+        rows = np.concatenate([rows, extra_rows[: n - held]])
+    if ns > nscap:
+        return None, rows
+    skip_words = (
+        np.ascontiguousarray(fused16[12 : 12 + 2 * sw]).view(np.int32).astype(np.int64)
+        & 0xFFFFFFFF
+    )
+    skip_bits = ((skip_words[:, None] >> np.arange(32)) & 1).astype(bool).reshape(-1)[:m]
+    pairs = np.ascontiguousarray(fused16[base : base + 4 * ns]).view(np.int32)
+    return _finish_sparse_p(pairs, skip_bits, rows, ns, qp, mbh, mbw)
+
+
+def _finish_sparse_p(pairs, skip_bits, rows, ns, qp, mbh, mbw):
+    """Shared tail of the sparse-P unpackers: (mv, info) pairs + skip
+    bitmap + dense-scattered rows -> PFrameCoeffs."""
+    m = mbh * mbw
     mv_c, info_c = pairs[0::2], pairs[1::2]
     pos = np.flatnonzero(~skip_bits)
     if len(pos) != ns:

@@ -17,6 +17,7 @@ blocks only on the coefficient device→host copy.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -30,18 +31,21 @@ import numpy as np
 
 from selkies_tpu.models.h264.numpy_ref import PFrameCoeffs
 
-from selkies_tpu.models.frameprep import FramePrep
+from selkies_tpu.models.frameprep import FramePrep, delta_buckets_for, tile_width_for
 from selkies_tpu.monitoring.tracing import tracer
 from selkies_tpu.models.stats import FrameStats as _FrameStats
 from selkies_tpu.models.h264.bitstream import StreamParams, write_pps, write_sps
 from selkies_tpu.models.h264.compact import (
     i_header_words,
     p_header_words,
+    p_sparse_packed_need,
+    p_sparse_packed_words,
     p_sparse_var_need,
     p_sparse_var_words,
     split_prefix,
     unpack_i_compact,
     unpack_p_compact,
+    unpack_p_sparse_packed,
     unpack_p_sparse_var,
 )
 from selkies_tpu.models.h264.device_cavlc import (
@@ -55,9 +59,12 @@ from selkies_tpu.models.h264.encoder_core import (
     fuse_downlink,
     pack_i_compact,
     pack_p_compact,
+    pack_p_sparse_packed,
     pack_p_sparse_var,
     scatter_tiles,
 )
+from selkies_tpu.models.stats import LinkByteCounter
+from selkies_tpu.models.tilecache import TileCache
 from selkies_tpu.models.h264.native import pack_slice_fast, pack_slice_p_fast
 from selkies_tpu.ops.colorspace import bgrx_to_i420, rgb_to_i420
 
@@ -186,11 +193,21 @@ def _unpack_delta(packed, w):
     return yb, ub, vb, idx
 
 
-def _p_scatter_step(packed, qp, sy, su, sv, ref_y, ref_u, ref_v, *, nscap, cap, tile_w):
+def _pack_sparse_p(out, nscap, cap, density):
+    """Delta-P downlink packer: density=None keeps the 16-lane row
+    layout (pack_p_sparse_var); an int percent enables the bit-packed
+    rows with that dense-fallback cap (pack_p_sparse_packed)."""
+    if density is None:
+        return pack_p_sparse_var(out, nscap, cap)
+    return pack_p_sparse_packed(out, nscap, cap, density)
+
+
+def _p_scatter_step(packed, qp, sy, su, sv, ref_y, ref_u, ref_v, *, nscap, cap, tile_w,
+                    density=None):
     yb, ub, vb, idx = _unpack_delta(packed, tile_w)
     y, u, v = scatter_tiles(sy, su, sv, yb, ub, vb, idx, tile_w)
     out = encode_frame_p_planes(y, u, v, ref_y, ref_u, ref_v, qp)
-    prefix, dense, buf = pack_p_sparse_var(out, nscap, cap)
+    prefix, dense, buf = _pack_sparse_p(out, nscap, cap, density)
     return prefix, dense, buf, out["recon_y"], out["recon_u"], out["recon_v"], y, u, v
 
 
@@ -204,7 +221,7 @@ def _i_scatter_step(packed, qp, sy, su, sv, *, tile_w):
 
 
 def _p_scatter_multi_step(packed_a, packed_b, qps, sy, su, sv, ref_y, ref_u, ref_v,
-                          *, nscap, cap, tile_w):
+                          *, nscap, cap, tile_w, density=None):
     """K delta frames in ONE device round trip.
 
     packed_a/packed_b: two (K/2, F) uint8 halves of the K frames' tile
@@ -223,7 +240,7 @@ def _p_scatter_multi_step(packed_a, packed_b, qps, sy, su, sv, ref_y, ref_u, ref
         yb, ub, vb, idx = _unpack_delta(pk, tile_w)
         y, u, v = scatter_tiles(cy, cu, cv, yb, ub, vb, idx, tile_w)
         out = encode_frame_p_planes(y, u, v, ry, ru, rv, qp)
-        prefix, dense, buf = pack_p_sparse_var(out, nscap, cap)
+        prefix, dense, buf = _pack_sparse_p(out, nscap, cap, density)
         return (
             (y, u, v, out["recon_y"], out["recon_u"], out["recon_v"]),
             (prefix, dense, buf),
@@ -243,6 +260,169 @@ def _i_resident_step(qp, sy, su, sv):
     header, buf = pack_i_compact(out)
     prefix = fuse_downlink(header, buf, CAP_ROWS)
     return prefix, buf, out["recon_y"], out["recon_u"], out["recon_v"]
+
+
+# Tile-cache delta steps (the CopyRect analogue, models/tilecache.py):
+# the packed upload carries [upload idx (bucket int32, -1 pads)] ++
+# [pool slot each upload is kept in (bucket int32, scratch = last pool
+# row)] ++ [(src_slot, dst_idx) copy pairs (cbucket x 2 int32, src=-1
+# pads)] ++ the uploaded pixel tiles. Copy pairs remap tiles already
+# resident in the device slot pool into their new positions WITHOUT any
+# pixel upload — a scrolled or window-moved tile costs 8 bytes instead
+# of ~3 KB. bucket/cbucket are static (one executable per combination,
+# same ladder discipline as the delta buckets); padding entries write a
+# tile's own current content back (reading it first), which keeps every
+# lane shape static without a device-side branch.
+
+
+def _unpack_delta2(packed, w, bucket, cbucket):
+    k = bucket
+    up_idx = jax.lax.bitcast_convert_type(packed[: 4 * k].reshape(k, 4), jnp.int32)
+    off = 4 * k
+    pool_dst = jax.lax.bitcast_convert_type(
+        packed[off : off + 4 * k].reshape(k, 4), jnp.int32
+    )
+    off += 4 * k
+    pairs = jax.lax.bitcast_convert_type(
+        packed[off : off + 8 * cbucket].reshape(2 * cbucket, 4), jnp.int32
+    ).reshape(cbucket, 2)
+    off += 8 * cbucket
+    yb = packed[off : off + k * 16 * w].reshape(k, 16, w)
+    off += k * 16 * w
+    ub = packed[off : off + k * 8 * (w // 2)].reshape(k, 8, w // 2)
+    off += k * 8 * (w // 2)
+    vb = packed[off : off + k * 8 * (w // 2)].reshape(k, 8, w // 2)
+    return up_idx, pool_dst, pairs, yb, ub, vb
+
+
+def _apply_tiles2(sy, su, sv, py, pu, pv, packed, *, tile_w, bucket, cbucket):
+    """Copy remaps (pool -> planes), then pixel uploads (-> planes AND
+    their pool slots). Copies run first so a same-step upload can land
+    on a position a remap also wrote (the upload is the newer content);
+    the host never emits a remap from a slot inserted in the same call."""
+    up_idx, pool_dst, pairs, yb, ub, vb = _unpack_delta2(packed, tile_w, bucket, cbucket)
+    ctw = tile_w // 2
+
+    def copy_body(i, planes):
+        y, u, v = planes
+        valid = pairs[i, 0] >= 0
+        slot = jnp.maximum(pairs[i, 0], 0)
+        d = jnp.maximum(pairs[i, 1], 0)
+        band, tile = d // 1024, d % 1024
+        ty = jax.lax.dynamic_slice(py, (slot, 0, 0), (1, 16, tile_w))[0]
+        tu = jax.lax.dynamic_slice(pu, (slot, 0, 0), (1, 8, ctw))[0]
+        tv = jax.lax.dynamic_slice(pv, (slot, 0, 0), (1, 8, ctw))[0]
+        cy = jax.lax.dynamic_slice(y, (band * 16, tile * tile_w), (16, tile_w))
+        cu = jax.lax.dynamic_slice(u, (band * 8, tile * ctw), (8, ctw))
+        cv = jax.lax.dynamic_slice(v, (band * 8, tile * ctw), (8, ctw))
+        y = jax.lax.dynamic_update_slice(
+            y, jnp.where(valid, ty, cy), (band * 16, tile * tile_w))
+        u = jax.lax.dynamic_update_slice(
+            u, jnp.where(valid, tu, cu), (band * 8, tile * ctw))
+        v = jax.lax.dynamic_update_slice(
+            v, jnp.where(valid, tv, cv), (band * 8, tile * ctw))
+        return y, u, v
+
+    if cbucket:
+        sy, su, sv = jax.lax.fori_loop(0, cbucket, copy_body, (sy, su, sv))
+    if not bucket:  # pure-remap frame (scroll/window steady state)
+        return sy, su, sv, py, pu, pv
+
+    def up_body(i, state):
+        y, u, v, qy, qu, qv = state
+        valid = up_idx[i] >= 0
+        d = jnp.maximum(up_idx[i], 0)
+        band, tile = d // 1024, d % 1024
+        cy = jax.lax.dynamic_slice(y, (band * 16, tile * tile_w), (16, tile_w))
+        cu = jax.lax.dynamic_slice(u, (band * 8, tile * ctw), (8, ctw))
+        cv = jax.lax.dynamic_slice(v, (band * 8, tile * ctw), (8, ctw))
+        y = jax.lax.dynamic_update_slice(
+            y, jnp.where(valid, yb[i], cy), (band * 16, tile * tile_w))
+        u = jax.lax.dynamic_update_slice(
+            u, jnp.where(valid, ub[i], cu), (band * 8, tile * ctw))
+        v = jax.lax.dynamic_update_slice(
+            v, jnp.where(valid, vb[i], cv), (band * 8, tile * ctw))
+        # keep the uploaded tile in its assigned pool slot (padding and
+        # not-kept uploads target the scratch row)
+        qy = jax.lax.dynamic_update_slice(qy, yb[i][None], (pool_dst[i], 0, 0))
+        qu = jax.lax.dynamic_update_slice(qu, ub[i][None], (pool_dst[i], 0, 0))
+        qv = jax.lax.dynamic_update_slice(qv, vb[i][None], (pool_dst[i], 0, 0))
+        return y, u, v, qy, qu, qv
+
+    return jax.lax.fori_loop(0, bucket, up_body, (sy, su, sv, py, pu, pv))
+
+
+def _pool_seed_step(pairs, sy, su, sv, py, pu, pv, *, tile_w, sbucket):
+    """Seed pool slots by GATHERING tiles from the resident source
+    planes — no pixel upload at all (only the (slot, idx) list crosses).
+    Runs after a full-frame upload whose dirty set was over the delta
+    budget: the next frame of a sustained scroll then remaps instead of
+    aborting to another full upload. pairs: (sbucket, 2) int32
+    (slot, dst_idx); padding rows target the scratch slot."""
+    ctw = tile_w // 2
+
+    def body(i, pool):
+        qy, qu, qv = pool
+        slot = pairs[i, 0]
+        d = jnp.maximum(pairs[i, 1], 0)
+        band, tile = d // 1024, d % 1024
+        ty = jax.lax.dynamic_slice(sy, (band * 16, tile * tile_w), (16, tile_w))
+        tu = jax.lax.dynamic_slice(su, (band * 8, tile * ctw), (8, ctw))
+        tv = jax.lax.dynamic_slice(sv, (band * 8, tile * ctw), (8, ctw))
+        qy = jax.lax.dynamic_update_slice(qy, ty[None], (slot, 0, 0))
+        qu = jax.lax.dynamic_update_slice(qu, tu[None], (slot, 0, 0))
+        qv = jax.lax.dynamic_update_slice(qv, tv[None], (slot, 0, 0))
+        return qy, qu, qv
+
+    return jax.lax.fori_loop(0, sbucket, body, (py, pu, pv))
+
+
+def _p_scatter_step2(packed, qp, sy, su, sv, py, pu, pv, ref_y, ref_u, ref_v,
+                     *, nscap, cap, tile_w, bucket, cbucket, density):
+    y, u, v, qy, qu, qv = _apply_tiles2(
+        sy, su, sv, py, pu, pv, packed, tile_w=tile_w, bucket=bucket, cbucket=cbucket)
+    out = encode_frame_p_planes(y, u, v, ref_y, ref_u, ref_v, qp)
+    prefix, dense, buf = _pack_sparse_p(out, nscap, cap, density)
+    return (prefix, dense, buf, out["recon_y"], out["recon_u"], out["recon_v"],
+            y, u, v, qy, qu, qv)
+
+
+def _i_scatter_step2(packed, qp, sy, su, sv, py, pu, pv, *, tile_w, bucket, cbucket):
+    y, u, v, qy, qu, qv = _apply_tiles2(
+        sy, su, sv, py, pu, pv, packed, tile_w=tile_w, bucket=bucket, cbucket=cbucket)
+    out = encode_frame_planes(y, u, v, qp)
+    header, buf = pack_i_compact(out)
+    prefix = fuse_downlink(header, buf, CAP_ROWS)
+    return (prefix, buf, out["recon_y"], out["recon_u"], out["recon_v"],
+            y, u, v, qy, qu, qv)
+
+
+def _p_scatter_multi_step2(packed_a, packed_b, qps, sy, su, sv, py, pu, pv,
+                           ref_y, ref_u, ref_v,
+                           *, nscap, cap, tile_w, bucket, cbucket, density):
+    """Grouped (lax.scan) variant of _p_scatter_step2: the slot pool
+    rides in the carry, so frame k's copy remaps may reference slots
+    frame k-1's uploads inserted — matching the host cache's sequential
+    split() order exactly."""
+    packed = jnp.concatenate([packed_a, packed_b], 0)
+
+    def body(carry, xs):
+        pk, qp = xs
+        cy, cu, cv, qy, qu, qv, ry, ru, rv = carry
+        y, u, v, qy, qu, qv = _apply_tiles2(
+            cy, cu, cv, qy, qu, qv, pk, tile_w=tile_w, bucket=bucket, cbucket=cbucket)
+        out = encode_frame_p_planes(y, u, v, ry, ru, rv, qp)
+        prefix, dense, buf = _pack_sparse_p(out, nscap, cap, density)
+        return (
+            (y, u, v, qy, qu, qv, out["recon_y"], out["recon_u"], out["recon_v"]),
+            (prefix, dense, buf),
+        )
+
+    carry, (prefixes, denses, bufs) = jax.lax.scan(
+        body, (sy, su, sv, py, pu, pv, ref_y, ref_u, ref_v), (packed, qps)
+    )
+    y, u, v, qy, qu, qv, ry, ru, rv = carry
+    return prefixes, denses, bufs, ry, ru, rv, y, u, v, qy, qu, qv
 
 
 def _fetch_rest(buf, n: int, base: int = CAP_ROWS) -> np.ndarray:
@@ -316,12 +496,32 @@ class TPUH264Encoder:
         scene_qp_boost: int = 0,
         device_entropy: bool = True,
         ltr_scenes: bool = True,
+        tile_cache: int | None = None,
+        packed_downlink: bool | None = None,
+        pack_density: int | None = None,
     ):
         self.width = width
         self.height = height
         self.fps = fps
         self._nscap = NSCAP
         self._cap_delta = CAP_ROWS_DELTA
+        # packed delta downlink: coefficient rows cross the link as a
+        # significance bitmap + nonzeros (encoder_core.pack_p_sparse_
+        # packed) — 3-6x fewer live bytes on desktop residuals, falling
+        # back to dense rows above the density cap. SELKIES_PACK_DENSITY
+        # overrides: "0" disables, an integer sets the cap percent.
+        # env is the DEFAULT only: explicit constructor arguments win
+        # (same precedence as the tile_cache knob); a malformed env
+        # value falls back rather than failing construction
+        dens_env = os.environ.get("SELKIES_PACK_DENSITY", "")
+        if packed_downlink is None:
+            packed_downlink = dens_env != "0"
+        if pack_density is None:
+            try:
+                pack_density = int(dens_env) if dens_env not in ("", "0") else 75
+            except ValueError:
+                pack_density = 75
+        self._density = int(pack_density) if packed_downlink else None
         self.set_qp(qp)
         self.channels = channels
         self.keyframe_interval = int(keyframe_interval)  # 0 = infinite GOP
@@ -339,9 +539,7 @@ class TPUH264Encoder:
         # blink is one ~3 KB tile, not a ~46 KB full-width band); the
         # largest power-of-two width that divides pad_w keeps device
         # shapes static (pad_w itself => full bands, the old behavior).
-        self._tile_w = next(
-            (t for t in (128, 64, 32, 16) if self._pad_w % t == 0), self._pad_w
-        )
+        self._tile_w = tile_width_for(width)
         self._prep: FramePrep | None = None
         if host_convert and channels == 4:
             # one conversion slot per possibly-in-flight async upload plus
@@ -361,7 +559,8 @@ class TPUH264Encoder:
             # inside the traced body): jax's trace cache is keyed on the
             # function object, so a global read would leak one encoder's
             # constants into another's executable.
-            _consts = dict(nscap=self._nscap, cap=self._cap_delta, tile_w=self._tile_w)
+            _consts = dict(nscap=self._nscap, cap=self._cap_delta, tile_w=self._tile_w,
+                           density=self._density)
             self._step_scatter_p = jax.jit(
                 partial(_p_scatter_step, **_consts), donate_argnums=(2, 3, 4, 5, 6, 7)
             )
@@ -419,20 +618,55 @@ class TPUH264Encoder:
             sorted({self.frame_batch, max(2, self.frame_batch // 2)}, reverse=True)
         ) if self.frame_batch > 1 else ()
         self._batch_pend: list = []  # (rec, yb, ub, vb, idx) to group-dispatch
-        nbands = self._pad_h // 16
         ntx = self._pad_w // self._tile_w
-        ntiles = nbands * ntx
         # delta bucket sizes: dirty-tile counts round up to one of these so
         # each resolution compiles a handful of scatter executables; frames
         # dirtier than the largest bucket use the full-upload path (the
         # delta would save little and each bucket costs a compile)
-        self._delta_buckets = tuple(
-            b for b in (8, 16, 32, 64, 128, 256, 512) if b <= ntiles // 2
-        ) or ((ntiles // 2,) if ntiles >= 2 else ())
+        self._delta_buckets = delta_buckets_for(width, height)
         # grouped-dispatch buckets: small sparse-update group, then the
         # area equivalents of the old 4- and 16-band group limits
         self.BATCH_BUCKETS = tuple(sorted({16, 4 * ntx, 16 * ntx} | (
             {self._delta_buckets[0]} if self._delta_buckets else set())))
+        # uplink tile cache (CopyRect remaps, models/tilecache.py): dirty
+        # tiles whose content already sits in the device slot pool become
+        # 8-byte (slot -> position) remaps instead of pixel uploads.
+        # SELKIES_TILE_CACHE sets the slot count ("0" disables; on
+        # PCIe-local hosts the hash/memcmp cost can exceed the cheap
+        # upload it saves — see docs/link_bytes.md).
+        if tile_cache is None:
+            tile_cache = int(os.environ.get("SELKIES_TILE_CACHE", "1024") or "0")
+        self.tile_cache_slots = (
+            int(tile_cache)
+            if (self._prep is not None and self._delta_buckets) else 0
+        )
+        self._tcache = (
+            TileCache(height, width, self._tile_w, self.tile_cache_slots)
+            if self.tile_cache_slots > 0 else None
+        )
+        self._pool_d = None  # device slot-pool planes, allocated lazily
+        # copy-pair bucket ladder: tiny (typing: no remaps) or full-delta
+        # (scroll: most dirty tiles are remaps); every (bucket, cbucket)
+        # combination is one compiled executable, so the ladder stays at 2.
+        # Upload buckets gain a 0 rung: a pure-remap frame (scroll/window
+        # steady state) ships ONLY the pair list — no pixel payload at all
+        # over-budget delta attempts (maximized-window scrolls): dirty
+        # counts up to 4x the delta cap still try the cache — remaps
+        # don't upload pixels, so such frames usually fit after the
+        # split; the bound keeps full-frame video off the hashing path
+        ntiles_all = (self._pad_h // 16) * ntx
+        self._tc_try_cap = (
+            min(4 * self._delta_buckets[-1], ntiles_all) if self._delta_buckets else 0
+        )
+        self._copy_buckets = (
+            tuple(sorted({16, self._delta_buckets[-1], self._tc_try_cap}))
+            if self._delta_buckets else ()
+        )
+        self._up_buckets = (0,) + self._delta_buckets
+        self._up_batch_buckets = (0,) + self.BATCH_BUCKETS
+        self._step2_cache: dict = {}
+        self._ltr_probe: object = ()  # per-frame memo, see _classify
+        self.link_bytes = LinkByteCounter()
         self._prev_frame: np.ndarray | None = None  # device-convert mode only
         self._inflight: deque = deque()
         self._pool = ThreadPoolExecutor(
@@ -447,9 +681,19 @@ class TPUH264Encoder:
         self._hdr_words_p = p_header_words(mbh, mbw)
         self._mbh, self._mbw = mbh, mbw
         # adaptive delta-downlink fetch: full var-buffer length and the
-        # live slice hint (int16 words), grown/shrunk from recent frames
-        self._pfx_total = p_sparse_var_words(mbh, mbw, self._nscap, self._cap_delta)
-        self._pfx_hint = min(self.PFX_SMALL, self._pfx_total)
+        # live slice hint (int16 words), grown/shrunk from recent frames.
+        # The packed layout shrinks the live content 2-6x, which keeps
+        # busy frames UNDER the small-fetch threshold where the 16-lane
+        # layout escalates to a full-buffer fetch (measured on the bench
+        # trace: typing needs 17.6k -> 8.9k words, i.e. a 164 KB full
+        # fetch becomes the 32 KB small one). Still exactly TWO fetch
+        # shapes (see PFX_SMALL).
+        if self._density is not None:
+            self._pfx_total = p_sparse_packed_words(mbh, mbw, self._nscap, self._cap_delta)
+        else:
+            self._pfx_total = p_sparse_var_words(mbh, mbw, self._nscap, self._cap_delta)
+        self._pfx_small = self.PFX_SMALL
+        self._pfx_hint = min(self._pfx_small, self._pfx_total)
         self._pfx_recent: deque = deque(maxlen=8)
         # appended by completion workers and the submit thread; iterating
         # a deque during a concurrent append raises RuntimeError
@@ -509,17 +753,32 @@ class TPUH264Encoder:
     # -- frame classification (static / delta / full upload) -----------
 
     def _classify(self, frame: np.ndarray):
-        """-> ("static" | "delta" | "full", dirty_tile_indices | None).
+        """-> ("static" | "delta" | "full", payload).
 
         Compares against the previous capture (FramePrep's per-tile
         memcmp when host conversion is on; tiles are 16 rows x _tile_w
         cols). "static": byte-identical — the dominant remote-desktop
         case, zero device work. "delta": few dirty tiles and the device
-        holds resident source planes — upload only the changed tiles
-        (idx encodes band*1024 + tile). "full": everything else. The
-        previous-frame state advances on every call; that is safe because
-        any encode failure nulls self._ref/_src, forcing a full-upload
-        IDR that bypasses the static and delta paths."""
+        holds resident source planes — upload only the changed tiles.
+        "full": everything else. The previous-frame state advances on
+        every call; that is safe because any encode failure nulls
+        self._ref/_src, forcing a full-upload IDR that bypasses the
+        static and delta paths.
+
+        Sets _ltr_probe when the over-budget branch already ran the
+        (expensive) scene-cache match, so submit() reuses it instead of
+        recomputing; () means "not computed this frame".
+
+        payload: dirty indices (band*1024 + tile, int32) without the
+        tile cache, or the cache's (up_idx, pool_dst, pairs) split with
+        it. With the cache the dirty-count gate moves to the POST-REMAP
+        upload count: a maximized-window scroll dirties far more tiles
+        than the delta buckets hold, but nearly all of them are
+        pool-resident, so the frame still fits after remapping (split
+        aborts without state change when it doesn't — the big win the
+        cache exists for). The attempt is bounded at _tc_try_cap dirty
+        tiles so sustained full-frame video skips the hashing."""
+        self._ltr_probe = ()
         if self._prep is None:
             if self._prev_frame is None or self._prev_frame.shape != frame.shape:
                 self._prev_frame = frame.copy()
@@ -536,10 +795,38 @@ class TPUH264Encoder:
         if self._src is None or not self._delta_buckets:
             return "full", None
         band_i, tile_i = np.nonzero(tiles)
-        if len(band_i) > self._delta_buckets[-1]:
+        cap = self._delta_buckets[-1]
+        if len(band_i) > (self._tc_try_cap if self._tcache is not None else cap):
             return "full", None
         idx = (band_i * 1024 + tile_i).astype(np.int32)
-        return "delta", idx
+        if self._tcache is None:
+            return "delta", idx
+        if len(band_i) > cap:
+            if self.ltr_scenes:
+                # a remembered scene covering this frame: the LTR
+                # restore emits ~50x fewer slice bits than a remap-delta
+                # predicting from the previous (entirely different)
+                # frame — let submit()'s scene-cache path take it. The
+                # probe is memoized for submit (it is a full per-tile
+                # compare).
+                self._ltr_probe = self._ltr_match(frame)
+                if self._ltr_probe is not None:
+                    return "full", None
+            # sampled membership probe: scrolled content is pool-
+            # resident after its seed frame, video content never is —
+            # skip the full hash/split attempt when it cannot pay
+            # (sustained motion then costs ~8 tile hashes per frame,
+            # and the seed hook is additionally bounded by _full_run)
+            if self._tcache.probe(frame, idx) < 0.5:
+                return "full", ("seed", idx)
+        payload = self._tcache.split(frame, idx, max_up=cap)
+        if payload is None:
+            # too many genuinely-new tiles: full upload — but remember
+            # the dirty set so submit() can seed the pool from the
+            # freshly-resident planes (a sustained over-budget scroll
+            # then fits from its second frame on)
+            return "full", ("seed", idx)
+        return "delta", payload
 
     def _allskip_slice(self, frame_num: int, mark_ltr: int | None = None,
                        mmco_evict: tuple = ()) -> bytes:
@@ -571,6 +858,7 @@ class TPUH264Encoder:
         parts = [y[i * rows : (i + 1) * rows] if i < Y_CHUNKS - 1
                  else y[(Y_CHUNKS - 1) * rows :] for i in range(Y_CHUNKS)]
         parts += [u, v]
+        self.link_bytes.add("up_full", sum(p.nbytes for p in parts))
         return list(self._upload_pool.map(jax.device_put, parts))
 
     def _run_step_i(self, frame: np.ndarray):
@@ -581,6 +869,7 @@ class TPUH264Encoder:
             # for the next frame (the I step does not donate them)
             self._src = (y, u, v)
             return out
+        self.link_bytes.add("up_full", frame.nbytes)
         return self._step(jax.device_put(frame), np.int32(self.qp))
 
     def _run_step_p(self, frame: np.ndarray):
@@ -596,6 +885,7 @@ class TPUH264Encoder:
             self._src = (out[5], out[6], out[7])
             # (kind, prefix, words, hdr, buf, recon_y, recon_u, recon_v)
             return ("p", out[0], None, None, out[1], out[2], out[3], out[4])
+        self.link_bytes.add("up_full", frame.nbytes)
         out = self._step_p(jax.device_put(frame), np.int32(self.qp), *self._ref)
         return ("p", out[0], None, None, out[1], out[2], out[3], out[4])
 
@@ -614,13 +904,140 @@ class TPUH264Encoder:
             idx = np.concatenate([idx, np.full(reps, idx[-1], np.int32)])
         return np.concatenate([idx.view(np.uint8), yb.ravel(), ub.ravel(), vb.ravel()])
 
-    def _run_step_delta(self, frame: np.ndarray, idx: np.ndarray, idr: bool):
-        """Single-frame delta: upload only the dirty tiles; scatter+encode
-        on device. Returns (prefix_d, hdr_d, buf_d, recon triple)."""
+    # -- tile cache (CopyRect remaps) -----------------------------------
+
+    def _get_pool(self):
+        """Device tile slot pool (slots + 1 rows; last row is scratch)."""
+        if self._pool_d is None:
+            s, tw = self.tile_cache_slots, self._tile_w
+            self._pool_d = (
+                jnp.zeros((s + 1, 16, tw), jnp.uint8),
+                jnp.zeros((s + 1, 8, tw // 2), jnp.uint8),
+                jnp.zeros((s + 1, 8, tw // 2), jnp.uint8),
+            )
+        return self._pool_d
+
+    def _reset_tile_cache(self) -> None:
+        """Host index and device pool must drop together: after a failed
+        or abandoned dispatch the pool contents are unknowable, and a
+        stale host entry would remap garbage pixels."""
+        if self._tcache is not None:
+            self._tcache.reset()
+        self._pool_d = None
+
+    def _get_step2(self, kind: str, bucket: int, cbucket: int):
+        """Compiled tile-cache scatter step for one (bucket, cbucket)
+        combination. kinds: "p"/"i" donate src+pool(+refs); "ltr" donates
+        only the pool (the stash planes must survive); "pk" is the
+        grouped scan."""
+        key = (kind, bucket, cbucket)
+        fn = self._step2_cache.get(key)
+        if fn is None:
+            consts = dict(tile_w=self._tile_w, bucket=bucket, cbucket=cbucket)
+            pconsts = dict(nscap=self._nscap, cap=self._cap_delta,
+                           density=self._density, **consts)
+            if kind == "p":
+                fn = jax.jit(partial(_p_scatter_step2, **pconsts),
+                             donate_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 10))
+            elif kind == "ltr":
+                fn = jax.jit(partial(_p_scatter_step2, **pconsts),
+                             donate_argnums=(5, 6, 7))
+            elif kind == "i":
+                fn = jax.jit(partial(_i_scatter_step2, **consts),
+                             donate_argnums=(2, 3, 4, 5, 6, 7))
+            elif kind == "seed":
+                # gathers from the resident planes (NOT donated — they
+                # are the next frame's delta base); pool donated
+                fn = jax.jit(
+                    partial(_pool_seed_step, tile_w=self._tile_w, sbucket=cbucket),
+                    donate_argnums=(4, 5, 6))
+            else:  # "pk": grouped scan
+                fn = jax.jit(partial(_p_scatter_multi_step2, **pconsts),
+                             donate_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11))
+            self._step2_cache[key] = fn
+        return fn
+
+    def _seed_pool(self, frame: np.ndarray, idx: np.ndarray) -> None:
+        """After an over-budget full upload: commit the dirty tiles to
+        the host cache and fill their pool slots device-side by
+        gathering from the freshly-resident source planes — only the
+        (slot, idx) list crosses the link."""
+        up_idx, pool_dst, _pairs = self._tcache.split(frame, idx)
+        if not len(up_idx):
+            return
+        sbucket = next(cb for cb in self._copy_buckets if cb >= len(up_idx))
+        pr = np.zeros((sbucket, 2), np.int32)
+        pr[:, 0] = self.tile_cache_slots  # scratch padding
+        pr[: len(up_idx), 0] = pool_dst
+        pr[: len(up_idx), 1] = up_idx
+        self.link_bytes.add("up_seed", pr.nbytes)
+        pool2 = self._get_step2("seed", 0, sbucket)(
+            jax.device_put(pr), *self._src, *self._get_pool())
+        self._pool_d = tuple(pool2)
+
+    def _pack_tiles2(self, yb, ub, vb, up_idx, pool_dst, pairs,
+                     bucket: int, cbucket: int) -> np.ndarray:
+        """Tile-cache upload buffer (see _unpack_delta2): uploads pad
+        with idx -1 (identity writes) targeting the scratch pool row;
+        copy pairs pad with src -1."""
+        tw = self._tile_w
+        k = len(up_idx)
+        pad = bucket - k
+        idxp = np.concatenate([up_idx, np.full(pad, -1, np.int32)])
+        dstp = np.concatenate([pool_dst, np.full(pad, self.tile_cache_slots, np.int32)])
+        if pad:
+            zy = np.zeros((pad, 16, tw), np.uint8)
+            zc = np.zeros((pad, 8, tw // 2), np.uint8)
+            yb = np.concatenate([yb, zy]) if k else zy
+            ub = np.concatenate([ub, zc]) if k else zc
+            vb = np.concatenate([vb, zc]) if k else zc
+        pr = np.full((cbucket, 2), -1, np.int32)
+        pr[:, 1] = 0
+        if len(pairs):
+            pr[: len(pairs)] = pairs
+        return np.concatenate([
+            idxp.view(np.uint8), dstp.view(np.uint8), pr.reshape(-1).view(np.uint8),
+            yb.ravel(), ub.ravel(), vb.ravel(),
+        ])
+
+    def _pack_payload2(self, frame: np.ndarray, payload):
+        """Cache split result -> (packed, bucket, cbucket): convert the
+        upload tiles and build the packed buffer (split itself already
+        ran — in _classify for delta frames, or at the call site for
+        LTR restores)."""
+        up_idx, pool_dst, pairs = payload
+        bucket = next(b for b in self._up_buckets if b >= len(up_idx))
+        cbucket = next(cb for cb in self._copy_buckets if cb >= len(pairs))
+        yb, ub, vb = self._prep.convert_tiles(frame, up_idx, self._tile_w)
+        packed = self._pack_tiles2(yb, ub, vb, up_idx, pool_dst, pairs, bucket, cbucket)
+        return packed, bucket, cbucket
+
+    def _run_step_delta(self, frame: np.ndarray, idx, idr: bool):
+        """Single-frame delta: upload only the dirty tiles (remapping
+        cache-resident ones); scatter+encode on device. `idx` is the
+        _classify payload: dirty indices, or the cache's split triple.
+        Returns (prefix_d, hdr_d, buf_d, recon triple)."""
+        qp = np.int32(self.qp)
+        if self._tcache is not None:
+            packed, bucket, cbucket = self._pack_payload2(frame, idx)
+            self.link_bytes.add("up_delta", packed.nbytes)
+            packed_d = jax.device_put(packed)
+            pool = self._get_pool()
+            if idr:
+                prefix_d, buf_d, ry, ru, rv, sy, su, sv, *pool2 = self._get_step2(
+                    "i", bucket, cbucket)(packed_d, qp, *self._src, *pool)
+                hdr_d = None
+            else:
+                prefix_d, hdr_d, buf_d, ry, ru, rv, sy, su, sv, *pool2 = self._get_step2(
+                    "p", bucket, cbucket)(packed_d, qp, *self._src, *pool, *self._ref)
+            self._pool_d = tuple(pool2)
+            self._src = (sy, su, sv)
+            return prefix_d, hdr_d, buf_d, ry, ru, rv
         bucket = next(b for b in self._delta_buckets if b >= len(idx))
         yb, ub, vb = self._prep.convert_tiles(frame, idx, self._tile_w)
-        packed_d = jax.device_put(self._pack_tiles(yb, ub, vb, idx, bucket))
-        qp = np.int32(self.qp)
+        packed = self._pack_tiles(yb, ub, vb, idx, bucket)
+        self.link_bytes.add("up_delta", packed.nbytes)
+        packed_d = jax.device_put(packed)
         if idr:
             prefix_d, buf_d, ry, ru, rv, sy, su, sv = self._step_scatter_i(
                 packed_d, qp, *self._src
@@ -686,10 +1103,26 @@ class TPUH264Encoder:
     def _run_step_ltr(self, frame: np.ndarray, idx: np.ndarray, stash: dict):
         """Scene restore: scatter the (few) tiles that differ from the
         remembered scene into a fresh copy of its source planes and
-        encode against its recon — the stash planes survive untouched."""
+        encode against its recon — the stash planes survive untouched.
+        Restore tiles hit the tile cache like any delta (the content a
+        window switch re-exposes is often pool-resident)."""
+        if self._tcache is not None:
+            packed, bucket, cbucket = self._pack_payload2(
+                frame, self._tcache.split(frame, idx))
+            self.link_bytes.add("up_ltr", packed.nbytes)
+            packed_d = jax.device_put(packed)
+            prefix_d, hdr_d, buf_d, ry, ru, rv, sy, su, sv, *pool2 = self._get_step2(
+                "ltr", bucket, cbucket)(
+                    packed_d, np.int32(self.qp), *stash["src"], *self._get_pool(),
+                    *stash["ref"])
+            self._pool_d = tuple(pool2)
+            self._src = (sy, su, sv)
+            return prefix_d, hdr_d, buf_d, ry, ru, rv
         bucket = next(b for b in self._delta_buckets if b >= len(idx))
         yb, ub, vb = self._prep.convert_tiles(frame, idx, self._tile_w)
-        packed_d = jax.device_put(self._pack_tiles(yb, ub, vb, idx, bucket))
+        packed = self._pack_tiles(yb, ub, vb, idx, bucket)
+        self.link_bytes.add("up_ltr", packed.nbytes)
+        packed_d = jax.device_put(packed)
         prefix_d, hdr_d, buf_d, ry, ru, rv, sy, su, sv = self._step_scatter_ltr(
             packed_d, np.int32(self.qp), *stash["src"], *stash["ref"]
         )
@@ -724,6 +1157,7 @@ class TPUH264Encoder:
         if not pend:
             return
         self._batch_pend = []
+        tc = self._tcache is not None
         try:
             i = 0
             while i < len(pend):
@@ -731,33 +1165,71 @@ class TPUH264Encoder:
                 group = pend[i : i + take]
                 i += take
                 if take == 1:
-                    rec, yb, ub, vb, idx = group[0]
-                    bucket = next(b for b in self._delta_buckets if b >= len(idx))
-                    packed_d = jax.device_put(self._pack_tiles(yb, ub, vb, idx, bucket))
-                    prefix_d, hdr_d, buf_d, ry, ru, rv, sy, su, sv = self._step_scatter_p(
-                        packed_d, np.int32(rec.qp), *self._src, *self._ref
-                    )
+                    rec, yb, ub, vb, idx, pool_dst, pairs = group[0]
+                    if tc:
+                        bucket = next(b for b in self._up_buckets if b >= len(idx))
+                        cbucket = next(cb for cb in self._copy_buckets if cb >= len(pairs))
+                        packed = self._pack_tiles2(yb, ub, vb, idx, pool_dst, pairs,
+                                                   bucket, cbucket)
+                        self.link_bytes.add("up_delta", packed.nbytes)
+                        (prefix_d, hdr_d, buf_d, ry, ru, rv, sy, su, sv,
+                         *pool2) = self._get_step2("p", bucket, cbucket)(
+                            jax.device_put(packed), np.int32(rec.qp),
+                            *self._src, *self._get_pool(), *self._ref)
+                        self._pool_d = tuple(pool2)
+                    else:
+                        bucket = next(b for b in self._delta_buckets if b >= len(idx))
+                        packed = self._pack_tiles(yb, ub, vb, idx, bucket)
+                        self.link_bytes.add("up_delta", packed.nbytes)
+                        prefix_d, hdr_d, buf_d, ry, ru, rv, sy, su, sv = self._step_scatter_p(
+                            jax.device_put(packed), np.int32(rec.qp), *self._src, *self._ref
+                        )
                     self._src, self._ref = (sy, su, sv), (ry, ru, rv)
                     rec.prefix_d, rec.hdr_d, rec.buf_d = prefix_d, hdr_d, buf_d
                     rec.pfx_slice_d = self._pfx_slice(prefix_d)
                     rec.batch_slot = -1
                     rec.future = self._pool.submit(self._complete_work, rec)
                     continue
-                bucket = next(
-                    b for b in self.BATCH_BUCKETS if b >= max(len(g[4]) for g in group)
-                )
-                packed = np.stack(
-                    [self._pack_tiles(yb, ub, vb, idx, bucket) for _, yb, ub, vb, idx in group]
-                )
                 qps = np.array([g[0].qp for g in group], np.int32)
+                if tc:
+                    bucket = next(
+                        b for b in self._up_batch_buckets
+                        if b >= max(len(g[4]) for g in group)
+                    )
+                    cbucket = next(
+                        cb for cb in self._copy_buckets
+                        if cb >= max(len(g[6]) for g in group)
+                    )
+                    packed = np.stack([
+                        self._pack_tiles2(yb, ub, vb, idx, pool_dst, pairs,
+                                          bucket, cbucket)
+                        for _, yb, ub, vb, idx, pool_dst, pairs in group
+                    ])
+                else:
+                    bucket = next(
+                        b for b in self.BATCH_BUCKETS
+                        if b >= max(len(g[4]) for g in group)
+                    )
+                    packed = np.stack([
+                        self._pack_tiles(yb, ub, vb, idx, bucket)
+                        for _, yb, ub, vb, idx, _pd, _pr in group
+                    ])
+                self.link_bytes.add("up_delta", packed.nbytes)
                 # two concurrent half uploads (h2d overlaps across threads)
                 half = take // 2
                 pa, pb = self._upload_pool.map(
                     jax.device_put, (packed[:half], packed[half:])
                 )
-                prefixes_d, denses_d, bufs_d, ry, ru, rv, sy, su, sv = self._step_scatter_pk(
-                    pa, pb, jax.device_put(qps), *self._src, *self._ref
-                )
+                if tc:
+                    (prefixes_d, denses_d, bufs_d, ry, ru, rv, sy, su, sv,
+                     *pool2) = self._get_step2("pk", bucket, cbucket)(
+                        pa, pb, jax.device_put(qps),
+                        *self._src, *self._get_pool(), *self._ref)
+                    self._pool_d = tuple(pool2)
+                else:
+                    prefixes_d, denses_d, bufs_d, ry, ru, rv, sy, su, sv = self._step_scatter_pk(
+                        pa, pb, jax.device_put(qps), *self._src, *self._ref
+                    )
                 self._src, self._ref = (sy, su, sv), (ry, ru, rv)
                 recs = [g[0] for g in group]
                 # per-slot full-row handles, dispatched NOW so a worker
@@ -779,6 +1251,7 @@ class TPUH264Encoder:
             self._inflight = deque(r for r in self._inflight if id(r) not in dropped)
             self._ref = None
             self._src = None
+            self._reset_tile_cache()
             raise
 
     # Small-slice length for the delta downlink fetch (int16 words =
@@ -794,7 +1267,7 @@ class TPUH264Encoder:
         with self._pfx_lock:
             recent = list(self._pfx_recent)
         want = max([2048] + [n * 3 // 2 for n in recent])
-        return self.PFX_SMALL if want <= self.PFX_SMALL else self._pfx_total
+        return self._pfx_small if want <= self._pfx_small else self._pfx_total
 
     def _pfx_slice(self, prefix_d):
         """Hint-sized view of a fused delta downlink, dispatched from the
@@ -808,23 +1281,35 @@ class TPUH264Encoder:
 
     def _unpack_sparse_var(self, fused, fused_d, buf_d, qp: int):
         """One delta frame's fused slice -> PFrameCoeffs (handling slice
-        shortfall, row spill past the cap, and the dense fallback).
+        shortfall, row spill past the cap, and the dense fallback), for
+        either sparse layout (bit-packed when self._density is set).
 
         fused_d is a per-frame FULL-row handle created at dispatch time:
         the shortfall refetch is then a pure transfer — slicing here (a
         device op) would queue behind scans dispatched since."""
-        need, n, ns = p_sparse_var_need(fused, self._mbh, self._mbw, self._nscap,
-                                        self._cap_delta)
+        if self._density is not None:
+            need, n, ns = p_sparse_packed_need(fused, self._mbh, self._mbw,
+                                               self._nscap, self._cap_delta)
+        else:
+            need, n, ns = p_sparse_var_need(fused, self._mbh, self._mbw, self._nscap,
+                                            self._cap_delta)
         with self._pfx_lock:
             self._pfx_recent.append(need)
         if need > len(fused):  # hint too small: refetch the live content
             fused = np.asarray(fused_d)
+            self.link_bytes.add("down_refetch", fused.nbytes)
         extra = None
         if n > self._cap_delta:  # rows spilled past the fused buffer
             extra = _fetch_rest(buf_d, n, self._cap_delta)
-        pfc, rows = unpack_p_sparse_var(
-            fused, qp, self._mbh, self._mbw, self._nscap, self._cap_delta, extra
-        )
+            self.link_bytes.add("down_spill", extra.nbytes)
+        if self._density is not None:
+            pfc, rows = unpack_p_sparse_packed(
+                fused, qp, self._mbh, self._mbw, self._nscap, self._cap_delta, extra
+            )
+        else:
+            pfc, rows = unpack_p_sparse_var(
+                fused, qp, self._mbh, self._mbw, self._nscap, self._cap_delta, extra
+            )
         return pfc, rows
 
     def _complete_batch(self, recs, pfx_slice_d, pfx_rows_d, denses_d, bufs_d):
@@ -832,6 +1317,7 @@ class TPUH264Encoder:
         prefix stack, then per-frame unpack + CAVLC pack. Returns a list
         indexed by batch_slot."""
         prefixes = np.asarray(pfx_slice_d)
+        self.link_bytes.add("down_prefix", prefixes.nbytes)
         results = []
         for slot, rec in enumerate(recs):
             t1 = time.perf_counter()
@@ -839,7 +1325,9 @@ class TPUH264Encoder:
                 prefixes[slot], pfx_rows_d[slot], bufs_d[slot], rec.qp
             )
             if pfc is None:  # ns > NSCAP: dense-header fallback fetch
-                pfc = unpack_p_compact(np.asarray(denses_d[slot]), rows, rec.qp)
+                dense = np.asarray(denses_d[slot])
+                self.link_bytes.add("down_spill", dense.nbytes)
+                pfc = unpack_p_compact(dense, rows, rec.qp)
             au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num,
                                    ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
                                    mmco_evict=rec.mmco_evict)
@@ -886,7 +1374,11 @@ class TPUH264Encoder:
         ltr_hit = None
         ltr_stash = None
         if self.ltr_scenes and not idr and kind == "full" and self._src is not None:
-            hit = self._ltr_match(frame)
+            # reuse _classify's probe when it already ran the match this
+            # frame (slot table unchanged in between: the pending
+            # candidate commits only below)
+            hit = (self._ltr_probe if self._ltr_probe != ()
+                   else self._ltr_match(frame))
             if hit is not None:
                 ltr_hit = hit
                 ltr_stash = self._ltr_slots[hit[0]]
@@ -939,19 +1431,27 @@ class TPUH264Encoder:
             not idr
             and kind == "delta"
             and self.frame_batch > 1
-            and len(dirty_idx) <= self.BATCH_BUCKETS[-1]
+            and (len(dirty_idx[0]) if self._tcache is not None else len(dirty_idx))
+            <= self.BATCH_BUCKETS[-1]
         ):
-            # group candidate: convert the dirty tiles NOW (the capture
-            # buffer may be reused before dispatch), dispatch when the
-            # group fills or a non-groupable frame arrives
-            yb, ub, vb = self._prep.convert_tiles(frame, dirty_idx, self._tile_w)
+            # group candidate: convert the (post-remap) upload tiles NOW
+            # — the capture buffer may be reused before dispatch, and
+            # the cache split already ran in _classify in frame order —
+            # then dispatch when the group fills or a non-groupable
+            # frame arrives
+            if self._tcache is not None:
+                up_idx, pool_dst, pairs = dirty_idx
+                yb, ub, vb = self._prep.convert_tiles(frame, up_idx, self._tile_w)
+            else:
+                up_idx, pool_dst, pairs = dirty_idx, None, None
+                yb, ub, vb = self._prep.convert_tiles(frame, dirty_idx, self._tile_w)
             rec = _Pending(
                 kind="pd", frame_index=self.frame_index, qp=self.qp,
                 frame_num=self._frames_since_idr % 256, idr_pic_id=0,
                 t0=t0, t1=0.0, meta=meta, mark_ltr=mark_ltr,
                 mmco_evict=mmco_evict,
             )
-            self._batch_pend.append((rec, yb, ub, vb, dirty_idx))
+            self._batch_pend.append((rec, yb, ub, vb, up_idx, pool_dst, pairs))
             batch_full = len(self._batch_pend) >= self.frame_batch
         else:
             try:
@@ -1018,6 +1518,21 @@ class TPUH264Encoder:
                     )
                     if pk == "pd":
                         rec.pfx_slice_d = self._pfx_slice(prefix_d)
+                # over-budget delta that fell back to full: seed the tile
+                # pool from the now-resident planes so the NEXT frame of
+                # a sustained scroll fits the delta path via remaps.
+                # Only the first frames of a full-frame run seed (same
+                # policy as the LTR stash): video playback would pay a
+                # full hash + commit + device gather per frame for
+                # content that never repeats
+                if (
+                    self._tcache is not None
+                    and kind == "full"
+                    and isinstance(dirty_idx, tuple)
+                    and self._src is not None
+                    and self._full_run <= 2
+                ):
+                    self._seed_pool(frame, dirty_idx[1])
                 # scene-stash bookkeeping: every full frame (IDR, full-P,
                 # or restore) becomes the pending LTR candidate — window
                 # switches arrive back-to-back, so mid-run frames are
@@ -1058,6 +1573,7 @@ class TPUH264Encoder:
                 self._ref = None
                 self._src = None
                 self._ltr_candidate = None  # forced IDR will clear slots
+                self._reset_tile_cache()
                 self.qp = orig_qp
                 raise
         self.qp = orig_qp
@@ -1129,6 +1645,7 @@ class TPUH264Encoder:
             self._src = None
             self._inflight.clear()
             self._batch_pend.clear()
+            self._reset_tile_cache()
             raise
         stats = FrameStats(
             frame_index=rec.frame_index, idr=rec.kind == "i", qp=rec.qp,
@@ -1146,10 +1663,13 @@ class TPUH264Encoder:
         if rec.kind == "pd":
             with tracer.span("fetch"):
                 fused = np.asarray(rec.pfx_slice_d)
+            self.link_bytes.add("down_prefix", fused.nbytes)
             t1 = time.perf_counter()
             pfc, rows = self._unpack_sparse_var(fused, rec.prefix_d, rec.buf_d, rec.qp)
             if pfc is None:
-                pfc = unpack_p_compact(np.asarray(rec.hdr_d), rows, rec.qp)
+                dense = np.asarray(rec.hdr_d)
+                self.link_bytes.add("down_spill", dense.nbytes)
+                pfc = unpack_p_compact(dense, rows, rec.qp)
             with tracer.span("pack"):
                 au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num,
                                        ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
@@ -1159,9 +1679,12 @@ class TPUH264Encoder:
         hdr_words = self._hdr_words_i if rec.kind == "i" else self._hdr_words_p
         cap = CAP_ROWS
         prefix = np.asarray(rec.prefix_d)
+        self.link_bytes.add("down_prefix", prefix.nbytes)
         header, data, n = split_prefix(prefix, hdr_words)
         if n > cap:  # rare: heavy frame spilled past the prefix
-            data = np.concatenate([data, _fetch_rest(rec.buf_d, n, cap)])
+            rest = _fetch_rest(rec.buf_d, n, cap)
+            self.link_bytes.add("down_spill", rest.nbytes)
+            data = np.concatenate([data, rest])
         t1 = time.perf_counter()
         skipped = 0
         if rec.kind == "i":
@@ -1184,11 +1707,13 @@ class TPUH264Encoder:
         """Device-entropy P frame: fetch [meta ++ bit words], splice the
         slice header, done — no coefficient unpack, no host CAVLC."""
         arr = np.asarray(rec.prefix_d)  # uint32: nbits, trailing, nskip, words...
+        self.link_bytes.add("down_prefix", arr.nbytes)
         nbits, trailing, skipped = int(arr[0]), int(arr[1]), int(arr[2])
         if nbits > BITS_WORD_CAP * 32:
             # pathological frame overflowed the bit buffer: dense fallback
             header = np.asarray(rec.hdr_d)
             data = _fetch_rest(rec.buf_d, int(header[0]), 0)
+            self.link_bytes.add("down_spill", header.nbytes + data.nbytes)
             t1 = time.perf_counter()
             pfc = unpack_p_compact(header, data, rec.qp)
             au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num,
@@ -1198,9 +1723,9 @@ class TPUH264Encoder:
         need = (nbits + 31) // 32
         words = arr[3 : 3 + min(need, BITS_PREFIX_WORDS)]
         if need > BITS_PREFIX_WORDS:  # spill: one extra fetch
-            words = np.concatenate(
-                [words, _fetch_rest(rec.words_d, need, BITS_PREFIX_WORDS)]
-            )
+            rest = _fetch_rest(rec.words_d, need, BITS_PREFIX_WORDS)
+            self.link_bytes.add("down_spill", rest.nbytes)
+            words = np.concatenate([words, rest])
         t1 = time.perf_counter()
         au = assemble_p_nal(words, nbits, trailing, self.params, rec.frame_num,
                             rec.qp, ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
@@ -1236,6 +1761,7 @@ class TPUH264Encoder:
         self._force_idr = True
         self._ref = None
         self._src = None
+        self._reset_tile_cache()
         if self._prep is not None:
             self._prep.reset()
         self._prev_frame = None

@@ -873,6 +873,100 @@ def pack_p_sparse_var(out, nscap: int, cap_rows: int):
     return fused, dense, buf
 
 
+def pack_p_sparse_packed(out, nscap: int, cap_rows: int, density_pct: int = 75):
+    """Bit-packed variant of pack_p_sparse_var: coefficient rows ride as
+    a significance bitmap + their nonzero values only.
+
+    A typical desktop-residual 4x4 block has 1-4 nonzero coefficients,
+    so shipping all 16 int16 lanes (32 B/row) wastes 3-6x of the
+    dominant d2h term (PERF.md: group prefix fetch ~12-19 ms/frame on
+    the relay). Per nonzero row the packed stream carries:
+
+      * one int16 significance bitmap (bit j = scan-order lane j != 0);
+      * the nonzero values, compacted to the front and padded to groups
+        of FOUR int16 — one int64 lane per group, so the stream stays
+        8-byte aligned and the host can bulk-view it.
+
+    Layout (int16 words):
+      [meta: n, mbh, mbw, ns, nw, dense_flag (6 int32 = 12)]
+      ++ skip_words(ceil(M/32) int32) ++ (mv, info) pairs for the first
+      ns non-skip MBs  -- as in pack_p_sparse_var --
+      ++ at dynamic offset base + 4*min(ns, nscap):
+           dense_flag=0: bitmaps (held int16) ++ values (nw int16)
+           dense_flag=1: rows (16 * held int16, the var layout)
+
+    `nw` = total packed value words (4 * sum of per-row groups). The
+    DENSE FALLBACK triggers when the packed stream would exceed
+    `density_pct`% of the dense rows — busy frames approach 16 nonzeros
+    per row, where bitmap + padding overhead inverts the win and the
+    host-side re-expansion is pure loss. Both layouts reconstruct the
+    exact same PFrameCoeffs (compact.unpack_p_sparse_packed), so
+    bitstreams are byte-identical either way. Returns (fused, dense
+    header, buf) with the same fallback contract as pack_p_sparse_var."""
+    n, mbh, mbw, mv_words, mbinfo, buf = _p_components(out)
+    mask = ~out["skip"].reshape(-1)
+    ns = mask.sum().astype(jnp.int32)
+    pos = jnp.cumsum(mask) - 1
+    dest = jnp.where(mask & (pos < nscap), pos, nscap)
+    mv_c = jnp.zeros((nscap + 1,), jnp.int32).at[dest].set(mv_words)[:nscap]
+    info_c = jnp.zeros((nscap + 1,), jnp.int32).at[dest].set(mbinfo)[:nscap]
+    skip_words = _bitpack32(out["skip"].reshape(-1))
+    sw = skip_words.shape[0]
+    pairs16 = jax.lax.bitcast_convert_type(
+        jnp.stack([mv_c, info_c], -1).reshape(-1), jnp.int16
+    ).reshape(-1)
+
+    rows = buf[:cap_rows]  # (cap, 16) int16; zero past row n
+    sig = rows != 0
+    bitmap16 = (sig.astype(jnp.int32) << jnp.arange(16, dtype=jnp.int32)).sum(-1).astype(jnp.int16)
+    counts = sig.sum(-1).astype(jnp.int32)  # per-row nonzeros (>=1 while live)
+    width = 4 * ((counts + 3) // 4)  # int16 slots incl group padding
+    off = jnp.cumsum(width) - width  # exclusive prefix
+    nw = width.sum().astype(jnp.int32)
+    lane = jnp.cumsum(sig, axis=-1) - 1  # within-row rank of each nonzero
+    vdest = jnp.where(sig, off[:, None] + lane, 16 * cap_rows)  # sentinel dropped
+    vals16 = (
+        jnp.zeros((16 * cap_rows + 1,), jnp.int16)
+        .at[vdest.reshape(-1)]
+        .set(rows.reshape(-1))[: 16 * cap_rows]
+    )
+
+    held = jnp.minimum(n, cap_rows)
+    # fallback when the packed stream stops paying (bitmaps + padding vs
+    # the 16-lane rows it replaces)
+    dense_flag = (held + nw) * 100 > (16 * held) * density_pct
+    meta = jnp.stack([n, jnp.int32(mbh), jnp.int32(mbw), ns, nw,
+                      dense_flag.astype(jnp.int32)])
+    head16 = jax.lax.bitcast_convert_type(
+        jnp.concatenate([meta, skip_words]), jnp.int16
+    ).reshape(-1)  # (12 + 2*sw,)
+    base = 12 + 2 * sw
+    total16 = base + 4 * nscap + cap_rows + 16 * cap_rows
+    fused = jnp.zeros((total16,), jnp.int16)
+    fused = jax.lax.dynamic_update_slice(fused, head16, (0,))
+    fused = jax.lax.dynamic_update_slice(fused, pairs16, (base,))
+    rows_off = base + 4 * jnp.clip(ns, 0, nscap)
+    rows16 = rows.reshape(-1)
+
+    def write_dense(f):
+        return jax.lax.dynamic_update_slice(f, rows16, (rows_off,))
+
+    def write_packed(f):
+        # the values overwrite the bitmap array's dead tail (rows past
+        # `held` have empty bitmaps), keeping the live content contiguous
+        f = jax.lax.dynamic_update_slice(f, bitmap16, (rows_off,))
+        return jax.lax.dynamic_update_slice(f, vals16, (rows_off + held,))
+
+    fused = jax.lax.cond(dense_flag, write_dense, write_packed, fused)
+    dense = jnp.concatenate([
+        jnp.stack([n, jnp.int32(mbh), jnp.int32(mbw), jnp.int32(0)]),
+        mv_words,
+        mbinfo,
+        skip_words,
+    ])
+    return fused, dense, buf
+
+
 def fuse_downlink(header, buf, cap_rows: int):
     """Fuse header + the first cap_rows data rows into ONE int16 buffer.
 

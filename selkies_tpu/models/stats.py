@@ -6,7 +6,31 @@ single type regardless of which encoder produced the frame.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
+
+
+class LinkByteCounter:
+    """Per-stage host<->device link-byte accounting.
+
+    Stages prefixed "up_" count host->device bytes, "down_" counts
+    device->host. Incremented from the dispatch thread AND the
+    completion workers, hence the lock. bench.py and
+    tools/profile_link_bytes.py read snapshots around a timed pass to
+    report bytes/frame per direction — the quantity the relay actually
+    prices (PERF.md cost model)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._stages: dict[str, int] = {}
+
+    def add(self, stage: str, nbytes: int) -> None:
+        with self._lock:
+            self._stages[stage] = self._stages.get(stage, 0) + int(nbytes)
+
+    def snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._stages)
 
 
 @dataclass

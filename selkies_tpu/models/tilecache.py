@@ -1,0 +1,249 @@
+"""Content-hash tile cache — the uplink's CopyRect analogue.
+
+The delta-upload path ships every dirty 16-row x tile_w-col tile's
+pixels, but scrolls, window moves, and alt-tab redraws mostly REARRANGE
+content the device has already seen: VNC encodes those as CopyRect
+(src rect -> dst rect) and ships no pixels. This cache provides the
+same economy for the host->device link: the device keeps an LRU pool of
+previously-uploaded I420 tiles, the host keeps a content-hash index of
+what each pool slot holds, and a dirty tile whose BGRx bytes hash-match
+(and memcmp-verify against) a pool slot becomes an 8-byte
+(slot -> dst position) remap executed by the jitted scatter step
+instead of a ~3 KB pixel upload.
+
+Correctness contract: a remap is emitted ONLY after an exact memcmp of
+the tile's BGRx bytes against the stored copy of what the slot was
+uploaded from — the hash (xxhash-style multiply-fold, numpy or
+native/frameprep.cc tile_hash) only selects the candidate slot, so a
+collision costs one wasted compare, never a wrong pixel. BGRx equality
+implies I420 equality because the tile converter is position-independent
+for interior tiles; edge tiles (whose converted bytes embed replicated
+padding) are excluded from the cache entirely.
+
+The encoder owns the device half (pool planes threaded through the
+scatter steps, models/h264/encoder.py); this class is pure host state
+and must be reset whenever the device pool is discarded.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from selkies_tpu.models import frameprep
+
+__all__ = ["TileCache", "tile_hash_np"]
+
+# splitmix64 constants — shared with native/frameprep.cc tile_hash (the
+# two implementations must produce identical hashes; tests compare them)
+_SM_GAMMA = np.uint64(0x9E3779B97F4A7C15)
+_SM_M1 = np.uint64(0xBF58476D1CE4E5B9)
+_SM_M2 = np.uint64(0x94D049BB133111EB)
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer (wrapping uint64 arithmetic)."""
+    x = (x + _SM_GAMMA).astype(np.uint64)
+    x ^= x >> np.uint64(30)
+    x = (x * _SM_M1).astype(np.uint64)
+    x ^= x >> np.uint64(27)
+    x = (x * _SM_M2).astype(np.uint64)
+    return x ^ (x >> np.uint64(31))
+
+
+_mult_cache: dict[int, np.ndarray] = {}
+
+
+def _mults(nwords: int) -> np.ndarray:
+    """Per-position odd multipliers: splitmix64(position) | 1."""
+    m = _mult_cache.get(nwords)
+    if m is None:
+        m = _splitmix64(np.arange(nwords, dtype=np.uint64)) | np.uint64(1)
+        _mult_cache[nwords] = m
+    return m
+
+
+def tile_hash_np(tiles_u8: np.ndarray) -> np.ndarray:
+    """(k, nbytes) uint8 tile rows -> (k,) uint64 content hashes.
+
+    Multiply-fold: XOR-reduce of each 8-byte lane times a per-position
+    splitmix64-derived odd multiplier, then a splitmix64 avalanche.
+    Position-dependent multipliers make permuted content hash apart;
+    one numpy pass over all k tiles (no per-tile Python loop)."""
+    k, nbytes = tiles_u8.shape
+    tiles_u8 = np.ascontiguousarray(tiles_u8)
+    lib = frameprep._load()
+    if lib is not None and hasattr(lib, "tile_hash"):
+        out = np.empty(k, np.uint64)
+        lib.tile_hash(
+            frameprep._u8p(tiles_u8), k, nbytes,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        )
+        return out
+    words = tiles_u8.view(np.uint64).reshape(k, nbytes // 8)
+    with np.errstate(over="ignore"):
+        h = np.bitwise_xor.reduce(words * _mults(words.shape[1]), axis=1)
+    return _splitmix64(h)
+
+
+class TileCache:
+    """Host half of the device tile-slot pool: hash index + LRU + the
+    BGRx bytes each slot was filled from (for exact verification).
+
+    Slot ids are [0, slots); slot id `slots` is the device pool's
+    SCRATCH slot (writes land there when a tile should not be kept)."""
+
+    def __init__(self, height: int, width: int, tile_w: int, slots: int):
+        self.height, self.width, self.tile_w = height, width, tile_w
+        self.slots = int(slots)
+        # only tiles fully inside the unpadded capture are cacheable:
+        # edge tiles' I420 bytes embed position-dependent padding
+        self._full_bands = height // 16
+        self._full_tiles = width // tile_w
+        self._tile_bytes = 16 * tile_w * 4
+        self._store = np.zeros((self.slots, self._tile_bytes), np.uint8)
+        self._hash2slot: dict[int, int] = {}
+        self._slot_hash: list[int | None] = [None] * self.slots
+        self._free = list(range(self.slots - 1, -1, -1))
+        self._stamp = np.zeros(self.slots, np.int64)
+        self._clock = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def reset(self) -> None:
+        """Forget everything (the device pool was discarded/reallocated)."""
+        self._hash2slot.clear()
+        self._slot_hash = [None] * self.slots
+        self._free = list(range(self.slots - 1, -1, -1))
+        self._stamp[:] = 0
+        self._clock = 0
+
+    def _tile_bgrx(self, frame: np.ndarray, band: int, tile: int) -> np.ndarray:
+        tw = self.tile_w
+        return np.ascontiguousarray(
+            frame[band * 16 : band * 16 + 16, tile * tw : (tile + 1) * tw]
+        ).reshape(-1)
+
+    def probe(self, frame: np.ndarray, idx: np.ndarray, samples: int = 8) -> float:
+        """Fraction of a sampled subset of dirty tiles whose content
+        hash is already in the pool index — no memcmp, no state change.
+        A cheap plausibility gate for over-budget frames: scrolled
+        content probes near 1.0 after its seed frame, video content
+        probes ~0.0 every frame (so the classifier skips the full
+        hash/split attempt AND the per-frame seeding)."""
+        step = max(1, len(idx) // samples)
+        raws = []
+        for d in list(idx[::step][:samples]):
+            d = int(d)
+            band, tile = d // 1024, d % 1024
+            if band < self._full_bands and tile < self._full_tiles:
+                raws.append(self._tile_bgrx(frame, band, tile))
+        if not raws:
+            return 0.0
+        hashes = tile_hash_np(np.stack(raws))
+        return sum(int(h) in self._hash2slot for h in hashes) / len(raws)
+
+    def split(self, frame: np.ndarray, idx: np.ndarray, max_up: int | None = None):
+        """Dirty tiles -> (upload_idx, pool_dst, copy_pairs), or None.
+
+        upload_idx: tiles whose pixels must cross the link;
+        pool_dst[i]: pool slot the device stores upload i into (`slots`
+        = scratch, i.e. not kept); copy_pairs (kc, 2) int32 rows
+        (src_slot, dst_idx) for tiles already resident in the pool.
+
+        With `max_up` set, a frame needing more than max_up pixel
+        uploads returns None WITHOUT any state change — all decisions
+        run against shadow copies of the index and commit atomically at
+        the end, so the caller can fall back to the full-upload path
+        with the pool still coherent. (This is what lets the encoder
+        try the delta path on over-budget dirty frames like a
+        maximized-window scroll: if enough tiles are pool-resident the
+        frame fits after remapping, and if not, nothing was harmed.)
+
+        Slots assigned IN THIS CALL are never referenced by this call's
+        copy pairs: the device applies copies before pool inserts inside
+        one step, so a same-step slot would read stale content. (Across
+        frames of a grouped dispatch the scan carry orders inserts
+        before the next frame's copies, matching host call order.)"""
+        uploads: list[int] = []
+        pool_dst: list[int] = []
+        pairs: list[tuple[int, int]] = []
+        cacheable = []
+        for d in idx:
+            d = int(d)
+            band, tile = d // 1024, d % 1024
+            cacheable.append(band < self._full_bands and tile < self._full_tiles)
+        tiles_bytes = {}
+        cidx = [int(d) for d, c in zip(idx, cacheable) if c]
+        if cidx:
+            stack = np.stack(
+                [self._tile_bgrx(frame, d // 1024, d % 1024) for d in cidx]
+            )
+            hashes = tile_hash_np(stack)
+            tiles_bytes = {d: (stack[i], int(hashes[i])) for i, d in enumerate(cidx)}
+        # shadow state: committed only if the frame fits the budget
+        h2s = dict(self._hash2slot)
+        slot_hash = list(self._slot_hash)
+        free = list(self._free)
+        stamp = self._stamp.copy()
+        clock = self._clock + 1
+        store_w: dict[int, np.ndarray] = {}
+        hits = misses = evictions = 0
+        new_slots: set[int] = set()
+        for d, c in zip(idx, cacheable):
+            d = int(d)
+            if not c:
+                uploads.append(d)
+                pool_dst.append(self.slots)  # scratch: never kept
+                if max_up is not None and len(uploads) > max_up:
+                    return None  # over budget: shadow state discarded
+                continue
+            raw, h = tiles_bytes[d]
+            slot = h2s.get(h)
+            if (
+                slot is not None
+                and slot not in new_slots
+                and np.array_equal(self._store[slot], raw)
+            ):
+                pairs.append((slot, d))
+                stamp[slot] = clock
+                hits += 1
+                continue
+            misses += 1
+            if slot is None:
+                if free:
+                    slot = free.pop()
+                else:
+                    slot = int(np.argmin(stamp))  # LRU
+                    old = slot_hash[slot]
+                    if old is not None and old in h2s:
+                        del h2s[old]
+                    evictions += 1
+                h2s[h] = slot
+                slot_hash[slot] = h
+            # else: hash collision or same-call duplicate — refresh the
+            # existing slot with this content (idempotent on duplicates)
+            store_w[slot] = raw
+            stamp[slot] = clock
+            new_slots.add(slot)
+            uploads.append(d)
+            pool_dst.append(slot)
+            if max_up is not None and len(uploads) > max_up:
+                return None  # over budget: shadow state discarded
+        self._hash2slot = h2s
+        self._slot_hash = slot_hash
+        self._free = free
+        self._stamp = stamp
+        self._clock = clock
+        for slot, raw in store_w.items():
+            self._store[slot] = raw
+        self.hits += hits
+        self.misses += misses
+        self.evictions += evictions
+        return (
+            np.array(uploads, np.int32),
+            np.array(pool_dst, np.int32),
+            np.array(pairs, np.int32).reshape(-1, 2),
+        )

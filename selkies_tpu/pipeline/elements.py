@@ -59,6 +59,71 @@ class SyntheticSource:
         return f
 
 
+def scroll_trace(width: int, height: int, n: int, *, band0: int = 2,
+                 bands: int = 8, seed: int = 0) -> list[np.ndarray]:
+    """Terminal-scroll workload: a full-width texture region scrolls up
+    by exactly 16 rows per frame while one new random line enters at the
+    bottom — the tile-cache's headline case (every scrolled tile's bytes
+    already crossed the link last frame). Tile-aligned by construction:
+    16-row steps keep band boundaries stable, so a CopyRect-style cache
+    can remap instead of re-uploading. Shared by tests/test_tile_cache.py
+    and tools/profile_link_bytes.py."""
+    if 16 * (band0 + bands) > height:
+        raise ValueError(
+            f"scroll region bands {band0}..{band0 + bands} exceeds height {height}")
+    rng = np.random.default_rng(seed)
+    base = np.full((height, width, 4), 230, np.uint8)
+    base[: height // 10] = (70, 60, 60, 0)
+    # texture strip taller than the visible window so fresh content keeps
+    # entering; every 16-row line is unique (no accidental dedup)
+    strip = rng.integers(0, 255, (16 * (bands + n), width, 4), np.uint8)
+    frames = []
+    r0 = band0 * 16
+    for i in range(n):
+        f = base.copy()
+        f[r0 : r0 + bands * 16] = strip[16 * i : 16 * (i + bands)]
+        frames.append(f)
+    return frames
+
+
+def window_move_trace(width: int, height: int, n: int, *, tile_w: int | None = None,
+                      seed: int = 0) -> list[np.ndarray]:
+    """Window-drag workload: a tile-periodic 'window' slides horizontally
+    by one tile per frame (right, then back left). Newly covered tiles
+    repeat window content the device already holds; re-exposed tiles
+    repeat wallpaper content — both remap-able by a content-addressed
+    tile cache. Shared by tests and tools/profile_link_bytes.py."""
+    rng = np.random.default_rng(seed)
+    if tile_w is None:
+        # align to the encoder's tile geometry so the tile-granular
+        # machinery (delta upload, tile cache) engages
+        from selkies_tpu.models.frameprep import tile_width_for
+
+        tile_w = tile_width_for(width)
+    # tile-periodic wallpaper: every (16 x tile_w) tile is identical, so
+    # re-exposed background matches pool content regardless of position
+    wp_tile = rng.integers(40, 200, (16, tile_w, 4), np.uint8)
+    reps_y = (height + 15) // 16
+    reps_x = (width + tile_w - 1) // tile_w
+    base = np.tile(wp_tile, (reps_y, reps_x, 1))[:height, :width]
+    win_tile = rng.integers(0, 255, (16, tile_w, 4), np.uint8)
+    wh, ww = 6 * 16, 3 * tile_w  # window: 6 bands x 3 tiles
+    win = np.tile(win_tile, (6, 3, 1))
+    y0 = 32
+    max_x = (width - ww) // tile_w
+    if max_x < 1 or y0 + wh > height:
+        raise ValueError(
+            f"{width}x{height} too small for a {ww}x{wh} window moving by {tile_w}")
+    frames = []
+    for i in range(n):
+        step = i % (2 * max_x)
+        x = (step if step < max_x else 2 * max_x - step) * tile_w
+        f = base.copy()
+        f[y0 : y0 + wh, x : x + ww] = win
+        frames.append(f)
+    return frames
+
+
 @dataclass
 class EncodedFrame:
     au: bytes

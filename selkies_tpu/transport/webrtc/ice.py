@@ -456,8 +456,11 @@ class IceAgent:
         # every accepted one makes this host send STUN checks to the
         # named address: an unbounded flood is both a memory leak and a
         # traffic-reflection primitive (the classic "ICE as port scanner")
-        # — real browsers gather far fewer (libwebrtc stays under ~32)
-        if len(self._pairs) >= MAX_CHECK_PAIRS:
+        # — real browsers gather far fewer (libwebrtc stays under ~32).
+        # A relayed allocation doubles the appends below, so reserve both
+        # slots up front or the cap could be exceeded by one.
+        need = 2 if self._relay_addr is not None else 1
+        if len(self._pairs) + need > MAX_CHECK_PAIRS:
             logger.warning("remote candidate limit reached; ignoring %s:%d",
                            cand.ip, cand.port)
             return

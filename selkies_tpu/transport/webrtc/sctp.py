@@ -131,6 +131,11 @@ class SctpAssociation:
         self._next_sid = 0 if is_client else 1
         self._reasm: dict[int, list[tuple[int, int, bytes, int]]] = {}
         self._reasm_total = 0  # in-progress fragment bytes, all streams
+        # per-stream running byte totals, kept in lockstep with _reasm:
+        # the over-budget eviction picks the largest stream, and summing
+        # fragment lists on every append would be O(streams x fragments)
+        # exactly in the many-parked-streams case the cap defends against
+        self._reasm_bytes: dict[int, int] = {}
         self._rx_out_of_order: dict[int, tuple[int, bytes]] = {}  # tsn -> (flags, chunk value)
         self._rx_buffered = 0  # bytes currently held in _rx_out_of_order
         self._cookie = b""
@@ -323,7 +328,12 @@ class SctpAssociation:
             logger.debug("SCTP DATA tsn %d outside rx window; dropping", tsn)
             return
         if tsn in self._rx_out_of_order:
-            return  # duplicate of an already-buffered out-of-order chunk
+            # duplicate of an already-buffered out-of-order chunk: still
+            # SACK it (mirrors the cumulative-duplicate path above) — a
+            # legitimately retransmitted chunk needs ack feedback or the
+            # sender keeps hitting RTO on it
+            self._send_sack()
+            return
         # the budget must never drop the gap-filling chunk (tsn == next
         # expected): it delivers immediately and DRAINS the buffer below,
         # while dropping it would deadlock a full buffer — every
@@ -352,29 +362,36 @@ class SctpAssociation:
         frags = self._reasm.setdefault(sid, [])
         frags.append((flags, ssn, payload, ppid))
         self._reasm_total += len(payload)
+        self._reasm_bytes[sid] = self._reasm_bytes.get(sid, 0) + len(payload)
         if not flags & 0x01:  # E bit clear: more fragments coming
             if self._reasm_total > REASM_MAX_BYTES:
-                # over the association budget: drop THIS stream's state
-                # (repeat offenders clear themselves fragment by fragment,
-                # so the total stays pinned at the cap)
-                logger.warning("reassembly over %d bytes (stream %d); "
-                               "dropping its fragment state",
-                               REASM_MAX_BYTES, sid)
-                self._reasm_total -= sum(len(f[2]) for f in frags)
-                del self._reasm[sid]  # empty-list entries would pile up over 64k sids
+                # over the association budget: evict the stream with the
+                # LARGEST buffered total, not whichever stream's fragment
+                # happened to cross the cap — otherwise attacker-parked
+                # B-fragments on other sids persist at the cap while a
+                # legitimate large message keeps getting sacrificed
+                victim = max(self._reasm_bytes, key=self._reasm_bytes.get)
+                vbytes = self._reasm_bytes.pop(victim)
+                logger.warning("reassembly over %d bytes; dropping stream "
+                               "%d fragment state (%d bytes buffered)",
+                               REASM_MAX_BYTES, victim, vbytes)
+                self._reasm_total -= vbytes
+                del self._reasm[victim]  # empty-list entries would pile up over 64k sids
             return
         # reassemble from the most recent B fragment; an E without any B
         # is malformed — drop the stream's fragment state, not the session
         start = next((i for i in range(len(frags) - 1, -1, -1) if frags[i][0] & 0x02), -1)
         if start < 0:
-            self._reasm_total -= sum(len(f[2]) for f in frags)
+            self._reasm_total -= self._reasm_bytes.pop(sid)
             del self._reasm[sid]
             return
         msg = b"".join(f[2] for f in frags[start:])
         ppid = frags[start][3]
         del frags[start:]
+        self._reasm_bytes[sid] -= len(msg)
         if not frags:
             del self._reasm[sid]
+            del self._reasm_bytes[sid]
         self._reasm_total -= len(msg)
         self._on_message_raw(sid, ppid, msg)
 

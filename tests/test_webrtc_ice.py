@@ -96,3 +96,34 @@ def test_ice_peer_reflexive_learning(loop):
         b.close()
 
     loop.run_until_complete(scenario())
+
+
+def test_relay_reserves_two_pair_slots(loop):
+    """With a TURN relay allocated every accepted candidate appends TWO
+    check pairs (direct + relayed), so the cap must be checked against
+    both — an odd pair count one below the cap must reject the next
+    candidate instead of exceeding MAX_CHECK_PAIRS by one."""
+    from selkies_tpu.transport.webrtc import ice as ice_mod
+
+    a = IceAgent(loop=loop)
+    try:
+        for i in range(ice_mod.MAX_CHECK_PAIRS - 1):
+            a.add_remote_candidate(
+                f"candidate:1 1 udp {candidate_priority('host')} "
+                f"10.1.{i // 250}.{i % 250 + 1} {40000 + i} typ host")
+        assert len(a._pairs) == ice_mod.MAX_CHECK_PAIRS - 1
+        # one free slot, but a relayed allocation needs two
+        a._relay_addr = ("198.51.100.9", 3478)
+        a.add_remote_candidate(
+            f"candidate:1 1 udp {candidate_priority('host')} "
+            f"10.2.0.1 41000 typ host")
+        assert len(a._pairs) == ice_mod.MAX_CHECK_PAIRS - 1, \
+            "relayed candidate must not squeeze past the pair cap"
+        # without the relay a single-pair candidate still fits
+        a._relay_addr = None
+        a.add_remote_candidate(
+            f"candidate:1 1 udp {candidate_priority('host')} "
+            f"10.2.0.2 41001 typ host")
+        assert len(a._pairs) == ice_mod.MAX_CHECK_PAIRS
+    finally:
+        a.close()

@@ -295,3 +295,46 @@ def test_reassembly_byte_cap():
     cli.send(ch, blob, binary=True)
     _pump(cli, srv)
     assert blob in got, "legitimate fragmented message lost after the cap"
+
+
+def test_duplicate_out_of_order_data_is_sacked():
+    """A retransmitted copy of an already-buffered out-of-order chunk
+    must still be SACKed (mirroring the cumulative-duplicate path) or the
+    sender never learns it arrived and keeps hitting RTO."""
+    cli, srv = _pair()
+    ch = cli.open_channel("input")
+    _pump(cli, srv)
+    cli.send(ch, b"one")
+    cli.take_packets()  # drop: creates the TSN gap
+    cli.send(ch, b"two")
+    second = cli.take_packets()
+    assert len(second) == 1
+    srv.take_packets()  # drain handshake leftovers
+    srv.put_packet(second[0])  # buffered out of order -> SACK
+    assert any(p[12] == S.SACK for p in srv.take_packets())
+    srv.put_packet(second[0])  # duplicate of the BUFFERED chunk
+    assert any(p[12] == S.SACK for p in srv.take_packets()), \
+        "duplicate of a buffered out-of-order chunk must be SACKed"
+
+
+def test_reassembly_eviction_targets_largest_stream(monkeypatch):
+    """When the association reassembly budget is crossed, the stream
+    with the LARGEST buffered total is evicted — not whichever stream's
+    fragment happened to cross the cap. Attacker-parked B fragments must
+    not survive at the cap while a legitimate message is sacrificed."""
+    monkeypatch.setattr(S, "REASM_MAX_BYTES", 4096)
+    cli, srv = _pair()
+
+    def frag(sid, flags, payload):
+        srv._deliver(flags, struct.pack("!IHHI", 0, sid, 0, S.PPID_BINARY) + payload)
+
+    frag(7, 0x02, b"A" * 3000)  # attacker parks a big B fragment
+    frag(9, 0x02, b"B" * 500)   # legitimate large message starts
+    frag(9, 0x00, b"C" * 700)   # middle fragment crosses the budget
+    assert 7 not in srv._reasm, "largest buffered stream must be evicted"
+    assert 9 in srv._reasm, "the stream that crossed the cap survived"
+    assert srv._reasm_total == 1200
+    # the surviving stream still completes
+    frag(9, 0x01, b"D" * 10)
+    assert 9 not in srv._reasm
+    assert srv._reasm_total == 0
