@@ -12,6 +12,7 @@ import ctypes
 import logging
 import os
 import subprocess
+import threading
 
 import numpy as np
 
@@ -120,11 +121,19 @@ def native_available() -> bool:
 
 # Per-geometry scratch buffers reused across frames (the packer runs every
 # 16 ms; per-frame multi-MB allocations would dominate small-slice cost).
-_scratch: dict[tuple[int, int], dict[str, np.ndarray]] = {}
+# THREAD-LOCAL: the multi-session service packs N same-geometry streams
+# concurrently (parallel/serving.py pack pool); a process-global buffer
+# set raced across sessions and silently corrupted bitstreams (caught by
+# the chaos suite's byte-identity check). Pack-pool threads are
+# persistent, so per-thread reuse keeps the no-allocation steady state.
+_scratch_tls = threading.local()
 
 
 def _get_scratch(mbh: int, mbw: int, cap: int) -> dict[str, np.ndarray]:
-    s = _scratch.get((mbh, mbw))
+    store = getattr(_scratch_tls, "by_geom", None)
+    if store is None:
+        store = _scratch_tls.by_geom = {}
+    s = store.get((mbh, mbw))
     if s is None or len(s["rbsp"]) < cap:
         s = {
             "rbsp": np.empty(cap, np.uint8),
@@ -132,7 +141,7 @@ def _get_scratch(mbh: int, mbw: int, cap: int) -> dict[str, np.ndarray]:
             "luma_tc": np.empty(mbh * 4 * mbw * 4, np.int32),
             "chroma_tc": np.empty(2 * mbh * 2 * mbw * 2, np.int32),
         }
-        _scratch[(mbh, mbw)] = s
+        store[(mbh, mbw)] = s
     return s
 
 

@@ -44,6 +44,11 @@ def supported_encoders() -> list[str]:
 
 
 def create_encoder(name: str, *, width: int, height: int, fps: int = 60, **kw):
+    # encoder (re)builds — including the resilience ladder's RESTART rung —
+    # reuse compiled executables across instances and process restarts
+    from selkies_tpu.utils.jaxcache import enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache()
     if name in _ALIASES:
         target = _ALIASES[name]
         logger.info("encoder %r aliased to %r (TPU-native equivalent)", name, target)

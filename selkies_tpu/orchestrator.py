@@ -24,6 +24,7 @@ import time
 
 from selkies_tpu.audio import AudioPipeline, open_best_audio_source, opus_available
 from selkies_tpu.config import Config, parse_config
+from selkies_tpu.resilience import get_injector
 from selkies_tpu.input_host import HostInput
 from selkies_tpu.input_host.resize import resize_display, set_cursor_size, set_dpi
 from selkies_tpu.monitoring import Metrics, SystemMonitor, TPUMonitor
@@ -43,7 +44,12 @@ from selkies_tpu.signalling.rtc_monitors import (
     fetch_turn_rest,
     make_turn_rtc_config_json_legacy,
 )
-from selkies_tpu.signalling.client import SignallingClient, SignallingErrorNoPeer
+from selkies_tpu.signalling.client import (
+    SignallingClient,
+    SignallingErrorNoPeer,
+    reconnect_backoff,
+    run_reconnect_loop,
+)
 from selkies_tpu.transport.congestion import GccController
 from selkies_tpu.transport.webrtc.transport import WebRTCTransport
 from selkies_tpu.transport.websocket import WebSocketTransport
@@ -101,11 +107,18 @@ def _first_ice_servers(stun_servers: str, turn_servers: str):
 
 class TransportMux:
     """One app-facing Transport fronting both byte planes: WebRTC when a
-    peer connection is up, the WebSocket fallback otherwise."""
+    peer connection is up, the WebSocket fallback otherwise.
 
-    def __init__(self, ws: WebSocketTransport, rtc: WebRTCTransport):
+    ``fault_site`` names this mux's send injection point for the
+    resilience harness (resilience/faultinject.py): solo mode uses
+    "send", fleet slots use "send:<k>" so a schedule can target one
+    session. With ``SELKIES_FAULTS`` unset the check is one None test."""
+
+    def __init__(self, ws: WebSocketTransport, rtc: WebRTCTransport,
+                 fault_site: str = "send"):
         self.ws = ws
         self.rtc = rtc
+        self.fault_site = fault_site
 
     @property
     def active(self):
@@ -125,8 +138,21 @@ class TransportMux:
     def send_data_channel(self, message: str) -> None:
         self._control.send_data_channel(message)
 
-    async def send_video(self, ef) -> None:
-        await self.active.send_video(ef)
+    async def send_video(self, ef) -> bool:
+        """Returns False when the frame did not reach the client (socket
+        gone, injected drop) so callers can count per-slot send failures;
+        transports that can't tell report None → success."""
+        fi = get_injector()
+        if fi is not None:
+            act = fi.check(self.fault_site)  # raises on a scheduled raise
+            if act is not None:
+                action, delay_ms = act
+                if action == "drop":
+                    return False
+                if action == "delay":
+                    await asyncio.sleep(delay_ms / 1000.0)
+        ok = await self.active.send_video(ef)
+        return ok is not False
 
     async def send_audio(self, ea) -> None:
         await self.active.send_audio(ea)
@@ -566,6 +592,9 @@ class Orchestrator:
             enable_basic_auth=bool(cfg.enable_basic_auth),
             basic_auth_user=cfg.basic_auth_user,
             basic_auth_password=cfg.basic_auth_password,
+            # a down signalling server sees decaying, jittered retries
+            # from inside connect(), not a fixed 2 s hammer
+            retry_backoff=reconnect_backoff(),
         )
         self.webrtc.on_sdp = client.send_sdp
         self.webrtc.on_ice = client.send_ice
@@ -592,16 +621,15 @@ class Orchestrator:
                 self._rearm_signalling.clear()
                 try:
                     await client.setup_call()
-                except Exception:
-                    pass
+                except Exception as exc:
+                    logger.warning("signalling re-arm failed: %r (will "
+                                   "retry on next re-arm)", exc)
 
         rearm = asyncio.get_running_loop().create_task(rearm_watch())
         try:
-            while True:
-                await client.connect()
-                await client.start()  # returns on disconnect
-                logger.info("internal signalling client disconnected; retrying")
-                await asyncio.sleep(2.0)
+            # shared reconnect loop: capped exponential backoff + jitter
+            # instead of a fixed 2 s beat (signalling/client.py)
+            await run_reconnect_loop(client, "internal signalling")
         finally:
             rearm.cancel()
             await client.stop()

@@ -45,7 +45,13 @@ from selkies_tpu.input_host import HostInput
 from selkies_tpu.models.h264.ratecontrol import CbrRateController
 from selkies_tpu.monitoring import Metrics, SystemMonitor, TPUMonitor
 from selkies_tpu.pipeline.elements import EncodedFrame, SyntheticSource
-from selkies_tpu.signalling.client import SignallingClient, SignallingErrorNoPeer
+from selkies_tpu.resilience import SlotSupervisor, get_injector
+from selkies_tpu.signalling.client import (
+    SignallingClient,
+    SignallingErrorNoPeer,
+    reconnect_backoff,
+    run_reconnect_loop,
+)
 from selkies_tpu.transport.congestion import GccController
 from selkies_tpu.transport.webrtc.transport import WebRTCTransport
 from selkies_tpu.transport.websocket import WebSocketTransport
@@ -79,7 +85,8 @@ class SessionSlot:
         # lazily from main(); fleet needs only the mux class)
         from selkies_tpu.orchestrator import TransportMux
 
-        self.transport = TransportMux(self.ws, self.webrtc)
+        self.transport = TransportMux(self.ws, self.webrtc,
+                                      fault_site=f"send:{index}")
         self.rc = CbrRateController(bitrate_kbps=bitrate_kbps, fps=fps)
         self.gcc: GccController | None = None
         self.input: HostInput | None = None
@@ -87,6 +94,9 @@ class SessionSlot:
         self.audio_lock = asyncio.Lock()  # serializes audio start/stop
         self.connected = False
         self.frames = 0
+        # resilience accounting (SessionFleet._run): consecutive counts
+        self.send_failures = 0
+        self.capture_failures = 0
         # cumulative (packetsLost, packetsReceived) from the last client
         # stats upload — interval loss for GCC on the WS plane
         self.last_loss_counters = (0.0, 0.0)
@@ -123,32 +133,101 @@ class SessionSlot:
         self._send("latency_measurement", {"latency_ms": ms})
 
 
+class _FleetRecovery:
+    """RecoveryActions for the batched fleet tick (resilience/supervisor).
+
+    The sharded step is lockstep, so rung actions are fleet-wide: the
+    force-IDR lands on every session (the failed tick may have corrupted
+    any reference plane), RESTART rebuilds the whole service, and the
+    degradation ladder sheds fps then swaps to the software service —
+    per-session resolution divergence is impossible in a lockstep batch
+    (docs/fleet.md), so the resolution rung maps to a second fps halving.
+    """
+
+    def __init__(self, fleet: "SessionFleet"):
+        self.fleet = fleet
+
+    def warn(self, msg: str) -> None:
+        logger.warning("%s", msg)
+
+    def force_idr(self) -> None:
+        for k in range(self.fleet.n):
+            self.fleet.service.force_keyframe(k)
+
+    def restart_encoder(self) -> None:
+        self.fleet.restart_service()
+
+    def degrade(self, level: int) -> None:
+        self.fleet.apply_degrade(level)
+
+    def undegrade(self, level: int) -> None:
+        self.fleet.apply_degrade(level)
+
+    def recycle(self) -> None:
+        self.fleet.recycle_sessions()
+
+
 class SessionFleet:
     """Media core for N sessions: one device tick, N output streams.
 
     ``sources`` is a list of per-session FrameSources (defaults to
     distinct SyntheticSources). The tick loop skips device work while no
     session has a client — an idle fleet costs no TPU time.
+
+    The loop is supervised (resilience/supervisor.py): tick failures climb
+    the recovery ladder — warn → batch force-IDR → service rebuild with
+    capped backoff → fps shedding / software-encoder fallback → session
+    recycle — and the loop itself never returns. Per-slot capture and send
+    failures are accounted separately so one poisoned session is ejected
+    (``on_slot_poisoned``) instead of taking the sharded batch down.
     """
+
+    # consecutive per-slot failures before the slot is ejected
+    SEND_FAILURE_LIMIT = 30
+    CAPTURE_FAILURE_LIMIT = 120
 
     def __init__(self, slots: list[SessionSlot], *, width: int, height: int,
                  fps: int, qp: int = 28, sources=None, devices=None,
-                 service=None):
+                 service=None, supervisor: SlotSupervisor | None = None):
         from selkies_tpu.parallel.serving import MultiSessionH264Service
 
         self.slots = slots
         self.n = len(slots)
         self.width, self.height, self.fps = width, height, fps
-        self.service = service or MultiSessionH264Service(
-            self.n, width, height, qp=qp, fps=fps, devices=devices)
+        self.base_fps = fps
+        self.qp = qp
+        self._devices = devices
+        self._make_tpu_service = lambda: MultiSessionH264Service(
+            self.n, width, height, qp=qp, fps=self.base_fps, devices=devices)
+        self.service = service or self._make_tpu_service()
+        self.software_mode = False
         self.sources = sources or [
             SyntheticSource(width, height, seed=k) for k in range(self.n)]
-        self._batch = np.empty((self.n, height, width, 4), np.uint8)
+        # zero-initialized, not np.empty: a slot whose FIRST capture fails
+        # rides "its previous frame", which must be black — never
+        # uninitialized heap memory encoded and sent to a client
+        self._batch = np.zeros((self.n, height, width, 4), np.uint8)
         self._geometry_warned: set[int] = set()
         self._task: asyncio.Task | None = None
+        self._watchdog_task: asyncio.Task | None = None
+        self.watchdog_interval = 1.0
+        # restart/tick serialization (both touched only on the event loop)
+        self._tick_in_flight = False
+        self._tick_started_at = 0.0
+        self._restart_pending = False
         self.ticks = 0
         self.last_tick_ms = 0.0
         self.on_tick = lambda device_ms: None  # monitoring tap
+        # a persistently-failing slot is ejected through this hook; the
+        # FleetOrchestrator rewires it to its disconnect path so transport
+        # teardown and signalling re-arm happen too
+        self.on_slot_poisoned = self._default_poison
+        self.supervisor = supervisor or SlotSupervisor(
+            "fleet", _FleetRecovery(self), fps=float(fps))
+
+    def _default_poison(self, k: int) -> None:
+        logger.error("session %d ejected (persistent failures)", k)
+        self.slots[k].connected = False
 
     # -- per-session controls (wired to slot transports/input) ---------
 
@@ -157,27 +236,128 @@ class SessionFleet:
 
     def set_session_bitrate(self, session: int, kbps: int) -> None:
         self.slots[session].rc.set_bitrate(int(kbps))
+        if hasattr(self.service, "set_bitrate"):
+            # degraded software mode: the encoder's own CBR takes the
+            # target directly (its set_qp is a no-op by design)
+            self.service.set_bitrate(session, int(kbps))
 
     # -- lifecycle -----------------------------------------------------
 
     async def start(self) -> None:
         if self._task is None:
-            self._task = asyncio.get_running_loop().create_task(self._run())
+            loop = asyncio.get_running_loop()
+            self._task = loop.create_task(self._run())
+            self._watchdog_task = loop.create_task(self._watchdog())
 
     async def stop(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
-            self._task = None
+        for attr in ("_task", "_watchdog_task"):
+            task = getattr(self, attr)
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+                setattr(self, attr, None)
         self.service.close()
 
-    def _capture_batch(self) -> None:
+    # -- recovery ladder plumbing (called via _FleetRecovery) ----------
+
+    # a tick stalled longer than this is treated as WEDGED: the restart is
+    # applied under it (its thread raises into the tick try/except and is
+    # counted) — deferring forever would make the watchdog a no-op for the
+    # exact scenario it exists for
+    FORCE_RESTART_STALL_S = 20.0
+
+    def restart_service(self) -> None:
+        """Rebuild the encode service. A tick mid-flight in the worker
+        thread (the watchdog escalates on slow-but-alive ticks too) only
+        REQUESTS the swap — closing the live service under the encode
+        would abort the pack mid-frame — unless the tick has been stuck
+        past FORCE_RESTART_STALL_S, in which case the swap is forced: a
+        wedged device call never returns to apply a pending restart."""
+        if self._tick_in_flight:
+            stalled = time.monotonic() - self._tick_started_at
+            if stalled < self.FORCE_RESTART_STALL_S:
+                self._restart_pending = True
+                logger.warning("fleet service restart requested (tick in "
+                               "flight %.1fs; applying after it returns)",
+                               stalled)
+                return
+            logger.error("fleet tick wedged for %.1fs; forcing service "
+                         "restart under it", stalled)
+        self._do_restart_service()
+
+    def _do_restart_service(self) -> None:
+        from selkies_tpu.parallel.serving import SoftwareFleetService
+
+        self._restart_pending = False
+        old = self.service
+        logger.warning("rebuilding fleet service (software_mode=%s)",
+                       self.software_mode)
+        if self.software_mode:
+            self.service = SoftwareFleetService(
+                self.n, self.width, self.height, qp=self.qp,
+                fps=max(1, int(self.fps)),
+                bitrate_kbps=[int(s.rc.bitrate_kbps) for s in self.slots])
+        else:
+            self.service = self._make_tpu_service()
+        try:
+            old.close()
+        except Exception:
+            logger.exception("closing failed fleet service")
+
+    def apply_degrade(self, level: int) -> None:
+        """Converge to degradation ``level``: 0 = full rate TPU service,
+        1 = half fps, 2 = quarter fps (the lockstep batch cannot diverge
+        resolution per session), 3 = quarter fps + software encoders."""
+        new_fps = max(1, self.base_fps // (2 ** min(level, 2)))
+        software = level >= 3
+        if new_fps != self.fps:
+            logger.warning("fleet fps %s -> %s (degrade level %d)",
+                           self.fps, new_fps, level)
+            self.fps = new_fps
+            for slot in self.slots:
+                slot.rc.set_framerate(new_fps)
+        if software != self.software_mode:
+            self.software_mode = software
+            self.restart_service()
+
+    def recycle_sessions(self) -> None:
+        """Last rung: eject every connected client (they reconnect into a
+        fresh session) and rebuild the service."""
+        for k, slot in enumerate(self.slots):
+            if slot.connected:
+                self.on_slot_poisoned(k)
+        self.restart_service()
+
+    async def _watchdog(self) -> None:
+        """Tick-deadline watchdog: catches a *silent* stall (a device call
+        that neither returns nor raises keeps _run awaiting and unable to
+        report), escalating through the same ladder."""
+        while True:
+            await asyncio.sleep(self.watchdog_interval)
+            if any(s.connected for s in self.slots):
+                self.supervisor.check_deadline()
+            else:
+                self.supervisor.note_idle()
+
+    def _capture_batch(self) -> list[tuple[int, Exception]]:
+        """Capture every session's frame. A source that throws (X server
+        died, injected fault) keeps its slot's PREVIOUS frame in the batch
+        and is reported to the caller — one session's dead display must
+        not take the lockstep batch down. Returns [(slot, exc), ...]."""
         h, w = self.height, self.width
+        fi = get_injector()
+        failed: list[tuple[int, Exception]] = []
         for k, src in enumerate(self.sources):
-            frame = src.capture()
+            try:
+                if fi is not None:
+                    fi.check(f"capture:{k}")
+                frame = src.capture()
+            except Exception as exc:
+                failed.append((k, exc))
+                continue
             if frame.shape[:2] == (h, w):
                 self._batch[k] = frame
                 continue
@@ -193,20 +373,67 @@ class SessionFleet:
             fh, fw = min(h, frame.shape[0]), min(w, frame.shape[1])
             self._batch[k] = 0
             self._batch[k, :fh, :fw] = frame[:fh, :fw]
+        return failed
 
     def _encode_tick(self) -> tuple[list[bytes], list[bool], list[int], float]:
         t0 = time.perf_counter()
+        fi = get_injector()
+        if fi is not None:
+            fi.check("encoder")
+        # snapshot: a supervisor-driven restart may swap self.service
+        # while this runs on the worker thread; qps, AUs and idr flags
+        # must all come from the SAME service instance
+        service = self.service
         qps = [slot.rc.frame_qp() for slot in self.slots]
         for k, qp in enumerate(qps):
-            self.service.set_qp(k, qp)
-        aus = self.service.encode_tick(self._batch)
-        return (aus, list(self.service.last_idrs), qps,
+            service.set_qp(k, qp)
+        aus = service.encode_tick(self._batch)
+        return (aus, list(service.last_idrs), qps,
                 (time.perf_counter() - t0) * 1e3)
+
+    def _note_capture_failures(self, failed: list[tuple[int, Exception]]) -> None:
+        """Per-slot capture accounting: transient faults ride on the slot's
+        previous frame; a persistently dead source ejects the slot."""
+        failed_slots = {k for k, _ in failed}
+        for k, exc in failed:
+            slot = self.slots[k]
+            slot.capture_failures += 1
+            if slot.capture_failures == 1 or slot.capture_failures % 60 == 0:
+                logger.warning("session %d capture failure #%d: %r",
+                               k, slot.capture_failures, exc)
+            if (slot.capture_failures >= self.CAPTURE_FAILURE_LIMIT
+                    and slot.connected):
+                logger.error("session %d capture dead (%d consecutive); "
+                             "ejecting slot", k, slot.capture_failures)
+                self.on_slot_poisoned(k)
+                slot.capture_failures = 0
+        for k, slot in enumerate(self.slots):
+            if k not in failed_slots:
+                slot.capture_failures = 0
+
+    def _note_send_result(self, k: int, result) -> None:
+        """Per-slot send accounting from the gather results (previously
+        discarded): count failures, log them, eject persistent failers."""
+        slot = self.slots[k]
+        if isinstance(result, BaseException) or result is False:
+            slot.send_failures += 1
+            if isinstance(result, BaseException):
+                logger.warning("session %d send failure #%d: %r",
+                               k, slot.send_failures, result)
+            elif slot.send_failures == 1 or slot.send_failures % 30 == 0:
+                logger.info("session %d send refused #%d (client gone?)",
+                            k, slot.send_failures)
+            if slot.send_failures >= self.SEND_FAILURE_LIMIT and slot.connected:
+                logger.error("session %d persistently failing sends (%d); "
+                             "ejecting slot", k, slot.send_failures)
+                self.on_slot_poisoned(k)
+                slot.send_failures = 0
+        else:
+            slot.send_failures = 0
 
     async def _run(self) -> None:
         next_tick = time.monotonic()
         t0 = next_tick
-        failures = 0
         while True:
             now = time.monotonic()
             if now < next_tick:
@@ -214,17 +441,28 @@ class SessionFleet:
             next_tick = max(next_tick + 1.0 / self.fps,
                             time.monotonic() - 0.5 / self.fps)
             if not any(s.connected for s in self.slots):
+                self.supervisor.note_idle()
                 continue  # idle fleet: no capture, no device work
             try:
-                await asyncio.to_thread(self._capture_batch)
+                if self._restart_pending:
+                    self._do_restart_service()
+                self._tick_in_flight = True
+                self._tick_started_at = time.monotonic()
+                capture_failed = await asyncio.to_thread(self._capture_batch)
+                self._note_capture_failures(capture_failed)
+                if len(capture_failed) == self.n and self.ticks == 0:
+                    # no slot has EVER captured: the batch is still all-
+                    # black — count and retry rather than stream nothing
+                    raise capture_failed[0][1]
                 aus, idrs, qps, tick_ms = await asyncio.to_thread(self._encode_tick)
                 self.ticks += 1
                 self.last_tick_ms = tick_ms
                 self.on_tick(tick_ms)
                 ts = int((time.monotonic() - t0) * 90000)
                 wall = time.time()
-                sends = []
-                for slot, au, idr, qp in zip(self.slots, aus, idrs, qps):
+                sends: list[tuple[int, object]] = []  # (slot index, coroutine)
+                for k, (slot, au, idr, qp) in enumerate(
+                        zip(self.slots, aus, idrs, qps)):
                     slot.rc.update(len(au), idr=idr)
                     if not slot.connected:
                         continue
@@ -236,18 +474,25 @@ class SessionFleet:
                         pack_ms=0.0,
                     )
                     slot.frames += 1
-                    sends.append(slot.transport.send_video(ef))
+                    sends.append((k, slot.transport.send_video(ef)))
                 if sends:
-                    await asyncio.gather(*sends, return_exceptions=True)
-                failures = 0
+                    results = await asyncio.gather(
+                        *(coro for _, coro in sends), return_exceptions=True)
+                    for (k, _), result in zip(sends, results):
+                        self._note_send_result(k, result)
+                self.supervisor.tick_ok()
             except asyncio.CancelledError:
                 raise
-            except Exception:
-                failures += 1
-                logger.exception("fleet tick error (%d consecutive)", failures)
-                if failures >= 30:
-                    logger.error("fleet loop giving up after %d failures", failures)
-                    return
+            except Exception as exc:
+                # the supervisor escalates (warn → IDR → service rebuild →
+                # degrade → recycle); the loop itself NEVER returns — a
+                # poisoned tick must degrade quality, not availability
+                logger.exception("fleet tick error (%d consecutive)",
+                                 self.supervisor.failures + 1)
+                self._tick_in_flight = False
+                self.supervisor.failure(exc)
+            finally:
+                self._tick_in_flight = False
 
 
 def dryrun(n_devices: int) -> None:
@@ -336,6 +581,12 @@ class FleetOrchestrator:
             sources=sources, devices=devices, service=service,
         )
         self._wire_audio()
+        # a poisoned slot (persistent capture/send failures, recycle rung)
+        # goes through the full disconnect path: transport teardown, input
+        # reset, signalling re-arm — the client reconnects into a fresh
+        # session instead of staring at a frozen canvas
+        self.fleet.on_slot_poisoned = (
+            lambda k: self._slot_disconnected(k, self.slots[k]))
         self.server = make_signalling_server(cfg)
         # /media/<k> per session; bare /media aliases session 0 so the
         # stock solo client works against a fleet server
@@ -596,6 +847,10 @@ class FleetOrchestrator:
             enable_basic_auth=bool(cfg.enable_basic_auth),
             basic_auth_user=cfg.basic_auth_user,
             basic_auth_password=cfg.basic_auth_password,
+            # decaying, jittered retries inside connect() too — N slots
+            # hammering a dead server on one fixed beat is the fleet-
+            # sized thundering herd
+            retry_backoff=reconnect_backoff(),
         )
         slot.webrtc.on_sdp = client.send_sdp
         slot.webrtc.on_ice = client.send_ice
@@ -619,15 +874,17 @@ class FleetOrchestrator:
                 self._rearm[k].clear()
                 try:
                     await client.setup_call()
-                except Exception:
-                    pass
+                except Exception as exc:
+                    logger.warning(
+                        "session %d signalling re-arm failed: %r "
+                        "(will retry on next re-arm)", k, exc)
 
         rearm = asyncio.get_running_loop().create_task(rearm_watch())
         try:
-            while True:
-                await client.connect()
-                await client.start()
-                await asyncio.sleep(2.0)
+            # shared reconnect loop with backoff + jitter — N slots
+            # hammering a dead server on one fixed beat would be the
+            # fleet-sized thundering herd (signalling/client.py)
+            await run_reconnect_loop(client, f"session {k} signalling")
         finally:
             rearm.cancel()
             await client.stop()

@@ -26,7 +26,7 @@ from selkies_tpu.models.h264.native import pack_slice_fast, pack_slice_p_fast
 from selkies_tpu.models.h264.numpy_ref import FrameCoeffs, PFrameCoeffs
 from selkies_tpu.parallel.sessions import MultiSessionEncoder
 
-__all__ = ["MultiSessionH264Service"]
+__all__ = ["MultiSessionH264Service", "SoftwareFleetService"]
 
 
 class _SessionState:
@@ -53,6 +53,11 @@ class MultiSessionH264Service:
 
     def __init__(self, n_sessions: int, width: int, height: int, *,
                  qp: int = 28, fps: int = 60, devices=None):
+        from selkies_tpu.utils.jaxcache import enable_persistent_compilation_cache
+
+        # service rebuilds (the fleet supervisor's RESTART rung) reload the
+        # sharded step from the disk cache instead of recompiling
+        enable_persistent_compilation_cache()
         self.enc = MultiSessionEncoder(n_sessions, width, height, devices=devices)
         self.n = n_sessions
         # per-session IDR flags of the most recent tick (the serving loop
@@ -155,3 +160,73 @@ class MultiSessionH264Service:
 
     def close(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)
+
+
+class SoftwareFleetService:
+    """Degraded-mode fleet service: N independent software encoders behind
+    the MultiSessionH264Service interface (encode_tick / set_qp /
+    force_keyframe / last_idrs / close).
+
+    The resilience ladder's last load-shedding rung (resilience/
+    supervisor.py): when the sharded TPU step is persistently failing, the
+    fleet swaps this in so sessions keep streaming off the CPU x264 row
+    (models/x264enc.py; the registry degrades that to solo TPU encoders
+    when libx264 is absent). Slower and lockstep-unsharded, but alive.
+    """
+
+    def __init__(self, n_sessions: int, width: int, height: int, *,
+                 qp: int = 28, fps: int = 60,
+                 bitrate_kbps: int | list[int] = 2000,
+                 encoder: str = "x264enc"):
+        from selkies_tpu.models.registry import create_encoder
+
+        self.n = n_sessions
+        # per-session bitrates: each slot's CBR/GCC target carries over
+        # into degraded mode (a scalar applies to every session)
+        if isinstance(bitrate_kbps, int):
+            bitrate_kbps = [bitrate_kbps] * n_sessions
+        self.encoders = [
+            create_encoder(encoder, width=width, height=height, fps=fps,
+                           bitrate_kbps=int(bitrate_kbps[i]), qp=qp)
+            for i in range(n_sessions)
+        ]
+        self._qps = [qp] * n_sessions
+        self.last_idrs: list[bool] = [True] * n_sessions
+        self._pool = ThreadPoolExecutor(max_workers=n_sessions,
+                                        thread_name_prefix="sw-fleet")
+
+    def set_qp(self, session: int, qp: int) -> None:
+        self._qps[session] = int(qp)
+        enc = self.encoders[session]
+        if hasattr(enc, "set_qp"):
+            enc.set_qp(int(qp))
+
+    def set_bitrate(self, session: int, kbps: int) -> None:
+        """Live per-session rate retarget (x264's CBR owns the quantizer,
+        so the GCC/client drive lands here, not in set_qp)."""
+        enc = self.encoders[session]
+        if hasattr(enc, "set_bitrate"):
+            enc.set_bitrate(int(kbps))
+
+    def force_keyframe(self, session: int) -> None:
+        self.encoders[session].force_keyframe()
+
+    def encode_tick(self, frames: np.ndarray) -> list[bytes]:
+        if frames.shape[0] != self.n:
+            raise ValueError(f"expected {self.n} frames, got {frames.shape[0]}")
+
+        def _one(i: int) -> bytes:
+            return self.encoders[i].encode_frame(frames[i], self._qps[i])
+
+        aus = list(self._pool.map(_one, range(self.n)))
+        self.last_idrs = [bool(e.last_stats.idr) for e in self.encoders]
+        return aus
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)
+        for enc in self.encoders:
+            if hasattr(enc, "close"):
+                try:
+                    enc.close()
+                except Exception:  # noqa: silent-except-audited — best-effort teardown
+                    pass

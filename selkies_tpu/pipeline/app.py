@@ -20,15 +20,78 @@ import asyncio
 import base64
 import json
 import logging
+import time
 from typing import Any, Awaitable, Callable, Protocol
 
 from selkies_tpu.models.registry import create_encoder, encoder_exists
 from selkies_tpu.models.h264.ratecontrol import CbrRateController
-from selkies_tpu.pipeline.elements import EncodedFrame, FrameSource, SyntheticSource, VideoPipeline
+from selkies_tpu.pipeline.elements import (
+    DownscaleSource,
+    EncodedFrame,
+    FrameSource,
+    SyntheticSource,
+    VideoPipeline,
+)
+from selkies_tpu.resilience import SlotSupervisor
 
 logger = logging.getLogger("tpuwebrtc_app")
 
 DEFAULT_VIDEO_BITRATE_KBPS = 2000
+
+SOFTWARE_FALLBACK_ENCODER = "x264enc"
+
+REBUILD_RETRY_S = 2.0  # min seconds between retries of a failing rebuild
+
+
+class _AppRecovery:
+    """RecoveryActions for the solo session (resilience/supervisor.py).
+
+    Degradation ladder: level 1 halves the tick rate, level 2 wraps the
+    source in a 2x DownscaleSource (the pipeline's geometry-change path
+    rebuilds the encoder at the reduced size on the next frame), level 3
+    swaps to the software x264 row. Reversal walks the same steps back
+    after sustained health."""
+
+    def __init__(self, app: "TPUWebRTCApp"):
+        self.app = app
+        self._pre_degrade_fps: int | None = None
+
+    def warn(self, msg: str) -> None:
+        logger.warning("%s", msg)
+
+    def force_idr(self) -> None:
+        self.app.force_keyframe()
+
+    def restart_encoder(self) -> None:
+        self.app._restart_encoder()
+
+    def degrade(self, level: int) -> None:
+        app = self.app
+        if level == 1:
+            self._pre_degrade_fps = int(app.framerate)
+            app.set_framerate(max(1, int(app.framerate) // 2))
+            app.send_framerate(int(app.framerate))
+        elif level == 2:
+            if app.pipeline is not None and not isinstance(
+                    app.pipeline.source, DownscaleSource):
+                app.pipeline.source = DownscaleSource(app.source)
+        elif level >= 3:
+            app._enter_software_fallback()
+
+    def undegrade(self, level: int) -> None:
+        app = self.app
+        if level < 3:
+            app._exit_software_fallback()
+        if level < 2 and app.pipeline is not None and isinstance(
+                app.pipeline.source, DownscaleSource):
+            app.pipeline.source = app.source
+        if level < 1 and self._pre_degrade_fps:
+            app.set_framerate(self._pre_degrade_fps)
+            app.send_framerate(self._pre_degrade_fps)
+            self._pre_degrade_fps = None
+
+    def recycle(self) -> None:
+        self.app._schedule_recycle()
 
 
 class Transport(Protocol):
@@ -43,6 +106,8 @@ class Transport(Protocol):
 
 
 class TPUWebRTCApp:
+    REBUILD_RETRY_S = REBUILD_RETRY_S
+
     def __init__(
         self,
         source: FrameSource | None = None,
@@ -77,6 +142,13 @@ class TPUWebRTCApp:
                                       bitrate_kbps=int(video_bitrate_kbps))
         self.rc = CbrRateController(bitrate_kbps=video_bitrate_kbps, fps=framerate)
         self.pipeline: VideoPipeline | None = None
+        # per-session supervisor: one instance for the app's lifetime so
+        # restart backoff and degradation state survive pipeline recycles
+        self.supervisor = SlotSupervisor(
+            "session", _AppRecovery(self), fps=float(framerate))
+        self.software_fallback = False
+        self._rebuild_failed: tuple = (None, 0.0)  # (geometry, monotonic)
+        self._recycle_task: asyncio.Task | None = None
 
         # callbacks wired by the orchestrator (__main__.py parity :684-871)
         self.on_sdp: Callable[[str, str], None] = lambda t, s: None
@@ -91,6 +163,11 @@ class TPUWebRTCApp:
     # lifecycle (reference :1759, :1810)
 
     async def start_pipeline(self) -> None:
+        if self.pipeline is not None:
+            # never orphan a live pipeline's tasks: a session restart that
+            # lands while a supervisor recycle is mid-flight must replace,
+            # not leak, the previous ticker/sender/watchdog
+            await self.stop_pipeline()
         logger.info(
             "starting pipeline: %s %dx%d@%d, %d kbps",
             self.encoder_name, self.source.width, self.source.height, self.framerate, self.video_bitrate_kbps,
@@ -108,24 +185,172 @@ class TPUWebRTCApp:
             fps=self.framerate,
         )
         self.pipeline.on_geometry_change = self._rebuild_encoder
+        self.pipeline.supervisor = self.supervisor
         await self.pipeline.start()
 
     async def stop_pipeline(self) -> None:
+        # an external stop (client disconnect) owns teardown: a pending
+        # supervisor recycle must not resurrect the pipeline afterwards
+        t = self._recycle_task
+        if t is not None and not t.done() and t is not asyncio.current_task():
+            t.cancel()
+            self._recycle_task = None
         if self.pipeline is not None:
             await self.pipeline.stop()
             self.pipeline = None
             logger.info("pipeline stopped")
 
+    def _active_encoder_name(self) -> str:
+        return (SOFTWARE_FALLBACK_ENCODER if self.software_fallback
+                else self.encoder_name)
+
     def _rebuild_encoder(self, width: int, height: int):
         """Display geometry changed (xrandr resize): new encoder + SPS/PPS
         at the new size (the reference tears down and rebuilds the whole
-        GStreamer pipeline for this; our encoder is the only sized stage)."""
-        logger.info("rebuilding %s for %dx%d", self.encoder_name, width, height)
-        self.encoder = create_encoder(
-            self.encoder_name, width=width, height=height, fps=self.framerate,
-            bitrate_kbps=int(self.video_bitrate_kbps),
-        )
+        GStreamer pipeline for this; our encoder is the only sized stage).
+
+        If construction throws the PREVIOUS encoder stays wired — the
+        stream keeps flowing at the old geometry (frames are dropped until
+        the size settles) instead of the pipeline dying mid-resize — and
+        the failure is reported on the data channel."""
+        name = self._active_encoder_name()
+        # rate-limit retries of a failing rebuild: the pipeline calls this
+        # every tick while the frame geometry mismatches, and re-attempting
+        # construction (plus a data-channel error) 60x/s helps nobody
+        failed_geom, failed_at = self._rebuild_failed
+        if (width, height) == failed_geom and \
+                time.monotonic() - failed_at < self.REBUILD_RETRY_S:
+            return self.encoder
+        logger.info("rebuilding %s for %dx%d", name, width, height)
+        try:
+            self.encoder = create_encoder(
+                name, width=width, height=height, fps=self.framerate,
+                bitrate_kbps=int(self.video_bitrate_kbps),
+            )
+            self._rebuild_failed = (None, 0.0)
+        except Exception as exc:
+            self._rebuild_failed = ((width, height), time.monotonic())
+            logger.exception("encoder rebuild for %dx%d failed; keeping the "
+                             "previous %dx%d encoder", width, height,
+                             self.encoder.width, self.encoder.height)
+            self._send("error", {
+                "message": (f"resize to {width}x{height} failed ({exc!r}); "
+                            f"continuing at {self.encoder.width}x"
+                            f"{self.encoder.height}")})
         return self.encoder
+
+    # ------------------------------------------------------------------
+    # recovery ladder plumbing (called via _AppRecovery / the supervisor)
+
+    def _swap_encoder(self, name: str, width: int, height: int) -> bool:
+        """Replace the live encoder in place (same geometry contract as
+        the ladder caller established). Keeps the old encoder when
+        construction fails; True on success."""
+        try:
+            new = create_encoder(
+                name, width=width, height=height, fps=self.framerate,
+                bitrate_kbps=int(self.video_bitrate_kbps))
+        except Exception as exc:
+            logger.exception("encoder swap to %s failed; keeping current", name)
+            self._send("error", {"message": f"encoder swap failed: {exc!r}"})
+            return False
+        old = self.encoder
+        self.encoder = new
+        if self.pipeline is not None:
+            self.pipeline.encoder = new
+        if old is not new:
+            self._dispose_encoder(old)
+        self.encoder.force_keyframe()
+        self.send_codec()  # the fallback row may negotiate a new bitstream
+        return True
+
+    def _dispose_encoder(self, old) -> None:
+        """Close a replaced encoder — but not under a worker thread that
+        may still be inside its encode (a watchdog-triggered swap races
+        the in-flight tick; closing libx264 mid-encode is native UB).
+        Deferred close polls until the tick finishes, with a hard 30 s
+        cap for permanently wedged calls."""
+        if not hasattr(old, "close"):
+            return
+        pipe = self.pipeline
+        if pipe is None or not getattr(pipe, "_tick_in_flight", False):
+            try:
+                old.close()
+            except Exception:
+                logger.exception("closing replaced encoder")
+            return
+
+        async def _close_when_idle() -> None:
+            for _ in range(300):
+                if self.pipeline is not pipe or not pipe._tick_in_flight:
+                    break
+                await asyncio.sleep(0.1)
+            try:
+                old.close()
+            except Exception:
+                logger.exception("closing replaced encoder (deferred)")
+
+        try:
+            asyncio.get_running_loop().create_task(_close_when_idle())
+        except RuntimeError:  # no loop (sync caller in tests)
+            try:
+                old.close()
+            except Exception:
+                logger.exception("closing replaced encoder")
+
+    def _restart_encoder(self) -> None:
+        """Ladder rung 3: same row, fresh instance — recovers encoders
+        whose device state is poisoned (stale executables, wedged worker
+        pools) without touching geometry or codec."""
+        enc = self.encoder
+        src = self.pipeline.source if self.pipeline is not None else self.source
+        self._swap_encoder(self._active_encoder_name(),
+                           getattr(enc, "width", src.width),
+                           getattr(enc, "height", src.height))
+
+    def _enter_software_fallback(self) -> None:
+        if self.software_fallback:
+            return
+        w, h = self.encoder.width, self.encoder.height
+        logger.warning("falling back to the software %s row at %dx%d",
+                       SOFTWARE_FALLBACK_ENCODER, w, h)
+        if self._swap_encoder(SOFTWARE_FALLBACK_ENCODER, w, h):
+            self.software_fallback = True
+
+    def _exit_software_fallback(self) -> None:
+        if not self.software_fallback:
+            return
+        src = self.pipeline.source if self.pipeline is not None else self.source
+        logger.info("restoring the %s row", self.encoder_name)
+        if self._swap_encoder(self.encoder_name, src.width, src.height):
+            self.software_fallback = False
+
+    def _schedule_recycle(self) -> None:
+        """Last rung: rebuild the whole pipeline. Scheduled as a task —
+        the supervisor calls this from inside the pipeline loop it is
+        about to tear down."""
+
+        async def _recycle() -> None:
+            logger.error("recycling video pipeline")
+            await self.stop_pipeline()
+            src = self.source
+            # the fresh pipeline must come back AT the supervisor's
+            # current degradation level, not silently undegraded — the
+            # overload that climbed the ladder is usually still there
+            # (fps shedding lives in self.framerate and the software
+            # fallback in _active_encoder_name, both already persistent;
+            # only the source downscale needs re-applying)
+            if self.supervisor.degrade_level >= 2:
+                src = DownscaleSource(self.source)
+            self._swap_encoder(self._active_encoder_name(),
+                               src.width, src.height)
+            await self.start_pipeline()
+            if self.supervisor.degrade_level >= 2 and self.pipeline is not None:
+                self.pipeline.source = src
+
+        if self._recycle_task is not None and not self._recycle_task.done():
+            return  # one recycle at a time
+        self._recycle_task = asyncio.get_running_loop().create_task(_recycle())
 
     async def _video_sink(self, ef: EncodedFrame) -> None:
         self.on_frame(ef)

@@ -21,6 +21,7 @@ from typing import Awaitable, Callable, Protocol
 import numpy as np
 
 from selkies_tpu.monitoring.tracing import tracer
+from selkies_tpu.resilience.faultinject import get_injector
 
 logger = logging.getLogger("pipeline")
 
@@ -124,6 +125,31 @@ def window_move_trace(width: int, height: int, n: int, *, tile_w: int | None = N
     return frames
 
 
+class DownscaleSource:
+    """2x subsampling wrapper around a FrameSource — the recovery ladder's
+    resolution step-down (resilience/supervisor.py rung 4 level 2): the
+    pipeline sees half-size frames, its geometry-change machinery rebuilds
+    the encoder at the reduced size, and unwrapping restores full
+    resolution the same way. Output stays macroblock-aligned (16) so the
+    H.264 rows take it without padding."""
+
+    def __init__(self, inner: FrameSource):
+        self.inner = inner
+
+    @property
+    def width(self) -> int:
+        return max(16, (self.inner.width // 2) // 16 * 16)
+
+    @property
+    def height(self) -> int:
+        return max(16, (self.inner.height // 2) // 16 * 16)
+
+    def capture(self) -> np.ndarray:
+        frame = self.inner.capture()
+        h, w = self.height, self.width
+        return np.ascontiguousarray(frame[: 2 * h : 2, : 2 * w : 2])
+
+
 @dataclass
 class EncodedFrame:
     au: bytes
@@ -158,8 +184,17 @@ class VideoPipeline:
         # called with (width, height) when source geometry changes; returns
         # a fresh encoder for the new size (wired by TPUWebRTCApp)
         self.on_geometry_change: Callable[[int, int], object] | None = None
+        # optional SlotSupervisor (resilience/supervisor.py), wired by
+        # TPUWebRTCApp: with one attached the loop NEVER gives up — tick
+        # failures climb the recovery ladder instead
+        self.supervisor = None
         self._task: asyncio.Task | None = None
         self._sender: asyncio.Task | None = None
+        self._watchdog: asyncio.Task | None = None
+        # True while a capture/encode is awaited on the worker thread;
+        # the app's encoder-swap path reads it to defer closing an
+        # encoder that may still be executing (pipeline/app.py)
+        self._tick_in_flight = False
         # ordered handoff to the sender task: every ENCODED frame must be
         # sent (dropping a P frame mid-chain would desync the decoder's
         # reference chain); a slow sink instead backpressures pre-encode —
@@ -188,9 +223,20 @@ class VideoPipeline:
             return
         self._task = asyncio.create_task(self._run(), name="video-pipeline")
         self._sender = asyncio.create_task(self._send_loop(), name="video-sender")
+        if self.supervisor is not None:
+            self._watchdog = asyncio.create_task(
+                self._watchdog_loop(), name="video-watchdog")
+
+    async def _watchdog_loop(self) -> None:
+        """Tick-deadline watchdog: a capture/encode call that neither
+        returns nor raises keeps _run silent — escalate through the same
+        ladder so the stall is at least acted on (IDR, encoder restart)."""
+        while True:
+            await asyncio.sleep(1.0)
+            self.supervisor.check_deadline()
 
     async def stop(self) -> None:
-        for attr in ("_task", "_sender"):
+        for attr in ("_task", "_sender", "_watchdog"):
             task = getattr(self, attr)
             if task is not None:
                 task.cancel()
@@ -207,6 +253,7 @@ class VideoPipeline:
         next_tick = t0
         failures = 0
         while True:
+            self._tick_in_flight = False
             now = time.monotonic()
             if now < next_tick:
                 await asyncio.sleep(next_tick - now)
@@ -214,11 +261,20 @@ class VideoPipeline:
 
             if len(self._outbox) >= self.outbox_depth:
                 # sink can't keep up: skip this capture tick (pre-encode
-                # drop keeps the encoded P-chain gapless)
+                # drop keeps the encoded P-chain gapless). This is
+                # TRANSPORT backpressure, not an encoder stall — refresh
+                # the supervisor's deadline clock or a wedged client
+                # would trigger pointless encoder restarts/degradation
+                if self.supervisor is not None:
+                    self.supervisor.note_idle()
                 self.dropped_frames += 1
                 tracer.instant("frame-drop")
                 continue
             try:
+                fi = get_injector()
+                if fi is not None:
+                    fi.check("capture")
+                self._tick_in_flight = True
                 with tracer.span("capture"):
                     frame = await asyncio.to_thread(self.source.capture)
                 if frame.shape[:2] != (self.encoder.height, self.encoder.width):
@@ -237,8 +293,18 @@ class VideoPipeline:
                         # drain + stop the old encoder's worker pool; its
                         # in-flight frames are stale-geometry, discard them
                         await asyncio.to_thread(old.close)
+                    if frame.shape[:2] != (self.encoder.height, self.encoder.width):
+                        # rebuild failed (handler kept the last-good
+                        # encoder): DROP the mismatched frame instead of
+                        # feeding it to the wrong-geometry encoder — that
+                        # would turn one failed resize into a per-tick
+                        # encode exception and climb the recovery ladder
+                        self.dropped_frames += 1
+                        continue
                 qp = self.rc.frame_qp()
                 ts = int((time.monotonic() - t0) * 90000)
+                if fi is not None:
+                    fi.check("encoder")
                 if hasattr(self.encoder, "submit"):
                     # pipelined path: dispatch this frame, emit whichever
                     # earlier frames completed (device latency hidden)
@@ -276,12 +342,19 @@ class VideoPipeline:
                     self.rc.update(len(ef.au), idr=ef.idr or ef.scene_cut)
                 self.frames += len(efs)
                 failures = 0
+                if self.supervisor is not None:
+                    self.supervisor.tick_ok()
             except asyncio.CancelledError:
                 raise
-            except Exception:
+            except Exception as exc:
                 failures += 1
                 logger.exception("video pipeline frame error (%d consecutive)", failures)
-                if failures >= self.MAX_CONSECUTIVE_FAILURES:
+                if self.supervisor is not None:
+                    # supervised: the ladder handles escalation (force IDR,
+                    # encoder restart, degradation, recycle) and the loop
+                    # NEVER gives up — a dead loop freezes the client
+                    self.supervisor.failure(exc)
+                elif failures >= self.MAX_CONSECUTIVE_FAILURES:
                     logger.error("video pipeline giving up after %d failures", failures)
                     return
                 continue

@@ -1,0 +1,34 @@
+"""Session resilience: supervised recovery + deterministic fault injection.
+
+The serving loops (solo VideoPipeline, fleet SessionFleet) used to be
+crash-fragile: 30 consecutive tick failures and the loop returned, leaving
+every connected client frozen. This package gives each serving slot a
+supervisor with an escalation ladder (warn → force IDR → restart encoder
+with capped backoff → graceful degradation → recycle the session) and a
+seeded fault-injection harness (``SELKIES_FAULTS``) so the ladder is
+exercised deterministically in tests instead of only in production.
+"""
+
+from selkies_tpu.resilience.faultinject import (
+    FaultInjector,
+    InjectedFault,
+    configure_faults,
+    get_injector,
+    reset_faults,
+)
+from selkies_tpu.resilience.supervisor import (
+    Backoff,
+    Rung,
+    SlotSupervisor,
+)
+
+__all__ = [
+    "Backoff",
+    "FaultInjector",
+    "InjectedFault",
+    "Rung",
+    "SlotSupervisor",
+    "configure_faults",
+    "get_injector",
+    "reset_faults",
+]

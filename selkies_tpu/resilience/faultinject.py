@@ -1,0 +1,218 @@
+"""Deterministic, seeded fault injection for the serving path.
+
+Configured with the ``SELKIES_FAULTS`` environment variable (or
+programmatically via :func:`configure_faults`); when unset the hot paths
+pay one ``None`` check and nothing else, and the encoded streams are
+byte-identical to an injection-free build.
+
+Grammar (semicolon-separated rules)::
+
+    SELKIES_FAULTS = rule (";" rule)*
+    rule   = site "@" sched ":" action
+    site   = capture | encoder | send | signalling   (wired sites; free-form)
+    sched  = tick list / ranges  "5,9,13" or "20-22" or "5,9,20-22"
+           | "every:N"           every Nth call (1-based)
+           | "p:0.01[,seed:N]"   seeded Bernoulli per call (deterministic)
+    action = raise | drop | delay:<ms> | flap
+
+Examples::
+
+    SELKIES_FAULTS='encoder@5,9,13:raise'            three encoder-tick crashes
+    SELKIES_FAULTS='send@20-24:drop'                 five dropped video sends
+    SELKIES_FAULTS='signalling@2:flap'               one signalling flap
+    SELKIES_FAULTS='capture@p:0.01,seed:7:raise'     1% seeded capture faults
+
+Each call site bumps a per-site tick counter, so schedules are exact and
+reproducible: the same spec against the same workload injects at the same
+ticks every run. Sites are matched by exact name or by prefix before a
+``:`` qualifier (a rule for ``send`` also matches ``send:3``, with a
+separate counter per qualified site — one schedule, per-slot clocks).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import re
+import threading
+
+logger = logging.getLogger("resilience.faultinject")
+
+__all__ = ["InjectedFault", "FaultInjector", "get_injector",
+           "configure_faults", "reset_faults"]
+
+ENV_VAR = "SELKIES_FAULTS"
+
+_ACTIONS = ("raise", "drop", "delay", "flap")
+
+
+class InjectedFault(RuntimeError):
+    """Raised by an injection site on a scheduled ``raise`` action."""
+
+
+class _Rule:
+    __slots__ = ("site", "action", "delay_ms", "ticks", "ranges", "every",
+                 "prob", "_rng")
+
+    def __init__(self, site: str, sched: str, action: str):
+        self.site = site
+        self.ticks: set[int] = set()
+        self.ranges: list[tuple[int, int]] = []
+        self.every = 0
+        self.prob = 0.0
+        self._rng: random.Random | None = None
+        self.delay_ms = 0.0
+
+        act, _, arg = action.partition(":")
+        if act not in _ACTIONS:
+            raise ValueError(f"unknown fault action {act!r} (one of {_ACTIONS})")
+        if act == "delay":
+            if not arg:
+                raise ValueError("delay action needs a millisecond arg: delay:<ms>")
+            self.delay_ms = float(arg)
+        elif arg:
+            raise ValueError(f"action {act!r} takes no argument, got {arg!r}")
+        self.action = act
+
+        if sched.startswith("every:"):
+            self.every = int(sched[len("every:"):])
+            if self.every < 1:
+                raise ValueError(f"every:N needs N >= 1, got {self.every}")
+        elif sched.startswith("p:"):
+            seed = 0
+            body = sched[len("p:"):]
+            m = re.fullmatch(r"([0-9.eE+-]+)(?:,seed:(\d+))?", body)
+            if not m:
+                raise ValueError(f"bad probability schedule {sched!r}")
+            self.prob = float(m.group(1))
+            if not 0.0 <= self.prob <= 1.0:
+                raise ValueError(f"probability {self.prob} out of [0, 1]")
+            if m.group(2) is not None:
+                seed = int(m.group(2))
+            self._rng = random.Random(seed)
+        else:
+            for part in sched.split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                lo, dash, hi = part.partition("-")
+                if dash:
+                    lo_i, hi_i = int(lo), int(hi)
+                    if hi_i < lo_i:
+                        raise ValueError(f"bad tick range {part!r}")
+                    self.ranges.append((lo_i, hi_i))
+                else:
+                    self.ticks.add(int(part))
+            if not self.ticks and not self.ranges:
+                raise ValueError(f"empty tick schedule {sched!r}")
+
+    def matches_site(self, site: str) -> bool:
+        return site == self.site or site.startswith(self.site + ":")
+
+    def fires(self, tick: int) -> bool:
+        if self.every:
+            return tick % self.every == 0
+        if self._rng is not None:
+            return self._rng.random() < self.prob
+        return (tick in self.ticks
+                or any(lo <= tick <= hi for lo, hi in self.ranges))
+
+
+def parse_faults(spec: str) -> list[_Rule]:
+    rules = []
+    for raw in spec.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        # the schedule may itself contain ':' (every:N, p:…,seed:N), so the
+        # action is matched as an anchored suffix alternation; sites may
+        # carry a ':<qualifier>' (per-slot, e.g. capture:1)
+        m = re.fullmatch(
+            r"([a-zA-Z_][\w.:-]*)@(.+?):(raise|drop|flap|delay:[0-9.eE+-]+)",
+            raw)
+        if not m:
+            raise ValueError(
+                f"bad fault rule {raw!r} (want site@sched:action, action one "
+                f"of {_ACTIONS} with delay:<ms>)")
+        rules.append(_Rule(m.group(1), m.group(2).strip(), m.group(3).strip()))
+    return rules
+
+
+class FaultInjector:
+    """Evaluates ``check(site)`` against the parsed schedule.
+
+    Per-site tick counters start at 1 on the first check. Thread-safe:
+    injection sites run on worker threads (encode) and the event loop
+    (send/signalling) concurrently.
+    """
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        self.rules = parse_faults(spec)
+        self._counters: dict[str, int] = {}
+        self._lock = threading.Lock()
+        # (site, tick, action) log — chaos tests assert against this
+        self.injected: list[tuple[str, int, str]] = []
+
+    def check(self, site: str) -> tuple[str, float] | None:
+        """Advance ``site``'s tick; raise InjectedFault on a scheduled
+        ``raise``, else return (action, delay_ms) for the caller to apply
+        (``drop`` / ``delay`` / ``flap``), or None."""
+        with self._lock:
+            tick = self._counters.get(site, 0) + 1
+            self._counters[site] = tick
+            hit: _Rule | None = None
+            for rule in self.rules:
+                if rule.matches_site(site) and rule.fires(tick):
+                    hit = rule
+                    break
+            if hit is None:
+                return None
+            self.injected.append((site, tick, hit.action))
+        logger.warning("injected %s at %s tick %d (%s)",
+                       hit.action, site, tick, self.spec)
+        if hit.action == "raise":
+            raise InjectedFault(f"injected fault at {site} tick {tick}")
+        return hit.action, hit.delay_ms
+
+    def tick_of(self, site: str) -> int:
+        with self._lock:
+            return self._counters.get(site, 0)
+
+
+_injector: FaultInjector | None = None
+_loaded = False
+
+
+def get_injector() -> FaultInjector | None:
+    """The process-wide injector from ``SELKIES_FAULTS`` (cached), or the
+    one installed by :func:`configure_faults`. None when injection is off —
+    call sites guard with ``if fi is not None`` so the disabled path costs
+    one attribute load."""
+    global _injector, _loaded
+    if not _loaded:
+        _loaded = True
+        spec = os.environ.get(ENV_VAR, "").strip()
+        if spec:
+            try:
+                _injector = FaultInjector(spec)
+                logger.warning("fault injection ACTIVE: %s=%s", ENV_VAR, spec)
+            except ValueError:
+                logger.exception("ignoring malformed %s=%r", ENV_VAR, spec)
+    return _injector
+
+
+def configure_faults(spec: str) -> FaultInjector:
+    """Install an injector programmatically (tests). Overrides the env."""
+    global _injector, _loaded
+    _injector = FaultInjector(spec)
+    _loaded = True
+    return _injector
+
+
+def reset_faults() -> None:
+    """Drop any cached injector; the next get_injector() re-reads the env."""
+    global _injector, _loaded
+    _injector = None
+    _loaded = False

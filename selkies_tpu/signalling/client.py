@@ -22,9 +22,46 @@ from typing import Any, Awaitable, Callable
 
 import aiohttp
 
+from selkies_tpu.resilience import get_injector
 from selkies_tpu.utils.aio import maybe_await as _maybe_await
 
 logger = logging.getLogger("signalling.client")
+
+
+def reconnect_backoff():
+    """The ONE signalling reconnect policy (capped exponential + jitter),
+    shared by the solo orchestrator loop, every fleet slot loop, and the
+    client's own connect() retries — fix it here, it is fixed everywhere."""
+    import random
+
+    from selkies_tpu.resilience import Backoff
+
+    return Backoff(base=0.5, cap=30.0, jitter=0.5, rand=random.random)
+
+
+async def run_reconnect_loop(client: "SignallingClient",
+                             log_prefix: str = "signalling") -> None:
+    """Connect/serve/reconnect forever with the shared backoff policy —
+    the single reconnect loop behind Orchestrator._signalling_loop and
+    every FleetOrchestrator slot loop. A connection that lived >= 30 s
+    was healthy and resets the backoff; errors out of the message loop
+    are logged, never fatal."""
+    import time
+
+    backoff = reconnect_backoff()
+    while True:
+        await client.connect()
+        connected_at = time.monotonic()
+        try:
+            await client.start()  # returns on disconnect
+        except Exception:
+            logger.exception("%s client error", log_prefix)
+        if time.monotonic() - connected_at > 30.0:
+            backoff.reset()
+        delay = backoff.next_delay()
+        logger.info("%s client disconnected; retrying in %.1fs",
+                    log_prefix, delay)
+        await asyncio.sleep(delay)
 
 
 class SignallingError(Exception):
@@ -46,6 +83,7 @@ class SignallingClient:
         basic_auth_user: str | None = None,
         basic_auth_password: str | None = None,
         retry_interval: float = 2.0,
+        retry_backoff=None,
     ):
         self.server = server
         self.id = id
@@ -55,6 +93,10 @@ class SignallingClient:
         self.basic_auth_user = basic_auth_user
         self.basic_auth_password = basic_auth_password
         self.retry_interval = retry_interval
+        # optional resilience.Backoff: when set, connect() retries decay
+        # (capped exponential + jitter) instead of a fixed beat — a dead
+        # signalling server isn't hammered every retry_interval forever
+        self.retry_backoff = retry_backoff
 
         self._session: aiohttp.ClientSession | None = None
         self._ws: aiohttp.ClientWebSocketResponse | None = None
@@ -86,9 +128,19 @@ class SignallingClient:
             try:
                 self._ws = await self._session.ws_connect(self.server, headers=headers, ssl=sslctx, heartbeat=None)
                 break
-            except (aiohttp.ClientConnectionError, OSError):
-                logger.info("connecting to signalling server...")
-                await asyncio.sleep(self.retry_interval)
+            except (aiohttp.ClientError, OSError) as exc:
+                # ClientError (not just ClientConnectionError): a proxy
+                # answering the WS upgrade with 502 during a restart
+                # raises WSServerHandshakeError — that must retry too,
+                # not kill the reconnect loop for good
+                delay = (self.retry_backoff.next_delay()
+                         if self.retry_backoff is not None
+                         else self.retry_interval)
+                logger.info("connecting to signalling server (%s; retry "
+                            "in %.1fs)...", type(exc).__name__, delay)
+                await asyncio.sleep(delay)
+        if self.retry_backoff is not None:
+            self.retry_backoff.reset()
         await self._ws.send_str(f"HELLO {self.id}")
 
     async def setup_call(self) -> None:
@@ -113,11 +165,28 @@ class SignallingClient:
             self._session = None
 
     async def start(self) -> None:
-        """Message loop: dispatches HELLO / SESSION_OK / ERROR / sdp / ice."""
+        """Message loop: dispatches HELLO / SESSION_OK / ERROR / sdp / ice.
+
+        Fault site ``signalling`` (resilience/faultinject.py): a scheduled
+        ``flap`` closes the socket mid-session — the reconnect/backoff
+        path in the orchestrators is exercised deterministically — and
+        ``drop`` discards one inbound message."""
         assert self._ws is not None
         async for msg in self._ws:
             if msg.type != aiohttp.WSMsgType.TEXT:
                 continue
+            fi = get_injector()
+            if fi is not None:
+                act = fi.check("signalling")
+                if act is not None:
+                    action, delay_ms = act
+                    if action == "flap":
+                        await self._ws.close()
+                        break
+                    if action == "drop":
+                        continue
+                    if action == "delay":
+                        await asyncio.sleep(delay_ms / 1000.0)
             await self._dispatch(msg.data)
         await _maybe_await(self.on_disconnect())
 

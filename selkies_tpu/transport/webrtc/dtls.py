@@ -45,8 +45,17 @@ _ssl.SSL_get_error.argtypes = [ctypes.c_void_p, ctypes.c_int]
 _ssl.SSL_is_init_finished.argtypes = [ctypes.c_void_p]
 _ssl.SSL_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int]
 _ssl.SSL_write.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int]
-_ssl.SSL_get1_peer_certificate.restype = ctypes.c_void_p
-_ssl.SSL_get1_peer_certificate.argtypes = [ctypes.c_void_p]
+# OpenSSL 3 renamed SSL_get_peer_certificate -> SSL_get1_peer_certificate
+# (both return a +1-ref X509*). Bind whichever this libssl exports: a
+# 1.1-only system must degrade the WebRTC plane at use, not kill every
+# import of the transport stack (orchestrator/fleet run fine on the WS
+# plane without DTLS).
+try:
+    _SSL_get_peer_cert = _ssl.SSL_get1_peer_certificate
+except AttributeError:  # libssl 1.1
+    _SSL_get_peer_cert = _ssl.SSL_get_peer_certificate
+_SSL_get_peer_cert.restype = ctypes.c_void_p
+_SSL_get_peer_cert.argtypes = [ctypes.c_void_p]
 _ssl.SSL_export_keying_material.argtypes = [
     ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
     ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
@@ -122,11 +131,16 @@ def _err() -> str:
 
 def make_certificate():
     """Self-signed ECDSA P-256 certificate -> (cert_der, key_der,
-    sha256_fingerprint 'AB:CD:...')."""
-    from cryptography import x509
-    from cryptography.hazmat.primitives import hashes, serialization
-    from cryptography.hazmat.primitives.asymmetric import ec
-    from cryptography.x509.oid import NameOID
+    sha256_fingerprint 'AB:CD:...'). Prefers the `cryptography` package;
+    degrades to a ctypes libcrypto implementation when it is absent so
+    the WebRTC plane still comes up on system-OpenSSL-only images."""
+    try:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import ec
+        from cryptography.x509.oid import NameOID
+    except ImportError:
+        return _make_certificate_libcrypto()
 
     key = ec.generate_private_key(ec.SECP256R1())
     name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "selkies-tpu")])
@@ -147,6 +161,94 @@ def make_certificate():
         serialization.PrivateFormat.PKCS8,
         serialization.NoEncryption(),
     )
+    digest = hashlib.sha256(cert_der).hexdigest().upper()
+    fp = ":".join(digest[i : i + 2] for i in range(0, 64, 2))
+    return cert_der, key_der, fp
+
+
+_NID_P256 = 415  # NID_X9_62_prime256v1
+_MBSTRING_ASC = 0x1001
+
+
+def _i2d(fn, obj) -> bytes:
+    """DER-encode via the i2d_* two-call convention."""
+    n = fn(obj, None)
+    if n <= 0:
+        raise DtlsError(f"i2d sizing failed: {_err()}")
+    buf = ctypes.create_string_buffer(n)
+    ptr = ctypes.cast(buf, ctypes.c_char_p)
+    fn(obj, ctypes.byref(ptr))
+    return buf.raw[:n]
+
+
+def _make_certificate_libcrypto():
+    """make_certificate without the `cryptography` package: EC P-256
+    keygen + self-signed X509 straight from the libcrypto this module
+    already loaded for DER parsing."""
+    c = _crypto
+    for name, restype, argtypes in (
+        ("EC_KEY_new_by_curve_name", ctypes.c_void_p, [ctypes.c_int]),
+        ("EC_KEY_generate_key", ctypes.c_int, [ctypes.c_void_p]),
+        ("EC_KEY_free", None, [ctypes.c_void_p]),
+        ("EVP_PKEY_new", ctypes.c_void_p, []),
+        ("EVP_PKEY_set1_EC_KEY", ctypes.c_int, [ctypes.c_void_p] * 2),
+        ("X509_new", ctypes.c_void_p, []),
+        ("X509_set_version", ctypes.c_int, [ctypes.c_void_p, ctypes.c_long]),
+        ("X509_get_serialNumber", ctypes.c_void_p, [ctypes.c_void_p]),
+        ("ASN1_INTEGER_set", ctypes.c_int, [ctypes.c_void_p, ctypes.c_long]),
+        ("X509_getm_notBefore", ctypes.c_void_p, [ctypes.c_void_p]),
+        ("X509_getm_notAfter", ctypes.c_void_p, [ctypes.c_void_p]),
+        ("X509_gmtime_adj", ctypes.c_void_p, [ctypes.c_void_p, ctypes.c_long]),
+        ("X509_get_subject_name", ctypes.c_void_p, [ctypes.c_void_p]),
+        ("X509_NAME_add_entry_by_txt", ctypes.c_int, [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int]),
+        ("X509_set_issuer_name", ctypes.c_int, [ctypes.c_void_p] * 2),
+        ("X509_set_pubkey", ctypes.c_int, [ctypes.c_void_p] * 2),
+        ("X509_sign", ctypes.c_int, [ctypes.c_void_p] * 3),
+        ("i2d_X509", ctypes.c_int, [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_char_p)]),
+        ("i2d_PrivateKey", ctypes.c_int, [ctypes.c_void_p,
+                                          ctypes.POINTER(ctypes.c_char_p)]),
+    ):
+        fn = getattr(c, name)
+        fn.restype = restype
+        fn.argtypes = argtypes
+
+    ec_key = c.EC_KEY_new_by_curve_name(_NID_P256)
+    if not ec_key or c.EC_KEY_generate_key(ec_key) != 1:
+        raise DtlsError(f"EC P-256 keygen failed: {_err()}")
+    pkey = c.EVP_PKEY_new()
+    x509 = None
+    try:
+        if c.EVP_PKEY_set1_EC_KEY(pkey, ec_key) != 1:
+            raise DtlsError(f"EVP_PKEY_set1_EC_KEY failed: {_err()}")
+        x509 = c.X509_new()
+        if not x509:
+            raise DtlsError(f"X509_new failed: {_err()}")
+        c.X509_set_version(x509, 2)  # X509v3
+        import secrets
+
+        c.ASN1_INTEGER_set(c.X509_get_serialNumber(x509),
+                           secrets.randbits(31) or 1)
+        c.X509_gmtime_adj(c.X509_getm_notBefore(x509), -86400)
+        c.X509_gmtime_adj(c.X509_getm_notAfter(x509), 30 * 86400)
+        name = c.X509_get_subject_name(x509)
+        if c.X509_NAME_add_entry_by_txt(
+                name, b"CN", _MBSTRING_ASC, b"selkies-tpu", -1, -1, 0) != 1:
+            raise DtlsError(f"X509_NAME_add_entry failed: {_err()}")
+        c.X509_set_issuer_name(x509, name)
+        if c.X509_set_pubkey(x509, pkey) != 1:
+            raise DtlsError(f"X509_set_pubkey failed: {_err()}")
+        if c.X509_sign(x509, pkey, c.EVP_sha256()) == 0:
+            raise DtlsError(f"X509_sign failed: {_err()}")
+        cert_der = _i2d(c.i2d_X509, x509)
+        key_der = _i2d(c.i2d_PrivateKey, pkey)
+    finally:
+        c.EC_KEY_free(ec_key)
+        if x509:
+            c.X509_free(x509)
+        c.EVP_PKEY_free(pkey)
     digest = hashlib.sha256(cert_der).hexdigest().upper()
     fp = ":".join(digest[i : i + 2] for i in range(0, 64, 2))
     return cert_der, key_der, fp
@@ -258,7 +360,7 @@ class DtlsEndpoint:
 
     def _finish_handshake(self) -> None:
         if self.peer_fingerprint is not None:
-            cert = _ssl.SSL_get1_peer_certificate(self._ssl)
+            cert = _SSL_get_peer_cert(self._ssl)
             if not cert:
                 raise DtlsError("peer sent no certificate")
             md = ctypes.create_string_buffer(32)

@@ -18,7 +18,10 @@ import hmac
 import hashlib
 import struct
 
-from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+try:
+    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+except ImportError:  # degrade to the ctypes EVP path below
+    Cipher = None
 
 AUTH_TAG_LEN = 10
 SRTCP_INDEX_LEN = 4
@@ -26,6 +29,41 @@ SRTCP_INDEX_LEN = 4
 
 class SrtpError(ValueError):
     pass
+
+
+def _evp_aes_ctr(key: bytes, iv: bytes, n: int) -> bytes:
+    """AES-128-CTR keystream via libcrypto EVP — the fallback when the
+    `cryptography` package is absent (images that ship only the system
+    OpenSSL). Same output, slower per-call; the media plane runs a few
+    hundred packets/s so construction cost is irrelevant."""
+    import ctypes
+    import ctypes.util
+
+    global _evp
+    if "_evp" not in globals():
+        lib = ctypes.CDLL(ctypes.util.find_library("crypto") or "libcrypto.so.3")
+        lib.EVP_CIPHER_CTX_new.restype = ctypes.c_void_p
+        lib.EVP_CIPHER_CTX_free.argtypes = [ctypes.c_void_p]
+        lib.EVP_aes_128_ctr.restype = ctypes.c_void_p
+        lib.EVP_EncryptInit_ex.argtypes = [ctypes.c_void_p] * 3 + [ctypes.c_char_p] * 2
+        lib.EVP_EncryptUpdate.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+            ctypes.c_char_p, ctypes.c_int,
+        ]
+        _evp = lib
+    ctx = _evp.EVP_CIPHER_CTX_new()
+    if not ctx:
+        raise SrtpError("EVP_CIPHER_CTX_new failed")
+    try:
+        if _evp.EVP_EncryptInit_ex(ctx, _evp.EVP_aes_128_ctr(), None, key, iv) != 1:
+            raise SrtpError("EVP_EncryptInit_ex(aes-128-ctr) failed")
+        out = ctypes.create_string_buffer(n + 16)
+        outl = ctypes.c_int(0)
+        if _evp.EVP_EncryptUpdate(ctx, out, ctypes.byref(outl), b"\x00" * n, n) != 1:
+            raise SrtpError("EVP_EncryptUpdate failed")
+        return out.raw[: outl.value]
+    finally:
+        _evp.EVP_CIPHER_CTX_free(ctx)
 
 
 class ReplayWindow:
@@ -58,6 +96,8 @@ class ReplayWindow:
 
 def _aes_cm_keystream(key: bytes, iv_int: int, n: int) -> bytes:
     iv = iv_int.to_bytes(16, "big")
+    if Cipher is None:
+        return _evp_aes_ctr(key, iv, n)
     enc = Cipher(algorithms.AES(key), modes.CTR(iv)).encryptor()
     return enc.update(b"\x00" * n) + enc.finalize()
 

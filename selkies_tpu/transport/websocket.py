@@ -95,8 +95,10 @@ class WebSocketTransport:
         except (ConnectionError, RuntimeError):
             pass
 
-    async def send_video(self, ef) -> None:
-        """EncodedFrame (pipeline/elements.py) → binary WS message."""
+    async def send_video(self, ef) -> bool:
+        """EncodedFrame (pipeline/elements.py) → binary WS message.
+        Returns False when the client is gone / the socket failed so the
+        fleet's per-slot send accounting sees it (parallel/fleet.py)."""
         flags = FLAG_KEYFRAME if ef.idr else 0
         seq = self._video_seq = (self._video_seq + 1) & 0xFFFF
         # sample the send clock BEFORE the await: under TCP backpressure
@@ -109,7 +111,7 @@ class WebSocketTransport:
         # and an ack for an unregistered seq would be dropped. A frame that
         # fails to send leaves a stale entry, which simply ages out.
         self.on_video_sent(seq, send_ms, len(ef.au) + HEADER.size)
-        await self._send_binary(pack_media_frame(KIND_VIDEO, flags, ef.timestamp_90k, ef.au, seq))
+        return await self._send_binary(pack_media_frame(KIND_VIDEO, flags, ef.timestamp_90k, ef.au, seq))
 
     async def send_audio(self, ea) -> None:
         """EncodedAudio (audio/pipeline.py) → binary WS message."""

@@ -1,0 +1,53 @@
+"""Persistent XLA compilation cache for fast encoder (re)builds.
+
+The resilience ladder's RESTART and RECYCLE rungs (resilience/
+supervisor.py) rebuild encoders and fleet services; without a
+compilation cache every rebuild pays the full XLA compile again — tens
+of seconds of dead air exactly when a session is trying to recover. With
+the disk cache, a rebuilt program with identical HLO loads in a fraction
+of the time, and a restarted *process* (supervisor-level recovery, CI
+reruns) warm-starts too.
+
+``SELKIES_JAX_CACHE`` controls it: unset/``1``/``on`` → enabled under the
+system temp dir; a path → enabled there; ``0``/``off`` → disabled.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+
+logger = logging.getLogger("utils.jaxcache")
+
+_done = False
+
+
+def enable_persistent_compilation_cache() -> None:
+    """Idempotent; call before building jitted programs. Failures degrade
+    to uncached compiles — never to a crash."""
+    global _done
+    if _done:
+        return
+    _done = True
+    mode = os.environ.get("SELKIES_JAX_CACHE", "1").strip()
+    if mode.lower() in ("0", "off", "false", ""):
+        logger.info("persistent compilation cache disabled (SELKIES_JAX_CACHE)")
+        return
+    path = (mode if mode.lower() not in ("1", "on", "true")
+            else os.path.join(tempfile.gettempdir(), "selkies-tpu-jax-cache"))
+    try:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        try:
+            # cache everything that takes real time; tiny programs stay
+            # in-memory only
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        except Exception:
+            logger.info("jax build without min-compile-time knob; using defaults")
+        logger.info("persistent compilation cache at %s", path)
+    except Exception:
+        logger.exception("persistent compilation cache unavailable; "
+                         "compiles will not be reused across restarts")

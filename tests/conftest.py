@@ -33,6 +33,13 @@ def _cpu_env(env: dict) -> dict:
 def pytest_configure(config):
     if not os.environ.get("PALLAS_AXON_POOL_IPS") or os.environ.get(_GUARD):
         os.environ.update({k: v for k, v in _cpu_env(os.environ).items() if k != _GUARD})
+        # identical encoder/service programs are rebuilt dozens of times
+        # across the suite (and by the resilience RESTART rung under
+        # test); the persistent cache keeps the whole run inside the
+        # tier-1 time budget
+        from selkies_tpu.utils.jaxcache import enable_persistent_compilation_cache
+
+        enable_persistent_compilation_cache()
         return
     capman = config.pluginmanager.getplugin("capturemanager")
     if capman is not None:

@@ -1,0 +1,390 @@
+"""Chaos suite: injected faults against the REAL serving loops.
+
+Drives the fleet tick loop and the solo e2e session through seeded
+``SELKIES_FAULTS`` schedules (resilience/faultinject.py) and asserts the
+recovery ladder's contract: streaming resumes within a bounded number of
+ticks, the first delivered frame after a crash window is an IDR, the
+serving loop never returns — and with injection disabled the encoded
+bytes are identical to an injection-free run (the wrappers are free when
+off).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import aiohttp
+import numpy as np
+import pytest
+
+from selkies_tpu.parallel.fleet import SessionFleet, SessionSlot
+from selkies_tpu.resilience import configure_faults, reset_faults
+from selkies_tpu.transport.websocket import (
+    FLAG_KEYFRAME,
+    KIND_VIDEO,
+    parse_media_frame,
+)
+
+W, H = 192, 128  # MB-aligned tiny geometry (matches tests/test_fleet.py)
+
+
+@pytest.fixture
+def faults():
+    """Install a fault schedule for one test; ALWAYS clears it after —
+    a leaked injector would poison every later test in the process."""
+    yield configure_faults
+    reset_faults()
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+class RecordingTransport:
+    """Slot transport double: keeps every EncodedFrame, always succeeds
+    (or always fails, for the ejection test)."""
+
+    def __init__(self, ok: bool = True):
+        self.frames = []
+        self.ok = ok
+        self.data_channel_ready = False
+
+    def send_data_channel(self, message: str) -> None:
+        pass
+
+    async def send_video(self, ef) -> bool:
+        if not self.ok:
+            return False
+        self.frames.append(ef)
+        return True
+
+
+def make_fleet(n=2, fps=60):
+    slots = [SessionSlot(k, bitrate_kbps=2000, fps=fps) for k in range(n)]
+    fleet = SessionFleet(slots, width=W, height=H, fps=fps)
+    for slot in slots:
+        slot.transport = RecordingTransport()
+        slot.connected = True
+    return fleet, slots
+
+
+async def wait_for(cond, timeout=90.0, interval=0.05):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if cond():
+            return True
+        await asyncio.sleep(interval)
+    return False
+
+
+# -- fleet loop under injected encoder crashes -------------------------
+
+
+def test_fleet_recovers_from_encoder_crashes(loop, faults):
+    """≥3 injected encoder-tick exceptions: the loop NEVER returns, the
+    ladder forces an IDR, and streaming resumes within 60 ticks."""
+    fi = faults("encoder@3,4,5:raise")
+
+    async def scenario():
+        fleet, slots = make_fleet()
+        try:
+            await fleet.start()
+            ok = await wait_for(lambda: all(
+                len(s.transport.frames) >= 6 for s in slots))
+            assert ok, (fleet.ticks, [len(s.transport.frames) for s in slots])
+            # the loop survived all three crashes and kept going
+            assert fleet._task is not None and not fleet._task.done()
+            assert fleet.supervisor.counters["failures"] >= 3
+            assert [x for x in fi.injected if x[0] == "encoder"] == [
+                ("encoder", 3, "raise"), ("encoder", 4, "raise"),
+                ("encoder", 5, "raise")]
+            for s in slots:
+                frames = s.transport.frames
+                # delivered ticks: 1 (all-IDR), 2 (P), then the crash
+                # window, then recovery — which must OPEN WITH AN IDR
+                # (rung 2 fired during the window), within 60 ticks
+                assert frames[0].idr and not frames[1].idr
+                assert frames[2].idr, "first frame after recovery is not IDR"
+                assert fleet.ticks <= 60
+        finally:
+            await fleet.stop()
+
+    loop.run_until_complete(scenario())
+
+
+def test_fleet_capture_fault_rides_previous_frame(loop, faults):
+    """A single session's capture dying (ticks 3-5) must not fail the
+    batch tick: the slot rides its previous frame, nobody else notices."""
+    faults("capture:1@3-5:raise")
+
+    async def scenario():
+        fleet, slots = make_fleet()
+        try:
+            await fleet.start()
+            ok = await wait_for(lambda: all(
+                len(s.transport.frames) >= 8 for s in slots))
+            assert ok
+            # batch-level supervisor saw NO failures; both sessions got
+            # a frame on every tick
+            assert fleet.supervisor.counters["failures"] == 0
+            n0, n1 = (len(s.transport.frames) for s in slots)
+            assert abs(n0 - n1) <= 1
+        finally:
+            await fleet.stop()
+
+    loop.run_until_complete(scenario())
+
+
+def test_fleet_persistent_send_failures_eject_slot(loop):
+    """Satellite: gather results are counted per slot — a slot whose
+    sends always fail is marked disconnected; the healthy slot streams
+    on (no injection needed: the transport double refuses)."""
+
+    async def scenario():
+        fleet, slots = make_fleet()
+        fleet.SEND_FAILURE_LIMIT = 5  # keep the test fast
+        slots[1].transport = RecordingTransport(ok=False)
+        slots[1].connected = True
+        poisoned = []
+        default = fleet.on_slot_poisoned
+        fleet.on_slot_poisoned = lambda k: (poisoned.append(k), default(k))
+        try:
+            await fleet.start()
+            ok = await wait_for(lambda: not slots[1].connected)
+            assert ok, "failing slot was never ejected"
+            assert poisoned == [1]
+            n0 = len(slots[0].transport.frames)
+            ok = await wait_for(
+                lambda: len(slots[0].transport.frames) >= n0 + 3)
+            assert ok, "healthy slot stopped streaming after ejection"
+            assert slots[0].connected
+        finally:
+            await fleet.stop()
+
+    loop.run_until_complete(scenario())
+
+
+def test_fleet_bytes_identical_with_injection_disabled(loop, faults):
+    """An armed-but-never-firing schedule must not perturb the bitstream:
+    the wrappers are pass-through when no rule fires (and absent rules
+    cost one None check)."""
+    faults("encoder@99999:raise;send@99999:drop;capture@99999:raise")
+
+    async def scenario():
+        fleet_a, _ = make_fleet()
+        try:
+            ticks_a = []
+            for _ in range(4):
+                fleet_a._capture_batch()
+                aus, idrs, _, _ = fleet_a._encode_tick()
+                for slot, au, idr in zip(fleet_a.slots, aus, idrs):
+                    slot.rc.update(len(au), idr=idr)
+                ticks_a.append([bytes(a) for a in aus])
+        finally:
+            fleet_a.service.close()
+        reset_faults()
+        fleet_b, _ = make_fleet()
+        try:
+            for i in range(4):
+                fleet_b._capture_batch()
+                aus, idrs, _, _ = fleet_b._encode_tick()
+                for slot, au, idr in zip(fleet_b.slots, aus, idrs):
+                    slot.rc.update(len(au), idr=idr)
+                assert [bytes(a) for a in aus] == ticks_a[i], f"tick {i}"
+        finally:
+            fleet_b.service.close()
+
+    loop.run_until_complete(scenario())
+
+
+# -- solo pipeline -----------------------------------------------------
+
+
+def test_solo_pipeline_recovers_from_encoder_crashes(loop, faults):
+    from selkies_tpu.pipeline.app import TPUWebRTCApp
+    from selkies_tpu.pipeline.elements import SyntheticSource
+
+    fi = faults("encoder@2,3,4:raise")
+
+    class FakeTransport:
+        def __init__(self):
+            self.frames = []
+            self.data_channel_ready = False
+
+        def send_data_channel(self, message):
+            pass
+
+        async def send_video(self, ef):
+            self.frames.append(ef)
+            return True
+
+    async def scenario():
+        transport = FakeTransport()
+        app = TPUWebRTCApp(
+            source=SyntheticSource(128, 96), transport=transport,
+            width=128, height=96, framerate=30, video_bitrate_kbps=500)
+        await app.start_pipeline()
+        try:
+            ok = await wait_for(lambda: len(transport.frames) >= 8)
+            assert ok, len(transport.frames)
+            assert app.pipeline is not None and app.pipeline.running
+            assert app.supervisor.counters["failures"] >= 3
+            assert app.supervisor.counters["idrs_forced"] >= 1
+            assert len([x for x in fi.injected if x[0] == "encoder"]) == 3
+            # the crash window interrupted the stream; it resumed with a
+            # forced IDR (beyond the session-opening one)
+            assert transport.frames[0].idr
+            assert any(f.idr for f in transport.frames[1:])
+        finally:
+            await app.stop_pipeline()
+
+    loop.run_until_complete(scenario())
+
+
+# -- e2e session: encoder crashes + signalling flap --------------------
+
+
+def test_e2e_session_chaos(loop, tmp_path, faults):
+    """The acceptance scenario: a seeded schedule injects 3 encoder-tick
+    exceptions and a signalling flap into a REAL e2e session (solo
+    Orchestrator, /media WS plane). The stream recovers with an IDR
+    within 60 delivered frames and the serving loop never returns."""
+    from selkies_tpu.input_host import FakeBackend, MemoryClipboard
+    from selkies_tpu.orchestrator import Orchestrator
+    from test_e2e_session import make_config
+
+    faults("encoder@5,6,7:raise;signalling@2:flap")
+
+    async def scenario():
+        orch = Orchestrator(make_config(tmp_path))
+        orch.input.backend = FakeBackend()
+        orch.input.clipboard = MemoryClipboard()
+        run_task = asyncio.ensure_future(orch.run())
+        for _ in range(100):
+            if orch.server._runner is not None and orch.server._runner.addresses:
+                break
+            await asyncio.sleep(0.05)
+        base = f"http://127.0.0.1:{orch.server.bound_port}"
+        try:
+            async with aiohttp.ClientSession() as http:
+                ws = await http.ws_connect(base + "/media")
+                frames: list[tuple[int, bytes]] = []
+                deadline = asyncio.get_event_loop().time() + 90
+                while (len(frames) < 12
+                       and asyncio.get_event_loop().time() < deadline):
+                    msg = await asyncio.wait_for(ws.receive(), 45)
+                    if msg.type == aiohttp.WSMsgType.BINARY:
+                        kind, flags, _, payload = parse_media_frame(msg.data)
+                        if kind == KIND_VIDEO:
+                            frames.append((flags, payload))
+                    elif msg.type != aiohttp.WSMsgType.TEXT:
+                        break
+                assert len(frames) >= 12, f"only {len(frames)} frames"
+                # session opened with an IDR, and the post-crash stream
+                # resumed with another one within the 60-frame bound
+                assert frames[0][0] & FLAG_KEYFRAME
+                assert any(f & FLAG_KEYFRAME for f, _ in frames[1:60]), \
+                    "no recovery IDR after the crash window"
+                # the pipeline survived the crash schedule
+                assert orch.app.pipeline is not None and orch.app.pipeline.running
+                assert orch.app.supervisor.counters["failures"] >= 3
+                assert not run_task.done(), "serving loop returned"
+                await ws.close()
+        finally:
+            await orch.server.stop()
+            try:
+                await asyncio.wait_for(run_task, 10)
+            except (asyncio.TimeoutError, asyncio.CancelledError, Exception):
+                run_task.cancel()
+
+    loop.run_until_complete(scenario())
+
+
+def test_e2e_signalling_flap_reconnects(loop, tmp_path, faults):
+    """A flapping signalling socket (injected) must be survived by the
+    backoff reconnect loop: the internal client reconnects and the web/
+    media planes keep serving."""
+    from selkies_tpu.input_host import FakeBackend, MemoryClipboard
+    from selkies_tpu.orchestrator import Orchestrator
+    from test_e2e_session import make_config
+
+    fi = faults("signalling@every:2:flap")
+
+    async def scenario():
+        orch = Orchestrator(make_config(tmp_path))
+        orch.input.backend = FakeBackend()
+        orch.input.clipboard = MemoryClipboard()
+        run_task = asyncio.ensure_future(orch.run())
+        for _ in range(100):
+            if orch.server._runner is not None and orch.server._runner.addresses:
+                break
+            await asyncio.sleep(0.05)
+        base = f"http://127.0.0.1:{orch.server.bound_port}"
+        try:
+            # let the flap schedule bite at least twice
+            await wait_for(lambda: len(fi.injected) >= 2, timeout=30)
+            async with aiohttp.ClientSession() as http:
+                r = await http.get(base + "/")
+                assert r.status == 200
+                ws = await http.ws_connect(base + "/media")
+                got = 0
+                deadline = asyncio.get_event_loop().time() + 60
+                while got < 4 and asyncio.get_event_loop().time() < deadline:
+                    msg = await asyncio.wait_for(ws.receive(), 30)
+                    if msg.type == aiohttp.WSMsgType.BINARY:
+                        kind, _, _, _ = parse_media_frame(msg.data)
+                        if kind == KIND_VIDEO:
+                            got += 1
+                assert got >= 4, "media plane stalled during signalling flaps"
+                await ws.close()
+            assert not run_task.done()
+        finally:
+            await orch.server.stop()
+            try:
+                await asyncio.wait_for(run_task, 10)
+            except (asyncio.TimeoutError, asyncio.CancelledError, Exception):
+                run_task.cancel()
+
+    loop.run_until_complete(scenario())
+
+
+# -- degradation ladder end-to-end (fleet) -----------------------------
+
+def test_fleet_sustained_failures_degrade_then_recover(loop, faults):
+    """A long crash burst climbs to the degradation rung (fps shed);
+    sustained health afterwards reverses it."""
+    faults("encoder@3-20:raise")
+
+    async def scenario():
+        fleet, slots = make_fleet(fps=60)
+        # fast ladder for the test: degrade on the 4th consecutive
+        # failure, reverse after 10 healthy ticks
+        from selkies_tpu.resilience import Backoff, SlotSupervisor
+        from selkies_tpu.parallel.fleet import _FleetRecovery
+
+        fleet.supervisor = SlotSupervisor(
+            "fleet", _FleetRecovery(fleet), fps=60.0, warn_after=1,
+            idr_after=2, restart_after=3, degrade_after=4, degrade_every=50,
+            recycle_after=1000, recover_after=10,
+            backoff=Backoff(base=30.0, cap=60.0))
+        try:
+            await fleet.start()
+            ok = await wait_for(lambda: fleet.supervisor.degrade_level >= 1)
+            assert ok, "never degraded"
+            assert fleet.fps == 30  # half of 60
+            for slot in slots:
+                assert slot.rc.fps == 30
+            # the schedule ends at encoder tick 20; health returns and
+            # the ladder walks back to full rate
+            ok = await wait_for(lambda: fleet.supervisor.degrade_level == 0)
+            assert ok, "degradation never reversed"
+            assert fleet.fps == 60
+        finally:
+            await fleet.stop()
+
+    loop.run_until_complete(scenario())

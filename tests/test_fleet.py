@@ -69,9 +69,11 @@ async def _boot(tmp_path, n=2):
 
 
 async def _collect_video(ws, n_frames, timeout=30.0):
-    """Read media frames off a /media/<k> socket until n_frames video AUs."""
+    """Read media frames off a /media/<k> socket until n_frames video AUs.
+    (asyncio.wait_for, not asyncio.timeout — the fleet image runs 3.10.)"""
     aus = []
-    async with asyncio.timeout(timeout):
+
+    async def _read():
         async for msg in ws:
             if msg.type != aiohttp.WSMsgType.BINARY:
                 continue
@@ -80,6 +82,8 @@ async def _collect_video(ws, n_frames, timeout=30.0):
                 aus.append((flags, payload))
                 if len(aus) >= n_frames:
                     break
+
+    await asyncio.wait_for(_read(), timeout)
     return aus
 
 
@@ -461,7 +465,9 @@ def test_fleet_per_session_audio(loop, tmp_path):
             async with aiohttp.ClientSession() as http:
                 ws0 = await http.ws_connect(base + "/media/0")
                 audio0 = 0
-                async with asyncio.timeout(60):
+
+                async def _read_audio(ws0=ws0):
+                    nonlocal audio0
                     async for msg in ws0:
                         if msg.type != aiohttp.WSMsgType.BINARY:
                             continue
@@ -470,13 +476,17 @@ def test_fleet_per_session_audio(loop, tmp_path):
                             audio0 += 1
                             if audio0 >= 5:
                                 break
+
+                await asyncio.wait_for(_read_audio(), 60)
                 assert audio0 >= 5
                 await ws0.close()
 
                 ws1 = await http.ws_connect(base + "/media/1")
                 aus = []
                 audio1 = 0
-                async with asyncio.timeout(60):
+
+                async def _read_mixed(ws1=ws1):
+                    nonlocal audio1
                     async for msg in ws1:
                         if msg.type != aiohttp.WSMsgType.BINARY:
                             continue
@@ -487,6 +497,8 @@ def test_fleet_per_session_audio(loop, tmp_path):
                             aus.append(payload)
                         if len(aus) >= 6:
                             break
+
+                await asyncio.wait_for(_read_mixed(), 60)
                 assert audio1 == 0 and len(aus) >= 6
                 await ws1.close()
         finally:

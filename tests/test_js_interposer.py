@@ -19,10 +19,41 @@ from selkies_tpu.input_host.gamepad import GamepadServer
 
 NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
 SO_PATH = os.path.join(NATIVE_DIR, "selkies_joystick_interposer.so")
+SRC_PATH = os.path.join(NATIVE_DIR, "joystick_interposer.c")
 
 if not os.path.exists(SO_PATH):  # build artifacts are not committed
     subprocess.run(["make", "-C", NATIVE_DIR, "-s", "selkies_joystick_interposer.so"],
                    check=False, capture_output=True, timeout=120)
+
+
+def _loadable(path: str) -> bool:
+    """Probe the .so in a THROWAWAY process (an interposer dlopen'd into
+    pytest would hook libc calls here): a prebuilt artifact from a newer
+    glibc fails the loader on older images."""
+    probe = subprocess.run(
+        [sys.executable, "-c", f"import ctypes; ctypes.CDLL({path!r})"],
+        capture_output=True, timeout=60)
+    return probe.returncode == 0
+
+
+def _usable_so_path(tmpdir: str) -> str:
+    """The committed .so when this loader accepts it; otherwise rebuild
+    from source into tmpdir (skip if no compiler)."""
+    if _loadable(SO_PATH):
+        return SO_PATH
+    import shutil as _shutil
+
+    cc = _shutil.which("cc") or _shutil.which("gcc")
+    if cc is None:
+        pytest.skip("prebuilt interposer incompatible with this glibc "
+                    "and no C compiler to rebuild")
+    out = os.path.join(tmpdir, "selkies_joystick_interposer.so")
+    r = subprocess.run([cc, "-O2", "-Wall", "-fPIC", "-shared", "-o", out,
+                        SRC_PATH, "-ldl"],
+                       capture_output=True, text=True, timeout=120)
+    if r.returncode != 0 or not _loadable(out):
+        pytest.skip(f"interposer rebuild failed: {r.stderr[:300]}")
+    return out
 
 CLIENT_SCRIPT = r"""
 import fcntl, os, struct, sys
@@ -64,12 +95,14 @@ os.close(fd)
 
 @pytest.mark.skipif(not os.path.exists(SO_PATH), reason="interposer not built")
 def test_interposer_end_to_end(tmp_path):
+    so_path = _usable_so_path(str(tmp_path))
+
     async def scenario():
         js = GamepadServer(str(tmp_path / "selkies_js0.sock"))
         await js.start()
 
         env = dict(os.environ)
-        env["LD_PRELOAD"] = SO_PATH
+        env["LD_PRELOAD"] = so_path
         env["SELKIES_INTERPOSER_SOCKET_PATH"] = str(tmp_path)
         env.pop("PALLAS_AXON_POOL_IPS", None)
         proc = await asyncio.create_subprocess_exec(

@@ -72,6 +72,70 @@ def test_app_pipeline_streams_frames():
     assert transport.frames[0].au[:5] == b"\x00\x00\x00\x01\x67"  # SPS first
 
 
+def test_rebuild_encoder_keeps_previous_on_failure(monkeypatch):
+    """Satellite (ISSUE 2): a mid-resize encoder construction failure must
+    keep the previous working encoder wired and report on the data
+    channel, not leave the pipeline pointing at a dead stage."""
+    import selkies_tpu.pipeline.app as app_mod
+
+    transport = FakeTransport()
+    app = TPUWebRTCApp(
+        source=SyntheticSource(128, 96), transport=transport,
+        width=128, height=96, framerate=30, video_bitrate_kbps=500)
+    old = app.encoder
+    calls = []
+
+    def boom2(*a, **k):
+        calls.append(k)
+        raise RuntimeError("no encoder for you")
+
+    monkeypatch.setattr(app_mod, "create_encoder", boom2)
+    got = app._rebuild_encoder(256, 192)
+    assert got is old and app.encoder is old
+    errors = [m for m in transport.messages if m["type"] == "error"]
+    assert errors and "256x192" in errors[0]["data"]["message"]
+    # retries of the same failing geometry are rate-limited: the pipeline
+    # calls this every tick while frames mismatch
+    got = app._rebuild_encoder(256, 192)
+    assert got is old and len(calls) == 1
+
+
+def test_app_degradation_ladder_and_reversal():
+    """The solo recovery actions: halve fps -> downscale source ->
+    software fallback, then walk back up (resilience/supervisor.py)."""
+    from selkies_tpu.pipeline.elements import DownscaleSource
+
+    class FakePipeline:
+        def __init__(self, app):
+            self.source = app.source
+            self.encoder = app.encoder
+
+        def set_framerate(self, fps):
+            self.fps = fps
+
+    app = TPUWebRTCApp(
+        source=SyntheticSource(128, 96), transport=FakeTransport(),
+        width=128, height=96, framerate=30, video_bitrate_kbps=500)
+    rec = app.supervisor.actions
+    rec.degrade(1)
+    assert app.framerate == 15
+    app.pipeline = FakePipeline(app)
+    rec.degrade(2)
+    assert isinstance(app.pipeline.source, DownscaleSource)
+    assert (app.pipeline.source.width, app.pipeline.source.height) == (64, 48)
+    rec.degrade(3)
+    assert app.software_fallback
+    assert app.pipeline.encoder is app.encoder  # swap reached the pipeline
+    rec.undegrade(2)
+    assert not app.software_fallback
+    rec.undegrade(1)
+    assert app.pipeline.source is app.source
+    rec.undegrade(0)
+    assert app.framerate == 30
+    if hasattr(app.encoder, "close"):
+        app.encoder.close()
+
+
 def test_app_rate_control_reacts():
     async def run():
         transport = FakeTransport()

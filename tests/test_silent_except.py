@@ -1,0 +1,49 @@
+"""Tier-1 wrapper for tools/check_silent_except.py: new silent
+`except Exception: pass` swallows in selkies_tpu/ fail the build."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "check_silent_except.py")
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location("check_silent_except", TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_repo_has_no_new_silent_excepts():
+    proc = subprocess.run([sys.executable, TOOL, REPO],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_scanner_catches_silent_swallow(tmp_path):
+    mod = _load_tool()
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "try:\n    x = 1\nexcept Exception:\n    pass\n"
+        "try:\n    y = 2\nexcept:\n    pass\n")
+    sites, count = mod.scan_file(str(bad), "bad.py")
+    assert count == 2 and len(sites) == 2
+
+
+def test_scanner_accepts_marker_and_logging(tmp_path):
+    mod = _load_tool()
+    ok = tmp_path / "ok.py"
+    ok.write_text(
+        "import logging\n"
+        "try:\n    x = 1\n"
+        "except Exception:  # noqa: silent-except-audited — shutdown path\n"
+        "    pass\n"
+        "try:\n    y = 2\nexcept Exception:\n    logging.exception('boom')\n"
+        "try:\n    z = 3\nexcept ValueError:\n    pass\n")
+    sites, count = mod.scan_file(str(ok), "ok.py")
+    assert count == 0, sites
