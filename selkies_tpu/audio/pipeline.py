@@ -17,6 +17,7 @@ from typing import Awaitable, Callable
 
 from selkies_tpu.audio.opus import FRAME_MS, OpusEncoder, SAMPLE_RATE
 from selkies_tpu.audio.sources import AudioSource, SyntheticAudioSource
+from selkies_tpu.monitoring.tracing import tracer
 
 logger = logging.getLogger("audio.pipeline")
 
@@ -86,7 +87,8 @@ class AudioPipeline:
             next_tick = max(next_tick + period, time.monotonic() - period)
             try:
                 pcm = await self.source.read_frame()
-                packet = await asyncio.to_thread(self.encoder.encode, pcm)
+                with tracer.span("audio-encode"):
+                    packet = await asyncio.to_thread(self.encoder.encode, pcm)
                 ea = EncodedAudio(packet=packet, timestamp_48k=samples, wall_time=time.time())
                 samples += SAMPLE_RATE * FRAME_MS // 1000
                 self.frames += 1
@@ -108,7 +110,8 @@ class AudioPipeline:
             if ea is None or self.sink is None:
                 continue
             try:
-                await self.sink(ea)
+                with tracer.span("audio-send"):
+                    await self.sink(ea)
             except asyncio.CancelledError:
                 raise
             except Exception:

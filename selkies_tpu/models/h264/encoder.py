@@ -32,6 +32,7 @@ import numpy as np
 from selkies_tpu.models.h264.numpy_ref import PFrameCoeffs
 
 from selkies_tpu.models.frameprep import FramePrep, delta_buckets_for, tile_width_for
+from selkies_tpu.monitoring.telemetry import telemetry
 from selkies_tpu.monitoring.tracing import tracer
 from selkies_tpu.models.stats import FrameStats as _FrameStats
 from selkies_tpu.models.h264.bitstream import StreamParams, write_pps, write_sps
@@ -667,6 +668,8 @@ class TPUH264Encoder:
         self._step2_cache: dict = {}
         self._ltr_probe: object = ()  # per-frame memo, see _classify
         self.link_bytes = LinkByteCounter()
+        # last-seen tile-cache totals, for per-frame telemetry deltas
+        self._tc_seen = (0, 0, 0)
         self._prev_frame: np.ndarray | None = None  # device-convert mode only
         self._inflight: deque = deque()
         self._pool = ThreadPoolExecutor(
@@ -827,6 +830,32 @@ class TPUH264Encoder:
             # then fits from its second frame on)
             return "full", ("seed", idx)
         return "delta", payload
+
+    def _emit_classify_telemetry(self, kind: str, payload) -> None:
+        """Fold one frame's classification into the telemetry bus: per-tile
+        cache hit/miss/evict deltas and the frame's upload class (a delta
+        whose upload list is empty is a pure-remap frame — the tile
+        cache's headline outcome). Called only when telemetry is enabled;
+        the frame id rides the ContextVar set by the pipeline's span."""
+        tc = self._tcache
+        if tc is not None:
+            hits, misses, evs = tc.hits, tc.misses, tc.evictions
+            dh, dm, de = (hits - self._tc_seen[0], misses - self._tc_seen[1],
+                          evs - self._tc_seen[2])
+            self._tc_seen = (hits, misses, evs)
+            if dh:
+                telemetry.count("selkies_tile_cache_tiles_total", dh,
+                                result="hit")
+            if dm:
+                telemetry.count("selkies_tile_cache_tiles_total", dm,
+                                result="miss")
+            if de:
+                telemetry.count("selkies_tile_cache_tiles_total", de,
+                                result="evict")
+            if (kind == "delta" and isinstance(payload, tuple)
+                    and len(payload[0]) == 0):
+                kind = "remap_only"
+        telemetry.count("selkies_tile_cache_frames_total", kind=kind)
 
     def _allskip_slice(self, frame_num: int, mark_ltr: int | None = None,
                        mmco_evict: tuple = ()) -> bytes:
@@ -1355,7 +1384,10 @@ class TPUH264Encoder:
         t0 = time.perf_counter()
         # classify on every frame (advances the previous-frame state even
         # across IDRs) but only short-circuit on P frames
-        kind, dirty_idx = self._classify(frame)
+        with tracer.span("classify"):
+            kind, dirty_idx = self._classify(frame)
+        if telemetry.enabled:
+            self._emit_classify_telemetry(kind, dirty_idx)
         batch_full = False
         orig_qp = self.qp
         # a scene CUT is the transition into a full-frame change; during

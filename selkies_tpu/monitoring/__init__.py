@@ -1,10 +1,17 @@
-"""Observability: Prometheus metrics, WebRTC stats CSV, system/TPU monitors.
+"""Observability: Prometheus metrics, WebRTC stats CSV, system/TPU monitors,
+the frame-correlated telemetry bus, and the black-box flight recorder.
 
-Parity with metrics.py / system_monitor.py / gpu_monitor.py (SURVEY.md §2.1).
+Parity with metrics.py / system_monitor.py / gpu_monitor.py (SURVEY.md §2.1)
+plus the production layer on top: tracing.py (stage spans), telemetry.py
+(labeled counters/histograms + per-frame event bus), flightrecorder.py
+(post-mortem bundles). See docs/observability.md.
 """
 
+from selkies_tpu.monitoring.flightrecorder import FlightRecorder
 from selkies_tpu.monitoring.metrics import Metrics
 from selkies_tpu.monitoring.system_monitor import SystemMonitor
+from selkies_tpu.monitoring.telemetry import Telemetry, telemetry
 from selkies_tpu.monitoring.tpu_monitor import TPUMonitor
 
-__all__ = ["Metrics", "SystemMonitor", "TPUMonitor"]
+__all__ = ["FlightRecorder", "Metrics", "SystemMonitor", "TPUMonitor",
+           "Telemetry", "telemetry"]

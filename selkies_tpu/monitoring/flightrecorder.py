@@ -1,0 +1,141 @@
+"""Black-box flight recorder: post-mortem evidence that survives the crash.
+
+When a session dies, the interesting data is the few seconds *before*
+the supervisor escalated — and that is exactly what a live dashboard
+cannot show after the fact. This recorder keeps a bounded ring of the
+telemetry bus's structured events per slot (last ``window_s`` seconds,
+hard-capped at ``max_events``) and, on demand, atomically writes a
+timestamped bundle:
+
+    <SELKIES_BLACKBOX_DIR>/blackbox-<slot>-<stamp>/
+        meta.json       escalating slot, reason, wall time, event count
+        events.jsonl    EVERY slot's event window merged by time (each
+                        line annotated with its session) — a slot rarely
+                        dies alone, and the supervisor's ladder events
+                        live in a different ring than the frame timeline
+        trace.json      tracer.chrome_trace() — load in Perfetto /
+                        chrome://tracing (empty trace when tracing is off)
+        metrics.json    full telemetry rollup() snapshot at dump time
+
+The bundle directory appears atomically (written under a dot-tmp name,
+then ``os.replace``d into place) so a collector sidecar never ships a
+half-written bundle. Dumps are rate-limited per slot
+(``min_dump_interval_s``) — a crash-looping slot produces one bundle per
+window, not one per failure. Triggering is wired in
+resilience/supervisor.py: every escalation past WARN calls
+``telemetry.escalation()``, which lands here.
+
+``SELKIES_BLACKBOX_DIR`` overrides the output directory (default
+``./blackbox``, gitignored). Everything is injectable (clock, dir,
+window) so tests drive it deterministically.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable
+
+logger = logging.getLogger("flightrecorder")
+
+__all__ = ["FlightRecorder", "DEFAULT_DIR", "ENV_DIR"]
+
+ENV_DIR = "SELKIES_BLACKBOX_DIR"
+DEFAULT_DIR = "blackbox"
+
+
+def _slug(s: str) -> str:
+    return "".join(c if c.isalnum() or c in "-_" else "-" for c in str(s)) or "slot"
+
+
+class FlightRecorder:
+    def __init__(self, *, window_s: float = 10.0, max_events: int = 4096,
+                 out_dir: str | None = None, min_dump_interval_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.window_s = float(window_s)
+        self.max_events = int(max_events)
+        self.out_dir = out_dir or os.environ.get(ENV_DIR) or DEFAULT_DIR
+        self.min_dump_interval_s = float(min_dump_interval_s)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._rings: dict[str, deque] = {}   # slot -> deque[(mono_t, event)]
+        self._last_dump: dict[str, float] = {}
+        self.dumps = 0
+        self.suppressed = 0
+
+    # -- recording (hot-ish: every telemetry emission lands here) ------
+
+    def record(self, slot: str, event: dict) -> None:
+        now = self.clock()
+        with self._lock:
+            ring = self._rings.get(slot)
+            if ring is None:
+                ring = self._rings[slot] = deque(maxlen=self.max_events)
+            ring.append((now, event))
+            cutoff = now - self.window_s
+            while ring and ring[0][0] < cutoff:
+                ring.popleft()
+
+    def events(self, slot: str) -> list[dict]:
+        with self._lock:
+            return [dict(ev, t=round(t, 4))
+                    for t, ev in self._rings.get(slot, ())]
+
+    # -- dumping -------------------------------------------------------
+
+    def dump(self, slot: str, reason: str, *,
+             snapshot: dict | None = None) -> str | None:
+        """Write a bundle for ``slot``'s escalation; None when
+        rate-limited (per slot). The bundle carries EVERY ring's window,
+        merged by time and annotated with the owning session — the
+        escalating slot's ladder events and the frame timeline live in
+        different rings, and cross-slot context is exactly what a
+        post-mortem needs. The write happens outside the lock (a slow
+        disk must not stall emitters)."""
+        now = self.clock()
+        with self._lock:
+            last = self._last_dump.get(slot)
+            if last is not None and now - last < self.min_dump_interval_s:
+                self.suppressed += 1
+                return None
+            self._last_dump[slot] = now
+            events = sorted(
+                (dict(ev, t=round(t, 4), session=s)
+                 for s, ring in self._rings.items() for t, ev in ring),
+                key=lambda e: e["t"])
+        try:
+            return self._write_bundle(slot, reason, events, snapshot)
+        except Exception:
+            # the black box must never take down the loop it observes
+            logger.exception("black-box dump for slot %r failed", slot)
+            return None
+
+    def _write_bundle(self, slot: str, reason: str, events: list[dict],
+                      snapshot: dict | None) -> str:
+        from selkies_tpu.monitoring.tracing import tracer
+
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        name = f"blackbox-{_slug(slot)}-{stamp}-{self.dumps:03d}"
+        os.makedirs(self.out_dir, exist_ok=True)
+        tmp = os.path.join(self.out_dir, f".{name}.tmp")
+        final = os.path.join(self.out_dir, name)
+        os.makedirs(tmp, exist_ok=True)
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump({"slot": str(slot), "reason": reason,
+                       "written_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                       "event_count": len(events)}, f, indent=2)
+        with open(os.path.join(tmp, "events.jsonl"), "w") as f:
+            for ev in events:
+                f.write(json.dumps(ev, default=str) + "\n")
+        with open(os.path.join(tmp, "trace.json"), "w") as f:
+            f.write(tracer.chrome_trace())
+        with open(os.path.join(tmp, "metrics.json"), "w") as f:
+            json.dump(snapshot or {}, f, indent=2, default=str)
+        os.replace(tmp, final)
+        self.dumps += 1
+        logger.warning("black-box bundle written: %s (%s)", final, reason)
+        return final

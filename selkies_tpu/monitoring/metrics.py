@@ -7,8 +7,14 @@ the client's RTCStats uploads (``_stats_video`` / ``_stats_audio``).
 
 The CSV writer handles the same dynamic-schema problem (browsers add stat
 fields mid-session) with a simpler mechanism than the reference's in-place
-column splicing: each file keeps an in-memory column union + row cache and
-is rewritten when the schema grows, so columns never misalign.
+column splicing: each file keeps an in-memory column union + a BOUNDED row
+cache and is rewritten from that cache when the schema grows, so columns
+never misalign.
+
+When telemetry is enabled (SELKIES_TELEMETRY=1), the frame-correlated
+telemetry bus (telemetry.py) folds its metric families into this scrape
+registry, so the one metrics HTTP port serves both the parity gauges and
+the expanded production families (docs/observability.md).
 """
 
 from __future__ import annotations
@@ -17,24 +23,34 @@ import asyncio
 import json
 import logging
 import os
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from datetime import datetime
 
 from prometheus_client import CollectorRegistry, Gauge, Histogram, Info, start_http_server
+
+from selkies_tpu.monitoring.telemetry import telemetry
 
 logger = logging.getLogger("metrics")
 
 FPS_HIST_BUCKETS = (0, 20, 40, 60)
 MIN_STAT_FIELDS = 14  # discard truncated reconnect bursts (reference :119)
 
+# rows kept in memory per CSV for schema-growth rewrites; at the client's
+# 100 ms stats cadence this is ~1 minute of history. A browser adds stat
+# fields in the first seconds of a connection, so rewrites past the cap
+# (which keep only the cached tail) are a non-event in practice — the
+# old behaviour cached EVERY row forever and rewrote the whole file,
+# unbounded memory on a long-lived session.
+CSV_CACHE_ROWS = 512
+
 
 class _CsvLog:
-    """One stats CSV with a growable column set."""
+    """One stats CSV with a growable column set and a bounded row cache."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, cache_rows: int = CSV_CACHE_ROWS):
         self.path = path
         self.columns: list[str] = ["timestamp"]
-        self.rows: list[dict[str, str]] = []
+        self.rows: deque[dict[str, str]] = deque(maxlen=cache_rows)
 
     def append(self, stats: "OrderedDict[str, str]") -> None:
         if len(stats) < MIN_STAT_FIELDS:
@@ -69,6 +85,9 @@ class _CsvLog:
             f.write(self._fmt(row))
 
     def _rewrite(self) -> None:
+        """Schema grew: rewrite header + the cached row tail. Rows older
+        than the cache are dropped from the file — bounded memory beats
+        perfect backfill for a diagnostics CSV."""
         import csv
 
         with open(self.path, "w") as f:
@@ -85,6 +104,13 @@ class Metrics:
         # per-instance registry: multiple Metrics (tests, multi-session
         # hosts) must not collide in the process-global default registry
         self.registry = registry or CollectorRegistry()
+        # expanded families (stage histograms, tile-cache/supervisor/
+        # congestion counters, live link bytes) fold into the same scrape
+        # endpoint as the parity gauges. Registered unconditionally: the
+        # collector emits nothing while telemetry is disabled, and this
+        # keeps a runtime telemetry.enable() exporting without caring
+        # whether Metrics was built first
+        telemetry.register_into(self.registry)
         self.fps = Gauge("fps", "Frames per second observed by client", registry=self.registry)
         self.fps_hist = Histogram(
             "fps_hist", "Histogram of FPS observed by client",

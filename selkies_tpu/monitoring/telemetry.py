@@ -1,0 +1,570 @@
+"""Frame-correlated telemetry bus — the serving stack's structured metrics.
+
+Where monitoring/tracing.py answers "how long did each stage take?"
+(anonymous spans on a timeline), this bus answers "what happened to
+frame N, and how often does each thing happen?": every emission carries
+a **frame correlation id** assigned at capture and threaded through
+tile-cache classification, device encode, entropy pack, transport send,
+and the client's congestion-control ack — so one frame's life can be
+reconstructed across threads and stages. Three consumers:
+
+* **Prometheus** — the internal state folds into labeled metric
+  families (``METRIC_FAMILIES``) via a zero-copy custom collector:
+  per-session stage-latency and frame-byte histograms, tile-cache /
+  supervisor / congestion / fault counters, and the encoder's
+  ``LinkByteCounter`` exported live through a registered provider.
+  ``Metrics`` (monitoring/metrics.py) registers the collector into its
+  scrape registry, so the existing metrics HTTP port serves everything.
+* **/statz** — ``rollup()`` is the JSON operations view served by the
+  signalling server (signalling/server.py).
+* **flight recorder** — every emission also lands in the attached
+  :class:`~selkies_tpu.monitoring.flightrecorder.FlightRecorder`'s
+  bounded per-slot ring; a supervisor escalation past ``warn`` dumps
+  the ring as a post-mortem bundle (``escalation()``).
+
+Cost discipline matches tracing.py: off by default (enable with
+``SELKIES_TELEMETRY=1`` or ``telemetry.enable()``), and every mutator
+early-returns on one attribute read, mutating **nothing** while
+disabled — encoded bytes are identical with telemetry on or off because
+no data-plane code ever branches on it.
+
+Frame-id propagation uses a ``contextvars.ContextVar``:
+``telemetry.span("submit", fid)`` sets the current frame id, and
+``asyncio.to_thread`` copies the context, so events emitted deep inside
+the encoder (tile-cache hit/miss counters) correlate without the
+encoder API carrying the id.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import itertools
+import json
+import logging
+import threading
+import time
+import os
+import weakref
+
+logger = logging.getLogger("telemetry")
+
+__all__ = [
+    "Telemetry",
+    "telemetry",
+    "METRIC_FAMILIES",
+    "STAGE_BUCKETS_MS",
+    "FRAME_BYTE_BUCKETS",
+]
+
+ENV_VAR = "SELKIES_TELEMETRY"
+
+# histogram bucket edges: stage latencies span sub-ms host packs to
+# multi-hundred-ms cold device round trips; frame bytes span all-skip
+# P slices (~tens of bytes) to 4K IDRs
+STAGE_BUCKETS_MS = (0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 33.0, 66.0, 133.0, 500.0)
+FRAME_BYTE_BUCKETS = (1024, 4096, 16384, 65536, 262144, 1048576)
+
+# Every family this bus can emit, name -> help string. The names are the
+# observability contract: tools/check_metric_docs.py asserts each one is
+# documented in docs/observability.md (run from tier-1 tests).
+METRIC_FAMILIES: dict[str, str] = {
+    "selkies_stage_ms":
+        "Per-stage latency histogram in milliseconds, labeled by pipeline "
+        "stage and session",
+    "selkies_frame_bytes":
+        "Encoded access-unit size histogram in bytes, labeled by session",
+    "selkies_frames_total":
+        "Encoded frames, labeled by session and kind (idr/p)",
+    "selkies_tile_cache_tiles_total":
+        "Tile-cache per-tile outcomes (hit/miss/evict), labeled by session",
+    "selkies_tile_cache_frames_total":
+        "Frame upload classification (static/delta/remap_only/full), "
+        "labeled by session",
+    "selkies_link_bytes_total":
+        "Host<->device link bytes, labeled by direction (up/down) and stage",
+    "selkies_congestion_target_kbps":
+        "GCC congestion-controller target bitrate, labeled by session",
+    "selkies_congestion_loss_ratio":
+        "Last reported fraction of packets lost, labeled by session",
+    "selkies_congestion_rtt_ms":
+        "Client round-trip latency from the ping channel, labeled by session",
+    "selkies_congestion_events_total":
+        "Congestion-controller events (increase/decrease/loss_report), "
+        "labeled by session",
+    "selkies_supervisor_rung":
+        "Current recovery-ladder rung (0=healthy .. 5=recycle), labeled "
+        "by slot",
+    "selkies_supervisor_events_total":
+        "Recovery-ladder events (warn/force_idr/restart/degrade/undegrade/"
+        "recycle/deadline_miss/recovered), labeled by slot",
+    "selkies_faults_injected_total":
+        "Deterministic injected faults (resilience/faultinject.py), "
+        "labeled by site and action",
+    "selkies_blackbox_dumps_total":
+        "Black-box flight-recorder bundles written, labeled by slot",
+}
+
+# canonical label names per family (order fixed for the Prometheus
+# exposition); emissions fill missing labels with "0" (the solo session)
+_FAMILY_LABELS: dict[str, tuple[str, ...]] = {
+    "selkies_stage_ms": ("stage", "session"),
+    "selkies_frame_bytes": ("session",),
+    "selkies_frames_total": ("session", "kind"),
+    "selkies_tile_cache_tiles_total": ("session", "result"),
+    "selkies_tile_cache_frames_total": ("session", "kind"),
+    "selkies_link_bytes_total": ("direction", "stage"),
+    "selkies_congestion_target_kbps": ("session",),
+    "selkies_congestion_loss_ratio": ("session",),
+    "selkies_congestion_rtt_ms": ("session",),
+    "selkies_congestion_events_total": ("session", "event"),
+    "selkies_supervisor_rung": ("slot",),
+    "selkies_supervisor_events_total": ("slot", "event"),
+    "selkies_faults_injected_total": ("site", "action"),
+    "selkies_blackbox_dumps_total": ("slot",),
+}
+
+_HIST_BUCKETS: dict[str, tuple[float, ...]] = {
+    "selkies_stage_ms": STAGE_BUCKETS_MS,
+    "selkies_frame_bytes": FRAME_BYTE_BUCKETS,
+}
+
+# current frame correlation id; 0 = none. asyncio.to_thread copies the
+# context, so a span set on the event loop is visible on the worker.
+_frame_ctx: contextvars.ContextVar[int] = contextvars.ContextVar(
+    "selkies_frame_id", default=0)
+
+
+class _Noop:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _Noop()
+
+
+class _TeleSpan:
+    """Times one stage for one frame; observes the stage histogram and
+    records a timeline event on exit. Sets the frame ContextVar so
+    nested emissions (encoder internals) correlate."""
+
+    __slots__ = ("t", "stage", "session", "frame", "fields", "t0", "_tok")
+
+    def __init__(self, t: "Telemetry", stage: str, session: str,
+                 frame: int, fields: dict):
+        self.t = t
+        self.stage = stage
+        self.session = session
+        self.frame = frame
+        self.fields = fields
+        self._tok = None
+
+    def __enter__(self):
+        if self.frame:
+            self._tok = _frame_ctx.set(self.frame)
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        ms = (time.perf_counter() - self.t0) * 1e3
+        if self._tok is not None:
+            _frame_ctx.reset(self._tok)
+        self.t.stage_ms(self.stage, ms, session=self.session,
+                        frame=self.frame, **self.fields)
+        return False
+
+
+class Telemetry:
+    """The bus. One process-global instance (``telemetry``) below."""
+
+    def __init__(self, enabled: bool | None = None):
+        self.enabled = (bool(os.environ.get(ENV_VAR))
+                        if enabled is None else bool(enabled))
+        self._lock = threading.Lock()
+        self._counters: dict[tuple, float] = {}   # (family, labelvals) -> n
+        self._gauges: dict[tuple, float] = {}
+        self._hists: dict[tuple, list] = {}       # -> [bucket_counts, sum]
+        self._providers: dict[str, object] = {}   # name -> () -> dict
+        self._slots: dict[str, object] = {}       # slot name -> SlotSupervisor
+        self._seq_map: dict[tuple[str, int], int] = {}  # (session, seq) -> fid
+        self._frame_ids = itertools.count(1)
+        self._epoch = time.time()
+        self._registry = None
+        self.recorder = None
+        if self.enabled:
+            self._ensure_recorder()
+
+    # -- control -------------------------------------------------------
+
+    def enable(self) -> None:
+        self.enabled = True
+        self._ensure_recorder()
+
+    def disable(self) -> None:
+        self.enabled = False
+        # detach the recorder too: with emission off the rings freeze,
+        # and a later escalation must not dump stale pre-disable events
+        # as if they were evidence for the current failure
+        self.recorder = None
+
+    def _ensure_recorder(self):
+        if self.recorder is None:
+            from selkies_tpu.monitoring.flightrecorder import FlightRecorder
+
+            self.recorder = FlightRecorder()
+        return self.recorder
+
+    def reset(self) -> None:
+        """Tests: drop all accumulated state (keeps registrations out —
+        providers/slots re-register on construction of their owners)."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+            self._seq_map.clear()
+            self._providers.clear()
+            self._slots.clear()
+        self.recorder = None
+        self._epoch = time.time()
+
+    # -- frame correlation ---------------------------------------------
+
+    def next_frame_id(self) -> int:
+        return next(self._frame_ids)
+
+    @staticmethod
+    def current_frame() -> int:
+        return _frame_ctx.get()
+
+    def span(self, stage: str, frame: int = 0, *, session: str = "0",
+             **fields):
+        """``with telemetry.span("capture", fid):`` — no-op when disabled
+        (same one-attribute-read discipline as tracing.Tracer.span)."""
+        if not self.enabled:
+            return _NOOP
+        return _TeleSpan(self, stage, session, frame, fields)
+
+    def map_seq(self, session: str, seq: int, frame: int) -> None:
+        """Transport send: remember which frame a wire sequence number
+        carried so the client's ack can be correlated back."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if len(self._seq_map) > 8192:  # acks lost: bound memory
+                self._seq_map.clear()
+            self._seq_map[(session, seq)] = frame
+
+    def ack(self, session: str, seq: int, recv_ms: float) -> None:
+        """Client feedback (``_ack,<seq>,<recv_ms>`` / RTCP): closes the
+        frame's timeline."""
+        if not self.enabled:
+            return
+        with self._lock:
+            fid = self._seq_map.pop((session, seq), 0)
+        self._record(session, {"ev": "ack", "fid": fid, "seq": seq,
+                               "recv_ms": round(recv_ms, 3)})
+
+    # -- emission ------------------------------------------------------
+
+    def _labels_of(self, family: str, labels: dict) -> tuple[str, ...]:
+        names = _FAMILY_LABELS.get(family)
+        if names is None:  # unregistered family: fail soft, keep serving
+            names = tuple(sorted(labels))
+            _FAMILY_LABELS[family] = names
+        return tuple(str(labels.get(n, "0")) for n in names)
+
+    def count(self, family: str, n: float = 1, **labels) -> None:
+        if not self.enabled:
+            return
+        key = (family, self._labels_of(family, labels))
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + n
+        self._record(labels.get("session") or labels.get("slot") or "0",
+                     {"ev": family, "n": n, **labels})
+
+    def gauge(self, family: str, value: float, **labels) -> None:
+        if not self.enabled:
+            return
+        key = (family, self._labels_of(family, labels))
+        with self._lock:
+            self._gauges[key] = float(value)
+        self._record(labels.get("session") or labels.get("slot") or "0",
+                     {"ev": family, "value": value, **labels})
+
+    def _observe(self, family: str, value: float, labels: dict) -> None:
+        buckets = _HIST_BUCKETS[family]
+        key = (family, self._labels_of(family, labels))
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = [[0] * (len(buckets) + 1), 0.0]
+            i = 0
+            while i < len(buckets) and value > buckets[i]:
+                i += 1
+            h[0][i] += 1
+            h[1] += value
+
+    def stage_ms(self, stage: str, ms: float, *, session: str = "0",
+                 frame: int = 0, **fields) -> None:
+        """One stage execution for one frame: histogram + timeline."""
+        if not self.enabled:
+            return
+        self._observe("selkies_stage_ms", ms, {"stage": stage,
+                                               "session": session})
+        self._record(session, {"ev": stage, "fid": frame or _frame_ctx.get(),
+                               "ms": round(ms, 3), **fields})
+
+    def frame_done(self, frame: int, nbytes: int, *, idr: bool,
+                   session: str = "0", device_ms: float = 0.0,
+                   pack_ms: float = 0.0) -> None:
+        """An encoded access unit left the encoder: fold its size, kind,
+        and on-device / entropy-pack milliseconds."""
+        if not self.enabled:
+            return
+        self._observe("selkies_frame_bytes", nbytes, {"session": session})
+        key = ("selkies_frames_total", (session, "idr" if idr else "p"))
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + 1
+        if device_ms:
+            self._observe("selkies_stage_ms", device_ms,
+                          {"stage": "device", "session": session})
+        if pack_ms:
+            self._observe("selkies_stage_ms", pack_ms,
+                          {"stage": "pack", "session": session})
+        self._record(session, {"ev": "frame", "fid": frame, "bytes": nbytes,
+                               "idr": idr, "device_ms": round(device_ms, 3),
+                               "pack_ms": round(pack_ms, 3)})
+
+    def _record(self, session: str, ev: dict) -> None:
+        rec = self.recorder
+        if rec is not None:
+            if "fid" not in ev:
+                # nested emissions (the encoder's tile-cache counters on
+                # the encode worker) inherit the frame id from the span's
+                # ContextVar — this read IS the advertised correlation
+                fid = _frame_ctx.get()
+                if fid:
+                    ev["fid"] = fid
+            rec.record(session, ev)
+
+    # -- registrations -------------------------------------------------
+
+    def register_provider(self, name: str, fn) -> None:
+        """A live read-side source folded into ``rollup()`` and the
+        Prometheus collector (e.g. the encoder's LinkByteCounter
+        snapshot). ``fn`` must be cheap and thread-safe. Bound methods
+        are held via WeakMethod so this process-global registry never
+        keeps a torn-down app/fleet (and its encoder) alive; names are
+        last-writer-wins — the newest owner of a name is the live one."""
+        if hasattr(fn, "__self__"):
+            self._providers[name] = weakref.WeakMethod(fn)
+        else:
+            self._providers[name] = lambda: fn
+
+    def register_slot(self, name: str, supervisor) -> None:
+        """Called by SlotSupervisor.__init__: makes the slot visible to
+        ``health()`` / ``/healthz`` regardless of whether metric
+        emission is enabled. Weakly referenced (a recycled app's
+        supervisor must not be pinned forever) and last-writer-wins per
+        name, matching the one-supervisor-per-slot-name product shape."""
+        self._slots[name] = weakref.ref(supervisor)
+
+    def _provider_values(self) -> dict[str, dict]:
+        out = {}
+        for name, ref in list(self._providers.items()):
+            fn = ref()
+            if fn is None:  # owner got collected
+                self._providers.pop(name, None)
+                continue
+            try:
+                out[name] = fn() or {}
+            except Exception:
+                logger.exception("telemetry provider %r failed", name)
+                out[name] = {}
+        return out
+
+    # -- read side -----------------------------------------------------
+
+    def health(self) -> dict:
+        """Rung/watchdog summary for k8s-style probes. Works with
+        telemetry disabled — supervisors register unconditionally.
+        ``status``: ok (all slots at/below WARN), degraded (a slot is
+        shedding load or restarting), down (a slot hit RECYCLE)."""
+        slots = {}
+        worst = 0
+        for name, ref in list(self._slots.items()):
+            sup = ref()
+            if sup is None:  # supervisor got collected
+                self._slots.pop(name, None)
+                continue
+            try:
+                slots[name] = sup.stats()
+                worst = max(worst, int(sup.rung))
+            except Exception:
+                slots[name] = {"error": "unreadable"}
+        status = "ok" if worst <= 1 else ("down" if worst >= 5 else "degraded")
+        return {"status": status, "worst_rung": worst, "slots": slots}
+
+    def rollup(self) -> dict:
+        """The /statz JSON: histograms, counters, gauges, providers,
+        health, and the tracer's per-stage summary."""
+        from selkies_tpu.monitoring.tracing import tracer
+
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = {k: (list(v[0]), v[1]) for k, v in self._hists.items()}
+
+        def label_str(family: str, vals: tuple) -> str:
+            names = _FAMILY_LABELS.get(family, ())
+            return ",".join(f"{n}={v}" for n, v in zip(names, vals))
+
+        def fold(d: dict) -> dict:
+            out: dict[str, dict] = {}
+            for (family, vals), v in sorted(d.items()):
+                out.setdefault(family, {})[label_str(family, vals)] = v
+            return out
+
+        stages: dict[str, dict] = {}
+        for (family, vals), (counts, total) in sorted(hists.items()):
+            n = sum(counts)
+            stages.setdefault(family, {})[label_str(family, vals)] = {
+                "count": n,
+                "mean": round(total / n, 3) if n else 0.0,
+                "buckets": dict(zip(
+                    [str(b) for b in _HIST_BUCKETS[family]] + ["+Inf"],
+                    itertools.accumulate(counts))),
+            }
+        return {
+            "enabled": self.enabled,
+            "uptime_s": round(time.time() - self._epoch, 1),
+            "histograms": stages,
+            "counters": fold(counters),
+            "gauges": fold(gauges),
+            "providers": self._provider_values(),
+            "health": self.health(),
+            "trace": tracer.summary() if tracer.enabled else {},
+        }
+
+    def statz_json(self) -> str:
+        return json.dumps(self.rollup(), indent=2)
+
+    # -- prometheus fold -----------------------------------------------
+
+    def register_into(self, registry) -> None:
+        """Fold this bus into an existing prometheus CollectorRegistry
+        (Metrics does this so one scrape port serves both)."""
+        registry.register(_TelemetryCollector(self))
+
+    @property
+    def registry(self):
+        """A standalone registry exporting only this bus."""
+        if self._registry is None:
+            from prometheus_client import CollectorRegistry
+
+            self._registry = CollectorRegistry()
+            self.register_into(self._registry)
+        return self._registry
+
+    # -- black box -----------------------------------------------------
+
+    def escalation(self, session: str, reason: str):
+        """Supervisor escalation past WARN: dump the black box for this
+        slot (rate-limited inside the recorder). When called on a
+        running event loop — supervisors escalate from inside the
+        serving loops — the disk write is handed to the default
+        executor so a slow disk can't stall every session at the exact
+        moment a slot is failing; the synchronous path (tests, worker
+        threads) returns the bundle path."""
+        rec = self.recorder
+        if rec is None:
+            if not self.enabled:
+                return None
+            rec = self._ensure_recorder()
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        if loop is not None:
+            loop.run_in_executor(None, self._dump_sync, rec, session, reason)
+            return None
+        return self._dump_sync(rec, session, reason)
+
+    def _dump_sync(self, rec, session: str, reason: str):
+        path = rec.dump(session, reason, snapshot=self.rollup())
+        if path is not None:
+            key = ("selkies_blackbox_dumps_total", (str(session),))
+            with self._lock:
+                self._counters[key] = self._counters.get(key, 0) + 1
+        return path
+
+
+class _TelemetryCollector:
+    """prometheus_client custom collector: converts the bus state (and
+    the link-bytes provider) into metric families at scrape time — no
+    per-event prometheus objects on the hot path."""
+
+    def __init__(self, t: Telemetry):
+        self._t = t
+
+    def collect(self):
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+            HistogramMetricFamily,
+        )
+
+        t = self._t
+        if not t.enabled:
+            return  # off means off: no families, not even provider reads
+        with t._lock:
+            counters = dict(t._counters)
+            gauges = dict(t._gauges)
+            hists = {k: (list(v[0]), v[1]) for k, v in t._hists.items()}
+        # live link bytes: the provider snapshot IS the counter value
+        # ("up_delta" -> direction=up, stage=delta)
+        link = t._provider_values().get("link_bytes", {})
+        for stage_key, nbytes in link.items():
+            direction, _, stage = str(stage_key).partition("_")
+            key = ("selkies_link_bytes_total", (direction, stage or "?"))
+            counters[key] = counters.get(key, 0) + nbytes
+
+        def group(d: dict) -> dict:
+            by_fam: dict[str, list] = {}
+            for (family, vals), v in sorted(d.items()):
+                by_fam.setdefault(family, []).append((vals, v))
+            return by_fam
+
+        for family, rows in group(counters).items():
+            f = CounterMetricFamily(
+                family, METRIC_FAMILIES.get(family, family),
+                labels=_FAMILY_LABELS.get(family, ()))
+            for vals, v in rows:
+                f.add_metric(list(vals), v)
+            yield f
+        for family, rows in group(gauges).items():
+            f = GaugeMetricFamily(
+                family, METRIC_FAMILIES.get(family, family),
+                labels=_FAMILY_LABELS.get(family, ()))
+            for vals, v in rows:
+                f.add_metric(list(vals), v)
+            yield f
+        for family, rows in group(hists).items():
+            f = HistogramMetricFamily(
+                family, METRIC_FAMILIES.get(family, family),
+                labels=_FAMILY_LABELS.get(family, ()))
+            edges = [str(b) for b in _HIST_BUCKETS[family]] + ["+Inf"]
+            for vals, (bucket_counts, total) in rows:
+                cum = list(itertools.accumulate(bucket_counts))
+                f.add_metric(list(vals), list(zip(edges, cum)),
+                             sum_value=total)
+            yield f
+
+
+# the process-global bus every emission site uses
+telemetry = Telemetry()

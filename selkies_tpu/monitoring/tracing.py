@@ -20,6 +20,31 @@ total / min / max / EWMA). Two export surfaces:
 Enable with SELKIES_TRACING=1 (or tracer.enable()); the ring holds the
 most recent `capacity` spans (default 8192 ≈ 2-3 s of a busy 1080p60
 pipeline across ~5 stages).
+
+Span-name vocabulary (the full set emitted by the framework — keep this
+list authoritative when adding instrumentation so dashboards and the
+black-box bundles stay greppable):
+
+  solo video loop (pipeline/elements.py):
+    capture       FrameSource.capture on the worker thread
+    classify      static/delta/full frame classification incl. the
+                  tile-cache hash/split (models/h264/encoder.py)
+    submit        pipelined encoder dispatch (classify + upload + step)
+    encode        synchronous encode_frame path (non-pipelined rows)
+    send          sink callback (transport handoff) per access unit
+    frame-drop    instant: capture tick skipped (transport backpressure)
+  encoder completion workers (models/h264/encoder.py):
+    fetch         device→host coefficient/word downlink
+    pack          host CAVLC entropy pack + NAL assembly
+  fleet service (parallel/serving.py):
+    convert       per-session BGRx→I420 on the pack pool
+    device-step   sharded batch encode dispatch
+    fetch / pack  batch downlink and concurrent per-session packs
+  transports (transport/websocket.py):
+    ws-send       one binary media frame over the WebSocket plane
+  audio (audio/pipeline.py):
+    audio-encode  one 10 ms Opus frame
+    audio-send    audio sink callback
 """
 
 from __future__ import annotations

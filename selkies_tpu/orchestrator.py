@@ -28,6 +28,7 @@ from selkies_tpu.resilience import get_injector
 from selkies_tpu.input_host import HostInput
 from selkies_tpu.input_host.resize import resize_display, set_cursor_size, set_dpi
 from selkies_tpu.monitoring import Metrics, SystemMonitor, TPUMonitor
+from selkies_tpu.monitoring.telemetry import telemetry
 from selkies_tpu.pipeline.app import TPUWebRTCApp
 from selkies_tpu.signalling import (
     SignallingOptions,
@@ -489,6 +490,9 @@ class Orchestrator:
 
     def _on_ping_response(self, latency_ms: float) -> None:
         self.metrics.set_latency(latency_ms)
+        if telemetry.enabled:
+            telemetry.gauge("selkies_congestion_rtt_ms", latency_ms,
+                            session="0")
         self.app.send_latency_time(latency_ms)
 
     # ------------------------------------------------------------------

@@ -44,6 +44,7 @@ from selkies_tpu.config import Config
 from selkies_tpu.input_host import HostInput
 from selkies_tpu.models.h264.ratecontrol import CbrRateController
 from selkies_tpu.monitoring import Metrics, SystemMonitor, TPUMonitor
+from selkies_tpu.monitoring.telemetry import telemetry
 from selkies_tpu.pipeline.elements import EncodedFrame, SyntheticSource
 from selkies_tpu.resilience import SlotSupervisor, get_injector
 from selkies_tpu.signalling.client import (
@@ -78,6 +79,7 @@ class SessionSlot:
                  turn_tls_insecure: bool = False):
         self.index = index
         self.ws = WebSocketTransport()
+        self.ws.session = str(index)  # telemetry seq->frame correlation
         self.webrtc = WebRTCTransport(audio=webrtc_audio,
                                       turn_tls_insecure=turn_tls_insecure)
         self.webrtc.set_codec(codec)
@@ -443,18 +445,23 @@ class SessionFleet:
             if not any(s.connected for s in self.slots):
                 self.supervisor.note_idle()
                 continue  # idle fleet: no capture, no device work
+            # one correlation id per lockstep tick: every slot's frame
+            # this tick shares it (the batch IS one device dispatch)
+            fid = telemetry.next_frame_id() if telemetry.enabled else 0
             try:
                 if self._restart_pending:
                     self._do_restart_service()
                 self._tick_in_flight = True
                 self._tick_started_at = time.monotonic()
-                capture_failed = await asyncio.to_thread(self._capture_batch)
+                with telemetry.span("capture", fid, session="fleet"):
+                    capture_failed = await asyncio.to_thread(self._capture_batch)
                 self._note_capture_failures(capture_failed)
                 if len(capture_failed) == self.n and self.ticks == 0:
                     # no slot has EVER captured: the batch is still all-
                     # black — count and retry rather than stream nothing
                     raise capture_failed[0][1]
-                aus, idrs, qps, tick_ms = await asyncio.to_thread(self._encode_tick)
+                with telemetry.span("encode", fid, session="fleet"):
+                    aus, idrs, qps, tick_ms = await asyncio.to_thread(self._encode_tick)
                 self.ticks += 1
                 self.last_tick_ms = tick_ms
                 self.on_tick(tick_ms)
@@ -471,9 +478,13 @@ class SessionFleet:
                         # the QP this frame was actually encoded at (rc
                         # .update above may already have moved the next)
                         qp=qp, device_ms=tick_ms,
-                        pack_ms=0.0,
+                        pack_ms=0.0, frame_id=fid,
                     )
                     slot.frames += 1
+                    if fid:
+                        telemetry.frame_done(fid, len(au), idr=idr,
+                                             session=str(k),
+                                             device_ms=tick_ms)
                     sends.append((k, slot.transport.send_video(ef)))
                 if sends:
                     results = await asyncio.gather(
@@ -600,6 +611,19 @@ class FleetOrchestrator:
         self._tasks: list[asyncio.Task] = []
         self._rearm: dict[int, asyncio.Event] = {}
         self._wire_slots()
+        telemetry.register_provider("fleet", self._fleet_stats)
+
+    def _fleet_stats(self) -> dict:
+        """/statz live view of the lockstep serving core."""
+        f = self.fleet
+        return {
+            "sessions": self.n,
+            "connected": sum(1 for s in self.slots if s.connected),
+            "ticks": f.ticks, "fps": f.fps,
+            "last_tick_ms": round(f.last_tick_ms, 3),
+            "software_mode": f.software_mode,
+            "frames": {str(k): s.frames for k, s in enumerate(self.slots)},
+        }
 
     def _make_sources(self, width: int, height: int):
         """Per-session displays from ``--session_displays`` (csv of X
@@ -744,6 +768,7 @@ class FleetOrchestrator:
                     min_kbps=max(100 + audio_kbps, int(cfg.video_bitrate) // 10),
                     max_kbps=int(cfg.video_bitrate),
                     on_estimate=lambda kbps, k=k: self.fleet.set_session_bitrate(k, kbps),
+                    session=str(k),
                 )
                 slot.ws.on_video_sent = slot.gcc.on_frame_sent
                 inp.on_media_ack = slot.gcc.on_frame_ack
@@ -777,7 +802,14 @@ class FleetOrchestrator:
             set_fps, set_latency = self.metrics.session_setters(k)
             inp.on_client_fps = set_fps
             inp.on_client_latency = set_latency
-            inp.on_ping_response = slot.send_latency_time
+
+            def on_ping(ms: float, k=k, slot=slot):
+                slot.send_latency_time(ms)
+                if telemetry.enabled:
+                    telemetry.gauge("selkies_congestion_rtt_ms", ms,
+                                    session=str(k))
+
+            inp.on_ping_response = on_ping
             inp.on_client_webrtc_stats = (
                 lambda t, s, k=k, slot=slot: self._on_slot_stats(slot, t, s))
 

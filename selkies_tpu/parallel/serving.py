@@ -24,6 +24,7 @@ from selkies_tpu.models.frameprep import FramePrep
 from selkies_tpu.models.h264.bitstream import StreamParams, write_pps, write_sps
 from selkies_tpu.models.h264.native import pack_slice_fast, pack_slice_p_fast
 from selkies_tpu.models.h264.numpy_ref import FrameCoeffs, PFrameCoeffs
+from selkies_tpu.monitoring.tracing import tracer
 from selkies_tpu.parallel.sessions import MultiSessionEncoder
 
 __all__ = ["MultiSessionH264Service", "SoftwareFleetService"]
@@ -105,14 +106,16 @@ class MultiSessionH264Service:
             np.copyto(self._batch_u[i], u)
             np.copyto(self._batch_v[i], v)
 
-        list(self._pool.map(_convert_into, range(self.n)))
+        with tracer.span("convert"):
+            list(self._pool.map(_convert_into, range(self.n)))
         batch = (self._batch_y, self._batch_u, self._batch_v)
-        if self.enc._ref is None:
-            # first tick: no reference planes exist, everyone starts a GOP
-            idrs[:] = True
-            out = self.enc.encode_idr(batch, qps)
-        else:
-            out = self.enc.encode_mixed(batch, qps, idrs)
+        with tracer.span("device-step"):
+            if self.enc._ref is None:
+                # first tick: no reference planes exist, everyone starts a GOP
+                idrs[:] = True
+                out = self.enc.encode_idr(batch, qps)
+            else:
+                out = self.enc.encode_mixed(batch, qps, idrs)
         # fetch the coefficient batch once, then pack per session in
         # parallel (independent streams). Branch-filler fields are
         # skipped when no session took that branch — the all-zero
@@ -122,12 +125,14 @@ class MultiSessionH264Service:
         p_only = {"mvs", "skip"}
         skip_keys = (i_only if not idrs.any() else set()) | (
             p_only if idrs.all() else set())
-        host = {k: np.asarray(v) for k, v in out.items() if k not in skip_keys}
-        futures = [
-            self._pool.submit(self._pack_one, i, host, bool(idrs[i]))
-            for i in range(self.n)
-        ]
-        aus = [f.result() for f in futures]
+        with tracer.span("fetch"):
+            host = {k: np.asarray(v) for k, v in out.items() if k not in skip_keys}
+        with tracer.span("pack"):
+            futures = [
+                self._pool.submit(self._pack_one, i, host, bool(idrs[i]))
+                for i in range(self.n)
+            ]
+            aus = [f.result() for f in futures]
         self.last_idrs = [bool(x) for x in idrs]
         for s, idr in zip(self.sessions, idrs):
             if idr:

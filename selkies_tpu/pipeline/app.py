@@ -25,6 +25,7 @@ from typing import Any, Awaitable, Callable, Protocol
 
 from selkies_tpu.models.registry import create_encoder, encoder_exists
 from selkies_tpu.models.h264.ratecontrol import CbrRateController
+from selkies_tpu.monitoring.telemetry import telemetry
 from selkies_tpu.pipeline.elements import (
     DownscaleSource,
     EncodedFrame,
@@ -158,6 +159,29 @@ class TPUWebRTCApp:
         self.on_frame: Callable[[EncodedFrame], None] = lambda f: None
 
         self.last_cursor_sent: Any = None
+
+        # /statz live read-side: the encoder's link-byte counters (reads
+        # through self.encoder so supervisor swaps/rebuilds stay covered)
+        # and the pipeline's frame/drop accounting
+        telemetry.register_provider("link_bytes", self._link_bytes_snapshot)
+        telemetry.register_provider("pipeline", self._pipeline_stats)
+
+    def _link_bytes_snapshot(self) -> dict:
+        lb = getattr(self.encoder, "link_bytes", None)
+        return lb.snapshot() if lb is not None else {}
+
+    def _pipeline_stats(self) -> dict:
+        p = self.pipeline
+        if p is None:
+            return {"running": False,
+                    "software_fallback": self.software_fallback}
+        return {
+            "running": p.running, "fps": p.fps, "frames": p.frames,
+            "dropped_ticks": p.dropped_ticks,
+            "dropped_frames": p.dropped_frames, "outbox": len(p._outbox),
+            "software_fallback": self.software_fallback,
+            "encoder": self._active_encoder_name(),
+        }
 
     # ------------------------------------------------------------------
     # lifecycle (reference :1759, :1810)

@@ -20,6 +20,7 @@ from typing import Awaitable, Callable, Protocol
 
 import numpy as np
 
+from selkies_tpu.monitoring.telemetry import telemetry
 from selkies_tpu.monitoring.tracing import tracer
 from selkies_tpu.resilience.faultinject import get_injector
 
@@ -160,6 +161,9 @@ class EncodedFrame:
     device_ms: float
     pack_ms: float
     scene_cut: bool = False
+    # telemetry correlation id assigned at capture (0 = telemetry off);
+    # metadata only — never touches the encoded bytes
+    frame_id: int = 0
 
 
 VideoSink = Callable[[EncodedFrame], Awaitable[None]]
@@ -206,6 +210,11 @@ class VideoPipeline:
         self.frames = 0
         self.dropped_ticks = 0
         self.dropped_frames = 0
+        # telemetry session label + submit-path frame-id ledger: the
+        # pipelined encoder returns EARLIER frames, keyed by the 90 kHz
+        # timestamp we dispatched them with
+        self.session = "0"
+        self._fid_by_ts: dict[int, int] = {}
 
     @property
     def running(self) -> bool:
@@ -270,12 +279,16 @@ class VideoPipeline:
                 self.dropped_frames += 1
                 tracer.instant("frame-drop")
                 continue
+            # frame correlation id: assigned at capture, carried through
+            # classify/encode/send and echoed by the client's ack
+            fid = telemetry.next_frame_id() if telemetry.enabled else 0
             try:
                 fi = get_injector()
                 if fi is not None:
                     fi.check("capture")
                 self._tick_in_flight = True
-                with tracer.span("capture"):
+                with tracer.span("capture"), \
+                        telemetry.span("capture", fid, session=self.session):
                     frame = await asyncio.to_thread(self.source.capture)
                 if frame.shape[:2] != (self.encoder.height, self.encoder.width):
                     # xrandr resize landed (capture.py re-arms its SHM at the
@@ -308,7 +321,15 @@ class VideoPipeline:
                 if hasattr(self.encoder, "submit"):
                     # pipelined path: dispatch this frame, emit whichever
                     # earlier frames completed (device latency hidden)
-                    with tracer.span("submit"):
+                    if fid:
+                        self._fid_by_ts[ts] = fid
+                        if len(self._fid_by_ts) > 1024:  # failed-tick leaks
+                            self._fid_by_ts.clear()
+                    # telemetry.span also sets the frame ContextVar, which
+                    # asyncio.to_thread copies — the encoder's tile-cache
+                    # events correlate without API changes
+                    with tracer.span("submit"), \
+                            telemetry.span("submit", fid, session=self.session):
                         done = await asyncio.to_thread(self.encoder.submit, frame, qp, ts)
                     efs = [
                         EncodedFrame(
@@ -320,11 +341,13 @@ class VideoPipeline:
                             device_ms=stats.device_ms,
                             pack_ms=stats.pack_ms,
                             scene_cut=getattr(stats, "scene_cut", False),
+                            frame_id=self._fid_by_ts.pop(meta, 0),
                         )
                         for au, stats, meta in done
                     ]
                 else:
-                    with tracer.span("encode"):
+                    with tracer.span("encode"), \
+                            telemetry.span("encode", fid, session=self.session):
                         au = await asyncio.to_thread(self.encoder.encode_frame, frame, qp)
                     stats = self.encoder.last_stats
                     efs = [
@@ -336,11 +359,18 @@ class VideoPipeline:
                             qp=stats.qp,
                             device_ms=stats.device_ms,
                             pack_ms=stats.pack_ms,
+                            frame_id=fid,
                         )
                     ]
                 for ef in efs:
                     self.rc.update(len(ef.au), idr=ef.idr or ef.scene_cut)
                 self.frames += len(efs)
+                if telemetry.enabled:
+                    for ef in efs:
+                        telemetry.frame_done(
+                            ef.frame_id, len(ef.au), idr=ef.idr,
+                            session=self.session, device_ms=ef.device_ms,
+                            pack_ms=ef.pack_ms)
                 failures = 0
                 if self.supervisor is not None:
                     self.supervisor.tick_ok()
@@ -369,7 +399,10 @@ class VideoPipeline:
             while self._outbox:
                 ef = self._outbox.popleft()
                 try:
-                    with tracer.span("send"):
+                    with tracer.span("send"), \
+                            telemetry.span("send", ef.frame_id,
+                                           session=self.session,
+                                           bytes=len(ef.au)):
                         await self.sink(ef)
                 except asyncio.CancelledError:
                     raise

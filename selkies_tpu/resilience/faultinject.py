@@ -37,6 +37,8 @@ import random
 import re
 import threading
 
+from selkies_tpu.monitoring.telemetry import telemetry
+
 logger = logging.getLogger("resilience.faultinject")
 
 __all__ = ["InjectedFault", "FaultInjector", "get_injector",
@@ -172,6 +174,11 @@ class FaultInjector:
             self.injected.append((site, tick, hit.action))
         logger.warning("injected %s at %s tick %d (%s)",
                        hit.action, site, tick, self.spec)
+        if telemetry.enabled:
+            # a scheduled fault firing is exactly the kind of event a
+            # post-mortem bundle must contain (chaos-run attribution)
+            telemetry.count("selkies_faults_injected_total",
+                            site=site, action=hit.action)
         if hit.action == "raise":
             raise InjectedFault(f"injected fault at {site} tick {tick}")
         return hit.action, hit.delay_ms

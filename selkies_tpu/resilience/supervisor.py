@@ -29,6 +29,8 @@ import logging
 import time
 from typing import Callable, Protocol
 
+from selkies_tpu.monitoring.telemetry import telemetry
+
 logger = logging.getLogger("resilience.supervisor")
 
 __all__ = ["Rung", "Backoff", "RecoveryActions", "SlotSupervisor"]
@@ -150,6 +152,21 @@ class SlotSupervisor:
         }
         self._next_restart_at = 0.0
         self._total_ok = 0  # lifetime, arms the deadline watchdog
+        # escalation hook (telemetry/black-box wiring): called with
+        # (rung, reason) whenever failure() applies an action PAST warn;
+        # the default path also asks the telemetry bus to dump the
+        # slot's flight-recorder ring (monitoring/flightrecorder.py)
+        self.on_escalation: Callable[[Rung, str], None] | None = None
+        telemetry.register_slot(name, self)  # /healthz visibility
+
+    def _emit(self, event: str) -> None:
+        """Fold a ladder event into the telemetry counters (one attribute
+        read when telemetry is off)."""
+        if telemetry.enabled:
+            telemetry.count("selkies_supervisor_events_total",
+                            slot=self.name, event=event)
+            telemetry.gauge("selkies_supervisor_rung", int(self.rung),
+                            slot=self.name)
 
     # -- events --------------------------------------------------------
 
@@ -161,6 +178,9 @@ class SlotSupervisor:
         self._total_ok += 1
         if self.rung != Rung.HEALTHY and self.degrade_level == 0:
             self.rung = Rung.HEALTHY
+            # push the rung gauge back down: alerts on an escalated rung
+            # must clear when the slot recovers, not on the next failure
+            self._emit("recovered")
         if self.healthy_streak >= self.recover_after:
             self.healthy_streak = 0
             self.backoff.reset()
@@ -173,6 +193,7 @@ class SlotSupervisor:
                             "level %d", self.name, self.degrade_level)
                 if self.degrade_level == 0:
                     self.rung = Rung.HEALTHY
+                self._emit("undegrade")
 
     def failure(self, exc: BaseException | None = None,
                 reason: str = "tick") -> Rung:
@@ -183,14 +204,18 @@ class SlotSupervisor:
         self.healthy_streak = 0
         self.counters["failures"] += 1
         n = self.failures
+        escalations: list[str] = []  # actions applied past WARN this call
         if n == self.warn_after:
             self.rung = max(self.rung, Rung.WARN)
             self._apply("warn", lambda: self.actions.warn(
                 f"{self.name}: {reason} failure #{n}: {exc!r}"))
+            self._emit("warn")
         if n == self.idr_after:
             self.rung = max(self.rung, Rung.FORCE_IDR)
             self.counters["idrs_forced"] += 1
             self._apply("force_idr", self.actions.force_idr)
+            self._emit("force_idr")
+            escalations.append("force_idr")
         if n >= self.restart_after and now >= self._next_restart_at:
             self.rung = max(self.rung, Rung.RESTART)
             self._next_restart_at = now + self.backoff.next_delay()
@@ -199,6 +224,8 @@ class SlotSupervisor:
                            "restart gated until +%.2fs)", self.name, n,
                            self._next_restart_at - now)
             self._apply("restart_encoder", self.actions.restart_encoder)
+            self._emit("restart")
+            escalations.append("restart")
         if (n >= self.degrade_after
                 and self.degrade_level < self.MAX_DEGRADE_LEVEL
                 and (n - self.degrade_after) % self.degrade_every == 0):
@@ -209,16 +236,30 @@ class SlotSupervisor:
                            self.name, self.degrade_level, n)
             self._apply("degrade",
                         lambda: self.actions.degrade(self.degrade_level))
+            self._emit("degrade")
+            escalations.append("degrade")
         if n >= self.recycle_after:
             self.rung = Rung.RECYCLE
             self.counters["recycles"] += 1
             logger.error("%s: recycling session after %d consecutive "
                          "failures", self.name, n)
             self._apply("recycle", self.actions.recycle)
+            self._emit("recycle")
+            escalations.append("recycle")
             # a recycled session starts a fresh ladder climb, but the
             # restart gate keeps its backoff so a crash-looping slot
             # cannot hot-loop encoder rebuilds
             self.failures = 0
+        if escalations:
+            # black-box hook: anything past WARN is evidence worth
+            # keeping — dump the flight recorder (rate-limited per slot)
+            # and notify any custom hook; neither may kill the loop
+            why = (f"{reason}: {'+'.join(escalations)} at failure #{n} "
+                   f"({exc!r})")
+            if self.on_escalation is not None:
+                self._apply("on_escalation",
+                            lambda: self.on_escalation(self.rung, why))
+            telemetry.escalation(self.name, why)
         return self.rung
 
     def note_idle(self) -> None:
@@ -238,6 +279,7 @@ class SlotSupervisor:
         if now - self.last_ok <= self.deadline_ticks / self.fps:
             return False
         self.counters["deadline_misses"] += 1
+        self._emit("deadline_miss")
         self.last_ok = now  # re-arm: one escalation per missed window
         self.failure(None, reason="tick deadline")
         return True

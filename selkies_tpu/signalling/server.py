@@ -185,7 +185,8 @@ class SignallingServer:
             return await self._handle_ws(request)
 
         # basic auth gates everything except the TURN credential endpoint
-        if opts.enable_basic_auth and path.rstrip("/") != "/turn":
+        # and the k8s liveness probe (probes cannot carry credentials)
+        if opts.enable_basic_auth and path.rstrip("/") not in ("/turn", "/healthz"):
             if not self._check_basic_auth(request):
                 hdrs = dict(cors)
                 hdrs["WWW-Authenticate"] = 'Basic realm="restricted, charset="UTF-8"'
@@ -199,6 +200,12 @@ class SignallingServer:
 
         if path.rstrip("/") == "/trace":
             return self._serve_trace(request, cors)
+
+        if path.rstrip("/") == "/statz":
+            return self._serve_statz(request, cors)
+
+        if path.rstrip("/") == "/healthz":
+            return self._serve_healthz(request, cors)
 
         return await self._serve_static(request, cors)
 
@@ -224,6 +231,45 @@ class SignallingServer:
         if request.query.get("reset") in ("1", "true"):
             tracer.reset()
         return web.Response(status=200, text=body, headers=headers)
+
+    def _serve_statz(self, request: web.Request, cors: dict[str, str]) -> web.Response:
+        """Telemetry rollup (monitoring/telemetry.py): per-stage latency
+        histograms, counters (tile cache, supervisor ladder, faults),
+        congestion gauges, live link bytes, and slot health as one JSON
+        document — pretty-printed by tools/statz.py. 404s with a hint
+        when telemetry is off (SELKIES_TELEMETRY=1), like /trace."""
+        from selkies_tpu.monitoring.telemetry import telemetry
+
+        headers = dict(cors)
+        if not telemetry.enabled:
+            headers["Content-Type"] = "text/plain"
+            return web.Response(
+                status=404, headers=headers,
+                text="telemetry disabled (set SELKIES_TELEMETRY=1)\n")
+        headers["Content-Type"] = "application/json"
+        return web.Response(status=200, text=telemetry.statz_json(),
+                            headers=headers)
+
+    def _serve_healthz(self, request: web.Request, cors: dict[str, str]) -> web.Response:
+        """Supervisor rung / watchdog summary shaped for k8s probes:
+        200 while every slot is healthy or degraded-but-serving, 503
+        once a slot hits the RECYCLE rung. Works with telemetry metric
+        emission off — supervisors register unconditionally.
+
+        The path is basic-auth exempt so probes work, but an
+        unauthenticated caller only gets the status word — the per-slot
+        ladder internals (slot names, failure counters) stay behind
+        auth with the rest of the server."""
+        from selkies_tpu.monitoring.telemetry import telemetry
+
+        health = telemetry.health()
+        headers = dict(cors)
+        headers["Content-Type"] = "application/json"
+        status = 503 if health["status"] == "down" else 200
+        if self.options.enable_basic_auth and not self._check_basic_auth(request):
+            health = {"status": health["status"]}
+        return web.Response(status=status, text=json.dumps(health, indent=2),
+                            headers=headers)
 
     def _serve_turn(self, request: web.Request, cors: dict[str, str]) -> web.Response:
         opts = self.options

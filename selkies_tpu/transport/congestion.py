@@ -31,6 +31,8 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Callable
 
+from selkies_tpu.monitoring.telemetry import telemetry
+
 logger = logging.getLogger("transport.gcc")
 
 # trendline / detector constants (draft-ietf-rmcat-gcc-02 §5)
@@ -131,7 +133,11 @@ class GccController:
         min_kbps: int = 100,
         max_kbps: int = 20000,
         on_estimate: Callable[[int], None] | None = None,
+        session: str = "0",
     ) -> None:
+        # telemetry label: bitrate flaps must be attributable to the
+        # session whose link caused them (fleet passes its slot index)
+        self.session = str(session)
         self.max_kbps = max_kbps
         self.min_kbps = min(min_kbps, max_kbps)
         self._floor = self.min_kbps  # audio-headroom floor; survives retargets
@@ -168,6 +174,11 @@ class GccController:
         self.min_kbps = min(self._floor, self.max_kbps)
         self.estimate_kbps = float(kbps)
         self._last_reported = float(kbps)
+        if telemetry.enabled:
+            telemetry.gauge("selkies_congestion_target_kbps", float(kbps),
+                            session=self.session)
+            telemetry.count("selkies_congestion_events_total",
+                            session=self.session, event="retarget")
 
     # -- send side -----------------------------------------------------
 
@@ -186,6 +197,10 @@ class GccController:
         sent = self._sent.pop(seq, None)
         if sent is None:
             return
+        if telemetry.enabled:
+            # closes the frame's timeline (fid resolved from the seq the
+            # transport registered at send time)
+            telemetry.ack(self.session, seq, recv_ms)
         self._recv_window.append((recv_ms, sent.size))
         while self._recv_window and recv_ms - self._recv_window[0][0] > 1000.0:
             self._recv_window.popleft()
@@ -194,6 +209,11 @@ class GccController:
 
     def on_loss_report(self, fraction_lost: float) -> None:
         """Loss-based bound (only meaningful on lossy transports)."""
+        if telemetry.enabled:
+            telemetry.gauge("selkies_congestion_loss_ratio", fraction_lost,
+                            session=self.session)
+            telemetry.count("selkies_congestion_events_total",
+                            session=self.session, event="loss_report")
         if fraction_lost > 0.10:
             self._set(self.estimate_kbps * (1.0 - 0.5 * fraction_lost))
         elif fraction_lost < 0.02:
@@ -241,4 +261,10 @@ class GccController:
         self.estimate_kbps = kbps
         if decreased or abs(kbps - self._last_reported) >= 0.05 * self._last_reported:
             self._last_reported = kbps
+            if telemetry.enabled:
+                telemetry.gauge("selkies_congestion_target_kbps", kbps,
+                                session=self.session)
+                telemetry.count("selkies_congestion_events_total",
+                                session=self.session,
+                                event="decrease" if decreased else "increase")
             self.on_estimate(int(round(kbps)))

@@ -28,6 +28,9 @@ from typing import Any, Awaitable, Callable
 
 from aiohttp import WSMsgType, web
 
+from selkies_tpu.monitoring.telemetry import telemetry
+from selkies_tpu.monitoring.tracing import tracer
+
 logger = logging.getLogger("transport.ws")
 
 HEADER = struct.Struct("!BBHI")
@@ -65,6 +68,8 @@ class WebSocketTransport:
         self.frames_sent = 0
         self.bytes_sent = 0
         self._video_seq = 0
+        # telemetry session label (fleet sets its slot index; solo = "0")
+        self.session = "0"
 
     # -- Transport protocol -------------------------------------------
 
@@ -111,7 +116,21 @@ class WebSocketTransport:
         # and an ack for an unregistered seq would be dropped. A frame that
         # fails to send leaves a stale entry, which simply ages out.
         self.on_video_sent(seq, send_ms, len(ef.au) + HEADER.size)
-        return await self._send_binary(pack_media_frame(KIND_VIDEO, flags, ef.timestamp_90k, ef.au, seq))
+        tele = telemetry.enabled
+        if tele:
+            # seq -> frame-id so the client's ack correlates back to the
+            # frame's capture/encode events (congestion.on_frame_ack)
+            telemetry.map_seq(self.session, seq, getattr(ef, "frame_id", 0))
+            t0 = time.perf_counter()
+        with tracer.span("ws-send"):
+            ok = await self._send_binary(
+                pack_media_frame(KIND_VIDEO, flags, ef.timestamp_90k, ef.au, seq))
+        if tele:
+            telemetry.stage_ms("ws-send", (time.perf_counter() - t0) * 1e3,
+                               session=self.session,
+                               frame=getattr(ef, "frame_id", 0),
+                               seq=seq, bytes=len(ef.au), ok=ok)
+        return ok
 
     async def send_audio(self, ea) -> None:
         """EncodedAudio (audio/pipeline.py) → binary WS message."""

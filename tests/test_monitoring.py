@@ -67,7 +67,64 @@ def test_csv_dynamic_schema(tmp_path):
 def test_csv_discards_truncated(tmp_path):
     log = _CsvLog(str(tmp_path / "s.csv"))
     log.append(Metrics.sanitize_json_stats([{"type": "x", "id": "1"}]))
-    assert log.rows == []
+    assert len(log.rows) == 0
+
+
+def test_csv_row_cache_is_bounded(tmp_path):
+    """The in-memory cache must not grow with session length, and a
+    schema-growth rewrite reconstructs the file from the cached tail
+    only (header + cap rows) instead of the full history."""
+    path = str(tmp_path / "capped.csv")
+    log = _CsvLog(path, cache_rows=5)
+    for i in range(8):
+        log.append(Metrics.sanitize_json_stats(_stats(framesDecoded=i)))
+    assert len(log.rows) == 5  # bounded despite 8 appends
+    with open(path) as f:
+        assert len(list(csv.reader(f))) == 9  # appends still hit the file
+    # schema growth: rewrite from the cap only
+    log.append(Metrics.sanitize_json_stats(_stats(n_extra=1, framesDecoded=8)))
+    with open(path) as f:
+        rows = list(csv.reader(f))
+    header = rows[0]
+    assert "inbound-rtp.extra0" in header
+    assert len(rows) == 1 + 5  # header + capped cache, all aligned
+    assert all(len(r) == len(header) for r in rows)
+    idx = header.index("inbound-rtp.framesDecoded")
+    assert [r[idx] for r in rows[1:]] == ["4", "5", "6", "7", "8"]
+
+
+def test_telemetry_families_fold_into_metrics_registry(tmp_path):
+    """SELKIES_TELEMETRY folds the expanded families into the SAME
+    scrape registry as the parity gauges (one metrics port serves
+    everything)."""
+    from prometheus_client import generate_latest
+
+    from selkies_tpu.monitoring.flightrecorder import FlightRecorder
+    from selkies_tpu.monitoring.telemetry import telemetry
+
+    telemetry.reset()
+    telemetry.enabled = True
+    telemetry.recorder = FlightRecorder(out_dir=str(tmp_path / "bb"))
+    try:
+        m = Metrics()
+        m.set_fps(60)
+        telemetry.stage_ms("capture", 2.0, frame=1)
+        telemetry.count("selkies_tile_cache_tiles_total", 4, result="hit")
+        telemetry.gauge("selkies_supervisor_rung", 0, slot="session")
+        telemetry.register_provider(
+            "link_bytes", lambda: {"up_delta": 1000, "down_pb": 2000})
+        text = generate_latest(m.registry).decode()
+    finally:
+        telemetry.enabled = False
+        telemetry.reset()
+    assert "fps 60.0" in text  # parity gauge still there
+    assert 'selkies_stage_ms_bucket{le="4.0",session="0",stage="capture"}' in text \
+        or 'selkies_stage_ms_bucket{le="4",session="0",stage="capture"}' in text
+    assert 'selkies_tile_cache_tiles_total{result="hit",session="0"} 4.0' in text
+    assert 'selkies_supervisor_rung{slot="session"} 0.0' in text
+    # live link bytes, split into direction/stage labels
+    assert 'selkies_link_bytes_total{direction="up",stage="delta"} 1000.0' in text
+    assert 'selkies_link_bytes_total{direction="down",stage="pb"} 2000.0' in text
 
 
 def test_set_webrtc_stats_roundtrip(tmp_path):

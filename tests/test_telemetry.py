@@ -1,0 +1,460 @@
+"""Telemetry bus + flight recorder + /statz + /healthz.
+
+Covers the observability contract: frame-id correlation across pipeline
+stages, the off-by-default no-op path, bit-identical encoded output with
+telemetry on vs. off, black-box dumps on forced supervisor escalation
+(with per-slot rate limiting), the signalling-server endpoints, and the
+metric-docs ratchet (tools/check_metric_docs.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from selkies_tpu.models.stats import FrameStats
+from selkies_tpu.monitoring.flightrecorder import FlightRecorder
+from selkies_tpu.monitoring.telemetry import (
+    METRIC_FAMILIES,
+    Telemetry,
+    telemetry,
+)
+from selkies_tpu.pipeline.elements import SyntheticSource, VideoPipeline
+from selkies_tpu.resilience.supervisor import Rung, SlotSupervisor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def tele(tmp_path):
+    """The process-global bus, enabled with a tmp-dir recorder; restored
+    to disabled/empty afterwards so the rest of the suite sees the
+    default off state."""
+    telemetry.reset()
+    telemetry.enabled = True
+    telemetry.recorder = FlightRecorder(out_dir=str(tmp_path / "bb"))
+    yield telemetry
+    telemetry.enabled = False
+    telemetry.reset()
+
+
+class TinyEncoder:
+    """Deterministic stand-in encoder (encode_frame path)."""
+
+    width, height = 64, 48
+
+    def __init__(self):
+        self.n = 0
+        self.last_stats = None
+
+    def encode_frame(self, frame, qp):
+        self.n += 1
+        self.last_stats = FrameStats(
+            frame_index=self.n, idr=self.n == 1, qp=qp,
+            bytes=16, device_ms=1.0, pack_ms=0.5)
+        return b"\x00\x00\x00\x01" + bytes([self.n % 251]) * 15
+
+    def force_keyframe(self):
+        pass
+
+
+class TinyRC:
+    def frame_qp(self):
+        return 30
+
+    def update(self, n, idr=False):
+        pass
+
+    def set_framerate(self, fps):
+        pass
+
+
+async def _run_pipeline(n_frames: int = 3):
+    got = []
+
+    async def sink(ef):
+        got.append(ef)
+
+    p = VideoPipeline(source=SyntheticSource(64, 48), encoder=TinyEncoder(),
+                      rate_controller=TinyRC(), sink=sink, fps=500)
+    await p.start()
+    for _ in range(200):
+        if len(got) >= n_frames:
+            break
+        await asyncio.sleep(0.01)
+    await p.stop()
+    assert len(got) >= n_frames, "pipeline produced no frames"
+    return got
+
+
+def test_frame_id_correlation_across_stages(tele):
+    frames = asyncio.run(_run_pipeline())
+    fids = {ef.frame_id for ef in frames}
+    assert 0 not in fids  # every delivered frame has a correlation id
+    events = tele.recorder.events("0")
+    by_fid: dict[int, set] = {}
+    for ev in events:
+        if "fid" in ev:
+            by_fid.setdefault(ev["fid"], set()).add(ev["ev"])
+    # a delivered frame's id ties capture → encode → completion → send
+    fid = frames[0].frame_id
+    assert {"capture", "encode", "frame", "send"} <= by_fid[fid]
+    # and the rollup grew the per-stage histograms + frame counters
+    roll = tele.rollup()
+    stage_series = roll["histograms"]["selkies_stage_ms"]
+    stages = {k.split(",")[0] for k in stage_series}
+    assert {"stage=capture", "stage=encode", "stage=send",
+            "stage=device", "stage=pack"} <= stages
+    assert roll["counters"]["selkies_frames_total"]["session=0,kind=idr"] == 1
+    assert "selkies_frame_bytes" in roll["histograms"]
+
+
+def test_disabled_is_noop_and_allocation_free():
+    t = Telemetry(enabled=False)
+    t.count("selkies_frames_total", session="0", kind="p")
+    t.gauge("selkies_congestion_target_kbps", 2000)
+    t.stage_ms("capture", 1.0, frame=1)
+    t.frame_done(1, 100, idr=False)
+    t.map_seq("0", 1, 1)
+    t.ack("0", 1, 0.0)
+    assert t._counters == {} and t._gauges == {} and t._hists == {}
+    # the span object is a shared singleton: no per-call allocation
+    assert t.span("capture") is t.span("send")
+    assert t.escalation("0", "x") is None  # no recorder, no dump
+
+
+def test_disabled_pipeline_emits_nothing():
+    assert not telemetry.enabled  # suite default
+    frames = asyncio.run(_run_pipeline())
+    assert all(ef.frame_id == 0 for ef in frames)
+    roll = telemetry.rollup()
+    assert roll["histograms"] == {} and roll["counters"] == {}
+
+
+def test_encoded_bytes_identical_with_telemetry_on_off(tmp_path):
+    """The acceptance bit-identity check: instrumentation must never
+    branch the data plane."""
+    from selkies_tpu.models.registry import create_encoder
+
+    def encode_all():
+        enc = create_encoder("tpuh264enc", width=64, height=64)
+        src = SyntheticSource(64, 64, seed=3)
+        try:
+            return [enc.encode_frame(src.capture()) for _ in range(4)]
+        finally:
+            if hasattr(enc, "close"):
+                enc.close()
+
+    telemetry.reset()
+    telemetry.enabled = False
+    off = encode_all()
+    telemetry.enabled = True
+    telemetry.recorder = FlightRecorder(out_dir=str(tmp_path / "bb"))
+    try:
+        on = encode_all()
+        # telemetry DID observe the frames...
+        assert telemetry.rollup()["counters"].get(
+            "selkies_tile_cache_frames_total")
+    finally:
+        telemetry.enabled = False
+        telemetry.reset()
+    # ...and the bytes are identical anyway
+    assert [bytes(a) for a in off] == [bytes(a) for a in on]
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+class _Actions:
+    def __init__(self):
+        self.calls = []
+
+    def __getattr__(self, name):
+        def _f(*a, **kw):
+            self.calls.append(name)
+
+        return _f
+
+
+def test_blackbox_dump_on_escalation_and_rate_limit(tele, tmp_path):
+    clock = _Clock()
+    rec = FlightRecorder(out_dir=str(tmp_path / "bb2"), window_s=10.0,
+                         min_dump_interval_s=30.0, clock=clock)
+    tele.recorder = rec
+    sup = SlotSupervisor("slotx", _Actions(), fps=60.0, warn_after=1,
+                         idr_after=2, restart_after=3, degrade_after=4,
+                         recycle_after=30, clock=clock)
+    tele.count("selkies_frames_total", session="slotx", kind="p")  # ring data
+    sup.failure(RuntimeError("boom"))          # warn: below the bar
+    assert not os.path.exists(rec.out_dir) or not os.listdir(rec.out_dir)
+    sup.failure(RuntimeError("boom"))          # force_idr: past warn → dump
+    bundles = sorted(os.listdir(rec.out_dir))
+    assert len(bundles) == 1
+    bundle = os.path.join(rec.out_dir, bundles[0])
+    meta = json.load(open(os.path.join(bundle, "meta.json")))
+    assert meta["slot"] == "slotx" and "force_idr" in meta["reason"]
+    # Perfetto-loadable chrome trace + parseable event lines + rollup
+    trace = json.load(open(os.path.join(bundle, "trace.json")))
+    assert "traceEvents" in trace
+    with open(os.path.join(bundle, "events.jsonl")) as f:
+        events = [json.loads(line) for line in f]
+    assert any(ev["ev"] == "selkies_supervisor_events_total" for ev in events)
+    # the bundle merges EVERY slot's ring, annotated and time-ordered —
+    # ladder events and the frame timeline live in different rings
+    assert {ev["session"] for ev in events} == {"slotx"}
+    ts = [ev["t"] for ev in events]
+    assert ts == sorted(ts)
+    roll = json.load(open(os.path.join(bundle, "metrics.json")))
+    assert roll["health"]["slots"]["slotx"]["rung"] == "FORCE_IDR"
+    # escalations keep coming (restart at #3) but the dump is rate-limited
+    clock.t += 1.0
+    sup.failure(RuntimeError("boom"))
+    assert len(os.listdir(rec.out_dir)) == 1 and rec.suppressed >= 1
+    # past the interval the next escalation dumps again
+    clock.t += 31.0
+    sup.failure(RuntimeError("boom"))          # degrade at #4
+    assert len(os.listdir(rec.out_dir)) == 2
+    assert tele.rollup()["counters"][
+        "selkies_blackbox_dumps_total"]["slot=slotx"] == 2
+    # no half-written tmp dirs left behind (atomic rename)
+    assert not [d for d in os.listdir(rec.out_dir) if d.startswith(".")]
+
+
+def test_flight_recorder_window_bounds_memory():
+    clock = _Clock()
+    rec = FlightRecorder(window_s=5.0, max_events=100, clock=clock)
+    for i in range(500):
+        clock.t += 0.1
+        rec.record("s", {"ev": "x", "i": i})
+    events = rec.events("s")
+    assert len(events) <= 51  # 5 s window at 10 ev/s (inclusive edge)
+    assert events[-1]["i"] == 499 and events[0]["i"] >= 449
+
+
+def test_seq_ack_correlation(tele):
+    from selkies_tpu.transport.congestion import GccController
+
+    gcc = GccController(start_kbps=1000, session="9")
+    tele.map_seq("9", 17, 4242)
+    gcc.on_frame_sent(17, 0.0, 1000)
+    gcc.on_frame_ack(17, 5.0)
+    acks = [ev for ev in tele.recorder.events("9") if ev["ev"] == "ack"]
+    assert acks and acks[0]["fid"] == 4242 and acks[0]["seq"] == 17
+    gcc.on_loss_report(0.5)  # >10%: multiplicative decrease, reported
+    roll = tele.rollup()
+    assert roll["gauges"]["selkies_congestion_loss_ratio"]["session=9"] == 0.5
+    assert "session=9" in roll["gauges"]["selkies_congestion_target_kbps"]
+    events = roll["counters"]["selkies_congestion_events_total"]
+    assert events.get("session=9,event=loss_report") == 1
+    assert events.get("session=9,event=decrease") == 1
+
+
+def test_fault_injection_emits_telemetry(tele):
+    from selkies_tpu.resilience.faultinject import FaultInjector
+
+    fi = FaultInjector("encoder@2:drop")
+    assert fi.check("encoder") is None
+    assert fi.check("encoder") == ("drop", 0.0)
+    roll = tele.rollup()
+    assert roll["counters"]["selkies_faults_injected_total"][
+        "site=encoder,action=drop"] == 1
+
+
+def test_statz_and_healthz_endpoints(tele, tmp_path):
+    import aiohttp
+
+    from selkies_tpu.signalling import SignallingOptions, SignallingServer
+
+    async def scenario():
+        srv = SignallingServer(SignallingOptions(addr="127.0.0.1", port=0))
+        await srv.start()
+        base = f"http://127.0.0.1:{srv.bound_port}"
+        sup = SlotSupervisor("probe", _Actions())
+        tele.stage_ms("capture", 2.0, frame=1)
+        tele.count("selkies_tile_cache_tiles_total", 3, result="hit")
+        tele.gauge("selkies_congestion_target_kbps", 1500)
+        try:
+            async with aiohttp.ClientSession() as http:
+                r = await http.get(base + "/statz")
+                assert r.status == 200
+                roll = json.loads(await r.text())
+                assert "stage=capture,session=0" in roll[
+                    "histograms"]["selkies_stage_ms"]
+                assert roll["counters"]["selkies_tile_cache_tiles_total"][
+                    "session=0,result=hit"] == 3
+                assert roll["gauges"]["selkies_congestion_target_kbps"][
+                    "session=0"] == 1500
+                assert roll["health"]["slots"]["probe"]["rung"] == "HEALTHY"
+
+                r = await http.get(base + "/healthz")
+                assert r.status == 200
+                health = json.loads(await r.text())
+                assert health["status"] == "ok"
+
+                # a slot on the RECYCLE rung flips the probe to 503
+                sup.rung = Rung.RECYCLE
+                r = await http.get(base + "/healthz")
+                assert r.status == 503
+                assert json.loads(await r.text())["status"] == "down"
+                sup.rung = Rung.HEALTHY
+
+                # telemetry off: /statz 404s with a hint, /healthz stays up
+                tele.enabled = False
+                r = await http.get(base + "/statz")
+                assert r.status == 404 and "SELKIES_TELEMETRY" in await r.text()
+                r = await http.get(base + "/healthz")
+                assert r.status == 200
+        finally:
+            await srv.stop()
+
+    asyncio.run(scenario())
+
+
+def test_healthz_hides_slot_detail_without_auth(tele):
+    """Probe-friendly but not information-disclosing: with basic auth
+    enabled, unauthenticated /healthz returns only the status word."""
+    import aiohttp
+
+    from selkies_tpu.signalling import SignallingOptions, SignallingServer
+
+    async def scenario():
+        srv = SignallingServer(SignallingOptions(
+            addr="127.0.0.1", port=0, enable_basic_auth=True,
+            basic_auth_user="u", basic_auth_password="p"))
+        await srv.start()
+        base = f"http://127.0.0.1:{srv.bound_port}"
+        sup = SlotSupervisor("secret-slot", _Actions())  # noqa: F841 — held
+        try:
+            async with aiohttp.ClientSession() as http:
+                r = await http.get(base + "/healthz")
+                assert r.status == 200
+                body = json.loads(await r.text())
+                assert body == {"status": "ok"}  # no slot internals
+                r = await http.get(base + "/healthz",
+                                   auth=aiohttp.BasicAuth("u", "p"))
+                body = json.loads(await r.text())
+                assert "secret-slot" in body["slots"]
+        finally:
+            await srv.stop()
+
+    asyncio.run(scenario())
+
+
+def test_statz_tool_renders_rollup_and_bundle(tele, tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "statz", os.path.join(REPO, "tools", "statz.py"))
+    statz = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(statz)
+
+    tele.stage_ms("capture", 2.0, frame=1)
+    tele.count("selkies_frames_total", session="0", kind="p")
+    text = statz.render(tele.rollup(), [])
+    assert "selkies_stage_ms" in text and "selkies_frames_total" in text
+
+    path = tele.escalation("0", "manual")
+    assert path is not None
+    roll, events = statz._load(path)
+    out = statz.render(roll, events)
+    assert "black-box events" in out
+
+
+def test_check_metric_docs_passes_and_catches_drift(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check_metric_docs.py"),
+         REPO], capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    spec = importlib.util.spec_from_file_location(
+        "check_metric_docs",
+        os.path.join(REPO, "tools", "check_metric_docs.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # a doc missing a family (and documenting a bogus one) fails both ways
+    os.makedirs(tmp_path / "docs")
+    (tmp_path / "docs" / "observability.md").write_text(
+        "only selkies_bogus_total here\n")
+    os.symlink(os.path.join(REPO, "selkies_tpu"), tmp_path / "selkies_tpu")
+    problems = mod.check(str(tmp_path))
+    assert any("selkies_stage_ms" in p for p in problems)
+    assert any("selkies_bogus_total" in p for p in problems)
+
+
+def test_contextvar_correlates_nested_emissions(tele):
+    """Emissions inside a span (the encoder's tile-cache counters on the
+    encode worker) inherit the span's frame id via the ContextVar."""
+    with tele.span("submit", 99):
+        tele.count("selkies_tile_cache_frames_total", kind="full")
+        tele.stage_ms("classify", 0.4)  # no explicit frame either
+    evs = {ev["ev"]: ev for ev in tele.recorder.events("0")}
+    assert evs["selkies_tile_cache_frames_total"]["fid"] == 99
+    assert evs["classify"]["fid"] == 99
+    assert evs["submit"]["fid"] == 99
+    # outside any span: no fid attached
+    tele.count("selkies_tile_cache_frames_total", kind="static")
+    last = tele.recorder.events("0")[-1]
+    assert "fid" not in last
+
+
+def test_rung_gauge_clears_on_recovery(tele):
+    sup = SlotSupervisor("gslot", _Actions(), warn_after=1, idr_after=2,
+                         restart_after=6, degrade_after=12, recycle_after=30)
+    sup.failure(RuntimeError("x"))
+    sup.failure(RuntimeError("x"))  # FORCE_IDR
+    assert tele.rollup()["gauges"]["selkies_supervisor_rung"]["slot=gslot"] == 2
+    sup.tick_ok()  # recovered: the gauge (and any alert on it) must clear
+    assert tele.rollup()["gauges"]["selkies_supervisor_rung"]["slot=gslot"] == 0
+    assert tele.rollup()["counters"]["selkies_supervisor_events_total"][
+        "slot=gslot,event=recovered"] == 1
+
+
+def test_statz_tool_sends_basic_auth(tele):
+    import aiohttp
+
+    from selkies_tpu.signalling import SignallingOptions, SignallingServer
+
+    spec = importlib.util.spec_from_file_location(
+        "statz_auth", os.path.join(REPO, "tools", "statz.py"))
+    statz = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(statz)
+    tele.stage_ms("capture", 1.0, frame=1)
+
+    async def scenario():
+        srv = SignallingServer(SignallingOptions(
+            addr="127.0.0.1", port=0, enable_basic_auth=True,
+            basic_auth_user="u", basic_auth_password="pw"))
+        await srv.start()
+        url = f"http://u:pw@127.0.0.1:{srv.bound_port}/statz"
+        try:
+            roll, _ = await asyncio.to_thread(statz._load, url)
+            assert "selkies_stage_ms" in roll["histograms"]
+            with pytest.raises(Exception):  # no creds -> 401
+                await asyncio.to_thread(
+                    statz._load, f"http://127.0.0.1:{srv.bound_port}/statz")
+        finally:
+            await srv.stop()
+
+    asyncio.run(scenario())
+
+
+def test_supervisor_custom_escalation_hook(tele):
+    hooks = []
+    sup = SlotSupervisor("hooked", _Actions(), warn_after=1, idr_after=2,
+                         restart_after=6, degrade_after=12, recycle_after=30)
+    sup.on_escalation = lambda rung, why: hooks.append((rung, why))
+    sup.failure(RuntimeError("a"))
+    assert hooks == []  # warn is below the bar
+    sup.failure(RuntimeError("b"))
+    assert hooks and hooks[0][0] == Rung.FORCE_IDR
+    assert "force_idr" in hooks[0][1]

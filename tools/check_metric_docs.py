@@ -1,0 +1,74 @@
+#!/usr/bin/env python3
+"""Static pass: every telemetry metric family must be documented.
+
+The metric family names in monitoring/telemetry.METRIC_FAMILIES are the
+observability contract operators build dashboards and alerts on; an
+undocumented family is invisible and a documented-but-unregistered one
+is a dashboard that silently flatlines. This check (run from tier-1 via
+tests/test_telemetry.py, like check_silent_except.py) asserts both
+directions against docs/observability.md:
+
+* every registered family name appears in the doc;
+* every ``selkies_*`` metric token the doc mentions is a registered
+  family (the ``selkies_tpu`` package-name prefix is exempt).
+
+Usage: python tools/check_metric_docs.py [repo_root]   (exit 1 on violation)
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+DOC = os.path.join("docs", "observability.md")
+
+
+def load_families(root: str) -> dict[str, str]:
+    sys.path.insert(0, root)
+    from selkies_tpu.monitoring.telemetry import METRIC_FAMILIES
+
+    return METRIC_FAMILIES
+
+
+def check(root: str = ".") -> list[str]:
+    doc_path = os.path.join(root, DOC)
+    if not os.path.exists(doc_path):
+        return [f"{DOC} is missing — the metric families must be documented"]
+    with open(doc_path, encoding="utf-8") as f:
+        text = f.read()
+    families = load_families(root)
+    problems = []
+    for name in sorted(families):
+        if name not in text:
+            problems.append(
+                f"metric family {name!r} is registered in "
+                f"monitoring/telemetry.py but not documented in {DOC}")
+    doc_tokens = set(re.findall(r"\bselkies_[a-z0-9_]+\b", text))
+    for token in sorted(doc_tokens):
+        if token.startswith("selkies_tpu"):
+            continue  # the package name, not a metric
+        # PromQL examples legitimately reference exposition sample names
+        # (histogram _bucket/_sum/_count)
+        base = re.sub(r"_(bucket|sum|count)$", "", token)
+        if token not in families and base not in families:
+            problems.append(
+                f"{DOC} documents {token!r}, which is not a registered "
+                f"metric family (stale doc or typo)")
+    return problems
+
+
+def main(root: str = ".") -> int:
+    problems = check(root)
+    if problems:
+        print("check_metric_docs: metric families and docs/observability.md "
+              "disagree.\n")
+        print("\n".join(problems))
+        return 1
+    print(f"check_metric_docs: OK ({len(load_families(root))} families "
+          f"documented)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else "."))
