@@ -106,7 +106,7 @@ def _desktop_trace(n: int = 60) -> list[np.ndarray]:
     return frames
 
 
-def bench_full_encoder() -> tuple[float, float, float, float, float] | None:
+def bench_full_encoder() -> tuple[float, float, float, float, float, float, float] | None:
     """Steady-state IP-GOP desktop encode (IDR once, then P frames; delta
     band uploads for partial updates, full uploads on window switches,
     on-device motion estimation). Uses the pipelined submit/flush API
@@ -146,7 +146,7 @@ def bench_full_encoder() -> tuple[float, float, float, float, float] | None:
     # fast, not the luckiest one; the trace includes the window-switch
     # full-frame changes)
     done = 0
-    device_ms = pack_ms = 0.0
+    device_ms = pack_ms = unpack_ms = cavlc_ms = 0.0
     lb0 = enc.link_bytes.snapshot()  # link-byte baseline (excl. warmup)
     t0 = time.perf_counter()
     for i in range(ITERS):
@@ -154,16 +154,21 @@ def bench_full_encoder() -> tuple[float, float, float, float, float] | None:
             done += 1
             device_ms += stats.device_ms
             pack_ms += stats.pack_ms
+            unpack_ms += getattr(stats, "unpack_ms", 0.0)
+            cavlc_ms += getattr(stats, "cavlc_ms", 0.0)
     for _, stats, _ in enc.flush():
         done += 1
         device_ms += stats.device_ms
         pack_ms += stats.pack_ms
+        unpack_ms += getattr(stats, "unpack_ms", 0.0)
+        cavlc_ms += getattr(stats, "cavlc_ms", 0.0)
     dt = time.perf_counter() - t0
     lb1 = enc.link_bytes.snapshot()
     up = sum(v - lb0.get(k, 0) for k, v in lb1.items() if k.startswith("up_"))
     down = sum(v - lb0.get(k, 0) for k, v in lb1.items() if k.startswith("down_"))
     assert done == ITERS, f"pipeline lost frames: {done}/{ITERS}"
-    return ITERS / dt, device_ms / done, pack_ms / done, up / done, down / done
+    return (ITERS / dt, device_ms / done, pack_ms / done,
+            unpack_ms / done, cavlc_ms / done, up / done, down / done)
 
 
 def bench_convert_only() -> float:
@@ -186,12 +191,16 @@ def main() -> int:
     _reexec_cpu_if_tunnel_down()
     out = bench_full_encoder()
     if out is not None:
-        fps, device_ms, pack_ms, up_pf, down_pf = out
+        fps, device_ms, pack_ms, unpack_ms, cavlc_ms, up_pf, down_pf = out
         # bytes_up/down_per_frame: what the relay actually prices
         # (PERF.md cost model) — lets future rounds track the link terms
-        # without a separate profiling pass
+        # without a separate profiling pass. pack_ms splits into
+        # unpack_ms (downlink bytes -> packer-ready coefficients) +
+        # cavlc_ms (entropy pack + NAL) so the trajectory attributes
+        # completion time to the right sub-stage.
         _result("tpuh264enc 1080p IP-GOP encode fps (1 chip)", fps,
                 device_stage_latency_ms=device_ms, pack_ms=pack_ms,
+                unpack_ms=unpack_ms, cavlc_ms=cavlc_ms,
                 bytes_up_per_frame=up_pf, bytes_down_per_frame=down_pf)
     else:
         _result("capture->I420 convert fps (encoder pending)", bench_convert_only())

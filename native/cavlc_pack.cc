@@ -208,28 +208,31 @@ inline int Median3(int a, int b, int c) {
 }
 
 // 8.4.1.3 motion-vector prediction for a 16x16 partition, single ref.
-// Mirrors numpy_ref.mv_pred_16x16.
-inline void MvPred16x16(const int16_t* mvs, int mbw, int mbx, int mby,
-                        int* px, int* py) {
+// Mirrors numpy_ref.mv_pred_16x16. Templated over the MV element type:
+// the dense path feeds int16 host tensors, the sparse path an int32
+// scratch grid.
+template <typename T>
+inline void MvPred16x16T(const T* mvs, int mbw, int mbx, int mby,
+                         int* px, int* py) {
   const bool a_av = mbx > 0;
   const bool b_av = mby > 0;
   bool c_av = (mby > 0) && (mbx + 1 < mbw);
   const bool d_av = (mby > 0) && (mbx > 0);
   int ax = 0, ay = 0, bx = 0, by = 0, cx = 0, cy = 0;
   if (a_av) {
-    const int16_t* m = mvs + ((int64_t)mby * mbw + mbx - 1) * 2;
-    ax = m[0]; ay = m[1];
+    const T* m = mvs + ((int64_t)mby * mbw + mbx - 1) * 2;
+    ax = (int)m[0]; ay = (int)m[1];
   }
   if (b_av) {
-    const int16_t* m = mvs + ((int64_t)(mby - 1) * mbw + mbx) * 2;
-    bx = m[0]; by = m[1];
+    const T* m = mvs + ((int64_t)(mby - 1) * mbw + mbx) * 2;
+    bx = (int)m[0]; by = (int)m[1];
   }
   if (c_av) {
-    const int16_t* m = mvs + ((int64_t)(mby - 1) * mbw + mbx + 1) * 2;
-    cx = m[0]; cy = m[1];
+    const T* m = mvs + ((int64_t)(mby - 1) * mbw + mbx + 1) * 2;
+    cx = (int)m[0]; cy = (int)m[1];
   } else if (d_av) {
-    const int16_t* m = mvs + ((int64_t)(mby - 1) * mbw + mbx - 1) * 2;
-    cx = m[0]; cy = m[1];
+    const T* m = mvs + ((int64_t)(mby - 1) * mbw + mbx - 1) * 2;
+    cx = (int)m[0]; cy = (int)m[1];
     c_av = true;
   }
   if (a_av && !b_av && !c_av) { *px = ax; *py = ay; return; }
@@ -242,6 +245,55 @@ inline void MvPred16x16(const int16_t* mvs, int mbw, int mbx, int mby,
   }
   *px = Median3(ax, bx, cx);
   *py = Median3(ay, by, cy);
+}
+
+// Shared residual-emission tail of the P-slice packers (cbp write +
+// luma blocks + chroma DC/AC with TotalCoeff-context bookkeeping).
+// RowFn maps a P_ENTRIES row index (0..15 luma block y4*4+x4, 16..23
+// chroma AC comp*4+y4*2+x4, 24..25 chroma DC comp — >=4 lanes) to that
+// row's int16 lanes; the dense packer passes tensor pointers, the
+// sparse one its per-MB row buffer. ONE copy so a CAVLC fix cannot
+// diverge the two paths' bytes.
+template <typename RowFn>
+inline void EmitPResiduals(BitWriter& w, RowFn row, int cbp_luma, int cbp_chroma,
+                           int mbx, int mby, int mbh, int lstride, int cstride,
+                           int32_t* luma_tc_buf, int32_t* chroma_tc_buf) {
+  int32_t scan[16];
+  const int cbp = cbp_luma | (cbp_chroma << 4);
+  w.PutUe(kInterCbpToCodeNum[cbp]);
+  if (cbp) w.PutSe(0);  // mb_qp_delta
+
+  for (int blk = 0; blk < 16; blk++) {
+    const int x4 = kLumaBlockOrder[blk][0], y4 = kLumaBlockOrder[blk][1];
+    const int b8 = (y4 >> 1) * 2 + (x4 >> 1);
+    if (!(cbp_luma & (1 << b8))) continue;
+    const int16_t* src = row(y4 * 4 + x4);
+    for (int i = 0; i < 16; i++) scan[i] = src[kZigzag[i]];
+    const int bx = mbx * 4 + x4, by = mby * 4 + y4;
+    const int nc = NcContext(luma_tc_buf, lstride, bx, by);
+    luma_tc_buf[by * lstride + bx] = ResidualBlock(w, scan, 16, nc);
+  }
+
+  if (cbp_chroma) {
+    for (int comp = 0; comp < 2; comp++) {
+      const int16_t* src = row(24 + comp);
+      for (int i = 0; i < 4; i++) scan[i] = src[i];
+      ResidualBlock(w, scan, 4, -1);
+    }
+  }
+  if (cbp_chroma == 2) {
+    for (int comp = 0; comp < 2; comp++) {
+      int32_t* ctc = chroma_tc_buf + (int64_t)comp * (mbh * 2) * cstride;
+      for (int blk = 0; blk < 4; blk++) {
+        const int x4 = kChromaBlockOrder[blk][0], y4 = kChromaBlockOrder[blk][1];
+        const int16_t* src = row(16 + comp * 4 + y4 * 2 + x4);
+        for (int i = 1; i < 16; i++) scan[i - 1] = src[kZigzag[i]];
+        const int bx = mbx * 2 + x4, by = mby * 2 + y4;
+        const int nc = NcContext(ctc, cstride, bx, by);
+        ctc[by * cstride + bx] = ResidualBlock(w, scan, 15, nc);
+      }
+    }
+  }
 }
 
 }  // namespace
@@ -371,7 +423,6 @@ int64_t pack_slice_p_rbsp(
   memset(luma_tc_buf, 0, sizeof(int32_t) * (size_t)(mbh * 4) * (size_t)lstride);
   memset(chroma_tc_buf, 0, sizeof(int32_t) * 2 * (size_t)(mbh * 2) * (size_t)cstride);
 
-  int32_t scan[16];
   uint32_t skip_run = 0;
   for (int mby = 0; mby < mbh; mby++) {
     for (int mbx = 0; mbx < mbw; mbx++) {
@@ -381,7 +432,7 @@ int64_t pack_slice_p_rbsp(
       skip_run = 0;
       w.PutUe(0);  // mb_type P_L0_16x16
       int px, py;
-      MvPred16x16(mvs, mbw, mbx, mby, &px, &py);
+      MvPred16x16T(mvs, mbw, mbx, mby, &px, &py);
       w.PutSe(4 * ((int)mvs[mb * 2] - px));      // mvd, quarter-pel units
       w.PutSe(4 * ((int)mvs[mb * 2 + 1] - py));
 
@@ -414,40 +465,13 @@ int64_t pack_slice_p_rbsp(
           if (cdc[i]) { cbp_chroma = 1; break; }
         }
       }
-      const int cbp = cbp_luma | (cbp_chroma << 4);
-      w.PutUe(kInterCbpToCodeNum[cbp]);
-      if (cbp) w.PutSe(0);  // mb_qp_delta
-
-      for (int blk = 0; blk < 16; blk++) {
-        const int x4 = kLumaBlockOrder[blk][0], y4 = kLumaBlockOrder[blk][1];
-        const int b8 = (y4 >> 1) * 2 + (x4 >> 1);
-        if (!(cbp_luma & (1 << b8))) continue;
-        const int16_t* src = lac + (y4 * 4 + x4) * 16;
-        for (int i = 0; i < 16; i++) scan[i] = src[kZigzag[i]];
-        const int bx = mbx * 4 + x4, by = mby * 4 + y4;
-        const int nc = NcContext(luma_tc_buf, lstride, bx, by);
-        luma_tc_buf[by * lstride + bx] = ResidualBlock(w, scan, 16, nc);
-      }
-
-      if (cbp_chroma) {
-        for (int comp = 0; comp < 2; comp++) {
-          for (int i = 0; i < 4; i++) scan[i] = cdc[comp * 4 + i];
-          ResidualBlock(w, scan, 4, -1);
-        }
-      }
-      if (cbp_chroma == 2) {
-        for (int comp = 0; comp < 2; comp++) {
-          int32_t* ctc = chroma_tc_buf + (int64_t)comp * (mbh * 2) * cstride;
-          for (int blk = 0; blk < 4; blk++) {
-            const int x4 = kChromaBlockOrder[blk][0], y4 = kChromaBlockOrder[blk][1];
-            const int16_t* src = cac + (comp * 4 + y4 * 2 + x4) * 16;
-            for (int i = 1; i < 16; i++) scan[i - 1] = src[kZigzag[i]];
-            const int bx = mbx * 2 + x4, by = mby * 2 + y4;
-            const int nc = NcContext(ctc, cstride, bx, by);
-            ctc[by * cstride + bx] = ResidualBlock(w, scan, 15, nc);
-          }
-        }
-      }
+      auto row = [&](int e) -> const int16_t* {
+        if (e < 16) return lac + e * 16;
+        if (e < 24) return cac + (e - 16) * 16;
+        return cdc + (e - 24) * 4;
+      };
+      EmitPResiduals(w, row, cbp_luma, cbp_chroma, mbx, mby, mbh,
+                     lstride, cstride, luma_tc_buf, chroma_tc_buf);
     }
   }
   if (skip_run) w.PutUe(skip_run);
@@ -481,6 +505,165 @@ int64_t emulation_prevent(const uint8_t* rbsp, int64_t n, uint8_t* out, int64_t 
 // re-derived here exactly as a decoder would, in raster order (every
 // neighbor an MB reads is already final). Mirrors
 // numpy_ref.skip_mv_16x16 / mv_pred_16x16.
+// Pack one P slice STRAIGHT FROM THE SPARSE DOWNLINK WIRE FORMAT
+// (encoder_core.pack_p_sparse_var / pack_p_sparse_packed): skip-bitmap
+// words, (mv, mbinfo) int32 pairs for the ns non-skip MBs in raster
+// order, and the nonzero coefficient rows in global scan order — either
+// as 16-lane int16 rows (`packed_layout` 0, the var layout and the
+// packed layout's dense fallback) or as significance bitmaps + quad-
+// padded nonzero values (`packed_layout` 1, folding compact.py's
+// _expand_packed_rows into the walk). Rows at global index >= `held`
+// come from `extra_rows` (the cap_rows spill fetch, always 16-lane).
+//
+// This replaces the host completion path's dense scatter into
+// (M, 26, 16) arrays + the packer's int16 re-copy: only non-skip MBs do
+// per-MB work; skip MBs cost one bit test plus the 8.4.1.1 MV
+// derivation (the wire omits their MVs, exactly like derive_skip_mvs).
+// Byte-identical to cavlc.pack_slice_p fed the unpacked PFrameCoeffs
+// (tests/test_sparse_native_pack.py).
+//
+// Word-sized fields (skip words, pairs) are passed as int16 regions of
+// the fetched buffer and read with memcpy: their byte offsets inside
+// the fused downlink are only 2-aligned. Little-endian host is asserted
+// at import (compact.py). mv_buf is (mbh*mbw*2) int32 scratch.
+// Returns RBSP length or -1 on overflow.
+int64_t pack_slice_p_sparse_rbsp(
+    const uint8_t* header_bytes, int64_t header_nbits,
+    const int16_t* skip_words16 /* 2*ceil(M/32) */,
+    const int16_t* pairs16 /* 4*ns */, int32_t ns,
+    int32_t packed_layout,
+    const int16_t* rows16 /* layout 0: held*16 */,
+    const int16_t* bitmaps /* layout 1: held */,
+    const int16_t* vals /* layout 1: nw */,
+    int32_t held,
+    const int16_t* extra_rows /* (n-held)*16, may be empty */,
+    int32_t n_rows /* total nonzero rows (bounds row consumption) */,
+    int32_t nw /* layout-1 value words (bounds voff) */,
+    int mbh, int mbw,
+    uint8_t* out, int64_t out_cap,
+    int32_t* luma_tc_buf, int32_t* chroma_tc_buf, int32_t* mv_buf) {
+  BitWriter w(out, out_cap);
+  int64_t full = header_nbits / 8;
+  for (int64_t i = 0; i < full; i++) w.PutBits(header_bytes[i], 8);
+  int rem = (int)(header_nbits % 8);
+  if (rem) w.PutBits((uint32_t)(header_bytes[full] >> (8 - rem)), rem);
+
+  const int lstride = mbw * 4, cstride = mbw * 2;
+  memset(luma_tc_buf, 0, sizeof(int32_t) * (size_t)(mbh * 4) * (size_t)lstride);
+  memset(chroma_tc_buf, 0, sizeof(int32_t) * 2 * (size_t)(mbh * 2) * (size_t)cstride);
+
+  int16_t mbrows[26][16];  // current MB's rows, absent entries zero
+  int64_t row_idx = 0;     // global nonzero-row cursor
+  int64_t voff = 0;        // layout-1 value cursor (rows consumed in order)
+  int64_t pair_idx = 0;
+  uint32_t skip_run = 0;
+  for (int mby = 0; mby < mbh; mby++) {
+    for (int mbx = 0; mbx < mbw; mbx++) {
+      const int mb = mby * mbw + mbx;
+      uint32_t sword;
+      memcpy(&sword, skip_words16 + 2 * (mb >> 5), 4);
+      int32_t* mvg = mv_buf + 2 * mb;
+      if ((sword >> (mb & 31)) & 1) {
+        // P_Skip: derive the MV exactly as derive_skip_mvs does (raster
+        // order => every neighbor read is already final)
+        if (mbx == 0 || mby == 0) {
+          mvg[0] = 0; mvg[1] = 0;
+        } else {
+          const int32_t* A = mv_buf + 2 * (mby * mbw + mbx - 1);
+          const int32_t* B = mv_buf + 2 * ((mby - 1) * mbw + mbx);
+          if ((A[0] == 0 && A[1] == 0) || (B[0] == 0 && B[1] == 0)) {
+            mvg[0] = 0; mvg[1] = 0;
+          } else {
+            const int32_t* C = (mbx + 1 < mbw)
+                ? mv_buf + 2 * ((mby - 1) * mbw + mbx + 1)
+                : mv_buf + 2 * ((mby - 1) * mbw + mbx - 1);
+            mvg[0] = Median3(A[0], B[0], C[0]);
+            mvg[1] = Median3(A[1], B[1], C[1]);
+          }
+        }
+        skip_run++;
+        continue;
+      }
+      if (pair_idx >= ns) return -2;  // skip bitmap / ns mismatch
+      int32_t mvw, info;
+      memcpy(&mvw, pairs16 + 4 * pair_idx, 4);
+      memcpy(&info, pairs16 + 4 * pair_idx + 2, 4);
+      pair_idx++;
+      const int mvx = (int16_t)(mvw & 0xFFFF);  // sign-extend low half
+      const int mvy = mvw >> 16;
+      mvg[0] = mvx; mvg[1] = mvy;
+
+      // materialize this MB's rows from the wire stream (global scan
+      // order; skip MBs contribute none, so raster-order consumption
+      // matches the device's compaction exactly)
+      memset(mbrows, 0, sizeof(mbrows));
+      for (int e = 0; e < 26; e++) {
+        if (!((info >> e) & 1)) continue;
+        // a corrupt mbinfo word must fail loudly, not read past the
+        // delivered rows/values (the Python oracle raises IndexError)
+        if (row_idx >= n_rows) return -2;
+        int16_t* dst = mbrows[e];
+        if (row_idx >= held) {
+          memcpy(dst, extra_rows + 16 * (row_idx - held), 32);
+        } else if (packed_layout) {
+          const uint32_t bm = (uint16_t)bitmaps[row_idx];
+          const int cnt = __builtin_popcount(bm);
+          if (voff + cnt > nw) return -2;
+          int k = 0;
+          for (int j = 0; j < 16; j++) {
+            if ((bm >> j) & 1) dst[j] = vals[voff + k++];
+          }
+          voff += 4 * ((cnt + 3) / 4);  // values pad to int16 quads
+        } else {
+          memcpy(dst, rows16 + 16 * row_idx, 32);
+        }
+        row_idx++;
+      }
+
+      w.PutUe(skip_run);
+      skip_run = 0;
+      w.PutUe(0);  // mb_type P_L0_16x16
+      int px, py;
+      MvPred16x16T(mv_buf, mbw, mbx, mby, &px, &py);
+      w.PutSe(4 * (mvx - px));  // mvd, quarter-pel units
+      w.PutSe(4 * (mvy - py));
+
+      // cbp_luma from the row-presence bits (a luma row is present iff
+      // it is nonzero — same predicate the dense packer evaluates)
+      int cbp_luma = 0;
+      for (int b8 = 0; b8 < 4; b8++) {
+        const int y8 = b8 >> 1, x8 = b8 & 1;
+        for (int sub = 0; sub < 4; sub++) {
+          const int e = (y8 * 2 + (sub >> 1)) * 4 + x8 * 2 + (sub & 1);
+          if ((info >> e) & 1) { cbp_luma |= 1 << b8; break; }
+        }
+      }
+      // cbp_chroma needs content, not presence: an AC row nonzero ONLY
+      // in lane (0,0) does not make cbp 2 (lane 0 belongs to chroma DC)
+      int cbp_chroma = 0;
+      for (int b = 0; b < 8 && cbp_chroma < 2; b++) {
+        const int16_t* blk = mbrows[16 + b];
+        for (int i = 1; i < 16; i++) {
+          if (blk[kZigzag[i]]) { cbp_chroma = 2; break; }
+        }
+      }
+      if (cbp_chroma == 0) {
+        for (int i = 0; i < 8; i++) {
+          if (mbrows[24 + (i >> 2)][i & 3]) { cbp_chroma = 1; break; }
+        }
+      }
+      EmitPResiduals(w, [&](int e) -> const int16_t* { return mbrows[e]; },
+                     cbp_luma, cbp_chroma, mbx, mby, mbh,
+                     lstride, cstride, luma_tc_buf, chroma_tc_buf);
+    }
+  }
+  if (skip_run) w.PutUe(skip_run);
+  w.RbspTrailing();
+  if (w.Overflowed()) return -1;
+  return w.BytePos();
+}
+
+
 void derive_skip_mvs(int32_t* mvs /* (mbh, mbw, 2) */, const uint8_t* skip,
                      int mbh, int mbw) {
     for (int y = 0; y < mbh; ++y) {

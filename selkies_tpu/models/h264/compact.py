@@ -11,6 +11,7 @@ replaces on the tunnel/PCIe.
 from __future__ import annotations
 
 import sys
+from dataclasses import dataclass
 
 import numpy as np
 
@@ -221,6 +222,81 @@ def unpack_p_sparse_packed(
     skip_bits = ((skip_words[:, None] >> np.arange(32)) & 1).astype(bool).reshape(-1)[:m]
     pairs = np.ascontiguousarray(fused16[base : base + 4 * ns]).view(np.int32)
     return _finish_sparse_p(pairs, skip_bits, rows, ns, qp, mbh, mbw)
+
+
+@dataclass
+class SparsePWire:
+    """Zero-copy views into one frame's sparse-P downlink buffer, in the
+    exact regions native/cavlc_pack.cc pack_slice_p_sparse_rbsp consumes.
+
+    All array fields are contiguous int16 views of the fetched fused
+    buffer (no scatter, no dtype copy — that is the point); `extra_rows`
+    is the cap_rows spill fetch (16-lane rows for global row index >=
+    held), empty when the frame fit. `packed` selects the bit-packed
+    rows layout (bitmaps + quad-padded values) over 16-lane rows.
+    """
+
+    mbh: int
+    mbw: int
+    n: int              # total nonzero rows
+    ns: int             # non-skip MBs (== len(pairs16) // 4)
+    held: int           # rows present in the primary layout
+    packed: bool
+    skip16: np.ndarray       # (2*ceil(M/32),) skip bitmap words
+    pairs16: np.ndarray      # (4*ns,) (mv, mbinfo) int32 pairs
+    rows16: np.ndarray       # (16*held,) 16-lane rows (empty when packed)
+    bitmaps: np.ndarray      # (held,) significance bitmaps (packed only)
+    vals: np.ndarray         # (nw,) quad-padded nonzero values (packed only)
+    extra_rows: np.ndarray   # ((n-held)*16,) spill rows, 16-lane
+
+
+_EMPTY_I16 = np.empty(0, np.int16)
+
+
+def p_sparse_wire_views(
+    fused16: np.ndarray, mbh: int, mbw: int, nscap: int, cap_rows: int,
+    packed: bool, extra_rows: np.ndarray | None = None,
+) -> SparsePWire | None:
+    """Sparse downlink buffer -> SparsePWire views for the sparse-native
+    packer, or None when ns > nscap (the pair region is truncated; the
+    caller must take the dense-header fallback). Validates the skip
+    bitmap against ns exactly like _finish_sparse_p so a corrupt buffer
+    fails loudly instead of packing garbage."""
+    m = mbh * mbw
+    sw = (m + 31) // 32
+    if packed:
+        meta = np.ascontiguousarray(fused16[:12]).view(np.int32)
+        n, ns, nw, dense = int(meta[0]), int(meta[3]), int(meta[4]), int(meta[5])
+        base = 12 + 2 * sw
+    else:
+        meta = np.ascontiguousarray(fused16[:8]).view(np.int32)
+        n, ns = int(meta[0]), int(meta[3])
+        nw, dense = 0, 1
+        base = 8 + 2 * sw
+    if ns > nscap:
+        return None
+    skip16 = fused16[base - 2 * sw : base]
+    nskip = int(np.unpackbits(np.ascontiguousarray(skip16).view(np.uint8)).sum())
+    if m - nskip != ns:
+        raise ValueError(f"skip bitmap has {m - nskip} non-skip MBs, header says {ns}")
+    held = min(n, cap_rows)
+    rows_off = base + 4 * ns
+    if packed and not dense:
+        rows16 = _EMPTY_I16
+        bitmaps = fused16[rows_off : rows_off + held]
+        vals = fused16[rows_off + held : rows_off + held + nw]
+    else:
+        rows16 = fused16[rows_off : rows_off + 16 * held]
+        bitmaps = vals = _EMPTY_I16
+    if n > held:
+        extra = np.ascontiguousarray(extra_rows[: n - held], np.int16).reshape(-1)
+    else:
+        extra = _EMPTY_I16
+    return SparsePWire(
+        mbh=mbh, mbw=mbw, n=n, ns=ns, held=held, packed=bool(packed and not dense),
+        skip16=skip16, pairs16=fused16[base:rows_off], rows16=rows16,
+        bitmaps=bitmaps, vals=vals, extra_rows=extra,
+    )
 
 
 def _finish_sparse_p(pairs, skip_bits, rows, ns, qp, mbh, mbw):

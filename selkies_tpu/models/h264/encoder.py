@@ -43,6 +43,7 @@ from selkies_tpu.models.h264.compact import (
     p_sparse_packed_words,
     p_sparse_var_need,
     p_sparse_var_words,
+    p_sparse_wire_views,
     split_prefix,
     unpack_i_compact,
     unpack_p_compact,
@@ -66,7 +67,12 @@ from selkies_tpu.models.h264.encoder_core import (
 )
 from selkies_tpu.models.stats import LinkByteCounter
 from selkies_tpu.models.tilecache import TileCache
-from selkies_tpu.models.h264.native import pack_slice_fast, pack_slice_p_fast
+from selkies_tpu.models.h264.native import (
+    pack_slice_fast,
+    pack_slice_p_fast,
+    pack_slice_p_sparse_native,
+    sparse_native_available,
+)
 from selkies_tpu.ops.colorspace import bgrx_to_i420, rgb_to_i420
 
 __all__ = ["TPUH264Encoder", "make_frame_step"]
@@ -675,6 +681,27 @@ class TPUH264Encoder:
         self._pool = ThreadPoolExecutor(
             max_workers=max(2, self.pipeline_depth + 1),
             thread_name_prefix="h264-complete",
+        )
+        # Per-slot CAVLC fan-out pool: a delta GROUP's frames are
+        # independent (separate slice NALs; the native packer releases
+        # the GIL and its scratch is thread-local), so the group
+        # completion spreads across cores instead of packing K frames
+        # serially on one worker. Sized to cover every frame that can be
+        # in flight at once — min(cores, frame_batch x pipeline_depth) —
+        # not today's max(2, depth+1); SELKIES_PACK_WORKERS overrides.
+        # Kept SEPARATE from self._pool: group coordinators block on
+        # slot futures, and coordinators + leaves sharing one executor
+        # can deadlock with every worker stuck coordinating.
+        pack_workers = int(os.environ.get("SELKIES_PACK_WORKERS", "0") or 0)
+        if pack_workers <= 0:
+            pack_workers = min(
+                os.cpu_count() or 4,
+                max(2, self.frame_batch * max(1, self.pipeline_depth)),
+            )
+        self._pack_pool = (
+            ThreadPoolExecutor(max_workers=pack_workers,
+                               thread_name_prefix="h264-pack")
+            if self.frame_batch > 1 else None
         )
         self._upload_pool = ThreadPoolExecutor(
             max_workers=Y_CHUNKS + 2, thread_name_prefix="h264-upload",
@@ -1291,77 +1318,120 @@ class TPUH264Encoder:
     # finer-grained adaptive ladder stalls the steady state on compiles.
     PFX_SMALL = 1 << 14
 
-    def _pfx_slice_len(self) -> int:
-        """Fetch length (int16) for the next delta downlink."""
+    def _update_pfx_hint(self) -> None:
+        """Recompute the delta-downlink fetch length from recent frames.
+
+        Runs on completion workers AND the submit thread; the compute and
+        the `_pfx_hint` store both happen under `_pfx_lock` — the hint
+        used to be assigned from pool workers with no lock while
+        `_pfx_slice` read it on the main thread (a torn read can't happen
+        for an int, but a stale one mis-sized the next fetch and the
+        deque iteration raced appends)."""
         with self._pfx_lock:
-            recent = list(self._pfx_recent)
-        want = max([2048] + [n * 3 // 2 for n in recent])
-        return self._pfx_small if want <= self._pfx_small else self._pfx_total
+            want = max([2048] + [n * 3 // 2 for n in self._pfx_recent])
+            self._pfx_hint = (
+                self._pfx_small if want <= self._pfx_small else self._pfx_total
+            )
 
     def _pfx_slice(self, prefix_d):
         """Hint-sized view of a fused delta downlink, dispatched from the
         MAIN thread right behind the step that produced it. Slicing is a
         device op: doing it in the completion worker would enqueue it
         after later groups' scans and stall the fetch behind them."""
-        L = self._pfx_hint
+        with self._pfx_lock:
+            L = self._pfx_hint
         if prefix_d.ndim == 1:
             return prefix_d[:L] if L < self._pfx_total else prefix_d
         return prefix_d[:, :L] if L < self._pfx_total else prefix_d
 
-    def _unpack_sparse_var(self, fused, fused_d, buf_d, qp: int):
-        """One delta frame's fused slice -> PFrameCoeffs (handling slice
-        shortfall, row spill past the cap, and the dense fallback), for
-        either sparse layout (bit-packed when self._density is set).
+    def _complete_sparse_p(self, fused, fused_d, dense_d, buf_d, rec):
+        """One delta frame's fused slice -> finished slice NAL, sparse
+        end-to-end when the native packer is available.
 
-        fused_d is a per-frame FULL-row handle created at dispatch time:
-        the shortfall refetch is then a pure transfer — slicing here (a
-        device op) would queue behind scans dispatched since."""
-        if self._density is not None:
-            need, n, ns = p_sparse_packed_need(fused, self._mbh, self._mbw,
-                                               self._nscap, self._cap_delta)
-        else:
-            need, n, ns = p_sparse_var_need(fused, self._mbh, self._mbw, self._nscap,
-                                            self._cap_delta)
-        with self._pfx_lock:
-            self._pfx_recent.append(need)
-        if need > len(fused):  # hint too small: refetch the live content
-            fused = np.asarray(fused_d)
-            self.link_bytes.add("down_refetch", fused.nbytes)
-        extra = None
-        if n > self._cap_delta:  # rows spilled past the fused buffer
-            extra = _fetch_rest(buf_d, n, self._cap_delta)
-            self.link_bytes.add("down_spill", extra.nbytes)
-        if self._density is not None:
-            pfc, rows = unpack_p_sparse_packed(
-                fused, qp, self._mbh, self._mbw, self._nscap, self._cap_delta, extra
-            )
-        else:
-            pfc, rows = unpack_p_sparse_var(
-                fused, qp, self._mbh, self._mbw, self._nscap, self._cap_delta, extra
-            )
-        return pfc, rows
+        Handles slice shortfall, row spill past the cap, and the
+        ns > nscap dense-header fallback, for either sparse layout
+        (bit-packed when self._density is set). fused_d is a per-frame
+        FULL-row handle created at dispatch time: the shortfall refetch
+        is then a pure transfer — slicing here (a device op) would queue
+        behind scans dispatched since.
+
+        The hot path hands the wire-format regions (skip words, pairs,
+        rows in either layout) straight to the C packer: no dense
+        (M, 26, 16) scatter, no int32 PFrameCoeffs, no int16 re-copy.
+        Without the native entry (or with SELKIES_SPARSE_NATIVE=0) the
+        Python dense expansion stays as the fallback and the equivalence
+        oracle. Returns (au, skipped_mbs, t_start, t_unpacked, t_done)."""
+        t1 = time.perf_counter()
+        packed = self._density is not None
+        with tracer.span("unpack"):
+            if packed:
+                need, n, ns = p_sparse_packed_need(
+                    fused, self._mbh, self._mbw, self._nscap, self._cap_delta)
+            else:
+                need, n, ns = p_sparse_var_need(
+                    fused, self._mbh, self._mbw, self._nscap, self._cap_delta)
+            with self._pfx_lock:
+                self._pfx_recent.append(need)
+            if need > len(fused):  # hint too small: refetch the live content
+                fused = np.asarray(fused_d)
+                self.link_bytes.add("down_refetch", fused.nbytes)
+            extra = None
+            if n > self._cap_delta:  # rows spilled past the fused buffer
+                extra = _fetch_rest(buf_d, n, self._cap_delta)
+                self.link_bytes.add("down_spill", extra.nbytes)
+            wire = pfc = None
+            if ns <= self._nscap and sparse_native_available():
+                wire = p_sparse_wire_views(
+                    fused, self._mbh, self._mbw, self._nscap, self._cap_delta,
+                    packed, extra)
+            if wire is None:
+                unpack = unpack_p_sparse_packed if packed else unpack_p_sparse_var
+                pfc, rows = unpack(fused, rec.qp, self._mbh, self._mbw,
+                                   self._nscap, self._cap_delta, extra)
+                if pfc is None:  # ns > NSCAP: dense-header fallback fetch
+                    dense = np.asarray(dense_d)
+                    self.link_bytes.add("down_spill", dense.nbytes)
+                    pfc = unpack_p_compact(dense, rows, rec.qp)
+        tu = time.perf_counter()
+        with tracer.span("pack"):
+            if wire is not None:
+                au = pack_slice_p_sparse_native(
+                    wire, self.params, rec.frame_num, rec.qp,
+                    ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
+                    mmco_evict=rec.mmco_evict)
+                skipped = self._mbh * self._mbw - wire.ns
+            else:
+                au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num,
+                                       ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
+                                       mmco_evict=rec.mmco_evict)
+                skipped = int(pfc.skip.sum())
+        return au, skipped, t1, tu, time.perf_counter()
 
     def _complete_batch(self, recs, pfx_slice_d, pfx_rows_d, denses_d, bufs_d):
         """Worker half for a delta group: ONE transfer of the pre-sliced
-        prefix stack, then per-frame unpack + CAVLC pack. Returns a list
-        indexed by batch_slot."""
+        prefix stack, then per-frame unpack + CAVLC pack FANNED OUT
+        per-slot across the pack pool — frames in a group are
+        independent slices and the native packer releases the GIL, so a
+        12-frame group completes in ~one frame's pack time instead of
+        twelve. Results come back indexed by batch_slot (submission
+        order is preserved by the ordered gather)."""
         prefixes = np.asarray(pfx_slice_d)
         self.link_bytes.add("down_prefix", prefixes.nbytes)
-        results = []
-        for slot, rec in enumerate(recs):
-            t1 = time.perf_counter()
-            pfc, rows = self._unpack_sparse_var(
-                prefixes[slot], pfx_rows_d[slot], bufs_d[slot], rec.qp
-            )
-            if pfc is None:  # ns > NSCAP: dense-header fallback fetch
-                dense = np.asarray(denses_d[slot])
-                self.link_bytes.add("down_spill", dense.nbytes)
-                pfc = unpack_p_compact(dense, rows, rec.qp)
-            au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num,
-                                   ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
-                                   mmco_evict=rec.mmco_evict)
-            results.append((au, int(pfc.skip.sum()), t1, time.perf_counter()))
-        self._pfx_hint = self._pfx_slice_len()
+        if self._pack_pool is not None and len(recs) > 1:
+            futs = [
+                self._pack_pool.submit(
+                    self._complete_sparse_p, prefixes[slot], pfx_rows_d[slot],
+                    denses_d[slot], bufs_d[slot], rec)
+                for slot, rec in enumerate(recs)
+            ]
+            results = [f.result() for f in futs]
+        else:
+            results = [
+                self._complete_sparse_p(prefixes[slot], pfx_rows_d[slot],
+                                        denses_d[slot], bufs_d[slot], rec)
+                for slot, rec in enumerate(recs)
+            ]
+        self._update_pfx_hint()
         return results
 
     def submit(self, frame: np.ndarray, qp: int | None = None, meta=None) -> list:
@@ -1590,7 +1660,7 @@ class TPUH264Encoder:
                     # hint NOW instead of stalling on shortfall refetches
                     with self._pfx_lock:
                         self._pfx_recent.append(self._pfx_total // 2)
-                    self._pfx_hint = self._pfx_slice_len()
+                    self._update_pfx_hint()
                 # start the downlink fetch + entropy pack on a worker NOW:
                 # fetch ops overlap across threads on the relay
                 # (tools/profile_rpc.py: 4 concurrent fetches ≈ cost of 1)
@@ -1669,9 +1739,9 @@ class TPUH264Encoder:
         # decoder, so null the ref (forces IDR) and drop the pipeline.
         try:
             if rec.batch_slot >= 0:
-                au, skipped, t1, t2 = rec.future.result()[rec.batch_slot]
+                au, skipped, t1, tu, t2 = rec.future.result()[rec.batch_slot]
             else:
-                au, skipped, t1, t2 = rec.future.result()
+                au, skipped, t1, tu, t2 = rec.future.result()
         except Exception:
             self._ref = None
             self._src = None
@@ -1684,30 +1754,25 @@ class TPUH264Encoder:
             bytes=len(au), device_ms=(t1 - rec.t0) * 1e3,
             pack_ms=(t2 - t1) * 1e3, skipped_mbs=skipped,
             scene_cut=rec.scene_cut,
+            unpack_ms=(tu - t1) * 1e3, cavlc_ms=(t2 - tu) * 1e3,
         )
         self.last_stats = stats
         return au, stats, rec.meta
 
     def _complete_work(self, rec: "_Pending"):
-        """Worker-thread half: single-fetch downlink + unpack/assemble."""
+        """Worker-thread half: single-fetch downlink + unpack/assemble.
+        Returns (au, skipped_mbs, t_start, t_unpacked, t_done) — the
+        unpack/cavlc split feeds the stage attribution in FrameStats."""
         if rec.kind == "pb":
             return self._complete_bits(rec)
         if rec.kind == "pd":
             with tracer.span("fetch"):
                 fused = np.asarray(rec.pfx_slice_d)
             self.link_bytes.add("down_prefix", fused.nbytes)
-            t1 = time.perf_counter()
-            pfc, rows = self._unpack_sparse_var(fused, rec.prefix_d, rec.buf_d, rec.qp)
-            if pfc is None:
-                dense = np.asarray(rec.hdr_d)
-                self.link_bytes.add("down_spill", dense.nbytes)
-                pfc = unpack_p_compact(dense, rows, rec.qp)
-            with tracer.span("pack"):
-                au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num,
-                                       ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
-                                       mmco_evict=rec.mmco_evict)
-            self._pfx_hint = self._pfx_slice_len()
-            return au, int(pfc.skip.sum()), t1, time.perf_counter()
+            out = self._complete_sparse_p(fused, rec.prefix_d, rec.hdr_d,
+                                          rec.buf_d, rec)
+            self._update_pfx_hint()
+            return out
         hdr_words = self._hdr_words_i if rec.kind == "i" else self._hdr_words_p
         cap = CAP_ROWS
         prefix = np.asarray(rec.prefix_d)
@@ -1720,20 +1785,26 @@ class TPUH264Encoder:
         t1 = time.perf_counter()
         skipped = 0
         if rec.kind == "i":
-            fc = unpack_i_compact(header, data, rec.qp)
+            with tracer.span("unpack"):
+                fc = unpack_i_compact(header, data, rec.qp)
+            tu = time.perf_counter()
             # frame_num counts from the last IDR (7.4.3: gaps are
             # disallowed by our SPS)
-            slice_nal = pack_slice_fast(
-                fc, self.params, frame_num=0, idr=True, idr_pic_id=rec.idr_pic_id
-            )
+            with tracer.span("pack"):
+                slice_nal = pack_slice_fast(
+                    fc, self.params, frame_num=0, idr=True, idr_pic_id=rec.idr_pic_id
+                )
             au = self._headers + slice_nal
         else:
-            pfc = unpack_p_compact(header, data, rec.qp)
+            with tracer.span("unpack"):
+                pfc = unpack_p_compact(header, data, rec.qp)
+            tu = time.perf_counter()
             skipped = int(pfc.skip.sum())
-            au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num,
-                                   ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
-                                   mmco_evict=rec.mmco_evict)
-        return au, skipped, t1, time.perf_counter()
+            with tracer.span("pack"):
+                au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num,
+                                       ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
+                                       mmco_evict=rec.mmco_evict)
+        return au, skipped, t1, tu, time.perf_counter()
 
     def _complete_bits(self, rec: "_Pending"):
         """Device-entropy P frame: fetch [meta ++ bit words], splice the
@@ -1748,10 +1819,11 @@ class TPUH264Encoder:
             self.link_bytes.add("down_spill", header.nbytes + data.nbytes)
             t1 = time.perf_counter()
             pfc = unpack_p_compact(header, data, rec.qp)
+            tu = time.perf_counter()
             au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num,
                                    ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
                                    mmco_evict=rec.mmco_evict)
-            return au, int(pfc.skip.sum()), t1, time.perf_counter()
+            return au, int(pfc.skip.sum()), t1, tu, time.perf_counter()
         need = (nbits + 31) // 32
         words = arr[3 : 3 + min(need, BITS_PREFIX_WORDS)]
         if need > BITS_PREFIX_WORDS:  # spill: one extra fetch
@@ -1762,7 +1834,7 @@ class TPUH264Encoder:
         au = assemble_p_nal(words, nbits, trailing, self.params, rec.frame_num,
                             rec.qp, ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
                             mmco_evict=rec.mmco_evict)
-        return au, skipped, t1, time.perf_counter()
+        return au, skipped, t1, t1, time.perf_counter()
 
     def encode_frame(self, frame: np.ndarray, qp: int | None = None) -> bytes:
         """Synchronous encode ((H, W, 4) BGRx or (H, W, 3) RGB uint8 in,
@@ -1807,6 +1879,8 @@ class TPUH264Encoder:
         self._inflight.clear()
         self._batch_pend.clear()
         self._pool.shutdown(wait=False, cancel_futures=True)
+        if self._pack_pool is not None:
+            self._pack_pool.shutdown(wait=False, cancel_futures=True)
         self._upload_pool.shutdown(wait=False, cancel_futures=True)
 
     def recon_planes(self, frame: np.ndarray):

@@ -38,19 +38,61 @@ _LIB_PATH = os.path.join(_NATIVE_DIR, "libcavlc.so")
 
 _lib = None
 _lib_tried = False
+# First-call init is racy without a lock now that the per-slot pack pool
+# makes concurrent first-calls routine: a worker racing the builder would
+# see _lib_tried=True with _lib still None and silently fall back to the
+# Python packer for the whole build window (and two racers could spawn
+# duplicate `make` processes).
+_load_lock = threading.Lock()
+
+
+def _lib_stale() -> bool:
+    """True when libcavlc.so is absent or older than its sources."""
+    try:
+        so_m = os.path.getmtime(_LIB_PATH)
+    except OSError:
+        return True
+    for src in ("cavlc_pack.cc", "cavlc_tables.h"):
+        try:
+            if os.path.getmtime(os.path.join(_NATIVE_DIR, src)) > so_m:
+                return True
+        except OSError:
+            continue
+    return False
 
 
 def _load() -> ctypes.CDLL | None:
     global _lib, _lib_tried
-    if _lib_tried:
+    if _lib_tried:  # unlocked fast path: set only after init finishes
         return _lib
-    _lib_tried = True
-    if not os.path.exists(_LIB_PATH) and os.path.exists(os.path.join(_NATIVE_DIR, "Makefile")):
+    with _load_lock:
+        if not _lib_tried:
+            try:
+                _lib = _load_impl()
+            finally:
+                _lib_tried = True  # build/load failure is permanent fallback
+        return _lib
+
+
+def _load_impl() -> ctypes.CDLL | None:
+    if os.path.exists(os.path.join(_NATIVE_DIR, "Makefile")) and _lib_stale():
+        # rebuild when the .so is missing or older than its sources: a
+        # stale prebuilt library loads fine but lacks newer entries like
+        # pack_slice_p_sparse_rbsp. The mtime gate (not an unconditional
+        # make) keeps toolchain-less deploys with a prebuilt .so from
+        # spawning a failing compiler on every process start; the
+        # Makefile builds to a temp name + rename, so a concurrent
+        # starter never loads a half-written library.
         try:
-            subprocess.run(["make", "-C", _NATIVE_DIR, "-s"], check=True, capture_output=True, timeout=120)
+            subprocess.run(["make", "-C", _NATIVE_DIR, "-s", "libcavlc.so"],
+                           check=True, capture_output=True, timeout=120)
         except (OSError, subprocess.SubprocessError) as exc:
-            logger.warning("could not build libcavlc.so (%s); using Python packer", exc)
-            return None
+            if not os.path.exists(_LIB_PATH):
+                logger.warning("could not build libcavlc.so (%s); using Python packer", exc)
+                return None
+            # keep the existing (possibly stale) library; entry-point
+            # availability is still gated per-symbol below
+            logger.warning("libcavlc.so rebuild failed (%s); using existing library", exc)
     try:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError as exc:
@@ -76,6 +118,24 @@ def _load() -> ctypes.CDLL | None:
         ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
     ]
+    try:
+        # sparse-native P packer (wire format in, RBSP out) — absent from
+        # a stale .so; callers gate on sparse_native_available()
+        lib.pack_slice_p_sparse_rbsp.restype = ctypes.c_int64
+        lib.pack_slice_p_sparse_rbsp.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int16), ctypes.POINTER(ctypes.c_int16),
+            ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int16), ctypes.POINTER(ctypes.c_int16),
+            ctypes.POINTER(ctypes.c_int16), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int16), ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+    except AttributeError:
+        pass
     lib.emulation_prevent.restype = ctypes.c_int64
     lib.emulation_prevent.argtypes = [
         ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
@@ -88,8 +148,7 @@ def _load() -> ctypes.CDLL | None:
         ]
     except AttributeError:
         pass  # stale .so; python fallback used
-    _lib = lib
-    return _lib
+    return lib
 
 
 def derive_skip_mvs_fast(mvs: np.ndarray, skip: np.ndarray) -> None:
@@ -140,6 +199,8 @@ def _get_scratch(mbh: int, mbw: int, cap: int) -> dict[str, np.ndarray]:
             "ebsp": np.empty(cap + cap // 2 + 16, np.uint8),
             "luma_tc": np.empty(mbh * 4 * mbw * 4, np.int32),
             "chroma_tc": np.empty(2 * mbh * 2 * mbw * 2, np.int32),
+            # sparse-native packer's MV grid (skip MBs re-derived in C)
+            "mv": np.empty(mbh * mbw * 2, np.int32),
         }
         store[(mbh, mbw)] = s
     return s
@@ -250,6 +311,64 @@ def pack_slice_p_native(fc: PFrameCoeffs, p: StreamParams, frame_num: int,
         cap = len(rbsp) * 2
         if cap > (1 << 30):
             raise RuntimeError("pack_slice_p_rbsp overflow beyond 1 GiB")
+    return _finish_nal(s, n, NAL_SLICE_NON_IDR)
+
+
+def sparse_native_available() -> bool:
+    """True when libcavlc.so exports the sparse-native P packer (a stale
+    .so lacks it) and SELKIES_SPARSE_NATIVE != 0."""
+    if os.environ.get("SELKIES_SPARSE_NATIVE", "1") == "0":
+        return False
+    lib = _load()
+    return lib is not None and hasattr(lib, "pack_slice_p_sparse_rbsp")
+
+
+def pack_slice_p_sparse_native(wire, p: StreamParams, frame_num: int, qp: int,
+                               ltr_ref: int | None = None,
+                               mark_ltr: int | None = None,
+                               mmco_evict: tuple = ()) -> bytes:
+    """Entropy-code one P slice straight from the sparse downlink wire
+    views (compact.SparsePWire) — no dense coefficient scatter, no int16
+    re-copy, no PFrameCoeffs. Byte-identical to cavlc.pack_slice_p fed
+    the unpacked frame (the dense path stays as the equivalence oracle
+    and the no-native fallback)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "pack_slice_p_sparse_rbsp"):
+        raise RuntimeError("libcavlc.so sparse packer unavailable")
+    mbh, mbw = wire.mbh, wire.mbw
+
+    hdr = BitWriter()
+    write_slice_header(hdr, p, SLICE_P, frame_num, idr=False, slice_qp=qp,
+                       ltr_ref=ltr_ref, mark_ltr=mark_ltr,
+                       mmco_evict=mmco_evict)
+    hdr_bytes, hdr_bits = hdr.get_partial()
+
+    # sized for typical sparse content; pathological levels retry bigger.
+    # The scratch is per-thread per-geometry and only ever grows, so the
+    # steady state allocates nothing frame-to-frame.
+    cap = len(hdr_bytes) + 4096 + 40 * wire.ns + 72 * wire.n
+    while True:
+        s = _get_scratch(mbh, mbw, cap)
+        rbsp = s["rbsp"]
+        n = lib.pack_slice_p_sparse_rbsp(
+            hdr_bytes, hdr_bits,
+            _i16ptr(wire.skip16), _i16ptr(wire.pairs16),
+            wire.ns, 1 if wire.packed else 0,
+            _i16ptr(wire.rows16), _i16ptr(wire.bitmaps), _i16ptr(wire.vals),
+            wire.held, _i16ptr(wire.extra_rows), wire.n, len(wire.vals),
+            mbh, mbw,
+            rbsp.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(rbsp),
+            _i32ptr(s["luma_tc"]), _i32ptr(s["chroma_tc"]), _i32ptr(s["mv"]),
+        )
+        if n >= 0:
+            break
+        if n == -2:
+            raise ValueError(
+                "sparse wire inconsistent: pair/row/value counts disagree "
+                "with the skip bitmap or mbinfo words")
+        cap = len(rbsp) * 2
+        if cap > (1 << 30):
+            raise RuntimeError("pack_slice_p_sparse_rbsp overflow beyond 1 GiB")
     return _finish_nal(s, n, NAL_SLICE_NON_IDR)
 
 

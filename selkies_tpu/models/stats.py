@@ -43,3 +43,10 @@ class FrameStats:
     pack_ms: float
     skipped_mbs: int = 0
     scene_cut: bool = False  # full-frame change coded as P (keyframe-sized)
+    # host completion sub-stages (pack_ms = unpack_ms + cavlc_ms for the
+    # coefficient rows; encoder rows without the split leave them 0):
+    # unpack_ms is downlink-bytes -> packer-ready coefficients (sparse
+    # expansion / dense scatter / fallback fetches), cavlc_ms the entropy
+    # pack + NAL assembly itself
+    unpack_ms: float = 0.0
+    cavlc_ms: float = 0.0

@@ -321,9 +321,12 @@ class Telemetry:
 
     def frame_done(self, frame: int, nbytes: int, *, idr: bool,
                    session: str = "0", device_ms: float = 0.0,
-                   pack_ms: float = 0.0) -> None:
+                   pack_ms: float = 0.0, unpack_ms: float = 0.0,
+                   cavlc_ms: float = 0.0) -> None:
         """An encoded access unit left the encoder: fold its size, kind,
-        and on-device / entropy-pack milliseconds."""
+        and on-device / entropy-pack milliseconds. unpack/cavlc are the
+        completion sub-stages of pack_ms (coefficient prep vs the CAVLC
+        bit pack itself); rows that don't attribute them pass 0."""
         if not self.enabled:
             return
         self._observe("selkies_frame_bytes", nbytes, {"session": session})
@@ -336,9 +339,17 @@ class Telemetry:
         if pack_ms:
             self._observe("selkies_stage_ms", pack_ms,
                           {"stage": "pack", "session": session})
+        if unpack_ms:
+            self._observe("selkies_stage_ms", unpack_ms,
+                          {"stage": "unpack", "session": session})
+        if cavlc_ms:
+            self._observe("selkies_stage_ms", cavlc_ms,
+                          {"stage": "cavlc", "session": session})
         self._record(session, {"ev": "frame", "fid": frame, "bytes": nbytes,
                                "idr": idr, "device_ms": round(device_ms, 3),
-                               "pack_ms": round(pack_ms, 3)})
+                               "pack_ms": round(pack_ms, 3),
+                               "unpack_ms": round(unpack_ms, 3),
+                               "cavlc_ms": round(cavlc_ms, 3)})
 
     def _record(self, session: str, ev: dict) -> None:
         rec = self.recorder

@@ -35,7 +35,13 @@ black-box bundles stay greppable):
     frame-drop    instant: capture tick skipped (transport backpressure)
   encoder completion workers (models/h264/encoder.py):
     fetch         device→host coefficient/word downlink
-    pack          host CAVLC entropy pack + NAL assembly
+    unpack        downlink bytes → packer-ready coefficients (sparse
+                  wire views / dense expansion, shortfall + spill +
+                  dense-header fallback fetches included)
+    pack          host CAVLC entropy pack + NAL assembly (the
+                  sparse-native packer when libcavlc exports it, the
+                  Python dense oracle otherwise); the matching
+                  selkies_stage_ms stages are "unpack" and "cavlc"
   fleet service (parallel/serving.py):
     convert       per-session BGRx→I420 on the pack pool
     device-step   sharded batch encode dispatch

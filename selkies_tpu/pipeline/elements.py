@@ -161,6 +161,10 @@ class EncodedFrame:
     device_ms: float
     pack_ms: float
     scene_cut: bool = False
+    # completion sub-stage split (pack_ms = unpack_ms + cavlc_ms; 0 for
+    # encoder rows that don't attribute it)
+    unpack_ms: float = 0.0
+    cavlc_ms: float = 0.0
     # telemetry correlation id assigned at capture (0 = telemetry off);
     # metadata only — never touches the encoded bytes
     frame_id: int = 0
@@ -341,6 +345,8 @@ class VideoPipeline:
                             device_ms=stats.device_ms,
                             pack_ms=stats.pack_ms,
                             scene_cut=getattr(stats, "scene_cut", False),
+                            unpack_ms=getattr(stats, "unpack_ms", 0.0),
+                            cavlc_ms=getattr(stats, "cavlc_ms", 0.0),
                             frame_id=self._fid_by_ts.pop(meta, 0),
                         )
                         for au, stats, meta in done
@@ -359,6 +365,8 @@ class VideoPipeline:
                             qp=stats.qp,
                             device_ms=stats.device_ms,
                             pack_ms=stats.pack_ms,
+                            unpack_ms=getattr(stats, "unpack_ms", 0.0),
+                            cavlc_ms=getattr(stats, "cavlc_ms", 0.0),
                             frame_id=fid,
                         )
                     ]
@@ -370,7 +378,8 @@ class VideoPipeline:
                         telemetry.frame_done(
                             ef.frame_id, len(ef.au), idr=ef.idr,
                             session=self.session, device_ms=ef.device_ms,
-                            pack_ms=ef.pack_ms)
+                            pack_ms=ef.pack_ms, unpack_ms=ef.unpack_ms,
+                            cavlc_ms=ef.cavlc_ms)
                 failures = 0
                 if self.supervisor is not None:
                     self.supervisor.tick_ok()

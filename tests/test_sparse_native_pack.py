@@ -1,0 +1,279 @@
+"""Sparse-native P-slice packer equivalence + concurrency suite.
+
+The sparse-native completion path (native/cavlc_pack.cc
+pack_slice_p_sparse_rbsp consuming the downlink wire format directly)
+must be byte-identical to the Python dense oracle (unpack to
+PFrameCoeffs, then cavlc.pack_slice_p) across both sparse layouts, the
+ns > nscap dense-header fallback, the cap_rows spill, and the LTR
+slice-header variants. Wire buffers come from the host mirror
+(sparse_ref.build_p_sparse_wire), which is itself validated against the
+device packers' unpack contract below — so the suite runs without a
+device and still pins the exact bytes the TPU downlink produces.
+
+When libcavlc.so (or its sparse entry) is absent the native-only
+assertions skip; the oracle-side checks (wire round-trip, fallback
+contract) still run.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from selkies_tpu.models.h264 import native
+from selkies_tpu.models.h264.bitstream import StreamParams
+from selkies_tpu.models.h264.cavlc import pack_slice_p
+from selkies_tpu.models.h264.compact import (
+    p_sparse_wire_views,
+    unpack_p_compact,
+    unpack_p_sparse_packed,
+    unpack_p_sparse_var,
+)
+from selkies_tpu.models.h264.sparse_ref import build_p_sparse_wire, synth_pfc
+
+needs_sparse_native = pytest.mark.skipif(
+    not native.sparse_native_available(),
+    reason="libcavlc.so sparse entry not available",
+)
+
+
+def _wire_and_oracle(pfc, nscap, cap_rows, packed):
+    """(fused, extra_rows, oracle PFrameCoeffs-or-None, rows) for one frame."""
+    fused, dense, buf = build_p_sparse_wire(pfc, nscap, cap_rows, packed=packed)
+    n = int(np.ascontiguousarray(fused[:8]).view(np.int32)[0])
+    extra = buf[cap_rows:n] if n > cap_rows else None
+    unpack = unpack_p_sparse_packed if packed else unpack_p_sparse_var
+    mbh, mbw = pfc.skip.shape
+    pfc2, rows = unpack(fused, pfc.qp, mbh, mbw, nscap, cap_rows, extra)
+    return fused, dense, extra, pfc2, rows
+
+
+@pytest.mark.parametrize("packed", [False, True])
+def test_wire_builder_matches_unpack_contract(packed):
+    """The host wire mirror must round-trip through the production
+    unpackers to the exact frame it was built from (incl. derived skip
+    MVs) — this is what ties the synthetic suite to the device format."""
+    rng = np.random.default_rng(7)
+    pfc = synth_pfc(rng, 6, 8, skip_frac=0.6, row_density=0.3)
+    _fused, _dense, _extra, pfc2, _rows = _wire_and_oracle(pfc, 512, 512, packed)
+    assert pfc2 is not None
+    np.testing.assert_array_equal(pfc2.skip, pfc.skip)
+    np.testing.assert_array_equal(pfc2.mvs, pfc.mvs)
+    np.testing.assert_array_equal(pfc2.luma_ac, pfc.luma_ac)
+    np.testing.assert_array_equal(pfc2.chroma_dc, pfc.chroma_dc)
+    np.testing.assert_array_equal(pfc2.chroma_ac, pfc.chroma_ac)
+
+
+@pytest.mark.parametrize("packed", [False, True])
+def test_wire_builder_matches_device_packer(packed):
+    """Mirror == device: the jitted pack_p_sparse_* of a real encode and
+    build_p_sparse_wire of the unpacked frame emit identical buffers."""
+    jax = pytest.importorskip("jax")
+    from selkies_tpu.models.h264 import encoder_core as core
+
+    jax.config.update("jax_platforms", "cpu")
+    rng = np.random.default_rng(3)
+    h, w = 64, 96
+    y = np.kron(rng.integers(16, 235, (h // 8, w // 8)), np.ones((8, 8))).astype(np.uint8)
+    u = rng.integers(0, 256, (h // 2, w // 2)).astype(np.uint8)
+    v = rng.integers(0, 256, (h // 2, w // 2)).astype(np.uint8)
+    ry = rng.integers(0, 256, (h, w)).astype(np.uint8)
+    out = jax.jit(core.encode_frame_p_planes)(y, u, v, ry, u, v, np.int32(30))
+    nscap, cap = 128, 128
+    if packed:
+        fused_d, dense_d, buf_d = jax.jit(
+            lambda o: core.pack_p_sparse_packed(o, nscap, cap))(out)
+    else:
+        fused_d, dense_d, buf_d = jax.jit(
+            lambda o: core.pack_p_sparse_var(o, nscap, cap))(out)
+    fused_d, dense_d = np.asarray(fused_d), np.asarray(dense_d)
+    n = int(np.ascontiguousarray(fused_d[:8]).view(np.int32)[0])
+    extra = np.asarray(buf_d)[cap:n] if n > cap else None
+    unpack = unpack_p_sparse_packed if packed else unpack_p_sparse_var
+    pfc, _rows = unpack(fused_d, 30, h // 16, w // 16, nscap, cap, extra)
+    assert pfc is not None
+    # rebuild from the unpacked frame, but with the DEVICE's raw MVs for
+    # skip MBs (the host derives them; the device dense header keeps the
+    # ME values) — only the dense header differs on those words
+    fused_h, _dense_h, _buf = build_p_sparse_wire(pfc, nscap, cap, packed=packed)
+    np.testing.assert_array_equal(fused_h, fused_d)
+
+
+@pytest.mark.parametrize("packed", [False, True])
+@pytest.mark.parametrize("caps", [(512, 512), (512, 16), (512, 3)])
+@needs_sparse_native
+def test_sparse_native_byte_identical(packed, caps):
+    """Randomized equivalence vs the Python dense oracle, both layouts,
+    including cap_rows spill (tiny cap) feeding extra_rows."""
+    nscap, cap_rows = caps
+    p = StreamParams(width=8 * 16, height=6 * 16, qp=30)
+    for seed in range(10):
+        rng = np.random.default_rng(seed)
+        pfc = synth_pfc(
+            rng, 6, 8,
+            skip_frac=float(rng.uniform(0.1, 1.0)),
+            row_density=float(rng.uniform(0.05, 0.6)),
+            big_levels=bool(seed % 3 == 0),
+        )
+        fused, _dense, extra, pfc2, _rows = _wire_and_oracle(
+            pfc, nscap, cap_rows, packed)
+        assert pfc2 is not None
+        wire = p_sparse_wire_views(fused, 6, 8, nscap, cap_rows, packed, extra)
+        for fn in (0, 9):
+            oracle = pack_slice_p(pfc2, p, frame_num=fn)
+            got = native.pack_slice_p_sparse_native(wire, p, fn, pfc.qp)
+            assert got == oracle, f"seed {seed} fn {fn} differs"
+
+
+@needs_sparse_native
+def test_sparse_native_ltr_variants():
+    """ltr_ref / mark_ltr / mmco_evict ride the slice header — the
+    sparse-native packer must splice them identically."""
+    p = StreamParams(width=8 * 16, height=6 * 16, qp=30)
+    rng = np.random.default_rng(42)
+    pfc = synth_pfc(rng, 6, 8, skip_frac=0.5, row_density=0.3)
+    fused, _dense, extra, pfc2, _rows = _wire_and_oracle(pfc, 512, 512, True)
+    wire = p_sparse_wire_views(fused, 6, 8, 512, 512, True, extra)
+    for kw in (dict(ltr_ref=0), dict(ltr_ref=1), dict(mark_ltr=0),
+               dict(mark_ltr=1, mmco_evict=(0, 2)),
+               dict(ltr_ref=1, mark_ltr=0, mmco_evict=(1,))):
+        oracle = pack_slice_p(pfc2, p, frame_num=5, **kw)
+        got = native.pack_slice_p_sparse_native(wire, p, 5, 30, **kw)
+        assert got == oracle, f"{kw} differs"
+
+
+@needs_sparse_native
+def test_sparse_native_all_skip_and_all_coded():
+    p = StreamParams(width=8 * 16, height=6 * 16, qp=28)
+    for skip_frac in (1.1, -0.1):  # all-skip / all-coded
+        pfc = synth_pfc(np.random.default_rng(1), 6, 8, skip_frac=skip_frac,
+                        row_density=0.4, qp=28)
+        fused, _dense, extra, pfc2, _rows = _wire_and_oracle(pfc, 512, 512, False)
+        wire = p_sparse_wire_views(fused, 6, 8, 512, 512, False, extra)
+        assert (native.pack_slice_p_sparse_native(wire, p, 2, 28)
+                == pack_slice_p(pfc2, p, frame_num=2))
+
+
+@pytest.mark.parametrize("packed", [False, True])
+def test_nscap_overflow_dense_fallback(packed):
+    """ns > nscap: the wire views refuse (None) and the oracle unpack
+    signals the dense-header fallback, which must reconstruct the frame
+    from the already-fetched rows. Runs with or without libcavlc."""
+    rng = np.random.default_rng(11)
+    pfc = synth_pfc(rng, 6, 8, skip_frac=0.1, row_density=0.3)
+    nscap = 4
+    assert int((~pfc.skip).sum()) > nscap
+    fused, dense, buf = build_p_sparse_wire(pfc, nscap, 512, packed=packed)
+    assert p_sparse_wire_views(fused, 6, 8, nscap, 512, packed, None) is None
+    unpack = unpack_p_sparse_packed if packed else unpack_p_sparse_var
+    pfc2, rows = unpack(fused, pfc.qp, 6, 8, nscap, 512, None)
+    assert pfc2 is None
+    pfc3 = unpack_p_compact(dense, rows, pfc.qp)
+    np.testing.assert_array_equal(pfc3.luma_ac, pfc.luma_ac)
+    np.testing.assert_array_equal(pfc3.skip, pfc.skip)
+    p = StreamParams(width=8 * 16, height=6 * 16, qp=30)
+    # mvs differ only on skip MBs (raw ME values vs derived) — the packed
+    # bytes must still agree because skip MBs emit no mvd
+    assert pack_slice_p(pfc3, p, 1) == pack_slice_p(
+        type(pfc3)(mvs=pfc.mvs, skip=pfc.skip, luma_ac=pfc.luma_ac,
+                   chroma_dc=pfc.chroma_dc, chroma_ac=pfc.chroma_ac,
+                   qp=pfc.qp), p, 1)
+
+
+@needs_sparse_native
+def test_corrupt_mbinfo_rejected_not_read_oob():
+    """A corrupted mbinfo word claiming more rows than the wire delivers
+    must fail loudly (ValueError), not read past the row buffers."""
+    rng = np.random.default_rng(6)
+    pfc = synth_pfc(rng, 6, 8, skip_frac=0.5, row_density=0.2)
+    p = StreamParams(width=8 * 16, height=6 * 16, qp=30)
+    for packed in (False, True):
+        fused, _dense, extra, pfc2, _rows = _wire_and_oracle(pfc, 512, 512, packed)
+        wire = p_sparse_wire_views(fused, 6, 8, 512, 512, packed, extra)
+        bad = wire.pairs16.copy()
+        # set every row bit in the first pair's info word (little-endian
+        # int32 at int16 lanes 2..3)
+        bad[2] = -1
+        bad[3] = 0x03FF
+        wire.pairs16 = bad
+        with pytest.raises(ValueError):
+            native.pack_slice_p_sparse_native(wire, p, 1, 30)
+
+
+def test_corrupt_skip_bitmap_raises():
+    rng = np.random.default_rng(5)
+    pfc = synth_pfc(rng, 6, 8, skip_frac=0.5, row_density=0.3)
+    fused, _dense, _buf = build_p_sparse_wire(pfc, 512, 512, packed=False)
+    sw = (6 * 8 + 31) // 32
+    bad = fused.copy()
+    bad[8 : 8 + 2 * sw] = 0  # nothing skipped per the bitmap, ns says otherwise
+    with pytest.raises(ValueError):
+        p_sparse_wire_views(bad, 6, 8, 512, 512, False, None)
+
+
+@needs_sparse_native
+def test_sparse_native_concurrent_group_matches_serial():
+    """A delta group fanned out across pool workers must emit the same
+    bytes as the serial walk — guards the thread-local scratch (the
+    PR-2 CAVLC scratch race would have failed exactly this). Mixed
+    geometries stress per-geometry scratch reuse across threads."""
+    geoms = [(6, 8), (4, 12), (6, 8), (8, 8)]
+    frames = []
+    for i in range(12):
+        mbh, mbw = geoms[i % len(geoms)]
+        rng = np.random.default_rng(200 + i)
+        pfc = synth_pfc(rng, mbh, mbw, skip_frac=0.5, row_density=0.35)
+        packed = bool(i % 2)
+        fused, _dense, extra, pfc2, _rows = _wire_and_oracle(pfc, 512, 512, packed)
+        wire = p_sparse_wire_views(fused, mbh, mbw, 512, 512, packed, extra)
+        p = StreamParams(width=mbw * 16, height=mbh * 16, qp=30)
+        frames.append((wire, p, i % 7))
+
+    def pack_one(args):
+        wire, p, fn = args
+        return native.pack_slice_p_sparse_native(wire, p, fn, 30)
+
+    serial = [pack_one(f) for f in frames]
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        for _ in range(4):  # repeat: races are probabilistic
+            fanned = list(pool.map(pack_one, frames))
+            assert fanned == serial
+
+
+def test_encoder_group_completion_fanned_vs_serial(monkeypatch):
+    """End-to-end: the SAME delta group completed through the encoder's
+    fan-out pool and through the serial path must produce identical
+    access units (the pool is an execution detail, not a format one)."""
+    jax = pytest.importorskip("jax")
+    jax.config.update("jax_platforms", "cpu")
+    from selkies_tpu.models.h264.encoder import TPUH264Encoder
+
+    def run(env_workers):
+        if env_workers is not None:
+            monkeypatch.setenv("SELKIES_PACK_WORKERS", env_workers)
+        else:
+            monkeypatch.delenv("SELKIES_PACK_WORKERS", raising=False)
+        rng = np.random.default_rng(9)
+        enc = TPUH264Encoder(128, 96, qp=30, frame_batch=4, pipeline_depth=1,
+                             tile_cache=0, ltr_scenes=False)
+        if env_workers == "1":
+            # serial completion inside the group worker; shut the real
+            # pool down first so its threads don't outlive the test
+            enc._pack_pool.shutdown(wait=False)
+            enc._pack_pool = None
+        base = rng.integers(0, 255, (96, 128, 4), np.uint8)
+        aus = [au for au, _s, _m in enc.submit(base.copy())]
+        frames = []
+        for i in range(4):
+            f = base.copy()
+            f[16 * i : 16 * i + 8, 32:48] = rng.integers(0, 255, (8, 16, 4))
+            frames.append(f)
+        for f in frames:
+            aus.extend(au for au, _s, _m in enc.submit(f))
+        aus.extend(au for au, _s, _m in enc.flush())
+        enc.close()
+        return aus
+
+    assert run(None) == run("1")
