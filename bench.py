@@ -64,8 +64,13 @@ def _result(metric: str, fps: float, **extra: float) -> None:
     # per-stage means ride along so the record isn't hostage to tunnel
     # weather: device_stage_latency_ms is each frame's dispatch->resolve
     # time through the device stage (queueing in its group + execute +
-    # fetch) observed during the SAME single timed pass — no extra runs
-    doc.update({k: round(v, 2) for k, v in extra.items()})
+    # fetch) observed during the SAME single timed pass — no extra runs.
+    # It splits as upload_ms (host convert + h2d/dispatch enqueue) +
+    # step_ms (dispatch -> device outputs ready) + fetch_ms (d2h
+    # transfer) so a regression attributes to the right sub-stage; with
+    # SELKIES_BANDS>1 `bands` and per-band `band_step_ms` ride along too.
+    doc.update({k: (round(v, 2) if isinstance(v, float) else v)
+                for k, v in extra.items()})
     print(json.dumps(doc))
 
 
@@ -106,7 +111,7 @@ def _desktop_trace(n: int = 60) -> list[np.ndarray]:
     return frames
 
 
-def bench_full_encoder() -> tuple[float, float, float, float, float, float, float] | None:
+def bench_full_encoder() -> tuple[float, dict] | None:
     """Steady-state IP-GOP desktop encode (IDR once, then P frames; delta
     band uploads for partial updates, full uploads on window switches,
     on-device motion estimation). Uses the pipelined submit/flush API
@@ -117,58 +122,91 @@ def bench_full_encoder() -> tuple[float, float, float, float, float, float, floa
         return None
     from selkies_tpu.models.registry import default_frame_batch, default_pipeline_depth
 
-    # grouped-dispatch depth + in-flight cap come from the SAME
-    # deployment-aware defaults the live pipeline uses
-    # (registry.default_frame_batch/default_pipeline_depth, PERF.md)
-    enc = TPUH264Encoder(W, H, qp=28, frame_batch=min(12, default_frame_batch()),
-                         pipeline_depth=default_pipeline_depth())
+    from selkies_tpu.parallel.bands import bands_from_env
+
     frames = _desktop_trace(ITERS)
-    # warmup compiles every executable the trace uses: IDR full, grouped
-    # delta scans (K=8 and K=4), single delta, P full, static
-    enc.encode_frame(frames[0])  # IDR full
-    fb = enc.frame_batch
-    i = 1
-    for _ in range(fb):  # consecutive deltas fill one group -> K=fb scan
-        enc.submit(frames[i]); i += 1
-    enc.flush()
-    for _ in range(max(2, fb // 2)):  # half group -> K=fb/2 scan
-        enc.submit(frames[i]); i += 1
-    enc.flush()
-    enc.encode_frame(frames[i])  # single delta (straggler path)
-    enc.encode_frame(frames[29 % len(frames)])  # window switch -> full P
-    enc.encode_frame(frames[29 % len(frames)])  # static
-    # LTR scene-cache warmup: switching back to the remembered desktop
-    # compiles the restore executable (non-donating scatter) + the
-    # device plane-snapshot step — both used by the steady-state loop
-    enc.encode_frame(frames[0])
-    enc.encode_frame(frames[1])
+    if bands_from_env() > 1:
+        # SELKIES_BANDS>1: bench the band-parallel encoder the registry
+        # would build — the timed loop below is identical (submit/flush),
+        # and the JSON gains bands / band_step_ms for band attribution
+        from selkies_tpu.parallel.bands import BandedH264Encoder
+
+        enc = BandedH264Encoder(W, H, qp=28)
+        enc.encode_frame(frames[0])   # IDR (compiles the I step)
+        enc.encode_frame(frames[1])   # P (compiles the band P step)
+        enc.encode_frame(frames[1])   # static all-skip
+    else:
+        # grouped-dispatch depth + in-flight cap come from the SAME
+        # deployment-aware defaults the live pipeline uses
+        # (registry.default_frame_batch/default_pipeline_depth, PERF.md)
+        enc = TPUH264Encoder(W, H, qp=28,
+                             frame_batch=min(12, default_frame_batch()),
+                             pipeline_depth=default_pipeline_depth())
+        # warmup compiles every executable the trace uses: IDR full,
+        # grouped delta scans (K=8 and K=4), single delta, P full, static
+        enc.encode_frame(frames[0])  # IDR full
+        fb = enc.frame_batch
+        i = 1
+        for _ in range(fb):  # consecutive deltas fill one group -> K=fb scan
+            enc.submit(frames[i]); i += 1
+        enc.flush()
+        for _ in range(max(2, fb // 2)):  # half group -> K=fb/2 scan
+            enc.submit(frames[i]); i += 1
+        enc.flush()
+        enc.encode_frame(frames[i])  # single delta (straggler path)
+        enc.encode_frame(frames[29 % len(frames)])  # window switch -> full P
+        enc.encode_frame(frames[29 % len(frames)])  # static
+        # LTR scene-cache warmup: switching back to the remembered desktop
+        # compiles the restore executable (non-donating scatter) + the
+        # device plane-snapshot step — both used by the steady-state loop
+        enc.encode_frame(frames[0])
+        enc.encode_frame(frames[1])
     # ONE timed pass — steady state, no best-of (every pass must be
     # fast, not the luckiest one; the trace includes the window-switch
     # full-frame changes)
     done = 0
-    device_ms = pack_ms = unpack_ms = cavlc_ms = 0.0
+    sums = {k: 0.0 for k in ("device_ms", "pack_ms", "unpack_ms", "cavlc_ms",
+                             "upload_ms", "step_ms", "fetch_ms")}
+    bands = 1
+    band_step_sums: list[float] = []
+    band_step_n = 0
+
+    def _account(stats) -> None:
+        nonlocal bands, band_step_sums, band_step_n
+        for k in sums:
+            sums[k] += getattr(stats, k, 0.0)
+        bands = max(bands, getattr(stats, "bands", 1))
+        bs = getattr(stats, "band_step_ms", ())
+        if bs:
+            if len(band_step_sums) < len(bs):
+                band_step_sums = list(band_step_sums) + [0.0] * (
+                    len(bs) - len(band_step_sums))
+            for b, ms in enumerate(bs):
+                band_step_sums[b] += ms
+            band_step_n += 1
+
     lb0 = enc.link_bytes.snapshot()  # link-byte baseline (excl. warmup)
     t0 = time.perf_counter()
     for i in range(ITERS):
         for _, stats, _ in enc.submit(frames[i % len(frames)]):
             done += 1
-            device_ms += stats.device_ms
-            pack_ms += stats.pack_ms
-            unpack_ms += getattr(stats, "unpack_ms", 0.0)
-            cavlc_ms += getattr(stats, "cavlc_ms", 0.0)
+            _account(stats)
     for _, stats, _ in enc.flush():
         done += 1
-        device_ms += stats.device_ms
-        pack_ms += stats.pack_ms
-        unpack_ms += getattr(stats, "unpack_ms", 0.0)
-        cavlc_ms += getattr(stats, "cavlc_ms", 0.0)
+        _account(stats)
     dt = time.perf_counter() - t0
     lb1 = enc.link_bytes.snapshot()
     up = sum(v - lb0.get(k, 0) for k, v in lb1.items() if k.startswith("up_"))
     down = sum(v - lb0.get(k, 0) for k, v in lb1.items() if k.startswith("down_"))
     assert done == ITERS, f"pipeline lost frames: {done}/{ITERS}"
-    return (ITERS / dt, device_ms / done, pack_ms / done,
-            unpack_ms / done, cavlc_ms / done, up / done, down / done)
+    means = {k: v / done for k, v in sums.items()}
+    means["bytes_up_per_frame"] = up / done
+    means["bytes_down_per_frame"] = down / done
+    if bands > 1 and band_step_n:
+        means["bands"] = bands
+        means["band_step_ms"] = [round(s / band_step_n, 2)
+                                 for s in band_step_sums]
+    return ITERS / dt, means
 
 
 def bench_convert_only() -> float:
@@ -191,17 +229,16 @@ def main() -> int:
     _reexec_cpu_if_tunnel_down()
     out = bench_full_encoder()
     if out is not None:
-        fps, device_ms, pack_ms, unpack_ms, cavlc_ms, up_pf, down_pf = out
+        fps, means = out
         # bytes_up/down_per_frame: what the relay actually prices
         # (PERF.md cost model) — lets future rounds track the link terms
         # without a separate profiling pass. pack_ms splits into
         # unpack_ms (downlink bytes -> packer-ready coefficients) +
-        # cavlc_ms (entropy pack + NAL) so the trajectory attributes
-        # completion time to the right sub-stage.
-        _result("tpuh264enc 1080p IP-GOP encode fps (1 chip)", fps,
-                device_stage_latency_ms=device_ms, pack_ms=pack_ms,
-                unpack_ms=unpack_ms, cavlc_ms=cavlc_ms,
-                bytes_up_per_frame=up_pf, bytes_down_per_frame=down_pf)
+        # cavlc_ms (entropy pack + NAL), device_stage_latency_ms into
+        # upload_ms + step_ms + fetch_ms, so the trajectory attributes
+        # each regression to the right sub-stage.
+        means["device_stage_latency_ms"] = means.pop("device_ms")
+        _result("tpuh264enc 1080p IP-GOP encode fps (1 chip)", fps, **means)
     else:
         _result("capture->I420 convert fps (encoder pending)", bench_convert_only())
     return 0

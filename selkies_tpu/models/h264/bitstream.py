@@ -167,6 +167,14 @@ def write_slice_header(
         the DPB past max_num_ref_frames. The encoder mirrors the DPB
         and passes the stale picNum diffs here.
     """
+    # first_mb positions a slice of a MULTI-SLICE picture (the band-
+    # parallel encode, parallel/bands.py: band b starts at mb-row-offset
+    # × mb_width). An out-of-picture value would produce a stream every
+    # decoder rejects — fail at write time, where the band math is.
+    if not 0 <= first_mb < p.mb_width * p.mb_height:
+        raise ValueError(
+            f"first_mb_in_slice {first_mb} outside picture "
+            f"({p.mb_width}x{p.mb_height} MBs)")
     w.write_ue(first_mb)
     w.write_ue(slice_type)
     w.write_ue(0)  # pic_parameter_set_id

@@ -153,13 +153,22 @@ def pack_slice(
     frame_num: int = 0,
     idr: bool = True,
     idr_pic_id: int = 0,
+    first_mb: int = 0,
 ) -> bytes:
-    """Entropy-code a whole frame of Intra16x16 MBs into one slice NAL."""
+    """Entropy-code Intra16x16 MBs into one slice NAL.
+
+    fc may cover the whole picture (first_mb=0, the single-slice default)
+    or one horizontal BAND of it (parallel/bands.py): first_mb is the
+    slice header's first_mb_in_slice, and fc's grid is the band's own
+    (band_mbh, mbw) — neighbour/nC context starts fresh at the band's
+    first row, which is exactly the slice-boundary availability rule
+    (neighbours in another slice are unavailable)."""
     mbh, mbw = fc.luma_mode.shape
     w = BitWriter()
     # fc.qp is the QP the coefficients were quantized with; slice_qp_delta
     # carries any difference from pic_init_qp (live rate-control retunes).
-    write_slice_header(w, p, SLICE_I, frame_num, idr=idr, idr_pic_id=idr_pic_id, slice_qp=fc.qp)
+    write_slice_header(w, p, SLICE_I, frame_num, idr=idr, idr_pic_id=idr_pic_id,
+                       slice_qp=fc.qp, first_mb=first_mb)
 
     # nC context grids (TotalCoeff per 4x4 block, frame-wide)
     luma_tc = np.zeros((mbh * 4, mbw * 4), np.int32)
@@ -220,6 +229,7 @@ def pack_slice_p(
     ltr_ref: int | None = None,
     mark_ltr: int | None = None,
     mmco_evict: tuple = (),
+    first_mb: int = 0,
 ) -> bytes:
     """Entropy-code one P frame (P_Skip / P_L0_16x16 MBs) into a slice NAL.
 
@@ -228,12 +238,17 @@ def pack_slice_p(
     reference), mvd relative to the 8.4.1.3 predictor in quarter-pel units,
     me(v)-mapped CBP, and 16-coefficient luma residual blocks (inter MBs
     have no luma DC Hadamard).
+
+    As with pack_slice, fc may be one band of a multi-slice picture:
+    first_mb positions the slice and fc's (band_mbh, mbw) grid resets
+    the MV-predictor / nC neighbourhood at the band's first row (slice
+    boundaries make those neighbours unavailable, 8.4.1.3 / 9.2.1).
     """
     mbh, mbw = fc.skip.shape
     w = BitWriter()
     write_slice_header(w, p, SLICE_P, frame_num, idr=False, slice_qp=fc.qp,
                        ltr_ref=ltr_ref, mark_ltr=mark_ltr,
-                       mmco_evict=mmco_evict)
+                       mmco_evict=mmco_evict, first_mb=first_mb)
 
     luma_tc = np.zeros((mbh * 4, mbw * 4), np.int32)
     chroma_tc = np.zeros((2, mbh * 2, mbw * 2), np.int32)

@@ -261,7 +261,14 @@ def p_sparse_wire_views(
     packer, or None when ns > nscap (the pair region is truncated; the
     caller must take the dense-header fallback). Validates the skip
     bitmap against ns exactly like _finish_sparse_p so a corrupt buffer
-    fails loudly instead of packing garbage."""
+    fails loudly instead of packing garbage.
+
+    Geometry is whatever the buffer was packed with: the band-parallel
+    encoder (parallel/bands.py) calls this once per BAND with the band's
+    own (band_mbh, mbw) grid — each band's fused buffer is a complete,
+    self-describing sparse downlink, so per-band wire views need no
+    extra layout; the band's first_mb_in_slice enters only at the
+    pack_slice_p_sparse_native call."""
     m = mbh * mbw
     sw = (m + 31) // 32
     if packed:

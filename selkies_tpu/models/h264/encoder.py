@@ -467,6 +467,12 @@ class _Pending:
     words_d: object = None  # pb only: full bit-word buffer (spill fetch)
     future: object = None  # completion future (threaded fetch+unpack+pack)
     batch_slot: int = -1  # >=0: index into a shared batch future's result list
+    # device-stage attribution (FrameStats upload/step/fetch split):
+    # host time spent converting + enqueuing this frame's dispatch, and
+    # the wall clock when the dispatch call returned (workers measure
+    # step_ms = outputs-ready - t_disp, then time the d2h fetch itself)
+    up_ms: float = 0.0
+    t_disp: float = 0.0
     scene_cut: bool = False  # full-frame change transition (rate control)
     # LTR scene cache slice-header flags (bitstream.write_slice_header):
     ltr_ref: int | None = None   # predict from long-term reference j
@@ -506,10 +512,21 @@ class TPUH264Encoder:
         tile_cache: int | None = None,
         packed_downlink: bool | None = None,
         pack_density: int | None = None,
+        bands: int | None = None,
     ):
         self.width = width
         self.height = height
         self.fps = fps
+        # bands: intra-frame slice parallelism lives in the band-parallel
+        # encoder (parallel/bands.py; the registry routes SELKIES_BANDS>1
+        # there) — here the knob only sizes the pack pool, so a caller
+        # that wraps this encoder per band fans its slices out correctly
+        if bands is None:
+            # lazy: parallel.bands imports this module
+            from selkies_tpu.parallel.bands import bands_from_env
+
+            bands = bands_from_env()
+        self.bands = int(bands)
         self._nscap = NSCAP
         self._cap_delta = CAP_ROWS_DELTA
         # packed delta downlink: coefficient rows cross the link as a
@@ -687,8 +704,10 @@ class TPUH264Encoder:
         # the GIL and its scratch is thread-local), so the group
         # completion spreads across cores instead of packing K frames
         # serially on one worker. Sized to cover every frame that can be
-        # in flight at once — min(cores, frame_batch x pipeline_depth) —
-        # not today's max(2, depth+1); SELKIES_PACK_WORKERS overrides.
+        # in flight at once — min(cores, bands x frame_batch x
+        # pipeline_depth), the bands factor covering per-band slice
+        # fan-out when this instance packs one band of a split frame —
+        # SELKIES_PACK_WORKERS overrides.
         # Kept SEPARATE from self._pool: group coordinators block on
         # slot futures, and coordinators + leaves sharing one executor
         # can deadlock with every worker stuck coordinating.
@@ -696,7 +715,7 @@ class TPUH264Encoder:
         if pack_workers <= 0:
             pack_workers = min(
                 os.cpu_count() or 4,
-                max(2, self.frame_batch * max(1, self.pipeline_depth)),
+                max(2, self.bands * self.frame_batch * max(1, self.pipeline_depth)),
             )
         self._pack_pool = (
             ThreadPoolExecutor(max_workers=pack_workers,
@@ -1217,6 +1236,7 @@ class TPUH264Encoder:
         try:
             i = 0
             while i < len(pend):
+                t_d0 = time.perf_counter()
                 take = next((s for s in self._batch_sizes if len(pend) - i >= s), 1)
                 group = pend[i : i + take]
                 i += take
@@ -1244,6 +1264,8 @@ class TPUH264Encoder:
                     rec.prefix_d, rec.hdr_d, rec.buf_d = prefix_d, hdr_d, buf_d
                     rec.pfx_slice_d = self._pfx_slice(prefix_d)
                     rec.batch_slot = -1
+                    rec.t_disp = time.perf_counter()
+                    rec.up_ms = (rec.t_disp - t_d0) * 1e3
                     rec.future = self._pool.submit(self._complete_work, rec)
                     continue
                 qps = np.array([g[0].qp for g in group], np.int32)
@@ -1291,6 +1313,10 @@ class TPUH264Encoder:
                 # per-slot full-row handles, dispatched NOW so a worker
                 # shortfall refetch is a pure transfer (no queued slice)
                 rows_d = [prefixes_d[i] for i in range(take)]
+                t_disp = time.perf_counter()
+                up_ms = (t_disp - t_d0) * 1e3
+                for rec in recs:
+                    rec.t_disp, rec.up_ms = t_disp, up_ms
                 shared = self._pool.submit(
                     self._complete_batch, recs, self._pfx_slice(prefixes_d),
                     rows_d, denses_d, bufs_d,
@@ -1415,7 +1441,11 @@ class TPUH264Encoder:
         12-frame group completes in ~one frame's pack time instead of
         twelve. Results come back indexed by batch_slot (submission
         order is preserved by the ordered gather)."""
+        step_ms, t_ready = self._wait_step(recs[0], pfx_slice_d)
         prefixes = np.asarray(pfx_slice_d)
+        # the group shares ONE transfer: step/fetch attribution is the
+        # group's, stamped onto every member frame
+        fetch_ms = (time.perf_counter() - t_ready) * 1e3
         self.link_bytes.add("down_prefix", prefixes.nbytes)
         if self._pack_pool is not None and len(recs) > 1:
             futs = [
@@ -1432,7 +1462,7 @@ class TPUH264Encoder:
                 for slot, rec in enumerate(recs)
             ]
         self._update_pfx_hint()
-        return results
+        return [(*r, step_ms, fetch_ms) for r in results]
 
     def submit(self, frame: np.ndarray, qp: int | None = None, meta=None) -> list:
         """Dispatch one frame into the encode pipeline.
@@ -1560,6 +1590,7 @@ class TPUH264Encoder:
                 # dispatch order must match frame order: drain any pending
                 # delta group before this frame touches device state
                 self._flush_batch()
+                t_d0 = time.perf_counter()
                 hdr_d = None
                 if idr:
                     if kind == "delta":
@@ -1620,6 +1651,11 @@ class TPUH264Encoder:
                     )
                     if pk == "pd":
                         rec.pfx_slice_d = self._pfx_slice(prefix_d)
+                # upload/step attribution boundary: everything since
+                # flush (conversion, tile packing, h2d enqueue, step
+                # enqueue) is the host dispatch cost of THIS frame
+                rec.t_disp = time.perf_counter()
+                rec.up_ms = (rec.t_disp - t_d0) * 1e3
                 # over-budget delta that fell back to full: seed the tile
                 # pool from the now-resident planes so the NEXT frame of
                 # a sustained scroll fits the delta path via remaps.
@@ -1739,9 +1775,10 @@ class TPUH264Encoder:
         # decoder, so null the ref (forces IDR) and drop the pipeline.
         try:
             if rec.batch_slot >= 0:
-                au, skipped, t1, tu, t2 = rec.future.result()[rec.batch_slot]
+                au, skipped, t1, tu, t2, step_ms, fetch_ms = (
+                    rec.future.result()[rec.batch_slot])
             else:
-                au, skipped, t1, tu, t2 = rec.future.result()
+                au, skipped, t1, tu, t2, step_ms, fetch_ms = rec.future.result()
         except Exception:
             self._ref = None
             self._src = None
@@ -1755,27 +1792,44 @@ class TPUH264Encoder:
             pack_ms=(t2 - t1) * 1e3, skipped_mbs=skipped,
             scene_cut=rec.scene_cut,
             unpack_ms=(tu - t1) * 1e3, cavlc_ms=(t2 - tu) * 1e3,
+            upload_ms=rec.up_ms, step_ms=step_ms, fetch_ms=fetch_ms,
         )
         self.last_stats = stats
         return au, stats, rec.meta
 
+    def _wait_step(self, rec: "_Pending", handle) -> tuple[float, float]:
+        """Block until the frame's downlink buffer is ready on device and
+        return (step_ms, t_ready). Worker-side only — the main thread
+        never waits — so the upload/step/fetch attribution costs one
+        block_until_ready per frame, not a pipeline stall."""
+        with tracer.span("step"):
+            jax.block_until_ready(handle)
+        t_ready = time.perf_counter()
+        t_disp = rec.t_disp or rec.t0
+        return (t_ready - t_disp) * 1e3, t_ready
+
     def _complete_work(self, rec: "_Pending"):
         """Worker-thread half: single-fetch downlink + unpack/assemble.
-        Returns (au, skipped_mbs, t_start, t_unpacked, t_done) — the
-        unpack/cavlc split feeds the stage attribution in FrameStats."""
+        Returns (au, skipped_mbs, t_start, t_unpacked, t_done, step_ms,
+        fetch_ms) — the unpack/cavlc and upload/step/fetch splits feed
+        the stage attribution in FrameStats."""
         if rec.kind == "pb":
             return self._complete_bits(rec)
         if rec.kind == "pd":
+            step_ms, t_ready = self._wait_step(rec, rec.pfx_slice_d)
             with tracer.span("fetch"):
                 fused = np.asarray(rec.pfx_slice_d)
+            fetch_ms = (time.perf_counter() - t_ready) * 1e3
             self.link_bytes.add("down_prefix", fused.nbytes)
             out = self._complete_sparse_p(fused, rec.prefix_d, rec.hdr_d,
                                           rec.buf_d, rec)
             self._update_pfx_hint()
-            return out
+            return (*out, step_ms, fetch_ms)
         hdr_words = self._hdr_words_i if rec.kind == "i" else self._hdr_words_p
         cap = CAP_ROWS
+        step_ms, t_ready = self._wait_step(rec, rec.prefix_d)
         prefix = np.asarray(rec.prefix_d)
+        fetch_ms = (time.perf_counter() - t_ready) * 1e3
         self.link_bytes.add("down_prefix", prefix.nbytes)
         header, data, n = split_prefix(prefix, hdr_words)
         if n > cap:  # rare: heavy frame spilled past the prefix
@@ -1804,12 +1858,14 @@ class TPUH264Encoder:
                 au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num,
                                        ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
                                        mmco_evict=rec.mmco_evict)
-        return au, skipped, t1, tu, time.perf_counter()
+        return au, skipped, t1, tu, time.perf_counter(), step_ms, fetch_ms
 
     def _complete_bits(self, rec: "_Pending"):
         """Device-entropy P frame: fetch [meta ++ bit words], splice the
         slice header, done — no coefficient unpack, no host CAVLC."""
+        step_ms, t_ready = self._wait_step(rec, rec.prefix_d)
         arr = np.asarray(rec.prefix_d)  # uint32: nbits, trailing, nskip, words...
+        fetch_ms = (time.perf_counter() - t_ready) * 1e3
         self.link_bytes.add("down_prefix", arr.nbytes)
         nbits, trailing, skipped = int(arr[0]), int(arr[1]), int(arr[2])
         if nbits > BITS_WORD_CAP * 32:
@@ -1823,7 +1879,8 @@ class TPUH264Encoder:
             au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num,
                                    ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
                                    mmco_evict=rec.mmco_evict)
-            return au, int(pfc.skip.sum()), t1, tu, time.perf_counter()
+            return (au, int(pfc.skip.sum()), t1, tu, time.perf_counter(),
+                    step_ms, fetch_ms)
         need = (nbits + 31) // 32
         words = arr[3 : 3 + min(need, BITS_PREFIX_WORDS)]
         if need > BITS_PREFIX_WORDS:  # spill: one extra fetch
@@ -1834,7 +1891,7 @@ class TPUH264Encoder:
         au = assemble_p_nal(words, nbits, trailing, self.params, rec.frame_num,
                             rec.qp, ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
                             mmco_evict=rec.mmco_evict)
-        return au, skipped, t1, t1, time.perf_counter()
+        return au, skipped, t1, t1, time.perf_counter(), step_ms, fetch_ms
 
     def encode_frame(self, frame: np.ndarray, qp: int | None = None) -> bytes:
         """Synchronous encode ((H, W, 4) BGRx or (H, W, 3) RGB uint8 in,

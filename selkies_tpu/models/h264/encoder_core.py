@@ -444,10 +444,24 @@ def coarse_vote_candidates_jnp(cur, ref):
     return jnp.asarray(cands[:n_real])[top_idx]  # (TOPK, 2) — tiny gather
 
 
-def _refine_cands_jnp(coarse):
+def _refine_cands_jnp(coarse, dy_max: int | None = None):
     """(TOPK, 2) coarse -> (1 + TOPK*(2R+1)^2, 2) full-res shift list,
-    zero MV first (mirrors numpy_ref.refine_candidate_list)."""
+    zero MV first (mirrors numpy_ref.refine_candidate_list).
+
+    dy_max (static) clamps the VERTICAL component of every refined
+    candidate to |dy| <= dy_max — the band-sliced step's candidate
+    window (parallel/bands.py): a band's chip holds only its reference
+    rows plus a `halo`, so when halo is below the full hierarchical
+    reach the coarse votes are clamped such that no refined candidate
+    can select prediction rows the slab doesn't really hold (predicting
+    from replicated slab-edge rows would diverge from the decoder's MC,
+    which reads the true full-frame reference). The clamp is applied to
+    the coarse displacement, so the refine grid stays the golden ±R
+    raster and candidate ORDER (rank tie-breaks) is preserved."""
     side = 2 * REFINE_R + 1
+    if dy_max is not None:
+        cmax = max(0, (int(dy_max) - REFINE_R) // COARSE_DS)
+        coarse = coarse.at[:, 1].set(jnp.clip(coarse[:, 1], -cmax, cmax))
     d = jnp.stack(
         jnp.meshgrid(
             jnp.arange(-REFINE_R, REFINE_R + 1),
@@ -461,7 +475,7 @@ def _refine_cands_jnp(coarse):
     return jnp.concatenate([jnp.zeros((1, 2), jnp.int32), cands.astype(jnp.int32)])
 
 
-def hier_me_mc(cur, ref_y, ry_pad, ru_pad, rv_pad):
+def hier_me_mc(cur, ref_y, ry_pad, ru_pad, rv_pad, dy_max: int | None = None):
     """Global-candidate ME fused with motion compensation — gather-free.
 
     Two scans over 1+TOPK*(2R+1)^2 global shifts. The COST scan carries
@@ -481,7 +495,7 @@ def hier_me_mc(cur, ref_y, ry_pad, ru_pad, rv_pad):
     h, w = cur.shape
     mbh, mbw = h // 16, w // 16
     ch, cw = h // 2, w // 2
-    cands = _refine_cands_jnp(coarse_vote_candidates_jnp(cur, ref_y))
+    cands = _refine_cands_jnp(coarse_vote_candidates_jnp(cur, ref_y), dy_max)
     ncand = cands.shape[0]
     ranks = jnp.arange(ncand, dtype=jnp.int32)
     scale = 1 << int(np.int64(ncand - 1)).bit_length()
@@ -664,12 +678,75 @@ def encode_frame_p_planes(y, u, v, ref_y, ref_u, ref_v, qp, search: int = 8, me:
     u = u.astype(jnp.int32)
     v = v.astype(jnp.int32)
     qp = jnp.asarray(qp, jnp.int32)
-    qp_c = _CHROMA_QP[qp]
 
     ry = jnp.pad(ref_y, MV_PAD, mode="edge")
     ru = jnp.pad(ref_u, MV_PAD, mode="edge")
     rv = jnp.pad(ref_v, MV_PAD, mode="edge")
+    mvs, pred_y, pred_u, pred_v = _me_mc_dispatch(
+        y, ref_y, ry, ru, rv, search=search, me=me)
+    return _p_transform_tail(y, u, v, qp, mvs, pred_y, pred_u, pred_v)
 
+
+def encode_band_p_planes(y, u, v, slab_y, slab_u, slab_v, qp, halo: int,
+                         search: int = 8, me: str = "hier"):
+    """Band-sliced P encode: one horizontal band of the frame against a
+    halo-extended reference SLAB — the device half of the band-parallel
+    slice step (parallel/bands.py).
+
+    y/u/v are the band's source rows (16·band_mbh luma rows). slab_y
+    carries the band's reference rows plus `halo` REAL reference rows
+    above and below (slab_u/slab_v: halo//2 chroma rows each side); at
+    picture edges the halo rows are edge-replicated, which matches both
+    jnp.pad(mode="edge") on the full frame and the decoder's
+    picture-boundary clamp (8.4.2.2.1). The slab is padded out to the
+    full MV_PAD reach with edge replication, and when `halo` is below
+    the hierarchical search's vertical reach the candidate list is
+    band-clamped (dy_max = halo - 2, see _refine_cands_jnp) so every
+    SELECTED prediction row is real reference content — exactly what
+    the decoder's MC will read from the full decoded reference. That is
+    the whole correctness story of the band split: each band's slice
+    depends only on data resident on its chip, yet reconstructs
+    identically on any conformant decoder.
+
+    With halo=0 and a slab equal to the FULL reference this is
+    graph-identical to encode_frame_p_planes (the SELKIES_BANDS=1
+    byte-identity contract) — halo=0 is ONLY valid in that full-slab
+    case. For a genuine band slab halo must be >= REFINE_R + 2: the
+    refine grid always emits dy = ±REFINE_R around every (clamped)
+    coarse candidate and the chroma bilinear reads one row past dy>>1,
+    so a smaller halo could select predictions from replicated slab
+    edges the decoder's full-frame reference does not contain. halo
+    must be even and <= MV_PAD."""
+    if halo % 2 or not 0 <= halo <= MV_PAD or 0 < halo < REFINE_R + 2:
+        raise ValueError(
+            f"halo {halo} must be even and 0 (full-reference slab) or in "
+            f"[{REFINE_R + 2}, {MV_PAD}]")
+    y = y.astype(jnp.int32)
+    u = u.astype(jnp.int32)
+    v = v.astype(jnp.int32)
+    qp = jnp.asarray(qp, jnp.int32)
+    halo_c = halo // 2
+    vt, vtc = MV_PAD - halo, MV_PAD - halo_c
+    ry = jnp.pad(slab_y, ((vt, vt), (MV_PAD, MV_PAD)), mode="edge")
+    ru = jnp.pad(slab_u, ((vtc, vtc), (MV_PAD, MV_PAD)), mode="edge")
+    rv = jnp.pad(slab_v, ((vtc, vtc), (MV_PAD, MV_PAD)), mode="edge")
+    # band-local reference rows (coarse candidate voting sees the band)
+    ref_y = slab_y[halo : slab_y.shape[0] - halo] if halo else slab_y
+    # full reach is COARSE_DS*COARSE_R + REFINE_R = 34 luma rows; the
+    # chroma bilinear additionally reads one row past dy>>1, so a halo
+    # of 36+ already covers every candidate and no clamp is applied —
+    # and neither is halo=0, where the slab IS the full reference
+    unclamped = halo == 0 or halo >= COARSE_DS * COARSE_R + REFINE_R + 2
+    dy_max = None if unclamped else halo - 2
+    mvs, pred_y, pred_u, pred_v = _me_mc_dispatch(
+        y, ref_y, ry, ru, rv, search=search, me=me, dy_max=dy_max)
+    return _p_transform_tail(y, u, v, qp, mvs, pred_y, pred_u, pred_v)
+
+
+def _me_mc_dispatch(y, ref_y, ry, ru, rv, *, search: int, me: str,
+                    dy_max: int | None = None):
+    """ME + MC over MV_PAD-padded reference planes (shared by the
+    full-frame and band-sliced steps)."""
     if me == "hier":
         # fused gather-free ME+MC: predictions fall out of the same
         # candidate scan that picks the MVs. On TPU the Pallas kernel
@@ -679,15 +756,19 @@ def encode_frame_p_planes(y, u, v, ref_y, ref_u, ref_v, qp, search: int = 8, me:
         if _use_pallas_me(y.shape[1]):
             from selkies_tpu.models.h264.pallas_me import hier_me_mc_pallas
 
-            mvs, pred_y, pred_u, pred_v = hier_me_mc_pallas(y, ref_y, ry, ru, rv)
-        else:
-            mvs, pred_y, pred_u, pred_v = hier_me_mc(y, ref_y, ry, ru, rv)
-    else:
-        mvs = motion_search(y, ry, search)
-        pred_y = mc_luma(ry, mvs)
-        pred_u = mc_chroma(ru, mvs)
-        pred_v = mc_chroma(rv, mvs)
+            return hier_me_mc_pallas(y, ref_y, ry, ru, rv, dy_max=dy_max)
+        return hier_me_mc(y, ref_y, ry, ru, rv, dy_max)
+    if dy_max is not None:
+        raise ValueError("band-clamped candidate windows require me='hier'")
+    mvs = motion_search(y, ry, search)
+    return mvs, mc_luma(ry, mvs), mc_chroma(ru, mvs), mc_chroma(rv, mvs)
 
+
+def _p_transform_tail(y, u, v, qp, mvs, pred_y, pred_u, pred_v):
+    """Transform + quant + recon + skip derivation — everything after
+    ME/MC, shared bit-exactly by encode_frame_p_planes and
+    encode_band_p_planes."""
+    qp_c = _CHROMA_QP[qp]
     # Luma: plain 4x4 transform, all 16 coeffs (no DC Hadamard in inter MBs)
     yb = _plane_to_mb_blocks(y - pred_y, 4)
     wy = fdct4(yb)

@@ -220,14 +220,20 @@ def pack_slice_native(
     frame_num: int = 0,
     idr: bool = True,
     idr_pic_id: int = 0,
+    first_mb: int = 0,
 ) -> bytes:
+    # first_mb rides entirely in the pre-built header bytes: the C packer
+    # walks whatever (mbh, mbw) grid it is handed as ONE slice, which is
+    # exactly the band-slice contract (neighbour context resets at the
+    # grid's first row) — no native-code change needed for multi-slice.
     lib = _load()
     if lib is None:
         raise RuntimeError("libcavlc.so unavailable")
     mbh, mbw = fc.luma_mode.shape
 
     hdr = BitWriter()
-    write_slice_header(hdr, p, SLICE_I, frame_num, idr=idr, idr_pic_id=idr_pic_id, slice_qp=fc.qp)
+    write_slice_header(hdr, p, SLICE_I, frame_num, idr=idr, idr_pic_id=idr_pic_id,
+                       slice_qp=fc.qp, first_mb=first_mb)
     hdr_bytes, hdr_bits = hdr.get_partial()
 
     arrs = {
@@ -255,11 +261,14 @@ def pack_slice_native(
     return _finish_nal(s, n, NAL_SLICE_IDR if idr else NAL_SLICE_NON_IDR)
 
 
-def pack_slice_fast(fc, p, frame_num=0, idr=True, idr_pic_id=0) -> bytes:
+def pack_slice_fast(fc, p, frame_num=0, idr=True, idr_pic_id=0,
+                    first_mb=0) -> bytes:
     """Native packer when available, Python fallback otherwise."""
     if native_available():
-        return pack_slice_native(fc, p, frame_num=frame_num, idr=idr, idr_pic_id=idr_pic_id)
-    return pack_slice_py(fc, p, frame_num=frame_num, idr=idr, idr_pic_id=idr_pic_id)
+        return pack_slice_native(fc, p, frame_num=frame_num, idr=idr,
+                                 idr_pic_id=idr_pic_id, first_mb=first_mb)
+    return pack_slice_py(fc, p, frame_num=frame_num, idr=idr,
+                         idr_pic_id=idr_pic_id, first_mb=first_mb)
 
 
 def _finish_nal(s: dict, n: int, nal_type: int) -> bytes:
@@ -277,7 +286,8 @@ def _finish_nal(s: dict, n: int, nal_type: int) -> bytes:
 def pack_slice_p_native(fc: PFrameCoeffs, p: StreamParams, frame_num: int,
                         ltr_ref: int | None = None,
                         mark_ltr: int | None = None,
-                        mmco_evict: tuple = ()) -> bytes:
+                        mmco_evict: tuple = (),
+                        first_mb: int = 0) -> bytes:
     lib = _load()
     if lib is None:
         raise RuntimeError("libcavlc.so unavailable")
@@ -286,7 +296,7 @@ def pack_slice_p_native(fc: PFrameCoeffs, p: StreamParams, frame_num: int,
     hdr = BitWriter()
     write_slice_header(hdr, p, SLICE_P, frame_num, idr=False, slice_qp=fc.qp,
                        ltr_ref=ltr_ref, mark_ltr=mark_ltr,
-                       mmco_evict=mmco_evict)
+                       mmco_evict=mmco_evict, first_mb=first_mb)
     hdr_bytes, hdr_bits = hdr.get_partial()
 
     mvs = np.ascontiguousarray(fc.mvs, dtype=np.int16)
@@ -326,7 +336,8 @@ def sparse_native_available() -> bool:
 def pack_slice_p_sparse_native(wire, p: StreamParams, frame_num: int, qp: int,
                                ltr_ref: int | None = None,
                                mark_ltr: int | None = None,
-                               mmco_evict: tuple = ()) -> bytes:
+                               mmco_evict: tuple = (),
+                               first_mb: int = 0) -> bytes:
     """Entropy-code one P slice straight from the sparse downlink wire
     views (compact.SparsePWire) — no dense coefficient scatter, no int16
     re-copy, no PFrameCoeffs. Byte-identical to cavlc.pack_slice_p fed
@@ -340,7 +351,7 @@ def pack_slice_p_sparse_native(wire, p: StreamParams, frame_num: int, qp: int,
     hdr = BitWriter()
     write_slice_header(hdr, p, SLICE_P, frame_num, idr=False, slice_qp=qp,
                        ltr_ref=ltr_ref, mark_ltr=mark_ltr,
-                       mmco_evict=mmco_evict)
+                       mmco_evict=mmco_evict, first_mb=first_mb)
     hdr_bytes, hdr_bits = hdr.get_partial()
 
     # sized for typical sparse content; pathological levels retry bigger.
@@ -375,10 +386,13 @@ def pack_slice_p_sparse_native(wire, p: StreamParams, frame_num: int, qp: int,
 def pack_slice_p_fast(fc: PFrameCoeffs, p: StreamParams, frame_num: int,
                       ltr_ref: int | None = None,
                       mark_ltr: int | None = None,
-                      mmco_evict: tuple = ()) -> bytes:
+                      mmco_evict: tuple = (),
+                      first_mb: int = 0) -> bytes:
     """Native P-slice packer when available, Python fallback otherwise."""
     if native_available():
         return pack_slice_p_native(fc, p, frame_num, ltr_ref=ltr_ref,
-                                   mark_ltr=mark_ltr, mmco_evict=mmco_evict)
+                                   mark_ltr=mark_ltr, mmco_evict=mmco_evict,
+                                   first_mb=first_mb)
     return pack_slice_p_py(fc, p, frame_num, ltr_ref=ltr_ref,
-                           mark_ltr=mark_ltr, mmco_evict=mmco_evict)
+                           mark_ltr=mark_ltr, mmco_evict=mmco_evict,
+                           first_mb=first_mb)

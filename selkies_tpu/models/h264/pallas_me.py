@@ -240,15 +240,25 @@ def _me_mc_call(cands, cur, ry_pad, ru_pad, rv_pad, interpret=False):
     return mvs, predy, predu, predv
 
 
-def hier_me_mc_pallas(cur, ref_y, ry_pad, ru_pad, rv_pad, *, interpret=None):
+def hier_me_mc_pallas(cur, ref_y, ry_pad, ru_pad, rv_pad, *, interpret=None,
+                      dy_max=None):
     """Drop-in replacement for encoder_core.hier_me_mc (same signature,
     bit-identical outputs). Coarse candidate voting stays in XLA (tiny);
-    the refine+MC walk runs in the fused kernel."""
+    the refine+MC walk runs in the fused kernel.
+
+    dy_max (static int) band-clamps the candidate window for the
+    band-sliced step (encoder_core.encode_band_p_planes): with a clamped
+    vertical reach every row each program DMAs from the `ry_pad` window
+    into VMEM is real reference content from the band's halo slab, so a
+    band's kernel never depends on rows resident on another chip. The
+    kernel body is unchanged — the clamp lands in the candidate list,
+    keeping the rank/tie-break order bit-identical to hier_me_mc."""
     from selkies_tpu.models.h264 import encoder_core as core
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    cands = core._refine_cands_jnp(core.coarse_vote_candidates_jnp(cur, ref_y))
+    cands = core._refine_cands_jnp(
+        core.coarse_vote_candidates_jnp(cur, ref_y), dy_max)
     # pad to a multiple of the kernel's candidate group with zero-MV
     # duplicates: same SAD as the rank-0 zero MV but a later rank, so a
     # padded slot can never win (cost = sad*scale + rank is all-distinct)

@@ -104,6 +104,37 @@ def _tpuh264enc(*, width: int, height: int, fps: int = 60, qp: int = 28, **kw):
     # the TPU row is QP-driven (the app's CbrRateController owns the
     # rate loop via set_qp); the library rows consume bitrate_kbps
     kw.pop("bitrate_kbps", None)
+    bands = kw.pop("bands", None)
+    if bands is None:
+        from selkies_tpu.parallel.bands import bands_from_env
+
+        bands = bands_from_env()
+    if bands > 1:
+        # SELKIES_BANDS>1: the frame band-splits across the chip mesh as
+        # independent slices (parallel/bands.py) — the 4K / full-motion
+        # path where the FIFO-serialized device step is the bottleneck.
+        # Falls back to the single-device band-sliced encode (identical
+        # bytes) when the mesh is smaller than the band count. Routed
+        # BEFORE the solo-knob setdefaults so `dropped` sees only what
+        # the caller actually passed.
+        from selkies_tpu.parallel.bands import BandedH264Encoder
+
+        dropped = set(kw) - {"frame_batch", "pipeline_depth",
+                             "keyframe_interval"}
+        if dropped:
+            # the solo encoder's uplink machinery (tile cache, delta
+            # paths, LTR scenes, scene QP boost) does not apply to band
+            # mode — say so instead of silently ignoring an explicitly-
+            # passed knob
+            logger.warning(
+                "band-parallel encoder ignores encoder kwargs %s "
+                "(solo-encoder knobs; see docs/bands.md)", sorted(dropped))
+        return BandedH264Encoder(
+            width=width, height=height, qp=qp, fps=fps, bands=bands,
+            frame_batch=kw.get("frame_batch", default_frame_batch()),
+            pipeline_depth=kw.get("pipeline_depth", default_pipeline_depth()),
+            keyframe_interval=kw.get("keyframe_interval", 0),
+        )
     kw.setdefault("frame_batch", default_frame_batch())
     kw.setdefault("pipeline_depth", default_pipeline_depth())
     kw.setdefault("scene_qp_boost", 6)

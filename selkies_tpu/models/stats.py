@@ -50,3 +50,14 @@ class FrameStats:
     # pack + NAL assembly itself
     unpack_ms: float = 0.0
     cavlc_ms: float = 0.0
+    # device-stage sub-split (device_ms ≈ upload_ms + step_ms + fetch_ms
+    # plus queueing; rows without the attribution leave them 0):
+    # upload_ms is host time enqueuing the h2d transfers, step_ms is
+    # dispatch -> device outputs ready, fetch_ms the d2h transfer itself
+    upload_ms: float = 0.0
+    step_ms: float = 0.0
+    fetch_ms: float = 0.0
+    # intra-frame band parallelism (parallel/bands.py): slice count and
+    # per-band dispatch->ready latency when the frame was band-split
+    bands: int = 1
+    band_step_ms: tuple = ()

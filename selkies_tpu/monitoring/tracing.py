@@ -33,7 +33,12 @@ black-box bundles stay greppable):
     encode        synchronous encode_frame path (non-pipelined rows)
     send          sink callback (transport handoff) per access unit
     frame-drop    instant: capture tick skipped (transport backpressure)
-  encoder completion workers (models/h264/encoder.py):
+  encoder completion workers (models/h264/encoder.py, parallel/bands.py):
+    step          dispatch → device outputs ready (block_until_ready on
+                  the frame's — or one BAND's — downlink buffer; with
+                  the band-parallel encoder one span per band, so the
+                  per-chip step latency is visible per slice); the
+                  matching selkies_stage_ms stage is "step"
     fetch         device→host coefficient/word downlink
     unpack        downlink bytes → packer-ready coefficients (sparse
                   wire views / dense expansion, shortfall + spill +
@@ -42,6 +47,11 @@ black-box bundles stay greppable):
                   sparse-native packer when libcavlc exports it, the
                   Python dense oracle otherwise); the matching
                   selkies_stage_ms stages are "unpack" and "cavlc"
+    band_gather   band-parallel encoder only (parallel/bands.py): the
+                  whole per-band fan-out — N per-chip fetches +
+                  unpack/pack overlapped on the pack pool — until the
+                  multi-slice access unit is assembled in band order;
+                  selkies_stage_ms stage "band_gather"
   fleet service (parallel/serving.py):
     convert       per-session BGRx→I420 on the pack pool
     device-step   sharded batch encode dispatch

@@ -2,6 +2,10 @@
 
 The reference scales out with one process per session plus K8s fleet
 discovery (SURVEY.md §2.6). Here, 8x 1080p60 sessions map onto a v5e-8 slice
-as a jax.sharding.Mesh with one stream per chip; 4K frames can band-split
-across chips as independent slices.
+as a jax.sharding.Mesh with one stream per chip (sessions.py / serving.py);
+4K frames band-split across chips as independent H.264 slices (bands.py:
+a shard_map over a ``band`` mesh axis with ppermute halo exchange, one
+slice NAL per chip, assembled into a multi-slice access unit in band
+order). The two axes trade off against each other — partition_devices
+carves a slice into sessions x bands rows (serving.BandedFleetService).
 """

@@ -1,0 +1,708 @@
+"""Intra-frame band parallelism: one frame sharded across the chip mesh
+as independent H.264 slices.
+
+The FIFO-serialized device step is the last per-frame term on a
+PCIe-local host (~10-14 ms/frame at 1080p, PERF.md round-7), capping a
+single chip at ~50-60 fps and putting 4K@60 out of reach. The classic
+encoder answer is slice parallelism (x264's sliced-threads; AV1/VP9 tile
+columns) — and H.264 multi-slice pictures are first-class syntax our
+slice headers already parameterize (`first_mb_in_slice`). This module
+splits each frame into `SELKIES_BANDS` horizontal macroblock-row bands
+and encodes each band as an INDEPENDENT slice on its own chip:
+
+  * device half — a `shard_map` over a ``band`` mesh axis runs
+    encoder_core.encode_band_p_planes per chip; each band's motion
+    estimation is constrained to its own reference rows plus a ``halo``
+    of neighbour rows exchanged on-mesh with ``jax.lax.ppermute``, so a
+    band's slice depends ONLY on data resident on its chip (and the
+    selected predictions are always real reference content, matching
+    the decoder's full-frame MC exactly — see encode_band_p_planes);
+  * link half — each band emits its own variable-packed sparse downlink
+    (encoder_core.pack_p_sparse_var), landing as N smaller fetches that
+    overlap on the link;
+  * host half — per-band unpack + CAVLC pack fan out across the
+    h264-pack pool (sized min(cores, bands × frame_batch ×
+    pipeline_depth)); the host concatenates the N slice NALs into one
+    access unit in band order.
+
+Correctness contract: each band's slice is byte-identical to a
+single-chip encode of the same band with the same ME constraint (the
+per-band oracle — the mesh and fallback paths run the same per-band
+graph), and ``SELKIES_BANDS=1`` reproduces the solo encoder's
+single-slice bytes exactly (tests/test_band_slices.py).
+
+Placement composes with the ``session`` axis: a v5e-8 can serve
+8 sessions × 1 band (parallel/sessions.py), 2 sessions × 4 bands, or
+1 session × 8 bands — ``partition_devices`` carves the chip list into
+per-session band rows for the fleet (serving.BandedFleetService).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from selkies_tpu.models.h264.bitstream import StreamParams, write_pps, write_sps
+from selkies_tpu.models.h264.compact import (
+    i_header_words,
+    p_sparse_var_need,
+    p_sparse_var_words,
+    p_sparse_wire_views,
+    split_prefix,
+    unpack_i_compact,
+    unpack_p_sparse_var,
+)
+from selkies_tpu.models.h264.encoder_core import (
+    encode_band_p_planes,
+    encode_frame_planes,
+    fuse_downlink,
+    pack_i_compact,
+    pack_p_sparse_var,
+)
+from selkies_tpu.models.h264.native import (
+    pack_slice_fast,
+    pack_slice_p_fast,
+    pack_slice_p_sparse_native,
+    sparse_native_available,
+)
+from selkies_tpu.models.h264.numpy_ref import MV_PAD, PFrameCoeffs
+from selkies_tpu.models.stats import FrameStats, LinkByteCounter
+from selkies_tpu.monitoring.telemetry import telemetry
+from selkies_tpu.monitoring.tracing import tracer
+from selkies_tpu.parallel.sessions import _CHECK_KW, _shard_map
+
+logger = logging.getLogger("parallel.bands")
+
+__all__ = [
+    "BAND_HALO",
+    "BandedH264Encoder",
+    "band_mesh",
+    "band_spans",
+    "bands_from_env",
+    "halo_from_env",
+    "partition_devices",
+    "usable_bands",
+]
+
+# Default halo: the full hierarchical-ME reach (34 luma rows) plus the
+# chroma bilinear's one-row lookahead rounds up to MV_PAD, so every
+# candidate the search can select reads REAL reference rows from the
+# slab and no candidate clamping is needed. Smaller halos (see
+# SELKIES_BAND_HALO) trade neighbour-row exchange bytes for a clamped
+# vertical search window (encode_band_p_planes dy_max).
+BAND_HALO = MV_PAD
+# A band must be tall enough that its neighbour's halo comes from THIS
+# band alone (ppermute exchanges adjacent bands only): 16·3 = 48 luma /
+# 24 chroma rows covers the 40/20-row default halo.
+MIN_BAND_MB_ROWS = 3
+
+
+def bands_from_env() -> int:
+    env = os.environ.get("SELKIES_BANDS", "")
+    if not env:
+        return 1
+    try:
+        return max(1, int(env))
+    except ValueError:
+        logger.warning("SELKIES_BANDS=%r is not an integer; using 1", env)
+        return 1
+
+
+def halo_from_env() -> int:
+    env = os.environ.get("SELKIES_BAND_HALO", "")
+    if not env:
+        return BAND_HALO
+    try:
+        halo = int(env)
+    except ValueError:
+        logger.warning("SELKIES_BAND_HALO=%r is not an integer; using %d",
+                       env, BAND_HALO)
+        return BAND_HALO
+    halo = max(4, min(BAND_HALO, halo))
+    return halo - halo % 2  # even: chroma slabs carry halo//2 rows
+
+
+def usable_bands(mb_height: int, requested: int) -> int:
+    """Largest band count <= `requested` that splits `mb_height` MB rows
+    into EQUAL bands of at least MIN_BAND_MB_ROWS (equal shards are what
+    shard_map places; unequal tails would force padded encodes)."""
+    requested = max(1, int(requested))
+    for bands in range(min(requested, mb_height // MIN_BAND_MB_ROWS), 1, -1):
+        if mb_height % bands == 0:
+            return bands
+    return 1
+
+
+def band_spans(mb_height: int, bands: int) -> list[tuple[int, int]]:
+    """(first_mb_row, mb_rows) per band, top to bottom (equal split)."""
+    if mb_height % bands:
+        raise ValueError(f"{bands} bands do not divide {mb_height} MB rows")
+    rows = mb_height // bands
+    return [(b * rows, rows) for b in range(bands)]
+
+
+def band_mesh(bands: int, devices=None) -> Mesh:
+    """One-axis ``band`` mesh over the first `bands` devices."""
+    devs = np.array(devices if devices is not None else jax.devices())
+    if len(devs) < bands:
+        raise ValueError(f"need {bands} devices for the band mesh, have {len(devs)}")
+    return Mesh(devs[:bands], axis_names=("band",))
+
+
+def partition_devices(n_sessions: int, bands: int, devices=None) -> list[list]:
+    """Carve the chip list into per-session band rows — the fleet's
+    chips-per-session vs sessions-per-slice trade. Returns n_sessions
+    rows of `bands` devices; raises when the slice is too small (the
+    caller decides whether to drop bands or sessions)."""
+    devs = list(devices if devices is not None else jax.devices())
+    need = n_sessions * bands
+    if len(devs) < need:
+        raise ValueError(
+            f"{n_sessions} sessions x {bands} bands needs {need} devices, "
+            f"have {len(devs)}")
+    return [devs[k * bands : (k + 1) * bands] for k in range(n_sessions)]
+
+
+# ---------------------------------------------------------------------------
+# Device steps
+# ---------------------------------------------------------------------------
+#
+# Per-band body shared by BOTH execution modes: the mesh path runs it
+# once per chip inside shard_map, the fallback path runs it per band
+# inside one single-device jit (a Python loop over a static band count,
+# NOT a vmap — identical per-band graphs are what makes the per-band
+# oracle a byte-identity statement rather than an approximation).
+
+
+def _band_i_body(y, u, v, qp, cap_rows: int):
+    out = encode_frame_planes(y, u, v, qp)
+    header, buf = pack_i_compact(out)
+    prefix = fuse_downlink(header, buf, cap_rows)
+    return prefix, buf, out["recon_y"], out["recon_u"], out["recon_v"]
+
+
+def _band_p_body(y, u, v, qp, slab_y, slab_u, slab_v, *, halo: int,
+                 nscap: int, cap_rows: int):
+    out = encode_band_p_planes(y, u, v, slab_y, slab_u, slab_v, qp, halo=halo)
+    # nscap == the band's MB count, so the ns > nscap dense fallback is
+    # structurally unreachable — every band completes from its fused
+    # buffer (+ the rare row spill from `buf`)
+    fused, _dense, buf = pack_p_sparse_var(out, nscap, cap_rows)
+    return fused, buf, out["recon_y"], out["recon_u"], out["recon_v"]
+
+
+def _slab_indices(bands: int, rows: int, halo: int) -> np.ndarray:
+    """(bands, rows + 2*halo) row gather indices into the stacked
+    (bands*rows) plane, clipped at the picture edges (clip == the
+    decoder's boundary replication == jnp.pad mode='edge')."""
+    base = rows * np.arange(bands)[:, None]
+    span = np.arange(-halo, rows + halo)[None, :]
+    return np.clip(base + span, 0, bands * rows - 1)
+
+
+def _stacked_slabs(ref, halo: int):
+    """Fallback-mode slab build: (B, rows, W) stacked ref -> halo-extended
+    (B, rows + 2*halo, W) slabs via one static gather."""
+    b, rows, w = ref.shape
+    idx = jnp.asarray(_slab_indices(b, rows, halo))
+    return ref.reshape(b * rows, w)[idx]
+
+
+def _ppermute_slab(r0, halo: int, bands: int, axis: str):
+    """Mesh-mode slab build: exchange `halo` boundary rows with the
+    adjacent bands over the mesh (band 0 / band B-1 edge-replicate,
+    matching the fallback clip and the decoder's picture clamp)."""
+    if halo == 0 or bands == 1:
+        return r0
+    w = r0.shape[1]
+    from_above = jax.lax.ppermute(
+        r0[-halo:], axis, [(b, b + 1) for b in range(bands - 1)])
+    from_below = jax.lax.ppermute(
+        r0[:halo], axis, [(b + 1, b) for b in range(bands - 1)])
+    i = jax.lax.axis_index(axis)
+    top = jnp.where(i == 0, jnp.broadcast_to(r0[:1], (halo, w)), from_above)
+    bot = jnp.where(i == bands - 1, jnp.broadcast_to(r0[-1:], (halo, w)), from_below)
+    return jnp.concatenate([top, r0, bot], axis=0)
+
+
+def _stacked_i_step(ys, us, vs, qp, *, bands: int, cap_rows: int):
+    outs = [_band_i_body(ys[b], us[b], vs[b], qp, cap_rows) for b in range(bands)]
+    return tuple(jnp.stack([o[k] for o in outs]) for k in range(5))
+
+
+def _stacked_p_step(ys, us, vs, qp, rys, rus, rvs, *, bands: int, halo: int,
+                    nscap: int, cap_rows: int):
+    sy = _stacked_slabs(rys, halo)
+    su = _stacked_slabs(rus, halo // 2)
+    sv = _stacked_slabs(rvs, halo // 2)
+    outs = [
+        _band_p_body(ys[b], us[b], vs[b], qp, sy[b], su[b], sv[b],
+                     halo=halo, nscap=nscap, cap_rows=cap_rows)
+        for b in range(bands)
+    ]
+    return tuple(jnp.stack([o[k] for o in outs]) for k in range(5))
+
+
+def _mesh_i_body(y, u, v, qp, *, cap_rows: int):
+    outs = _band_i_body(y[0], u[0], v[0], qp, cap_rows)
+    return tuple(o[None] for o in outs)
+
+
+def _mesh_p_body(y, u, v, qp, ry, ru, rv, *, bands: int, halo: int,
+                 nscap: int, cap_rows: int):
+    sy = _ppermute_slab(ry[0], halo, bands, "band")
+    su = _ppermute_slab(ru[0], halo // 2, bands, "band")
+    sv = _ppermute_slab(rv[0], halo // 2, bands, "band")
+    outs = _band_p_body(y[0], u[0], v[0], qp, sy, su, sv,
+                        halo=halo, nscap=nscap, cap_rows=cap_rows)
+    return tuple(o[None] for o in outs)
+
+
+# row spill past the fused cap: the solo encoder's overflow fetch (same
+# bucketing discipline, one definition — drift between the two fetch
+# paths would mean different compiled fetch shapes for the same spill)
+from selkies_tpu.models.h264.encoder import _fetch_rest
+
+
+class BandedH264Encoder:
+    """Full-frame band-parallel H.264 encoder: frame in, multi-slice
+    Annex-B access unit out.
+
+    One IDR then P frames forever (keyframe_interval / force_keyframe as
+    in TPUH264Encoder); every picture is `bands` slices, one per chip
+    when a band mesh is available, falling back to a single-device
+    band-sliced encode (identical bytes, no parallelism) when the mesh
+    is smaller than the band count. This is the full-motion / 4K path —
+    the delta-upload and tile-cache machinery of the solo encoder is
+    intentionally absent (those frames are not device-step-bound); an
+    unchanged capture still short-circuits to host-built all-skip
+    slices.
+    """
+
+    codec = "h264"
+
+    def __init__(self, width: int, height: int, qp: int = 28, fps: int = 60,
+                 channels: int = 4, keyframe_interval: int = 0,
+                 bands: int | None = None, halo: int | None = None,
+                 devices=None, frame_batch: int = 1, pipeline_depth: int = 1,
+                 pack_workers: int | None = None):
+        if channels != 4:
+            raise ValueError("band-parallel encode expects BGRx capture (channels=4)")
+        self.width = width
+        self.height = height
+        self.fps = fps
+        self.set_qp(qp)
+        self.keyframe_interval = int(keyframe_interval)
+        self._pad_h = (height + 15) // 16 * 16
+        self._pad_w = (width + 15) // 16 * 16
+        self._mbh, self._mbw = self._pad_h // 16, self._pad_w // 16
+        requested = bands if bands is not None else bands_from_env()
+        self.bands = usable_bands(self._mbh, requested)
+        if self.bands != requested:
+            logger.info(
+                "%dx%d: %d bands requested, using %d (%d MB rows must split "
+                "into equal bands of >= %d rows)", width, height, requested,
+                self.bands, self._mbh, MIN_BAND_MB_ROWS)
+        halo = halo_from_env() if halo is None else int(halo)
+        # a real band slab (bands > 1) needs at least the refine grid's
+        # reach + the chroma bilinear lookahead in REAL rows — see
+        # encode_band_p_planes; below that, a single band's slab IS the
+        # full reference and halo collapses to the 0 identity case
+        self.halo = max(0, min(BAND_HALO, halo - halo % 2))
+        if self.halo < 4:
+            self.halo = 0 if self.bands == 1 else 4
+        if self.halo != halo:
+            logger.info("band halo %d adjusted to %d", halo, self.halo)
+        self.spans = band_spans(self._mbh, self.bands)
+        self._band_mbh = self._mbh // self.bands
+        self._band_h = 16 * self._band_mbh
+        m_band = self._band_mbh * self._mbw
+        # per-band downlink caps: nscap = the band's MB count makes the
+        # dense-header fallback unreachable; the row cap matches the solo
+        # encoder's per-frame prefix budget so bands=1 fetches the exact
+        # same shapes
+        self._nscap = m_band
+        self._cap_p = min(26 * m_band, 4096)
+        self._cap_i = min(27 * m_band, 4096)
+        self._hdr_words_i = i_header_words(self._band_mbh, self._mbw)
+        self._pfx_total = p_sparse_var_words(
+            self._band_mbh, self._mbw, self._nscap, self._cap_p)
+        # two fetch shapes only (compile discipline, encoder.py PFX_SMALL)
+        self._pfx_small = min(1 << 14, self._pfx_total)
+        self._pfx_hint = self._pfx_small
+        self._pfx_recent: list[int] = []
+        self._pfx_lock = threading.Lock()
+
+        devs = list(devices) if devices is not None else jax.devices()
+        self.mesh_enabled = self.bands > 1 and len(devs) >= self.bands
+        self.params = StreamParams(width=width, height=height, qp=self.qp, fps=fps)
+        self._headers = write_sps(self.params) + write_pps(self.params)
+        from selkies_tpu.models.frameprep import FramePrep
+
+        self._prep = FramePrep(width, height, self._pad_w, self._pad_h, nslots=2)
+        iconsts = dict(cap_rows=self._cap_i)
+        pconsts = dict(bands=self.bands, halo=self.halo, nscap=self._nscap,
+                       cap_rows=self._cap_p)
+        if self.mesh_enabled:
+            self.mesh = band_mesh(self.bands, devs)
+            self._shard = NamedSharding(self.mesh, P("band"))
+            spec = P("band")
+            kw = {_CHECK_KW: False} if _CHECK_KW else {}
+            self._step_i = jax.jit(_shard_map(
+                partial(_mesh_i_body, **iconsts), mesh=self.mesh,
+                in_specs=(spec, spec, spec, P()), out_specs=spec, **kw))
+            self._step_p = jax.jit(
+                _shard_map(
+                    partial(_mesh_p_body, **pconsts), mesh=self.mesh,
+                    in_specs=(spec, spec, spec, P(), spec, spec, spec),
+                    out_specs=spec, **kw),
+                donate_argnums=(4, 5, 6))
+        else:
+            if self.bands > 1:
+                logger.info(
+                    "band mesh unavailable (%d devices < %d bands): running "
+                    "the band-sliced step on one device (identical bytes, "
+                    "no intra-frame parallelism)", len(devs), self.bands)
+            self.mesh = None
+            self._shard = None
+            # honor the assigned device (a fleet round-robins fallback
+            # sessions across chips); None = the process default
+            self._fallback_dev = devs[0] if devs else None
+            self._step_i = jax.jit(partial(_stacked_i_step, bands=self.bands,
+                                           **iconsts))
+            self._step_p = jax.jit(partial(_stacked_p_step, **pconsts),
+                                   donate_argnums=(4, 5, 6))
+        # per-band completion fan-out over the h264-pack pool, sized for
+        # every slice that can be in flight at once (the solo formula
+        # gains the bands factor — see encoder.py)
+        if pack_workers is None:
+            pack_workers = min(
+                os.cpu_count() or 4,
+                max(2, self.bands * max(1, frame_batch) * max(1, pipeline_depth)),
+            )
+        self._pack_pool = ThreadPoolExecutor(
+            max_workers=pack_workers, thread_name_prefix="h264-pack")
+        self.link_bytes = LinkByteCounter()
+        self._ref = None  # stacked (bands, band_h, W) recon triple
+        self._prev_frame: np.ndarray | None = None
+        self._allskip: PFrameCoeffs | None = None
+        self.frame_index = 0
+        self._frames_since_idr = 0
+        self._idr_pic_id = 0
+        self._force_idr = True
+        self.last_stats: FrameStats | None = None
+
+    # -- live retune API ------------------------------------------------
+
+    def set_qp(self, qp: int) -> None:
+        if not 0 <= qp <= 51:
+            raise ValueError(f"qp {qp} out of range")
+        self.qp = int(qp)
+
+    def force_keyframe(self) -> None:
+        self._force_idr = True
+
+    # -- device dispatch ------------------------------------------------
+
+    def _put_band_planes(self, y: np.ndarray, u: np.ndarray, v: np.ndarray):
+        """Stack converted planes on a leading band axis and upload —
+        sharded one band per chip on the mesh (each chip receives only
+        its own rows), plain on the fallback device."""
+        b, bh = self.bands, self._band_h
+        ys = np.asarray(y).reshape(b, bh, self._pad_w)
+        us = np.asarray(u).reshape(b, bh // 2, self._pad_w // 2)
+        vs = np.asarray(v).reshape(b, bh // 2, self._pad_w // 2)
+        self.link_bytes.add("up_full", ys.nbytes + us.nbytes + vs.nbytes)
+        dst = self._shard if self._shard is not None else self._fallback_dev
+        return (jax.device_put(ys, dst), jax.device_put(us, dst),
+                jax.device_put(vs, dst))
+
+    def _band_handles(self, arr):
+        """Per-band device handles of a stacked (bands, ...) output, in
+        band order. On the mesh these are the per-chip shards (so a
+        fetch pulls only from that band's chip); on the fallback device
+        they are row slices of the same array."""
+        if self._shard is None or self.bands == 1:
+            return [arr[b] for b in range(self.bands)]
+        handles = [None] * self.bands
+        for sh in arr.addressable_shards:
+            # drop the unit band axis on the owning chip (a view-level
+            # slice, enqueued behind the step like any other device op)
+            handles[sh.index[0].start] = sh.data[0]
+        if any(h is None for h in handles):  # non-addressable topology
+            return [arr[b] for b in range(self.bands)]
+        return handles
+
+    def _pfx_slice_len(self) -> int:
+        with self._pfx_lock:
+            return self._pfx_hint
+
+    def _note_need(self, need: int) -> None:
+        with self._pfx_lock:
+            self._pfx_recent.append(need)
+            del self._pfx_recent[:-8]
+            want = max([2048] + [n * 3 // 2 for n in self._pfx_recent])
+            self._pfx_hint = (
+                self._pfx_small if want <= self._pfx_small else self._pfx_total)
+
+    # -- host completion (per band, on the pack pool) -------------------
+
+    def _complete_band_i(self, band: int, pfx_d, buf_d, idr_pic_id: int):
+        jax.block_until_ready(pfx_d)  # keep fetch_ms a pure-transfer time
+        t0 = time.perf_counter()
+        with tracer.span("fetch"):
+            prefix = np.asarray(pfx_d)
+        t_f = time.perf_counter()
+        self.link_bytes.add("down_prefix", prefix.nbytes)
+        header, data, n = split_prefix(prefix, self._hdr_words_i)
+        if n > self._cap_i:
+            rest = _fetch_rest(buf_d, n, self._cap_i)
+            self.link_bytes.add("down_spill", rest.nbytes)
+            data = np.concatenate([data, rest])
+        with tracer.span("unpack"):
+            fc = unpack_i_compact(header, data, self.qp)
+        t_u = time.perf_counter()
+        with tracer.span("pack"):
+            nal = pack_slice_fast(
+                fc, self.params, frame_num=0, idr=True, idr_pic_id=idr_pic_id,
+                first_mb=self.spans[band][0] * self._mbw)
+        return nal, 0, t_f - t0, t_u - t_f, time.perf_counter() - t_u, t_f
+
+    def _complete_band_p(self, band: int, pfx_d, full_d, buf_d, frame_num: int,
+                         qp: int):
+        jax.block_until_ready(pfx_d)  # keep fetch_ms a pure-transfer time
+        t0 = time.perf_counter()
+        with tracer.span("fetch"):
+            fused = np.asarray(pfx_d)
+        t_f = time.perf_counter()
+        self.link_bytes.add("down_prefix", fused.nbytes)
+        need, n, ns = p_sparse_var_need(
+            fused, self._band_mbh, self._mbw, self._nscap, self._cap_p)
+        self._note_need(need)
+        if need > len(fused):  # hint too small: refetch the live content
+            fused = np.asarray(full_d)
+            self.link_bytes.add("down_refetch", fused.nbytes)
+        extra = None
+        if n > self._cap_p:
+            extra = _fetch_rest(buf_d, n, self._cap_p)
+            self.link_bytes.add("down_spill", extra.nbytes)
+        first_mb = self.spans[band][0] * self._mbw
+        with tracer.span("unpack"):
+            wire = pfc = None
+            if sparse_native_available():
+                wire = p_sparse_wire_views(
+                    fused, self._band_mbh, self._mbw, self._nscap, self._cap_p,
+                    packed=False, extra_rows=extra)
+            if wire is None:
+                pfc, _rows = unpack_p_sparse_var(
+                    fused, qp, self._band_mbh, self._mbw, self._nscap,
+                    self._cap_p, extra)
+        t_u = time.perf_counter()
+        with tracer.span("pack"):
+            if wire is not None:
+                nal = pack_slice_p_sparse_native(
+                    wire, self.params, frame_num, qp, first_mb=first_mb)
+                skipped = self._band_mbh * self._mbw - wire.ns
+            else:
+                nal = pack_slice_p_fast(pfc, self.params, frame_num=frame_num,
+                                        first_mb=first_mb)
+                skipped = int(pfc.skip.sum())
+        return nal, skipped, t_f - t0, t_u - t_f, time.perf_counter() - t_u, t_f
+
+    # -- static short-circuit -------------------------------------------
+
+    def _allskip_au(self, frame_num: int) -> bytes:
+        """Unchanged capture: every band becomes an all-skip P slice,
+        built host-side — no upload, no device step, no downlink (the
+        decoder's recon stays exactly the device reference)."""
+        if self._allskip is None:
+            bm, mw = self._band_mbh, self._mbw
+            self._allskip = PFrameCoeffs(
+                mvs=np.zeros((bm, mw, 2), np.int32),
+                skip=np.ones((bm, mw), bool),
+                luma_ac=np.zeros((bm, mw, 4, 4, 4, 4), np.int32),
+                chroma_dc=np.zeros((bm, mw, 2, 2, 2), np.int32),
+                chroma_ac=np.zeros((bm, mw, 2, 2, 2, 4, 4), np.int32),
+                qp=self.qp,
+            )
+        self._allskip.qp = self.qp
+        return b"".join(
+            pack_slice_p_fast(self._allskip, self.params, frame_num=frame_num,
+                              first_mb=mb0 * self._mbw)
+            for mb0, _ in self.spans
+        )
+
+    # -- encoding -------------------------------------------------------
+
+    def encode_frame(self, frame: np.ndarray, qp: int | None = None) -> bytes:
+        """Synchronous encode: (H, W, 4) BGRx uint8 in, complete multi-
+        slice Annex-B access unit out (SPS/PPS prepended on IDR)."""
+        if qp is not None:
+            self.set_qp(qp)
+        t0 = time.perf_counter()
+        idr = (
+            self._force_idr
+            or self._ref is None
+            or (self.keyframe_interval > 0
+                and self._frames_since_idr >= self.keyframe_interval)
+        )
+        static = (
+            not idr
+            and self._prev_frame is not None
+            and self._prev_frame.shape == frame.shape
+            # strided probe first: np.array_equal cannot short-circuit,
+            # so without it every full-motion frame would pay two whole-
+            # frame reads (~66 MB at 4K) just to learn it isn't static
+            and np.array_equal(self._prev_frame[::64, ::64], frame[::64, ::64])
+            and np.array_equal(self._prev_frame, frame)
+        )
+        if self._prev_frame is not None and self._prev_frame.shape == frame.shape:
+            np.copyto(self._prev_frame, frame)
+        else:
+            self._prev_frame = frame.copy()
+        if static:
+            au = self._allskip_au(self._frames_since_idr % 256)
+            self.last_stats = FrameStats(
+                frame_index=self.frame_index, idr=False, qp=self.qp,
+                bytes=len(au), device_ms=(time.perf_counter() - t0) * 1e3,
+                pack_ms=0.0, skipped_mbs=self._mbh * self._mbw,
+                bands=self.bands,
+            )
+            self.frame_index += 1
+            self._frames_since_idr += 1
+            return au
+        y, u, v = self._prep.convert(frame)
+        parts = self._put_band_planes(y, u, v)
+        t_up = time.perf_counter()
+        qp32 = np.int32(self.qp)
+        try:
+            if idr:
+                prefix_d, buf_d, ry, ru, rv = self._step_i(*parts, qp32)
+            else:
+                prefix_d, buf_d, ry, ru, rv = self._step_p(*parts, qp32, *self._ref)
+            self._ref = (ry, ru, rv)
+        except Exception:
+            # a failed/aborted step may have consumed the donated refs:
+            # null them so the next frame self-heals as an IDR
+            self._ref = None
+            self._prev_frame = None
+            raise
+        # hint-sized fused slices, dispatched from the submit thread
+        # right behind the step (a later slice op would queue behind
+        # other work); per-band handles so each fetch pulls one chip
+        if idr:
+            pfx = prefix_d
+        else:
+            hint = self._pfx_slice_len()
+            pfx = prefix_d[:, :hint] if hint < self._pfx_total else prefix_d
+        pfx_h = self._band_handles(pfx)
+        full_h = self._band_handles(prefix_d)
+        buf_h = self._band_handles(buf_d)
+        def _one(b: int):
+            if idr:
+                return self._complete_band_i(b, pfx_h[b], buf_h[b],
+                                             self._idr_pic_id)
+            return self._complete_band_p(b, pfx_h[b], full_h[b], buf_h[b],
+                                         self._frames_since_idr % 256, self.qp)
+
+        # per-band step timing: ready time of each band's downlink on its
+        # chip (the profile tool and bench read band_step_ms off stats).
+        # Measured on the MAIN thread, in band order, while completions
+        # run on the pack pool — a pool smaller than the band count would
+        # otherwise queue later bands behind earlier bands' host packs
+        # and report that host time as device step latency.
+        t_ready = [0.0] * self.bands
+        try:
+            with tracer.span("band_gather"):
+                futs = [self._pack_pool.submit(_one, b)
+                        for b in range(self.bands)]
+                for b in range(self.bands):
+                    with tracer.span("step"):
+                        jax.block_until_ready(pfx_h[b])
+                    t_ready[b] = time.perf_counter()
+                results = [f.result() for f in futs]
+        except Exception:
+            # a failed band fetch/pack means the client never receives
+            # this frame, but self._ref already advanced to its recon:
+            # null the chain so the next frame self-heals as a full IDR
+            # instead of silently desyncing the decoder
+            self._ref = None
+            self._prev_frame = None
+            raise
+        t_done = time.perf_counter()
+        nals = [r[0] for r in results]
+        au = (self._headers + b"".join(nals)) if idr else b"".join(nals)
+        skipped = sum(r[1] for r in results)
+        # wall-clock attribution matching the solo encoder's device_ms
+        # (dispatch -> downlink fetched): the overlapped per-band d2h
+        # transfers contribute their slowest tail, so fetch_ms is the
+        # max band fetch and device_ms runs to the LAST band's fetch
+        # end; unpack/cavlc stay per-band sums (host pool work)
+        fetch_ms = max(r[2] for r in results) * 1e3
+        t_fetched = max(r[5] for r in results)
+        unpack_ms = sum(r[3] for r in results) * 1e3
+        cavlc_ms = sum(r[4] for r in results) * 1e3
+        band_step = tuple(round((t - t_up) * 1e3, 3) for t in t_ready)
+        step_ms = (max(t_ready) - t_up) * 1e3
+        if telemetry.enabled:
+            telemetry.stage_ms("band_gather", (t_done - t_up) * 1e3)
+            for ms in band_step:
+                telemetry.stage_ms("step", ms)
+        stats = FrameStats(
+            frame_index=self.frame_index, idr=idr, qp=self.qp,
+            bytes=len(au), device_ms=(t_fetched - t0) * 1e3,
+            pack_ms=unpack_ms + cavlc_ms, skipped_mbs=skipped,
+            unpack_ms=unpack_ms, cavlc_ms=cavlc_ms,
+            # upload_ms spans the whole host dispatch (static probe,
+            # BGRx->I420 conversion, h2d enqueue) — the same boundary as
+            # the solo sync path, so a bands-vs-solo A/B attributes
+            # conversion time identically on both rows
+            upload_ms=(t_up - t0) * 1e3, step_ms=step_ms,
+            fetch_ms=fetch_ms, bands=self.bands, band_step_ms=band_step,
+        )
+        self.last_stats = stats
+        if idr:
+            self._frames_since_idr = 0
+            self._idr_pic_id = (self._idr_pic_id + 1) % 2
+            self._force_idr = False
+        self.frame_index += 1
+        self._frames_since_idr += 1
+        return au
+
+    def submit(self, frame: np.ndarray, qp: int | None = None, meta=None) -> list:
+        """Pipelined-API adapter (encoder.py submit/flush contract): the
+        band encoder overlaps WITHIN the frame (N chips + the pack pool)
+        rather than across frames, so submit completes synchronously and
+        returns its one (au, stats, meta) triple immediately. Lets
+        bench.py and the VideoPipeline drive either encoder unchanged."""
+        au = self.encode_frame(frame, qp)
+        return [(au, self.last_stats, meta)]
+
+    def flush(self) -> list:
+        return []  # synchronous encoder: nothing ever in flight
+
+    def prewarm(self) -> None:
+        """Compile the IDR and P executables before the live loop."""
+        rng = np.random.default_rng(0)
+        shape = (self.height, self.width, 4)
+        self.encode_frame(rng.integers(0, 255, shape, np.uint8))
+        self.encode_frame(rng.integers(0, 255, shape, np.uint8))
+        self._force_idr = True
+        self._ref = None
+        self._prev_frame = None
+        self.frame_index = 0
+        self._frames_since_idr = 0
+        self._idr_pic_id = 0
+
+    def close(self) -> None:
+        self._pack_pool.shutdown(wait=False, cancel_futures=True)

@@ -191,7 +191,9 @@ class SessionFleet:
     def __init__(self, slots: list[SessionSlot], *, width: int, height: int,
                  fps: int, qp: int = 28, sources=None, devices=None,
                  service=None, supervisor: SlotSupervisor | None = None):
-        from selkies_tpu.parallel.serving import MultiSessionH264Service
+        from selkies_tpu.parallel.bands import bands_from_env
+        from selkies_tpu.parallel.serving import (
+            BandedFleetService, MultiSessionH264Service)
 
         self.slots = slots
         self.n = len(slots)
@@ -199,8 +201,20 @@ class SessionFleet:
         self.base_fps = fps
         self.qp = qp
         self._devices = devices
-        self._make_tpu_service = lambda: MultiSessionH264Service(
-            self.n, width, height, qp=qp, fps=self.base_fps, devices=devices)
+        # chips-per-session trade (SELKIES_BANDS): 1 band keeps the
+        # classic one-session-per-chip lockstep shard; B>1 gives every
+        # session a B-chip band row for intra-frame slice parallelism
+        # (parallel/bands.py) — fewer sessions per slice, each faster
+        bands = bands_from_env()
+        if bands > 1:
+            logger.info("fleet: SELKIES_BANDS=%d — band-parallel per-session "
+                        "encoders (%d sessions)", bands, self.n)
+            self._make_tpu_service = lambda: BandedFleetService(
+                self.n, width, height, qp=qp, fps=self.base_fps,
+                bands=bands, devices=devices)
+        else:
+            self._make_tpu_service = lambda: MultiSessionH264Service(
+                self.n, width, height, qp=qp, fps=self.base_fps, devices=devices)
         self.service = service or self._make_tpu_service()
         self.software_mode = False
         self.sources = sources or [

@@ -27,7 +27,7 @@ from selkies_tpu.models.h264.numpy_ref import FrameCoeffs, PFrameCoeffs
 from selkies_tpu.monitoring.tracing import tracer
 from selkies_tpu.parallel.sessions import MultiSessionEncoder
 
-__all__ = ["MultiSessionH264Service", "SoftwareFleetService"]
+__all__ = ["BandedFleetService", "MultiSessionH264Service", "SoftwareFleetService"]
 
 
 class _SessionState:
@@ -165,6 +165,84 @@ class MultiSessionH264Service:
 
     def close(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)
+
+
+class BandedFleetService:
+    """Band-parallel fleet service: N sessions, each band-split across
+    its OWN row of chips (parallel/bands.py), behind the
+    MultiSessionH264Service interface.
+
+    This is the other end of the chips-per-session trade the session
+    mesh makes: MultiSessionH264Service maps one session per chip
+    (8 sessions on a v5e-8); with SELKIES_BANDS=B this service carves
+    the slice into N = chips // B rows and gives every session B-way
+    intra-frame parallelism instead — 2 sessions x 4 bands serves 4K
+    where one chip cannot. Sessions are fully independent (per-session
+    GOP, QP, multi-slice access units), so there is no lockstep device
+    tick to shard; the per-session encoders dispatch concurrently from
+    the service pool and each session's pack fan-out uses its encoder's
+    own band pool."""
+
+    def __init__(self, n_sessions: int, width: int, height: int, *,
+                 qp: int = 28, fps: int = 60, bands: int | None = None,
+                 devices=None):
+        from selkies_tpu.parallel.bands import (
+            BandedH264Encoder, bands_from_env, partition_devices)
+        from selkies_tpu.utils.jaxcache import enable_persistent_compilation_cache
+
+        enable_persistent_compilation_cache()
+        self.n = n_sessions
+        if bands is None:
+            bands = bands_from_env()
+        try:
+            rows = partition_devices(n_sessions, bands, devices)
+        except ValueError:
+            # slice too small for n x bands: every session falls back to
+            # a single-device band-sliced encode (identical bytes),
+            # round-robined across the chips that DO exist — passing the
+            # full device list through would instead build every
+            # session's band mesh over the same first `bands` chips
+            import jax
+
+            devs = list(devices if devices is not None else jax.devices())
+            rows = [[devs[k % len(devs)]] for k in range(n_sessions)]
+        self.encoders = [
+            BandedH264Encoder(width, height, qp=qp, fps=fps, bands=bands,
+                              devices=rows[k])
+            for k in range(n_sessions)
+        ]
+        self.bands = self.encoders[0].bands
+        self.last_idrs: list[bool] = [True] * n_sessions
+        self._pool = ThreadPoolExecutor(max_workers=n_sessions,
+                                        thread_name_prefix="band-fleet")
+
+    def set_qp(self, session: int, qp: int) -> None:
+        self.encoders[session].set_qp(qp)
+
+    def force_keyframe(self, session: int) -> None:
+        self.encoders[session].force_keyframe()
+
+    def encode_tick(self, frames: np.ndarray) -> list[bytes]:
+        if frames.shape[0] != self.n:
+            raise ValueError(f"expected {self.n} frames, got {frames.shape[0]}")
+
+        def _one(i: int) -> bytes:
+            return self.encoders[i].encode_frame(frames[i])
+
+        # span "encode" (the synchronous encode_frame vocabulary), NOT
+        # "device-step": this covers fetch + host unpack/pack too, and a
+        # trace reader triaging a wedged tick must not pin host CAVLC
+        # time on the TPU. The per-band step/fetch/pack spans inside
+        # each encoder carry the device-vs-host split.
+        with tracer.span("encode"):
+            aus = list(self._pool.map(_one, range(self.n)))
+        self.last_idrs = [bool(e.last_stats.idr) for e in self.encoders]
+        return aus
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)
+        for enc in self.encoders:
+            enc.close()
 
 
 class SoftwareFleetService:

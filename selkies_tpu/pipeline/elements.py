@@ -165,6 +165,12 @@ class EncodedFrame:
     # encoder rows that don't attribute it)
     unpack_ms: float = 0.0
     cavlc_ms: float = 0.0
+    # device-stage split (device_ms ≈ upload_ms + step_ms + fetch_ms) and
+    # band-parallel slice count (parallel/bands.py; 1 = single slice)
+    upload_ms: float = 0.0
+    step_ms: float = 0.0
+    fetch_ms: float = 0.0
+    bands: int = 1
     # telemetry correlation id assigned at capture (0 = telemetry off);
     # metadata only — never touches the encoded bytes
     frame_id: int = 0
@@ -347,6 +353,10 @@ class VideoPipeline:
                             scene_cut=getattr(stats, "scene_cut", False),
                             unpack_ms=getattr(stats, "unpack_ms", 0.0),
                             cavlc_ms=getattr(stats, "cavlc_ms", 0.0),
+                            upload_ms=getattr(stats, "upload_ms", 0.0),
+                            step_ms=getattr(stats, "step_ms", 0.0),
+                            fetch_ms=getattr(stats, "fetch_ms", 0.0),
+                            bands=getattr(stats, "bands", 1),
                             frame_id=self._fid_by_ts.pop(meta, 0),
                         )
                         for au, stats, meta in done
@@ -367,6 +377,10 @@ class VideoPipeline:
                             pack_ms=stats.pack_ms,
                             unpack_ms=getattr(stats, "unpack_ms", 0.0),
                             cavlc_ms=getattr(stats, "cavlc_ms", 0.0),
+                            upload_ms=getattr(stats, "upload_ms", 0.0),
+                            step_ms=getattr(stats, "step_ms", 0.0),
+                            fetch_ms=getattr(stats, "fetch_ms", 0.0),
+                            bands=getattr(stats, "bands", 1),
                             frame_id=fid,
                         )
                     ]

@@ -1,0 +1,272 @@
+"""Multi-slice band-parallel bitstream suite (parallel/bands.py).
+
+The band split's correctness contract, as tested here:
+
+* per-band ORACLE: every slice of a multi-band access unit is
+  byte-identical to a single-chip encode of that band alone (same
+  planes, same halo slab, same ME constraint) packed with the band's
+  first_mb_in_slice — built here from the primitives, not the encoder;
+* SELKIES_BANDS=1 reproduces the solo TPUH264Encoder's single-slice
+  bytes exactly (IDR, full P, and the static all-skip short-circuit);
+* an assembled N-slice access unit round-trips through the FFmpeg
+  reference decoder within the conformance bounds;
+* a mesh smaller than the band count degrades gracefully to the
+  single-device band-sliced encode; the mesh-vs-fallback identity test
+  skips cleanly when the CPU mesh has too few devices.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from selkies_tpu.models.h264.bitstream import StreamParams
+from selkies_tpu.models.h264.encoder import TPUH264Encoder
+from selkies_tpu.models.h264.encoder_core import (
+    encode_band_p_planes,
+    encode_frame_planes,
+)
+from selkies_tpu.models.h264.native import pack_slice_fast, pack_slice_p_fast
+from selkies_tpu.models.h264.numpy_ref import FrameCoeffs, PFrameCoeffs
+from selkies_tpu.parallel.bands import (
+    BAND_HALO,
+    BandedH264Encoder,
+    band_spans,
+    usable_bands,
+)
+
+W, H = 256, 256  # 16 MB rows -> 4 bands x 4 MB rows
+QP = 30
+BANDS = 4
+
+
+def _frames():
+    rng = np.random.default_rng(7)
+    f0 = rng.integers(0, 256, (H, W, 4), np.uint8)
+    f1 = np.roll(f0, 9, axis=0).copy()  # global vertical motion (crosses bands)
+    f2 = np.roll(f1, -7, axis=1).copy()
+    f2[100:140, 30:90] = rng.integers(0, 256, (40, 60, 4), np.uint8)
+    return f0, f1, f2
+
+
+def _split_nals(au: bytes) -> list[bytes]:
+    parts = au.split(b"\x00\x00\x00\x01")
+    assert parts[0] == b""
+    return [b"\x00\x00\x00\x01" + p for p in parts[1:]]
+
+
+def _clip_slab(plane: np.ndarray, r0: int, rows: int, halo: int) -> np.ndarray:
+    idx = np.clip(np.arange(r0 - halo, r0 + rows + halo), 0, plane.shape[0] - 1)
+    return plane[idx]
+
+
+# -- geometry -----------------------------------------------------------
+
+
+def test_usable_bands():
+    assert usable_bands(16, 4) == 4
+    assert usable_bands(16, 1) == 1
+    assert usable_bands(68, 4) == 4          # 1080p
+    assert usable_bands(68, 8) == 4          # 8 does not divide 68
+    assert usable_bands(135, 4) == 3         # 4K: 135 -> 3 x 45
+    assert usable_bands(16, 5) == 4          # quotient >= 3 MB rows
+    assert usable_bands(7, 4) == 1
+    assert band_spans(16, 4) == [(0, 4), (4, 4), (8, 4), (12, 4)]
+    with pytest.raises(ValueError):
+        band_spans(16, 5)
+
+
+# -- per-band oracle ----------------------------------------------------
+
+
+def test_slices_match_single_band_oracle():
+    """Each slice of the banded AU == the band encoded alone from the
+    same planes/slab, packed with its first_mb — built from primitives."""
+    from selkies_tpu.models.frameprep import FramePrep
+
+    f0, f1, _ = _frames()
+    enc = BandedH264Encoder(W, H, qp=QP, bands=BANDS,
+                            devices=jax.devices()[:1])
+    au_i = enc.encode_frame(f0)
+    au_p = enc.encode_frame(f1)
+
+    params = StreamParams(width=W, height=H, qp=QP)
+    prep = FramePrep(W, H, W, H, nslots=1)
+    y0, u0, v0 = (np.array(p, copy=True) for p in prep.convert(f0))
+    y1, u1, v1 = (np.array(p, copy=True) for p in prep.convert(f1))
+
+    slices_i = _split_nals(au_i)[2:]  # drop SPS, PPS
+    slices_p = _split_nals(au_p)
+    assert len(slices_i) == BANDS and len(slices_p) == BANDS
+
+    spans = band_spans(H // 16, BANDS)
+    bh = 16 * (H // 16 // BANDS)
+    recon = {"y": np.zeros((H, W), np.uint8),
+             "u": np.zeros((H // 2, W // 2), np.uint8),
+             "v": np.zeros((H // 2, W // 2), np.uint8)}
+    for b, (mb0, _rows) in enumerate(spans):
+        r0 = mb0 * 16
+        out = encode_frame_planes(y0[r0:r0 + bh], u0[r0 // 2:(r0 + bh) // 2],
+                                  v0[r0 // 2:(r0 + bh) // 2], QP)
+        fc = FrameCoeffs(
+            luma_mode=np.asarray(out["luma_mode"]),
+            chroma_mode=np.asarray(out["chroma_mode"]),
+            luma_dc=np.asarray(out["luma_dc"]),
+            luma_ac=np.asarray(out["luma_ac"]),
+            chroma_dc=np.asarray(out["chroma_dc"]),
+            chroma_ac=np.asarray(out["chroma_ac"]),
+            qp=QP,
+        )
+        nal = pack_slice_fast(fc, params, frame_num=0, idr=True, idr_pic_id=0,
+                              first_mb=mb0 * (W // 16))
+        assert nal == slices_i[b], f"IDR band {b} differs from oracle"
+        recon["y"][r0:r0 + bh] = np.asarray(out["recon_y"])
+        recon["u"][r0 // 2:(r0 + bh) // 2] = np.asarray(out["recon_u"])
+        recon["v"][r0 // 2:(r0 + bh) // 2] = np.asarray(out["recon_v"])
+
+    for b, (mb0, _rows) in enumerate(spans):
+        r0 = mb0 * 16
+        out = encode_band_p_planes(
+            y1[r0:r0 + bh], u1[r0 // 2:(r0 + bh) // 2],
+            v1[r0 // 2:(r0 + bh) // 2],
+            _clip_slab(recon["y"], r0, bh, enc.halo),
+            _clip_slab(recon["u"], r0 // 2, bh // 2, enc.halo // 2),
+            _clip_slab(recon["v"], r0 // 2, bh // 2, enc.halo // 2),
+            QP, halo=enc.halo)
+        pfc = PFrameCoeffs(
+            mvs=np.asarray(out["mvs"]), skip=np.asarray(out["skip"]),
+            luma_ac=np.asarray(out["luma_ac"]),
+            chroma_dc=np.asarray(out["chroma_dc"]),
+            chroma_ac=np.asarray(out["chroma_ac"]), qp=QP,
+        )
+        nal = pack_slice_p_fast(pfc, params, frame_num=1,
+                                first_mb=mb0 * (W // 16))
+        assert nal == slices_p[b], f"P band {b} differs from oracle"
+    enc.close()
+
+
+# -- SELKIES_BANDS=1 byte identity --------------------------------------
+
+
+def test_bands1_matches_solo_encoder():
+    f0, f1, _ = _frames()
+    banded = BandedH264Encoder(W, H, qp=QP, bands=1)
+    solo = TPUH264Encoder(W, H, qp=QP, frame_batch=1, pipeline_depth=0,
+                          ltr_scenes=False, scene_qp_boost=0)
+    try:
+        for i, f in enumerate([f0, f1, f1]):  # IDR, full P, static all-skip
+            a = banded.encode_frame(f)
+            b = solo.encode_frame(f)
+            assert a == b, f"frame {i}: banded bands=1 differs from solo"
+    finally:
+        banded.close()
+        solo.close()
+
+
+def test_bands1_halo0_matches_solo_encoder():
+    # explicit halo=0 (bands=1 maps any halo<4 here): the slab IS the
+    # full reference, so the ME candidate window must stay UNclamped —
+    # a dy_max=0 clamp would silently inflate vertical-motion P frames
+    f0, f1, _ = _frames()
+    banded = BandedH264Encoder(W, H, qp=QP, bands=1, halo=0)
+    solo = TPUH264Encoder(W, H, qp=QP, frame_batch=1, pipeline_depth=0,
+                          ltr_scenes=False, scene_qp_boost=0)
+    try:
+        assert banded.halo == 0
+        for i, f in enumerate([f0, f1]):  # IDR, vertical-motion P
+            (a, stats, meta), = banded.submit(f, meta=i)  # pipelined API
+            b = solo.encode_frame(f)
+            assert (meta, stats.bands) == (i, 1)
+            assert a == b, f"frame {i}: banded halo=0 differs from solo"
+    finally:
+        banded.close()
+        solo.close()
+
+
+def test_registry_routes_bands(monkeypatch):
+    from selkies_tpu.models.registry import create_encoder
+
+    monkeypatch.setenv("SELKIES_BANDS", "4")
+    enc = create_encoder("tpuh264enc", width=W, height=H)
+    assert isinstance(enc, BandedH264Encoder) and enc.bands == BANDS
+    enc.close()
+    monkeypatch.setenv("SELKIES_BANDS", "1")
+    enc = create_encoder("tpuh264enc", width=W, height=H, frame_batch=1,
+                         pipeline_depth=0)
+    assert isinstance(enc, TPUH264Encoder)
+    enc.close()
+
+
+# -- decoder round-trip -------------------------------------------------
+
+
+def test_multislice_au_decodes(tmp_path):
+    cv2 = pytest.importorskip("cv2")
+    f0, f1, f2 = _frames()
+    enc = BandedH264Encoder(W, H, qp=24, bands=BANDS,
+                            devices=jax.devices()[:1])
+    data = b"".join(enc.encode_frame(f) for f in (f0, f1, f2, f2))
+    path = tmp_path / "bands.h264"
+    path.write_bytes(data)
+    cap = cv2.VideoCapture(str(path))
+    frames = []
+    while True:
+        ok, f = cap.read()
+        if not ok:
+            break
+        frames.append(f)
+    cap.release()
+    assert len(frames) == 4, "decoder rejected the multi-slice stream"
+    # recon comparison (BT.601 limited, same bounds as conformance suite)
+    ry = np.asarray(enc._ref[0]).reshape(H, W).astype(int)
+    ru = np.asarray(enc._ref[1]).reshape(H // 2, W // 2).astype(int)
+    rv = np.asarray(enc._ref[2]).reshape(H // 2, W // 2).astype(int)
+    enc.close()
+    up = np.repeat(np.repeat(ru, 2, 0), 2, 1)
+    vp = np.repeat(np.repeat(rv, 2, 0), 2, 1)
+    yf = (ry - 16) * 1.164383
+    r = np.clip(yf + 1.596027 * (vp - 128) + 0.5, 0, 255).astype(int)
+    g = np.clip(yf - 0.391762 * (up - 128) - 0.812968 * (vp - 128) + 0.5,
+                0, 255).astype(int)
+    b = np.clip(yf + 2.017232 * (up - 128) + 0.5, 0, 255).astype(int)
+    d = np.abs(frames[-1].astype(int) - np.stack([b, g, r], -1))
+    assert d.mean() < 1.5 and d.max() <= 4, f"MAE={d.mean():.2f} max={d.max()}"
+
+
+# -- mesh vs fallback ---------------------------------------------------
+
+
+def test_mesh_smaller_than_bands_falls_back():
+    """Requesting more bands than devices must not fail: the band-sliced
+    program runs on one device with identical slicing."""
+    f0, f1, _ = _frames()
+    enc = BandedH264Encoder(W, H, qp=QP, bands=BANDS,
+                            devices=jax.devices()[:1])
+    assert not enc.mesh_enabled and enc.bands == BANDS
+    au = enc.encode_frame(f0)
+    assert len(_split_nals(au)) == 2 + BANDS  # SPS + PPS + one slice/band
+    assert len(_split_nals(enc.encode_frame(f1))) == BANDS
+    enc.close()
+
+
+@pytest.mark.skipif(len(jax.devices()) < BANDS,
+                    reason=f"band mesh needs {BANDS} devices")
+def test_mesh_matches_fallback_bytes():
+    """On a real band mesh the shard_map + ppermute path must produce
+    byte-identical access units to the single-device fallback."""
+    f0, f1, f2 = _frames()
+    mesh = BandedH264Encoder(W, H, qp=QP, bands=BANDS)
+    assert mesh.mesh_enabled
+    fb = BandedH264Encoder(W, H, qp=QP, bands=BANDS,
+                           devices=jax.devices()[:1])
+    try:
+        for i, f in enumerate([f0, f1, f2]):
+            a = mesh.encode_frame(f)
+            b = fb.encode_frame(f)
+            assert a == b, f"frame {i}: mesh differs from fallback"
+        assert len(mesh.last_stats.band_step_ms) == BANDS
+    finally:
+        mesh.close()
+        fb.close()

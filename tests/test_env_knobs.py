@@ -1,0 +1,52 @@
+"""Tier-1 wrapper for tools/check_env_knobs.py: a SELKIES_* env var read
+anywhere in selkies_tpu/ without documentation under docs/ fails the
+build (same ratchet pattern as check_silent_except / check_metric_docs)."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "check_env_knobs.py")
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location("check_env_knobs", TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_repo_has_no_undocumented_env_knobs():
+    proc = subprocess.run([sys.executable, TOOL, REPO],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_scanner_catches_undocumented_read(tmp_path):
+    mod = _load_tool()
+    src = tmp_path / "selkies_tpu"
+    src.mkdir()
+    (src / "mod.py").write_text(
+        "import os\nx = os.environ.get('SELKIES_MYSTERY_KNOB', '')\n"
+        "# a comment naming SELKIES_NOT_A_READ is not a knob\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "a.md").write_text("nothing here\n")
+    problems = mod.check(str(tmp_path))
+    assert len(problems) == 1 and "SELKIES_MYSTERY_KNOB" in problems[0]
+
+
+def test_scanner_accepts_documented_read(tmp_path):
+    mod = _load_tool()
+    src = tmp_path / "selkies_tpu"
+    src.mkdir()
+    (src / "mod.py").write_text(
+        "import os\nx = os.getenv('SELKIES_DOCUMENTED', '1')\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "knobs.md").write_text("`SELKIES_DOCUMENTED` does a thing.\n")
+    assert mod.check(str(tmp_path)) == []

@@ -1,0 +1,88 @@
+#!/usr/bin/env python3
+"""Static pass: every ``SELKIES_*`` env var the code reads must be documented.
+
+Environment knobs are the operational contract: an undocumented knob is
+either dead configuration or — worse — a load-bearing switch operators
+can't discover (SELKIES_PIPELINE_DEPTH spent three PRs undocumented
+while PERF.md told people to tune it). This check (run from tier-1 via
+tests/test_env_knobs.py, like check_silent_except.py and
+check_metric_docs.py) scans ``selkies_tpu/`` for environment READS of
+``SELKIES_*`` names — lines that mention ``environ`` or ``getenv`` — and
+requires each name to appear somewhere under ``docs/``.
+
+Only reads count: a variable named in a comment or log string is not a
+knob. Dynamic names (f-strings) are invisible to the scan; name knobs
+literally.
+
+Usage: python tools/check_env_knobs.py [repo_root]   (exit 1 on violation)
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+SRC_DIR = "selkies_tpu"
+DOC_DIR = "docs"
+
+_NAME = re.compile(r"\bSELKIES_[A-Z0-9_]+\b")
+_READ = re.compile(r"environ|getenv")
+
+
+def env_reads(root: str) -> dict[str, list[str]]:
+    """{env var: ["path:line", ...]} for every SELKIES_* read in src."""
+    reads: dict[str, list[str]] = {}
+    for dirpath, dirnames, filenames in os.walk(os.path.join(root, SRC_DIR)):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root)
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if not _READ.search(line):
+                        continue
+                    for name in _NAME.findall(line):
+                        reads.setdefault(name, []).append(f"{rel}:{lineno}")
+    return reads
+
+
+def documented_names(root: str) -> set[str]:
+    names: set[str] = set()
+    doc_root = os.path.join(root, DOC_DIR)
+    for dirpath, _dirnames, filenames in os.walk(doc_root):
+        for fn in filenames:
+            if not fn.endswith(".md"):
+                continue
+            with open(os.path.join(dirpath, fn), encoding="utf-8") as f:
+                names.update(_NAME.findall(f.read()))
+    return names
+
+
+def check(root: str = ".") -> list[str]:
+    reads = env_reads(root)
+    documented = documented_names(root)
+    problems = []
+    for name in sorted(reads):
+        if name not in documented:
+            sites = ", ".join(reads[name][:3])
+            problems.append(
+                f"{name} is read ({sites}) but documented nowhere under "
+                f"{DOC_DIR}/ — add it to the doc that owns its subsystem")
+    return problems
+
+
+def main(root: str = ".") -> int:
+    problems = check(root)
+    if problems:
+        print("check_env_knobs: undocumented SELKIES_* environment knobs.\n")
+        print("\n".join(problems))
+        return 1
+    print(f"check_env_knobs: OK ({len(env_reads(root))} knobs documented)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else "."))

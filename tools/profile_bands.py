@@ -1,0 +1,169 @@
+#!/usr/bin/env python3
+"""Microbenchmark of the band-parallel device step (parallel/bands.py):
+per-band step latency, downlink gather, and multi-slice assembly
+overhead vs band count.
+
+Runs anywhere: with no real TPU it forces an 8-device CPU host mesh
+(the same trick tests/conftest.py uses), so band scaling is measurable
+in CI containers; run it on hardware via tools/run_on_chip.sh for the
+numbers that go into PERF.md. Prints one human line per band count plus
+bench.py-shaped JSON lines (the same shape tools/profile_pack.py's
+summary feeds the PERF record with):
+
+    JAX_PLATFORMS=cpu python tools/profile_bands.py [--frames N] [--bands 1,2,4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# must land before jax import: an 8-device host mesh on CPU-only boxes
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+from selkies_tpu.parallel.bands import BandedH264Encoder, usable_bands  # noqa: E402
+
+
+def _motion_frames(w: int, h: int, n: int) -> list[np.ndarray]:
+    """Full-motion trace (the band path's target workload): a textured
+    frame scrolling diagonally, every frame a full-frame change."""
+    rng = np.random.default_rng(11)
+    base = rng.integers(0, 256, (h, w, 4), np.uint8)
+    return [np.roll(np.roll(base, 4 * i, 0), 7 * i, 1).copy() for i in range(n)]
+
+
+def profile_bands(bands: int, w: int, h: int, frames: list[np.ndarray],
+                  qp: int = 28, force_serial: bool = False) -> dict:
+    devices = jax.devices()[:1] if force_serial else None
+    enc = BandedH264Encoder(w, h, qp=qp, bands=bands, devices=devices)
+    try:
+        enc.encode_frame(frames[0])      # compile IDR
+        enc.encode_frame(frames[1])      # compile P
+        enc.encode_frame(frames[2])      # steady
+        sums = {"wall_ms": 0.0, "step_ms": 0.0, "fetch_ms": 0.0,
+                "pack_ms": 0.0, "upload_ms": 0.0}
+        band_step = np.zeros(enc.bands)
+        n = 0
+        au = b""
+        for f in frames[3:]:
+            t0 = time.perf_counter()
+            au = enc.encode_frame(f)
+            sums["wall_ms"] += (time.perf_counter() - t0) * 1e3
+            s = enc.last_stats
+            sums["step_ms"] += s.step_ms
+            sums["fetch_ms"] += s.fetch_ms
+            sums["pack_ms"] += s.pack_ms
+            sums["upload_ms"] += s.upload_ms
+            band_step += np.asarray(s.band_step_ms)
+            n += 1
+        # assembly overhead: re-join the last AU's slice NALs (what the
+        # encoder does after the per-band fan-out) — amortized cost of
+        # the multi-slice access unit itself
+        nals = [b"\x00\x00\x00\x01" + p
+                for p in au.split(b"\x00\x00\x00\x01")[1:]]
+        t0 = time.perf_counter()
+        for _ in range(256):
+            b"".join(nals)
+        asm_ms = (time.perf_counter() - t0) / 256 * 1e3
+        out = {k: v / n for k, v in sums.items()}
+        out["assemble_ms"] = asm_ms
+        out["band_step_ms"] = [round(x / n, 2) for x in band_step]
+        out["bands"] = enc.bands
+        out["mesh"] = enc.mesh_enabled
+        out["au_bytes"] = len(au)
+        return out
+    finally:
+        enc.close()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--width", type=int, default=1920)
+    ap.add_argument("--height", type=int, default=1080)
+    ap.add_argument("--frames", type=int, default=12)
+    ap.add_argument("--bands", default="1,2,4",
+                    help="comma-separated band counts to sweep")
+    ap.add_argument("--qp", type=int, default=28)
+    args = ap.parse_args()
+
+    mbh = (args.height + 15) // 16
+    ndev = len(jax.devices())
+    print(f"devices: {ndev} ({jax.default_backend()}), "
+          f"{args.width}x{args.height} ({mbh} MB rows), "
+          f"{args.frames} timed P frames")
+    frames = _motion_frames(args.width, args.height, args.frames + 3)
+
+    results = {}
+    for req in (int(b) for b in args.bands.split(",")):
+        b = usable_bands(mbh, req)
+        if b in results:
+            continue
+        r = profile_bands(b, args.width, args.height, frames, args.qp)
+        if b > 1:
+            # the same b-band program on ONE device runs the bands
+            # serially: total/b is each band's program latency free of
+            # host-core contention — i.e. what a DEDICATED chip per band
+            # delivers. On starved CPU hosts (2-core CI containers) the
+            # concurrent mesh number under-reports the hardware scaling;
+            # both are printed.
+            serial = profile_bands(b, args.width, args.height, frames,
+                                   args.qp, force_serial=True)
+            r["per_band_isolated_ms"] = serial["step_ms"] / b
+        results[b] = r
+        per_band = ("  [" + " ".join(f"{x:6.1f}" for x in r["band_step_ms"]) + "]"
+                    if b > 1 else "")
+        print(f"bands={b} (mesh={r['mesh']}): wall {r['wall_ms']:7.1f} ms  "
+              f"step {r['step_ms']:7.1f}  fetch {r['fetch_ms']:5.2f}  "
+              f"pack {r['pack_ms']:5.1f}  assemble {r['assemble_ms']:.3f} ms"
+              + per_band)
+        doc = {
+            "metric": f"band device step latency ({b} bands, "
+                      f"{args.width}x{args.height})",
+            "value": round(r["step_ms"], 2), "unit": "ms/frame",
+            "wall_ms": round(r["wall_ms"], 2),
+            "fetch_ms": round(r["fetch_ms"], 3),
+            "pack_ms": round(r["pack_ms"], 2),
+            "assemble_ms": round(r["assemble_ms"], 4),
+            "band_step_ms": r["band_step_ms"],
+            "bands": b, "mesh": r["mesh"], "au_bytes": r["au_bytes"],
+        }
+        if "per_band_isolated_ms" in r:
+            doc["per_band_isolated_ms"] = round(r["per_band_isolated_ms"], 2)
+        print(json.dumps(doc))
+
+    if 1 in results:
+        base = results[1]["step_ms"]
+        for b, r in sorted(results.items()):
+            if b == 1:
+                continue
+            doc = {
+                "metric": f"band step speedup ({b} vs 1 bands, "
+                          f"{args.width}x{args.height})",
+                "value": round(base / r["step_ms"], 2), "unit": "x",
+                "assemble_ms": round(r["assemble_ms"], 4),
+            }
+            if "per_band_isolated_ms" in r:
+                # dedicated-chip projection: what the mesh delivers when
+                # each band really has its own chip (host cores stop
+                # being the bound)
+                doc["dedicated_chip_speedup"] = round(
+                    base / r["per_band_isolated_ms"], 2)
+            print(json.dumps(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
