@@ -39,16 +39,11 @@ from selkies_tpu.models.h264.bitstream import StreamParams, write_pps, write_sps
 from selkies_tpu.models.h264.compact import (
     i_header_words,
     p_header_words,
-    p_sparse_packed_need,
     p_sparse_packed_words,
-    p_sparse_var_need,
     p_sparse_var_words,
-    p_sparse_wire_views,
     split_prefix,
     unpack_i_compact,
     unpack_p_compact,
-    unpack_p_sparse_packed,
-    unpack_p_sparse_var,
 )
 from selkies_tpu.models.h264.device_cavlc import (
     WORD_CAP_DEFAULT as BITS_WORD_CAP,
@@ -65,13 +60,15 @@ from selkies_tpu.models.h264.encoder_core import (
     pack_p_sparse_var,
     scatter_tiles,
 )
+from selkies_tpu.models.h264.sparse_complete import (
+    complete_sparse_slice,
+    fetch_rest,
+)
 from selkies_tpu.models.stats import LinkByteCounter
 from selkies_tpu.models.tilecache import TileCache
 from selkies_tpu.models.h264.native import (
     pack_slice_fast,
     pack_slice_p_fast,
-    pack_slice_p_sparse_native,
-    sparse_native_available,
 )
 from selkies_tpu.ops.colorspace import bgrx_to_i420, rgb_to_i420
 
@@ -432,16 +429,9 @@ def _p_scatter_multi_step2(packed_a, packed_b, qps, sy, su, sv, py, pu, pv,
     return prefixes, denses, bufs, ry, ru, rv, y, u, v, qy, qu, qv
 
 
-def _fetch_rest(buf, n: int, base: int = CAP_ROWS) -> np.ndarray:
-    """Overflow path: rows [base, >=n) in power-of-two buckets (base=0
-    fetches from the start, bucketed from 4096)."""
-    total = buf.shape[0]
-    bucket = max(base, 4096)
-    while bucket < n:
-        bucket <<= 1
-    if bucket >= total:
-        return np.asarray(buf)[base:]
-    return np.asarray(buf[base:bucket])
+# shared with the band-parallel completion path (sparse_complete.py owns
+# the implementation; the 4096 default there IS CAP_ROWS)
+_fetch_rest = fetch_rest
 
 
 FrameStats = _FrameStats  # shared definition (models/stats.py)
@@ -1370,67 +1360,33 @@ class TPUH264Encoder:
             return prefix_d[:L] if L < self._pfx_total else prefix_d
         return prefix_d[:, :L] if L < self._pfx_total else prefix_d
 
+    def _note_need(self, need: int) -> None:
+        """Record one slice's live word count for the fetch-hint loop
+        (the hint itself recomputes in _update_pfx_hint)."""
+        with self._pfx_lock:
+            self._pfx_recent.append(need)
+
     def _complete_sparse_p(self, fused, fused_d, dense_d, buf_d, rec):
         """One delta frame's fused slice -> finished slice NAL, sparse
         end-to-end when the native packer is available.
 
-        Handles slice shortfall, row spill past the cap, and the
+        The shared per-slice flow (sparse_complete.complete_sparse_slice)
+        handles slice shortfall, row spill past the cap, and the
         ns > nscap dense-header fallback, for either sparse layout
         (bit-packed when self._density is set). fused_d is a per-frame
         FULL-row handle created at dispatch time: the shortfall refetch
         is then a pure transfer — slicing here (a device op) would queue
         behind scans dispatched since.
-
-        The hot path hands the wire-format regions (skip words, pairs,
-        rows in either layout) straight to the C packer: no dense
-        (M, 26, 16) scatter, no int32 PFrameCoeffs, no int16 re-copy.
-        Without the native entry (or with SELKIES_SPARSE_NATIVE=0) the
-        Python dense expansion stays as the fallback and the equivalence
-        oracle. Returns (au, skipped_mbs, t_start, t_unpacked, t_done)."""
+        Returns (au, skipped_mbs, t_start, t_unpacked, t_done)."""
         t1 = time.perf_counter()
-        packed = self._density is not None
-        with tracer.span("unpack"):
-            if packed:
-                need, n, ns = p_sparse_packed_need(
-                    fused, self._mbh, self._mbw, self._nscap, self._cap_delta)
-            else:
-                need, n, ns = p_sparse_var_need(
-                    fused, self._mbh, self._mbw, self._nscap, self._cap_delta)
-            with self._pfx_lock:
-                self._pfx_recent.append(need)
-            if need > len(fused):  # hint too small: refetch the live content
-                fused = np.asarray(fused_d)
-                self.link_bytes.add("down_refetch", fused.nbytes)
-            extra = None
-            if n > self._cap_delta:  # rows spilled past the fused buffer
-                extra = _fetch_rest(buf_d, n, self._cap_delta)
-                self.link_bytes.add("down_spill", extra.nbytes)
-            wire = pfc = None
-            if ns <= self._nscap and sparse_native_available():
-                wire = p_sparse_wire_views(
-                    fused, self._mbh, self._mbw, self._nscap, self._cap_delta,
-                    packed, extra)
-            if wire is None:
-                unpack = unpack_p_sparse_packed if packed else unpack_p_sparse_var
-                pfc, rows = unpack(fused, rec.qp, self._mbh, self._mbw,
-                                   self._nscap, self._cap_delta, extra)
-                if pfc is None:  # ns > NSCAP: dense-header fallback fetch
-                    dense = np.asarray(dense_d)
-                    self.link_bytes.add("down_spill", dense.nbytes)
-                    pfc = unpack_p_compact(dense, rows, rec.qp)
-        tu = time.perf_counter()
-        with tracer.span("pack"):
-            if wire is not None:
-                au = pack_slice_p_sparse_native(
-                    wire, self.params, rec.frame_num, rec.qp,
-                    ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
-                    mmco_evict=rec.mmco_evict)
-                skipped = self._mbh * self._mbw - wire.ns
-            else:
-                au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num,
-                                       ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
-                                       mmco_evict=rec.mmco_evict)
-                skipped = int(pfc.skip.sum())
+        au, skipped, tu = complete_sparse_slice(
+            fused, mbh=self._mbh, mbw=self._mbw, nscap=self._nscap,
+            cap_rows=self._cap_delta, qp=rec.qp, frame_num=rec.frame_num,
+            params=self.params, packed=self._density is not None,
+            full_d=fused_d, buf_d=buf_d, dense_d=dense_d,
+            link_bytes=self.link_bytes, note_need=self._note_need,
+            ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
+            mmco_evict=rec.mmco_evict)
         return au, skipped, t1, tu, time.perf_counter()
 
     def _complete_batch(self, recs, pfx_slice_d, pfx_rows_d, denses_d, bufs_d):

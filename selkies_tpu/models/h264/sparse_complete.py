@@ -1,0 +1,136 @@
+"""Shared per-slice sparse P completion: fused downlink words → slice NAL.
+
+Both host completion paths — the solo pipelined encoder's delta frames
+(models/h264/encoder.py) and the band-parallel encoder's per-band slices
+(parallel/bands.py) — finish a sparse P downlink the same way:
+
+  1. read the fused prefix's need/row/non-skip counts
+     (``p_sparse_*_need``) and feed the hint feedback loop;
+  2. refetch the full live content when the hint-sized slice fell short;
+  3. fetch the row spill past the fused cap (``fetch_rest``);
+  4. hand the wire-format regions straight to the native C packer
+     (``p_sparse_wire_views`` + ``pack_slice_p_sparse_native``) when
+     it is available, else run the Python dense expansion
+     (``unpack_p_sparse_*`` + ``pack_slice_p_fast``) — including the
+     ns > nscap dense-header fallback fetch where the caller has one.
+
+PR 5 duplicated this flow per band; this module is the one definition
+(flagged follow-up in CHANGES.md PR 5). The two callers differ only in
+slice geometry (full frame vs one band), the ``first_mb`` slice-header
+offset, and the LTR slice-header flags — all parameters here. Byte
+output is identical to both former inline flows by construction
+(tests/test_sparse_native_pack.py, tests/test_band_slices.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+import numpy as np
+
+from selkies_tpu.models.h264.compact import (
+    p_sparse_packed_need,
+    p_sparse_var_need,
+    p_sparse_wire_views,
+    unpack_p_compact,
+    unpack_p_sparse_packed,
+    unpack_p_sparse_var,
+)
+from selkies_tpu.models.h264.native import (
+    pack_slice_p_fast,
+    pack_slice_p_sparse_native,
+    sparse_native_available,
+)
+from selkies_tpu.monitoring.tracing import tracer
+
+__all__ = ["complete_sparse_slice", "fetch_rest"]
+
+
+def fetch_rest(buf, n: int, base: int = 4096) -> np.ndarray:
+    """Overflow path: rows [base, >=n) in power-of-two buckets (base=0
+    fetches from the start, bucketed from 4096). Exactly two-ish fetch
+    shapes per geometry keep the compile discipline of the prefix
+    fetches (encoder.py PFX_SMALL)."""
+    total = buf.shape[0]
+    bucket = max(base, 4096)
+    while bucket < n:
+        bucket <<= 1
+    if bucket >= total:
+        return np.asarray(buf)[base:]
+    return np.asarray(buf[base:bucket])
+
+
+def complete_sparse_slice(
+    fused: np.ndarray,
+    *,
+    mbh: int,
+    mbw: int,
+    nscap: int,
+    cap_rows: int,
+    qp: int,
+    frame_num: int,
+    params,
+    packed: bool = False,
+    full_d=None,
+    buf_d=None,
+    dense_d=None,
+    link_bytes=None,
+    note_need: Callable[[int], None] | None = None,
+    first_mb: int = 0,
+    ltr_ref: int | None = None,
+    mark_ltr: int | None = None,
+    mmco_evict: tuple = (),
+) -> tuple[bytes, int, float]:
+    """One P slice's fused sparse downlink → (nal, skipped_mbs, t_unpacked).
+
+    ``fused`` is the (possibly hint-sized) fetched prefix; ``full_d`` the
+    full-length device handle for the shortfall refetch, ``buf_d`` the
+    row-spill buffer, ``dense_d`` the dense header for the ns > nscap
+    fallback (callers whose nscap equals the slice MB count pass None —
+    that branch is structurally unreachable for them). ``t_unpacked`` is
+    the unpack→pack boundary timestamp for the caller's stage split.
+    """
+    with tracer.span("unpack"):
+        need_fn = p_sparse_packed_need if packed else p_sparse_var_need
+        need, n, ns = need_fn(fused, mbh, mbw, nscap, cap_rows)
+        if note_need is not None:
+            note_need(need)
+        if need > len(fused):  # hint too small: refetch the live content
+            fused = np.asarray(full_d)
+            if link_bytes is not None:
+                link_bytes.add("down_refetch", fused.nbytes)
+        extra = None
+        if n > cap_rows:  # rows spilled past the fused buffer
+            extra = fetch_rest(buf_d, n, cap_rows)
+            if link_bytes is not None:
+                link_bytes.add("down_spill", extra.nbytes)
+        wire = pfc = None
+        if ns <= nscap and sparse_native_available():
+            wire = p_sparse_wire_views(
+                fused, mbh, mbw, nscap, cap_rows, packed, extra)
+        if wire is None:
+            unpack = unpack_p_sparse_packed if packed else unpack_p_sparse_var
+            pfc, rows = unpack(fused, qp, mbh, mbw, nscap, cap_rows, extra)
+            if pfc is None:  # ns > nscap: dense-header fallback fetch
+                if dense_d is None:
+                    raise RuntimeError(
+                        "ns > nscap with no dense fallback buffer (caller "
+                        "geometry should make this unreachable)")
+                dense = np.asarray(dense_d)
+                if link_bytes is not None:
+                    link_bytes.add("down_spill", dense.nbytes)
+                pfc = unpack_p_compact(dense, rows, qp)
+    t_unpacked = time.perf_counter()
+    with tracer.span("pack"):
+        if wire is not None:
+            nal = pack_slice_p_sparse_native(
+                wire, params, frame_num, qp, ltr_ref=ltr_ref,
+                mark_ltr=mark_ltr, mmco_evict=mmco_evict, first_mb=first_mb)
+            skipped = mbh * mbw - wire.ns
+        else:
+            nal = pack_slice_p_fast(
+                pfc, params, frame_num=frame_num, ltr_ref=ltr_ref,
+                mark_ltr=mark_ltr, mmco_evict=mmco_evict, first_mb=first_mb)
+            skipped = int(pfc.skip.sum())
+    return nal, skipped, t_unpacked

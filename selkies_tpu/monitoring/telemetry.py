@@ -103,6 +103,18 @@ METRIC_FAMILIES: dict[str, str] = {
         "labeled by site and action",
     "selkies_blackbox_dumps_total":
         "Black-box flight-recorder bundles written, labeled by slot",
+    "selkies_admission_total":
+        "Session admission-control decisions (parallel/lifecycle.py), "
+        "labeled by decision (accept/queue/reject) and reason",
+    "selkies_lifecycle_events_total":
+        "Fleet lifecycle transitions (drain_begin/drain_done/drain_timeout/"
+        "recarve_borrow/recarve_return/checkpoint/restore/release), "
+        "labeled by event",
+    "selkies_placement_chips":
+        "Chips by placement state in the SessionPlacer carve "
+        "(free/assigned/borrowed)",
+    "selkies_drain_state":
+        "Process drain state (0=serving, 1=draining, 2=drained)",
 }
 
 # canonical label names per family (order fixed for the Prometheus
@@ -122,6 +134,10 @@ _FAMILY_LABELS: dict[str, tuple[str, ...]] = {
     "selkies_supervisor_events_total": ("slot", "event"),
     "selkies_faults_injected_total": ("site", "action"),
     "selkies_blackbox_dumps_total": ("slot",),
+    "selkies_admission_total": ("decision", "reason"),
+    "selkies_lifecycle_events_total": ("event",),
+    "selkies_placement_chips": ("state",),
+    "selkies_drain_state": (),
 }
 
 _HIST_BUCKETS: dict[str, tuple[float, ...]] = {
@@ -191,6 +207,7 @@ class Telemetry:
         self._hists: dict[tuple, list] = {}       # -> [bucket_counts, sum]
         self._providers: dict[str, object] = {}   # name -> () -> dict
         self._slots: dict[str, object] = {}       # slot name -> SlotSupervisor
+        self._lifecycle = None                    # weakref to DrainController
         self._seq_map: dict[tuple[str, int], int] = {}  # (session, seq) -> fid
         self._frame_ids = itertools.count(1)
         self._epoch = time.time()
@@ -229,6 +246,7 @@ class Telemetry:
             self._seq_map.clear()
             self._providers.clear()
             self._slots.clear()
+            self._lifecycle = None
         self.recorder = None
         self._epoch = time.time()
 
@@ -377,6 +395,14 @@ class Telemetry:
         else:
             self._providers[name] = lambda: fn
 
+    def register_lifecycle(self, controller) -> None:
+        """Called by lifecycle.DrainController.__init__: makes the drain
+        state visible to ``health()`` / ``/healthz`` (503 while
+        draining) regardless of metric emission. Weakly referenced and
+        last-writer-wins, like slot registration — one live drain
+        controller per process is the product shape."""
+        self._lifecycle = weakref.ref(controller)
+
     def register_slot(self, name: str, supervisor) -> None:
         """Called by SlotSupervisor.__init__: makes the slot visible to
         ``health()`` / ``/healthz`` regardless of whether metric
@@ -405,7 +431,9 @@ class Telemetry:
         """Rung/watchdog summary for k8s-style probes. Works with
         telemetry disabled — supervisors register unconditionally.
         ``status``: ok (all slots at/below WARN), degraded (a slot is
-        shedding load or restarting), down (a slot hit RECYCLE)."""
+        shedding load or restarting), down (a slot hit RECYCLE),
+        draining (the process is in its preStop drain — probes should
+        stop routing new clients here)."""
         slots = {}
         worst = 0
         for name, ref in list(self._slots.items()):
@@ -419,7 +447,19 @@ class Telemetry:
             except Exception:
                 slots[name] = {"error": "unreadable"}
         status = "ok" if worst <= 1 else ("down" if worst >= 5 else "degraded")
-        return {"status": status, "worst_rung": worst, "slots": slots}
+        out = {"status": status, "worst_rung": worst, "slots": slots}
+        lc = self._lifecycle() if self._lifecycle is not None else None
+        if lc is not None:
+            try:
+                view = lc.health_view()
+            except Exception:
+                view = {"state": "unreadable"}
+            out["lifecycle"] = view
+            # drain outranks everything except a hard-down slot: the
+            # balancer must stop routing here even while slots are healthy
+            if view.get("state") in ("draining", "drained") and status != "down":
+                out["status"] = "draining"
+        return out
 
     def rollup(self) -> dict:
         """The /statz JSON: histograms, counters, gauges, providers,

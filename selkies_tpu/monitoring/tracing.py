@@ -56,6 +56,14 @@ black-box bundles stay greppable):
     convert       per-session BGRx→I420 on the pack pool
     device-step   sharded batch encode dispatch
     fetch / pack  batch downlink and concurrent per-session packs
+  fleet lifecycle (parallel/lifecycle.py):
+    admit         one admission-control decision (accept/queue/reject)
+    recarve       a dynamic re-carve transition (borrow or return of
+                  band chips, incl. the affected encoder rebuilds'
+                  dispatch on the serving side)
+    drain         the whole graceful-drain sequence (force-IDR + flush
+                  + checkpoint hand-off), SIGTERM to exit-ready
+    migrate       one checkpoint_session or restore_session call
   transports (transport/websocket.py):
     ws-send       one binary media frame over the WebSocket plane
   audio (audio/pipeline.py):

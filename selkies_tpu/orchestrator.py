@@ -364,7 +364,39 @@ class Orchestrator:
         self._rearm_signalling = asyncio.Event()
         self._last_loss_counters = (0.0, 0.0)
         self.last_resize_success = True
+        self._uninstall_signals = None
+        # graceful drain (parallel/lifecycle.py — the fleet path shares
+        # the same controller): SIGTERM force-IDRs the client so it holds
+        # a decodable recovery point, flushes the pipeline, flips
+        # /healthz to 503 for the whole window, then stops the server so
+        # run() returns instead of dying mid-frame
+        from selkies_tpu.parallel.lifecycle import DrainController
+
+        self.drainer = DrainController(
+            "solo", force_idr=self.app.force_keyframe,
+            flush=self._drain_flush, on_drained=self._drain_exit)
         self._wire_callbacks()
+
+    async def _drain_flush(self) -> None:
+        """Wait for one post-flag IDR to actually REACH the client (the
+        drainer's force-IDR only sets a sticky flag — stopping the
+        pipeline before the next tick encodes it would tear down a
+        client with no recovery point), then stop the pipeline: its
+        stop path flushes remaining in-flight groups to the transport.
+        Deadline-bounded by the DrainController's wait_for."""
+        pipe = self.app.pipeline
+        if pipe is not None and pipe.running:
+            target = pipe.idr_sent + 1
+            while (self.app.pipeline is pipe and pipe.running
+                   and pipe.idr_sent < target):
+                await asyncio.sleep(0.02)
+        await self._stop_session()
+
+    async def _drain_exit(self) -> None:
+        await self.server.stop()
+
+    async def drain(self) -> bool:
+        return await self.drainer.drain()
 
     # ------------------------------------------------------------------
 
@@ -669,6 +701,11 @@ class Orchestrator:
         if cfg.enable_metrics_http:
             self._tasks.append(spawn(self.metrics.start_http()))
 
+        # SIGTERM/SIGINT route through the drain path (lifecycle.py)
+        # instead of abrupt cancellation
+        from selkies_tpu.parallel.lifecycle import install_signal_handlers
+
+        self._uninstall_signals = install_signal_handlers(self.drain)
         logger.info(
             "selkies-tpu ready on %s:%s (encoder=%s, transport=ws+webrtc)",
             cfg.addr, cfg.port, cfg.encoder,
@@ -679,6 +716,9 @@ class Orchestrator:
             await self.shutdown()
 
     async def shutdown(self) -> None:
+        if self._uninstall_signals is not None:
+            self._uninstall_signals()
+            self._uninstall_signals = None
         await self.webrtc.stop_session()
         await self._stop_session()
         self.system_mon.stop()

@@ -6,6 +6,8 @@ as a jax.sharding.Mesh with one stream per chip (sessions.py / serving.py);
 4K frames band-split across chips as independent H.264 slices (bands.py:
 a shard_map over a ``band`` mesh axis with ppermute halo exchange, one
 slice NAL per chip, assembled into a multi-slice access unit in band
-order). The two axes trade off against each other — partition_devices
-carves a slice into sessions x bands rows (serving.BandedFleetService).
+order). The two axes trade off against each other — and the carve between
+them is MUTABLE state owned by lifecycle.SessionPlacer (admission control,
+graceful drain, dynamic re-carving, checkpoint/restore session migration)
+rather than a one-shot constructor-time partition.
 """

@@ -55,12 +55,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from selkies_tpu.models.h264.bitstream import StreamParams, write_pps, write_sps
 from selkies_tpu.models.h264.compact import (
     i_header_words,
-    p_sparse_var_need,
     p_sparse_var_words,
-    p_sparse_wire_views,
     split_prefix,
     unpack_i_compact,
-    unpack_p_sparse_var,
 )
 from selkies_tpu.models.h264.encoder_core import (
     encode_band_p_planes,
@@ -72,8 +69,6 @@ from selkies_tpu.models.h264.encoder_core import (
 from selkies_tpu.models.h264.native import (
     pack_slice_fast,
     pack_slice_p_fast,
-    pack_slice_p_sparse_native,
-    sparse_native_available,
 )
 from selkies_tpu.models.h264.numpy_ref import MV_PAD, PFrameCoeffs
 from selkies_tpu.models.stats import FrameStats, LinkByteCounter
@@ -271,7 +266,10 @@ def _mesh_p_body(y, u, v, qp, ry, ru, rv, *, bands: int, halo: int,
 # row spill past the fused cap: the solo encoder's overflow fetch (same
 # bucketing discipline, one definition — drift between the two fetch
 # paths would mean different compiled fetch shapes for the same spill)
-from selkies_tpu.models.h264.encoder import _fetch_rest
+from selkies_tpu.models.h264.sparse_complete import (
+    complete_sparse_slice,
+    fetch_rest as _fetch_rest,
+)
 
 
 class BandedH264Encoder:
@@ -486,37 +484,18 @@ class BandedH264Encoder:
             fused = np.asarray(pfx_d)
         t_f = time.perf_counter()
         self.link_bytes.add("down_prefix", fused.nbytes)
-        need, n, ns = p_sparse_var_need(
-            fused, self._band_mbh, self._mbw, self._nscap, self._cap_p)
-        self._note_need(need)
-        if need > len(fused):  # hint too small: refetch the live content
-            fused = np.asarray(full_d)
-            self.link_bytes.add("down_refetch", fused.nbytes)
-        extra = None
-        if n > self._cap_p:
-            extra = _fetch_rest(buf_d, n, self._cap_p)
-            self.link_bytes.add("down_spill", extra.nbytes)
-        first_mb = self.spans[band][0] * self._mbw
-        with tracer.span("unpack"):
-            wire = pfc = None
-            if sparse_native_available():
-                wire = p_sparse_wire_views(
-                    fused, self._band_mbh, self._mbw, self._nscap, self._cap_p,
-                    packed=False, extra_rows=extra)
-            if wire is None:
-                pfc, _rows = unpack_p_sparse_var(
-                    fused, qp, self._band_mbh, self._mbw, self._nscap,
-                    self._cap_p, extra)
-        t_u = time.perf_counter()
-        with tracer.span("pack"):
-            if wire is not None:
-                nal = pack_slice_p_sparse_native(
-                    wire, self.params, frame_num, qp, first_mb=first_mb)
-                skipped = self._band_mbh * self._mbw - wire.ns
-            else:
-                nal = pack_slice_p_fast(pfc, self.params, frame_num=frame_num,
-                                        first_mb=first_mb)
-                skipped = int(pfc.skip.sum())
+        # shared per-slice flow (models/h264/sparse_complete.py): need +
+        # hint feedback, shortfall refetch, row spill, native wire pack
+        # vs Python dense fallback — one band IS one slice, so the solo
+        # delta-frame completion applies verbatim with this band's
+        # geometry and first_mb offset (dense_d omitted: nscap equals the
+        # band's MB count, the dense-header fallback is unreachable)
+        nal, skipped, t_u = complete_sparse_slice(
+            fused, mbh=self._band_mbh, mbw=self._mbw, nscap=self._nscap,
+            cap_rows=self._cap_p, qp=qp, frame_num=frame_num,
+            params=self.params, full_d=full_d, buf_d=buf_d,
+            link_bytes=self.link_bytes, note_need=self._note_need,
+            first_mb=self.spans[band][0] * self._mbw)
         return nal, skipped, t_f - t0, t_u - t_f, time.perf_counter() - t_u, t_f
 
     # -- static short-circuit -------------------------------------------

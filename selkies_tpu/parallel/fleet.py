@@ -190,8 +190,10 @@ class SessionFleet:
 
     def __init__(self, slots: list[SessionSlot], *, width: int, height: int,
                  fps: int, qp: int = 28, sources=None, devices=None,
-                 service=None, supervisor: SlotSupervisor | None = None):
+                 service=None, supervisor: SlotSupervisor | None = None,
+                 placer=None):
         from selkies_tpu.parallel.bands import bands_from_env
+        from selkies_tpu.parallel.lifecycle import SessionPlacer
         from selkies_tpu.parallel.serving import (
             BandedFleetService, MultiSessionH264Service)
 
@@ -206,12 +208,25 @@ class SessionFleet:
         # session a B-chip band row for intra-frame slice parallelism
         # (parallel/bands.py) — fewer sessions per slice, each faster
         bands = bands_from_env()
+        self.bands = bands
+        # the carve is MUTABLE state owned by the placer (parallel/
+        # lifecycle.py): admission gates client connects against it, and
+        # for banded services re-carves move chips between sessions live
+        self.placer = placer or SessionPlacer(devices=devices, bands=bands)
+        self.placer.place_initial(self.n, bands)
+        # queue promotion: a release frees chips, the placer grants them
+        # to a queued session, and THIS rebuilds its encoder on the new
+        # row so the client's reconnect retry serves from it
+        self.placer.on_admitted = self._on_promoted
         if bands > 1:
             logger.info("fleet: SELKIES_BANDS=%d — band-parallel per-session "
                         "encoders (%d sessions)", bands, self.n)
+            # rebuilds (supervisor RESTART rung) read the placer's LIVE
+            # carve, so a restarted service keeps any borrowed chips
             self._make_tpu_service = lambda: BandedFleetService(
                 self.n, width, height, qp=qp, fps=self.base_fps,
-                bands=bands, devices=devices)
+                bands=bands, devices=devices,
+                rows=[self.placer.row(k) for k in range(self.n)])
         else:
             self._make_tpu_service = lambda: MultiSessionH264Service(
                 self.n, width, height, qp=qp, fps=self.base_fps, devices=devices)
@@ -231,6 +246,7 @@ class SessionFleet:
         self._tick_in_flight = False
         self._tick_started_at = 0.0
         self._restart_pending = False
+        self._pending_recarves: list[int] = []
         self.ticks = 0
         self.last_tick_ms = 0.0
         self.on_tick = lambda device_ms: None  # monitoring tap
@@ -244,6 +260,137 @@ class SessionFleet:
     def _default_poison(self, k: int) -> None:
         logger.error("session %d ejected (persistent failures)", k)
         self.slots[k].connected = False
+
+    # -- lifecycle control plane (parallel/lifecycle.py) ---------------
+
+    def release_session(self, k: int) -> None:
+        """Tear session k out of the carve (migrated away for good —
+        NOT the eject path, whose client reconnects into its kept row):
+        its chips go back to the pool — possibly promoting a queued
+        session (on_admitted rebuilds the promoted encoder) — then k's
+        now-rowless encoder is parked so nothing keeps encoding its
+        unwatched frames on the freed chips. Encoders sharing a chip
+        for the one deferred tick in between is benign (the shared
+        fallback carve runs that way permanently, parallel/bands.py)."""
+        self.placer.release(k)
+        self._recarve_safely(k)
+
+    def _on_promoted(self, k: int) -> None:
+        """placer.on_admitted: a queued session was just granted a row
+        on someone else's release — rebuild its encoder there so the
+        client's reconnect retry serves from the new chips."""
+        self._recarve_safely(int(k))
+
+    def admit_client(self, k: int):
+        """Admission gate for a client connecting to session k. A
+        ``chips-lent`` queue answer means this idle session lent its
+        band chips away: reclaim them (pressure) and retry once."""
+        adm = self.placer.admit(k)
+        if adm.decision == "queue" and adm.reason == "chips-lent":
+            for borrower in self.placer.borrowers_from(k):
+                self.return_bands(borrower)
+            adm = self.placer.admit(k)
+        if adm.accepted:
+            self.placer.set_busy(k, True)
+            # a released-then-re-admitted session comes back with a row
+            # but a PARKED encoder (recarve(k, []) on release): rebuild
+            # it on the freshly granted chips or the client streams b""
+            encs = getattr(self.service, "encoders", None)
+            if encs is not None and encs[k] is None and self.placer.row(k):
+                self._recarve_safely(k)
+        return adm
+
+    def _recarve_safely(self, k: int) -> bool:
+        """Rebuild session k's encoder on its CURRENT placer row —
+        deferred past an in-flight tick exactly like a service restart
+        (swapping an encoder under the worker thread's encode would
+        abort the pack mid-frame)."""
+        if not hasattr(self.service, "recarve"):
+            return False
+        if self._tick_in_flight:
+            self._pending_recarves.append(k)
+            return True
+        try:
+            self.service.recarve(k, self.placer.row(k))
+        except Exception:
+            # recarve raises BEFORE touching the encoder (incl. injected
+            # migrate faults), so the session keeps serving its old row
+            logger.exception("re-carve of session %d failed; encoder "
+                             "keeps its current row", k)
+            return False
+        return True
+
+    def _apply_pending_recarves(self) -> None:
+        while self._pending_recarves:
+            k = self._pending_recarves.pop(0)
+            try:
+                self.service.recarve(k, self.placer.row(k))
+            except Exception:
+                logger.exception("deferred re-carve of session %d failed", k)
+                # mirror the synchronous borrow path's rollback: if k is
+                # a borrower, settle its debts so the carve never
+                # disagrees with the running encoders (return_bands
+                # rebuilds both sides on their restored rows; a failure
+                # there keeps the old encoders on those same rows —
+                # still consistent). No tick is in flight here, so
+                # nothing re-enters this queue.
+                if self.return_bands(k):
+                    logger.warning("rolled back session %d's borrow after "
+                                   "its deferred re-carve failed", k)
+
+    def borrow_bands(self, k: int) -> bool:
+        """Dynamic re-carve: move an idle session's band chips to busy
+        session k and rebuild its encoder (byte continuity via the
+        restored encoder's forced IDR). A failed/injected re-carve
+        undoes the borrow before any encoder state moves — never a
+        leaked chip, never a carve the encoders disagree with."""
+        try:
+            chips = self.placer.borrow(k)
+        except Exception as exc:
+            logger.warning("re-carve borrow for session %d failed: %r", k, exc)
+            return False
+        if not chips:
+            return False
+        if not self._recarve_safely(k):
+            # the rebuild never happened (service without recarve, or
+            # checkpoint/build raised before touching the encoder):
+            # settle the debt so the carve matches the running encoders
+            self.placer.return_borrowed(k)
+            return False
+        # park each lender whose whole row was just lent: left running,
+        # the lent chips would carry the borrower's enlarged mesh AND
+        # the lender's unwatched frames every tick
+        for sid, state in self.placer.states().items():
+            if state == "lent":
+                self._recarve_safely(int(sid))
+        return True
+
+    def return_bands(self, k: int) -> bool:
+        """Return session k's borrowed chips to their lenders and
+        rebuild both sides' encoders on their restored rows."""
+        settled = self.placer.return_borrowed(k)
+        if not settled:
+            return False
+        ok = self._recarve_safely(k)
+        for lender, _ in settled:
+            if self.placer.row(lender):
+                self._recarve_safely(lender)
+        return ok
+
+    def checkpoint_all(self) -> list:
+        """Drain hand-off: checkpoint every connected session's minimal
+        encoder state (lifecycle.checkpoint_session)."""
+        from selkies_tpu.parallel.lifecycle import checkpoint_session
+
+        cks = []
+        for k, slot in enumerate(self.slots):
+            if not slot.connected:
+                continue
+            try:
+                cks.append(checkpoint_session(self.service, k, slot=slot))
+            except Exception:
+                logger.exception("checkpointing session %d failed", k)
+        return cks
 
     # -- per-session controls (wired to slot transports/input) ---------
 
@@ -308,6 +455,9 @@ class SessionFleet:
         from selkies_tpu.parallel.serving import SoftwareFleetService
 
         self._restart_pending = False
+        # a full service rebuild re-reads the placer's live carve, so any
+        # deferred per-session re-carves are subsumed by it
+        self._pending_recarves.clear()
         old = self.service
         logger.warning("rebuilding fleet service (software_mode=%s)",
                        self.software_mode)
@@ -465,6 +615,7 @@ class SessionFleet:
             try:
                 if self._restart_pending:
                     self._do_restart_service()
+                self._apply_pending_recarves()
                 self._tick_in_flight = True
                 self._tick_started_at = time.monotonic()
                 with telemetry.span("capture", fid, session="fleet"):
@@ -484,6 +635,12 @@ class SessionFleet:
                 sends: list[tuple[int, object]] = []  # (slot index, coroutine)
                 for k, (slot, au, idr, qp) in enumerate(
                         zip(self.slots, aus, idrs, qps)):
+                    if not au:
+                        # parked session (chips lent away): no frame was
+                        # encoded — feeding len 0 into the CBR controller
+                        # would walk qp to the floor and blow up the
+                        # post-reclaim recovery IDR
+                        continue
                     slot.rc.update(len(au), idr=idr)
                     if not slot.connected:
                         continue
@@ -624,11 +781,23 @@ class FleetOrchestrator:
         self.tpu_mon.on_stats = self._broadcast_tpu_stats
         self._tasks: list[asyncio.Task] = []
         self._rearm: dict[int, asyncio.Event] = {}
+        self._uninstall_signals = None
         self._wire_slots()
+        # graceful drain (the K8s preStop path, parallel/lifecycle.py):
+        # SIGTERM stops admitting, force-IDRs every client, flushes the
+        # in-flight tick, checkpoints sessions for hand-off, then stops
+        # the serving loop and the server so run() returns cleanly
+        from selkies_tpu.parallel.lifecycle import DrainController
+
+        self.drain_checkpoints: list = []
+        self.drainer = DrainController(
+            "fleet", placer=self.fleet.placer,
+            force_idr=self._drain_force_idr, flush=self._drain_flush,
+            handoff=self._drain_handoff, on_drained=self._drain_exit)
         telemetry.register_provider("fleet", self._fleet_stats)
 
     def _fleet_stats(self) -> dict:
-        """/statz live view of the lockstep serving core."""
+        """/statz live view of the lockstep serving core + placement."""
         f = self.fleet
         return {
             "sessions": self.n,
@@ -637,7 +806,49 @@ class FleetOrchestrator:
             "last_tick_ms": round(f.last_tick_ms, 3),
             "software_mode": f.software_mode,
             "frames": {str(k): s.frames for k, s in enumerate(self.slots)},
+            # placement rollup: live carve map, admission accept/reject
+            # counters, queue depth, borrowed-chip count
+            "placement": f.placer.stats(),
         }
+
+    # -- drain plumbing (lifecycle.DrainController callbacks) ----------
+
+    def _drain_force_idr(self) -> None:
+        for k, slot in enumerate(self.slots):
+            if slot.connected:
+                self.fleet.force_keyframe(k)
+
+    async def _drain_flush(self) -> None:
+        """In-flight groups land on the wire: wait out any running tick
+        FIRST (it may have sampled the keyframe flags before
+        _drain_force_idr set them), THEN one more delivered tick — the
+        fresh tick is guaranteed to carry the forced IDR (ticks
+        increments before _tick_in_flight clears, so the target below
+        always demands a tick that started after the flags were set)."""
+        fleet = self.fleet
+        while fleet._tick_in_flight:
+            await asyncio.sleep(0.02)
+        target = fleet.ticks + 1
+        while (any(s.connected for s in self.slots)
+               and fleet._task is not None and fleet.ticks < target):
+            await asyncio.sleep(0.02)
+        # ticks increments BEFORE the tick's send gather is awaited:
+        # wait out the in-flight flag (cleared in _run's finally, after
+        # the sends land) or stop() could cancel the IDR mid-send
+        while fleet._tick_in_flight:
+            await asyncio.sleep(0.02)
+
+    def _drain_handoff(self) -> list:
+        self.drain_checkpoints = self.fleet.checkpoint_all()
+        return self.drain_checkpoints
+
+    async def _drain_exit(self) -> None:
+        await self.fleet.stop()
+        await self.server.stop()
+
+    async def drain(self) -> bool:
+        """Graceful exit: see lifecycle.DrainController.drain()."""
+        return await self.drainer.drain()
 
     def _make_sources(self, width: int, height: int):
         """Per-session displays from ``--session_displays`` (csv of X
@@ -743,6 +954,20 @@ class FleetOrchestrator:
 
             def on_connect(k=k, slot=slot):
                 first = not slot.connected
+                if first:
+                    # admission control (parallel/lifecycle.py): a first
+                    # plane connecting is a session asking for capacity —
+                    # draining hosts, down fleets, and over-committed
+                    # carves refuse here; the client's reconnect loop
+                    # retries into freed capacity (queue promotion)
+                    adm = self.fleet.admit_client(k)
+                    if not adm.accepted:
+                        logger.warning("session %d client refused: %s (%s)",
+                                       k, adm.decision, adm.reason)
+                        loop = asyncio.get_running_loop()
+                        loop.create_task(slot.ws.close())
+                        loop.create_task(slot.webrtc.stop_session())
+                        return
                 slot.connected = True
                 if slot.gcc is not None:
                     slot.gcc.reset()
@@ -872,6 +1097,9 @@ class FleetOrchestrator:
         if not slot.connected:
             return
         slot.connected = False
+        # placement pressure bookkeeping: an idle session's chips become
+        # borrowable again (its row stays carved until release/recycle)
+        self.fleet.placer.set_busy(k, False)
         logger.info("session %d client disconnected", k)
         slot.input.reset_keyboard()
         loop = asyncio.get_running_loop()
@@ -972,6 +1200,11 @@ class FleetOrchestrator:
         if cfg.enable_metrics_http:
             self._tasks.append(spawn(self.metrics.start_http()))
         await self.fleet.start()
+        # SIGTERM/SIGINT route through the drain path (lifecycle.py)
+        # instead of abrupt cancellation: the K8s preStop contract
+        from selkies_tpu.parallel.lifecycle import install_signal_handlers
+
+        self._uninstall_signals = install_signal_handlers(self.drain)
         logger.info("selkies-tpu fleet ready on %s:%s (%d sessions %dx%d@%d)",
                     cfg.addr, cfg.port, self.n, self.fleet.width,
                     self.fleet.height, self.fleet.fps)
@@ -981,6 +1214,9 @@ class FleetOrchestrator:
             await self.shutdown()
 
     async def shutdown(self) -> None:
+        if self._uninstall_signals is not None:
+            self._uninstall_signals()
+            self._uninstall_signals = None
         await self.fleet.stop()
         self.system_mon.stop()
         self.tpu_mon.stop()

@@ -16,6 +16,7 @@ instances fed the same frames (tests/test_multi_session_serving.py).
 
 from __future__ import annotations
 
+import logging
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
@@ -26,6 +27,8 @@ from selkies_tpu.models.h264.native import pack_slice_fast, pack_slice_p_fast
 from selkies_tpu.models.h264.numpy_ref import FrameCoeffs, PFrameCoeffs
 from selkies_tpu.monitoring.tracing import tracer
 from selkies_tpu.parallel.sessions import MultiSessionEncoder
+
+logger = logging.getLogger("parallel.serving")
 
 __all__ = ["BandedFleetService", "MultiSessionH264Service", "SoftwareFleetService"]
 
@@ -185,7 +188,7 @@ class BandedFleetService:
 
     def __init__(self, n_sessions: int, width: int, height: int, *,
                  qp: int = 28, fps: int = 60, bands: int | None = None,
-                 devices=None):
+                 devices=None, rows: list[list] | None = None):
         from selkies_tpu.parallel.bands import (
             BandedH264Encoder, bands_from_env, partition_devices)
         from selkies_tpu.utils.jaxcache import enable_persistent_compilation_cache
@@ -194,40 +197,134 @@ class BandedFleetService:
         self.n = n_sessions
         if bands is None:
             bands = bands_from_env()
-        try:
-            rows = partition_devices(n_sessions, bands, devices)
-        except ValueError:
-            # slice too small for n x bands: every session falls back to
-            # a single-device band-sliced encode (identical bytes),
-            # round-robined across the chips that DO exist — passing the
-            # full device list through would instead build every
-            # session's band mesh over the same first `bands` chips
-            import jax
+        if rows is None:
+            # no placer-managed carve handed in: one-shot static carve
+            try:
+                rows = partition_devices(n_sessions, bands, devices)
+            except ValueError:
+                # slice too small for n x bands: every session falls back
+                # to a single-device band-sliced encode (identical bytes),
+                # round-robined across the chips that DO exist — passing
+                # the full device list through would instead build every
+                # session's band mesh over the same first `bands` chips
+                import jax
 
-            devs = list(devices if devices is not None else jax.devices())
-            rows = [[devs[k % len(devs)]] for k in range(n_sessions)]
+                devs = list(devices if devices is not None else jax.devices())
+                rows = [[devs[k % len(devs)]] for k in range(n_sessions)]
+        self._width, self._height = width, height
+        self._qp, self._fps, self._bands_req = qp, fps, bands
+        # an empty row means the session is PARKED: its chips are lent
+        # out (lifecycle re-carve) and it has no client, so it encodes
+        # nothing until recarve() hands it a row again. Row width drives
+        # the band count (_row_bands): a service rebuild mid-borrow
+        # reads the placer's live rows, and the borrower must come back
+        # on its enlarged mesh, not the constructor default
         self.encoders = [
-            BandedH264Encoder(width, height, qp=qp, fps=fps, bands=bands,
-                              devices=rows[k])
+            BandedH264Encoder(width, height, qp=qp, fps=fps,
+                              bands=self._row_bands(rows[k]),
+                              devices=rows[k]) if rows[k] else None
             for k in range(n_sessions)
         ]
-        self.bands = self.encoders[0].bands
+        live = next((e for e in self.encoders if e is not None), None)
+        self.bands = live.bands if live is not None else bands
         self.last_idrs: list[bool] = [True] * n_sessions
         self._pool = ThreadPoolExecutor(max_workers=n_sessions,
                                         thread_name_prefix="band-fleet")
 
     def set_qp(self, session: int, qp: int) -> None:
-        self.encoders[session].set_qp(qp)
+        enc = self.encoders[session]
+        if enc is not None:
+            enc.set_qp(qp)
 
     def force_keyframe(self, session: int) -> None:
-        self.encoders[session].force_keyframe()
+        enc = self.encoders[session]
+        if enc is not None:
+            enc.force_keyframe()
+
+    def _row_bands(self, row) -> int:
+        """Band count for a device row: borrowed chips ENLARGE the band
+        mesh — a row wider than the constructor band count re-slices the
+        frame across every chip it holds (that is the whole point of
+        borrowing; ``band_mesh`` only places the first ``bands`` devices,
+        so without this the borrowed chips would sit idle). The encoder
+        itself clamps via ``usable_bands`` when the geometry's MB rows
+        do not divide into that many bands — at such geometries the
+        extra chips cannot carry a slice and the band count (and the
+        bytes) stay exactly the constructor carve's."""
+        return max(self._bands_req, len(row))
+
+    def recarve(self, session: int, devices: list) -> None:
+        """Rebuild one session's encoder on a new device row (the
+        lifecycle re-carve: the session borrowed band chips or returned
+        them). GOP phase / QP carry over via checkpoint/restore and the
+        restored encoder opens with a forced IDR. Byte continuity: while
+        the effective band count is unchanged (a return to the original
+        row, or an enlargement clamped by the geometry) the stream from
+        that IDR is byte-identical to a never-re-carved encoder fed the
+        same frames (mesh and single-device placements already produce
+        identical bytes per band — tests/test_band_slices.py); a borrow
+        window that does enlarge the mesh re-slices the frame into more
+        bands — a decodable multi-slice continuation opened by the
+        forced IDR — and the round-trip back to the original row is
+        byte-identical to the oracle from its first post-IDR frame.
+        Callers must not have an encode_tick in flight (the fleet defers
+        the swap exactly like a supervisor service restart). An empty
+        ``devices`` row parks the session (its chips are lent out and it
+        has no client — encoding its unwatched frames would oversubscribe
+        the lent chips); a later recarve with a row un-parks it. On any
+        exception the old encoder is left untouched and keeps serving:
+        the ``migrate`` fault fires in checkpoint_session before any
+        state is read, and a restore-side failure closes the half-built
+        replacement before propagating (no leaked pack pool / device
+        buffers)."""
+        from selkies_tpu.parallel.bands import BandedH264Encoder
+        from selkies_tpu.parallel.lifecycle import (
+            checkpoint_session, restore_session)
+
+        old = self.encoders[session]
+        if not devices:
+            self.encoders[session] = None
+            if old is not None:
+                try:
+                    old.close()
+                except Exception:
+                    logger.exception("closing parked encoder %d", session)
+            return
+        ck = checkpoint_session(self, session) if old is not None else None
+        # the new encoder is built with the SERVICE's constructor qp, not
+        # the session's current dynamic qp: params.qp feeds the PPS
+        # pic_init_qp and every slice_qp_delta, so baking the dynamic qp
+        # in would shift all deltas vs a never-re-carved encoder. The
+        # dynamic qp carries over via restore_session -> set_qp.
+        enc = BandedH264Encoder(
+            self._width, self._height, qp=self._qp, fps=self._fps,
+            bands=self._row_bands(devices), devices=devices)
+        if ck is not None:
+            try:
+                restore_session(ck, enc)
+            except Exception:
+                try:
+                    enc.close()
+                except Exception:
+                    logger.exception(
+                        "closing failed replacement encoder %d", session)
+                raise
+        self.encoders[session] = enc
+        if old is not None:
+            try:
+                old.close()
+            except Exception:
+                logger.exception("closing re-carved encoder %d", session)
 
     def encode_tick(self, frames: np.ndarray) -> list[bytes]:
         if frames.shape[0] != self.n:
             raise ValueError(f"expected {self.n} frames, got {frames.shape[0]}")
 
         def _one(i: int) -> bytes:
-            return self.encoders[i].encode_frame(frames[i])
+            enc = self.encoders[i]
+            if enc is None:  # parked: chips lent away, no client
+                return b""
+            return enc.encode_frame(frames[i])
 
         # span "encode" (the synchronous encode_frame vocabulary), NOT
         # "device-step": this covers fetch + host unpack/pack too, and a
@@ -236,13 +333,15 @@ class BandedFleetService:
         # each encoder carry the device-vs-host split.
         with tracer.span("encode"):
             aus = list(self._pool.map(_one, range(self.n)))
-        self.last_idrs = [bool(e.last_stats.idr) for e in self.encoders]
+        self.last_idrs = [bool(e.last_stats.idr) if e is not None else False
+                          for e in self.encoders]
         return aus
 
     def close(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)
         for enc in self.encoders:
-            enc.close()
+            if enc is not None:
+                enc.close()
 
 
 class SoftwareFleetService:

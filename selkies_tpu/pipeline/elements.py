@@ -220,6 +220,10 @@ class VideoPipeline:
         self.frames = 0
         self.dropped_ticks = 0
         self.dropped_frames = 0
+        # IDRs DELIVERED to the sink (not merely encoded): the solo
+        # drain path (orchestrator._drain_flush) waits on this so the
+        # client holds a decodable recovery point before teardown
+        self.idr_sent = 0
         # telemetry session label + submit-path frame-id ledger: the
         # pipelined encoder returns EARLIER frames, keyed by the 90 kHz
         # timestamp we dispatched them with
@@ -427,6 +431,8 @@ class VideoPipeline:
                                            session=self.session,
                                            bytes=len(ef.au)):
                         await self.sink(ef)
+                    if ef.idr:
+                        self.idr_sent += 1
                 except asyncio.CancelledError:
                     raise
                 except Exception:

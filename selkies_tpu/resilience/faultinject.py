@@ -9,11 +9,23 @@ Grammar (semicolon-separated rules)::
 
     SELKIES_FAULTS = rule (";" rule)*
     rule   = site "@" sched ":" action
-    site   = capture | encoder | send | signalling   (wired sites; free-form)
+    site   = capture | encoder | send | signalling      (serving path)
+           | admission | recarve | migrate | drain      (fleet lifecycle)
+           (wired sites; names are free-form)
     sched  = tick list / ranges  "5,9,13" or "20-22" or "5,9,20-22"
            | "every:N"           every Nth call (1-based)
            | "p:0.01[,seed:N]"   seeded Bernoulli per call (deterministic)
     action = raise | drop | delay:<ms> | flap
+
+Fleet-scale sites (parallel/lifecycle.py): ``admission`` fires inside
+the SessionPlacer's admit (``drop``/``raise`` both reject the client);
+``recarve`` fires before a borrow moves any chips (a ``raise`` is a
+re-carve-during-encode that must leave the carve untouched);
+``migrate`` fires in checkpoint_session/restore_session (``raise`` is
+a kill-slot-mid-migration; the qualified form ``migrate:<k>`` targets
+one session); ``drain`` fires at drain start (``delay:<ms>`` stretches
+the preStop window toward its deadline, ``raise`` marks the drain
+failed while it still completes).
 
 Examples::
 

@@ -253,8 +253,13 @@ class SignallingServer:
     def _serve_healthz(self, request: web.Request, cors: dict[str, str]) -> web.Response:
         """Supervisor rung / watchdog summary shaped for k8s probes:
         200 while every slot is healthy or degraded-but-serving, 503
-        once a slot hits the RECYCLE rung. Works with telemetry metric
-        emission off — supervisors register unconditionally.
+        once a slot hits the RECYCLE rung — and 503 for the whole
+        drain window (parallel/lifecycle.DrainController), so a load
+        balancer stops routing new clients the moment the preStop path
+        begins; the body's ``lifecycle`` block carries the per-slot
+        drain/placement state (serving/busy/lent/queued). Works with
+        telemetry metric emission off — supervisors and the drain
+        controller register unconditionally.
 
         The path is basic-auth exempt so probes work, but an
         unauthenticated caller only gets the status word — the per-slot
@@ -265,7 +270,7 @@ class SignallingServer:
         health = telemetry.health()
         headers = dict(cors)
         headers["Content-Type"] = "application/json"
-        status = 503 if health["status"] == "down" else 200
+        status = 503 if health["status"] in ("down", "draining") else 200
         if self.options.enable_basic_auth and not self._check_basic_auth(request):
             health = {"status": health["status"]}
         return web.Response(status=status, text=json.dumps(health, indent=2),

@@ -77,6 +77,14 @@ class WebSocketTransport:
     def data_channel_ready(self) -> bool:
         return self._ws is not None and not self._ws.closed
 
+    async def close(self) -> None:
+        """Server-initiated disconnect (admission refused, drain): close
+        the live socket; the connection handler's finally runs the
+        normal on_disconnect path."""
+        ws = self._ws
+        if ws is not None and not ws.closed:
+            await ws.close()
+
     def send_data_channel(self, message: str) -> None:
         """Callable from the event loop or worker threads (reference
         bridges with run_coroutine_threadsafe, gstwebrtc_app.py:1792)."""

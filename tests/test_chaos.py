@@ -353,6 +353,78 @@ def test_e2e_signalling_flap_reconnects(loop, tmp_path, faults):
     loop.run_until_complete(scenario())
 
 
+# -- bands x faults (SELKIES_BANDS>1 + SELKIES_FAULTS together) --------
+
+
+def test_banded_fleet_recovers_from_encoder_crashes(loop, faults, monkeypatch):
+    """Satellite: the band-parallel fleet service under the same crash
+    schedule as the lockstep one — the combination SELKIES_BANDS>1 +
+    SELKIES_FAULTS was previously untested. The loop never returns and
+    streaming resumes with a recovery IDR."""
+    monkeypatch.setenv("SELKIES_BANDS", "2")
+    fi = faults("encoder@3,4,5:raise")
+
+    async def scenario():
+        from selkies_tpu.parallel.serving import BandedFleetService
+
+        fleet, slots = make_fleet()
+        assert isinstance(fleet.service, BandedFleetService)
+        assert fleet.service.bands == 2
+        try:
+            await fleet.start()
+            ok = await wait_for(lambda: all(
+                len(s.transport.frames) >= 6 for s in slots), timeout=150)
+            assert ok, (fleet.ticks, [len(s.transport.frames) for s in slots])
+            assert fleet._task is not None and not fleet._task.done()
+            assert fleet.supervisor.counters["failures"] >= 3
+            assert len([x for x in fi.injected if x[0] == "encoder"]) == 3
+            for s in slots:
+                frames = s.transport.frames
+                # session opens with a (multi-slice) IDR; the crash
+                # window is followed by the ladder's recovery IDR
+                assert frames[0].idr
+                assert any(f.idr for f in frames[1:])
+        finally:
+            await fleet.stop()
+
+    loop.run_until_complete(scenario())
+
+
+def test_banded_fleet_bytes_identical_with_injection_disabled(
+        loop, faults, monkeypatch):
+    """Armed-but-never-firing schedules must not perturb the banded
+    service's multi-slice bitstream either (byte-identity acceptance for
+    the bands x faults grid)."""
+    monkeypatch.setenv("SELKIES_BANDS", "2")
+    faults("encoder@99999:raise;send@99999:drop;capture@99999:raise")
+
+    async def scenario():
+        fleet_a, _ = make_fleet()
+        try:
+            ticks_a = []
+            for _ in range(4):
+                fleet_a._capture_batch()
+                aus, idrs, _, _ = fleet_a._encode_tick()
+                for slot, au, idr in zip(fleet_a.slots, aus, idrs):
+                    slot.rc.update(len(au), idr=idr)
+                ticks_a.append([bytes(a) for a in aus])
+        finally:
+            fleet_a.service.close()
+        reset_faults()
+        fleet_b, _ = make_fleet()
+        try:
+            for i in range(4):
+                fleet_b._capture_batch()
+                aus, idrs, _, _ = fleet_b._encode_tick()
+                for slot, au, idr in zip(fleet_b.slots, aus, idrs):
+                    slot.rc.update(len(au), idr=idr)
+                assert [bytes(a) for a in aus] == ticks_a[i], f"tick {i}"
+        finally:
+            fleet_b.service.close()
+
+    loop.run_until_complete(scenario())
+
+
 # -- degradation ladder end-to-end (fleet) -----------------------------
 
 def test_fleet_sustained_failures_degrade_then_recover(loop, faults):

@@ -1,0 +1,594 @@
+"""Fleet session lifecycle control plane (parallel/lifecycle.py).
+
+Deterministic chaos contract (ISSUE 6 acceptance):
+
+* admission never over-commits chips — the placer invariant (every chip
+  in exactly one place) holds under seeded random admit/release/borrow/
+  return sequences WITH injected admission/re-carve faults;
+* drain exits cleanly under fault injection, inside its deadline;
+* a killed slot's session resumes via checkpoint/restore within one
+  recovery GOP, byte-identical to an uninterrupted oracle from the
+  recovery IDR on;
+* a re-carve round-trip (borrow then return) leaves encoded bytes
+  identical to a never-re-carved oracle after the first post-IDR frame.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from selkies_tpu.monitoring.telemetry import telemetry
+from selkies_tpu.parallel.lifecycle import (
+    DrainController,
+    SessionCheckpoint,
+    SessionPlacer,
+    checkpoint_session,
+    install_signal_handlers,
+    restore_session,
+)
+from selkies_tpu.resilience import InjectedFault, configure_faults, reset_faults
+
+W, H = 64, 96  # tiny MB-aligned geometry: mbh=6 -> 2 bands x 3 MB rows
+
+
+@pytest.fixture
+def faults():
+    yield configure_faults
+    reset_faults()
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def chips(n=8):
+    return [f"chip{i}" for i in range(n)]
+
+
+# -- placer: admission, capacity, queueing ------------------------------
+
+
+def test_placer_admission_and_queue_promotion():
+    p = SessionPlacer(devices=chips(8), bands=2, host_cores=8, queue_limit=2)
+    rows = p.place_initial(3, 2)
+    assert [len(r) for r in rows] == [2, 2, 2] and len(p._free) == 2
+    assert p.admit(0).accepted            # already placed
+    assert p.admit(3).accepted            # takes the last two free chips
+    adm = p.admit(4)
+    assert adm.decision == "queue" and adm.reason == "capacity"
+    assert p.admit(5).decision == "queue"
+    assert p.admit(6).decision == "reject"  # queue full
+    promoted = []
+    p.on_admitted = promoted.append
+    p.release(3)                          # frees 2 chips -> head of queue
+    assert promoted == [4] and p.row(4)
+    p.assert_consistent()
+    st = p.stats()
+    assert st["accepts"] == 2 and st["rejects"] == 1 and st["borrowed"] == 0
+
+
+def test_placer_pack_pool_headroom_gates_admission():
+    # 2 host cores -> headroom 4 committed workers; two busy 2-chip
+    # sessions saturate it, a third client queues even though chips exist
+    p = SessionPlacer(devices=chips(8), bands=2, host_cores=2, queue_limit=4)
+    p.place_initial(2, 2)
+    p.set_busy(0, True)
+    p.set_busy(1, True)
+    adm = p.admit(2)
+    assert adm.decision == "queue" and adm.reason == "pack-pool"
+    # a PLACED but idle session is gated the same way — the wired fleet
+    # pre-carves a row for every session at startup, so this is the gate
+    # production clients actually hit
+    p2 = SessionPlacer(devices=chips(8), bands=2, host_cores=2, queue_limit=4)
+    p2.place_initial(3, 2)
+    p2.set_busy(0, True)
+    p2.set_busy(1, True)
+    adm = p2.admit(2)
+    assert adm.decision == "queue" and adm.reason == "pack-pool"
+    p2.set_busy(1, False)  # a disconnect frees headroom
+    assert p2.admit(2).accepted and 2 not in p2._queue
+
+
+def test_placer_shared_fallback_small_slice():
+    # 1 chip, 2 sessions x 2 bands: shared accounting, no capacity math
+    p = SessionPlacer(devices=chips(1), bands=2, host_cores=8)
+    rows = p.place_initial(2, 2)
+    assert p.shared and rows == [["chip0"], ["chip0"]]
+    assert p.admit(0).accepted and p.admit(5).accepted
+    assert p.borrow(0) == []  # no re-carve in shared mode
+    p.draining = True
+    assert p.admit(6).decision == "reject"
+
+
+def test_placer_borrow_return_and_reclaim():
+    p = SessionPlacer(devices=chips(4), bands=2, host_cores=8)
+    p.place_initial(2, 2)
+    p.set_busy(0, True)
+    got = p.borrow(0)
+    assert len(got) == 2 and p.row(1) == [] and p.borrowed_chips() == 2
+    assert p.states()["1"] == "lent"
+    # the lender's client comes back: admission says reclaim
+    adm = p.admit(1)
+    assert adm.decision == "queue" and adm.reason == "chips-lent"
+    assert p.borrowers_from(1) == [0]
+    settled = p.return_borrowed(0)
+    assert settled and len(p.row(1)) == 2 and p.borrowed_chips() == 0
+    assert p.admit(1).accepted
+    p.assert_consistent()
+
+
+def test_released_lender_readmission_does_not_inherit_old_loan():
+    """A lender that releases (migrated away for good) and later
+    re-admits comes back on a FRESH bands-sized row: its orphaned loan
+    settles to the POOL on return — paying it into the new row would
+    grow it past the bands carve and strand chips with no debt record
+    to reclaim them by."""
+    p = SessionPlacer(devices=chips(6), bands=2, host_cores=8)
+    p.place_initial(2, 2)              # 4 chips carved, 2 free
+    p.set_busy(0, True)
+    assert len(p.borrow(0)) == 2       # 0 borrows 1's whole row
+    p.release(1)                       # the lender migrates away
+    assert p.admit(1).accepted         # re-admitted on 2 fresh chips
+    assert len(p.row(1)) == 2
+    p.return_borrowed(0)               # the orphaned loan -> the pool
+    assert len(p.row(0)) == 2 and len(p.row(1)) == 2
+    assert p.stats()["free"] == 2 and p.borrowed_chips() == 0
+    p.assert_consistent()
+
+
+def test_placement_gauges_match_owned_chips_in_shared_mode():
+    """Shared small-slice carve: selkies_placement_chips must not
+    double-count (the rows alias the same chips) — free=0,
+    assigned=owned, matching what stats()/'/statz' report."""
+    telemetry.reset()
+    telemetry.enabled = True
+    try:
+        p = SessionPlacer(devices=chips(1), bands=1, host_cores=8)
+        p.place_initial(2, 1)          # 2 sessions round-robin 1 chip
+        assert p.shared
+        g = {lbls[0]: v for (fam, lbls), v in telemetry._gauges.items()
+             if fam == "selkies_placement_chips"}
+        assert g == {"free": 0.0, "assigned": 1.0, "borrowed": 0.0}
+    finally:
+        telemetry.enabled = False
+        telemetry.reset()
+
+
+def test_placer_never_overcommits_under_seeded_chaos(faults):
+    """The acceptance invariant: a seeded random op sequence with
+    admission/re-carve faults firing never over-commits or leaks a chip
+    — every mutator self-checks assert_consistent, so surviving the
+    sequence IS the proof."""
+    faults("admission@p:0.2,seed:7:drop;recarve@p:0.3,seed:11:raise")
+    p = SessionPlacer(devices=chips(8), bands=2, host_cores=8, queue_limit=4)
+    p.place_initial(2, 2)
+    rng = np.random.default_rng(42)
+    placed_total = len(chips(8))
+    for step in range(300):
+        sid = int(rng.integers(0, 6))
+        op = int(rng.integers(0, 5))
+        if op == 0:
+            p.admit(sid)
+        elif op == 1:
+            p.release(sid)
+        elif op == 2:
+            try:
+                p.borrow(sid)
+            except InjectedFault:
+                pass  # re-carve-during-encode: carve must be untouched
+        elif op == 3:
+            p.return_borrowed(sid)
+        else:
+            p.set_busy(sid, bool(rng.integers(0, 2)))
+        p.assert_consistent()
+        st = p.stats()
+        placed = sum(len(v) for v in st["carve"].values())
+        assert placed + st["free"] == placed_total, (step, st)
+    assert p.counters["borrows"] >= 1 and p.counters["returns"] >= 1
+
+
+def test_admission_fault_site_rejects(faults):
+    fi = faults("admission@1:drop;admission@2:raise")
+    p = SessionPlacer(devices=chips(4), bands=1, host_cores=8)
+    p.place_initial(2, 1)
+    assert p.admit(0).reason == "fault-injected"
+    assert p.admit(0).reason == "fault-injected"
+    assert p.admit(0).accepted  # schedule exhausted
+    assert [x[0] for x in fi.injected] == ["admission", "admission"]
+
+
+# -- checkpoint / restore ----------------------------------------------
+
+
+def test_checkpoint_json_roundtrip():
+    ck = SessionCheckpoint(session=3, qp=31, frames_since_idr=17,
+                           idr_pic_id=1, rc={"bitrate_kbps": 1500},
+                           congestion={"estimate_kbps": 900.0},
+                           ltr={"0": 5})
+    assert SessionCheckpoint.from_json(ck.to_json()) == ck
+    # forward-compat: unknown keys in an old/new bundle are ignored
+    blob = ck.to_json()[:-1] + ', "future_field": 1}'
+    assert SessionCheckpoint.from_json(blob) == ck
+
+
+def test_migration_killed_slot_resumes_within_one_gop(faults):
+    """Kill-slot-mid-migration: the first checkpoint attempt dies on an
+    injected fault, the retry lands, and the session resumes on a fresh
+    service with ONE recovery IDR whose stream is byte-identical to an
+    uninterrupted oracle that force-IDRed at the same tick."""
+    from selkies_tpu.parallel.serving import MultiSessionH264Service
+
+    import jax
+
+    devs = jax.devices()
+    faults("migrate:1@1:raise")
+    rng = np.random.default_rng(0)
+    frames = [rng.integers(0, 255, (2, H, W, 4), np.uint8) for _ in range(5)]
+    svc = MultiSessionH264Service(2, W, H, qp=28, fps=30, devices=devs[:2])
+    oracle = MultiSessionH264Service(2, W, H, qp=28, fps=30, devices=devs[2:4])
+    slot = type("Slot", (), {})()
+    from selkies_tpu.models.h264.ratecontrol import CbrRateController
+
+    slot.rc = CbrRateController(bitrate_kbps=1800, fps=30)
+    slot.gcc = None
+    try:
+        for t in range(3):
+            a = svc.encode_tick(frames[t])
+            b = oracle.encode_tick(frames[t])
+            assert [bytes(x) for x in a] == [bytes(x) for x in b]
+        with pytest.raises(InjectedFault):
+            checkpoint_session(svc, 1, slot=slot)  # the mid-migration kill
+        ck = checkpoint_session(svc, 1, slot=slot)  # retry succeeds
+        assert ck.idr_pic_id == svc.sessions[1].idr_pic_id
+        assert ck.rc["bitrate_kbps"] == 1800
+        svc.close()  # the dead host
+
+        target = MultiSessionH264Service(2, W, H, qp=28, fps=30,
+                                         devices=devs[4:6])
+        slot2 = type("Slot", (), {})()
+        slot2.rc = CbrRateController(bitrate_kbps=1000, fps=30)
+        slot2.gcc = None
+        restore_session(SessionCheckpoint.from_json(ck.to_json()),
+                        target, 1, slot=slot2)
+        assert slot2.rc.bitrate_kbps == 1800  # RC state migrated
+        oracle.force_keyframe(0)
+        oracle.force_keyframe(1)
+        a = target.encode_tick(frames[3])
+        b = oracle.encode_tick(frames[3])
+        assert target.last_idrs[1], "resume frame is not the recovery IDR"
+        assert bytes(a[1]) == bytes(b[1]), "recovery IDR differs from oracle"
+        a = target.encode_tick(frames[4])
+        b = oracle.encode_tick(frames[4])
+        assert bytes(a[1]) == bytes(b[1]), "post-IDR P frame differs"
+        target.close()
+    finally:
+        oracle.close()
+
+
+# -- dynamic re-carve ---------------------------------------------------
+
+
+def test_recarve_roundtrip_byte_identity():
+    """Borrow then return: the re-carved session's bytes equal a
+    never-re-carved oracle's from the first post-IDR frame on (the
+    acceptance oracle condition)."""
+    from selkies_tpu.parallel.serving import BandedFleetService
+
+    import jax
+
+    devs = jax.devices()
+    rng = np.random.default_rng(1)
+    frames = [rng.integers(0, 255, (2, H, W, 4), np.uint8) for _ in range(6)]
+    placer = SessionPlacer(devices=devs, bands=2, host_cores=8)
+    rows = placer.place_initial(2, 2)
+    svc = BandedFleetService(2, W, H, qp=28, fps=30, bands=2, rows=rows)
+    oracle = BandedFleetService(2, W, H, qp=28, fps=30, bands=2,
+                                rows=[[devs[4], devs[5]], [devs[6], devs[7]]])
+    try:
+        for t in range(2):
+            a = svc.encode_tick(frames[t])
+            b = oracle.encode_tick(frames[t])
+            assert [bytes(x) for x in a] == [bytes(x) for x in b]
+        placer.set_busy(0, True)
+        # rate control has moved the session off its constructor qp by
+        # now: the rebuilt encoder must carry the DYNAMIC qp over without
+        # baking it into its StreamParams (which would shift pic_init_qp
+        # and every slice_qp_delta vs the oracle)
+        svc.set_qp(0, 34)
+        oracle.set_qp(0, 34)
+        assert len(placer.borrow(0)) == 2      # borrow idle session 1's row
+        svc.recarve(0, placer.row(0))          # rebuild on 4 chips
+        oracle.force_keyframe(0)               # oracle: same IDR, no re-carve
+        for t in range(2, 4):
+            a = svc.encode_tick(frames[t])
+            b = oracle.encode_tick(frames[t])
+            assert bytes(a[0]) == bytes(b[0]), f"tick {t}: borrower diverged"
+            assert bytes(a[1]) == bytes(b[1]), f"tick {t}: lender diverged"
+        assert svc.last_idrs == [False, False]
+        placer.return_borrowed(0)              # the round-trip
+        svc.recarve(0, placer.row(0))
+        svc.recarve(1, placer.row(1))
+        oracle.force_keyframe(0)
+        oracle.force_keyframe(1)
+        for t in range(4, 6):
+            a = svc.encode_tick(frames[t])
+            b = oracle.encode_tick(frames[t])
+            assert bytes(a[0]) == bytes(b[0]) and bytes(a[1]) == bytes(b[1])
+        placer.assert_consistent()
+        assert placer.borrowed_chips() == 0
+    finally:
+        svc.close()
+        oracle.close()
+
+
+# -- drain --------------------------------------------------------------
+
+
+class _FakeSessionState:
+    def __init__(self):
+        self.frames_since_idr = 4
+        self.idr_pic_id = 1
+        self.force_idr = False
+        self.qp = 30
+
+
+class _FakeService:
+    """MultiSessionH264Service-shaped double: instant ticks, real
+    per-session GOP state for checkpointing."""
+
+    def __init__(self, n):
+        self.n = n
+        self.sessions = [_FakeSessionState() for _ in range(n)]
+        self.params = type("P", (), {"width": W, "height": H, "fps": 30})()
+        self.last_idrs = [True] * n
+        self.forced: list[int] = []
+        self.closed = False
+
+    def set_qp(self, k, qp):
+        self.sessions[k].qp = qp
+
+    def force_keyframe(self, k):
+        self.forced.append(k)
+        self.sessions[k].force_idr = True
+
+    def encode_tick(self, frames):
+        idrs = [s.force_idr for s in self.sessions]
+        for s in self.sessions:
+            s.force_idr = False
+        self.last_idrs = idrs
+        return [b"\x00\x00\x00\x01" + bytes([65 + k]) * 8
+                for k in range(self.n)]
+
+    def close(self):
+        self.closed = True
+
+
+class _RecordingTransport:
+    def __init__(self):
+        self.frames = []
+        self.data_channel_ready = False
+
+    def send_data_channel(self, message):
+        pass
+
+    async def send_video(self, ef):
+        self.frames.append(ef)
+        return True
+
+
+def _fake_fleet(n=2):
+    from selkies_tpu.parallel.fleet import SessionFleet, SessionSlot
+
+    slots = [SessionSlot(k, bitrate_kbps=2000, fps=60) for k in range(n)]
+    fleet = SessionFleet(slots, width=W, height=H, fps=60,
+                         service=_FakeService(n))
+    for slot in slots:
+        slot.transport = _RecordingTransport()
+        slot.connected = True
+        fleet.placer.set_busy(slot.index, True)
+    return fleet, slots
+
+
+def test_fleet_drain_under_fault_injection_meets_deadline(loop, faults):
+    """The preStop sequence against a live (fake-service) fleet with a
+    drain delay injected: completes inside the deadline, force-IDRs
+    every connected session, hands off one checkpoint per session, and
+    refuses admission afterwards."""
+    faults("drain@1:delay:50")
+
+    async def scenario():
+        fleet, slots = _fake_fleet()
+
+        async def _flush():
+            target = fleet.ticks + 1
+            while fleet._tick_in_flight or fleet.ticks < target:
+                await asyncio.sleep(0.02)
+
+        drainer = DrainController(
+            "fleet-test", placer=fleet.placer, deadline_s=5.0,
+            force_idr=lambda: [fleet.force_keyframe(k) for k in range(2)],
+            flush=_flush, handoff=fleet.checkpoint_all)
+        await fleet.start()
+        try:
+            ok = await asyncio.wait_for(drainer.drain(), 10)
+            assert ok, "drain missed its deadline"
+            assert drainer.state == "drained"
+            assert sorted(fleet.service.forced[:2]) == [0, 1]
+            assert len(drainer.checkpoints) == 2
+            assert {ck.session for ck in drainer.checkpoints} == {0, 1}
+            assert drainer.checkpoints[0].idr_pic_id == 1  # real GOP state
+            adm = fleet.admit_client(0)
+            assert adm.decision == "reject" and adm.reason == "draining"
+        finally:
+            await fleet.stop()
+
+    loop.run_until_complete(scenario())
+
+
+def test_sigterm_routes_through_drain(loop):
+    """Satellite regression: a real SIGTERM drives the drain path (not
+    abrupt cancellation) and the drain completes within the deadline."""
+
+    async def scenario():
+        flushed = []
+        drainer = DrainController(
+            "sig-test", deadline_s=5.0,
+            flush=lambda: _sleepy(flushed))
+
+        async def _sleepy(log):
+            await asyncio.sleep(0.01)
+            log.append("flushed")
+
+        uninstall = install_signal_handlers(
+            drainer.drain, loop=asyncio.get_running_loop())
+        os.kill(os.getpid(), signal.SIGTERM)
+        for _ in range(200):
+            if drainer.state == "drained":
+                break
+            await asyncio.sleep(0.02)
+        assert drainer.state == "drained", "SIGTERM did not drain"
+        assert drainer.completed_in_deadline
+        assert flushed == ["flushed"]
+        uninstall()
+
+    loop.run_until_complete(scenario())
+
+
+def test_fleet_admit_client_reclaims_lent_chips():
+    """Pressure path: a lender's client reconnecting makes the fleet
+    return the borrowed chips and admit it."""
+    from selkies_tpu.parallel.fleet import SessionFleet, SessionSlot
+
+    class _RecarvingService(_FakeService):
+        def __init__(self, n):
+            super().__init__(n)
+            self.recarves: list[tuple[int, int]] = []
+
+        def recarve(self, k, devices):
+            self.recarves.append((k, len(devices)))
+
+    slots = [SessionSlot(k, bitrate_kbps=2000, fps=60) for k in range(2)]
+    fleet = SessionFleet(slots, width=W, height=H, fps=60,
+                         service=_RecarvingService(2))
+    # hand-carve a banded placer so borrow/return are meaningful
+    fleet.placer = SessionPlacer(devices=chips(4), bands=2, host_cores=8)
+    fleet.placer.place_initial(2, 2)
+    fleet.placer.set_busy(0, True)
+    assert fleet.borrow_bands(0)
+    # borrower rebuilt on 4 chips, then the lender PARKED (0 devices):
+    # its encoder must not keep encoding on the chips it just lent
+    assert fleet.service.recarves == [(0, 4), (1, 0)]
+    assert fleet.placer.row(1) == []
+    adm = fleet.admit_client(1)  # the lender's client is back
+    assert adm.accepted
+    assert fleet.placer.borrowed_chips() == 0
+    assert len(fleet.placer.row(1)) == 2
+    # both sides rebuilt on their restored rows
+    assert (0, 2) in fleet.service.recarves and (1, 2) in fleet.service.recarves
+    fleet.placer.assert_consistent()
+
+
+def test_borrow_bands_rolls_back_when_recarve_fails():
+    """A re-carve that dies before touching the encoder (e.g. an
+    injected kill-slot-mid-migration inside recarve's checkpoint) must
+    undo the borrow: the carve may never disagree with the encoders."""
+    from selkies_tpu.parallel.fleet import SessionFleet, SessionSlot
+
+    class _FailingService(_FakeService):
+        def recarve(self, k, devices):
+            raise RuntimeError("killed mid-migration")
+
+    slots = [SessionSlot(k, bitrate_kbps=2000, fps=60) for k in range(2)]
+    fleet = SessionFleet(slots, width=W, height=H, fps=60,
+                         service=_FailingService(2))
+    fleet.placer = SessionPlacer(devices=chips(4), bands=2, host_cores=8)
+    fleet.placer.place_initial(2, 2)
+    fleet.placer.set_busy(0, True)
+    assert not fleet.borrow_bands(0)
+    assert fleet.placer.borrowed_chips() == 0
+    assert len(fleet.placer.row(0)) == 2 and len(fleet.placer.row(1)) == 2
+    fleet.placer.assert_consistent()
+
+
+def test_deferred_recarve_failure_rolls_back_borrow():
+    """A borrow deferred past an in-flight tick whose re-carve then
+    fails at the tick boundary must settle the debt too — the deferred
+    path owes the same 'never a carve the encoders disagree with'
+    guarantee as the synchronous rollback above."""
+    from selkies_tpu.parallel.fleet import SessionFleet, SessionSlot
+
+    class _FlakyService(_FakeService):
+        def __init__(self, n):
+            super().__init__(n)
+            self.fail_next = True
+            self.recarves: list[tuple[int, int]] = []
+
+        def recarve(self, k, devices):
+            if self.fail_next:
+                self.fail_next = False  # only the deferred apply dies
+                raise RuntimeError("killed at the tick boundary")
+            self.recarves.append((k, len(devices)))
+
+    slots = [SessionSlot(k, bitrate_kbps=2000, fps=60) for k in range(2)]
+    fleet = SessionFleet(slots, width=W, height=H, fps=60,
+                         service=_FlakyService(2))
+    fleet.placer = SessionPlacer(devices=chips(4), bands=2, host_cores=8)
+    fleet.placer.place_initial(2, 2)
+    fleet.placer.set_busy(0, True)
+    fleet._tick_in_flight = True            # mid-tick: the borrow defers
+    assert fleet.borrow_bands(0)
+    assert fleet.placer.borrowed_chips() == 2
+    assert fleet._pending_recarves == [0, 1]
+    fleet._tick_in_flight = False
+    fleet._apply_pending_recarves()         # the borrower's apply raises
+    assert fleet.placer.borrowed_chips() == 0
+    assert len(fleet.placer.row(0)) == 2 and len(fleet.placer.row(1)) == 2
+    # both sides rebuilt on their restored rows by the rollback
+    assert (0, 2) in fleet.service.recarves and (1, 2) in fleet.service.recarves
+    fleet.placer.assert_consistent()
+
+
+def test_healthz_503_while_draining(loop):
+    """/healthz flips to 503 the moment draining begins and reports the
+    per-slot placement state."""
+    import aiohttp
+
+    from selkies_tpu.signalling.server import (
+        SignallingOptions, SignallingServer)
+
+    async def scenario():
+        placer = SessionPlacer(devices=chips(2), bands=1, host_cores=8)
+        placer.place_initial(2, 1)
+        placer.set_busy(0, True)
+        drainer = DrainController("hz-test", placer=placer, deadline_s=5.0)
+        server = SignallingServer(SignallingOptions(addr="127.0.0.1", port=0))
+        await server.start()
+        try:
+            base = f"http://127.0.0.1:{server.bound_port}"
+            async with aiohttp.ClientSession() as http:
+                r = await http.get(base + "/healthz")
+                body = await r.json()
+                assert r.status == 200
+                assert body["lifecycle"]["state"] == "serving"
+                assert body["lifecycle"]["slots"] == {"0": "busy",
+                                                      "1": "serving"}
+                drainer.begin()
+                r = await http.get(base + "/healthz")
+                body = await r.json()
+                assert r.status == 503, "draining host must fail its probe"
+                assert body["status"] == "draining"
+                assert body["lifecycle"]["state"] == "draining"
+        finally:
+            await server.stop()
+            telemetry._lifecycle = None
+
+    loop.run_until_complete(scenario())
