@@ -170,11 +170,17 @@ def bench_full_encoder() -> tuple[float, dict] | None:
     bands = 1
     band_step_sums: list[float] = []
     band_step_n = 0
+    # which payload each P downlink shipped (coeff rows vs device-entropy
+    # bits vs a dense fallback; "none" = no downlink, e.g. static frames)
+    # — future rounds track WHICH path busy frames took, not just totals
+    mode_counts: dict[str, int] = {}
 
     def _account(stats) -> None:
         nonlocal bands, band_step_sums, band_step_n
         for k in sums:
             sums[k] += getattr(stats, k, 0.0)
+        mode = getattr(stats, "downlink_mode", "") or "none"
+        mode_counts[mode] = mode_counts.get(mode, 0) + 1
         bands = max(bands, getattr(stats, "bands", 1))
         bs = getattr(stats, "band_step_ms", ())
         if bs:
@@ -198,10 +204,18 @@ def bench_full_encoder() -> tuple[float, dict] | None:
     lb1 = enc.link_bytes.snapshot()
     up = sum(v - lb0.get(k, 0) for k, v in lb1.items() if k.startswith("up_"))
     down = sum(v - lb0.get(k, 0) for k, v in lb1.items() if k.startswith("down_"))
+    # device-entropy frames account under down_bits* stages — split the
+    # downlink into its coefficient and final-slice-bits components so
+    # the trajectory shows the ISSUE-7 conversion, not just the total
+    bits = sum(v - lb0.get(k, 0) for k, v in lb1.items()
+               if k.startswith("down_bits"))
     assert done == ITERS, f"pipeline lost frames: {done}/{ITERS}"
     means = {k: v / done for k, v in sums.items()}
     means["bytes_up_per_frame"] = up / done
     means["bytes_down_per_frame"] = down / done
+    means["bytes_down_coeff_per_frame"] = (down - bits) / done
+    means["bytes_down_bits_per_frame"] = bits / done
+    means["downlink_mode"] = mode_counts
     if bands > 1 and band_step_n:
         means["bands"] = bands
         means["band_step_ms"] = [round(s / band_step_n, 2)

@@ -168,6 +168,28 @@ def p_sparse_packed_need(fused16: np.ndarray, mbh: int, mbw: int, nscap: int,
     return 12 + 2 * sw + 4 * min(ns, nscap) + rows_words, n, ns
 
 
+ENTROPY_META16 = 16  # int16 words of the pack_p_sparse_entropy meta prefix
+
+
+def p_sparse_entropy_words(mbh: int, mbw: int, nscap: int, cap_rows: int,
+                           packed: bool, bits_words: int) -> int:
+    """Total int16 length of the entropy-wrapped fused buffer
+    (encoder_core.pack_p_sparse_entropy): the 8-int32 meta prefix plus a
+    payload region sized for whichever of the two modes is larger."""
+    coeff = (p_sparse_packed_words(mbh, mbw, nscap, cap_rows) if packed
+             else p_sparse_var_words(mbh, mbw, nscap, cap_rows))
+    return ENTROPY_META16 + max(coeff, 2 * bits_words)
+
+
+def p_sparse_entropy_meta(fused16: np.ndarray):
+    """(mode, nbits, trailing_skip, nskip, ns) from an entropy-wrapped
+    fused buffer's meta prefix. mode 1 = the payload is slice-data bit
+    words; mode 0 = the payload is the unchanged sparse coeff layout
+    starting at ENTROPY_META16."""
+    meta = np.ascontiguousarray(fused16[:ENTROPY_META16]).view(np.int32)
+    return int(meta[0]), int(meta[1]), int(meta[2]), int(meta[3]), int(meta[4])
+
+
 def _expand_packed_rows(bitmaps: np.ndarray, vals: np.ndarray) -> np.ndarray:
     """bitmaps (held,) int16 + packed values -> dense rows (held, 16).
 

@@ -1,4 +1,5 @@
-"""CAVLC entropy coding ON DEVICE: P-frame slice-data bits from XLA.
+"""CAVLC entropy coding ON DEVICE: P-frame slice-data bits from XLA,
+with cost proportional to frame ACTIVITY, not frame area.
 
 The compact-coefficient downlink still ships multi-MB tensors for busy
 frames (a 1080p full-frame change is ~4.5 MB of nonzero rows — the
@@ -7,17 +8,35 @@ entire §9.2 entropy coder into the frame jit, so what crosses the link
 is the final slice-data bitstream (~50-300 KB), exactly like the
 reference's NVENC emits finished bitstreams on-GPU.
 
+Two entry points share one implementation:
+
+* ``pack_p_slice_bits`` — the full-grid coder (every MB pays), used as
+  the fixed-shape oracle by tests and the profiler;
+* ``pack_p_slice_bits_active`` — the production coder: the skip map and
+  per-MB TotalCoeff are known before any bit is written, so the coded
+  (non-skip) MBs are COMPACTED into a dense prefix and the expensive
+  per-block work (VLC one-hot LUT contractions, level suffix chains,
+  prefix-sum bit concatenation) runs over a bucketed padded count of
+  active MBs — a typing frame with ~200 live MBs pays ~256 MBs of
+  entropy-coding work instead of the full 8160-MB grid. Buckets are
+  powers-of-two-ish (`bits_buckets`) selected per frame ON DEVICE via
+  ``lax.switch`` — one executable, no recompiles (the same discipline
+  as the NSCAP dense fallback in encoder_core.pack_p_sparse_packed).
+  Compaction preserves raster order and padded slots emit zero bits,
+  so the merged stream is bit-identical to the full-grid coder.
+
 Everything vectorizes: VLC tables become constant-array gathers; the
-per-level suffix-length adaptation and run_before chains are 16-step
-`lax.scan`s across ALL blocks at once; nC neighbour contexts are plain
-shifted-grid reads (TotalCoeff of every block is known before any bit is
-written); the serial-looking bit concatenation is two levels of
-prefix-sum offsets + shift/scatter-add (bit-disjoint, so add == or).
+per-level suffix-length adaptation and run_before chains are unrolled
+16-step walks across ALL blocks at once; nC neighbour contexts are plain
+shifted-grid reads; the serial-looking bit concatenation is two levels
+of prefix-sum offsets + shift/scatter-add (bit-disjoint, so add == or).
 
 The host prepends the slice header (variable length, so the device
-stream is bit-shifted to the header tail), appends the trailing
-skip_run + rbsp trailing bits, and runs emulation prevention (C++).
-Output is BIT-IDENTICAL to cavlc.pack_slice_p (tests/test_device_cavlc.py).
+stream is bit-shifted to the header tail — ``first_mb_in_slice`` for a
+band slice lives in that header, so band bits need no device change),
+appends the trailing skip_run + rbsp trailing bits, and runs emulation
+prevention (C++). Output is BIT-IDENTICAL to cavlc.pack_slice_p
+(tests/test_device_cavlc.py, tests/test_device_entropy_sparse.py).
 """
 
 from __future__ import annotations
@@ -30,7 +49,66 @@ import jax.numpy as jnp
 from selkies_tpu.models.h264 import tables as T
 from selkies_tpu.models.h264.cavlc import INTER_CBP_TO_CODENUM
 
-__all__ = ["pack_p_slice_bits", "WORD_CAP_DEFAULT"]
+__all__ = [
+    "pack_p_slice_bits",
+    "pack_p_slice_bits_active",
+    "bits_buckets",
+    "device_entropy_default",
+    "resolve_entropy",
+    "BITS_MIN_MBS_DEFAULT",
+    "WORD_CAP_DEFAULT",
+]
+
+# A delta/band P slice with at least this many live (non-skip) MBs ships
+# its final slice bits; below it the sparse coefficient downlink is
+# already small and its host pack near-free. SELKIES_BITS_MIN_MBS
+# overrides (the density-threshold knob, docs/device_entropy.md).
+BITS_MIN_MBS_DEFAULT = 512
+
+
+def device_entropy_default(explicit=None) -> bool:
+    """Resolve the device-entropy knob: an explicit constructor argument
+    wins, then SELKIES_DEVICE_ENTROPY=0/1, then auto — on for real TPU
+    backends, off on CPU, where the "device" coder competes with the
+    host pack for the same cores and only adds compile time (the
+    SELKIES_PALLAS_ME dispatch discipline)."""
+    if explicit is not None:
+        return bool(explicit)
+    import os
+
+    env = os.environ.get("SELKIES_DEVICE_ENTROPY", "")
+    if env == "0":
+        return False
+    if env:
+        return True
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def resolve_entropy(m: int, device_entropy=None, bits_min_mbs=None):
+    """One resolver for the device-entropy knobs, shared by the solo and
+    banded encoders -> (enabled, min_mbs, bits_words, consts).
+
+    `m` is the slice MB count (full grid, or one band). `consts` is the
+    (bits_words, min_mbs, buckets) tuple the jitted
+    encoder_core.pack_p_sparse_entropy closes over — None when the
+    feature is off. bits_words is the bit-payload cap in uint32 words:
+    ~16 words/MB covers busy desktop residuals, clamped to 256 KB."""
+    enabled = device_entropy_default(device_entropy)
+    if bits_min_mbs is None:
+        import os
+
+        try:
+            bits_min_mbs = int(os.environ.get("SELKIES_BITS_MIN_MBS", "")
+                               or BITS_MIN_MBS_DEFAULT)
+        except ValueError:
+            bits_min_mbs = BITS_MIN_MBS_DEFAULT
+    min_mbs = max(0, int(bits_min_mbs))
+    bits_words = min(1 << 16, max(1024, 16 * int(m)))
+    consts = (bits_words, min_mbs, bits_buckets(m)) if enabled else None
+    return enabled, min_mbs, bits_words, consts
 
 # ---------------------------------------------------------------------------
 # VLC tables as dense arrays (generated from the FFmpeg-validated
@@ -91,7 +169,7 @@ def _lut(idx, pair: np.ndarray):
 
     pair: (N, 2) np table. Per-element gathers price ~17 ns on v5e — a
     (B, 15) run_before lookup pair costs 30+ ms as a gather and ~1 ms as
-    an MXU contraction (tools/profile_cavlc_device.py). f32 is exact for
+    an MXU contraction (tools/profile_device_entropy.py). f32 is exact for
     every VLC value (< 2^24)."""
     n = pair.shape[0]
     flat = idx.reshape(-1)
@@ -234,7 +312,7 @@ def _encode_blocks(coeffs, nc, chroma_dc: bool):
     # depends only on (level, suffix_len_before, is_first), so it runs
     # ONCE vectorized over all (L, B) slots. The L-step walk is UNROLLED
     # in Python: a lax.scan at this width pays ~1.5 ms of per-step launch
-    # overhead on v5e (tools/profile_cavlc_device.py) while the unrolled
+    # overhead on v5e (tools/profile_device_entropy.py) while the unrolled
     # form fuses into a handful of kernels.
     init_sl = jnp.where((total > 10) & (t1 < 3), 1, 0)
     val_t = val_rev.T  # (L, B)
@@ -435,13 +513,34 @@ def _mv_pred_grid(mvs, skip_unused):
     return pred
 
 
-def pack_p_slice_bits(out, word_cap: int = WORD_CAP_DEFAULT):
-    """P-frame encode outputs -> slice-data bitstream on device.
+def _nc_grid(grid):
+    """nC for every block position of a (BH, BW) TotalCoeff grid —
+    elementwise shifted reads (9.2.1 availability: left/top within the
+    slice), no per-block gather. Used instead of the old flat fancy-index
+    reads so the per-MB structure compacts with plain row scatters."""
+    bh, bw = grid.shape
+    left = jnp.pad(grid, ((0, 0), (1, 0)))[:, :-1]
+    top = jnp.pad(grid, ((1, 0), (0, 0)))[:-1]
+    has_l = jnp.arange(bw, dtype=jnp.int32)[None, :] > 0
+    has_t = jnp.arange(bh, dtype=jnp.int32)[:, None] > 0
+    both = (left + top + 1) >> 1
+    return jnp.where(
+        has_l & has_t, both,
+        jnp.where(has_l, left, jnp.where(has_t, top, 0)))
 
-    Returns (words (word_cap,) uint32 big-endian bit order, nbits int32,
-    trailing_skip int32). The stream covers everything between the slice
-    header and the final skip_run — the host splices it after its own
-    header bits and finishes the NAL.
+
+def _frame_structure(out):
+    """Full-grid per-MB syntax structure — the CHEAP half of the coder.
+
+    Everything here is elementwise work or an O(M) prefix scan over the
+    MB grid: skip runs, mv prediction, cbp, TotalCoeff/nC context grids,
+    header codewords, and the per-MB residual blocks re-laid into coding
+    order. No VLC one-hot contraction or bit packing happens yet, so
+    this pass costs the same for a busy and an idle frame — the
+    expensive emission half (`_emit_slice_bits`) runs on the (optionally
+    activity-compacted) structure it returns. Every per-MB array keys
+    into `_COMPACT_KEYS` so `_compact_structure` can gather the coded
+    MBs into a dense prefix with one row scatter each.
     """
     mvs = out["mvs"]
     skip = out["skip"]
@@ -458,7 +557,6 @@ def pack_p_slice_bits(out, word_cap: int = WORD_CAP_DEFAULT):
     # ---- frame-wide structure ------------------------------------------
     coded = ~skip
     # cbp per MB
-    l8 = luma_scan.reshape(mbh, mbw, 2, 2, 2, 2, 16)  # (.., y8, y4, x8... ) careful below
     # 8x8 group b8 = (y4>>1)*2 + (x4>>1): regroup (4,4) block grid into 2x2 of 2x2
     lg = luma_scan.reshape(mbh, mbw, 2, 2, 2, 2, 16).transpose(0, 1, 2, 4, 3, 5, 6)
     # lg[.., y8, x8, y4in, x4in, :]
@@ -489,81 +587,53 @@ def pack_p_slice_bits(out, word_cap: int = WORD_CAP_DEFAULT):
     ch_tc_grid = jnp.where(ch_gate, ch_total, 0)
     ch_tc_flat = ch_tc_grid.transpose(2, 0, 3, 1, 4).reshape(2, mbh * 2, mbw * 2)
 
-    def nc_from(grid, flat_by, flat_bx, has_l, has_t):
-        # availability comes from the CALLER (a chroma component's row 0
-        # must not read the other component's bottom row in the stacked
-        # grid)
-        left = jnp.pad(grid, ((0, 0), (1, 0)))[:, :-1]
-        top = jnp.pad(grid, ((1, 0), (0, 0)))[:-1]
-        both = (left[flat_by, flat_bx] + top[flat_by, flat_bx] + 1) >> 1
-        nc = jnp.where(
-            has_l & has_t, both,
-            jnp.where(has_l, left[flat_by, flat_bx],
-                      jnp.where(has_t, top[flat_by, flat_bx], 0)),
-        )
-        return nc
-
-    # ---- per-block encodings -------------------------------------------
-    # luma: MBs x 16 blocks in coding order
+    # ---- per-block inputs (coding order) -------------------------------
+    # luma: MBs x 16 blocks in coding order. Block reorder as a STATIC
+    # take over the 16-block axis: the equivalent multi-array fancy
+    # gather lowers to a general gather that costs ~200 ms/frame on v5e
+    # (tools/profile_device_entropy.py); nC likewise comes from the
+    # elementwise grid (_nc_grid) statically re-laid into coding order.
     ox, oy = jnp.asarray(_LUMA_ORDER)[:, 0], jnp.asarray(_LUMA_ORDER)[:, 1]
-    mby = jnp.broadcast_to(jnp.arange(mbh)[:, None, None], (mbh, mbw, 16))
-    mbx = jnp.broadcast_to(jnp.arange(mbw)[None, :, None], (mbh, mbw, 16))
-    oyb = jnp.broadcast_to(oy[None, None, :], (mbh, mbw, 16))
-    oxb = jnp.broadcast_to(ox[None, None, :], (mbh, mbw, 16))
-    by = (mby * 4 + oyb).reshape(-1)
-    bx = (mbx * 4 + oxb).reshape(-1)
-    nc_luma = nc_from(luma_tc_flat, by, bx, bx > 0, by > 0)
-    # block reorder as a STATIC take over the 16-block axis: the
-    # equivalent multi-array fancy gather lowers to a general gather
-    # that costs ~200 ms/frame on v5e (tools/profile_cavlc_device.py)
     luma_perm = jnp.asarray(
         np.asarray(_LUMA_ORDER)[:, 1] * 4 + np.asarray(_LUMA_ORDER)[:, 0]
     )
+    nc_luma = jnp.take(
+        _nc_grid(luma_tc_flat).reshape(mbh, 4, mbw, 4).transpose(0, 2, 1, 3)
+        .reshape(M, 16),
+        luma_perm, axis=1,
+    )  # (M, 16) in coding order
     luma_blocks = jnp.take(
         luma_scan.reshape(mbh, mbw, 16, 16), luma_perm, axis=2
-    ).reshape(-1, 16)  # (M*16, 16) in coding order
-    lv, lb, _ = _encode_blocks(luma_blocks, nc_luma, chroma_dc=False)
+    ).reshape(M, 16, 16)  # (M, 16, 16) in coding order
     # gate: block emitted iff MB coded & its b8 set
     b8_idx = (oy // 2) * 2 + (ox // 2)
     luma_emit = (
         coded[..., None] & ((cbp_luma[..., None] >> b8_idx[None, None]) & 1).astype(bool)
-    ).reshape(-1)
-    lb = jnp.where(luma_emit[:, None], lb, 0)
+    ).reshape(M, 16)
 
     # chroma DC: MBs x 2 comps (4-coeff blocks, nc = -1)
-    cdc_blocks = cdc.reshape(-1, 4)
-    dv, db, _ = _encode_blocks(cdc_blocks, jnp.full((M * 2,), -1, jnp.int32), chroma_dc=True)
+    cdc_blocks = cdc.reshape(M, 2, 4)
     cdc_emit = jnp.broadcast_to(
         (coded & (cbp_chroma >= 1))[..., None], (mbh, mbw, 2)
-    ).reshape(-1)
-    db = jnp.where(cdc_emit[:, None], db, 0)
+    ).reshape(M, 2)
 
-    # chroma AC: MBs x 2 comps x 4 blocks in coding order, 15 coeffs
-    cox, coy = jnp.asarray(_CHROMA_ORDER)[:, 0], jnp.asarray(_CHROMA_ORDER)[:, 1]
-    cmby = jnp.broadcast_to(jnp.arange(mbh)[:, None, None, None], (mbh, mbw, 2, 4))
-    cmbx = jnp.broadcast_to(jnp.arange(mbw)[None, :, None, None], (mbh, mbw, 2, 4))
-    comp_b = jnp.broadcast_to(jnp.arange(2)[None, None, :, None], (mbh, mbw, 2, 4))
-    coyb = jnp.broadcast_to(coy[None, None, None, :], (mbh, mbw, 2, 4))
-    coxb = jnp.broadcast_to(cox[None, None, None, :], (mbh, mbw, 2, 4))
-    cby_b = (cmby * 2 + coyb).reshape(-1)
-    cbx_b = (cmbx * 2 + coxb).reshape(-1)
-    comp_f = comp_b.reshape(-1)
-    nc_ch = nc_from(
-        ch_tc_flat.reshape(2 * mbh * 2, mbw * 2),
-        comp_f * (mbh * 2) + cby_b, cbx_b,
-        cbx_b > 0, cby_b > 0,
-    )
+    # chroma AC: MBs x 2 comps x 4 blocks in coding order, 15 coeffs.
+    # nC per component from its OWN grid (a component's row 0 must not
+    # read the other component's bottom row).
     ch_perm = jnp.asarray(
         np.asarray(_CHROMA_ORDER)[:, 1] * 2 + np.asarray(_CHROMA_ORDER)[:, 0]
     )
+    nc_ch = jnp.take(
+        jnp.stack([_nc_grid(ch_tc_flat[c]) for c in range(2)])
+        .reshape(2, mbh, 2, mbw, 2).transpose(1, 3, 0, 2, 4).reshape(M, 2, 4),
+        ch_perm, axis=2,
+    ).reshape(M, 8)
     ch_blocks = jnp.take(
         chroma_scan.reshape(mbh, mbw, 2, 4, 16), ch_perm, axis=3
-    ).reshape(-1, 16)[:, 1:]  # (M*8, 15) in coding order
-    cv, cb, _ = _encode_blocks(ch_blocks, nc_ch, chroma_dc=False)
+    ).reshape(M, 8, 16)[..., 1:]  # (M, 8, 15) in coding order
     ch_emit = jnp.broadcast_to(
         (coded & (cbp_chroma == 2))[..., None, None], (mbh, mbw, 2, 4)
-    ).reshape(-1)
-    cb = jnp.where(ch_emit[:, None], cb, 0)
+    ).reshape(M, 8)
 
     # ---- MB headers -----------------------------------------------------
     # skip_run before each coded MB: # of consecutive skips immediately
@@ -597,10 +667,69 @@ def pack_p_slice_bits(out, word_cap: int = WORD_CAP_DEFAULT):
     emit_mb = coded_flat.astype(bool)
     hdr_bits = jnp.where(emit_mb[:, None], hdr_bits, 0)
 
+    # trailing skip run (after the last coded MB)
+    last_coded = prev_coded_pos[-1]
+    trailing = jnp.where(last_coded >= 0, csum_skip[-1] - csum_at[last_coded + 1], csum_skip[-1])
+    return {
+        "hdr_vals": hdr_vals, "hdr_bits": hdr_bits,
+        "luma_blocks": luma_blocks, "nc_luma": nc_luma, "luma_emit": luma_emit,
+        "cdc_blocks": cdc_blocks, "cdc_emit": cdc_emit,
+        "ch_blocks": ch_blocks, "nc_ch": nc_ch, "ch_emit": ch_emit,
+        "coded": emit_mb, "trailing": trailing,
+        "ns": coded_flat.sum().astype(jnp.int32),
+    }
+
+
+# per-MB arrays the activity compaction gathers into a dense prefix
+_COMPACT_KEYS = (
+    "hdr_vals", "hdr_bits", "luma_blocks", "nc_luma", "luma_emit",
+    "cdc_blocks", "cdc_emit", "ch_blocks", "nc_ch", "ch_emit",
+)
+
+
+def _compact_structure(s, A: int):
+    """Gather the coded MBs of a frame structure into a dense prefix of
+    `A` padded slots (raster order preserved; slots past the coded count
+    stay all-zero, so their segments emit zero bits and vanish in the
+    merge). One row scatter per array — M near-unique updates each, the
+    same cheap shape as encoder_core's sparse pair compaction. Coded MBs
+    past slot A are DROPPED: the caller must only select this path when
+    ns <= A (pack_p_slice_bits_active's bucket switch guarantees it)."""
+    coded = s["coded"]
+    pos = jnp.cumsum(coded.astype(jnp.int32)) - 1
+    dest = jnp.where(coded & (pos < A), pos, A)  # sentinel row, dropped
+
+    def cp(a):
+        buf = jnp.zeros((A + 1,) + a.shape[1:], a.dtype)
+        return buf.at[dest].set(a)[:A]
+
+    return {k: cp(s[k]) for k in _COMPACT_KEYS}
+
+
+def _emit_slice_bits(s, word_cap: int):
+    """The EXPENSIVE half: VLC-encode every block of a (possibly
+    compacted) per-MB structure, pack each segment's codewords into bit
+    buffers, and merge them into one stream. Cost scales with the
+    structure's leading axis (U MBs), which is what makes the bucket
+    compaction activity-proportional. Returns (words, nbits)."""
+    U = s["hdr_bits"].shape[0]
+    lv, lb, _ = _encode_blocks(
+        s["luma_blocks"].reshape(U * 16, 16), s["nc_luma"].reshape(-1),
+        chroma_dc=False)
+    lb = jnp.where(s["luma_emit"].reshape(-1)[:, None], lb, 0)
+    dv, db, _ = _encode_blocks(
+        s["cdc_blocks"].reshape(U * 2, 4),
+        jnp.full((U * 2,), -1, jnp.int32), chroma_dc=True)
+    db = jnp.where(s["cdc_emit"].reshape(-1)[:, None], db, 0)
+    cv, cb, _ = _encode_blocks(
+        s["ch_blocks"].reshape(U * 8, 15), s["nc_ch"].reshape(-1),
+        chroma_dc=False)
+    cb = jnp.where(s["ch_emit"].reshape(-1)[:, None], cb, 0)
+
     # ---- assemble: MB unit = header + 16 luma + 2 cdc + 8 cac ----------
     HW = 4      # header words (6 codewords <= 78 bits)
     BW = 32     # per-block words (hard bound: 16+3+16*52+9+14*11 = 1014 bits)
-    hdr_w, hdr_n = _pack_pairs(hdr_vals, hdr_bits, HW)
+    hdr_w, hdr_n = _pack_pairs(s["hdr_vals"], s["hdr_bits"], HW)
     luma_w, luma_n = _pack_pairs(lv, lb, BW)
     cdc_w, cdc_n = _pack_pairs(dv, db, BW)
     cac_w, cac_n = _pack_pairs(cv, cb, BW)
@@ -609,24 +738,81 @@ def pack_p_slice_bits(out, word_cap: int = WORD_CAP_DEFAULT):
     # header, luma blocks 0..15, cdc 0..1, cac 0..7
     seg_words = jnp.concatenate(
         [
-            jnp.pad(hdr_w.reshape(M, 1, HW), ((0, 0), (0, 0), (0, BW - HW))),
-            luma_w.reshape(M, 16, BW),
-            cdc_w.reshape(M, 2, BW),
-            cac_w.reshape(M, 8, BW),
+            jnp.pad(hdr_w.reshape(U, 1, HW), ((0, 0), (0, 0), (0, BW - HW))),
+            luma_w.reshape(U, 16, BW),
+            cdc_w.reshape(U, 2, BW),
+            cac_w.reshape(U, 8, BW),
         ],
         axis=1,
-    ).reshape(M * 27, BW)
+    ).reshape(U * 27, BW)
     seg_bits = jnp.concatenate(
-        [hdr_n.reshape(M, 1), luma_n.reshape(M, 16), cdc_n.reshape(M, 2),
-         cac_n.reshape(M, 8)],
+        [hdr_n.reshape(U, 1), luma_n.reshape(U, 16), cdc_n.reshape(U, 2),
+         cac_n.reshape(U, 8)],
         axis=1,
-    ).reshape(M * 27)
-    words, nbits = _merge_streams(seg_words, seg_bits, word_cap)
+    ).reshape(U * 27)
+    return _merge_streams(seg_words, seg_bits, word_cap)
 
-    # trailing skip run (after the last coded MB)
-    last_coded = prev_coded_pos[-1]
-    trailing = jnp.where(last_coded >= 0, csum_skip[-1] - csum_at[last_coded + 1], csum_skip[-1])
-    return words, nbits, trailing
+
+def pack_p_slice_bits(out, word_cap: int = WORD_CAP_DEFAULT):
+    """P-frame encode outputs -> slice-data bitstream on device,
+    FULL-GRID (every MB pays the emission cost regardless of activity).
+
+    Returns (words (word_cap,) uint32 big-endian bit order, nbits int32,
+    trailing_skip int32). The stream covers everything between the slice
+    header and the final skip_run — the host splices it after its own
+    header bits and finishes the NAL. Production paths use
+    pack_p_slice_bits_active; this fixed-shape form remains the oracle
+    for tests and the cost baseline for tools/profile_device_entropy.py.
+    """
+    s = _frame_structure(out)
+    words, nbits = _emit_slice_bits(s, word_cap)
+    return words, nbits, s["trailing"]
+
+
+def bits_buckets(m: int, ladder=(256, 1024, 4096)) -> tuple[int, ...]:
+    """Activity buckets for a slice of `m` MBs: the power-of-two-ish
+    ladder clipped to the grid, always ending at m so every frame has a
+    bucket. Tiny slices (tests, bands of small frames) collapse to a
+    single full-grid bucket — no switch, no extra compile."""
+    m = int(m)
+    return tuple(sorted({min(int(b), m) for b in ladder} | {m}))
+
+
+def pack_p_slice_bits_active(out, word_cap: int = WORD_CAP_DEFAULT,
+                             buckets: tuple[int, ...] | None = None):
+    """Activity-proportional device CAVLC: like pack_p_slice_bits, but
+    the emission half runs over a compacted padded count of coded MBs.
+
+    The bucket (smallest entry >= the frame's coded-MB count ns) is
+    selected ON DEVICE with lax.switch — all buckets compile into the
+    one executable, each frame executes only its own, so a typing frame
+    pays the 256-slot coder while a scene cut pays the full grid.
+    Returns (words, nbits, trailing_skip, ns); ns lets the caller make
+    its ship-bits-or-coefficients decision in the same jit. Output is
+    bit-identical to the full-grid coder for every ns (compaction
+    preserves raster order; padded slots emit zero bits)."""
+    s = _frame_structure(out)
+    M = s["coded"].shape[0]
+    if buckets is None:
+        buckets = bits_buckets(M)
+    ns = s["ns"]
+    if len(buckets) == 1:
+        A = buckets[0]
+        words, nbits = _emit_slice_bits(
+            s if A >= M else _compact_structure(s, A), word_cap)
+        return words, nbits, s["trailing"], ns
+
+    def _branch(A: int):
+        if A >= M:
+            return lambda _: _emit_slice_bits(s, word_cap)
+        return lambda _: _emit_slice_bits(_compact_structure(s, A), word_cap)
+
+    idx = jnp.clip(
+        jnp.searchsorted(jnp.asarray(buckets, jnp.int32), ns, side="left"),
+        0, len(buckets) - 1)
+    words, nbits = jax.lax.switch(idx, [_branch(b) for b in buckets],
+                                  jnp.int32(0))
+    return words, nbits, s["trailing"], ns
 
 
 # ---------------------------------------------------------------------------
@@ -658,17 +844,21 @@ def assemble_p_nal(words: np.ndarray, nbits: int, trailing_skip: int,
                    p, frame_num: int, qp: int,
                    ltr_ref: int | None = None,
                    mark_ltr: int | None = None,
-                   mmco_evict: tuple = ()) -> bytes:
+                   mmco_evict: tuple = (),
+                   first_mb: int = 0) -> bytes:
     """Finish a P slice from device bits: header + stream + trailing
     skip_run + rbsp stop, emulation-prevented and Annex-B wrapped.
-    Byte-identical to cavlc.pack_slice_p for the same inputs."""
+    Byte-identical to cavlc.pack_slice_p for the same inputs. first_mb
+    positions a band slice of a multi-slice picture (parallel/bands.py)
+    — it lives entirely in the host-written header, so the device words
+    are the same with or without it."""
     from selkies_tpu.models.h264.bitstream import SLICE_P, NAL_SLICE_NON_IDR, write_slice_header
     from selkies_tpu.utils.bits import BitWriter, annexb_nal
 
     w = BitWriter()
     write_slice_header(w, p, SLICE_P, frame_num, idr=False, slice_qp=qp,
                        ltr_ref=ltr_ref, mark_ltr=mark_ltr,
-                       mmco_evict=mmco_evict)
+                       mmco_evict=mmco_evict, first_mb=first_mb)
     hdr_bytes, hdr_bits = w.get_partial()
 
     dev_bytes = np.ascontiguousarray(words[: (nbits + 31) // 32]).astype(">u4").view(np.uint8)

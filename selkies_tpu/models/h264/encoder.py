@@ -39,6 +39,7 @@ from selkies_tpu.models.h264.bitstream import StreamParams, write_pps, write_sps
 from selkies_tpu.models.h264.compact import (
     i_header_words,
     p_header_words,
+    p_sparse_entropy_words,
     p_sparse_packed_words,
     p_sparse_var_words,
     split_prefix,
@@ -48,7 +49,8 @@ from selkies_tpu.models.h264.compact import (
 from selkies_tpu.models.h264.device_cavlc import (
     WORD_CAP_DEFAULT as BITS_WORD_CAP,
     assemble_p_nal,
-    pack_p_slice_bits,
+    pack_p_slice_bits_active,
+    resolve_entropy,
 )
 from selkies_tpu.models.h264.encoder_core import (
     encode_frame_p_planes,
@@ -56,6 +58,7 @@ from selkies_tpu.models.h264.encoder_core import (
     fuse_downlink,
     pack_i_compact,
     pack_p_compact,
+    pack_p_sparse_entropy,
     pack_p_sparse_packed,
     pack_p_sparse_var,
     scatter_tiles,
@@ -107,6 +110,10 @@ NSCAP = 4096
 # + the first BITS_PREFIX_WORDS words; bigger frames spill one extra
 # fetch; frames overflowing the word cap fall back to the dense path.
 BITS_PREFIX_WORDS = 1 << 16  # 256 KB: covers typical full-P slices in ONE fetch
+# Delta frames run the same device entropy coder activity-proportionally
+# (pack_p_sparse_entropy); the live-MB threshold and the rest of the
+# knob resolution live in device_cavlc.resolve_entropy, shared with the
+# banded encoder.
 
 
 def _device_step(frame, qp, *, pad_h: int, pad_w: int, channels: int):
@@ -140,10 +147,12 @@ def _p_planes_step(y, u, v, qp, ref_y, ref_u, ref_v):
 
 def _p_bits_step(y, u, v, qp, ref_y, ref_u, ref_v):
     """Full-P with ON-DEVICE entropy coding: what crosses the link is the
-    slice bitstream itself. Dense header/buf ride along device-side only,
-    as the overflow fallback (fetched on the rare nbits > cap frame)."""
+    slice bitstream itself. The activity-proportional coder picks its
+    bucket per frame (a moderately-busy scene cut pays for its live MBs,
+    not the grid). Dense header/buf ride along device-side only, as the
+    overflow fallback (fetched on the rare nbits > cap frame)."""
     out = encode_frame_p_planes(y, u, v, ref_y, ref_u, ref_v, qp)
-    words, nbits, trailing = pack_p_slice_bits(out, BITS_WORD_CAP)
+    words, nbits, trailing, _ns = pack_p_slice_bits_active(out, BITS_WORD_CAP)
     nskip = out["skip"].sum().astype(jnp.int32)
     meta = jnp.stack([nbits, trailing, nskip]).astype(jnp.uint32)
     prefix = jnp.concatenate([meta, words[:BITS_PREFIX_WORDS]])
@@ -197,21 +206,29 @@ def _unpack_delta(packed, w):
     return yb, ub, vb, idx
 
 
-def _pack_sparse_p(out, nscap, cap, density):
+def _pack_sparse_p(out, nscap, cap, density, entropy=None):
     """Delta-P downlink packer: density=None keeps the 16-lane row
     layout (pack_p_sparse_var); an int percent enables the bit-packed
-    rows with that dense-fallback cap (pack_p_sparse_packed)."""
+    rows with that dense-fallback cap (pack_p_sparse_packed). entropy
+    (bits_words, min_mbs, buckets) wraps either layout in the
+    activity-proportional device-entropy decision (pack_p_sparse_
+    entropy): busy frames then ship final slice bits, quiet frames the
+    sparse rows — same fused-buffer fetch either way."""
+    if entropy is not None:
+        bits_words, min_mbs, buckets = entropy
+        return pack_p_sparse_entropy(out, nscap, cap, density,
+                                     bits_words, min_mbs, buckets)
     if density is None:
         return pack_p_sparse_var(out, nscap, cap)
     return pack_p_sparse_packed(out, nscap, cap, density)
 
 
 def _p_scatter_step(packed, qp, sy, su, sv, ref_y, ref_u, ref_v, *, nscap, cap, tile_w,
-                    density=None):
+                    density=None, entropy=None):
     yb, ub, vb, idx = _unpack_delta(packed, tile_w)
     y, u, v = scatter_tiles(sy, su, sv, yb, ub, vb, idx, tile_w)
     out = encode_frame_p_planes(y, u, v, ref_y, ref_u, ref_v, qp)
-    prefix, dense, buf = _pack_sparse_p(out, nscap, cap, density)
+    prefix, dense, buf = _pack_sparse_p(out, nscap, cap, density, entropy)
     return prefix, dense, buf, out["recon_y"], out["recon_u"], out["recon_v"], y, u, v
 
 
@@ -225,7 +242,7 @@ def _i_scatter_step(packed, qp, sy, su, sv, *, tile_w):
 
 
 def _p_scatter_multi_step(packed_a, packed_b, qps, sy, su, sv, ref_y, ref_u, ref_v,
-                          *, nscap, cap, tile_w, density=None):
+                          *, nscap, cap, tile_w, density=None, entropy=None):
     """K delta frames in ONE device round trip.
 
     packed_a/packed_b: two (K/2, F) uint8 halves of the K frames' tile
@@ -244,7 +261,7 @@ def _p_scatter_multi_step(packed_a, packed_b, qps, sy, su, sv, ref_y, ref_u, ref
         yb, ub, vb, idx = _unpack_delta(pk, tile_w)
         y, u, v = scatter_tiles(cy, cu, cv, yb, ub, vb, idx, tile_w)
         out = encode_frame_p_planes(y, u, v, ry, ru, rv, qp)
-        prefix, dense, buf = _pack_sparse_p(out, nscap, cap, density)
+        prefix, dense, buf = _pack_sparse_p(out, nscap, cap, density, entropy)
         return (
             (y, u, v, out["recon_y"], out["recon_u"], out["recon_v"]),
             (prefix, dense, buf),
@@ -382,11 +399,11 @@ def _pool_seed_step(pairs, sy, su, sv, py, pu, pv, *, tile_w, sbucket):
 
 
 def _p_scatter_step2(packed, qp, sy, su, sv, py, pu, pv, ref_y, ref_u, ref_v,
-                     *, nscap, cap, tile_w, bucket, cbucket, density):
+                     *, nscap, cap, tile_w, bucket, cbucket, density, entropy=None):
     y, u, v, qy, qu, qv = _apply_tiles2(
         sy, su, sv, py, pu, pv, packed, tile_w=tile_w, bucket=bucket, cbucket=cbucket)
     out = encode_frame_p_planes(y, u, v, ref_y, ref_u, ref_v, qp)
-    prefix, dense, buf = _pack_sparse_p(out, nscap, cap, density)
+    prefix, dense, buf = _pack_sparse_p(out, nscap, cap, density, entropy)
     return (prefix, dense, buf, out["recon_y"], out["recon_u"], out["recon_v"],
             y, u, v, qy, qu, qv)
 
@@ -403,7 +420,8 @@ def _i_scatter_step2(packed, qp, sy, su, sv, py, pu, pv, *, tile_w, bucket, cbuc
 
 def _p_scatter_multi_step2(packed_a, packed_b, qps, sy, su, sv, py, pu, pv,
                            ref_y, ref_u, ref_v,
-                           *, nscap, cap, tile_w, bucket, cbucket, density):
+                           *, nscap, cap, tile_w, bucket, cbucket, density,
+                           entropy=None):
     """Grouped (lax.scan) variant of _p_scatter_step2: the slot pool
     rides in the carry, so frame k's copy remaps may reference slots
     frame k-1's uploads inserted — matching the host cache's sequential
@@ -416,7 +434,7 @@ def _p_scatter_multi_step2(packed_a, packed_b, qps, sy, su, sv, py, pu, pv,
         y, u, v, qy, qu, qv = _apply_tiles2(
             cy, cu, cv, qy, qu, qv, pk, tile_w=tile_w, bucket=bucket, cbucket=cbucket)
         out = encode_frame_p_planes(y, u, v, ry, ru, rv, qp)
-        prefix, dense, buf = _pack_sparse_p(out, nscap, cap, density)
+        prefix, dense, buf = _pack_sparse_p(out, nscap, cap, density, entropy)
         return (
             (y, u, v, qy, qu, qv, out["recon_y"], out["recon_u"], out["recon_v"]),
             (prefix, dense, buf),
@@ -497,7 +515,8 @@ class TPUH264Encoder:
         pipeline_depth: int = 2,
         frame_batch: int = 4,
         scene_qp_boost: int = 0,
-        device_entropy: bool = True,
+        device_entropy: bool | None = None,
+        bits_min_mbs: int | None = None,
         ltr_scenes: bool = True,
         tile_cache: int | None = None,
         packed_downlink: bool | None = None,
@@ -563,6 +582,26 @@ class TPUH264Encoder:
                 width, height, self._pad_w, self._pad_h,
                 nslots=self.pipeline_depth + 2,
             )
+        # device_entropy: P frames emit their slice BITSTREAM on device
+        # (device_cavlc.py) — the downlink is the final bits, not
+        # coefficient tensors. Full-P frames always ship bits when this
+        # is on; delta frames decide per frame ON DEVICE (busy frames —
+        # >= bits_min_mbs live MBs — ship bits, quiet frames keep the
+        # sparse coeff downlink whose host pack is already near-free).
+        # Requires host conversion mode (the only production path);
+        # byte-identical either way. Default is AUTO — on for real TPU
+        # backends, off on CPU; SELKIES_DEVICE_ENTROPY=0/1 forces,
+        # SELKIES_BITS_MIN_MBS moves the decision threshold; explicit
+        # constructor arguments win (tile_cache precedence rules). The
+        # resolved consts (_entropy) are what the jitted delta steps
+        # close over: bits payload cap, live-MB threshold, bucket ladder.
+        (self.device_entropy, self.bits_min_mbs, self._bits_words,
+         self._entropy) = resolve_entropy(
+            (self._pad_h // 16) * (self._pad_w // 16),
+            device_entropy, bits_min_mbs)
+        if self._prep is None:  # device conversion mode: host path only
+            self.device_entropy = False
+            self._entropy = None
         if self._prep is not None:
             self._step = jax.jit(_i_planes_step_chunked)
             self._step_p = jax.jit(_p_planes_step_chunked, donate_argnums=(7, 8, 9))
@@ -574,7 +613,7 @@ class TPUH264Encoder:
             # function object, so a global read would leak one encoder's
             # constants into another's executable.
             _consts = dict(nscap=self._nscap, cap=self._cap_delta, tile_w=self._tile_w,
-                           density=self._density)
+                           density=self._density, entropy=self._entropy)
             self._step_scatter_p = jax.jit(
                 partial(_p_scatter_step, **_consts), donate_argnums=(2, 3, 4, 5, 6, 7)
             )
@@ -618,11 +657,6 @@ class TPUH264Encoder:
         # frames after it re-sharpen within a few hundred ms. 0 = off
         # (keeps delta-vs-full bit-exactness tests meaningful).
         self.scene_qp_boost = int(scene_qp_boost)
-        # device_entropy: full-P frames emit their slice BITSTREAM on
-        # device (device_cavlc.py) — the downlink is the final bits, not
-        # coefficient tensors. Requires host conversion mode (the only
-        # production path); byte-identical either way.
-        self.device_entropy = bool(device_entropy)
         self._prev_kind = "full"  # first frame is not a "scene cut"
         self.frame_batch = max(1, int(frame_batch))
         # scan executables compile for these group sizes only (greedy
@@ -727,7 +761,11 @@ class TPUH264Encoder:
         # trace: typing needs 17.6k -> 8.9k words, i.e. a 164 KB full
         # fetch becomes the 32 KB small one). Still exactly TWO fetch
         # shapes (see PFX_SMALL).
-        if self._density is not None:
+        if self._entropy is not None:
+            self._pfx_total = p_sparse_entropy_words(
+                mbh, mbw, self._nscap, self._cap_delta,
+                self._density is not None, self._bits_words)
+        elif self._density is not None:
             self._pfx_total = p_sparse_packed_words(mbh, mbw, self._nscap, self._cap_delta)
         else:
             self._pfx_total = p_sparse_var_words(mbh, mbw, self._nscap, self._cap_delta)
@@ -1000,7 +1038,8 @@ class TPUH264Encoder:
         if fn is None:
             consts = dict(tile_w=self._tile_w, bucket=bucket, cbucket=cbucket)
             pconsts = dict(nscap=self._nscap, cap=self._cap_delta,
-                           density=self._density, **consts)
+                           density=self._density, entropy=self._entropy,
+                           **consts)
             if kind == "p":
                 fn = jax.jit(partial(_p_scatter_step2, **pconsts),
                              donate_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 10))
@@ -1367,27 +1406,31 @@ class TPUH264Encoder:
             self._pfx_recent.append(need)
 
     def _complete_sparse_p(self, fused, fused_d, dense_d, buf_d, rec):
-        """One delta frame's fused slice -> finished slice NAL, sparse
-        end-to-end when the native packer is available.
+        """One delta frame's fused slice -> finished slice NAL: spliced
+        straight from device bits when the frame shipped them, sparse
+        end-to-end otherwise (native packer when available).
 
         The shared per-slice flow (sparse_complete.complete_sparse_slice)
-        handles slice shortfall, row spill past the cap, and the
+        reads the entropy meta (when enabled) and handles the bits
+        splice, slice shortfall, row spill past the cap, and the
         ns > nscap dense-header fallback, for either sparse layout
         (bit-packed when self._density is set). fused_d is a per-frame
         FULL-row handle created at dispatch time: the shortfall refetch
         is then a pure transfer — slicing here (a device op) would queue
         behind scans dispatched since.
-        Returns (au, skipped_mbs, t_start, t_unpacked, t_done)."""
+        Returns (au, skipped_mbs, t_start, t_unpacked, t_done, mode)."""
         t1 = time.perf_counter()
-        au, skipped, tu = complete_sparse_slice(
+        au, skipped, tu, mode = complete_sparse_slice(
             fused, mbh=self._mbh, mbw=self._mbw, nscap=self._nscap,
             cap_rows=self._cap_delta, qp=rec.qp, frame_num=rec.frame_num,
             params=self.params, packed=self._density is not None,
+            device_bits=self._entropy is not None,
             full_d=fused_d, buf_d=buf_d, dense_d=dense_d,
-            link_bytes=self.link_bytes, note_need=self._note_need,
+            link_bytes=self.link_bytes, prefix_bytes=fused.nbytes,
+            note_need=self._note_need,
             ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
             mmco_evict=rec.mmco_evict)
-        return au, skipped, t1, tu, time.perf_counter()
+        return au, skipped, t1, tu, time.perf_counter(), mode
 
     def _complete_batch(self, recs, pfx_slice_d, pfx_rows_d, denses_d, bufs_d):
         """Worker half for a delta group: ONE transfer of the pre-sliced
@@ -1402,7 +1445,8 @@ class TPUH264Encoder:
         # the group shares ONE transfer: step/fetch attribution is the
         # group's, stamped onto every member frame
         fetch_ms = (time.perf_counter() - t_ready) * 1e3
-        self.link_bytes.add("down_prefix", prefixes.nbytes)
+        # down_prefix/down_bits accounting happens per slot inside
+        # complete_sparse_slice (only the meta read knows the mode)
         if self._pack_pool is not None and len(recs) > 1:
             futs = [
                 self._pack_pool.submit(
@@ -1731,10 +1775,10 @@ class TPUH264Encoder:
         # decoder, so null the ref (forces IDR) and drop the pipeline.
         try:
             if rec.batch_slot >= 0:
-                au, skipped, t1, tu, t2, step_ms, fetch_ms = (
+                au, skipped, t1, tu, t2, mode, step_ms, fetch_ms = (
                     rec.future.result()[rec.batch_slot])
             else:
-                au, skipped, t1, tu, t2, step_ms, fetch_ms = rec.future.result()
+                au, skipped, t1, tu, t2, mode, step_ms, fetch_ms = rec.future.result()
         except Exception:
             self._ref = None
             self._src = None
@@ -1749,6 +1793,7 @@ class TPUH264Encoder:
             scene_cut=rec.scene_cut,
             unpack_ms=(tu - t1) * 1e3, cavlc_ms=(t2 - tu) * 1e3,
             upload_ms=rec.up_ms, step_ms=step_ms, fetch_ms=fetch_ms,
+            downlink_mode=mode,
         )
         self.last_stats = stats
         return au, stats, rec.meta
@@ -1776,7 +1821,6 @@ class TPUH264Encoder:
             with tracer.span("fetch"):
                 fused = np.asarray(rec.pfx_slice_d)
             fetch_ms = (time.perf_counter() - t_ready) * 1e3
-            self.link_bytes.add("down_prefix", fused.nbytes)
             out = self._complete_sparse_p(fused, rec.prefix_d, rec.hdr_d,
                                           rec.buf_d, rec)
             self._update_pfx_hint()
@@ -1814,7 +1858,10 @@ class TPUH264Encoder:
                 au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num,
                                        ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
                                        mmco_evict=rec.mmco_evict)
-        return au, skipped, t1, tu, time.perf_counter(), step_ms, fetch_ms
+        # downlink_mode is a P-frame label ("" on the IDR row — keyframes
+        # can never ship device bits, so they must not count as "coeff")
+        mode = "coeff" if rec.kind != "i" else ""
+        return au, skipped, t1, tu, time.perf_counter(), mode, step_ms, fetch_ms
 
     def _complete_bits(self, rec: "_Pending"):
         """Device-entropy P frame: fetch [meta ++ bit words], splice the
@@ -1822,7 +1869,7 @@ class TPUH264Encoder:
         step_ms, t_ready = self._wait_step(rec, rec.prefix_d)
         arr = np.asarray(rec.prefix_d)  # uint32: nbits, trailing, nskip, words...
         fetch_ms = (time.perf_counter() - t_ready) * 1e3
-        self.link_bytes.add("down_prefix", arr.nbytes)
+        self.link_bytes.add("down_bits", arr.nbytes)
         nbits, trailing, skipped = int(arr[0]), int(arr[1]), int(arr[2])
         if nbits > BITS_WORD_CAP * 32:
             # pathological frame overflowed the bit buffer: dense fallback
@@ -1836,18 +1883,19 @@ class TPUH264Encoder:
                                    ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
                                    mmco_evict=rec.mmco_evict)
             return (au, int(pfc.skip.sum()), t1, tu, time.perf_counter(),
-                    step_ms, fetch_ms)
+                    "dense", step_ms, fetch_ms)
         need = (nbits + 31) // 32
         words = arr[3 : 3 + min(need, BITS_PREFIX_WORDS)]
         if need > BITS_PREFIX_WORDS:  # spill: one extra fetch
-            rest = _fetch_rest(rec.words_d, need, BITS_PREFIX_WORDS)
-            self.link_bytes.add("down_spill", rest.nbytes)
+            with tracer.span("bits_fetch"):
+                rest = _fetch_rest(rec.words_d, need, BITS_PREFIX_WORDS)
+            self.link_bytes.add("down_bits_spill", rest.nbytes)
             words = np.concatenate([words, rest])
         t1 = time.perf_counter()
         au = assemble_p_nal(words, nbits, trailing, self.params, rec.frame_num,
                             rec.qp, ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
                             mmco_evict=rec.mmco_evict)
-        return au, skipped, t1, t1, time.perf_counter(), step_ms, fetch_ms
+        return au, skipped, t1, t1, time.perf_counter(), "bits", step_ms, fetch_ms
 
     def encode_frame(self, frame: np.ndarray, qp: int | None = None) -> bytes:
         """Synchronous encode ((H, W, 4) BGRx or (H, W, 3) RGB uint8 in,

@@ -1048,6 +1048,68 @@ def pack_p_sparse_packed(out, nscap: int, cap_rows: int, density_pct: int = 75):
     return fused, dense, buf
 
 
+def pack_p_sparse_entropy(out, nscap: int, cap_rows: int,
+                          density_pct: int | None, bits_words: int,
+                          min_mbs: int, buckets: tuple[int, ...]):
+    """Activity-proportional entropy downlink: busy frames ship their
+    FINAL slice bits, quiet frames ship sparse coefficients — decided
+    per frame ON DEVICE, inside the same jit (so it composes with the
+    grouped lax.scan dispatch unchanged).
+
+    Wraps the existing sparse layouts (pack_p_sparse_var /
+    pack_p_sparse_packed — byte-for-byte the same payload, so the host
+    parses it with the unchanged compact.py machinery) and the
+    activity-compacted device CAVLC (device_cavlc.pack_p_slice_bits_
+    active). The fused buffer gains an 8-int32 meta prefix:
+
+      [mode, nbits, trailing_skip, nskip, ns, 0, 0, 0]   (16 int16)
+      ++ mode=0: the untouched sparse layout (coeff rows)
+         mode=1: the slice-data bit words (uint32, bit-cast)
+
+    mode=1 is chosen when the frame is busy enough to pay
+    (ns >= min_mbs), codeable (ns <= buckets[-1]) and the bits fit the
+    `bits_words` payload cap — otherwise the coefficient path runs
+    exactly as before (the word-cap overflow fallback). The sparse pack
+    is cheap scatters and the bits pack is activity-proportional, so
+    running both costs a quiet frame almost nothing; the decision only
+    selects which payload lands in the fused buffer. Returns
+    (fused, dense_header, buf) with the same fallback contract as the
+    wrapped sparse packers (dense/buf are coeff-mode-only fetches).
+    host half: models/h264/sparse_complete.complete_sparse_slice
+    (device_bits=True)."""
+    from selkies_tpu.models.h264.device_cavlc import pack_p_slice_bits_active
+
+    if density_pct is None:
+        fused, dense, buf = pack_p_sparse_var(out, nscap, cap_rows)
+    else:
+        fused, dense, buf = pack_p_sparse_packed(out, nscap, cap_rows, density_pct)
+    words, nbits, trailing, ns = pack_p_slice_bits_active(
+        out, word_cap=bits_words, buckets=buckets)
+    nskip = out["skip"].reshape(-1).sum().astype(jnp.int32)
+    use_bits = (
+        (ns >= jnp.int32(min_mbs))
+        & (ns <= jnp.int32(buckets[-1]))
+        & (nbits <= jnp.int32(32 * bits_words))
+    )
+    meta2 = jnp.stack([
+        use_bits.astype(jnp.int32), nbits, trailing, nskip, ns,
+        jnp.int32(0), jnp.int32(0), jnp.int32(0)])
+    head16 = jax.lax.bitcast_convert_type(meta2, jnp.int16).reshape(-1)
+    total16 = 16 + max(int(fused.shape[0]), 2 * bits_words)
+    fused2 = jnp.zeros((total16,), jnp.int16)
+
+    def wr_coeff(f):
+        return jax.lax.dynamic_update_slice(f, fused, (16,))
+
+    def wr_bits(f):
+        w16 = jax.lax.bitcast_convert_type(words, jnp.int16).reshape(-1)
+        return jax.lax.dynamic_update_slice(f, w16, (16,))
+
+    fused2 = jax.lax.cond(use_bits, wr_bits, wr_coeff, fused2)
+    fused2 = jax.lax.dynamic_update_slice(fused2, head16, (0,))
+    return fused2, dense, buf
+
+
 def fuse_downlink(header, buf, cap_rows: int):
     """Fuse header + the first cap_rows data rows into ONE int16 buffer.
 

@@ -14,12 +14,23 @@ Both host completion paths — the solo pipelined encoder's delta frames
      (``unpack_p_sparse_*`` + ``pack_slice_p_fast``) — including the
      ns > nscap dense-header fallback fetch where the caller has one.
 
+With ``device_bits=True`` the fused buffer is the entropy-wrapped
+layout (encoder_core.pack_p_sparse_entropy): an 8-int32 meta prefix
+whose mode flag says whether the payload is the unchanged sparse coeff
+layout (the flow above, applied to the offset view) or the frame's
+FINAL slice-data bits packed on device — in which case the host only
+splices the slice header around the fetched words (``assemble_p_nal``)
+and no coefficient unpack or CAVLC pack runs at all. That bits branch
+is what turns a busy delta frame's completion into a near-zero host
+tail (ISSUE 7 / PERF.md round 9).
+
 PR 5 duplicated this flow per band; this module is the one definition
 (flagged follow-up in CHANGES.md PR 5). The two callers differ only in
 slice geometry (full frame vs one band), the ``first_mb`` slice-header
 offset, and the LTR slice-header flags — all parameters here. Byte
 output is identical to both former inline flows by construction
-(tests/test_sparse_native_pack.py, tests/test_band_slices.py).
+(tests/test_sparse_native_pack.py, tests/test_band_slices.py,
+tests/test_device_entropy_sparse.py).
 """
 
 from __future__ import annotations
@@ -30,6 +41,8 @@ from typing import Callable
 import numpy as np
 
 from selkies_tpu.models.h264.compact import (
+    ENTROPY_META16,
+    p_sparse_entropy_meta,
     p_sparse_packed_need,
     p_sparse_var_need,
     p_sparse_wire_views,
@@ -37,6 +50,7 @@ from selkies_tpu.models.h264.compact import (
     unpack_p_sparse_packed,
     unpack_p_sparse_var,
 )
+from selkies_tpu.models.h264.device_cavlc import assemble_p_nal
 from selkies_tpu.models.h264.native import (
     pack_slice_p_fast,
     pack_slice_p_sparse_native,
@@ -72,17 +86,20 @@ def complete_sparse_slice(
     frame_num: int,
     params,
     packed: bool = False,
+    device_bits: bool = False,
     full_d=None,
     buf_d=None,
     dense_d=None,
     link_bytes=None,
+    prefix_bytes: int = 0,
     note_need: Callable[[int], None] | None = None,
     first_mb: int = 0,
     ltr_ref: int | None = None,
     mark_ltr: int | None = None,
     mmco_evict: tuple = (),
-) -> tuple[bytes, int, float]:
-    """One P slice's fused sparse downlink → (nal, skipped_mbs, t_unpacked).
+) -> tuple[bytes, int, float, str]:
+    """One P slice's fused sparse downlink → (nal, skipped_mbs,
+    t_unpacked, downlink_mode).
 
     ``fused`` is the (possibly hint-sized) fetched prefix; ``full_d`` the
     full-length device handle for the shortfall refetch, ``buf_d`` the
@@ -90,14 +107,56 @@ def complete_sparse_slice(
     fallback (callers whose nscap equals the slice MB count pass None —
     that branch is structurally unreachable for them). ``t_unpacked`` is
     the unpack→pack boundary timestamp for the caller's stage split.
+
+    ``prefix_bytes`` is the caller's already-fetched prefix size: the
+    accounting lives here (not at the fetch site) because only the meta
+    read knows whether those bytes were coefficient rows (``down_prefix``)
+    or device bits (``down_bits``) — bench.py splits the per-frame
+    downlink on exactly that stage-name prefix. ``downlink_mode`` is
+    "bits" (device-entropy payload), "dense" (ns > nscap dense-header
+    fallback) or "coeff" (sparse rows, either layout).
     """
+    off = 0
+    if device_bits:
+        mode, nbits, trailing, nskip, _ns = p_sparse_entropy_meta(fused)
+        if mode == 1:
+            # device-entropy payload: the words ARE the slice data —
+            # splice the header, no unpack, no host CAVLC
+            nw = (nbits + 31) // 32
+            need = ENTROPY_META16 + 2 * nw
+            if note_need is not None:
+                note_need(need)
+            if link_bytes is not None and prefix_bytes:
+                link_bytes.add("down_bits", prefix_bytes)
+            if need > len(fused):  # hint too small: refetch
+                # span marks only the EXTRA transfer (tracing.py contract
+                # — the main prefix fetch rode the caller's "fetch" span)
+                with tracer.span("bits_fetch"):
+                    fused = np.asarray(full_d)
+                if link_bytes is not None:
+                    link_bytes.add("down_bits_refetch", fused.nbytes)
+            words = np.ascontiguousarray(
+                fused[ENTROPY_META16:ENTROPY_META16 + 2 * nw]).view(np.uint32)
+            t_unpacked = time.perf_counter()
+            with tracer.span("pack"):
+                nal = assemble_p_nal(
+                    words, nbits, trailing, params, frame_num, qp,
+                    ltr_ref=ltr_ref, mark_ltr=mark_ltr,
+                    mmco_evict=mmco_evict, first_mb=first_mb)
+            return nal, nskip, t_unpacked, "bits"
+        # mode 0: the payload is the unchanged sparse layout at an offset
+        off = ENTROPY_META16
+        fused = fused[off:]
+    if link_bytes is not None and prefix_bytes:
+        link_bytes.add("down_prefix", prefix_bytes)
+    downlink_mode = "coeff"
     with tracer.span("unpack"):
         need_fn = p_sparse_packed_need if packed else p_sparse_var_need
         need, n, ns = need_fn(fused, mbh, mbw, nscap, cap_rows)
         if note_need is not None:
-            note_need(need)
+            note_need(need + off)
         if need > len(fused):  # hint too small: refetch the live content
-            fused = np.asarray(full_d)
+            fused = np.asarray(full_d)[off:]
             if link_bytes is not None:
                 link_bytes.add("down_refetch", fused.nbytes)
         extra = None
@@ -121,6 +180,7 @@ def complete_sparse_slice(
                 if link_bytes is not None:
                     link_bytes.add("down_spill", dense.nbytes)
                 pfc = unpack_p_compact(dense, rows, qp)
+                downlink_mode = "dense"
     t_unpacked = time.perf_counter()
     with tracer.span("pack"):
         if wire is not None:
@@ -133,4 +193,4 @@ def complete_sparse_slice(
                 pfc, params, frame_num=frame_num, ltr_ref=ltr_ref,
                 mark_ltr=mark_ltr, mmco_evict=mmco_evict, first_mb=first_mb)
             skipped = int(pfc.skip.sum())
-    return nal, skipped, t_unpacked
+    return nal, skipped, t_unpacked, downlink_mode

@@ -120,7 +120,8 @@ def _tpuh264enc(*, width: int, height: int, fps: int = 60, qp: int = 28, **kw):
         from selkies_tpu.parallel.bands import BandedH264Encoder
 
         dropped = set(kw) - {"frame_batch", "pipeline_depth",
-                             "keyframe_interval"}
+                             "keyframe_interval", "device_entropy",
+                             "bits_min_mbs"}
         if dropped:
             # the solo encoder's uplink machinery (tile cache, delta
             # paths, LTR scenes, scene QP boost) does not apply to band
@@ -134,6 +135,8 @@ def _tpuh264enc(*, width: int, height: int, fps: int = 60, qp: int = 28, **kw):
             frame_batch=kw.get("frame_batch", default_frame_batch()),
             pipeline_depth=kw.get("pipeline_depth", default_pipeline_depth()),
             keyframe_interval=kw.get("keyframe_interval", 0),
+            device_entropy=kw.get("device_entropy"),
+            bits_min_mbs=kw.get("bits_min_mbs"),
         )
     kw.setdefault("frame_batch", default_frame_batch())
     kw.setdefault("pipeline_depth", default_pipeline_depth())

@@ -61,3 +61,9 @@ class FrameStats:
     # per-band dispatch->ready latency when the frame was band-split
     bands: int = 1
     band_step_ms: tuple = ()
+    # which payload the P downlink shipped (ISSUE 7 / PERF.md round 9):
+    # "coeff" sparse coefficient rows, "bits" device-entropy slice bits,
+    # "dense" a dense-fallback fetch; "" for frames with no downlink
+    # (static all-skip) or encoder rows that don't attribute it. A
+    # banded frame reports "bits" only when EVERY band shipped bits.
+    downlink_mode: str = ""

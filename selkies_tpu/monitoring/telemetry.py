@@ -83,6 +83,10 @@ METRIC_FAMILIES: dict[str, str] = {
         "labeled by session",
     "selkies_link_bytes_total":
         "Host<->device link bytes, labeled by direction (up/down) and stage",
+    "selkies_downlink_mode_total":
+        "P-frame downlink payload mode per encoded frame (coeff = sparse "
+        "coefficient rows, bits = device-entropy slice bits, dense = "
+        "dense-fallback fetch), labeled by session and mode",
     "selkies_congestion_target_kbps":
         "GCC congestion-controller target bitrate, labeled by session",
     "selkies_congestion_loss_ratio":
@@ -126,6 +130,7 @@ _FAMILY_LABELS: dict[str, tuple[str, ...]] = {
     "selkies_tile_cache_tiles_total": ("session", "result"),
     "selkies_tile_cache_frames_total": ("session", "kind"),
     "selkies_link_bytes_total": ("direction", "stage"),
+    "selkies_downlink_mode_total": ("session", "mode"),
     "selkies_congestion_target_kbps": ("session",),
     "selkies_congestion_loss_ratio": ("session",),
     "selkies_congestion_rtt_ms": ("session",),
@@ -340,17 +345,27 @@ class Telemetry:
     def frame_done(self, frame: int, nbytes: int, *, idr: bool,
                    session: str = "0", device_ms: float = 0.0,
                    pack_ms: float = 0.0, unpack_ms: float = 0.0,
-                   cavlc_ms: float = 0.0) -> None:
+                   cavlc_ms: float = 0.0, downlink_mode: str = "",
+                   bits_fetch_ms: float = 0.0) -> None:
         """An encoded access unit left the encoder: fold its size, kind,
         and on-device / entropy-pack milliseconds. unpack/cavlc are the
         completion sub-stages of pack_ms (coefficient prep vs the CAVLC
-        bit pack itself); rows that don't attribute them pass 0."""
+        bit pack itself); rows that don't attribute them pass 0.
+        downlink_mode ("coeff"/"bits"/"dense", "" = no downlink) counts
+        into selkies_downlink_mode_total; bits_fetch_ms is the d2h
+        transfer of a device-entropy frame's bit words (the "bits_fetch"
+        stage), so bits-mode fetch latency stays separable from the
+        coefficient fetch it replaces."""
         if not self.enabled:
             return
         self._observe("selkies_frame_bytes", nbytes, {"session": session})
         key = ("selkies_frames_total", (session, "idr" if idr else "p"))
         with self._lock:
             self._counters[key] = self._counters.get(key, 0) + 1
+        if downlink_mode:
+            mkey = ("selkies_downlink_mode_total", (session, downlink_mode))
+            with self._lock:
+                self._counters[mkey] = self._counters.get(mkey, 0) + 1
         if device_ms:
             self._observe("selkies_stage_ms", device_ms,
                           {"stage": "device", "session": session})
@@ -363,11 +378,15 @@ class Telemetry:
         if cavlc_ms:
             self._observe("selkies_stage_ms", cavlc_ms,
                           {"stage": "cavlc", "session": session})
+        if bits_fetch_ms:
+            self._observe("selkies_stage_ms", bits_fetch_ms,
+                          {"stage": "bits_fetch", "session": session})
         self._record(session, {"ev": "frame", "fid": frame, "bytes": nbytes,
                                "idr": idr, "device_ms": round(device_ms, 3),
                                "pack_ms": round(pack_ms, 3),
                                "unpack_ms": round(unpack_ms, 3),
-                               "cavlc_ms": round(cavlc_ms, 3)})
+                               "cavlc_ms": round(cavlc_ms, 3),
+                               "mode": downlink_mode})
 
     def _record(self, session: str, ev: dict) -> None:
         rec = self.recorder

@@ -40,6 +40,17 @@ black-box bundles stay greppable):
                   per-chip step latency is visible per slice); the
                   matching selkies_stage_ms stage is "step"
     fetch         device→host coefficient/word downlink
+    bits_fetch    device→host transfer of a device-entropy frame's
+                  FINAL slice-data bit words. Spans mark only the EXTRA
+                  transfers (shortfall refetch / word spill —
+                  sparse_complete.complete_sparse_slice, encoder.
+                  _complete_bits); the main prefix fetch rides the
+                  shared "fetch" span like every downlink. The
+                  selkies_stage_ms stage "bits_fetch" is wider: one
+                  observation per bits-mode frame covering its WHOLE
+                  payload fetch (pipeline/elements.py frame_done), so
+                  the histogram tracks the fetch that replaced the
+                  coefficient downlink, not just the spill tail
     unpack        downlink bytes → packer-ready coefficients (sparse
                   wire views / dense expansion, shortfall + spill +
                   dense-header fallback fetches included)

@@ -55,15 +55,18 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from selkies_tpu.models.h264.bitstream import StreamParams, write_pps, write_sps
 from selkies_tpu.models.h264.compact import (
     i_header_words,
+    p_sparse_entropy_words,
     p_sparse_var_words,
     split_prefix,
     unpack_i_compact,
 )
+from selkies_tpu.models.h264.device_cavlc import resolve_entropy
 from selkies_tpu.models.h264.encoder_core import (
     encode_band_p_planes,
     encode_frame_planes,
     fuse_downlink,
     pack_i_compact,
+    pack_p_sparse_entropy,
     pack_p_sparse_var,
 )
 from selkies_tpu.models.h264.native import (
@@ -187,12 +190,21 @@ def _band_i_body(y, u, v, qp, cap_rows: int):
 
 
 def _band_p_body(y, u, v, qp, slab_y, slab_u, slab_v, *, halo: int,
-                 nscap: int, cap_rows: int):
+                 nscap: int, cap_rows: int, entropy=None):
     out = encode_band_p_planes(y, u, v, slab_y, slab_u, slab_v, qp, halo=halo)
     # nscap == the band's MB count, so the ns > nscap dense fallback is
     # structurally unreachable — every band completes from its fused
     # buffer (+ the rare row spill from `buf`)
-    fused, _dense, buf = pack_p_sparse_var(out, nscap, cap_rows)
+    if entropy is not None:
+        # activity-proportional device entropy per band: a busy band
+        # ships its own bit-shifted slice payload (first_mb lives in the
+        # host-written header), a quiet band keeps the sparse rows —
+        # decided per band per frame, inside the shard_map body
+        bits_words, min_mbs, buckets = entropy
+        fused, _dense, buf = pack_p_sparse_entropy(
+            out, nscap, cap_rows, None, bits_words, min_mbs, buckets)
+    else:
+        fused, _dense, buf = pack_p_sparse_var(out, nscap, cap_rows)
     return fused, buf, out["recon_y"], out["recon_u"], out["recon_v"]
 
 
@@ -236,13 +248,14 @@ def _stacked_i_step(ys, us, vs, qp, *, bands: int, cap_rows: int):
 
 
 def _stacked_p_step(ys, us, vs, qp, rys, rus, rvs, *, bands: int, halo: int,
-                    nscap: int, cap_rows: int):
+                    nscap: int, cap_rows: int, entropy=None):
     sy = _stacked_slabs(rys, halo)
     su = _stacked_slabs(rus, halo // 2)
     sv = _stacked_slabs(rvs, halo // 2)
     outs = [
         _band_p_body(ys[b], us[b], vs[b], qp, sy[b], su[b], sv[b],
-                     halo=halo, nscap=nscap, cap_rows=cap_rows)
+                     halo=halo, nscap=nscap, cap_rows=cap_rows,
+                     entropy=entropy)
         for b in range(bands)
     ]
     return tuple(jnp.stack([o[k] for o in outs]) for k in range(5))
@@ -254,12 +267,13 @@ def _mesh_i_body(y, u, v, qp, *, cap_rows: int):
 
 
 def _mesh_p_body(y, u, v, qp, ry, ru, rv, *, bands: int, halo: int,
-                 nscap: int, cap_rows: int):
+                 nscap: int, cap_rows: int, entropy=None):
     sy = _ppermute_slab(ry[0], halo, bands, "band")
     su = _ppermute_slab(ru[0], halo // 2, bands, "band")
     sv = _ppermute_slab(rv[0], halo // 2, bands, "band")
     outs = _band_p_body(y[0], u[0], v[0], qp, sy, su, sv,
-                        halo=halo, nscap=nscap, cap_rows=cap_rows)
+                        halo=halo, nscap=nscap, cap_rows=cap_rows,
+                        entropy=entropy)
     return tuple(o[None] for o in outs)
 
 
@@ -293,7 +307,9 @@ class BandedH264Encoder:
                  channels: int = 4, keyframe_interval: int = 0,
                  bands: int | None = None, halo: int | None = None,
                  devices=None, frame_batch: int = 1, pipeline_depth: int = 1,
-                 pack_workers: int | None = None):
+                 pack_workers: int | None = None,
+                 device_entropy: bool | None = None,
+                 bits_min_mbs: int | None = None):
         if channels != 4:
             raise ValueError("band-parallel encode expects BGRx capture (channels=4)")
         self.width = width
@@ -333,8 +349,20 @@ class BandedH264Encoder:
         self._cap_p = min(26 * m_band, 4096)
         self._cap_i = min(27 * m_band, 4096)
         self._hdr_words_i = i_header_words(self._band_mbh, self._mbw)
-        self._pfx_total = p_sparse_var_words(
-            self._band_mbh, self._mbw, self._nscap, self._cap_p)
+        # per-band activity-proportional device entropy (the solo
+        # encoder's knobs resolved at per-slice geometry — one shared
+        # resolver, device_cavlc.resolve_entropy): a busy band downlinks
+        # its final slice bits instead of coefficient rows
+        (self.device_entropy, self.bits_min_mbs, self._bits_words,
+         self._entropy) = resolve_entropy(m_band, device_entropy,
+                                          bits_min_mbs)
+        if self._entropy is not None:
+            self._pfx_total = p_sparse_entropy_words(
+                self._band_mbh, self._mbw, self._nscap, self._cap_p,
+                False, self._bits_words)
+        else:
+            self._pfx_total = p_sparse_var_words(
+                self._band_mbh, self._mbw, self._nscap, self._cap_p)
         # two fetch shapes only (compile discipline, encoder.py PFX_SMALL)
         self._pfx_small = min(1 << 14, self._pfx_total)
         self._pfx_hint = self._pfx_small
@@ -350,7 +378,7 @@ class BandedH264Encoder:
         self._prep = FramePrep(width, height, self._pad_w, self._pad_h, nslots=2)
         iconsts = dict(cap_rows=self._cap_i)
         pconsts = dict(bands=self.bands, halo=self.halo, nscap=self._nscap,
-                       cap_rows=self._cap_p)
+                       cap_rows=self._cap_p, entropy=self._entropy)
         if self.mesh_enabled:
             self.mesh = band_mesh(self.bands, devs)
             self._shard = NamedSharding(self.mesh, P("band"))
@@ -474,7 +502,8 @@ class BandedH264Encoder:
             nal = pack_slice_fast(
                 fc, self.params, frame_num=0, idr=True, idr_pic_id=idr_pic_id,
                 first_mb=self.spans[band][0] * self._mbw)
-        return nal, 0, t_f - t0, t_u - t_f, time.perf_counter() - t_u, t_f
+        return (nal, 0, t_f - t0, t_u - t_f, time.perf_counter() - t_u, t_f,
+                "")  # downlink_mode is a P-frame label — "" on IDR rows
 
     def _complete_band_p(self, band: int, pfx_d, full_d, buf_d, frame_num: int,
                          qp: int):
@@ -483,20 +512,24 @@ class BandedH264Encoder:
         with tracer.span("fetch"):
             fused = np.asarray(pfx_d)
         t_f = time.perf_counter()
-        self.link_bytes.add("down_prefix", fused.nbytes)
-        # shared per-slice flow (models/h264/sparse_complete.py): need +
-        # hint feedback, shortfall refetch, row spill, native wire pack
-        # vs Python dense fallback — one band IS one slice, so the solo
-        # delta-frame completion applies verbatim with this band's
-        # geometry and first_mb offset (dense_d omitted: nscap equals the
-        # band's MB count, the dense-header fallback is unreachable)
-        nal, skipped, t_u = complete_sparse_slice(
+        # shared per-slice flow (models/h264/sparse_complete.py): entropy
+        # meta (bits splice vs coeff rows), need + hint feedback,
+        # shortfall refetch, row spill, native wire pack vs Python dense
+        # fallback — one band IS one slice, so the solo delta-frame
+        # completion applies verbatim with this band's geometry and
+        # first_mb offset (dense_d omitted: nscap equals the band's MB
+        # count, the dense-header fallback is unreachable; down_prefix/
+        # down_bits accounting happens inside, where the mode is known)
+        nal, skipped, t_u, mode = complete_sparse_slice(
             fused, mbh=self._band_mbh, mbw=self._mbw, nscap=self._nscap,
             cap_rows=self._cap_p, qp=qp, frame_num=frame_num,
-            params=self.params, full_d=full_d, buf_d=buf_d,
-            link_bytes=self.link_bytes, note_need=self._note_need,
+            params=self.params, device_bits=self._entropy is not None,
+            full_d=full_d, buf_d=buf_d,
+            link_bytes=self.link_bytes, prefix_bytes=fused.nbytes,
+            note_need=self._note_need,
             first_mb=self.spans[band][0] * self._mbw)
-        return nal, skipped, t_f - t0, t_u - t_f, time.perf_counter() - t_u, t_f
+        return (nal, skipped, t_f - t0, t_u - t_f,
+                time.perf_counter() - t_u, t_f, mode)
 
     # -- static short-circuit -------------------------------------------
 
@@ -631,6 +664,13 @@ class BandedH264Encoder:
         t_fetched = max(r[5] for r in results)
         unpack_ms = sum(r[3] for r in results) * 1e3
         cavlc_ms = sum(r[4] for r in results) * 1e3
+        # per-band payload modes fold into one frame-level label: "bits"
+        # only when EVERY slice shipped device bits ("dense" never occurs
+        # here — band nscap equals the band MB count)
+        modes = {r[6] for r in results}
+        downlink_mode = ("dense" if "dense" in modes
+                         else "bits" if modes == {"bits"}
+                         else "coeff" if "coeff" in modes else "")
         band_step = tuple(round((t - t_up) * 1e3, 3) for t in t_ready)
         step_ms = (max(t_ready) - t_up) * 1e3
         if telemetry.enabled:
@@ -648,6 +688,7 @@ class BandedH264Encoder:
             # conversion time identically on both rows
             upload_ms=(t_up - t0) * 1e3, step_ms=step_ms,
             fetch_ms=fetch_ms, bands=self.bands, band_step_ms=band_step,
+            downlink_mode=downlink_mode,
         )
         self.last_stats = stats
         if idr:

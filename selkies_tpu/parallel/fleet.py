@@ -554,6 +554,10 @@ class SessionFleet:
         for k, qp in enumerate(qps):
             service.set_qp(k, qp)
         aus = service.encode_tick(self._batch)
+        # per-session downlink modes from the SAME service instance (the
+        # swap-safety rule above); stashed rather than returned so the
+        # tuple callers keep their shape
+        self._last_modes = list(getattr(service, "last_modes", ()))
         return (aus, list(service.last_idrs), qps,
                 (time.perf_counter() - t0) * 1e3)
 
@@ -653,9 +657,11 @@ class SessionFleet:
                     )
                     slot.frames += 1
                     if fid:
-                        telemetry.frame_done(fid, len(au), idr=idr,
-                                             session=str(k),
-                                             device_ms=tick_ms)
+                        modes = getattr(self, "_last_modes", ())
+                        telemetry.frame_done(
+                            fid, len(au), idr=idr, session=str(k),
+                            device_ms=tick_ms,
+                            downlink_mode=modes[k] if k < len(modes) else "")
                     sends.append((k, slot.transport.send_video(ef)))
                 if sends:
                     results = await asyncio.gather(

@@ -66,8 +66,11 @@ class MultiSessionH264Service:
         self.n = n_sessions
         # per-session IDR flags of the most recent tick (the serving loop
         # needs them for keyframe framing + VBV accounting, mirroring the
-        # solo encoder's last_stats pattern)
+        # solo encoder's last_stats pattern). The batched multi-session
+        # step has no per-frame downlink attribution, so last_modes stays
+        # "" here (unattributed) rather than guessing "coeff".
         self.last_idrs: list[bool] = [True] * n_sessions
+        self.last_modes: list[str] = [""] * n_sessions
         self.params = StreamParams(width=width, height=height, qp=qp, fps=fps)
         self._headers = write_sps(self.params) + write_pps(self.params)
         self.sessions = [_SessionState(qp) for _ in range(n_sessions)]
@@ -228,6 +231,10 @@ class BandedFleetService:
         live = next((e for e in self.encoders if e is not None), None)
         self.bands = live.bands if live is not None else bands
         self.last_idrs: list[bool] = [True] * n_sessions
+        # per-session P-downlink payload mode of the most recent tick
+        # ("coeff"/"bits"/"dense", "" = IDR/static/parked) — feeds
+        # selkies_downlink_mode_total from the fleet serving loop
+        self.last_modes: list[str] = [""] * n_sessions
         self._pool = ThreadPoolExecutor(max_workers=n_sessions,
                                         thread_name_prefix="band-fleet")
 
@@ -335,6 +342,9 @@ class BandedFleetService:
             aus = list(self._pool.map(_one, range(self.n)))
         self.last_idrs = [bool(e.last_stats.idr) if e is not None else False
                           for e in self.encoders]
+        self.last_modes = [
+            getattr(e.last_stats, "downlink_mode", "") if e is not None else ""
+            for e in self.encoders]
         return aus
 
     def close(self) -> None:
@@ -374,6 +384,7 @@ class SoftwareFleetService:
         ]
         self._qps = [qp] * n_sessions
         self.last_idrs: list[bool] = [True] * n_sessions
+        self.last_modes: list[str] = [""] * n_sessions
         self._pool = ThreadPoolExecutor(max_workers=n_sessions,
                                         thread_name_prefix="sw-fleet")
 
@@ -402,6 +413,8 @@ class SoftwareFleetService:
 
         aus = list(self._pool.map(_one, range(self.n)))
         self.last_idrs = [bool(e.last_stats.idr) for e in self.encoders]
+        self.last_modes = [getattr(e.last_stats, "downlink_mode", "")
+                           for e in self.encoders]
         return aus
 
     def close(self) -> None:

@@ -171,6 +171,9 @@ class EncodedFrame:
     step_ms: float = 0.0
     fetch_ms: float = 0.0
     bands: int = 1
+    # P downlink payload mode ("coeff"/"bits"/"dense"; "" = no downlink
+    # or unattributed) — see models/stats.FrameStats.downlink_mode
+    downlink_mode: str = ""
     # telemetry correlation id assigned at capture (0 = telemetry off);
     # metadata only — never touches the encoded bytes
     frame_id: int = 0
@@ -361,6 +364,7 @@ class VideoPipeline:
                             step_ms=getattr(stats, "step_ms", 0.0),
                             fetch_ms=getattr(stats, "fetch_ms", 0.0),
                             bands=getattr(stats, "bands", 1),
+                            downlink_mode=getattr(stats, "downlink_mode", ""),
                             frame_id=self._fid_by_ts.pop(meta, 0),
                         )
                         for au, stats, meta in done
@@ -385,6 +389,7 @@ class VideoPipeline:
                             step_ms=getattr(stats, "step_ms", 0.0),
                             fetch_ms=getattr(stats, "fetch_ms", 0.0),
                             bands=getattr(stats, "bands", 1),
+                            downlink_mode=getattr(stats, "downlink_mode", ""),
                             frame_id=fid,
                         )
                     ]
@@ -397,7 +402,11 @@ class VideoPipeline:
                             ef.frame_id, len(ef.au), idr=ef.idr,
                             session=self.session, device_ms=ef.device_ms,
                             pack_ms=ef.pack_ms, unpack_ms=ef.unpack_ms,
-                            cavlc_ms=ef.cavlc_ms)
+                            cavlc_ms=ef.cavlc_ms,
+                            downlink_mode=ef.downlink_mode,
+                            bits_fetch_ms=(ef.fetch_ms
+                                           if ef.downlink_mode == "bits"
+                                           else 0.0))
                 failures = 0
                 if self.supervisor is not None:
                     self.supervisor.tick_ok()

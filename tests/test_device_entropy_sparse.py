@@ -1,0 +1,253 @@
+"""Activity-proportional device entropy (ISSUE 7): the compacted
+device coder and the whole ship-bits-or-coefficients downlink must be
+byte-identical to the host pack at every density, bucket boundary,
+fallback, LTR variant, band offset, and grouped-scan shape."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from selkies_tpu.models.h264.bitstream import StreamParams
+from selkies_tpu.models.h264.cavlc import pack_slice_p
+from selkies_tpu.models.h264.compact import p_sparse_entropy_meta
+from selkies_tpu.models.h264.device_cavlc import (
+    assemble_p_nal,
+    bits_buckets,
+    pack_p_slice_bits,
+    pack_p_slice_bits_active,
+)
+from selkies_tpu.models.h264.encoder_core import pack_p_sparse_entropy
+from selkies_tpu.models.h264.native import derive_skip_mvs_fast
+from selkies_tpu.models.h264.numpy_ref import PFrameCoeffs
+from selkies_tpu.models.h264.sparse_complete import complete_sparse_slice
+
+MBH, MBW = 6, 8
+M = MBH * MBW
+W, H = MBW * 16, MBH * 16
+LADDER = (4, 16, M)  # forced multi-bucket ladder for a tiny grid
+
+
+def _fc(seed, live, mag=8, mv=8, mbh=MBH, mbw=MBW):
+    """Random coefficients with EXACTLY `live` non-skip MBs."""
+    rng = np.random.default_rng(seed)
+    m = mbh * mbw
+    skip = np.ones(m, bool)
+    if live:
+        skip[rng.choice(m, size=min(live, m), replace=False)] = False
+    skip = skip.reshape(mbh, mbw)
+    mvs = rng.integers(-mv, mv + 1, (mbh, mbw, 2)).astype(np.int32)
+
+    def coeffs(shape):
+        c = rng.integers(-mag, mag + 1, shape).astype(np.int32)
+        c[rng.random(shape) < 0.8] = 0
+        return c
+
+    luma = coeffs((mbh, mbw, 4, 4, 4, 4))
+    cac = coeffs((mbh, mbw, 2, 2, 2, 4, 4))
+    cac[..., 0, 0] = 0  # AC blocks: DC position unused
+    cdc = coeffs((mbh, mbw, 2, 2, 2))
+    luma[skip] = 0
+    cac[skip] = 0
+    cdc[skip] = 0  # skip MBs carry no residual (encoder invariant)
+    # ...and carry the DERIVED skip MV (the sparse wire ships no pairs
+    # for skip MBs; the host packer re-derives them) — same invariant
+    # synth_pfc honours in tests/test_sparse_native_pack.py
+    derive_skip_mvs_fast(mvs, skip)
+    return PFrameCoeffs(mvs=mvs, skip=skip, luma_ac=luma, chroma_dc=cdc,
+                        chroma_ac=cac, qp=26)
+
+
+def _out(fc):
+    return {k: jnp.asarray(getattr(fc, k))
+            for k in ("mvs", "skip", "luma_ac", "chroma_dc", "chroma_ac")}
+
+
+_active = jax.jit(lambda o: pack_p_slice_bits_active(o, buckets=LADDER))
+_full = jax.jit(pack_p_slice_bits)
+
+
+def _assert_active_matches(fc, **hdr):
+    p = StreamParams(width=W, height=H, qp=fc.qp)
+    ref = pack_slice_p(fc, p, frame_num=1, **hdr)
+    words, nbits, trailing, ns = _active(_out(fc))
+    assert int(ns) == int((~fc.skip).sum())
+    nal = assemble_p_nal(np.asarray(words), int(nbits), int(trailing), p, 1,
+                         fc.qp, **hdr)
+    assert nal == ref, f"compacted coder diverged at ns={int(ns)}"
+    # and the compacted stream IS the full-grid stream
+    wf, nf, tf = _full(_out(fc))
+    assert int(nf) == int(nbits) and int(tf) == int(trailing)
+    assert np.array_equal(np.asarray(wf)[: (int(nf) + 31) // 32],
+                          np.asarray(words)[: (int(nbits) + 31) // 32])
+
+
+@pytest.mark.parametrize("live", [0, 1, M // 2, M])
+def test_density_sweep(live):
+    """0% / ~2% (one MB) / 50% / 100% live MBs, each through a bucket."""
+    _assert_active_matches(_fc(live * 7 + 1, live))
+
+
+@pytest.mark.parametrize("live", [3, 4, 5, 15, 16, 17])
+def test_bucket_boundaries(live):
+    """ns exactly at / around each ladder rung (4, 16): the switch picks
+    the right bucket and the padded slots stay silent."""
+    _assert_active_matches(_fc(live + 100, live))
+
+
+def test_big_levels_through_compaction():
+    """Escape + extended-prefix levels survive the compacted path."""
+    _assert_active_matches(_fc(13, 5, mag=5000))
+
+
+def _entropy_fused(fc, bits_words=2048, min_mbs=0, nscap=M, cap_rows=M * 26):
+    fn = jax.jit(lambda o: pack_p_sparse_entropy(
+        o, nscap, cap_rows, None, bits_words, min_mbs, LADDER))
+    return fn(_out(fc))
+
+
+def _complete(fc, fused_d, buf_d, nscap=M, cap_rows=M * 26, **hdr):
+    p = StreamParams(width=W, height=H, qp=fc.qp)
+    nal, skipped, _tu, mode = complete_sparse_slice(
+        np.asarray(fused_d), mbh=MBH, mbw=MBW, nscap=nscap,
+        cap_rows=cap_rows, qp=fc.qp, frame_num=1, params=p,
+        device_bits=True, full_d=fused_d, buf_d=buf_d, **hdr)
+    return nal, skipped, mode
+
+
+def test_fused_bits_mode_end_to_end():
+    """pack_p_sparse_entropy mode=1 -> the host splice reproduces the
+    oracle, and the reported skip count matches."""
+    fc = _fc(21, M // 2)
+    fused_d, _dense_d, buf_d = _entropy_fused(fc)
+    mode, nbits, _t, nskip, ns = p_sparse_entropy_meta(np.asarray(fused_d))
+    assert mode == 1 and nbits > 0 and ns == int((~fc.skip).sum())
+    nal, skipped, m = _complete(fc, fused_d, buf_d)
+    p = StreamParams(width=W, height=H, qp=fc.qp)
+    assert m == "bits" and skipped == int(fc.skip.sum()) == nskip
+    assert nal == pack_slice_p(fc, p, frame_num=1)
+
+
+def test_word_cap_overflow_falls_back_to_coeff():
+    """bits_words too small for the slice -> the on-device decision
+    ships coefficients instead; byte output is unchanged."""
+    fc = _fc(22, M)  # dense frame, thousands of bits
+    fused_d, _dense_d, buf_d = _entropy_fused(fc, bits_words=4)
+    assert p_sparse_entropy_meta(np.asarray(fused_d))[0] == 0
+    nal, _skipped, m = _complete(fc, fused_d, buf_d)
+    p = StreamParams(width=W, height=H, qp=fc.qp)
+    assert m == "coeff"
+    assert nal == pack_slice_p(fc, p, frame_num=1)
+
+
+def test_min_mbs_threshold_keeps_quiet_frames_on_coeff():
+    fc = _fc(23, 2)
+    fused_d, _dense_d, buf_d = _entropy_fused(fc, min_mbs=10)
+    assert p_sparse_entropy_meta(np.asarray(fused_d))[0] == 0
+    nal, _s, m = _complete(fc, fused_d, buf_d)
+    p = StreamParams(width=W, height=H, qp=fc.qp)
+    assert m == "coeff"
+    assert nal == pack_slice_p(fc, p, frame_num=1)
+
+
+@pytest.mark.parametrize("hdr", [
+    {"ltr_ref": 1},
+    {"mark_ltr": 0},
+    {"mark_ltr": 1, "mmco_evict": (0, 2)},
+])
+def test_ltr_header_variants_on_bits(hdr):
+    """LTR slice-header flags live entirely in the host-written header;
+    the device bits splice must carry them bit-exactly (the header tail
+    shifts the device stream by a different phase per variant)."""
+    fc = _fc(31, M // 2)
+    fused_d, _dense_d, buf_d = _entropy_fused(fc)
+    nal, _s, m = _complete(fc, fused_d, buf_d, **hdr)
+    p = StreamParams(width=W, height=H, qp=fc.qp)
+    assert m == "bits"
+    assert nal == pack_slice_p(fc, p, frame_num=1, **hdr)
+
+
+def test_banded_slice_nonzero_first_mb():
+    """A band's bits splice with first_mb_in_slice > 0 matches the host
+    pack of the same band grid (slice-local prediction resets)."""
+    fc = _fc(41, 10, mbh=3, mbw=MBW)  # one 3-row band of a 6-row frame
+    p = StreamParams(width=W, height=H, qp=fc.qp)
+    first_mb = 3 * MBW  # second band
+    ref = pack_slice_p(fc, p, frame_num=1, first_mb=first_mb)
+    words, nbits, trailing, _ns = jax.jit(
+        lambda o: pack_p_slice_bits_active(o, buckets=bits_buckets(3 * MBW))
+    )(_out(fc))
+    nal = assemble_p_nal(np.asarray(words), int(nbits), int(trailing), p, 1,
+                         fc.qp, first_mb=first_mb)
+    assert nal == ref
+
+
+def test_banded_encoder_bits_vs_coeff_byte_identity():
+    """BandedH264Encoder with per-band device entropy == without, over
+    IDR + busy P + static frames (2 bands, nonzero first_mb slices)."""
+    from selkies_tpu.parallel.bands import BandedH264Encoder
+
+    rng = np.random.default_rng(3)
+    frames = [np.ascontiguousarray(rng.integers(0, 255, (96, 96, 4), np.uint8))
+              for _ in range(3)]
+    frames.append(frames[-1].copy())  # static tail
+    ref_enc = BandedH264Encoder(96, 96, qp=24, bands=2, device_entropy=False)
+    ref = [ref_enc.encode_frame(f) for f in frames]
+    enc = BandedH264Encoder(96, 96, qp=24, bands=2, device_entropy=True,
+                            bits_min_mbs=0)
+    got = [enc.encode_frame(f) for f in frames]
+    assert got == ref
+    assert enc.last_stats.downlink_mode == ""  # static frame: no downlink
+
+
+def _delta_trace(seed=7, w=96, h=64, n=6):
+    rng = np.random.default_rng(seed)
+    f0 = np.ascontiguousarray(rng.integers(0, 255, (h, w, 4), np.uint8))
+    frames = [f0]
+    for i in range(1, n):
+        f = frames[-1].copy()
+        f[(i * 16) % h:(i * 16) % h + 16, 0:16] ^= (i + 1)
+        frames.append(f)
+    return frames
+
+
+def test_grouped_scan_vs_single_frame_oracle():
+    """frame_batch>1 grouped lax.scan with forced bits mode == the
+    single-frame no-entropy oracle, frame for frame."""
+    from selkies_tpu.models.h264.encoder import TPUH264Encoder
+
+    frames = _delta_trace()
+    ref_enc = TPUH264Encoder(96, 64, qp=24, frame_batch=1,
+                             device_entropy=False)
+    ref = [ref_enc.encode_frame(f) for f in frames]
+    enc = TPUH264Encoder(96, 64, qp=24, frame_batch=3, pipeline_depth=1,
+                         device_entropy=True, bits_min_mbs=0)
+    got = []
+    for f in frames:
+        got += [au for au, _s, _m in enc.submit(f)]
+    got += [au for au, _s, _m in enc.flush()]
+    assert got == ref
+
+
+def test_bits_refetch_on_short_hint():
+    """A hint-sized fetch shorter than the bits payload refetches from
+    the full device handle (the bits_fetch path), accounts the bytes
+    under down_bits*, and stays byte-exact."""
+    from selkies_tpu.models.h264.compact import ENTROPY_META16
+    from selkies_tpu.models.stats import LinkByteCounter
+
+    fc = _fc(51, M // 2)
+    fused_d, _dense_d, buf_d = _entropy_fused(fc)
+    short = np.asarray(fused_d)[:ENTROPY_META16 + 8]  # meta only
+    lb = LinkByteCounter()
+    p = StreamParams(width=W, height=H, qp=fc.qp)
+    nal, _s, _tu, mode = complete_sparse_slice(
+        short, mbh=MBH, mbw=MBW, nscap=M, cap_rows=M * 26, qp=fc.qp,
+        frame_num=1, params=p, device_bits=True, full_d=fused_d,
+        buf_d=buf_d, link_bytes=lb, prefix_bytes=short.nbytes)
+    assert mode == "bits"
+    assert nal == pack_slice_p(fc, p, frame_num=1)
+    snap = lb.snapshot()
+    assert snap.get("down_bits_refetch", 0) > 0
+    assert snap.get("down_bits", 0) == short.nbytes

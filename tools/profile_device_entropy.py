@@ -1,0 +1,175 @@
+#!/usr/bin/env python3
+"""Device-entropy cost model: activity sweep + component timings.
+
+Consolidates the old profile_cavlc_device.py / profile_cavlc_parts2.py
+into the round-9 measurement (PERF.md): for 1080p P-frame outputs with
+200 / 1k / 4k / 8160 live (non-skip) MBs it times
+
+  * the FULL-GRID device coder (pack_p_slice_bits) — the round-2b
+    design the delta paths were rejected from in round 5;
+  * the ACTIVITY-PROPORTIONAL coder (pack_p_slice_bits_active) at the
+    production bucket ladder — what pack_p_sparse_entropy runs;
+  * the sparse downlink pack alone (pack_p_sparse_var) — the device
+    cost of the coefficient path the bits path replaces;
+  * the HOST completion of the same frame's sparse downlink (unpack +
+    CAVLC via the shared sparse_complete flow) — the host cost the
+    bits path deletes;
+
+and reports the device-bits vs host-pack crossover per activity level.
+Component rows (_encode_blocks / _pack_pairs / _merge_streams at full
+and compacted sizes) remain for kernel-level attribution.
+
+Run on a chip for PERF rounds; runs on CPU too (slower, same shapes):
+    JAX_PLATFORMS=cpu python tools/profile_device_entropy.py [--quick]
+"""
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from selkies_tpu.models.h264 import device_cavlc as dc  # noqa: E402
+from selkies_tpu.models.h264.bitstream import StreamParams  # noqa: E402
+from selkies_tpu.models.h264.encoder_core import (  # noqa: E402
+    pack_p_sparse_var,
+)
+from selkies_tpu.models.h264.sparse_complete import (  # noqa: E402
+    complete_sparse_slice,
+)
+
+QUICK = "--quick" in sys.argv
+MBH, MBW = 68, 120  # 1080p
+M = MBH * MBW
+NSCAP, CAP = 4096, 4096
+ACTIVITY = (200, 1000, 4000, M)
+BUCKETS = dc.bits_buckets(M)
+rng = np.random.default_rng(1)
+
+_tiny = jax.jit(lambda a: a.ravel()[:1])
+
+
+def sync(x):
+    np.asarray(_tiny(jax.tree_util.tree_leaves(x)[0]))
+
+
+def timed(fn, *args, n=None):
+    n = n or (3 if QUICK else 10)
+    sync(fn(*args))
+    reps = []
+    for _ in range(2 if QUICK else 3):
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(n):
+            o = fn(*args)
+        sync(o)
+        reps.append((time.perf_counter() - t0) / n)
+    return 1e3 * min(reps)
+
+
+def frame_out(live_mbs: int, seed: int = 0):
+    """Realistic P output with exactly `live_mbs` non-skip MBs: sparse
+    small coefficients on the live MBs (desktop-residual shape), zero +
+    skip elsewhere."""
+    r = np.random.default_rng(seed)
+    skip = np.ones(M, bool)
+    skip[r.choice(M, size=live_mbs, replace=False)] = False
+
+    def blocks(shape, density):
+        x = r.integers(-4, 5, shape).astype(np.int32)
+        x[r.random(shape) > density] = 0
+        return x
+
+    luma = blocks((M, 4, 4, 4, 4), 0.10)
+    cac = blocks((M, 2, 2, 2, 4, 4), 0.04)
+    cac[..., 0, 0] = 0
+    cdc = blocks((M, 2, 2, 2), 0.15)
+    luma[skip] = 0
+    cac[skip] = 0
+    cdc[skip] = 0
+    return {
+        "mvs": jnp.asarray(
+            np.where(skip[:, None], 0, r.integers(-8, 9, (M, 2))).astype(np.int32)
+            .reshape(MBH, MBW, 2)),
+        "skip": jnp.asarray(skip.reshape(MBH, MBW)),
+        "luma_ac": jnp.asarray(luma.reshape(MBH, MBW, 4, 4, 4, 4)),
+        "chroma_dc": jnp.asarray(cdc.reshape(MBH, MBW, 2, 2, 2)),
+        "chroma_ac": jnp.asarray(cac.reshape(MBH, MBW, 2, 2, 2, 4, 4)),
+    }
+
+
+def host_pack_ms(out, params):
+    """Host completion cost of the sparse downlink (the work the bits
+    path deletes): fused buffer -> slice NAL via the shared flow."""
+    fused_d, dense_d, buf_d = jax.jit(
+        lambda o: pack_p_sparse_var(o, NSCAP, CAP))(out)
+    fused = np.asarray(fused_d)
+    n = 2 if QUICK else 5
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        complete_sparse_slice(
+            fused, mbh=MBH, mbw=MBW, nscap=NSCAP, cap_rows=CAP, qp=28,
+            frame_num=1, params=params, full_d=fused_d, buf_d=buf_d,
+            dense_d=dense_d)
+        best = min(best, time.perf_counter() - t0)
+    return 1e3 * best
+
+
+def main() -> int:
+    params = StreamParams(width=1920, height=1080, qp=28)
+    full = jax.jit(lambda o: dc.pack_p_slice_bits(o))
+    active = jax.jit(lambda o: dc.pack_p_slice_bits_active(o, buckets=BUCKETS))
+    sparse = jax.jit(lambda o: pack_p_sparse_var(o, NSCAP, CAP))
+
+    print(f"device entropy activity sweep  {MBW * 16}x{MBH * 16}  "
+          f"buckets={BUCKETS}  devices={jax.devices()[0].platform}")
+    print(f"{'live MBs':>9} {'full-grid':>10} {'active':>10} {'ratio':>6} "
+          f"{'sparse-pack':>11} {'host-pack':>10} {'bits bytes':>10}")
+    rows = []
+    for live in ACTIVITY:
+        out = frame_out(live)
+        t_full = timed(full, out)
+        t_act = timed(active, out)
+        t_sparse = timed(sparse, out)
+        t_host = host_pack_ms(out, params)
+        _w, nbits, _t, _ns = active(out)
+        nbytes = (int(nbits) + 7) // 8
+        rows.append((live, t_full, t_act, t_sparse, t_host, nbytes))
+        print(f"{live:>9} {t_full:>9.2f}m {t_act:>9.2f}m "
+              f"{t_full / t_act:>5.1f}x {t_sparse:>10.2f}m {t_host:>9.2f}m "
+              f"{nbytes:>10}")
+
+    print("\ncrossover: bits mode pays when (active - sparse-pack) device "
+          "ms < host-pack ms + fetch savings;")
+    print("the ratio column is the activity-proportional win the round-9 "
+          "acceptance gate reads (>=5x at <=1k live MBs).")
+
+    if not QUICK:
+        # component rows (the old profile_cavlc_parts2 view), full vs
+        # compacted sizes
+        coeffs = (rng.integers(-4, 5, (M * 16, 16), np.int32)
+                  * (rng.random((M * 16, 16)) < 0.08)).astype(np.int32)
+        nc = rng.integers(0, 4, (M * 16,), np.int32)
+        for A, label in ((M, "full"), (1024, "A=1024")):
+            cj = jax.device_put(coeffs[: A * 16])
+            ncj = jax.device_put(nc[: A * 16])
+            enc = jax.jit(lambda c, n: dc._encode_blocks(c, n, chroma_dc=False))
+            t_enc = timed(enc, cj, ncj)
+            v, b, _ = enc(cj, ncj)
+            pack = jax.jit(lambda v, b: dc._pack_pairs(v, b, 32))
+            t_pack = timed(pack, v, b)
+            w, nb = pack(v, b)
+            segw = jax.device_put(np.tile(np.asarray(w)[:A], (27, 1))[: A * 27])
+            segb = jax.device_put(np.tile(np.asarray(nb)[:A], 27)[: A * 27])
+            merge = jax.jit(lambda sw, sb: dc._merge_streams(sw, sb, dc.WORD_CAP_DEFAULT))
+            t_merge = timed(merge, segw, segb)
+            print(f"[{label:>7}] encode_blocks {t_enc:7.2f} ms   "
+                  f"pack_pairs {t_pack:7.2f} ms   merge {t_merge:7.2f} ms")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,7 +4,9 @@
 Runs the synthetic scroll / window-move traces (pipeline/elements.py)
 and the bench desktop trace through the encoder with the tile cache and
 packed downlink ON vs OFF, and reports bytes/frame per stage
-(up_full / up_delta / up_ltr, down_prefix / down_refetch / down_spill)
+(up_full / up_delta / up_ltr, down_prefix / down_refetch / down_spill,
+plus down_bits / down_bits_refetch / down_bits_spill when device
+entropy ships final slice bits — docs/device_entropy.md)
 plus the reduction ratios — the terms the relay prices per byte
 (PERF.md cost model). This is the measurement backing the ISSUE-1
 acceptance criteria (>=2x uplink cut on scroll, >=2x prefix-fetch cut
