@@ -50,15 +50,47 @@ H, W = 1080, 1920
 WARMUP = 3
 ITERS = 30
 
+# named geometries for --resolution; anything else parses as WxH
+RESOLUTIONS = {
+    "720p": (1280, 720),
+    "1080p": (1920, 1080),
+    "1440p": (2560, 1440),
+    "4k": (3840, 2160),
+    "4k-dci": (4096, 2160),
+    "8k": (7680, 4320),
+}
 
-def _result(metric: str, fps: float, **extra: float) -> None:
+
+def _parse_resolutions(spec: str) -> list[tuple[str, int, int]]:
+    """"1080p,4k" / "3840x2160" -> [(label, width, height), ...]."""
+    out = []
+    for token in spec.split(","):
+        token = token.strip().lower()
+        if not token:
+            continue
+        if token in RESOLUTIONS:
+            w, h = RESOLUTIONS[token]
+        else:
+            try:
+                w_s, h_s = token.split("x")
+                w, h = int(w_s), int(h_s)
+            except ValueError:
+                raise SystemExit(
+                    f"--resolution {token!r}: use {sorted(RESOLUTIONS)} "
+                    f"or WxH") from None
+        out.append((token, w, h))
+    return out or [("1080p", W, H)]
+
+
+def _result(metric: str, fps: float, unit: str = "fps@1080p",
+            **extra: float) -> None:
     device = os.environ.get("SELKIES_BENCH_DEVICE")
     if device:
         metric = f"{metric} [{device}]"
     doc = {
         "metric": metric,
         "value": round(fps, 2),
-        "unit": "fps@1080p",
+        "unit": unit,
         "vs_baseline": round(fps / BASELINE_FPS, 3),
     }
     # per-stage means ride along so the record isn't hostage to tunnel
@@ -74,25 +106,34 @@ def _result(metric: str, fps: float, **extra: float) -> None:
     print(json.dumps(doc))
 
 
-def _desktop_trace(n: int = 60) -> list[np.ndarray]:
-    """A realistic 1080p desktop-streaming trace — the reference's headline
+def _desktop_trace(n: int = 60, w: int = W, h: int = H) -> list[np.ndarray]:
+    """A realistic desktop-streaming trace — the reference's headline
     workload (remote desktop, README.md:7): a mostly-static screen with a
     busy terminal region (text updates touching a few 16-row bands per
     frame), a moving cursor, and a full-screen window switch twice per
-    second. Matches what ximagesrc+XDamage would hand the reference."""
+    second. Matches what ximagesrc+XDamage would hand the reference.
+    Region geometry scales with the resolution (`--resolution 4k`); at
+    1080p the trace is byte-identical to the historical fixed-geometry
+    one, so the trajectory's bench rows stay comparable."""
     rng = np.random.default_rng(42)
+    sx, sy = w / W, h / H
 
     def _wallpaper(seed):
         r = np.random.default_rng(seed)
-        base = r.integers(40, 200, size=(H // 40, W // 40, 4), dtype=np.uint8)
-        return np.ascontiguousarray(np.kron(base, np.ones((40, 40, 1), np.uint8)))
+        base = r.integers(40, 200, size=(-(-h // 40), -(-w // 40), 4),
+                          dtype=np.uint8)
+        return np.ascontiguousarray(
+            np.kron(base, np.ones((40, 40, 1), np.uint8))[:h, :w])
 
     desk_a, desk_b = _wallpaper(1), _wallpaper(2)
     for d in (desk_a, desk_b):
-        d[260:780, 360:1560] = (248, 248, 248, 0)  # "window" fill
+        # "window" fill
+        d[int(260 * sy):int(780 * sy), int(360 * sx):int(1560 * sx)] = (
+            248, 248, 248, 0)
     frames = []
     cur = desk_a.copy()
     which = 0
+    line_w = int(1150 * sx)
     for i in range(n):
         if i % 30 == 29:
             # window switch: full-frame change
@@ -101,17 +142,20 @@ def _desktop_trace(n: int = 60) -> list[np.ndarray]:
         else:
             # terminal output: one new text line (1 band) + scroll of a
             # 4-band tail of the text area = <=5 dirty bands, bucket 8
-            row = 288 + ((i * 16) % 64)
-            glyphs = rng.integers(0, 2, size=(12, 192), dtype=np.uint8) * 255
-            line = np.kron(glyphs, np.ones((1, 6), np.uint8))[:, :1150]
-            cur[row : row + 12, 380 : 380 + 1150, :3] = line[..., None]
+            row = int(288 * sy) + ((i * 16) % 64)
+            glyphs = rng.integers(0, 2, size=(12, line_w // 6 + 1),
+                                  dtype=np.uint8) * 255
+            line = np.kron(glyphs, np.ones((1, 6), np.uint8))[:, :line_w]
+            x0 = int(380 * sx)
+            cur[row : row + 12, x0 : x0 + line_w, :3] = line[..., None]
             # cursor blink: one more band
-            cur[700:712, 380:392] = (0, 0, 0, 0) if i % 2 else (248, 248, 248, 0)
+            cur[int(700 * sy):int(700 * sy) + 12, x0:x0 + 12] = (
+                (0, 0, 0, 0) if i % 2 else (248, 248, 248, 0))
         frames.append(cur.copy())
     return frames
 
 
-def bench_full_encoder() -> tuple[float, dict] | None:
+def bench_full_encoder(w: int = W, h: int = H) -> tuple[float, dict] | None:
     """Steady-state IP-GOP desktop encode (IDR once, then P frames; delta
     band uploads for partial updates, full uploads on window switches,
     on-device motion estimation). Uses the pipelined submit/flush API
@@ -122,16 +166,19 @@ def bench_full_encoder() -> tuple[float, dict] | None:
         return None
     from selkies_tpu.models.registry import default_frame_batch, default_pipeline_depth
 
-    from selkies_tpu.parallel.bands import bands_from_env
+    from selkies_tpu.parallel.bands import bands_from_env, grid_from_env
 
-    frames = _desktop_trace(ITERS)
-    if bands_from_env() > 1:
-        # SELKIES_BANDS>1: bench the band-parallel encoder the registry
-        # would build — the timed loop below is identical (submit/flush),
-        # and the JSON gains bands / band_step_ms for band attribution
+    frames = _desktop_trace(ITERS, w, h)
+    grid = grid_from_env()
+    if grid is not None and max(grid) > 1 or bands_from_env() > 1:
+        # SELKIES_BANDS>1 / SELKIES_TILE_GRID: bench the band/tile-
+        # parallel encoder the registry would build — the timed loop
+        # below is identical (submit/flush), and the JSON gains bands /
+        # cols / band_step_ms for per-slice attribution
         from selkies_tpu.parallel.bands import BandedH264Encoder
 
-        enc = BandedH264Encoder(W, H, qp=28)
+        rows_, cols_ = grid if grid is not None else (bands_from_env(), 1)
+        enc = BandedH264Encoder(w, h, qp=28, bands=rows_, cols=cols_)
         enc.encode_frame(frames[0])   # IDR (compiles the I step)
         enc.encode_frame(frames[1])   # P (compiles the band P step)
         enc.encode_frame(frames[1])   # static all-skip
@@ -139,7 +186,7 @@ def bench_full_encoder() -> tuple[float, dict] | None:
         # grouped-dispatch depth + in-flight cap come from the SAME
         # deployment-aware defaults the live pipeline uses
         # (registry.default_frame_batch/default_pipeline_depth, PERF.md)
-        enc = TPUH264Encoder(W, H, qp=28,
+        enc = TPUH264Encoder(w, h, qp=28,
                              frame_batch=min(12, default_frame_batch()),
                              pipeline_depth=default_pipeline_depth())
         # warmup compiles every executable the trace uses: IDR full,
@@ -168,6 +215,7 @@ def bench_full_encoder() -> tuple[float, dict] | None:
     sums = {k: 0.0 for k in ("device_ms", "pack_ms", "unpack_ms", "cavlc_ms",
                              "upload_ms", "step_ms", "fetch_ms")}
     bands = 1
+    cols = 1
     band_step_sums: list[float] = []
     band_step_n = 0
     # which payload each P downlink shipped (coeff rows vs device-entropy
@@ -176,12 +224,13 @@ def bench_full_encoder() -> tuple[float, dict] | None:
     mode_counts: dict[str, int] = {}
 
     def _account(stats) -> None:
-        nonlocal bands, band_step_sums, band_step_n
+        nonlocal bands, cols, band_step_sums, band_step_n
         for k in sums:
             sums[k] += getattr(stats, k, 0.0)
         mode = getattr(stats, "downlink_mode", "") or "none"
         mode_counts[mode] = mode_counts.get(mode, 0) + 1
         bands = max(bands, getattr(stats, "bands", 1))
+        cols = max(cols, getattr(stats, "cols", 1))
         bs = getattr(stats, "band_step_ms", ())
         if bs:
             if len(band_step_sums) < len(bs):
@@ -220,6 +269,9 @@ def bench_full_encoder() -> tuple[float, dict] | None:
         means["bands"] = bands
         means["band_step_ms"] = [round(s / band_step_n, 2)
                                  for s in band_step_sums]
+    if cols > 1:
+        means["cols"] = cols
+    enc.close()
     return ITERS / dt, means
 
 
@@ -240,9 +292,29 @@ def bench_convert_only() -> float:
 
 
 def main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--resolution", default=None,
+        help="comma-separated geometry rows to bench: named "
+             f"({', '.join(sorted(RESOLUTIONS))}) or WxH; one JSON line "
+             "per resolution, each with the upload/step/fetch/pack split. "
+             "Default: 1080p plus a 4K row on a real TPU backend (4K on "
+             "the CPU backend takes minutes, so CI runs stay 1080p-only)")
+    args = ap.parse_args()
     _reexec_cpu_if_tunnel_down()
-    out = bench_full_encoder()
-    if out is not None:
+    if args.resolution is None:
+        import jax
+
+        args.resolution = ("1080p,4k" if jax.default_backend() == "tpu"
+                           else "1080p")
+    ran = False
+    for label, w, h in _parse_resolutions(args.resolution):
+        out = bench_full_encoder(w, h)
+        if out is None:
+            break
+        ran = True
         fps, means = out
         # bytes_up/down_per_frame: what the relay actually prices
         # (PERF.md cost model) — lets future rounds track the link terms
@@ -252,8 +324,10 @@ def main() -> int:
         # upload_ms + step_ms + fetch_ms, so the trajectory attributes
         # each regression to the right sub-stage.
         means["device_stage_latency_ms"] = means.pop("device_ms")
-        _result("tpuh264enc 1080p IP-GOP encode fps (1 chip)", fps, **means)
-    else:
+        means["resolution"] = label
+        _result(f"tpuh264enc {label} IP-GOP encode fps (1 chip)", fps,
+                unit=f"fps@{label}", **means)
+    if not ran:
         _result("capture->I420 convert fps (encoder pending)", bench_convert_only())
     return 0
 

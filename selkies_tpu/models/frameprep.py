@@ -136,9 +136,21 @@ class FramePrep:
     """Per-stream host prep state: conversion buffers + previous frame."""
 
     def __init__(self, width: int, height: int, pad_w: int, pad_h: int, nslots: int = 4):
-        if width % 2 or height % 2:
-            raise ValueError(f"frame size {width}x{height} must be even")
         self.width, self.height = width, height
+        # odd capture geometry (DCI projectors, xrandr panning splits)
+        # cannot carry 4:2:0 chroma siting — the 2x2 subsample and the
+        # native converter both walk pixel quads. Odd frames are edge-
+        # replicated to even dims on the host before conversion; the
+        # extra column/row lands inside the encoder's 16-multiple pad
+        # region (the capture layer normally pads BEFORE the encoder is
+        # built — pipeline/capture.pad_frame_to_even — this is the
+        # defensive mirror for direct FramePrep users).
+        self._even_w = width + (width & 1)
+        self._even_h = height + (height & 1)
+        if pad_w < self._even_w or pad_h < self._even_h:
+            raise ValueError(
+                f"pad {pad_w}x{pad_h} cannot hold the even-padded "
+                f"{self._even_w}x{self._even_h} frame")
         self.pad_w, self.pad_h = pad_w, pad_h
         self._lib = _load()
         # rotating output buffers: the encoder pipelines dispatches, and an
@@ -166,6 +178,10 @@ class FramePrep:
         flight (async device uploads) before a slot is overwritten."""
         if frame.shape != (self.height, self.width, 4):
             raise ValueError(f"frame {frame.shape} != {(self.height, self.width, 4)}")
+        if (self._even_h, self._even_w) != (self.height, self.width):
+            frame = np.pad(frame, ((0, self._even_h - self.height),
+                                   (0, self._even_w - self.width), (0, 0)),
+                           mode="edge")
         if not frame.flags["C_CONTIGUOUS"]:
             frame = np.ascontiguousarray(frame)
         if self._bufs is None:
@@ -181,8 +197,8 @@ class FramePrep:
         self._slot = (self._slot + 1) % self._nslots
         if self._lib is not None:
             self._lib.bgrx_to_i420_pad(
-                _u8p(frame), self.height, self.width, self.pad_h, self.pad_w,
-                _u8p(y), _u8p(u), _u8p(v),
+                _u8p(frame), self._even_h, self._even_w, self.pad_h,
+                self.pad_w, _u8p(y), _u8p(u), _u8p(v),
             )
         else:
             y2, u2, v2 = _numpy_convert_pad(frame, self.pad_h, self.pad_w)
@@ -204,6 +220,13 @@ class FramePrep:
             raise ValueError(f"frame {frame.shape} != {(self.height, self.width, 4)}")
         if tile_w % 16 or self.pad_w % tile_w:
             raise ValueError(f"tile_w {tile_w} must be a 16-multiple dividing {self.pad_w}")
+        # odd geometry: same even-pad normalization as convert() — the
+        # quad-walking converters (native and numpy) must never see an
+        # odd plane, whichever entry point a direct FramePrep user hits
+        if (self._even_h, self._even_w) != (self.height, self.width):
+            frame = np.pad(frame, ((0, self._even_h - self.height),
+                                   (0, self._even_w - self.width), (0, 0)),
+                           mode="edge")
         if not frame.flags["C_CONTIGUOUS"]:
             frame = np.ascontiguousarray(frame)
         idx = np.ascontiguousarray(idx, np.int32)
@@ -213,7 +236,7 @@ class FramePrep:
         vb = np.empty((k, 8, tile_w // 2), np.uint8)
         if self._lib is not None and hasattr(self._lib, "bgrx_to_i420_tiles"):
             self._lib.bgrx_to_i420_tiles(
-                _u8p(frame), self.height, self.width, self.pad_w, tile_w,
+                _u8p(frame), self._even_h, self._even_w, self.pad_w, tile_w,
                 idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), k,
                 _u8p(yb), _u8p(ub), _u8p(vb),
             )

@@ -403,20 +403,32 @@ def _downsample4(plane):
     return jnp.right_shift(s + 8, 4)
 
 
-def coarse_vote_candidates_jnp(cur, ref):
-    """Device mirror of numpy_ref.coarse_vote_candidates: (TOPK, 2) int32
-    coarse MVs in downsampled units, element-exact with the golden model."""
+def coarse_votes_jnp(cur, rd_ext, halo_dcols: int = 0):
+    """Per-MB coarse-rank vote histogram: ((2*COARSE_R+1)^2,) int32.
+
+    ``rd_ext`` is the DOWNSAMPLED reference, optionally pre-extended by
+    ``halo_dcols`` REAL neighbour columns each side (the 2D tile grid's
+    column exchange, parallel/bands.py — in downsampled space, so a
+    tile's votes are element-exact with the full-row computation whose
+    edge pad also happens after downsampling). halo_dcols=0 with a
+    full-width plane is the classic band/frame case. Votes from the
+    tiles of one slice row SUM to the row's histogram (psum over the
+    ``col`` mesh axis / a host-side add), which is what makes the
+    merged candidate list identical to the full-row encoder's."""
     h, w = cur.shape
     mbh, mbw = h // 16, w // 16
     yd = _downsample4(cur)
-    rd = _downsample4(ref.astype(jnp.int32))
+    rd = rd_ext.astype(jnp.int32)
     hd, wd = yd.shape
+    if not 0 <= halo_dcols <= COARSE_R:
+        raise ValueError(f"halo_dcols {halo_dcols} not in [0, {COARSE_R}]")
+    px = COARSE_R - halo_dcols  # edge-pad the remaining horizontal reach
 
     cands, ranks = _me_candidates(COARSE_R)
     scale = 1 << int(ranks.max()).bit_length()
     cand_chunks = jnp.asarray(cands.reshape(-1, _ME_CHUNK, 2))
     rank_chunks = jnp.asarray(ranks.reshape(-1, _ME_CHUNK))
-    rp = jnp.pad(rd, COARSE_R, mode="edge")
+    rp = jnp.pad(rd, ((COARSE_R, COARSE_R), (px, px)), mode="edge")
 
     def sad_one(dxdy):
         sh = jax.lax.dynamic_slice(rp, (COARSE_R + dxdy[1], COARSE_R + dxdy[0]), (hd, wd))
@@ -437,14 +449,31 @@ def coarse_vote_candidates_jnp(cur, ref):
 
     n_real = (2 * COARSE_R + 1) ** 2
     # dense bincount (gather/scatter-free): votes[r] = #{MBs with rank r}
-    votes = (best_rank.reshape(-1, 1) == jnp.arange(n_real)[None, :]).sum(0)
+    return (best_rank.reshape(-1, 1) == jnp.arange(n_real)[None, :]).sum(0)
+
+
+def select_coarse_jnp(votes):
+    """Vote histogram -> (TOPK, 2) int32 coarse candidates, in the golden
+    model's order (votes desc, then rank asc)."""
+    cands, _ = _me_candidates(COARSE_R)
+    n_real = (2 * COARSE_R + 1) ** 2
     # top-K by votes desc then rank asc; vote count <= mbh*mbw < 2^22
     score = votes * 512 + (511 - jnp.arange(n_real))
     _, top_idx = jax.lax.top_k(score, TOPK)
     return jnp.asarray(cands[:n_real])[top_idx]  # (TOPK, 2) — tiny gather
 
 
-def _refine_cands_jnp(coarse, dy_max: int | None = None):
+def coarse_vote_candidates_jnp(cur, ref):
+    """Device mirror of numpy_ref.coarse_vote_candidates: (TOPK, 2) int32
+    coarse MVs in downsampled units, element-exact with the golden model.
+    (Split into coarse_votes_jnp + select_coarse_jnp so the tile grid can
+    psum the vote histograms of one slice row before selection — the
+    composition here is graph-identical to the pre-split definition.)"""
+    return select_coarse_jnp(coarse_votes_jnp(cur, _downsample4(ref.astype(jnp.int32))))
+
+
+def _refine_cands_jnp(coarse, dy_max: int | None = None,
+                      dx_max: int | None = None):
     """(TOPK, 2) coarse -> (1 + TOPK*(2R+1)^2, 2) full-res shift list,
     zero MV first (mirrors numpy_ref.refine_candidate_list).
 
@@ -457,11 +486,17 @@ def _refine_cands_jnp(coarse, dy_max: int | None = None):
     from replicated slab-edge rows would diverge from the decoder's MC,
     which reads the true full-frame reference). The clamp is applied to
     the coarse displacement, so the refine grid stays the golden ±R
-    raster and candidate ORDER (rank tie-breaks) is preserved."""
+    raster and candidate ORDER (rank tie-breaks) is preserved.
+    dx_max is the HORIZONTAL mirror for the 2D tile grid: a tile's chip
+    holds only `halo_cols` neighbour columns, so sub-reach column halos
+    clamp the coarse dx the same way."""
     side = 2 * REFINE_R + 1
     if dy_max is not None:
         cmax = max(0, (int(dy_max) - REFINE_R) // COARSE_DS)
         coarse = coarse.at[:, 1].set(jnp.clip(coarse[:, 1], -cmax, cmax))
+    if dx_max is not None:
+        cmax = max(0, (int(dx_max) - REFINE_R) // COARSE_DS)
+        coarse = coarse.at[:, 0].set(jnp.clip(coarse[:, 0], -cmax, cmax))
     d = jnp.stack(
         jnp.meshgrid(
             jnp.arange(-REFINE_R, REFINE_R + 1),
@@ -475,7 +510,8 @@ def _refine_cands_jnp(coarse, dy_max: int | None = None):
     return jnp.concatenate([jnp.zeros((1, 2), jnp.int32), cands.astype(jnp.int32)])
 
 
-def hier_me_mc(cur, ref_y, ry_pad, ru_pad, rv_pad, dy_max: int | None = None):
+def hier_me_mc(cur, ref_y, ry_pad, ru_pad, rv_pad, dy_max: int | None = None,
+               dx_max: int | None = None, coarse=None):
     """Global-candidate ME fused with motion compensation — gather-free.
 
     Two scans over 1+TOPK*(2R+1)^2 global shifts. The COST scan carries
@@ -491,11 +527,19 @@ def hier_me_mc(cur, ref_y, ry_pad, ru_pad, rv_pad, dy_max: int | None = None):
     frac weights, so selected values match the per-MB gather formulation.
     (Why no gathers: tools/profile_slope2.py measured 30 ms per full-plane
     gather on v5e vs 0.26 ms per global-shift SAD map.)
+
+    ``coarse`` (a (TOPK, 2) candidate array) overrides the internal
+    coarse vote — the 2D tile grid passes the row-merged selection
+    (parallel/bands.py) so every tile of a slice row refines the same
+    global candidates the full-row encoder would. ``dx_max`` clamps the
+    horizontal window for sub-reach column halos (see _refine_cands_jnp).
     """
     h, w = cur.shape
     mbh, mbw = h // 16, w // 16
     ch, cw = h // 2, w // 2
-    cands = _refine_cands_jnp(coarse_vote_candidates_jnp(cur, ref_y), dy_max)
+    if coarse is None:
+        coarse = coarse_vote_candidates_jnp(cur, ref_y)
+    cands = _refine_cands_jnp(coarse, dy_max, dx_max)
     ncand = cands.shape[0]
     ranks = jnp.arange(ncand, dtype=jnp.int32)
     scale = 1 << int(np.int64(ncand - 1)).bit_length()
@@ -717,36 +761,87 @@ def encode_band_p_planes(y, u, v, slab_y, slab_u, slab_v, qp, halo: int,
     so a smaller halo could select predictions from replicated slab
     edges the decoder's full-frame reference does not contain. halo
     must be even and <= MV_PAD."""
+    return encode_tile_p_planes(y, u, v, slab_y, slab_u, slab_v, qp,
+                                halo=halo, search=search, me=me)
+
+
+def encode_tile_p_planes(y, u, v, slab_y, slab_u, slab_v, qp, halo: int,
+                         halo_cols: int = 0, search: int = 8, me: str = "hier",
+                         coarse=None, defer_skip: bool = False):
+    """Tile-sliced P encode: one rows×cols tile of the frame against a
+    2D halo-extended reference SLAB — the device half of the 2D
+    tile-grid step (parallel/bands.py, SELKIES_TILE_GRID).
+
+    Generalizes encode_band_p_planes to a second (column) halo axis:
+    ``slab_y`` carries the tile's reference pixels plus ``halo`` REAL
+    rows above/below AND ``halo_cols`` REAL columns left/right (chroma
+    slabs carry half of each), edge-replicated at picture boundaries —
+    including the diagonal corner blocks, which the column-then-row
+    exchange order in parallel/bands.py fills with the diagonal
+    neighbour's pixels. ``halo_cols=0`` with a full-width slab is
+    exactly the band case (same graph). The validity rule mirrors the
+    vertical one: halo_cols must be even and either 0 (full-width slab)
+    or in [REFINE_R + 2, MV_PAD]; below the full hierarchical reach + the
+    chroma bilinear's one-column lookahead (COARSE_DS*COARSE_R +
+    REFINE_R + 2 = 36) the horizontal candidate window is clamped to
+    ``halo_cols - 2`` so no SELECTED prediction column is fabricated.
+
+    ``coarse`` injects a precomputed (TOPK, 2) coarse candidate list:
+    the tile grid merges the per-tile vote histograms of one slice row
+    (psum over the ``col`` mesh axis) and selects ONCE, so every tile
+    refines the same global candidates as the full-row band encoder —
+    that, plus full-reach halos, is what makes an RxC grid's access
+    units byte-identical to the SELKIES_BANDS=R oracle.
+
+    ``defer_skip=True`` returns ``resid_zero`` instead of ``skip``: the
+    P_Skip derivation needs the MV of the macroblock to the LEFT, which
+    at an interior tile seam lives on the neighbouring chip — the tile
+    grid derives skip AFTER the row gather, on the merged full-row MV
+    grid, exactly reproducing the full-row semantics."""
     if halo % 2 or not 0 <= halo <= MV_PAD or 0 < halo < REFINE_R + 2:
         raise ValueError(
             f"halo {halo} must be even and 0 (full-reference slab) or in "
             f"[{REFINE_R + 2}, {MV_PAD}]")
+    if halo_cols % 2 or not 0 <= halo_cols <= MV_PAD or \
+            0 < halo_cols < REFINE_R + 2:
+        raise ValueError(
+            f"halo_cols {halo_cols} must be even and 0 (full-width slab) or "
+            f"in [{REFINE_R + 2}, {MV_PAD}]")
     y = y.astype(jnp.int32)
     u = u.astype(jnp.int32)
     v = v.astype(jnp.int32)
     qp = jnp.asarray(qp, jnp.int32)
-    halo_c = halo // 2
+    halo_c, halo_cc = halo // 2, halo_cols // 2
     vt, vtc = MV_PAD - halo, MV_PAD - halo_c
-    ry = jnp.pad(slab_y, ((vt, vt), (MV_PAD, MV_PAD)), mode="edge")
-    ru = jnp.pad(slab_u, ((vtc, vtc), (MV_PAD, MV_PAD)), mode="edge")
-    rv = jnp.pad(slab_v, ((vtc, vtc), (MV_PAD, MV_PAD)), mode="edge")
-    # band-local reference rows (coarse candidate voting sees the band)
+    ht, htc = MV_PAD - halo_cols, MV_PAD - halo_cc
+    ry = jnp.pad(slab_y, ((vt, vt), (ht, ht)), mode="edge")
+    ru = jnp.pad(slab_u, ((vtc, vtc), (htc, htc)), mode="edge")
+    rv = jnp.pad(slab_v, ((vtc, vtc), (htc, htc)), mode="edge")
+    # tile-local reference (coarse candidate voting sees the tile when no
+    # merged `coarse` list is injected)
     ref_y = slab_y[halo : slab_y.shape[0] - halo] if halo else slab_y
-    # full reach is COARSE_DS*COARSE_R + REFINE_R = 34 luma rows; the
-    # chroma bilinear additionally reads one row past dy>>1, so a halo
+    if halo_cols:
+        ref_y = ref_y[:, halo_cols : ref_y.shape[1] - halo_cols]
+    # full reach is COARSE_DS*COARSE_R + REFINE_R = 34 luma rows/cols; the
+    # chroma bilinear additionally reads one row/col past d>>1, so a halo
     # of 36+ already covers every candidate and no clamp is applied —
     # and neither is halo=0, where the slab IS the full reference
-    unclamped = halo == 0 or halo >= COARSE_DS * COARSE_R + REFINE_R + 2
-    dy_max = None if unclamped else halo - 2
+    full_reach = COARSE_DS * COARSE_R + REFINE_R + 2
+    dy_max = None if halo == 0 or halo >= full_reach else halo - 2
+    dx_max = (None if halo_cols == 0 or halo_cols >= full_reach
+              else halo_cols - 2)
     mvs, pred_y, pred_u, pred_v = _me_mc_dispatch(
-        y, ref_y, ry, ru, rv, search=search, me=me, dy_max=dy_max)
-    return _p_transform_tail(y, u, v, qp, mvs, pred_y, pred_u, pred_v)
+        y, ref_y, ry, ru, rv, search=search, me=me, dy_max=dy_max,
+        dx_max=dx_max, coarse=coarse)
+    return _p_transform_tail(y, u, v, qp, mvs, pred_y, pred_u, pred_v,
+                             defer_skip=defer_skip)
 
 
 def _me_mc_dispatch(y, ref_y, ry, ru, rv, *, search: int, me: str,
-                    dy_max: int | None = None):
+                    dy_max: int | None = None, dx_max: int | None = None,
+                    coarse=None):
     """ME + MC over MV_PAD-padded reference planes (shared by the
-    full-frame and band-sliced steps)."""
+    full-frame, band-sliced, and tile-sliced steps)."""
     if me == "hier":
         # fused gather-free ME+MC: predictions fall out of the same
         # candidate scan that picks the MVs. On TPU the Pallas kernel
@@ -756,18 +851,22 @@ def _me_mc_dispatch(y, ref_y, ry, ru, rv, *, search: int, me: str,
         if _use_pallas_me(y.shape[1]):
             from selkies_tpu.models.h264.pallas_me import hier_me_mc_pallas
 
-            return hier_me_mc_pallas(y, ref_y, ry, ru, rv, dy_max=dy_max)
-        return hier_me_mc(y, ref_y, ry, ru, rv, dy_max)
-    if dy_max is not None:
-        raise ValueError("band-clamped candidate windows require me='hier'")
+            return hier_me_mc_pallas(y, ref_y, ry, ru, rv, dy_max=dy_max,
+                                     dx_max=dx_max, coarse=coarse)
+        return hier_me_mc(y, ref_y, ry, ru, rv, dy_max, dx_max, coarse)
+    if dy_max is not None or dx_max is not None or coarse is not None:
+        raise ValueError("tile-clamped candidate windows require me='hier'")
     mvs = motion_search(y, ry, search)
     return mvs, mc_luma(ry, mvs), mc_chroma(ru, mvs), mc_chroma(rv, mvs)
 
 
-def _p_transform_tail(y, u, v, qp, mvs, pred_y, pred_u, pred_v):
+def _p_transform_tail(y, u, v, qp, mvs, pred_y, pred_u, pred_v,
+                      defer_skip: bool = False):
     """Transform + quant + recon + skip derivation — everything after
     ME/MC, shared bit-exactly by encode_frame_p_planes and
-    encode_band_p_planes."""
+    encode_band_p_planes/encode_tile_p_planes. ``defer_skip`` replaces
+    the ``skip`` output with ``resid_zero`` (the residual-free mask) so
+    a tile-grid caller can run _skip_mask on the row-merged MV grid."""
     qp_c = _CHROMA_QP[qp]
     # Luma: plain 4x4 transform, all 16 coeffs (no DC Hadamard in inter MBs)
     yb = _plane_to_mb_blocks(y - pred_y, 4)
@@ -795,11 +894,12 @@ def _p_transform_tail(y, u, v, qp, mvs, pred_y, pred_u, pred_v):
         & (cb_ac == 0).all((-4, -3, -2, -1))
         & (cr_ac == 0).all((-4, -3, -2, -1))
     )
-    skip = _skip_mask(mvs, resid_zero)
+    skip_kv = ({"resid_zero": resid_zero} if defer_skip
+               else {"skip": _skip_mask(mvs, resid_zero)})
 
     return {
         "mvs": mvs,
-        "skip": skip,
+        **skip_kv,
         "luma_ac": luma_ac,
         "chroma_dc": jnp.stack([cb_dc, cr_dc], axis=2),
         "chroma_ac": jnp.stack([cb_ac, cr_ac], axis=2),

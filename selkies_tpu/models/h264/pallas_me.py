@@ -241,7 +241,7 @@ def _me_mc_call(cands, cur, ry_pad, ru_pad, rv_pad, interpret=False):
 
 
 def hier_me_mc_pallas(cur, ref_y, ry_pad, ru_pad, rv_pad, *, interpret=None,
-                      dy_max=None):
+                      dy_max=None, dx_max=None, coarse=None):
     """Drop-in replacement for encoder_core.hier_me_mc (same signature,
     bit-identical outputs). Coarse candidate voting stays in XLA (tiny);
     the refine+MC walk runs in the fused kernel.
@@ -252,13 +252,18 @@ def hier_me_mc_pallas(cur, ref_y, ry_pad, ru_pad, rv_pad, *, interpret=None,
     into VMEM is real reference content from the band's halo slab, so a
     band's kernel never depends on rows resident on another chip. The
     kernel body is unchanged — the clamp lands in the candidate list,
-    keeping the rank/tie-break order bit-identical to hier_me_mc."""
+    keeping the rank/tie-break order bit-identical to hier_me_mc.
+    dx_max is the horizontal mirror for the 2D tile grid
+    (encoder_core.encode_tile_p_planes), and ``coarse`` injects the tile
+    grid's row-merged (TOPK, 2) coarse candidate list — both land in the
+    candidate list exactly like dy_max; the kernel is untouched."""
     from selkies_tpu.models.h264 import encoder_core as core
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    cands = core._refine_cands_jnp(
-        core.coarse_vote_candidates_jnp(cur, ref_y), dy_max)
+    if coarse is None:
+        coarse = core.coarse_vote_candidates_jnp(cur, ref_y)
+    cands = core._refine_cands_jnp(coarse, dy_max, dx_max)
     # pad to a multiple of the kernel's candidate group with zero-MV
     # duplicates: same SAD as the rank-0 zero MV but a later rank, so a
     # padded slot can never win (cost = sad*scale + rank is all-distinct)

@@ -105,18 +105,27 @@ def _tpuh264enc(*, width: int, height: int, fps: int = 60, qp: int = 28, **kw):
     # rate loop via set_qp); the library rows consume bitrate_kbps
     kw.pop("bitrate_kbps", None)
     bands = kw.pop("bands", None)
-    if bands is None:
-        from selkies_tpu.parallel.bands import bands_from_env
+    cols = kw.pop("cols", None)
+    if bands is None and cols is None:
+        from selkies_tpu.parallel.bands import bands_from_env, grid_from_env
 
-        bands = bands_from_env()
-    if bands > 1:
-        # SELKIES_BANDS>1: the frame band-splits across the chip mesh as
-        # independent slices (parallel/bands.py) — the 4K / full-motion
-        # path where the FIFO-serialized device step is the bottleneck.
-        # Falls back to the single-device band-sliced encode (identical
-        # bytes) when the mesh is smaller than the band count. Routed
-        # BEFORE the solo-knob setdefaults so `dropped` sees only what
-        # the caller actually passed.
+        grid = grid_from_env()
+        if grid is not None:
+            # SELKIES_TILE_GRID=RxC owns the carve: R band-rows × C tile
+            # columns (C=1 degenerates to SELKIES_BANDS=R exactly)
+            bands, cols = grid
+        else:
+            bands = bands_from_env()
+    bands = 1 if bands is None else bands
+    cols = 1 if cols is None else cols
+    if bands > 1 or cols > 1:
+        # SELKIES_BANDS>1 / SELKIES_TILE_GRID: the frame splits across
+        # the chip mesh as independent slices (parallel/bands.py) — the
+        # 4K / full-motion path where the FIFO-serialized device step is
+        # the bottleneck. Falls back to the single-device sliced encode
+        # (identical bytes) when the mesh is smaller than the carve.
+        # Routed BEFORE the solo-knob setdefaults so `dropped` sees only
+        # what the caller actually passed.
         from selkies_tpu.parallel.bands import BandedH264Encoder
 
         dropped = set(kw) - {"frame_batch", "pipeline_depth",
@@ -132,6 +141,7 @@ def _tpuh264enc(*, width: int, height: int, fps: int = 60, qp: int = 28, **kw):
                 "(solo-encoder knobs; see docs/bands.md)", sorted(dropped))
         return BandedH264Encoder(
             width=width, height=height, qp=qp, fps=fps, bands=bands,
+            cols=cols,
             frame_batch=kw.get("frame_batch", default_frame_batch()),
             pipeline_depth=kw.get("pipeline_depth", default_pipeline_depth()),
             keyframe_interval=kw.get("keyframe_interval", 0),

@@ -58,8 +58,13 @@ class FrameStats:
     step_ms: float = 0.0
     fetch_ms: float = 0.0
     # intra-frame band parallelism (parallel/bands.py): slice count and
-    # per-band dispatch->ready latency when the frame was band-split
+    # per-band dispatch->ready latency when the frame was band-split.
+    # cols > 1 = 2D tile grid (SELKIES_TILE_GRID): each of the `bands`
+    # slice rows was additionally tile-split across `cols` chips
+    # (band_step_ms stays per ROW — the row payload is col-merged on
+    # device before it is fetched)
     bands: int = 1
+    cols: int = 1
     band_step_ms: tuple = ()
     # which payload the P downlink shipped (ISSUE 7 / PERF.md round 9):
     # "coeff" sparse coefficient rows, "bits" device-entropy slice bits,

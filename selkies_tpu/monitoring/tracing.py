@@ -63,6 +63,20 @@ black-box bundles stay greppable):
                   unpack/pack overlapped on the pack pool — until the
                   multi-slice access unit is assembled in band order;
                   selkies_stage_ms stage "band_gather"
+    row_gather    2D tile-grid encoder only (SELKIES_TILE_GRID,
+                  parallel/bands.py): the tile-mode analogue of
+                  band_gather — per-ROW payload fetches off the
+                  (band, col) mesh (each row's C tile outputs were
+                  already col-merged on device) + the per-slice host
+                  completions, until the multi-slice access unit is
+                  assembled in band-row order; selkies_stage_ms stage
+                  "row_gather"
+    col_halo      tile-grid collective probe (tools/profile_bands.py):
+                  the column+row halo-slab construction a tile chip
+                  performs before ME — on a real mesh this is the two
+                  ppermute exchanges; the profiler emits the span
+                  around its serial analogue so trace summaries bound
+                  the exchange term of the dedicated-chip projection
   fleet service (parallel/serving.py):
     convert       per-session BGRx→I420 on the pack pool
     device-step   sharded batch encode dispatch

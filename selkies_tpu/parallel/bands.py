@@ -35,6 +35,32 @@ Placement composes with the ``session`` axis: a v5e-8 can serve
 8 sessions × 1 band (parallel/sessions.py), 2 sessions × 4 bands, or
 1 session × 8 bands — ``partition_devices`` carves the chip list into
 per-session band rows for the fleet (serving.BandedFleetService).
+
+2D tile grid (``SELKIES_TILE_GRID=RxC``): rows alone stop paying at 4K —
+a horizontal band of a 4K frame is ~4x the MB area of its 1080p
+counterpart, and bands below 3 MB rows break the adjacent-halo
+invariant — so the band axis extends to a two-axis ``(band, col)`` chip
+mesh where each chip encodes ONE tile:
+
+  * compute (ME/MC, transform, quant) is per-tile independent; vertical
+    reference halos ride the existing ``band``-axis ppermute and NEW
+    horizontal halo columns ride a ``col``-axis ppermute (columns first,
+    then rows, so the diagonal corner blocks carry the diagonal
+    neighbour's real pixels);
+  * the coarse ME vote histograms of one slice row are psum-merged over
+    ``col`` before candidate selection, and P_Skip derivation runs on
+    the row-gathered MV grid (the post-ME neighbour-MV exchange), so
+    MV prediction at tile seams matches the full-row encoder exactly;
+  * the bitstream stays valid H.264 by keeping SLICES per band-row: each
+    row's C tile payloads are all-gathered along ``col``, merged into
+    the full-row coefficient layout (or handed to the PR 7 active
+    entropy coder, run per row), and completed by the unchanged
+    per-slice host flow (sparse_complete.py).
+
+``RxC`` with ``C=1`` is byte-identical to ``SELKIES_BANDS=R`` (same code
+path), ``1x1`` to the solo encoder, and — with the default full-reach
+halos — an RxC access unit is byte-identical to the SELKIES_BANDS=R
+oracle (tests/test_tile_grid.py).
 """
 
 from __future__ import annotations
@@ -62,18 +88,23 @@ from selkies_tpu.models.h264.compact import (
 )
 from selkies_tpu.models.h264.device_cavlc import resolve_entropy
 from selkies_tpu.models.h264.encoder_core import (
+    _downsample4,
+    _skip_mask,
+    coarse_votes_jnp,
     encode_band_p_planes,
     encode_frame_planes,
+    encode_tile_p_planes,
     fuse_downlink,
     pack_i_compact,
     pack_p_sparse_entropy,
     pack_p_sparse_var,
+    select_coarse_jnp,
 )
 from selkies_tpu.models.h264.native import (
     pack_slice_fast,
     pack_slice_p_fast,
 )
-from selkies_tpu.models.h264.numpy_ref import MV_PAD, PFrameCoeffs
+from selkies_tpu.models.h264.numpy_ref import COARSE_R, MV_PAD, PFrameCoeffs
 from selkies_tpu.models.stats import FrameStats, LinkByteCounter
 from selkies_tpu.monitoring.telemetry import telemetry
 from selkies_tpu.monitoring.tracing import tracer
@@ -87,9 +118,13 @@ __all__ = [
     "band_mesh",
     "band_spans",
     "bands_from_env",
+    "grid_from_env",
     "halo_from_env",
     "partition_devices",
+    "tile_halo_from_env",
+    "tile_mesh",
     "usable_bands",
+    "usable_cols",
 ]
 
 # Default halo: the full hierarchical-ME reach (34 luma rows) plus the
@@ -103,6 +138,26 @@ BAND_HALO = MV_PAD
 # band alone (ppermute exchanges adjacent bands only): 16·3 = 48 luma /
 # 24 chroma rows covers the 40/20-row default halo.
 MIN_BAND_MB_ROWS = 3
+# The column mirror: a tile must be wide enough that its neighbour's
+# column halo comes from THIS tile alone — 16·3 = 48 luma columns covers
+# the 40/20-column default halo AND the coarse vote's downsampled
+# COARSE_R-column exchange (8 <= 48/4 = 12 downsampled columns).
+MIN_TILE_MB_COLS = 3
+
+
+def grid_from_env() -> tuple[int, int] | None:
+    """SELKIES_TILE_GRID=RxC -> (rows, cols), or None when unset/invalid.
+    Set, it owns the carve: R band-rows × C tile columns per frame
+    (SELKIES_BANDS is ignored — RxC with C=1 IS the band carve)."""
+    env = os.environ.get("SELKIES_TILE_GRID", "")
+    if not env:
+        return None
+    try:
+        r_s, c_s = env.lower().replace("×", "x").split("x")
+        return max(1, int(r_s)), max(1, int(c_s))
+    except ValueError:
+        logger.warning("SELKIES_TILE_GRID=%r is not RxC; ignoring", env)
+        return None
 
 
 def bands_from_env() -> int:
@@ -130,6 +185,35 @@ def halo_from_env() -> int:
     return halo - halo % 2  # even: chroma slabs carry halo//2 rows
 
 
+def tile_halo_from_env() -> int:
+    """Horizontal halo COLUMNS exchanged along the ``col`` axis
+    (SELKIES_TILE_HALO; default = the full hierarchical reach, like the
+    row halo — below 36 the horizontal candidate window clamps to
+    halo-2 and the byte-oracle vs SELKIES_BANDS=R no longer holds)."""
+    env = os.environ.get("SELKIES_TILE_HALO", "")
+    if not env:
+        return BAND_HALO
+    try:
+        halo = int(env)
+    except ValueError:
+        logger.warning("SELKIES_TILE_HALO=%r is not an integer; using %d",
+                       env, BAND_HALO)
+        return BAND_HALO
+    halo = max(4, min(BAND_HALO, halo))
+    return halo - halo % 2  # even: chroma slabs carry halo//2 columns
+
+
+def usable_cols(mb_width: int, requested: int) -> int:
+    """Largest tile-column count <= `requested` that splits `mb_width` MB
+    columns into EQUAL tiles of at least MIN_TILE_MB_COLS (the column
+    mirror of usable_bands)."""
+    requested = max(1, int(requested))
+    for cols in range(min(requested, mb_width // MIN_TILE_MB_COLS), 1, -1):
+        if mb_width % cols == 0:
+            return cols
+    return 1
+
+
 def usable_bands(mb_height: int, requested: int) -> int:
     """Largest band count <= `requested` that splits `mb_height` MB rows
     into EQUAL bands of at least MIN_BAND_MB_ROWS (equal shards are what
@@ -155,6 +239,18 @@ def band_mesh(bands: int, devices=None) -> Mesh:
     if len(devs) < bands:
         raise ValueError(f"need {bands} devices for the band mesh, have {len(devs)}")
     return Mesh(devs[:bands], axis_names=("band",))
+
+
+def tile_mesh(rows: int, cols: int, devices=None) -> Mesh:
+    """Two-axis ``(band, col)`` mesh over the first rows*cols devices:
+    chip (r, c) encodes the tile at band-row r, tile-column c."""
+    devs = np.array(devices if devices is not None else jax.devices())
+    if len(devs) < rows * cols:
+        raise ValueError(
+            f"need {rows * cols} devices for the {rows}x{cols} tile mesh, "
+            f"have {len(devs)}")
+    return Mesh(devs[: rows * cols].reshape(rows, cols),
+                axis_names=("band", "col"))
 
 
 def partition_devices(n_sessions: int, bands: int, devices=None) -> list[list]:
@@ -189,22 +285,29 @@ def _band_i_body(y, u, v, qp, cap_rows: int):
     return prefix, buf, out["recon_y"], out["recon_u"], out["recon_v"]
 
 
-def _band_p_body(y, u, v, qp, slab_y, slab_u, slab_v, *, halo: int,
-                 nscap: int, cap_rows: int, entropy=None):
-    out = encode_band_p_planes(y, u, v, slab_y, slab_u, slab_v, qp, halo=halo)
-    # nscap == the band's MB count, so the ns > nscap dense fallback is
-    # structurally unreachable — every band completes from its fused
-    # buffer (+ the rare row spill from `buf`)
+def _pack_fused(out, nscap: int, cap_rows: int, entropy):
+    """One band-row's P outputs -> (fused, buf) downlink pair — the
+    pack dispatch shared by the 1D band body and the tile grid's
+    post-merge row pack. nscap == the row's MB count, so the ns > nscap
+    dense fallback is structurally unreachable — every row completes
+    from its fused buffer (+ the rare row spill from `buf`)."""
     if entropy is not None:
-        # activity-proportional device entropy per band: a busy band
+        # activity-proportional device entropy per row: a busy row
         # ships its own bit-shifted slice payload (first_mb lives in the
-        # host-written header), a quiet band keeps the sparse rows —
-        # decided per band per frame, inside the shard_map body
+        # host-written header), a quiet row keeps the sparse rows —
+        # decided per row per frame, inside the shard_map body
         bits_words, min_mbs, buckets = entropy
         fused, _dense, buf = pack_p_sparse_entropy(
             out, nscap, cap_rows, None, bits_words, min_mbs, buckets)
     else:
         fused, _dense, buf = pack_p_sparse_var(out, nscap, cap_rows)
+    return fused, buf
+
+
+def _band_p_body(y, u, v, qp, slab_y, slab_u, slab_v, *, halo: int,
+                 nscap: int, cap_rows: int, entropy=None):
+    out = encode_band_p_planes(y, u, v, slab_y, slab_u, slab_v, qp, halo=halo)
+    fused, buf = _pack_fused(out, nscap, cap_rows, entropy)
     return fused, buf, out["recon_y"], out["recon_u"], out["recon_v"]
 
 
@@ -240,6 +343,182 @@ def _ppermute_slab(r0, halo: int, bands: int, axis: str):
     top = jnp.where(i == 0, jnp.broadcast_to(r0[:1], (halo, w)), from_above)
     bot = jnp.where(i == bands - 1, jnp.broadcast_to(r0[-1:], (halo, w)), from_below)
     return jnp.concatenate([top, r0, bot], axis=0)
+
+
+def _ppermute_cols(r0, halo: int, cols: int, axis: str):
+    """Column mirror of _ppermute_slab: exchange `halo` boundary COLUMNS
+    with the adjacent tiles over the mesh (tile 0 / tile C-1
+    edge-replicate, matching the full-row encoder's horizontal edge pad
+    and the decoder's picture clamp). Run BEFORE the row exchange so the
+    vertically-exchanged rows already carry their horizontal halos — the
+    diagonal corner blocks then hold the diagonal neighbour's pixels."""
+    if halo == 0 or cols == 1:
+        return r0
+    h = r0.shape[0]
+    from_left = jax.lax.ppermute(
+        r0[:, -halo:], axis, [(c, c + 1) for c in range(cols - 1)])
+    from_right = jax.lax.ppermute(
+        r0[:, :halo], axis, [(c + 1, c) for c in range(cols - 1)])
+    i = jax.lax.axis_index(axis)
+    left = jnp.where(i == 0, jnp.broadcast_to(r0[:, :1], (h, halo)), from_left)
+    right = jnp.where(i == cols - 1, jnp.broadcast_to(r0[:, -1:], (h, halo)),
+                      from_right)
+    return jnp.concatenate([left, r0, right], axis=1)
+
+
+# keys merged tile->row before the per-row pack: everything the sparse
+# packers read, in MB-grid layout (axis 1 = MB column). recon stays
+# per-tile — it is next frame's per-chip reference.
+_ROW_MERGE_KEYS = ("mvs", "resid_zero", "luma_ac", "chroma_dc", "chroma_ac")
+
+
+def _row_pack(row, nscap: int, cap_rows: int, entropy):
+    """Full-row out dict (post-merge) -> (fused, buf): P_Skip derivation
+    on the merged MV grid, then the unchanged per-row pack dispatch
+    (_pack_fused — sparse rows or the PR 7 entropy wrap, per row)."""
+    row["skip"] = _skip_mask(row["mvs"], row.pop("resid_zero"))
+    return _pack_fused(row, nscap, cap_rows, entropy)
+
+
+def _mesh_tile_p_body(y, u, v, qp, ry, ru, rv, *, bands: int, cols: int,
+                      halo: int, halo_cols: int, nscap: int, cap_rows: int,
+                      entropy=None):
+    """Per-chip tile body (shard_map over the 2D (band, col) mesh):
+    column-then-row halo exchange, row-merged coarse votes, independent
+    tile encode, then the ``col``-axis row gather + per-row pack. The
+    gathered inputs are identical on every chip of a row, so the row's
+    fused payload is computed replicated along ``col`` (the host fetches
+    the col-0 copy) — the pack is cheap scatters; ME/MC/transform, the
+    actual per-chip budget, stays fully tile-split."""
+    cur, cu, cv = y[0, 0], u[0, 0], v[0, 0]
+    r0, u0, v0 = ry[0, 0], ru[0, 0], rv[0, 0]
+    hy = _ppermute_cols(r0, halo_cols, cols, "col")
+    hu = _ppermute_cols(u0, halo_cols // 2, cols, "col")
+    hv = _ppermute_cols(v0, halo_cols // 2, cols, "col")
+    sy = _ppermute_slab(hy, halo, bands, "band")
+    su = _ppermute_slab(hu, halo // 2, bands, "band")
+    sv = _ppermute_slab(hv, halo // 2, bands, "band")
+    # coarse votes with a REAL-column downsampled halo (exchanged in
+    # downsampled space so picture-edge replication matches the full-row
+    # encoder's post-downsample edge pad), psum-merged over the row:
+    # every tile refines the same candidates the full-row encoder picks
+    rd_ext = _ppermute_cols(_downsample4(r0), COARSE_R, cols, "col")
+    votes = jax.lax.psum(coarse_votes_jnp(cur, rd_ext, COARSE_R), "col")
+    coarse = select_coarse_jnp(votes)
+    out = encode_tile_p_planes(cur, cu, cv, sy, su, sv, qp, halo=halo,
+                               halo_cols=halo_cols, coarse=coarse,
+                               defer_skip=True)
+    # row gather: each row's C tile outputs merge into the full-row MB
+    # grid (axis 1 = MB/pixel column) — the post-ME neighbour exchange
+    # that makes seam P_Skip/mvd context identical to the full-row coder
+    row = {k: jax.lax.all_gather(out[k], "col", axis=1, tiled=True)
+           for k in _ROW_MERGE_KEYS}
+    fused, buf = _row_pack(row, nscap, cap_rows, entropy)
+    return (fused[None, None], buf[None, None], out["recon_y"][None, None],
+            out["recon_u"][None, None], out["recon_v"][None, None])
+
+
+def _mesh_tile_i_body(y, u, v, qp, *, cols: int, cap_rows: int, tile_w: int):
+    """IDR tile body: row 0 of an I slice is a serial DC-prediction chain
+    across the FULL row (left-neighbour recon), so the row's source tiles
+    are all-gathered and every chip of the row runs the identical
+    full-row I encode (IDRs are one-per-GOP — redundant compute on C
+    chips beats serializing the chain through one). Each chip keeps its
+    own tile's recon crop as the P-step reference."""
+    gy = jax.lax.all_gather(y[0, 0], "col", axis=1, tiled=True)
+    gu = jax.lax.all_gather(u[0, 0], "col", axis=1, tiled=True)
+    gv = jax.lax.all_gather(v[0, 0], "col", axis=1, tiled=True)
+    prefix, buf, ry_, ru_, rv_ = _band_i_body(gy, gu, gv, qp, cap_rows)
+    c = jax.lax.axis_index("col")
+    ty = jax.lax.dynamic_slice(ry_, (0, c * tile_w), (ry_.shape[0], tile_w))
+    tu = jax.lax.dynamic_slice(
+        ru_, (0, c * (tile_w // 2)), (ru_.shape[0], tile_w // 2))
+    tv = jax.lax.dynamic_slice(
+        rv_, (0, c * (tile_w // 2)), (rv_.shape[0], tile_w // 2))
+    return (prefix[None, None], buf[None, None], ty[None, None],
+            tu[None, None], tv[None, None])
+
+
+def _stacked_tile_p_step(ys, us, vs, qp, rys, rus, rvs, *, bands: int,
+                         cols: int, halo: int, halo_cols: int, nscap: int,
+                         cap_rows: int, entropy=None):
+    """Single-device fallback of the tile-grid P step: identical per-tile
+    graphs run in a static Python loop (the per-tile oracle stays a
+    byte-identity statement), slabs/votes built from the reassembled
+    full planes with the same edge semantics as the mesh exchanges."""
+    b, c, th, tw = rys.shape
+    cth, ctw = th // 2, tw // 2
+    hc, hcc = halo_cols, halo_cols // 2
+    fy = rys.transpose(0, 2, 1, 3).reshape(b * th, c * tw)
+    fu = rus.transpose(0, 2, 1, 3).reshape(b * cth, c * ctw)
+    fv = rvs.transpose(0, 2, 1, 3).reshape(b * cth, c * ctw)
+    py = jnp.pad(fy, ((halo, halo), (hc, hc)), mode="edge")
+    pu = jnp.pad(fu, ((halo // 2, halo // 2), (hcc, hcc)), mode="edge")
+    pv = jnp.pad(fv, ((halo // 2, halo // 2), (hcc, hcc)), mode="edge")
+    twd = tw // 4  # downsampled tile width (coarse vote geometry)
+    fused_rows, buf_rows = [], []
+    recon = [[None] * c for _ in range(b)]
+    for r in range(b):
+        # merged coarse votes of the row (the psum's serial analogue)
+        rd = jnp.pad(_downsample4(fy[r * th:(r + 1) * th]),
+                     ((0, 0), (COARSE_R, COARSE_R)), mode="edge")
+        votes = sum(
+            coarse_votes_jnp(
+                ys[r, k], rd[:, k * twd : (k + 1) * twd + 2 * COARSE_R],
+                COARSE_R)
+            for k in range(c))
+        coarse = select_coarse_jnp(votes)
+        touts = []
+        for k in range(c):
+            sy = py[r * th : (r + 1) * th + 2 * halo,
+                    k * tw : (k + 1) * tw + 2 * hc]
+            su = pu[r * cth : (r + 1) * cth + halo,
+                    k * ctw : (k + 1) * ctw + 2 * hcc]
+            sv = pv[r * cth : (r + 1) * cth + halo,
+                    k * ctw : (k + 1) * ctw + 2 * hcc]
+            out = encode_tile_p_planes(
+                ys[r, k], us[r, k], vs[r, k], sy, su, sv, qp, halo=halo,
+                halo_cols=hc, coarse=coarse, defer_skip=True)
+            touts.append(out)
+            recon[r][k] = (out["recon_y"], out["recon_u"], out["recon_v"])
+        row = {key: jnp.concatenate([t[key] for t in touts], axis=1)
+               for key in _ROW_MERGE_KEYS}
+        fused, buf = _row_pack(row, nscap, cap_rows, entropy)
+        fused_rows.append(fused)
+        buf_rows.append(buf)
+    # fused/buf gain a unit col axis so the host-side handle logic is
+    # shape-uniform with the mesh path's (bands, cols, ...) outputs
+    return (
+        jnp.stack(fused_rows)[:, None],
+        jnp.stack(buf_rows)[:, None],
+        jnp.stack([jnp.stack([recon[r][k][0] for k in range(c)])
+                   for r in range(b)]),
+        jnp.stack([jnp.stack([recon[r][k][1] for k in range(c)])
+                   for r in range(b)]),
+        jnp.stack([jnp.stack([recon[r][k][2] for k in range(c)])
+                   for r in range(b)]),
+    )
+
+
+def _stacked_tile_i_step(ys, us, vs, qp, *, bands: int, cols: int,
+                         cap_rows: int):
+    b, c, th, tw = ys.shape
+    prefixes, bufs = [], []
+    ry, ru, rv = [], [], []
+    for r in range(b):
+        gy = ys[r].transpose(1, 0, 2).reshape(th, c * tw)
+        gu = us[r].transpose(1, 0, 2).reshape(th // 2, c * tw // 2)
+        gv = vs[r].transpose(1, 0, 2).reshape(th // 2, c * tw // 2)
+        prefix, buf, ry_, ru_, rv_ = _band_i_body(gy, gu, gv, qp, cap_rows)
+        prefixes.append(prefix)
+        bufs.append(buf)
+        ry.append(jnp.stack([ry_[:, k * tw:(k + 1) * tw] for k in range(c)]))
+        ru.append(jnp.stack(
+            [ru_[:, k * (tw // 2):(k + 1) * (tw // 2)] for k in range(c)]))
+        rv.append(jnp.stack(
+            [rv_[:, k * (tw // 2):(k + 1) * (tw // 2)] for k in range(c)]))
+    return (jnp.stack(prefixes)[:, None], jnp.stack(bufs)[:, None],
+            jnp.stack(ry), jnp.stack(ru), jnp.stack(rv))
 
 
 def _stacked_i_step(ys, us, vs, qp, *, bands: int, cap_rows: int):
@@ -287,7 +566,7 @@ from selkies_tpu.models.h264.sparse_complete import (
 
 
 class BandedH264Encoder:
-    """Full-frame band-parallel H.264 encoder: frame in, multi-slice
+    """Full-frame band/tile-parallel H.264 encoder: frame in, multi-slice
     Annex-B access unit out.
 
     One IDR then P frames forever (keyframe_interval / force_keyframe as
@@ -299,6 +578,12 @@ class BandedH264Encoder:
     intentionally absent (those frames are not device-step-bound); an
     unchanged capture still short-circuits to host-built all-skip
     slices.
+
+    With ``cols > 1`` (SELKIES_TILE_GRID=RxC) each band-row additionally
+    splits into C tiles across a 2D ``(band, col)`` chip mesh — compute
+    is per-tile, slices (and the whole host completion path) stay per
+    band-row via the on-mesh row gather. ``cols=1`` takes the 1D band
+    code path unchanged.
     """
 
     codec = "h264"
@@ -306,6 +591,7 @@ class BandedH264Encoder:
     def __init__(self, width: int, height: int, qp: int = 28, fps: int = 60,
                  channels: int = 4, keyframe_interval: int = 0,
                  bands: int | None = None, halo: int | None = None,
+                 cols: int | None = None, halo_cols: int | None = None,
                  devices=None, frame_batch: int = 1, pipeline_depth: int = 1,
                  pack_workers: int | None = None,
                  device_entropy: bool | None = None,
@@ -320,6 +606,10 @@ class BandedH264Encoder:
         self._pad_h = (height + 15) // 16 * 16
         self._pad_w = (width + 15) // 16 * 16
         self._mbh, self._mbw = self._pad_h // 16, self._pad_w // 16
+        if bands is None and cols is None:
+            grid = grid_from_env()
+            if grid is not None:
+                bands, cols = grid
         requested = bands if bands is not None else bands_from_env()
         self.bands = usable_bands(self._mbh, requested)
         if self.bands != requested:
@@ -327,6 +617,13 @@ class BandedH264Encoder:
                 "%dx%d: %d bands requested, using %d (%d MB rows must split "
                 "into equal bands of >= %d rows)", width, height, requested,
                 self.bands, self._mbh, MIN_BAND_MB_ROWS)
+        cols_req = 1 if cols is None else max(1, int(cols))
+        self.cols = usable_cols(self._mbw, cols_req)
+        if self.cols != cols_req:
+            logger.info(
+                "%dx%d: %d tile columns requested, using %d (%d MB columns "
+                "must split into equal tiles of >= %d columns)", width,
+                height, cols_req, self.cols, self._mbw, MIN_TILE_MB_COLS)
         halo = halo_from_env() if halo is None else int(halo)
         # a real band slab (bands > 1) needs at least the refine grid's
         # reach + the chroma bilinear lookahead in REAL rows — see
@@ -337,9 +634,32 @@ class BandedH264Encoder:
             self.halo = 0 if self.bands == 1 else 4
         if self.halo != halo:
             logger.info("band halo %d adjusted to %d", halo, self.halo)
+        # column halo: 0 (full-width slab) in band mode, else the same
+        # adjustment rules as the row halo. NOTE: below 36 the horizontal
+        # candidate window clamps and the RxC == SELKIES_BANDS=R byte
+        # oracle no longer holds (still a valid, decodable stream).
+        halo_cols = (tile_halo_from_env() if halo_cols is None
+                     else int(halo_cols))
+        if self.cols == 1:
+            self.halo_cols = 0
+        else:
+            self.halo_cols = max(4, min(BAND_HALO, halo_cols - halo_cols % 2))
+            if self.halo_cols != halo_cols:
+                logger.info("tile column halo %d adjusted to %d", halo_cols,
+                            self.halo_cols)
+            if self.bands == 1:
+                # a single band-row spans the full frame height: the
+                # band-axis ppermute exchanges nothing, so the tile slab
+                # IS the full-height reference (halo=0 identity case)
+                self.halo = 0
         self.spans = band_spans(self._mbh, self.bands)
         self._band_mbh = self._mbh // self.bands
         self._band_h = 16 * self._band_mbh
+        self._tile_mbw = self._mbw // self.cols
+        self._tile_w = 16 * self._tile_mbw
+        # per-ROW downlink geometry: slices stay one-per-band-row in tile
+        # mode (the col axis gathers before the pack), so every cap/fetch
+        # shape below is identical to the same-R band encoder's
         m_band = self._band_mbh * self._mbw
         # per-band downlink caps: nscap = the band's MB count makes the
         # dense-header fallback unreachable; the row cap matches the solo
@@ -370,20 +690,58 @@ class BandedH264Encoder:
         self._pfx_lock = threading.Lock()
 
         devs = list(devices) if devices is not None else jax.devices()
-        self.mesh_enabled = self.bands > 1 and len(devs) >= self.bands
+        chips = self.bands * self.cols
+        self.mesh_enabled = chips > 1 and len(devs) >= chips
         self.params = StreamParams(width=width, height=height, qp=self.qp, fps=fps)
         self._headers = write_sps(self.params) + write_pps(self.params)
         from selkies_tpu.models.frameprep import FramePrep
 
         self._prep = FramePrep(width, height, self._pad_w, self._pad_h, nslots=2)
+        kw = {_CHECK_KW: False} if _CHECK_KW else {}
+        # 1D band-step constants (unused by the cols > 1 tile branch,
+        # but built once so the mesh and fallback band paths can never
+        # compile against different constants)
         iconsts = dict(cap_rows=self._cap_i)
         pconsts = dict(bands=self.bands, halo=self.halo, nscap=self._nscap,
                        cap_rows=self._cap_p, entropy=self._entropy)
-        if self.mesh_enabled:
+        if self.cols > 1:
+            ticonsts = dict(cols=self.cols, cap_rows=self._cap_i,
+                            tile_w=self._tile_w)
+            tpconsts = dict(bands=self.bands, cols=self.cols, halo=self.halo,
+                            halo_cols=self.halo_cols, nscap=self._nscap,
+                            cap_rows=self._cap_p, entropy=self._entropy)
+            if self.mesh_enabled:
+                self.mesh = tile_mesh(self.bands, self.cols, devs)
+                self._shard = NamedSharding(self.mesh, P("band", "col"))
+                spec = P("band", "col")
+                self._step_i = jax.jit(_shard_map(
+                    partial(_mesh_tile_i_body, **ticonsts), mesh=self.mesh,
+                    in_specs=(spec, spec, spec, P()), out_specs=spec, **kw))
+                self._step_p = jax.jit(
+                    _shard_map(
+                        partial(_mesh_tile_p_body, **tpconsts), mesh=self.mesh,
+                        in_specs=(spec, spec, spec, P(), spec, spec, spec),
+                        out_specs=spec, **kw),
+                    donate_argnums=(4, 5, 6))
+            else:
+                logger.info(
+                    "tile mesh unavailable (%d devices < %dx%d grid): "
+                    "running the tile-sliced step on one device (identical "
+                    "bytes, no intra-frame parallelism)", len(devs),
+                    self.bands, self.cols)
+                self.mesh = None
+                self._shard = None
+                self._fallback_dev = devs[0] if devs else None
+                self._step_i = jax.jit(partial(
+                    _stacked_tile_i_step, bands=self.bands, cols=self.cols,
+                    cap_rows=self._cap_i))
+                self._step_p = jax.jit(partial(_stacked_tile_p_step,
+                                               **tpconsts),
+                                       donate_argnums=(4, 5, 6))
+        elif self.mesh_enabled:
             self.mesh = band_mesh(self.bands, devs)
             self._shard = NamedSharding(self.mesh, P("band"))
             spec = P("band")
-            kw = {_CHECK_KW: False} if _CHECK_KW else {}
             self._step_i = jax.jit(_shard_map(
                 partial(_mesh_i_body, **iconsts), mesh=self.mesh,
                 in_specs=(spec, spec, spec, P()), out_specs=spec, **kw))
@@ -441,23 +799,50 @@ class BandedH264Encoder:
     # -- device dispatch ------------------------------------------------
 
     def _put_band_planes(self, y: np.ndarray, u: np.ndarray, v: np.ndarray):
-        """Stack converted planes on a leading band axis and upload —
-        sharded one band per chip on the mesh (each chip receives only
-        its own rows), plain on the fallback device."""
+        """Stack converted planes on a leading band axis — (bands, cols)
+        leading axes in tile-grid mode — and upload, sharded one band
+        (tile) per chip on the mesh (each chip receives only its own
+        pixels), plain on the fallback device."""
         b, bh = self.bands, self._band_h
-        ys = np.asarray(y).reshape(b, bh, self._pad_w)
-        us = np.asarray(u).reshape(b, bh // 2, self._pad_w // 2)
-        vs = np.asarray(v).reshape(b, bh // 2, self._pad_w // 2)
+        if self.cols > 1:
+            c, tw = self.cols, self._tile_w
+            ys = np.ascontiguousarray(
+                np.asarray(y).reshape(b, bh, c, tw).transpose(0, 2, 1, 3))
+            us = np.ascontiguousarray(
+                np.asarray(u).reshape(b, bh // 2, c, tw // 2)
+                .transpose(0, 2, 1, 3))
+            vs = np.ascontiguousarray(
+                np.asarray(v).reshape(b, bh // 2, c, tw // 2)
+                .transpose(0, 2, 1, 3))
+        else:
+            ys = np.asarray(y).reshape(b, bh, self._pad_w)
+            us = np.asarray(u).reshape(b, bh // 2, self._pad_w // 2)
+            vs = np.asarray(v).reshape(b, bh // 2, self._pad_w // 2)
         self.link_bytes.add("up_full", ys.nbytes + us.nbytes + vs.nbytes)
         dst = self._shard if self._shard is not None else self._fallback_dev
         return (jax.device_put(ys, dst), jax.device_put(us, dst),
                 jax.device_put(vs, dst))
 
     def _band_handles(self, arr):
-        """Per-band device handles of a stacked (bands, ...) output, in
-        band order. On the mesh these are the per-chip shards (so a
+        """Per-band-row device handles of a stacked (bands, ...) output,
+        in band order. On the mesh these are the per-chip shards (so a
         fetch pulls only from that band's chip); on the fallback device
-        they are row slices of the same array."""
+        they are row slices of the same array. In tile-grid mode the
+        per-row downlink payloads are (bands, cols, ...) with identical
+        copies along ``col`` (every chip of a row computed the gathered
+        row pack) — the fetch pulls the col-0 chip's copy."""
+        if self.cols > 1:
+            if self._shard is None:  # fallback: unit col axis
+                return [arr[b, 0] for b in range(self.bands)]
+            handles = [None] * self.bands
+            for sh in arr.addressable_shards:
+                # a size-1 mesh axis leaves its dim unpartitioned, so the
+                # shard index is slice(None) there — start None means 0
+                if (sh.index[1].start or 0) == 0:
+                    handles[sh.index[0].start or 0] = sh.data[0, 0]
+            if any(h is None for h in handles):  # non-addressable topology
+                return [arr[b, 0] for b in range(self.bands)]
+            return handles
         if self._shard is None or self.bands == 1:
             return [arr[b] for b in range(self.bands)]
         handles = [None] * self.bands
@@ -588,7 +973,7 @@ class BandedH264Encoder:
                 frame_index=self.frame_index, idr=False, qp=self.qp,
                 bytes=len(au), device_ms=(time.perf_counter() - t0) * 1e3,
                 pack_ms=0.0, skipped_mbs=self._mbh * self._mbw,
-                bands=self.bands,
+                bands=self.bands, cols=self.cols,
             )
             self.frame_index += 1
             self._frames_since_idr += 1
@@ -616,7 +1001,12 @@ class BandedH264Encoder:
             pfx = prefix_d
         else:
             hint = self._pfx_slice_len()
-            pfx = prefix_d[:, :hint] if hint < self._pfx_total else prefix_d
+            if hint >= self._pfx_total:
+                pfx = prefix_d
+            elif self.cols > 1:
+                pfx = prefix_d[:, :, :hint]
+            else:
+                pfx = prefix_d[:, :hint]
         pfx_h = self._band_handles(pfx)
         full_h = self._band_handles(prefix_d)
         buf_h = self._band_handles(buf_d)
@@ -634,8 +1024,12 @@ class BandedH264Encoder:
         # otherwise queue later bands behind earlier bands' host packs
         # and report that host time as device step latency.
         t_ready = [0.0] * self.bands
+        # span vocabulary: "row_gather" is the tile-grid fan-out (per-ROW
+        # payloads off a 2D mesh — each already col-merged on device),
+        # "band_gather" the classic 1D band fan-out (tracing.py)
+        gather_stage = "row_gather" if self.cols > 1 else "band_gather"
         try:
-            with tracer.span("band_gather"):
+            with tracer.span(gather_stage):
                 futs = [self._pack_pool.submit(_one, b)
                         for b in range(self.bands)]
                 for b in range(self.bands):
@@ -674,7 +1068,7 @@ class BandedH264Encoder:
         band_step = tuple(round((t - t_up) * 1e3, 3) for t in t_ready)
         step_ms = (max(t_ready) - t_up) * 1e3
         if telemetry.enabled:
-            telemetry.stage_ms("band_gather", (t_done - t_up) * 1e3)
+            telemetry.stage_ms(gather_stage, (t_done - t_up) * 1e3)
             for ms in band_step:
                 telemetry.stage_ms("step", ms)
         stats = FrameStats(
@@ -687,8 +1081,8 @@ class BandedH264Encoder:
             # the solo sync path, so a bands-vs-solo A/B attributes
             # conversion time identically on both rows
             upload_ms=(t_up - t0) * 1e3, step_ms=step_ms,
-            fetch_ms=fetch_ms, bands=self.bands, band_step_ms=band_step,
-            downlink_mode=downlink_mode,
+            fetch_ms=fetch_ms, bands=self.bands, cols=self.cols,
+            band_step_ms=band_step, downlink_mode=downlink_mode,
         )
         self.last_stats = stats
         if idr:

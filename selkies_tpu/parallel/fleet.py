@@ -192,7 +192,7 @@ class SessionFleet:
                  fps: int, qp: int = 28, sources=None, devices=None,
                  service=None, supervisor: SlotSupervisor | None = None,
                  placer=None):
-        from selkies_tpu.parallel.bands import bands_from_env
+        from selkies_tpu.parallel.bands import bands_from_env, grid_from_env
         from selkies_tpu.parallel.lifecycle import SessionPlacer
         from selkies_tpu.parallel.serving import (
             BandedFleetService, MultiSessionH264Service)
@@ -203,29 +203,38 @@ class SessionFleet:
         self.base_fps = fps
         self.qp = qp
         self._devices = devices
-        # chips-per-session trade (SELKIES_BANDS): 1 band keeps the
-        # classic one-session-per-chip lockstep shard; B>1 gives every
-        # session a B-chip band row for intra-frame slice parallelism
-        # (parallel/bands.py) — fewer sessions per slice, each faster
-        bands = bands_from_env()
+        # chips-per-session trade (SELKIES_BANDS / SELKIES_TILE_GRID):
+        # 1 band keeps the classic one-session-per-chip lockstep shard;
+        # B>1 gives every session a B-chip band row for intra-frame
+        # slice parallelism (parallel/bands.py), and RxC carves a
+        # two-axis tile grid per session (rows*cols chips each, the
+        # 4K/8K split-frame placement) — fewer sessions per slice,
+        # each faster
+        grid = grid_from_env()
+        rows_, cols_ = grid if grid is not None else (bands_from_env(), 1)
+        self.grid = (rows_, cols_)
+        bands = rows_ * cols_  # chips per session (the placer's unit)
         self.bands = bands
         # the carve is MUTABLE state owned by the placer (parallel/
         # lifecycle.py): admission gates client connects against it, and
         # for banded services re-carves move chips between sessions live
-        self.placer = placer or SessionPlacer(devices=devices, bands=bands)
+        self.placer = placer or SessionPlacer(devices=devices, bands=bands,
+                                              grid=self.grid)
         self.placer.place_initial(self.n, bands)
         # queue promotion: a release frees chips, the placer grants them
         # to a queued session, and THIS rebuilds its encoder on the new
         # row so the client's reconnect retry serves from it
         self.placer.on_admitted = self._on_promoted
         if bands > 1:
-            logger.info("fleet: SELKIES_BANDS=%d — band-parallel per-session "
-                        "encoders (%d sessions)", bands, self.n)
+            logger.info("fleet: %s — %s per-session encoders (%d sessions)",
+                        f"SELKIES_TILE_GRID={rows_}x{cols_}" if cols_ > 1
+                        else f"SELKIES_BANDS={bands}",
+                        "tile-grid" if cols_ > 1 else "band-parallel", self.n)
             # rebuilds (supervisor RESTART rung) read the placer's LIVE
             # carve, so a restarted service keeps any borrowed chips
             self._make_tpu_service = lambda: BandedFleetService(
                 self.n, width, height, qp=qp, fps=self.base_fps,
-                bands=bands, devices=devices,
+                bands=rows_, cols=cols_, devices=devices,
                 rows=[self.placer.row(k) for k in range(self.n)])
         else:
             self._make_tpu_service = lambda: MultiSessionH264Service(

@@ -124,6 +124,7 @@ class SessionPlacer:
     """
 
     def __init__(self, devices=None, *, bands: int = 1,
+                 grid: tuple[int, int] | None = None,
                  host_cores: int | None = None,
                  queue_limit: int | None = None,
                  health=None):
@@ -133,6 +134,17 @@ class SessionPlacer:
             devices = jax.devices()
         self.devices = list(devices)
         self.bands = max(1, int(bands))
+        # 2D tile-grid carve shape (SELKIES_TILE_GRID=RxC): purely
+        # descriptive here — the placer's unit stays CHIPS per session
+        # (bands == rows*cols for a grid carve), so every admission /
+        # borrow / gauge path below is shape-agnostic; the shape is
+        # surfaced through stats()/'/statz' so operators can see how a
+        # session's chip row folds into its (band, col) mesh
+        self.grid = (int(grid[0]), int(grid[1])) if grid is not None else None
+        if self.grid is not None and self.grid[0] * self.grid[1] != self.bands:
+            raise ValueError(
+                f"grid {self.grid[0]}x{self.grid[1]} does not match "
+                f"{self.bands} chips per session")
         self.host_cores = host_cores if host_cores is not None else (
             os.cpu_count() or 4)
         self.queue_limit = (_queue_limit_from_env()
@@ -443,6 +455,8 @@ class SessionPlacer:
             return {
                 "chips": len(self.devices),
                 "free": len(self._free) if not self.shared else 0,
+                "grid": (f"{self.grid[0]}x{self.grid[1]}"
+                         if self.grid is not None else None),
                 "shared": self.shared,
                 "draining": self.draining,
                 "borrowed": self._borrowed(),

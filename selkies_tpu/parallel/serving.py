@@ -191,19 +191,30 @@ class BandedFleetService:
 
     def __init__(self, n_sessions: int, width: int, height: int, *,
                  qp: int = 28, fps: int = 60, bands: int | None = None,
+                 cols: int | None = None,
                  devices=None, rows: list[list] | None = None):
         from selkies_tpu.parallel.bands import (
-            BandedH264Encoder, bands_from_env, partition_devices)
+            BandedH264Encoder, bands_from_env, grid_from_env,
+            partition_devices)
         from selkies_tpu.utils.jaxcache import enable_persistent_compilation_cache
 
         enable_persistent_compilation_cache()
         self.n = n_sessions
-        if bands is None:
-            bands = bands_from_env()
+        if bands is None and cols is None:
+            grid = grid_from_env()
+            if grid is not None:
+                bands, cols = grid  # SELKIES_TILE_GRID=RxC owns the carve
+            else:
+                bands = bands_from_env()
+        bands = 1 if bands is None else max(1, int(bands))
+        # cols: per-session 2D tile grid (each session's row of chips is
+        # an R×C mesh; a session's chip budget is bands*cols)
+        self.cols = 1 if cols is None else max(1, int(cols))
         if rows is None:
             # no placer-managed carve handed in: one-shot static carve
             try:
-                rows = partition_devices(n_sessions, bands, devices)
+                rows = partition_devices(n_sessions, bands * self.cols,
+                                         devices)
             except ValueError:
                 # slice too small for n x bands: every session falls back
                 # to a single-device band-sliced encode (identical bytes),
@@ -225,6 +236,7 @@ class BandedFleetService:
         self.encoders = [
             BandedH264Encoder(width, height, qp=qp, fps=fps,
                               bands=self._row_bands(rows[k]),
+                              cols=self.cols,
                               devices=rows[k]) if rows[k] else None
             for k in range(n_sessions)
         ]
@@ -253,12 +265,16 @@ class BandedFleetService:
         mesh — a row wider than the constructor band count re-slices the
         frame across every chip it holds (that is the whole point of
         borrowing; ``band_mesh`` only places the first ``bands`` devices,
-        so without this the borrowed chips would sit idle). The encoder
-        itself clamps via ``usable_bands`` when the geometry's MB rows
-        do not divide into that many bands — at such geometries the
-        extra chips cannot carry a slice and the band count (and the
-        bytes) stay exactly the constructor carve's."""
-        return max(self._bands_req, len(row))
+        so without this the borrowed chips would sit idle). With a 2D
+        tile grid the enlargement adds whole BAND-ROWS of ``cols`` chips
+        (a lender's row is bands*cols chips, so loans arrive in grid
+        multiples); a remainder smaller than one grid row cannot carry a
+        slice row and stays idle. The encoder itself clamps via
+        ``usable_bands`` when the geometry's MB rows do not divide into
+        that many bands — at such geometries the extra chips cannot
+        carry a slice and the band count (and the bytes) stay exactly
+        the constructor carve's."""
+        return max(self._bands_req, len(row) // self.cols)
 
     def recarve(self, session: int, devices: list) -> None:
         """Rebuild one session's encoder on a new device row (the
@@ -305,7 +321,7 @@ class BandedFleetService:
         # dynamic qp carries over via restore_session -> set_qp.
         enc = BandedH264Encoder(
             self._width, self._height, qp=self._qp, fps=self._fps,
-            bands=self._row_bands(devices), devices=devices)
+            bands=self._row_bands(devices), cols=self.cols, devices=devices)
         if ck is not None:
             try:
                 restore_session(ck, enc)

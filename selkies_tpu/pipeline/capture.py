@@ -55,6 +55,24 @@ def _record_x_error(dpy, event):
 _handler_installed = False
 
 
+def pad_frame_to_even(frame: np.ndarray) -> np.ndarray:
+    """Edge-replicate a BGRx frame's last column/row when its geometry is
+    odd (returns the frame unchanged when already even).
+
+    4:2:0 chroma siting cannot express an odd luma dimension — H.264's
+    frame cropping works in 2-sample units and every converter in the
+    stack walks 2x2 pixel quads — so odd root-window geometry (DCI
+    projectors at 4096x2161 panning strips, xrandr splits) is normalized
+    HERE, at the capture boundary: the encoder is built at the even
+    size, the stream carries one replicated edge column/row, and nothing
+    downstream ever sees an odd plane."""
+    h, w = frame.shape[:2]
+    if not (h & 1 or w & 1):
+        return frame
+    return np.ascontiguousarray(
+        np.pad(frame, ((0, h & 1), (0, w & 1), (0, 0)), mode="edge"))
+
+
 def _install_error_handler(x) -> None:
     global _handler_installed
     if not _handler_installed:
@@ -127,7 +145,13 @@ class X11CaptureSource:
         _install_error_handler(x)
         self._screen = x.XDefaultScreen(self._dpy)
         self._root = x.XDefaultRootWindow(self._dpy)
-        self.width, self.height = self._root_geometry()
+        # raw X geometry drives the grabs; the PUBLIC width/height (what
+        # the pipeline builds the encoder from) round odd dims up to
+        # even, matching the pad_frame_to_even normalization capture()
+        # applies to every returned frame
+        self._raw_w, self._raw_h = self._root_geometry()
+        self.width = self._raw_w + (self._raw_w & 1)
+        self.height = self._raw_h + (self._raw_h & 1)
         self._last_geom_check = 0.0
 
         self._libc = _load("libc.so.6", "libc.so")
@@ -138,7 +162,7 @@ class X11CaptureSource:
             self._declare_shm(self._xext, self._libc)
             if self._xext.XShmQueryExtension(self._dpy):
                 try:
-                    self._setup_shm(self.width, self.height)
+                    self._setup_shm(self._raw_w, self._raw_h)
                 except OSError as e:
                     logger.warning("MIT-SHM setup failed (%s); using XGetImage", e)
         if self._shm_img is None:
@@ -268,12 +292,14 @@ class X11CaptureSource:
         if now - self._last_geom_check >= _GEOMETRY_POLL_S:
             self._last_geom_check = now
             w, h = self._root_geometry()
-            if (w, h) != (self.width, self.height):
-                logger.info("display resized %dx%d -> %dx%d", self.width, self.height, w, h)
+            if (w, h) != (self._raw_w, self._raw_h):
+                logger.info("display resized %dx%d -> %dx%d",
+                            self._raw_w, self._raw_h, w, h)
                 if self._shm_img is not None:
                     self._teardown_shm()
                     self._setup_shm(w, h)
-                self.width, self.height = w, h
+                self._raw_w, self._raw_h = w, h
+                self.width, self.height = w + (w & 1), h + (h & 1)
         if self._shm_img is not None:
             if not self._xext.XShmGetImage(
                 self._dpy, self._root, self._shm_img, 0, 0, _ALL_PLANES
@@ -282,9 +308,14 @@ class X11CaptureSource:
             img = self._shm_img.contents
             buf = ctypes.string_at(img.data, img.bytes_per_line * img.height)
             frame = np.frombuffer(buf, np.uint8).reshape(img.height, img.bytes_per_line)
-            return np.ascontiguousarray(frame[:, : img.width * 4].reshape(img.height, img.width, 4))
+            return pad_frame_to_even(np.ascontiguousarray(
+                frame[:, : img.width * 4].reshape(img.height, img.width, 4)))
+        # raw geometry, not the poll's locals: within the 1 s poll
+        # interval `w`/`h` are unbound here (the XGetImage fallback used
+        # to NameError on every frame between polls)
         ptr = self._x.XGetImage(
-            self._dpy, self._root, 0, 0, w, h, _ALL_PLANES, _ZPIXMAP
+            self._dpy, self._root, 0, 0, self._raw_w, self._raw_h,
+            _ALL_PLANES, _ZPIXMAP
         )
         if not ptr:
             raise RuntimeError("XGetImage failed")
@@ -292,7 +323,8 @@ class X11CaptureSource:
             img = ptr.contents
             buf = ctypes.string_at(img.data, img.bytes_per_line * img.height)
             frame = np.frombuffer(buf, np.uint8).reshape(img.height, img.bytes_per_line)
-            return np.ascontiguousarray(frame[:, : img.width * 4].reshape(img.height, img.width, 4))
+            return pad_frame_to_even(np.ascontiguousarray(
+                frame[:, : img.width * 4].reshape(img.height, img.width, 4)))
         finally:
             ptr.contents.f.destroy_image(ctypes.cast(ptr, ctypes.c_void_p))
 

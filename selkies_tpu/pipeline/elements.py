@@ -166,11 +166,14 @@ class EncodedFrame:
     unpack_ms: float = 0.0
     cavlc_ms: float = 0.0
     # device-stage split (device_ms ≈ upload_ms + step_ms + fetch_ms) and
-    # band-parallel slice count (parallel/bands.py; 1 = single slice)
+    # band-parallel slice count (parallel/bands.py; 1 = single slice);
+    # cols > 1 = each band-row additionally tile-split across a 2D
+    # (band, col) chip mesh (SELKIES_TILE_GRID)
     upload_ms: float = 0.0
     step_ms: float = 0.0
     fetch_ms: float = 0.0
     bands: int = 1
+    cols: int = 1
     # P downlink payload mode ("coeff"/"bits"/"dense"; "" = no downlink
     # or unattributed) — see models/stats.FrameStats.downlink_mode
     downlink_mode: str = ""
@@ -364,6 +367,7 @@ class VideoPipeline:
                             step_ms=getattr(stats, "step_ms", 0.0),
                             fetch_ms=getattr(stats, "fetch_ms", 0.0),
                             bands=getattr(stats, "bands", 1),
+                            cols=getattr(stats, "cols", 1),
                             downlink_mode=getattr(stats, "downlink_mode", ""),
                             frame_id=self._fid_by_ts.pop(meta, 0),
                         )
@@ -389,6 +393,7 @@ class VideoPipeline:
                             step_ms=getattr(stats, "step_ms", 0.0),
                             fetch_ms=getattr(stats, "fetch_ms", 0.0),
                             bands=getattr(stats, "bands", 1),
+                            cols=getattr(stats, "cols", 1),
                             downlink_mode=getattr(stats, "downlink_mode", ""),
                             frame_id=fid,
                         )

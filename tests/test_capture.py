@@ -16,6 +16,7 @@ from selkies_tpu.pipeline.capture import (
     _XImage,
     _XShmSegmentInfo,
     make_frame_source,
+    pad_frame_to_even,
 )
 from selkies_tpu.input_host.x11 import X11Unavailable
 from selkies_tpu.pipeline.elements import SyntheticSource
@@ -31,6 +32,41 @@ def test_ximage_struct_layout():
     assert _XImage.bits_per_pixel.offset == 48
     assert _XImage.red_mask.offset == 56
     assert _XShmSegmentInfo.shmaddr.offset == 16
+
+
+def test_pad_frame_to_even():
+    """Odd root-window geometry (4096x2161 DCI panning strips, xrandr
+    splits) is normalized at the capture boundary: the last column/row
+    is edge-replicated, even frames pass through untouched, and the
+    result is always C-contiguous (the converter walks raw pointers)."""
+    rng = np.random.default_rng(4)
+    even = rng.integers(0, 256, (48, 64, 4), np.uint8)
+    assert pad_frame_to_even(even) is even  # no copy on the hot path
+
+    for h, w in [(48, 63), (47, 64), (47, 63)]:
+        frame = rng.integers(0, 256, (h, w, 4), np.uint8)
+        out = pad_frame_to_even(frame)
+        eh, ew = h + (h & 1), w + (w & 1)
+        assert out.shape == (eh, ew, 4) and out.flags["C_CONTIGUOUS"]
+        np.testing.assert_array_equal(out[:h, :w], frame)
+        if w & 1:
+            np.testing.assert_array_equal(out[:h, w], frame[:, w - 1])
+        if h & 1:
+            np.testing.assert_array_equal(out[h, :w], frame[h - 1, :])
+        if h & 1 and w & 1:
+            np.testing.assert_array_equal(out[h, w], frame[h - 1, w - 1])
+
+
+def test_4k_dci_capture_padding():
+    """The full 4K-DCI odd strip (4096x2161) pads to 4096x2162 without
+    copying the even case — the geometry the X11 source's public
+    width/height rounding promises the pipeline."""
+    frame = np.zeros((2161, 4096, 4), np.uint8)
+    frame[-1, :, 0] = 7
+    out = pad_frame_to_even(frame)
+    assert out.shape == (2162, 4096, 4)
+    np.testing.assert_array_equal(out[-1], out[-2])
+    assert (out[-1, :, 0] == 7).all()
 
 
 def test_selection_falls_back_without_display(monkeypatch):

@@ -63,9 +63,74 @@ def test_dirty_bands():
     assert not prep.dirty_bands(f2).any()
 
 
-def test_odd_size_rejected():
+def test_odd_geometry_edge_pads():
+    """Odd capture geometry (DCI projectors, xrandr panning splits) is
+    edge-replicated to even dims before conversion — bit-exact with
+    converting the manually padded frame — as long as the encoder pad
+    region can hold the extra column/row."""
+    rng = np.random.default_rng(11)
+    for h, w in [(48, 63), (47, 64), (47, 63)]:
+        ph, pw = (h + 15) // 16 * 16, (w + 15) // 16 * 16
+        frame = rng.integers(0, 256, (h, w, 4), dtype=np.uint8)
+        even = np.pad(frame, ((0, h & 1), (0, w & 1), (0, 0)), mode="edge")
+        y, u, v = FramePrep(w, h, pw, ph).convert(frame)
+        ry, ru, rv = FramePrep(even.shape[1], even.shape[0], pw, ph).convert(even)
+        np.testing.assert_array_equal(y, ry)
+        np.testing.assert_array_equal(u, ru)
+        np.testing.assert_array_equal(v, rv)
+
+
+def test_odd_geometry_convert_tiles_edge_pads():
+    """convert_tiles mirrors convert()'s even-pad normalization — a
+    direct FramePrep user at odd geometry gets bit-exact tiles, not a
+    quad walk past the last row/column."""
+    rng = np.random.default_rng(13)
+    h, w = 47, 63
+    ph, pw = 64, 64
+    frame = rng.integers(0, 256, (h, w, 4), dtype=np.uint8)
+    prep = FramePrep(w, h, pw, ph)
+    idx = np.array([0, 1024 + 0], np.int32)  # band 0 and band 1, tile 0
+    yb, ub, vb = prep.convert_tiles(frame, idx, pw)
+    y, u, v = prep.convert(frame)
+    for i, band in enumerate((0, 1)):
+        np.testing.assert_array_equal(yb[i], y[band * 16:(band + 1) * 16])
+        np.testing.assert_array_equal(ub[i], u[band * 8:(band + 1) * 8])
+        np.testing.assert_array_equal(vb[i], v[band * 8:(band + 1) * 8])
+
+
+def test_pad_too_small_for_even_rejected():
+    # an odd frame needs one extra column: a pad that cannot hold the
+    # even-padded frame is a contract violation, not a silent crop
     with pytest.raises(ValueError):
-        FramePrep(63, 48, 64, 48)
+        FramePrep(63, 48, 63, 48)
+    with pytest.raises(ValueError):
+        FramePrep(64, 47, 64, 47)
+
+
+@pytest.mark.parametrize("size", [(2160, 4096), (2159, 4095)])
+def test_4k_dci_geometry_padding(size):
+    """4K-DCI (4096x2160) and its odd panning-strip variants convert
+    bit-exactly vs the numpy reference at full scale — the capture path
+    above 1080p exercises the same 16-multiple padding the encoder
+    sees (2160 = 135 MB rows is NOT a multiple-of-16 pixel pad story at
+    DCI width alone: the odd variant forces both the even-pad and the
+    16-pad paths at once)."""
+    h, w = size
+    ph, pw = (h + 1 + 15) // 16 * 16, (w + 1 + 15) // 16 * 16
+    rng = np.random.default_rng(w)
+    # kron-expanded coarse noise: full-scale content without a 34 MB
+    # random draw dominating the test's runtime
+    coarse = rng.integers(0, 256, ((h + 39) // 40, (w + 39) // 40, 4),
+                          dtype=np.uint8)
+    frame = np.kron(coarse, np.ones((40, 40, 1), np.uint8))[:h, :w]
+    frame = np.ascontiguousarray(frame)
+    prep = FramePrep(w, h, pw, ph)
+    y, u, v = prep.convert(frame)
+    even = np.pad(frame, ((0, h & 1), (0, w & 1), (0, 0)), mode="edge")
+    fy, fu, fv = _numpy_convert_pad(even, ph, pw)
+    np.testing.assert_array_equal(y, fy)
+    np.testing.assert_array_equal(u, fu)
+    np.testing.assert_array_equal(v, fv)
 
 
 def test_dirty_tiles_and_convert_tiles_bit_exact():

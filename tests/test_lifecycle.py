@@ -163,6 +163,60 @@ def test_placement_gauges_match_owned_chips_in_shared_mode():
         telemetry.reset()
 
 
+def test_placer_grid_carve_admission_and_borrow():
+    """2D tile-grid carve (SELKIES_TILE_GRID=RxC -> bands=R*C chips per
+    session): admission, queueing, and borrow/return move whole R*C-chip
+    grid rows, and the shape is surfaced through stats()/'/statz'."""
+    p = SessionPlacer(devices=chips(16), bands=4, grid=(2, 2),
+                      host_cores=16, queue_limit=2)
+    rows = p.place_initial(3, 4)
+    assert [len(r) for r in rows] == [4, 4, 4] and len(p._free) == 4
+    assert p.stats()["grid"] == "2x2"
+    assert p.admit(3).accepted            # takes the last grid row
+    assert p.admit(4).decision == "queue"  # capacity
+    # borrow moves the lender's WHOLE grid row (bands*cols chips), so a
+    # 2x2 borrower re-carves onto grid-multiple chip counts
+    p.set_busy(0, True)
+    got = p.borrow(0)
+    assert len(got) == 4 and len(p.row(0)) == 8 and p.borrowed_chips() == 4
+    settled = p.return_borrowed(0)
+    assert settled and p.borrowed_chips() == 0
+    p.assert_consistent()
+
+
+def test_placer_grid_shape_must_match_chip_budget():
+    with pytest.raises(ValueError):
+        SessionPlacer(devices=chips(8), bands=3, grid=(2, 2))
+
+
+def test_placement_gauges_2d_carve_sum_to_owned():
+    """selkies_placement_chips for a grid carve: free/assigned/borrowed
+    always partition the owned chips — a borrow moves a whole grid row
+    into `borrowed` without double-counting it under `assigned`."""
+    telemetry.reset()
+    telemetry.enabled = True
+    try:
+        p = SessionPlacer(devices=chips(12), bands=4, grid=(2, 2),
+                          host_cores=16)
+        p.place_initial(2, 4)
+
+        def gauges():
+            return {lbls[0]: v for (fam, lbls), v in telemetry._gauges.items()
+                    if fam == "selkies_placement_chips"}
+
+        assert gauges() == {"free": 4.0, "assigned": 8.0, "borrowed": 0.0}
+        p.set_busy(0, True)
+        p.borrow(0)                     # session 1's whole 2x2 row moves
+        g = gauges()
+        assert g == {"free": 4.0, "assigned": 4.0, "borrowed": 4.0}
+        assert sum(g.values()) == len(p.devices)
+        p.return_borrowed(0)
+        assert gauges() == {"free": 4.0, "assigned": 8.0, "borrowed": 0.0}
+    finally:
+        telemetry.enabled = False
+        telemetry.reset()
+
+
 def test_placer_never_overcommits_under_seeded_chaos(faults):
     """The acceptance invariant: a seeded random op sequence with
     admission/re-carve faults firing never over-commits or leaks a chip
