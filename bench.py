@@ -275,6 +275,58 @@ def bench_full_encoder(w: int = W, h: int = H) -> tuple[float, dict] | None:
     return ITERS / dt, means
 
 
+def bench_codec_encoder(codec: str, w: int = W, h: int = H) -> tuple[float, dict] | None:
+    """Per-codec row for the --codec sweep: the encoder the registry
+    would negotiate for `codec` (signalling/negotiate.py) driven over
+    the same desktop trace through the plain encode_frame interface.
+    None when the codec's backing library is absent in this image.
+
+    The JSON mirrors the h264 row where the stages exist: device_ms is
+    the row's encode stage (libaom/libvpx on CPU, or the device step),
+    pack_ms its convert+stitch time; au_bytes_per_frame is what the
+    client downlink ships.  Link-byte fields are device-path specific
+    and omitted for the library-backed rows."""
+    from selkies_tpu.signalling.negotiate import CODEC_ROWS, codec_available
+
+    if codec not in CODEC_ROWS or not codec_available(codec):
+        return None
+    from selkies_tpu.models.registry import create_encoder
+
+    enc = create_encoder(CODEC_ROWS[codec], width=w, height=h, fps=60)
+    frames = _desktop_trace(ITERS, w, h)
+    # warmup: keyframe, delta, static (compiles the front-end step /
+    # fills the tile-column payload cache)
+    enc.encode_frame(frames[0])
+    enc.encode_frame(frames[1])
+    enc.encode_frame(frames[1])
+    sums = {"device_ms": 0.0, "pack_ms": 0.0}
+    au_bytes = 0
+    static = idrs = 0
+    cols = 1
+    t0 = time.perf_counter()
+    for i in range(ITERS):
+        au = enc.encode_frame(frames[i % len(frames)])
+        au_bytes += len(au)
+        stats = enc.last_stats
+        if stats is not None:
+            sums["device_ms"] += getattr(stats, "device_ms", 0.0)
+            sums["pack_ms"] += getattr(stats, "pack_ms", 0.0)
+            idrs += bool(getattr(stats, "idr", False))
+            cols = max(cols, getattr(stats, "cols", 1))
+    dt = time.perf_counter() - t0
+    static = getattr(enc, "static_frames", 0)
+    means = {k: v / ITERS for k, v in sums.items()}
+    means["au_bytes_per_frame"] = au_bytes / ITERS
+    means["idr_frames"] = idrs
+    means["static_frames"] = static
+    if cols > 1:
+        means["cols"] = cols
+    means["codec"] = codec
+    if hasattr(enc, "close"):
+        enc.close()
+    return ITERS / dt, means
+
+
 def bench_convert_only() -> float:
     import jax
 
@@ -302,6 +354,14 @@ def main() -> int:
              "per resolution, each with the upload/step/fetch/pack split. "
              "Default: 1080p plus a 4K row on a real TPU backend (4K on "
              "the CPU backend takes minutes, so CI runs stay 1080p-only)")
+    ap.add_argument(
+        "--codec", default=None,
+        help="comma-separated codec sweep (h264,av1,vp9,...): one JSON "
+             "line per codec at each --resolution, from the encoder row "
+             "per-client negotiation would pick (signalling/negotiate.py). "
+             "h264 runs the full pipelined bench; library-backed rows run "
+             "the plain encode_frame loop. Codecs whose libraries are "
+             "absent are skipped with a note")
     args = ap.parse_args()
     _reexec_cpu_if_tunnel_down()
     if args.resolution is None:
@@ -309,8 +369,26 @@ def main() -> int:
 
         args.resolution = ("1080p,4k" if jax.default_backend() == "tpu"
                            else "1080p")
+    codecs = [c.strip().lower() for c in (args.codec or "h264").split(",")
+              if c.strip()]
     ran = False
     for label, w, h in _parse_resolutions(args.resolution):
+        for codec in codecs:
+            if codec == "h264":
+                continue  # the flagship row below
+            row = bench_codec_encoder(codec, w, h)
+            if row is None:
+                print(json.dumps({"metric": f"{codec} {label} skipped",
+                                  "note": "codec library unavailable"}),
+                      file=sys.stderr)
+                continue
+            ran = True
+            c_fps, c_means = row
+            c_means["resolution"] = label
+            _result(f"{codec} {label} IP-GOP encode fps", c_fps,
+                    unit=f"fps@{label}", **c_means)
+        if "h264" not in codecs:
+            continue
         out = bench_full_encoder(w, h)
         if out is None:
             break
@@ -325,6 +403,7 @@ def main() -> int:
         # each regression to the right sub-stage.
         means["device_stage_latency_ms"] = means.pop("device_ms")
         means["resolution"] = label
+        means["codec"] = "h264"
         _result(f"tpuh264enc {label} IP-GOP encode fps (1 chip)", fps,
                 unit=f"fps@{label}", **means)
     if not ran:

@@ -45,7 +45,12 @@ def _load():
     if _lib_tried:
         return _lib
     _lib_tried = True
-    for name in ("libdav1d.so.6", "libdav1d.so", "dav1d"):
+    # .so.4 (0.7.x) kept the same API surface and picture layout as 1.0
+    # (verified empirically: planes @16, strides @40, p.{w,h,layout,bpc}
+    # @56..68); _get_picture's layout/bpc sanity check guards a drifted
+    # build either way.
+    for name in ("libdav1d.so.6", "libdav1d.so.5", "libdav1d.so.4",
+                 "libdav1d.so", "dav1d"):
         try:
             lib = ctypes.CDLL(name)
             break

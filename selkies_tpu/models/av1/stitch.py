@@ -1,0 +1,723 @@
+"""AV1 tile-column bitstream stitching — splice N independently encoded
+column strips into ONE spec-conformant frame (tile group with N tile
+columns).
+
+This is the entropy-layer half of the codec-mesh subsystem
+(parallel/codec_mesh.py): the device front-end shards per tile column
+across the chip mesh, each dirty column re-encodes through its own
+libaom strip encoder, and this module rebuilds a single temporal unit
+the client decodes as one frame.  The construction is only valid under
+the constraints the strip encoders are pinned to (and this module
+verifies on every frame):
+
+* **intra-only** — intra prediction availability resets at tile
+  boundaries exactly like at frame edges, so a strip's tile payload
+  parses identically whether its left edge is a frame edge (strip
+  encode) or a tile edge (stitched frame).  Inter strips would motion-
+  compensate across the seam from edge-extension pixels that the
+  stitched reference does not contain.
+* **lossless** (base_q_idx=0, no deltas) — CodedLossless=1 removes the
+  frame-level loop filter / CDEF / LR passes whose parameters are
+  chosen per-encoder and applied ACROSS tile boundaries; with them gone
+  the stitched decode is exact and `decode == source`, which is what
+  makes the single-encoder oracle comparison in tests pixel-exact
+  rather than approximate.
+* **default CDFs** (primary_ref_frame=NONE: keyframes / intra-only
+  frames) — every tile's arithmetic coder starts from spec-default
+  contexts, so a payload encoded as "the only tile of a narrow frame"
+  is bit-compatible with "tile k of a wide frame".
+
+Frame sequencing mirrors the hybrid row's re-show ladder: the first
+stitched frame is a KEY_FRAME (refresh all slots, carries the sequence
+header), every later changed frame is a shown INTRA_ONLY_FRAME
+refreshing slot 0 (showable, unlike shown keyframes — spec 5.9.2), and
+unchanged frames ride the 5-byte show_existing_frame temporal unit
+re-showing slot 0.  Columns whose content did not change splice their
+cached tile payload back in without touching libaom at all (the
+tile-column analogue of the active-map path: per-column work is decided
+by the front-end's dirty map).
+
+The header machinery below parses the strip encoders' own output
+(sequence header + lossless-intra frame header, all plain f(n)/uvlc
+bits) and re-emits the stitched frame header with the tile_info this
+module owns.  Anything outside the constrained envelope raises
+ValueError and the caller falls back to the full-frame encoder — a
+malformed stitch must never reach the wire.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from selkies_tpu.models.av1.headers import (
+    KEY_FRAME,
+    INTRA_ONLY_FRAME,
+    OBU_FRAME,
+    OBU_FRAME_HEADER,
+    OBU_SEQUENCE_HEADER,
+    OBU_TEMPORAL_DELIMITER,
+    OBU_TILE_GROUP,
+    _Bits,
+    iter_obus,
+)
+
+__all__ = [
+    "SequenceInfo",
+    "IntraFrameInfo",
+    "parse_sequence_info",
+    "parse_intra_frame_header",
+    "extract_strip",
+    "tile_columns",
+    "write_stitched_frame",
+    "build_stitched_tu",
+    "StitchError",
+]
+
+
+class StitchError(ValueError):
+    """The bitstream left the constrained lossless-intra envelope."""
+
+
+# ---------------------------------------------------------------------------
+# bit writer
+
+
+class BitWriter:
+    def __init__(self):
+        self._bits: list[int] = []
+
+    @property
+    def pos(self) -> int:
+        return len(self._bits)
+
+    def f(self, value: int, n: int) -> None:
+        if n and not 0 <= value < (1 << n):
+            raise StitchError(f"value {value} does not fit in {n} bits")
+        for i in range(n - 1, -1, -1):
+            self._bits.append((value >> i) & 1)
+
+    def align(self) -> None:
+        while len(self._bits) % 8:
+            self._bits.append(0)
+
+    def trailing_bits(self) -> None:
+        self._bits.append(1)
+        self.align()
+
+    def bytes(self) -> bytes:
+        self.align()
+        out = bytearray(len(self._bits) // 8)
+        for i, b in enumerate(self._bits):
+            if b:
+                out[i >> 3] |= 0x80 >> (i & 7)
+        return bytes(out)
+
+
+def _leb128_encode(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def obu(otype: int, payload: bytes) -> bytes:
+    """Wrap a payload as an OBU with has_size=1 (low-overhead stream)."""
+    return bytes([(otype << 3) | 0x02]) + _leb128_encode(len(payload)) + payload
+
+
+def temporal_delimiter() -> bytes:
+    return obu(OBU_TEMPORAL_DELIMITER, b"")
+
+
+# ---------------------------------------------------------------------------
+# sequence header — full parse (the prefix parse in headers.py stops at
+# order_hint_bits; stitching additionally needs the superres/cdef/
+# restoration gates, the color config and the film-grain flag because
+# they decide which frame-header bits exist)
+
+
+@dataclass
+class SequenceInfo:
+    seq_profile: int
+    still_picture: bool
+    reduced_still_picture: bool
+    decoder_model_info_present: bool
+    equal_picture_interval: bool
+    frame_presentation_time_length: int
+    initial_display_delay_present: bool
+    frame_width_bits: int
+    frame_height_bits: int
+    max_frame_width: int
+    max_frame_height: int
+    frame_id_numbers_present: bool
+    frame_id_length: int
+    delta_frame_id_length: int
+    use_128x128_superblock: bool
+    enable_filter_intra: bool
+    enable_intra_edge_filter: bool
+    enable_order_hint: bool
+    order_hint_bits: int
+    force_screen_content_tools: int
+    force_integer_mv: int
+    enable_superres: bool
+    enable_cdef: bool
+    enable_restoration: bool
+    high_bitdepth: bool
+    monochrome: bool
+    separate_uv_delta_q: bool
+    film_grain_params_present: bool
+
+    @property
+    def sb_size(self) -> int:
+        return 128 if self.use_128x128_superblock else 64
+
+    def tile_compatible(self, other: "SequenceInfo") -> bool:
+        """Do tile payloads produced under `other` parse identically
+        under this sequence header?  Compares every sequence-level field
+        that gates tile-data syntax or frame-header bit presence."""
+        keys = (
+            "seq_profile", "reduced_still_picture",
+            "decoder_model_info_present", "frame_id_numbers_present",
+            "use_128x128_superblock", "enable_filter_intra",
+            "enable_intra_edge_filter", "enable_order_hint",
+            "order_hint_bits", "force_screen_content_tools",
+            "force_integer_mv", "enable_superres", "high_bitdepth",
+            "monochrome", "separate_uv_delta_q",
+            "film_grain_params_present",
+        )
+        return all(getattr(self, k) == getattr(other, k) for k in keys)
+
+
+def parse_sequence_info(payload: bytes) -> SequenceInfo:
+    b = _Bits(payload)
+    seq_profile = b.f(3)
+    still_picture = bool(b.f(1))
+    reduced = bool(b.f(1))
+    decoder_model_info_present = False
+    equal_picture_interval = False
+    fpt_len = 0
+    buffer_delay_length = 0
+    initial_display_delay_present = False
+    if reduced:
+        b.f(5)  # seq_level_idx[0]
+    else:
+        if b.f(1):  # timing_info_present
+            b.f(32)  # num_units_in_display_tick
+            b.f(32)  # time_scale
+            equal_picture_interval = bool(b.f(1))
+            if equal_picture_interval:
+                b.uvlc()
+            decoder_model_info_present = bool(b.f(1))
+            if decoder_model_info_present:
+                buffer_delay_length = b.f(5) + 1
+                b.f(32)
+                b.f(5)
+                fpt_len = b.f(5) + 1
+        initial_display_delay_present = bool(b.f(1))
+        op_cnt = b.f(5) + 1
+        for _ in range(op_cnt):
+            b.f(12)
+            seq_level_idx = b.f(5)
+            if seq_level_idx > 7:
+                b.f(1)
+            if decoder_model_info_present:
+                if b.f(1):
+                    b.f(buffer_delay_length)
+                    b.f(buffer_delay_length)
+                    b.f(1)
+            if initial_display_delay_present:
+                if b.f(1):
+                    b.f(4)
+    frame_width_bits = b.f(4) + 1
+    frame_height_bits = b.f(4) + 1
+    max_w = b.f(frame_width_bits) + 1
+    max_h = b.f(frame_height_bits) + 1
+    frame_id_numbers_present = False
+    delta_len = 0
+    id_len = 0
+    if not reduced:
+        frame_id_numbers_present = bool(b.f(1))
+    if frame_id_numbers_present:
+        delta_len = b.f(4) + 2
+        id_len = delta_len + b.f(3) + 1
+    use_128 = bool(b.f(1))
+    enable_filter_intra = bool(b.f(1))
+    enable_intra_edge = bool(b.f(1))
+    enable_order_hint = False
+    order_hint_bits = 0
+    force_sct = 2
+    force_imv = 2
+    if not reduced:
+        b.f(1)  # enable_interintra_compound
+        b.f(1)  # enable_masked_compound
+        b.f(1)  # enable_warped_motion
+        b.f(1)  # enable_dual_filter
+        enable_order_hint = bool(b.f(1))
+        if enable_order_hint:
+            b.f(1)  # enable_jnt_comp
+            b.f(1)  # enable_ref_frame_mvs
+        force_sct = 2 if b.f(1) else b.f(1)
+        if force_sct > 0:
+            force_imv = 2 if b.f(1) else b.f(1)
+        else:
+            force_imv = 2
+        if enable_order_hint:
+            order_hint_bits = b.f(3) + 1
+    enable_superres = bool(b.f(1))
+    enable_cdef = bool(b.f(1))
+    enable_restoration = bool(b.f(1))
+    # color_config()
+    high_bitdepth = bool(b.f(1))
+    if seq_profile == 2 and high_bitdepth:
+        b.f(1)  # twelve_bit
+    monochrome = False
+    if seq_profile != 1:
+        monochrome = bool(b.f(1))
+    if b.f(1):  # color_description_present
+        color_primaries = b.f(8)
+        transfer_characteristics = b.f(8)
+        matrix_coefficients = b.f(8)
+    else:
+        color_primaries = transfer_characteristics = matrix_coefficients = 2
+    separate_uv_delta_q = False
+    if monochrome:
+        b.f(1)  # color_range
+    elif (color_primaries == 1 and transfer_characteristics == 13
+          and matrix_coefficients == 0):
+        separate_uv_delta_q = bool(b.f(1))
+    else:
+        b.f(1)  # color_range
+        if seq_profile == 0:
+            pass  # 4:2:0
+        elif seq_profile == 1:
+            pass  # 4:4:4
+        else:
+            if high_bitdepth:  # profile 2, 12-bit: subsampling coded
+                if b.f(1):  # subsampling_x
+                    b.f(1)
+        # chroma_sample_position for 4:2:0 streams
+        if seq_profile != 1:
+            b.f(2)
+        separate_uv_delta_q = bool(b.f(1))
+    film_grain = bool(b.f(1))
+    return SequenceInfo(
+        seq_profile=seq_profile,
+        still_picture=still_picture,
+        reduced_still_picture=reduced,
+        decoder_model_info_present=decoder_model_info_present,
+        equal_picture_interval=equal_picture_interval,
+        frame_presentation_time_length=fpt_len,
+        initial_display_delay_present=initial_display_delay_present,
+        frame_width_bits=frame_width_bits,
+        frame_height_bits=frame_height_bits,
+        max_frame_width=max_w,
+        max_frame_height=max_h,
+        frame_id_numbers_present=frame_id_numbers_present,
+        frame_id_length=id_len,
+        delta_frame_id_length=delta_len,
+        use_128x128_superblock=use_128,
+        enable_filter_intra=enable_filter_intra,
+        enable_intra_edge_filter=enable_intra_edge,
+        enable_order_hint=enable_order_hint,
+        order_hint_bits=order_hint_bits,
+        force_screen_content_tools=force_sct,
+        force_integer_mv=force_imv,
+        enable_superres=enable_superres,
+        enable_cdef=enable_cdef,
+        enable_restoration=enable_restoration,
+        high_bitdepth=high_bitdepth,
+        monochrome=monochrome,
+        separate_uv_delta_q=separate_uv_delta_q,
+        film_grain_params_present=film_grain,
+    )
+
+
+# ---------------------------------------------------------------------------
+# lossless-intra frame header: parse + write
+
+
+@dataclass
+class IntraFrameInfo:
+    frame_type: int
+    show_frame: bool
+    error_resilient: bool
+    disable_cdf_update: bool
+    allow_screen_content_tools: bool
+    order_hint: int
+    refresh_frame_flags: int
+    frame_width: int
+    frame_height: int
+    render_and_frame_size_different: bool
+    render_width: int
+    render_height: int
+    allow_intrabc: bool
+    disable_frame_end_update_cdf: bool
+    reduced_tx_set: bool
+    header_bits: int = 0  # parse position after the last header bit
+    # fields that must match across every strip for the splice to parse
+    SPLICE_KEYS = (
+        "disable_cdf_update", "allow_screen_content_tools",
+        "allow_intrabc", "disable_frame_end_update_cdf", "reduced_tx_set",
+    )
+
+    def splice_compatible(self, other: "IntraFrameInfo") -> bool:
+        return all(getattr(self, k) == getattr(other, k)
+                   for k in self.SPLICE_KEYS)
+
+
+def parse_intra_frame_header(payload: bytes, seq: SequenceInfo) -> IntraFrameInfo:
+    """Parse a shown lossless intra (KEY / INTRA_ONLY) frame header and
+    return its fields plus total header bit length.  Raises StitchError
+    whenever the header leaves the envelope write_stitched_frame() can
+    re-emit (inter frame, superres, q>0, segmentation, qmatrix...)."""
+    if seq.reduced_still_picture:
+        raise StitchError("reduced still picture streams cannot be stitched")
+    b = _Bits(payload)
+    if b.f(1):
+        raise StitchError("show_existing_frame header is not a coded frame")
+    frame_type = b.f(2)
+    if frame_type not in (KEY_FRAME, INTRA_ONLY_FRAME):
+        raise StitchError(f"frame_type {frame_type} is not intra")
+    show_frame = bool(b.f(1))
+    if show_frame and seq.decoder_model_info_present and not seq.equal_picture_interval:
+        b.f(seq.frame_presentation_time_length)
+    if not show_frame:
+        b.f(1)  # showable_frame
+    if frame_type == KEY_FRAME and show_frame:
+        error_resilient = True
+    else:
+        error_resilient = bool(b.f(1))
+    disable_cdf_update = bool(b.f(1))
+    if seq.force_screen_content_tools == 2:
+        allow_sct = bool(b.f(1))
+    else:
+        allow_sct = bool(seq.force_screen_content_tools)
+    if allow_sct and seq.force_integer_mv == 2:
+        b.f(1)  # force_integer_mv (intra frames infer 1 regardless)
+    if seq.frame_id_numbers_present:
+        b.f(seq.frame_id_length)
+    frame_size_override = bool(b.f(1))
+    order_hint = b.f(seq.order_hint_bits)
+    # intra frame: primary_ref_frame is inferred NONE, no bits
+    if seq.decoder_model_info_present:
+        if b.f(1):  # buffer_removal_time_present_flag
+            raise StitchError("buffer_removal_time not supported")
+    if frame_type == KEY_FRAME and show_frame:
+        refresh = 0xFF
+    else:
+        refresh = b.f(8)
+        if refresh == 0xFF:
+            raise StitchError("intra-only frame refreshing all slots")
+        if error_resilient and seq.enable_order_hint:
+            for _ in range(8):
+                b.f(seq.order_hint_bits)
+    # FrameIsIntra: frame_size(), render_size(), allow_intrabc
+    if frame_size_override:
+        frame_width = b.f(seq.frame_width_bits) + 1
+        frame_height = b.f(seq.frame_height_bits) + 1
+    else:
+        frame_width = seq.max_frame_width
+        frame_height = seq.max_frame_height
+    if seq.enable_superres:
+        if b.f(1):  # use_superres
+            raise StitchError("superres frames cannot be stitched")
+    render_differs = bool(b.f(1))
+    render_w, render_h = frame_width, frame_height
+    if render_differs:
+        render_w = b.f(16) + 1
+        render_h = b.f(16) + 1
+    allow_intrabc = False
+    if allow_sct:
+        allow_intrabc = bool(b.f(1))
+    if disable_cdf_update:
+        disable_frame_end_update_cdf = True
+    else:
+        disable_frame_end_update_cdf = bool(b.f(1))
+    # tile_info() — the strip's own tiling must be a single tile
+    _parse_tile_info_single(b, seq, frame_width, frame_height)
+    # quantization_params() — must be lossless
+    base_q_idx = b.f(8)
+    if base_q_idx != 0:
+        raise StitchError(f"base_q_idx {base_q_idx} != 0 (not lossless)")
+    if _read_delta_q(b) != 0:
+        raise StitchError("DeltaQYDc != 0")
+    if not seq.monochrome:
+        if seq.separate_uv_delta_q:
+            diff_uv = bool(b.f(1))
+        else:
+            diff_uv = False
+        if _read_delta_q(b) != 0 or _read_delta_q(b) != 0:
+            raise StitchError("chroma delta q != 0")
+        if diff_uv:
+            if _read_delta_q(b) != 0 or _read_delta_q(b) != 0:
+                raise StitchError("V delta q != 0")
+    if b.f(1):  # using_qmatrix
+        raise StitchError("qmatrix streams cannot be stitched")
+    if b.f(1):  # segmentation_enabled
+        raise StitchError("segmentation streams cannot be stitched")
+    # base_q_idx == 0 -> no delta_q_params / delta_lf_params bits;
+    # CodedLossless -> no loop filter / cdef / lr / tx_mode bits;
+    # intra -> no reference mode / skip mode / warped motion bits
+    reduced_tx_set = bool(b.f(1))
+    # intra -> no global motion params; film grain gated by seq flag
+    if seq.film_grain_params_present and (show_frame or frame_type != KEY_FRAME):
+        if b.f(1):  # apply_grain
+            raise StitchError("film grain streams cannot be stitched")
+    return IntraFrameInfo(
+        frame_type=frame_type,
+        show_frame=show_frame,
+        error_resilient=error_resilient,
+        disable_cdf_update=disable_cdf_update,
+        allow_screen_content_tools=allow_sct,
+        order_hint=order_hint,
+        refresh_frame_flags=refresh,
+        frame_width=frame_width,
+        frame_height=frame_height,
+        render_and_frame_size_different=render_differs,
+        render_width=render_w,
+        render_height=render_h,
+        allow_intrabc=allow_intrabc,
+        disable_frame_end_update_cdf=disable_frame_end_update_cdf,
+        reduced_tx_set=reduced_tx_set,
+        header_bits=b.pos,
+    )
+
+
+def _read_delta_q(b: _Bits) -> int:
+    if b.f(1):  # delta_coded
+        v = b.f(7)  # su(7): sign bit is the high bit
+        return v - 128 if v >= 64 else v
+    return 0
+
+
+def _tile_log2(blk: int, target: int) -> int:
+    k = 0
+    while (blk << k) < target:
+        k += 1
+    return k
+
+
+def _sb_cols_rows(seq: SequenceInfo, width: int, height: int) -> tuple[int, int]:
+    mi_cols = 2 * ((width + 7) >> 3)
+    mi_rows = 2 * ((height + 7) >> 3)
+    if seq.use_128x128_superblock:
+        return (mi_cols + 31) >> 5, (mi_rows + 31) >> 5
+    return (mi_cols + 15) >> 4, (mi_rows + 15) >> 4
+
+
+def _min_log2_tile_cols(seq: SequenceInfo, width: int, height: int) -> tuple[int, int, int]:
+    """(minLog2TileCols, maxLog2TileCols, maxLog2TileRows) per 5.9.15."""
+    sb_cols, sb_rows = _sb_cols_rows(seq, width, height)
+    sb_shift = 5 if seq.use_128x128_superblock else 4
+    sb_size = sb_shift + 2
+    max_tile_width_sb = 4096 >> sb_size
+    max_tile_area_sb = (4096 * 2304) >> (2 * sb_size)
+    max_log2_cols = _tile_log2(1, min(sb_cols, 64))
+    max_log2_rows = _tile_log2(1, min(sb_rows, 64))
+    min_log2_cols = _tile_log2(max_tile_width_sb, sb_cols)
+    min_log2_tiles = max(min_log2_cols,
+                         _tile_log2(max_tile_area_sb, sb_rows * sb_cols))
+    return min_log2_cols, max_log2_cols, max_log2_rows, min_log2_tiles
+
+
+def _parse_tile_info_single(b: _Bits, seq: SequenceInfo,
+                            width: int, height: int) -> None:
+    """Parse the strip's tile_info and require exactly one tile."""
+    min_cols, max_cols, max_rows, min_tiles = _min_log2_tile_cols(seq, width, height)
+    if min_cols > 0:
+        raise StitchError("strip wider than one max-width tile")
+    uniform = bool(b.f(1))
+    if not uniform:
+        raise StitchError("strip used explicit tile spacing")
+    cols_log2 = min_cols
+    while cols_log2 < max_cols:
+        if b.f(1):
+            cols_log2 += 1
+        else:
+            break
+    min_rows = max(min_tiles - cols_log2, 0)
+    rows_log2 = min_rows
+    while rows_log2 < max_rows:
+        if b.f(1):
+            rows_log2 += 1
+        else:
+            break
+    if cols_log2 or rows_log2:
+        raise StitchError(
+            f"strip is not single-tile (cols_log2={cols_log2}, rows_log2={rows_log2})")
+
+
+def tile_columns(width: int, cols_log2: int, sb: int = 64) -> list[tuple[int, int]]:
+    """The uniform-spacing column carve for `cols_log2` (spec 5.9.15):
+    [(x0, w), ...] in pixels.  The actual column count can be smaller
+    than 2**cols_log2 for narrow frames — callers size the mesh off
+    len() of this."""
+    mi_cols = 2 * ((width + 7) >> 3)
+    sb_cols = (mi_cols + (sb >> 2) - 1) // (sb >> 2)
+    tile_width_sb = (sb_cols + (1 << cols_log2) - 1) >> cols_log2
+    out = []
+    start = 0
+    while start < sb_cols:
+        x0 = start * sb
+        end = min(start + tile_width_sb, sb_cols)
+        x1 = min(end * sb, width)
+        out.append((x0, x1 - x0))
+        start = end
+    return out
+
+
+def write_stitched_frame(seq: SequenceInfo, template: IntraFrameInfo,
+                         frame_type: int, refresh_frame_flags: int,
+                         width: int, height: int, cols_log2: int,
+                         tile_payloads: list[bytes],
+                         tile_size_bytes: int = 4) -> bytes:
+    """Emit one OBU_FRAME: a shown lossless intra frame of (width,
+    height) with the uniform tile-column carve, splicing the given
+    per-column tile payloads.  `template` supplies the strip encoders'
+    shared per-frame choices (cdf update, sct, reduced_tx_set...)."""
+    ncols = len(tile_columns(width, cols_log2))
+    if len(tile_payloads) != ncols:
+        raise StitchError(
+            f"{len(tile_payloads)} payloads for {ncols} tile columns")
+    w = BitWriter()
+    w.f(0, 1)  # show_existing_frame
+    w.f(frame_type, 2)
+    w.f(1, 1)  # show_frame
+    if seq.decoder_model_info_present and not seq.equal_picture_interval:
+        w.f(0, seq.frame_presentation_time_length)
+    # shown frames: showable inferred; KEY+show: error_resilient inferred
+    if frame_type != KEY_FRAME:
+        w.f(0, 1)  # error_resilient_mode (0: no ref_order_hint list)
+    w.f(int(template.disable_cdf_update), 1)
+    if seq.force_screen_content_tools == 2:
+        w.f(int(template.allow_screen_content_tools), 1)
+    if template.allow_screen_content_tools and seq.force_integer_mv == 2:
+        w.f(1, 1)  # force_integer_mv (intra frames use 1)
+    if seq.frame_id_numbers_present:
+        raise StitchError("frame_id_numbers streams cannot be stitched")
+    size_override = not (width == seq.max_frame_width
+                         and height == seq.max_frame_height)
+    w.f(int(size_override), 1)
+    w.f(0, seq.order_hint_bits)  # order_hint
+    if seq.decoder_model_info_present:
+        w.f(0, 1)  # buffer_removal_time_present_flag
+    if frame_type != KEY_FRAME:
+        if refresh_frame_flags == 0xFF:
+            raise StitchError("INTRA_ONLY frames must not refresh all slots")
+        w.f(refresh_frame_flags, 8)
+        # error_resilient written 0 above -> no ref_order_hint list
+    if size_override:
+        w.f(width - 1, seq.frame_width_bits)
+        w.f(height - 1, seq.frame_height_bits)
+    if seq.enable_superres:
+        w.f(0, 1)  # use_superres
+    w.f(0, 1)  # render_and_frame_size_different
+    if template.allow_screen_content_tools:
+        w.f(int(template.allow_intrabc), 1)
+    if not template.disable_cdf_update:
+        w.f(int(template.disable_frame_end_update_cdf), 1)
+    _write_tile_info(w, seq, width, height, cols_log2, tile_size_bytes)
+    # quantization_params: lossless
+    w.f(0, 8)  # base_q_idx
+    w.f(0, 1)  # DeltaQYDc delta_coded
+    if not seq.monochrome:
+        if seq.separate_uv_delta_q:
+            w.f(0, 1)  # diff_uv_delta
+        w.f(0, 1)  # DeltaQUDc
+        w.f(0, 1)  # DeltaQUAc
+    w.f(0, 1)  # using_qmatrix
+    w.f(0, 1)  # segmentation_enabled
+    # base_q_idx==0 -> no delta_q/delta_lf; CodedLossless -> no lf/cdef/
+    # lr/tx_mode; intra -> no ref mode/skip mode/warped/global motion
+    w.f(int(template.reduced_tx_set), 1)
+    if seq.film_grain_params_present:
+        w.f(0, 1)  # apply_grain
+    # frame_header done; OBU_FRAME: byte-align then tile group
+    w.align()
+    ntiles = len(tile_payloads)
+    body = bytearray(w.bytes())
+    if ntiles > 1:
+        body.append(0x00)  # tile_start_and_end_present_flag=0 + alignment
+    for i, payload in enumerate(tile_payloads):
+        if i < ntiles - 1:
+            body += (len(payload) - 1).to_bytes(tile_size_bytes, "little")
+        body += payload
+    return obu(OBU_FRAME, bytes(body))
+
+
+def _write_tile_info(w: BitWriter, seq: SequenceInfo, width: int,
+                     height: int, cols_log2: int, tile_size_bytes: int) -> None:
+    min_cols, max_cols, max_rows, min_tiles = _min_log2_tile_cols(seq, width, height)
+    if not min_cols <= cols_log2 <= max_cols:
+        raise StitchError(
+            f"cols_log2 {cols_log2} outside [{min_cols}, {max_cols}]")
+    w.f(1, 1)  # uniform_tile_spacing_flag
+    for _ in range(cols_log2 - min_cols):
+        w.f(1, 1)  # increment_tile_cols_log2
+    if cols_log2 < max_cols:
+        w.f(0, 1)
+    min_rows = max(min_tiles - cols_log2, 0)
+    if min_rows > 0:
+        raise StitchError("frame area requires tile rows; columns only")
+    rows_log2 = 0
+    if rows_log2 < max_rows:
+        w.f(0, 1)
+    if cols_log2 > 0:
+        w.f(0, cols_log2 + rows_log2)  # context_update_tile_id
+        w.f(tile_size_bytes - 1, 2)
+
+
+# ---------------------------------------------------------------------------
+# strip extraction
+
+
+@dataclass
+class Strip:
+    """One column encoder's parsed output."""
+    seq_payload: bytes | None
+    seq: SequenceInfo | None
+    frame: IntraFrameInfo
+    tile_payload: bytes
+
+
+def extract_strip(tu: bytes, seq: SequenceInfo | None = None,
+                  seq_payload: bytes | None = None) -> Strip:
+    """Split a strip encoder's temporal unit into its sequence header
+    (if present), parsed frame header, and raw single-tile payload."""
+    frame_info = None
+    tile_payload = None
+    for otype, payload in iter_obus(tu):
+        if otype == OBU_SEQUENCE_HEADER:
+            seq_payload = payload
+            seq = parse_sequence_info(payload)
+        elif otype == OBU_FRAME:
+            if seq is None:
+                raise StitchError("frame before sequence header")
+            frame_info = parse_intra_frame_header(payload, seq)
+            tile_payload = payload[(frame_info.header_bits + 7) // 8:]
+        elif otype in (OBU_FRAME_HEADER, OBU_TILE_GROUP):
+            raise StitchError("split header/tile-group strips not supported")
+    if frame_info is None or not tile_payload:
+        raise StitchError("no frame OBU in strip temporal unit")
+    return Strip(seq_payload=seq_payload, seq=seq, frame=frame_info,
+                 tile_payload=tile_payload)
+
+
+def build_stitched_tu(seq_payload: bytes | None, seq: SequenceInfo,
+                      template: IntraFrameInfo, frame_type: int,
+                      refresh_frame_flags: int, width: int, height: int,
+                      cols_log2: int, tile_payloads: list[bytes]) -> bytes:
+    """One temporal unit: TD [+ sequence header on keyframes] + stitched
+    frame OBU."""
+    out = temporal_delimiter()
+    if seq_payload is not None:
+        out += obu(OBU_SEQUENCE_HEADER, seq_payload)
+    out += write_stitched_frame(seq, template, frame_type,
+                                refresh_frame_flags, width, height,
+                                cols_log2, tile_payloads)
+    return out

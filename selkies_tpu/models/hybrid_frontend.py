@@ -158,6 +158,13 @@ class HybridFrontendMixin:
     exists yet — the row's show-existing / active-map policy consumes it
     identically either way."""
 
+    def _make_device_frontend(self, width: int, height: int):
+        """Hook: which device front-end serves this row.  The codec-mesh
+        rows (parallel/codec_mesh.py) override this to shard the step
+        one tile column per chip; everything else in the mixin —
+        host-path fallback, classification contract — is shared."""
+        return DeviceDeltaFrontend(width, height)
+
     def _init_frontend(self, width: int, height: int,
                        mode: str | None = None) -> None:
         from selkies_tpu.models import frameprep
@@ -168,7 +175,7 @@ class HybridFrontendMixin:
         self.last_hints: np.ndarray | None = None
         self.frontend_device_ms = 0.0
         if self.frontend_mode == "device":
-            self._device_fe = DeviceDeltaFrontend(width, height)
+            self._device_fe = self._make_device_frontend(width, height)
             self._prep = None
         else:
             pad_w = (width + 15) // 16 * 16

@@ -107,6 +107,111 @@ class _AomActiveMap(ctypes.Structure):
 _lib = None
 _lib_tried = False
 
+# --- legacy libaom 1.0.x support (strip encoders only) ---------------------
+# Some deployment images carry libaom.so.0 (AV1 1.0.0) instead of the 3.x
+# the realtime row above is probed for.  1.0 has no string-option API and
+# no realtime usage, but the cfg struct fields this module pokes sit at
+# THE SAME word offsets (verified against config_default ground truth
+# below), the encoder ABI is 12, and the control enum was recovered by an
+# error-detail fingerprint scan (each range-checked control names its
+# field, the same technique libvpx_enc._row_mt_available uses):
+#   13 "cpu_used out of range [0..8]"      32 "lossless expected boolean"
+#   33 "tile_columns out of range [..6]"   34 "tile_rows out of range [..6]"
+#   54 "superblock_size out of range [...]"
+# The fingerprints are re-verified at load time, so a shifted enum in some
+# other v1.x build disables the legacy path instead of corrupting state.
+# Only AomStripEncoder (lossless tile-column strips, parallel/codec_mesh)
+# uses this path; the realtime CBR row still requires 3.x.
+_LEGACY_ABI = 12
+_LEGACY_IMG_STRIDE_OFF = 96  # aom 1.0 aom_image_t: planes @64, stride @96
+_LEGACY_CTRL = {
+    "cpu_used": 13,
+    "lossless": 32,
+    "tile_columns": 33,
+    "tile_rows": 34,
+    "superblock_size": 54,
+}
+_LEGACY_FINGERPRINT = {
+    13: b"cpu_used",
+    32: b"lossless",
+    33: b"tile_columns",
+    34: b"tile_rows",
+    54: b"superblock_size",
+}
+
+_legacy = None
+_legacy_tried = False
+
+
+def _load_legacy():
+    """Load and validate the aom 1.0 ABI for strip encoding."""
+    global _legacy, _legacy_tried
+    if _legacy_tried:
+        return _legacy
+    _legacy_tried = True
+    for name in ("libaom.so.0", "libaom.so.1", "libaom.so.2"):
+        try:
+            lib = ctypes.CDLL(name)
+            break
+        except OSError:
+            continue
+    else:
+        return None
+    if getattr(lib, "aom_codec_set_option", None):
+        # a modern library under an old soname: not the 1.0 ABI
+        return None
+    lib.aom_codec_av1_cx.restype = ctypes.c_void_p
+    lib.aom_img_alloc.restype = ctypes.c_void_p
+    lib.aom_codec_get_cx_data.restype = ctypes.c_void_p
+    lib.aom_codec_error_detail.restype = ctypes.c_char_p
+    lib.aom_codec_encode.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_ulong, ctypes.c_long,
+    ]
+    iface = lib.aom_codec_av1_cx()
+    cfg = (ctypes.c_uint8 * _CFG_BYTES)()
+    if lib.aom_codec_enc_config_default(ctypes.c_void_p(iface), cfg, 0):
+        return None
+    w = ctypes.cast(cfg, ctypes.POINTER(ctypes.c_uint32))
+    # good-quality (usage 0) ground truth for the shared word offsets
+    ok = (
+        w[_OFF_G_W] == 320 and w[_OFF_G_H] == 240
+        and w[_OFF_TB_NUM] == 1 and w[_OFF_TB_DEN] == 30
+        and w[_OFF_TARGET_BITRATE] == 256
+        and w[_OFF_MAX_Q] == 63
+        and w[_OFF_KF_MODE] == 1 and w[_OFF_KF_MAX_DIST] == 9999
+    )
+    if not ok:
+        logger.info("legacy libaom cfg layout mismatch; strip path disabled")
+        return None
+    ctx = (ctypes.c_uint8 * _CTX_BYTES)()
+    if lib.aom_codec_enc_init_ver(ctx, ctypes.c_void_p(iface), cfg, 0, _LEGACY_ABI):
+        logger.info("legacy libaom ABI %d rejected; strip path disabled", _LEGACY_ABI)
+        return None
+    try:
+        for cid, name in _LEGACY_FINGERPRINT.items():
+            rc = lib.aom_codec_control_(ctx, cid, ctypes.c_int(999999))
+            det = lib.aom_codec_error_detail(ctx) or b""
+            if rc == 0 or name not in det:
+                logger.info("legacy libaom control %d fingerprint mismatch "
+                            "(%r); strip path disabled", cid, det)
+                return None
+    finally:
+        lib.aom_codec_destroy(ctx)
+    img = lib.aom_img_alloc(None, _AOM_IMG_FMT_I420, 320, 240, 16)
+    if not img:
+        return None
+    raw = ctypes.string_at(img, _LEGACY_IMG_STRIDE_OFF + 12)
+    planes = _struct.unpack_from("<3Q", raw, _IMG_PLANES_OFF)
+    strides = _struct.unpack_from("<3i", raw, _LEGACY_IMG_STRIDE_OFF)
+    lib.aom_img_free(ctypes.c_void_p(img))
+    if not (all(planes) and strides[0] >= 320 and strides[1] >= 160
+            and strides[1] == strides[2]):
+        logger.info("legacy libaom image layout mismatch; strip path disabled")
+        return None
+    _legacy = lib
+    return _legacy
+
 
 def _load_and_verify():
     """Load libaom and verify every struct offset this wrapper pokes."""
@@ -195,6 +300,12 @@ def _load_and_verify():
 
 def libaom_available() -> bool:
     return _load_and_verify() is not None
+
+
+def aom_strip_available() -> bool:
+    """Can AomStripEncoder run?  True on either the modern (3.x) or the
+    validated legacy (1.0) ABI."""
+    return _load_and_verify() is not None or _load_legacy() is not None
 
 
 class LibAomEncoder:
@@ -381,3 +492,132 @@ class LibAomEncoder:
         )
         self.frame_index += 1
         return out
+
+
+class AomStripEncoder:
+    """One tile column's encoder for the AV1 tile-column mesh
+    (parallel/codec_mesh.py): lossless, all-intra, single-tile, 64px
+    superblocks, one thread.  Every knob here is a CORRECTNESS pin, not
+    a tuning choice — models/av1/stitch.py splices this encoder's tile
+    payloads into a wider frame, which is only bit-compatible when the
+    payload is position-independent (intra + default CDFs), the carve is
+    64px-superblock aligned, and no cross-tile filter pass exists
+    (CodedLossless).  See the stitch module docstring for the proof
+    obligations; tests decode the splice with independent libdav1d.
+
+    Runs against modern libaom (string-option API) or the validated
+    legacy 1.0 ABI (_load_legacy) — both via good-quality usage 0, the
+    only usage the legacy library has.  Parallelism comes from the mesh
+    running one instance per column, so g_threads stays 1 and encodes
+    are deterministic per instance.
+    """
+
+    codec = "av1"
+
+    def __init__(self, width: int, height: int, cpu_used: int = 6):
+        lib = _load_and_verify()
+        self._legacy = False
+        if lib is None:
+            lib = _load_legacy()
+            self._legacy = True
+        if lib is None:
+            raise RuntimeError("libaom unavailable")
+        if width % 2 or height % 2:
+            raise ValueError("4:2:0 requires even dimensions")
+        self._lib = lib
+        self.width, self.height = width, height
+        iface = lib.aom_codec_av1_cx()
+        self._cfg = (ctypes.c_uint8 * _CFG_BYTES)()
+        err = lib.aom_codec_enc_config_default(ctypes.c_void_p(iface), self._cfg, 0)
+        if err:
+            raise RuntimeError(f"aom_codec_enc_config_default: {err}")
+        w = ctypes.cast(self._cfg, ctypes.POINTER(ctypes.c_uint32))
+        w[_OFF_G_W], w[_OFF_G_H] = width, height
+        w[_OFF_G_THREADS] = 1
+        w[_OFF_TB_NUM], w[_OFF_TB_DEN] = 1, 30
+        w[_OFF_LAG_IN_FRAMES] = 0
+        self._ctx = (ctypes.c_uint8 * _CTX_BYTES)()
+        abi = _LEGACY_ABI if self._legacy else _ENCODER_ABI_VERSION
+        err = lib.aom_codec_enc_init_ver(
+            self._ctx, ctypes.c_void_p(iface), self._cfg, 0, abi)
+        if err:
+            raise RuntimeError(f"aom_codec_enc_init_ver: {err}")
+        cpu_used = max(0, min(8, cpu_used))
+        if self._legacy:
+            pins = (("cpu_used", cpu_used), ("lossless", 1),
+                    ("tile_columns", 0), ("tile_rows", 0),
+                    ("superblock_size", 0))  # AOM_SUPERBLOCK_SIZE_64X64
+            for name, val in pins:
+                rc = lib.aom_codec_control_(
+                    self._ctx, _LEGACY_CTRL[name], ctypes.c_int(val))
+                if rc:
+                    lib.aom_codec_destroy(self._ctx)
+                    self._ctx = None
+                    raise RuntimeError(f"aom control {name}={val} rejected ({rc})")
+        else:
+            if lib.aom_codec_control(self._ctx, _AOME_SET_CPUUSED,
+                                     ctypes.c_int(cpu_used)):
+                logger.warning("AOME_SET_CPUUSED rejected")
+            for opt, val in (("lossless", "1"), ("tile-columns", "0"),
+                             ("tile-rows", "0"), ("sb-size", "64")):
+                rc = lib.aom_codec_set_option(self._ctx, opt.encode(), val.encode())
+                if rc:
+                    lib.aom_codec_destroy(self._ctx)
+                    self._ctx = None
+                    raise RuntimeError(f"aom option {opt}={val} rejected ({rc})")
+        self._img = lib.aom_img_alloc(None, _AOM_IMG_FMT_I420, width, height, 16)
+        if not self._img:
+            raise RuntimeError("aom_img_alloc failed")
+        stride_off = _LEGACY_IMG_STRIDE_OFF if self._legacy else _IMG_STRIDE_OFF
+        raw = ctypes.string_at(self._img, stride_off + 12)
+        self._planes = _struct.unpack_from("<3Q", raw, _IMG_PLANES_OFF)
+        self._strides = _struct.unpack_from("<3i", raw, stride_off)
+        self.frame_index = 0
+
+    def close(self) -> None:
+        if getattr(self, "_img", None):
+            self._lib.aom_img_free(ctypes.c_void_p(self._img))
+            self._img = None
+        if getattr(self, "_ctx", None) is not None:
+            self._lib.aom_codec_destroy(self._ctx)
+            self._ctx = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: silent-except-audited — best-effort teardown
+            pass
+
+    def encode_planes(self, y: np.ndarray, u: np.ndarray, v: np.ndarray) -> bytes:
+        """Encode pre-converted I420 planes as a forced keyframe; returns
+        the temporal unit (sequence header included on the first call)."""
+        for plane, arr, stride, rows in (
+            (self._planes[0], y, self._strides[0], self.height),
+            (self._planes[1], u, self._strides[1], self.height // 2),
+            (self._planes[2], v, self._strides[2], self.height // 2),
+        ):
+            buf = np.ctypeslib.as_array(
+                ctypes.cast(plane, ctypes.POINTER(ctypes.c_uint8)), (rows, stride))
+            buf[:, : arr.shape[1]] = arr
+        err = self._lib.aom_codec_encode(
+            self._ctx, ctypes.c_void_p(self._img), self.frame_index, 1,
+            _AOM_EFLAG_FORCE_KF)
+        if err:
+            raise RuntimeError(f"aom_codec_encode: {err}")
+        out = b""
+        it = ctypes.c_void_p(None)
+        while True:
+            pkt = self._lib.aom_codec_get_cx_data(self._ctx, ctypes.byref(it))
+            if not pkt:
+                break
+            raw = ctypes.string_at(pkt, _PKT_READ)
+            if _struct.unpack_from("<i", raw, _PKT_KIND_OFF)[0] == 0:
+                buf, sz = _struct.unpack_from("<QQ", raw, _PKT_BUF_OFF)
+                out += ctypes.string_at(buf, sz)
+        self.frame_index += 1
+        return out
+
+    def encode_frame(self, frame: np.ndarray) -> bytes:
+        """BGRx convenience entry (tests / oracle paths)."""
+        y, u, v = _bgrx_to_i420_np(np.asarray(frame))
+        return self.encode_planes(y, u, v)

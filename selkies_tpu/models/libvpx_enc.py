@@ -21,6 +21,7 @@ import ctypes
 import ctypes.util
 import logging
 import os
+import re
 import time
 
 import numpy as np
@@ -71,7 +72,14 @@ _VP9E_SET_FRAME_PARALLEL_DECODING = 35
 # "row_mt out of range [0..1]" — an exact-name fingerprint no other
 # control produces.
 _VP9E_SET_ROW_MT = 55
-_ENCODER_ABI_VERSION = 5
+# vpx_codec_enc_init_ver checks the ABI version before touching the
+# context, so probing candidates is side-effect free: 5 is the Debian
+# 1.12 build this wrapper was written against, 23 the 1.9 build some
+# deployment images carry (both verified empirically; a build accepting
+# neither disables the rows).  Decoder ABI likewise (12 on 1.9).
+_ENCODER_ABI_CANDIDATES = (5, 23)
+_DECODER_ABI_CANDIDATES = (3, 12)
+_ENCODER_ABI_VERSION = 5  # resolved per-library by _encoder_abi()
 _CFG_BYTES = 4096
 _CTX_BYTES = 512
 
@@ -133,7 +141,7 @@ def _load():
     if _lib_tried:
         return _lib
     _lib_tried = True
-    for name in ("libvpx.so.7", "libvpx.so", "vpx"):
+    for name in ("libvpx.so.7", "libvpx.so.6", "libvpx.so", "vpx"):
         try:
             lib = ctypes.CDLL(name)
             break
@@ -150,8 +158,45 @@ def _load():
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
         ctypes.c_ulong, ctypes.c_int64, ctypes.c_ulong,
     ]
+    # cfg struct ground-truth check (mirrors libaom_enc._load_and_verify;
+    # previously the offsets were trusted blind, which turned the broader
+    # soname list above into a memory-corruption hazard on a drifted build)
+    cfg = (ctypes.c_uint8 * _CFG_BYTES)()
+    iface = lib.vpx_codec_vp9_cx()
+    if lib.vpx_codec_enc_config_default(ctypes.c_void_p(iface), cfg, 0):
+        logger.warning("vpx_codec_enc_config_default failed; rows disabled")
+        return None
+    w = ctypes.cast(cfg, ctypes.POINTER(ctypes.c_uint32))
+    if not (w[_OFF_G_W] == 320 and w[_OFF_G_H] == 240
+            and w[_OFF_TB_NUM] == 1 and w[_OFF_TB_DEN] == 30
+            and w[_OFF_TARGET_BITRATE] == 256 and w[_OFF_MAX_Q] == 63):
+        logger.warning("libvpx cfg layout mismatch; vp9enc/vp8enc disabled")
+        return None
     _lib = lib
     return _lib
+
+
+_enc_abi: int | None = None
+
+
+def _encoder_abi(lib) -> int:
+    """Resolve the encoder ABI version for this build (cached)."""
+    global _enc_abi
+    if _enc_abi is not None:
+        return _enc_abi
+    cfg = (ctypes.c_uint8 * _CFG_BYTES)()
+    iface = lib.vpx_codec_vp9_cx()
+    if lib.vpx_codec_enc_config_default(ctypes.c_void_p(iface), cfg, 0):
+        raise RuntimeError("vpx_codec_enc_config_default failed")
+    for abi in _ENCODER_ABI_CANDIDATES:
+        ctx = (ctypes.c_uint8 * _CTX_BYTES)()
+        if lib.vpx_codec_enc_init_ver(ctx, ctypes.c_void_p(iface), cfg, 0, abi) == 0:
+            lib.vpx_codec_destroy(ctx)
+            _enc_abi = abi
+            return abi
+    raise RuntimeError(
+        f"libvpx accepted none of the known encoder ABI versions "
+        f"{_ENCODER_ABI_CANDIDATES}")
 
 
 _row_mt_state: bool | None = None
@@ -187,7 +232,7 @@ def _row_mt_available() -> bool:
         "iface = lib.vpx_codec_vp9_cx()\n"
         "assert not lib.vpx_codec_enc_config_default(ctypes.c_void_p(iface), cfg, 0)\n"
         "ctx = (ctypes.c_uint8 * m._CTX_BYTES)()\n"
-        "assert not lib.vpx_codec_enc_init_ver(ctx, ctypes.c_void_p(iface), cfg, 0, m._ENCODER_ABI_VERSION)\n"
+        "assert not lib.vpx_codec_enc_init_ver(ctx, ctypes.c_void_p(iface), cfg, 0, m._encoder_abi(lib))\n"
         "ok = lib.vpx_codec_control_(ctx, m._VP9E_SET_ROW_MT, ctypes.c_int(1))\n"
         "bad = lib.vpx_codec_control_(ctx, m._VP9E_SET_ROW_MT, ctypes.c_int(7))\n"
         "lib.vpx_codec_error_detail.restype = ctypes.c_char_p\n"
@@ -211,6 +256,20 @@ def _row_mt_available() -> bool:
 
 def libvpx_available() -> bool:
     return _load() is not None
+
+
+def libvpx_version() -> tuple[int, int, int]:
+    """(major, minor, patch) of the loaded libvpx, (0, 0, 0) if absent.
+    Behavioural contracts differ across generations (1.9 re-filters
+    active-map-skipped regions where 1.12 leaves them bit-stable), so
+    version-sensitive tests gate on this instead of guessing."""
+    lib = _load()
+    if lib is None:
+        return (0, 0, 0)
+    lib.vpx_codec_version_str.restype = ctypes.c_char_p
+    raw = (lib.vpx_codec_version_str() or b"").decode(errors="replace")
+    m = re.match(r"v?(\d+)\.(\d+)\.(\d+)", raw)
+    return tuple(int(g) for g in m.groups()) if m else (0, 0, 0)
 
 
 def _bgrx_to_i420_np(frame: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -248,7 +307,8 @@ class LibVpxEncoder:
 
     codec = "vp9"
 
-    def __init__(self, width: int, height: int, fps: int = 60, bitrate_kbps: int = 2000, vp8: bool = False):
+    def __init__(self, width: int, height: int, fps: int = 60, bitrate_kbps: int = 2000, vp8: bool = False,
+                 tile_columns_log2: int | None = None, threads: int | None = None):
         lib = _load()
         if lib is None:
             raise RuntimeError("libvpx unavailable")
@@ -268,8 +328,12 @@ class LibVpxEncoder:
         w[_OFF_G_W], w[_OFF_G_H] = width, height
         w[_OFF_TB_NUM], w[_OFF_TB_DEN] = 1, fps
         # reference vp9enc row threads up to 16 (gstwebrtc_app.py:703);
-        # row-mt + tile columns below make them engage at 1080p
-        w[_OFF_G_THREADS] = min(16, max(1, (os.cpu_count() or 4) - 1))
+        # row-mt + tile columns below make them engage at 1080p. The
+        # codec-mesh row overrides both so the tile carve matches the
+        # front-end's column shards (parallel/codec_mesh.py).
+        if threads is None:
+            threads = min(16, max(1, (os.cpu_count() or 4) - 1))
+        w[_OFF_G_THREADS] = max(1, threads)
         w[_OFF_LAG_IN_FRAMES] = 0           # zero latency
         w[_OFF_END_USAGE] = _VPX_CBR
         w[_OFF_TARGET_BITRATE] = bitrate_kbps
@@ -287,7 +351,7 @@ class LibVpxEncoder:
         w[_OFF_ERROR_RESILIENT] = 1
         self._ctx = (ctypes.c_uint8 * _CTX_BYTES)()
         err = lib.vpx_codec_enc_init_ver(
-            self._ctx, ctypes.c_void_p(self._iface), self._cfg, 0, _ENCODER_ABI_VERSION
+            self._ctx, ctypes.c_void_p(self._iface), self._cfg, 0, _encoder_abi(lib)
         )
         if err:
             raise RuntimeError(f"vpx_codec_enc_init_ver: {err}")
@@ -301,7 +365,10 @@ class LibVpxEncoder:
             # make the g_threads above actually engage at 1080p. The
             # row-mt control id is validated once in a crash-isolated
             # subprocess (headers absent from this image).
-            if lib.vpx_codec_control_(self._ctx, _VP9E_SET_TILE_COLUMNS, ctypes.c_int(2)):
+            if tile_columns_log2 is None:
+                tile_columns_log2 = 2
+            if lib.vpx_codec_control_(self._ctx, _VP9E_SET_TILE_COLUMNS,
+                                      ctypes.c_int(tile_columns_log2)):
                 logger.warning("VP9E_SET_TILE_COLUMNS rejected")
             if lib.vpx_codec_control_(self._ctx, _VP9E_SET_FRAME_PARALLEL_DECODING, ctypes.c_int(1)):
                 logger.warning("VP9E_SET_FRAME_PARALLEL_DECODING rejected")
@@ -427,4 +494,69 @@ class LibVpxEncoder:
             pack_ms=(t1 - t0) * 1e3,    # colorspace conversion
         )
         self.frame_index += 1
+        return out
+
+
+class LibVpxDecoder:
+    """VP9/VP8 conformance decoding via libvpx's own decoder interface —
+    the oracle the tile-column VP9 tests use (this image's FFmpeg build
+    has no guaranteed software VP9 decoder).  Feed one compressed frame,
+    get (Y, U, V) uint8 planes back; show_existing_frame headers return
+    the re-shown picture."""
+
+    def __init__(self, vp8: bool = False):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("libvpx unavailable")
+        self._lib = lib
+        lib.vpx_codec_vp9_dx.restype = ctypes.c_void_p
+        lib.vpx_codec_vp8_dx.restype = ctypes.c_void_p
+        lib.vpx_codec_get_frame.restype = ctypes.POINTER(_VpxImage)
+        iface = lib.vpx_codec_vp8_dx() if vp8 else lib.vpx_codec_vp9_dx()
+        self._ctx = (ctypes.c_uint8 * _CTX_BYTES)()
+        for abi in _DECODER_ABI_CANDIDATES:
+            if lib.vpx_codec_dec_init_ver(
+                    self._ctx, ctypes.c_void_p(iface), None, 0, abi) == 0:
+                break
+        else:
+            raise RuntimeError(
+                f"libvpx accepted none of the known decoder ABI versions "
+                f"{_DECODER_ABI_CANDIDATES}")
+
+    def close(self) -> None:
+        if getattr(self, "_ctx", None) is not None:
+            self._lib.vpx_codec_destroy(self._ctx)
+            self._ctx = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: silent-except-audited — best-effort teardown
+            pass
+
+    def decode(self, frame: bytes) -> list[tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        buf = (ctypes.c_uint8 * len(frame)).from_buffer_copy(frame)
+        rc = self._lib.vpx_codec_decode(self._ctx, buf, len(frame), None, 0)
+        if rc:
+            raise RuntimeError(f"vpx_codec_decode: {rc}")
+        out = []
+        it = ctypes.c_void_p(None)
+        while True:
+            img = self._lib.vpx_codec_get_frame(self._ctx, ctypes.byref(it))
+            if not img:
+                break
+            im = img.contents
+            if im.fmt != _VPX_IMG_FMT_I420:
+                raise RuntimeError(f"unexpected decode fmt 0x{im.fmt:x}")
+            w, h = im.d_w, im.d_h
+
+            def plane(idx, rows, cols):
+                a = np.ctypeslib.as_array(
+                    ctypes.cast(im.planes[idx], ctypes.POINTER(ctypes.c_uint8)),
+                    (rows, im.stride[idx]))
+                return a[:, :cols].copy()
+
+            out.append((plane(0, h, w),
+                        plane(1, (h + 1) // 2, (w + 1) // 2),
+                        plane(2, (h + 1) // 2, (w + 1) // 2)))
         return out

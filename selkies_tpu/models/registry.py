@@ -21,11 +21,32 @@ logger = logging.getLogger("models.registry")
 
 _FACTORIES: dict[str, Callable] = {}
 _ALIASES: dict[str, str] = {}
+_CODECS: dict[str, str] = {}
+
+# codec -> RTP payloader (module, class) — resolved lazily so importing
+# the registry never drags the transport stack in.  Every codec a row
+# declares MUST map here: tools/check_codec_rows.py ratchets the
+# invariant, because a registry row whose codec has no payloader can be
+# negotiated but never streamed.
+_PAYLOADERS: dict[str, tuple[str, str]] = {
+    "h264": ("selkies_tpu.transport.rtp", "H264Payloader"),
+    "h265": ("selkies_tpu.transport.rtp_h265", "H265Payloader"),
+    "av1": ("selkies_tpu.transport.rtp_av1", "Av1Payloader"),
+    "vp8": ("selkies_tpu.transport.rtp_vpx", "Vp8Payloader"),
+    "vp9": ("selkies_tpu.transport.rtp_vpx", "Vp9Payloader"),
+}
 
 
-def register(name: str) -> Callable[[Callable], Callable]:
+def register(name: str, codec: str = "") -> Callable[[Callable], Callable]:
+    """Register an encoder factory.  ``codec`` declares the bitstream the
+    row emits ("h264"/"av1"/...) — per-client negotiation
+    (signalling/negotiate.py) and the payloader wiring key off it, and
+    tools/check_codec_rows.py fails the build when a row forgets it."""
+
     def deco(factory: Callable) -> Callable:
         _FACTORIES[name] = factory
+        if codec:
+            _CODECS[name] = codec
         return factory
 
     return deco
@@ -41,6 +62,23 @@ def encoder_exists(name: str) -> bool:
 
 def supported_encoders() -> list[str]:
     return sorted(_FACTORIES) + sorted(_ALIASES)
+
+
+def codec_for_encoder(name: str) -> str:
+    """The codec a registry row (or alias) declares; "" if unknown."""
+    name = _ALIASES.get(name, name)
+    return _CODECS.get(name, "")
+
+
+def payloader_for_codec(codec: str):
+    """The RTP payloader class for a codec (lazy import)."""
+    import importlib
+
+    try:
+        mod_name, cls_name = _PAYLOADERS[codec.lower()]
+    except KeyError:
+        raise ValueError(f"no payloader for codec {codec!r}") from None
+    return getattr(importlib.import_module(mod_name), cls_name)
 
 
 def create_encoder(name: str, *, width: int, height: int, fps: int = 60, **kw):
@@ -97,7 +135,7 @@ def default_pipeline_depth() -> int:
     return 2
 
 
-@register("tpuh264enc")
+@register("tpuh264enc", codec="h264")
 def _tpuh264enc(*, width: int, height: int, fps: int = 60, qp: int = 28, **kw):
     from selkies_tpu.models.h264.encoder import TPUH264Encoder
 
@@ -154,32 +192,43 @@ def _tpuh264enc(*, width: int, height: int, fps: int = 60, qp: int = 28, **kw):
     return TPUH264Encoder(width=width, height=height, qp=qp, fps=fps, **kw)
 
 
-@register("tpuvp9enc")
+@register("tpuvp9enc", codec="vp9")
 def _tpuvp9enc(*, width: int, height: int, fps: int = 60, bitrate_kbps: int = 2000, **kw):
     """VP9 row with the framework's capture-delta front-end: unchanged
     frames short-circuit to 1-byte show_existing_frame headers, changed
     frames go through libvpx (see models/vp9/encoder.py for why VP9's
-    entropy back-end cannot be rebuilt from scratch in this image)."""
+    entropy back-end cannot be rebuilt from scratch in this image).
+    ``cols``/SELKIES_TILE_COLS > 1 routes to the tile-column mesh mode:
+    column-sharded device front-end + libvpx tile columns pinned to the
+    carve (parallel/codec_mesh.py)."""
+    from selkies_tpu.parallel.codec_mesh import TileColumnVP9Encoder, cols_from_env
+
+    cols = kw.pop("cols", None)
+    cols = cols_from_env() if cols is None else max(1, int(cols))
+    if cols > 1:
+        return TileColumnVP9Encoder(
+            width=width, height=height, fps=fps, bitrate_kbps=bitrate_kbps,
+            cols=cols, frontend=kw.get("frontend"))
     from selkies_tpu.models.vp9.encoder import TPUVP9Encoder
 
     return TPUVP9Encoder(width=width, height=height, fps=fps, bitrate_kbps=bitrate_kbps)
 
 
-@register("vp9enc")
+@register("vp9enc", codec="vp9")
 def _vp9enc(*, width: int, height: int, fps: int = 60, bitrate_kbps: int = 2000, **kw):
     from selkies_tpu.models.libvpx_enc import LibVpxEncoder
 
     return LibVpxEncoder(width=width, height=height, fps=fps, bitrate_kbps=bitrate_kbps)
 
 
-@register("vp8enc")
+@register("vp8enc", codec="vp8")
 def _vp8enc(*, width: int, height: int, fps: int = 60, bitrate_kbps: int = 2000, **kw):
     from selkies_tpu.models.libvpx_enc import LibVpxEncoder
 
     return LibVpxEncoder(width=width, height=height, fps=fps, bitrate_kbps=bitrate_kbps, vp8=True)
 
 
-@register("x264enc")
+@register("x264enc", codec="h264")
 def _x264enc(*, width: int, height: int, fps: int = 60, bitrate_kbps: int = 2000, **kw):
     """The REAL x264 software row (ctypes libx264, reference tuning —
     gstwebrtc_app.py:609-639); degrades to the TPU encoder when the
@@ -192,7 +241,7 @@ def _x264enc(*, width: int, height: int, fps: int = 60, bitrate_kbps: int = 2000
     return X264Encoder(width=width, height=height, fps=fps, bitrate_kbps=bitrate_kbps)
 
 
-@register("tpuav1enc")
+@register("tpuav1enc", codec="av1")
 def _tpuav1enc(*, width: int, height: int, fps: int = 60, bitrate_kbps: int = 2000, **kw):
     """AV1 row with the framework's capture-delta front-end: unchanged
     frames encode with an all-inactive active map (every block skips from
@@ -201,12 +250,35 @@ def _tpuav1enc(*, width: int, height: int, fps: int = 60, bitrate_kbps: int = 20
     H.264 encoder only if the libaom ABI probe fails — the reference's
     own policy when an encoder is missing is to fail the pipeline
     (gstwebrtc_app.py:1123-1140); we degrade instead and log."""
-    from selkies_tpu.models.libaom_enc import libaom_available
+    from selkies_tpu.models.libaom_enc import (
+        aom_strip_available, libaom_available)
+    from selkies_tpu.parallel.codec_mesh import TileColumnAV1Encoder, cols_from_env
 
+    cols = kw.pop("cols", None)
+    cols = cols_from_env() if cols is None else max(1, int(cols))
+    if cols > 1 and aom_strip_available():
+        # SELKIES_TILE_COLS / negotiated carve: the tile-column mesh mode
+        # (parallel/codec_mesh.py — per-column strip encoders spliced
+        # into one frame). Pinned lossless; the realtime CBR hybrid row
+        # below stays the single-column path.
+        return TileColumnAV1Encoder(
+            width=width, height=height, fps=fps, cols=cols,
+            frontend=kw.get("frontend"),
+            keyframe_interval=kw.get("keyframe_interval", 0))
     if not libaom_available():
+        if aom_strip_available():
+            # legacy-ABI libaom (1.0): no realtime usage for the hybrid
+            # CBR row, but the lossless tile-column splice works — serve
+            # AV1 through the mesh row at cols=1 rather than silently
+            # negotiating H.264
+            return TileColumnAV1Encoder(
+                width=width, height=height, fps=fps, cols=1,
+                frontend=kw.get("frontend"),
+                keyframe_interval=kw.get("keyframe_interval", 0))
         logger.warning("libaom unavailable; tpuav1enc falls back to tpuh264enc "
                        "— the session will negotiate H.264")
         kw.pop("cpu_used", None)
+        kw.pop("frontend", None)
         return _FACTORIES["tpuh264enc"](width=width, height=height, fps=fps, **kw)
     from selkies_tpu.models.av1.encoder import TPUAV1Encoder
 
@@ -214,7 +286,7 @@ def _tpuav1enc(*, width: int, height: int, fps: int = 60, bitrate_kbps: int = 20
                          bitrate_kbps=bitrate_kbps, **kw)
 
 
-@register("av1enc")
+@register("av1enc", codec="av1")
 def _av1enc(*, width: int, height: int, fps: int = 60, bitrate_kbps: int = 2000, **kw):
     """The REAL libaom software row (ctypes, reference tuning —
     gstwebrtc_app.py:741-783); degrades to tpuav1enc's fallback chain
@@ -229,7 +301,7 @@ def _av1enc(*, width: int, height: int, fps: int = 60, bitrate_kbps: int = 2000,
                          bitrate_kbps=bitrate_kbps, **kw)
 
 
-@register("x265enc")
+@register("x265enc", codec="h265")
 def _x265enc(*, width: int, height: int, fps: int = 60, bitrate_kbps: int = 2000, **kw):
     """The REAL x265 HEVC software row (ctypes libx265, reference tuning —
     gstwebrtc_app.py:667-683); degrades to the TPU encoder when the
@@ -263,7 +335,7 @@ for _legacy_av1 in ("nvav1enc", "vaav1enc", "rav1enc"):
     alias(_legacy_av1, "tpuav1enc")
 
 
-@register("svtav1enc")
+@register("svtav1enc", codec="av1")
 def _svtav1enc(*, width: int, height: int, fps: int = 60,
                bitrate_kbps: int = 2000, **kw):
     """REAL SVT-AV1 row when libSvtAv1Enc passes ABI validation

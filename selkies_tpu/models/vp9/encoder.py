@@ -67,9 +67,15 @@ class TPUVP9Encoder(HybridFrontendMixin, LibVpxEncoder):
     codec = "vp9"
 
     def __init__(self, width: int, height: int, fps: int = 60,
-                 bitrate_kbps: int = 2000, frontend: str | None = None):
+                 bitrate_kbps: int = 2000, frontend: str | None = None,
+                 tile_columns_log2: int | None = None,
+                 threads: int | None = None):
+        # tile_columns_log2/threads: the codec-mesh row pins libvpx's
+        # tile split to the front-end's column carve (parallel/codec_mesh)
         super().__init__(width=width, height=height, fps=fps,
-                         bitrate_kbps=bitrate_kbps, vp8=False)
+                         bitrate_kbps=bitrate_kbps, vp8=False,
+                         tile_columns_log2=tile_columns_log2,
+                         threads=threads)
         self._init_frontend(width, height, frontend)
         self._have_ref = False
         self._map_active = False  # whether a restrictive map is installed

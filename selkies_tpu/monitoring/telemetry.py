@@ -119,6 +119,10 @@ METRIC_FAMILIES: dict[str, str] = {
         "(free/assigned/borrowed)",
     "selkies_drain_state":
         "Process drain state (0=serving, 1=draining, 2=drained)",
+    "selkies_codec_sessions":
+        "Sessions currently negotiated per codec (h264/av1/vp9/...), "
+        "labeled by codec — per-client negotiation is "
+        "signalling/negotiate.py",
 }
 
 # canonical label names per family (order fixed for the Prometheus
@@ -143,6 +147,7 @@ _FAMILY_LABELS: dict[str, tuple[str, ...]] = {
     "selkies_lifecycle_events_total": ("event",),
     "selkies_placement_chips": ("state",),
     "selkies_drain_state": (),
+    "selkies_codec_sessions": ("codec",),
 }
 
 _HIST_BUCKETS: dict[str, tuple[float, ...]] = {

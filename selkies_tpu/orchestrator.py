@@ -530,6 +530,71 @@ class Orchestrator:
     # ------------------------------------------------------------------
     # session lifecycle
 
+    def _negotiate_codec(self, meta) -> None:
+        """Resolve the client's codec preference list (HELLO meta)
+        against the registry rows before the offer is built. No
+        preferences anywhere (client or SELKIES_CODEC) keeps the
+        configured encoder row untouched."""
+        from selkies_tpu.signalling import negotiate
+
+        prefs = meta.get("codecs") if isinstance(meta, dict) else None
+        if not prefs and not negotiate.server_preferences():
+            # no preference from THIS client: a previous client's
+            # negotiated row must not leak onto it — restore the
+            # configured encoder if negotiation moved away from it
+            # (software_fallback swaps are the ladder's, not ours)
+            if (self.app.encoder_name != self.cfg.encoder
+                    and not self.app.software_fallback):
+                enc = self.app.encoder
+                if self.app._swap_encoder(self.cfg.encoder,
+                                          enc.width, enc.height):
+                    self.app.encoder_name = self.cfg.encoder
+            self.webrtc.set_codec(getattr(self.app.encoder, "codec", "h264"))
+            # every session start reports its live codec, preference
+            # list or not — the gauge means "currently negotiated"
+            self._emit_codec_gauge(getattr(self.app.encoder, "codec", "h264"))
+            return
+        try:
+            import jax
+
+            chips = len(jax.devices())
+        except Exception:
+            chips = 1
+        current = getattr(self.app.encoder, "codec", "h264")
+        n = negotiate.resolve(prefs, session_chips=chips,
+                              per_session_carve=True, fallback=current)
+        if n.codec != current:
+            enc = self.app.encoder
+            # the mesh rows take the negotiated tile-column budget; other
+            # rows must NOT see a cols kwarg (the h264 factory would read
+            # it as a tile-grid carve). A later ladder rebuild re-derives
+            # cols from SELKIES_TILE_COLS — the negotiated budget applies
+            # to this session's swap only.
+            kw = ({"cols": n.cols} if n.codec in ("av1", "vp9") else {})
+            if self.app._swap_encoder(n.encoder, enc.width, enc.height, **kw):
+                # resizes / supervisor rebuilds re-create the ACTIVE row
+                # (app._active_encoder_name) — the negotiated codec must
+                # survive them, not revert to the configured one
+                self.app.encoder_name = n.encoder
+            else:
+                logger.warning("negotiated %s encoder swap failed; staying "
+                               "on %s", n.codec, current)
+        codec = getattr(self.app.encoder, "codec", "h264")
+        self.webrtc.set_codec(codec)
+        logger.info("client negotiated codec %s (%s)", codec, n.reason)
+        self._emit_codec_gauge(codec)
+
+    def _emit_codec_gauge(self, codec: str | None) -> None:
+        """selkies_codec_sessions for the solo (single-session) host:
+        1 for the live session's codec, 0 everywhere else — None (no
+        client) zeroes every series so a departed session's codec
+        doesn't read as live forever."""
+        if not telemetry.enabled:
+            return
+        for c in ("h264", "av1", "vp9", "vp8", "h265"):
+            telemetry.gauge("selkies_codec_sessions",
+                            1 if c == codec else 0, codec=c)
+
     def _on_client_connected(self) -> None:
         if self._session_active:
             # second byte plane joined the same session (e.g. WS fallback
@@ -575,6 +640,7 @@ class Orchestrator:
             return
         logger.info("client disconnected; stopping pipelines")
         self._session_active = False
+        self._emit_codec_gauge(None)  # no live session, no live codec
         loop = asyncio.get_running_loop()
         loop.create_task(self._stop_session())
         # drop any half-negotiated peer and re-arm for the next browser
@@ -645,9 +711,17 @@ class Orchestrator:
             else:
                 logger.warning("signalling client error: %s", exc)
 
+        async def on_session(peer, meta) -> None:
+            # per-client codec negotiation (signalling/negotiate.py): the
+            # browser's HELLO meta carries its codec preference list;
+            # resolve it before the offer so SDP, payloader and encoder
+            # row agree end-to-end
+            self._negotiate_codec(meta)
+            await self.webrtc.start_session()
+
         client.on_connect = call_retrying
         client.on_error = on_error
-        client.on_session = lambda peer, meta: self.webrtc.start_session()
+        client.on_session = on_session
         client.on_sdp = lambda t, s: self.app.set_sdp(t, s)
         client.on_ice = lambda m, c: self.app.set_ice(m, c)
 

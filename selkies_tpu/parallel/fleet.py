@@ -232,10 +232,13 @@ class SessionFleet:
                         "tile-grid" if cols_ > 1 else "band-parallel", self.n)
             # rebuilds (supervisor RESTART rung) read the placer's LIVE
             # carve, so a restarted service keeps any borrowed chips
+            # codecs come from the placer too: a supervisor service
+            # rebuild mid-AV1-session must come back as AV1
             self._make_tpu_service = lambda: BandedFleetService(
                 self.n, width, height, qp=qp, fps=self.base_fps,
                 bands=rows_, cols=cols_, devices=devices,
-                rows=[self.placer.row(k) for k in range(self.n)])
+                rows=[self.placer.row(k) for k in range(self.n)],
+                codecs=[self.placer.codec(k) for k in range(self.n)])
         else:
             self._make_tpu_service = lambda: MultiSessionH264Service(
                 self.n, width, height, qp=qp, fps=self.base_fps, devices=devices)
@@ -281,6 +284,12 @@ class SessionFleet:
         unwatched frames on the freed chips. Encoders sharing a chip
         for the one deferred tick in between is benign (the shared
         fallback carve runs that way permanently, parallel/bands.py)."""
+        codecs = getattr(self.service, "codecs", None)
+        if codecs is not None:
+            # the negotiated codec left with the client (placer.release
+            # clears its record too); the next admit rebuilds as h264
+            # until the new client's negotiation says otherwise
+            codecs[k] = "h264"
         self.placer.release(k)
         self._recarve_safely(k)
 
@@ -334,6 +343,11 @@ class SessionFleet:
             k = self._pending_recarves.pop(0)
             try:
                 self.service.recarve(k, self.placer.row(k))
+                # a deferred encoder build that degraded the codec (e.g.
+                # an av1 mesh that failed to construct) must heal the
+                # placer's record too, or a supervisor rebuild re-seeds
+                # the failed codec forever
+                self.placer.set_codec(k, self.session_codec(k))
             except Exception:
                 logger.exception("deferred re-carve of session %d failed", k)
                 # mirror the synchronous borrow path's rollback: if k is
@@ -402,6 +416,38 @@ class SessionFleet:
         return cks
 
     # -- per-session controls (wired to slot transports/input) ---------
+
+    def session_codec(self, k: int) -> str:
+        """Session k's live codec (h264 unless negotiation changed it)."""
+        codecs = getattr(self.service, "codecs", None)
+        return codecs[k] if codecs else "h264"
+
+    def negotiate_session(self, k: int, preferences):
+        """Resolve a client's codec preference list (HELLO meta) against
+        the registry rows and this session's chip carve, rebuilding the
+        session's encoder when the codec changes (deferred past an
+        in-flight tick exactly like a lifecycle re-carve). Returns the
+        NegotiatedCodec that actually holds — a failed rebuild degrades
+        back to h264 inside the service and is reported as such."""
+        from selkies_tpu.signalling import negotiate
+
+        per_session = hasattr(self.service, "recarve")
+        row = self.placer.row(k)
+        n = negotiate.resolve(preferences,
+                              session_chips=max(1, len(row)),
+                              per_session_carve=per_session)
+        if per_session and self.service.set_codec(k, n.codec):
+            self._recarve_safely(k)
+        codec = self.session_codec(k)
+        self.placer.set_codec(k, codec)
+        if codec != n.codec:
+            n = negotiate.NegotiatedCodec(
+                codec=codec,
+                encoder=negotiate.CODEC_ROWS.get(codec, "tpuh264enc"),
+                cols=1, reason="rebuild-degraded")
+        logger.info("session %d negotiated codec %s (%s, %d chips)",
+                    k, n.codec, n.reason, len(row))
+        return n
 
     def force_keyframe(self, session: int) -> None:
         self.service.force_keyframe(session)
@@ -987,7 +1033,7 @@ class FleetOrchestrator:
                 if slot.gcc is not None:
                     slot.gcc.reset()
                 self.fleet.force_keyframe(k)
-                slot.send_codec("h264")
+                slot.send_codec(self.fleet.session_codec(k))
                 if first and slot.audio is not None:
                     asyncio.get_running_loop().create_task(
                         self._apply_audio_state(slot))
@@ -1151,9 +1197,20 @@ class FleetOrchestrator:
             else:
                 logger.warning("session %d signalling error: %s", k, exc)
 
+        async def on_session(peer, meta, k=k, slot=slot):
+            # per-client codec negotiation: the browser's HELLO meta
+            # carries its preference list; the fleet resolves it against
+            # the registry and this session's chip carve BEFORE the
+            # offer is built, so the SDP (and thereby the payloader)
+            # matches the encoder that will actually stream
+            prefs = meta.get("codecs") if isinstance(meta, dict) else None
+            n = self.fleet.negotiate_session(k, prefs)
+            slot.webrtc.set_codec(n.codec)
+            await slot.webrtc.start_session()
+
         client.on_connect = client.setup_call
         client.on_error = on_error
-        client.on_session = lambda peer, meta: slot.webrtc.start_session()
+        client.on_session = on_session
         client.on_sdp = slot.webrtc.set_remote_sdp
         client.on_ice = slot.webrtc.add_remote_ice
 

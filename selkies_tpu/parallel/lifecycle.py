@@ -158,6 +158,12 @@ class SessionPlacer:
         self._debts: dict[int, list[tuple[int, list]]] = {}
         self._busy: set[int] = set()
         self._queue: list[int] = []
+        # per-session negotiated codec (signalling/negotiate.py): the
+        # DURABLE placement-side record — a supervisor service rebuild
+        # reconstructs each session's encoder from this, so a restart
+        # mid-AV1-session comes back as AV1, not the h264 default
+        self._codecs: dict[int, str] = {}
+        self._codec_series: set[str] = {"h264"}  # gauge series ever emitted
         self.shared = False  # degenerate small-slice carve (no capacity math)
         self.draining = False
         self.counters: dict[str, int] = {
@@ -322,6 +328,10 @@ class SessionPlacer:
             row = self._rows.pop(session, None)
             if row and not self.shared:
                 self._free.extend(row)
+            # the codec record belongs to the DEPARTING client — a
+            # re-admitted slot must not be rebuilt with it before the
+            # next client's negotiation runs
+            self._codecs.pop(session, None)
             self._busy.discard(session)
             if session in self._queue:
                 self._queue.remove(session)
@@ -432,6 +442,26 @@ class SessionPlacer:
         with self._lock:
             return list(self._rows.get(session, ()))
 
+    def set_codec(self, session: int, codec: str) -> None:
+        """Record session's negotiated codec (the placement-side truth;
+        serving rebuilds read it back through ``codec``)."""
+        with self._lock:
+            self._codecs[int(session)] = str(codec).lower() or "h264"
+        self._export_gauges()
+
+    def codec(self, session: int) -> str:
+        with self._lock:
+            return self._codecs.get(int(session), "h264")
+
+    def codec_counts(self) -> dict[str, int]:
+        """sessions-per-codec rollup (selkies_codec_sessions)."""
+        with self._lock:
+            out: dict[str, int] = {}
+            for k in self._rows:
+                c = self._codecs.get(k, "h264")
+                out[c] = out.get(c, 0) + 1
+            return out
+
     def borrowed_chips(self) -> int:
         with self._lock:
             return self._borrowed()
@@ -463,6 +493,8 @@ class SessionPlacer:
                 "queue": list(self._queue),
                 "carve": {str(k): [str(getattr(d, "id", d)) for d in row]
                           for k, row in sorted(self._rows.items())},
+                "codecs": {str(k): self._codecs.get(k, "h264")
+                           for k in sorted(self._rows)},
                 **self.counters,
             }
 
@@ -499,6 +531,15 @@ class SessionPlacer:
         telemetry.gauge("selkies_placement_chips", free, state="free")
         telemetry.gauge("selkies_placement_chips", assigned, state="assigned")
         telemetry.gauge("selkies_placement_chips", borrowed, state="borrowed")
+        # emit zeros for every codec that ever had a session too —
+        # Prometheus gauges keep their last value, so dropping the series
+        # when the last av1 session releases would freeze it at 1
+        counts = self.codec_counts()
+        with self._lock:
+            self._codec_series.update(counts)
+        for codec in self._codec_series:
+            telemetry.gauge("selkies_codec_sessions", counts.get(codec, 0),
+                            codec=codec)
 
 
 # ---------------------------------------------------------------------------

@@ -192,7 +192,8 @@ class BandedFleetService:
     def __init__(self, n_sessions: int, width: int, height: int, *,
                  qp: int = 28, fps: int = 60, bands: int | None = None,
                  cols: int | None = None,
-                 devices=None, rows: list[list] | None = None):
+                 devices=None, rows: list[list] | None = None,
+                 codecs: list[str] | None = None):
         from selkies_tpu.parallel.bands import (
             BandedH264Encoder, bands_from_env, grid_from_env,
             partition_devices)
@@ -200,6 +201,13 @@ class BandedFleetService:
 
         enable_persistent_compilation_cache()
         self.n = n_sessions
+        # per-session negotiated codec (signalling/negotiate.py): h264
+        # rides the band/tile H.264 mesh, av1/vp9 ride the tile-column
+        # codec mesh (parallel/codec_mesh.py) on the same chip row. The
+        # placer's codec record seeds this on service rebuilds so a
+        # supervisor restart keeps every session's negotiated codec.
+        self.codecs = [c.lower() if c else "h264"
+                       for c in (codecs or ["h264"] * n_sessions)]
         if bands is None and cols is None:
             grid = grid_from_env()
             if grid is not None:
@@ -234,13 +242,12 @@ class BandedFleetService:
         # reads the placer's live rows, and the borrower must come back
         # on its enlarged mesh, not the constructor default
         self.encoders = [
-            BandedH264Encoder(width, height, qp=qp, fps=fps,
-                              bands=self._row_bands(rows[k]),
-                              cols=self.cols,
-                              devices=rows[k]) if rows[k] else None
+            self._build_encoder(k, rows[k]) if rows[k] else None
             for k in range(n_sessions)
         ]
-        live = next((e for e in self.encoders if e is not None), None)
+        live = next((e for e in self.encoders
+                     if e is not None and getattr(e, "codec", "") == "h264"),
+                    None)
         self.bands = live.bands if live is not None else bands
         self.last_idrs: list[bool] = [True] * n_sessions
         # per-session P-downlink payload mode of the most recent tick
@@ -255,10 +262,73 @@ class BandedFleetService:
         if enc is not None:
             enc.set_qp(qp)
 
+    def set_bitrate(self, session: int, kbps: int) -> None:
+        """Per-session rate retarget for the library-CBR codec rows
+        (vp9; the lossless AV1 splice accepts and ignores it). The
+        H.264 rows stay QP-driven through set_qp."""
+        enc = self.encoders[session]
+        if enc is not None and hasattr(enc, "set_bitrate"):
+            enc.set_bitrate(int(kbps))
+
     def force_keyframe(self, session: int) -> None:
         enc = self.encoders[session]
         if enc is not None:
             enc.force_keyframe()
+
+    def set_codec(self, session: int, codec: str) -> bool:
+        """Record a session's negotiated codec; returns True when it
+        changed (the caller then re-carves, which rebuilds the encoder
+        on the session's row through _build_encoder)."""
+        codec = (codec or "h264").lower()
+        if codec == self.codecs[session]:
+            return False
+        self.codecs[session] = codec
+        return True
+
+    def _build_encoder(self, session: int, devices: list):
+        """One session's encoder on its chip row, by negotiated codec.
+        av1/vp9 mesh their tile columns over the row's chips; anything
+        that fails to build degrades to the H.264 band encoder (and
+        resets the codec record) so the session always streams."""
+        codec = self.codecs[session]
+        if codec not in ("av1", "vp9", "h264"):
+            # a codec the fleet has no per-session row for (vp8/h265
+            # negotiate fine on solo hosts): degrade the RECORD too, so
+            # session_codec reports what actually streams and the
+            # negotiation answer corrects to h264 instead of wrapping
+            # H.264 AUs in the wrong payloader
+            logger.warning("fleet has no %s session row; session %d "
+                           "degrades to h264", codec, session)
+            self.codecs[session] = "h264"
+            codec = "h264"
+        try:
+            if codec == "av1":
+                from selkies_tpu.parallel.codec_mesh import (
+                    TileColumnAV1Encoder, budget_cols)
+
+                # budget_cols applies the SELKIES_TILE_COLS clamp the
+                # negotiation layer documents — the row's chip count is
+                # the budget, the knob bounds it
+                return TileColumnAV1Encoder(
+                    self._width, self._height, fps=self._fps,
+                    cols=budget_cols(len(devices)), devices=devices)
+            if codec == "vp9":
+                from selkies_tpu.parallel.codec_mesh import (
+                    TileColumnVP9Encoder, budget_cols)
+
+                return TileColumnVP9Encoder(
+                    self._width, self._height, fps=self._fps,
+                    cols=budget_cols(len(devices)), devices=devices)
+        except Exception:
+            logger.exception(
+                "session %d %s encoder build failed; degrading to h264",
+                session, codec)
+            self.codecs[session] = "h264"
+        from selkies_tpu.parallel.bands import BandedH264Encoder
+
+        return BandedH264Encoder(
+            self._width, self._height, qp=self._qp, fps=self._fps,
+            bands=self._row_bands(devices), cols=self.cols, devices=devices)
 
     def _row_bands(self, row) -> int:
         """Band count for a device row: borrowed chips ENLARGE the band
@@ -300,7 +370,6 @@ class BandedFleetService:
         state is read, and a restore-side failure closes the half-built
         replacement before propagating (no leaked pack pool / device
         buffers)."""
-        from selkies_tpu.parallel.bands import BandedH264Encoder
         from selkies_tpu.parallel.lifecycle import (
             checkpoint_session, restore_session)
 
@@ -313,15 +382,20 @@ class BandedFleetService:
                 except Exception:
                     logger.exception("closing parked encoder %d", session)
             return
-        ck = checkpoint_session(self, session) if old is not None else None
+        # checkpoint/restore is the H.264 GOP contract (idr_pic_id
+        # parity etc.) — it only carries across an h264 -> h264 rebuild.
+        # A codec switch (or a non-h264 re-carve) opens fresh with the
+        # encoder's own forced keyframe instead.
+        h264_to_h264 = (old is not None
+                        and self.codecs[session] == "h264"
+                        and getattr(old, "codec", "h264") == "h264")
+        ck = checkpoint_session(self, session) if h264_to_h264 else None
         # the new encoder is built with the SERVICE's constructor qp, not
         # the session's current dynamic qp: params.qp feeds the PPS
         # pic_init_qp and every slice_qp_delta, so baking the dynamic qp
         # in would shift all deltas vs a never-re-carved encoder. The
         # dynamic qp carries over via restore_session -> set_qp.
-        enc = BandedH264Encoder(
-            self._width, self._height, qp=self._qp, fps=self._fps,
-            bands=self._row_bands(devices), cols=self.cols, devices=devices)
+        enc = self._build_encoder(session, devices)
         if ck is not None:
             try:
                 restore_session(ck, enc)

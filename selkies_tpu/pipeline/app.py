@@ -266,14 +266,17 @@ class TPUWebRTCApp:
     # ------------------------------------------------------------------
     # recovery ladder plumbing (called via _AppRecovery / the supervisor)
 
-    def _swap_encoder(self, name: str, width: int, height: int) -> bool:
+    def _swap_encoder(self, name: str, width: int, height: int,
+                      **encoder_kw) -> bool:
         """Replace the live encoder in place (same geometry contract as
         the ladder caller established). Keeps the old encoder when
-        construction fails; True on success."""
+        construction fails; True on success. ``encoder_kw`` forwards
+        row-specific knobs (the negotiated tile-column budget for the
+        av1/vp9 mesh rows — orchestrator._negotiate_codec)."""
         try:
             new = create_encoder(
                 name, width=width, height=height, fps=self.framerate,
-                bitrate_kbps=int(self.video_bitrate_kbps))
+                bitrate_kbps=int(self.video_bitrate_kbps), **encoder_kw)
         except Exception as exc:
             logger.exception("encoder swap to %s failed; keeping current", name)
             self._send("error", {"message": f"encoder swap failed: {exc!r}"})

@@ -173,9 +173,13 @@ class PeerConnection:
                 "refusing media session")
         if r.video_pt is not None:
             # pay with the PT the answer actually negotiated, not the
-            # static offer PT (browsers normally echo it, but RFC 3264
-            # lets the answer re-number)
+            # static offer PT or any payloader-class default (browsers
+            # normally echo the offer, but RFC 3264 lets the answer
+            # re-number — tests/test_rtp_pt.py regression-tests every
+            # codec payloader through this path)
             self.video_pay.payload_type = r.video_pt
+        if r.audio_pt is not None:
+            self.audio_pay.payload_type = r.audio_pt
         self._remote = r
         if r.twcc_id is not None:
             self._twcc_id = r.twcc_id

@@ -132,6 +132,7 @@ class RemoteDescription:
     setup: str = ""
     candidates: list[str] = field(default_factory=list)
     video_pt: int | None = None
+    audio_pt: int | None = None
     red_pt: int | None = None
     ulpfec_pt: int | None = None
     twcc_id: int | None = None
@@ -206,6 +207,10 @@ def parse_answer(sdp: str, prefer: str = "h264") -> RemoteDescription:
                 r.red_pt = int(pt)
             elif enc.lower().startswith("ulpfec/") and r.ulpfec_pt is None:
                 r.ulpfec_pt = int(pt)
+            elif enc.upper().startswith("OPUS/") and r.audio_pt is None:
+                # RFC 3264 lets the answer re-number audio too; the
+                # payloader must send what the answer negotiated
+                r.audio_pt = int(pt)
         elif line.startswith("a=extmap:"):
             body = line[len("a=extmap:"):]
             eid, uri = body.split(" ", 1)

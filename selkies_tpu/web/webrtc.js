@@ -45,6 +45,12 @@ class SelkiesWebRTC {
       const meta = {
         res: `${Math.round(innerWidth * devicePixelRatio)}x${Math.round(innerHeight * devicePixelRatio)}`,
         scale: devicePixelRatio,
+        // codec preference list for per-client negotiation
+        // (signalling/negotiate.py). Default keeps h264 first (no
+        // behaviour change); `?codec=av1` or `?codec=vp9,h264` opts a
+        // client into another row the server resolves against its
+        // registry + chip carve.
+        codecs: this._codecPreferences(),
       };
       this.ws.send(`HELLO ${this.peerId} ${btoa(JSON.stringify(meta))}`);
     };
@@ -52,6 +58,22 @@ class SelkiesWebRTC {
       if (!this.closed && !this.connected) this._fail("signalling closed");
     };
     this.ws.onmessage = (ev) => this._signal(ev.data, iceServers);
+  }
+
+  _codecPreferences() {
+    const forced = new URLSearchParams(location.search).get("codec");
+    if (forced) return forced.split(",").map((c) => c.trim().toLowerCase()).filter(Boolean);
+    let caps = null;
+    try {
+      if (window.RTCRtpReceiver && RTCRtpReceiver.getCapabilities)
+        caps = RTCRtpReceiver.getCapabilities("video");
+    } catch (e) { /* capability probe is best-effort */ }
+    if (!caps || !caps.codecs) return ["h264"];
+    const have = new Set(caps.codecs.map((c) => (c.mimeType || "").toLowerCase()));
+    const order = [["video/h264", "h264"], ["video/av1", "av1"],
+                   ["video/vp9", "vp9"], ["video/vp8", "vp8"]];
+    const out = order.filter(([m]) => have.has(m)).map(([, n]) => n);
+    return out.length ? out : ["h264"];
   }
 
   _signal(data, iceServers) {

@@ -1,0 +1,318 @@
+"""Codec-mesh conformance: tile-column AV1 splice + VP9 mesh front-end.
+
+The correctness contracts (parallel/codec_mesh.py, models/av1/stitch.py):
+
+* AV1 tile-column frames are spec-conformant and decode through the
+  INDEPENDENT ctypes libdav1d oracle pixel-identical to (a) the source
+  (lossless by construction) and (b) the single-encoder path — both are
+  lossless, so "pixel-identical to the oracle" is exact, not
+  approximate;
+* per-column payload caching and the parallel strip pool change no
+  bytes vs a serial re-encode;
+* the VP9 mesh row is byte-identical to the same row on the host
+  classifier (the mesh only moves WHERE classification runs) and its
+  tiles decode via libvpx's own decoder;
+* the mesh-sharded dirty map equals the solo front-end's.
+
+Everything is skip-gated on the backing libraries (libaom/dav1d for
+AV1, libvpx for VP9) exactly like the other codec-row suites; the
+stitch bit-writer units at the top run everywhere.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from selkies_tpu.models.av1 import headers, stitch
+from selkies_tpu.models.av1.dav1d import dav1d_available
+from selkies_tpu.models.libaom_enc import aom_strip_available
+from selkies_tpu.models.libvpx_enc import libvpx_available
+
+needs_av1 = pytest.mark.skipif(
+    not (aom_strip_available() and dav1d_available()),
+    reason="libaom strip path or libdav1d not present")
+needs_vpx = pytest.mark.skipif(not libvpx_available(),
+                               reason="libvpx not present")
+
+
+def _trace(n=6, w=256, h=128, seed=11):
+    rng = np.random.default_rng(seed)
+    f0 = rng.integers(0, 255, (h, w, 4), dtype=np.uint8)
+    f0[:, :, 3] = 0
+    frames = [f0]
+    cur = f0
+    for i in range(1, n):
+        if i in (2, 3):
+            frames.append(cur)  # static
+            continue
+        cur = cur.copy()
+        x = (i * 48) % (w - 96)
+        cur[:40, x:x + 90] = rng.integers(0, 255, (40, 90, 4), dtype=np.uint8)
+        cur[:, :, 3] = 0
+        frames.append(cur)
+    return frames
+
+
+def _i420(frame):
+    from selkies_tpu.models.libvpx_enc import _bgrx_to_i420_np
+
+    return _bgrx_to_i420_np(frame)
+
+
+# ---------------------------------------------------------------------------
+# stitch bit machinery (no codec libraries needed)
+
+
+def test_bitwriter_roundtrip():
+    w = stitch.BitWriter()
+    w.f(0b101, 3)
+    w.f(0x3FF, 10)
+    w.f(0, 1)
+    w.trailing_bits()
+    data = w.bytes()
+    b = headers._Bits(data)
+    assert b.f(3) == 0b101
+    assert b.f(10) == 0x3FF
+    assert b.f(1) == 0
+    assert b.f(1) == 1  # trailing one bit
+
+
+def test_obu_wrap_iterates():
+    payload = b"\x01\x02\x03" * 50
+    tu = stitch.temporal_delimiter() + stitch.obu(headers.OBU_PADDING, payload)
+    got = list(headers.iter_obus(tu))
+    assert got[0][0] == headers.OBU_TEMPORAL_DELIMITER
+    assert got[1] == (headers.OBU_PADDING, payload)
+
+
+def test_tile_columns_carve():
+    # 256px @ sb64: 4 SBs; log2=1 -> 2 columns of 128
+    assert stitch.tile_columns(256, 1) == [(0, 128), (128, 128)]
+    # 1920px: 30 SBs; log2=2 -> uniform spacing gives 8/8/8/6 SBs
+    assert stitch.tile_columns(1920, 2) == [
+        (0, 512), (512, 512), (1024, 512), (1536, 384)]
+    # narrow frame: log2 larger than the SB count collapses
+    assert stitch.tile_columns(128, 3) == [(0, 64), (64, 64)]
+    # log2=0 is the single-column identity
+    assert stitch.tile_columns(640, 0) == [(0, 640)]
+
+
+def test_cols_log2_for():
+    from selkies_tpu.parallel.codec_mesh import cols_log2_for
+
+    assert [cols_log2_for(c) for c in (1, 2, 3, 4, 5, 8)] == [0, 1, 2, 2, 3, 3]
+
+
+# ---------------------------------------------------------------------------
+# AV1 tile-column splice vs the dav1d oracle
+
+
+@needs_av1
+def test_strip_parses_lossless_intra():
+    from selkies_tpu.models.libaom_enc import AomStripEncoder
+
+    enc = AomStripEncoder(128, 96)
+    tu = enc.encode_frame(_trace(1, 128, 96)[0])
+    s = stitch.extract_strip(tu)
+    assert s.seq is not None and s.seq_payload
+    assert s.frame.frame_type == headers.KEY_FRAME
+    assert s.frame.show_frame
+    assert s.tile_payload
+    # header parse consumed real bits and the payload picks up after it
+    assert 0 < (s.frame.header_bits + 7) // 8 < len(tu)
+    enc.close()
+
+
+@needs_av1
+def test_av1_mesh_decodes_pixel_identical_to_oracle():
+    """The acceptance contract: tile-column frames decode via libdav1d
+    pixel-identical to the single-encoder (cols=1) path — both lossless,
+    so both must equal the source conversion exactly; the stream also
+    exercises INTRA_ONLY cached splices and the 3-byte re-show TU."""
+    from selkies_tpu.models.av1.dav1d import Dav1dDecoder
+    from selkies_tpu.parallel.codec_mesh import TileColumnAV1Encoder
+
+    frames = _trace()
+    mesh = TileColumnAV1Encoder(256, 128, cols=2, frontend="host")
+    solo = TileColumnAV1Encoder(256, 128, cols=1, frontend="host")
+    assert mesh.cols == 2 and solo.cols == 1
+    mesh_aus = [mesh.encode_frame(f) for f in frames]
+    solo_aus = [solo.encode_frame(f) for f in frames]
+    assert mesh.stitch_fallbacks == 0
+    assert mesh.static_frames >= 1          # the re-show path ran
+    assert mesh.cached_columns >= 1         # clean columns spliced from cache
+    assert len(mesh_aus[3]) < 16            # show_existing TU is tiny
+    dec_mesh, dec_solo = Dav1dDecoder(), Dav1dDecoder()
+    for i, f in enumerate(frames):
+        exp = _i420(f)
+        for dec, au in ((dec_mesh, mesh_aus[i]), (dec_solo, solo_aus[i])):
+            pics = dec.decode(au)
+            assert len(pics) == 1, f"frame {i}: {len(pics)} pictures"
+            for got, want in zip(pics[0], exp):
+                assert np.array_equal(got, want), f"frame {i} differs"
+    dec_mesh.close(), dec_solo.close()
+    mesh.close(), solo.close()
+
+
+@needs_av1
+def test_av1_mesh_parallel_matches_serial_bytes():
+    """Pool scheduling must not change bytes: per-column encoders are
+    deterministic per instance, so a single-worker re-run of the same
+    trace splices identical temporal units."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from selkies_tpu.parallel.codec_mesh import TileColumnAV1Encoder
+
+    frames = _trace(4)
+    a = TileColumnAV1Encoder(256, 128, cols=2, frontend="host")
+    b = TileColumnAV1Encoder(256, 128, cols=2, frontend="host")
+    # force b's strip encodes through one serial worker
+    b._pool.shutdown(wait=True)
+    b._pool = ThreadPoolExecutor(max_workers=1)
+    for i, f in enumerate(frames):
+        au_a, au_b = a.encode_frame(f), b.encode_frame(f)
+        assert au_a == au_b, f"frame {i}: parallel != serial"
+    a.close(), b.close()
+
+
+@needs_av1
+def test_av1_mesh_force_keyframe_and_fallback():
+    from selkies_tpu.models.av1.dav1d import Dav1dDecoder
+    from selkies_tpu.parallel.codec_mesh import TileColumnAV1Encoder
+
+    frames = _trace(4)
+    enc = TileColumnAV1Encoder(256, 128, cols=2, frontend="host")
+    enc.encode_frame(frames[0])
+    enc.encode_frame(frames[1])
+    enc.force_keyframe()
+    au = enc.encode_frame(frames[1])     # unchanged + forced -> KEY splice
+    assert enc.last_stats.idr
+    dec = Dav1dDecoder()
+    pics = dec.decode(au)
+    assert len(pics) == 1
+    # poison one cached column field so the next splice leaves the
+    # envelope: the encoder must ship the full-frame fallback TU, which
+    # still decodes to the exact source
+    enc._fields[1] = stitch.IntraFrameInfo(
+        frame_type=headers.KEY_FRAME, show_frame=True, error_resilient=True,
+        disable_cdf_update=not enc._fields[0].disable_cdf_update,
+        allow_screen_content_tools=False, order_hint=0,
+        refresh_frame_flags=0xFF, frame_width=128, frame_height=128,
+        render_and_frame_size_different=False, render_width=128,
+        render_height=128, allow_intrabc=False,
+        disable_frame_end_update_cdf=True, reduced_tx_set=False)
+    enc._payloads[1] = b"\x00"
+    au = enc.encode_frame(frames[2].copy())  # cache poisoned -> fallback
+    assert enc.stitch_fallbacks == 1
+    pics = dec.decode(au)
+    assert len(pics) == 1
+    exp = _i420(frames[2])
+    for got, want in zip(pics[0], exp):
+        assert np.array_equal(got, want)
+    dec.close()
+    enc.close()
+
+
+@needs_av1
+@pytest.mark.slow
+def test_av1_mesh_conformance_sweep():
+    """Heavy sweep: geometries with unequal last columns and 3-column
+    carves, longer traces — tier-1 keeps the 2-column smoke above."""
+    from selkies_tpu.models.av1.dav1d import Dav1dDecoder
+    from selkies_tpu.parallel.codec_mesh import TileColumnAV1Encoder
+
+    for w, h, cols, seed in ((320, 96, 3, 3), (384, 128, 4, 4),
+                             (192, 192, 2, 5)):
+        frames = _trace(8, w, h, seed)
+        enc = TileColumnAV1Encoder(w, h, cols=cols, frontend="host")
+        dec = Dav1dDecoder()
+        for i, f in enumerate(frames):
+            au = enc.encode_frame(f)
+            pics = dec.decode(au)
+            assert len(pics) == 1
+            exp = _i420(f)
+            for got, want in zip(pics[0], exp):
+                assert np.array_equal(got, want), (w, h, cols, i)
+        assert enc.stitch_fallbacks == 0
+        dec.close()
+        enc.close()
+
+
+# ---------------------------------------------------------------------------
+# VP9 tile-column mesh
+
+
+@needs_vpx
+def test_vp9_mesh_vs_solo_device_bytes_and_decode():
+    """The VP9 byte contract: the column-sharded mesh front-end only
+    moves WHERE classification runs — output must be byte-identical to
+    the solo hybrid row with the same tile carve and the same
+    (MB-granular) device classifier, and decode via libvpx.  (The host
+    classifier is NOT byte-comparable: FramePrep classifies at tile
+    granularity, so its active maps are coarser than the device MB
+    maps.)"""
+    from selkies_tpu.models.libvpx_enc import LibVpxDecoder
+    from selkies_tpu.models.vp9.encoder import TPUVP9Encoder
+    from selkies_tpu.parallel.codec_mesh import TileColumnVP9Encoder
+
+    frames = _trace()
+    mesh = TileColumnVP9Encoder(256, 128, cols=2, frontend="device")
+    solo = TPUVP9Encoder(256, 128, frontend="device",
+                         tile_columns_log2=1, threads=2)
+    assert mesh.frontend_mode == "device"
+    dec = LibVpxDecoder()
+    for i, f in enumerate(frames):
+        a, b = mesh.encode_frame(f), solo.encode_frame(f)
+        assert a == b, f"frame {i}: column mesh != solo device front-end"
+        pics = dec.decode(a)
+        assert len(pics) == 1, f"frame {i}"
+    assert mesh.static_frames >= 1
+    mesh.close(), solo.close()
+
+
+@needs_vpx
+def test_vp9_mesh_static_one_byte():
+    from selkies_tpu.parallel.codec_mesh import TileColumnVP9Encoder
+
+    frames = _trace()
+    enc = TileColumnVP9Encoder(256, 128, cols=2, frontend="host")
+    sizes = [len(enc.encode_frame(f)) for f in frames]
+    assert sizes[3] == 1  # second static repeat rides show_existing
+    enc.close()
+
+
+# ---------------------------------------------------------------------------
+# mesh front-end
+
+
+def test_mesh_frontend_dirty_identity():
+    """Column-sharded classification == the solo device front-end ==
+    the analytic per-MB diff, on the forced 8-device CPU mesh."""
+    from selkies_tpu.models.hybrid_frontend import DeviceDeltaFrontend
+    from selkies_tpu.parallel.codec_mesh import MeshDeltaFrontend
+
+    frames = _trace(5, 208, 96, seed=9)  # 13 MB cols: unequal shard pad
+    mesh = MeshDeltaFrontend(208, 96, cols=4)
+    solo = DeviceDeltaFrontend(208, 96)
+    assert mesh.step(frames[0]) == (None, None)
+    solo.step(frames[0])
+    for i in range(1, len(frames)):
+        dm, _hm = mesh.step(frames[i])
+        ds, _hs = solo.step(frames[i])
+        diff = (frames[i] != frames[i - 1]).reshape(6, 16, 13, 16, 4)
+        expect = diff.any(axis=(1, 3, 4))
+        assert np.array_equal(dm, expect), f"frame {i} mesh dirty"
+        assert np.array_equal(ds, expect), f"frame {i} solo dirty"
+
+
+def test_mesh_frontend_reset():
+    from selkies_tpu.parallel.codec_mesh import MeshDeltaFrontend
+
+    frames = _trace(3, 128, 64)
+    fe = MeshDeltaFrontend(128, 64, cols=2)
+    fe.step(frames[0])
+    dirty, _ = fe.step(frames[1])
+    assert dirty is not None
+    fe.reset()
+    assert fe.step(frames[1]) == (None, None)

@@ -1,0 +1,20 @@
+"""tools/check_codec_rows.py as a tier-1 gate (like test_env_knobs.py):
+every registry encoder row declares a codec that maps to a payloader
+and an SDP rtpmap entry."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_codec_rows_clean():
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import check_codec_rows
+    finally:
+        sys.path.pop(0)
+    problems = check_codec_rows.check(ROOT)
+    assert not problems, "\n".join(problems)

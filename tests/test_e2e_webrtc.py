@@ -337,6 +337,136 @@ def test_webrtc_codec_session_end_to_end(loop, tmp_path, codec_case):
     loop.run_until_complete(scenario())
 
 
+@pytest.mark.parametrize("codec_case", ["av1", "vp9"])
+def test_webrtc_negotiated_codec_session(loop, tmp_path, codec_case,
+                                         monkeypatch):
+    """The ISSUE-9 acceptance path: the server is CONFIGURED for h264,
+    the browser's HELLO meta carries a codec preference list, and the
+    session NEGOTIATES av1/vp9 (signalling/negotiate.py) — the encoder
+    row swaps to the tile-column mesh (SELKIES_TILE_COLS=2), the offer
+    advertises the negotiated codec, and the streamed temporal units
+    decode through the independent decoder. Pixel-identity of the mesh
+    encode vs the single-encoder oracle is held at encoder level by
+    tests/test_codec_mesh.py; here the same tile-column encoder streams
+    through a real negotiated WebRTC session."""
+    import base64 as b64
+
+    if codec_case == "av1":
+        from selkies_tpu.models.av1.dav1d import dav1d_available
+        from selkies_tpu.models.libaom_enc import aom_strip_available
+
+        if not (aom_strip_available() and dav1d_available()):
+            pytest.skip("libaom strip path / libdav1d not present")
+        sdp_codec, enc_type = "AV1", "TileColumnAV1Encoder"
+    else:
+        from selkies_tpu.models.libvpx_enc import libvpx_available
+
+        if not libvpx_available():
+            pytest.skip("libvpx not present")
+        sdp_codec, enc_type = "VP9", "TPUVP9Encoder"
+
+    monkeypatch.setenv("SELKIES_TILE_COLS", "2")
+
+    async def scenario():
+        cfg = make_config(tmp_path)
+        assert cfg.encoder == "tpuh264enc"  # negotiation, not config
+        orch = Orchestrator(cfg)
+        orch.input.backend = FakeBackend()
+        orch.input.clipboard = MemoryClipboard()
+        assert orch.webrtc._kw["codec"] == "h264"
+        run_task = asyncio.ensure_future(orch.run())
+        for _ in range(100):
+            if orch.server._runner is not None and orch.server._runner.addresses:
+                break
+            await asyncio.sleep(0.05)
+        port = orch.server.bound_port
+
+        browser = FakeBrowser()
+        async with aiohttp.ClientSession() as http:
+            ws = await http.ws_connect(f"http://127.0.0.1:{port}/ws")
+            meta = b64.b64encode(json.dumps(
+                {"codecs": [codec_case, "h264"]}).encode()).decode()
+            await ws.send_str(f"HELLO 1 {meta}")
+            deadline = asyncio.get_event_loop().time() + 90
+            input_ch = None
+            pump = SignallingPump(ws, browser, codec=sdp_codec)
+            while asyncio.get_event_loop().time() < deadline:
+                if not await pump.step():
+                    break
+                if browser.dtls is not None and browser.dtls.handshake_complete \
+                        and input_ch is None:
+                    input_ch = browser.sctp.open_channel("input")
+                    for pkt in browser.sctp.take_packets():
+                        browser.dtls.send(pkt)
+                    browser._flush()
+                if len(browser.rtp_packets) >= 20:
+                    break
+
+            assert pump.answered, "no offer arrived"
+            assert f"{sdp_codec}/90000" in pump.offer_sdp, \
+                f"offer must advertise the NEGOTIATED codec {sdp_codec}"
+            # the encoder row swapped to the tile-column mesh
+            assert type(orch.app.encoder).__name__ == enc_type
+            assert getattr(orch.app.encoder, "cols", 1) == 2
+            assert orch.webrtc._kw["codec"] == codec_case
+            assert len(browser.rtp_packets) >= 10, \
+                f"only {len(browser.rtp_packets)} SRTP packets"
+
+            from selkies_tpu.transport.webrtc import sdp as sdp_mod
+
+            if codec_case == "av1":
+                from selkies_tpu.transport.rtp_av1 import Av1Depayloader
+
+                depay = Av1Depayloader()
+            else:
+                from selkies_tpu.transport.rtp_vpx import Vp9Depayloader
+
+                depay = Vp9Depayloader()
+            units = []
+            for wire in browser.rtp_packets:
+                try:
+                    pkt = RtpPacket.parse(wire)
+                except ValueError:
+                    continue
+                if pkt.payload_type != sdp_mod.VIDEO_PT:
+                    continue
+                unit = depay.push(pkt)
+                if unit:
+                    units.append(unit)
+            assert units, "no temporal units reassembled"
+            if codec_case == "av1":
+                from selkies_tpu.models.av1.dav1d import Dav1dDecoder
+
+                dec = Dav1dDecoder()
+                pics = []
+                for tu in units:
+                    pics += dec.decode(tu)
+                pics += dec.flush()
+                dec.close()
+                assert pics, "libdav1d decoded no pictures"
+                assert pics[-1][0].shape == (128, 192)
+            else:
+                from selkies_tpu.models.libvpx_enc import LibVpxDecoder
+
+                dec = LibVpxDecoder()
+                pics = []
+                for unit in units:
+                    pics += dec.decode(unit)
+                dec.close()
+                assert pics, "libvpx decoded no pictures"
+                assert pics[-1][0].shape == (128, 192)
+            await ws.close()
+
+        await orch.shutdown()
+        run_task.cancel()
+        try:
+            await run_task
+        except (asyncio.CancelledError, Exception):
+            pass
+
+    loop.run_until_complete(scenario())
+
+
 def test_webrtc_session_survives_hostile_sctp(loop, tmp_path):
     """The authenticated DTLS peer injects the hostile SCTP classes the
     hardening addressed — INIT_ACK outside COOKIE-WAIT (RFC 9260 §5.2.3),

@@ -1,0 +1,146 @@
+"""Fleet-side codec negotiation: per-session codecs in the banded
+service, placement records, service-rebuild persistence, and the
+last_modes contract for non-H.264 sessions."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from selkies_tpu.models.libvpx_enc import libvpx_available
+
+W, H = 256, 128
+
+needs_vpx = pytest.mark.skipif(not libvpx_available(),
+                               reason="libvpx not present")
+
+
+def _frames(n=3, sessions=2, seed=7):
+    rng = np.random.default_rng(seed)
+    out = []
+    cur = rng.integers(0, 255, (sessions, H, W, 4), dtype=np.uint8)
+    for i in range(n):
+        if i:
+            cur = cur.copy()
+            cur[:, :32, 32 * i:32 * i + 48] = rng.integers(
+                0, 255, (sessions, 32, 48, 4), dtype=np.uint8)
+        out.append(cur)
+    return out
+
+
+@needs_vpx
+def test_banded_service_mixed_codecs_tick():
+    """One service, session 0 on H.264, session 1 negotiated to VP9:
+    both stream from one encode_tick, the VP9 AU decodes via libvpx,
+    and last_modes reports a stable "" (not a stale h264 value) for the
+    non-H.264 session."""
+    from selkies_tpu.models.libvpx_enc import LibVpxDecoder
+    from selkies_tpu.parallel.lifecycle import SessionPlacer
+    from selkies_tpu.parallel.serving import BandedFleetService
+
+    import jax
+
+    placer = SessionPlacer(devices=jax.devices(), bands=1, host_cores=8)
+    rows = placer.place_initial(2, 1)
+    svc = BandedFleetService(2, W, H, qp=28, fps=30, bands=1, rows=rows)
+    try:
+        assert svc.set_codec(1, "vp9")
+        assert not svc.set_codec(1, "vp9")  # idempotent
+        svc.recarve(1, rows[1])
+        assert svc.codecs == ["h264", "vp9"]
+        dec = LibVpxDecoder()
+        for i, batch in enumerate(_frames()):
+            aus = svc.encode_tick(batch)
+            assert aus[0].startswith(b"\x00\x00\x00\x01"), "h264 Annex-B"
+            assert len(dec.decode(aus[1])) == 1, f"tick {i} vp9 decode"
+            assert svc.last_modes[1] == "", "non-h264 downlink_mode"
+        assert svc.last_idrs[1] is False  # steady state went inter
+        dec.close()
+    finally:
+        svc.close()
+
+
+@needs_vpx
+def test_banded_service_rebuild_keeps_codecs():
+    """The supervisor RESTART rung rebuilds the service from the
+    placer's codec record — a vp9 session must come back as vp9."""
+    from selkies_tpu.parallel.lifecycle import SessionPlacer
+    from selkies_tpu.parallel.serving import BandedFleetService
+
+    import jax
+
+    placer = SessionPlacer(devices=jax.devices(), bands=1, host_cores=8)
+    rows = placer.place_initial(2, 1)
+    placer.set_codec(1, "vp9")
+    svc = BandedFleetService(
+        2, W, H, qp=28, fps=30, bands=1, rows=rows,
+        codecs=[placer.codec(k) for k in range(2)])
+    try:
+        assert svc.codecs == ["h264", "vp9"]
+        assert svc.encoders[1].codec == "vp9"
+        assert placer.codec_counts() == {"h264": 1, "vp9": 1}
+        assert placer.stats()["codecs"] == {"0": "h264", "1": "vp9"}
+    finally:
+        svc.close()
+
+
+@needs_vpx
+def test_fleet_negotiate_session_vp9():
+    from selkies_tpu.parallel.fleet import SessionFleet, SessionSlot
+    from selkies_tpu.parallel.serving import BandedFleetService
+
+    import jax
+
+    devs = jax.devices()
+    svc = BandedFleetService(2, W, H, qp=28, fps=30, bands=1,
+                             rows=[[devs[0]], [devs[1]]])
+    slots = [SessionSlot(k, bitrate_kbps=2000, fps=30) for k in range(2)]
+    # SessionFleet owns the placer's initial carve; its rows cover the
+    # same first chips the service was built on
+    fleet = SessionFleet(slots, width=W, height=H, fps=30, service=svc)
+    placer = fleet.placer
+    try:
+        n = fleet.negotiate_session(1, ["vp9", "h264"])
+        assert (n.codec, n.encoder) == ("vp9", "tpuvp9enc")
+        assert fleet.session_codec(1) == "vp9"
+        assert fleet.session_codec(0) == "h264"
+        assert placer.codec(1) == "vp9"
+        # unknown-only preference list falls back and stays h264
+        n0 = fleet.negotiate_session(0, ["codec-from-the-future"])
+        assert (n0.codec, fleet.session_codec(0)) == ("h264", "h264")
+        aus = svc.encode_tick(_frames(1)[0])
+        assert aus[0].startswith(b"\x00\x00\x00\x01")
+        assert aus[1] and not aus[1].startswith(b"\x00\x00\x00\x01")
+    finally:
+        svc.close()
+
+
+def test_fleet_negotiate_lockstep_refuses_mesh_codecs():
+    """A fleet on the lockstep batch shard (no per-session recarve) has
+    no per-session chips to mesh — av1/vp9 preferences resolve to
+    h264."""
+    from selkies_tpu.parallel.fleet import SessionFleet, SessionSlot
+
+    class _FakeService:
+        def __init__(self, n):
+            self.n = n
+            self.last_idrs = [True] * n
+            self.last_modes = [""] * n
+
+        def encode_tick(self, frames):
+            return [b"au"] * self.n
+
+        def set_qp(self, k, qp):
+            pass
+
+        def force_keyframe(self, k):
+            pass
+
+        def close(self):
+            pass
+
+    slots = [SessionSlot(k, bitrate_kbps=2000, fps=30) for k in range(2)]
+    fleet = SessionFleet(slots, width=W, height=H, fps=30,
+                         service=_FakeService(2))
+    n = fleet.negotiate_session(0, ["av1", "vp9", "h264"])
+    assert (n.codec, fleet.session_codec(0)) == ("h264", "h264")

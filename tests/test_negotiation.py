@@ -1,0 +1,87 @@
+"""Per-client codec negotiation: preference list -> registry row ->
+payloader (signalling/negotiate.py)."""
+
+from __future__ import annotations
+
+import pytest
+
+from selkies_tpu.models import registry
+from selkies_tpu.signalling import negotiate
+
+
+def test_resolver_prefers_first_available(monkeypatch):
+    monkeypatch.setattr(negotiate, "codec_available", lambda c: True)
+    n = negotiate.resolve(["av1", "h264"], session_chips=4)
+    assert (n.codec, n.encoder) == ("av1", "tpuav1enc")
+    assert n.cols == 4
+    assert n.reason == "client-preference"
+
+
+def test_resolver_skips_unknown_and_unavailable(monkeypatch):
+    monkeypatch.setattr(negotiate, "codec_available",
+                        lambda c: c in ("vp9", "h264"))
+    n = negotiate.resolve(["codec-from-the-future", "av1", "vp9"],
+                          session_chips=2)
+    assert (n.codec, n.encoder, n.cols) == ("vp9", "tpuvp9enc", 2)
+
+
+def test_resolver_lockstep_carve_refuses_mesh_codecs(monkeypatch):
+    monkeypatch.setattr(negotiate, "codec_available", lambda c: True)
+    n = negotiate.resolve(["av1", "vp9", "h264"], session_chips=1,
+                          per_session_carve=False)
+    assert (n.codec, n.cols) == ("h264", 1)
+
+
+def test_resolver_tile_cols_env_clamps(monkeypatch):
+    monkeypatch.setattr(negotiate, "codec_available", lambda c: True)
+    monkeypatch.setenv("SELKIES_TILE_COLS", "2")
+    n = negotiate.resolve(["av1"], session_chips=8)
+    assert n.cols == 2
+    monkeypatch.setenv("SELKIES_TILE_COLS", "16")
+    n = negotiate.resolve(["av1"], session_chips=4)
+    assert n.cols == 4  # the carve bounds the env request
+
+
+def test_resolver_server_preferences_env(monkeypatch):
+    monkeypatch.setattr(negotiate, "codec_available", lambda c: c == "vp9")
+    monkeypatch.setenv("SELKIES_CODEC", "av1, vp9")
+    assert negotiate.server_preferences() == ["av1", "vp9"]
+    n = negotiate.resolve(None, session_chips=2)
+    assert n.codec == "vp9"
+
+
+def test_resolver_empty_falls_back(monkeypatch):
+    monkeypatch.delenv("SELKIES_CODEC", raising=False)
+    n = negotiate.resolve([], session_chips=1)
+    assert (n.codec, n.encoder) == ("h264", "tpuh264enc")
+
+
+def test_resolver_all_refused_falls_back(monkeypatch):
+    monkeypatch.setattr(negotiate, "codec_available", lambda c: c == "h264")
+    n = negotiate.resolve(["av1", "vp9"], session_chips=4)
+    assert (n.codec, n.reason) == ("h264", "fallback")
+
+
+def test_every_negotiable_codec_maps_to_row_and_payloader():
+    for codec, row in negotiate.CODEC_ROWS.items():
+        assert registry.encoder_exists(row), (codec, row)
+        assert registry.codec_for_encoder(row) == codec
+        pay = registry.payloader_for_codec(codec)
+        assert callable(getattr(pay, "payload_au", None))
+
+
+def test_payloader_for_unknown_codec_raises():
+    with pytest.raises(ValueError, match="no payloader"):
+        registry.payloader_for_codec("theora")
+
+
+def test_alias_rows_inherit_target_codec():
+    assert registry.codec_for_encoder("nvh264enc") == "h264"
+    assert registry.codec_for_encoder("vavp9enc") == "vp9"
+    assert registry.codec_for_encoder("rav1enc") == "av1"
+    assert registry.codec_for_encoder("no-such-row") == ""
+
+
+def test_h264_always_available():
+    assert negotiate.codec_available("h264")
+    assert not negotiate.codec_available("theora")

@@ -158,6 +158,11 @@ def test_registry_h265_and_av1_names_resolve(monkeypatch):
     monkeypatch.setattr(x265enc, "_lib_tried", True)
     monkeypatch.setattr(libaom_enc, "_lib", None)
     monkeypatch.setattr(libaom_enc, "_lib_tried", True)
+    # the legacy-ABI (libaom 1.0) strip path must fail too, or the AV1
+    # row legitimately serves through the tile-column splice instead of
+    # falling back
+    monkeypatch.setattr(libaom_enc, "_legacy", None)
+    monkeypatch.setattr(libaom_enc, "_legacy_tried", True)
     enc = registry.create_encoder("x265enc", width=640, height=360, fps=30)
     assert enc == "H264ENC" and created["width"] == 640
     enc = registry.create_encoder("nvav1enc", width=320, height=240, fps=15,

@@ -1,0 +1,114 @@
+"""Regression: RTP payload types come from the negotiated SDP answer,
+never the payloader-class defaults (rtp.py's 102 / rtp_av1.py's 45 /
+rtp_h265.py's 103 are construction-time defaults only — an answer that
+re-numbers per RFC 3264 must win for every codec, audio included)."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from selkies_tpu.transport.webrtc import sdp
+
+
+def _answer(video_lines, audio_lines=()):
+    return "\r\n".join([
+        "v=0", "o=- 1 2 IN IP4 127.0.0.1", "s=-",
+        "a=ice-ufrag:u", "a=ice-pwd:p",
+        "a=fingerprint:sha-256 AA:BB", "a=setup:active",
+        *video_lines, *audio_lines,
+    ]) + "\r\n"
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# one minimal VALID access unit per codec, so the packets (not just the
+# payloader attribute) prove the negotiated PT reaches the wire
+def _tiny_au(codec: str) -> bytes:
+    if codec == "h264":
+        return b"\x00\x00\x00\x01" + bytes([0x65]) + b"\x11" * 24
+    if codec == "h265":
+        return b"\x00\x00\x00\x01" + bytes([19 << 1, 1]) + b"\x11" * 24
+    if codec == "av1":
+        from selkies_tpu.models.av1.headers import show_existing_frame_tu
+
+        return show_existing_frame_tu(0)
+    return b"\x11" * 24  # vp8/vp9: the payloader treats frames as opaque
+
+
+@pytest.mark.parametrize("codec,rtpmap", [
+    ("h264", "H264/90000"),
+    ("av1", "AV1/90000"),
+    ("h265", "H265/90000"),
+    ("vp9", "VP9/90000"),
+    ("vp8", "VP8/90000"),
+])
+def test_video_pt_follows_answer(codec, rtpmap):
+    from selkies_tpu.transport.webrtc.peer import PeerConnection
+
+    async def scenario():
+        pc = PeerConnection(codec=codec, audio=False,
+                            loop=asyncio.get_event_loop())
+        default_pt = pc.video_pay.payload_type
+        answer = _answer([
+            "m=video 9 UDP/TLS/RTP/SAVPF 119",
+            f"a=rtpmap:119 {rtpmap}",
+        ])
+        await pc.set_answer(answer)
+        assert pc.video_pay.payload_type == 119 != default_pt
+        # the PT reaches the wire packets, not just the attribute
+        pkts = pc.video_pay.payload_au(_tiny_au(codec), 0)
+        assert pkts and all(p.payload_type == 119 for p in pkts)
+        pc.close()
+
+    _run(scenario())
+
+
+def test_audio_pt_follows_answer():
+    from selkies_tpu.transport.webrtc.peer import PeerConnection
+
+    async def scenario():
+        pc = PeerConnection(codec="h264", audio=True,
+                            loop=asyncio.get_event_loop())
+        answer = _answer(
+            ["m=video 9 UDP/TLS/RTP/SAVPF 96", "a=rtpmap:96 H264/90000"],
+            ["m=audio 9 UDP/TLS/RTP/SAVPF 63", "a=rtpmap:63 OPUS/48000/2"])
+        await pc.set_answer(answer)
+        assert pc.audio_pay.payload_type == 63
+        pkt = pc.audio_pay.payload_packet(b"\x01\x02", 0)
+        assert pkt.payload_type == 63
+        pc.close()
+
+    _run(scenario())
+
+
+def test_parse_answer_extracts_audio_pt():
+    r = sdp.parse_answer(_answer(
+        ["m=video 9 UDP/TLS/RTP/SAVPF 96", "a=rtpmap:96 H264/90000"],
+        ["m=audio 9 UDP/TLS/RTP/SAVPF 111", "a=rtpmap:111 opus/48000/2"]))
+    assert r.video_pt == 96
+    assert r.audio_pt == 111
+
+
+def test_answer_without_renumber_keeps_offer_pt():
+    from selkies_tpu.transport.webrtc.peer import PeerConnection
+
+    async def scenario():
+        pc = PeerConnection(codec="vp9", audio=False,
+                            loop=asyncio.get_event_loop())
+        answer = _answer([
+            f"m=video 9 UDP/TLS/RTP/SAVPF {sdp.VIDEO_PT}",
+            f"a=rtpmap:{sdp.VIDEO_PT} VP9/90000",
+        ])
+        await pc.set_answer(answer)
+        assert pc.video_pay.payload_type == sdp.VIDEO_PT
+        pc.close()
+
+    _run(scenario())

@@ -1,0 +1,75 @@
+#!/usr/bin/env python3
+"""Static pass: every registry encoder row must declare a codec that
+maps to an RTP payloader.
+
+Per-client negotiation (signalling/negotiate.py) resolves a preference
+list to a registry row and then to a payloader by the row's declared
+codec; a row without one can be configured but never negotiated, and a
+declared codec without a payloader mapping is a session that connects
+and then streams nothing.  This check (run from tier-1 via
+tests/test_codec_rows.py, like check_env_knobs.py and
+check_metric_docs.py) asserts, for every registered factory AND every
+alias:
+
+* the row declares a codec (``@register(name, codec=...)``);
+* the codec maps to a payloader class (``registry.payloader_for_codec``)
+  that actually imports and exposes ``payload_au``;
+* the codec is representable in SDP (``transport/webrtc/sdp.py``'s
+  CODEC_RTPMAP), so the negotiated row can be offered.
+
+Usage: python tools/check_codec_rows.py [repo_root]   (exit 1 on violation)
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def check(root: str = ".") -> list[str]:
+    sys.path.insert(0, root)
+    from selkies_tpu.models import registry
+    from selkies_tpu.transport.webrtc import sdp
+
+    problems = []
+    for name in registry.supported_encoders():
+        codec = registry.codec_for_encoder(name)
+        if not codec:
+            problems.append(
+                f"encoder row {name!r} declares no codec — add "
+                f"codec=... to its @register decorator")
+            continue
+        try:
+            pay = registry.payloader_for_codec(codec)
+        except ValueError:
+            problems.append(
+                f"encoder row {name!r} declares codec {codec!r}, which "
+                f"maps to no payloader (registry._PAYLOADERS)")
+            continue
+        if not callable(getattr(pay, "payload_au", None)):
+            problems.append(
+                f"payloader {pay.__name__} for codec {codec!r} has no "
+                f"payload_au entry point")
+        if codec not in sdp.CODEC_RTPMAP:
+            problems.append(
+                f"codec {codec!r} (row {name!r}) is missing from "
+                f"transport/webrtc/sdp.py CODEC_RTPMAP — it cannot be "
+                f"offered")
+    return problems
+
+
+def main(root: str = ".") -> int:
+    problems = check(root)
+    if problems:
+        print("check_codec_rows: registry codec rows and payloaders "
+              "disagree.\n")
+        print("\n".join(problems))
+        return 1
+    from selkies_tpu.models import registry
+
+    print(f"check_codec_rows: OK ({len(registry.supported_encoders())} "
+          f"rows map to payloaders)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else "."))
