@@ -275,6 +275,207 @@ def bench_full_encoder(w: int = W, h: int = H) -> tuple[float, dict] | None:
     return ITERS / dt, means
 
 
+# ---------------------------------------------------------------------------
+# scenario bench suite (ROADMAP item 5 / docs/policy.md): per-workload
+# rows instead of the single desktop trace, so every future PR reports
+# fps / latency / link bytes PER SCENARIO — and the policy engine's
+# per-scenario wins are measurable against the static defaults.
+# ---------------------------------------------------------------------------
+
+SCENARIOS = ("idle", "typing", "scroll", "window_drag", "video", "game")
+SCENARIO_FPS = 60.0  # paced tick rate: latency percentiles are only
+                     # meaningful against the cadence a live session has
+
+
+def _scenario_trace(name: str, n: int, w: int, h: int,
+                    seed: int = 11) -> list[np.ndarray]:
+    """Synthetic per-scenario frame traces (BGRx uint8), deterministic.
+
+    idle         static desktop, cursor blink every 30 frames
+    typing       a new 12-row glyph line every 3rd frame (~20 cps)
+    scroll       full-width texture region scrolling 16 rows/frame
+                 (pipeline/elements.scroll_trace — the tile-cache
+                 headline case)
+    window_drag  a tile-periodic window sliding one tile/frame
+                 (window_move_trace)
+    video        a centered half-size region with new content every
+                 OTHER frame (30 fps playback on a 60 fps tick)
+    game         full-frame motion every frame
+    """
+    from selkies_tpu.pipeline.elements import scroll_trace, window_move_trace
+
+    if name == "scroll":
+        return scroll_trace(w, h, n, bands=8, seed=seed)
+    if name == "window_drag":
+        return window_move_trace(w, h, n, seed=seed)
+    rng = np.random.default_rng(seed)
+    base = np.full((h, w, 4), 230, np.uint8)
+    base[: h // 10] = (70, 60, 60, 0)
+    frames: list[np.ndarray] = []
+    if name == "idle":
+        cur = base.copy()
+        for i in range(n):
+            if i % 30 == 0:
+                on = (i // 30) % 2
+                cur[h // 2 : h // 2 + 12, w // 4 : w // 4 + 12] = (
+                    (0, 0, 0, 0) if on else (230, 230, 230, 0))
+            frames.append(cur.copy())
+        return frames
+    if name == "typing":
+        cur = base.copy()
+        line_w = min(w - 64, 1024)
+        for i in range(n):
+            if i % 3 == 0:
+                row = h // 4 + ((i // 3) * 16) % (h // 2)
+                glyphs = rng.integers(0, 2, (12, line_w // 6 + 1),
+                                      np.uint8) * 255
+                line = np.kron(glyphs, np.ones((1, 6), np.uint8))[:, :line_w]
+                cur[row : row + 12, 32 : 32 + line_w, :3] = line[..., None]
+            frames.append(cur.copy())
+        return frames
+    if name == "video":
+        # sliding window over a long random strip: content NEVER repeats
+        # (np.roll would cycle within the trace, letting the tile cache
+        # remap a "video" — unrealistically)
+        rh, rw = (h // 2) // 16 * 16, (w // 2) // 16 * 16
+        y0, x0 = (h - rh) // 2 // 16 * 16, (w - rw) // 2 // 16 * 16
+        strip = rng.integers(0, 255, (rh, rw + 24 * (n // 2 + 1), 4),
+                             np.uint8)
+        cur = base.copy()
+        for i in range(n):
+            if i % 2 == 0:
+                off = 24 * (i // 2)
+                cur[y0 : y0 + rh, x0 : x0 + rw] = strip[:, off : off + rw]
+            frames.append(cur.copy())
+        return frames
+    if name == "game":
+        world = rng.integers(0, 255, (h, w, 4), np.uint8)
+        for i in range(n):
+            f = np.roll(world, 40 * i, axis=1)
+            # fresh per-frame band: the roll alone would repeat the
+            # exact frame every w/gcd(40,w) ticks
+            f[:16] = rng.integers(0, 255, (16, w, 4), np.uint8)
+            x = (i * 48) % (w - 64)
+            f[h // 3 : h // 3 + 64, x : x + 64] = (250, 40, 40, 0)
+            frames.append(f)
+        return frames
+    raise SystemExit(f"unknown scenario {name!r} (one of {SCENARIOS})")
+
+
+def bench_scenario(name: str, w: int, h: int, n: int,
+                   policy_on: bool) -> dict:
+    """One scenario row: drive the production encoder over the scenario
+    trace at a paced 60 fps tick, twice — an untimed SETTLE pass (the
+    policy classifies, transitions and pays any knob-change compile
+    there) and a TIMED pass measuring the settled steady state. The
+    row therefore compares postures, not transition costs."""
+    from selkies_tpu.models.h264.encoder import TPUH264Encoder
+    from selkies_tpu.models.registry import (
+        default_frame_batch, default_pipeline_depth)
+
+    enc = TPUH264Encoder(w, h, qp=28,
+                         frame_batch=min(12, default_frame_batch()),
+                         pipeline_depth=default_pipeline_depth())
+    runtime = None
+    pending: list = []
+    if policy_on:
+        from selkies_tpu.policy import (
+            EncoderActuator, PolicyEngine, PolicyRuntime, preset_from_env)
+
+        engine = PolicyEngine(session="bench", preset=preset_from_env())
+        runtime = PolicyRuntime(engine, EncoderActuator(
+            lambda: enc, drain=lambda: pending.extend(enc.flush())))
+
+    def run_pass(chunk) -> dict:
+        submit_t: dict[int, float] = {}
+        lats: list[float] = []
+        active_lats: list[float] = []
+        sums = {k: 0.0 for k in ("device_ms", "pack_ms", "unpack_ms",
+                                 "cavlc_ms", "upload_ms", "step_ms",
+                                 "fetch_ms")}
+        modes: dict[str, int] = {}
+        done = 0
+
+        def _account(outs) -> None:
+            nonlocal done
+            now = time.perf_counter()
+            for _au, stats, meta in outs:
+                done += 1
+                lat = (now - submit_t.pop(meta)) * 1e3
+                lats.append(lat)
+                # active = the frame carried new content to the client
+                # (statics are ~0 ms host-side all-skips and would bury
+                # the percentiles that matter for interactivity)
+                if getattr(stats, "upload_kind", "") != "static":
+                    active_lats.append(lat)
+                for k in sums:
+                    sums[k] += getattr(stats, k, 0.0)
+                m = getattr(stats, "downlink_mode", "") or "none"
+                modes[m] = modes.get(m, 0) + 1
+
+        lb0 = enc.link_bytes.snapshot()
+        t0 = time.perf_counter()
+        next_tick = t0
+        last_tick = t0
+        for i, frame in enumerate(chunk):
+            now = time.perf_counter()
+            if now < next_tick:
+                time.sleep(next_tick - now)
+            now = time.perf_counter()
+            next_tick = max(next_tick + 1.0 / SCENARIO_FPS,
+                            now - 0.5 / SCENARIO_FPS)
+            submit_t[i] = time.perf_counter()
+            outs = enc.submit(frame, None, i)
+            _account(outs)
+            if runtime is not None:
+                runtime.tick([s for _, s, _ in outs],
+                             interval_ms=(now - last_tick) * 1e3)
+                last_tick = now
+                if pending:  # an actuation drained in-flight frames
+                    _account(pending)
+                    pending.clear()
+        _account(enc.flush())
+        dt = time.perf_counter() - t0
+        lb1 = enc.link_bytes.snapshot()
+        assert done == len(chunk), f"lost frames: {done}/{len(chunk)}"
+        up = sum(v - lb0.get(k, 0) for k, v in lb1.items()
+                 if k.startswith("up_"))
+        down = sum(v - lb0.get(k, 0) for k, v in lb1.items()
+                   if k.startswith("down_"))
+        lats.sort()
+        active_lats.sort()
+        pct = active_lats or lats
+        row = {k: v / done for k, v in sums.items()}
+        row["fps"] = done / dt
+        row["p50_latency_ms"] = pct[len(pct) // 2]
+        row["p95_latency_ms"] = pct[int(len(pct) * 0.95)]
+        row["active_frames"] = len(active_lats)
+        row["bytes_up_per_frame"] = up / done
+        row["bytes_down_per_frame"] = down / done
+        row["downlink_mode"] = modes
+        return row
+
+    # two independently-seeded trace halves: the settle pass classifies
+    # + actuates + compiles, the timed pass measures steady state over
+    # FRESH content — a content-addressed cache only gets the hits the
+    # scenario legitimately produces (replaying the settle frames would
+    # make everything pool-resident by pass 2), and only one pass's
+    # frames are resident at a time (a 1080p trace is ~2 GB per pass)
+    settle = _scenario_trace(name, n, w, h, seed=11)
+    run_pass(settle)
+    del settle
+    row = run_pass(_scenario_trace(name, n, w, h, seed=12))
+    if runtime is not None:
+        st = runtime.engine.stats()
+        row["policy_scenario"] = st["scenario"]
+        row["policy_transitions"] = sum(st["transitions"].values())
+        row["policy_disarmed"] = st["disarmed"]
+    enc.close()
+    row["scenario"] = name
+    row["policy"] = int(policy_on)
+    return row
+
+
 def bench_codec_encoder(codec: str, w: int = W, h: int = H) -> tuple[float, dict] | None:
     """Per-codec row for the --codec sweep: the encoder the registry
     would negotiate for `codec` (signalling/negotiate.py) driven over
@@ -355,6 +556,21 @@ def main() -> int:
              "Default: 1080p plus a 4K row on a real TPU backend (4K on "
              "the CPU backend takes minutes, so CI runs stay 1080p-only)")
     ap.add_argument(
+        "--scenario", default=None,
+        help="comma-separated scenario sweep (or 'all'): "
+             f"{', '.join(SCENARIOS)}. One JSON row per scenario at the "
+             "first --resolution: fps, p50/p95 capture->deliver latency, "
+             "bytes_up/down_per_frame, stage split. Runs INSTEAD of the "
+             "flagship desktop row (docs/policy.md)")
+    ap.add_argument(
+        "--scenario-frames", type=int, default=240,
+        help="frames per scenario pass (two passes run: settle + timed)")
+    ap.add_argument(
+        "--policy", type=int, choices=(0, 1), default=None,
+        help="scenario suite only: 1 drives the scenario-adaptive policy "
+             "engine (selkies_tpu/policy), 0 static default knobs. "
+             "Default follows SELKIES_POLICY")
+    ap.add_argument(
         "--codec", default=None,
         help="comma-separated codec sweep (h264,av1,vp9,...): one JSON "
              "line per codec at each --resolution, from the encoder row "
@@ -369,6 +585,24 @@ def main() -> int:
 
         args.resolution = ("1080p,4k" if jax.default_backend() == "tpu"
                            else "1080p")
+    if args.scenario:
+        from selkies_tpu.policy import policy_enabled
+
+        names = ([*SCENARIOS] if args.scenario.strip().lower() == "all"
+                 else [s.strip().lower() for s in args.scenario.split(",")
+                       if s.strip()])
+        policy_on = (policy_enabled() if args.policy is None
+                     else bool(args.policy))
+        label, w, h = _parse_resolutions(args.resolution)[0]
+        for name in names:
+            row = bench_scenario(name, w, h, max(60, args.scenario_frames),
+                                 policy_on)
+            fps = row.pop("fps")
+            row["resolution"] = label
+            _result(f"scenario {name} {label} encode "
+                    f"({'policy' if policy_on else 'static'})", fps,
+                    unit=f"fps@{label}", **row)
+        return 0
     codecs = [c.strip().lower() for c in (args.codec or "h264").split(",")
               if c.strip()]
     ran = False

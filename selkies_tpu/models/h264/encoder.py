@@ -482,6 +482,11 @@ class _Pending:
     up_ms: float = 0.0
     t_disp: float = 0.0
     scene_cut: bool = False  # full-frame change transition (rate control)
+    # dirty-tile accounting for the scenario policy signals
+    # (FrameStats.upload_kind/dirty_frac/remap_frac): pixel-upload tiles
+    # and tile-cache remap pairs of a delta frame
+    n_up: int = 0
+    n_remap: int = 0
     # LTR scene cache slice-header flags (bitstream.write_slice_header):
     ltr_ref: int | None = None   # predict from long-term reference j
     mark_ltr: int | None = None  # mark the previous frame as LT index k
@@ -665,8 +670,15 @@ class TPUH264Encoder:
         self._batch_sizes = tuple(
             sorted({self.frame_batch, max(2, self.frame_batch // 2)}, reverse=True)
         ) if self.frame_batch > 1 else ()
+        # live policy cap on the effective group size (set_batch_cap):
+        # <= frame_batch, snapped to a compiled scan size; the default
+        # (== frame_batch) is byte- and behavior-identical to the
+        # pre-policy encoder
+        self._batch_cap = self.frame_batch
         self._batch_pend: list = []  # (rec, yb, ub, vb, idx) to group-dispatch
         ntx = self._pad_w // self._tile_w
+        # total delta tiles in the frame (policy dirty_frac denominator)
+        self._ntiles = (self._pad_h // 16) * ntx
         # delta bucket sizes: dirty-tile counts round up to one of these so
         # each resolution compiles a handful of scatter executables; frames
         # dirtier than the largest bucket use the full-upload path (the
@@ -826,6 +838,111 @@ class TPUH264Encoder:
 
     def force_keyframe(self) -> None:
         self._force_idr = True
+
+    # -- policy actuation (selkies_tpu/policy): runtime-safe retunes ---
+
+    def set_tile_cache(self, enabled: bool) -> bool:
+        """Runtime uplink tile-cache toggle (policy actuation); returns
+        True when the state changed. Byte-safe at any frame boundary:
+        a remap reproduces the exact pixels an upload would (PR 1's
+        bit-exactness contract), so the encoded stream is identical
+        with the cache on or off. Only togglable when the cache
+        machinery was built (slots > 0 at construction — the compiled
+        scatter ladder and device pool shapes are sized then).
+        Re-enabling starts from an EMPTY cache: while classification
+        bypassed it the device pool went stale, and a stale host entry
+        would remap garbage pixels."""
+        enabled = bool(enabled)
+        if self.tile_cache_slots <= 0 or not self._delta_buckets:
+            return False
+        if enabled == (self._tcache is not None):
+            return False
+        # pending group payloads were split for the OLD mode (with the
+        # cache the tuple carries pool_dst/pairs): dispatch them first
+        self._flush_batch()
+        self._tcache = (
+            TileCache(self.height, self.width, self._tile_w,
+                      self.tile_cache_slots)
+            if enabled else None)
+        self._pool_d = None
+        return True
+
+    def set_batch_cap(self, cap: int) -> bool:
+        """Cap the effective grouped-dispatch size (policy actuation);
+        returns True when it changed. The cap snaps DOWN to an
+        already-compiled scan size (1, frame_batch//2, frame_batch —
+        _flush_batch's greedy ladder), so no policy flap can trigger a
+        group-scan compile. Byte-safe at any frame boundary: grouped
+        and single delta dispatches are byte-identical
+        (tests/test_sparse_native_pack.py). Cap 1 dispatches every
+        delta immediately — the latency posture: a frame never waits
+        for group members that are whole capture intervals away."""
+        cap = max(1, min(int(cap), self.frame_batch))
+        sizes = (1,) + tuple(self._batch_sizes)
+        cap = max(s for s in sizes if s <= cap)
+        if cap == self._batch_cap:
+            return False
+        self._batch_cap = cap
+        if len(self._batch_pend) >= cap:
+            self._flush_batch()
+        return True
+
+    def retune_entropy(self, device_entropy: bool | None = None,
+                       bits_min_mbs: int | None = None) -> bool:
+        """Re-resolve the device-entropy downlink decision at runtime
+        (policy actuation); returns True when anything changed. Bytes
+        are identical either way (tests/test_device_entropy_sparse.py)
+        — what changes is the DOWNLINK: busy frames ship final slice
+        bits instead of multi-MB coefficient rows (PR 7). Expensive:
+        the delta-scatter partials close over the entropy consts, so
+        they are rebuilt and recompile on next use — the policy
+        engine's dwell is what keeps this off the flap path. The
+        caller must have NO frames in flight (the in-flight frames'
+        completion reads the downlink sizing being replaced); the
+        policy actuator drains the pipeline first."""
+        if self._prep is None:  # device-convert mode has no entropy path
+            return False
+        de, bm, bw, ent = resolve_entropy(
+            self._mbh * self._mbw, device_entropy, bits_min_mbs)
+        if de == self.device_entropy and bm == self.bits_min_mbs:
+            return False
+        if ent == self._entropy and bw == self._bits_words:
+            # threshold bookkeeping with the device coder disabled (or
+            # consts unchanged): no jitted partial closes over it, so
+            # nothing to rebuild and no flush needed
+            self.device_entropy, self.bits_min_mbs = de, bm
+            return True
+        if self._inflight or self._batch_pend:
+            raise RuntimeError(
+                "retune_entropy with frames in flight; flush first")
+        self.device_entropy, self.bits_min_mbs = de, bm
+        self._bits_words, self._entropy = bw, ent
+        _consts = dict(nscap=self._nscap, cap=self._cap_delta,
+                       tile_w=self._tile_w, density=self._density,
+                       entropy=self._entropy)
+        self._step_scatter_p = jax.jit(
+            partial(_p_scatter_step, **_consts),
+            donate_argnums=(2, 3, 4, 5, 6, 7))
+        self._step_scatter_pk = jax.jit(
+            partial(_p_scatter_multi_step, **_consts),
+            donate_argnums=(3, 4, 5, 6, 7, 8))
+        self._step_scatter_ltr = jax.jit(partial(_p_scatter_step, **_consts))
+        self._step2_cache.clear()
+        # downlink sizing tracks the fused-buffer layout
+        if self._entropy is not None:
+            self._pfx_total = p_sparse_entropy_words(
+                self._mbh, self._mbw, self._nscap, self._cap_delta,
+                self._density is not None, self._bits_words)
+        elif self._density is not None:
+            self._pfx_total = p_sparse_packed_words(
+                self._mbh, self._mbw, self._nscap, self._cap_delta)
+        else:
+            self._pfx_total = p_sparse_var_words(
+                self._mbh, self._mbw, self._nscap, self._cap_delta)
+        with self._pfx_lock:
+            self._pfx_recent.clear()
+            self._pfx_hint = min(self._pfx_small, self._pfx_total)
+        return True
 
     # -- frame classification (static / delta / full upload) -----------
 
@@ -1582,9 +1699,13 @@ class TPUH264Encoder:
                 frame_num=self._frames_since_idr % 256, idr_pic_id=0,
                 t0=t0, t1=0.0, meta=meta, mark_ltr=mark_ltr,
                 mmco_evict=mmco_evict,
+                n_up=len(up_idx),
+                n_remap=len(pairs) if pairs is not None else 0,
             )
             self._batch_pend.append((rec, yb, ub, vb, up_idx, pool_dst, pairs))
-            batch_full = len(self._batch_pend) >= self.frame_batch
+            # the policy batch cap (set_batch_cap) bounds the group; its
+            # default is frame_batch, the pre-policy behavior
+            batch_full = len(self._batch_pend) >= self._batch_cap
         else:
             try:
                 # dispatch order must match frame order: drain any pending
@@ -1618,6 +1739,7 @@ class TPUH264Encoder:
                     self._force_idr = False
                 else:
                     ltr_ref = None
+                    n_up = n_remap = 0
                     if ltr_hit is not None:
                         # scene restore: a few tiles against the slot's
                         # long-term reference instead of a full-frame
@@ -1627,12 +1749,17 @@ class TPUH264Encoder:
                         )
                         pk, words_d = "pd", None
                         ltr_ref = ltr_hit[0]
+                        n_up = len(ltr_hit[1])
                         self.ltr_restores += 1
                     elif kind == "delta":
                         prefix_d, hdr_d, buf_d, ry, ru, rv = self._run_step_delta(
                             frame, dirty_idx, idr=False
                         )
                         pk, words_d = "pd", None
+                        if isinstance(dirty_idx, tuple):  # tile-cache split
+                            n_up, n_remap = len(dirty_idx[0]), len(dirty_idx[2])
+                        else:
+                            n_up = len(dirty_idx)
                     else:
                         (pk, prefix_d, words_d, hdr_d, buf_d, ry, ru, rv) = (
                             self._run_step_p(frame)
@@ -1646,6 +1773,7 @@ class TPUH264Encoder:
                         t0=t0, t1=0.0, meta=meta,
                         prefix_d=prefix_d, buf_d=buf_d, hdr_d=hdr_d,
                         words_d=words_d, scene_cut=scene_cut,
+                        n_up=n_up, n_remap=n_remap,
                         ltr_ref=ltr_ref, mark_ltr=mark_ltr,
                         mmco_evict=mmco_evict,
                     )
@@ -1767,6 +1895,7 @@ class TPUH264Encoder:
                 bytes=len(au), device_ms=(rec.t1 - rec.t0) * 1e3,
                 pack_ms=0.0,
                 skipped_mbs=(self._pad_h // 16) * (self._pad_w // 16),
+                upload_kind="static",
             )
             self.last_stats = stats
             return au, stats, rec.meta
@@ -1786,6 +1915,10 @@ class TPUH264Encoder:
             self._batch_pend.clear()
             self._reset_tile_cache()
             raise
+        # upload classification signals for the policy engine: "pd" was
+        # a tile delta (dirty = uploads + remaps), everything else that
+        # reached the device was a full-frame upload
+        dirty = rec.n_up + rec.n_remap
         stats = FrameStats(
             frame_index=rec.frame_index, idr=rec.kind == "i", qp=rec.qp,
             bytes=len(au), device_ms=(t1 - rec.t0) * 1e3,
@@ -1794,6 +1927,11 @@ class TPUH264Encoder:
             unpack_ms=(tu - t1) * 1e3, cavlc_ms=(t2 - tu) * 1e3,
             upload_ms=rec.up_ms, step_ms=step_ms, fetch_ms=fetch_ms,
             downlink_mode=mode,
+            upload_kind="delta" if rec.kind == "pd" else "full",
+            dirty_frac=(min(1.0, dirty / self._ntiles)
+                        if rec.kind == "pd" else 1.0),
+            remap_frac=(rec.n_remap / dirty
+                        if rec.kind == "pd" and dirty else 0.0),
         )
         self.last_stats = stats
         return au, stats, rec.meta

@@ -66,6 +66,17 @@ class FrameStats:
     bands: int = 1
     cols: int = 1
     band_step_ms: tuple = ()
+    # upload-side classification signals for the scenario policy engine
+    # (selkies_tpu/policy): upload_kind is the encoder's own frame
+    # class ("static" byte-identical capture / "delta" tile upload /
+    # "full" whole-frame upload; "" for rows without the attribution),
+    # dirty_frac the dirty-tile fraction of the frame (1.0 for full
+    # uploads), remap_frac the fraction of those dirty tiles served as
+    # tile-cache remaps instead of pixel uploads. Metadata only — never
+    # feeds back into the encoded bytes.
+    upload_kind: str = ""
+    dirty_frac: float = 0.0
+    remap_frac: float = 0.0
     # which payload the P downlink shipped (ISSUE 7 / PERF.md round 9):
     # "coeff" sparse coefficient rows, "bits" device-entropy slice bits,
     # "dense" a dense-fallback fetch; "" for frames with no downlink

@@ -123,6 +123,19 @@ METRIC_FAMILIES: dict[str, str] = {
         "Sessions currently negotiated per codec (h264/av1/vp9/...), "
         "labeled by codec — per-client negotiation is "
         "signalling/negotiate.py",
+    "selkies_policy_scenario":
+        "Scenario the policy engine currently classifies a session as "
+        "(selkies_tpu/policy): 1 for the active scenario, 0 otherwise, "
+        "labeled by session and scenario (idle/typing/scroll/drag/video/"
+        "game/unknown, plus the 'congested' link overlay)",
+    "selkies_policy_transitions_total":
+        "Policy scenario transitions, labeled by session and the "
+        "scenario transitioned INTO ('congested' and 'disarmed' count "
+        "the overlay and the wedged-engine fallback)",
+    "selkies_policy_actuations_total":
+        "Encoder knob retunes the policy engine applied, labeled by "
+        "session and knob (tile_cache/batch_cap/device_entropy/"
+        "keyframe_interval)",
 }
 
 # canonical label names per family (order fixed for the Prometheus
@@ -148,6 +161,9 @@ _FAMILY_LABELS: dict[str, tuple[str, ...]] = {
     "selkies_placement_chips": ("state",),
     "selkies_drain_state": (),
     "selkies_codec_sessions": ("codec",),
+    "selkies_policy_scenario": ("session", "scenario"),
+    "selkies_policy_transitions_total": ("session", "scenario"),
+    "selkies_policy_actuations_total": ("session", "knob"),
 }
 
 _HIST_BUCKETS: dict[str, tuple[float, ...]] = {

@@ -33,6 +33,12 @@ black-box bundles stay greppable):
     encode        synchronous encode_frame path (non-pipelined rows)
     send          sink callback (transport handoff) per access unit
     frame-drop    instant: capture tick skipped (transport backpressure)
+    policy        one scenario-policy evaluation (selkies_tpu/policy):
+                  signal observe + classify + any knob actuation this
+                  tick applied — the fleet emits the same span around
+                  its per-slot policy pass in _encode_tick, so a slow
+                  actuation (the device-entropy retune recompile) is
+                  attributable on the timeline
   encoder completion workers (models/h264/encoder.py, parallel/bands.py):
     step          dispatch → device outputs ready (block_until_ready on
                   the frame's — or one BAND's — downlink buffer; with

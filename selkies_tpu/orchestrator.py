@@ -375,7 +375,22 @@ class Orchestrator:
         self.drainer = DrainController(
             "solo", force_idr=self.app.force_keyframe,
             flush=self._drain_flush, on_drained=self._drain_exit)
+        self._last_rtt_ms = 0.0
         self._wire_callbacks()
+        # scenario-policy congestion signals (selkies_tpu/policy): the
+        # engine reads the GCC estimate/loss and the ping-channel RTT to
+        # tell a link bottleneck from an encoder one (docs/policy.md)
+        if self.app.policy_engine is not None and self.gcc is not None:
+            self.app.policy_engine.congestion = self._policy_congestion
+
+    def _policy_congestion(self) -> dict:
+        g = self.gcc
+        return {
+            "rtt_ms": self._last_rtt_ms,
+            "loss": getattr(g, "last_loss", 0.0),
+            "target_kbps": g.estimate_kbps,
+            "min_kbps": g.min_kbps,
+        }
 
     async def _drain_flush(self) -> None:
         """Wait for one post-flag IDR to actually REACH the client (the
@@ -522,6 +537,7 @@ class Orchestrator:
 
     def _on_ping_response(self, latency_ms: float) -> None:
         self.metrics.set_latency(latency_ms)
+        self._last_rtt_ms = float(latency_ms)
         if telemetry.enabled:
             telemetry.gauge("selkies_congestion_rtt_ms", latency_ms,
                             session="0")

@@ -45,6 +45,7 @@ from selkies_tpu.input_host import HostInput
 from selkies_tpu.models.h264.ratecontrol import CbrRateController
 from selkies_tpu.monitoring import Metrics, SystemMonitor, TPUMonitor
 from selkies_tpu.monitoring.telemetry import telemetry
+from selkies_tpu.monitoring.tracing import tracer
 from selkies_tpu.pipeline.elements import EncodedFrame, SyntheticSource
 from selkies_tpu.resilience import SlotSupervisor, get_injector
 from selkies_tpu.signalling.client import (
@@ -268,6 +269,47 @@ class SessionFleet:
         self.on_slot_poisoned = self._default_poison
         self.supervisor = supervisor or SlotSupervisor(
             "fleet", _FleetRecovery(self), fps=float(fps))
+        # scenario-adaptive policy (selkies_tpu/policy, SELKIES_POLICY=1):
+        # one engine per SLOT — classification state is per session —
+        # actuating through whatever per-session encoder the live service
+        # exposes. Banded/codec-mesh sessions classify via the skip-
+        # fraction fallback (their FrameStats carry no upload
+        # attribution, hence the total_mbs plumbing) and actuate the
+        # knob subset their encoder exports; the LOCKSTEP batch service
+        # has no per-session encoder OR per-session stats, so its slots
+        # skip the policy tick entirely. Fault sites policy:<k>.
+        self.policies = None
+        from selkies_tpu.policy import policy_enabled
+
+        if policy_enabled():
+            from selkies_tpu.policy import (
+                EncoderActuator, PolicyEngine, PolicyRuntime,
+                preset_from_env)
+
+            total_mbs = ((height + 15) // 16) * ((width + 15) // 16)
+            preset = preset_from_env()
+            self.policies = [
+                PolicyRuntime(
+                    PolicyEngine(session=str(k), preset=preset,
+                                 total_mbs=total_mbs,
+                                 fault_site=f"policy:{k}"),
+                    EncoderActuator(lambda k=k: self._session_encoder(k)))
+                for k in range(self.n)
+            ]
+            telemetry.register_provider("policy", self._policy_rollup)
+
+    def _session_encoder(self, k: int):
+        """Session k's per-session encoder on the LIVE service, or None
+        (lockstep batch service / parked slot) — the policy actuator
+        resolves through this so supervisor service rebuilds are seen."""
+        encs = getattr(self.service, "encoders", None)
+        return encs[k] if encs is not None and k < len(encs) else None
+
+    def _policy_rollup(self) -> dict:
+        if self.policies is None:
+            return {}
+        return {str(k): rt.engine.stats()
+                for k, rt in enumerate(self.policies)}
 
     def _default_poison(self, k: int) -> None:
         logger.error("session %d ejected (persistent failures)", k)
@@ -613,6 +655,21 @@ class SessionFleet:
         # swap-safety rule above); stashed rather than returned so the
         # tuple callers keep their shape
         self._last_modes = list(getattr(service, "last_modes", ()))
+        if self.policies is not None:
+            # per-slot scenario policy: observe each session's frame
+            # signals and retune its encoder's runtime-safe knobs.
+            # PolicyRuntime.tick never raises (a wedged engine disarms
+            # to static knobs), so a policy fault can't poison the tick.
+            with tracer.span("policy"):
+                for k, rt in enumerate(self.policies):
+                    if not self.slots[k].connected or not aus[k]:
+                        continue
+                    enc = self._session_encoder(k)
+                    stats = (getattr(enc, "last_stats", None)
+                             if enc is not None else None)
+                    if stats is not None:
+                        rt.tick([stats],
+                                interval_ms=1000.0 / max(1.0, self.fps))
         return (aus, list(service.last_idrs), qps,
                 (time.perf_counter() - t0) * 1e3)
 

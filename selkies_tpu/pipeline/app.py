@@ -160,6 +160,40 @@ class TPUWebRTCApp:
 
         self.last_cursor_sent: Any = None
 
+        # scenario-adaptive policy engine (selkies_tpu/policy): one per
+        # app lifetime so classification state survives pipeline
+        # recycles; the runtime binding to the live pipeline/encoder is
+        # rebuilt in start_pipeline. Off (None) unless SELKIES_POLICY=1.
+        self.policy_engine = None
+        from selkies_tpu.policy import (
+            PolicyEngine, policy_enabled, preset_from_env)
+
+        if policy_enabled():
+            from selkies_tpu.policy import EncoderActuator
+
+            self.policy_engine = PolicyEngine(
+                session="0", preset=preset_from_env(),
+                # skip-fraction fallback denominator for encoder rows
+                # without upload attribution (banded SELKIES_BANDS rows)
+                total_mbs=((self.source.height + 15) // 16)
+                * ((self.source.width + 15) // 16))
+            # sustained link congestion sheds BYTES (the PR 2 resolution
+            # rung) before anything touches the tick rate
+            self.policy_engine.on_link_pressure = self._policy_link_degrade
+            self.policy_engine.on_link_relief = self._policy_link_undegrade
+            # ONE actuator for the app's lifetime, like the engine: a
+            # pipeline restart reuses the live (possibly actuated)
+            # encoder, and a fresh actuator would capture those knobs
+            # as "constructed defaults" — poisoning every later plan
+            # merge and the disarm restore contract. The closure reads
+            # the encoder THROUGH the pipeline so swaps/rebuilds are
+            # picked up (refresh re-captures from the NEW object).
+            self.policy_actuator = EncoderActuator(
+                lambda: (self.pipeline.encoder
+                         if self.pipeline is not None else self.encoder),
+                drain=self._policy_drain)
+            telemetry.register_provider("policy", self._policy_stats)
+
         # /statz live read-side: the encoder's link-byte counters (reads
         # through self.encoder so supervisor swaps/rebuilds stay covered)
         # and the pipeline's frame/drop accounting
@@ -210,6 +244,11 @@ class TPUWebRTCApp:
         )
         self.pipeline.on_geometry_change = self._rebuild_encoder
         self.pipeline.supervisor = self.supervisor
+        if self.policy_engine is not None:
+            from selkies_tpu.policy import PolicyRuntime
+
+            self.pipeline.policy = PolicyRuntime(
+                self.policy_engine, self.policy_actuator)
         await self.pipeline.start()
 
     async def stop_pipeline(self) -> None:
@@ -227,6 +266,44 @@ class TPUWebRTCApp:
     def _active_encoder_name(self) -> str:
         return (SOFTWARE_FALLBACK_ENCODER if self.software_fallback
                 else self.encoder_name)
+
+    # ------------------------------------------------------------------
+    # scenario-policy plumbing (selkies_tpu/policy, docs/policy.md)
+
+    def _policy_stats(self) -> dict:
+        eng = self.policy_engine
+        return {"0": eng.stats()} if eng is not None else {}
+
+    def _policy_drain(self) -> None:
+        """Actuator drain for the app-lifetime actuator: delivers the
+        LIVE pipeline's in-flight frames (no-op between sessions)."""
+        if self.pipeline is not None:
+            self.pipeline.drain_inflight()
+
+    def _policy_link_degrade(self) -> None:
+        """Congestion overlay: the link (not the encoder) is the
+        bottleneck, so step straight onto the PR 2 ladder's RESOLUTION
+        rung — a 2x DownscaleSource cuts the per-frame bytes ~4x while
+        the tick rate (interactivity) is untouched; fps-halving stays
+        the failure ladder's own move. No-op while the supervisor's
+        failure-driven degradation already owns the source: the two
+        controllers must not fight over it."""
+        if self.supervisor.degrade_level > 0:
+            return
+        pipe = self.pipeline
+        if pipe is not None and not isinstance(pipe.source, DownscaleSource):
+            logger.warning("policy: link congested — downscaling source "
+                           "(bytes shed before fps)")
+            pipe.source = DownscaleSource(self.source)
+
+    def _policy_link_undegrade(self) -> None:
+        if self.supervisor.degrade_level > 0:
+            return
+        pipe = self.pipeline
+        if pipe is not None and isinstance(pipe.source, DownscaleSource):
+            logger.info("policy: link recovered — restoring full "
+                        "resolution")
+            pipe.source = self.source
 
     def _rebuild_encoder(self, width: int, height: int):
         """Display geometry changed (xrandr resize): new encoder + SPS/PPS

@@ -177,6 +177,12 @@ class EncodedFrame:
     # P downlink payload mode ("coeff"/"bits"/"dense"; "" = no downlink
     # or unattributed) — see models/stats.FrameStats.downlink_mode
     downlink_mode: str = ""
+    # scenario-policy signals (models/stats.FrameStats): the encoder's
+    # upload class and dirty/remap tile fractions; metadata only
+    upload_kind: str = ""
+    dirty_frac: float = 0.0
+    remap_frac: float = 0.0
+    skipped_mbs: int = 0
     # telemetry correlation id assigned at capture (0 = telemetry off);
     # metadata only — never touches the encoded bytes
     frame_id: int = 0
@@ -235,6 +241,16 @@ class VideoPipeline:
         # timestamp we dispatched them with
         self.session = "0"
         self._fid_by_ts: dict[int, int] = {}
+        # optional scenario-policy runtime (selkies_tpu/policy), wired by
+        # TPUWebRTCApp when SELKIES_POLICY=1: observes every encoded
+        # frame and retunes the encoder's runtime-safe knobs. Its tick
+        # NEVER raises (a wedged engine disarms back to static knobs).
+        self.policy = None
+        self._last_tick_t = 0.0
+        # frames a policy drain completed on the to_thread worker; the
+        # loop delivers them right after the tick await (asyncio.Event
+        # is not thread-safe, so the worker never touches the outbox)
+        self._policy_drained: list[EncodedFrame] = []
 
     @property
     def running(self) -> bool:
@@ -352,52 +368,16 @@ class VideoPipeline:
                             telemetry.span("submit", fid, session=self.session):
                         done = await asyncio.to_thread(self.encoder.submit, frame, qp, ts)
                     efs = [
-                        EncodedFrame(
-                            au=au,
-                            timestamp_90k=meta,
-                            wall_time=time.time(),
-                            idr=stats.idr,
-                            qp=stats.qp,
-                            device_ms=stats.device_ms,
-                            pack_ms=stats.pack_ms,
-                            scene_cut=getattr(stats, "scene_cut", False),
-                            unpack_ms=getattr(stats, "unpack_ms", 0.0),
-                            cavlc_ms=getattr(stats, "cavlc_ms", 0.0),
-                            upload_ms=getattr(stats, "upload_ms", 0.0),
-                            step_ms=getattr(stats, "step_ms", 0.0),
-                            fetch_ms=getattr(stats, "fetch_ms", 0.0),
-                            bands=getattr(stats, "bands", 1),
-                            cols=getattr(stats, "cols", 1),
-                            downlink_mode=getattr(stats, "downlink_mode", ""),
-                            frame_id=self._fid_by_ts.pop(meta, 0),
-                        )
+                        self._ef_from_stats(au, stats, meta,
+                                            self._fid_by_ts.pop(meta, 0))
                         for au, stats, meta in done
                     ]
                 else:
                     with tracer.span("encode"), \
                             telemetry.span("encode", fid, session=self.session):
                         au = await asyncio.to_thread(self.encoder.encode_frame, frame, qp)
-                    stats = self.encoder.last_stats
-                    efs = [
-                        EncodedFrame(
-                            au=au,
-                            timestamp_90k=ts,
-                            wall_time=time.time(),
-                            idr=stats.idr,
-                            qp=stats.qp,
-                            device_ms=stats.device_ms,
-                            pack_ms=stats.pack_ms,
-                            unpack_ms=getattr(stats, "unpack_ms", 0.0),
-                            cavlc_ms=getattr(stats, "cavlc_ms", 0.0),
-                            upload_ms=getattr(stats, "upload_ms", 0.0),
-                            step_ms=getattr(stats, "step_ms", 0.0),
-                            fetch_ms=getattr(stats, "fetch_ms", 0.0),
-                            bands=getattr(stats, "bands", 1),
-                            cols=getattr(stats, "cols", 1),
-                            downlink_mode=getattr(stats, "downlink_mode", ""),
-                            frame_id=fid,
-                        )
-                    ]
+                    efs = [self._ef_from_stats(au, self.encoder.last_stats,
+                                               ts, fid)]
                 for ef in efs:
                     self.rc.update(len(ef.au), idr=ef.idr or ef.scene_cut)
                 self.frames += len(efs)
@@ -432,6 +412,83 @@ class VideoPipeline:
             self._outbox.extend(efs)
             if efs:
                 self._frame_ready.set()
+            if self.policy is not None and not self.policy.engine.dead:
+                # after the outbox extend so a policy-triggered drain
+                # (drain_inflight) queues NEWER frames behind this
+                # tick's, keeping the sender strictly in frame order.
+                # PolicyRuntime.tick never raises (and once the engine
+                # disarms, this block stops paying the per-frame thread
+                # hop). Off the event loop: an actuation drain blocks
+                # on in-flight device work (like every other encoder
+                # touch in this loop).
+                now = time.monotonic()
+                interval_ms = ((now - self._last_tick_t) * 1e3
+                               if self._last_tick_t else 0.0)
+                self._last_tick_t = now
+                with tracer.span("policy"):
+                    await asyncio.to_thread(self.policy.tick, efs,
+                                            interval_ms)
+                if self._policy_drained:
+                    self._outbox.extend(self._policy_drained)
+                    self._policy_drained.clear()
+                    self._frame_ready.set()
+
+    def _ef_from_stats(self, au: bytes, stats, ts: int,
+                       fid: int) -> EncodedFrame:
+        """One encoder completion -> EncodedFrame (shared by the
+        pipelined submit path, the synchronous encode path, and the
+        policy drain)."""
+        return EncodedFrame(
+            au=au,
+            timestamp_90k=ts,
+            wall_time=time.time(),
+            idr=stats.idr,
+            qp=stats.qp,
+            device_ms=stats.device_ms,
+            pack_ms=stats.pack_ms,
+            scene_cut=getattr(stats, "scene_cut", False),
+            unpack_ms=getattr(stats, "unpack_ms", 0.0),
+            cavlc_ms=getattr(stats, "cavlc_ms", 0.0),
+            upload_ms=getattr(stats, "upload_ms", 0.0),
+            step_ms=getattr(stats, "step_ms", 0.0),
+            fetch_ms=getattr(stats, "fetch_ms", 0.0),
+            bands=getattr(stats, "bands", 1),
+            cols=getattr(stats, "cols", 1),
+            downlink_mode=getattr(stats, "downlink_mode", ""),
+            upload_kind=getattr(stats, "upload_kind", ""),
+            dirty_frac=getattr(stats, "dirty_frac", 0.0),
+            remap_frac=getattr(stats, "remap_frac", 0.0),
+            skipped_mbs=getattr(stats, "skipped_mbs", 0),
+            frame_id=fid,
+        )
+
+    def drain_inflight(self) -> None:
+        """Complete every in-flight encoder frame — the policy
+        actuator's barrier before a knob retune that rebuilds
+        executables (EncoderActuator drain). Drained frames go through
+        the same rate-control / telemetry accounting as the tick path
+        and are staged in _policy_drained; the loop appends them to the
+        outbox right after the policy tick returns (BEHIND anything
+        already queued, so the P-chain reaches the client gapless and
+        in order). Runs on the policy tick's worker thread — it must
+        not touch the asyncio Event."""
+        enc = self.encoder
+        if not hasattr(enc, "flush"):
+            return
+        for au, stats, meta in enc.flush():
+            ef = self._ef_from_stats(au, stats, meta,
+                                     self._fid_by_ts.pop(meta, 0))
+            self.rc.update(len(ef.au), idr=ef.idr or ef.scene_cut)
+            self.frames += 1
+            if telemetry.enabled:
+                telemetry.frame_done(
+                    ef.frame_id, len(ef.au), idr=ef.idr,
+                    session=self.session, device_ms=ef.device_ms,
+                    pack_ms=ef.pack_ms, unpack_ms=ef.unpack_ms,
+                    cavlc_ms=ef.cavlc_ms, downlink_mode=ef.downlink_mode,
+                    bits_fetch_ms=(ef.fetch_ms
+                                   if ef.downlink_mode == "bits" else 0.0))
+            self._policy_drained.append(ef)
 
     async def _send_loop(self) -> None:
         while True:

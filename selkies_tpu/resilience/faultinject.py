@@ -11,6 +11,7 @@ Grammar (semicolon-separated rules)::
     rule   = site "@" sched ":" action
     site   = capture | encoder | send | signalling      (serving path)
            | admission | recarve | migrate | drain      (fleet lifecycle)
+           | policy                                     (scenario policy)
            (wired sites; names are free-form)
     sched  = tick list / ranges  "5,9,13" or "20-22" or "5,9,20-22"
            | "every:N"           every Nth call (1-based)
@@ -25,7 +26,12 @@ re-carve-during-encode that must leave the carve untouched);
 a kill-slot-mid-migration; the qualified form ``migrate:<k>`` targets
 one session); ``drain`` fires at drain start (``delay:<ms>`` stretches
 the preStop window toward its deadline, ``raise`` marks the drain
-failed while it still completes).
+failed while it still completes). ``policy`` fires inside the scenario
+policy engine's per-tick decide (selkies_tpu/policy; fleet slots are
+``policy:<k>``): ``flap`` forces a misclassification the hysteresis
+must absorb, ``drop`` skips the evaluation, and repeated ``raise``
+wedges the engine — which must DISARM back to static knobs instead of
+stalling the serving loop (tests/test_chaos.py).
 
 Examples::
 

@@ -153,6 +153,7 @@ class GccController:
         self._last_decrease_throughput: float | None = None
         self._last_increase_ms: float | None = None
         self._last_reported = float(start_kbps)
+        self.last_loss = 0.0  # policy-engine congestion signal
 
     def reset(self) -> None:
         """New client connection: the receive clock epoch changed
@@ -209,6 +210,9 @@ class GccController:
 
     def on_loss_report(self, fraction_lost: float) -> None:
         """Loss-based bound (only meaningful on lossy transports)."""
+        # last-reported loss fraction: the scenario policy engine reads
+        # it to tell a link bottleneck from an encoder one
+        self.last_loss = float(fraction_lost)
         if telemetry.enabled:
             telemetry.gauge("selkies_congestion_loss_ratio", fraction_lost,
                             session=self.session)

@@ -460,3 +460,57 @@ def test_fleet_sustained_failures_degrade_then_recover(loop, faults):
             await fleet.stop()
 
     loop.run_until_complete(scenario())
+
+
+# -- scenario policy: a wedged engine must not stall the serving loop --
+
+
+def test_wedged_policy_engine_degrades_to_static_knobs(
+        loop, faults, monkeypatch):
+    """Every policy evaluation raises (the `policy` fault site). The
+    runtime must disarm the engine after its failure budget and restore
+    the encoder's constructed static knobs — with the pipeline still
+    delivering frames throughout (docs/policy.md failure containment)."""
+    from selkies_tpu.pipeline.app import TPUWebRTCApp
+    from selkies_tpu.pipeline.elements import SyntheticSource
+
+    monkeypatch.setenv("SELKIES_POLICY", "1")
+    faults("policy@1-999:raise")
+
+    class FakeTransport:
+        def __init__(self):
+            self.frames = []
+            self.data_channel_ready = False
+
+        def send_data_channel(self, message):
+            pass
+
+        async def send_video(self, ef):
+            self.frames.append(ef)
+            return True
+
+    async def scenario():
+        transport = FakeTransport()
+        app = TPUWebRTCApp(
+            source=SyntheticSource(128, 96), transport=transport,
+            width=128, height=96, framerate=30, video_bitrate_kbps=500)
+        assert app.policy_engine is not None
+        await app.start_pipeline()
+        try:
+            ok = await wait_for(lambda: len(transport.frames) >= 10)
+            assert ok, len(transport.frames)
+            # the engine wedged and DISARMED instead of killing the loop
+            assert app.policy_engine.dead
+            assert app.supervisor.counters["failures"] == 0
+            assert app.pipeline is not None and app.pipeline.running
+            # static knobs: the encoder runs its constructed config
+            enc = app.pipeline.encoder
+            assert enc._batch_cap == enc.frame_batch
+            # and frames KEPT flowing after the disarm
+            n = len(transport.frames)
+            ok = await wait_for(lambda: len(transport.frames) >= n + 5)
+            assert ok, "pipeline stalled after policy disarm"
+        finally:
+            await app.stop_pipeline()
+
+    loop.run_until_complete(scenario())

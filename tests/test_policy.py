@@ -1,0 +1,361 @@
+"""Scenario policy engine (selkies_tpu/policy, docs/policy.md).
+
+Deterministic classifier tests replay recorded per-scenario signal
+traces and assert the expected class; hysteresis/dwell tests prove
+single-frame flaps and rapid alternation never transition; actuation
+tests prove every runtime knob retune is byte-identical on the live
+encoder (the byte-safety contract) and that a wedged engine disarms
+back to static knobs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from selkies_tpu.models.h264.encoder import TPUH264Encoder
+from selkies_tpu.policy import (
+    EncoderActuator,
+    KnobPlan,
+    PolicyEngine,
+    PolicyRuntime,
+    PRESETS,
+    Scenario,
+    plan_for,
+    policy_enabled,
+    preset_from_env,
+)
+from selkies_tpu.resilience import configure_faults, reset_faults
+
+W, H = 192, 128
+
+
+@pytest.fixture
+def faults():
+    yield configure_faults
+    reset_faults()
+
+
+# ---------------------------------------------------------------------------
+# recorded signal traces: (upload_kind, dirty_frac, remap_frac) per frame,
+# shaped like what the bench scenario generators actually produce
+# ---------------------------------------------------------------------------
+
+def _signals(name: str, n: int = 48):
+    out = []
+    for i in range(n):
+        if name == "idle":
+            out.append(("delta", 0.004, 0.0) if i % 30 == 0
+                       else ("static", 0.0, 0.0))
+        elif name == "typing":
+            out.append(("delta", 0.01, 0.0) if i % 3 == 0
+                       else ("static", 0.0, 0.0))
+        elif name == "typing_small_screen":  # one text line on 320x192
+            out.append(("delta", 0.07, 0.0) if i % 3 == 0
+                       else ("static", 0.0, 0.0))
+        elif name == "scroll":
+            out.append(("delta", 0.12, 0.92))
+        elif name == "drag":
+            out.append(("delta", 0.03, 0.95))
+        elif name == "video":  # 30 fps playback on a 60 fps tick
+            out.append(("delta", 0.25, 0.0) if i % 2 == 0
+                       else ("static", 0.0, 0.0))
+        elif name == "game":
+            out.append(("full", 1.0, 0.0))
+        else:
+            raise ValueError(name)
+    return out
+
+
+def _drive(engine: PolicyEngine, signals) -> list:
+    plans = []
+    for kind, dirty, remap in signals:
+        engine.observe(upload_kind=kind, dirty_frac=dirty, remap_frac=remap)
+        plan = engine.decide()
+        if plan is not None:
+            plans.append(plan)
+    return plans
+
+
+EXPECTED = {
+    "idle": Scenario.IDLE,
+    "typing": Scenario.TYPING,
+    "typing_small_screen": Scenario.TYPING,
+    "scroll": Scenario.SCROLL,
+    "drag": Scenario.DRAG,
+    "video": Scenario.VIDEO,
+    "game": Scenario.GAME,
+}
+
+
+@pytest.mark.parametrize("name", sorted(EXPECTED))
+def test_classifier_recorded_traces(name):
+    eng = PolicyEngine(confirm=4, dwell=0)
+    plans = _drive(eng, _signals(name))
+    assert eng.scenario is EXPECTED[name]
+    assert plans, "a transition must have produced a knob plan"
+    assert plans[-1].scenario == EXPECTED[name].value
+
+
+def test_skip_frac_fallback_rows_classify():
+    """Rows without upload attribution (banded/fleet encoders) classify
+    from the skip fraction."""
+    eng = PolicyEngine(confirm=4, dwell=0, total_mbs=1000)
+    for _ in range(48):
+        eng.observe(upload_kind="", skipped_mbs=100)  # 10% skipped: motion
+    eng.decide()
+    for _ in range(8):
+        eng.decide()
+    assert eng.scenario is Scenario.GAME
+    eng2 = PolicyEngine(confirm=4, dwell=0, total_mbs=1000)
+    for _ in range(48):
+        eng2.observe(upload_kind="", skipped_mbs=1000)
+        eng2.decide()
+    assert eng2.scenario is Scenario.IDLE
+
+
+def test_hysteresis_suppresses_single_frame_flap():
+    eng = PolicyEngine(confirm=6, dwell=0)
+    _drive(eng, _signals("typing"))
+    assert eng.scenario is Scenario.TYPING
+    # one scroll-looking frame inside steady typing: the window moves a
+    # little, the candidate (if any) never survives the confirm streak
+    flap = _signals("typing", 40)
+    flap[10] = ("delta", 0.12, 0.92)
+    plans = _drive(eng, flap)
+    assert eng.scenario is Scenario.TYPING
+    assert not plans
+
+
+def test_dwell_rate_limits_transitions():
+    eng = PolicyEngine(confirm=4, dwell=200)
+    _drive(eng, _signals("typing"))  # first transition: not dwell-gated
+    assert eng.scenario is Scenario.TYPING
+    # an immediate, sustained scenario change must wait out the dwell
+    plans = _drive(eng, _signals("game", 100))
+    assert eng.scenario is Scenario.TYPING
+    assert not plans
+    plans = _drive(eng, _signals("game", 150))
+    assert eng.scenario is Scenario.GAME
+    assert len(plans) == 1
+
+
+def test_presets_and_plan_merge():
+    assert set(PRESETS) == {"latency", "balanced", "throughput"}
+    for s in Scenario:
+        if s is Scenario.UNKNOWN:
+            continue
+        assert plan_for("latency", s).batch_cap == "min"
+        assert plan_for("throughput", s).batch_cap == "max"
+    video = plan_for("balanced", Scenario.VIDEO)
+    assert video.tile_cache is False and video.bits_min_mbs == 256
+    # the entropy MODE stays at the backend AUTO default (forcing it on
+    # a CPU backend measurably regresses fps and downlink bytes)
+    assert video.device_entropy is None
+    typing = plan_for("balanced", Scenario.TYPING)
+    assert typing.batch_cap == "min" and typing.tile_cache is True
+    # merged plans are ABSOLUTE: unset fields revert to the defaults
+    defaults = KnobPlan("defaults", tile_cache=True, batch_cap="max",
+                        device_entropy=False, bits_min_mbs=512,
+                        keyframe_interval=0)
+    m = typing.merged_over(defaults)
+    assert m.device_entropy is False and m.keyframe_interval == 0
+    assert m.batch_cap == "min"
+
+
+def test_env_knobs(monkeypatch):
+    monkeypatch.delenv("SELKIES_POLICY", raising=False)
+    assert not policy_enabled()
+    monkeypatch.setenv("SELKIES_POLICY", "1")
+    assert policy_enabled()
+    monkeypatch.setenv("SELKIES_POLICY", "0")
+    assert not policy_enabled()
+    monkeypatch.setenv("SELKIES_POLICY_PRESET", "latency")
+    assert preset_from_env() == "latency"
+    monkeypatch.setenv("SELKIES_POLICY_PRESET", "warp-speed")
+    assert preset_from_env() == "balanced"
+
+
+def test_congestion_overlay_enters_and_exits():
+    sig = {"loss": 0.0, "target_kbps": 2000.0, "min_kbps": 200.0}
+    eng = PolicyEngine(confirm=4, dwell=0, congestion=lambda: sig)
+    pressed, relieved = [], []
+    eng.on_link_pressure = lambda: pressed.append(1)
+    eng.on_link_relief = lambda: relieved.append(1)
+    from selkies_tpu.policy.engine import CONG_ENTER, CONG_EXIT
+
+    for _ in range(CONG_ENTER + 5):
+        eng.decide()
+    assert not pressed  # clean link: no overlay
+    sig["loss"] = 0.2
+    for _ in range(CONG_ENTER + 5):
+        eng.decide()
+    assert pressed == [1] and eng.congested
+    sig["loss"] = 0.0
+    for _ in range(CONG_EXIT + 5):
+        eng.decide()
+    assert relieved == [1] and not eng.congested
+    assert eng.transitions.get("congested") == 1
+
+
+def test_fault_flap_is_absorbed(faults):
+    """The `flap` action forces a misclassification for one evaluation;
+    the confirm streak must absorb it without a transition."""
+    faults("policy@30:flap")
+    eng = PolicyEngine(confirm=6, dwell=0)
+    plans = _drive(eng, _signals("typing", 64))
+    assert eng.scenario is Scenario.TYPING
+    assert [p.scenario for p in plans] == ["typing"]
+
+
+# ---------------------------------------------------------------------------
+# actuation against the real encoder
+# ---------------------------------------------------------------------------
+
+def _typing_frames(n=24, w=W, h=H):
+    rng = np.random.default_rng(3)
+    cur = np.full((h, w, 4), 230, np.uint8)
+    frames = []
+    for i in range(n):
+        if i % 3 == 0:
+            r = (i // 3 * 16) % (h - 16)
+            cur[r : r + 12, 16 : 80, :3] = rng.integers(
+                0, 255, (12, 64, 3), np.uint8)
+        frames.append(cur.copy())
+    return frames
+
+
+def _encode_all(enc, frames, actions=None):
+    out = []
+    for i, f in enumerate(frames):
+        if actions and i in actions:
+            for au, st, _ in enc.flush():
+                out.append((au, st))
+            actions[i](enc)
+        for au, st, _ in enc.submit(f, None, i):
+            out.append((au, st))
+    for au, st, _ in enc.flush():
+        out.append((au, st))
+    return out
+
+
+def test_runtime_knob_toggles_byte_identity():
+    """The byte-safety contract: tile cache, batch cap and the entropy
+    retune each produce byte-identical streams when toggled live (on a
+    trace whose upload classification they do not change)."""
+    frames = _typing_frames()
+    enc_a = TPUH264Encoder(W, H, qp=28, frame_batch=4, pipeline_depth=2)
+    base = _encode_all(enc_a, frames)
+    enc_a.close()
+    enc_b = TPUH264Encoder(W, H, qp=28, frame_batch=4, pipeline_depth=2)
+    toggled = _encode_all(enc_b, frames, {
+        5: lambda e: e.set_batch_cap(1),
+        9: lambda e: e.set_tile_cache(False),
+        13: lambda e: e.set_tile_cache(True),
+        15: lambda e: e.retune_entropy(device_entropy=True, bits_min_mbs=0),
+        19: lambda e: e.retune_entropy(device_entropy=False),
+    })
+    enc_b.close()
+    assert len(base) == len(toggled) == len(frames)
+    for i, ((a, _), (b, sb)) in enumerate(zip(base, toggled)):
+        assert a == b, f"frame {i} bytes differ"
+    # the entropy window actually shipped bits (the knob was live)
+    modes = [s.downlink_mode for _, s in toggled[15:19]]
+    assert "bits" in modes
+
+
+def test_signal_fields_on_stats():
+    frames = _typing_frames(9)
+    enc = TPUH264Encoder(W, H, qp=28, frame_batch=1, pipeline_depth=0)
+    out = _encode_all(enc, frames)
+    enc.close()
+    kinds = [s.upload_kind for _, s in out]
+    assert kinds[0] == "full"  # IDR
+    assert "static" in kinds and "delta" in kinds
+    deltas = [s for _, s in out if s.upload_kind == "delta"]
+    assert deltas and all(0 < s.dirty_frac < 0.5 for s in deltas)
+
+
+def test_retune_entropy_requires_flush():
+    enc = TPUH264Encoder(W, H, qp=28, frame_batch=4, pipeline_depth=2)
+    frames = _typing_frames(6)
+    enc.submit(frames[0], None, 0)  # IDR
+    enc.flush()
+    # a delta parked in the group accumulator is guaranteed in flight
+    enc.submit(frames[3], None, 1)
+    assert enc._batch_pend
+    with pytest.raises(RuntimeError, match="flight"):
+        enc.retune_entropy(device_entropy=True, bits_min_mbs=0)
+    enc.flush()
+    assert enc.retune_entropy(device_entropy=True, bits_min_mbs=0)
+    enc.close()
+
+
+def test_runtime_applies_scenario_to_encoder():
+    """End-to-end: typing signals -> TYPING -> batch cap 1 on the live
+    encoder; a disarm restores the constructed knobs."""
+    enc = TPUH264Encoder(W, H, qp=28, frame_batch=4, pipeline_depth=2)
+    eng = PolicyEngine(confirm=4, dwell=0)
+    rt = PolicyRuntime(eng, EncoderActuator(lambda: enc))
+    for kind, dirty, remap in _signals("typing"):
+        class S:  # what EncodedFrame/FrameStats duck-type to
+            upload_kind, dirty_frac, remap_frac = kind, dirty, remap
+            skipped_mbs = 0
+        rt.tick([S()])
+    assert eng.scenario is Scenario.TYPING
+    assert enc._batch_cap == 1
+    rt._disarm()
+    assert eng.dead
+    assert enc._batch_cap == enc.frame_batch
+    enc.close()
+
+
+def test_runtime_disarms_on_repeated_failures(faults):
+    faults("policy@1-99:raise")
+    enc = TPUH264Encoder(W, H, qp=28, frame_batch=4, pipeline_depth=2)
+    eng = PolicyEngine(confirm=2, dwell=0)
+    rt = PolicyRuntime(eng, EncoderActuator(lambda: enc))
+    for kind, dirty, remap in _signals("typing", 12):
+        class S:
+            upload_kind, dirty_frac, remap_frac = kind, dirty, remap
+            skipped_mbs = 0
+        rt.tick([S()])  # must never raise
+    assert eng.dead  # disarmed after MAX_FAILURES
+    assert enc._batch_cap == enc.frame_batch  # static knobs
+    enc.close()
+
+
+def test_fleet_builds_per_slot_engines(monkeypatch):
+    """Fleet wiring: SELKIES_POLICY=1 gives every slot its own engine
+    (fault sites policy:<k>), the /statz provider rolls them up, and a
+    lockstep tick runs clean with the policy armed (the batch service
+    has no per-session encoder, so slots observe nothing — and must
+    not break the tick)."""
+    monkeypatch.setenv("SELKIES_POLICY", "1")
+    from selkies_tpu.parallel.fleet import SessionFleet, SessionSlot
+
+    slots = [SessionSlot(k, bitrate_kbps=2000, fps=60) for k in range(2)]
+    fleet = SessionFleet(slots, width=W, height=H, fps=60)
+    try:
+        assert fleet.policies is not None and len(fleet.policies) == 2
+        assert fleet.policies[1].engine.fault_site == "policy:1"
+        roll = fleet._policy_rollup()
+        assert set(roll) == {"0", "1"}
+        assert roll["0"]["scenario"] == "unknown"
+        for slot in slots:
+            slot.connected = True
+        aus, idrs, _, _ = fleet._encode_tick()
+        assert len(aus) == 2 and all(aus)
+    finally:
+        fleet.service.close()
+
+
+def test_policy_off_is_inert(monkeypatch):
+    """SELKIES_POLICY unset: no policy object is constructed anywhere
+    (byte identity with pre-policy builds holds by construction)."""
+    monkeypatch.delenv("SELKIES_POLICY", raising=False)
+    from selkies_tpu.pipeline.app import TPUWebRTCApp
+    from selkies_tpu.pipeline.elements import SyntheticSource
+
+    app = TPUWebRTCApp(source=SyntheticSource(W, H), encoder="tpuh264enc")
+    assert app.policy_engine is None
