@@ -213,7 +213,8 @@ def bench_full_encoder(w: int = W, h: int = H) -> tuple[float, dict] | None:
     # full-frame changes)
     done = 0
     sums = {k: 0.0 for k in ("device_ms", "pack_ms", "unpack_ms", "cavlc_ms",
-                             "upload_ms", "step_ms", "fetch_ms")}
+                             "upload_ms", "step_ms", "fetch_ms",
+                             "classify_ms", "convert_ms", "h2d_ms")}
     bands = 1
     cols = 1
     band_step_sums: list[float] = []
@@ -362,13 +363,60 @@ def _scenario_trace(name: str, n: int, w: int, h: int,
     raise SystemExit(f"unknown scenario {name!r} (one of {SCENARIOS})")
 
 
+def _scenario_damage(name: str, i: int, w: int, h: int):
+    """Per-frame damage-rect hints for the synthetic scenario traces —
+    what an XDamage-armed capture layer would report (capture.py):
+    authoritative SUPERSETS of the pixels _scenario_trace changes at
+    frame i, as (x, y, w, h) tuples. None = unknown (full scan; frame 0
+    of each pass switches the whole trace content). Byte-neutral by the
+    FramePrep.scan superset contract; the hinted-vs-full AU byte
+    identity is pinned by tests/test_frontend_parallel.py (the bench
+    rows report identical bytes_up/down either way)."""
+    if i == 0:
+        return None
+    if name == "idle":
+        # cursor blink touches one 12x12 block every 30th frame
+        return ([(w // 4, h // 2, 12, 12)] if i % 30 == 0 else [])
+    if name == "typing":
+        if i % 3 != 0:
+            return []
+        row = h // 4 + ((i // 3) * 16) % (h // 2)
+        line_w = min(w - 64, 1024)
+        return [(32, row, line_w, 12)]
+    if name == "scroll":
+        # scroll_trace(bands=8, band0=2): rows 32..32+128 change
+        return [(0, 32, w, 8 * 16)]
+    if name == "window_drag":
+        # window_move_trace: window (6 bands x 3 tiles) at y0=32 slides
+        # one tile per frame — old + new positions bound the change
+        # (window_move_x is the trace's own position formula, so the
+        # hint can never drift from what the generator draws)
+        from selkies_tpu.models.frameprep import tile_width_for
+        from selkies_tpu.pipeline.elements import window_move_x
+
+        tile_w = tile_width_for(w)
+        x0, x1 = sorted((window_move_x(i - 1, w, tile_w),
+                         window_move_x(i, w, tile_w)))
+        return [(x0, 32, x1 - x0 + 3 * tile_w, 6 * 16)]
+    if name == "video":
+        if i % 2 != 0:
+            return []
+        rh, rw = (h // 2) // 16 * 16, (w // 2) // 16 * 16
+        y0, x0 = (h - rh) // 2 // 16 * 16, (w - rw) // 2 // 16 * 16
+        return [(x0, y0, rw, rh)]
+    return None  # game: full-frame motion, a hint saves nothing
+
+
 def bench_scenario(name: str, w: int, h: int, n: int,
-                   policy_on: bool) -> dict:
+                   policy_on: bool, damage_on: bool = False) -> dict:
     """One scenario row: drive the production encoder over the scenario
     trace at a paced 60 fps tick, twice — an untimed SETTLE pass (the
     policy classifies, transitions and pays any knob-change compile
     there) and a TIMED pass measuring the settled steady state. The
-    row therefore compares postures, not transition costs."""
+    row therefore compares postures, not transition costs. With
+    ``damage_on`` the submit carries the trace's damage-rect hints
+    (_scenario_damage), bounding the classify scan like a live XDamage
+    capture would."""
     from selkies_tpu.models.h264.encoder import TPUH264Encoder
     from selkies_tpu.models.registry import (
         default_frame_batch, default_pipeline_depth)
@@ -392,7 +440,8 @@ def bench_scenario(name: str, w: int, h: int, n: int,
         active_lats: list[float] = []
         sums = {k: 0.0 for k in ("device_ms", "pack_ms", "unpack_ms",
                                  "cavlc_ms", "upload_ms", "step_ms",
-                                 "fetch_ms")}
+                                 "fetch_ms", "classify_ms", "convert_ms",
+                                 "h2d_ms")}
         modes: dict[str, int] = {}
         done = 0
 
@@ -425,7 +474,8 @@ def bench_scenario(name: str, w: int, h: int, n: int,
             next_tick = max(next_tick + 1.0 / SCENARIO_FPS,
                             now - 0.5 / SCENARIO_FPS)
             submit_t[i] = time.perf_counter()
-            outs = enc.submit(frame, None, i)
+            dmg = _scenario_damage(name, i, w, h) if damage_on else None
+            outs = enc.submit(frame, None, i, damage=dmg)
             _account(outs)
             if runtime is not None:
                 runtime.tick([s for _, s, _ in outs],
@@ -473,6 +523,7 @@ def bench_scenario(name: str, w: int, h: int, n: int,
     enc.close()
     row["scenario"] = name
     row["policy"] = int(policy_on)
+    row["damage"] = int(damage_on)
     return row
 
 
@@ -571,6 +622,12 @@ def main() -> int:
              "engine (selkies_tpu/policy), 0 static default knobs. "
              "Default follows SELKIES_POLICY")
     ap.add_argument(
+        "--damage", type=int, choices=(0, 1), default=0,
+        help="scenario suite only: 1 submits the traces' damage-rect "
+             "hints (what an XDamage capture reports), bounding the "
+             "classify scan; byte-identical to 0 by the superset "
+             "contract (FramePrep.scan)")
+    ap.add_argument(
         "--codec", default=None,
         help="comma-separated codec sweep (h264,av1,vp9,...): one JSON "
              "line per codec at each --resolution, from the encoder row "
@@ -596,11 +653,13 @@ def main() -> int:
         label, w, h = _parse_resolutions(args.resolution)[0]
         for name in names:
             row = bench_scenario(name, w, h, max(60, args.scenario_frames),
-                                 policy_on)
+                                 policy_on, damage_on=bool(args.damage))
             fps = row.pop("fps")
             row["resolution"] = label
-            _result(f"scenario {name} {label} encode "
-                    f"({'policy' if policy_on else 'static'})", fps,
+            label_bits = "policy" if policy_on else "static"
+            if args.damage:
+                label_bits += "+damage"
+            _result(f"scenario {name} {label} encode ({label_bits})", fps,
                     unit=f"fps@{label}", **row)
         return 0
     codecs = [c.strip().lower() for c in (args.codec or "h264").split(",")

@@ -33,6 +33,7 @@ from selkies_tpu.models.h264.numpy_ref import PFrameCoeffs
 
 from selkies_tpu.models.frameprep import FramePrep, delta_buckets_for, tile_width_for
 from selkies_tpu.monitoring.telemetry import telemetry
+from selkies_tpu.resilience.faultinject import get_injector
 from selkies_tpu.monitoring.tracing import tracer
 from selkies_tpu.models.stats import FrameStats as _FrameStats
 from selkies_tpu.models.h264.bitstream import StreamParams, write_pps, write_sps
@@ -476,10 +477,19 @@ class _Pending:
     future: object = None  # completion future (threaded fetch+unpack+pack)
     batch_slot: int = -1  # >=0: index into a shared batch future's result list
     # device-stage attribution (FrameStats upload/step/fetch split):
-    # host time spent converting + enqueuing this frame's dispatch, and
-    # the wall clock when the dispatch call returned (workers measure
-    # step_ms = outputs-ready - t_disp, then time the d2h fetch itself)
+    # up_ms is the HOST front-end cost of this frame — classify (fused
+    # dirty scan + tile-cache hash/split) + convert (BGRx->I420 of the
+    # upload payload) + h2d (transfer enqueue) + packing glue — split
+    # out in classify_ms/convert_ms/h2d_ms. t_disp is the wall clock
+    # just BEFORE the device-step dispatch call: workers measure
+    # step_ms = outputs-ready - t_disp, so a dispatch call that blocks
+    # (CPU backend contention, full dispatch queue) counts as device
+    # step time, not as upload — the round-11 bench misread exactly
+    # this (PERF.md round 12)
     up_ms: float = 0.0
+    classify_ms: float = 0.0
+    convert_ms: float = 0.0
+    h2d_ms: float = 0.0
     t_disp: float = 0.0
     scene_cut: bool = False  # full-frame change transition (rate control)
     # dirty-tile accounting for the scenario policy signals
@@ -507,6 +517,10 @@ class TPUH264Encoder:
     """
 
     codec = "h264"
+    # the submit()/encode paths take capture-layer damage-rect hints
+    # (FramePrep.scan superset contract); the pipeline only forwards
+    # hints to encoders that declare this
+    accepts_damage = True
 
     def __init__(
         self,
@@ -730,6 +744,13 @@ class TPUH264Encoder:
         # last-seen tile-cache totals, for per-frame telemetry deltas
         self._tc_seen = (0, 0, 0)
         self._prev_frame: np.ndarray | None = None  # device-convert mode only
+        # per-dispatch front-end stage scratch (submit-thread only):
+        # convert/h2d accumulate inside the dispatch helpers, _t_disp0
+        # records the wall clock immediately before the jitted step call
+        # (the step/upload attribution boundary — see _Pending)
+        self._t_conv_ms = 0.0
+        self._t_h2d_ms = 0.0
+        self._t_disp0 = 0.0
         self._inflight: deque = deque()
         self._pool = ThreadPoolExecutor(
             max_workers=max(2, self.pipeline_depth + 1),
@@ -946,7 +967,7 @@ class TPUH264Encoder:
 
     # -- frame classification (static / delta / full upload) -----------
 
-    def _classify(self, frame: np.ndarray):
+    def _classify(self, frame: np.ndarray, damage=None):
         """-> ("static" | "delta" | "full", payload).
 
         Compares against the previous capture (FramePrep's per-tile
@@ -971,7 +992,14 @@ class TPUH264Encoder:
         pool-resident, so the frame still fits after remapping (split
         aborts without state change when it doesn't — the big win the
         cache exists for). The attempt is bounded at _tc_try_cap dirty
-        tiles so sustained full-frame video skips the hashing."""
+        tiles so sustained full-frame video skips the hashing.
+
+        ``damage``: optional capture-layer dirty-rect hints (superset
+        contract — see FramePrep.scan): the fused scan is bounded to
+        their band/tile box, so an idle/typing frame stops paying a
+        full-frame memcmp for a cursor blink. The scan also emits the
+        tile-cache content hashes in the same pass (want_hashes), which
+        probe/split consume instead of re-reading the dirty tiles."""
         self._ltr_probe = ()
         if self._prep is None:
             if self._prev_frame is None or self._prev_frame.shape != frame.shape:
@@ -981,9 +1009,11 @@ class TPUH264Encoder:
                 return "static", None
             np.copyto(self._prev_frame, frame)
             return "full", None
-        tiles = self._prep.dirty_tiles(frame, self._tile_w)
-        if tiles is None:
+        res = self._prep.scan(frame, self._tile_w, damage=damage,
+                              want_hashes=self._tcache is not None)
+        if res is None:
             return "full", None
+        tiles = res.tiles
         if not tiles.any():
             return "static", None
         if self._src is None or not self._delta_buckets:
@@ -1009,17 +1039,19 @@ class TPUH264Encoder:
             # sampled membership probe: scrolled content is pool-
             # resident after its seed frame, video content never is —
             # skip the full hash/split attempt when it cannot pay
-            # (sustained motion then costs ~8 tile hashes per frame,
-            # and the seed hook is additionally bounded by _full_run)
-            if self._tcache.probe(frame, idx) < 0.5:
-                return "full", ("seed", idx)
-        payload = self._tcache.split(frame, idx, max_up=cap)
+            # (sustained motion then reads ~8 precomputed hashes per
+            # frame, and the seed hook is additionally bounded by
+            # _full_run)
+            if self._tcache.probe(frame, idx, hashes=res.hashes) < 0.5:
+                return "full", ("seed", idx, res.hashes)
+        payload = self._tcache.split(frame, idx, max_up=cap, hashes=res.hashes)
         if payload is None:
             # too many genuinely-new tiles: full upload — but remember
-            # the dirty set so submit() can seed the pool from the
-            # freshly-resident planes (a sustained over-budget scroll
-            # then fits from its second frame on)
-            return "full", ("seed", idx)
+            # the dirty set (and its fused-scan hashes) so submit() can
+            # seed the pool from the freshly-resident planes without
+            # re-reading the tiles (a sustained over-budget scroll then
+            # fits from its second frame on)
+            return "full", ("seed", idx, res.hashes)
         return "delta", payload
 
     def _emit_classify_telemetry(self, kind: str, payload) -> None:
@@ -1079,22 +1111,47 @@ class TPUH264Encoder:
                  else y[(Y_CHUNKS - 1) * rows :] for i in range(Y_CHUNKS)]
         parts += [u, v]
         self.link_bytes.add("up_full", sum(p.nbytes for p in parts))
-        return list(self._upload_pool.map(jax.device_put, parts))
+        t0 = time.perf_counter()
+        out = list(self._upload_pool.map(jax.device_put, parts))
+        self._t_h2d_ms += (time.perf_counter() - t0) * 1e3
+        return out
+
+    def _convert_timed(self, frame: np.ndarray):
+        t0 = time.perf_counter()
+        planes = self._prep.convert(frame)
+        self._t_conv_ms += (time.perf_counter() - t0) * 1e3
+        return planes
+
+    def _convert_tiles_timed(self, frame: np.ndarray, idx, tile_w: int):
+        t0 = time.perf_counter()
+        out = self._prep.convert_tiles(frame, idx, tile_w)
+        self._t_conv_ms += (time.perf_counter() - t0) * 1e3
+        return out
+
+    def _put_timed(self, arr):
+        t0 = time.perf_counter()
+        out = jax.device_put(arr)
+        self._t_h2d_ms += (time.perf_counter() - t0) * 1e3
+        return out
 
     def _run_step_i(self, frame: np.ndarray):
         if self._prep is not None:
-            parts = self._put_chunked(*self._prep.convert(frame))
+            parts = self._put_chunked(*self._convert_timed(frame))
+            self._t_disp0 = time.perf_counter()
             *out, y, u, v = self._step(*parts, np.int32(self.qp))
             # keep the joined planes resident: they are the delta base
             # for the next frame (the I step does not donate them)
             self._src = (y, u, v)
             return out
         self.link_bytes.add("up_full", frame.nbytes)
-        return self._step(jax.device_put(frame), np.int32(self.qp))
+        parts = self._put_timed(frame)
+        self._t_disp0 = time.perf_counter()
+        return self._step(parts, np.int32(self.qp))
 
     def _run_step_p(self, frame: np.ndarray):
         if self._prep is not None:
-            parts = self._put_chunked(*self._prep.convert(frame))
+            parts = self._put_chunked(*self._convert_timed(frame))
+            self._t_disp0 = time.perf_counter()
             if self.device_entropy:
                 prefix_d, words_d, hdr_d, buf_d, ry, ru, rv, y, u, v = self._step_pb(
                     *parts, np.int32(self.qp), *self._ref
@@ -1106,7 +1163,9 @@ class TPUH264Encoder:
             # (kind, prefix, words, hdr, buf, recon_y, recon_u, recon_v)
             return ("p", out[0], None, None, out[1], out[2], out[3], out[4])
         self.link_bytes.add("up_full", frame.nbytes)
-        out = self._step_p(jax.device_put(frame), np.int32(self.qp), *self._ref)
+        parts = self._put_timed(frame)
+        self._t_disp0 = time.perf_counter()
+        out = self._step_p(parts, np.int32(self.qp), *self._ref)
         return ("p", out[0], None, None, out[1], out[2], out[3], out[4])
 
     @staticmethod
@@ -1178,12 +1237,16 @@ class TPUH264Encoder:
             self._step2_cache[key] = fn
         return fn
 
-    def _seed_pool(self, frame: np.ndarray, idx: np.ndarray) -> None:
+    def _seed_pool(self, frame: np.ndarray, idx: np.ndarray,
+                   hashes: np.ndarray | None = None) -> None:
         """After an over-budget full upload: commit the dirty tiles to
         the host cache and fill their pool slots device-side by
         gathering from the freshly-resident source planes — only the
-        (slot, idx) list crosses the link."""
-        up_idx, pool_dst, _pairs = self._tcache.split(frame, idx)
+        (slot, idx) list crosses the link. `hashes` is the fused scan's
+        content-hash array for this frame's dirty tiles (the classify
+        pass already computed them — re-hashing here would repeat the
+        exact redundant read the fused front-end removed)."""
+        up_idx, pool_dst, _pairs = self._tcache.split(frame, idx, hashes=hashes)
         if not len(up_idx):
             return
         sbucket = next(cb for cb in self._copy_buckets if cb >= len(up_idx))
@@ -1229,7 +1292,7 @@ class TPUH264Encoder:
         up_idx, pool_dst, pairs = payload
         bucket = next(b for b in self._up_buckets if b >= len(up_idx))
         cbucket = next(cb for cb in self._copy_buckets if cb >= len(pairs))
-        yb, ub, vb = self._prep.convert_tiles(frame, up_idx, self._tile_w)
+        yb, ub, vb = self._convert_tiles_timed(frame, up_idx, self._tile_w)
         packed = self._pack_tiles2(yb, ub, vb, up_idx, pool_dst, pairs, bucket, cbucket)
         return packed, bucket, cbucket
 
@@ -1242,8 +1305,9 @@ class TPUH264Encoder:
         if self._tcache is not None:
             packed, bucket, cbucket = self._pack_payload2(frame, idx)
             self.link_bytes.add("up_delta", packed.nbytes)
-            packed_d = jax.device_put(packed)
+            packed_d = self._put_timed(packed)
             pool = self._get_pool()
+            self._t_disp0 = time.perf_counter()
             if idr:
                 prefix_d, buf_d, ry, ru, rv, sy, su, sv, *pool2 = self._get_step2(
                     "i", bucket, cbucket)(packed_d, qp, *self._src, *pool)
@@ -1255,10 +1319,11 @@ class TPUH264Encoder:
             self._src = (sy, su, sv)
             return prefix_d, hdr_d, buf_d, ry, ru, rv
         bucket = next(b for b in self._delta_buckets if b >= len(idx))
-        yb, ub, vb = self._prep.convert_tiles(frame, idx, self._tile_w)
+        yb, ub, vb = self._convert_tiles_timed(frame, idx, self._tile_w)
         packed = self._pack_tiles(yb, ub, vb, idx, bucket)
         self.link_bytes.add("up_delta", packed.nbytes)
-        packed_d = jax.device_put(packed)
+        packed_d = self._put_timed(packed)
+        self._t_disp0 = time.perf_counter()
         if idr:
             prefix_d, buf_d, ry, ru, rv, sy, su, sv = self._step_scatter_i(
                 packed_d, qp, *self._src
@@ -1331,19 +1396,22 @@ class TPUH264Encoder:
             packed, bucket, cbucket = self._pack_payload2(
                 frame, self._tcache.split(frame, idx))
             self.link_bytes.add("up_ltr", packed.nbytes)
-            packed_d = jax.device_put(packed)
+            packed_d = self._put_timed(packed)
+            pool = self._get_pool()
+            self._t_disp0 = time.perf_counter()
             prefix_d, hdr_d, buf_d, ry, ru, rv, sy, su, sv, *pool2 = self._get_step2(
                 "ltr", bucket, cbucket)(
-                    packed_d, np.int32(self.qp), *stash["src"], *self._get_pool(),
+                    packed_d, np.int32(self.qp), *stash["src"], *pool,
                     *stash["ref"])
             self._pool_d = tuple(pool2)
             self._src = (sy, su, sv)
             return prefix_d, hdr_d, buf_d, ry, ru, rv
         bucket = next(b for b in self._delta_buckets if b >= len(idx))
-        yb, ub, vb = self._prep.convert_tiles(frame, idx, self._tile_w)
+        yb, ub, vb = self._convert_tiles_timed(frame, idx, self._tile_w)
         packed = self._pack_tiles(yb, ub, vb, idx, bucket)
         self.link_bytes.add("up_ltr", packed.nbytes)
-        packed_d = jax.device_put(packed)
+        packed_d = self._put_timed(packed)
+        self._t_disp0 = time.perf_counter()
         prefix_d, hdr_d, buf_d, ry, ru, rv, sy, su, sv = self._step_scatter_ltr(
             packed_d, np.int32(self.qp), *stash["src"], *stash["ref"]
         )
@@ -1388,30 +1456,43 @@ class TPUH264Encoder:
                 i += take
                 if take == 1:
                     rec, yb, ub, vb, idx, pool_dst, pairs = group[0]
+                    self._t_h2d_ms = 0.0
                     if tc:
                         bucket = next(b for b in self._up_buckets if b >= len(idx))
                         cbucket = next(cb for cb in self._copy_buckets if cb >= len(pairs))
                         packed = self._pack_tiles2(yb, ub, vb, idx, pool_dst, pairs,
                                                    bucket, cbucket)
                         self.link_bytes.add("up_delta", packed.nbytes)
+                        packed_d = self._put_timed(packed)
+                        pool = self._get_pool()
+                        self._t_disp0 = time.perf_counter()
                         (prefix_d, hdr_d, buf_d, ry, ru, rv, sy, su, sv,
                          *pool2) = self._get_step2("p", bucket, cbucket)(
-                            jax.device_put(packed), np.int32(rec.qp),
-                            *self._src, *self._get_pool(), *self._ref)
+                            packed_d, np.int32(rec.qp),
+                            *self._src, *pool, *self._ref)
                         self._pool_d = tuple(pool2)
                     else:
                         bucket = next(b for b in self._delta_buckets if b >= len(idx))
                         packed = self._pack_tiles(yb, ub, vb, idx, bucket)
                         self.link_bytes.add("up_delta", packed.nbytes)
+                        packed_d = self._put_timed(packed)
+                        self._t_disp0 = time.perf_counter()
                         prefix_d, hdr_d, buf_d, ry, ru, rv, sy, su, sv = self._step_scatter_p(
-                            jax.device_put(packed), np.int32(rec.qp), *self._src, *self._ref
+                            packed_d, np.int32(rec.qp), *self._src, *self._ref
                         )
                     self._src, self._ref = (sy, su, sv), (ry, ru, rv)
                     rec.prefix_d, rec.hdr_d, rec.buf_d = prefix_d, hdr_d, buf_d
                     rec.pfx_slice_d = self._pfx_slice(prefix_d)
                     rec.batch_slot = -1
-                    rec.t_disp = time.perf_counter()
-                    rec.up_ms = (rec.t_disp - t_d0) * 1e3
+                    # upload/step boundary: t_disp is the instant BEFORE the
+                    # step dispatch call, so a blocking dispatch reads as
+                    # device step time (see _Pending); up_ms is the host
+                    # front-end (classify + convert at submit, h2d + pack
+                    # glue here)
+                    rec.t_disp = self._t_disp0
+                    rec.h2d_ms += self._t_h2d_ms
+                    rec.up_ms = (rec.classify_ms + rec.convert_ms
+                                 + (rec.t_disp - t_d0) * 1e3)
                     rec.future = self._pool.submit(self._complete_work, rec)
                     continue
                 qps = np.array([g[0].qp for g in group], np.int32)
@@ -1441,28 +1522,39 @@ class TPUH264Encoder:
                 self.link_bytes.add("up_delta", packed.nbytes)
                 # two concurrent half uploads (h2d overlaps across threads)
                 half = take // 2
+                t_h0 = time.perf_counter()
                 pa, pb = self._upload_pool.map(
                     jax.device_put, (packed[:half], packed[half:])
                 )
+                qps_d = jax.device_put(qps)
+                h2d_ms = (time.perf_counter() - t_h0) * 1e3
+                self._t_disp0 = time.perf_counter()
                 if tc:
                     (prefixes_d, denses_d, bufs_d, ry, ru, rv, sy, su, sv,
                      *pool2) = self._get_step2("pk", bucket, cbucket)(
-                        pa, pb, jax.device_put(qps),
+                        pa, pb, qps_d,
                         *self._src, *self._get_pool(), *self._ref)
                     self._pool_d = tuple(pool2)
                 else:
                     prefixes_d, denses_d, bufs_d, ry, ru, rv, sy, su, sv = self._step_scatter_pk(
-                        pa, pb, jax.device_put(qps), *self._src, *self._ref
+                        pa, pb, qps_d, *self._src, *self._ref
                     )
                 self._src, self._ref = (sy, su, sv), (ry, ru, rv)
                 recs = [g[0] for g in group]
                 # per-slot full-row handles, dispatched NOW so a worker
                 # shortfall refetch is a pure transfer (no queued slice)
                 rows_d = [prefixes_d[i] for i in range(take)]
-                t_disp = time.perf_counter()
-                up_ms = (t_disp - t_d0) * 1e3
+                # group-wide host front-end time (pack + h2d enqueue,
+                # everything before the step dispatch call) stamped on
+                # every member, plus each frame's own classify/convert
+                # from submit time — the step/upload boundary is
+                # t_disp = pre-dispatch (see _Pending)
+                t_disp = self._t_disp0
+                grp_ms = (t_disp - t_d0) * 1e3
                 for rec in recs:
-                    rec.t_disp, rec.up_ms = t_disp, up_ms
+                    rec.t_disp = t_disp
+                    rec.h2d_ms += h2d_ms
+                    rec.up_ms = rec.classify_ms + rec.convert_ms + grp_ms
                 shared = self._pool.submit(
                     self._complete_batch, recs, self._pfx_slice(prefixes_d),
                     rows_d, denses_d, bufs_d,
@@ -1581,14 +1673,21 @@ class TPUH264Encoder:
         self._update_pfx_hint()
         return [(*r, step_ms, fetch_ms) for r in results]
 
-    def submit(self, frame: np.ndarray, qp: int | None = None, meta=None) -> list:
+    def submit(self, frame: np.ndarray, qp: int | None = None, meta=None,
+               damage=None) -> list:
         """Dispatch one frame into the encode pipeline.
 
         Returns completed (au, stats, meta) tuples, oldest first — empty
         while the pipeline (depth `pipeline_depth`) is filling. Device
-        dispatch is async, so frame N+1's upload/compute overlaps frame
-        N's downlink fetch and host CAVLC pack: the round-trip latency of
+        dispatch is async, so frame N+1's host front-end (the fused
+        classify/hash/convert scan) overlaps frame N's device step,
+        downlink fetch and host CAVLC pack: the round-trip latency of
         the host↔device link is hidden at steady state.
+
+        ``damage``: optional capture-layer dirty-rect hints ((x, y, w, h)
+        pixel tuples, superset contract — FramePrep.scan) bounding the
+        classification scan. None = full scan; hints never change the
+        encoded bytes, only how much of the frame the classifier reads.
         """
         if qp is not None:
             self.set_qp(qp)
@@ -1599,10 +1698,18 @@ class TPUH264Encoder:
             or (self.keyframe_interval > 0 and self._frames_since_idr >= self.keyframe_interval)
         )
         t0 = time.perf_counter()
+        fi = get_injector()
+        if fi is not None:
+            # "frontend" chaos site: a fault in the classify/hash/convert
+            # stage must surface like any encode failure (submit raises,
+            # the next frame self-heals as a full-upload IDR) and must
+            # never strand the frames already in flight
+            fi.check("frontend")
         # classify on every frame (advances the previous-frame state even
         # across IDRs) but only short-circuit on P frames
         with tracer.span("classify"):
-            kind, dirty_idx = self._classify(frame)
+            kind, dirty_idx = self._classify(frame, damage)
+        classify_ms = (time.perf_counter() - t0) * 1e3
         if telemetry.enabled:
             self._emit_classify_telemetry(kind, dirty_idx)
         batch_full = False
@@ -1675,6 +1782,7 @@ class TPUH264Encoder:
                 frame_num=self._frames_since_idr % 256, idr_pic_id=0,
                 t0=t0, t1=time.perf_counter(), meta=meta, au=slice_nal,
                 mark_ltr=mark_ltr, mmco_evict=mmco_evict,
+                classify_ms=classify_ms, up_ms=classify_ms,
             )
         elif (
             not idr
@@ -1688,12 +1796,13 @@ class TPUH264Encoder:
             # the cache split already ran in _classify in frame order —
             # then dispatch when the group fills or a non-groupable
             # frame arrives
+            self._t_conv_ms = 0.0
             if self._tcache is not None:
                 up_idx, pool_dst, pairs = dirty_idx
-                yb, ub, vb = self._prep.convert_tiles(frame, up_idx, self._tile_w)
+                yb, ub, vb = self._convert_tiles_timed(frame, up_idx, self._tile_w)
             else:
                 up_idx, pool_dst, pairs = dirty_idx, None, None
-                yb, ub, vb = self._prep.convert_tiles(frame, dirty_idx, self._tile_w)
+                yb, ub, vb = self._convert_tiles_timed(frame, dirty_idx, self._tile_w)
             rec = _Pending(
                 kind="pd", frame_index=self.frame_index, qp=self.qp,
                 frame_num=self._frames_since_idr % 256, idr_pic_id=0,
@@ -1701,6 +1810,7 @@ class TPUH264Encoder:
                 mmco_evict=mmco_evict,
                 n_up=len(up_idx),
                 n_remap=len(pairs) if pairs is not None else 0,
+                classify_ms=classify_ms, convert_ms=self._t_conv_ms,
             )
             self._batch_pend.append((rec, yb, ub, vb, up_idx, pool_dst, pairs))
             # the policy batch cap (set_batch_cap) bounds the group; its
@@ -1712,6 +1822,9 @@ class TPUH264Encoder:
                 # delta group before this frame touches device state
                 self._flush_batch()
                 t_d0 = time.perf_counter()
+                self._t_conv_ms = 0.0
+                self._t_h2d_ms = 0.0
+                self._t_disp0 = 0.0
                 hdr_d = None
                 if idr:
                     if kind == "delta":
@@ -1720,6 +1833,7 @@ class TPUH264Encoder:
                         )
                     elif kind == "static" and self._src is not None:
                         # forced IDR over unchanged content: zero upload
+                        self._t_disp0 = time.perf_counter()
                         prefix_d, buf_d, ry, ru, rv = self._step_resident_i(
                             np.int32(self.qp), *self._src
                         )
@@ -1780,10 +1894,16 @@ class TPUH264Encoder:
                     if pk == "pd":
                         rec.pfx_slice_d = self._pfx_slice(prefix_d)
                 # upload/step attribution boundary: everything since
-                # flush (conversion, tile packing, h2d enqueue, step
-                # enqueue) is the host dispatch cost of THIS frame
-                rec.t_disp = time.perf_counter()
-                rec.up_ms = (rec.t_disp - t_d0) * 1e3
+                # flush UP TO the step dispatch call (conversion, tile
+                # packing, h2d enqueue) is the host front-end cost of
+                # THIS frame; the dispatch call itself counts as step
+                # time (it blocks exactly when the device is the
+                # bottleneck — see _Pending)
+                rec.t_disp = self._t_disp0 or time.perf_counter()
+                rec.classify_ms = classify_ms
+                rec.convert_ms = self._t_conv_ms
+                rec.h2d_ms = self._t_h2d_ms
+                rec.up_ms = classify_ms + (rec.t_disp - t_d0) * 1e3
                 # over-budget delta that fell back to full: seed the tile
                 # pool from the now-resident planes so the NEXT frame of
                 # a sustained scroll fits the delta path via remaps.
@@ -1798,7 +1918,7 @@ class TPUH264Encoder:
                     and self._src is not None
                     and self._full_run <= 2
                 ):
-                    self._seed_pool(frame, dirty_idx[1])
+                    self._seed_pool(frame, dirty_idx[1], dirty_idx[2])
                 # scene-stash bookkeeping: every full frame (IDR, full-P,
                 # or restore) becomes the pending LTR candidate — window
                 # switches arrive back-to-back, so mid-run frames are
@@ -1896,6 +2016,7 @@ class TPUH264Encoder:
                 pack_ms=0.0,
                 skipped_mbs=(self._pad_h // 16) * (self._pad_w // 16),
                 upload_kind="static",
+                upload_ms=rec.up_ms, classify_ms=rec.classify_ms,
             )
             self.last_stats = stats
             return au, stats, rec.meta
@@ -1926,6 +2047,8 @@ class TPUH264Encoder:
             scene_cut=rec.scene_cut,
             unpack_ms=(tu - t1) * 1e3, cavlc_ms=(t2 - tu) * 1e3,
             upload_ms=rec.up_ms, step_ms=step_ms, fetch_ms=fetch_ms,
+            classify_ms=rec.classify_ms, convert_ms=rec.convert_ms,
+            h2d_ms=rec.h2d_ms,
             downlink_mode=mode,
             upload_kind="delta" if rec.kind == "pd" else "full",
             dirty_frac=(min(1.0, dirty / self._ntiles)

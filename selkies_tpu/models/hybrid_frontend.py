@@ -196,6 +196,12 @@ class HybridFrontendMixin:
         return tiles[:mb_rows][:, cols]
 
     def _classify_mbs(self, frame: np.ndarray) -> np.ndarray | None:
+        """The host path rides the fused band-parallel front-end scan
+        (ISSUE 12): one pass computes the dirty map and updates the
+        previous-frame state, sharded across SELKIES_FRONTEND_WORKERS.
+        (Damage-rect hints stop at the H.264 rows for now — the library
+        rows' encode_frame surface has no hint plumbing, so threading a
+        parameter this deep would be dead code until it does.)"""
         if self._device_fe is not None:
             dirty, hints = self._device_fe.step(frame)
             self.frontend_device_ms = self._device_fe.last_device_ms

@@ -52,11 +52,23 @@ class FrameStats:
     cavlc_ms: float = 0.0
     # device-stage sub-split (device_ms ≈ upload_ms + step_ms + fetch_ms
     # plus queueing; rows without the attribution leave them 0):
-    # upload_ms is host time enqueuing the h2d transfers, step_ms is
-    # dispatch -> device outputs ready, fetch_ms the d2h transfer itself
+    # upload_ms is the HOST front-end cost of the frame — classify +
+    # convert + h2d enqueue + packing glue — step_ms is step-dispatch ->
+    # device outputs ready (including any time the dispatch call itself
+    # blocks: that is device-side backpressure, not host work — ISSUE 12
+    # reattribution, PERF.md round 12), fetch_ms the d2h transfer itself
     upload_ms: float = 0.0
     step_ms: float = 0.0
     fetch_ms: float = 0.0
+    # front-end sub-split of upload_ms (ISSUE 12; rows without the
+    # attribution leave them 0): classify_ms is the fused dirty scan +
+    # tile-cache hash/split (damage-bounded when the capture layer
+    # passes rect hints), convert_ms the BGRx->I420 conversion of the
+    # upload payload (full planes or dirty tiles), h2d_ms the
+    # host->device transfer enqueues
+    classify_ms: float = 0.0
+    convert_ms: float = 0.0
+    h2d_ms: float = 0.0
     # intra-frame band parallelism (parallel/bands.py): slice count and
     # per-band dispatch->ready latency when the frame was band-split.
     # cols > 1 = 2D tile grid (SELKIES_TILE_GRID): each of the `bands`

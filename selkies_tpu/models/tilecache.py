@@ -126,26 +126,54 @@ class TileCache:
             frame[band * 16 : band * 16 + 16, tile * tw : (tile + 1) * tw]
         ).reshape(-1)
 
-    def probe(self, frame: np.ndarray, idx: np.ndarray, samples: int = 8) -> float:
+    def _gather_tiles(self, frame: np.ndarray, cidx: list[int]) -> np.ndarray:
+        """(k, tile_bytes) stack of the cacheable tiles' BGRx bytes: a
+        native per-row memcpy gather (frameprep.cc gather_tiles), with a
+        vectorized fancy-index fallback — either way one call instead of
+        the historical per-tile _tile_bgrx Python walk (the split's
+        dominant cost on scroll frames — ISSUE 12)."""
+        tw = self.tile_w
+        lib = frameprep._load()
+        if lib is not None and hasattr(lib, "gather_tiles"):
+            if not frame.flags["C_CONTIGUOUS"]:
+                frame = np.ascontiguousarray(frame)
+            cid = np.ascontiguousarray(cidx, np.int32)
+            out = np.empty((len(cid), self._tile_bytes), np.uint8)
+            lib.gather_tiles(
+                frameprep._u8p(frame), self.height, self.width, tw,
+                cid.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                len(cid), frameprep._u8p(out))
+            return out
+        cid = np.asarray(cidx, np.int64)
+        rows = cid[:, None] // 1024 * 16 + np.arange(16)[None, :]
+        cols = cid[:, None] % 1024 * tw + np.arange(tw)[None, :]
+        return frame[rows[:, :, None], cols[:, None, :]].reshape(len(cid), -1)
+
+    def probe(self, frame: np.ndarray, idx: np.ndarray, samples: int = 8,
+              hashes: np.ndarray | None = None) -> float:
         """Fraction of a sampled subset of dirty tiles whose content
         hash is already in the pool index — no memcmp, no state change.
         A cheap plausibility gate for over-budget frames: scrolled
         content probes near 1.0 after its seed frame, video content
         probes ~0.0 every frame (so the classifier skips the full
-        hash/split attempt AND the per-frame seeding)."""
+        hash/split attempt AND the per-frame seeding). ``hashes`` is the
+        fused scan's (nbands, ntiles) content-hash array (FramePrep.scan
+        want_hashes): with it the probe reads precomputed values and
+        touches no pixel bytes at all."""
         step = max(1, len(idx) // samples)
-        raws = []
-        for d in list(idx[::step][:samples]):
-            d = int(d)
-            band, tile = d // 1024, d % 1024
-            if band < self._full_bands and tile < self._full_tiles:
-                raws.append(self._tile_bgrx(frame, band, tile))
-        if not raws:
+        cand = [int(d) for d in idx[::step][:samples]
+                if (int(d) // 1024 < self._full_bands
+                    and int(d) % 1024 < self._full_tiles)]
+        if not cand:
             return 0.0
-        hashes = tile_hash_np(np.stack(raws))
-        return sum(int(h) in self._hash2slot for h in hashes) / len(raws)
+        if hashes is not None:
+            hs = [int(hashes[d // 1024, d % 1024]) for d in cand]
+        else:
+            hs = [int(h) for h in tile_hash_np(self._gather_tiles(frame, cand))]
+        return sum(h in self._hash2slot for h in hs) / len(cand)
 
-    def split(self, frame: np.ndarray, idx: np.ndarray, max_up: int | None = None):
+    def split(self, frame: np.ndarray, idx: np.ndarray, max_up: int | None = None,
+              hashes: np.ndarray | None = None):
         """Dirty tiles -> (upload_idx, pool_dst, copy_pairs), or None.
 
         upload_idx: tiles whose pixels must cross the link;
@@ -166,7 +194,14 @@ class TileCache:
         copy pairs: the device applies copies before pool inserts inside
         one step, so a same-step slot would read stale content. (Across
         frames of a grouped dispatch the scan carry orders inserts
-        before the next frame's copies, matching host call order.)"""
+        before the next frame's copies, matching host call order.)
+
+        ``hashes`` is the fused front-end scan's (nbands, ntiles)
+        content-hash array (FramePrep.scan want_hashes, valid at the
+        dirty tiles `idx` names): with it the split skips its own
+        hashing pass — the values are identical by construction
+        (tests/test_frontend_parallel.py pins them against
+        tile_hash_np)."""
         uploads: list[int] = []
         pool_dst: list[int] = []
         pairs: list[tuple[int, int]] = []
@@ -176,13 +211,31 @@ class TileCache:
             band, tile = d // 1024, d % 1024
             cacheable.append(band < self._full_bands and tile < self._full_tiles)
         tiles_bytes = {}
+        verified: dict[int, bool] = {}
         cidx = [int(d) for d, c in zip(idx, cacheable) if c]
         if cidx:
-            stack = np.stack(
-                [self._tile_bgrx(frame, d // 1024, d % 1024) for d in cidx]
-            )
-            hashes = tile_hash_np(stack)
-            tiles_bytes = {d: (stack[i], int(hashes[i])) for i, d in enumerate(cidx)}
+            stack = self._gather_tiles(frame, cidx)
+            if hashes is not None:
+                cid = np.asarray(cidx, np.int64)
+                hvals = hashes[cid // 1024, cid % 1024]
+            else:
+                hvals = tile_hash_np(stack)
+            tiles_bytes = {d: (stack[i], int(hvals[i])) for i, d in enumerate(cidx)}
+            # batch the hash-hit memcmp verifies: ONE vectorized compare
+            # of every pre-call candidate against its stored bytes
+            # (replacing the per-tile array_equal loop — the split's
+            # dominant cost on scroll frames). Valid because the loop
+            # below only consults a verify for slots looked up from the
+            # PRE-CALL index: an in-call insert is skipped via
+            # new_slots, and an in-call eviction removes the hash so
+            # the lookup misses before it could read a stale verdict.
+            cand = [(i, self._hash2slot.get(int(hvals[i]))) for i in range(len(cidx))]
+            cand = [(i, s) for i, s in cand if s is not None]
+            if cand:
+                ci = np.fromiter((i for i, _ in cand), np.int64, len(cand))
+                cs = np.fromiter((s for _, s in cand), np.int64, len(cand))
+                eq = (stack[ci] == self._store[cs]).all(axis=1)
+                verified = {cidx[int(i)]: bool(e) for i, e in zip(ci, eq)}
         # shadow state: committed only if the frame fits the budget
         h2s = dict(self._hash2slot)
         slot_hash = list(self._slot_hash)
@@ -205,7 +258,7 @@ class TileCache:
             if (
                 slot is not None
                 and slot not in new_slots
-                and np.array_equal(self._store[slot], raw)
+                and verified.get(d, False)
             ):
                 pairs.append((slot, d))
                 stamp[slot] = clock

@@ -367,7 +367,8 @@ class Telemetry:
                    session: str = "0", device_ms: float = 0.0,
                    pack_ms: float = 0.0, unpack_ms: float = 0.0,
                    cavlc_ms: float = 0.0, downlink_mode: str = "",
-                   bits_fetch_ms: float = 0.0) -> None:
+                   bits_fetch_ms: float = 0.0, classify_ms: float = 0.0,
+                   convert_ms: float = 0.0, h2d_ms: float = 0.0) -> None:
         """An encoded access unit left the encoder: fold its size, kind,
         and on-device / entropy-pack milliseconds. unpack/cavlc are the
         completion sub-stages of pack_ms (coefficient prep vs the CAVLC
@@ -376,7 +377,11 @@ class Telemetry:
         into selkies_downlink_mode_total; bits_fetch_ms is the d2h
         transfer of a device-entropy frame's bit words (the "bits_fetch"
         stage), so bits-mode fetch latency stays separable from the
-        coefficient fetch it replaces."""
+        coefficient fetch it replaces. classify/convert/h2d are the
+        uplink front-end sub-stages of the frame's upload cost (fused
+        dirty scan + hash/split, BGRx->I420 of the upload payload, h2d
+        transfer enqueues — ISSUE 12): without this split a regression
+        in the host front-end hides inside the device stage again."""
         if not self.enabled:
             return
         self._observe("selkies_frame_bytes", nbytes, {"session": session})
@@ -402,6 +407,15 @@ class Telemetry:
         if bits_fetch_ms:
             self._observe("selkies_stage_ms", bits_fetch_ms,
                           {"stage": "bits_fetch", "session": session})
+        if classify_ms:
+            self._observe("selkies_stage_ms", classify_ms,
+                          {"stage": "classify", "session": session})
+        if convert_ms:
+            self._observe("selkies_stage_ms", convert_ms,
+                          {"stage": "convert", "session": session})
+        if h2d_ms:
+            self._observe("selkies_stage_ms", h2d_ms,
+                          {"stage": "h2d", "session": session})
         self._record(session, {"ev": "frame", "fid": frame, "bytes": nbytes,
                                "idr": idr, "device_ms": round(device_ms, 3),
                                "pack_ms": round(pack_ms, 3),

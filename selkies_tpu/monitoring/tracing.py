@@ -27,8 +27,16 @@ black-box bundles stay greppable):
 
   solo video loop (pipeline/elements.py):
     capture       FrameSource.capture on the worker thread
-    classify      static/delta/full frame classification incl. the
-                  tile-cache hash/split (models/h264/encoder.py)
+    classify      static/delta/full frame classification: the fused
+                  band-sharded dirty scan (FramePrep.scan, damage-
+                  bounded when the capture layer passes XDamage rect
+                  hints) incl. the tile-cache hash/split
+                  (models/h264/encoder.py). The matching
+                  selkies_stage_ms stage is "classify"; its front-end
+                  siblings "convert" (BGRx→I420 of the upload payload)
+                  and "h2d" (host→device transfer enqueues) are emitted
+                  per frame at frame_done — together they decompose
+                  FrameStats.upload_ms, the host front-end cost
     submit        pipelined encoder dispatch (classify + upload + step)
     encode        synchronous encode_frame path (non-pipelined rows)
     send          sink callback (transport handoff) per access unit
@@ -44,7 +52,11 @@ black-box bundles stay greppable):
                   the frame's — or one BAND's — downlink buffer; with
                   the band-parallel encoder one span per band, so the
                   per-chip step latency is visible per slice); the
-                  matching selkies_stage_ms stage is "step"
+                  matching selkies_stage_ms stage is "step". The clock
+                  starts immediately BEFORE the jitted step call: a
+                  dispatch call that blocks (CPU-backend contention,
+                  full dispatch queue) is device-side backpressure and
+                  counts here, not in upload (PERF.md round 12)
     fetch         device→host coefficient/word downlink
     bits_fetch    device→host transfer of a device-entropy frame's
                   FINAL slice-data bit words. Spans mark only the EXTRA

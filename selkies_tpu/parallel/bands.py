@@ -587,6 +587,9 @@ class BandedH264Encoder:
     """
 
     codec = "h264"
+    # encode_frame/submit take capture-layer damage-rect hints
+    # (FramePrep.scan superset contract)
+    accepts_damage = True
 
     def __init__(self, width: int, height: int, qp: int = 28, fps: int = 60,
                  channels: int = 4, keyframe_interval: int = 0,
@@ -778,7 +781,6 @@ class BandedH264Encoder:
             max_workers=pack_workers, thread_name_prefix="h264-pack")
         self.link_bytes = LinkByteCounter()
         self._ref = None  # stacked (bands, band_h, W) recon triple
-        self._prev_frame: np.ndarray | None = None
         self._allskip: PFrameCoeffs | None = None
         self.frame_index = 0
         self._frames_since_idr = 0
@@ -941,9 +943,14 @@ class BandedH264Encoder:
 
     # -- encoding -------------------------------------------------------
 
-    def encode_frame(self, frame: np.ndarray, qp: int | None = None) -> bytes:
+    def encode_frame(self, frame: np.ndarray, qp: int | None = None,
+                     damage=None) -> bytes:
         """Synchronous encode: (H, W, 4) BGRx uint8 in, complete multi-
-        slice Annex-B access unit out (SPS/PPS prepended on IDR)."""
+        slice Annex-B access unit out (SPS/PPS prepended on IDR).
+
+        ``damage``: optional capture-layer dirty-rect hints (superset
+        contract, FramePrep.scan) bounding the static-detection scan —
+        an idle tick with a tight hint stops reading the whole frame."""
         if qp is not None:
             self.set_qp(qp)
         t0 = time.perf_counter()
@@ -953,20 +960,13 @@ class BandedH264Encoder:
             or (self.keyframe_interval > 0
                 and self._frames_since_idr >= self.keyframe_interval)
         )
-        static = (
-            not idr
-            and self._prev_frame is not None
-            and self._prev_frame.shape == frame.shape
-            # strided probe first: np.array_equal cannot short-circuit,
-            # so without it every full-motion frame would pay two whole-
-            # frame reads (~66 MB at 4K) just to learn it isn't static
-            and np.array_equal(self._prev_frame[::64, ::64], frame[::64, ::64])
-            and np.array_equal(self._prev_frame, frame)
-        )
-        if self._prev_frame is not None and self._prev_frame.shape == frame.shape:
-            np.copyto(self._prev_frame, frame)
-        else:
-            self._prev_frame = frame.copy()
+        # fused band-granular scan (ISSUE 12): dirty detection + the
+        # previous-frame update for dirty bands only, sharded across the
+        # front-end pool — replacing the strided probe + full-frame
+        # array_equal + full-frame copyto triple read/write
+        scan = self._prep.scan(frame, self.width, damage=damage)
+        static = not idr and scan is not None and not scan.tiles.any()
+        classify_ms = (time.perf_counter() - t0) * 1e3
         if static:
             au = self._allskip_au(self._frames_since_idr % 256)
             self.last_stats = FrameStats(
@@ -974,13 +974,19 @@ class BandedH264Encoder:
                 bytes=len(au), device_ms=(time.perf_counter() - t0) * 1e3,
                 pack_ms=0.0, skipped_mbs=self._mbh * self._mbw,
                 bands=self.bands, cols=self.cols,
+                upload_ms=classify_ms, classify_ms=classify_ms,
+                upload_kind="static",
             )
             self.frame_index += 1
             self._frames_since_idr += 1
             return au
+        t_c0 = time.perf_counter()
         y, u, v = self._prep.convert(frame)
+        t_h0 = time.perf_counter()
         parts = self._put_band_planes(y, u, v)
         t_up = time.perf_counter()
+        convert_ms = (t_h0 - t_c0) * 1e3
+        h2d_ms = (t_up - t_h0) * 1e3
         qp32 = np.int32(self.qp)
         try:
             if idr:
@@ -992,7 +998,7 @@ class BandedH264Encoder:
             # a failed/aborted step may have consumed the donated refs:
             # null them so the next frame self-heals as an IDR
             self._ref = None
-            self._prev_frame = None
+            self._prep.reset()
             raise
         # hint-sized fused slices, dispatched from the submit thread
         # right behind the step (a later slice op would queue behind
@@ -1043,7 +1049,7 @@ class BandedH264Encoder:
             # null the chain so the next frame self-heals as a full IDR
             # instead of silently desyncing the decoder
             self._ref = None
-            self._prev_frame = None
+            self._prep.reset()
             raise
         t_done = time.perf_counter()
         nals = [r[0] for r in results]
@@ -1076,12 +1082,14 @@ class BandedH264Encoder:
             bytes=len(au), device_ms=(t_fetched - t0) * 1e3,
             pack_ms=unpack_ms + cavlc_ms, skipped_mbs=skipped,
             unpack_ms=unpack_ms, cavlc_ms=cavlc_ms,
-            # upload_ms spans the whole host dispatch (static probe,
-            # BGRx->I420 conversion, h2d enqueue) — the same boundary as
-            # the solo sync path, so a bands-vs-solo A/B attributes
-            # conversion time identically on both rows
+            # upload_ms spans the whole host front-end (fused dirty
+            # scan, BGRx->I420 conversion, h2d enqueue) — the same
+            # boundary as the solo sync path, so a bands-vs-solo A/B
+            # attributes conversion time identically on both rows; the
+            # classify/convert/h2d split is the ISSUE 12 contract
             upload_ms=(t_up - t0) * 1e3, step_ms=step_ms,
             fetch_ms=fetch_ms, bands=self.bands, cols=self.cols,
+            classify_ms=classify_ms, convert_ms=convert_ms, h2d_ms=h2d_ms,
             band_step_ms=band_step, downlink_mode=downlink_mode,
         )
         self.last_stats = stats
@@ -1093,13 +1101,14 @@ class BandedH264Encoder:
         self._frames_since_idr += 1
         return au
 
-    def submit(self, frame: np.ndarray, qp: int | None = None, meta=None) -> list:
+    def submit(self, frame: np.ndarray, qp: int | None = None, meta=None,
+               damage=None) -> list:
         """Pipelined-API adapter (encoder.py submit/flush contract): the
         band encoder overlaps WITHIN the frame (N chips + the pack pool)
         rather than across frames, so submit completes synchronously and
         returns its one (au, stats, meta) triple immediately. Lets
         bench.py and the VideoPipeline drive either encoder unchanged."""
-        au = self.encode_frame(frame, qp)
+        au = self.encode_frame(frame, qp, damage=damage)
         return [(au, self.last_stats, meta)]
 
     def flush(self) -> list:
@@ -1113,7 +1122,7 @@ class BandedH264Encoder:
         self.encode_frame(rng.integers(0, 255, shape, np.uint8))
         self._force_idr = True
         self._ref = None
-        self._prev_frame = None
+        self._prep.reset()
         self.frame_index = 0
         self._frames_since_idr = 0
         self._idr_pic_id = 0

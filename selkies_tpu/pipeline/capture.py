@@ -37,6 +37,12 @@ _IPC_PRIVATE = 0
 _IPC_CREAT = 0o1000
 _IPC_RMID = 0
 _GEOMETRY_POLL_S = 1.0  # resize detection interval (avoid a sync X round trip per frame)
+_DAMAGE_REPORT_RAW_RECTANGLES = 0  # XDamageReportRawRectangles (damagewire.h)
+_XEVENT_BYTES = 192  # sizeof(XEvent): 24 longs on LP64
+# past this many rects per drain the damage plainly covers most of the
+# frame and the hint saves nothing — publish "unknown" (full scan)
+# instead of paying per-rect bookkeeping in exactly the busy regime
+_DAMAGE_MAX_RECTS = 256
 
 # Xlib's default error handler calls exit(1) on any async error (e.g. the
 # server rejecting XShmAttach for a remote client) — install a recording
@@ -88,6 +94,29 @@ class _XShmSegmentInfo(ctypes.Structure):
         ("shmid", ctypes.c_int),
         ("shmaddr", ctypes.c_void_p),
         ("readOnly", ctypes.c_int),
+    ]
+
+
+class _XRectangle(ctypes.Structure):
+    _fields_ = [
+        ("x", ctypes.c_short), ("y", ctypes.c_short),
+        ("width", ctypes.c_ushort), ("height", ctypes.c_ushort),
+    ]
+
+
+class _XDamageNotifyEvent(ctypes.Structure):
+    _fields_ = [
+        ("type", ctypes.c_int),
+        ("serial", ctypes.c_ulong),
+        ("send_event", ctypes.c_int),
+        ("display", ctypes.c_void_p),
+        ("drawable", ctypes.c_ulong),
+        ("damage", ctypes.c_ulong),
+        ("level", ctypes.c_int),
+        ("more", ctypes.c_int),
+        ("timestamp", ctypes.c_ulong),
+        ("area", _XRectangle),
+        ("geometry", _XRectangle),
     ]
 
 
@@ -167,6 +196,23 @@ class X11CaptureSource:
                     logger.warning("MIT-SHM setup failed (%s); using XGetImage", e)
         if self._shm_img is None:
             logger.info("capture via XGetImage round trips (no MIT-SHM)")
+        # XDamage dirty-rect hints (the reference's ximagesrc analogue):
+        # the damage-bounded classifier (FramePrep.scan) reads
+        # `last_damage` — a SUPERSET of the pixels that changed since the
+        # previous grab, or None when unknown (full scan). Fail-soft: no
+        # libXdamage / remote display / SELKIES_XDAMAGE=0 just means
+        # every frame scans fully, exactly the pre-hint behaviour.
+        self.last_damage: list[tuple[int, int, int, int]] | None = None
+        self._xdmg = None
+        self._damage_handle = 0
+        self._damage_event_base = 0
+        self._prev_drain: list[tuple[int, int, int, int]] | None = None
+        if os.environ.get("SELKIES_XDAMAGE", "1") != "0":
+            try:
+                self._setup_damage()
+            except (OSError, AttributeError) as e:
+                logger.info("XDamage unavailable (%s); full-frame scans", e)
+                self._xdmg = None
 
     # -- ctypes declarations -------------------------------------------
 
@@ -214,6 +260,92 @@ class X11CaptureSource:
         libc.shmat.argtypes = [i, vp, i]
         libc.shmdt.argtypes = [vp]
         libc.shmctl.argtypes = [i, i, vp]
+
+    # -- XDamage dirty-rect hints ---------------------------------------
+
+    def _setup_damage(self) -> None:
+        xd = _load("libXdamage.so.1", "libXdamage.so")
+        if xd is None:
+            raise OSError("libXdamage not found")
+        vp, i, ul = ctypes.c_void_p, ctypes.c_int, ctypes.c_ulong
+        xd.XDamageQueryExtension.restype = i
+        xd.XDamageQueryExtension.argtypes = [vp, ctypes.POINTER(i),
+                                             ctypes.POINTER(i)]
+        xd.XDamageCreate.restype = ul
+        xd.XDamageCreate.argtypes = [vp, ul, i]
+        xd.XDamageDestroy.argtypes = [vp, ul]
+        xd.XDamageSubtract.argtypes = [vp, ul, ul, ul]
+        self._x.XPending.restype = i
+        self._x.XPending.argtypes = [vp]
+        self._x.XNextEvent.argtypes = [vp, ctypes.c_void_p]
+        ev_base, err_base = ctypes.c_int(0), ctypes.c_int(0)
+        if not xd.XDamageQueryExtension(self._dpy, ctypes.byref(ev_base),
+                                        ctypes.byref(err_base)):
+            raise OSError("XDamage extension not present")
+        _last_x_error.clear()
+        # raw rectangles: one event per drawing op, so the drain below
+        # sees every damaged area without a region fetch round trip
+        handle = xd.XDamageCreate(self._dpy, self._root,
+                                  _DAMAGE_REPORT_RAW_RECTANGLES)
+        self._x.XSync(self._dpy, 0)
+        if not handle or _last_x_error:
+            raise OSError("XDamageCreate rejected")
+        self._xdmg = xd
+        self._damage_handle = handle
+        self._damage_event_base = ev_base.value
+        logger.info("XDamage dirty-rect hints armed (event base %d)",
+                    ev_base.value)
+
+    def _teardown_damage(self) -> None:
+        if self._xdmg is not None and self._damage_handle:
+            self._xdmg.XDamageDestroy(self._dpy, self._damage_handle)
+            self._damage_handle = 0
+        self._xdmg = None
+
+    def _drain_damage(self) -> None:
+        """Collect the damage rects delivered since the previous drain
+        and publish `last_damage`.
+
+        Ordering contract (the superset guarantee): this runs AFTER the
+        grab plus an XSync, so every draw that landed before the grab's
+        server time has its event in the queue. A draw racing the grab
+        may deliver its event to THIS drain while its pixels only land
+        in the NEXT grab — so the published hint is the union of the
+        current and previous drains, which covers both sides of the
+        race at the cost of one frame of extra rects."""
+        ev = ctypes.create_string_buffer(_XEVENT_BYTES)
+        rects: list[tuple[int, int, int, int]] = []
+        notify_type = self._damage_event_base  # XDamageNotify = base + 0
+        overflow = False
+        while self._x.XPending(self._dpy):
+            # the queue must drain either way (unconsumed events grow
+            # without bound); past the cap we stop parsing rects — a
+            # busy full-repaint frame gains nothing from hints and
+            # should not pay per-rect bookkeeping (raw-rectangle
+            # reporting is kept because the coalescing levels need a
+            # region fetch round trip to read the area back)
+            self._x.XNextEvent(self._dpy, ev)
+            etype = ctypes.cast(ev, ctypes.POINTER(ctypes.c_int)).contents.value
+            if etype == notify_type and not overflow:
+                dn = ctypes.cast(ev, ctypes.POINTER(_XDamageNotifyEvent)).contents
+                rects.append((int(dn.area.x), int(dn.area.y),
+                              int(dn.area.width), int(dn.area.height)))
+                overflow = len(rects) > _DAMAGE_MAX_RECTS
+        # keep the accumulated region empty (raw events keep firing
+        # either way; an ever-growing region costs server memory)
+        self._xdmg.XDamageSubtract(self._dpy, self._damage_handle, 0, 0)
+        if overflow:
+            # unknown coverage: this frame AND the next must full-scan
+            # (the next frame's union would otherwise miss this drain)
+            self._prev_drain = None
+            self.last_damage = None
+            return
+        if self._prev_drain is None:
+            # first drain since (re)arming/overflow: no usable reference
+            self.last_damage = None
+        else:
+            self.last_damage = self._prev_drain + rects
+        self._prev_drain = rects
 
     # -- SHM lifecycle --------------------------------------------------
 
@@ -300,6 +432,10 @@ class X11CaptureSource:
                     self._setup_shm(w, h)
                 self._raw_w, self._raw_h = w, h
                 self.width, self.height = w + (w & 1), h + (h & 1)
+                # geometry moved: pending damage rects describe the old
+                # layout — force one full scan
+                self._prev_drain = None
+                self.last_damage = None
         if self._shm_img is not None:
             if not self._xext.XShmGetImage(
                 self._dpy, self._root, self._shm_img, 0, 0, _ALL_PLANES
@@ -308,6 +444,10 @@ class X11CaptureSource:
             img = self._shm_img.contents
             buf = ctypes.string_at(img.data, img.bytes_per_line * img.height)
             frame = np.frombuffer(buf, np.uint8).reshape(img.height, img.bytes_per_line)
+            if self._xdmg is not None:
+                # after the grab: XShmGetImage's reply serialized every
+                # earlier damage event into the queue (see _drain_damage)
+                self._drain_damage()
             return pad_frame_to_even(np.ascontiguousarray(
                 frame[:, : img.width * 4].reshape(img.height, img.width, 4)))
         # raw geometry, not the poll's locals: within the 1 s poll
@@ -323,6 +463,8 @@ class X11CaptureSource:
             img = ptr.contents
             buf = ctypes.string_at(img.data, img.bytes_per_line * img.height)
             frame = np.frombuffer(buf, np.uint8).reshape(img.height, img.bytes_per_line)
+            if self._xdmg is not None:
+                self._drain_damage()
             return pad_frame_to_even(np.ascontiguousarray(
                 frame[:, : img.width * 4].reshape(img.height, img.width, 4)))
         finally:
@@ -330,6 +472,7 @@ class X11CaptureSource:
 
     def close(self) -> None:
         if self._dpy:
+            self._teardown_damage()
             self._teardown_shm()
             self._x.XCloseDisplay(self._dpy)
             self._dpy = None

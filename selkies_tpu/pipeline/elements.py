@@ -37,7 +37,18 @@ class FrameSource(Protocol):
 
 
 class SyntheticSource:
-    """Animated desktop-like test source (the stack's videotestsrc)."""
+    """Animated desktop-like test source (the stack's videotestsrc).
+
+    Publishes ``last_damage`` after every capture — the rects that cover
+    everything that changed since the previous grab (cursor old+new
+    positions, the scrolling noise region), mirroring what the X11
+    source reports from XDamage, including its one-drop immunity: the
+    published list is the UNION of the previous and current captures'
+    rects, so a consumer whose reference is one frame older than the
+    latest grab (a dropped/failed tick) still holds a superset. The
+    pipeline forwards them to the encoder's damage-bounded classifier; a
+    superset is always valid, so the first capture reports the whole
+    frame."""
 
     def __init__(self, width: int = 1280, height: int = 720, seed: int = 0):
         self.width = width
@@ -48,6 +59,9 @@ class SyntheticSource:
         self._base[height // 3 : 2 * height // 3, width // 8 : width // 2] = (250, 250, 250, 0)
         self._noise = rng.integers(0, 255, (height // 3, width // 3, 4), dtype=np.uint8)
         self._tick = 0
+        self._prev_cursor: tuple[int, int] | None = None
+        self._prev_rects: list[tuple[int, int, int, int]] | None = None
+        self.last_damage: list[tuple[int, int, int, int]] | None = None
 
     def capture(self) -> np.ndarray:
         f = self._base.copy()
@@ -57,6 +71,18 @@ class SyntheticSource:
         f[y : y + 16, x : x + 16] = (0, 0, 0, 0)
         h3, w3 = self._noise.shape[:2]
         f[-h3:, -w3:] = np.roll(self._noise, self._tick, axis=1)
+        if self._prev_cursor is None:
+            rects = None  # first grab: no reference
+        else:
+            px, py = self._prev_cursor
+            rects = [
+                (px, py, 16, 16), (x, y, 16, 16),
+                (self.width - w3, self.height - h3, w3, h3),
+            ]
+        self.last_damage = (None if rects is None or self._prev_rects is None
+                            else self._prev_rects + rects)
+        self._prev_rects = rects
+        self._prev_cursor = (x, y)
         self._tick += 1
         return f
 
@@ -86,6 +112,18 @@ def scroll_trace(width: int, height: int, n: int, *, band0: int = 2,
         f[r0 : r0 + bands * 16] = strip[16 * i : 16 * (i + bands)]
         frames.append(f)
     return frames
+
+
+def window_move_x(i: int, width: int, tile_w: int) -> int:
+    """Frame i's window x-position in window_move_trace (one tile per
+    frame right, then back left). Single definition so the bench's
+    damage-rect hints (bench._scenario_damage) derive the changed
+    region from the SAME formula the trace draws with — a drifted copy
+    would silently break the hint's superset contract."""
+    ww = 3 * tile_w
+    max_x = (width - ww) // tile_w
+    step = i % (2 * max_x)
+    return (step if step < max_x else 2 * max_x - step) * tile_w
 
 
 def window_move_trace(width: int, height: int, n: int, *, tile_w: int | None = None,
@@ -118,8 +156,7 @@ def window_move_trace(width: int, height: int, n: int, *, tile_w: int | None = N
             f"{width}x{height} too small for a {ww}x{wh} window moving by {tile_w}")
     frames = []
     for i in range(n):
-        step = i % (2 * max_x)
-        x = (step if step < max_x else 2 * max_x - step) * tile_w
+        x = window_move_x(i, width, tile_w)
         f = base.copy()
         f[y0 : y0 + wh, x : x + ww] = win
         frames.append(f)
@@ -172,6 +209,12 @@ class EncodedFrame:
     upload_ms: float = 0.0
     step_ms: float = 0.0
     fetch_ms: float = 0.0
+    # front-end sub-split of upload_ms (models/stats.FrameStats): fused
+    # dirty scan + hash/split, BGRx->I420 of the upload payload, h2d
+    # transfer enqueues
+    classify_ms: float = 0.0
+    convert_ms: float = 0.0
+    h2d_ms: float = 0.0
     bands: int = 1
     cols: int = 1
     # P downlink payload mode ("coeff"/"bits"/"dense"; "" = no downlink
@@ -251,6 +294,11 @@ class VideoPipeline:
         # loop delivers them right after the tick await (asyncio.Event
         # is not thread-safe, so the worker never touches the outbox)
         self._policy_drained: list[EncodedFrame] = []
+        # damage hints are only forwarded while the encoder's previous-
+        # frame state is exactly one capture behind the source's rects;
+        # any failed/dropped tick AFTER a capture breaks that pairing
+        # and forces one full-scan submit to resync (superset contract)
+        self._damage_stale = True
 
     @property
     def running(self) -> bool:
@@ -335,6 +383,7 @@ class VideoPipeline:
                             "frame %dx%d != encoder %dx%d and no resize handler; dropping",
                             frame.shape[1], frame.shape[0], self.encoder.width, self.encoder.height,
                         )
+                        self._damage_stale = True  # captured but not encoded
                         continue
                     old = self.encoder
                     self.encoder = self.on_geometry_change(frame.shape[1], frame.shape[0])
@@ -349,6 +398,7 @@ class VideoPipeline:
                         # would turn one failed resize into a per-tick
                         # encode exception and climb the recovery ladder
                         self.dropped_frames += 1
+                        self._damage_stale = True  # captured but not encoded
                         continue
                 qp = self.rc.frame_qp()
                 ts = int((time.monotonic() - t0) * 90000)
@@ -366,7 +416,27 @@ class VideoPipeline:
                     # events correlate without API changes
                     with tracer.span("submit"), \
                             telemetry.span("submit", fid, session=self.session):
-                        done = await asyncio.to_thread(self.encoder.submit, frame, qp, ts)
+                        if getattr(self.encoder, "accepts_damage", False):
+                            # capture-layer damage hints (XDamage /
+                            # synthetic dirty boxes) bound the encoder's
+                            # classify scan — supersets of the changed
+                            # pixels, never byte-bearing. After a failed
+                            # or dropped tick the hints are STALE: the
+                            # encoder's previous-frame state is >=2
+                            # captures behind while the source's rects
+                            # only cover the latest deltas, so a hinted
+                            # scan could miss real changes (superset
+                            # contract broken). One full scan resyncs.
+                            damage = (None if self._damage_stale
+                                      else getattr(self.source,
+                                                   "last_damage", None))
+                            done = await asyncio.to_thread(
+                                self.encoder.submit, frame, qp, ts,
+                                damage=damage)
+                            self._damage_stale = False
+                        else:
+                            done = await asyncio.to_thread(
+                                self.encoder.submit, frame, qp, ts)
                     efs = [
                         self._ef_from_stats(au, stats, meta,
                                             self._fid_by_ts.pop(meta, 0))
@@ -388,6 +458,8 @@ class VideoPipeline:
                             session=self.session, device_ms=ef.device_ms,
                             pack_ms=ef.pack_ms, unpack_ms=ef.unpack_ms,
                             cavlc_ms=ef.cavlc_ms,
+                            classify_ms=ef.classify_ms,
+                            convert_ms=ef.convert_ms, h2d_ms=ef.h2d_ms,
                             downlink_mode=ef.downlink_mode,
                             bits_fetch_ms=(ef.fetch_ms
                                            if ef.downlink_mode == "bits"
@@ -399,6 +471,10 @@ class VideoPipeline:
                 raise
             except Exception as exc:
                 failures += 1
+                # the capture (and its damage drain) may have happened
+                # before the failure: the next hinted scan would miss
+                # the lost frame's rects — resync with one full scan
+                self._damage_stale = True
                 logger.exception("video pipeline frame error (%d consecutive)", failures)
                 if self.supervisor is not None:
                     # supervised: the ladder handles escalation (force IDR,
@@ -452,6 +528,9 @@ class VideoPipeline:
             upload_ms=getattr(stats, "upload_ms", 0.0),
             step_ms=getattr(stats, "step_ms", 0.0),
             fetch_ms=getattr(stats, "fetch_ms", 0.0),
+            classify_ms=getattr(stats, "classify_ms", 0.0),
+            convert_ms=getattr(stats, "convert_ms", 0.0),
+            h2d_ms=getattr(stats, "h2d_ms", 0.0),
             bands=getattr(stats, "bands", 1),
             cols=getattr(stats, "cols", 1),
             downlink_mode=getattr(stats, "downlink_mode", ""),
@@ -485,7 +564,9 @@ class VideoPipeline:
                     ef.frame_id, len(ef.au), idr=ef.idr,
                     session=self.session, device_ms=ef.device_ms,
                     pack_ms=ef.pack_ms, unpack_ms=ef.unpack_ms,
-                    cavlc_ms=ef.cavlc_ms, downlink_mode=ef.downlink_mode,
+                    cavlc_ms=ef.cavlc_ms, classify_ms=ef.classify_ms,
+                    convert_ms=ef.convert_ms, h2d_ms=ef.h2d_ms,
+                    downlink_mode=ef.downlink_mode,
                     bits_fetch_ms=(ef.fetch_ms
                                    if ef.downlink_mode == "bits" else 0.0))
             self._policy_drained.append(ef)

@@ -10,6 +10,7 @@ Grammar (semicolon-separated rules)::
     SELKIES_FAULTS = rule (";" rule)*
     rule   = site "@" sched ":" action
     site   = capture | encoder | send | signalling      (serving path)
+           | frontend                                   (uplink front-end)
            | admission | recarve | migrate | drain      (fleet lifecycle)
            | policy                                     (scenario policy)
            (wired sites; names are free-form)
@@ -31,7 +32,12 @@ policy engine's per-tick decide (selkies_tpu/policy; fleet slots are
 ``policy:<k>``): ``flap`` forces a misclassification the hysteresis
 must absorb, ``drop`` skips the evaluation, and repeated ``raise``
 wedges the engine — which must DISARM back to static knobs instead of
-stalling the serving loop (tests/test_chaos.py).
+stalling the serving loop (tests/test_chaos.py). ``frontend`` fires at
+the top of the pipelined encoder's submit — inside the uplink
+classify/hash/convert stage — so a ``raise`` exercises the
+double-buffered front-end's failure contract: frames already in flight
+stay deliverable in order, and the next submit self-heals as a
+full-upload IDR (tests/test_frontend_parallel.py).
 
 Examples::
 

@@ -1,0 +1,348 @@
+"""ISSUE 12 — parallel overlapped uplink front-end.
+
+Byte contracts of the fused band-sharded classify/hash/convert scan:
+
+* sharded (worker-pool) scan output is byte-identical to the serial
+  oracle (SELKIES_PARALLEL_FRONTEND=0) — dirty map, hashes AND the
+  updated previous-frame state — on randomized scenario-shaped traces
+  including the odd 4K-DCI-panning geometry 4095x2159, workers 1/2/4;
+* damage-rect hints (authoritative supersets) never change any output
+  vs a full scan, and the periodic full-scan ratchet fires;
+* the scan's fused tile hashes equal tilecache.tile_hash_np exactly
+  (the cache's correctness depends on it);
+* the vectorized numpy fallback equals the native path (the historical
+  per-tile Python loop is gone — this is its regression pin);
+* encoder-level: AU streams are sha256-identical parallel vs serial vs
+  damage-hinted, and the double-buffered pipeline survives a
+  SELKIES_FAULTS "frontend" fault with in-flight frames delivered in
+  order.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from selkies_tpu.models import frameprep
+from selkies_tpu.models.frameprep import FramePrep, tile_width_for
+from selkies_tpu.models.tilecache import TileCache, tile_hash_np
+from selkies_tpu.resilience.faultinject import configure_faults, reset_faults
+
+
+def _mutate(rng, frame, n_regions: int, h: int, w: int) -> np.ndarray:
+    f = frame.copy()
+    for _ in range(n_regions):
+        rh = int(rng.integers(1, 40))
+        rw = int(rng.integers(1, 60))
+        y = int(rng.integers(0, h - rh))
+        x = int(rng.integers(0, w - rw))
+        f[y : y + rh, x : x + rw] = rng.integers(0, 255, (rh, rw, 4), np.uint8)
+    return f
+
+
+def _prep(w: int, h: int) -> FramePrep:
+    pad_w, pad_h = (w + 15) // 16 * 16, (h + 15) // 16 * 16
+    return FramePrep(w, h, pad_w, pad_h, nslots=2)
+
+
+def _use_workers(monkeypatch, n: int | None) -> None:
+    """Re-point the shared front-end pool at `n` workers (None = serial
+    oracle via SELKIES_PARALLEL_FRONTEND=0)."""
+    if n is None:
+        monkeypatch.setenv("SELKIES_PARALLEL_FRONTEND", "0")
+    else:
+        monkeypatch.setenv("SELKIES_PARALLEL_FRONTEND", "1")
+        monkeypatch.setenv("SELKIES_FRONTEND_WORKERS", str(n))
+    pool, frameprep._fe_pool = frameprep._fe_pool, None
+    if pool is not None:
+        pool.shutdown(wait=False)
+
+
+@pytest.mark.parametrize("geom", [(640, 368), (612, 347)])
+@pytest.mark.parametrize("workers", [1, 2, 4])
+def test_sharded_scan_matches_serial(monkeypatch, geom, workers):
+    w, h = geom
+    rng = np.random.default_rng(workers * 100 + w)
+    tw = tile_width_for(w)
+    frames = [rng.integers(0, 255, (h, w, 4), np.uint8)]
+    for i in range(5):
+        frames.append(_mutate(rng, frames[-1], int(rng.integers(0, 9)), h, w))
+
+    _use_workers(monkeypatch, None)
+    serial = _prep(w, h)
+    oracle = []
+    for f in frames:
+        r = serial.scan(f, tw, want_hashes=True)
+        oracle.append(None if r is None else
+                      (r.tiles.copy(),
+                       r.hashes.copy(), serial._prev.copy()))
+
+    _use_workers(monkeypatch, workers)
+    par = _prep(w, h)
+    for f, exp in zip(frames, oracle):
+        r = par.scan(f, tw, want_hashes=True)
+        if exp is None:
+            assert r is None
+            continue
+        tiles, hashes, prev = exp
+        assert np.array_equal(r.tiles, tiles)
+        assert np.array_equal(par._prev, prev)
+        # hashes compare at dirty cacheable tiles (the defined region)
+        fb, ft = h // 16, w // tw
+        bi, ti = np.nonzero(tiles)
+        for b, t in zip(bi, ti):
+            if b < fb and t < ft:
+                assert r.hashes[b, t] == hashes[b, t]
+
+
+@pytest.mark.slow
+def test_sharded_scan_odd_4k_dci(monkeypatch):
+    """The 4095x2159 odd-geometry pin at real scale (marked slow)."""
+    w, h = 4095, 2159
+    rng = np.random.default_rng(7)
+    tw = tile_width_for(w)
+    f0 = rng.integers(0, 255, (h, w, 4), np.uint8)
+    f1 = _mutate(rng, f0, 12, h, w)
+    _use_workers(monkeypatch, None)
+    serial = _prep(w, h)
+    serial.scan(f0, tw)
+    exp = serial.scan(f1, tw, want_hashes=True)
+    for workers in (2, 4):
+        _use_workers(monkeypatch, workers)
+        par = _prep(w, h)
+        par.scan(f0, tw)
+        got = par.scan(f1, tw, want_hashes=True)
+        assert np.array_equal(got.tiles, exp.tiles)
+        assert np.array_equal(par._prev, serial._prev)
+
+
+def test_damage_superset_equals_full_scan():
+    w, h = 640, 368
+    rng = np.random.default_rng(3)
+    tw = tile_width_for(w)
+    full = _prep(w, h)
+    hinted = _prep(w, h)
+    f = rng.integers(0, 255, (h, w, 4), np.uint8)
+    full.scan(f, tw)
+    hinted.scan(f, tw)
+    for i in range(6):
+        g = f.copy()
+        rects = []
+        for _ in range(int(rng.integers(1, 4))):
+            y, x = int(rng.integers(0, h - 24)), int(rng.integers(0, w - 24))
+            rh, rw = int(rng.integers(1, 20)), int(rng.integers(1, 20))
+            g[y : y + rh, x : x + rw] = rng.integers(0, 255)
+            # superset rect: padded beyond the touched region
+            rects.append((max(0, x - 5), max(0, y - 5), rw + 10, rh + 10))
+        exp = full.scan(g, tw, want_hashes=True)
+        got = hinted.scan(g, tw, damage=rects, want_hashes=True)
+        assert np.array_equal(got.tiles, exp.tiles)
+        assert np.array_equal(hinted._prev, full._prev)
+        f = g
+    # empty damage = nothing changed: clean result, no scan
+    exp = full.scan(f, tw)
+    got = hinted.scan(f, tw, damage=[])
+    assert not exp.tiles.any() and not got.tiles.any()
+
+
+def test_damage_full_scan_ratchet(monkeypatch):
+    monkeypatch.setenv("SELKIES_DAMAGE_FULL_SCAN", "3")
+    w, h = 320, 192
+    prep = _prep(w, h)
+    tw = tile_width_for(w)
+    rng = np.random.default_rng(0)
+    f = rng.integers(0, 255, (h, w, 4), np.uint8)
+    prep.scan(f, tw)
+    seen_full = 0
+    for i in range(6):
+        r = prep.scan(f, tw, damage=[])
+        seen_full += int(r.full_scan)
+    assert seen_full == 2  # every 3rd scan walks the whole frame
+
+
+def test_scan_hashes_match_tile_hash_np():
+    w, h = 640, 368
+    rng = np.random.default_rng(11)
+    tw = tile_width_for(w)
+    prep = _prep(w, h)
+    f0 = rng.integers(0, 255, (h, w, 4), np.uint8)
+    f1 = _mutate(rng, f0, 10, h, w)
+    prep.scan(f0, tw)
+    res = prep.scan(f1, tw, want_hashes=True)
+    fb, ft = h // 16, w // tw
+    bi, ti = np.nonzero(res.tiles)
+    checked = 0
+    for b, t in zip(bi, ti):
+        if b < fb and t < ft:
+            raw = np.ascontiguousarray(
+                f1[b * 16 : (b + 1) * 16, t * tw : (t + 1) * tw]).reshape(1, -1)
+            assert res.hashes[b, t] == tile_hash_np(raw)[0]
+            checked += 1
+    assert checked > 0
+
+
+def test_numpy_fallback_matches_native():
+    """Satellite regression: the vectorized reshape+any fallback must
+    pin the native fused scan exactly (it replaced the O(ntiles)
+    per-tile Python loop)."""
+    w, h = 612, 347  # odd geometry: partial edge tiles exercised
+    rng = np.random.default_rng(5)
+    tw = tile_width_for(w)
+    native = _prep(w, h)
+    if not native.native:
+        pytest.skip("libframeprep.so unavailable")
+    fallback = _prep(w, h)
+    fallback._lib = None
+    f = rng.integers(0, 255, (h, w, 4), np.uint8)
+    native.scan(f, tw)
+    fallback.scan(f, tw)
+    for i in range(5):
+        f = _mutate(rng, f, int(rng.integers(0, 7)), h, w)
+        dmg = None if i % 2 else [(0, 0, w, h // 2), (0, h // 2, w, h - h // 2)]
+        rn = native.scan(f, tw, damage=dmg, want_hashes=True)
+        rf = fallback.scan(f, tw, damage=dmg, want_hashes=True)
+        assert np.array_equal(rn.tiles, rf.tiles)
+        assert np.array_equal(native._prev, fallback._prev)
+        fb, ft = h // 16, w // tw
+        bi, ti = np.nonzero(rn.tiles)
+        for b, t in zip(bi, ti):
+            if b < fb and t < ft:
+                assert rn.hashes[b, t] == rf.hashes[b, t]
+
+
+def test_split_with_scan_hashes_matches_plain_split():
+    w, h = 640, 368
+    rng = np.random.default_rng(9)
+    tw = tile_width_for(w)
+    prep_a, prep_b = _prep(w, h), _prep(w, h)
+    tc_a = TileCache(h, w, tw, 64)
+    tc_b = TileCache(h, w, tw, 64)
+    f = rng.integers(0, 255, (h, w, 4), np.uint8)
+    prep_a.scan(f, tw)
+    prep_b.scan(f, tw)
+    for _ in range(6):
+        f = _mutate(rng, f, 6, h, w)
+        res = prep_a.scan(f, tw, want_hashes=True)
+        prep_b.scan(f, tw)
+        bi, ti = np.nonzero(res.tiles)
+        idx = (bi * 1024 + ti).astype(np.int32)
+        a = tc_a.split(f, idx, hashes=res.hashes)
+        b = tc_b.split(f, idx)
+        for xa, xb in zip(a, b):
+            assert np.array_equal(xa, xb)
+    assert (tc_a.hits, tc_a.misses, tc_a.evictions) == (
+        tc_b.hits, tc_b.misses, tc_b.evictions)
+
+
+# -- encoder-level byte identity -------------------------------------------
+
+
+def _scrollish_frames(w: int, h: int, n: int, seed: int = 21):
+    """Scroll + typing + blink mix covering static/delta/remap/full."""
+    rng = np.random.default_rng(seed)
+    base = np.full((h, w, 4), 230, np.uint8)
+    strip = rng.integers(0, 255, (16 * (4 + n), w, 4), np.uint8)
+    frames = []
+    for i in range(n):
+        f = base.copy()
+        if i % 7 == 6:
+            f = rng.integers(0, 255, (h, w, 4), np.uint8)  # full change
+            base = f.copy()
+        else:
+            f[32 : 32 + 64] = strip[16 * i : 16 * (i + 4)]
+            if i % 2:
+                f[h - 20 : h - 8, 8:20] = 0  # blink
+        frames.append(f)
+    return frames
+
+
+def _run_encoder(monkeypatch, workers, damage_fn=None, faults=None):
+    from selkies_tpu.models.h264.encoder import TPUH264Encoder
+
+    _use_workers(monkeypatch, workers)
+    w, h = 320, 192
+    frames = _scrollish_frames(w, h, 16)
+    enc = TPUH264Encoder(w, h, qp=30, frame_batch=2, pipeline_depth=1,
+                         scene_qp_boost=0)
+    aus = []
+    indices = []
+    faulted = 0
+    try:
+        for i, f in enumerate(frames):
+            dmg = damage_fn(i) if damage_fn else None
+            try:
+                outs = enc.submit(f, None, i, damage=dmg)
+            except RuntimeError:
+                faulted += 1
+                continue
+            for au, st, meta in outs:
+                aus.append(au)
+                indices.append(st.frame_index)
+        for au, st, meta in enc.flush():
+            aus.append(au)
+            indices.append(st.frame_index)
+    finally:
+        enc.close()
+    # completion order must stay submission order
+    assert indices == sorted(indices)
+    return hashlib.sha256(b"".join(aus)).hexdigest(), len(aus), faulted
+
+
+def test_encoder_bytes_parallel_vs_serial_vs_damage(monkeypatch):
+    sha_serial, n_serial, _ = _run_encoder(monkeypatch, None)
+    sha_par, n_par, _ = _run_encoder(monkeypatch, 2)
+    assert (sha_par, n_par) == (sha_serial, n_serial)
+
+    w, h = 320, 192
+
+    def damage(i):
+        if i == 0 or i % 7 == 6:
+            return None  # full change / first frame: unknown
+        rects = [(0, 32, w, 64)]
+        if i % 2:
+            rects.append((8, h - 20, 12, 12))
+        if (i - 1) % 2 and i >= 1:
+            rects.append((8, h - 20, 12, 12))  # previous blink restored
+        return rects
+
+    sha_dmg, n_dmg, _ = _run_encoder(monkeypatch, 2, damage_fn=damage)
+    assert (sha_dmg, n_dmg) == (sha_serial, n_serial)
+
+
+def test_frontend_fault_keeps_inflight_frames_ordered(monkeypatch):
+    """SELKIES_FAULTS frontend site: a fault in the front-end stage of
+    frame N must not disturb frames already double-buffered in flight —
+    they deliver in order, and the stream continues (the faulted frame
+    is simply never dispatched, so no IDR/self-heal is even needed)."""
+    configure_faults("frontend@4,9:raise")
+    try:
+        sha, n, faulted = _run_encoder(monkeypatch, 2)
+    finally:
+        reset_faults()
+    assert faulted == 2
+    assert n == 16 - 2
+
+
+def test_encoder_stats_carry_frontend_split(monkeypatch):
+    from selkies_tpu.models.h264.encoder import TPUH264Encoder
+
+    _use_workers(monkeypatch, 2)
+    w, h = 320, 192
+    frames = _scrollish_frames(w, h, 8)
+    enc = TPUH264Encoder(w, h, qp=30, frame_batch=2, pipeline_depth=1)
+    stats = []
+    for i, f in enumerate(frames):
+        stats.extend(st for _, st, _ in enc.submit(f, None, i))
+    stats.extend(st for _, st, _ in enc.flush())
+    enc.close()
+    deltas = [s for s in stats if s.upload_kind == "delta"]
+    assert deltas, "trace produced no delta frames"
+    for s in deltas:
+        assert s.classify_ms > 0
+        # the split stages can never exceed the upload they decompose
+        assert s.classify_ms + s.convert_ms + s.h2d_ms <= s.upload_ms + 1e-6
+    fulls = [s for s in stats if s.upload_kind == "full" and not s.idr]
+    for s in fulls:
+        assert s.convert_ms > 0 and s.h2d_ms > 0

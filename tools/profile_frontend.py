@@ -1,0 +1,180 @@
+#!/usr/bin/env python3
+"""Uplink front-end microbench (ISSUE 12): per-stage cost of the host
+classify/hash/convert path, swept over workers x damage-hints x scenario.
+
+Stages timed in isolation over real scenario traces (bench.py's
+generators), jax-free — this is pure host work:
+
+  scan      FramePrep.scan fused pass (dirty map + prev update [+ tile
+            hashes]) — vs the LEGACY serial flow (band_diff + tile_diff
+            + full-frame np.copyto) it replaces
+  split     TileCache.split with the scan's precomputed hashes vs the
+            legacy re-gather + re-hash split
+  convert   dirty-tile I420 conversion (convert_tiles) and the full-
+            frame convert() (band-parallel across the pool)
+
+Rows print as JSON for PERF.md; run on an idle machine. Workers sweep
+re-execs with SELKIES_FRONTEND_WORKERS / SELKIES_PARALLEL_FRONTEND so
+the shared pool is sized per run.
+
+Usage: python tools/profile_frontend.py [--resolution 720p] [--frames 40]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+RESOLUTIONS = {"720p": (1280, 720), "1080p": (1920, 1080),
+               "4k": (3840, 2160)}
+
+
+def _traces(name: str, n: int, w: int, h: int):
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import bench
+
+    frames = bench._scenario_trace(name, n, w, h, seed=11)
+    damage = [bench._scenario_damage(name, i, w, h) for i in range(n)]
+    return frames, damage
+
+
+def _legacy_scan(prep, frame, tile_w):
+    """The pre-ISSUE-12 serial three-pass flow: tile diff (native
+    band_diff+tile_diff), then a FULL-frame np.copyto prev update."""
+    import ctypes
+
+    from selkies_tpu.models import frameprep as fp
+
+    lib = prep._lib
+    ntiles = (prep.width + tile_w - 1) // tile_w
+    out = np.empty((prep.nbands, ntiles), np.uint8)
+    lib.band_diff(fp._u8p(frame), fp._u8p(prep._prev), prep.height,
+                  prep.width, fp.BAND_ROWS, fp._u8p(prep._bands))
+    lib.tile_diff(fp._u8p(frame), fp._u8p(prep._prev), prep.height,
+                  prep.width, fp.BAND_ROWS, tile_w, fp._u8p(prep._bands),
+                  fp._u8p(out))
+    np.copyto(prep._prev, frame)
+    return out.astype(bool)
+
+
+def run_rows(w: int, h: int, nframes: int) -> list[dict]:
+    from selkies_tpu.models.frameprep import (
+        FramePrep, frontend_workers, parallel_frontend_enabled,
+        tile_width_for)
+    from selkies_tpu.models.tilecache import TileCache
+
+    pad_w, pad_h = (w + 15) // 16 * 16, (h + 15) // 16 * 16
+    tile_w = tile_width_for(w)
+    rows = []
+    workers = frontend_workers() if parallel_frontend_enabled() else 0
+    for scen in ("typing", "scroll", "window_drag", "video"):
+        frames, damage = _traces(scen, nframes, w, h)
+        for dmg_on in (False, True):
+            prep = FramePrep(w, h, pad_w, pad_h)
+            tc = TileCache(h, w, tile_w, 1024)
+            prep.scan(frames[0], tile_w)
+            t_scan = t_split = t_conv = 0.0
+            n_dirty = 0
+            for i in range(1, nframes):
+                dmg = damage[i] if dmg_on else None
+                t0 = time.perf_counter()
+                res = prep.scan(frames[i], tile_w, damage=dmg,
+                                want_hashes=True)
+                t1 = time.perf_counter()
+                band_i, tile_i = np.nonzero(res.tiles)
+                idx = (band_i * 1024 + tile_i).astype(np.int32)
+                n_dirty += len(idx)
+                payload = tc.split(frames[i], idx, hashes=res.hashes)
+                t2 = time.perf_counter()
+                if payload is not None and len(payload[0]):
+                    prep.convert_tiles(frames[i], payload[0], tile_w)
+                t3 = time.perf_counter()
+                t_scan += t1 - t0
+                t_split += t2 - t1
+                t_conv += t3 - t2
+            # legacy serial flow on an identical fresh state (full-copy
+            # prev update + split re-hash), damage is inapplicable
+            leg_scan = leg_split = 0.0
+            if not dmg_on and prep.native:
+                prep2 = FramePrep(w, h, pad_w, pad_h)
+                tc2 = TileCache(h, w, tile_w, 1024)
+                prep2.scan(frames[0], tile_w)
+                for i in range(1, nframes):
+                    t0 = time.perf_counter()
+                    tiles = _legacy_scan(prep2, frames[i], tile_w)
+                    t1 = time.perf_counter()
+                    band_i, tile_i = np.nonzero(tiles)
+                    idx = (band_i * 1024 + tile_i).astype(np.int32)
+                    tc2.split(frames[i], idx)
+                    leg_scan += t1 - t0
+                    leg_split += time.perf_counter() - t1
+            n = nframes - 1
+            row = {
+                "scenario": scen, "workers": workers,
+                "damage": int(dmg_on),
+                "scan_ms": round(t_scan / n * 1e3, 3),
+                "split_ms": round(t_split / n * 1e3, 3),
+                "convert_ms": round(t_conv / n * 1e3, 3),
+                "dirty_tiles_per_frame": round(n_dirty / n, 1),
+            }
+            if leg_scan:
+                row["legacy_scan_ms"] = round(leg_scan / n * 1e3, 3)
+                row["legacy_split_ms"] = round(leg_split / n * 1e3, 3)
+                row["scan_speedup"] = round(leg_scan / max(t_scan, 1e-9), 2)
+                row["split_speedup"] = round(leg_split / max(t_split, 1e-9), 2)
+            rows.append(row)
+            print(json.dumps(row))
+    # full-frame convert row (video/game/full-upload path)
+    prep = FramePrep(w, h, pad_w, pad_h)
+    frames, _ = _traces("video", min(nframes, 12), w, h)
+    prep.convert(frames[0])
+    t0 = time.perf_counter()
+    for i in range(1, len(frames)):
+        prep.convert(frames[i])
+    row = {"scenario": "full_convert", "workers": workers,
+           "convert_ms": round((time.perf_counter() - t0)
+                               / (len(frames) - 1) * 1e3, 3)}
+    rows.append(row)
+    print(json.dumps(row))
+    return rows
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--resolution", default="720p")
+    ap.add_argument("--frames", type=int, default=40)
+    ap.add_argument("--workers", default=None,
+                    help="comma list to sweep (re-execs per value); "
+                         "0 = serial oracle (SELKIES_PARALLEL_FRONTEND=0)")
+    args = ap.parse_args()
+    if args.workers is not None:
+        for wk in (v.strip() for v in args.workers.split(",") if v.strip()):
+            env = dict(os.environ)
+            if wk == "0":
+                env["SELKIES_PARALLEL_FRONTEND"] = "0"
+            else:
+                env["SELKIES_PARALLEL_FRONTEND"] = "1"
+                env["SELKIES_FRONTEND_WORKERS"] = wk
+            subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--resolution", args.resolution,
+                 "--frames", str(args.frames)],
+                env=env, check=True)
+        return 0
+    w, h = (RESOLUTIONS[args.resolution]
+            if args.resolution in RESOLUTIONS
+            else tuple(int(v) for v in args.resolution.split("x")))
+    run_rows(w, h, max(8, args.frames))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
