@@ -514,7 +514,17 @@ def bench_scenario(name: str, w: int, h: int, n: int,
     settle = _scenario_trace(name, n, w, h, seed=11)
     run_pass(settle)
     del settle
+    # recompile sentinel (monitoring/jitprof.py): the timed pass runs
+    # over a settled encoder, so its compile count SHOULD be zero — a
+    # non-zero `compiles` field in a scenario row means an executable-
+    # reuse discipline (bucket ladders, snap-to-compiled batch caps,
+    # policy dwell) broke under this workload
+    from selkies_tpu.monitoring import jitprof
+
+    sentinel = jitprof.install()
+    c0 = sentinel.stats()["compiles"]
     row = run_pass(_scenario_trace(name, n, w, h, seed=12))
+    row["compiles"] = sentinel.stats()["compiles"] - c0
     if runtime is not None:
         st = runtime.engine.stats()
         row["policy_scenario"] = st["scenario"]

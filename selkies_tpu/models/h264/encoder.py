@@ -936,6 +936,12 @@ class TPUH264Encoder:
         if self._inflight or self._batch_pend:
             raise RuntimeError(
                 "retune_entropy with frames in flight; flush first")
+        # recompile sentinel (monitoring/jitprof.py): the partials below
+        # recompile lazily on their next call — attribute those compiles
+        # to this actuation, wherever/whenever they land
+        from selkies_tpu.monitoring import jitprof
+
+        jitprof.mark("actuation", "entropy-retune")
         self.device_entropy, self.bits_min_mbs = de, bm
         self._bits_words, self._entropy = bw, ent
         _consts = dict(nscap=self._nscap, cap=self._cap_delta,

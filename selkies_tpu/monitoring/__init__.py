@@ -4,14 +4,18 @@ the frame-correlated telemetry bus, and the black-box flight recorder.
 Parity with metrics.py / system_monitor.py / gpu_monitor.py (SURVEY.md §2.1)
 plus the production layer on top: tracing.py (stage spans), telemetry.py
 (labeled counters/histograms + per-frame event bus), flightrecorder.py
-(post-mortem bundles). See docs/observability.md.
+(post-mortem bundles + the latency-outlier trigger), slo.py (per-session
+burn-rate objectives), jitprof.py (XLA recompile sentinel). See
+docs/observability.md and docs/slo.md.
 """
 
-from selkies_tpu.monitoring.flightrecorder import FlightRecorder
+from selkies_tpu.monitoring.flightrecorder import FlightRecorder, OutlierTrigger
 from selkies_tpu.monitoring.metrics import Metrics
+from selkies_tpu.monitoring.slo import SessionSLO, SLOTargets, slo_enabled
 from selkies_tpu.monitoring.system_monitor import SystemMonitor
 from selkies_tpu.monitoring.telemetry import Telemetry, telemetry
 from selkies_tpu.monitoring.tpu_monitor import TPUMonitor
 
-__all__ = ["FlightRecorder", "Metrics", "SystemMonitor", "TPUMonitor",
-           "Telemetry", "telemetry"]
+__all__ = ["FlightRecorder", "Metrics", "OutlierTrigger", "SessionSLO",
+           "SLOTargets", "SystemMonitor", "TPUMonitor", "Telemetry",
+           "slo_enabled", "telemetry"]

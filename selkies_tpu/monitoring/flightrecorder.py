@@ -25,6 +25,14 @@ window, not one per failure. Triggering is wired in
 resilience/supervisor.py: every escalation past WARN calls
 ``telemetry.escalation()``, which lands here.
 
+Beyond supervisor escalations, the SLO plane (monitoring/slo.py) feeds
+every frame's latency through an :class:`OutlierTrigger` — a rolling-
+quantile detector that dumps a bundle for a p99-outlier frame, tagged
+with that frame's correlation id (``meta.json`` ``frame_id``), even
+when no escalation ever happens. Outlier dumps rate-limit under their
+own per-session bucket (``<session>-outlier``) so tail-latency evidence
+never suppresses a real escalation bundle or vice versa.
+
 ``SELKIES_BLACKBOX_DIR`` overrides the output directory (default
 ``./blackbox``, gitignored). Everything is injectable (clock, dir,
 window) so tests drive it deterministically.
@@ -32,6 +40,7 @@ window) so tests drive it deterministically.
 
 from __future__ import annotations
 
+import bisect
 import json
 import logging
 import os
@@ -42,7 +51,7 @@ from typing import Callable
 
 logger = logging.getLogger("flightrecorder")
 
-__all__ = ["FlightRecorder", "DEFAULT_DIR", "ENV_DIR"]
+__all__ = ["FlightRecorder", "OutlierTrigger", "DEFAULT_DIR", "ENV_DIR"]
 
 ENV_DIR = "SELKIES_BLACKBOX_DIR"
 DEFAULT_DIR = "blackbox"
@@ -50,6 +59,75 @@ DEFAULT_DIR = "blackbox"
 
 def _slug(s: str) -> str:
     return "".join(c if c.isalnum() or c in "-_" else "-" for c in str(s)) or "slot"
+
+
+class OutlierTrigger:
+    """Rolling-quantile latency-outlier detector (the black-box trigger
+    for frames that are dramatically worse than the session's own recent
+    tail, monitoring/slo.py).
+
+    Keeps the last ``window`` observations in arrival order plus a
+    sorted mirror (bisect insert/remove — the window is small enough
+    that the O(n) memmove is nanoseconds), and judges each NEW sample
+    against the quantile of what came *before* it: an outlier is a
+    sample at or above ``max(quantile * factor, floor_ms)``. The sample
+    then joins the window either way, so a sustained latency shift
+    re-baselines within one window instead of dumping forever — the
+    sustained case is the burn-rate windows' job, this trigger exists
+    for the lone catastrophic frame. No judgment happens before
+    ``warmup`` samples (a cold session's first compile-priced frames
+    are not outliers, they are startup).
+
+    Single-threaded by contract, like the SessionSLO that owns it.
+    """
+
+    def __init__(self, *, window: int = 512, warmup: int = 120,
+                 quantile: float = 0.99, factor: float = 1.5,
+                 floor_ms: float = 50.0):
+        if not 0.0 < quantile < 1.0:
+            raise ValueError(f"quantile must be in (0, 1), got {quantile}")
+        self.window = int(window)
+        self.warmup = max(1, int(warmup))
+        self.quantile = float(quantile)
+        self.factor = float(factor)
+        self.floor_ms = float(floor_ms)
+        self._ring: deque[float] = deque()
+        self._sorted: list[float] = []
+        self.observed = 0
+        self.outliers = 0
+
+    def reset(self) -> None:
+        """Drop the window (a new client's traffic must not be judged
+        against the previous one's baseline); lifetime counters stay."""
+        self._ring.clear()
+        self._sorted.clear()
+
+    def quantile_ms(self) -> float:
+        """The configured quantile of the current window (0.0 empty)."""
+        s = self._sorted
+        if not s:
+            return 0.0
+        return s[min(len(s) - 1, int(self.quantile * (len(s) - 1) + 0.5))]
+
+    def observe(self, latency_ms: float) -> bool:
+        """Judge one sample against the window-so-far; True = outlier.
+        Rate limiting is the dump path's job, not this trigger's — the
+        caller counts every detection, suppressed or not."""
+        latency_ms = float(latency_ms)
+        self.observed += 1
+        is_outlier = False
+        if len(self._ring) >= self.warmup:
+            threshold = max(self.quantile_ms() * self.factor, self.floor_ms)
+            is_outlier = latency_ms >= threshold
+        if len(self._ring) >= self.window:
+            oldest = self._ring.popleft()
+            i = bisect.bisect_left(self._sorted, oldest)
+            del self._sorted[i]
+        self._ring.append(latency_ms)
+        bisect.insort(self._sorted, latency_ms)
+        if is_outlier:
+            self.outliers += 1
+        return is_outlier
 
 
 class FlightRecorder:
@@ -88,14 +166,17 @@ class FlightRecorder:
     # -- dumping -------------------------------------------------------
 
     def dump(self, slot: str, reason: str, *,
-             snapshot: dict | None = None) -> str | None:
+             snapshot: dict | None = None,
+             extra_meta: dict | None = None) -> str | None:
         """Write a bundle for ``slot``'s escalation; None when
         rate-limited (per slot). The bundle carries EVERY ring's window,
         merged by time and annotated with the owning session — the
         escalating slot's ladder events and the frame timeline live in
         different rings, and cross-slot context is exactly what a
-        post-mortem needs. The write happens outside the lock (a slow
-        disk must not stall emitters)."""
+        post-mortem needs. ``extra_meta`` lands in ``meta.json`` (the
+        outlier path tags the breaching frame's correlation id there).
+        The write happens outside the lock (a slow disk must not stall
+        emitters)."""
         now = self.clock()
         with self._lock:
             last = self._last_dump.get(slot)
@@ -108,14 +189,16 @@ class FlightRecorder:
                  for s, ring in self._rings.items() for t, ev in ring),
                 key=lambda e: e["t"])
         try:
-            return self._write_bundle(slot, reason, events, snapshot)
+            return self._write_bundle(slot, reason, events, snapshot,
+                                      extra_meta)
         except Exception:
             # the black box must never take down the loop it observes
             logger.exception("black-box dump for slot %r failed", slot)
             return None
 
     def _write_bundle(self, slot: str, reason: str, events: list[dict],
-                      snapshot: dict | None) -> str:
+                      snapshot: dict | None,
+                      extra_meta: dict | None = None) -> str:
         from selkies_tpu.monitoring.tracing import tracer
 
         stamp = time.strftime("%Y%m%d-%H%M%S")
@@ -127,7 +210,8 @@ class FlightRecorder:
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump({"slot": str(slot), "reason": reason,
                        "written_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
-                       "event_count": len(events)}, f, indent=2)
+                       "event_count": len(events), **(extra_meta or {})},
+                      f, indent=2, default=str)
         with open(os.path.join(tmp, "events.jsonl"), "w") as f:
             for ev in events:
                 f.write(json.dumps(ev, default=str) + "\n")

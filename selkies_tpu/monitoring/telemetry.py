@@ -54,7 +54,10 @@ __all__ = [
     "telemetry",
     "METRIC_FAMILIES",
     "STAGE_BUCKETS_MS",
+    "STAGE_BUCKETS_SUBMS",
+    "STAGE_BUCKET_LADDERS",
     "FRAME_BYTE_BUCKETS",
+    "COMPILE_BUCKETS_MS",
 ]
 
 ENV_VAR = "SELKIES_TELEMETRY"
@@ -64,6 +67,28 @@ ENV_VAR = "SELKIES_TELEMETRY"
 # P slices (~tens of bytes) to 4K IDRs
 STAGE_BUCKETS_MS = (0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 33.0, 66.0, 133.0, 500.0)
 FRAME_BYTE_BUCKETS = (1024, 4096, 16384, 65536, 262144, 1048576)
+# XLA compiles (monitoring/jitprof.py) span ~1 ms trivial rebuilds to
+# minute-class cold device-entropy programs
+COMPILE_BUCKETS_MS = (1.0, 4.0, 16.0, 64.0, 256.0, 1024.0, 4096.0,
+                      16384.0, 65536.0)
+
+# Per-STAGE bucket ladders for selkies_stage_ms: the PR 11 uplink
+# front-end stages run in tens of microseconds on damage-hinted frames,
+# so on the default ladder every observation lands in the lowest (0.5
+# ms) bucket and a 10x regression is invisible until it crosses into
+# milliseconds. Stages listed here histogram on a sub-ms ladder; each
+# exposition series carries its own `le` edges, which Prometheus
+# handles per-series (histogram_quantile works unchanged). unpack and
+# bits_fetch ride along: both are sub-ms on every scenario row since
+# the PR 4/PR 7 work.
+STAGE_BUCKETS_SUBMS = (0.05, 0.1, 0.2, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 33.0)
+STAGE_BUCKET_LADDERS: dict[str, tuple[float, ...]] = {
+    "classify": STAGE_BUCKETS_SUBMS,
+    "convert": STAGE_BUCKETS_SUBMS,
+    "h2d": STAGE_BUCKETS_SUBMS,
+    "unpack": STAGE_BUCKETS_SUBMS,
+    "bits_fetch": STAGE_BUCKETS_SUBMS,
+}
 
 # Every family this bus can emit, name -> help string. The names are the
 # observability contract: tools/check_metric_docs.py asserts each one is
@@ -136,6 +161,33 @@ METRIC_FAMILIES: dict[str, str] = {
         "Encoder knob retunes the policy engine applied, labeled by "
         "session and knob (tile_cache/batch_cap/device_entropy/"
         "keyframe_interval)",
+    "selkies_slo_burn_rate":
+        "SLO burn rate (observed badness / allowed badness) per session "
+        "and objective (latency_p50/latency_p95/fps/downlink) over the "
+        "fast (1-min) and slow (30-min) windows (monitoring/slo.py)",
+    "selkies_slo_breached":
+        "SLO breach state per session and objective: 0 ok, 1 chronic "
+        "(slow window over threshold), 2 acute (fast window over "
+        "threshold — hooks fired)",
+    "selkies_slo_breaches_total":
+        "SLO burn-threshold crossings, labeled by session, objective and "
+        "the window that crossed (fast/slow)",
+    "selkies_slo_outliers_total":
+        "p99 latency-outlier frames the rolling-quantile trigger "
+        "detected (each dumps a rate-limited black-box bundle tagged "
+        "with the frame's correlation id), labeled by session",
+    "selkies_compile_total":
+        "XLA executable compiles observed by the recompile sentinel "
+        "(monitoring/jitprof.py), labeled by attributed trigger "
+        "(actuation/recarve/codec_switch/resize/restart/startup/"
+        "unattributed)",
+    "selkies_compile_ms":
+        "XLA compile wall-time histogram in milliseconds, labeled by "
+        "attributed trigger",
+    "selkies_compile_storms_total":
+        "Recompile storms flagged (N compiles inside the dwell window — "
+        "an executable-reuse discipline is broken), labeled by the "
+        "window's dominant trigger",
 }
 
 # canonical label names per family (order fixed for the Prometheus
@@ -164,12 +216,29 @@ _FAMILY_LABELS: dict[str, tuple[str, ...]] = {
     "selkies_policy_scenario": ("session", "scenario"),
     "selkies_policy_transitions_total": ("session", "scenario"),
     "selkies_policy_actuations_total": ("session", "knob"),
+    "selkies_slo_burn_rate": ("session", "objective", "window"),
+    "selkies_slo_breached": ("session", "objective"),
+    "selkies_slo_breaches_total": ("session", "objective", "window"),
+    "selkies_slo_outliers_total": ("session",),
+    "selkies_compile_total": ("trigger",),
+    "selkies_compile_ms": ("trigger",),
+    "selkies_compile_storms_total": ("trigger",),
 }
 
 _HIST_BUCKETS: dict[str, tuple[float, ...]] = {
     "selkies_stage_ms": STAGE_BUCKETS_MS,
     "selkies_frame_bytes": FRAME_BYTE_BUCKETS,
+    "selkies_compile_ms": COMPILE_BUCKETS_MS,
 }
+
+
+def _buckets_for(family: str, labelvals: tuple[str, ...]) -> tuple[float, ...]:
+    """Bucket edges for one histogram series: selkies_stage_ms resolves
+    a per-stage ladder (the stage is the first label), everything else
+    uses the family's single ladder."""
+    if family == "selkies_stage_ms" and labelvals:
+        return STAGE_BUCKET_LADDERS.get(labelvals[0], STAGE_BUCKETS_MS)
+    return _HIST_BUCKETS[family]
 
 # current frame correlation id; 0 = none. asyncio.to_thread copies the
 # context, so a span set on the event loop is visible on the worker.
@@ -234,6 +303,7 @@ class Telemetry:
         self._providers: dict[str, object] = {}   # name -> () -> dict
         self._slots: dict[str, object] = {}       # slot name -> SlotSupervisor
         self._lifecycle = None                    # weakref to DrainController
+        self._slo = None                          # weakref to health_view fn
         self._seq_map: dict[tuple[str, int], int] = {}  # (session, seq) -> fid
         self._frame_ids = itertools.count(1)
         self._epoch = time.time()
@@ -273,6 +343,7 @@ class Telemetry:
             self._providers.clear()
             self._slots.clear()
             self._lifecycle = None
+            self._slo = None
         self.recorder = None
         self._epoch = time.time()
 
@@ -340,9 +411,17 @@ class Telemetry:
         self._record(labels.get("session") or labels.get("slot") or "0",
                      {"ev": family, "value": value, **labels})
 
+    def observe(self, family: str, value: float, **labels) -> None:
+        """Public histogram observation for emitters outside this module
+        (the compile sentinel's selkies_compile_ms)."""
+        if not self.enabled:
+            return
+        self._observe(family, value, labels)
+
     def _observe(self, family: str, value: float, labels: dict) -> None:
-        buckets = _HIST_BUCKETS[family]
-        key = (family, self._labels_of(family, labels))
+        vals = self._labels_of(family, labels)
+        buckets = _buckets_for(family, vals)
+        key = (family, vals)
         with self._lock:
             h = self._hists.get(key)
             if h is None:
@@ -423,6 +502,17 @@ class Telemetry:
                                "cavlc_ms": round(cavlc_ms, 3),
                                "mode": downlink_mode})
 
+    def event(self, kind: str, *, session: str = "0", **fields) -> None:
+        """A first-class timeline event for the flight-recorder rings —
+        no metric, just post-mortem context. The post-PR-3 subsystems
+        emit these so their state changes appear in dumped bundles next
+        to the frame timeline: policy transitions/actuations, codec
+        negotiations, lifecycle admit/recarve/migrate/drain, SLO
+        breaches/recoveries, recompile storms."""
+        if not self.enabled:
+            return
+        self._record(str(session), {"ev": kind, **fields})
+
     def _record(self, session: str, ev: dict) -> None:
         rec = self.recorder
         if rec is not None:
@@ -456,6 +546,14 @@ class Telemetry:
         last-writer-wins, like slot registration — one live drain
         controller per process is the product shape."""
         self._lifecycle = weakref.ref(controller)
+
+    def register_slo(self, fn) -> None:
+        """Called by the SLO plane's owner (app / fleet): ``fn`` returns
+        the per-session breach summary folded into ``health()`` →
+        ``/healthz`` as the ``slo`` block. Weakly referenced and
+        last-writer-wins, like the lifecycle registration."""
+        self._slo = weakref.WeakMethod(fn) if hasattr(fn, "__self__") \
+            else weakref.ref(fn)
 
     def register_slot(self, name: str, supervisor) -> None:
         """Called by SlotSupervisor.__init__: makes the slot visible to
@@ -513,6 +611,16 @@ class Telemetry:
             # balancer must stop routing here even while slots are healthy
             if view.get("state") in ("draining", "drained") and status != "down":
                 out["status"] = "draining"
+        slo = self._slo() if self._slo is not None else None
+        if slo is not None:
+            # SLO detail (monitoring/slo.py): which sessions are burning
+            # which objectives — probes keep getting 200 on a pure SLO
+            # breach (the supervisor's sticky WARN rung carries it), but
+            # the detail is what an autoscaler reads
+            try:
+                out["slo"] = slo()
+            except Exception:
+                out["slo"] = {"error": "unreadable"}
         return out
 
     def rollup(self) -> dict:
@@ -542,7 +650,7 @@ class Telemetry:
                 "count": n,
                 "mean": round(total / n, 3) if n else 0.0,
                 "buckets": dict(zip(
-                    [str(b) for b in _HIST_BUCKETS[family]] + ["+Inf"],
+                    [str(b) for b in _buckets_for(family, vals)] + ["+Inf"],
                     itertools.accumulate(counts))),
             }
         return {
@@ -600,8 +708,35 @@ class Telemetry:
             return None
         return self._dump_sync(rec, session, reason)
 
-    def _dump_sync(self, rec, session: str, reason: str):
-        path = rec.dump(session, reason, snapshot=self.rollup())
+    def outlier_dump(self, session: str, reason: str, *,
+                     extra_meta: dict | None = None):
+        """Latency-outlier black-box capture (monitoring/slo.py): dump
+        the rings for a p99-outlier frame even though no supervisor
+        escalation happened. Rate-limits under its own per-session
+        bucket (``<session>-outlier``) so tail-latency bundles never
+        starve — or get starved by — escalation bundles; ``extra_meta``
+        tags the breaching frame's correlation id into ``meta.json``.
+        Same executor discipline as :meth:`escalation`."""
+        rec = self.recorder
+        if rec is None:
+            if not self.enabled:
+                return None
+            rec = self._ensure_recorder()
+        slot = f"{session}-outlier"
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        if loop is not None:
+            loop.run_in_executor(None, self._dump_sync, rec, slot, reason,
+                                 extra_meta)
+            return None
+        return self._dump_sync(rec, slot, reason, extra_meta)
+
+    def _dump_sync(self, rec, session: str, reason: str,
+                   extra_meta: dict | None = None):
+        path = rec.dump(session, reason, snapshot=self.rollup(),
+                        extra_meta=extra_meta)
         if path is not None:
             key = ("selkies_blackbox_dumps_total", (str(session),))
             with self._lock:
@@ -663,8 +798,10 @@ class _TelemetryCollector:
             f = HistogramMetricFamily(
                 family, METRIC_FAMILIES.get(family, family),
                 labels=_FAMILY_LABELS.get(family, ()))
-            edges = [str(b) for b in _HIST_BUCKETS[family]] + ["+Inf"]
             for vals, (bucket_counts, total) in rows:
+                # edges resolve per SERIES: selkies_stage_ms stages carry
+                # per-stage ladders (sub-ms front-end stages)
+                edges = [str(b) for b in _buckets_for(family, vals)] + ["+Inf"]
                 cum = list(itertools.accumulate(bucket_counts))
                 f.add_metric(list(vals), list(zip(edges, cum)),
                              sum_value=total)

@@ -587,6 +587,11 @@ class Orchestrator:
             # cols from SELKIES_TILE_COLS — the negotiated budget applies
             # to this session's swap only.
             kw = ({"cols": n.cols} if n.codec in ("av1", "vp9") else {})
+            # recompile sentinel: the new row's executables compile on
+            # its first frames — attribute them to this negotiation
+            from selkies_tpu.monitoring import jitprof
+
+            jitprof.mark("codec_switch", n.codec)
             if self.app._swap_encoder(n.encoder, enc.width, enc.height, **kw):
                 # resizes / supervisor rebuilds re-create the ACTIVE row
                 # (app._active_encoder_name) — the negotiated codec must
@@ -598,6 +603,8 @@ class Orchestrator:
         codec = getattr(self.app.encoder, "codec", "h264")
         self.webrtc.set_codec(codec)
         logger.info("client negotiated codec %s (%s)", codec, n.reason)
+        telemetry.event("codec_negotiated", codec=codec, reason=n.reason,
+                        encoder=self.app.encoder_name)
         self._emit_codec_gauge(codec)
 
     def _emit_codec_gauge(self, codec: str | None) -> None:
@@ -690,6 +697,13 @@ class Orchestrator:
 
     async def _stop_session(self) -> None:
         await self.app.stop_pipeline()
+        if self.app.slo is not None:
+            # the departed client's SLO windows, breach state, outlier
+            # baseline and sticky WARN must not be inherited by the
+            # next client (the fleet's reset_session_slo precedent);
+            # the pressure-hook downscale needs no undo here — the next
+            # start_pipeline builds a fresh pipeline on the full source
+            self.app.slo.reset()
         if self.audio is not None:
             await self.audio.stop()
         await self.input.stop_js_server()

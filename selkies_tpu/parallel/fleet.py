@@ -298,6 +298,79 @@ class SessionFleet:
             ]
             telemetry.register_provider("policy", self._policy_rollup)
 
+        # serving SLO plane (monitoring/slo.py, SELKIES_SLO=1): one
+        # SessionSLO per SLOT sharing the fleet supervisor (its sticky
+        # WARN rung refcounts by session key). A slot's acute breach
+        # sheds its OWN downlink bytes — the bitrate target halves, the
+        # per-session CBR follows — before anything touches the lockstep
+        # tick rate every other session shares; relief restores the
+        # pre-shed target. Opting in turns the telemetry bus on (the
+        # plane is a bus consumer).
+        self.slos = None
+        self._slo_shed_kbps: dict[int, int] = {}
+        from selkies_tpu.monitoring.slo import slo_enabled
+
+        if slo_enabled():
+            from selkies_tpu.monitoring import jitprof
+            from selkies_tpu.monitoring.slo import SessionSLO
+
+            telemetry.enable()
+            jitprof.install()
+            self.slos = [
+                SessionSLO(session=str(k), supervisor=self.supervisor)
+                for k in range(self.n)
+            ]
+            for k, slo in enumerate(self.slos):
+                slo.on_pressure = (lambda k=k: self._slo_shed(k))
+                slo.on_relief = (lambda k=k: self._slo_restore(k))
+                if self.policies is not None:
+                    self.policies[k].engine.on_scenario = slo.set_scenario
+            telemetry.register_provider("slo", self._slo_rollup)
+            telemetry.register_provider("compile", jitprof.stats)
+            telemetry.register_slo(self._slo_health)
+
+    def _slo_rollup(self) -> dict:
+        if self.slos is None:
+            return {}
+        return {str(k): s.stats() for k, s in enumerate(self.slos)}
+
+    def _slo_health(self) -> dict:
+        if self.slos is None:
+            return {}
+        return {str(k): s.health_view() for k, s in enumerate(self.slos)
+                if self.slots[k].connected}
+
+    def _slo_shed(self, k: int) -> None:
+        if k in self._slo_shed_kbps:
+            return
+        cur = int(self.slots[k].rc.bitrate_kbps)
+        shed = max(250, cur // 2)
+        if shed >= cur:
+            # already at/below the shed floor: RAISING the target under
+            # pressure would be the opposite of shedding — leave it
+            return
+        self._slo_shed_kbps[k] = cur
+        logger.warning("session %d SLO breach: shedding bitrate %d -> %d "
+                       "kbps (bytes before fps)", k, cur, shed)
+        self.set_session_bitrate(k, shed)
+
+    def _slo_restore(self, k: int) -> None:
+        prior = self._slo_shed_kbps.pop(k, None)
+        if prior is not None:
+            logger.info("session %d SLO recovered: restoring %d kbps",
+                        k, prior)
+            self.set_session_bitrate(k, prior)
+
+    def reset_session_slo(self, k: int) -> None:
+        """Client departure (disconnect / release / poison-eject): the
+        breach belonged to the departed client's traffic — restore any
+        shed bitrate and clear the windows + sticky WARN so the next
+        admit starts clean (the PR 8.1 codec-record precedent)."""
+        if self.slos is None:
+            return
+        self._slo_restore(k)
+        self.slos[k].reset()
+
     def _session_encoder(self, k: int):
         """Session k's per-session encoder on the LIVE service, or None
         (lockstep batch service / parked slot) — the policy actuator
@@ -314,6 +387,7 @@ class SessionFleet:
     def _default_poison(self, k: int) -> None:
         logger.error("session %d ejected (persistent failures)", k)
         self.slots[k].connected = False
+        self.reset_session_slo(k)
 
     # -- lifecycle control plane (parallel/lifecycle.py) ---------------
 
@@ -332,6 +406,7 @@ class SessionFleet:
             # clears its record too); the next admit rebuilds as h264
             # until the new client's negotiation says otherwise
             codecs[k] = "h264"
+        self.reset_session_slo(k)
         self.placer.release(k)
         self._recarve_safely(k)
 
@@ -489,6 +564,8 @@ class SessionFleet:
                 cols=1, reason="rebuild-degraded")
         logger.info("session %d negotiated codec %s (%s, %d chips)",
                     k, n.codec, n.reason, len(row))
+        telemetry.event("codec_negotiated", session=str(k), codec=n.codec,
+                        reason=n.reason, chips=len(row))
         return n
 
     def force_keyframe(self, session: int) -> None:
@@ -780,6 +857,24 @@ class SessionFleet:
                         *(coro for _, coro in sends), return_exceptions=True)
                     for (k, _), result in zip(sends, results):
                         self._note_send_result(k, result)
+                if self.slos is not None:
+                    # SLO intake: the lockstep tick's wall span (capture
+                    # begin → sends landed) is every slot's frame latency
+                    # this tick — the batch IS one device dispatch. Must
+                    # never poison the tick (a failure here would count
+                    # as an encode failure and climb the ladder).
+                    try:
+                        lat_ms = (time.monotonic()
+                                  - self._tick_started_at) * 1e3
+                        for k, (slot, au) in enumerate(
+                                zip(self.slots, aus)):
+                            if not au or not slot.connected:
+                                continue
+                            slo = self.slos[k]
+                            slo.observe_frame(lat_ms, len(au), fid=fid)
+                            slo.evaluate()
+                    except Exception:
+                        logger.exception("SLO intake failed")
                 self.supervisor.tick_ok()
             except asyncio.CancelledError:
                 raise
@@ -1218,6 +1313,9 @@ class FleetOrchestrator:
         # placement pressure bookkeeping: an idle session's chips become
         # borrowable again (its row stays carved until release/recycle)
         self.fleet.placer.set_busy(k, False)
+        # the departed client's SLO breach state / shed bitrate / sticky
+        # WARN must not outlive it (the next admit starts clean)
+        self.fleet.reset_session_slo(k)
         logger.info("session %d client disconnected", k)
         slot.input.reset_keyboard()
         loop = asyncio.get_running_loop()

@@ -229,6 +229,10 @@ class SessionPlacer:
         if telemetry.enabled:
             telemetry.count("selkies_admission_total",
                             decision=adm.decision, reason=adm.reason or "ok")
+            # ring event with the SESSION attached so the decision shows
+            # up in that session's black-box window, not just "0"'s
+            telemetry.event("admission", session=str(session),
+                            decision=adm.decision, reason=adm.reason or "ok")
         self._export_gauges()
         self.assert_consistent()
         return adm
@@ -392,6 +396,9 @@ class SessionPlacer:
         if telemetry.enabled:
             telemetry.count("selkies_lifecycle_events_total",
                             event="recarve_borrow")
+            telemetry.event("recarve", session=str(borrower),
+                            action="borrow", lender=lender,
+                            chips=len(chips))
         self._export_gauges()
         self.assert_consistent()
         return list(chips)
@@ -408,6 +415,8 @@ class SessionPlacer:
         if settled and telemetry.enabled:
             telemetry.count("selkies_lifecycle_events_total",
                             event="recarve_return")
+            telemetry.event("recarve", session=str(borrower),
+                            action="return", settled=len(settled))
         self._export_gauges()
         self.assert_consistent()
         return settled
@@ -646,6 +655,8 @@ def checkpoint_session(service, session: int, *, slot=None) -> SessionCheckpoint
                                  "min_kbps": int(gcc.min_kbps)}
     if telemetry.enabled:
         telemetry.count("selkies_lifecycle_events_total", event="checkpoint")
+        telemetry.event("migrate", session=str(ck.session),
+                        action="checkpoint")
     return ck
 
 
@@ -692,6 +703,7 @@ def restore_session(ck: SessionCheckpoint, service, session: int | None = None,
                 gcc.estimate_kbps = min(max(est, gcc.min_kbps), gcc.max_kbps)
     if telemetry.enabled:
         telemetry.count("selkies_lifecycle_events_total", event="restore")
+        telemetry.event("migrate", session=str(session), action="restore")
 
 
 # ---------------------------------------------------------------------------
@@ -757,6 +769,8 @@ class DrainController:
         if telemetry.enabled:
             telemetry.count("selkies_lifecycle_events_total",
                             event="drain_begin")
+            telemetry.event("drain", state="draining",
+                            deadline_s=self.deadline_s)
             telemetry.gauge("selkies_drain_state", 1)
 
     async def drain(self) -> bool:
@@ -813,6 +827,10 @@ class DrainController:
                 "selkies_lifecycle_events_total",
                 event="drain_done" if self.completed_in_deadline
                 else "drain_timeout")
+            telemetry.event("drain", state="drained",
+                            in_deadline=bool(self.completed_in_deadline),
+                            elapsed_s=round(elapsed, 2),
+                            checkpoints=len(self.checkpoints))
             telemetry.gauge("selkies_drain_state", 2)
         logger.warning("%s: drain %s in %.2fs (%d checkpoints)", self.name,
                        "completed" if self.completed_in_deadline else

@@ -208,6 +208,10 @@ class BandedFleetService:
         # supervisor restart keeps every session's negotiated codec.
         self.codecs = [c.lower() if c else "h264"
                        for c in (codecs or ["h264"] * n_sessions)]
+        # sessions whose codec changed but whose re-carve hasn't landed
+        # yet — recompile-sentinel attribution handoff (set_codec ->
+        # recarve)
+        self._codec_pending: set[int] = set()
         if bands is None and cols is None:
             grid = grid_from_env()
             if grid is not None:
@@ -282,6 +286,11 @@ class BandedFleetService:
         codec = (codec or "h264").lower()
         if codec == self.codecs[session]:
             return False
+        # recompile-sentinel attribution: the caller's re-carve (possibly
+        # deferred past an in-flight tick) rebuilds this session's
+        # encoder for the new codec — those compiles belong to the
+        # negotiation, not a chip shuffle; recarve() consumes the flag
+        self._codec_pending.add(session)
         self.codecs[session] = codec
         return True
 
@@ -370,9 +379,20 @@ class BandedFleetService:
         state is read, and a restore-side failure closes the half-built
         replacement before propagating (no leaked pack pool / device
         buffers)."""
+        from selkies_tpu.monitoring import jitprof
         from selkies_tpu.parallel.lifecycle import (
             checkpoint_session, restore_session)
 
+        # recompile sentinel (monitoring/jitprof.py): the rebuilt
+        # encoder's executables compile lazily on its first ticks —
+        # attribute them to whichever rebuild owns this call (a pending
+        # set_codec means the re-carve is a negotiation's vehicle)
+        if session in self._codec_pending:
+            self._codec_pending.discard(session)
+            jitprof.mark("codec_switch",
+                         f"session-{session}:{self.codecs[session]}")
+        else:
+            jitprof.mark("recarve", f"session-{session}")
         old = self.encoders[session]
         if not devices:
             self.encoders[session] = None

@@ -25,6 +25,7 @@ from typing import Any, Awaitable, Callable, Protocol
 
 from selkies_tpu.models.registry import create_encoder, encoder_exists
 from selkies_tpu.models.h264.ratecontrol import CbrRateController
+from selkies_tpu.monitoring import jitprof
 from selkies_tpu.monitoring.telemetry import telemetry
 from selkies_tpu.pipeline.elements import (
     DownscaleSource,
@@ -194,11 +195,42 @@ class TPUWebRTCApp:
                 drain=self._policy_drain)
             telemetry.register_provider("policy", self._policy_stats)
 
+        # serving SLO plane (monitoring/slo.py, SELKIES_SLO=1): burn-rate
+        # objectives over every delivered frame, the XLA recompile
+        # sentinel, and latency-outlier black-box capture. The plane IS
+        # a telemetry consumer, so opting in also turns the bus on.
+        self.slo = None
+        from selkies_tpu.monitoring.slo import SessionSLO, slo_enabled
+
+        if slo_enabled():
+            telemetry.enable()
+            jitprof.install()
+            self.slo = SessionSLO(session="0", supervisor=self.supervisor)
+            # acute breach = the session is failing its latency/fps/byte
+            # promise NOW: shed bytes the same way the policy congestion
+            # overlay does (downscale BEFORE any fps-halving); relief
+            # restores it. Both callbacks are idempotent and defer to
+            # the failure ladder when it owns the source.
+            self.slo.on_pressure = self._policy_link_degrade
+            self.slo.on_relief = self._policy_link_undegrade
+            if self.policy_engine is not None:
+                # scenario transitions retarget the live objectives
+                self.policy_engine.on_scenario = self.slo.set_scenario
+            telemetry.register_provider("slo", self._slo_stats)
+            telemetry.register_provider("compile", jitprof.stats)
+            telemetry.register_slo(self._slo_health)
+
         # /statz live read-side: the encoder's link-byte counters (reads
         # through self.encoder so supervisor swaps/rebuilds stay covered)
         # and the pipeline's frame/drop accounting
         telemetry.register_provider("link_bytes", self._link_bytes_snapshot)
         telemetry.register_provider("pipeline", self._pipeline_stats)
+
+    def _slo_stats(self) -> dict:
+        return {"0": self.slo.stats()} if self.slo is not None else {}
+
+    def _slo_health(self) -> dict:
+        return {"0": self.slo.health_view()} if self.slo is not None else {}
 
     def _link_bytes_snapshot(self) -> dict:
         lb = getattr(self.encoder, "link_bytes", None)
@@ -232,9 +264,17 @@ class TPUWebRTCApp:
         )
         if hasattr(self.encoder, "prewarm"):
             # compile the IDR + full-P executables before the first real
-            # frame (the device-entropy program is a large cold build)
+            # frame (the device-entropy program is a large cold build);
+            # the jitprof scope attributes these eager compiles exactly,
+            # even past the sentinel's startup grace (session restarts)
             logger.info("prewarming encoder executables")
-            await asyncio.to_thread(self.encoder.prewarm)
+            enc = self.encoder
+
+            def _prewarm() -> None:
+                with jitprof.scope("startup", "prewarm"):
+                    enc.prewarm()
+
+            await asyncio.to_thread(_prewarm)
         self.pipeline = VideoPipeline(
             source=self.source,
             encoder=self.encoder,
@@ -244,6 +284,7 @@ class TPUWebRTCApp:
         )
         self.pipeline.on_geometry_change = self._rebuild_encoder
         self.pipeline.supervisor = self.supervisor
+        self.pipeline.slo = self.slo
         if self.policy_engine is not None:
             from selkies_tpu.policy import PolicyRuntime
 
@@ -323,6 +364,7 @@ class TPUWebRTCApp:
                 time.monotonic() - failed_at < self.REBUILD_RETRY_S:
             return self.encoder
         logger.info("rebuilding %s for %dx%d", name, width, height)
+        jitprof.mark("resize", f"{width}x{height}")
         try:
             self.encoder = create_encoder(
                 name, width=width, height=height, fps=self.framerate,
@@ -408,6 +450,7 @@ class TPUWebRTCApp:
         pools) without touching geometry or codec."""
         enc = self.encoder
         src = self.pipeline.source if self.pipeline is not None else self.source
+        jitprof.mark("restart", self._active_encoder_name())
         self._swap_encoder(self._active_encoder_name(),
                            getattr(enc, "width", src.width),
                            getattr(enc, "height", src.height))
@@ -426,6 +469,7 @@ class TPUWebRTCApp:
             return
         src = self.pipeline.source if self.pipeline is not None else self.source
         logger.info("restoring the %s row", self.encoder_name)
+        jitprof.mark("restart", "undegrade")
         if self._swap_encoder(self.encoder_name, src.width, src.height):
             self.software_fallback = False
 

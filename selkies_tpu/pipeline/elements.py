@@ -284,6 +284,13 @@ class VideoPipeline:
         # timestamp we dispatched them with
         self.session = "0"
         self._fid_by_ts: dict[int, int] = {}
+        # optional serving-SLO plane (monitoring/slo.py), wired by
+        # TPUWebRTCApp when SELKIES_SLO=1: per-frame capture→AU-ready
+        # latency feeds the burn-rate windows and the outlier trigger.
+        # _t_by_ts is the submit-time ledger (same shape as _fid_by_ts)
+        # so a pipelined completion is charged its OWN dispatch time
+        self.slo = None
+        self._t_by_ts: dict[int, float] = {}
         # optional scenario-policy runtime (selkies_tpu/policy), wired by
         # TPUWebRTCApp when SELKIES_POLICY=1: observes every encoded
         # frame and retunes the encoder's runtime-safe knobs. Its tick
@@ -366,10 +373,15 @@ class VideoPipeline:
             # frame correlation id: assigned at capture, carried through
             # classify/encode/send and echoed by the client's ack
             fid = telemetry.next_frame_id() if telemetry.enabled else 0
+            tick_start = time.monotonic()
             try:
                 fi = get_injector()
                 if fi is not None:
-                    fi.check("capture")
+                    act = fi.check("capture")
+                    if act is not None and act[0] == "delay":
+                        # scheduled latency fault: stall the tick (the
+                        # SLO plane must see it as frame latency)
+                        await asyncio.sleep(act[1] / 1e3)
                 self._tick_in_flight = True
                 with tracer.span("capture"), \
                         telemetry.span("capture", fid, session=self.session):
@@ -403,7 +415,9 @@ class VideoPipeline:
                 qp = self.rc.frame_qp()
                 ts = int((time.monotonic() - t0) * 90000)
                 if fi is not None:
-                    fi.check("encoder")
+                    act = fi.check("encoder")
+                    if act is not None and act[0] == "delay":
+                        await asyncio.sleep(act[1] / 1e3)
                 if hasattr(self.encoder, "submit"):
                     # pipelined path: dispatch this frame, emit whichever
                     # earlier frames completed (device latency hidden)
@@ -411,6 +425,10 @@ class VideoPipeline:
                         self._fid_by_ts[ts] = fid
                         if len(self._fid_by_ts) > 1024:  # failed-tick leaks
                             self._fid_by_ts.clear()
+                    if self.slo is not None:
+                        self._t_by_ts[ts] = tick_start
+                        if len(self._t_by_ts) > 1024:
+                            self._t_by_ts.clear()
                     # telemetry.span also sets the frame ContextVar, which
                     # asyncio.to_thread copies — the encoder's tile-cache
                     # events correlate without API changes
@@ -488,6 +506,7 @@ class VideoPipeline:
             self._outbox.extend(efs)
             if efs:
                 self._frame_ready.set()
+            slo_frames = list(efs) if self.slo is not None else None
             if self.policy is not None and not self.policy.engine.dead:
                 # after the outbox extend so a policy-triggered drain
                 # (drain_inflight) queues NEWER frames behind this
@@ -505,9 +524,29 @@ class VideoPipeline:
                     await asyncio.to_thread(self.policy.tick, efs,
                                             interval_ms)
                 if self._policy_drained:
+                    if slo_frames is not None:
+                        slo_frames.extend(self._policy_drained)
                     self._outbox.extend(self._policy_drained)
                     self._policy_drained.clear()
                     self._frame_ready.set()
+            if slo_frames is not None:
+                # SLO intake: per-frame capture→AU-ready latency from the
+                # dispatch ledger (pipelined completions are EARLIER
+                # frames — charging them this tick's span would be
+                # flattering). evaluate() is internally gated to ~1/s;
+                # breach hooks / outlier dumps never raise into the loop,
+                # and neither may the intake itself.
+                try:
+                    now_m = time.monotonic()
+                    for ef in slo_frames:
+                        t_sub = self._t_by_ts.pop(ef.timestamp_90k,
+                                                  tick_start)
+                        self.slo.observe_frame((now_m - t_sub) * 1e3,
+                                               len(ef.au),
+                                               fid=ef.frame_id)
+                    self.slo.evaluate()
+                except Exception:
+                    logger.exception("SLO intake failed")
 
     def _ef_from_stats(self, au: bytes, stats, ts: int,
                        fid: int) -> EncodedFrame:

@@ -88,6 +88,10 @@ class PolicyEngine:
         self._clear_streak = 0
         self.on_link_pressure = None   # () -> None; app wires downscale
         self.on_link_relief = None
+        # scenario-change hook: the SLO plane (monitoring/slo.py) wires
+        # SessionSLO.set_scenario here so a transition retargets the
+        # session's objectives; called with the new scenario's value
+        self.on_scenario = None
         # skip-fraction fallback arming: rows that never report a single
         # skipped MB (the software x264/x265 rows hardcode 0) carry no
         # skip signal at all — without this gate an idle desktop on such
@@ -160,10 +164,21 @@ class PolicyEngine:
         if telemetry.enabled:
             telemetry.count("selkies_policy_transitions_total",
                             session=self.session, scenario=cand.value)
+            # first-class ring event so the transition appears in dumped
+            # black-box bundles next to the frames it retuned
+            telemetry.event("policy_transition", session=self.session,
+                            scenario=cand.value, prev=prev.value,
+                            preset=self.preset)
             for s in Scenario:
                 telemetry.gauge("selkies_policy_scenario",
                                 1 if s is cand else 0,
                                 session=self.session, scenario=s.value)
+        if self.on_scenario is not None:
+            try:
+                self.on_scenario(cand.value)
+            except Exception:
+                logger.exception("scenario hook failed on session %s",
+                                 self.session)
         return plan_for(self.preset, cand)
 
     def _check_congestion(self) -> None:
@@ -271,6 +286,9 @@ class PolicyRuntime:
             if plan is not None:
                 applied = self.actuator.apply(plan)
                 if applied and telemetry.enabled:
+                    telemetry.event("policy_actuation", session=eng.session,
+                                    scenario=plan.scenario,
+                                    knobs=list(applied))
                     for knob in applied:
                         telemetry.count("selkies_policy_actuations_total",
                                         session=eng.session, knob=knob)

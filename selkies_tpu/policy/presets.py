@@ -37,9 +37,10 @@ from __future__ import annotations
 
 from dataclasses import dataclass, replace
 
+from selkies_tpu.monitoring.slo import SLOTargets
 from selkies_tpu.policy.classifier import Scenario
 
-__all__ = ["KnobPlan", "PRESETS", "plan_for"]
+__all__ = ["KnobPlan", "PRESETS", "SLO_TARGETS", "plan_for"]
 
 # batch_cap vocabulary: only ALREADY-COMPILED scan sizes are reachable
 # (1 / frame_batch//2 / frame_batch — encoder.set_batch_cap snaps), so a
@@ -118,3 +119,41 @@ PRESETS: dict[str, dict[Scenario, KnobPlan]] = {
 def plan_for(preset: str, scenario: Scenario) -> KnobPlan:
     matrix = PRESETS.get(preset) or PRESETS["balanced"]
     return matrix.get(scenario) or matrix[Scenario.UNKNOWN]
+
+
+# -- serving SLO objectives per scenario class (monitoring/slo.py) ----------
+#
+# The objectives live HERE, next to the knob matrices, because they are
+# the same kind of product statement: what this scenario's session
+# promises its user. The latency ceilings follow the scenario bench's
+# measured interaction classes (PERF.md rounds 11-12, docs/slo.md has
+# the full table with the why per row):
+#
+# * interactive rows (idle/typing) promise keystroke-class p50 — a
+#   typed character must render within ~2 capture ticks at 60 fps;
+# * scroll/drag tolerate a longer pipeline (content momentum hides
+#   ~100 ms) but promise a 20 fps floor — below that a drag visibly
+#   stutters;
+# * full-motion rows (video/game) judge by throughput + sustained
+#   latency, with the downlink budget doing the real work: a video
+#   session stuck on coefficient rows (device-entropy misconfigured)
+#   blows a 25 Mbit/s budget long before any latency ceiling trips;
+# * unknown (no classification yet, or policy off) is deliberately
+#   loose: objectives tighten only once the workload is known, so an
+#   unclassified session never pages on a scenario it isn't in.
+SLO_TARGETS: dict[Scenario, SLOTargets] = {
+    Scenario.UNKNOWN: SLOTargets(p50_ms=250.0, p95_ms=600.0,
+                                 fps_floor=5.0, down_kbps=0.0),
+    Scenario.IDLE: SLOTargets(p50_ms=50.0, p95_ms=150.0,
+                              fps_floor=10.0, down_kbps=2_000.0),
+    Scenario.TYPING: SLOTargets(p50_ms=35.0, p95_ms=100.0,
+                                fps_floor=20.0, down_kbps=3_000.0),
+    Scenario.SCROLL: SLOTargets(p50_ms=100.0, p95_ms=250.0,
+                                fps_floor=20.0, down_kbps=15_000.0),
+    Scenario.DRAG: SLOTargets(p50_ms=100.0, p95_ms=250.0,
+                              fps_floor=20.0, down_kbps=10_000.0),
+    Scenario.VIDEO: SLOTargets(p50_ms=150.0, p95_ms=400.0,
+                               fps_floor=24.0, down_kbps=25_000.0),
+    Scenario.GAME: SLOTargets(p50_ms=150.0, p95_ms=400.0,
+                              fps_floor=24.0, down_kbps=30_000.0),
+}

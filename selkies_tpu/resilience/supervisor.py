@@ -149,7 +149,12 @@ class SlotSupervisor:
         self.counters: dict[str, int] = {
             "failures": 0, "deadline_misses": 0, "idrs_forced": 0,
             "restarts": 0, "degrades": 0, "undegrades": 0, "recycles": 0,
+            "slo_warns": 0,
         }
+        # sessions currently holding the slot on the WARN rung for an
+        # SLO breach (monitoring/slo.py) — refcounted by session key so
+        # one fleet slot's recovery can't clear another's breach
+        self._slo_pressure: set[str] = set()
         self._next_restart_at = 0.0
         self._total_ok = 0  # lifetime, arms the deadline watchdog
         # escalation hook (telemetry/black-box wiring): called with
@@ -177,10 +182,19 @@ class SlotSupervisor:
         self.healthy_streak += 1
         self._total_ok += 1
         if self.rung != Rung.HEALTHY and self.degrade_level == 0:
-            self.rung = Rung.HEALTHY
             # push the rung gauge back down: alerts on an escalated rung
-            # must clear when the slot recovers, not on the next failure
-            self._emit("recovered")
+            # must clear when the slot recovers, not on the next failure.
+            # An SLO breach holds WARN — and only WARN — sticky across
+            # healthy ticks (the loop is fine, the objective isn't; only
+            # slo_clear() releases it): a transient failure's higher
+            # rung still steps down to the held WARN on recovery
+            if self._slo_pressure:
+                if self.rung > Rung.WARN:
+                    self.rung = Rung.WARN
+                    self._emit("recovered")
+            else:
+                self.rung = Rung.HEALTHY
+                self._emit("recovered")
         if self.healthy_streak >= self.recover_after:
             self.healthy_streak = 0
             self.backoff.reset()
@@ -262,6 +276,32 @@ class SlotSupervisor:
             telemetry.escalation(self.name, why)
         return self.rung
 
+    def slo_warn(self, reason: str, key: str = "slo") -> None:
+        """SLO-plane breach (monitoring/slo.py): put the slot on the
+        WARN rung WITHOUT counting a tick failure — the serving loop is
+        healthy, the latency/fps/byte objective isn't, and escalating
+        past WARN (forced IDRs, encoder restarts) would make the
+        latency worse, not better. Sticky until :meth:`slo_clear` for
+        the same ``key`` (fleet mode refcounts one supervisor across
+        many sessions' SLOs)."""
+        self._slo_pressure.add(key)
+        self.counters["slo_warns"] += 1
+        self.rung = max(self.rung, Rung.WARN)
+        self._apply("warn", lambda: self.actions.warn(
+            f"{self.name}: {reason}"))
+        self._emit("warn")
+
+    def slo_clear(self, key: str = "slo") -> None:
+        """The keyed SLO breach recovered; releases the sticky WARN once
+        every key has cleared (and nothing else holds the rung up)."""
+        self._slo_pressure.discard(key)
+        if self._slo_pressure:
+            return
+        if (self.rung == Rung.WARN and self.failures == 0
+                and self.degrade_level == 0):
+            self.rung = Rung.HEALTHY
+            self._emit("recovered")
+
     def note_idle(self) -> None:
         """No work expected (no connected client): keep the deadline clock
         from counting idle time as a stall."""
@@ -297,4 +337,5 @@ class SlotSupervisor:
 
     def stats(self) -> dict[str, int | str]:
         return {"rung": self.rung.name, "degrade_level": self.degrade_level,
+                "slo_pressure": sorted(self._slo_pressure),
                 **self.counters}

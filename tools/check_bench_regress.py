@@ -1,0 +1,194 @@
+#!/usr/bin/env python3
+"""Perf ratchet: a fresh ``bench.py --scenario`` run vs the committed rows.
+
+The scenario bench rows (BENCH_scenarios_r02.json) are the repo's
+latency/throughput ground truth — PERF.md's cost models and the SLO
+objectives (docs/slo.md) are both derived from them — but nothing
+re-ran them between PRs, so a regression surfaced only when the next
+perf round happened to look. This ratchet runs the scenario suite and
+compares each row against its committed counterpart (matched on
+scenario + policy + damage + resolution) with **stated tolerances**:
+
+* ``fps`` may drop to ``(1 - tol_fps)`` of the committed value
+  (default tol 0.40 — generous because the committed rows were measured
+  on a different container generation; the ratchet catches order-of-
+  magnitude breaks and creeping 2x regressions, not 5 % noise);
+* ``p50_latency_ms`` may grow to ``(1 + tol_p50)`` of the committed
+  value (default tol 0.60);
+* a non-zero ``compiles`` count in the timed pass fails outright when
+  the committed row RECORDS a zero count — steady state must not build
+  executables. (BENCH_scenarios_r02.json predates the field, so this
+  leg arms automatically once a future bench round commits rows that
+  carry it; absent baseline fields never fail.)
+
+Scenario rows whose baseline is missing are reported and skipped. The
+frame count defaults to the committed rows' 240 — short runs are NOT
+comparable (an idle pass at 60 frames has ~2 active frames, so its p50
+is just the IDR's latency).
+
+Usage:
+    python tools/check_bench_regress.py [--scenario idle,typing]
+        [--frames 240] [--baseline BENCH_scenarios_r02.json]
+        [--run-file rows.jsonl]        # compare an existing run instead
+        [--tol-fps 0.40] [--tol-p50 0.60]
+
+Exit 0 when every matched row is inside tolerance, 1 on regression,
+2 on usage/setup errors. Wired as a ``slow``-marked test
+(tests/test_slo.py::test_bench_regress_ratchet) so the tier-1 run stays
+fast while `-m slow` CI legs get the ratchet.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = "BENCH_scenarios_r02.json"
+
+
+def _key(row: dict) -> tuple:
+    return (row.get("scenario"), int(row.get("policy", 0)),
+            int(row.get("damage", 0)), row.get("resolution"))
+
+
+def load_rows(path: str) -> dict[tuple, dict]:
+    rows: dict[tuple, dict] = {}
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            if row.get("scenario"):
+                rows[_key(row)] = row
+    return rows
+
+
+def run_bench(scenarios: list[str], frames: int, *, policy: int = 0,
+              damage: int = 0,
+              resolution: str = "720p") -> dict[tuple, dict]:
+    """Run bench.py --scenario and parse its stdout JSON lines. The
+    resolution defaults to the committed rows' 720p — rows only match
+    baselines recorded at the same geometry."""
+    cmd = [sys.executable, os.path.join(REPO, "bench.py"),
+           "--scenario", ",".join(scenarios),
+           "--scenario-frames", str(frames),
+           "--resolution", resolution,
+           "--policy", str(policy), "--damage", str(damage)]
+    env = dict(os.environ, JAX_PLATFORMS=os.environ.get(
+        "JAX_PLATFORMS", "cpu"))
+    proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                          cwd=REPO)
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr[-4000:])
+        raise RuntimeError(f"bench.py failed (rc={proc.returncode})")
+    rows: dict[tuple, dict] = {}
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if row.get("scenario"):
+            # bench emits fps as "value"
+            row.setdefault("fps", row.get("value"))
+            rows[_key(row)] = row
+    return rows
+
+
+def compare(baseline: dict[tuple, dict], fresh: dict[tuple, dict], *,
+            tol_fps: float, tol_p50: float) -> list[str]:
+    problems: list[str] = []
+    for key, row in sorted(fresh.items(), key=str):
+        base = baseline.get(key)
+        label = "/".join(str(k) for k in key)
+        if base is None:
+            print(f"  [skip] {label}: no committed baseline row")
+            continue
+        base_fps = float(base.get("value", base.get("fps", 0)) or 0)
+        fps = float(row.get("fps", row.get("value", 0)) or 0)
+        if base_fps > 0 and fps < base_fps * (1.0 - tol_fps):
+            problems.append(
+                f"{label}: fps {fps:.2f} < {base_fps:.2f} * "
+                f"(1 - {tol_fps}) = {base_fps * (1 - tol_fps):.2f}")
+        base_p50 = float(base.get("p50_latency_ms", 0) or 0)
+        p50 = float(row.get("p50_latency_ms", 0) or 0)
+        if base_p50 > 0 and p50 > base_p50 * (1.0 + tol_p50):
+            problems.append(
+                f"{label}: p50 {p50:.1f} ms > {base_p50:.1f} ms * "
+                f"(1 + {tol_p50}) = {base_p50 * (1 + tol_p50):.1f} ms")
+        compiles = int(row.get("compiles", 0) or 0)
+        if ("compiles" in base and compiles > 0
+                and int(base.get("compiles") or 0) == 0):
+            problems.append(
+                f"{label}: {compiles} XLA compiles in the TIMED pass "
+                f"(steady state must reuse executables — see docs/slo.md)")
+        status = "OK" if not problems or not problems[-1].startswith(label) \
+            else "FAIL"
+        print(f"  [{status.lower()}] {label}: fps {fps:.2f} "
+              f"(base {base_fps:.2f}), p50 {p50:.1f} ms "
+              f"(base {base_p50:.1f}), compiles {compiles}")
+    return problems
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--scenario", default="idle,typing",
+                    help="comma-separated scenarios to ratchet "
+                         "(default: the two cheapest rows)")
+    ap.add_argument("--frames", type=int, default=240,
+                    help="frames per pass (settle + timed); must match "
+                         "the baseline rows' count for comparable "
+                         "latency percentiles")
+    ap.add_argument("--baseline",
+                    default=os.path.join(REPO, DEFAULT_BASELINE))
+    ap.add_argument("--run-file", default=None,
+                    help="compare this JSONL of bench rows instead of "
+                         "running bench.py")
+    ap.add_argument("--resolution", default="720p",
+                    help="geometry for the fresh run (must match the "
+                         "baseline rows' resolution to compare)")
+    ap.add_argument("--tol-fps", type=float, default=0.40)
+    ap.add_argument("--tol-p50", type=float, default=0.60)
+    args = ap.parse_args(argv)
+
+    if not os.path.exists(args.baseline):
+        print(f"check_bench_regress: baseline {args.baseline} missing")
+        return 2
+    baseline = load_rows(args.baseline)
+    if args.run_file:
+        fresh = load_rows(args.run_file)
+        for row in fresh.values():
+            row.setdefault("fps", row.get("value"))
+    else:
+        scenarios = [s.strip() for s in args.scenario.split(",") if s.strip()]
+        print(f"check_bench_regress: running bench.py --scenario "
+              f"{','.join(scenarios)} --scenario-frames {args.frames} "
+              f"--resolution {args.resolution}")
+        fresh = run_bench(scenarios, max(60, args.frames),
+                          resolution=args.resolution)
+    if not fresh:
+        print("check_bench_regress: no scenario rows produced")
+        return 2
+    problems = compare(baseline, fresh,
+                       tol_fps=args.tol_fps, tol_p50=args.tol_p50)
+    if problems:
+        print("\ncheck_bench_regress: PERF REGRESSION vs "
+              f"{os.path.basename(args.baseline)} (tolerances: fps "
+              f"-{args.tol_fps:.0%}, p50 +{args.tol_p50:.0%}):\n")
+        print("\n".join("  " + p for p in problems))
+        return 1
+    print(f"check_bench_regress: OK ({len(fresh)} rows inside tolerance)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,9 +9,15 @@ Usage:
 
 Renders the JSON rollup (monitoring/telemetry.py rollup()) as aligned
 tables: stage-latency histograms, counters, gauges, link bytes, slot
-health. For a black-box bundle directory it reads metrics.json and also
-summarizes events.jsonl; the bundle's trace.json loads directly in
-Perfetto (https://ui.perfetto.dev) — this tool doesn't render it.
+health — plus the subsystem blocks the later PRs added: the fleet
+lifecycle/placement rollup (PR 6: carve map, admission counters, queue,
+per-slot drain states), per-session policy scenarios (PR 10), negotiated
+codecs (PR 8.1), and the serving-SLO block (burn rates per objective and
+window, breach states, outlier counts) with the recompile sentinel's
+per-trigger compile counts. For a black-box bundle directory it reads
+metrics.json and also summarizes events.jsonl; the bundle's trace.json
+loads directly in Perfetto (https://ui.perfetto.dev) — this tool doesn't
+render it.
 """
 
 from __future__ import annotations
@@ -62,6 +68,100 @@ def _table(rows: list[tuple], header: tuple) -> str:
     return "\n".join(lines)
 
 
+def _render_policy(data: dict) -> str:
+    """Per-session scenario-policy block (selkies_tpu/policy)."""
+    rows = []
+    for sess, st in sorted(data.items()):
+        trans = st.get("transitions") or {}
+        rows.append((sess, st.get("scenario", "?"), st.get("preset", "?"),
+                     "yes" if st.get("congested") else "no",
+                     "DISARMED" if st.get("disarmed") else "armed",
+                     st.get("frames", 0),
+                     ",".join(f"{k}:{v}" for k, v in sorted(trans.items()))
+                     or "-"))
+    return _table(rows, ("session", "scenario", "preset", "congested",
+                         "engine", "frames", "transitions"))
+
+
+def _render_slo(data: dict) -> str:
+    """Per-session SLO block (monitoring/slo.py): one row per
+    session x objective with both windows' burn rates."""
+    rows = []
+    for sess, st in sorted(data.items()):
+        t = st.get("targets") or {}
+        for obj, o in sorted((st.get("objectives") or {}).items()):
+            state = ("ACUTE" if o.get("breached")
+                     else ("chronic" if o.get("chronic") else "ok"))
+            rows.append((sess, st.get("scenario", "?"), obj,
+                         o.get("fast_burn", 0.0), o.get("slow_burn", 0.0),
+                         state))
+        rows.append((sess, "", "breaches/outliers",
+                     st.get("breaches", 0), st.get("outliers", 0),
+                     f"targets p50<{t.get('p50_ms', '?')}ms "
+                     f"p95<{t.get('p95_ms', '?')}ms "
+                     f"fps>={t.get('fps_floor', '?')} "
+                     f"down<={t.get('down_kbps', 0) or '∞'}kbps"))
+    return _table(rows, ("session", "scenario", "objective", "fast_burn",
+                         "slow_burn", "state"))
+
+
+def _render_compile(data: dict) -> str:
+    """Recompile-sentinel block (monitoring/jitprof.py)."""
+    by_trigger = data.get("by_trigger") or {}
+    rows = [(t, n) for t, n in sorted(by_trigger.items())]
+    head = (f"compiles={data.get('compiles', 0)} "
+            f"cache_hits={data.get('cache_hits', 0)} "
+            f"total={data.get('compile_ms_total', 0)}ms "
+            f"storms={data.get('storms', 0)}")
+    body = _table(rows, ("trigger", "compiles")) if rows else "(no compiles)"
+    return head + "\n" + body
+
+
+def _render_placement(p: dict) -> str:
+    """SessionPlacer rollup (parallel/lifecycle.py)."""
+    head = (f"chips={p.get('chips', '?')} free={p.get('free', '?')} "
+            f"borrowed={p.get('borrowed', 0)} "
+            f"grid={p.get('grid') or '-'} "
+            f"draining={p.get('draining', False)} "
+            f"queue={p.get('queue') or []}")
+    counters = {k: v for k, v in p.items()
+                if k in ("accepts", "rejects", "queued", "reclaims",
+                         "borrows", "returns")}
+    if counters:
+        head += "\nadmission: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(counters.items()))
+    carve = p.get("carve") or {}
+    codecs = p.get("codecs") or {}
+    rows = [(k, codecs.get(k, "h264"), len(row), " ".join(row[:8])
+             + (" …" if len(row) > 8 else ""))
+            for k, row in sorted(carve.items())]
+    if rows:
+        head += "\n" + _table(rows, ("session", "codec", "chips", "devices"))
+    return head
+
+
+def _render_fleet(data: dict) -> str:
+    head = (f"sessions={data.get('sessions', '?')} "
+            f"connected={data.get('connected', '?')} "
+            f"ticks={data.get('ticks', 0)} fps={data.get('fps', '?')} "
+            f"last_tick={data.get('last_tick_ms', 0)}ms "
+            f"software={data.get('software_mode', False)}")
+    placement = data.get("placement")
+    if placement:
+        head += "\n" + _render_placement(placement)
+    return head
+
+
+# providers with a dedicated renderer; anything else dumps as JSON
+_PROVIDER_RENDERERS = {
+    "policy": _render_policy,
+    "slo": _render_slo,
+    "compile": _render_compile,
+    "fleet": _render_fleet,
+    "placement": _render_placement,
+}
+
+
 def render(rollup: dict, events: list[dict]) -> str:
     out = []
     out.append(f"telemetry rollup — enabled={rollup.get('enabled')}"
@@ -97,6 +197,13 @@ def render(rollup: dict, events: list[dict]) -> str:
     for name, data in sorted((rollup.get("providers") or {}).items()):
         if name == "link_bytes" or not data:
             continue
+        renderer = _PROVIDER_RENDERERS.get(name)
+        if renderer is not None:
+            try:
+                out.append(f"\n== {name}\n" + renderer(data))
+                continue
+            except Exception:  # malformed snapshot: fall back to raw JSON
+                pass
         out.append(f"\n== provider: {name}\n"
                    + json.dumps(data, indent=2, default=str))
 
@@ -107,6 +214,20 @@ def render(rollup: dict, events: list[dict]) -> str:
         for slot, stats in sorted((health.get("slots") or {}).items()):
             out.append(f"  {slot}: " + ", ".join(
                 f"{k}={v}" for k, v in stats.items()))
+        lc = health.get("lifecycle") or {}
+        if lc:
+            out.append(f"  lifecycle: state={lc.get('state', '?')} "
+                       f"deadline={lc.get('deadline_s', '?')}s")
+            slots = lc.get("slots") or {}
+            if slots:
+                out.append("    placement: " + ", ".join(
+                    f"{k}={v}" for k, v in sorted(slots.items())))
+        slo = health.get("slo") or {}
+        for sess, view in sorted(slo.items()):
+            breached = "+".join(view.get("breached") or []) or "-"
+            chronic = "+".join(view.get("chronic") or []) or "-"
+            out.append(f"  slo {sess}: scenario={view.get('scenario', '?')} "
+                       f"acute={breached} chronic={chronic}")
 
     trace = rollup.get("trace") or {}
     if trace:
