@@ -137,11 +137,11 @@ METRIC_FAMILIES: dict[str, str] = {
         "labeled by decision (accept/queue/reject) and reason",
     "selkies_lifecycle_events_total":
         "Fleet lifecycle transitions (drain_begin/drain_done/drain_timeout/"
-        "recarve_borrow/recarve_return/checkpoint/restore/release), "
-        "labeled by event",
+        "recarve_borrow/recarve_return/checkpoint/restore/release/"
+        "quarantine/readmit), labeled by event",
     "selkies_placement_chips":
         "Chips by placement state in the SessionPlacer carve "
-        "(free/assigned/borrowed)",
+        "(free/assigned/borrowed/quarantined)",
     "selkies_drain_state":
         "Process drain state (0=serving, 1=draining, 2=drained)",
     "selkies_codec_sessions":
@@ -188,6 +188,15 @@ METRIC_FAMILIES: dict[str, str] = {
         "Recompile storms flagged (N compiles inside the dwell window — "
         "an executable-reuse discipline is broken), labeled by the "
         "window's dominant trigger",
+    "selkies_device_health":
+        "Per-chip device health in the DevicePool "
+        "(resilience/devhealth.py): 0 healthy, 1 quarantined "
+        "(probation until sustained healthy probes readmit it), "
+        "labeled by chip",
+    "selkies_device_quarantines_total":
+        "Chip quarantine transitions (attributed step-failure streak or "
+        "failed liveness probe crossing SELKIES_DEVICE_FAIL_THRESHOLD), "
+        "labeled by chip and reason",
 }
 
 # canonical label names per family (order fixed for the Prometheus
@@ -223,6 +232,8 @@ _FAMILY_LABELS: dict[str, tuple[str, ...]] = {
     "selkies_compile_total": ("trigger",),
     "selkies_compile_ms": ("trigger",),
     "selkies_compile_storms_total": ("trigger",),
+    "selkies_device_health": ("chip",),
+    "selkies_device_quarantines_total": ("chip", "reason"),
 }
 
 _HIST_BUCKETS: dict[str, tuple[float, ...]] = {
@@ -304,6 +315,7 @@ class Telemetry:
         self._slots: dict[str, object] = {}       # slot name -> SlotSupervisor
         self._lifecycle = None                    # weakref to DrainController
         self._slo = None                          # weakref to health_view fn
+        self._devhealth = None                    # weakref to DevicePool view
         self._seq_map: dict[tuple[str, int], int] = {}  # (session, seq) -> fid
         self._frame_ids = itertools.count(1)
         self._epoch = time.time()
@@ -344,6 +356,7 @@ class Telemetry:
             self._slots.clear()
             self._lifecycle = None
             self._slo = None
+            self._devhealth = None
         self.recorder = None
         self._epoch = time.time()
 
@@ -555,6 +568,17 @@ class Telemetry:
         self._slo = weakref.WeakMethod(fn) if hasattr(fn, "__self__") \
             else weakref.ref(fn)
 
+    def register_devices(self, fn) -> None:
+        """Called by the DevicePool (resilience/devhealth.py): ``fn``
+        returns the chip-health capacity detail folded into ``health()``
+        → ``/healthz`` as the ``devices`` block — the degraded-capacity
+        signal the chronic-burn autoscaler reads. A pure chip quarantine
+        never flips the probe status; sessions carry their own impact
+        through the supervisor rungs. Weakly referenced and last-writer-
+        wins like the lifecycle/slo registrations."""
+        self._devhealth = weakref.WeakMethod(fn) if hasattr(fn, "__self__") \
+            else weakref.ref(fn)
+
     def register_slot(self, name: str, supervisor) -> None:
         """Called by SlotSupervisor.__init__: makes the slot visible to
         ``health()`` / ``/healthz`` regardless of whether metric
@@ -611,6 +635,15 @@ class Telemetry:
             # balancer must stop routing here even while slots are healthy
             if view.get("state") in ("draining", "drained") and status != "down":
                 out["status"] = "draining"
+        dev = self._devhealth() if self._devhealth is not None else None
+        if dev is not None:
+            # chip-health capacity detail (resilience/devhealth.py):
+            # quarantined chips shrink the serveable carve without any
+            # slot being unhealthy — the autoscaling plane reads this
+            try:
+                out["devices"] = dev()
+            except Exception:
+                out["devices"] = {"error": "unreadable"}
         slo = self._slo() if self._slo is not None else None
         if slo is not None:
             # SLO detail (monitoring/slo.py): which sessions are burning

@@ -571,9 +571,11 @@ class Orchestrator:
             self._emit_codec_gauge(getattr(self.app.encoder, "codec", "h264"))
             return
         try:
-            import jax
+            # health-plane view: a quarantined chip must not count
+            # toward the tile-column budget a negotiation carves over
+            from selkies_tpu.resilience.devhealth import get_device_pool
 
-            chips = len(jax.devices())
+            chips = len(get_device_pool().healthy_devices())
         except Exception:
             chips = 1
         current = getattr(self.app.encoder, "codec", "h264")

@@ -109,6 +109,10 @@ from selkies_tpu.models.stats import FrameStats, LinkByteCounter
 from selkies_tpu.monitoring.telemetry import telemetry
 from selkies_tpu.monitoring.tracing import tracer
 from selkies_tpu.parallel.sessions import _CHECK_KW, _shard_map
+from selkies_tpu.resilience.devhealth import (
+    check_device_faults,
+    get_device_pool,
+)
 
 logger = logging.getLogger("parallel.bands")
 
@@ -234,8 +238,11 @@ def band_spans(mb_height: int, bands: int) -> list[tuple[int, int]]:
 
 
 def band_mesh(bands: int, devices=None) -> Mesh:
-    """One-axis ``band`` mesh over the first `bands` devices."""
-    devs = np.array(devices if devices is not None else jax.devices())
+    """One-axis ``band`` mesh over the first `bands` devices (the
+    DevicePool's healthy view when none are given — a quarantined chip
+    never lands in a fresh mesh)."""
+    devs = np.array(devices if devices is not None
+                    else get_device_pool().healthy_devices())
     if len(devs) < bands:
         raise ValueError(f"need {bands} devices for the band mesh, have {len(devs)}")
     return Mesh(devs[:bands], axis_names=("band",))
@@ -244,7 +251,8 @@ def band_mesh(bands: int, devices=None) -> Mesh:
 def tile_mesh(rows: int, cols: int, devices=None) -> Mesh:
     """Two-axis ``(band, col)`` mesh over the first rows*cols devices:
     chip (r, c) encodes the tile at band-row r, tile-column c."""
-    devs = np.array(devices if devices is not None else jax.devices())
+    devs = np.array(devices if devices is not None
+                    else get_device_pool().healthy_devices())
     if len(devs) < rows * cols:
         raise ValueError(
             f"need {rows * cols} devices for the {rows}x{cols} tile mesh, "
@@ -258,7 +266,8 @@ def partition_devices(n_sessions: int, bands: int, devices=None) -> list[list]:
     chips-per-session vs sessions-per-slice trade. Returns n_sessions
     rows of `bands` devices; raises when the slice is too small (the
     caller decides whether to drop bands or sessions)."""
-    devs = list(devices if devices is not None else jax.devices())
+    devs = list(devices if devices is not None
+                else get_device_pool().healthy_devices())
     need = n_sessions * bands
     if len(devs) < need:
         raise ValueError(
@@ -614,13 +623,36 @@ class BandedH264Encoder:
             if grid is not None:
                 bands, cols = grid
         requested = bands if bands is not None else bands_from_env()
+        cols_req = 1 if cols is None else max(1, int(cols))
+        # device carve: explicit lists are the caller's contract; the
+        # default enumerates through the health plane (resilience/
+        # devhealth.py) so a rebuild after a chip quarantine lands on
+        # the SURVIVING chips — and, when quarantines shrank the slice
+        # below the requested carve, on a SHRUNK mesh (fewer bands;
+        # grid carves shrink in whole band-rows of `cols` chips) rather
+        # than piling the full band count onto one fallback device. A
+        # machine that simply has fewer chips than bands (no quarantine)
+        # keeps the classic identical-bytes single-device fallback.
+        if devices is not None:
+            devs = list(devices)
+        else:
+            pool = get_device_pool()
+            devs = pool.healthy_devices()
+            if pool.has_quarantined():
+                cap = max(1, len(devs) // cols_req)
+                if cap < requested:
+                    logger.warning(
+                        "%dx%d: %d bands requested but only %d healthy "
+                        "chips (quarantine active) — shrinking the carve "
+                        "to %d bands", width, height, requested,
+                        len(devs), cap)
+                    requested = cap
         self.bands = usable_bands(self._mbh, requested)
         if self.bands != requested:
             logger.info(
                 "%dx%d: %d bands requested, using %d (%d MB rows must split "
                 "into equal bands of >= %d rows)", width, height, requested,
                 self.bands, self._mbh, MIN_BAND_MB_ROWS)
-        cols_req = 1 if cols is None else max(1, int(cols))
         self.cols = usable_cols(self._mbw, cols_req)
         if self.cols != cols_req:
             logger.info(
@@ -692,7 +724,6 @@ class BandedH264Encoder:
         self._pfx_recent: list[int] = []
         self._pfx_lock = threading.Lock()
 
-        devs = list(devices) if devices is not None else jax.devices()
         chips = self.bands * self.cols
         self.mesh_enabled = chips > 1 and len(devs) >= chips
         self.params = StreamParams(width=width, height=height, qp=self.qp, fps=fps)
@@ -769,6 +800,14 @@ class BandedH264Encoder:
                                            **iconsts))
             self._step_p = jax.jit(partial(_stacked_p_step, **pconsts),
                                    donate_argnums=(4, 5, 6))
+        # the chips this encoder actually dispatches to: the device
+        # fault site checks exactly these each frame, and the health
+        # plane's restart regression asserts a rebuilt encoder's carve
+        # against them
+        self.devices = (list(devs[:chips]) if self.mesh_enabled
+                        else ([getattr(self, "_fallback_dev", None)]
+                              if getattr(self, "_fallback_dev", None)
+                              is not None else []))
         # per-band completion fan-out over the h264-pack pool, sized for
         # every slice that can be in flight at once (the solo formula
         # gains the bands factor — see encoder.py)
@@ -953,6 +992,13 @@ class BandedH264Encoder:
         an idle tick with a tight hint stops reading the whole frame."""
         if qp is not None:
             self.set_qp(qp)
+        # deterministic device chaos (resilience/devhealth.py): a
+        # scheduled device:<chip> fault kills (DeviceFault), wedges
+        # (delay) or flaps this encoder's chips exactly where hardware
+        # would — BEFORE the scan mutates any previous-frame state, so
+        # a killed tick leaves the front-end consistent and the next
+        # frame self-heals cleanly. One injector read when unset.
+        check_device_faults(self.devices)
         t0 = time.perf_counter()
         idr = (
             self._force_idr

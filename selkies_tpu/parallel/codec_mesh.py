@@ -145,10 +145,19 @@ class MeshDeltaFrontend:
         from selkies_tpu.ops.colorspace import bgrx_to_i420
         from selkies_tpu.parallel.sessions import _CHECK_KW, _shard_map
 
-        devs = np.array(devices if devices is not None else jax.devices())
+        if devices is None:
+            # single source of chip enumeration (resilience/devhealth):
+            # a rebuilt av1/vp9 tile-column mesh must land on the
+            # surviving chips after a quarantine, like the h264 mesh
+            from selkies_tpu.resilience.devhealth import get_device_pool
+
+            devices = get_device_pool().healthy_devices()
+        devs = np.array(devices)
         if len(devs) < cols:
             raise ValueError(
                 f"need {cols} devices for the column mesh, have {len(devs)}")
+        # the chips this front-end dispatches to
+        self.devices = list(devs[:cols])
         self.width, self.height, self.cols = width, height, cols
         self.pad_h = (height + 15) // 16 * 16
         # every shard an equal multiple of 16 so MBs never straddle seams

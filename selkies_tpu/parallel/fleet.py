@@ -239,7 +239,12 @@ class SessionFleet:
                 self.n, width, height, qp=qp, fps=self.base_fps,
                 bands=rows_, cols=cols_, devices=devices,
                 rows=[self.placer.row(k) for k in range(self.n)],
-                codecs=[self.placer.codec(k) for k in range(self.n)])
+                codecs=[self.placer.codec(k) for k in range(self.n)],
+                # shared small-slice rows band-slice at the full carve;
+                # non-shared rows SMALLER than it were shrunk by a chip
+                # quarantine and rebuild on fewer bands (serving.py
+                # _row_bands) — a restart must reconstruct that shape
+                shared=self.placer.shared)
         else:
             self._make_tpu_service = lambda: MultiSessionH264Service(
                 self.n, width, height, qp=qp, fps=self.base_fps, devices=devices)
@@ -517,6 +522,78 @@ class SessionFleet:
                 self._recarve_safely(lender)
         return ok
 
+    # -- device health plane (resilience/devhealth.py) -----------------
+
+    def note_device_failure(self, exc: BaseException) -> bool:
+        """Classify a failed tick as a device error: a DeviceFault in
+        the exception chain names the chip (the deterministic chaos
+        plane); jax/XLA-shaped failures fall back to probing the carve —
+        the failing mesh coordinate mapped to a chip. Crossing the
+        failure threshold quarantines the chip and re-carves every
+        session whose row held it onto the SHRUNK mesh (an emptied row
+        ejects the slot via the existing poison path — never the whole
+        batch). Returns True when a chip was newly quarantined."""
+        key = self._classify_device_failure(exc)
+        if key is None:
+            return False
+        self._quarantine_chip(key)
+        return True
+
+    def _classify_device_failure(self, exc: BaseException) -> str | None:
+        """The (possibly probing, hence blocking) classification half —
+        the serving loop runs this via to_thread and applies the
+        quarantine on the loop, where the re-carve guard is race-free."""
+        from selkies_tpu.resilience.devhealth import note_tick_failure
+
+        return note_tick_failure(exc, self.placer.devices)
+
+    def _quarantine_chip(self, key: str) -> None:
+        """Placement half of a quarantine: pull the chip out of the
+        carve and rebuild the affected sessions on their shrunk rows
+        (deferred past an in-flight tick like every re-carve). Byte
+        continuity rides the same checkpoint/restore + forced-IDR
+        machinery as a borrow."""
+        affected = self.placer.quarantine(key)
+        for k in affected:
+            if not self.placer.row(k):
+                # 0 surviving chips: the SLOT dies, not the batch — the
+                # client reconnects into freed capacity once chips exist
+                logger.error("session %d lost its last chip to the "
+                             "quarantine of %s; ejecting slot", k, key)
+                self.on_slot_poisoned(k)
+            self._recarve_safely(k)
+
+    def _device_health_tick(self) -> None:
+        """Synchronous health work (tests, direct callers): probation
+        probes then the carve sync. The watchdog splits the two — the
+        probes (which can block on sick hardware) go to a thread, the
+        carve mutations stay on the event loop where the
+        ``_tick_in_flight`` re-carve guard is race-free."""
+        from selkies_tpu.resilience import peek_device_pool
+
+        pool = peek_device_pool()
+        if pool is None:
+            return
+        pool.tick()
+        self._device_health_sync(pool)
+
+    def _device_health_sync(self, pool) -> None:
+        """Converge the placer to the pool's health view (no probes, no
+        blocking — loop-safe): quarantines the pool discovered outside
+        the tick path (flap noise crossing the threshold) shrink the
+        carve, and chips the pool readmitted rejoin it. Reconciles by
+        STATE, not by tick()'s return value — another consumer (the
+        solo pipeline's watchdog, a second fleet) may have driven the
+        probes that readmitted a chip."""
+        for key in pool.quarantined_keys():
+            if self.placer.owns(key) and not self.placer.is_quarantined(key):
+                self._quarantine_chip(key)
+        for key in self.placer.quarantined_keys():
+            if not pool.is_quarantined(key):
+                home = self.placer.readmit(key)
+                if home is not None:
+                    self._recarve_safely(home)
+
     def checkpoint_all(self) -> list:
         """Drain hand-off: checkpoint every connected session's minimal
         encoder state (lifecycle.checkpoint_session)."""
@@ -681,6 +758,21 @@ class SessionFleet:
                 self.supervisor.check_deadline()
             else:
                 self.supervisor.note_idle()
+            try:
+                # probation probes can block (device round-trips to sick
+                # hardware, injected delay faults): they run off the
+                # loop; the carve sync then runs ON the loop so the
+                # _tick_in_flight re-carve guard stays race-free —
+                # a thread-side recarve could read the flag as clear
+                # just as the loop dispatches the next encode tick
+                from selkies_tpu.resilience import peek_device_pool
+
+                pool = peek_device_pool()
+                if pool is not None:
+                    await asyncio.to_thread(pool.tick)
+                    self._device_health_sync(pool)
+            except Exception:
+                logger.exception("device health tick failed")
 
     def _capture_batch(self) -> list[tuple[int, Exception]]:
         """Capture every session's frame. A source that throws (X server
@@ -885,6 +977,20 @@ class SessionFleet:
                 logger.exception("fleet tick error (%d consecutive)",
                                  self.supervisor.failures + 1)
                 self._tick_in_flight = False
+                # device-error classification BEFORE the ladder acts: a
+                # quarantine re-carves the hit sessions onto surviving
+                # chips, so the ladder's own restart (if the streak gets
+                # there) rebuilds on a healthy carve instead of the dead
+                # chip forever. The classification may PROBE (blocking
+                # device round-trips) — it runs off the loop; the carve
+                # mutation runs on it, where _tick_in_flight is stable.
+                try:
+                    key = await asyncio.to_thread(
+                        self._classify_device_failure, exc)
+                    if key is not None:
+                        self._quarantine_chip(key)
+                except Exception:
+                    logger.exception("device-failure classification failed")
                 self.supervisor.failure(exc)
             finally:
                 self._tick_in_flight = False

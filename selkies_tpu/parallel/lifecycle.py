@@ -52,7 +52,7 @@ from dataclasses import asdict, dataclass, field
 
 from selkies_tpu.monitoring.telemetry import telemetry
 from selkies_tpu.monitoring.tracing import tracer
-from selkies_tpu.resilience import InjectedFault, get_injector
+from selkies_tpu.resilience import InjectedFault, chip_key, get_injector
 
 logger = logging.getLogger("parallel.lifecycle")
 
@@ -128,10 +128,18 @@ class SessionPlacer:
                  host_cores: int | None = None,
                  queue_limit: int | None = None,
                  health=None):
+        preq: tuple[str, ...] = ()
         if devices is None:
-            import jax
+            # the device health plane is the single source of chip
+            # enumeration (resilience/devhealth.py): the placer owns ALL
+            # chips — quarantine is a first-class placement location —
+            # and pre-applies whatever the pool already quarantined, so
+            # placement and health can never disagree about the chip set
+            from selkies_tpu.resilience import get_device_pool
 
-            devices = jax.devices()
+            pool = get_device_pool()
+            devices = pool.all_devices()
+            preq = tuple(pool.quarantined_keys())
         self.devices = list(devices)
         self.bands = max(1, int(bands))
         # 2D tile-grid carve shape (SELKIES_TILE_GRID=RxC): purely
@@ -153,6 +161,14 @@ class SessionPlacer:
         self._lock = threading.RLock()
         self._free: list = list(self.devices)
         self._rows: dict[int, list] = {}
+        # quarantined chips: the third first-class location (free pool /
+        # a row / quarantine) the every-chip-in-exactly-one-place
+        # invariant covers. _quarantine_home remembers which session's
+        # row a chip was pulled from so readmit can restore the carve.
+        self._quarantined: dict[str, object] = {}
+        self._quarantine_home: dict[str, int | None] = {}
+        self._key_map: dict[str, object] = {
+            chip_key(d): d for d in self.devices}
         # borrower -> [(lender, chips), ...]; lenders' rows sit empty
         # ("lent") until the borrower returns or releases
         self._debts: dict[int, list[tuple[int, list]]] = {}
@@ -173,6 +189,8 @@ class SessionPlacer:
         # wired by the serving layer: called with a session id when a
         # queued session gains capacity on someone else's release
         self.on_admitted = None
+        for key in preq:  # pool-known quarantines predate this carve
+            self.quarantine(key)
 
     # -- initial carve --------------------------------------------------
 
@@ -189,7 +207,11 @@ class SessionPlacer:
                 if self._rows:
                     raise RuntimeError("place_initial called on a live carve")
                 self.shared = True
-                devs = self.devices
+                # round-robin over the HEALTHY chips: a quarantine that
+                # pre-dates the carve (pool preq) must not pin a shared
+                # session to a dead chip — shared mode has no later
+                # quarantine transition to move it off
+                devs = self._shared_devs_locked()
                 self._rows = {
                     k: [devs[k % len(devs)]] for k in range(n_sessions)}
                 logger.info(
@@ -279,8 +301,8 @@ class SessionPlacer:
                 return Admission("reject", "unhealthy")
             need = self.bands if bands is None else max(1, int(bands))
             if self.shared:
-                self._rows[session] = [
-                    self.devices[session % len(self.devices)]]
+                devs = self._shared_devs_locked()
+                self._rows[session] = [devs[session % len(devs)]]
                 return Admission("accept", "shared")
             if self._committed_workers() + need > max(2, 2 * self.host_cores):
                 return self._enqueue(session, "pack-pool")
@@ -290,6 +312,15 @@ class SessionPlacer:
                     self._queue.remove(session)
                 return Admission("accept", "placed")
             return self._enqueue(session, "capacity")
+
+    def _shared_devs_locked(self) -> list:
+        """Shared-carve round-robin candidates (lock held): healthy
+        chips only, falling back to every owned chip when quarantine
+        has emptied the healthy set (serve degraded over serve
+        nothing)."""
+        healthy = [d for d in self.devices
+                   if chip_key(d) not in self._quarantined]
+        return healthy or list(self.devices)
 
     def _committed_workers(self) -> int:
         """CAVLC pack workers committed to busy sessions (lock held)."""
@@ -339,21 +370,14 @@ class SessionPlacer:
             self._busy.discard(session)
             if session in self._queue:
                 self._queue.remove(session)
+            # a released session's quarantine homes are orphaned: a chip
+            # readmitted later must settle to the POOL, never into
+            # whatever row this session id is re-admitted into
+            for key, home in self._quarantine_home.items():
+                if home == session:
+                    self._quarantine_home[key] = None
             self.counters["releases"] += 1
-            if not self.shared:
-                # promotion grants rows to CAPACITY-queued sessions only;
-                # a pack-pool-queued session already holds a row (carving
-                # it another would leak the old one) and gets in via its
-                # client's reconnect retry once headroom frees
-                while len(self._free) >= self.bands:
-                    sid = next((s for s in self._queue
-                                if not self._rows.get(s)), None)
-                    if sid is None:
-                        break
-                    self._queue.remove(sid)
-                    self._rows[sid] = [self._free.pop(0)
-                                       for _ in range(self.bands)]
-                    promoted.append(sid)
+            promoted = self._promote_locked()
         if telemetry.enabled:
             telemetry.count("selkies_lifecycle_events_total", event="release")
         self._export_gauges()
@@ -445,6 +469,157 @@ class SessionPlacer:
             return [b for b, debts in self._debts.items()
                     if any(l == lender for l, _ in debts)]
 
+    def _promote_locked(self) -> list[int]:
+        """Grant freed capacity to CAPACITY-queued sessions (lock held);
+        a pack-pool-queued session already holds a row (carving it
+        another would leak the old one) and gets in via its client's
+        reconnect retry once headroom frees. Returns the promoted ids —
+        the caller fires ``on_admitted`` outside the lock."""
+        promoted: list[int] = []
+        if self.shared:
+            return promoted
+        while len(self._free) >= self.bands:
+            sid = next((s for s in self._queue
+                        if not self._rows.get(s)), None)
+            if sid is None:
+                break
+            self._queue.remove(sid)
+            self._rows[sid] = [self._free.pop(0)
+                               for _ in range(self.bands)]
+            promoted.append(sid)
+        return promoted
+
+    # -- device quarantine (the health plane's placement half) ----------
+
+    def quarantine(self, chip) -> list[int]:
+        """Pull one chip out of circulation — from the free pool, a
+        session's row, or a live borrow debt — into the quarantine
+        location. Returns the sessions whose rows shrank (the serving
+        layer re-carves them on the smaller carve; an emptied row is its
+        caller's poison-path signal). Accepts a device object or its
+        ``chip_key``. No-op in the shared small-slice carve (rows alias
+        chips and there is no capacity math to shrink)."""
+        key = chip if isinstance(chip, str) else chip_key(chip)
+        affected: list[int] = []
+        with self._lock:
+            if self.shared or key in self._quarantined:
+                return []
+            dev = self._key_map.get(key)
+            if dev is None:
+                return []  # not a chip this placer owns
+            home: int | None = None
+            if dev in self._free:
+                self._free.remove(dev)
+            else:
+                for k, row in self._rows.items():
+                    if dev in row:
+                        self._rows[k] = [d for d in row if d != dev]
+                        affected.append(k)
+                        home = k
+                        break
+                # a chip on loan sits in the borrower's row (removed
+                # above) AND in a debt record: shrink the debt too, or
+                # settling it would resurrect the quarantined chip into
+                # the lender's row. The LENDER is the home — the chip
+                # belongs to its carve, not the borrower's — and an
+                # orphaned loan (lender already released, recorded as
+                # None) homes to the POOL: readmitting it into the
+                # borrower's row would grow it past the bands carve
+                # with no debt record to reclaim the chip by.
+                for b, debts in self._debts.items():
+                    fixed = []
+                    for lender, cs in debts:
+                        if dev in cs:
+                            cs = [c for c in cs if c != dev]
+                            home = lender
+                        fixed.append((lender, cs))
+                    self._debts[b] = fixed
+            self._quarantined[key] = dev
+            self._quarantine_home[key] = home
+        logger.error("placer: chip %s quarantined (home session %s, "
+                     "%d rows shrank)", key, home, len(affected))
+        if telemetry.enabled:
+            telemetry.count("selkies_lifecycle_events_total",
+                            event="quarantine")
+            telemetry.event("device", chip=key, action="placer_quarantine",
+                            sessions=affected)
+        self._export_gauges()
+        self.assert_consistent()
+        return affected
+
+    def readmit(self, chip) -> int | None:
+        """A quarantined chip passed probation: restore it to its home
+        session's row when that session still holds a live row (the
+        caller re-carves it back up — and a later borrow can hand the
+        chip out again), otherwise to the free pool, where it may
+        promote a queued session. Returns the session it rejoined, or
+        None."""
+        key = chip if isinstance(chip, str) else chip_key(chip)
+        promoted: list[int] = []
+        home_out: int | None = None
+        with self._lock:
+            dev = self._quarantined.pop(key, None)
+            if dev is None:
+                return None
+            home = self._quarantine_home.pop(key, None)
+            if (home is not None and not self.shared
+                    and home in self._rows):
+                if self._rows[home]:
+                    self._rows[home] = self._rows[home] + [dev]
+                    home_out = home
+                else:
+                    # the home row is EMPTY: either its chips are lent
+                    # out (this chip was quarantined off a live loan —
+                    # rejoin the outstanding DEBT so the eventual
+                    # return restores the lender's full carve, instead
+                    # of silently shrinking it forever) or quarantine
+                    # itself emptied the row (give the chip back).
+                    borrower = next(
+                        (b for b, debts in self._debts.items()
+                         if any(l == home for l, _ in debts)), None)
+                    if borrower is not None:
+                        self._rows[borrower] = self._rows[borrower] + [dev]
+                        self._debts[borrower] = [
+                            ((l, cs + [dev]) if l == home else (l, cs))
+                            for l, cs in self._debts[borrower]]
+                        home_out = borrower
+                    else:
+                        self._rows[home] = [dev]
+                        home_out = home
+            else:
+                self._free.append(dev)
+                promoted = self._promote_locked()
+        logger.warning("placer: chip %s readmitted (%s)", key,
+                       f"session {home_out}" if home_out is not None
+                       else "free pool")
+        if telemetry.enabled:
+            telemetry.count("selkies_lifecycle_events_total",
+                            event="readmit")
+            telemetry.event("device", chip=key, action="placer_readmit",
+                            home=home_out)
+        self._export_gauges()
+        self.assert_consistent()
+        for sid in promoted:
+            if self.on_admitted is not None:
+                try:
+                    self.on_admitted(sid)
+                except Exception:
+                    logger.exception("on_admitted(%d) failed", sid)
+        return home_out
+
+    def is_quarantined(self, chip) -> bool:
+        key = chip if isinstance(chip, str) else chip_key(chip)
+        with self._lock:
+            return key in self._quarantined
+
+    def owns(self, chip) -> bool:
+        key = chip if isinstance(chip, str) else chip_key(chip)
+        return key in self._key_map
+
+    def quarantined_keys(self) -> list[str]:
+        with self._lock:
+            return sorted(self._quarantined)
+
     # -- read side ------------------------------------------------------
 
     def row(self, session: int) -> list:
@@ -494,6 +669,7 @@ class SessionPlacer:
             return {
                 "chips": len(self.devices),
                 "free": len(self._free) if not self.shared else 0,
+                "quarantined": sorted(self._quarantined),
                 "grid": (f"{self.grid[0]}x{self.grid[1]}"
                          if self.grid is not None else None),
                 "shared": self.shared,
@@ -509,13 +685,15 @@ class SessionPlacer:
 
     def assert_consistent(self) -> None:
         """The no-over-commit / no-leak invariant: in a non-shared carve
-        every device sits in exactly one place (free pool or one row)."""
+        every device sits in exactly one place (free pool, one row, or
+        quarantine)."""
         if self.shared:
             return
         with self._lock:
             seen: list = list(self._free)
             for row in self._rows.values():
                 seen.extend(row)
+            seen.extend(self._quarantined.values())
             if len(seen) != len(self.devices) or \
                     {id(d) for d in seen} != {id(d) for d in self.devices}:
                 raise AssertionError(
@@ -531,15 +709,18 @@ class SessionPlacer:
                 # same chips, so summing them would double-count — every
                 # owned chip is in use and nothing is free or borrowable
                 # (matching stats()/'/statz', which forces free=0)
-                free, borrowed = 0, 0
+                free, borrowed, quarantined = 0, 0, 0
                 assigned = len(self.devices)
             else:
                 free = len(self._free)
                 borrowed = self._borrowed()
                 assigned = sum(len(r) for r in self._rows.values()) - borrowed
+                quarantined = len(self._quarantined)
         telemetry.gauge("selkies_placement_chips", free, state="free")
         telemetry.gauge("selkies_placement_chips", assigned, state="assigned")
         telemetry.gauge("selkies_placement_chips", borrowed, state="borrowed")
+        telemetry.gauge("selkies_placement_chips", quarantined,
+                        state="quarantined")
         # emit zeros for every codec that ever had a session too —
         # Prometheus gauges keep their last value, so dropping the series
         # when the last av1 session releases would freeze it at 1

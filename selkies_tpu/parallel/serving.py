@@ -27,6 +27,7 @@ from selkies_tpu.models.h264.native import pack_slice_fast, pack_slice_p_fast
 from selkies_tpu.models.h264.numpy_ref import FrameCoeffs, PFrameCoeffs
 from selkies_tpu.monitoring.tracing import tracer
 from selkies_tpu.parallel.sessions import MultiSessionEncoder
+from selkies_tpu.resilience.devhealth import check_device_faults
 
 logger = logging.getLogger("parallel.serving")
 
@@ -88,6 +89,10 @@ class MultiSessionH264Service:
         self._batch_y = np.empty((n_sessions, height, width), np.uint8)
         self._batch_u = np.empty((n_sessions, height // 2, width // 2), np.uint8)
         self._batch_v = np.empty((n_sessions, height // 2, width // 2), np.uint8)
+        # the session mesh's chips, for the device:<chip> fault site —
+        # a seeded schedule can kill/wedge/flap one chip of the lockstep
+        # batch mid-stream (resilience/devhealth.py)
+        self.devices = list(np.asarray(self.enc.mesh.devices).flat)
 
     def set_qp(self, session: int, qp: int) -> None:
         if not 0 <= qp <= 51:
@@ -101,6 +106,7 @@ class MultiSessionH264Service:
         """(N, H, W, 4) BGRx batch -> one Annex-B access unit per session."""
         if frames.shape[0] != self.n:
             raise ValueError(f"expected {self.n} frames, got {frames.shape[0]}")
+        check_device_faults(self.devices)
         idrs = np.array(
             [s.force_idr or s.frames_since_idr == 0 for s in self.sessions], bool
         )
@@ -193,7 +199,8 @@ class BandedFleetService:
                  qp: int = 28, fps: int = 60, bands: int | None = None,
                  cols: int | None = None,
                  devices=None, rows: list[list] | None = None,
-                 codecs: list[str] | None = None):
+                 codecs: list[str] | None = None,
+                 shared: bool | None = None):
         from selkies_tpu.parallel.bands import (
             BandedH264Encoder, bands_from_env, grid_from_env,
             partition_devices)
@@ -222,6 +229,12 @@ class BandedFleetService:
         # cols: per-session 2D tile grid (each session's row of chips is
         # an R×C mesh; a session's chip budget is bands*cols)
         self.cols = 1 if cols is None else max(1, int(cols))
+        # shared small-slice carve (placer.shared): rows round-robin one
+        # chip each but every session still band-slices at the REQUESTED
+        # count (identical bytes, no parallelism). Distinguished from a
+        # quarantine-SHRUNK row, which genuinely re-slices into fewer
+        # bands — _row_bands branches on this.
+        self.shared_carve = bool(shared) if shared is not None else False
         if rows is None:
             # no placer-managed carve handed in: one-shot static carve
             try:
@@ -233,10 +246,12 @@ class BandedFleetService:
                 # round-robined across the chips that DO exist — passing
                 # the full device list through would instead build every
                 # session's band mesh over the same first `bands` chips
-                import jax
+                from selkies_tpu.resilience.devhealth import get_device_pool
 
-                devs = list(devices if devices is not None else jax.devices())
+                devs = list(devices if devices is not None
+                            else get_device_pool().healthy_devices())
                 rows = [[devs[k % len(devs)]] for k in range(n_sessions)]
+                self.shared_carve = True
         self._width, self._height = width, height
         self._qp, self._fps, self._bands_req = qp, fps, bands
         # an empty row means the session is PARKED: its chips are lent
@@ -352,8 +367,20 @@ class BandedFleetService:
         ``usable_bands`` when the geometry's MB rows do not divide into
         that many bands — at such geometries the extra chips cannot
         carry a slice and the band count (and the bytes) stay exactly
-        the constructor carve's."""
-        return max(self._bands_req, len(row) // self.cols)
+        the constructor carve's.
+
+        A row SMALLER than the constructor carve in a non-shared
+        placement means the health plane quarantined a chip out of it:
+        the session rebuilds on a SHRUNK mesh (fewer bands; grid carves
+        round down in whole band-rows of ``cols`` chips), degrading to
+        the plain single-band/single-chip encode at 1 surviving chip.
+        The shared small-slice carve is exempt — its 1-chip rows always
+        band-slice at the requested count (identical bytes by contract,
+        parallel/bands.py)."""
+        n = len(row) // self.cols
+        if self.shared_carve or n >= self._bands_req:
+            return max(self._bands_req, n)
+        return max(1, n)
 
     def recarve(self, session: int, devices: list) -> None:
         """Rebuild one session's encoder on a new device row (the

@@ -61,7 +61,32 @@ __all__ = ["MultiSessionEncoder", "dryrun"]
 
 
 def _session_mesh(n: int, devices=None) -> Mesh:
-    devs = np.array(devices if devices is not None else jax.devices()[:n])
+    if devices is None:
+        # single source of chip enumeration (resilience/devhealth.py):
+        # a fleet service rebuilt after a chip quarantine places its
+        # session mesh on the surviving chips. The lockstep carve needs
+        # one DISTINCT chip per session and cannot shrink its session
+        # count, so when quarantines leave fewer healthy chips than
+        # sessions the mesh falls back to the full enumeration: the
+        # rebuild stays BUILDABLE (the pre-health-plane behavior)
+        # instead of raising until probation — a genuinely dead chip
+        # still fails the single SPMD batch tick, and the supervisor
+        # ladder's software-fleet rung is the availability floor there
+        from selkies_tpu.resilience.devhealth import get_device_pool
+
+        pool = get_device_pool()
+        healthy = pool.healthy_devices()
+        if len(healthy) >= n:
+            devices = healthy[:n]
+        else:
+            import logging
+
+            logging.getLogger("parallel.sessions").warning(
+                "session mesh needs %d chips but only %d are healthy; "
+                "using the full enumeration (quarantined chips included)",
+                n, len(healthy))
+            devices = pool.all_devices()[:n]
+    devs = np.array(devices)
     if len(devs) < n:
         raise ValueError(f"need {n} devices, have {len(devs)}")
     return Mesh(devs[:n], axis_names=("session",))

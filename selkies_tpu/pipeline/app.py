@@ -284,6 +284,7 @@ class TPUWebRTCApp:
         )
         self.pipeline.on_geometry_change = self._rebuild_encoder
         self.pipeline.supervisor = self.supervisor
+        self.pipeline.on_device_fault = self._on_device_fault
         self.pipeline.slo = self.slo
         if self.policy_engine is not None:
             from selkies_tpu.policy import PolicyRuntime
@@ -443,6 +444,18 @@ class TPUWebRTCApp:
                 old.close()
             except Exception:
                 logger.exception("closing replaced encoder")
+
+    def _on_device_fault(self, chip: str) -> None:
+        """A chip this session encodes on was just quarantined
+        (resilience/devhealth.py): rebuild the encoder immediately on
+        the surviving carve — the registry's pool-routed device default
+        enumerates only healthy chips, shrinking the band count when the
+        quarantine leaves fewer chips than the carve — instead of the
+        ladder grinding three more failures to its RESTART rung on the
+        dead device."""
+        logger.error("chip %s quarantined; rebuilding the encoder on the "
+                     "surviving carve", chip)
+        self._restart_encoder()
 
     def _restart_encoder(self) -> None:
         """Ladder rung 3: same row, fresh instance — recovers encoders

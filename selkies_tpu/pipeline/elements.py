@@ -306,6 +306,11 @@ class VideoPipeline:
         # any failed/dropped tick AFTER a capture breaks that pairing
         # and forces one full-scan submit to resync (superset contract)
         self._damage_stale = True
+        # device health plane (resilience/devhealth.py): called with a
+        # chip key when a tick failure crossed the quarantine threshold
+        # — the app rebuilds the encoder immediately on the surviving
+        # carve instead of waiting for the ladder's RESTART rung
+        self.on_device_fault: Callable[[str], None] | None = None
 
     @property
     def running(self) -> bool:
@@ -334,6 +339,47 @@ class VideoPipeline:
         while True:
             await asyncio.sleep(1.0)
             self.supervisor.check_deadline()
+            try:
+                # probation probes / readmits for quarantined chips — a
+                # readmitted chip re-enters the pool's healthy view and
+                # the next encoder rebuild carves over it again. No-op
+                # (and no jax init) while no pool exists. Probes can
+                # block (device round-trips to sick hardware, injected
+                # delay faults), so they run off the event loop.
+                from selkies_tpu.resilience.devhealth import peek_device_pool
+
+                pool = peek_device_pool()
+                if pool is not None:
+                    await asyncio.to_thread(pool.tick)
+            except Exception:
+                logger.exception("device health tick failed")
+
+    def _note_device_failure(self, exc: BaseException) -> None:
+        """Classify a failed tick as a device error (a DeviceFault in
+        the chain names the chip; jax/XLA-shaped errors probe the
+        encoder's carve) and feed the health plane. Crossing the
+        threshold quarantines the chip and fires ``on_device_fault`` so
+        the app rebuilds on the surviving carve at once. Never raises.
+        The serving loop runs the (possibly probing, hence blocking)
+        classification half via to_thread instead of this sync whole."""
+        self._fire_device_fault(self._classify_device_failure(exc))
+
+    def _classify_device_failure(self, exc: BaseException) -> str | None:
+        try:
+            from selkies_tpu.resilience.devhealth import note_tick_failure
+
+            return note_tick_failure(
+                exc, getattr(self.encoder, "devices", None))
+        except Exception:
+            logger.exception("device-failure classification failed")
+            return None
+
+    def _fire_device_fault(self, key: str | None) -> None:
+        if key is not None and self.on_device_fault is not None:
+            try:
+                self.on_device_fault(key)
+            except Exception:
+                logger.exception("on_device_fault(%s) failed", key)
 
     async def stop(self) -> None:
         for attr in ("_task", "_sender", "_watchdog"):
@@ -494,6 +540,10 @@ class VideoPipeline:
                 # the lost frame's rects — resync with one full scan
                 self._damage_stale = True
                 logger.exception("video pipeline frame error (%d consecutive)", failures)
+                # classification may probe (blocking device round-trips)
+                # — off the loop; the rebuild hook fires back on it
+                self._fire_device_fault(await asyncio.to_thread(
+                    self._classify_device_failure, exc))
                 if self.supervisor is not None:
                     # supervised: the ladder handles escalation (force IDR,
                     # encoder restart, degradation, recycle) and the loop

@@ -9,6 +9,16 @@ seeded fault-injection harness (``SELKIES_FAULTS``) so the ladder is
 exercised deterministically in tests instead of only in production.
 """
 
+from selkies_tpu.resilience.devhealth import (
+    DeviceFault,
+    DevicePool,
+    check_device_faults,
+    chip_key,
+    get_device_pool,
+    peek_device_pool,
+    reset_device_pool,
+    set_device_pool,
+)
 from selkies_tpu.resilience.faultinject import (
     FaultInjector,
     InjectedFault,
@@ -24,11 +34,19 @@ from selkies_tpu.resilience.supervisor import (
 
 __all__ = [
     "Backoff",
+    "DeviceFault",
+    "DevicePool",
     "FaultInjector",
     "InjectedFault",
     "Rung",
     "SlotSupervisor",
+    "check_device_faults",
+    "chip_key",
     "configure_faults",
+    "get_device_pool",
     "get_injector",
+    "peek_device_pool",
+    "reset_device_pool",
     "reset_faults",
+    "set_device_pool",
 ]

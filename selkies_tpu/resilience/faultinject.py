@@ -13,6 +13,7 @@ Grammar (semicolon-separated rules)::
            | frontend                                   (uplink front-end)
            | admission | recarve | migrate | drain      (fleet lifecycle)
            | policy                                     (scenario policy)
+           | device                                     (chip health plane)
            (wired sites; names are free-form)
     sched  = tick list / ranges  "5,9,13" or "20-22" or "5,9,20-22"
            | "every:N"           every Nth call (1-based)
@@ -37,7 +38,17 @@ the top of the pipelined encoder's submit — inside the uplink
 classify/hash/convert stage — so a ``raise`` exercises the
 double-buffered front-end's failure contract: frames already in flight
 stay deliverable in order, and the next submit self-heals as a
-full-upload IDR (tests/test_frontend_parallel.py).
+full-upload IDR (tests/test_frontend_parallel.py). ``device:<chip>``
+fires per chip in the banded/tiled encoders and the lockstep session
+service, once per encode per chip (resilience/devhealth.py
+check_device_faults, plus every probation probe of a quarantined chip):
+``raise``/``drop`` kill the step with a DeviceFault naming the chip —
+the supervisor's classification quarantines it and re-carves the
+session onto the surviving chips — ``delay:<ms>`` wedges the chip (the
+tick-deadline watchdog's territory), and ``flap`` records a health-plane
+blip without failing the frame, which the
+``SELKIES_DEVICE_FAIL_THRESHOLD`` streak must absorb
+(tests/test_device_faults.py).
 
 Examples::
 

@@ -1,0 +1,790 @@
+"""Device-fault tolerance (resilience/devhealth.py + the placement half).
+
+The ISSUE 14 acceptance contract:
+
+* seeded ``device:<chip>`` chaos on a 4-band fleet session quarantines
+  the chip, the session re-carves to 3 bands and resumes within one GOP
+  **byte-identical** to a 3-band oracle from the first recovery IDR;
+* after probation the chip is readmitted (sustained healthy probes) and
+  a subsequent borrow can hand it out again;
+* the placer's every-chip-in-exactly-one-place invariant — quarantine
+  included as a first-class location — holds after every transition,
+  including a 60-op chaos schedule mixing device faults with
+  borrow/return/migrate/drain;
+* a restart/rebuild of a banded slot consults device health instead of
+  the constructor-time device row (kill chip → rebuild lands on the
+  surviving chips, shrunk bands).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import time
+
+import numpy as np
+import pytest
+
+from selkies_tpu.monitoring.telemetry import telemetry
+from selkies_tpu.parallel.lifecycle import SessionPlacer, checkpoint_session
+from selkies_tpu.resilience import (
+    DeviceFault,
+    DevicePool,
+    InjectedFault,
+    check_device_faults,
+    chip_key,
+    configure_faults,
+    reset_device_pool,
+    reset_faults,
+    set_device_pool,
+)
+from selkies_tpu.resilience.devhealth import (
+    fail_threshold_from_env,
+    probation_from_env,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# mbh = 12: divides into 4 bands (3 MB rows each) AND 3 bands (4 rows) —
+# the 4-band -> quarantined -> 3-band shrink is representable exactly
+W, H = 64, 192
+
+
+@pytest.fixture
+def faults():
+    yield configure_faults
+    reset_faults()
+
+
+@pytest.fixture
+def pool_reset():
+    yield set_device_pool
+    reset_device_pool()
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def chips(n=8):
+    return [f"chip{i}" for i in range(n)]
+
+
+# -- DevicePool: thresholds, probation, probes --------------------------
+
+
+def test_pool_threshold_quarantine_and_streak_reset():
+    clk = _Clock()
+    p = DevicePool(devices=chips(3), fail_threshold=3, probation_s=10,
+                   clock=clk)
+    assert not p.note_failure("chip1")
+    assert not p.note_failure("chip1")
+    p.note_ok("chip1")                      # healthy evidence resets streak
+    assert not p.note_failure("chip1")
+    assert not p.note_failure("chip1")
+    assert p.note_failure("chip1")          # third consecutive: quarantined
+    assert p.is_quarantined("chip1")
+    assert p.healthy_devices() == ["chip0", "chip2"]
+    assert p.quarantined_keys() == ["chip1"]
+    # an already-quarantined chip absorbs further failures silently
+    assert not p.note_failure("chip1")
+
+
+def test_pool_stale_streak_restarts():
+    """Isolated blips spread over hours (older than one probation
+    window) must not accumulate into a quarantine."""
+    clk = _Clock()
+    p = DevicePool(devices=chips(2), fail_threshold=2, probation_s=10,
+                   clock=clk)
+    assert not p.note_failure("chip0")
+    clk.t += 100.0                          # way past the probation window
+    assert not p.note_failure("chip0")      # streak restarted at 1
+    assert not p.is_quarantined("chip0")
+    assert p.note_failure("chip0")          # back-to-back: quarantined
+
+
+def test_pool_probation_backoff_and_probe_readmit():
+    clk = _Clock()
+    probes: list[str] = []
+
+    def probe(dev):
+        probes.append(dev)
+        return True
+
+    p = DevicePool(devices=chips(2), fail_threshold=1, probation_s=10,
+                   readmit_after=3, clock=clk, probe=probe)
+    assert p.note_failure("chip0")
+    assert p.tick() == [] and probes == []   # probation: no probes yet
+    clk.t += 11.0
+    assert p.tick() == [] and probes == ["chip0"]
+    assert p.tick() == []
+    assert p.tick() == ["chip0"]             # third healthy probe readmits
+    assert p.healthy_devices() == chips(2)
+    # re-quarantine doubles probation (capped backoff)
+    assert p.quarantine("chip0")
+    st = p.stats()["quarantined"]["chip0"]
+    assert st["probation_s"] == 20.0 and st["quarantines"] == 2
+    # the cap: repeated quarantines never exceed 8x the base
+    for _ in range(6):
+        p.readmit("chip0")
+        p.quarantine("chip0")
+    assert p.stats()["quarantined"]["chip0"]["probation_s"] == 80.0
+
+
+def test_pool_failed_probe_extends_probation():
+    clk = _Clock()
+    p = DevicePool(devices=chips(1), fail_threshold=1, probation_s=10,
+                   readmit_after=1, clock=clk, probe=lambda d: False)
+    assert p.note_failure("chip0")
+    clk.t += 11.0
+    assert p.tick() == []                    # probe failed
+    st = p.stats()["quarantined"]["chip0"]
+    assert st["probation_s"] == 20.0         # one doubled window re-armed
+    assert p.is_quarantined("chip0")
+
+
+def test_pool_tracks_unknown_chips_lazily():
+    p = DevicePool(devices=chips(2), fail_threshold=1, probation_s=5,
+                   clock=_Clock())
+    assert p.note_failure("ghost")           # a chip this pool never owned
+    assert p.is_quarantined("ghost")
+    assert p.healthy_devices() == chips(2)   # enumeration unaffected
+
+
+def test_env_knob_parsing(monkeypatch):
+    monkeypatch.setenv("SELKIES_DEVICE_FAIL_THRESHOLD", "5")
+    monkeypatch.setenv("SELKIES_DEVICE_PROBATION_S", "2.5")
+    assert fail_threshold_from_env() == 5
+    assert probation_from_env() == 2.5
+    monkeypatch.setenv("SELKIES_DEVICE_FAIL_THRESHOLD", "junk")
+    monkeypatch.setenv("SELKIES_DEVICE_PROBATION_S", "junk")
+    assert fail_threshold_from_env() == 3    # documented defaults
+    assert probation_from_env() == 30.0
+
+
+# -- the device:<chip> fault site ---------------------------------------
+
+
+def test_device_fault_site_kill_wedge_flap(faults, pool_reset):
+    clk = _Clock()
+    pool = pool_reset(DevicePool(devices=["c1", "c2", "c3"],
+                                 fail_threshold=3, probation_s=10,
+                                 clock=clk))
+    faults("device:c1@2:raise;device:c2@1,2:flap;device:c3@1:delay:30")
+    t0 = time.perf_counter()
+    check_device_faults(["c1", "c2", "c3"])  # tick 1: flap c2, wedge c3
+    assert time.perf_counter() - t0 >= 0.025, "delay action must stall"
+    # flap: a health-plane blip, no exception, frame still delivers
+    assert pool.stats()["failures"] == {"c2": 1}
+    with pytest.raises(DeviceFault) as ei:
+        check_device_faults(["c1", "c2", "c3"])  # tick 2: kill c1
+    assert ei.value.chip == "c1"
+    # the raise chains the InjectedFault for chaos-log attribution
+    assert isinstance(ei.value.__cause__, InjectedFault)
+    # two flaps stayed below the threshold: c2 never quarantined
+    assert not pool.is_quarantined("c2")
+    # attribution: a DeviceFault anywhere in a failed tick's chain
+    wrapped = RuntimeError("tick failed")
+    wrapped.__cause__ = ei.value
+    assert pool.attribute(wrapped) == "c1"
+    assert pool.attribute(RuntimeError("host bug")) is None
+
+
+def test_fault_site_grammar_documented():
+    """The chaos-suite site list: faultinject's grammar doc, the parser,
+    and docs/resilience.md stay in sync on the device site."""
+    import selkies_tpu.resilience.faultinject as fi
+
+    assert "device" in fi.__doc__, "faultinject grammar must list device"
+    with open(os.path.join(REPO, "docs", "resilience.md")) as f:
+        doc = f.read()
+    assert "`device:<chip>`" in doc
+    rules = fi.parse_faults("device:chip3@5:raise;device@every:2:flap")
+    assert rules[0].matches_site("device:chip3")
+    assert not rules[0].matches_site("device:chip30")
+    assert rules[1].matches_site("device:anything")  # per-chip clocks
+
+
+def test_quarantined_probe_consults_fault_site(faults):
+    """A chaos schedule keeps a chip dead through probation: the probe
+    rides the same per-chip site, so the readmit happens exactly when
+    the schedule says the chip comes back."""
+    clk = _Clock()
+    p = DevicePool(devices=["c9"], fail_threshold=1, probation_s=10,
+                   readmit_after=1, clock=clk)
+    faults("device:c9@1:raise")
+    p.note_failure("c9")
+    clk.t += 11.0
+    assert p.tick() == []                    # probe hits the scheduled raise
+    clk.t += 21.0
+    assert p.tick() == ["c9"]                # schedule exhausted: readmitted
+
+
+# -- placer: quarantine as a first-class location -----------------------
+
+
+def test_placer_quarantine_and_readmit_transitions():
+    p = SessionPlacer(devices=chips(6), bands=2, host_cores=8)
+    p.place_initial(2, 2)                    # rows [0,1] [2,3]; free [4,5]
+    # free-pool chip: no session affected
+    assert p.quarantine("chip4") == []
+    assert p.stats()["quarantined"] == ["chip4"]
+    p.assert_consistent()
+    # row chip: the session shrinks and is reported for re-carve
+    assert p.quarantine("chip1") == [0]
+    assert p.row(0) == ["chip0"]
+    p.assert_consistent()
+    # admission cannot hand out a quarantined chip (only chip5 is free)
+    adm = p.admit(2)
+    assert adm.decision == "queue" and adm.reason == "capacity"
+    # readmit restores the home row (the session re-carves back up)
+    assert p.readmit("chip1") == 0
+    assert p.row(0) == ["chip0", "chip1"]
+    # a free-pool chip readmits to the pool and can promote the queued
+    promoted = []
+    p.on_admitted = promoted.append
+    assert p.readmit("chip4") is None
+    assert promoted == [2] and len(p.row(2)) == 2
+    p.assert_consistent()
+    assert p.stats()["quarantined"] == []
+    # double transitions are no-ops
+    assert p.readmit("chip4") is None and p.quarantine("zzz") == []
+
+
+def test_placer_quarantine_inside_borrow_debt():
+    """A chip on loan sits in the borrower's row AND a debt record: the
+    quarantine must shrink both, the return must not resurrect it, and
+    the readmit home is the LENDER (the chip belongs to its carve)."""
+    p = SessionPlacer(devices=chips(4), bands=2, host_cores=8)
+    p.place_initial(2, 2)
+    p.set_busy(0, True)
+    assert len(p.borrow(0)) == 2             # 0 holds [0,1,2,3]
+    affected = p.quarantine("chip2")         # a borrowed chip dies
+    assert affected == [0] and len(p.row(0)) == 3
+    p.assert_consistent()
+    settled = p.return_borrowed(0)
+    assert settled and p.row(1) == ["chip3"]  # no resurrected chip
+    p.assert_consistent()
+    assert p.readmit("chip2") == 1           # home: the lender's row
+    assert sorted(p.row(1)) == ["chip2", "chip3"]
+    p.assert_consistent()
+
+
+def test_quarantine_on_orphaned_loan_homes_to_pool():
+    """A chip on an ORPHANED loan (its lender already released) must
+    home to the pool: readmitting it into the borrower's row would grow
+    the row past the bands carve with no debt record to reclaim it."""
+    p = SessionPlacer(devices=chips(4), bands=2, host_cores=8)
+    p.place_initial(2, 2)
+    p.set_busy(0, True)
+    assert len(p.borrow(0)) == 2             # 0 holds all 4 chips
+    p.release(1)                             # lender gone: loan orphaned
+    assert p.quarantine("chip2") == [0]      # a chip on the orphaned loan
+    p.assert_consistent()
+    assert p.readmit("chip2") is None        # POOL, not the borrower's row
+    assert len(p.row(0)) == 3
+    p.return_borrowed(0)
+    assert p.row(0) == ["chip0", "chip1"]    # carve restored exactly
+    p.assert_consistent()
+
+
+def test_readmit_while_home_row_lent_rejoins_the_loan():
+    """Readmit of a chip whose home session has its whole row lent out:
+    the chip rejoins the OUTSTANDING loan (borrower row + debt record),
+    so the eventual return restores the lender's full carve instead of
+    silently shrinking it forever."""
+    p = SessionPlacer(devices=chips(4), bands=2, host_cores=8)
+    p.place_initial(2, 2)
+    p.set_busy(0, True)
+    assert len(p.borrow(0)) == 2             # 0 holds all 4; 1 is lent
+    assert p.quarantine("chip2") == [0]      # off the live loan
+    p.assert_consistent()
+    assert p.readmit("chip2") == 0           # rejoined the BORROWER's row
+    assert "chip2" in p.row(0) and p.borrowed_chips() == 2
+    p.assert_consistent()
+    settled = p.return_borrowed(0)           # the loan settles in full
+    assert settled
+    assert sorted(p.row(1)) == ["chip2", "chip3"]
+    assert len(p.row(0)) == 2
+    p.assert_consistent()
+
+
+def test_readmit_to_quarantine_emptied_row_restores_it():
+    """A row emptied by quarantine itself (not lent) gets its chip back
+    on readmit — the poisoned slot regains capacity."""
+    p = SessionPlacer(devices=chips(2), bands=1, host_cores=8)
+    p.place_initial(2, 1)
+    assert p.quarantine("chip0") == [0]
+    assert p.row(0) == []
+    assert p.readmit("chip0") == 0
+    assert p.row(0) == ["chip0"]
+    p.assert_consistent()
+
+
+def test_released_home_orphans_readmit_to_pool():
+    p = SessionPlacer(devices=chips(4), bands=2, host_cores=8)
+    p.place_initial(2, 2)
+    assert p.quarantine("chip1") == [0]
+    p.release(0)                             # the home session is gone
+    assert p.readmit("chip1") is None        # settles to the POOL
+    assert p.stats()["free"] == 2            # chip0 (released) + chip1
+    p.assert_consistent()
+
+
+def test_placer_shared_mode_quarantine_noop():
+    p = SessionPlacer(devices=chips(1), bands=2, host_cores=8)
+    p.place_initial(2, 2)
+    assert p.shared and p.quarantine("chip0") == []
+    p.assert_consistent()
+
+
+def test_shared_carve_skips_prequarantined_chips():
+    """A quarantine that pre-dates the carve must not pin a shared
+    round-robin session to the dead chip (shared mode has no later
+    quarantine transition to move it off)."""
+    p = SessionPlacer(devices=chips(2), bands=2, host_cores=8)
+    p.quarantine("chip0")                    # pool preq path
+    rows = p.place_initial(2, 2)             # 1 free < 4 -> shared
+    assert p.shared
+    assert rows == [["chip1"], ["chip1"]]
+    adm = p.admit(5)                         # shared admit: same filter
+    assert adm.accepted and p.row(5) == ["chip1"]
+
+
+def test_mesh_frontend_enumerates_through_pool(pool_reset):
+    """The av1/vp9 tile-column mesh front-end routes its default device
+    enumeration through the DevicePool like every other mesh builder —
+    a rebuild after a quarantine lands on surviving chips."""
+    import jax
+
+    from selkies_tpu.parallel.codec_mesh import MeshDeltaFrontend
+
+    devs = jax.devices()
+    if len(devs) < 3:
+        pytest.skip("needs >= 3 devices")
+    pool = pool_reset(DevicePool(devices=devs[:3], fail_threshold=1,
+                                 probation_s=60, clock=_Clock()))
+    dead = chip_key(devs[0])
+    assert pool.note_failure(dead)
+    fe = MeshDeltaFrontend(64, 64, 2)        # devices=None -> pool view
+    assert dead not in {chip_key(d) for d in fe.devices}
+    assert {chip_key(d) for d in fe.devices} <= {
+        chip_key(d) for d in pool.healthy_devices()}
+
+
+class _MigratableService:
+    """Minimal MultiSessionH264Service shape for checkpoint_session."""
+
+    def __init__(self, n):
+        class _S:
+            qp, frames_since_idr, idr_pic_id, force_idr = 30, 3, 1, False
+
+        self.sessions = [_S() for _ in range(n)]
+        self.params = type("P", (), {"width": W, "height": H, "fps": 30})()
+
+
+def test_placer_invariant_under_60op_device_chaos(faults):
+    """The acceptance chaos schedule: 60+ seeded ops mixing device
+    quarantine/readmit with borrow/return/migrate/drain (and injected
+    admission/recarve/migrate faults) — assert_consistent plus full
+    chip conservation (rows + free + quarantined == owned) after every
+    single transition."""
+    faults("admission@p:0.2,seed:7:drop;recarve@p:0.25,seed:11:raise;"
+           "migrate@p:0.3,seed:13:raise")
+    clk = _Clock()
+    pool = DevicePool(devices=chips(8), fail_threshold=1, probation_s=10,
+                      readmit_after=1, clock=clk)
+    p = SessionPlacer(devices=chips(8), bands=2, host_cores=8, queue_limit=4)
+    p.place_initial(2, 2)
+    svc = _MigratableService(4)
+    rng = np.random.default_rng(1234)
+    quarantines = readmits = 0
+    for step in range(80):
+        sid = int(rng.integers(0, 5))
+        op = int(rng.integers(0, 9))
+        if op == 0:
+            p.admit(sid)
+        elif op == 1:
+            p.release(sid)
+        elif op == 2:
+            try:
+                p.borrow(sid)
+            except InjectedFault:
+                pass                          # carve must stay untouched
+        elif op == 3:
+            p.return_borrowed(sid)
+        elif op == 4:
+            p.set_busy(sid, bool(rng.integers(0, 2)))
+        elif op == 5:                         # device fault -> quarantine
+            key = f"chip{int(rng.integers(0, 4))}"
+            if pool.note_failure(key):
+                p.quarantine(key)
+                quarantines += 1
+        elif op == 6:                         # probation passes -> readmit
+            clk.t += 11.0
+            for key in pool.tick():
+                p.readmit(key)
+                readmits += 1
+        elif op == 7:                         # drain window toggles
+            p.draining = not p.draining
+        else:                                 # migrate (checkpoint) attempt
+            try:
+                checkpoint_session(svc, sid % 4)
+            except InjectedFault:
+                pass
+        p.assert_consistent()
+        st = p.stats()
+        placed = sum(len(v) for v in st["carve"].values())
+        conserved = placed + st["free"] + len(st["quarantined"])
+        assert conserved == 8, (step, st)
+    assert quarantines >= 1 and readmits >= 1, "chaos never hit the plane"
+
+
+# -- fleet wiring (classification -> quarantine -> re-carve -> poison) --
+
+
+class _RecarvingService:
+    """BandedFleetService shape: records re-carves, never encodes."""
+
+    def __init__(self, n):
+        self.n = n
+        self.codecs = ["h264"] * n
+        self.last_idrs = [True] * n
+        self.last_modes = [""] * n
+        self.recarves: list[tuple[int, int]] = []
+
+    def set_qp(self, k, qp):
+        pass
+
+    def force_keyframe(self, k):
+        pass
+
+    def recarve(self, k, devices):
+        self.recarves.append((k, len(devices)))
+
+    def close(self):
+        pass
+
+
+def _chip_fleet(pool_reset, n=2, threshold=1):
+    from selkies_tpu.parallel.fleet import SessionFleet, SessionSlot
+
+    devs = chips(4)
+    pool = pool_reset(DevicePool(devices=devs, fail_threshold=threshold,
+                                 probation_s=10, readmit_after=1,
+                                 clock=_Clock()))
+    slots = [SessionSlot(k, bitrate_kbps=2000, fps=60) for k in range(n)]
+    fleet = SessionFleet(slots, width=W, height=H, fps=60,
+                         service=_RecarvingService(n))
+    fleet.placer = SessionPlacer(devices=devs, bands=2, host_cores=8)
+    fleet.placer.place_initial(n, 2)
+    return fleet, pool
+
+
+def test_fleet_device_failure_quarantines_and_recarves_shrunk(pool_reset):
+    """Kill chip -> the fleet's tick-failure classification quarantines
+    it and the session rebuilds on the SURVIVING chips (the placer's
+    live row, not the constructor-time device row) — the satellite
+    restart regression."""
+    fleet, pool = _chip_fleet(pool_reset)
+    exc = RuntimeError("tick failed")
+    exc.__cause__ = DeviceFault("chip1")
+    assert fleet.note_device_failure(exc)
+    assert pool.is_quarantined("chip1")
+    assert fleet.placer.row(0) == ["chip0"]
+    assert fleet.service.recarves == [(0, 1)]  # shrunk, surviving chip only
+    fleet.placer.assert_consistent()
+    # a second, non-device failure classifies as nothing
+    assert not fleet.note_device_failure(RuntimeError("host bug"))
+    # probation passes: the watchdog tick readmits and re-carves back up
+    pool._clock.t += 11.0
+    fleet._device_health_tick()
+    assert not pool.is_quarantined("chip1")
+    assert sorted(fleet.placer.row(0)) == ["chip0", "chip1"]
+    assert (0, 2) in fleet.service.recarves
+    fleet.placer.assert_consistent()
+
+
+def test_fleet_whole_row_quarantine_ejects_slot_not_batch(pool_reset):
+    fleet, pool = _chip_fleet(pool_reset)
+    poisoned = []
+    fleet.on_slot_poisoned = poisoned.append
+    for key in ("chip0", "chip1"):
+        exc = RuntimeError("tick failed")
+        exc.__cause__ = DeviceFault(key)
+        fleet.note_device_failure(exc)
+    assert fleet.placer.row(0) == []
+    assert poisoned == [0], "only the emptied slot is ejected"
+    assert (0, 0) in fleet.service.recarves   # parked, not left encoding
+    assert fleet.placer.row(1) == ["chip2", "chip3"]  # the batch survives
+    fleet.placer.assert_consistent()
+
+
+def test_fleet_reconciles_externally_consumed_readmit(pool_reset):
+    """The placer readmit is STATE-based: if another consumer (the solo
+    pipeline's watchdog, a second fleet) drove the pool.tick() that
+    readmitted the chip, the fleet's next health tick still converges
+    the placer to the pool's healthy view."""
+    fleet, pool = _chip_fleet(pool_reset)
+    exc = RuntimeError("tick failed")
+    exc.__cause__ = DeviceFault("chip1")
+    assert fleet.note_device_failure(exc)
+    pool._clock.t += 11.0
+    assert pool.tick() == ["chip1"]          # external consumer readmits
+    assert not pool.is_quarantined("chip1")
+    assert fleet.placer.is_quarantined("chip1")
+    fleet._device_health_tick()
+    assert not fleet.placer.is_quarantined("chip1")
+    assert sorted(fleet.placer.row(0)) == ["chip0", "chip1"]
+    assert (0, 2) in fleet.service.recarves
+    fleet.placer.assert_consistent()
+
+
+def test_fleet_watchdog_syncs_flap_quarantines(pool_reset):
+    """Flap noise crossing the threshold outside the tick path (no
+    raised exception) still reaches the placer via the watchdog sync."""
+    fleet, pool = _chip_fleet(pool_reset, threshold=2)
+    pool.note_failure("chip2", reason="flap")
+    pool.note_failure("chip2", reason="flap")
+    assert pool.is_quarantined("chip2")
+    assert not fleet.placer.is_quarantined("chip2")
+    fleet._device_health_tick()
+    assert fleet.placer.is_quarantined("chip2")
+    assert fleet.placer.row(1) == ["chip3"]
+    assert (1, 1) in fleet.service.recarves
+    fleet.placer.assert_consistent()
+
+
+def test_solo_pipeline_classifies_device_failure(pool_reset):
+    from selkies_tpu.pipeline.elements import VideoPipeline
+
+    class _Enc:
+        devices = ["x1"]
+        width, height = W, H
+
+    pool = pool_reset(DevicePool(devices=["x1"], fail_threshold=1,
+                                 probation_s=10, clock=_Clock()))
+    pipe = VideoPipeline(source=object(), encoder=_Enc(),
+                         rate_controller=object(), sink=None, fps=30)
+    hits: list[str] = []
+    pipe.on_device_fault = hits.append
+    exc = RuntimeError("tick failed")
+    exc.__cause__ = DeviceFault("x1")
+    pipe._note_device_failure(exc)
+    assert hits == ["x1"] and pool.is_quarantined("x1")
+    # host-shaped failures never touch the pool
+    pipe._note_device_failure(RuntimeError("host bug"))
+    assert hits == ["x1"]
+
+
+# -- solo rebuild consults device health (satellite 2) ------------------
+
+
+def test_banded_rebuild_lands_on_surviving_chips(pool_reset):
+    """kill chip -> a rebuilt banded encoder (registry default device
+    path) shrinks to the surviving carve instead of reusing the dead
+    chip: 4 requested bands on 3 healthy chips -> a 3-band mesh."""
+    import jax
+
+    from selkies_tpu.parallel.bands import BandedH264Encoder
+
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs >= 4 devices")
+    pool = pool_reset(DevicePool(devices=devs[:4], fail_threshold=1,
+                                 probation_s=60, clock=_Clock()))
+    dead = chip_key(devs[1])
+    assert pool.note_failure(dead)
+    enc = BandedH264Encoder(W, H, qp=28, fps=30, bands=4)  # devices=None
+    try:
+        assert enc.bands == 3 and enc.mesh_enabled
+        assert dead not in {chip_key(d) for d in enc.devices}
+        assert {chip_key(d) for d in enc.devices} <= {
+            chip_key(d) for d in pool.healthy_devices()}
+    finally:
+        enc.close()
+
+
+def test_banded_fallback_without_quarantine_keeps_band_count(pool_reset):
+    """A machine that simply has fewer chips than bands (no quarantine)
+    keeps the classic identical-bytes single-device fallback at the
+    FULL band count — the shrink applies only to quarantine losses."""
+    import jax
+
+    from selkies_tpu.parallel.bands import BandedH264Encoder
+
+    devs = jax.devices()
+    pool_reset(DevicePool(devices=devs[:2], fail_threshold=3,
+                          probation_s=60, clock=_Clock()))
+    enc = BandedH264Encoder(W, H, qp=28, fps=30, bands=4)
+    try:
+        assert enc.bands == 4 and not enc.mesh_enabled
+    finally:
+        enc.close()
+
+
+def test_session_mesh_prefers_healthy_but_never_raises_short(pool_reset):
+    """The lockstep session mesh places on healthy chips when enough
+    exist, and falls back to the full enumeration when quarantines
+    leave fewer healthy chips than sessions — a service rebuild must
+    never become unbuildable by quarantine alone (a genuinely dead
+    chip still fails the batch tick; the ladder's software rung is
+    the availability floor there)."""
+    import jax
+
+    from selkies_tpu.parallel.sessions import _session_mesh
+
+    devs = jax.devices()
+    if len(devs) < 3:
+        pytest.skip("needs >= 3 devices")
+    pool = pool_reset(DevicePool(devices=devs[:3], fail_threshold=1,
+                                 probation_s=60, clock=_Clock()))
+    pool.note_failure(chip_key(devs[0]))
+    mesh = _session_mesh(2)                  # 2 healthy: prefer them
+    assert devs[0] not in set(mesh.devices.flat)
+    pool.note_failure(chip_key(devs[1]))
+    mesh = _session_mesh(2)                  # 1 healthy < 2 sessions
+    assert len(list(mesh.devices.flat)) == 2  # full-enumeration fallback
+
+
+# -- telemetry / statz / healthz surfaces -------------------------------
+
+
+def test_device_health_surfaces(pool_reset):
+    telemetry.reset()
+    telemetry.enabled = True
+    try:
+        clk = _Clock()
+        pool = pool_reset(DevicePool(devices=["a", "b"], fail_threshold=1,
+                                     probation_s=10, clock=clk))
+        pool.note_failure("a")
+        gauges = {lbls: v for (fam, lbls), v in telemetry._gauges.items()
+                  if fam == "selkies_device_health"}
+        assert gauges[("a",)] == 1.0 and gauges[("b",)] == 0.0
+        counts = {lbls: v for (fam, lbls), v in telemetry._counters.items()
+                  if fam == "selkies_device_quarantines_total"}
+        assert counts[("a", "step")] == 1
+        # /healthz degraded-capacity detail (the autoscaling signal) —
+        # a pure chip quarantine keeps the probe status untouched
+        health = telemetry.health()
+        assert health["devices"] == {"chips": 2, "healthy": 1,
+                                     "quarantined": ["a"], "capacity": 0.5}
+        assert health["status"] == "ok"
+        # /statz provider block + the statz.py renderer
+        rollup = telemetry.rollup()
+        assert rollup["providers"]["devices"]["quarantined"]["a"][
+            "failures"] == 1
+        spec = importlib.util.spec_from_file_location(
+            "statz", os.path.join(REPO, "tools", "statz.py"))
+        statz = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(statz)
+        text = statz.render(rollup, [])
+        assert "QUARANTINED" in text and "devices:" in text
+        pool.readmit("a")
+        gauges = {lbls: v for (fam, lbls), v in telemetry._gauges.items()
+                  if fam == "selkies_device_health"}
+        assert gauges[("a",)] == 0.0          # gauge clears on readmit
+    finally:
+        telemetry.enabled = False
+        telemetry.reset()
+
+
+# -- the acceptance end-to-end ------------------------------------------
+
+
+def test_device_kill_recarves_to_3_bands_byte_identical(
+        faults, pool_reset, monkeypatch):
+    """ISSUE 14 acceptance: seeded ``device:<chip>@4-6:raise`` chaos on
+    a 4-band fleet session. The third attributed failure quarantines the
+    chip, the session re-carves to 3 bands on the surviving chips and
+    resumes at the NEXT tick (within one GOP) with a recovery IDR byte-
+    identical to a 3-band oracle fed the same frames; after probation
+    the chip is readmitted (the row re-carves back to 4 bands) and a
+    subsequent borrow hands it out again. The placer invariant is
+    asserted after every transition (its mutators self-check)."""
+    import jax
+
+    from selkies_tpu.parallel.bands import BandedH264Encoder
+    from selkies_tpu.parallel.fleet import SessionFleet, SessionSlot
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device test mesh")
+    clk = _Clock()
+    pool = pool_reset(DevicePool(devices=devs, fail_threshold=3,
+                                 probation_s=50, readmit_after=3,
+                                 clock=clk))
+    monkeypatch.setenv("SELKIES_BANDS", "4")
+    slots = [SessionSlot(k, bitrate_kbps=2000, fps=30) for k in range(2)]
+    fleet = SessionFleet(slots, width=W, height=H, fps=30, devices=devs)
+    svc = fleet.service
+    # park the lender session's ENCODER (its placement row stays carved,
+    # which is all the borrow needs): the 1-core CPU container cannot
+    # make progress on two concurrent 4-band SPMD programs — their
+    # collectives starve each other's shard threads. Placement-plane
+    # behaviour for live co-encoding sessions is covered by the
+    # fake-service fleet tests above.
+    svc.recarve(1, [])
+    dead = chip_key(devs[1])                 # a chip in session 0's row
+    assert devs[1] in fleet.placer.row(0)
+    faults(f"device:{dead}@4-6:raise")
+    oracle = BandedH264Encoder(W, H, qp=28, fps=30, bands=3,
+                               devices=[devs[0]])
+    rng = np.random.default_rng(7)
+    frames = [rng.integers(0, 255, (2, H, W, 4), np.uint8)
+              for _ in range(9)]
+    try:
+        failures = 0
+        for t in range(3):                   # healthy 4-band ticks 1-3
+            aus = svc.encode_tick(frames[t])
+            assert aus[0]
+            oracle.encode_frame(frames[t][0])
+        for t in range(3, 6):                # scheduled kills: ticks 4-6
+            with pytest.raises(Exception) as ei:
+                svc.encode_tick(frames[t])
+            failures += 1
+            handled = fleet.note_device_failure(ei.value)
+            # the oracle skips faulted ticks too: the dead session's GOP
+            # never advanced, so neither may the oracle's
+            if failures < 3:
+                assert not handled, "threshold crossed early"
+        assert handled, "third attributed failure must quarantine"
+        assert pool.is_quarantined(dead)
+        assert fleet.placer.is_quarantined(dead)
+        row = fleet.placer.row(0)
+        assert len(row) == 3 and devs[1] not in row
+        assert svc.encoders[0].bands == 3, "session must re-carve shrunk"
+        assert {chip_key(d) for d in svc.encoders[0].devices} == {
+            chip_key(d) for d in row}
+        # resume within one GOP: the very next tick is the recovery IDR,
+        # byte-identical to the 3-band oracle from that IDR on
+        oracle.force_keyframe()
+        aus = svc.encode_tick(frames[6])
+        assert svc.last_idrs[0], "recovery frame must be the IDR"
+        assert bytes(aus[0]) == bytes(oracle.encode_frame(frames[6][0])), \
+            "recovery IDR differs from the 3-band oracle"
+        aus = svc.encode_tick(frames[7])
+        assert bytes(aus[0]) == bytes(oracle.encode_frame(frames[7][0])), \
+            "post-recovery P frame differs from the 3-band oracle"
+        # probation passes; sustained healthy probes readmit (3 ticks),
+        # the home row re-carves back up to the full 4-band carve
+        clk.t += 51.0
+        for _ in range(3):
+            fleet._device_health_tick()
+        assert not pool.is_quarantined(dead)
+        assert devs[1] in fleet.placer.row(0)
+        assert svc.encoders[0].bands == 4
+        fleet.placer.assert_consistent()
+        # ... and a subsequent borrow can hand the chip out again
+        fleet.placer.set_busy(1, True)
+        assert fleet.borrow_bands(1)
+        assert devs[1] in fleet.placer.row(1)
+        assert fleet.placer.borrowed_chips() == 4
+        fleet.placer.assert_consistent()
+    finally:
+        fleet.service.close()
+        oracle.close()

@@ -157,7 +157,8 @@ def test_placement_gauges_match_owned_chips_in_shared_mode():
         assert p.shared
         g = {lbls[0]: v for (fam, lbls), v in telemetry._gauges.items()
              if fam == "selkies_placement_chips"}
-        assert g == {"free": 0.0, "assigned": 1.0, "borrowed": 0.0}
+        assert g == {"free": 0.0, "assigned": 1.0, "borrowed": 0.0,
+                     "quarantined": 0.0}
     finally:
         telemetry.enabled = False
         telemetry.reset()
@@ -204,14 +205,17 @@ def test_placement_gauges_2d_carve_sum_to_owned():
             return {lbls[0]: v for (fam, lbls), v in telemetry._gauges.items()
                     if fam == "selkies_placement_chips"}
 
-        assert gauges() == {"free": 4.0, "assigned": 8.0, "borrowed": 0.0}
+        assert gauges() == {"free": 4.0, "assigned": 8.0, "borrowed": 0.0,
+                            "quarantined": 0.0}
         p.set_busy(0, True)
         p.borrow(0)                     # session 1's whole 2x2 row moves
         g = gauges()
-        assert g == {"free": 4.0, "assigned": 4.0, "borrowed": 4.0}
+        assert g == {"free": 4.0, "assigned": 4.0, "borrowed": 4.0,
+                     "quarantined": 0.0}
         assert sum(g.values()) == len(p.devices)
         p.return_borrowed(0)
-        assert gauges() == {"free": 4.0, "assigned": 8.0, "borrowed": 0.0}
+        assert gauges() == {"free": 4.0, "assigned": 8.0, "borrowed": 0.0,
+                            "quarantined": 0.0}
     finally:
         telemetry.enabled = False
         telemetry.reset()

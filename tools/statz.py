@@ -140,6 +140,27 @@ def _render_placement(p: dict) -> str:
     return head
 
 
+def _render_devices(data: dict) -> str:
+    """Device health plane block (resilience/devhealth.py): per-chip
+    state, quarantine ages, attributed failure counts."""
+    head = (f"chips={data.get('chips', '?')} "
+            f"healthy={data.get('healthy', '?')} "
+            f"fail_threshold={data.get('fail_threshold', '?')} "
+            f"probation={data.get('probation_s', '?')}s")
+    q = data.get("quarantined") or {}
+    failures = data.get("failures") or {}
+    rows = [(chip, "QUARANTINED", f"{st.get('age_s', 0)}s",
+             f"{st.get('probation_s', 0)}s", st.get("probe_ok", 0),
+             st.get("failures", 0), st.get("reason", "?"))
+            for chip, st in sorted(q.items())]
+    rows.extend((chip, "healthy", "-", "-", "-", n, "-")
+                for chip, n in sorted(failures.items()) if chip not in q)
+    if rows:
+        head += "\n" + _table(rows, ("chip", "state", "age", "probation",
+                                     "probe_ok", "failures", "reason"))
+    return head
+
+
 def _render_fleet(data: dict) -> str:
     head = (f"sessions={data.get('sessions', '?')} "
             f"connected={data.get('connected', '?')} "
@@ -159,6 +180,7 @@ _PROVIDER_RENDERERS = {
     "compile": _render_compile,
     "fleet": _render_fleet,
     "placement": _render_placement,
+    "devices": _render_devices,
 }
 
 
@@ -222,6 +244,12 @@ def render(rollup: dict, events: list[dict]) -> str:
             if slots:
                 out.append("    placement: " + ", ".join(
                     f"{k}={v}" for k, v in sorted(slots.items())))
+        dev = health.get("devices") or {}
+        if dev:
+            out.append(f"  devices: {dev.get('healthy', '?')}/"
+                       f"{dev.get('chips', '?')} healthy "
+                       f"(capacity {dev.get('capacity', '?')}) "
+                       f"quarantined={dev.get('quarantined') or []}")
         slo = health.get("slo") or {}
         for sess, view in sorted(slo.items()):
             breached = "+".join(view.get("breached") or []) or "-"
