@@ -197,6 +197,20 @@ METRIC_FAMILIES: dict[str, str] = {
         "Chip quarantine transitions (attributed step-failure streak or "
         "failed liveness probe crossing SELKIES_DEVICE_FAIL_THRESHOLD), "
         "labeled by chip and reason",
+    "selkies_cluster_peers":
+        "Cluster membership view (selkies_tpu/cluster): peers counted "
+        "by lease state (alive/dead)",
+    "selkies_cluster_heartbeats_total":
+        "Cluster heartbeat traffic, labeled by peer and result "
+        "(ok/fail on the send side, received/rejected on the receive "
+        "side — rejected means a bad HMAC signature)",
+    "selkies_cluster_redirects_total":
+        "Server-initiated signalling redirects actually sent, labeled "
+        "by reason (draining/capacity/codec/migrated)",
+    "selkies_cluster_migrations_total":
+        "Cross-host live migrations, labeled by direction (out/in) and "
+        "result (ok/fail) — an `out` failure leaves the session serving "
+        "on the source",
 }
 
 # canonical label names per family (order fixed for the Prometheus
@@ -234,6 +248,10 @@ _FAMILY_LABELS: dict[str, tuple[str, ...]] = {
     "selkies_compile_storms_total": ("trigger",),
     "selkies_device_health": ("chip",),
     "selkies_device_quarantines_total": ("chip", "reason"),
+    "selkies_cluster_peers": ("state",),
+    "selkies_cluster_heartbeats_total": ("peer", "result"),
+    "selkies_cluster_redirects_total": ("reason",),
+    "selkies_cluster_migrations_total": ("direction", "result"),
 }
 
 _HIST_BUCKETS: dict[str, tuple[float, ...]] = {
@@ -603,6 +621,36 @@ class Telemetry:
 
     # -- read side -----------------------------------------------------
 
+    def capacity_digest(self) -> dict:
+        """The ONE machine-readable capacity/drain summary of this
+        process — shared verbatim by ``/healthz`` (the ``capacity``
+        block), ``/statz`` (inside ``health``) and the cluster
+        heartbeat (selkies_tpu/cluster/membership.py, which owns the
+        actual derivation in ``build_digest``). Folds the registered
+        lifecycle (drain state + placer carve), device-health
+        (degraded chip capacity) and SLO (chronic-burn sessions) views
+        plus the probed codec rows into stable fields so no surface
+        re-derives them."""
+        from selkies_tpu.cluster.membership import build_digest
+
+        lc = self._lifecycle() if self._lifecycle is not None else None
+        dev = self._devhealth() if self._devhealth is not None else None
+        slo = self._slo() if self._slo is not None else None
+        dev_view = slo_views = None
+        if dev is not None:
+            try:
+                dev_view = dev()
+            except Exception:
+                dev_view = None
+        if slo is not None:
+            try:
+                slo_views = slo()
+            except Exception:
+                slo_views = None
+        return build_digest(drain=lc, devices_view=dev_view,
+                            slo_views=slo_views,
+                            codecs=_supported_codecs())
+
     def health(self) -> dict:
         """Rung/watchdog summary for k8s-style probes. Works with
         telemetry disabled — supervisors register unconditionally.
@@ -654,6 +702,13 @@ class Telemetry:
                 out["slo"] = slo()
             except Exception:
                 out["slo"] = {"error": "unreadable"}
+        # the machine-readable capacity digest: the same fields the
+        # cluster heartbeat ships, so an external balancer/autoscaler
+        # reads ONE schema whether it scrapes /healthz or the gossip
+        try:
+            out["capacity"] = self.capacity_digest()
+        except Exception:
+            out["capacity"] = {"error": "unreadable"}
         return out
 
     def rollup(self) -> dict:
@@ -839,6 +894,28 @@ class _TelemetryCollector:
                 f.add_metric(list(vals), list(zip(edges, cum)),
                              sum_value=total)
             yield f
+
+
+_codec_cache: list[str] | None = None
+
+
+def _supported_codecs() -> list[str]:
+    """Codec rows this image can actually serve (negotiate.py probes,
+    cached — library availability cannot change mid-process). Part of
+    the capacity digest so a router never lands an AV1 client on an
+    h264-only host."""
+    global _codec_cache
+    if _codec_cache is None:
+        try:
+            from selkies_tpu.signalling.negotiate import (
+                CODEC_ROWS, codec_available)
+
+            _codec_cache = sorted(c for c in CODEC_ROWS if codec_available(c))
+        except Exception:
+            logger.exception("codec availability probe failed; digesting "
+                             "h264 only")
+            _codec_cache = ["h264"]
+    return list(_codec_cache)
 
 
 # the process-global bus every emission site uses

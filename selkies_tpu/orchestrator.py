@@ -375,6 +375,41 @@ class Orchestrator:
         self.drainer = DrainController(
             "solo", force_idr=self.app.force_keyframe,
             flush=self._drain_flush, on_drained=self._drain_exit)
+        # multi-host cluster plane (selkies_tpu/cluster): the solo host
+        # heartbeats its capacity digest and routes client HELLOs —
+        # redirecting when draining or already serving — but doesn't
+        # receive migrations (one session shape, nothing to restore
+        # into while occupied)
+        self.cluster = None
+        from selkies_tpu.cluster import cluster_enabled
+
+        if cluster_enabled():
+            from selkies_tpu.cluster import (build_cluster_plane,
+                                             wire_cluster_plane)
+            from selkies_tpu.monitoring.telemetry import telemetry
+
+            def _solo_digest():
+                # a bare solo host has no placer: occupancy is the one
+                # capacity fact it owns, and without it peers would
+                # keep scoring an occupied host as free and redirect
+                # clients into a hang
+                d = telemetry.capacity_digest()
+                d["busy"] = 1 if self._session_active else 0
+                d["free_slots"] = 0 if self._session_active else 1
+                return d
+
+            # pin ONLY the active session's own browser peer (the solo
+            # web client registers as peer "1"): its encoder state lives
+            # here even mid-drain, but a DIFFERENT client knocking on an
+            # occupied or draining solo host should go through routing.
+            # wire_cluster_plane owns the wire-or-refuse security policy
+            # (unsigned /cluster routes on a basic-auth server)
+            self.cluster = wire_cluster_plane(
+                build_cluster_plane(
+                    is_local_session=lambda uid: (self._session_active
+                                                  and str(uid) == "1"),
+                    digest_fn=_solo_digest),
+                self.server, enable_basic_auth=bool(cfg.enable_basic_auth))
         self._last_rtt_ms = 0.0
         self._wire_callbacks()
         # scenario-policy congestion signals (selkies_tpu/policy): the
@@ -807,6 +842,8 @@ class Orchestrator:
         if cfg.enable_metrics_http:
             self._tasks.append(spawn(self.metrics.start_http()))
 
+        if self.cluster is not None:
+            await self.cluster.start()  # membership heartbeats
         # SIGTERM/SIGINT route through the drain path (lifecycle.py)
         # instead of abrupt cancellation
         from selkies_tpu.parallel.lifecycle import install_signal_handlers
@@ -825,6 +862,8 @@ class Orchestrator:
         if self._uninstall_signals is not None:
             self._uninstall_signals()
             self._uninstall_signals = None
+        if self.cluster is not None:
+            await self.cluster.stop()
         await self.webrtc.stop_session()
         await self._stop_session()
         self.system_mon.stop()

@@ -1102,17 +1102,38 @@ class FleetOrchestrator:
         self._rearm: dict[int, asyncio.Event] = {}
         self._uninstall_signals = None
         self._wire_slots()
+        # multi-host cluster plane (selkies_tpu/cluster, on only when
+        # SELKIES_CLUSTER_PEERS names peers): membership heartbeats,
+        # capacity-aware HELLO routing on the signalling server, and the
+        # inbound/outbound live-migration halves
+        self.cluster = None
+        from selkies_tpu.cluster import cluster_enabled
+
+        if cluster_enabled():
+            from selkies_tpu.cluster import (build_cluster_plane,
+                                             wire_cluster_plane)
+
+            # wire_cluster_plane owns the wire-or-refuse security policy
+            # (unsigned /cluster routes on a basic-auth server)
+            self.cluster = wire_cluster_plane(
+                build_cluster_plane(
+                    fleet=self.fleet,
+                    is_local_session=self._cluster_local_session),
+                self.server, enable_basic_auth=bool(cfg.enable_basic_auth))
         # graceful drain (the K8s preStop path, parallel/lifecycle.py):
         # SIGTERM stops admitting, force-IDRs every client, flushes the
-        # in-flight tick, checkpoints sessions for hand-off, then stops
-        # the serving loop and the server so run() returns cleanly
+        # in-flight tick, live-migrates sessions to cluster peers when
+        # the plane is wired (migrate-off-then-stop), checkpoints the
+        # leftovers for hand-off, then stops the serving loop and the
+        # server so run() returns cleanly
         from selkies_tpu.parallel.lifecycle import DrainController
 
         self.drain_checkpoints: list = []
         self.drainer = DrainController(
             "fleet", placer=self.fleet.placer,
             force_idr=self._drain_force_idr, flush=self._drain_flush,
-            handoff=self._drain_handoff, on_drained=self._drain_exit)
+            handoff=self._drain_handoff, on_drained=self._drain_exit,
+            migrate=self._drain_migrate if self.cluster is not None else None)
         telemetry.register_provider("fleet", self._fleet_stats)
 
     def _fleet_stats(self) -> dict:
@@ -1129,6 +1150,85 @@ class FleetOrchestrator:
             # counters, queue depth, borrowed-chip count
             "placement": f.placer.stats(),
         }
+
+    # -- cluster plumbing (selkies_tpu/cluster) ------------------------
+
+    def _cluster_local_session(self, uid: str) -> bool:
+        """Router hook: HELLOs from clients of sessions currently
+        served HERE are pinned — their encoder state and carve row live
+        on this host, so redirecting a reconnect would orphan both.
+        A migrated-in session inside its claim window counts too: its
+        restored encoder state is parked on a not-yet-connected slot,
+        and bouncing the redirected client away (e.g. because the
+        restore consumed the last free slot) would strand that state
+        until the claim expires and the session is lost."""
+        try:
+            n = int(uid)
+        except (TypeError, ValueError):
+            return False
+        k, rem = divmod(n - 1, 10)
+        if rem != 0 or not 0 <= k < self.n:
+            return False
+        if self.slots[k].connected:
+            return True
+        plane = self.cluster
+        return plane is not None and k in plane.target.pending_claims
+
+    async def _drain_migrate(self) -> list[int]:
+        """Migrate-off-then-stop: for every connected session pick the
+        best cluster target (codec-capable, capacity, not draining),
+        ship its checkpoint, and redirect its client to the new host.
+        Sessions the cluster can't place (or whose ship fails) stay
+        connected and fall through to the checkpoint hand-off."""
+        from selkies_tpu.cluster import Redirect, migrate_session
+
+        async def _migrate_one(k: int, slot) -> int | None:
+            target = self.cluster.router.pick_migration_target(
+                codec=self.fleet.session_codec(k))
+            if target is None:
+                logger.warning("drain: no cluster target for session %d; "
+                               "leaving it for the checkpoint hand-off", k)
+                return None
+            try:
+                ack = await migrate_session(self.fleet, k, target,
+                                            self.cluster.channel,
+                                            source=self.cluster.node.host)
+            except Exception:
+                logger.exception("drain: migrating session %d to %s "
+                                 "failed; it stays for the hand-off",
+                                 k, target)
+                return None
+            # mark the slot migrated BEFORE the redirect await: a drain
+            # deadline cancelling us here must not leave a connected
+            # slot for checkpoint_all to double-checkpoint (the client
+            # missing its redirect degrades to the documented
+            # lost-redirect path — target claim expiry)
+            slot.connected = False
+            # the client follows the redirect into its restored session;
+            # the landing slot index rides along so a cross-index
+            # landing re-registers under the right peer id. The full
+            # transport teardown runs only AFTER the record is on the
+            # signalling socket — the dc/pc close racing ahead of the
+            # redirect would strand a browser, whose only reconnect
+            # path IS the redirect itself
+            await self.server.redirect_peer(
+                str(browser_peer_id(k)),
+                Redirect(host=target, reason="migrated",
+                         session=ack.get("session")))
+            self._teardown_slot(k, slot)
+            return k
+
+        # ship concurrently: migrations are independent, and one slow or
+        # dead target (the 10 s HTTP ship timeout) must not serially eat
+        # the shared drain deadline for sessions whose targets are fine
+        moved = await asyncio.gather(
+            *(_migrate_one(k, slot) for k, slot in enumerate(self.slots)
+              if slot.connected),
+            return_exceptions=True)
+        for m in moved:
+            if isinstance(m, BaseException):
+                logger.error("drain migrate task failed: %r", m)
+        return [m for m in moved if isinstance(m, int)]
 
     # -- drain plumbing (lifecycle.DrainController callbacks) ----------
 
@@ -1416,6 +1516,13 @@ class FleetOrchestrator:
         if not slot.connected:
             return
         slot.connected = False
+        self._teardown_slot(k, slot)
+
+    def _teardown_slot(self, k: int, slot: SessionSlot) -> None:
+        """Post-disconnect teardown (transport, input, SLO, re-arm) —
+        split from _slot_disconnected so the drain migrate path can
+        flip ``connected`` early (its double-checkpoint guard) yet run
+        the teardown only after the client's redirect went out."""
         # placement pressure bookkeeping: an idle session's chips become
         # borrowable again (its row stays carved until release/recycle)
         self.fleet.placer.set_busy(k, False)
@@ -1533,6 +1640,8 @@ class FleetOrchestrator:
         if cfg.enable_metrics_http:
             self._tasks.append(spawn(self.metrics.start_http()))
         await self.fleet.start()
+        if self.cluster is not None:
+            await self.cluster.start()  # membership heartbeats
         # SIGTERM/SIGINT route through the drain path (lifecycle.py)
         # instead of abrupt cancellation: the K8s preStop contract
         from selkies_tpu.parallel.lifecycle import install_signal_handlers
@@ -1550,6 +1659,8 @@ class FleetOrchestrator:
         if self._uninstall_signals is not None:
             self._uninstall_signals()
             self._uninstall_signals = None
+        if self.cluster is not None:
+            await self.cluster.stop()
         await self.fleet.stop()
         self.system_mon.stop()
         self.tpu_mon.stop()

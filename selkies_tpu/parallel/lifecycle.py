@@ -908,7 +908,7 @@ class DrainController:
 
     def __init__(self, name: str = "fleet", *, placer: SessionPlacer | None = None,
                  deadline_s: float | None = None, force_idr=None, flush=None,
-                 handoff=None, on_drained=None):
+                 handoff=None, on_drained=None, migrate=None):
         self.name = name
         self.placer = placer
         self.deadline_s = (drain_timeout_from_env()
@@ -917,8 +917,15 @@ class DrainController:
         self._flush = flush
         self._handoff = handoff
         self._on_drained = on_drained
+        # migrate-off-then-stop (selkies_tpu/cluster): an async callable
+        # run after the flush that live-migrates connected sessions to
+        # cluster peers, returning the moved session ids; sessions it
+        # can't place stay for the checkpoint hand-off. SIGTERM then
+        # empties a host into the cluster instead of dropping sessions.
+        self._migrate = migrate
         self.state = "serving"
         self.checkpoints: list[SessionCheckpoint] = []
+        self.migrated: list[int] = []
         self.completed_in_deadline: bool | None = None
         self._done = asyncio.Event()
         telemetry.register_lifecycle(self)
@@ -994,6 +1001,21 @@ class DrainController:
                 except Exception:
                     ok = False
                     logger.exception("%s: drain flush failed", self.name)
+            if self._migrate is not None:
+                # migrate-off before the hand-off: every session a peer
+                # accepts leaves with its client redirected; leftovers
+                # (no cluster capacity, ship failures) still checkpoint
+                remaining = self.deadline_s - (time.monotonic() - t0)
+                try:
+                    self.migrated = list(await asyncio.wait_for(
+                        self._migrate(), timeout=max(0.05, remaining)) or [])
+                except asyncio.TimeoutError:
+                    ok = False
+                    logger.error("%s: drain migrate-off missed the %.1fs "
+                                 "deadline", self.name, self.deadline_s)
+                except Exception:
+                    ok = False
+                    logger.exception("%s: drain migrate-off failed", self.name)
             if self._handoff is not None:
                 try:
                     self.checkpoints = list(self._handoff() or [])
@@ -1011,7 +1033,8 @@ class DrainController:
             telemetry.event("drain", state="drained",
                             in_deadline=bool(self.completed_in_deadline),
                             elapsed_s=round(elapsed, 2),
-                            checkpoints=len(self.checkpoints))
+                            checkpoints=len(self.checkpoints),
+                            migrated=len(self.migrated))
             telemetry.gauge("selkies_drain_state", 2)
         logger.warning("%s: drain %s in %.2fs (%d checkpoints)", self.name,
                        "completed" if self.completed_in_deadline else

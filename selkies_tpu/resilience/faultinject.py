@@ -14,6 +14,7 @@ Grammar (semicolon-separated rules)::
            | admission | recarve | migrate | drain      (fleet lifecycle)
            | policy                                     (scenario policy)
            | device                                     (chip health plane)
+           | cluster                                    (multi-host plane)
            (wired sites; names are free-form)
     sched  = tick list / ranges  "5,9,13" or "20-22" or "5,9,20-22"
            | "every:N"           every Nth call (1-based)
@@ -48,7 +49,19 @@ session onto the surviving chips — ``delay:<ms>`` wedges the chip (the
 tick-deadline watchdog's territory), and ``flap`` records a health-plane
 blip without failing the frame, which the
 ``SELKIES_DEVICE_FAIL_THRESHOLD`` streak must absorb
-(tests/test_device_faults.py).
+(tests/test_device_faults.py). The multi-host plane
+(selkies_tpu/cluster) wires four qualified ``cluster`` sites:
+``cluster:heartbeat`` fires per heartbeat send (``drop`` = a lost beat
+the receiver's lease must age out, ``raise`` = a send failure driving
+the capped-backoff re-join, ``delay:<ms>`` stretches the beat);
+``cluster:partition`` fires per heartbeat receive (``drop`` = a
+one-way partition); ``cluster:ship`` fires in the cross-host
+checkpoint ship of a live migration (``delay:<ms>`` = a slow ship
+eating the drain deadline, ``raise``/``drop`` = mid-migration peer
+death — the source keeps serving the session); ``cluster:redirect``
+fires where the signalling server SENDS a redirect record (``drop`` =
+redirect lost in flight — the client's reconnect loop retries and the
+next HELLO re-routes) (tests/test_cluster.py).
 
 Examples::
 

@@ -45,7 +45,11 @@ async def run_reconnect_loop(client: "SignallingClient",
     the single reconnect loop behind Orchestrator._signalling_loop and
     every FleetOrchestrator slot loop. A connection that lived >= 30 s
     was healthy and resets the backoff; errors out of the message loop
-    are logged, never fatal."""
+    are logged, never fatal. A server-initiated redirect (the cluster
+    plane's REDIRECT record) re-targets ``client.server`` and rides
+    this same loop: the next iteration connects to the NEW host after
+    the record's retry-after beat (not a penalty backoff — the move
+    was server-directed, so the backoff resets with it)."""
     import time
 
     backoff = reconnect_backoff()
@@ -58,9 +62,16 @@ async def run_reconnect_loop(client: "SignallingClient",
             logger.exception("%s client error", log_prefix)
         if time.monotonic() - connected_at > 30.0:
             backoff.reset()
-        delay = backoff.next_delay()
-        logger.info("%s client disconnected; retrying in %.1fs",
-                    log_prefix, delay)
+        retry_after = client.consume_retry_after()
+        if retry_after is not None:
+            backoff.reset()
+            delay = retry_after
+            logger.info("%s client redirected to %s; following in %.1fs",
+                        log_prefix, client.server, delay)
+        else:
+            delay = backoff.next_delay()
+            logger.info("%s client disconnected; retrying in %.1fs",
+                        log_prefix, delay)
         await asyncio.sleep(delay)
 
 
@@ -73,6 +84,12 @@ class SignallingErrorNoPeer(SignallingError):
 
 
 class SignallingClient:
+    # server-initiated redirect chain bounds: at most this many hops
+    # inside the window, and never back to a host already in the chain
+    # (the two-host ping-pong loop)
+    MAX_REDIRECT_HOPS = 4
+    REDIRECT_WINDOW_S = 60.0
+
     def __init__(
         self,
         server: str,
@@ -84,6 +101,7 @@ class SignallingClient:
         basic_auth_password: str | None = None,
         retry_interval: float = 2.0,
         retry_backoff=None,
+        meta: dict | None = None,
     ):
         self.server = server
         self.id = id
@@ -97,9 +115,16 @@ class SignallingClient:
         # (capped exponential + jitter) instead of a fixed beat — a dead
         # signalling server isn't hammered every retry_interval forever
         self.retry_backoff = retry_backoff
+        # HELLO meta (the browser's third token: codec preferences etc.).
+        # Carrying meta also marks this client cluster-routable — the
+        # server only ever redirects HELLOs that have it.
+        self.meta = meta
 
         self._session: aiohttp.ClientSession | None = None
         self._ws: aiohttp.ClientWebSocketResponse | None = None
+        # redirect-following state (cluster/router.py records)
+        self._redirect_path: list[tuple[str, float]] = []
+        self._retry_after: float | None = None
 
         # callbacks (any may be sync or async)
         self.on_connect: Callable[[], Any] = lambda: logger.warning("unhandled on_connect")
@@ -141,7 +166,12 @@ class SignallingClient:
                 await asyncio.sleep(delay)
         if self.retry_backoff is not None:
             self.retry_backoff.reset()
-        await self._ws.send_str(f"HELLO {self.id}")
+        hello = f"HELLO {self.id}"
+        if self.meta:
+            meta64 = base64.b64encode(
+                json.dumps(self.meta).encode()).decode("ascii")
+            hello = f"{hello} {meta64}"
+        await self._ws.send_str(hello)
 
     async def setup_call(self) -> None:
         """Request a session with the configured peer (after server HELLO)."""
@@ -190,10 +220,70 @@ class SignallingClient:
             await self._dispatch(msg.data)
         await _maybe_await(self.on_disconnect())
 
+    def consume_retry_after(self) -> float | None:
+        """The pending redirect's retry-after beat, once (the reconnect
+        loop reads it to pace the follow); None when no redirect is
+        pending."""
+        ra, self._retry_after = self._retry_after, None
+        return ra
+
+    async def _on_redirect(self, message: str) -> None:
+        """Server-initiated redirect record (cluster/router.py): point
+        ``self.server`` at the new host and drop the socket so the
+        reconnect loop follows. Chains are capped — at most
+        MAX_REDIRECT_HOPS inside REDIRECT_WINDOW_S, and never back to a
+        host already in the recent chain, so two misconfigured hosts
+        can never ping-pong a client forever."""
+        import time
+
+        from selkies_tpu.cluster.router import parse_redirect, ws_url_of
+
+        rd = parse_redirect(message)
+        if rd is None:
+            return
+        target = ws_url_of(rd.host)
+        now = time.monotonic()
+        self._redirect_path = [
+            (h, t) for h, t in self._redirect_path
+            if now - t < self.REDIRECT_WINDOW_S]
+        seen = {h for h, _ in self._redirect_path}
+        # the path holds origin + followed hops, so hop count is len-1
+        hops = max(0, len(self._redirect_path) - 1)
+        if target in seen or hops >= self.MAX_REDIRECT_HOPS:
+            logger.warning(
+                "ignoring redirect to %s (%s): chain capped (%d recent "
+                "hops%s)", target, rd.reason, hops,
+                ", ping-pong" if target in seen else "")
+            return
+        if not self._redirect_path:
+            self._redirect_path.append((self.server, now))
+        self._redirect_path.append((target, now))
+        logger.warning("server redirected us to %s (%s, retry in %.1fs)",
+                       target, rd.reason or "?", rd.retry_after_s)
+        self.server = target
+        if rd.session is not None:
+            # a migrated session can land on a DIFFERENT slot index on
+            # the target; ids following the fleet convention (browser
+            # 1+10k, server client 2+10k — parallel/fleet.py) re-target
+            # so the client pairs with the slot that holds its restored
+            # encoder state, not whatever its old index maps to there
+            try:
+                if (int(self.id) - 1) % 10 == 0:
+                    self.id = 1 + 10 * int(rd.session)
+                if (int(self.peer_id) - 2) % 10 == 0:
+                    self.peer_id = 2 + 10 * int(rd.session)
+            except (TypeError, ValueError):
+                pass  # non-numeric ids: the owner wires its own mapping
+        self._retry_after = max(0.0, rd.retry_after_s)
+        if self._ws is not None:
+            await self._ws.close()
+
     async def _dispatch(self, message: str) -> None:
         if message == "HELLO":
             logger.info("connected")
             await _maybe_await(self.on_connect())
+        elif message.startswith("REDIRECT"):
+            await self._on_redirect(message)
         elif message.startswith("SESSION_OK"):
             toks = message.split()
             meta = json.loads(base64.b64decode(toks[1])) if len(toks) > 1 else {}

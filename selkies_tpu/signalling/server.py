@@ -114,6 +114,11 @@ class SignallingServer:
         # extra WebSocket endpoints (e.g. the /media transport) registered by
         # the orchestrator: path-prefix -> async handler(request) -> response
         self.ws_routes: dict[str, Any] = {}
+        # multi-host admission (selkies_tpu/cluster): when wired, every
+        # client HELLO that carries meta (browsers always do — backend
+        # planes never do) is routed — serve locally, or answer with a
+        # REDIRECT record the client's reconnect loop follows
+        self.cluster_router = None
         self.peers: dict[str, _Peer] = {}
         self.sessions: dict[str, str] = {}
         self.rooms: dict[str, set[str]] = {}
@@ -346,13 +351,81 @@ class SignallingServer:
             await ws.close(code=1002, message=b"invalid protocol")
             return None
         uid = toks[1]
-        if not uid or uid in self.peers or uid.split() != [uid]:
+        if not uid or uid.split() != [uid]:
+            await ws.close(code=1002, message=b"invalid peer uid")
+            return None
+        collision = uid in self.peers
+        if self.cluster_router is not None and meta is not None:
+            try:
+                # a colliding uid is never a live local reconnect (that
+                # peer is still registered and serving) — stock clients
+                # all register as the same peer id, so a SECOND browser
+                # knocking on an occupied host must go through capacity
+                # routing (pin bypassed) instead of a bare uid error
+                rd = self.cluster_router.route(
+                    meta, uid="" if collision else uid)
+            except Exception:
+                logger.exception("cluster routing failed; serving locally")
+                rd = None
+            if rd is not None:
+                # redirect instead of registering; a lost record (the
+                # cluster:redirect fault site) still closes the socket,
+                # so the client's reconnect loop retries and re-routes
+                await self._send_redirect(ws, rd)
+                await ws.close(code=1000, message=b"redirect")
+                return None
+        if collision:
             await ws.close(code=1002, message=b"invalid peer uid")
             return None
         self.peers[uid] = _Peer(uid, ws, request.remote, meta)
         logger.info("registered peer %r at %r meta=%s", uid, request.remote, meta)
         await ws.send_str("HELLO")
         return uid
+
+    async def _send_redirect(self, ws, redirect) -> bool:
+        """Ship one redirect record; the ``cluster:redirect`` fault
+        site fires here (``drop`` = the record is lost in flight — the
+        client must recover through its ordinary reconnect loop,
+        ``delay:<ms>`` stretches delivery). True iff it was sent."""
+        from selkies_tpu.resilience import InjectedFault, get_injector
+
+        fi = get_injector()
+        if fi is not None:
+            try:
+                act = fi.check("cluster:redirect")
+            except InjectedFault:
+                return False
+            if act is not None:
+                kind, ms = act
+                if kind in ("drop", "flap"):
+                    logger.warning("redirect to %s LOST (injected)",
+                                   redirect.host)
+                    return False
+                if kind == "delay":
+                    await asyncio.sleep(ms / 1e3)
+        await ws.send_str(redirect.to_wire())
+        from selkies_tpu.monitoring.telemetry import telemetry
+
+        if telemetry.enabled:
+            telemetry.count("selkies_cluster_redirects_total",
+                            reason=redirect.reason or "?")
+            telemetry.event("cluster", action="redirect",
+                            to=redirect.host, reason=redirect.reason)
+        return True
+
+    async def redirect_peer(self, uid: str, redirect) -> bool:
+        """Send a registered peer a redirect record and disconnect it
+        (the migrate-off path: its session now lives on another host).
+        True iff the peer existed and the record went out."""
+        peer = self.peers.get(str(uid))
+        if peer is None:
+            return False
+        try:
+            sent = await self._send_redirect(peer.ws, redirect)
+        except (ConnectionError, RuntimeError):
+            sent = False
+        await self._remove_peer(str(uid))
+        return sent
 
     async def _peer_loop(self, peer: _Peer) -> None:
         ws = peer.ws

@@ -5,7 +5,7 @@
  */
 
 interface SelkiesStatsEvent {
-  event?: "open" | "close" | "failed";
+  event?: "open" | "close" | "failed" | "redirect";
   reason?: string;
   [key: string]: unknown;
 }

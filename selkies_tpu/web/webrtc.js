@@ -31,6 +31,14 @@ class SelkiesWebRTC {
     this._jbTimer = null;
     this._probe = null;
     this._pendingCandidates = [];
+    // cluster redirect state (mirrors signalling/client.py): the ws URL
+    // a REDIRECT record re-targeted us to, and the recent hop chain
+    this._wsUrl = null;
+    this._redirectPath = [];
+    // distinguishes a _fail-initiated close (resurrectable by a racing
+    // REDIRECT — the server tears down WebRTC around the same instant
+    // it redirects) from an app-initiated close() (final)
+    this._failed = false;
   }
 
   async connect() {
@@ -40,7 +48,7 @@ class SelkiesWebRTC {
       iceServers = cfg.iceServers || [];
     } catch (e) { /* STUN-less LAN still works via host candidates */ }
     const proto = location.protocol === "https:" ? "wss:" : "ws:";
-    this.ws = new WebSocket(`${proto}//${location.host}/ws`);
+    this.ws = new WebSocket(this._wsUrl || `${proto}//${location.host}/ws`);
     this.ws.onopen = () => {
       const meta = {
         res: `${Math.round(innerWidth * devicePixelRatio)}x${Math.round(innerHeight * devicePixelRatio)}`,
@@ -78,11 +86,75 @@ class SelkiesWebRTC {
 
   _signal(data, iceServers) {
     if (data === "HELLO" || data.startsWith("SESSION_OK")) return;
+    if (data.startsWith("REDIRECT ")) { this._onRedirect(data); return; }
     if (data.startsWith("ERROR")) { console.warn("signalling:", data); return; }
     let obj;
     try { obj = JSON.parse(data); } catch (e) { return; }
     if (obj.sdp && obj.sdp.type === "offer") this._onOffer(obj.sdp, iceServers);
     else if (obj.ice) this._onRemoteIce(obj.ice);
+  }
+
+  /* cluster/router.py ws_url_of: advertised base URL -> signalling WS URL */
+  _wsUrlOf(host) {
+    host = String(host).replace(/\/+$/, "");
+    if (host.startsWith("ws://") || host.startsWith("wss://")) {
+      return host.split("://", 2)[1].includes("/") ? host : host + "/ws";
+    }
+    if (host.startsWith("https://")) return "wss://" + host.slice(8) + "/ws";
+    if (host.startsWith("http://")) return "ws://" + host.slice(7) + "/ws";
+    return "ws://" + host + "/ws";
+  }
+
+  /* Server-initiated redirect record (cluster plane: drain migrate-off,
+   * capacity/codec routing) — the browser counterpart of
+   * signalling/client.py._on_redirect. Re-targets the signalling URL,
+   * re-registers under the landing slot's peer id when the record names
+   * one, and reconnects after the retry-after beat. Chains are capped
+   * the same way (4 hops / 60 s, never back to a host already in the
+   * chain) so two misconfigured hosts can never ping-pong a browser. */
+  _onRedirect(data) {
+    let rd;
+    try { rd = JSON.parse(atob(data.slice("REDIRECT ".length).trim())); }
+    catch (e) { console.warn("ignoring malformed redirect record"); return; }
+    if (!rd || !rd.host) return;
+    // a drain's WebRTC teardown can race ahead of this record and trip
+    // _fail -> close(); the server-directed move still stands — only an
+    // app-initiated close() is final
+    if (this.closed && !this._failed) return;
+    this.closed = false;
+    this._failed = false;
+    const target = this._wsUrlOf(rd.host);
+    const proto = location.protocol === "https:" ? "wss:" : "ws:";
+    const origin = this._wsUrl || `${proto}//${location.host}/ws`;
+    const now = performance.now();
+    this._redirectPath = this._redirectPath.filter(([, t]) => now - t < 60000);
+    const seen = new Set(this._redirectPath.map(([h]) => h));
+    const hops = Math.max(0, this._redirectPath.length - 1);
+    if (seen.has(target) || hops >= 4) {
+      console.warn(`ignoring redirect to ${target}: chain capped (${hops} recent hops)`);
+      return;
+    }
+    if (!this._redirectPath.length) this._redirectPath.push([origin, now]);
+    this._redirectPath.push([target, now]);
+    if (rd.session !== null && rd.session !== undefined) {
+      // migrated sessions can land on a different slot index on the
+      // target; re-register under its peer id (fleet 1+10k convention)
+      this.session = rd.session | 0;
+      this.peerId = 1 + 10 * this.session;
+    }
+    this._wsUrl = target;
+    const delayMs = Math.max(0, (rd.retry_after_s || 0.5) * 1000);
+    console.warn(`server redirected us to ${target} (${rd.reason || "?"}, retry in ${delayMs}ms)`);
+    // tear down without tripping _fail: the move is server-directed
+    this.connected = false;
+    if (this._statsTimer) clearInterval(this._statsTimer);
+    if (this._jbTimer) clearInterval(this._jbTimer);
+    this.stopLatencyProbe();
+    if (this.dc) { this.dc.onclose = null; this.dc.onmessage = null; try { this.dc.close(); } catch (e) {} this.dc = null; }
+    if (this.pc) { this.pc.onconnectionstatechange = null; this.pc.ontrack = null; try { this.pc.close(); } catch (e) {} this.pc = null; }
+    if (this.ws) { this.ws.onclose = null; this.ws.onmessage = null; try { this.ws.close(); } catch (e) {} this.ws = null; }
+    this.onStats({ event: "redirect", reason: rd.reason || "", host: String(rd.host) });
+    setTimeout(() => { if (!this.closed) this.connect(); }, delayMs);
   }
 
   async _onOffer(desc, iceServers) {
@@ -317,6 +389,7 @@ class SelkiesWebRTC {
     if (this.closed) return;
     console.warn("webrtc plane failed:", reason);
     const wasConnected = this.connected;
+    this._failed = true;
     this.close();
     this.onStats({ event: wasConnected ? "close" : "failed", reason });
   }

@@ -1,0 +1,1290 @@
+"""Multi-host cluster plane (selkies_tpu/cluster) — ISSUE 15 acceptance.
+
+* membership: signed heartbeats, lease expiry, capped-backoff re-join,
+  deterministic heartbeat-drop / partition chaos;
+* capacity digest: ONE derivation shared by /healthz, /statz and the
+  heartbeat;
+* router: serve-local-first, drain/capacity/codec redirects, chronic-
+  burn and quarantine penalties, local-session pinning;
+* client: redirect records followed through the existing reconnect
+  loop, chains capped (no two-host ping-pong);
+* migration: checkpoint → ship → restore ordering, mid-migration peer
+  death leaves the source serving, unclaimed slots expire;
+* seeded multi-host chaos: no session double-placed or lost across
+  heartbeat loss, mid-migration kills and drain-under-partition, with
+  the placer invariant on every host throughout;
+* the end-to-end: two in-process hosts with REAL encoders and REAL
+  signalling servers — host A drains, the session live-migrates to
+  host B, the client follows the redirect, and the post-migration
+  stream opens with a recovery IDR byte-identical to an uninterrupted
+  single-host oracle.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+
+from selkies_tpu.cluster import (
+    ClusterNode,
+    ClusterRouter,
+    LocalMigrationChannel,
+    MigrationError,
+    MigrationTarget,
+    Redirect,
+    build_digest,
+    migrate_session,
+    parse_redirect,
+    ws_url_of,
+)
+from selkies_tpu.cluster.membership import sign_blob, verify_blob
+from selkies_tpu.monitoring.telemetry import telemetry
+from selkies_tpu.parallel.lifecycle import DrainController, SessionPlacer
+from selkies_tpu.resilience import InjectedFault, configure_faults, reset_faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+W, H = 64, 96
+
+
+@pytest.fixture
+def faults():
+    yield configure_faults
+    reset_faults()
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.close()
+
+
+def chips(n=4, tag=""):
+    return [f"chip{tag}{i}" for i in range(n)]
+
+
+def _loopback(nodes: dict):
+    """In-process heartbeat transport: peer URL -> ClusterNode."""
+
+    async def send(peer, body, sig):
+        node = nodes.get(peer)
+        return node is not None and node.receive(body, sig)
+
+    return send
+
+
+def _mk_node(host, peers, nodes, *, digest=None, clock=None, secret="k",
+             heartbeat_s=0.05, lease_s=0.2):
+    node = ClusterNode(host, peers, secret=secret, heartbeat_s=heartbeat_s,
+                       lease_s=lease_s, transport=_loopback(nodes),
+                       digest_fn=digest or (lambda: build_digest(
+                           codecs=["h264"])),
+                       **({"clock": clock} if clock else {}))
+    nodes[host] = node
+    return node
+
+
+# -- capacity digest ----------------------------------------------------
+
+
+def test_build_digest_folds_placer_drain_devices_slo():
+    p = SessionPlacer(devices=chips(4), bands=2, host_cores=8)
+    p.place_initial(1, 2)
+    p.set_busy(0, True)
+    d = build_digest(
+        host="http://a:1", placer=p,
+        devices_view={"chips": 4, "healthy": 3, "quarantined": ["chip3"],
+                      "capacity": 0.75},
+        slo_views={"0": {"chronic": ["latency_p50"]},
+                   "1": {"chronic": []}},
+        codecs=["av1", "h264"])
+    assert d["has_placer"] and d["bands"] == 2 and not d["shared"]
+    assert d["chips"] == 4 and d["healthy_chips"] == 3
+    assert d["quarantined_chips"] == 1 and d["capacity"] == 0.75
+    assert d["sessions"] == 1 and d["busy"] == 1
+    assert d["free_chips"] == 2 and d["free_slots"] == 1  # 2 free // 2 bands
+    assert d["chronic_burn"] == ["0"]
+    assert d["codecs"] == ["av1", "h264"]
+    assert not d["draining"]
+    # the digest is a wire contract: it must be JSON-serializable as-is
+    assert json.loads(json.dumps(d)) == d
+
+
+def test_capacity_digest_shared_by_healthz_and_heartbeat(loop):
+    """The satellite: /healthz's machine-readable capacity block, the
+    /statz health fold and the heartbeat envelope all come from ONE
+    helper — same fields, same values."""
+    import aiohttp
+
+    from selkies_tpu.signalling.server import (
+        SignallingOptions, SignallingServer)
+
+    async def scenario():
+        placer = SessionPlacer(devices=chips(2), bands=1, host_cores=8)
+        placer.place_initial(2, 1)
+        placer.set_busy(0, True)
+        drainer = DrainController("digest-test", placer=placer,
+                                  deadline_s=5.0)
+        server = SignallingServer(SignallingOptions(addr="127.0.0.1", port=0))
+        await server.start()
+        try:
+            base = f"http://127.0.0.1:{server.bound_port}"
+            async with aiohttp.ClientSession() as http:
+                r = await http.get(base + "/healthz")
+                body = await r.json()
+            cap = body["capacity"]
+            assert cap["has_placer"] and cap["sessions"] == 2
+            assert cap["busy"] == 1 and cap["free_slots"] == 1
+            assert cap["drain_state"] == "serving" and not cap["draining"]
+            assert "h264" in cap["codecs"]
+            # the heartbeat ships the same derivation
+            hb = telemetry.capacity_digest()
+            for key in ("sessions", "busy", "free_slots", "draining",
+                        "codecs", "chips"):
+                assert hb[key] == cap[key], key
+            drainer.begin()
+            assert telemetry.capacity_digest()["draining"] is True
+        finally:
+            await server.stop()
+            telemetry._lifecycle = None
+
+    loop.run_until_complete(scenario())
+
+
+# -- membership ---------------------------------------------------------
+
+
+def test_heartbeat_signature_rejected_on_bad_secret():
+    assert verify_blob("s", "body", sign_blob("s", "body"))
+    assert not verify_blob("s", "body", sign_blob("wrong", "body"))
+    nodes: dict = {}
+    a = _mk_node("http://a:1", ["http://b:2"], nodes, secret="right")
+    b = _mk_node("http://b:2", ["http://a:1"], nodes, secret="WRONG")
+    body, sig = a.envelope()
+    assert b.receive(body, sig) is False
+    assert b.alive_peers() == {}
+    # matching secrets accept
+    b.secret = "right"
+    body, sig = a.envelope()
+    assert b.receive(body, sig) is True
+    assert "http://a:1" in b.alive_peers()
+
+
+def test_membership_lease_expiry_and_rejoin(loop):
+    t = [0.0]
+    nodes: dict = {}
+    a = _mk_node("http://a:1", ["http://b:2"], nodes, clock=lambda: t[0],
+                 lease_s=0.2)
+    b = _mk_node("http://b:2", ["http://a:1"], nodes, clock=lambda: t[0],
+                 lease_s=0.2)
+
+    async def scenario():
+        await a.heartbeat_once()
+        assert b.peer_alive("http://a:1")
+        t[0] += 0.3  # two silent beats: the lease expires
+        assert not b.peer_alive("http://a:1")
+        assert b.alive_peers() == {}
+        await a.heartbeat_once()  # the peer re-joins on its next beat
+        assert b.peer_alive("http://a:1")
+
+    loop.run_until_complete(scenario())
+
+
+def test_send_failure_arms_capped_backoff_and_heals(loop):
+    t = [0.0]
+    calls = {"n": 0, "fail": True}
+
+    async def flaky(peer, body, sig):
+        calls["n"] += 1
+        if calls["fail"]:
+            raise ConnectionError("peer down")
+        return True
+
+    a = ClusterNode("http://a:1", ["http://b:2"], secret="", heartbeat_s=0.05,
+                    lease_s=0.2, transport=flaky, clock=lambda: t[0],
+                    digest_fn=lambda: build_digest(codecs=["h264"]))
+
+    async def scenario():
+        await a.heartbeat_once()
+        st = a._peers["http://b:2"]
+        assert st.failed == 1 and st.next_send > t[0]  # backing off
+        await a.heartbeat_once()  # still inside the backoff window
+        assert calls["n"] == 1, "backed-off peer must not be re-sent yet"
+        t[0] = st.next_send + 0.01
+        calls["fail"] = False
+        await a.heartbeat_once()  # the re-join retry lands
+        assert calls["n"] == 2 and st.ok == 1
+        assert st.next_send == 0.0  # healed: back on the heartbeat cadence
+
+    loop.run_until_complete(scenario())
+
+
+def test_heartbeat_drop_fault_keeps_peer_dead(loop, faults):
+    """cluster:heartbeat drop = the beat is lost in flight: the sender
+    pays no backoff, the receiver's lease simply never refreshes."""
+    faults("cluster:heartbeat@1-2:drop")
+    nodes: dict = {}
+    a = _mk_node("http://a:1", ["http://b:2"], nodes)
+    b = _mk_node("http://b:2", ["http://a:1"], nodes)
+
+    async def scenario():
+        await a.heartbeat_once()
+        await a.heartbeat_once()
+        assert not b.peer_alive("http://a:1")  # both beats dropped
+        assert a._peers["http://b:2"].failed == 0  # loss != send failure
+        await a.heartbeat_once()  # schedule exhausted: this one lands
+        assert b.peer_alive("http://a:1")
+
+    loop.run_until_complete(scenario())
+
+
+def test_partition_fault_discards_inbound(loop, faults):
+    """A partitioned receive discards the beat AND looks like a failed
+    POST to the sender (no 200 comes back through a partition), so the
+    sender's re-join backoff arms; once it expires, the next beat
+    heals the view."""
+    faults("cluster:partition@1:drop")
+    t = [0.0]
+    nodes: dict = {}
+    a = _mk_node("http://a:1", ["http://b:2"], nodes, clock=lambda: t[0])
+    b = _mk_node("http://b:2", ["http://a:1"], nodes, clock=lambda: t[0])
+
+    async def scenario():
+        await a.heartbeat_once()  # b's receive is partitioned away
+        assert not b.peer_alive("http://a:1")
+        st = a._peers["http://b:2"]
+        assert st.failed == 1 and st.next_send > t[0]  # sender backs off
+        t[0] = st.next_send + 0.01  # the re-join retry comes due
+        await a.heartbeat_once()
+        assert b.peer_alive("http://a:1")
+
+    loop.run_until_complete(scenario())
+
+
+# -- router -------------------------------------------------------------
+
+
+def _digest(host, *, free=1, draining=False, chronic=(), quarantined=0,
+            codecs=("h264",)):
+    return {"host": host, "has_placer": True, "shared": False,
+            "draining": draining, "free_slots": free,
+            "chronic_burn": list(chronic),
+            "quarantined_chips": quarantined, "codecs": list(codecs)}
+
+
+class _StubNode:
+    def __init__(self, local, peers):
+        self.local = local
+        self.peers = peers
+
+    def self_digest(self):
+        return self.local
+
+    def alive_peers(self):
+        return self.peers
+
+
+def test_router_serves_local_first_and_redirects_on_drain():
+    peers = {"http://b:2": _digest("http://b:2", free=2)}
+    r = ClusterRouter(_StubNode(_digest("a", free=1), peers))
+    assert r.route({"codecs": ["h264"]}, uid="1") is None
+    r2 = ClusterRouter(_StubNode(_digest("a", free=1, draining=True), peers))
+    rd = r2.route({"codecs": ["h264"]}, uid="1")
+    assert rd is not None and rd.host == "http://b:2"
+    assert rd.reason == "draining"
+    # full (not draining) local carve redirects with reason=capacity
+    r3 = ClusterRouter(_StubNode(_digest("a", free=0), peers))
+    assert r3.route({"codecs": ["h264"]}).reason == "capacity"
+    # no live peer: serve/queue locally rather than bounce into the void
+    r4 = ClusterRouter(_StubNode(_digest("a", free=0), {}))
+    assert r4.route({"codecs": ["h264"]}) is None
+    assert [d["reason"] for d in r4.stats()["decisions"]] == ["no-peer"]
+
+
+def test_router_scoring_penalizes_burn_and_quarantine():
+    peers = {
+        "http://burn:1": _digest("http://burn:1", free=3,
+                                 chronic=["0", "1"]),
+        "http://quar:2": _digest("http://quar:2", free=3, quarantined=2),
+        "http://clean:3": _digest("http://clean:3", free=2),
+    }
+    r = ClusterRouter(_StubNode(_digest("a", draining=True), peers))
+    # clean host wins despite fewer free slots: burn -4, quarantine -1
+    assert r.route({"codecs": ["h264"]}).host == "http://clean:3"
+
+
+def test_router_codec_capability():
+    """An AV1 client never lands on an h264-only host when an av1 host
+    with capacity exists — and a host that would degrade the client
+    hands it onward."""
+    peers = {
+        "http://h264:1": _digest("http://h264:1", free=5),
+        "http://av1:2": _digest("http://av1:2", free=1,
+                                codecs=["av1", "h264"]),
+    }
+    # local draining: the av1 client must go to the av1 host even
+    # though the h264 host has more free capacity
+    r = ClusterRouter(_StubNode(_digest("a", draining=True), peers))
+    assert r.route({"codecs": ["av1", "h264"]}).host == "http://av1:2"
+    # local serving h264-only WITH capacity: codec routing hands the
+    # av1 client to the host that serves its preference natively
+    r2 = ClusterRouter(_StubNode(_digest("a", free=3), peers))
+    rd = r2.route({"codecs": ["av1", "h264"]})
+    assert rd is not None and rd.host == "http://av1:2"
+    assert rd.reason == "codec"
+    # an h264 client stays local
+    assert r2.route({"codecs": ["h264"]}) is None
+
+
+def test_pick_migration_target_skips_placerless_hosts():
+    """A bare solo host routes and heartbeats but wires no
+    /cluster/migrate endpoint — shipping it a checkpoint can only 404,
+    so it is never a migration target even when it outscores."""
+    solo = _digest("http://solo:1")
+    solo["has_placer"] = False
+    solo["free_slots"] = 0
+    peers = {"http://solo:1": solo,
+             "http://fleet:2": _digest("http://fleet:2", free=1)}
+    r = ClusterRouter(_StubNode(_digest("a", draining=True), peers))
+    assert r.pick_migration_target() == "http://fleet:2"
+    # with ONLY the solo host alive there is nowhere to migrate
+    r2 = ClusterRouter(_StubNode(_digest("a", draining=True),
+                                 {"http://solo:1": solo}))
+    assert r2.pick_migration_target() is None
+
+
+def test_migration_restore_prefers_checkpoint_slot(loop):
+    """The restore lands on the checkpoint's OWN slot index when free
+    (the client's peer id encodes it), and falls over to another slot —
+    reported in the ack — when that index is occupied."""
+    from selkies_tpu.parallel.lifecycle import SessionCheckpoint
+
+    fb = _fake_host("b")
+    target = MigrationTarget(fleet=fb, advertise="http://b:2", claim_s=30)
+    ck = SessionCheckpoint(session=1, qp=33)
+    ack = target.handle({"checkpoint": ck.to_json(), "source": "a"})
+    assert ack["session"] == 1  # same-index landing, not first-free
+    fb2 = _fake_host("c")
+    fb2.slots[1].connected = True  # the preferred index is occupied
+    target2 = MigrationTarget(fleet=fb2, advertise="http://c:3", claim_s=30)
+    ack2 = target2.handle({"checkpoint": ck.to_json(), "source": "a"})
+    assert ack2["session"] == 0  # cross-index landing rides the ack
+
+
+def test_router_pins_local_sessions():
+    peers = {"http://b:2": _digest("http://b:2", free=2)}
+    r = ClusterRouter(_StubNode(_digest("a", draining=True), peers),
+                      is_local_session=lambda uid: uid == "11")
+    assert r.route({"codecs": ["h264"]}, uid="11") is None  # reconnect: pin
+    assert r.route({"codecs": ["h264"]}, uid="21") is not None
+
+
+def test_redirect_record_wire_roundtrip():
+    rd = Redirect(host="http://b:2", reason="capacity", retry_after_s=1.5)
+    assert parse_redirect(rd.to_wire()) == rd
+    rd = Redirect(host="http://b:2", reason="migrated", session=3)
+    assert parse_redirect(rd.to_wire()) == rd
+    assert parse_redirect("REDIRECT !!!garbage") is None
+    assert ws_url_of("http://h:1") == "ws://h:1/ws"
+    assert ws_url_of("https://h:1") == "wss://h:1/ws"
+    assert ws_url_of("wss://h:1/custom") == "wss://h:1/custom"
+    assert ws_url_of("h:1") == "ws://h:1/ws"
+
+
+def test_heartbeat_replay_does_not_overwrite_newer_digest():
+    """An out-of-order / replayed beat from the peer's current boot
+    must neither roll the digest back (a delayed pre-drain digest
+    would keep routing clients to a draining host) nor revive a dead
+    peer's lease — while a genuinely restarted peer (fresh boot id,
+    seq reset) re-joins immediately."""
+    t = [0.0]
+    nodes: dict = {}
+    a = _mk_node("http://a:1", ["http://b:2"], nodes, clock=lambda: t[0],
+                 lease_s=1.0)
+    b = _mk_node("http://b:2", ["http://a:1"], nodes, clock=lambda: t[0],
+                 lease_s=1.0)
+    old_body, old_sig = b.envelope()  # seq 1, pre-drain digest
+    new_body, new_sig = b.envelope()  # seq 2
+    assert a.receive(new_body, new_sig)
+    lease_before = a._peers["http://b:2"].lease_until
+    t[0] += 0.5
+    assert a.receive(old_body, old_sig)  # replay: accepted but ignored
+    st = a._peers["http://b:2"]
+    assert st.last_seq == 2 and st.lease_until == lease_before
+    # the lease lapses; a same-boot captured beat can NOT revive it
+    t[0] += 1.0
+    assert not a.peer_alive("http://b:2")
+    a.receive(old_body, old_sig)
+    assert not a.peer_alive("http://b:2")
+    # a restarted peer carries a fresh boot id and re-joins at once
+    b2 = _mk_node("http://b:2", ["http://a:1"], nodes, clock=lambda: t[0],
+                  lease_s=1.0)
+    body, sig = b2.envelope()  # seq 1 again, new boot
+    assert a.receive(body, sig)
+    assert a.peer_alive("http://b:2") and st.last_seq == 1
+
+
+def test_redirect_chain_allows_documented_hop_count(loop):
+    """Exactly MAX_REDIRECT_HOPS distinct redirects are followed inside
+    the window; the next one is refused (the path seeds with the
+    origin, which must not eat a hop)."""
+    from selkies_tpu.signalling.client import SignallingClient
+
+    client = SignallingClient("ws://h0/ws", id=1, peer_id=2)
+    for i in range(1, client.MAX_REDIRECT_HOPS + 1):
+        rd = Redirect(host=f"http://h{i}:1", reason="capacity")
+        loop.run_until_complete(client._on_redirect(rd.to_wire()))
+        assert client.server == f"ws://h{i}:1/ws", f"hop {i} not followed"
+    last = client.server
+    rd = Redirect(host="http://h9:1", reason="capacity")
+    loop.run_until_complete(client._on_redirect(rd.to_wire()))
+    assert client.server == last  # hop 5 refused: chain capped
+
+
+def test_router_placerless_busy_host_is_full():
+    """A bare solo host's `busy` bit is its whole capacity story: busy
+    means redirect away locally AND never a candidate for peers."""
+    solo_busy = {"host": "s", "has_placer": False, "draining": False,
+                 "busy": 1, "codecs": ["h264"]}
+    peers = {"http://b:2": _digest("http://b:2", free=1)}
+    r = ClusterRouter(_StubNode(dict(solo_busy), peers))
+    rd = r.route({"codecs": ["h264"]})
+    assert rd is not None and rd.reason == "capacity"
+    r2 = ClusterRouter(_StubNode(_digest("a", draining=True),
+                                 {"http://s:1": dict(solo_busy)}))
+    assert r2.route({"codecs": ["h264"]}) is None  # busy solo: no target
+    solo_free = dict(solo_busy, busy=0)
+    r3 = ClusterRouter(_StubNode(_digest("a", draining=True),
+                                 {"http://s:1": solo_free}))
+    assert r3.route({"codecs": ["h264"]}).host == "http://s:1"
+
+
+def test_client_redirect_retargets_fleet_peer_ids(loop):
+    """A migrate-off redirect carrying the landing slot re-registers
+    the client under that slot's peer ids (fleet convention 1+10k /
+    2+10k) so it pairs with the slot holding its restored state."""
+    from selkies_tpu.signalling.client import SignallingClient
+
+    client = SignallingClient("ws://a/ws", id=21, peer_id=22,
+                              meta={"codecs": ["h264"]})  # source slot 2
+    rd = Redirect(host="http://b:2", reason="migrated", session=0)
+    loop.run_until_complete(client._on_redirect(rd.to_wire()))
+    assert client.id == 1 and client.peer_id == 2  # landing slot 0
+    assert client.server == "ws://b:2/ws"
+    # non-numeric ids are left alone (the owner wires its own mapping)
+    client2 = SignallingClient("ws://a/ws", id="browser-x", peer_id="y")
+    rd2 = Redirect(host="http://c:3", reason="migrated", session=1)
+    loop.run_until_complete(client2._on_redirect(rd2.to_wire()))
+    assert client2.id == "browser-x" and client2.peer_id == "y"
+
+
+# -- client follows redirects ------------------------------------------
+
+
+class _AlwaysRedirect:
+    def __init__(self, host):
+        self.host = host
+
+    def route(self, meta, uid=""):
+        return Redirect(host=self.host, reason="capacity",
+                        retry_after_s=0.05)
+
+
+async def _start_server(router=None):
+    from selkies_tpu.signalling.server import (
+        SignallingOptions, SignallingServer)
+
+    server = SignallingServer(SignallingOptions(addr="127.0.0.1", port=0))
+    server.cluster_router = router
+    await server.start()
+    return server
+
+
+def test_client_follows_server_redirect(loop):
+    """The satellite: a meta-carrying HELLO redirected by host A lands
+    on host B through the client's EXISTING reconnect loop."""
+    from selkies_tpu.signalling.client import (
+        SignallingClient, run_reconnect_loop)
+
+    async def scenario():
+        server_b = await _start_server()
+        server_a = await _start_server(
+            _AlwaysRedirect(f"http://127.0.0.1:{server_b.bound_port}"))
+        client = SignallingClient(
+            f"ws://127.0.0.1:{server_a.bound_port}/ws", id=1, peer_id=2,
+            meta={"codecs": ["h264"]})
+        task = asyncio.get_running_loop().create_task(
+            run_reconnect_loop(client, "test"))
+        try:
+            for _ in range(200):
+                if "1" in server_b.peers:
+                    break
+                await asyncio.sleep(0.02)
+            assert "1" in server_b.peers, "client never followed the redirect"
+            assert "1" not in server_a.peers
+            assert client.server == \
+                f"ws://127.0.0.1:{server_b.bound_port}/ws"
+        finally:
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
+            await client.stop()
+            await server_a.stop()
+            await server_b.stop()
+
+    loop.run_until_complete(scenario())
+
+
+def test_client_caps_redirect_pingpong(loop):
+    """Two hosts redirecting at each other can never ping-pong a client
+    forever: the chain caps and the client parks."""
+    from selkies_tpu.signalling.client import (
+        SignallingClient, run_reconnect_loop)
+
+    async def scenario():
+        server_a = await _start_server()
+        server_b = await _start_server()
+        server_a.cluster_router = _AlwaysRedirect(
+            f"http://127.0.0.1:{server_b.bound_port}")
+        server_b.cluster_router = _AlwaysRedirect(
+            f"http://127.0.0.1:{server_a.bound_port}")
+        client = SignallingClient(
+            f"ws://127.0.0.1:{server_a.bound_port}/ws", id=1, peer_id=2,
+            meta={"codecs": ["h264"]})
+        task = asyncio.get_running_loop().create_task(
+            run_reconnect_loop(client, "test"))
+        try:
+            await asyncio.sleep(1.0)
+            # one bounce A->B, then B's redirect back to A is IGNORED
+            # (A is already in the chain): the path never grows past
+            # [origin, B] and the client stays parked on B
+            hops = [h for h, _ in client._redirect_path]
+            assert len(hops) == 2, hops
+            assert client.server == \
+                f"ws://127.0.0.1:{server_b.bound_port}/ws"
+        finally:
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
+            await client.stop()
+            await server_a.stop()
+            await server_b.stop()
+
+    loop.run_until_complete(scenario())
+
+
+def test_redirect_loss_fault_client_recovers(loop, faults):
+    """cluster:redirect drop = the record is lost in flight; the closed
+    socket sends the client back through its reconnect loop, and the
+    NEXT HELLO's redirect lands."""
+    from selkies_tpu.signalling.client import (
+        SignallingClient, run_reconnect_loop)
+
+    faults("cluster:redirect@1:drop")
+
+    async def scenario():
+        server_b = await _start_server()
+        server_a = await _start_server(
+            _AlwaysRedirect(f"http://127.0.0.1:{server_b.bound_port}"))
+        client = SignallingClient(
+            f"ws://127.0.0.1:{server_a.bound_port}/ws", id=1, peer_id=2,
+            meta={"codecs": ["h264"]})
+        task = asyncio.get_running_loop().create_task(
+            run_reconnect_loop(client, "test"))
+        try:
+            for _ in range(400):
+                if "1" in server_b.peers:
+                    break
+                await asyncio.sleep(0.02)
+            assert "1" in server_b.peers, \
+                "client never recovered from the lost redirect"
+        finally:
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
+            await client.stop()
+            await server_a.stop()
+            await server_b.stop()
+
+    loop.run_until_complete(scenario())
+
+
+# -- migration (fake fleets) -------------------------------------------
+
+
+class _FakeSessionState:
+    def __init__(self):
+        self.frames_since_idr = 4
+        self.idr_pic_id = 1
+        self.force_idr = False
+        self.qp = 30
+
+
+class _FakeService:
+    def __init__(self, n):
+        self.n = n
+        self.sessions = [_FakeSessionState() for _ in range(n)]
+        self.params = type("P", (), {"width": W, "height": H, "fps": 30})()
+        self.last_idrs = [True] * n
+
+    def set_qp(self, k, qp):
+        self.sessions[k].qp = qp
+
+    def force_keyframe(self, k):
+        self.sessions[k].force_idr = True
+
+    def encode_tick(self, frames):
+        idrs = [s.force_idr for s in self.sessions]
+        for s in self.sessions:
+            s.force_idr = False
+        self.last_idrs = idrs
+        return [b"\x00" for _ in range(self.n)]
+
+    def close(self):
+        pass
+
+
+def _fake_host(tag, n=2):
+    from selkies_tpu.parallel.fleet import SessionFleet, SessionSlot
+
+    slots = [SessionSlot(k, bitrate_kbps=2000, fps=60) for k in range(n)]
+    fleet = SessionFleet(slots, width=W, height=H, fps=60,
+                         service=_FakeService(n))
+    fleet.placer = SessionPlacer(devices=chips(n, tag), bands=1,
+                                 host_cores=8)
+    fleet.placer.place_initial(n, 1)
+    return fleet
+
+
+def test_migrate_session_moves_state_and_frees_source(loop):
+    fa, fb = _fake_host("a"), _fake_host("b")
+    fa.slots[0].connected = True
+    fa.placer.set_busy(0, True)
+    fa.service.sessions[0].qp = 37
+    fa.service.sessions[0].idr_pic_id = 1
+    channel = LocalMigrationChannel()
+    target = MigrationTarget(fleet=fb, advertise="http://b:2", claim_s=5)
+    channel.register("http://b:2", target.handle)
+
+    async def scenario():
+        ack = await migrate_session(fa, 0, "http://b:2", channel,
+                                    source="http://a:1")
+        assert ack["ok"] and ack["host"] == "http://b:2"
+        k2 = ack["session"]
+        # GOP + qp state landed, recovery IDR armed
+        assert fb.service.sessions[k2].qp == 37
+        assert fb.service.sessions[k2].idr_pic_id == 1
+        assert fb.service.sessions[k2].force_idr is True
+        # the target holds a claim until the client follows
+        assert k2 in target.pending_claims
+        # source placement released; the carve is consistent on both
+        assert fa.placer.row(0) == []
+        fa.placer.assert_consistent()
+        fb.placer.assert_consistent()
+
+    loop.run_until_complete(scenario())
+
+
+def test_mid_migration_peer_death_leaves_source_serving(loop, faults):
+    faults("cluster:ship@1:raise")
+    fa, fb = _fake_host("a"), _fake_host("b")
+    fa.slots[0].connected = True
+    fa.placer.set_busy(0, True)
+    channel = LocalMigrationChannel()
+    target = MigrationTarget(fleet=fb, advertise="http://b:2", claim_s=5)
+    channel.register("http://b:2", target.handle)
+
+    async def scenario():
+        with pytest.raises(InjectedFault):
+            await migrate_session(fa, 0, "http://b:2", channel)
+        # the source is UNTOUCHED: still placed, still busy, target empty
+        assert len(fa.placer.row(0)) == 1
+        assert fa.slots[0].connected
+        assert not any(s.force_idr for s in fb.service.sessions)
+        fa.placer.assert_consistent()
+        # the retry (schedule exhausted) lands
+        ack = await migrate_session(fa, 0, "http://b:2", channel)
+        assert ack["ok"]
+
+    loop.run_until_complete(scenario())
+
+
+def test_failed_restore_releases_admitted_slot(faults):
+    """A restore that dies AFTER admission (here: an injected
+    migrate:<k> fault inside restore_session) must release the slot it
+    just admitted — acked ok=False to the source, zero parked chips on
+    the target."""
+    from selkies_tpu.parallel.lifecycle import SessionCheckpoint
+
+    faults("migrate:0@1:raise")
+    fb = _fake_host("b")
+    target = MigrationTarget(fleet=fb, advertise="http://b:2", claim_s=30)
+    ck = SessionCheckpoint(session=0, qp=30)
+    ack = target.handle({"checkpoint": ck.to_json(), "source": "a"})
+    assert not ack["ok"]
+    assert 0 not in target.pending_claims
+    assert fb.placer.row(0) == []  # released, not parked-busy forever
+    fb.placer.assert_consistent()
+    # the retry (schedule exhausted) admits and restores cleanly
+    ack = target.handle({"checkpoint": ck.to_json(), "source": "a"})
+    assert ack["ok"] and ack["session"] == 0
+
+
+def test_unclaimed_migration_slot_expires(loop):
+    t = [100.0]
+    fb = _fake_host("b")
+    target = MigrationTarget(fleet=fb, advertise="http://b:2",
+                             claim_s=1.0, clock=lambda: t[0])
+    from selkies_tpu.parallel.lifecycle import SessionCheckpoint
+
+    ck = SessionCheckpoint(session=0, qp=30)
+    ack = target.handle({"checkpoint": ck.to_json(), "source": "a"})
+    assert ack["ok"]
+    k2 = ack["session"]
+    assert len(fb.placer.row(k2)) == 1
+    t[0] += 0.5
+    assert target.expire_claims() == []  # inside the claim window
+    t[0] += 1.0
+    assert target.expire_claims() == [k2]  # client never came: release
+    assert fb.placer.row(k2) == []
+    fb.placer.assert_consistent()
+    # a CLAIMED slot is kept: restore again, connect the client
+    ack = target.handle({"checkpoint": ck.to_json(), "source": "a"})
+    k3 = ack["session"]
+    fb.slots[k3].connected = True
+    t[0] += 5.0
+    assert target.expire_claims() == []
+    assert k3 not in target.pending_claims
+
+
+# -- seeded multi-host chaos -------------------------------------------
+
+
+def test_cluster_chaos_no_double_placed_or_lost_sessions(loop, faults):
+    """Heartbeat drops + mid-migration kills + drain-under-partition
+    over three in-process hosts: after every op each logical session is
+    serving on EXACTLY one host (or checkpointed by a drain hand-off),
+    and every placer invariant holds."""
+    faults("cluster:heartbeat@3,7,11,15:drop;"
+           "cluster:ship@2,5:raise;"
+           "cluster:partition@9-12:drop")
+    hosts = ["http://a:1", "http://b:2", "http://c:3"]
+    fleets = {h: _fake_host(t) for h, t in zip(hosts, "abc")}
+    nodes: dict = {}
+    for h in hosts:
+        _mk_node(h, [p for p in hosts if p != h], nodes,
+                 digest=lambda h=h: build_digest(
+                     placer=fleets[h].placer, codecs=["h264"]),
+                 lease_s=10.0)
+    routers = {h: ClusterRouter(nodes[h]) for h in hosts}
+    channel = LocalMigrationChannel()
+    targets = {h: MigrationTarget(fleet=fleets[h], advertise=h, claim_s=30)
+               for h in hosts}
+    for h in hosts:
+        channel.register(h, targets[h].handle)
+
+    # logical sessions L0/L1 start connected on host A slots 0/1
+    loc = {}
+    for lg, k in (("L0", 0), ("L1", 1)):
+        fleets[hosts[0]].slots[k].connected = True
+        fleets[hosts[0]].placer.set_busy(k, True)
+        loc[lg] = (hosts[0], k)
+    checkpointed: set[str] = set()
+
+    def assert_invariants(step):
+        for h in hosts:
+            fleets[h].placer.assert_consistent()
+        # the STRONG form: the set of connected slots across the whole
+        # cluster equals exactly the live logical sessions' recorded
+        # locations — a session serving in two places (double-placed)
+        # or zero places (lost) both break this equality
+        connected = sorted(
+            (hh, kk) for hh in hosts
+            for kk, slot in enumerate(fleets[hh].slots) if slot.connected)
+        live = sorted(loc[lg] for lg in loc if lg not in checkpointed)
+        assert connected == live, (step, connected, live)
+        assert len(set(live)) == len(live), (step, "slot shared", live)
+
+    async def scenario():
+        rng = np.random.default_rng(7)
+        for step in range(40):
+            op = int(rng.integers(0, 3))
+            if op == 0:  # a heartbeat round (drops per the schedule)
+                for h in hosts:
+                    await nodes[h].heartbeat_once()
+            elif op == 1:  # migrate a random live logical session
+                lg = ["L0", "L1"][int(rng.integers(0, 2))]
+                if lg in checkpointed:
+                    continue
+                src, k = loc[lg]
+                dst = hosts[int(rng.integers(0, 3))]
+                if dst == src:
+                    continue
+                fleet = fleets[src]
+                try:
+                    ack = await migrate_session(fleet, k, dst, channel,
+                                                source=src)
+                except (InjectedFault, MigrationError):
+                    pass  # mid-migration death: source keeps serving
+                else:
+                    k2 = ack["session"]
+                    fleet.slots[k].connected = False
+                    fleets[dst].slots[k2].connected = True  # client followed
+                    targets[dst].pending_claims.pop(k2, None)
+                    loc[lg] = (dst, k2)
+            else:  # a router decision round (exercises stale views)
+                h = hosts[int(rng.integers(0, 3))]
+                routers[h].route({"codecs": ["h264"]}, uid="1")
+            assert_invariants(step)
+
+        # drain host A under the (already-consumed or live) partition:
+        # whatever its router can place migrates, the rest hands off as
+        # checkpoints — nothing is lost either way
+        src = hosts[0]
+        fleet = fleets[src]
+
+        async def _migrate_off():
+            moved = []
+            for k, slot in enumerate(fleet.slots):
+                if not slot.connected:
+                    continue
+                lg = next((g for g, v in loc.items() if v == (src, k)), None)
+                dst = routers[src].pick_migration_target()
+                if dst is None:
+                    continue
+                try:
+                    ack = await migrate_session(fleet, k, dst, channel,
+                                                source=src)
+                except (InjectedFault, MigrationError):
+                    continue
+                slot.connected = False
+                fleets[dst].slots[ack["session"]].connected = True
+                targets[dst].pending_claims.pop(ack["session"], None)
+                if lg is not None:
+                    loc[lg] = (dst, ack["session"])
+                moved.append(k)
+            return moved
+
+        drainer = DrainController("chaos-a", placer=fleet.placer,
+                                  deadline_s=10.0, migrate=_migrate_off,
+                                  handoff=fleet.checkpoint_all)
+        await drainer.drain()
+        for k, slot in enumerate(fleet.slots):
+            if slot.connected:  # not placed anywhere: must be handed off
+                lg = next(g for g, v in loc.items() if v == (src, k))
+                assert any(ck.session == k for ck in drainer.checkpoints), \
+                    (lg, "lost: neither migrated nor checkpointed")
+                checkpointed.add(lg)
+                slot.connected = False  # the drained process exits
+        assert_invariants("post-drain")
+        # every logical session survived: serving off the drained host,
+        # or carried forward as a hand-off checkpoint
+        for lg in ("L0", "L1"):
+            assert lg in checkpointed or loc[lg][0] != src, (lg, loc)
+
+    loop.run_until_complete(scenario())
+    telemetry._lifecycle = None
+
+
+# -- the end-to-end acceptance -----------------------------------------
+
+
+def test_drain_migrates_session_across_hosts_byte_identical(loop):
+    """ISSUE 15 acceptance: two in-process hosts with real encoders and
+    real signalling servers. A client is admitted on host A; host A
+    drains; the session live-migrates to host B; the client follows the
+    redirect; and B's post-migration stream opens with a recovery IDR
+    byte-identical to an uninterrupted single-host oracle — placer
+    invariants checked on both hosts throughout."""
+    import jax
+
+    from selkies_tpu.parallel.fleet import SessionFleet, SessionSlot
+    from selkies_tpu.parallel.serving import MultiSessionH264Service
+    from selkies_tpu.signalling.client import (
+        SignallingClient, run_reconnect_loop)
+
+    devs = jax.devices()
+    rng = np.random.default_rng(3)
+    frames = [rng.integers(0, 255, (2, H, W, 4), np.uint8) for _ in range(5)]
+
+    def _host(devices):
+        slots = [SessionSlot(k, bitrate_kbps=2000, fps=30) for k in range(2)]
+        svc = MultiSessionH264Service(2, W, H, qp=28, fps=30,
+                                      devices=devices)
+        fleet = SessionFleet(slots, width=W, height=H, fps=30,
+                             service=svc, devices=devices)
+        return fleet
+
+    async def scenario():
+        fleet_a = _host(devs[:2])
+        fleet_b = _host(devs[2:4])
+        oracle = MultiSessionH264Service(2, W, H, qp=28, fps=30,
+                                         devices=devs[4:6])
+        server_a = await _start_server()
+        server_b = await _start_server()
+        host_a = f"http://127.0.0.1:{server_a.bound_port}"
+        host_b = f"http://127.0.0.1:{server_b.bound_port}"
+        nodes: dict = {}
+        node_a = _mk_node(host_a, [host_b], nodes,
+                          digest=lambda: build_digest(
+                              drain=drainer, placer=fleet_a.placer,
+                              codecs=["h264"]),
+                          lease_s=30.0)
+        node_b = _mk_node(host_b, [host_a], nodes,
+                          digest=lambda: build_digest(
+                              placer=fleet_b.placer, codecs=["h264"]),
+                          lease_s=30.0)
+        router_a = ClusterRouter(node_a)
+        server_a.cluster_router = router_a
+        channel = LocalMigrationChannel()
+        target_b = MigrationTarget(fleet=fleet_b, advertise=host_b,
+                                   claim_s=30)
+        channel.register(host_b, target_b.handle)
+
+        async def _migrate_off():
+            moved = []
+            for k, slot in enumerate(fleet_a.slots):
+                if not slot.connected:
+                    continue
+                dst = router_a.pick_migration_target()
+                if dst is None:
+                    continue
+                await migrate_session(fleet_a, k, dst, channel,
+                                      source=host_a)
+                await server_a.redirect_peer(
+                    "1", Redirect(host=dst, reason="migrated",
+                                  retry_after_s=0.05))
+                slot.connected = False
+                moved.append(k)
+            return moved
+
+        drainer = DrainController(
+            "e2e-a", placer=fleet_a.placer, deadline_s=30.0,
+            force_idr=lambda: None, migrate=_migrate_off,
+            handoff=fleet_a.checkpoint_all)
+
+        client = SignallingClient(ws_url_of(host_a), id=1, peer_id=2,
+                                  meta={"codecs": ["h264"]})
+        task = asyncio.get_running_loop().create_task(
+            run_reconnect_loop(client, "browser"))
+        try:
+            # --- the client is admitted on host A (capacity: served) --
+            for _ in range(200):
+                if "1" in server_a.peers:
+                    break
+                await asyncio.sleep(0.02)
+            assert "1" in server_a.peers, "client never registered on A"
+            adm = fleet_a.admit_client(0)
+            assert adm.accepted
+            fleet_a.slots[0].connected = True
+            fleet_a.placer.assert_consistent()
+
+            # --- host A and the oracle encode in lockstep -------------
+            for t in range(3):
+                a = fleet_a.service.encode_tick(frames[t])
+                b = oracle.encode_tick(frames[t])
+                assert [bytes(x) for x in a] == [bytes(x) for x in b]
+
+            # --- B heartbeats its capacity to A; A drains -------------
+            await node_b.heartbeat_once()
+            assert node_a.peer_alive(host_b)
+            ok = await asyncio.wait_for(drainer.drain(), 60)
+            assert ok, "drain missed its deadline"
+            assert drainer.migrated == [0], "the session did not migrate"
+            assert fleet_a.placer.row(0) == []  # released off A
+            fleet_a.placer.assert_consistent()
+            fleet_b.placer.assert_consistent()
+
+            # --- the client follows the redirect to host B ------------
+            for _ in range(400):
+                if "1" in server_b.peers:
+                    break
+                await asyncio.sleep(0.02)
+            assert "1" in server_b.peers, "client never landed on B"
+            assert client.server == ws_url_of(host_b)
+            k2 = next(iter(target_b.pending_claims))
+            fleet_b.slots[k2].connected = True  # the fleet's on_connect
+            target_b.expire_claims()
+            assert k2 not in target_b.pending_claims
+
+            # --- post-migration bytes == uninterrupted oracle ---------
+            oracle.force_keyframe(0)
+            a = fleet_b.service.encode_tick(frames[3])
+            b = oracle.encode_tick(frames[3])
+            assert fleet_b.service.last_idrs[k2], \
+                "resume frame is not the recovery IDR"
+            assert bytes(a[k2]) == bytes(b[0]), \
+                "recovery IDR differs from the single-host oracle"
+            a = fleet_b.service.encode_tick(frames[4])
+            b = oracle.encode_tick(frames[4])
+            assert bytes(a[k2]) == bytes(b[0]), \
+                "post-IDR P frame differs from the oracle"
+            fleet_b.placer.assert_consistent()
+        finally:
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
+            await client.stop()
+            await server_a.stop()
+            await server_b.stop()
+            fleet_a.service.close()
+            fleet_b.service.close()
+            oracle.close()
+            telemetry._lifecycle = None
+
+    loop.run_until_complete(scenario())
+
+
+# -- ratchets / rendering ----------------------------------------------
+
+
+def test_cluster_fault_sites_documented():
+    """Grammar sync: the four cluster sites exist in faultinject's
+    grammar doc AND docs/resilience.md (the device-site precedent)."""
+    import selkies_tpu.resilience.faultinject as fi
+
+    for site in ("cluster:heartbeat", "cluster:partition",
+                 "cluster:ship", "cluster:redirect"):
+        assert site in fi.__doc__, f"faultinject grammar must list {site}"
+    with open(os.path.join(REPO, "docs", "resilience.md")) as f:
+        doc = f.read()
+    for site in ("cluster:heartbeat", "cluster:partition",
+                 "cluster:ship", "cluster:redirect"):
+        assert site in doc, f"docs/resilience.md must document {site}"
+
+
+def test_statz_renders_cluster_block():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "statz", os.path.join(REPO, "tools", "statz.py"))
+    statz = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(statz)
+    rollup = {
+        "enabled": True, "uptime_s": 5.0,
+        "providers": {"cluster": {
+            "membership": {
+                "self": "http://a:1", "heartbeat_s": 2.0, "lease_s": 6.0,
+                "signed": True,
+                "peers": {"http://b:2": {
+                    "alive": True, "lease_s": 4.2, "sent": 10, "ok": 9,
+                    "failed": 1, "received": 8, "backoff_s": 0.0,
+                    "free_slots": 3, "draining": False}},
+            },
+            "router": {"redirects": 2, "decisions": [
+                {"ts": 1.0, "uid": "1", "to": "http://b:2",
+                 "reason": "capacity"}]},
+            "migrations": {"out_ok": 1, "out_fail": 0, "in_ok": 0,
+                           "in_fail": 0, "in_flight": 0,
+                           "claims_expired": 0},
+        }},
+    }
+    out = statz.render(rollup, [])
+    assert "cluster" in out and "http://b:2" in out
+    assert "alive" in out and "capacity" in out
+    assert "out_ok=1" in out
+
+
+def test_cluster_telemetry_families_emitted(loop, faults):
+    """The selkies_cluster_* families actually emit from the plane."""
+    telemetry.reset()
+    telemetry.enabled = True
+    try:
+        nodes: dict = {}
+        a = _mk_node("http://a:1", ["http://b:2"], nodes)
+        b = _mk_node("http://b:2", ["http://a:1"], nodes)
+
+        async def scenario():
+            await a.heartbeat_once()
+
+        loop.run_until_complete(scenario())
+        fams = {fam for (fam, _) in
+                list(telemetry._counters) + list(telemetry._gauges)}
+        assert "selkies_cluster_heartbeats_total" in fams
+        assert "selkies_cluster_peers" in fams
+    finally:
+        telemetry.enabled = False
+        telemetry.reset()
+
+
+def test_membership_bounds_tracked_peers():
+    """The peer table is bounded: unknown senders are admitted only up
+    to MAX_TRACKED_PEERS (each tracked host is memory plus a Prometheus
+    label series), with dead non-seed entries evicted to make room and
+    seeds never evicted."""
+    t = [0.0]
+    node = ClusterNode("http://a:1", ["http://seed:2"], secret="k",
+                       heartbeat_s=0.05, lease_s=1.0,
+                       transport=_loopback({}), digest_fn=build_digest,
+                       clock=lambda: t[0])
+
+    def beat(host, seq=1):
+        body = json.dumps({"host": host, "seq": seq, "boot": "b" + host,
+                           "digest": {"free_slots": 1}}, sort_keys=True)
+        return node.receive(body, sign_blob("k", body))
+
+    cap = ClusterNode.MAX_TRACKED_PEERS
+    admitted = [beat(f"http://stranger{i}:1") for i in range(cap + 10)]
+    assert len(node._peers) == cap
+    assert admitted.count(False) == 11  # overflow strangers refused
+    assert "http://seed:2" in node._peers
+    # leases lapse: dead strangers are evicted to admit a new live one
+    t[0] += 2.0
+    assert beat("http://fresh:1")
+    assert "http://fresh:1" in node._peers
+    assert "http://seed:2" in node._peers  # the seed survives eviction
+    assert len(node._peers) <= cap
+
+
+def test_wire_cluster_plane_wire_or_refuse():
+    """wire_cluster_plane is the ONE wire-or-refuse policy for both
+    orchestrators: a basic-auth server without a cluster secret refuses
+    (unsigned /cluster routes would be its only unauthenticated write
+    surface), a signed plane wires routes + router, and a solo plane
+    (no migration target) gets only the heartbeat route."""
+    from selkies_tpu.cluster import ClusterPlane, wire_cluster_plane
+
+    def mk_plane(secret, *, fleet=None):
+        node = ClusterNode("http://a:1", [], secret=secret,
+                           transport=_loopback({}), digest_fn=build_digest)
+        target = None if fleet is None else MigrationTarget(
+            fleet=fleet, secret=secret, advertise="http://a:1")
+        return ClusterPlane(node=node, router=ClusterRouter(node),
+                            target=target)
+
+    class _Srv:
+        def __init__(self):
+            self.ws_routes = {}
+            self.cluster_router = None
+
+    srv = _Srv()
+    refused = wire_cluster_plane(mk_plane("", fleet=_fake_host("w")), srv,
+                                 enable_basic_auth=True)
+    assert refused is None
+    assert srv.ws_routes == {} and srv.cluster_router is None
+    srv2 = _Srv()
+    plane = mk_plane("k", fleet=_fake_host("x"))
+    assert wire_cluster_plane(plane, srv2, enable_basic_auth=True) is plane
+    assert set(srv2.ws_routes) == {"/cluster/heartbeat", "/cluster/migrate"}
+    assert srv2.cluster_router is plane.router
+    srv3 = _Srv()
+    solo = mk_plane("")
+    assert wire_cluster_plane(solo, srv3) is solo  # unsigned, no basic auth
+    assert set(srv3.ws_routes) == {"/cluster/heartbeat"}
+
+
+def test_cluster_local_session_pins_pending_claims():
+    """A migrated-in session inside its claim window is pinned even
+    though its slot is not connected yet: the restore may have consumed
+    the target's last free slot, and re-routing the redirected client
+    away (reason=capacity) would strand the restored state until the
+    claim expires and the session is lost."""
+    import types
+
+    from selkies_tpu.parallel.fleet import FleetOrchestrator
+    from selkies_tpu.parallel.lifecycle import SessionCheckpoint
+
+    fb = _fake_host("p")
+    target = MigrationTarget(fleet=fb, advertise="http://b:2", claim_s=30)
+    ack = target.handle({"checkpoint": SessionCheckpoint(session=1,
+                                                         qp=30).to_json(),
+                         "source": "a"})
+    assert ack["ok"] and 1 in target.pending_claims
+    fn = FleetOrchestrator._cluster_local_session
+    stub = types.SimpleNamespace(
+        n=2, slots=fb.slots,
+        cluster=types.SimpleNamespace(target=target))
+    assert fn(stub, "11") is True   # uid 1+10*1: unclaimed migration pinned
+    assert fn(stub, "1") is False   # slot 0: neither connected nor claimed
+    assert fn(stub, "12") is False  # off-convention uid
+    fb.slots[1].connected = True    # the client claimed the slot
+    target.expire_claims()
+    assert fn(stub, "11") is True   # now pinned via connected
+    stub.cluster = None             # no plane wired: connected-only pinning
+    assert fn(stub, "1") is False
+
+
+def test_migrate_replay_nonce_refused(loop):
+    """A captured signed migrate POST re-verifies forever (the HMAC
+    carries no ordering, unlike the heartbeat's boot+seq) — the
+    target's seen-nonce window refuses the replay, so it can't
+    repeatedly park capacity under claim windows. The production ship
+    path mints a fresh nonce inside the signed body per migration."""
+    from selkies_tpu.parallel.lifecycle import SessionCheckpoint
+
+    fb = _fake_host("r")
+    target = MigrationTarget(fleet=fb, advertise="http://b:2", claim_s=30)
+    payload = {"checkpoint": SessionCheckpoint(session=0, qp=30).to_json(),
+               "source": "a", "nonce": "deadbeef"}
+    ack = target.handle(dict(payload))
+    assert ack["ok"]
+    replay = target.handle(dict(payload))  # byte-identical replay
+    assert not replay["ok"] and "replay" in replay["error"]
+    # a fresh ship (re-nonced, which needs the secret) is admitted
+    ack2 = target.handle(dict(payload, nonce="cafebabe"))
+    assert ack2["ok"] and ack2["session"] != ack["session"]
+
+    sent = {}
+
+    class _Chan:
+        async def send(self, host, payload):
+            sent.update(payload)
+            return {"ok": True, "session": 0, "host": host}
+
+    fa = _fake_host("s")
+    fa.slots[0].connected = True
+    fa.placer.set_busy(0, True)
+    loop.run_until_complete(
+        migrate_session(fa, 0, "http://b:2", _Chan(), source="a"))
+    assert len(sent.get("nonce", "")) == 32  # 16 random bytes, hex
+
+
+def test_hello_uid_collision_routes_before_close(loop):
+    """Stock clients all register as the same peer id: a SECOND browser
+    knocking on a host whose uid is taken goes through capacity routing
+    (local-session pin bypassed — a colliding uid is never a live local
+    reconnect) instead of a bare 'invalid peer uid' close."""
+    import base64
+
+    import aiohttp
+
+    async def scenario():
+        server_b = await _start_server()
+        server_a = await _start_server()
+
+        class _PinningRouter:
+            # production shape: pins the live session's own uid, routes
+            # everything else to the peer with capacity
+            def route(self, meta, uid=""):
+                if uid == "1":
+                    return None
+                return Redirect(
+                    host=f"http://127.0.0.1:{server_b.bound_port}",
+                    reason="capacity", retry_after_s=0.05)
+
+        server_a.cluster_router = _PinningRouter()
+        meta64 = base64.b64encode(
+            json.dumps({"codecs": ["h264"]}).encode()).decode()
+        url = f"ws://127.0.0.1:{server_a.bound_port}/ws"
+        async with aiohttp.ClientSession() as http:
+            ws1 = await http.ws_connect(url)
+            await ws1.send_str(f"HELLO 1 {meta64}")
+            msg = await ws1.receive()
+            assert msg.data == "HELLO"  # first browser: pinned, registered
+            ws2 = await http.ws_connect(url)
+            await ws2.send_str(f"HELLO 1 {meta64}")
+            msg2 = await ws2.receive()
+            assert msg2.type == aiohttp.WSMsgType.TEXT
+            assert msg2.data.startswith("REDIRECT ")
+            rd = parse_redirect(msg2.data)
+            assert rd.host == f"http://127.0.0.1:{server_b.bound_port}"
+            # the first browser's registration is untouched
+            assert "1" in server_a.peers
+            await ws2.close()
+            await ws1.close()
+        await server_a.stop()
+        await server_b.stop()
+
+    loop.run_until_complete(scenario())

@@ -12,9 +12,10 @@ tables: stage-latency histograms, counters, gauges, link bytes, slot
 health — plus the subsystem blocks the later PRs added: the fleet
 lifecycle/placement rollup (PR 6: carve map, admission counters, queue,
 per-slot drain states), per-session policy scenarios (PR 10), negotiated
-codecs (PR 8.1), and the serving-SLO block (burn rates per objective and
+codecs (PR 8.1), the serving-SLO block (burn rates per objective and
 window, breach states, outlier counts) with the recompile sentinel's
-per-trigger compile counts. For a black-box bundle directory it reads
+per-trigger compile counts, and the multi-host cluster block (peer
+leases, last redirect decisions, migrations in flight). For a black-box bundle directory it reads
 metrics.json and also summarizes events.jsonl; the bundle's trace.json
 loads directly in Perfetto (https://ui.perfetto.dev) — this tool doesn't
 render it.
@@ -161,6 +162,39 @@ def _render_devices(data: dict) -> str:
     return head
 
 
+def _render_cluster(data: dict) -> str:
+    """Multi-host cluster plane block (selkies_tpu/cluster): membership
+    leases, last routing decisions, migration counters."""
+    m = data.get("membership") or {}
+    out = [f"self={m.get('self', '?')} heartbeat={m.get('heartbeat_s', '?')}s "
+           f"lease={m.get('lease_s', '?')}s "
+           f"signed={'yes' if m.get('signed') else 'NO'}"]
+    peers = m.get("peers") or {}
+    rows = [(host, "alive" if st.get("alive") else "DEAD",
+             f"{st.get('lease_s', 0)}s",
+             f"{st.get('ok', 0)}/{st.get('sent', 0)}",
+             st.get("failed", 0), st.get("received", 0),
+             st.get("free_slots", "?"),
+             "draining" if st.get("draining") else "-",
+             f"{st.get('backoff_s', 0)}s" if st.get("backoff_s") else "-")
+            for host, st in sorted(peers.items())]
+    if rows:
+        out.append(_table(rows, ("peer", "state", "lease", "hb ok/sent",
+                                 "fail", "recv", "free", "drain", "backoff")))
+    r = data.get("router") or {}
+    out.append(f"redirects={r.get('redirects', 0)}")
+    decisions = r.get("decisions") or []
+    if decisions:
+        rows = [(d.get("ts", "?"), d.get("uid", "?"), d.get("to", "?"),
+                 d.get("reason", "?")) for d in decisions[-8:]]
+        out.append(_table(rows, ("ts", "uid", "routed-to", "reason")))
+    mig = data.get("migrations") or {}
+    if mig:
+        out.append("migrations: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(mig.items())))
+    return "\n".join(out)
+
+
 def _render_fleet(data: dict) -> str:
     head = (f"sessions={data.get('sessions', '?')} "
             f"connected={data.get('connected', '?')} "
@@ -181,6 +215,7 @@ _PROVIDER_RENDERERS = {
     "fleet": _render_fleet,
     "placement": _render_placement,
     "devices": _render_devices,
+    "cluster": _render_cluster,
 }
 
 
