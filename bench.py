@@ -589,6 +589,103 @@ def bench_codec_encoder(codec: str, w: int = W, h: int = H) -> tuple[float, dict
     return ITERS / dt, means
 
 
+# ---------------------------------------------------------------------------
+# capacity bench (--capacity): sessions-at-SLO curves. Ramps N scenario-
+# mix sessions on one fleet service until the tick's p95 latency (or its
+# throughput floor) breaches the per-scenario SLO targets
+# (policy/presets.SLO_TARGETS), once with the serial lockstep tick and
+# once with the occupancy scheduler (parallel/occupancy.py) — the
+# delta IS the overlap win, and the emitted max_sessions_at_slo rows
+# are the measured capacity curve build_digest serves to the cluster
+# router via SELKIES_CAPACITY_FILE (cluster/membership.py).
+# ---------------------------------------------------------------------------
+
+# scenario mixes: session i of an N-session ramp plays mix[i % len].
+# "desktop" is the fleet's bread-and-butter tenancy (mostly interactive,
+# one video watcher per four desks); "interactive" is a call-center /
+# thin-client floor (no full-motion rows at all)
+CAPACITY_MIXES = {
+    "desktop": ("typing", "idle", "scroll", "video"),
+    "interactive": ("typing", "window_drag", "idle", "typing"),
+}
+
+# bench scenario names -> SLO_TARGETS vocabulary (policy/classifier.py)
+_SLO_KEY = {"window_drag": "drag"}
+
+
+def bench_capacity(w: int, h: int, frames_per_pass: int, mixes: list[str],
+                   max_sessions: int) -> list[dict]:
+    """One capacity row per (mix, mode): ramp N until the SLO breaks.
+
+    Every N builds a fresh BandedFleetService (bands=1 — one chip per
+    session, the density carve) and free-runs the tick over per-session
+    scenario traces: each tick's wall time is every member session's
+    capture->deliver latency (the tick returns all AUs together), so
+    per-session p95 == tick p95 and the per-session fps floor is the
+    achieved tick rate. N passes while every DISTINCT scenario in the
+    mix meets its p95 ceiling and fps floor; the ramp stops at the
+    first breach and reports the last passing N."""
+    import jax
+
+    from selkies_tpu.parallel.occupancy import OccupancyScheduler
+    from selkies_tpu.parallel.serving import BandedFleetService
+    from selkies_tpu.monitoring.slo import scenario_targets
+
+    chips = len(jax.devices())
+    targets = scenario_targets()
+    rows = []
+    for mix_name in mixes:
+        cycle = CAPACITY_MIXES[mix_name]
+        for mode in ("lockstep", "overlap"):
+            max_ok, ramp = 0, []
+            for n in range(1, max_sessions + 1):
+                scens = [cycle[i % len(cycle)] for i in range(n)]
+                traces = [
+                    _scenario_trace(s, frames_per_pass, w, h, seed=11 + i)
+                    for i, s in enumerate(scens)
+                ]
+                svc = BandedFleetService(n, w, h, bands=1)
+                sched = (OccupancyScheduler.for_service(svc)
+                         if mode == "overlap" else None)
+                tick = svc.encode_tick if sched is None else sched.encode_tick
+                try:
+                    for t in range(min(8, frames_per_pass)):  # settle/compile
+                        tick(np.stack([tr[t] for tr in traces]))
+                    lats = []
+                    t_start = time.perf_counter()
+                    for t in range(frames_per_pass):
+                        t0 = time.perf_counter()
+                        tick(np.stack([tr[t] for tr in traces]))
+                        lats.append((time.perf_counter() - t0) * 1e3)
+                    elapsed = time.perf_counter() - t_start
+                finally:
+                    if sched is not None:
+                        sched.close()
+                    svc.close()
+                fps = frames_per_pass / elapsed
+                p50 = float(np.percentile(lats, 50))
+                p95 = float(np.percentile(lats, 95))
+                ok = all(
+                    p95 <= targets[_SLO_KEY.get(s, s)].p95_ms
+                    and fps >= targets[_SLO_KEY.get(s, s)].fps_floor
+                    for s in set(scens))
+                step = {"sessions": n, "p50_ms": round(p50, 1),
+                        "p95_ms": round(p95, 1), "fps_per_session": round(fps, 2),
+                        "slo_ok": ok}
+                if sched is not None:
+                    step["overlap_ratio"] = sched.stats()["overlap_ratio"]
+                ramp.append(step)
+                if not ok:
+                    break
+                max_ok = n
+            rows.append({
+                "bench": "capacity", "mode": mode, "chips": chips,
+                "codec": "h264", "mix": mix_name,
+                "max_sessions_at_slo": max_ok, "ramp": ramp,
+            })
+    return rows
+
+
 def bench_convert_only() -> float:
     import jax
 
@@ -638,6 +735,22 @@ def main() -> int:
              "classify scan; byte-identical to 0 by the superset "
              "contract (FramePrep.scan)")
     ap.add_argument(
+        "--capacity", nargs="?", const="all", default=None,
+        help="capacity ramp (or a comma mix list: "
+             f"{', '.join(sorted(CAPACITY_MIXES))}): ramp N scenario-mix "
+             "sessions until p95 latency breaches the per-scenario SLO "
+             "targets, lockstep AND occupancy-overlapped, one JSON row "
+             "per (mix, mode) with max_sessions_at_slo — the measured "
+             "capacity curve SELKIES_CAPACITY_FILE feeds to the cluster "
+             "digest. Runs INSTEAD of the flagship row")
+    ap.add_argument(
+        "--capacity-frames", type=int, default=96,
+        help="frames per capacity ramp step (after an 8-frame settle)")
+    ap.add_argument(
+        "--capacity-max", type=int, default=8,
+        help="ramp ceiling: stop raising N at this many sessions even "
+             "if the SLO still holds")
+    ap.add_argument(
         "--codec", default=None,
         help="comma-separated codec sweep (h264,av1,vp9,...): one JSON "
              "line per codec at each --resolution, from the encoder row "
@@ -647,6 +760,25 @@ def main() -> int:
              "absent are skipped with a note")
     args = ap.parse_args()
     _reexec_cpu_if_tunnel_down()
+    if args.capacity:
+        mixes = (sorted(CAPACITY_MIXES)
+                 if args.capacity.strip().lower() == "all"
+                 else [m.strip().lower() for m in args.capacity.split(",")
+                       if m.strip()])
+        for m in mixes:
+            if m not in CAPACITY_MIXES:
+                raise SystemExit(f"unknown capacity mix {m!r} (one of "
+                                 f"{sorted(CAPACITY_MIXES)})")
+        label, w, h = _parse_resolutions(args.resolution or "512x288")[0]
+        for row in bench_capacity(w, h, max(30, args.capacity_frames),
+                                  mixes, max(1, args.capacity_max)):
+            _result(
+                f"capacity {row['codec']} {label} chips={row['chips']} "
+                f"mix={row['mix']} ({row['mode']})",
+                float(row["max_sessions_at_slo"]), unit="sessions@slo",
+                **{k: v for k, v in row.items() if k != "codec"},
+                resolution=label, codec=row["codec"])
+        return 0
     if args.resolution is None:
         import jax
 

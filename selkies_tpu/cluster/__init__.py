@@ -27,9 +27,12 @@ from dataclasses import dataclass, field
 from selkies_tpu.cluster.membership import (
     ClusterNode,
     build_digest,
+    capacity_rows_from_env,
     cluster_enabled,
     cluster_peers_from_env,
     cluster_self_from_env,
+    load_capacity_rows,
+    measured_max_sessions,
 )
 from selkies_tpu.cluster.migrate import (
     HttpMigrationChannel,
@@ -57,9 +60,12 @@ __all__ = [
     "Redirect",
     "build_cluster_plane",
     "build_digest",
+    "capacity_rows_from_env",
     "cluster_enabled",
     "cluster_peers_from_env",
     "cluster_self_from_env",
+    "load_capacity_rows",
+    "measured_max_sessions",
     "migrate_session",
     "migration_stats",
     "parse_redirect",

@@ -57,11 +57,14 @@ logger = logging.getLogger("cluster.membership")
 __all__ = [
     "ClusterNode",
     "build_digest",
+    "capacity_rows_from_env",
     "cluster_enabled",
     "cluster_peers_from_env",
     "cluster_self_from_env",
     "heartbeat_interval_from_env",
     "lease_from_env",
+    "load_capacity_rows",
+    "measured_max_sessions",
     "sign_blob",
     "verify_blob",
 ]
@@ -71,6 +74,7 @@ ENV_SELF = "SELKIES_CLUSTER_SELF"
 ENV_SECRET = "SELKIES_CLUSTER_SECRET"
 ENV_HEARTBEAT = "SELKIES_CLUSTER_HEARTBEAT_S"
 ENV_LEASE = "SELKIES_CLUSTER_LEASE_S"
+ENV_CAPACITY = "SELKIES_CAPACITY_FILE"
 
 
 def cluster_enabled() -> bool:
@@ -130,6 +134,129 @@ def verify_blob(secret: str, body: str, signature: str) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# measured capacity curves (bench.py --capacity)
+# ---------------------------------------------------------------------------
+
+
+def load_capacity_rows(path: str) -> list[dict]:
+    """Parse a ``bench.py --capacity`` record into capacity rows.
+
+    Accepts the bench's native JSON-lines stream, a JSON array, or a
+    driver wrapper dict (the ``BENCH_*.json`` shape, whose row rides in
+    ``parsed``/``tail``). A capacity row is any object carrying a
+    positive ``max_sessions_at_slo``; everything else in the file is
+    ignored, and an unreadable file is an empty curve — the digest then
+    falls back to structural free-slot counts, never an error."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        logger.warning("capacity file %s unreadable; using free slots", path)
+        return []
+    docs: list = []
+    try:
+        docs.append(json.loads(text))
+    except ValueError:
+        for line in text.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    docs.append(json.loads(line))
+                except ValueError:
+                    continue
+    rows: list[dict] = []
+
+    def _walk(obj) -> None:
+        if isinstance(obj, dict):
+            if obj.get("max_sessions_at_slo"):
+                rows.append(obj)
+                return
+            for key in ("parsed", "rows"):
+                _walk(obj.get(key))
+            tail = obj.get("tail")
+            if isinstance(tail, str):
+                for line in tail.splitlines():
+                    if line.startswith("{"):
+                        try:
+                            _walk(json.loads(line))
+                        except ValueError:
+                            continue
+        elif isinstance(obj, list):
+            for item in obj:
+                _walk(item)
+
+    _walk(docs)
+    return rows
+
+
+_capacity_cache: tuple[str, float, list[dict]] | None = None
+
+
+def capacity_rows_from_env() -> list[dict]:
+    """Capacity rows from ``SELKIES_CAPACITY_FILE`` (cached by path and
+    mtime, so a re-run bench is picked up without a restart)."""
+    global _capacity_cache
+    path = os.environ.get(ENV_CAPACITY, "").strip()
+    if not path:
+        return []
+    try:
+        mtime = os.stat(path).st_mtime
+    except OSError:
+        mtime = -1.0
+    if _capacity_cache is not None and _capacity_cache[:2] == (path, mtime):
+        return _capacity_cache[2]
+    rows = load_capacity_rows(path)
+    _capacity_cache = (path, mtime, rows)
+    return rows
+
+
+def measured_max_sessions(rows: list[dict], *, chips: int,
+                          codecs: list[str] | None = None) -> int:
+    """The measured sessions-at-SLO ceiling for a host shape, 0 when
+    the curve has no applicable row (= not measured; callers fall back
+    to structural free-slot counts).
+
+    Selection is conservative: rows must name a codec this host serves;
+    occupancy-mode rows win over lockstep ones when both exist (the
+    production scheduler runs overlapped); an exact chip-count match
+    wins over scaling, otherwise the ceiling scales linearly with the
+    chip ratio (floored, min 1 — capacity curves are near-linear in
+    chips until the host core saturates, PERF.md); and the MIN across
+    scenario mixes is taken, so a host never advertises headroom its
+    worst measured mix can't serve."""
+    served = {str(c).lower() for c in (codecs or ["h264"])}
+    usable = []
+    for row in rows:
+        try:
+            ceiling = int(row.get("max_sessions_at_slo", 0))
+        except (TypeError, ValueError):
+            continue
+        if ceiling <= 0:
+            continue
+        codec = str(row.get("codec", "h264")).lower()
+        if codec not in served:
+            continue
+        usable.append(row)
+    if not usable:
+        return 0
+    overlap = [r for r in usable
+               if str(r.get("mode", "overlap")).lower() != "lockstep"]
+    if overlap:
+        usable = overlap
+    exact = [r for r in usable if int(r.get("chips", 0) or 0) == chips]
+    per_mix: dict[str, int] = {}
+    for row in (exact or usable):
+        ceiling = int(row["max_sessions_at_slo"])
+        row_chips = int(row.get("chips", 0) or 0)
+        if not exact and chips > 0 and row_chips > 0 and row_chips != chips:
+            ceiling = max(1, (ceiling * chips) // row_chips)
+        mix = str(row.get("mix", row.get("metric", "?")))
+        prev = per_mix.get(mix)
+        per_mix[mix] = ceiling if prev is None else min(prev, ceiling)
+    return min(per_mix.values())
+
+
+# ---------------------------------------------------------------------------
 # the capacity digest — ONE derivation for /healthz, /statz, heartbeat
 # ---------------------------------------------------------------------------
 
@@ -137,7 +264,8 @@ def verify_blob(secret: str, body: str, signature: str) -> bool:
 def build_digest(*, host: str = "", drain=None, placer=None,
                  devices_view: dict | None = None,
                  slo_views: dict | None = None,
-                 codecs: list[str] | None = None) -> dict:
+                 codecs: list[str] | None = None,
+                 capacity_rows: list[dict] | None = None) -> dict:
     """The machine-readable capacity/drain summary of one host.
 
     Pure: every source is injected, so two in-process test hosts can
@@ -147,6 +275,11 @@ def build_digest(*, host: str = "", drain=None, placer=None,
     block), ``/statz`` and the cluster heartbeat — additive changes
     only. ``has_placer=False`` marks a host without a placement plane
     (bare solo); the router treats it as one free slot unless draining.
+
+    ``measured_max_sessions`` is the sessions-at-SLO ceiling from this
+    host's measured capacity curve (``bench.py --capacity`` via
+    ``capacity_rows`` or ``SELKIES_CAPACITY_FILE``); 0 means not
+    measured, and routers fall back to the structural ``free_slots``.
     """
     d = {
         "host": host,
@@ -167,6 +300,7 @@ def build_digest(*, host: str = "", drain=None, placer=None,
         "queue": 0,
         "chronic_burn": [],
         "codecs": list(codecs) if codecs is not None else ["h264"],
+        "measured_max_sessions": 0,
     }
     if devices_view:
         d["chips"] = int(devices_view.get("chips", 0))
@@ -205,6 +339,11 @@ def build_digest(*, host: str = "", drain=None, placer=None,
         d["chronic_burn"] = sorted(
             s for s, v in slo_views.items()
             if isinstance(v, dict) and v.get("chronic"))
+    rows = (capacity_rows if capacity_rows is not None
+            else capacity_rows_from_env())
+    if rows:
+        d["measured_max_sessions"] = measured_max_sessions(
+            rows, chips=d["chips"], codecs=d["codecs"])
     return d
 
 

@@ -128,22 +128,43 @@ class ClusterRouter:
         return []
 
     @staticmethod
-    def _has_capacity(digest: dict) -> bool:
+    def _measured_headroom(digest: dict) -> int | None:
+        """Sessions the host's MEASURED capacity curve still admits
+        (``measured_max_sessions`` minus placed sessions), or None when
+        the host reports no curve (fall back to structural slots)."""
+        measured = int(digest.get("measured_max_sessions", 0) or 0)
+        if measured <= 0:
+            return None
+        return max(0, measured - int(digest.get("sessions", 0)))
+
+    @classmethod
+    def _has_capacity(cls, digest: dict) -> bool:
         if digest.get("draining"):
             return False
         if not digest.get("has_placer"):
             # bare solo host: its one session is the whole capacity —
             # `busy` (set by the solo wiring) is its free/full bit
             return int(digest.get("busy", 0)) == 0
+        headroom = cls._measured_headroom(digest)
+        if headroom is not None and headroom <= 0:
+            # the measured sessions-at-SLO ceiling binds even a shared
+            # placer: structurally admissible ≠ servable within SLO
+            return False
         return bool(digest.get("shared")) or int(
             digest.get("free_slots", 0)) > 0
 
-    @staticmethod
-    def score(digest: dict, prefs: list[str]) -> float:
-        """Higher is better. Free slots up, chronic SLO burn and
-        quarantined chips down, small bonus for serving the client's
-        top codec preference natively."""
+    @classmethod
+    def score(cls, digest: dict, prefs: list[str]) -> float:
+        """Higher is better. Free slots up — clamped to the measured
+        sessions-at-SLO headroom when the host ships a capacity curve
+        (a shared placer's headroom replaces its slot count outright) —
+        chronic SLO burn and quarantined chips down, small bonus for
+        serving the client's top codec preference natively."""
         s = float(digest.get("free_slots", 0))
+        headroom = cls._measured_headroom(digest)
+        if headroom is not None:
+            s = float(headroom) if digest.get("shared") else min(
+                s, float(headroom))
         if not digest.get("has_placer"):
             s = 0.0 if digest.get("busy") else 1.0
         s -= _W_CHRONIC * len(digest.get("chronic_burn") or ())

@@ -88,6 +88,10 @@ STAGE_BUCKET_LADDERS: dict[str, tuple[float, ...]] = {
     "h2d": STAGE_BUCKETS_SUBMS,
     "unpack": STAGE_BUCKETS_SUBMS,
     "bits_fetch": STAGE_BUCKETS_SUBMS,
+    # occupancy dispatch-lane wait (parallel/occupancy.py): how long a
+    # session's dispatch sat behind earlier sessions this tick — sub-ms
+    # when the lane keeps up, milliseconds when a front-end hogs it
+    "sched_wait": STAGE_BUCKETS_SUBMS,
 }
 
 # Every family this bus can emit, name -> help string. The names are the
@@ -211,6 +215,11 @@ METRIC_FAMILIES: dict[str, str] = {
         "Cross-host live migrations, labeled by direction (out/in) and "
         "result (ok/fail) — an `out` failure leaves the session serving "
         "on the source",
+    "selkies_occupancy_overlap_ratio":
+        "Fraction of the tick's serialized per-session stage time hidden "
+        "by the occupancy scheduler's overlap (parallel/occupancy.py): "
+        "0 = fully serial, approaching 1-1/N when N equal sessions "
+        "overlap perfectly; 1 - wall / sum(stage time) per tick",
 }
 
 # canonical label names per family (order fixed for the Prometheus
@@ -252,6 +261,7 @@ _FAMILY_LABELS: dict[str, tuple[str, ...]] = {
     "selkies_cluster_heartbeats_total": ("peer", "result"),
     "selkies_cluster_redirects_total": ("reason",),
     "selkies_cluster_migrations_total": ("direction", "result"),
+    "selkies_occupancy_overlap_ratio": (),
 }
 
 _HIST_BUCKETS: dict[str, tuple[float, ...]] = {

@@ -99,6 +99,13 @@ black-box bundles stay greppable):
     convert       per-session BGRx→I420 on the pack pool
     device-step   sharded batch encode dispatch
     fetch / pack  batch downlink and concurrent per-session packs
+  occupancy scheduler (parallel/occupancy.py):
+    sched_wait    selkies_stage_ms stage only (no tracer span — it is a
+                  wait, not work): how long a session's dispatch sat
+                  behind earlier sessions on the scheduler's dispatch
+                  lane this tick, per session. Sub-ms while the lane
+                  keeps up; a session whose front-end hogs the lane
+                  shows up as ITS SUCCESSORS' sched_wait growing
   fleet lifecycle (parallel/lifecycle.py):
     admit         one admission-control decision (accept/queue/reject)
     recarve       a dynamic re-carve transition (borrow or return of

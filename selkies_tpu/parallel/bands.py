@@ -574,6 +574,21 @@ from selkies_tpu.models.h264.sparse_complete import (
 )
 
 
+class _PendingFrame:
+    """In-flight state between ``dispatch_frame`` and ``complete_frame``:
+    the per-band device handles of a dispatched (unfetched) step plus the
+    GOP/QP snapshots the completion must pack against. A static frame
+    short-circuits at dispatch and carries its host-built AU here."""
+
+    __slots__ = ("idr", "static_au", "static_stats", "qp", "frame_num",
+                 "idr_pic_id", "pfx_h", "full_h", "buf_h", "t0", "t_up",
+                 "classify_ms", "convert_ms", "h2d_ms")
+
+    def __init__(self, *, idr: bool, static_au: bytes | None = None):
+        self.idr = idr
+        self.static_au = static_au
+
+
 class BandedH264Encoder:
     """Full-frame band/tile-parallel H.264 encoder: frame in, multi-slice
     Annex-B access unit out.
@@ -826,6 +841,9 @@ class BandedH264Encoder:
         self._idr_pic_id = 0
         self._force_idr = True
         self.last_stats: FrameStats | None = None
+        # dispatch/complete split guard (occupancy scheduler): at most
+        # one frame in flight — self._ref advances at dispatch
+        self._inflight = False
 
     # -- live retune API ------------------------------------------------
 
@@ -989,7 +1007,29 @@ class BandedH264Encoder:
 
         ``damage``: optional capture-layer dirty-rect hints (superset
         contract, FramePrep.scan) bounding the static-detection scan —
-        an idle tick with a tight hint stops reading the whole frame."""
+        an idle tick with a tight hint stops reading the whole frame.
+
+        Composed of :meth:`dispatch_frame` + :meth:`complete_frame` —
+        the occupancy scheduler's split (parallel/occupancy.py) — so the
+        overlapped path is byte-identical to this one by construction."""
+        return self.complete_frame(self.dispatch_frame(frame, qp,
+                                                       damage=damage))
+
+    def dispatch_frame(self, frame: np.ndarray, qp: int | None = None,
+                       damage=None) -> "_PendingFrame":
+        """Front half of :meth:`encode_frame`: host front-end (fused
+        dirty scan, BGRx->I420 conversion, h2d upload) plus the ASYNC
+        device step dispatch. Returns a pending token whose downlink has
+        been enqueued on the chips but not fetched — the caller's thread
+        is free while the device steps (jax dispatch returns before the
+        chips finish). Exactly one frame may be in flight per encoder:
+        the reference-plane donation chain (``self._ref``) advances at
+        dispatch, so a second dispatch before ``complete_frame`` would
+        step against a recon the client never received."""
+        if self._inflight:
+            raise RuntimeError(
+                "dispatch_frame while a frame is in flight — "
+                "complete_frame the previous token first")
         if qp is not None:
             self.set_qp(qp)
         # deterministic device chaos (resilience/devhealth.py): a
@@ -1015,7 +1055,7 @@ class BandedH264Encoder:
         classify_ms = (time.perf_counter() - t0) * 1e3
         if static:
             au = self._allskip_au(self._frames_since_idr % 256)
-            self.last_stats = FrameStats(
+            stats = FrameStats(
                 frame_index=self.frame_index, idr=False, qp=self.qp,
                 bytes=len(au), device_ms=(time.perf_counter() - t0) * 1e3,
                 pack_ms=0.0, skipped_mbs=self._mbh * self._mbw,
@@ -1023,9 +1063,10 @@ class BandedH264Encoder:
                 upload_ms=classify_ms, classify_ms=classify_ms,
                 upload_kind="static",
             )
-            self.frame_index += 1
-            self._frames_since_idr += 1
-            return au
+            pending = _PendingFrame(idr=False, static_au=au)
+            pending.static_stats = stats
+            self._inflight = True
+            return pending
         t_c0 = time.perf_counter()
         y, u, v = self._prep.convert(frame)
         t_h0 = time.perf_counter()
@@ -1059,15 +1100,47 @@ class BandedH264Encoder:
                 pfx = prefix_d[:, :, :hint]
             else:
                 pfx = prefix_d[:, :hint]
-        pfx_h = self._band_handles(pfx)
-        full_h = self._band_handles(prefix_d)
-        buf_h = self._band_handles(buf_d)
+        pending = _PendingFrame(idr=idr)
+        pending.pfx_h = self._band_handles(pfx)
+        pending.full_h = self._band_handles(prefix_d)
+        pending.buf_h = self._band_handles(buf_d)
+        # GOP/QP snapshots: complete_frame must pack against the state
+        # this frame was DISPATCHED under, even if a policy set_qp or a
+        # force_keyframe lands between the halves on the scheduler
+        pending.qp = self.qp
+        pending.frame_num = self._frames_since_idr % 256
+        pending.idr_pic_id = self._idr_pic_id
+        pending.t0, pending.t_up = t0, t_up
+        pending.classify_ms = classify_ms
+        pending.convert_ms, pending.h2d_ms = convert_ms, h2d_ms
+        self._inflight = True
+        return pending
+
+    def complete_frame(self, pending: "_PendingFrame") -> bytes:
+        """Back half of :meth:`encode_frame`: per-band downlink fetch +
+        host unpack/CAVLC pack fan-out, stats assembly, and the GOP
+        state advance. Blocks until the dispatched step's outputs are
+        ready — this is where the device wait lives, so the occupancy
+        scheduler runs it on a completion worker while the caller's
+        thread dispatches the next session."""
+        self._inflight = False
+        if pending.static_au is not None:
+            self.last_stats = pending.static_stats
+            self.frame_index += 1
+            self._frames_since_idr += 1
+            return pending.static_au
+        idr = pending.idr
+        pfx_h, full_h, buf_h = pending.pfx_h, pending.full_h, pending.buf_h
+        t0, t_up = pending.t0, pending.t_up
+        classify_ms = pending.classify_ms
+        convert_ms, h2d_ms = pending.convert_ms, pending.h2d_ms
+
         def _one(b: int):
             if idr:
                 return self._complete_band_i(b, pfx_h[b], buf_h[b],
-                                             self._idr_pic_id)
+                                             pending.idr_pic_id)
             return self._complete_band_p(b, pfx_h[b], full_h[b], buf_h[b],
-                                         self._frames_since_idr % 256, self.qp)
+                                         pending.frame_num, pending.qp)
 
         # per-band step timing: ready time of each band's downlink on its
         # chip (the profile tool and bench read band_step_ms off stats).
@@ -1124,7 +1197,7 @@ class BandedH264Encoder:
             for ms in band_step:
                 telemetry.stage_ms("step", ms)
         stats = FrameStats(
-            frame_index=self.frame_index, idr=idr, qp=self.qp,
+            frame_index=self.frame_index, idr=idr, qp=pending.qp,
             bytes=len(au), device_ms=(t_fetched - t0) * 1e3,
             pack_ms=unpack_ms + cavlc_ms, skipped_mbs=skipped,
             unpack_ms=unpack_ms, cavlc_ms=cavlc_ms,

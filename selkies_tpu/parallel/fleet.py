@@ -250,6 +250,11 @@ class SessionFleet:
                 self.n, width, height, qp=qp, fps=self.base_fps, devices=devices)
         self.service = service or self._make_tpu_service()
         self.software_mode = False
+        # occupancy scheduler bound to the live service (built lazily on
+        # the first tick; rebuilt when a restart swaps the service)
+        self._occ = None
+        self._occ_service = None
+        telemetry.register_provider("occupancy", self._occupancy_stats)
         self.sources = sources or [
             SyntheticSource(width, height, seed=k) for k in range(self.n)]
         # zero-initialized, not np.empty: a slot whose FIRST capture fails
@@ -673,6 +678,10 @@ class SessionFleet:
                 except asyncio.CancelledError:
                     pass
                 setattr(self, attr, None)
+        if self._occ is not None:
+            self._occ.close()
+            self._occ = None
+            self._occ_service = None
         self.service.close()
 
     # -- recovery ladder plumbing (called via _FleetRecovery) ----------
@@ -819,7 +828,16 @@ class SessionFleet:
         qps = [slot.rc.frame_qp() for slot in self.slots]
         for k, qp in enumerate(qps):
             service.set_qp(k, qp)
-        aus = service.encode_tick(self._batch)
+        # overlapped occupancy scheduling (parallel/occupancy.py): same
+        # per-session bytes, session A's host front-end/pack overlapping
+        # session B's device step. SELKIES_OCCUPANCY=0 (or a service
+        # with no schedulable shape — the software fallback) takes the
+        # serial lockstep tick.
+        occ = self._occupancy_for(service)
+        if occ is not None:
+            aus = occ.encode_tick(self._batch)
+        else:
+            aus = service.encode_tick(self._batch)
         # per-session downlink modes from the SAME service instance (the
         # swap-safety rule above); stashed rather than returned so the
         # tuple callers keep their shape
@@ -841,6 +859,32 @@ class SessionFleet:
                                 interval_ms=1000.0 / max(1.0, self.fps))
         return (aus, list(service.last_idrs), qps,
                 (time.perf_counter() - t0) * 1e3)
+
+    def _occupancy_for(self, service):
+        """The occupancy scheduler bound to ``service``, built lazily and
+        rebuilt when a supervisor restart swaps the service instance
+        (re-carves mutate the encoders list in place — the scheduler's
+        units resolve encoders lazily, so no rebuild is needed there).
+        None when SELKIES_OCCUPANCY=0 or the service has no schedulable
+        shape (software fallback, test fakes)."""
+        from selkies_tpu.parallel.occupancy import (
+            OccupancyScheduler, occupancy_enabled)
+
+        if not occupancy_enabled():
+            return None
+        if self._occ is None or self._occ_service is not service:
+            if self._occ is not None:
+                self._occ.close()
+            self._occ = OccupancyScheduler.for_service(service)
+            self._occ_service = service
+        return self._occ
+
+    def _occupancy_stats(self) -> dict:
+        from selkies_tpu.parallel.occupancy import occupancy_enabled
+
+        if self._occ is None:
+            return {"enabled": occupancy_enabled(), "ticks": 0}
+        return self._occ.stats()
 
     def _note_capture_failures(self, failed: list[tuple[int, Exception]]) -> None:
         """Per-slot capture accounting: transient faults ride on the slot's

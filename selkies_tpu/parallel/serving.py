@@ -103,14 +103,25 @@ class MultiSessionH264Service:
         self.sessions[session].force_idr = True
 
     def encode_tick(self, frames: np.ndarray) -> list[bytes]:
-        """(N, H, W, 4) BGRx batch -> one Annex-B access unit per session."""
+        """(N, H, W, 4) BGRx batch -> one Annex-B access unit per session.
+
+        Composed of :meth:`dispatch_tick` + :meth:`complete_tick` — the
+        occupancy scheduler's split (parallel/occupancy.py) — so the
+        overlapped path is byte-identical to this one by construction."""
+        return self.complete_tick(self.dispatch_tick(frames))
+
+    def dispatch_tick(self, frames: np.ndarray) -> tuple:
+        """Front half of :meth:`encode_tick`: per-session host conversion
+        plus the ASYNC sharded device step dispatch. The returned token
+        holds unfetched device arrays — the chips are stepping while the
+        caller's thread moves on (jax dispatch returns before the step
+        completes); :meth:`complete_tick` fetches and packs."""
         if frames.shape[0] != self.n:
             raise ValueError(f"expected {self.n} frames, got {frames.shape[0]}")
         check_device_faults(self.devices)
         idrs = np.array(
             [s.force_idr or s.frames_since_idr == 0 for s in self.sessions], bool
         )
-        qps = np.array([s.qp for s in self.sessions], np.int32)
         # concurrent per-session host conversion (native frameprep)
         def _convert_into(i: int) -> None:
             y, u, v = self._preps[i].convert(frames[i])
@@ -121,6 +132,7 @@ class MultiSessionH264Service:
         with tracer.span("convert"):
             list(self._pool.map(_convert_into, range(self.n)))
         batch = (self._batch_y, self._batch_u, self._batch_v)
+        qps = np.array([s.qp for s in self.sessions], np.int32)
         with tracer.span("device-step"):
             if self.enc._ref is None:
                 # first tick: no reference planes exist, everyone starts a GOP
@@ -128,6 +140,13 @@ class MultiSessionH264Service:
                 out = self.enc.encode_idr(batch, qps)
             else:
                 out = self.enc.encode_mixed(batch, qps, idrs)
+        return (out, idrs)
+
+    def complete_tick(self, pending: tuple) -> list[bytes]:
+        """Back half of :meth:`encode_tick`: coefficient fetch (this is
+        where the device wait lives), concurrent per-session CAVLC pack,
+        and the GOP state advance."""
+        out, idrs = pending
         # fetch the coefficient batch once, then pack per session in
         # parallel (independent streams). Branch-filler fields are
         # skipped when no session took that branch — the all-zero

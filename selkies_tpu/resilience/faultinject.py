@@ -15,6 +15,7 @@ Grammar (semicolon-separated rules)::
            | policy                                     (scenario policy)
            | device                                     (chip health plane)
            | cluster                                    (multi-host plane)
+           | sched                                      (occupancy scheduler)
            (wired sites; names are free-form)
     sched  = tick list / ranges  "5,9,13" or "20-22" or "5,9,20-22"
            | "every:N"           every Nth call (1-based)
@@ -61,7 +62,15 @@ eating the drain deadline, ``raise``/``drop`` = mid-migration peer
 death — the source keeps serving the session); ``cluster:redirect``
 fires where the signalling server SENDS a redirect record (``drop`` =
 redirect lost in flight — the client's reconnect loop retries and the
-next HELLO re-routes) (tests/test_cluster.py).
+next HELLO re-routes) (tests/test_cluster.py). ``sched:<k>`` fires in
+the occupancy scheduler (parallel/occupancy.py) per session per tick,
+at the scheduling decision before session ``k``'s stage dispatches:
+``drop`` skips that session's dispatch for the tick (the frame is never
+encoded; later frames still deliver in order), ``delay:<ms>`` wedges
+that session's own completion lane while every other session's pipeline
+keeps flowing, and ``raise`` fails the session — the scheduler finishes
+the other sessions' stages before re-raising, preserving the serial
+tick's failure semantics (tests/test_occupancy.py).
 
 Examples::
 

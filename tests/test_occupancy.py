@@ -1,0 +1,563 @@
+"""Occupancy scheduler (parallel/occupancy.py): byte identity vs the
+serial lockstep oracle, mixed tenancy on one chip, wedged-session
+isolation, seeded sched:<k> chaos, and the measured capacity curve's
+path into the cluster digest/router.
+
+The byte contract under test is the tentpole's whole safety story:
+overlap-on AU streams must be sha256-identical per session to the
+serial tick, because dispatch+complete IS encode_frame split at the
+device-handle seam (jax async dispatch) and sessions share no state.
+"""
+
+import hashlib
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from selkies_tpu.parallel.occupancy import (
+    MixedTenancyService,
+    OccupancyScheduler,
+    occupancy_enabled,
+)
+from selkies_tpu.parallel.serving import (
+    BandedFleetService,
+    MultiSessionH264Service,
+    SoftwareFleetService,
+)
+from selkies_tpu.resilience import InjectedFault, configure_faults, reset_faults
+
+W, H = 192, 128  # MB-aligned tiny geometry (matches tests/test_fleet.py)
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def faults():
+    """Install a fault schedule for one test; ALWAYS clears it after."""
+    yield configure_faults
+    reset_faults()
+
+
+def _traces(n: int, frames: int, w: int = W, h: int = H,
+            seed: int = 3) -> list[list[np.ndarray]]:
+    """Mixed per-session content, deterministic: each session updates a
+    different 16-row band on its own cadence and REPEATS frames in
+    between — so the ramp covers IDR, P-delta and the static
+    short-circuit (the three paths the dispatch/complete split must
+    keep byte-identical)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for s in range(n):
+        cur = np.full((h, w, 4), 150 + 17 * s, np.uint8)
+        frs = []
+        for t in range(frames):
+            if (t + s) % 3 != 2:  # two busy frames, then a static repeat
+                cur = cur.copy()
+                row = 16 * ((t + 2 * s) % (h // 16))
+                cur[row : row + 16] = rng.integers(0, 255, (16, w, 4),
+                                                  np.uint8)
+            frs.append(cur)
+        out.append(frs)
+    return out
+
+
+def _drive(tick, traces, ticks=None):
+    """Run `tick` over the traces; returns per-session AU lists."""
+    n, frames = len(traces), len(traces[0])
+    streams = [[] for _ in range(n)]
+    for t in ticks if ticks is not None else range(frames):
+        aus = tick(np.stack([tr[t] for tr in traces]))
+        for s in range(n):
+            streams[s].append(aus[s])
+    return streams
+
+
+def _sha(stream: list[bytes]) -> str:
+    return hashlib.sha256(b"".join(stream)).hexdigest()
+
+
+# -- byte identity -----------------------------------------------------------
+
+
+def test_overlap_streams_sha256_identical_to_serial():
+    """The headline contract: per-session sha256 of the overlapped AU
+    stream equals the serial lockstep oracle's, over a mixed trace that
+    hits IDR, P and static paths — and the bookkeeping mirrors too."""
+    if len(jax.devices()) < 3:
+        pytest.skip("needs >=3 devices (virtual CPU mesh)")
+    n, frames = 3, 6
+    traces = _traces(n, frames)
+
+    svc = BandedFleetService(n, W, H, bands=1)
+    sched = OccupancyScheduler.for_service(svc)
+    try:
+        got = _drive(sched.encode_tick, traces)
+        got_idrs = list(svc.last_idrs)
+        st = sched.stats()
+    finally:
+        sched.close()
+        svc.close()
+
+    oracle = BandedFleetService(n, W, H, bands=1)
+    try:
+        want = _drive(oracle.encode_tick, traces)
+        want_idrs = list(oracle.last_idrs)
+    finally:
+        oracle.close()
+
+    for s in range(n):
+        assert _sha(got[s]) == _sha(want[s]), f"session {s} diverged"
+    assert got_idrs == want_idrs
+    assert st["ticks"] == frames
+    assert 0.0 <= st["overlap_ratio"] < 1.0
+    assert set(st["sched_wait_ms"]) == {str(s) for s in range(n)}
+
+
+@pytest.mark.slow
+def test_batch_pipeline_identical_to_serial_lockstep():
+    """A lockstep batch group schedules as ONE unit; its streams must
+    still match the plain encode_tick byte-for-byte. (slow: two extra
+    sharded-service compiles; the mixed-tenancy test drives
+    BatchPipeline in tier-1.)"""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 devices (virtual CPU mesh)")
+    n, frames = 2, 6
+    traces = _traces(n, frames, seed=9)
+
+    svc = MultiSessionH264Service(n, W, H, qp=26)
+    sched = OccupancyScheduler.for_service(svc)
+    try:
+        got = _drive(sched.encode_tick, traces)
+    finally:
+        sched.close()
+        svc.close()
+
+    oracle = MultiSessionH264Service(n, W, H, qp=26)
+    try:
+        want = _drive(oracle.encode_tick, traces)
+    finally:
+        oracle.close()
+    assert [_sha(s) for s in got] == [_sha(s) for s in want]
+
+
+def test_mixed_tenancy_on_one_chip_matches_serial(monkeypatch):
+    """Banded + batch sessions sharing chip 0's timeline: the occupancy
+    path and the SELKIES_OCCUPANCY=0 serial fallback must produce
+    identical per-session bytes."""
+    dev = jax.devices()[0]
+    frames = 5
+    traces = _traces(2, frames, seed=5)
+
+    def build():
+        batch = MultiSessionH264Service(1, W, H, qp=26, devices=[dev])
+        banded = BandedFleetService(1, W, H, bands=1, rows=[[dev]])
+        return MixedTenancyService(batch, banded)
+
+    monkeypatch.setenv("SELKIES_OCCUPANCY", "1")
+    svc = build()
+    try:
+        got = _drive(svc.encode_tick, traces)
+        assert svc.scheduler() is not None, "occupancy path not taken"
+        assert len(svc.last_idrs) == 2 and len(svc.last_modes) == 2
+    finally:
+        svc.close()
+
+    monkeypatch.setenv("SELKIES_OCCUPANCY", "0")
+    oracle = build()
+    try:
+        want = _drive(oracle.encode_tick, traces)
+        assert oracle.scheduler() is None, "oracle must stay serial"
+    finally:
+        oracle.close()
+    assert [_sha(s) for s in got] == [_sha(s) for s in want]
+
+
+# -- isolation ---------------------------------------------------------------
+
+
+def test_wedged_session_does_not_stall_others():
+    """Session 0's completion wedges mid-tick; session 1's completion
+    must still run to the end while 0 is stuck. Deterministic: 0 is
+    only released AFTER 1 demonstrably finished — a scheduler that
+    serialized completions behind the wedge would deadlock (timeout)."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 devices (virtual CPU mesh)")
+    traces = _traces(2, 3, seed=7)
+    svc = BandedFleetService(2, W, H, bands=1)
+    sched = OccupancyScheduler.for_service(svc)
+    try:
+        _drive(sched.encode_tick, traces, ticks=range(2))  # warm
+        done1 = threading.Event()
+        enc0, enc1 = svc.encoders[0], svc.encoders[1]
+        orig0, orig1 = enc0.complete_frame, enc1.complete_frame
+
+        def wedged(pending):
+            assert done1.wait(timeout=30), \
+                "session 1 never completed while session 0 was wedged"
+            return orig0(pending)
+
+        def observed(pending):
+            out = orig1(pending)
+            done1.set()
+            return out
+
+        enc0.complete_frame = wedged
+        enc1.complete_frame = observed
+        aus = sched.encode_tick(np.stack([tr[2] for tr in traces]))
+        assert done1.is_set()
+        assert aus[0] and aus[1]  # the wedged frame still delivered
+    finally:
+        sched.close()
+        svc.close()
+
+
+def test_sched_drop_keeps_streams_in_order_no_bleed(faults):
+    """sched:0 drop at tick 2: session 0's tick-2 frame is never
+    encoded (empty AU), its LATER frames equal an oracle that never saw
+    that frame, and session 1's stream is untouched — in-order
+    delivery, zero cross-session bleed."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 devices (virtual CPU mesh)")
+    frames = 4
+    traces = _traces(2, frames, seed=11)
+    fi = faults("sched:0@2:drop")
+    svc = BandedFleetService(2, W, H, bands=1)
+    sched = OccupancyScheduler.for_service(svc)
+    try:
+        got = _drive(sched.encode_tick, traces)
+    finally:
+        sched.close()
+        svc.close()
+    reset_faults()
+    assert ("sched:0", 2, "drop") in fi.injected
+    assert got[0][1] == b""  # the dropped tick delivered nothing
+
+    oracle0 = BandedFleetService(1, W, H, bands=1)
+    try:  # session 0's oracle never sees the dropped frame
+        want0 = [oracle0.encode_tick(traces[0][t][None])[0]
+                 for t in (0, 2, 3)]
+    finally:
+        oracle0.close()
+    assert [got[0][0], got[0][2], got[0][3]] == want0
+
+    oracle1 = BandedFleetService(1, W, H, bands=1)
+    try:
+        want1 = [oracle1.encode_tick(traces[1][t][None])[0]
+                 for t in range(frames)]
+    finally:
+        oracle1.close()
+    assert got[1] == want1
+
+
+def test_sched_raise_serial_parity(faults):
+    """sched:1 raise at tick 2: the tick re-raises InjectedFault (the
+    supervisor ladder's contract), but session 0's stages still ran —
+    its GOP advanced — and BOTH sessions' later streams line up with
+    their oracles."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 devices (virtual CPU mesh)")
+    frames = 4
+    traces = _traces(2, frames, seed=13)
+    faults("sched:1@2:raise")
+    svc = BandedFleetService(2, W, H, bands=1)
+    sched = OccupancyScheduler.for_service(svc)
+    got = {0: [], 1: []}
+    try:
+        for t in range(frames):
+            batch = np.stack([tr[t] for tr in traces])
+            if t == 1:
+                with pytest.raises(InjectedFault):
+                    sched.encode_tick(batch)
+                assert sched.stats()["errors"], "error must surface in stats"
+                continue
+            aus = sched.encode_tick(batch)
+            got[0].append(aus[0])
+            got[1].append(aus[1])
+    finally:
+        sched.close()
+        svc.close()
+    reset_faults()
+
+    # session 0 encoded EVERY frame (its tick-2 AU was just lost to the
+    # caller); session 1 never encoded the failed frame
+    oracle0 = BandedFleetService(1, W, H, bands=1)
+    try:
+        all0 = [oracle0.encode_tick(traces[0][t][None])[0]
+                for t in range(frames)]
+    finally:
+        oracle0.close()
+    assert got[0] == [all0[0], all0[2], all0[3]]
+
+    oracle1 = BandedFleetService(1, W, H, bands=1)
+    try:
+        want1 = [oracle1.encode_tick(traces[1][t][None])[0]
+                 for t in (0, 2, 3)]
+    finally:
+        oracle1.close()
+    assert got[1] == want1
+
+
+# -- scheduler shape / knobs -------------------------------------------------
+
+
+def test_for_service_shapes():
+    sw = SoftwareFleetService.__new__(SoftwareFleetService)  # no x264 needed
+    assert OccupancyScheduler.for_service(sw) is None
+    assert OccupancyScheduler.for_service(object()) is None
+
+
+def test_occupancy_env_switch(monkeypatch):
+    monkeypatch.delenv("SELKIES_OCCUPANCY", raising=False)
+    assert occupancy_enabled()
+    for off in ("0", "false", "OFF", "no"):
+        monkeypatch.setenv("SELKIES_OCCUPANCY", off)
+        assert not occupancy_enabled()
+    monkeypatch.setenv("SELKIES_OCCUPANCY", "1")
+    assert occupancy_enabled()
+
+
+def test_dispatch_inflight_guard():
+    """The banded encoder holds at most one frame in flight: dispatch
+    without complete must refuse a second dispatch (reference planes
+    were already donated forward)."""
+    from selkies_tpu.parallel.bands import BandedH264Encoder
+
+    enc = BandedH264Encoder(W, H, qp=28, bands=1)
+    try:
+        frame = np.full((H, W, 4), 128, np.uint8)
+        pending = enc.dispatch_frame(frame)
+        with pytest.raises(RuntimeError, match="in flight"):
+            enc.dispatch_frame(frame)
+        enc.complete_frame(pending)
+        enc.dispatch_frame(frame)  # guard clears after complete
+    finally:
+        enc.close()
+
+
+# -- docs / grammar / rendering ratchets -------------------------------------
+
+
+def test_sched_fault_site_documented():
+    """Grammar sync: the sched site exists in faultinject's grammar doc
+    AND docs/resilience.md (the cluster-site precedent)."""
+    import selkies_tpu.resilience.faultinject as fi
+
+    assert "sched" in fi.__doc__ and "sched:<k>" in fi.__doc__
+    with open(os.path.join(REPO, "docs", "resilience.md")) as f:
+        doc = f.read()
+    assert "sched:<k>" in doc
+
+
+def test_overlap_metric_family_documented():
+    from selkies_tpu.monitoring.telemetry import (
+        METRIC_FAMILIES, STAGE_BUCKET_LADDERS)
+
+    assert "selkies_occupancy_overlap_ratio" in METRIC_FAMILIES
+    assert "sched_wait" in STAGE_BUCKET_LADDERS
+    with open(os.path.join(REPO, "docs", "observability.md")) as f:
+        doc = f.read()
+    assert "selkies_occupancy_overlap_ratio" in doc and "sched_wait" in doc
+
+
+def test_statz_renders_occupancy_block():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "statz", os.path.join(REPO, "tools", "statz.py"))
+    statz = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(statz)
+    rollup = {
+        "enabled": True, "uptime_s": 5.0,
+        "providers": {"occupancy": {
+            "enabled": True, "units": 3, "sessions": 3, "ticks": 42,
+            "overlap_ratio": 0.31, "last_overlap": 0.28,
+            "sched_wait_ms": {"0": 0.0, "1": 1.2, "2": 2.4},
+            "errors": {"2": "InjectedFault('boom')"},
+        }},
+    }
+    text = statz.render(rollup, [])
+    assert "occupancy" in text and "overlap" in text
+    assert "1.2" in text or "1.20" in text
+    assert "InjectedFault" in text
+
+
+# -- measured capacity curve -> digest -> router -----------------------------
+
+CAP_ROWS = [
+    {"bench": "capacity", "mode": "overlap", "chips": 8, "codec": "h264",
+     "mix": "desktop", "max_sessions_at_slo": 6},
+    {"bench": "capacity", "mode": "overlap", "chips": 8, "codec": "h264",
+     "mix": "interactive", "max_sessions_at_slo": 9},
+    {"bench": "capacity", "mode": "lockstep", "chips": 8, "codec": "h264",
+     "mix": "desktop", "max_sessions_at_slo": 4},
+    {"bench": "capacity", "mode": "overlap", "chips": 8, "codec": "av1",
+     "mix": "desktop", "max_sessions_at_slo": 3},
+]
+
+
+def test_measured_max_sessions_selection():
+    from selkies_tpu.cluster.membership import measured_max_sessions
+
+    # overlap rows preferred over lockstep; MIN across mixes
+    assert measured_max_sessions(CAP_ROWS, chips=8, codecs=["h264"]) == 6
+    # codec must match what the host serves
+    assert measured_max_sessions(CAP_ROWS, chips=8, codecs=["av1"]) == 3
+    assert measured_max_sessions(CAP_ROWS, chips=8, codecs=["vp9"]) == 0
+    # no exact chip row: scale by chip ratio, floored
+    assert measured_max_sessions(CAP_ROWS, chips=4, codecs=["h264"]) == 3
+    assert measured_max_sessions([], chips=8, codecs=["h264"]) == 0
+
+
+def test_capacity_file_loader(tmp_path):
+    from selkies_tpu.cluster.membership import load_capacity_rows
+
+    # bench-native JSON lines
+    p = tmp_path / "cap.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in CAP_ROWS) + "\n")
+    assert len(load_capacity_rows(str(p))) == len(CAP_ROWS)
+    # driver wrapper dict: rows ride in parsed/tail
+    p2 = tmp_path / "wrap.json"
+    p2.write_text(json.dumps({
+        "n": 1, "parsed": CAP_ROWS[0],
+        "tail": "noise\n" + json.dumps(CAP_ROWS[1])}))
+    assert len(load_capacity_rows(str(p2))) == 2
+    # unreadable file is an empty curve, not an error
+    assert load_capacity_rows(str(tmp_path / "missing.json")) == []
+
+
+def test_build_digest_measured_max_sessions(tmp_path, monkeypatch):
+    from selkies_tpu.cluster.membership import build_digest
+
+    d = build_digest(capacity_rows=CAP_ROWS)
+    # chips=0 in a bare digest: no exact row, scaling disabled -> min of
+    # the overlap h264 mixes as-is
+    assert d["measured_max_sessions"] == 6
+    assert build_digest(capacity_rows=[])["measured_max_sessions"] == 0
+
+    # the env-file path feeds the same selection
+    p = tmp_path / "cap.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in CAP_ROWS) + "\n")
+    monkeypatch.setenv("SELKIES_CAPACITY_FILE", str(p))
+    import selkies_tpu.cluster.membership as m
+
+    m._capacity_cache = None  # defeat the mtime cache for the test
+    try:
+        assert build_digest()["measured_max_sessions"] == 6
+    finally:
+        m._capacity_cache = None
+
+
+def test_router_prefers_measured_headroom():
+    from selkies_tpu.cluster.router import ClusterRouter
+
+    # at the measured ceiling: no capacity, even though shared would
+    # structurally admit more
+    full = {"has_placer": True, "shared": True, "free_slots": 0,
+            "sessions": 6, "measured_max_sessions": 6}
+    assert not ClusterRouter._has_capacity(full)
+    open_ = dict(full, sessions=3)
+    assert ClusterRouter._has_capacity(open_)
+    # shared: measured headroom replaces the (structural) slot count
+    assert ClusterRouter.score(open_, []) == pytest.approx(3.0)
+    # non-shared: clamped to min(free_slots, headroom)
+    ns = {"has_placer": True, "shared": False, "free_slots": 5,
+          "sessions": 4, "measured_max_sessions": 6}
+    assert ClusterRouter.score(ns, []) == pytest.approx(2.0)
+    # unmeasured digests keep the pre-curve behavior exactly
+    legacy = {"has_placer": True, "shared": False, "free_slots": 5}
+    assert ClusterRouter.score(legacy, []) == pytest.approx(5.0)
+    assert ClusterRouter._measured_headroom(legacy) is None
+
+
+def test_router_best_picks_measured_headroom_host():
+    """Two structurally identical peers: the one with measured headroom
+    left must win _best; the one at its measured ceiling is ineligible."""
+    from selkies_tpu.cluster.membership import ClusterNode
+    from selkies_tpu.cluster.router import ClusterRouter
+
+    node = ClusterNode("http://self:1", [], heartbeat_s=1.0)
+    digest = {"draining": False, "has_placer": True, "shared": False,
+              "free_slots": 3, "sessions": 5, "busy": 5, "queue": 0,
+              "chronic_burn": [], "quarantined_chips": 0,
+              "codecs": ["h264"]}
+    at_ceiling = dict(digest, measured_max_sessions=5)
+    headroom = dict(digest, measured_max_sessions=7)
+    for host, dg in (("http://a:1", at_ceiling), ("http://b:1", headroom)):
+        body = json.dumps({"host": host, "seq": 1, "boot": "x",
+                           "digest": dg})
+        assert node.receive(body, "")
+    best = ClusterRouter(node)._best(["h264"])
+    assert best is not None and best[0] == "http://b:1"
+
+
+# -- capacity bench vocabulary / ratchet -------------------------------------
+
+
+def test_capacity_mixes_use_known_scenarios():
+    """bench.py's capacity mixes must stay inside the scenario-trace and
+    SLO-target vocabularies (a typo'd mix would KeyError mid-ramp)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    from selkies_tpu.monitoring.slo import scenario_targets
+
+    targets = scenario_targets()
+    for mix, cycle in bench.CAPACITY_MIXES.items():
+        for s in cycle:
+            assert s in bench.SCENARIOS, f"{mix}: unknown scenario {s}"
+            key = bench._SLO_KEY.get(s, s)
+            assert key in targets, f"{mix}: no SLO target for {key}"
+
+
+def test_check_bench_regress_capacity_leg(tmp_path):
+    import subprocess
+    import sys
+
+    base = tmp_path / "base.jsonl"
+    base.write_text(json.dumps({
+        "bench": "capacity", "mix": "desktop", "mode": "overlap",
+        "chips": 1, "codec": "h264", "resolution": "512x288",
+        "max_sessions_at_slo": 4}) + "\n")
+
+    def run(rows):
+        rf = tmp_path / "run.jsonl"
+        rf.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+        return subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "check_bench_regress.py"),
+             "--capacity", "--capacity-baseline", str(base),
+             "--run-file", str(rf)],
+            capture_output=True, text=True, cwd=REPO)
+
+    ok_row = {"bench": "capacity", "mix": "desktop", "mode": "overlap",
+              "chips": 1, "codec": "h264", "resolution": "512x288",
+              "max_sessions_at_slo": 3}  # within the 1-session tolerance
+    proc = run([ok_row])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    bad = dict(ok_row, max_sessions_at_slo=1)
+    proc = run([bad])
+    assert proc.returncode == 1
+    assert "max_sessions_at_slo" in proc.stdout
+
+    novel = dict(ok_row, mix="gamer-floor")
+    proc = run([novel])
+    assert proc.returncode == 0
+    assert "skip" in proc.stdout
+
+    # the COMMITTED curve parses and carries both modes per mix
+    from selkies_tpu.cluster.membership import load_capacity_rows
+
+    committed = load_capacity_rows(os.path.join(REPO,
+                                                "BENCH_capacity_r01.json"))
+    assert committed, "BENCH_capacity_r01.json must hold capacity rows"
+    modes = {(r["mix"], r["mode"]) for r in committed}
+    for mix in {r["mix"] for r in committed}:
+        assert (mix, "lockstep") in modes and (mix, "overlap") in modes

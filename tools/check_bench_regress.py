@@ -26,11 +26,24 @@ frame count defaults to the committed rows' 240 — short runs are NOT
 comparable (an idle pass at 60 frames has ~2 active frames, so its p50
 is just the IDR's latency).
 
+``--capacity`` switches the ratchet to the **capacity curve** instead
+(``bench.py --capacity`` vs the committed ``BENCH_capacity_r01.json``):
+rows match on mix + mode + chips + codec + resolution, and each fresh
+``max_sessions_at_slo`` may drop at most ``--tol-sessions`` (default 1
+— the curve is a small integer measured on a shared container) below
+its committed value. A capacity regression means the occupancy
+scheduler (or the serial tick it falls back to) serves fewer sessions
+at SLO than the fleet's routers were told to expect
+(``SELKIES_CAPACITY_FILE`` → ``measured_max_sessions``,
+cluster/membership.py).
+
 Usage:
     python tools/check_bench_regress.py [--scenario idle,typing]
         [--frames 240] [--baseline BENCH_scenarios_r02.json]
         [--run-file rows.jsonl]        # compare an existing run instead
         [--tol-fps 0.40] [--tol-p50 0.60]
+    python tools/check_bench_regress.py --capacity [desktop,interactive]
+        [--capacity-baseline BENCH_capacity_r01.json] [--tol-sessions 1]
 
 Exit 0 when every matched row is inside tolerance, 1 on regression,
 2 on usage/setup errors. Wired as a ``slow``-marked test
@@ -48,6 +61,7 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_BASELINE = "BENCH_scenarios_r02.json"
+DEFAULT_CAPACITY_BASELINE = "BENCH_capacity_r01.json"
 
 
 def _key(row: dict) -> tuple:
@@ -100,6 +114,77 @@ def run_bench(scenarios: list[str], frames: int, *, policy: int = 0,
             row.setdefault("fps", row.get("value"))
             rows[_key(row)] = row
     return rows
+
+
+def _cap_key(row: dict) -> tuple:
+    return (row.get("mix"), row.get("mode"), int(row.get("chips", 0) or 0),
+            row.get("codec", "h264"), row.get("resolution"))
+
+
+def load_capacity(path: str) -> dict[tuple, dict]:
+    """Capacity rows (``bench: capacity``) from a bench JSONL record."""
+    rows: dict[tuple, dict] = {}
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if row.get("bench") == "capacity":
+                rows[_cap_key(row)] = row
+    return rows
+
+
+def run_capacity(mixes: list[str], frames: int, max_sessions: int,
+                 resolution: str) -> dict[tuple, dict]:
+    cmd = [sys.executable, os.path.join(REPO, "bench.py"),
+           "--capacity", ",".join(mixes),
+           "--capacity-frames", str(frames),
+           "--capacity-max", str(max_sessions),
+           "--resolution", resolution]
+    env = dict(os.environ, JAX_PLATFORMS=os.environ.get(
+        "JAX_PLATFORMS", "cpu"))
+    proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                          cwd=REPO)
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr[-4000:])
+        raise RuntimeError(f"bench.py --capacity failed (rc={proc.returncode})")
+    rows: dict[tuple, dict] = {}
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if row.get("bench") == "capacity":
+            rows[_cap_key(row)] = row
+    return rows
+
+
+def compare_capacity(baseline: dict[tuple, dict], fresh: dict[tuple, dict],
+                     *, tol_sessions: int) -> list[str]:
+    problems: list[str] = []
+    for key, row in sorted(fresh.items(), key=str):
+        base = baseline.get(key)
+        label = "/".join(str(k) for k in key)
+        if base is None:
+            print(f"  [skip] {label}: no committed capacity row")
+            continue
+        base_n = int(base.get("max_sessions_at_slo", 0) or 0)
+        n = int(row.get("max_sessions_at_slo", 0) or 0)
+        ok = n >= base_n - tol_sessions
+        if not ok:
+            problems.append(
+                f"{label}: max_sessions_at_slo {n} < committed {base_n} "
+                f"- tol {tol_sessions} (routers were promised {base_n})")
+        print(f"  [{'ok' if ok else 'fail'}] {label}: "
+              f"{n} sessions at SLO (committed {base_n})")
+    return problems
 
 
 def compare(baseline: dict[tuple, dict], fresh: dict[tuple, dict], *,
@@ -158,7 +243,51 @@ def main(argv: list[str] | None = None) -> int:
                          "baseline rows' resolution to compare)")
     ap.add_argument("--tol-fps", type=float, default=0.40)
     ap.add_argument("--tol-p50", type=float, default=0.60)
+    ap.add_argument("--capacity", nargs="?", const="all", default=None,
+                    help="ratchet the sessions-at-SLO capacity curve "
+                         "instead of the scenario rows (optionally a "
+                         "comma mix list; default all committed mixes)")
+    ap.add_argument("--capacity-baseline",
+                    default=os.path.join(REPO, DEFAULT_CAPACITY_BASELINE))
+    ap.add_argument("--capacity-frames", type=int, default=96)
+    ap.add_argument("--capacity-max", type=int, default=8)
+    ap.add_argument("--tol-sessions", type=int, default=1,
+                    help="sessions the fresh max_sessions_at_slo may "
+                         "fall below the committed row")
     args = ap.parse_args(argv)
+
+    if args.capacity:
+        if not os.path.exists(args.capacity_baseline):
+            print("check_bench_regress: capacity baseline "
+                  f"{args.capacity_baseline} missing")
+            return 2
+        baseline = load_capacity(args.capacity_baseline)
+        if args.run_file:
+            fresh = load_capacity(args.run_file)
+        else:
+            mixes = (sorted({k[0] for k in baseline})
+                     if args.capacity.strip().lower() == "all"
+                     else [m.strip() for m in args.capacity.split(",")
+                           if m.strip()])
+            base_res = next((k[4] for k in baseline if k[4]), "512x288")
+            print(f"check_bench_regress: running bench.py --capacity "
+                  f"{','.join(mixes)} --resolution {base_res}")
+            fresh = run_capacity(mixes, args.capacity_frames,
+                                 args.capacity_max, base_res)
+        if not fresh:
+            print("check_bench_regress: no capacity rows produced")
+            return 2
+        problems = compare_capacity(baseline, fresh,
+                                    tol_sessions=args.tol_sessions)
+        if problems:
+            print("\ncheck_bench_regress: CAPACITY REGRESSION vs "
+                  f"{os.path.basename(args.capacity_baseline)} "
+                  f"(tolerance: -{args.tol_sessions} sessions):\n")
+            print("\n".join("  " + p for p in problems))
+            return 1
+        print(f"check_bench_regress: OK ({len(fresh)} capacity rows "
+              f"inside tolerance)")
+        return 0
 
     if not os.path.exists(args.baseline):
         print(f"check_bench_regress: baseline {args.baseline} missing")

@@ -1,0 +1,202 @@
+#!/usr/bin/env python3
+"""Profile the occupancy scheduler (parallel/occupancy.py): per-stage
+overlap timeline + idle-fraction rows, lockstep vs overlapped.
+
+For each session count the tool runs the SAME fleet service three ways:
+
+* **lockstep** — the serial ``service.encode_tick`` oracle;
+* **overlap** — ``OccupancyScheduler.encode_tick`` (double-buffered
+  dispatch: session A's host front-end/pack under session B's device
+  step);
+* **staged** — the units driven by hand, each dispatch and complete
+  timed separately on one thread, which decomposes a session's tick
+  into its host-side dispatch cost (dirty scan + convert + h2d + async
+  step dispatch) and its completion cost (device wait + fetch + pack).
+
+From the staged split it prints the idle-fraction rows — what fraction
+of the lockstep tick each side of the machine sat idle (host idles
+during the device wait, chips idle during host front-ends/packs) —
+i.e. exactly the time the scheduler's overlap reclaims, and the
+measured ``overlap_ratio``/per-session ``sched_wait`` from the live
+scheduler. It also prints the dedicated-chip capacity projection (the
+PERF.md round-8 methodology): on a host whose cores are NOT the bound,
+the dispatch lane is the serial resource, so sessions-at-SLO scales
+with ``tick_budget / host_ms`` under overlap vs
+``tick_budget / (host_ms + device_ms)`` lockstep — the ratio is the
+projected occupancy win this container's single shared core can't
+show directly.
+
+Runs anywhere: with no real TPU it forces an 8-device CPU host mesh
+(the tests/conftest.py trick). Prints one human block per shape plus
+bench.py-shaped JSON lines for the PERF record:
+
+    JAX_PLATFORMS=cpu python tools/profile_occupancy.py \\
+        [--sessions 1,2,4] [--frames 48] [--width 512 --height 288]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# must land before jax import: an 8-device host mesh on CPU-only boxes
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+from selkies_tpu.parallel.occupancy import OccupancyScheduler  # noqa: E402
+from selkies_tpu.parallel.serving import BandedFleetService  # noqa: E402
+
+
+def _traces(n_sessions: int, frames: int, w: int, h: int) -> list[list[np.ndarray]]:
+    """Mixed per-session content: even sessions scroll a textured band
+    (busy front-end + busy device), odd sessions type (sparse deltas) —
+    the tenancy mix whose stage costs differ enough to show overlap."""
+    rng = np.random.default_rng(7)
+    out = []
+    for s in range(n_sessions):
+        base = np.full((h, w, 4), 200 + 5 * s, np.uint8)
+        tex = rng.integers(0, 255, (h, w, 4), np.uint8)
+        frs = []
+        for i in range(frames):
+            f = base.copy()
+            if s % 2 == 0:
+                f[: h // 2] = np.roll(tex[: h // 2], 16 * i, axis=1)
+            elif i % 3 == 0:
+                row = 16 * ((i // 3) % max(1, h // 16 - 1))
+                f[row : row + 12, : w // 2] = rng.integers(
+                    0, 255, (12, w // 2, 4), np.uint8)
+            frs.append(f)
+        out.append(frs)
+    return out
+
+
+def _timed_pass(tick, traces, frames: int) -> list[float]:
+    lats = []
+    for t in range(frames):
+        batch = np.stack([tr[t] for tr in traces])
+        t0 = time.perf_counter()
+        tick(batch)
+        lats.append((time.perf_counter() - t0) * 1e3)
+    return lats
+
+
+def profile_shape(n: int, frames: int, w: int, h: int) -> dict:
+    traces = _traces(n, frames, w, h)
+    settle = min(8, frames)
+
+    # -- lockstep oracle ------------------------------------------------
+    svc = BandedFleetService(n, w, h, bands=1)
+    try:
+        _timed_pass(svc.encode_tick, traces, settle)
+        serial = _timed_pass(svc.encode_tick, traces, frames)
+    finally:
+        svc.close()
+
+    # -- overlapped -----------------------------------------------------
+    svc = BandedFleetService(n, w, h, bands=1)
+    sched = OccupancyScheduler.for_service(svc)
+    try:
+        _timed_pass(sched.encode_tick, traces, settle)
+        overlap = _timed_pass(sched.encode_tick, traces, frames)
+        st = sched.stats()
+    finally:
+        sched.close()
+        svc.close()
+
+    # -- staged decomposition (one thread, stages timed apart) ----------
+    svc = BandedFleetService(n, w, h, bands=1)
+    sched2 = OccupancyScheduler.for_service(svc)
+    units = sched2.units
+    disp_ms = [0.0] * n
+    comp_ms = [0.0] * n
+    try:
+        _timed_pass(sched2.encode_tick, traces, settle)  # warm executables
+        for t in range(frames):
+            batch = np.stack([tr[t] for tr in traces])
+            tokens = []
+            for k, unit in enumerate(units):
+                t0 = time.perf_counter()
+                tokens.append(unit.dispatch(batch))
+                disp_ms[k] += (time.perf_counter() - t0) * 1e3
+            for k, unit in enumerate(units):
+                t0 = time.perf_counter()
+                unit.complete(tokens[k])
+                comp_ms[k] += (time.perf_counter() - t0) * 1e3
+    finally:
+        sched2.close()
+        svc.close()
+    disp_ms = [v / frames for v in disp_ms]
+    comp_ms = [v / frames for v in comp_ms]
+
+    serial_ms = float(np.mean(serial))
+    overlap_ms = float(np.mean(overlap))
+    host_ms = sum(disp_ms)                      # dispatch lane is host-serial
+    complete_ms = sum(comp_ms)                  # device wait + fetch + pack
+    # idle fractions of the LOCKSTEP tick: while one session's chain runs
+    # serially, the chips sit idle for its host stages and the host sits
+    # idle for its device wait — the reclaimable time
+    host_idle = max(0.0, 1.0 - host_ms / serial_ms) if serial_ms else 0.0
+    chip_idle = max(0.0, 1.0 - complete_ms / serial_ms) if serial_ms else 0.0
+    # dedicated-chip projection (host cores not the bound): overlap's
+    # serial resource is the dispatch lane; lockstep's is the whole chain
+    per_host = host_ms / n if n else 0.0
+    per_chain = (host_ms + complete_ms) / n if n else 0.0
+    projection = per_chain / per_host if per_host > 0 else 1.0
+    return {
+        "sessions": n,
+        "serial_ms": round(serial_ms, 2),
+        "overlap_ms": round(overlap_ms, 2),
+        "speedup": round(serial_ms / overlap_ms, 3) if overlap_ms else 0.0,
+        "overlap_ratio": st["overlap_ratio"],
+        "sched_wait_ms": st["sched_wait_ms"],
+        "dispatch_ms": [round(v, 2) for v in disp_ms],
+        "complete_ms": [round(v, 2) for v in comp_ms],
+        "host_idle_frac_lockstep": round(host_idle, 3),
+        "chip_idle_frac_lockstep": round(chip_idle, 3),
+        "projected_dedicated_win": round(projection, 2),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--sessions", default="1,2,4")
+    ap.add_argument("--frames", type=int, default=48)
+    ap.add_argument("--width", type=int, default=512)
+    ap.add_argument("--height", type=int, default=288)
+    args = ap.parse_args()
+
+    print(f"# occupancy profile: {args.width}x{args.height}, "
+          f"{args.frames} frames/pass, backend={jax.default_backend()} "
+          f"({len(jax.devices())} devices)")
+    for tok in args.sessions.split(","):
+        n = int(tok)
+        row = profile_shape(n, args.frames, args.width, args.height)
+        print(f"n={n}: lockstep {row['serial_ms']:.1f} ms/tick, overlap "
+              f"{row['overlap_ms']:.1f} ms/tick ({row['speedup']:.2f}x), "
+              f"overlap_ratio {row['overlap_ratio']:.3f}")
+        print(f"   per-session dispatch {row['dispatch_ms']} ms, "
+              f"complete {row['complete_ms']} ms")
+        print(f"   lockstep idle: host {row['host_idle_frac_lockstep']:.0%}, "
+              f"chips {row['chip_idle_frac_lockstep']:.0%}; dedicated-chip "
+              f"projected win {row['projected_dedicated_win']:.2f}x")
+        print(json.dumps({
+            "metric": f"occupancy overlap n={n} "
+                      f"({args.width}x{args.height})",
+            "value": row["speedup"], "unit": "x vs lockstep", **row}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

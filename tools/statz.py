@@ -195,6 +195,24 @@ def _render_cluster(data: dict) -> str:
     return "\n".join(out)
 
 
+def _render_occupancy(data: dict) -> str:
+    """Occupancy scheduler block (parallel/occupancy.py): overlap-ratio
+    EWMA, per-session dispatch-lane waits, contained stage errors."""
+    head = (f"enabled={data.get('enabled', False)} "
+            f"units={data.get('units', 0)} "
+            f"sessions={data.get('sessions', '?')} "
+            f"ticks={data.get('ticks', 0)} "
+            f"overlap={data.get('overlap_ratio', 0.0)} "
+            f"(last={data.get('last_overlap', 0.0)})")
+    waits = data.get("sched_wait_ms") or {}
+    errors = data.get("errors") or {}
+    rows = [(k, f"{ms}ms", errors.get(k, "-"))
+            for k, ms in sorted(waits.items(), key=lambda kv: int(kv[0]))]
+    if rows:
+        head += "\n" + _table(rows, ("session", "sched_wait", "last_error"))
+    return head
+
+
 def _render_fleet(data: dict) -> str:
     head = (f"sessions={data.get('sessions', '?')} "
             f"connected={data.get('connected', '?')} "
@@ -216,6 +234,7 @@ _PROVIDER_RENDERERS = {
     "placement": _render_placement,
     "devices": _render_devices,
     "cluster": _render_cluster,
+    "occupancy": _render_occupancy,
 }
 
 
