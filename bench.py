@@ -11,6 +11,10 @@ so 1.0 == reference parity.
 The bench measures the flagship path available at the current milestone:
 the full tpuh264enc frame step once it exists, otherwise the capture→I420
 conversion stage alone (clearly labelled).
+
+Alternate suites (each runs INSTEAD of the flagship row): ``--scenario``
+(per-scenario fps/latency rows), ``--capacity`` (sessions-at-SLO ramp),
+``--impair`` (the recovery-ladder impairment gauntlet, docs/recovery.md).
 """
 
 from __future__ import annotations
@@ -686,6 +690,173 @@ def bench_capacity(w: int, h: int, frames_per_pass: int, mixes: list[str],
     return rows
 
 
+# ---------------------------------------------------------------------------
+# impairment gauntlet (--impair): the recovery ladder under trace-driven
+# loss. Encoded scenario AUs replay through the deterministic link
+# profiles (transport/impair.py PROFILES) into a receiver that actually
+# attempts recovery (transport/receiver.py): NACK scheduling back into
+# the sender's RTX ring, ULP FEC rebuild, freeze deadline. Everything
+# runs on a simulated 60 fps clock — no sleeping, seeded RNGs — so
+# BENCH_impair_r01.json ratchets stably (check_bench_regress --impair).
+# ---------------------------------------------------------------------------
+
+IMPAIR_SCENARIOS = ("typing", "video")  # light + full-motion packet mix
+IMPAIR_FPS = 60.0
+
+
+def _encode_scenario_aus(name: str, n: int, w: int,
+                         h: int) -> list[tuple[bytes, bool]]:
+    """Encode the scenario trace once -> [(au, is_idr), ...]; the same
+    AUs replay through every impairment profile."""
+    from selkies_tpu.models.h264.encoder import TPUH264Encoder
+    from selkies_tpu.models.registry import (
+        default_frame_batch, default_pipeline_depth)
+
+    enc = TPUH264Encoder(w, h, qp=28,
+                         frame_batch=min(12, default_frame_batch()),
+                         pipeline_depth=default_pipeline_depth())
+    aus: dict[int, tuple[bytes, bool]] = {}
+    try:
+        for i, frame in enumerate(_scenario_trace(name, n, w, h, seed=11)):
+            for au, stats, meta in enc.submit(frame, None, i):
+                aus[meta] = (bytes(au), bool(getattr(stats, "idr", meta == 0)))
+        for au, stats, meta in enc.flush():
+            aus[meta] = (bytes(au), bool(getattr(stats, "idr", meta == 0)))
+    finally:
+        enc.close()
+    return [aus[i] for i in sorted(aus)]
+
+
+def _impair_run(profile: str, scenario: str,
+                aus: list[tuple[bytes, bool]]) -> dict:
+    """One gauntlet cell: replay `aus` through `profile`'s link model
+    with the full recovery ladder in the loop."""
+    import heapq
+    import itertools
+
+    from selkies_tpu.transport.impair import LoopbackSender, TraceImpairment
+    from selkies_tpu.transport.receiver import RecoveringReceiver
+    from selkies_tpu.transport.recovery import RecoveryController
+    from selkies_tpu.transport.rtp import RtpPacket
+    from selkies_tpu.transport.webrtc import rtcp
+
+    sim = {"s": 0.0}  # simulated wall clock, seconds
+    trace = TraceImpairment(profile, seed=17)
+    heap: list[tuple[float, int, bytes]] = []  # (deliver_ms, tie, wire)
+    tie = itertools.count()
+    mode = ["media"]  # what the capture below is watching the peer send
+    sent_bytes = {"media": 0, "fec": 0, "rtx": 0}
+
+    def on_wire(wire: bytes) -> None:
+        kind = mode[0]
+        if kind == "media":
+            try:  # FEC parity rides the media path; classify by RED pt
+                if RtpPacket.parse(wire).payload[0] & 0x7F == 99:
+                    kind = "fec"
+            except (ValueError, IndexError):
+                pass
+        sent_bytes[kind] += len(wire)
+        now_ms = sim["s"] * 1e3
+        for delay_ms, data in trace.admit(wire, now_ms):
+            heapq.heappush(heap, (now_ms + delay_ms, next(tie), data))
+
+    ls = LoopbackSender(on_wire=on_wire, fec_percentage=20,
+                        clock=lambda: sim["s"])
+    rx = RecoveringReceiver(session=f"{profile}/{scenario}")
+    rc = RecoveryController(session=f"{profile}/{scenario}", enabled=True,
+                            clock=lambda: sim["s"])
+    fec_peak = [0]
+    idr_req = [False]
+
+    def _set_fec(pct: int) -> None:
+        fec_peak[0] = max(fec_peak[0], pct)
+        ls.pc.set_fec_percentage(pct)
+
+    rc.on_set_fec = _set_fec
+    rc.on_force_idr = lambda: idr_req.__setitem__(0, True)
+    ls.pc.on_nack = rc.on_nack
+    ls.pc.on_unrecoverable = rc.on_unrecoverable
+    rc.attach()  # clean link starts at 0 % FEC, not the static default
+
+    tick_ms = 1000.0 / IMPAIR_FPS
+    last_adm = last_drop = 0
+    t_ms = 0.0
+
+    def pump(t_ms: float) -> None:
+        while heap and heap[0][0] <= t_ms:
+            dms, _, data = heapq.heappop(heap)
+            rx.receive(data, dms)
+        seqs = rx.poll(t_ms)
+        if seqs:
+            mode[0] = "rtx"
+            ls.pc._on_srtcp(rtcp.build_nack(1, ls.pc.video_ssrc, seqs))
+            mode[0] = "media"
+
+    try:
+        for i, (au, idr) in enumerate(aus):
+            t_ms = i * tick_ms
+            sim["s"] = t_ms / 1e3
+            mode[0] = "media"
+            ls.pc.send_video(au, int(i * 90000 // IMPAIR_FPS),
+                             idr=idr or idr_req[0])
+            idr_req[0] = False
+            pump(t_ms)
+            if (i + 1) % int(IMPAIR_FPS) == 0:
+                # one RR-shaped loss report per simulated second
+                adm, drop = trace.admitted, trace.dropped
+                d_adm, d_drop = adm - last_adm, drop - last_drop
+                last_adm, last_drop = adm, drop
+                rc.on_loss_report(d_drop / d_adm if d_adm else 0.0)
+        # post-roll: let late deliveries, NACK retries and the freeze
+        # deadline settle before closing the books
+        end_ms = t_ms + 1000.0
+        while t_ms < end_ms:
+            t_ms += tick_ms
+            sim["s"] = t_ms / 1e3
+            pump(t_ms)
+        rx.flush()
+    finally:
+        ls.close()
+    st, rs = rx.stats(), rc.stats()
+    overhead = sent_bytes["fec"] + sent_bytes["rtx"]
+    return {
+        "bench": "impair", "profile": profile, "scenario": scenario,
+        "frames_sent": len(aus),
+        "recovered_ratio": round(st["recovered_ratio"], 4),
+        "frames_total": st["frames_total"],
+        "frames_frozen": st["frames_frozen"],
+        "frames_repaired": st["frames_repaired"],
+        "recovery_ms_p50": st["recovery_ms_p50"],
+        "recovery_ms_p95": st["recovery_ms_p95"],
+        "media_bytes": sent_bytes["media"],
+        "fec_bytes": sent_bytes["fec"],
+        "rtx_bytes": sent_bytes["rtx"],
+        "overhead_pct": round(100.0 * overhead / max(1, sent_bytes["media"]), 2),
+        "packets_lost": trace.dropped,
+        "packets_admitted": trace.admitted,
+        "losses_detected": st["losses_detected"],
+        "repaired_rtx": st["repaired_rtx"],
+        "repaired_fec": st["repaired_fec"],
+        "nacks_sent": st["nacks_sent"],
+        "fec_pct_peak": fec_peak[0],
+        "fec_pct_final": rs["fec_pct"],
+        "idr_forced": rs["idr_forced"],
+        "degrades": rs["degrades"],
+    }
+
+
+def bench_impair(w: int, h: int, n_frames: int, profiles: list[str],
+                 scenarios: list[str]) -> list[dict]:
+    """One row per (profile, scenario): encode each scenario once, then
+    replay the same AUs through every profile's link model."""
+    rows = []
+    for scen in scenarios:
+        aus = _encode_scenario_aus(scen, n_frames, w, h)
+        for profile in profiles:
+            rows.append(_impair_run(profile, scen, aus))
+    return rows
+
+
 def bench_convert_only() -> float:
     import jax
 
@@ -751,6 +922,23 @@ def main() -> int:
         help="ramp ceiling: stop raising N at this many sessions even "
              "if the SLO still holds")
     ap.add_argument(
+        "--impair", nargs="?", const="all", default=None,
+        help="impairment gauntlet (or a comma profile list: lte_handover, "
+             "hotel_wifi, v2x): replay encoded scenario traces through "
+             "deterministic link-loss profiles into a recovering receiver "
+             "(NACK/RTX + FEC + forced-IDR ladder), one JSON row per "
+             "(profile, scenario) with recovered-vs-frozen ratio, recovery "
+             "latency p50/p95 and rtx/fec overhead bytes. Runs INSTEAD of "
+             "the flagship row (docs/recovery.md)")
+    ap.add_argument(
+        "--impair-frames", type=int, default=300,
+        help="frames per impairment cell (replayed at a simulated 60 fps, "
+             "so 300 frames = 5 s of link trace per cell)")
+    ap.add_argument(
+        "--impair-scenarios", default=",".join(IMPAIR_SCENARIOS),
+        help="comma-separated scenarios to encode for the gauntlet "
+             f"(default {','.join(IMPAIR_SCENARIOS)})")
+    ap.add_argument(
         "--codec", default=None,
         help="comma-separated codec sweep (h264,av1,vp9,...): one JSON "
              "line per codec at each --resolution, from the encoder row "
@@ -778,6 +966,31 @@ def main() -> int:
                 float(row["max_sessions_at_slo"]), unit="sessions@slo",
                 **{k: v for k, v in row.items() if k != "codec"},
                 resolution=label, codec=row["codec"])
+        return 0
+    if args.impair:
+        from selkies_tpu.transport.impair import PROFILES
+
+        profiles = (sorted(PROFILES)
+                    if args.impair.strip().lower() == "all"
+                    else [p.strip().lower() for p in args.impair.split(",")
+                          if p.strip()])
+        for p in profiles:
+            if p not in PROFILES:
+                raise SystemExit(f"unknown impairment profile {p!r} "
+                                 f"(one of {sorted(PROFILES)})")
+        scenarios = [s.strip().lower() for s in
+                     args.impair_scenarios.split(",") if s.strip()]
+        for s in scenarios:
+            if s not in SCENARIOS:
+                raise SystemExit(f"unknown scenario {s!r} (one of "
+                                 f"{list(SCENARIOS)})")
+        label, w, h = _parse_resolutions(args.resolution or "512x288")[0]
+        for row in bench_impair(w, h, max(60, args.impair_frames),
+                                profiles, scenarios):
+            _result(
+                f"impair {row['profile']} {row['scenario']} {label}",
+                float(row["recovered_ratio"]), unit="recovered_ratio",
+                **row, resolution=label)
         return 0
     if args.resolution is None:
         import jax

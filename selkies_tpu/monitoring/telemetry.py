@@ -131,6 +131,19 @@ METRIC_FAMILIES: dict[str, str] = {
     "selkies_supervisor_events_total":
         "Recovery-ladder events (warn/force_idr/restart/degrade/undegrade/"
         "recycle/deadline_miss/recovered), labeled by slot",
+    "selkies_rtx_packets_total":
+        "NACK-driven retransmissions at the peer's send boundary, labeled "
+        "by result (sent/budget_drop — budget_drop counts retransmits the "
+        "abuse token bucket refused)",
+    "selkies_fec_recovered_total":
+        "Packets rebuilt from ULP FEC parity by the recovering receiver, "
+        "labeled by session",
+    "selkies_frames_frozen_total":
+        "Frames abandoned because a gap outlived every recovery rung "
+        "(the receiver's freeze deadline expired), labeled by session",
+    "selkies_recovery_rung":
+        "Transport recovery-ladder rung (0=clean 1=rtx 2=fec 3=refresh "
+        "4=degrade), labeled by session",
     "selkies_faults_injected_total":
         "Deterministic injected faults (resilience/faultinject.py), "
         "labeled by site and action",

@@ -528,6 +528,32 @@ class Orchestrator:
         else:
             self.gcc = None
 
+        # recovery ladder (transport/recovery.py): the same RR loss tap
+        # GCC consumes also drives the protection level — FEC scales
+        # with smoothed loss, unrecoverable gaps force an IDR, and the
+        # link-pressure degrade rungs become the LAST resort. Inert
+        # under SELKIES_RECOVERY=0 (every input no-ops, so the peer
+        # keeps its static constructor FEC percentage).
+        from selkies_tpu.transport.recovery import RecoveryController
+
+        self.recovery = RecoveryController(session="0")
+        self.recovery.on_set_fec = self.webrtc.set_fec_percentage
+        # unthrottled internal path — same one transport handover uses
+        self.recovery.on_force_idr = app.force_keyframe
+        self.recovery.on_degrade = app._policy_link_degrade
+        self.recovery.on_undegrade = app._policy_link_undegrade
+        self.webrtc.on_nack = self.recovery.on_nack
+        self.webrtc.on_unrecoverable = self.recovery.on_unrecoverable
+        gcc_loss = self.webrtc.on_loss
+        rec_loss = self.recovery.on_loss_report
+
+        def _on_loss(fraction: float) -> None:
+            gcc_loss(fraction)
+            rec_loss(fraction)
+
+        self.webrtc.on_loss = _on_loss
+        telemetry.register_provider("recovery", self.recovery.stats)
+
         # monitors → client stats channels
         def on_timer(ts: float) -> None:
             inp.send_ping(ts)
@@ -785,6 +811,9 @@ class Orchestrator:
             # row agree end-to-end
             self._negotiate_codec(meta)
             await self.webrtc.start_session()
+            # the fresh peer starts at the ladder's CURRENT protection
+            # level (0 % on a clean link, not the static default)
+            self.recovery.attach()
 
         client.on_connect = call_retrying
         client.on_error = on_error

@@ -55,6 +55,7 @@ from selkies_tpu.signalling.client import (
     run_reconnect_loop,
 )
 from selkies_tpu.transport.congestion import GccController
+from selkies_tpu.transport.recovery import RecoveryController
 from selkies_tpu.transport.webrtc.transport import WebRTCTransport
 from selkies_tpu.transport.websocket import WebSocketTransport
 
@@ -92,6 +93,7 @@ class SessionSlot:
                                       fault_site=f"send:{index}")
         self.rc = CbrRateController(bitrate_kbps=bitrate_kbps, fps=fps)
         self.gcc: GccController | None = None
+        self.recovery: RecoveryController | None = None  # _wire_slots
         self.input: HostInput | None = None
         self.audio = None  # per-session AudioPipeline (fleet._wire_audio)
         self.audio_lock = asyncio.Lock()  # serializes audio start/stop
@@ -1179,6 +1181,7 @@ class FleetOrchestrator:
             handoff=self._drain_handoff, on_drained=self._drain_exit,
             migrate=self._drain_migrate if self.cluster is not None else None)
         telemetry.register_provider("fleet", self._fleet_stats)
+        telemetry.register_provider("recovery", self._recovery_stats)
 
     def _fleet_stats(self) -> dict:
         """/statz live view of the lockstep serving core + placement."""
@@ -1194,6 +1197,11 @@ class FleetOrchestrator:
             # counters, queue depth, borrowed-chip count
             "placement": f.placer.stats(),
         }
+
+    def _recovery_stats(self) -> dict:
+        """/statz recovery block: one ladder per session slot."""
+        return {str(k): s.recovery.stats()
+                for k, s in enumerate(self.slots) if s.recovery is not None}
 
     # -- cluster plumbing (selkies_tpu/cluster) ------------------------
 
@@ -1478,6 +1486,41 @@ class FleetOrchestrator:
                 slot.webrtc.on_video_acked = slot.gcc.on_frame_ack
                 slot.webrtc.on_loss = slot.gcc.on_loss_report
 
+            # per-session recovery ladder (transport/recovery.py): FEC
+            # tracks THIS session's loss; an unrecoverable gap force-IDRs
+            # only this slot; the degrade rung clamps this session's
+            # bitrate (fleet geometry/fps are lockstep, so a single bad
+            # link must never downscale the whole fleet). Inert under
+            # SELKIES_RECOVERY=0.
+            slot.recovery = RecoveryController(session=str(k))
+            slot.recovery.on_set_fec = slot.webrtc.set_fec_percentage
+            slot.recovery.on_force_idr = (
+                lambda k=k: self.fleet.force_keyframe(k))
+
+            def on_rec_degrade(k=k, slot=slot):
+                floor = max(250, int(cfg.video_bitrate) // 4)
+                self.fleet.set_session_bitrate(k, floor)
+                if slot.gcc is not None:
+                    slot.gcc.set_target(floor)
+
+            def on_rec_undegrade(k=k, slot=slot):
+                self.fleet.set_session_bitrate(k, int(cfg.video_bitrate))
+                if slot.gcc is not None:
+                    slot.gcc.set_target(int(cfg.video_bitrate))
+
+            slot.recovery.on_degrade = on_rec_degrade
+            slot.recovery.on_undegrade = on_rec_undegrade
+            slot.webrtc.on_nack = slot.recovery.on_nack
+            slot.webrtc.on_unrecoverable = slot.recovery.on_unrecoverable
+            rtc_loss = slot.webrtc.on_loss
+            rec_loss = slot.recovery.on_loss_report
+
+            def on_slot_loss(fraction: float, _gcc=rtc_loss, _rec=rec_loss):
+                _gcc(fraction)
+                _rec(fraction)
+
+            slot.webrtc.on_loss = on_slot_loss
+
             def on_video_bitrate(kbps: int, k=k, slot=slot):
                 self.fleet.set_session_bitrate(k, int(kbps))
                 if slot.gcc is not None:
@@ -1619,6 +1662,9 @@ class FleetOrchestrator:
             n = self.fleet.negotiate_session(k, prefs)
             slot.webrtc.set_codec(n.codec)
             await slot.webrtc.start_session()
+            if slot.recovery is not None:
+                # fresh peer starts at the ladder's current level
+                slot.recovery.attach()
 
         client.on_connect = client.setup_call
         client.on_error = on_error

@@ -16,6 +16,7 @@ Grammar (semicolon-separated rules)::
            | device                                     (chip health plane)
            | cluster                                    (multi-host plane)
            | sched                                      (occupancy scheduler)
+           | net                                        (packet impairment)
            (wired sites; names are free-form)
     sched  = tick list / ranges  "5,9,13" or "20-22" or "5,9,20-22"
            | "every:N"           every Nth call (1-based)
@@ -70,7 +71,18 @@ encoded; later frames still deliver in order), ``delay:<ms>`` wedges
 that session's own completion lane while every other session's pipeline
 keeps flowing, and ``raise`` fails the session — the scheduler finishes
 the other sessions' stages before re-raising, preserving the serial
-tick's failure semantics (tests/test_occupancy.py).
+tick's failure semantics (tests/test_occupancy.py). The ``net`` family
+fires per outgoing datagram at the peer's send boundary
+(transport/impair.py NetImpairment, armed by webrtc/peer.py when any
+``net`` rule is configured — each site's tick counter counts
+datagrams): ``net:loss`` with ``drop`` discards the datagram (the
+NACK/FEC recovery ladder's job is to survive exactly this);
+``net:jitter`` with ``delay:<ms>`` defers its delivery; ``net:reorder``
+(any action) holds the datagram and releases it behind the next one;
+``net:dup`` (any action) delivers it twice; ``net:bandwidth:<kbps>``
+(any action) rate-shapes matching datagrams through a serialization
+queue at the kbps named in the site qualifier
+(tests/test_recovery.py).
 
 Examples::
 
@@ -78,6 +90,7 @@ Examples::
     SELKIES_FAULTS='send@20-24:drop'                 five dropped video sends
     SELKIES_FAULTS='signalling@2:flap'               one signalling flap
     SELKIES_FAULTS='capture@p:0.01,seed:7:raise'     1% seeded capture faults
+    SELKIES_FAULTS='net:loss@p:0.05,seed:3:drop'     5% seeded packet loss
 
 Each call site bumps a per-site tick counter, so schedules are exact and
 reproducible: the same spec against the same workload injects at the same

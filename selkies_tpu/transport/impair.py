@@ -1,0 +1,269 @@
+"""Deterministic network impairment: the ``net:*`` fault plane.
+
+Two drivers share one packet-pipe model (``admit(datagram, now_ms) ->
+[(delay_ms, datagram), ...]``, empty on loss):
+
+* :class:`NetImpairment` — wired into PeerConnection's send boundary
+  (webrtc/peer.py ``_net_send``) and driven by the seeded
+  ``SELKIES_FAULTS`` schedule (resilience/faultinject.py), so every
+  recovery-ladder transition is reproducible tick-for-tick:
+
+  - ``net:loss``       ``drop`` discards the datagram
+  - ``net:jitter``     ``delay:<ms>`` defers its delivery
+  - ``net:reorder``    any firing holds the datagram behind the next one
+  - ``net:dup``        any firing delivers it twice
+  - ``net:bandwidth:<kbps>`` any firing rate-shapes it through a
+    serialization queue at the site-qualifier's kbps
+
+* :class:`TraceImpairment` — trace-driven profiles for the gauntlet
+  bench (``bench.py --impair``): piecewise link segments (loss
+  probability, jitter, duplication, reordering, bandwidth) replayed on
+  a seeded RNG over a simulated clock. The committed profiles model the
+  networks the source papers evaluate under: an LTE handover (clean ->
+  outage -> congested recovery), a contended hotel/conference WLAN, and
+  the V2X vehicular burst-loss regime of the 8K60 edge-streaming study.
+
+:class:`LoopbackSender` is the measurement apparatus both the bench and
+tests/test_recovery.py use: a real PeerConnection armed with an
+identity SRTP stub and a capture-sink ICE stub, so the full send path —
+payloader, RED/FEC, RTX ring, the net shim — runs in-process with no
+sockets and an injectable clock.
+"""
+
+from __future__ import annotations
+
+import random
+
+from selkies_tpu.resilience.faultinject import FaultInjector, get_injector
+
+__all__ = ["NetImpairment", "TraceImpairment", "LoopbackSender", "PROFILES"]
+
+
+class _Shaper:
+    """Serialization queue: a datagram admitted at ``now_ms`` leaves
+    after every byte ahead of it has drained at ``kbps``."""
+
+    def __init__(self, kbps: float):
+        self.kbps = max(1.0, float(kbps))
+        self._busy_until = 0.0
+
+    def delay_ms(self, nbytes: int, now_ms: float) -> float:
+        start = max(now_ms, self._busy_until)
+        self._busy_until = start + nbytes * 8.0 / self.kbps
+        return self._busy_until - now_ms
+
+
+class NetImpairment:
+    """Faultinject-driven impairment at the peer's send boundary."""
+
+    def __init__(self, injector: FaultInjector):
+        self.injector = injector
+        self._held: list[tuple[float, bytes]] | None = None
+        # net:bandwidth:<kbps> rules carry the rate in the site
+        # qualifier; each keeps its own shaper + schedule counter
+        self._shapers: list[tuple[str, _Shaper]] = []
+        for rule in injector.rules:
+            if rule.site.startswith("net:bandwidth:"):
+                try:
+                    kbps = float(rule.site.rsplit(":", 1)[1])
+                except ValueError:
+                    continue
+                self._shapers.append((rule.site, _Shaper(kbps)))
+
+    @classmethod
+    def from_faults(cls) -> "NetImpairment | None":
+        """None unless the active injector has a ``net`` rule — the
+        disabled send path stays one attribute load."""
+        fi = get_injector()
+        if fi is None:
+            return None
+        if not any(r.site == "net" or r.site.startswith("net:")
+                   for r in fi.rules):
+            return None
+        return cls(fi)
+
+    def admit(self, datagram: bytes,
+              now_ms: float) -> list[tuple[float, bytes]]:
+        """-> [(delay_ms, datagram), ...] in delivery order; [] = lost.
+        Advances each net site's tick counter exactly once per call, so
+        a ``net:loss@5,9:drop`` schedule counts datagrams."""
+        fi = self.injector
+        held, self._held = self._held, None
+        # every site's counter advances on EVERY datagram (checked before
+        # any early-out), so "net:dup@7" always means the 7th datagram
+        # regardless of what the loss schedule did to earlier ones
+        loss = fi.check("net:loss")
+        jitter = fi.check("net:jitter")
+        shaped = [(shaper, fi.check(site) is not None)
+                  for site, shaper in self._shapers]
+        dup = fi.check("net:dup")
+        reorder = fi.check("net:reorder")
+        if loss is not None and loss[0] == "drop":
+            return held or []
+        delay = 0.0
+        if jitter is not None and jitter[0] == "delay":
+            delay += jitter[1]
+        for shaper, fired in shaped:
+            if fired:
+                delay += shaper.delay_ms(len(datagram), now_ms + delay)
+        out = [(delay, datagram)]
+        if dup is not None:
+            out.append((delay, datagram))
+        if reorder is not None:
+            # hold this datagram: it rides BEHIND whatever comes next
+            self._held = out
+            return held or []
+        return (held or []) + out if held else out
+
+
+# ---------------------------------------------------------------------------
+# trace profiles (bench.py --impair)
+# ---------------------------------------------------------------------------
+
+# segment: (duration_ms, loss_prob, jitter_ms, dup_prob, reorder_prob,
+#           bandwidth_kbps or 0 = unshaped); profiles cycle.
+PROFILES: dict[str, list[tuple[float, float, float, float, float, float]]] = {
+    # LTE handover: long clean stretch, a ~400 ms cell switch where most
+    # packets die, then a congested recovery window on the new cell
+    "lte_handover": [
+        (3000.0, 0.002, 5.0, 0.0, 0.005, 0.0),
+        (400.0, 0.45, 60.0, 0.0, 0.05, 2000.0),
+        (1600.0, 0.05, 20.0, 0.0, 0.02, 6000.0),
+    ],
+    # contended hotel/conference WLAN: persistent moderate loss, heavy
+    # jitter, occasional duplicates and reordering, capped throughput
+    "hotel_wifi": [
+        (5000.0, 0.03, 30.0, 0.01, 0.02, 4000.0),
+    ],
+    # V2X vehicular edge (8K60 study's regime): mostly-clean driving
+    # punctuated by deep burst loss at obstructions
+    "v2x": [
+        (2000.0, 0.01, 10.0, 0.0, 0.01, 0.0),
+        (600.0, 0.30, 40.0, 0.0, 0.05, 8000.0),
+        (1000.0, 0.08, 20.0, 0.0, 0.02, 0.0),
+    ],
+}
+
+
+class TraceImpairment:
+    """Seeded trace-driven link model over a simulated clock."""
+
+    def __init__(self, profile: str, seed: int = 0):
+        if profile not in PROFILES:
+            raise ValueError(f"unknown impairment profile {profile!r} "
+                             f"(one of {sorted(PROFILES)})")
+        self.profile = profile
+        self.segments = PROFILES[profile]
+        self.total_ms = sum(s[0] for s in self.segments)
+        self.rng = random.Random(seed)
+        self._held: list[tuple[float, bytes]] | None = None
+        self._shaper: _Shaper | None = None
+        self._shaper_kbps = 0.0
+        # accounting the bench reports
+        self.admitted = 0
+        self.dropped = 0
+        self.duplicated = 0
+        self.reordered = 0
+
+    def _segment(self, now_ms: float):
+        t = now_ms % self.total_ms
+        for seg in self.segments:
+            if t < seg[0]:
+                return seg
+            t -= seg[0]
+        return self.segments[-1]
+
+    def admit(self, datagram: bytes,
+              now_ms: float) -> list[tuple[float, bytes]]:
+        _, loss, jitter, dup, reorder, kbps = self._segment(now_ms)
+        held, self._held = self._held, None
+        self.admitted += 1
+        if self.rng.random() < loss:
+            self.dropped += 1
+            return held or []
+        delay = self.rng.random() * jitter
+        if kbps > 0:
+            if self._shaper is None or self._shaper_kbps != kbps:
+                self._shaper = _Shaper(kbps)
+                self._shaper_kbps = kbps
+            delay += self._shaper.delay_ms(len(datagram), now_ms + delay)
+        out = [(delay, datagram)]
+        if self.rng.random() < dup:
+            self.duplicated += 1
+            out.append((delay, datagram))
+        if self.rng.random() < reorder:
+            self.reordered += 1
+            self._held = out
+            return held or []
+        return (held or []) + out if held else out
+
+
+# ---------------------------------------------------------------------------
+# loopback measurement apparatus
+# ---------------------------------------------------------------------------
+
+class _IdentitySrtp:
+    """SRTP stub: the loopback link is in-process, so protect is the
+    identity — what the receiver sees IS what ULP FEC protects."""
+
+    def protect(self, wire: bytes) -> bytes:
+        return wire
+
+    def protect_rtcp(self, wire: bytes) -> bytes:
+        return wire
+
+    def unprotect_rtcp(self, wire: bytes) -> bytes:
+        return wire
+
+
+class _SinkIce:
+    """ICE stub: connected, delivers every datagram to a callback."""
+
+    def __init__(self, on_wire):
+        self.connected = True
+        self.on_wire = on_wire
+        self.local_candidates: list = []
+
+    def send(self, datagram: bytes) -> None:
+        self.on_wire(datagram)
+
+    def close(self) -> None:
+        self.connected = False
+
+
+class LoopbackSender:
+    """A PeerConnection armed for direct in-process delivery: identity
+    SRTP + capture-sink ICE, FEC armed as a red/ulpfec answer would,
+    and an injectable clock. ``on_wire(datagram)`` receives every
+    outgoing pre-SRTP packet (media, FEC, retransmits)."""
+
+    def __init__(self, *, on_wire, fec_percentage: int = 20,
+                 clock=None, media_pt: int = 96, red_pt: int = 98,
+                 ulpfec_pt: int = 99):
+        import asyncio
+
+        from selkies_tpu.transport.webrtc import fec as fec_mod
+        from selkies_tpu.transport.webrtc.peer import PeerConnection
+
+        # a loop object is required by the constructor but never run:
+        # the loopback path is synchronous (no DTLS ticks, no jitter
+        # timers — trace delays are applied by the caller's event queue)
+        self._loop = asyncio.new_event_loop()
+        pc = PeerConnection(audio=False, fec_percentage=fec_percentage,
+                            loop=self._loop)
+        pc.ice.close()  # release the gathering sockets; replace with sink
+        pc.ice = _SinkIce(on_wire)
+        pc.srtp = _IdentitySrtp()
+        if clock is not None:
+            pc._clock = clock
+            pc._rtx_refill_at = clock()
+        pc.video_pay.payload_type = media_pt
+        if fec_percentage >= 0:
+            pc._fec = fec_mod.FecEncoder(fec_percentage)
+            pc._red_pt, pc._ulpfec_pt = red_pt, ulpfec_pt
+        self.pc = pc
+
+    def close(self) -> None:
+        self.pc._closed = True
+        self.pc.ice.close()
+        self._loop.close()

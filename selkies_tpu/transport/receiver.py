@@ -1,0 +1,298 @@
+"""RecoveringReceiver: a loss-simulating receiver that actually recovers.
+
+In production the recovery half of the transport runs in the browser:
+it NACKs gaps, rebuilds singles from ULP FEC parity, and freezes the
+canvas when a frame can never be completed. To *measure* the sender's
+recovery ladder (bench.py --impair) and to regression-test it
+deterministically (tests/test_recovery.py), this module implements that
+half honestly: RED demux, duplicate suppression, gap detection with
+NACK scheduling, FEC single-loss rebuild (webrtc/fec.recover), an
+in-order delivery cursor with a freeze deadline, and per-repair
+latency/source accounting.
+
+Everything is simulated-clock driven: callers push wire datagrams with
+``receive(wire, now_ms)`` and pump ``poll(now_ms)`` for the NACK/freeze
+timers, so a whole gauntlet run is reproducible bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from selkies_tpu.monitoring.telemetry import telemetry
+from selkies_tpu.transport.rtp import RtpPacket
+from selkies_tpu.transport.webrtc import fec
+
+__all__ = ["RecoveringReceiver"]
+
+
+def _seq_lt(a: int, b: int) -> bool:
+    """a < b in 16-bit serial-number arithmetic (RFC 1982)."""
+    return ((b - a) & 0xFFFF) != 0 and ((b - a) & 0xFFFF) < 0x8000
+
+
+class _Missing:
+    __slots__ = ("since_ms", "nacks", "last_nack_ms")
+
+    def __init__(self, now_ms: float):
+        self.since_ms = now_ms
+        self.nacks = 0
+        self.last_nack_ms = float("-inf")
+
+
+class RecoveringReceiver:
+    """Browser-half recovery model over a simulated clock."""
+
+    def __init__(self, *, session: str = "0", red_pt: int = 98,
+                 ulpfec_pt: int = 99, nack_delay_ms: float = 20.0,
+                 nack_retry_ms: float = 80.0, max_nacks: int = 4,
+                 freeze_after_ms: float = 400.0,
+                 parity_ttl_ms: float = 2000.0):
+        self.session = str(session)
+        self.red_pt = int(red_pt)
+        self.ulpfec_pt = int(ulpfec_pt)
+        self.nack_delay_ms = float(nack_delay_ms)
+        self.nack_retry_ms = float(nack_retry_ms)
+        self.max_nacks = int(max_nacks)
+        self.freeze_after_ms = float(freeze_after_ms)
+        self.parity_ttl_ms = float(parity_ttl_ms)
+        # wire state
+        self._wire: dict[int, bytes] = {}          # seq -> full RTP bytes
+        self._meta: dict[int, tuple] = {}          # seq -> (kind, ts, marker)
+        self._missing: dict[int, _Missing] = {}
+        self._repaired: set[int] = set()           # seqs that closed a gap
+        self._parities: list[tuple[bytes, float, frozenset]] = []
+        self._ssrc: int | None = None
+        self._highest: int | None = None
+        self._next: int | None = None              # delivery cursor
+        # frame assembly
+        self._frame_ts: int | None = None
+        self._frame_poisoned = False
+        self._frame_repaired = False
+        # accounting
+        self.packets = 0
+        self.dups = 0
+        self.losses_detected = 0
+        self.repaired_rtx = 0
+        self.repaired_fec = 0
+        self.given_up = 0
+        self.nacks_sent = 0
+        self.frames_recovered = 0
+        self.frames_repaired = 0
+        self.frames_frozen = 0
+        self.recovery_ms: list[float] = []
+
+    # -- ingest -------------------------------------------------------
+
+    def receive(self, wire: bytes, now_ms: float) -> None:
+        """One wire datagram off the (impaired) link."""
+        try:
+            pkt = RtpPacket.parse(wire)
+        except ValueError:
+            return
+        self._ingest(pkt, wire, now_ms, rebuilt=False)
+
+    def _ingest(self, pkt: RtpPacket, wire: bytes, now_ms: float,
+                *, rebuilt: bool) -> None:
+        seq = pkt.sequence & 0xFFFF
+        if seq in self._wire:
+            self.dups += 1
+            return
+        if self._ssrc is None:
+            self._ssrc = pkt.ssrc
+        self.packets += 1
+        self._wire[seq] = wire
+        kind, ts, marker = self._classify(pkt, now_ms)
+        self._meta[seq] = (kind, ts, marker)
+        # gap bookkeeping
+        gone = self._missing.pop(seq, None)
+        if gone is not None:
+            lat = now_ms - gone.since_ms
+            self.recovery_ms.append(lat)
+            self._repaired.add(seq)
+            if rebuilt:
+                self.repaired_fec += 1
+                if telemetry.enabled:
+                    telemetry.count("selkies_fec_recovered_total",
+                                    session=self.session)
+            else:
+                # the original was lost and this copy closed a gap we had
+                # (or would have) NACKed: the retransmission rung at work
+                self.repaired_rtx += 1
+        if self._highest is None:
+            self._highest = seq
+            self._next = seq
+        elif _seq_lt(self._highest, seq):
+            s = (self._highest + 1) & 0xFFFF
+            while s != seq:
+                # only track gaps the cursor still cares about
+                if self._next is None or not _seq_lt(s, self._next):
+                    self._missing[s] = _Missing(now_ms)
+                    self.losses_detected += 1
+                s = (s + 1) & 0xFFFF
+            self._highest = seq
+        self._try_fec(now_ms)
+        self._deliver()
+
+    def _classify(self, pkt: RtpPacket, now_ms: float) -> tuple:
+        """-> (kind, ts, marker); queues parity payloads for recovery."""
+        if pkt.payload_type == self.red_pt:
+            try:
+                block_pt, inner = fec.red_unwrap(pkt.payload)
+            except ValueError:
+                return ("media", pkt.timestamp, pkt.marker)
+            if block_pt == self.ulpfec_pt:
+                group = self._parity_group(inner)
+                if group:
+                    self._parities.append((inner, now_ms, group))
+                return ("fec", pkt.timestamp, False)
+        return ("media", pkt.timestamp, pkt.marker)
+
+    @staticmethod
+    def _parity_group(parity: bytes) -> frozenset:
+        """Seqs a ULP FEC payload protects (header base_seq + mask)."""
+        if len(parity) < 14:
+            return frozenset()
+        base_seq = struct.unpack_from("!H", parity, 2)[0]
+        mask = struct.unpack_from("!H", parity, 12)[0]
+        return frozenset((base_seq + off) & 0xFFFF
+                         for off in range(16) if mask & (1 << (15 - off)))
+
+    def _try_fec(self, now_ms: float) -> None:
+        if not self._parities or self._ssrc is None:
+            return
+        keep: list[tuple[bytes, float, frozenset]] = []
+        for parity, born_ms, group in self._parities:
+            missing = [s for s in group if s not in self._wire]
+            if not missing:
+                continue  # group complete: parity spent
+            if len(missing) == 1:
+                rebuilt = fec.recover(parity, self._wire, self._ssrc)
+                if rebuilt is not None:
+                    try:
+                        pkt = RtpPacket.parse(rebuilt)
+                    except ValueError:
+                        pkt = None
+                    if pkt is not None:
+                        self._ingest(pkt, rebuilt, now_ms, rebuilt=True)
+                        continue
+            if now_ms - born_ms <= self.parity_ttl_ms:
+                keep.append((parity, born_ms, group))
+        self._parities = keep
+
+    # -- timers -------------------------------------------------------
+
+    def poll(self, now_ms: float) -> list[int]:
+        """Run the NACK/freeze timers; returns seqs to NACK now (feed
+        them through rtcp.build_nack back to the sender)."""
+        to_nack: list[int] = []
+        for seq, m in sorted(self._missing.items()):
+            age = now_ms - m.since_ms
+            if age >= self.freeze_after_ms:
+                # this gap will never close: skip the cursor past it and
+                # let frame assembly freeze the affected frame
+                del self._missing[seq]
+                self.given_up += 1
+                if self._next is not None and not _seq_lt(seq, self._next):
+                    self._poison_through(seq)
+                continue
+            if age < self.nack_delay_ms or m.nacks >= self.max_nacks:
+                continue
+            if now_ms - m.last_nack_ms < self.nack_retry_ms:
+                continue
+            m.last_nack_ms = now_ms
+            m.nacks += 1
+            to_nack.append(seq)
+        if to_nack:
+            self.nacks_sent += len(to_nack)
+        self._deliver()
+        return to_nack
+
+    def _poison_through(self, seq: int) -> None:
+        """Give up on `seq`: advance the cursor over it (delivering any
+        packets queued before it) and poison the in-progress frame."""
+        self._deliver()
+        nxt = self._next
+        if nxt is None or _seq_lt(seq, nxt):
+            return
+        s = nxt
+        while True:
+            if s not in self._wire:
+                self._frame_poisoned = True
+            if s == seq:
+                break
+            s = (s + 1) & 0xFFFF
+        self._next = (seq + 1) & 0xFFFF
+        self._deliver()
+
+    # -- in-order delivery / frame assembly ---------------------------
+
+    def _deliver(self) -> None:
+        while self._next is not None and self._next in self._wire:
+            seq = self._next
+            kind, ts, marker = self._meta.get(seq, ("media", None, False))
+            if kind == "media" and ts is not None:
+                if self._frame_ts is None:
+                    self._frame_ts = ts
+                elif ts != self._frame_ts:
+                    # marker packet lost and given up on: close the old
+                    # frame on the timestamp change instead
+                    self._close_frame()
+                    self._frame_ts = ts
+                if seq in self._repaired:
+                    self._frame_repaired = True
+                if marker:
+                    self._close_frame()
+                    self._frame_ts = None
+            self._next = (seq + 1) & 0xFFFF
+            if self._next in self._missing:
+                break
+
+    def _close_frame(self) -> None:
+        if self._frame_poisoned:
+            self.frames_frozen += 1
+            if telemetry.enabled:
+                telemetry.count("selkies_frames_frozen_total",
+                                session=self.session)
+        else:
+            self.frames_recovered += 1
+            if self._frame_repaired:
+                self.frames_repaired += 1
+        self._frame_poisoned = False
+        self._frame_repaired = False
+
+    def flush(self) -> None:
+        """End of run: close any half-assembled frame."""
+        self._deliver()
+        if self._frame_ts is not None:
+            self._close_frame()
+            self._frame_ts = None
+
+    # -- observability ------------------------------------------------
+
+    @staticmethod
+    def _pct(samples: list[float], q: float) -> float:
+        if not samples:
+            return 0.0
+        xs = sorted(samples)
+        i = min(len(xs) - 1, int(round(q * (len(xs) - 1))))
+        return xs[i]
+
+    def stats(self) -> dict:
+        total = self.frames_recovered + self.frames_frozen
+        return {
+            "packets": self.packets,
+            "dups": self.dups,
+            "losses_detected": self.losses_detected,
+            "repaired_rtx": self.repaired_rtx,
+            "repaired_fec": self.repaired_fec,
+            "given_up": self.given_up,
+            "nacks_sent": self.nacks_sent,
+            "frames_total": total,
+            "frames_recovered": self.frames_recovered,
+            "frames_repaired": self.frames_repaired,
+            "frames_frozen": self.frames_frozen,
+            "recovered_ratio": (self.frames_recovered / total) if total else 1.0,
+            "recovery_ms_p50": round(self._pct(self.recovery_ms, 0.50), 3),
+            "recovery_ms_p95": round(self._pct(self.recovery_ms, 0.95), 3),
+        }

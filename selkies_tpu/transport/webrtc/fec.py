@@ -113,15 +113,57 @@ def recover(fec_payload: bytes, received: dict[int, bytes],
 
 class FecEncoder:
     """Groups outgoing video packets and emits parity per the configured
-    percentage (reference fec-percentage=20 -> one FEC per 5 packets)."""
+    percentage (reference fec-percentage=20 -> one FEC per 5 packets).
+
+    The percentage is live (:meth:`set_percentage`): the recovery ladder
+    (transport/recovery.py) scales it with the measured loss fraction,
+    down to 0 — at 0 the encoder stays armed (media keeps its negotiated
+    RED encapsulation) but emits no parity at all."""
 
     def __init__(self, percentage: int = 20):
-        self.group_size = max(1, min(16, round(100 / max(percentage, 1))))
+        self.percentage = int(percentage)
+        self.group_size = self._group_size(self.percentage)
         self._group: list[bytes] = []
+
+    @staticmethod
+    def _group_size(percentage: int) -> int:
+        if percentage <= 0:
+            return 0  # protection off: push/flush emit nothing
+        return max(1, min(16, round(100 / percentage)))
+
+    def set_percentage(self, percentage: int) -> None:
+        """Live protection-level change. Lowering to 0 drops the pending
+        group (those packets still have the RTX ring); any other change
+        just re-sizes the group — the pending packets emit under the new
+        size at the next push/flush, never spanning the old and new
+        grouping."""
+        pct = int(percentage)
+        if pct == self.percentage:
+            return
+        self.percentage = pct
+        self.group_size = self._group_size(pct)
+        if self.group_size == 0:
+            self._group.clear()
+
+    def begin_au(self, keyframe: bool = False) -> bytes | None:
+        """Access-unit boundary: before a KEYFRAME, flush the pending
+        group so a protection row never spans an IDR — a recovered
+        pre-IDR packet is useless after the refresh, so parity crossing
+        the boundary would protect nothing. Returns leftover parity for
+        the caller to send (sequenced before the keyframe's packets).
+        Plain AU boundaries need no flush here because send_video
+        flushes per frame anyway; this keeps the IDR invariant even if
+        that per-frame flush is ever relaxed."""
+        if not keyframe or not self._group:
+            return None
+        group, self._group = self._group, []
+        return build_fec(group)
 
     def push(self, media_packet: bytes) -> bytes | None:
         """Track a sent media packet; returns a FEC payload when the
         group fills (caller wraps it in RED + RTP and sends)."""
+        if self.group_size == 0:
+            return None
         self._group.append(media_packet)
         if len(self._group) < self.group_size:
             return None
@@ -133,7 +175,7 @@ class FecEncoder:
         recovery latency bounded to one frame; a 1-packet group's parity
         is a valid XOR-identity duplicate and still protects the frame's
         marker packet)."""
-        if not self._group:
+        if self.group_size == 0 or not self._group:
             return None
         group, self._group = self._group, []
         return build_fec(group)

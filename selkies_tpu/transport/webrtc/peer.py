@@ -16,6 +16,7 @@ import secrets
 import struct
 import time
 
+from selkies_tpu.monitoring.telemetry import telemetry
 from selkies_tpu.transport.rtp import H264Payloader, OpusPayloader, RtpPacket
 from selkies_tpu.transport.webrtc import fec, rtcp, sdp
 from selkies_tpu.transport.webrtc.dtls import DtlsEndpoint, is_dtls, make_certificate
@@ -100,8 +101,19 @@ class PeerConnection:
         # answer accepts both payload types
         self.fec_percentage = int(fec_percentage)
         self._fec: fec.FecEncoder | None = None
+        self._fec_live = False  # set_fec_percentage called (recovery ladder)
         self._red_pt = sdp.RED_PT
         self._ulpfec_pt = sdp.ULPFEC_PT
+        # injectable clock: the rtx floors/budget below are wall-time
+        # rates, and the impairment bench + recovery tests drive this
+        # peer on a simulated timeline
+        self._clock = time.monotonic
+        # net:* impairment shim (transport/impair.py) — None unless a
+        # SELKIES_FAULTS net rule is configured, so the clean path pays
+        # one attribute load per send
+        from selkies_tpu.transport.impair import NetImpairment
+
+        self._impair = NetImpairment.from_faults()
         self._connected = asyncio.Event()
         self._closed = False
         # TWCC send state
@@ -122,12 +134,14 @@ class PeerConnection:
         self._last_pli_keyframe = float("-inf")
         self._rtx_last: dict[int, float] = {}   # seq -> last retransmit time
         self._rtx_tokens = float(RTX_BUDGET_BYTES)
-        self._rtx_refill_at = time.monotonic()
+        self._rtx_refill_at = self._clock()
         # control surface callbacks
         self.on_force_keyframe = lambda: None
         self.on_packet_sent = lambda seq, send_ms, size: None   # GCC
         self.on_packet_acked = lambda seq, recv_ms: None        # GCC
         self.on_loss = lambda fraction: None                    # GCC
+        self.on_nack = lambda n_seqs: None            # recovery ladder
+        self.on_unrecoverable = lambda seq: None      # gap past the ring
         self.on_datachannel = lambda ch: None
         self.on_datachannel_message = lambda ch, data, binary: None
         self.on_connected = lambda: None
@@ -184,7 +198,11 @@ class PeerConnection:
         if r.twcc_id is not None:
             self._twcc_id = r.twcc_id
         self._playout_delay_id = r.playout_delay_id
-        if self.fec_percentage > 0 and r.red_pt is not None and r.ulpfec_pt is not None:
+        if ((self.fec_percentage > 0 or self._fec_live)
+                and r.red_pt is not None and r.ulpfec_pt is not None):
+            # armed even at a live 0 % (recovery ladder): media keeps its
+            # negotiated RED encapsulation so a later loss-driven ramp-up
+            # needs no renegotiation — only the parity emission gates
             self._fec = fec.FecEncoder(self.fec_percentage)
             self._red_pt, self._ulpfec_pt = r.red_pt, r.ulpfec_pt
         # browser answers a=setup:active -> we are the DTLS server
@@ -304,7 +322,7 @@ class PeerConnection:
             # bypass this path and are always honored. Shared by the
             # single-session app and the fleet (both wire
             # on_force_keyframe off this peer).
-            now = time.monotonic()
+            now = self._clock()
             if now - self._last_pli_keyframe >= self.KEYFRAME_MIN_INTERVAL:
                 self._last_pli_keyframe = now
                 self.on_force_keyframe()
@@ -320,36 +338,47 @@ class PeerConnection:
                     t += pkt.recv_delta_ms
                     self.on_packet_acked(pkt.seq, t)
         if fb.nacks:
-            now = time.monotonic()
+            now = self._clock()
             self._rtx_tokens = min(
                 float(RTX_BUDGET_BYTES),
                 self._rtx_tokens + (now - self._rtx_refill_at) * RTX_BUDGET_BYTES)
             self._rtx_refill_at = now
+            self.on_nack(len(fb.nacks))
+        rtx_sent = rtx_dropped = 0
         for seq in fb.nacks:
             wire = self._rtx.get(seq)
-            if wire is not None and self.srtp is not None:
+            if wire is None:
+                # the seq aged out of the ring: no retransmit (and no
+                # FEC span) can close this gap — the recovery ladder
+                # answers with a forced IDR instead
+                self.on_unrecoverable(seq)
+                continue
+            if self.srtp is not None:
                 # abuse bounds (see RTX_SEQ_FLOOR/RTX_BUDGET_BYTES): skip
                 # a seq retransmitted within the floor (the rtx is likely
                 # still in flight) and stop when the byte budget is dry
                 if now - self._rtx_last.get(seq, float("-inf")) < RTX_SEQ_FLOOR:
                     continue
                 if self._rtx_tokens < len(wire):
-                    logger.debug("rtx budget exhausted; dropping NACKs")
+                    rtx_dropped += 1
                     break
                 self._rtx_last[seq] = now
                 self._rtx_tokens -= len(wire)
+                rtx_sent += 1
                 # plain retransmission (no rtx ssrc): re-protect fails the
                 # SRTP replay rules on some stacks, so resend the original
                 # protected packet bytes
                 try:
-                    self.ice.send(wire)
+                    self._net_send(wire)
                 except ConnectionError:
                     pass
-        if len(self._rtx_last) > 2 * RTX_BUFFER:
-            # keep the floor map aligned with the live ring (seqs wrap at
-            # 65536, so without pruning a long session pins every seq)
-            self._rtx_last = {s: t for s, t in self._rtx_last.items()
-                              if s in self._rtx}
+        if telemetry.enabled and (rtx_sent or rtx_dropped):
+            if rtx_sent:
+                telemetry.count("selkies_rtx_packets_total", n=rtx_sent,
+                                result="sent")
+            if rtx_dropped:
+                telemetry.count("selkies_rtx_packets_total", n=rtx_dropped,
+                                result="budget_drop")
         if fb.bye:
             logger.info("peer sent RTCP BYE")
             self.close()
@@ -373,8 +402,8 @@ class PeerConnection:
             pkt.extensions.append((self._playout_delay_id, b"\x00\x00\x00"))
         wire = pkt.serialize()
         protected = self.srtp.protect(wire)
-        self.ice.send(protected)
-        now_ms = time.monotonic() * 1e3
+        self._net_send(protected)
+        now_ms = self._clock() * 1e3
         self.on_packet_sent(self._twcc_seq, now_ms, len(protected))
         if audio_stream:
             self._aud_packets += 1
@@ -385,12 +414,56 @@ class PeerConnection:
             self._rtx[pkt.sequence & 0xFFFF] = protected
             while len(self._rtx) > RTX_BUFFER:
                 # dicts iterate in insertion order == send order, which
-                # stays correct across the 16-bit sequence wrap
-                del self._rtx[next(iter(self._rtx))]
+                # stays correct across the 16-bit sequence wrap; the
+                # retransmit-floor map is pruned WITH the eviction so a
+                # long session never pins dead seqs (they wrap at 65536)
+                evicted = next(iter(self._rtx))
+                del self._rtx[evicted]
+                self._rtx_last.pop(evicted, None)
         return wire
 
-    def send_video(self, au: bytes, timestamp_90k: int) -> None:
+    def _net_send(self, datagram: bytes) -> None:
+        """The send boundary every media/rtx datagram crosses: with a
+        ``net:*`` fault rule active the NetImpairment shim decides
+        drop/delay/duplicate/reorder deterministically; otherwise this
+        is ``ice.send`` plus one attribute load."""
+        imp = self._impair
+        if imp is None:
+            self.ice.send(datagram)
+            return
+        for delay_ms, data in imp.admit(datagram, self._clock() * 1e3):
+            if delay_ms <= 0:
+                self.ice.send(data)
+            else:
+                self._loop.call_later(delay_ms / 1e3, self._late_send, data)
+
+    def _late_send(self, data: bytes) -> None:
+        if self._closed:
+            return
+        try:
+            self.ice.send(data)
+        except ConnectionError:
+            pass
+
+    def set_fec_percentage(self, percentage: int) -> None:
+        """Live protection-level change (recovery ladder). Takes effect
+        on the armed encoder immediately; before the answer arrives it
+        just updates the arming percentage — and marks the peer
+        ladder-driven, so set_answer arms the encoder even at 0 %."""
+        self._fec_live = True
+        self.fec_percentage = max(0, int(percentage))
+        if self._fec is not None:
+            self._fec.set_percentage(self.fec_percentage)
+
+    def send_video(self, au: bytes, timestamp_90k: int, *,
+                   idr: bool = False) -> None:
         ts = int(timestamp_90k) & 0xFFFFFFFF
+        if self._fec is not None and idr:
+            # keyframe boundary: a protection row must not span the IDR
+            # (leftover parity belongs to the PREVIOUS frame's timestamp)
+            parity = self._fec.begin_au(keyframe=True)
+            if parity is not None:
+                self._send_fec(parity, self._last_video_ts)
         self._last_video_ts = ts
         for pkt in self.video_pay.payload_au(au, ts):
             if self._fec is not None:

@@ -162,6 +162,25 @@ def build_sender_report(ssrc: int, rtp_ts: int, packets: int, octets: int,
     return struct.pack("!BBH", 0x80, RTCP_SR, len(body) // 4) + body
 
 
+def build_nack(sender_ssrc: int, media_ssrc: int, seqs: list[int]) -> bytes:
+    """Generic NACK (RFC 4585 §6.2.1): pack missing seqs into PID+BLP
+    pairs. Receiver-side counterpart of ``_parse_nack`` — the loopback
+    recovery harness feeds its output straight into ``_on_srtcp``."""
+    pairs: list[tuple[int, int]] = []
+    for seq in sorted({s & 0xFFFF for s in seqs}):
+        if pairs:
+            pid, blp = pairs[-1]
+            off = (seq - pid) & 0xFFFF
+            if 1 <= off <= 16:
+                pairs[-1] = (pid, blp | (1 << (off - 1)))
+                continue
+        pairs.append((seq, 0))
+    body = struct.pack("!II", sender_ssrc, media_ssrc)
+    for pid, blp in pairs:
+        body += struct.pack("!HH", pid, blp)
+    return struct.pack("!BBH", 0x80 | 1, RTCP_RTPFB, len(body) // 4) + body
+
+
 def build_sdes(ssrc: int, cname: str = "selkies-tpu") -> bytes:
     item = struct.pack("!BB", 1, len(cname)) + cname.encode()
     chunk = struct.pack("!I", ssrc) + item + b"\x00"

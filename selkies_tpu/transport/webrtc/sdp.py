@@ -181,7 +181,10 @@ def parse_answer(sdp: str, prefer: str = "h264") -> RemoteDescription:
         elif line.startswith("a=ice-pwd:") and not r.ice_pwd:
             r.ice_pwd = line.split(":", 1)[1]
         elif line.startswith("a=fingerprint:sha-256") and not r.fingerprint:
-            r.fingerprint = line.split(None, 1)[1].strip()
+            parts = line.split(None, 1)
+            if len(parts) < 2:
+                raise ValueError("fingerprint attribute missing its value")
+            r.fingerprint = parts[1].strip()
         elif line.startswith("a=setup:") and not r.setup:
             r.setup = line.split(":", 1)[1]
         elif line.startswith("a=candidate:"):

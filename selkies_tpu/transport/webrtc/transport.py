@@ -53,6 +53,10 @@ class WebRTCTransport:
         self.on_video_acked: Callable[[int, float], None] = lambda seq, ms: None
         self.on_loss: Callable[[float], None] = lambda fraction: None
         self.on_force_keyframe: Callable[[], None] = lambda: None
+        # recovery-ladder taps (transport/recovery.py)
+        self.on_nack: Callable[[int], None] = lambda n_seqs: None
+        self.on_unrecoverable: Callable[[int], None] = lambda seq: None
+        self._fec_override: int | None = None  # ladder-set, survives restarts
 
     @property
     def connected(self) -> bool:
@@ -89,6 +93,13 @@ class WebRTCTransport:
         pc.on_packet_sent = lambda seq, ms, size: self.on_video_sent(seq, ms, size)
         pc.on_packet_acked = lambda seq, ms: self.on_video_acked(seq, ms)
         pc.on_loss = lambda f: self.on_loss(f)
+        pc.on_nack = lambda n: self.on_nack(n)
+        pc.on_unrecoverable = lambda seq: self.on_unrecoverable(seq)
+        if self._fec_override is not None:
+            # a restarted session keeps the ladder's protection level
+            # (RecoveryController.attach() re-applies it anyway, but the
+            # peer must be ladder-armed BEFORE the answer arrives)
+            pc.set_fec_percentage(self._fec_override)
         pc.on_datachannel = self._on_channel
         pc.on_datachannel_message = self._on_dc_message
         pc.on_closed = self._on_pc_closed
@@ -166,12 +177,20 @@ class WebRTCTransport:
             loop.call_soon_threadsafe(
                 lambda: pc.send_datachannel(ch, message.encode()))
 
+    def set_fec_percentage(self, percentage: int) -> None:
+        """Live FEC protection level (recovery ladder): applied to the
+        current peer immediately and remembered for future sessions."""
+        self._fec_override = max(0, int(percentage))
+        if self.pc is not None:
+            self.pc.set_fec_percentage(self._fec_override)
+
     # -- media sinks --------------------------------------------------
 
     async def send_video(self, ef) -> None:
         if self.pc is None or not self.pc.connected:
             return
-        self.pc.send_video(ef.au, ef.timestamp_90k)
+        self.pc.send_video(ef.au, ef.timestamp_90k,
+                           idr=bool(getattr(ef, "idr", False)))
         self.frames_sent += 1
         self.bytes_sent += len(ef.au)
 

@@ -192,6 +192,7 @@ def test_pli_flood_keyframe_floor():
     app-layer force_keyframe stays UNTHROTTLED for internal callers
     (transport handover is never retried)."""
     import struct
+    import time
 
     from selkies_tpu.transport.webrtc.peer import PeerConnection
 
@@ -200,6 +201,10 @@ def test_pli_flood_keyframe_floor():
     pc._last_pli_keyframe = float("-inf")
     pc._rtx, pc._rtx_last = {}, {}
     pc._rtx_tokens, pc._rtx_refill_at = 0.0, 0.0
+    pc._clock = time.monotonic
+    pc._impair = None
+    pc.on_nack = lambda n: None
+    pc.on_unrecoverable = lambda seq: None
     forced = []
     pc.on_force_keyframe = lambda: forced.append(1)
     pc.on_loss = lambda fraction: None
@@ -253,9 +258,13 @@ def test_nack_rtx_abuse_bounds(monkeypatch):
     pc._last_pli_keyframe = float("-inf")
     pc._rtx_last = {}
     pc._rtx_tokens = float(peer_mod.RTX_BUDGET_BYTES)
-    pc._rtx_refill_at = 0.0
+    pc._rtx_refill_at = 1000.0  # matches the frozen clock: no refill
+    pc._clock = peer_mod.time.monotonic
+    pc._impair = None
     pc.on_force_keyframe = lambda: None
     pc.on_loss = lambda fraction: None
+    pc.on_nack = lambda n: None
+    pc.on_unrecoverable = lambda seq: None
     sent = []
 
     class _Ice:

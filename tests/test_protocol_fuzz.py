@@ -137,6 +137,74 @@ def test_rtcp_parse_never_raises():
         assert isinstance(fb, Feedback)
 
 
+def test_rtcp_nack_twcc_bodies_never_raise():
+    """Targeted RTPFB soup: NACK (fmt 1) and TWCC (fmt 15) bodies are
+    attacker-controlled and drive the RTX/congestion paths — truncated,
+    odd-length and length-lying bodies must parse to a Feedback, never
+    raise. A well-formed build_nack still round-trips afterwards."""
+    for _ in range(N_MUTATED):
+        fmt = 1 if RNG.random() < 0.5 else 15
+        body = _rand_bytes(60)
+        # random (often lying) length field in 32-bit words
+        length = int(RNG.integers(0, 20))
+        pkt = struct.pack("!BBH", 0x80 | fmt, 205, length) + body
+        fb = parse_compound(pkt)
+        assert isinstance(fb, Feedback)
+        # same soup mid-compound: the walker must resynchronize or stop
+        fb = parse_compound(build_sdes(0x1234) + pkt + _valid_rtcp())
+        assert isinstance(fb, Feedback)
+    # truncated-at-every-byte valid NACK: no offset may raise
+    from selkies_tpu.transport.webrtc.rtcp import build_nack
+
+    nack = build_nack(1, 0x5678, [100, 101, 103, 130])
+    for cut in range(len(nack)):
+        assert isinstance(parse_compound(nack[:cut]), Feedback)
+    fb = parse_compound(nack)
+    assert set(fb.nacks) == {100, 101, 103, 130}
+
+
+def test_recovering_receiver_survives_wire_fuzz():
+    """The gauntlet receiver (transport/receiver.py) eats the impaired
+    wire directly: seeded loss/dup/reorder storms plus raw garbage must
+    never raise, and the accounting invariants must hold."""
+    from selkies_tpu.transport.receiver import RecoveringReceiver
+
+    rx = RecoveringReceiver(freeze_after_ms=200.0)
+    n_media = 0
+    now = 0.0
+    pending: list[bytes] = []
+    for i in range(N_RANDOM):
+        now += float(RNG.random()) * 20.0
+        wire = RtpPacket(payload_type=96, sequence=i, timestamp=(i // 3) * 1500,
+                         ssrc=9, payload=b"m" * int(RNG.integers(1, 60)),
+                         marker=(i % 3 == 2)).serialize()
+        n_media += 1
+        r = RNG.random()
+        if r < 0.10:
+            continue                       # lost outright
+        if r < 0.25:
+            pending.append(wire)           # reordered: held back
+        else:
+            rx.receive(wire, now)
+            if r < 0.35:
+                rx.receive(wire, now)      # duplicated
+        if pending and RNG.random() < 0.5:
+            rx.receive(pending.pop(int(RNG.integers(0, len(pending)))), now)
+        if RNG.random() < 0.3:
+            rx.receive(_rand_bytes(), now)  # raw garbage on the same port
+        rx.poll(now)
+    for w in pending:
+        rx.receive(w, now)
+    rx.poll(now + 1000.0)
+    rx.flush()
+    st = rx.stats()
+    assert st["packets"] <= n_media        # dups/garbage never double-count
+    assert st["dups"] > 0
+    assert 0.0 <= st["recovered_ratio"] <= 1.0
+    assert st["frames_total"] <= (n_media + 2) // 3 + 1
+    assert st["repaired_rtx"] + st["repaired_fec"] <= st["losses_detected"]
+
+
 # -------------------------------------------------------------------- RTP
 
 def test_rtp_parse_valueerror_only():

@@ -1,0 +1,630 @@
+"""Recovery ladder + net:* impairment plane + recovering receiver.
+
+Pins the adaptive recovery contract end to end: the FEC adaptation
+curve and its hysteresis (transport/recovery.py), the deterministic
+``net:*`` fault sites (transport/impair.py driven by
+resilience/faultinject.py), the browser-half recovering receiver
+(transport/receiver.py), the FEC/IDR span alignment
+(webrtc/fec.FecEncoder.begin_au), the ``SELKIES_RECOVERY=0``
+byte-identity off switch, and the impairment-gauntlet ratchet
+(tools/check_bench_regress.py --impair vs BENCH_impair_r01.json).
+
+The chaos ladder test drives a REAL PeerConnection (LoopbackSender) on
+a simulated clock through a seeded ``net:loss`` burst and asserts the
+escalation order from the fault log + flight-recorder event ring:
+NACK -> RTX first, FEC ramps and returns to 0 %, exactly one forced
+IDR per unrecoverable burst, degradation only after the lower rungs
+are exhausted.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from selkies_tpu.monitoring.flightrecorder import FlightRecorder
+from selkies_tpu.monitoring.telemetry import telemetry
+from selkies_tpu.resilience import configure_faults, reset_faults
+from selkies_tpu.resilience.faultinject import get_injector
+from selkies_tpu.transport.impair import (
+    PROFILES,
+    LoopbackSender,
+    NetImpairment,
+    TraceImpairment,
+)
+from selkies_tpu.transport.receiver import RecoveringReceiver
+from selkies_tpu.transport.recovery import (
+    RUNG_NAMES,
+    RecoveryController,
+    max_fec_pct,
+    recovery_enabled,
+)
+from selkies_tpu.transport.rtp import RtpPacket
+from selkies_tpu.transport.webrtc import fec, rtcp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def faults():
+    """Install a fault schedule for one test; ALWAYS clears it after."""
+    yield configure_faults
+    reset_faults()
+
+
+@pytest.fixture
+def tele(tmp_path):
+    telemetry.reset()
+    telemetry.enabled = True
+    telemetry.recorder = FlightRecorder(out_dir=str(tmp_path / "bb"))
+    yield telemetry
+    telemetry.enabled = False
+    telemetry.reset()
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def make_controller(clock, **kw):
+    kw.setdefault("enabled", True)
+    kw.setdefault("fec_max", 50)
+    return RecoveryController(session="t", clock=clock, **kw)
+
+
+# ---------------------------------------------------------------------------
+# RecoveryController policy
+# ---------------------------------------------------------------------------
+
+
+def test_fec_curve_shape():
+    rc = make_controller(Clock())
+    assert rc._target_pct(0.0) == 0
+    assert rc._target_pct(0.019) == 0          # below fec_loss: no parity
+    assert rc._target_pct(0.02) == 5
+    assert rc._target_pct(0.05) == 10          # ~2x loss, 5 % quantized
+    assert rc._target_pct(0.25) == 50
+    assert rc._target_pct(0.90) == 50          # capped at fec_max
+
+
+def test_fec_raises_immediately_lowers_with_hysteresis():
+    clk = Clock()
+    rc = make_controller(clk, recover_after=3)
+    calls: list[int] = []
+    rc.on_set_fec = calls.append
+    rc.on_loss_report(0.3)                     # smoothed 0.09 -> 20 %
+    assert calls == [20] and rc.fec_pct == 20
+    # calmer reports: the target drops but FEC holds for recover_after
+    rc.on_loss_report(0.0)
+    rc.on_loss_report(0.0)
+    assert rc.fec_pct == 20, "lowered before the calm window elapsed"
+    rc.on_loss_report(0.0)                     # 3rd calm report: lower
+    assert rc.fec_pct < 20
+    for _ in range(12):
+        rc.on_loss_report(0.0)
+    assert rc.fec_pct == 0 and calls[-1] == 0  # decays all the way back
+
+
+def test_forced_idr_floor():
+    clk = Clock()
+    rc = make_controller(clk, idr_floor_s=1.0)
+    idrs: list[float] = []
+    rc.on_force_idr = lambda: idrs.append(clk.t)
+    for _ in range(5):                         # a gap BURST
+        rc.on_unrecoverable(100)
+    assert idrs == [0.0], "a burst must cost exactly one refresh"
+    clk.t = 1.5
+    rc.on_unrecoverable(200)
+    assert idrs == [0.0, 1.5]
+    assert rc.idr_forced_total == 2
+    assert rc.rung == 3 and RUNG_NAMES[rc.rung] == "refresh"
+
+
+def test_degrade_only_after_lower_rungs_exhausted():
+    clk = Clock()
+    rc = make_controller(clk, degrade_after=3, undegrade_after=4)
+    deg: list[str] = []
+    rc.on_degrade = lambda: deg.append("down")
+    rc.on_undegrade = lambda: deg.append("up")
+    # unrecoverable churn with FEC BELOW its cap: refresh rung only
+    for _ in range(6):
+        rc.on_unrecoverable(1)
+    assert deg == [] and rc.rung == 3
+    # drive FEC to its cap, then the same churn escalates
+    for _ in range(8):
+        rc.on_loss_report(0.9)
+    assert rc.fec_pct == rc.fec_max
+    for _ in range(3):
+        rc.on_unrecoverable(2)
+    assert deg == ["down"] and rc.rung == 4
+    rc.on_unrecoverable(3)
+    assert deg == ["down"], "degrade must not repeat while degraded"
+    # reversal: undegrade_after consecutive clean reports
+    for _ in range(4):
+        rc.on_loss_report(0.0)
+    assert deg == ["down", "up"]
+    assert rc.rung < 4 and rc.undegrades_total == 1
+
+
+def test_rung_walk_and_reversal():
+    clk = Clock()
+    rc = make_controller(clk, nack_window_s=3.0, window_s=10.0)
+    assert rc.rung == 0
+    rc.on_nack(2)
+    assert rc.rung == 1                        # rtx: NACKs being answered
+    rc.on_loss_report(0.3)
+    assert rc.rung == 2                        # fec engaged
+    rc.on_unrecoverable(7)
+    assert rc.rung == 3                        # refresh
+    # quiet link: the rungs age out as their windows pass
+    clk.t = 60.0
+    for _ in range(12):
+        rc.on_loss_report(0.0)
+    assert rc.rung == 0 and rc.fec_pct == 0
+
+
+def test_disabled_controller_is_inert(monkeypatch):
+    monkeypatch.setenv("SELKIES_RECOVERY", "0")
+    assert not recovery_enabled()
+    rc = RecoveryController(session="t", clock=Clock())  # enabled from env
+    assert rc.enabled is False
+    calls: list = []
+    rc.on_set_fec = calls.append
+    rc.on_force_idr = lambda: calls.append("idr")
+    rc.on_degrade = lambda: calls.append("deg")
+    rc.attach()
+    rc.on_loss_report(0.9)
+    rc.on_nack(5)
+    rc.on_unrecoverable(1)
+    assert calls == [] and rc.rung == 0 and rc.fec_pct == 0
+
+
+def test_env_knob_parsing(monkeypatch):
+    monkeypatch.setenv("SELKIES_FEC_MAX_PCT", "150")
+    assert max_fec_pct() == 100                # clamped into 1..100
+    monkeypatch.setenv("SELKIES_FEC_MAX_PCT", "nope")
+    assert max_fec_pct() == 50                 # unparsable -> default
+    monkeypatch.delenv("SELKIES_RECOVERY", raising=False)
+    assert recovery_enabled()                  # ON by default
+
+
+# ---------------------------------------------------------------------------
+# FEC span / IDR alignment (webrtc/fec.FecEncoder)
+# ---------------------------------------------------------------------------
+
+
+def _media_wire(seq: int, payload: bytes = b"\xaa" * 40) -> bytes:
+    return RtpPacket(payload_type=98, sequence=seq, timestamp=3000,
+                     ssrc=7, payload=payload).serialize()
+
+
+def test_fec_group_never_spans_idr():
+    enc = fec.FecEncoder(20)                   # group size 5
+    assert enc.push(_media_wire(0)) is None
+    assert enc.push(_media_wire(1)) is None
+    parity = enc.begin_au(keyframe=True)       # IDR boundary: flush NOW
+    assert parity is not None, "pending span must close before the IDR"
+    covered = RecoveringReceiver._parity_group(parity)
+    assert covered == {0, 1}
+    # delta boundary: the group keeps accumulating across the AU
+    assert enc.push(_media_wire(2)) is None
+    assert enc.begin_au(keyframe=False) is None
+    assert enc.push(_media_wire(3)) is None
+
+
+def test_fec_set_percentage_live():
+    enc = fec.FecEncoder(50)                   # group size 2
+    assert enc.push(_media_wire(0)) is None
+    assert enc.push(_media_wire(1)) is not None
+    enc.set_percentage(0)                      # ladder clean rung: disarm
+    assert enc.group_size == 0
+    assert enc.push(_media_wire(2)) is None
+    assert enc.flush() is None, "0 % must emit no parity at all"
+    enc.set_percentage(100)                    # worst-case burst: 1:1
+    assert enc.push(_media_wire(3)) is not None
+
+
+# ---------------------------------------------------------------------------
+# net:* impairment plane
+# ---------------------------------------------------------------------------
+
+
+def test_net_sites_count_datagrams_deterministically(faults):
+    faults("net:loss@2:drop;net:jitter@3:delay:30;net:dup@4:drop;"
+           "net:reorder@5:drop")
+    imp = NetImpairment.from_faults()
+    assert imp is not None
+    out = [imp.admit(bytes([i]), now_ms=0.0) for i in range(1, 8)]
+    assert out[0] == [(0.0, b"\x01")]          # 1: clean
+    assert out[1] == []                        # 2: lost
+    assert out[2] == [(30.0, b"\x03")]         # 3: delayed 30 ms
+    assert out[3] == [(0.0, b"\x04"), (0.0, b"\x04")]   # 4: duplicated
+    assert out[4] == []                        # 5: held for reordering...
+    assert out[5] == [(0.0, b"\x05"), (0.0, b"\x06")]   # ...rides behind 6
+    assert out[6] == [(0.0, b"\x07")]
+    # the loss on tick 2 must NOT shift later sites' counters: every
+    # site's tick advanced on every datagram
+    fi = get_injector()
+    assert ("net:loss", 2, "drop") in fi.injected
+    assert ("net:dup", 4, "drop") in fi.injected
+
+
+def test_net_bandwidth_shaper_serializes(faults):
+    faults("net:bandwidth:8@every:1:drop")     # 8 kbps: 1000 B = 1000 ms
+    imp = NetImpairment.from_faults()
+    [(d1, _)] = imp.admit(b"x" * 1000, now_ms=0.0)
+    assert d1 == pytest.approx(1000.0)
+    [(d2, _)] = imp.admit(b"x" * 1000, now_ms=0.0)
+    assert d2 == pytest.approx(2000.0), "queue drains serially"
+    # after the queue drains, a fresh datagram pays only its own bytes
+    [(d3, _)] = imp.admit(b"x" * 1000, now_ms=10_000.0)
+    assert d3 == pytest.approx(1000.0)
+
+
+def test_from_faults_requires_a_net_rule(faults):
+    faults("encoder@1:raise")
+    assert NetImpairment.from_faults() is None
+    faults("net:loss@p:0.5,seed:1:drop")
+    assert NetImpairment.from_faults() is not None
+    reset_faults()
+    assert NetImpairment.from_faults() is None
+
+
+def test_trace_impairment_seeded_determinism():
+    def run(seed):
+        tr = TraceImpairment("v2x", seed=seed)
+        out = []
+        for i in range(400):
+            out.append(tr.admit(bytes([i & 0xFF]) * 8, now_ms=i * 16.0))
+        return out, (tr.admitted, tr.dropped, tr.duplicated, tr.reordered)
+
+    a_out, a_cnt = run(5)
+    b_out, b_cnt = run(5)
+    assert a_out == b_out and a_cnt == b_cnt   # bit-for-bit reproducible
+    assert a_cnt[0] == 400 and a_cnt[1] > 0    # v2x bursts actually drop
+    with pytest.raises(ValueError):
+        TraceImpairment("fibre_to_the_moon")
+
+
+def test_profiles_are_well_formed():
+    assert {"lte_handover", "hotel_wifi", "v2x"} <= set(PROFILES)
+    for name, segments in PROFILES.items():
+        assert segments, name
+        for seg in segments:
+            dur, loss, jitter, dup, reorder, kbps = seg
+            assert dur > 0 and 0 <= loss < 1 and jitter >= 0
+            assert 0 <= dup < 1 and 0 <= reorder < 1 and kbps >= 0
+
+
+# ---------------------------------------------------------------------------
+# RecoveringReceiver (the browser half, honestly)
+# ---------------------------------------------------------------------------
+
+
+def _frame_wires(ls: LoopbackSender, n: int, size: int = 300) -> list[list[bytes]]:
+    """Send n tiny AUs through a capture list; -> wires grouped per frame."""
+    grouped: list[list[bytes]] = []
+    for i in range(n):
+        frame: list[bytes] = []
+        ls.pc.ice.on_wire = frame.append
+        au = b"\x00\x00\x00\x01\x65" + bytes([i & 0xFF]) * size
+        ls.pc.send_video(au, i * 1500, idr=(i == 0))
+        grouped.append(frame)
+    return grouped
+
+
+def test_receiver_nack_then_rtx_recovers():
+    ls = LoopbackSender(on_wire=lambda w: None, fec_percentage=0)
+    try:
+        frames = _frame_wires(ls, 6)
+        rx = RecoveringReceiver()
+        lost: list[bytes] = []
+        for i, frame in enumerate(frames):
+            for w in frame:
+                if i == 3 and not lost:        # drop frame 3's first packet
+                    lost.append(w)
+                    continue
+                rx.receive(w, now_ms=i * 16.0)
+        assert rx.losses_detected == 1
+        seqs = rx.poll(now_ms=200.0)           # past nack_delay_ms
+        assert len(seqs) == 1 and rx.nacks_sent == 1
+        rx.receive(lost[0], now_ms=230.0)      # the retransmission lands
+        rx.flush()
+        st = rx.stats()
+        assert st["repaired_rtx"] == 1 and st["frames_frozen"] == 0
+        assert st["frames_repaired"] >= 1
+        assert st["recovered_ratio"] == 1.0
+        assert st["recovery_ms_p50"] > 0
+    finally:
+        ls.close()
+
+
+def test_receiver_fec_rebuilds_single_loss():
+    ls = LoopbackSender(on_wire=lambda w: None, fec_percentage=50)
+    try:
+        frames = _frame_wires(ls, 4, size=900)  # >1 media pkt per frame
+        rx = RecoveringReceiver()
+        dropped = 0
+        for i, frame in enumerate(frames):
+            for j, w in enumerate(frame):
+                if i == 2 and j == 0:          # one loss inside a FEC span
+                    dropped += 1
+                    continue
+                rx.receive(w, now_ms=i * 16.0)
+        assert dropped == 1
+        rx.flush()
+        st = rx.stats()
+        assert st["repaired_fec"] == 1, "parity must rebuild the single"
+        assert st["frames_frozen"] == 0 and st["nacks_sent"] == 0
+    finally:
+        ls.close()
+
+
+def test_receiver_freeze_deadline_and_dup_accounting():
+    ls = LoopbackSender(on_wire=lambda w: None, fec_percentage=0)
+    try:
+        frames = _frame_wires(ls, 5)
+        rx = RecoveringReceiver(freeze_after_ms=100.0, max_nacks=2)
+        for i, frame in enumerate(frames):
+            for w in frame:
+                if i == 2:
+                    continue                   # frame 2 never arrives
+                rx.receive(w, now_ms=i * 16.0)
+                rx.receive(w, now_ms=i * 16.0)  # duplicate delivery
+        rx.poll(50.0)
+        rx.poll(130.0)
+        rx.poll(500.0)                         # past the freeze deadline
+        rx.flush()
+        st = rx.stats()
+        assert st["dups"] > 0
+        assert st["given_up"] >= 1
+        # frame 2 was lost WHOLE, so its timestamp was never seen: the
+        # poisoned gap freezes the next assembled frame (2+3 merge into
+        # one frozen delivery) — 3 clean frames survive out of 4 closed
+        assert st["frames_frozen"] == 1
+        assert st["frames_recovered"] == 3
+        assert st["frames_total"] == 4
+        assert st["nacks_sent"] <= 2 * st["losses_detected"]
+    finally:
+        ls.close()
+
+
+def test_receiver_reorder_tolerant():
+    ls = LoopbackSender(on_wire=lambda w: None, fec_percentage=0)
+    try:
+        frames = _frame_wires(ls, 4)
+        rx = RecoveringReceiver()
+        wires = [w for f in frames for w in f]
+        wires[1], wires[2] = wires[2], wires[1]  # swap adjacent packets
+        for i, w in enumerate(wires):
+            rx.receive(w, now_ms=i * 16.0)
+        rx.flush()
+        st = rx.stats()
+        assert st["frames_frozen"] == 0
+        assert st["frames_recovered"] == 4     # cursor reassembles in order
+    finally:
+        ls.close()
+
+
+# ---------------------------------------------------------------------------
+# the deterministic chaos ladder (tentpole acceptance test)
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_ladder_escalation_order(faults, tele):
+    """Seeded net:loss burst against a REAL PeerConnection on a simulated
+    clock: NACK->RTX recovers everything, FEC ramps and decays back to
+    0 %, an unrecoverable gap forces exactly one IDR, and degradation
+    never fires because the lower rungs were never exhausted — all
+    asserted from the fault log + the flight-recorder event ring."""
+    faults("net:loss@40-70:drop")              # a ~30-datagram blackout
+    clk = Clock()
+    delivered: list[bytes] = []
+    ls = LoopbackSender(on_wire=delivered.append, fec_percentage=20,
+                        clock=clk)
+    rx = RecoveringReceiver(freeze_after_ms=3000.0)
+    rc = RecoveryController(session="0", enabled=True, fec_max=50,
+                            recover_after=2, clock=clk)
+    idrs: list[float] = []
+    degrades: list[str] = []
+    rc.on_set_fec = ls.pc.set_fec_percentage
+    rc.on_force_idr = lambda: idrs.append(clk.t)
+    rc.on_degrade = lambda: degrades.append("down")
+    ls.pc.on_nack = rc.on_nack
+    ls.pc.on_unrecoverable = rc.on_unrecoverable
+    rc.attach()                                # clean link: 0 % FEC
+    assert ls.pc.fec_percentage == 0
+
+    fi = get_injector()
+    fec_track: list[int] = []
+    sent = drops = 0
+    try:
+        for i in range(240):                   # 4 simulated seconds @60fps
+            clk.t = i / 60.0
+            au = b"\x00\x00\x00\x01\x65" + bytes([i & 0xFF]) * 120
+            ls.pc.send_video(au, i * 1500, idr=(i == 0))
+            for w in delivered:
+                rx.receive(w, clk.t * 1e3)
+            delivered.clear()
+            seqs = rx.poll(clk.t * 1e3)
+            if seqs:
+                ls.pc._on_srtcp(rtcp.build_nack(1, ls.pc.video_ssrc, seqs))
+                for w in delivered:            # retransmissions (impaired too)
+                    rx.receive(w, clk.t * 1e3)
+                delivered.clear()
+            if (i + 1) % 60 == 0:              # one RR per simulated second
+                d = len([x for x in fi.injected if x[0] == "net:loss"])
+                total = d - drops + (rx.packets - sent)
+                frac = (d - drops) / total if total else 0.0
+                drops, sent = d, rx.packets
+                rc.on_loss_report(frac)
+                fec_track.append(rc.fec_pct)
+        # keep the link clean a few more seconds: the ladder must reverse
+        for k in range(8):
+            clk.t = 4.0 + k
+            rc.on_loss_report(0.0)
+        rx.flush()
+    finally:
+        ls.close()
+
+    # 1) the burst really happened, exactly where scheduled
+    loss_ticks = sorted(t for s, t, _ in fi.injected if s == "net:loss")
+    assert loss_ticks and min(loss_ticks) >= 40 and max(loss_ticks) <= 70
+
+    # 2) NACK -> RTX was the first rung and it recovered every frame
+    st = rx.stats()
+    assert st["repaired_rtx"] > 0 and st["nacks_sent"] > 0
+    assert st["frames_frozen"] == 0 and st["recovered_ratio"] == 1.0
+    assert rc.nacks_total > 0
+
+    # 3) FEC ramped during the burst and decayed back to 0 afterwards
+    assert max(fec_track) > 0, "loss must raise the protection level"
+    assert rc.fec_pct == 0, "calm link must decay FEC back to 0 %"
+
+    # 4) an unrecoverable gap (seq far beyond the RTX ring) forces
+    #    exactly ONE IDR — the floor absorbs the burst
+    ancient = (ls.pc.video_pay.sequence - 5000) & 0xFFFF
+    for _ in range(4):
+        ls.pc._on_srtcp(rtcp.build_nack(1, ls.pc.video_ssrc, [ancient]))
+    assert len(idrs) == 1 and rc.idr_forced_total == 1
+
+    # 5) degradation never fired: FEC never reached its cap, so the
+    #    lower rungs were by definition not exhausted
+    assert degrades == [] and rc.degrades_total == 0
+
+    # 6) the event ring carries the whole transition history
+    evs = [e for e in tele.recorder.events("0") if e["ev"] == "recovery"]
+    actions = [e["action"] for e in evs]
+    assert "set_fec" in actions and "force_idr" in actions
+    rungs = [e["rung"] for e in evs if e["action"] == "rung"]
+    assert rungs and max(rungs) == 3           # refresh reached, never 4
+    first_fec = next(e for e in evs if e["action"] == "set_fec")
+    assert first_fec["pct"] > 0
+
+
+def test_recovery_off_is_byte_identical(monkeypatch):
+    """SELKIES_RECOVERY=0 on a clean link: wiring the controller (as the
+    orchestrator always does) must not change a single wire byte vs the
+    static pre-ladder peer."""
+    monkeypatch.setenv("SELKIES_RECOVERY", "0")
+
+    def run(with_controller: bool) -> str:
+        wires: list[bytes] = []
+        ls = LoopbackSender(on_wire=wires.append, fec_percentage=20,
+                            clock=lambda: 0.0)
+        ls.pc.video_ssrc = 0x0BADF00D
+        ls.pc.video_pay.ssrc = 0x0BADF00D
+        if with_controller:
+            rc = RecoveryController(session="0", clock=lambda: 0.0)
+            assert rc.enabled is False          # from the env switch
+            rc.on_set_fec = ls.pc.set_fec_percentage
+            ls.pc.on_nack = rc.on_nack
+            ls.pc.on_unrecoverable = rc.on_unrecoverable
+            rc.attach()
+            rc.on_loss_report(0.4)              # even loss must not touch FEC
+            rc.on_unrecoverable(1)
+        try:
+            for i in range(24):
+                au = b"\x00\x00\x00\x01\x65" + bytes([i]) * 200
+                ls.pc.send_video(au, i * 1500, idr=(i == 0))
+        finally:
+            ls.close()
+        return hashlib.sha256(b"".join(wires)).hexdigest()
+
+    assert run(False) == run(True)
+
+
+# ---------------------------------------------------------------------------
+# grammar sync: net:* documented wherever the fault grammar lives
+# ---------------------------------------------------------------------------
+
+
+def test_net_grammar_documented_everywhere():
+    from selkies_tpu.resilience import faultinject
+
+    doc = faultinject.__doc__ or ""
+    with open(os.path.join(REPO, "docs", "resilience.md"),
+              encoding="utf-8") as f:
+        md = f.read()
+    for site in ("net:loss", "net:jitter", "net:reorder", "net:dup",
+                 "net:bandwidth"):
+        assert site in doc, f"{site} missing from the faultinject docstring"
+        assert site in md, f"{site} missing from docs/resilience.md"
+    with open(os.path.join(REPO, "docs", "recovery.md"),
+              encoding="utf-8") as f:
+        rec = f.read()
+    for knob in ("SELKIES_RECOVERY", "SELKIES_FEC_MAX_PCT"):
+        assert knob in rec, f"{knob} undocumented in docs/recovery.md"
+
+
+# ---------------------------------------------------------------------------
+# the impairment ratchet (check_bench_regress --impair)
+# ---------------------------------------------------------------------------
+
+
+def _run_ratchet(args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "check_bench_regress.py"), *args],
+        capture_output=True, text=True, cwd=REPO)
+
+
+def test_check_bench_regress_impair_tolerances(tmp_path):
+    base = tmp_path / "base.jsonl"
+    base.write_text(json.dumps({
+        "bench": "impair", "profile": "v2x", "scenario": "typing",
+        "resolution": "512x288", "recovered_ratio": 0.98,
+        "recovery_ms_p95": 100.0, "frames_frozen": 2}) + "\n")
+    ok = tmp_path / "ok.jsonl"
+    ok.write_text(json.dumps({
+        "bench": "impair", "profile": "v2x", "scenario": "typing",
+        "resolution": "512x288", "recovered_ratio": 0.95,
+        "recovery_ms_p95": 140.0, "frames_frozen": 5}) + "\n")
+    proc = _run_ratchet(["--impair", "--run-file", str(ok),
+                         "--impair-baseline", str(base)])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps({
+        "bench": "impair", "profile": "v2x", "scenario": "typing",
+        "resolution": "512x288", "recovered_ratio": 0.60,
+        "recovery_ms_p95": 900.0, "frames_frozen": 120}) + "\n")
+    proc = _run_ratchet(["--impair", "--run-file", str(bad),
+                         "--impair-baseline", str(base)])
+    assert proc.returncode == 1
+    assert "recovered_ratio" in proc.stdout and "p95" in proc.stdout
+
+    # novel (profile, scenario) rows are skipped, not failed
+    novel = tmp_path / "novel.jsonl"
+    novel.write_text(json.dumps({
+        "bench": "impair", "profile": "tin_cans", "scenario": "typing",
+        "resolution": "512x288", "recovered_ratio": 0.0,
+        "recovery_ms_p95": 1e9}) + "\n")
+    proc = _run_ratchet(["--impair", "--run-file", str(novel),
+                         "--impair-baseline", str(base)])
+    assert proc.returncode == 0
+    assert "skip" in proc.stdout
+
+    # a missing baseline is a setup error, not a silent pass
+    proc = _run_ratchet(["--impair", "--run-file", str(ok),
+                         "--impair-baseline", str(tmp_path / "absent.json")])
+    assert proc.returncode == 2
+
+
+@pytest.mark.slow
+def test_bench_impair_ratchet():
+    """The real gauntlet ratchet: a fresh bench.py --impair run over the
+    committed profiles vs BENCH_impair_r01.json (slow: encodes two
+    scenario traces on CPU)."""
+    proc = _run_ratchet(["--impair"])
+    sys.stdout.write(proc.stdout)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -37,6 +37,15 @@ at SLO than the fleet's routers were told to expect
 (``SELKIES_CAPACITY_FILE`` → ``measured_max_sessions``,
 cluster/membership.py).
 
+``--impair`` ratchets the **impairment gauntlet** (``bench.py --impair``
+vs the committed ``BENCH_impair_r01.json``): rows match on profile +
+scenario + resolution; ``recovered_ratio`` may drop at most
+``--tol-recovered`` (absolute, default 0.05) below its committed value
+and ``recovery_ms_p95`` may grow to ``(1 + tol_p95)`` of it (default
+0.75 — the gauntlet clock is simulated so the slack covers ladder-
+tuning drift, not host noise). An impairment regression means frames
+freeze on links the recovery ladder (docs/recovery.md) used to survive.
+
 Usage:
     python tools/check_bench_regress.py [--scenario idle,typing]
         [--frames 240] [--baseline BENCH_scenarios_r02.json]
@@ -44,6 +53,9 @@ Usage:
         [--tol-fps 0.40] [--tol-p50 0.60]
     python tools/check_bench_regress.py --capacity [desktop,interactive]
         [--capacity-baseline BENCH_capacity_r01.json] [--tol-sessions 1]
+    python tools/check_bench_regress.py --impair [lte_handover,v2x]
+        [--impair-baseline BENCH_impair_r01.json] [--tol-recovered 0.05]
+        [--tol-p95 0.75]
 
 Exit 0 when every matched row is inside tolerance, 1 on regression,
 2 on usage/setup errors. Wired as a ``slow``-marked test
@@ -62,6 +74,7 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_BASELINE = "BENCH_scenarios_r02.json"
 DEFAULT_CAPACITY_BASELINE = "BENCH_capacity_r01.json"
+DEFAULT_IMPAIR_BASELINE = "BENCH_impair_r01.json"
 
 
 def _key(row: dict) -> tuple:
@@ -187,6 +200,84 @@ def compare_capacity(baseline: dict[tuple, dict], fresh: dict[tuple, dict],
     return problems
 
 
+def _impair_key(row: dict) -> tuple:
+    return (row.get("profile"), row.get("scenario"), row.get("resolution"))
+
+
+def load_impair(path: str) -> dict[tuple, dict]:
+    """Gauntlet rows (``bench: impair``) from a bench JSONL record."""
+    rows: dict[tuple, dict] = {}
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if row.get("bench") == "impair":
+                rows[_impair_key(row)] = row
+    return rows
+
+
+def run_impair(profiles: list[str], scenarios: list[str], frames: int,
+               resolution: str) -> dict[tuple, dict]:
+    cmd = [sys.executable, os.path.join(REPO, "bench.py"),
+           "--impair", ",".join(profiles),
+           "--impair-scenarios", ",".join(scenarios),
+           "--impair-frames", str(frames),
+           "--resolution", resolution]
+    env = dict(os.environ, JAX_PLATFORMS=os.environ.get(
+        "JAX_PLATFORMS", "cpu"))
+    proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                          cwd=REPO)
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr[-4000:])
+        raise RuntimeError(f"bench.py --impair failed (rc={proc.returncode})")
+    rows: dict[tuple, dict] = {}
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if row.get("bench") == "impair":
+            rows[_impair_key(row)] = row
+    return rows
+
+
+def compare_impair(baseline: dict[tuple, dict], fresh: dict[tuple, dict],
+                   *, tol_recovered: float, tol_p95: float) -> list[str]:
+    problems: list[str] = []
+    for key, row in sorted(fresh.items(), key=str):
+        base = baseline.get(key)
+        label = "/".join(str(k) for k in key)
+        if base is None:
+            print(f"  [skip] {label}: no committed impairment row")
+            continue
+        base_r = float(base.get("recovered_ratio", 0) or 0)
+        r = float(row.get("recovered_ratio", 0) or 0)
+        if r < base_r - tol_recovered:
+            problems.append(
+                f"{label}: recovered_ratio {r:.4f} < committed {base_r:.4f}"
+                f" - tol {tol_recovered} (frames freeze on a link the "
+                f"ladder used to survive)")
+        base_p95 = float(base.get("recovery_ms_p95", 0) or 0)
+        p95 = float(row.get("recovery_ms_p95", 0) or 0)
+        if base_p95 > 0 and p95 > base_p95 * (1.0 + tol_p95):
+            problems.append(
+                f"{label}: recovery_ms_p95 {p95:.1f} > {base_p95:.1f} * "
+                f"(1 + {tol_p95}) = {base_p95 * (1 + tol_p95):.1f} ms")
+        ok = not problems or not problems[-1].startswith(label)
+        print(f"  [{'ok' if ok else 'fail'}] {label}: recovered "
+              f"{r:.4f} (base {base_r:.4f}), p95 {p95:.1f} ms "
+              f"(base {base_p95:.1f}), frozen {row.get('frames_frozen')}")
+    return problems
+
+
 def compare(baseline: dict[tuple, dict], fresh: dict[tuple, dict], *,
             tol_fps: float, tol_p50: float) -> list[str]:
     problems: list[str] = []
@@ -254,7 +345,57 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--tol-sessions", type=int, default=1,
                     help="sessions the fresh max_sessions_at_slo may "
                          "fall below the committed row")
+    ap.add_argument("--impair", nargs="?", const="all", default=None,
+                    help="ratchet the impairment-gauntlet recovery rows "
+                         "instead (optionally a comma profile list; "
+                         "default all committed profiles)")
+    ap.add_argument("--impair-baseline",
+                    default=os.path.join(REPO, DEFAULT_IMPAIR_BASELINE))
+    ap.add_argument("--impair-frames", type=int, default=300)
+    ap.add_argument("--tol-recovered", type=float, default=0.05,
+                    help="absolute recovered_ratio drop allowed below "
+                         "the committed row")
+    ap.add_argument("--tol-p95", type=float, default=0.75,
+                    help="relative recovery_ms_p95 growth allowed over "
+                         "the committed row")
     args = ap.parse_args(argv)
+
+    if args.impair:
+        if not os.path.exists(args.impair_baseline):
+            print("check_bench_regress: impairment baseline "
+                  f"{args.impair_baseline} missing")
+            return 2
+        baseline = load_impair(args.impair_baseline)
+        if args.run_file:
+            fresh = load_impair(args.run_file)
+        else:
+            profiles = (sorted({k[0] for k in baseline})
+                        if args.impair.strip().lower() == "all"
+                        else [p.strip() for p in args.impair.split(",")
+                              if p.strip()])
+            scenarios = sorted({k[1] for k in baseline if k[1]})
+            base_res = next((k[2] for k in baseline if k[2]), "512x288")
+            print(f"check_bench_regress: running bench.py --impair "
+                  f"{','.join(profiles)} --impair-scenarios "
+                  f"{','.join(scenarios)} --resolution {base_res}")
+            fresh = run_impair(profiles, scenarios, args.impair_frames,
+                               base_res)
+        if not fresh:
+            print("check_bench_regress: no impairment rows produced")
+            return 2
+        problems = compare_impair(baseline, fresh,
+                                  tol_recovered=args.tol_recovered,
+                                  tol_p95=args.tol_p95)
+        if problems:
+            print("\ncheck_bench_regress: RECOVERY REGRESSION vs "
+                  f"{os.path.basename(args.impair_baseline)} (tolerances: "
+                  f"recovered -{args.tol_recovered}, p95 "
+                  f"+{args.tol_p95:.0%}):\n")
+            print("\n".join("  " + p for p in problems))
+            return 1
+        print(f"check_bench_regress: OK ({len(fresh)} impairment rows "
+              f"inside tolerance)")
+        return 0
 
     if args.capacity:
         if not os.path.exists(args.capacity_baseline):

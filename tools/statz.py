@@ -213,6 +213,27 @@ def _render_occupancy(data: dict) -> str:
     return head
 
 
+def _render_recovery(data: dict) -> str:
+    """Transport recovery-ladder block (transport/recovery.py): current
+    rung + protection level per session, with the repair counters.
+    Accepts both shapes: one flat stats() dict (solo) or a map of
+    session -> stats() (fleet)."""
+    sessions = data
+    if "rung" in data:  # solo: a single controller's flat stats dict
+        sessions = {"0": data}
+    rows = [(k, "on" if st.get("enabled") else "OFF",
+             f"{st.get('rung', 0)}:{st.get('rung_name', '?')}",
+             f"{st.get('fec_pct', 0)}%/{st.get('fec_max', 0)}%",
+             st.get("smoothed_loss", 0.0), st.get("nacks", 0),
+             st.get("unrecoverable", 0), st.get("idr_forced", 0),
+             f"{st.get('degrades', 0)}/{st.get('undegrades', 0)}")
+            for k, st in sorted(sessions.items()) if isinstance(st, dict)]
+    if not rows:
+        return "(no sessions)"
+    return _table(rows, ("session", "ladder", "rung", "fec", "loss",
+                         "nacks", "unrec", "idr", "deg/undeg"))
+
+
 def _render_fleet(data: dict) -> str:
     head = (f"sessions={data.get('sessions', '?')} "
             f"connected={data.get('connected', '?')} "
@@ -235,6 +256,7 @@ _PROVIDER_RENDERERS = {
     "devices": _render_devices,
     "cluster": _render_cluster,
     "occupancy": _render_occupancy,
+    "recovery": _render_recovery,
 }
 
 
