@@ -704,15 +704,16 @@ IMPAIR_SCENARIOS = ("typing", "video")  # light + full-motion packet mix
 IMPAIR_FPS = 60.0
 
 
-def _encode_scenario_aus(name: str, n: int, w: int,
-                         h: int) -> list[tuple[bytes, bool]]:
+def _encode_scenario_aus(name: str, n: int, w: int, h: int,
+                         qp: int = 28) -> list[tuple[bytes, bool]]:
     """Encode the scenario trace once -> [(au, is_idr), ...]; the same
-    AUs replay through every impairment profile."""
+    AUs replay through every impairment profile. The quality suite
+    reuses this with explicit QPs to sweep the tpuh264enc ladder."""
     from selkies_tpu.models.h264.encoder import TPUH264Encoder
     from selkies_tpu.models.registry import (
         default_frame_batch, default_pipeline_depth)
 
-    enc = TPUH264Encoder(w, h, qp=28,
+    enc = TPUH264Encoder(w, h, qp=qp,
                          frame_batch=min(12, default_frame_batch()),
                          pipeline_depth=default_pipeline_depth())
     aus: dict[int, tuple[bytes, bool]] = {}
@@ -857,6 +858,133 @@ def bench_impair(w: int, h: int, n_frames: int, profiles: list[str],
     return rows
 
 
+# ---------------------------------------------------------------------------
+# rate/quality suite (docs/quality.md): per-scenario rate-distortion
+# points — tpuh264enc across its QP ladder, x264 preset anchors and vp9
+# across a bitrate ladder — each scored by decoding the WHOLE stream
+# through the codec's reference oracle (monitoring/quality.GopDecoder)
+# and comparing decoded luma against the pre-encode I420 source. Point
+# rows carry mean PSNR/SSIM/VMAF (vmaf_kind says proxy vs real CLI);
+# bdrate rows summarise each test curve against each x264 anchor curve
+# with the classic BD-rate integral. Deterministic traces + intra-only
+# oracles => BENCH_quality_r01.json ratchets stably
+# (check_bench_regress --quality).
+# ---------------------------------------------------------------------------
+
+QUALITY_FPS = 60.0
+QUALITY_QP_LADDER = (24, 28, 32, 36)          # tpuh264enc sweep
+QUALITY_RATE_LADDER = (500, 1000, 2000, 4000)  # kbps, x264/vp9 sweeps
+QUALITY_X264_ANCHORS = ("ultrafast", "veryfast")
+
+
+def _mean_scores(refs: list[np.ndarray], lumas: list[np.ndarray]) -> dict:
+    """Mean PSNR/SSIM/VMAF over decoded-vs-source luma pairs; PSNR is
+    capped at the probe's 99 dB ceiling so lossless frames (idle
+    scenario) keep the mean finite."""
+    from selkies_tpu.monitoring.quality import PSNR_CAP_DB, score_planes
+
+    ps, ss, vs, kind = [], [], [], "proxy"
+    for ref, dec in zip(refs, lumas):
+        sc = score_planes(ref, dec)
+        ps.append(min(sc.psnr_db, PSNR_CAP_DB))
+        ss.append(sc.ssim)
+        vs.append(sc.vmaf)
+        kind = sc.vmaf_kind
+    n = max(1, len(ps))
+    return {"psnr_db": round(sum(ps) / n, 3), "ssim": round(sum(ss) / n, 5),
+            "vmaf": round(sum(vs) / n, 2), "vmaf_kind": kind,
+            "frames_scored": len(ps)}
+
+
+def _quality_point(scenario: str, refs: list[np.ndarray],
+                   aus: list[bytes], codec: str) -> dict | None:
+    """Decode one encoded stream through its oracle and score it.
+    None when the oracle dropped frames (refuse to mis-align)."""
+    from selkies_tpu.monitoring.quality import GopDecoder
+
+    lumas = GopDecoder(codec).decode_all(aus)
+    if len(lumas) < len(aus):
+        return None
+    kbps = (sum(len(a) for a in aus) * 8.0 * QUALITY_FPS
+            / max(1, len(aus)) / 1000.0)
+    return {"rate_kbps": round(kbps, 1),
+            **_mean_scores(refs, lumas[:len(refs)])}
+
+
+def bench_quality(scenarios: list[str], w: int, h: int,
+                  n_frames: int) -> list[dict]:
+    """Rate/quality suite: point rows (one per scenario x encoder x
+    rung) then bdrate rows (one per scenario x test-encoder x x264
+    anchor). x264/vp9 rungs are skipped with a stderr note when the
+    library is absent; BD-rate rows need >= 2 points per curve."""
+    from selkies_tpu.models.libvpx_enc import (
+        _bgrx_to_i420_np, libvpx_available)
+    from selkies_tpu.models.x264enc import X264Encoder, x264_available
+    from selkies_tpu.monitoring.quality import bd_rate
+
+    rows: list[dict] = []
+    for scen in scenarios:
+        trace = _scenario_trace(scen, n_frames, w, h, seed=11)
+        refs = [_bgrx_to_i420_np(f)[0] for f in trace]
+        curves: dict[str, list[tuple[float, float]]] = {}
+
+        def point(encoder: str, preset: str, aus: list[bytes],
+                  codec: str, scen=scen, refs=refs, curves=curves) -> None:
+            pt = _quality_point(scen, refs, aus, codec)
+            if pt is None:
+                print(json.dumps({
+                    "metric": f"quality {scen} {encoder} {preset} skipped",
+                    "note": "oracle dropped frames"}), file=sys.stderr)
+                return
+            curves.setdefault(encoder, []).append(
+                (pt["rate_kbps"], pt["psnr_db"]))
+            rows.append({"bench": "quality", "kind": "point",
+                         "scenario": scen, "encoder": encoder,
+                         "preset": preset, "codec": codec, **pt})
+
+        for qp in QUALITY_QP_LADDER:
+            aus = [a for a, _ in
+                   _encode_scenario_aus(scen, n_frames, w, h, qp=qp)]
+            point("tpuh264enc", f"qp{qp}", aus, "h264")
+        if x264_available():
+            for preset in QUALITY_X264_ANCHORS:
+                for kbps in QUALITY_RATE_LADDER:
+                    enc = X264Encoder(w, h, fps=int(QUALITY_FPS),
+                                      bitrate_kbps=kbps, preset=preset)
+                    aus = [enc.encode_frame(f) for f in trace]
+                    point(f"x264-{preset}", f"{kbps}kbps", aus, "h264")
+        else:
+            print(json.dumps({"metric": f"quality {scen} x264 skipped",
+                              "note": "libx264 unavailable"}),
+                  file=sys.stderr)
+        if libvpx_available():
+            from selkies_tpu.models.libvpx_enc import LibVpxEncoder
+
+            for kbps in QUALITY_RATE_LADDER:
+                enc = LibVpxEncoder(w, h, fps=int(QUALITY_FPS),
+                                    bitrate_kbps=kbps)
+                aus = [enc.encode_frame(f) for f in trace]
+                point("vp9", f"{kbps}kbps", aus, "vp9")
+        else:
+            print(json.dumps({"metric": f"quality {scen} vp9 skipped",
+                              "note": "libvpx unavailable"}),
+                  file=sys.stderr)
+
+        anchors = [e for e in curves if e.startswith("x264-")]
+        for encoder, pts in curves.items():
+            if encoder.startswith("x264-"):
+                continue
+            for anchor in anchors:
+                bd = bd_rate(curves[anchor], pts)
+                if bd is None:
+                    continue
+                rows.append({"bench": "quality", "kind": "bdrate",
+                             "scenario": scen, "encoder": encoder,
+                             "anchor": anchor,
+                             "bd_rate_pct": round(bd, 2)})
+    return rows
+
+
 def bench_convert_only() -> float:
     import jax
 
@@ -939,6 +1067,18 @@ def main() -> int:
         help="comma-separated scenarios to encode for the gauntlet "
              f"(default {','.join(IMPAIR_SCENARIOS)})")
     ap.add_argument(
+        "--quality", nargs="?", const="all", default=None,
+        help="rate/quality suite (or a comma scenario list: "
+             f"{', '.join(SCENARIOS)}): encode each scenario across the "
+             "tpuh264enc QP ladder plus x264-preset and vp9 bitrate "
+             "ladders, decode every stream through its reference oracle "
+             "and score PSNR/SSIM/VMAF vs the pre-encode source; point "
+             "rows per rung, BD-rate rows vs the x264 anchors. Runs "
+             "INSTEAD of the flagship row (docs/quality.md)")
+    ap.add_argument(
+        "--quality-frames", type=int, default=90,
+        help="frames per quality cell (every decoded frame is scored)")
+    ap.add_argument(
         "--codec", default=None,
         help="comma-separated codec sweep (h264,av1,vp9,...): one JSON "
              "line per codec at each --resolution, from the encoder row "
@@ -991,6 +1131,29 @@ def main() -> int:
                 f"impair {row['profile']} {row['scenario']} {label}",
                 float(row["recovered_ratio"]), unit="recovered_ratio",
                 **row, resolution=label)
+        return 0
+    if args.quality:
+        names = ([*SCENARIOS] if args.quality.strip().lower() == "all"
+                 else [s.strip().lower() for s in args.quality.split(",")
+                       if s.strip()])
+        for s in names:
+            if s not in SCENARIOS:
+                raise SystemExit(f"unknown scenario {s!r} (one of "
+                                 f"{list(SCENARIOS)})")
+        label, w, h = _parse_resolutions(args.resolution or "512x288")[0]
+        for row in bench_quality(names, w, h, max(30, args.quality_frames)):
+            if row["kind"] == "point":
+                _result(
+                    f"quality {row['scenario']} {row['encoder']} "
+                    f"{row['preset']} {label}",
+                    float(row["psnr_db"]), unit="psnr_db",
+                    **row, resolution=label)
+            else:
+                _result(
+                    f"bdrate {row['scenario']} {row['encoder']} "
+                    f"vs {row['anchor']} {label}",
+                    float(row["bd_rate_pct"]), unit="bd_rate_pct",
+                    **row, resolution=label)
         return 0
     if args.resolution is None:
         import jax

@@ -42,6 +42,13 @@ class CbrRateController:
     def vbv_size_bits(self) -> float:
         return self.frame_budget_bits * self.vbv_frames
 
+    @property
+    def fullness(self) -> float:
+        """VBV fullness normalized to the buffer size — the exported RC
+        state (telemetry's selkies_rc_fullness): 0 is neutral, 1.0 one
+        full VBV of accumulated debt, clamped to [-1, 4] by update()."""
+        return self._fullness / max(self.vbv_size_bits, 1.0)
+
     def set_bitrate(self, bitrate_kbps: int) -> None:
         """Live retune (UI 'vb' message or GCC estimate)."""
         if bitrate_kbps <= 0:

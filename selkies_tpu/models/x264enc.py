@@ -53,7 +53,7 @@ def _load_and_verify():
     if _lib_tried:
         return _lib
     _lib_tried = True
-    for name in ("libx264.so.164", "libx264.so", "x264"):
+    for name in ("libx264.so.164", "libx264.so.160", "libx264.so", "x264"):
         try:
             lib = ctypes.CDLL(name)
             break
@@ -62,10 +62,16 @@ def _load_and_verify():
     else:
         logger.info("libx264 not found; x264enc row unavailable")
         return None
-    try:
-        open_fn = lib.x264_encoder_open_164
-    except AttributeError:
-        logger.warning("libx264 present but not build 164; refusing ABI guess")
+    # builds 160-164 share every offset this wrapper pokes; the versioned
+    # open symbol names the build, and the verification below is what
+    # actually gates safety — an unexpected layout disables the row
+    for sym in ("x264_encoder_open_164", "x264_encoder_open_160"):
+        open_fn = getattr(lib, sym, None)
+        if open_fn is not None:
+            break
+    else:
+        logger.warning(
+            "libx264 present but no known open symbol; refusing ABI guess")
         return None
     lib._open = open_fn
     lib._open.restype = ctypes.c_void_p

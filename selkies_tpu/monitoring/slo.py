@@ -73,8 +73,11 @@ __all__ = ["SLOTargets", "SessionSLO", "OBJECTIVES", "slo_enabled",
 ENV_VAR = "SELKIES_SLO"
 
 # objective vocabulary (the `objective` label of the selkies_slo_*
-# families); each burns against its own allowance
-OBJECTIVES = ("latency_p50", "latency_p95", "fps", "downlink")
+# families); each burns against its own allowance. "quality" is the
+# optional min-PSNR floor fed by the sampled decode-and-compare probe
+# (monitoring/quality.py) — unbudgeted (never burns) unless the
+# scenario's SLOTargets sets psnr_floor_db > 0 AND a probe is wired.
+OBJECTIVES = ("latency_p50", "latency_p95", "fps", "downlink", "quality")
 
 # default burn-rate thresholds per objective: (fast-window, slow-window).
 # Half the frames over a p50 ceiling is burn 1.0 — the SLO exactly
@@ -91,18 +94,27 @@ DEFAULT_BURN: dict[str, tuple[float, float]] = {
     "latency_p95": (2.0, 1.0),
     "fps": (1.0, 1.0),
     "downlink": (1.25, 1.0),
+    # quality allows 5% of SAMPLES below the PSNR floor (the p95
+    # shape: burn = bad_fraction / 0.05, range 0..20) — one soft
+    # frame per ~100 s at the default sampling rate is budget, a
+    # sustained slump is a breach
+    "quality": (2.0, 1.0),
 }
 
 
 @dataclass(frozen=True)
 class SLOTargets:
     """One scenario class's objectives. ``down_kbps=0`` leaves the
-    downlink unbudgeted (the objective never burns)."""
+    downlink unbudgeted (the objective never burns); ``psnr_floor_db=0``
+    likewise leaves the quality objective unbudgeted — it only arms
+    when a scenario states a floor AND the SELKIES_QUALITY probe is
+    feeding samples (docs/quality.md)."""
 
     p50_ms: float = 250.0
     p95_ms: float = 600.0
     fps_floor: float = 10.0
     down_kbps: float = 0.0
+    psnr_floor_db: float = 0.0
 
 
 def slo_enabled() -> bool:
@@ -148,6 +160,7 @@ class SessionSLO:
                  recovery_evals: int = 3,
                  eval_interval_s: float = 1.0,
                  min_frames: int = 16,
+                 min_quality_samples: int = 4,
                  supervisor=None,
                  outlier: OutlierTrigger | None = None,
                  clock: Callable[[], float] = time.monotonic):
@@ -166,11 +179,17 @@ class SessionSLO:
         # session's first seconds (cold compiles, no client) are not an
         # SLO violation, and an fps floor over an empty window is noise
         self.min_frames = int(min_frames)
+        # the quality probe samples sparsely (one frame in ~300), so the
+        # quality objective has its own, much smaller traffic gate
+        self.min_quality_samples = max(1, int(min_quality_samples))
         self.supervisor = supervisor
         self.outlier = outlier if outlier is not None else OutlierTrigger()
         self.clock = clock
         # per-second bins: [sec:int, frames, bad_p50, bad_p95, bytes]
         self._bins: deque[list] = deque()
+        # quality sample bins: [sec:int, samples, below_floor]
+        self._qbins: deque[list] = deque()
+        self.quality_samples = 0
         self._state = {obj: _ObjectiveState() for obj in OBJECTIVES}
         self._last_eval = -1e18
         self.frames = 0
@@ -249,6 +268,28 @@ class SessionSLO:
                                 "latency_ms": round(latency_ms, 1),
                                 "rolling_p99_ms": round(p99, 1)})
 
+    def observe_quality(self, psnr_db: float,
+                        now: float | None = None) -> None:
+        """One scored quality sample from the decode-and-compare probe
+        (monitoring/quality.QualityProbe, thread-safe append shape:
+        the probe's background worker calls this). Judged against the
+        scenario's ``psnr_floor_db`` AT OBSERVATION TIME, like the
+        latency bins — a retarget never rewrites history."""
+        now = self.clock() if now is None else now
+        floor = self.targets.psnr_floor_db
+        bad = int(floor > 0 and psnr_db < floor)
+        sec = int(now)
+        bins = self._qbins
+        if bins and bins[-1][0] == sec:
+            bins[-1][1] += 1
+            bins[-1][2] += bad
+        else:
+            bins.append([sec, 1, bad])
+        cutoff = sec - int(self.slow_s) - 1
+        while bins and bins[0][0] < cutoff:
+            bins.popleft()
+        self.quality_samples += 1
+
     # -- burn computation ------------------------------------------------
 
     def _window(self, now: float, span_s: float) -> tuple[int, int, int, int, float]:
@@ -268,10 +309,28 @@ class SessionSLO:
         span = min(span_s, max(1.0, now - first)) if first is not None else 0.0
         return frames, bad50, bad95, nbytes, span
 
+    def _quality_window(self, now: float, span_s: float) -> tuple[int, int]:
+        """(samples, below_floor) over the last ``span_s`` seconds."""
+        cutoff = now - span_s
+        samples = bad = 0
+        for sec, n, b in reversed(self._qbins):
+            if sec < cutoff:
+                break
+            samples += n
+            bad += b
+        return samples, bad
+
     def _burns(self, now: float, span_s: float) -> dict[str, float]:
         frames, bad50, bad95, nbytes, span = self._window(now, span_s)
         t = self.targets
         out = dict.fromkeys(OBJECTIVES, 0.0)
+        # the quality objective gates on ITS OWN sparse sample count,
+        # not the frame gate — a probe at 1-in-300 sampling would never
+        # clear min_frames
+        if t.psnr_floor_db > 0:
+            qs, qbad = self._quality_window(now, span_s)
+            if qs >= self.min_quality_samples:
+                out["quality"] = (qbad / qs) / 0.05
         if frames < self.min_frames or span <= 0:
             return out
         out["latency_p50"] = (bad50 / frames) / 0.50
@@ -414,6 +473,7 @@ class SessionSLO:
         fires on_relief."""
         was = self._any_breached()
         self._bins.clear()
+        self._qbins.clear()
         self._state = {obj: _ObjectiveState() for obj in OBJECTIVES}
         self._last_eval = -1e18
         self.outlier.reset()
@@ -451,8 +511,10 @@ class SessionSLO:
             "scenario": self.scenario,
             "targets": {"p50_ms": t.p50_ms, "p95_ms": t.p95_ms,
                         "fps_floor": t.fps_floor,
-                        "down_kbps": t.down_kbps},
+                        "down_kbps": t.down_kbps,
+                        "psnr_floor_db": t.psnr_floor_db},
             "frames": self.frames,
+            "quality_samples": self.quality_samples,
             "evaluations": self.evaluations,
             "breaches": self.breaches,
             "outliers": self.outliers,

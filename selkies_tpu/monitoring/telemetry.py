@@ -180,8 +180,9 @@ METRIC_FAMILIES: dict[str, str] = {
         "keyframe_interval)",
     "selkies_slo_burn_rate":
         "SLO burn rate (observed badness / allowed badness) per session "
-        "and objective (latency_p50/latency_p95/fps/downlink) over the "
-        "fast (1-min) and slow (30-min) windows (monitoring/slo.py)",
+        "and objective (latency_p50/latency_p95/fps/downlink/quality) "
+        "over the fast (1-min) and slow (30-min) windows "
+        "(monitoring/slo.py)",
     "selkies_slo_breached":
         "SLO breach state per session and objective: 0 ok, 1 chronic "
         "(slow window over threshold), 2 acute (fast window over "
@@ -233,6 +234,26 @@ METRIC_FAMILIES: dict[str, str] = {
         "by the occupancy scheduler's overlap (parallel/occupancy.py): "
         "0 = fully serial, approaching 1-1/N when N equal sessions "
         "overlap perfectly; 1 - wall / sum(stage time) per tick",
+    "selkies_quality_psnr_db":
+        "Sampled decode-and-compare luma PSNR in dB "
+        "(monitoring/quality.py, SELKIES_QUALITY=1), labeled by session "
+        "and scenario; capped at 99 dB (= visually lossless)",
+    "selkies_quality_ssim":
+        "Sampled decode-and-compare luma SSIM (monitoring/quality.py), "
+        "labeled by session and scenario",
+    "selkies_quality_vmaf":
+        "Sampled VMAF-axis score 0-100 (monitoring/quality.py): the "
+        "real vmaf CLI when present, otherwise the documented "
+        "PSNR+SSIM proxy composite — the quality_sample ring event's "
+        "vmaf_kind says which; labeled by session and scenario",
+    "selkies_rc_qp":
+        "Per-frame quantizer the encoder actually used (the CBR "
+        "controller's output — models/h264/ratecontrol.py), labeled by "
+        "session; the RC state the quality axis correlates with",
+    "selkies_rc_fullness":
+        "CBR leaky-bucket VBV fullness per encoded frame, normalized to "
+        "the VBV size (0 = midpoint-neutral, 1 = one full VBV of debt, "
+        "clamps at -1 and 4 — ratecontrol.py), labeled by session",
 }
 
 # canonical label names per family (order fixed for the Prometheus
@@ -275,12 +296,32 @@ _FAMILY_LABELS: dict[str, tuple[str, ...]] = {
     "selkies_cluster_redirects_total": ("reason",),
     "selkies_cluster_migrations_total": ("direction", "result"),
     "selkies_occupancy_overlap_ratio": (),
+    "selkies_quality_psnr_db": ("session", "scenario"),
+    "selkies_quality_ssim": ("session", "scenario"),
+    "selkies_quality_vmaf": ("session", "scenario"),
+    "selkies_rc_qp": ("session",),
+    "selkies_rc_fullness": ("session",),
 }
 
 _HIST_BUCKETS: dict[str, tuple[float, ...]] = {
     "selkies_stage_ms": STAGE_BUCKETS_MS,
     "selkies_frame_bytes": FRAME_BYTE_BUCKETS,
     "selkies_compile_ms": COMPILE_BUCKETS_MS,
+    # quality axes (monitoring/quality.py): PSNR edges straddle the
+    # 30-40 dB band where streaming encodes actually live; SSIM edges
+    # compress toward 1.0 the same way the scores do
+    "selkies_quality_psnr_db": (20.0, 24.0, 28.0, 30.0, 32.0, 34.0, 36.0,
+                                38.0, 40.0, 44.0, 50.0, 99.0),
+    "selkies_quality_ssim": (0.5, 0.7, 0.8, 0.85, 0.9, 0.93, 0.95, 0.97,
+                             0.98, 0.99, 0.995, 1.0),
+    "selkies_quality_vmaf": (10.0, 20.0, 30.0, 40.0, 50.0, 60.0, 70.0,
+                             80.0, 90.0, 95.0, 99.0),
+    # RC state: the H.264 QP range and the controller's clamped
+    # normalized VBV fullness [-1, 4] (models/h264/ratecontrol.py)
+    "selkies_rc_qp": (10.0, 14.0, 18.0, 22.0, 26.0, 30.0, 34.0, 38.0,
+                      42.0, 46.0, 51.0),
+    "selkies_rc_fullness": (-1.0, -0.5, -0.25, 0.0, 0.25, 0.5, 1.0, 2.0,
+                            3.0, 4.0),
 }
 
 
@@ -501,7 +542,8 @@ class Telemetry:
                    pack_ms: float = 0.0, unpack_ms: float = 0.0,
                    cavlc_ms: float = 0.0, downlink_mode: str = "",
                    bits_fetch_ms: float = 0.0, classify_ms: float = 0.0,
-                   convert_ms: float = 0.0, h2d_ms: float = 0.0) -> None:
+                   convert_ms: float = 0.0, h2d_ms: float = 0.0,
+                   qp: int = 0, rc_fullness: float | None = None) -> None:
         """An encoded access unit left the encoder: fold its size, kind,
         and on-device / entropy-pack milliseconds. unpack/cavlc are the
         completion sub-stages of pack_ms (coefficient prep vs the CAVLC
@@ -514,7 +556,11 @@ class Telemetry:
         uplink front-end sub-stages of the frame's upload cost (fused
         dirty scan + hash/split, BGRx->I420 of the upload payload, h2d
         transfer enqueues — ISSUE 12): without this split a regression
-        in the host front-end hides inside the device stage again."""
+        in the host front-end hides inside the device stage again.
+        qp (>0) and rc_fullness (None = unattributed; 0.0 is a real
+        reading) export the rate-control state the quality axis
+        correlates with — the frame's actual quantizer and the CBR
+        VBV fullness normalized to the buffer size."""
         if not self.enabled:
             return
         self._observe("selkies_frame_bytes", nbytes, {"session": session})
@@ -549,12 +595,19 @@ class Telemetry:
         if h2d_ms:
             self._observe("selkies_stage_ms", h2d_ms,
                           {"stage": "h2d", "session": session})
+        if qp > 0:
+            self._observe("selkies_rc_qp", qp, {"session": session})
+        if rc_fullness is not None:
+            self._observe("selkies_rc_fullness", rc_fullness,
+                          {"session": session})
         self._record(session, {"ev": "frame", "fid": frame, "bytes": nbytes,
                                "idr": idr, "device_ms": round(device_ms, 3),
                                "pack_ms": round(pack_ms, 3),
                                "unpack_ms": round(unpack_ms, 3),
                                "cavlc_ms": round(cavlc_ms, 3),
-                               "mode": downlink_mode})
+                               "mode": downlink_mode, "qp": qp,
+                               **({"vbv": round(rc_fullness, 3)}
+                                  if rc_fullness is not None else {})})
 
     def event(self, kind: str, *, session: str = "0", **fields) -> None:
         """A first-class timeline event for the flight-recorder rings —

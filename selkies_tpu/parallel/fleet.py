@@ -988,7 +988,9 @@ class SessionFleet:
                         telemetry.frame_done(
                             fid, len(au), idr=idr, session=str(k),
                             device_ms=tick_ms,
-                            downlink_mode=modes[k] if k < len(modes) else "")
+                            downlink_mode=modes[k] if k < len(modes) else "",
+                            qp=qp,
+                            rc_fullness=getattr(slot.rc, "fullness", None))
                     sends.append((k, slot.transport.send_video(ef)))
                 if sends:
                     results = await asyncio.gather(

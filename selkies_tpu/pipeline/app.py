@@ -220,6 +220,40 @@ class TPUWebRTCApp:
             telemetry.register_provider("compile", jitprof.stats)
             telemetry.register_slo(self._slo_health)
 
+        # decode-and-compare quality probe (monitoring/quality.py,
+        # SELKIES_QUALITY=1): samples 1-in-N delivered frames, decodes
+        # the enclosing GOP through the codec's reference oracle on a
+        # background worker and scores PSNR/SSIM/VMAF against the
+        # pre-encode source. A telemetry consumer like the SLO plane,
+        # so opting in turns the bus on; scores also feed the SLO
+        # quality objective when both planes are armed.
+        self.quality = None
+        from selkies_tpu.monitoring.quality import (
+            QualityProbe, decoder_available, quality_enabled)
+
+        if quality_enabled():
+            codec = getattr(self.encoder, "codec", "h264")
+            if not decoder_available(codec):
+                logger.warning(
+                    "SELKIES_QUALITY=1 but no decode oracle for %r; "
+                    "quality probe disabled", codec)
+            else:
+                telemetry.enable()
+                self.quality = QualityProbe(
+                    session="0", codec=codec, slo=self.slo)
+                if self.policy_engine is not None:
+                    # scenario transitions retag quality samples too;
+                    # chain rather than replace the SLO retarget hook
+                    prev = self.policy_engine.on_scenario
+
+                    def _on_scenario(name: str, _prev=prev) -> None:
+                        if _prev is not None:
+                            _prev(name)
+                        self.quality.set_scenario(name)
+
+                    self.policy_engine.on_scenario = _on_scenario
+                telemetry.register_provider("quality", self._quality_stats)
+
         # /statz live read-side: the encoder's link-byte counters (reads
         # through self.encoder so supervisor swaps/rebuilds stay covered)
         # and the pipeline's frame/drop accounting
@@ -228,6 +262,10 @@ class TPUWebRTCApp:
 
     def _slo_stats(self) -> dict:
         return {"0": self.slo.stats()} if self.slo is not None else {}
+
+    def _quality_stats(self) -> dict:
+        return ({"0": self.quality.stats()}
+                if self.quality is not None else {})
 
     def _slo_health(self) -> dict:
         return {"0": self.slo.health_view()} if self.slo is not None else {}
@@ -286,6 +324,7 @@ class TPUWebRTCApp:
         self.pipeline.supervisor = self.supervisor
         self.pipeline.on_device_fault = self._on_device_fault
         self.pipeline.slo = self.slo
+        self.pipeline.quality = self.quality
         if self.policy_engine is not None:
             from selkies_tpu.policy import PolicyRuntime
 

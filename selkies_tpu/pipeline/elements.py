@@ -296,6 +296,12 @@ class VideoPipeline:
         # frame and retunes the encoder's runtime-safe knobs. Its tick
         # NEVER raises (a wedged engine disarms back to static knobs).
         self.policy = None
+        # optional decode-and-compare quality probe (monitoring/quality.py),
+        # wired by TPUWebRTCApp when SELKIES_QUALITY=1: samples 1-in-N
+        # captures, decodes the GOP through the codec's oracle off-thread
+        # and scores PSNR/SSIM/VMAF against the pre-encode source. None
+        # (the default) keeps the hot path untouched by construction.
+        self.quality = None
         self._last_tick_t = 0.0
         # frames a policy drain completed on the to_thread worker; the
         # loop delivers them right after the tick await (asyncio.Event
@@ -460,6 +466,10 @@ class VideoPipeline:
                         continue
                 qp = self.rc.frame_qp()
                 ts = int((time.monotonic() - t0) * 90000)
+                if self.quality is not None:
+                    # sampled frames retain a pre-encode I420 luma copy,
+                    # keyed by the same 90 kHz ts the AU will carry back
+                    self.quality.note_frame(ts, frame)
                 if fi is not None:
                     act = fi.check("encoder")
                     if act is not None and act[0] == "delay":
@@ -515,6 +525,10 @@ class VideoPipeline:
                 for ef in efs:
                     self.rc.update(len(ef.au), idr=ef.idr or ef.scene_cut)
                 self.frames += len(efs)
+                if self.quality is not None:
+                    for ef in efs:
+                        self.quality.note_au(ef.timestamp_90k, ef.au,
+                                             ef.idr or ef.scene_cut)
                 if telemetry.enabled:
                     for ef in efs:
                         telemetry.frame_done(
@@ -527,7 +541,9 @@ class VideoPipeline:
                             downlink_mode=ef.downlink_mode,
                             bits_fetch_ms=(ef.fetch_ms
                                            if ef.downlink_mode == "bits"
-                                           else 0.0))
+                                           else 0.0),
+                            qp=ef.qp,
+                            rc_fullness=getattr(self.rc, "fullness", None))
                 failures = 0
                 if self.supervisor is not None:
                     self.supervisor.tick_ok()
@@ -648,6 +664,9 @@ class VideoPipeline:
                                      self._fid_by_ts.pop(meta, 0))
             self.rc.update(len(ef.au), idr=ef.idr or ef.scene_cut)
             self.frames += 1
+            if self.quality is not None:
+                self.quality.note_au(ef.timestamp_90k, ef.au,
+                                     ef.idr or ef.scene_cut)
             if telemetry.enabled:
                 telemetry.frame_done(
                     ef.frame_id, len(ef.au), idr=ef.idr,
@@ -657,7 +676,9 @@ class VideoPipeline:
                     convert_ms=ef.convert_ms, h2d_ms=ef.h2d_ms,
                     downlink_mode=ef.downlink_mode,
                     bits_fetch_ms=(ef.fetch_ms
-                                   if ef.downlink_mode == "bits" else 0.0))
+                                   if ef.downlink_mode == "bits" else 0.0),
+                    qp=ef.qp,
+                    rc_fullness=getattr(self.rc, "fullness", None))
             self._policy_drained.append(ef)
 
     async def _send_loop(self) -> None:

@@ -1,0 +1,481 @@
+"""The rate/quality observability plane (monitoring/quality.py,
+docs/quality.md): metric kernels (PSNR=inf/SSIM=1.0 on identity, a
+seeded noise ladder strictly monotone), decode-oracle round-trips for
+every codec with an oracle in this image, the live QualityProbe's
+sampling/scoring/drop accounting, the SLO ``quality`` burn objective,
+the RC telemetry (selkies_rc_qp / selkies_rc_fullness), BD-rate, the
+``SELKIES_QUALITY=0`` byte-identity off switch, and the quality ratchet
+(tools/check_bench_regress.py --quality vs BENCH_quality_r01.json)."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from selkies_tpu.monitoring.flightrecorder import FlightRecorder
+from selkies_tpu.monitoring.quality import (
+    PSNR_CAP_DB,
+    GopDecoder,
+    QualityProbe,
+    bd_rate,
+    decoder_available,
+    psnr_db,
+    quality_enabled,
+    score_planes,
+    ssim,
+    vmaf_proxy,
+)
+from selkies_tpu.monitoring.slo import SessionSLO, SLOTargets
+from selkies_tpu.monitoring.telemetry import telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+W, H = 256, 160
+
+
+@pytest.fixture
+def tele(tmp_path):
+    telemetry.reset()
+    telemetry.enabled = True
+    telemetry.recorder = FlightRecorder(out_dir=str(tmp_path / "bb"))
+    yield telemetry
+    telemetry.enabled = False
+    telemetry.reset()
+
+
+def _trace(n=8, static=()):
+    from conftest import codec_trace
+
+    return codec_trace(n, W, H, static=static)
+
+
+def _ref_luma(frame_bgrx):
+    from selkies_tpu.models.libvpx_enc import _bgrx_to_i420_np
+
+    return _bgrx_to_i420_np(frame_bgrx)[0]
+
+
+# -- metric kernels ----------------------------------------------------------
+
+
+def test_identical_planes_score_perfect():
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 255, (H, W)).astype(np.uint8)
+    assert psnr_db(y, y) == math.inf
+    assert ssim(y, y) == pytest.approx(1.0)
+    sc = score_planes(y, y)
+    assert sc.vmaf_kind == "proxy"
+    # the emitted form caps PSNR so series/JSON stay finite
+    assert sc.as_dict()["psnr_db"] == PSNR_CAP_DB
+    assert sc.as_dict()["vmaf"] == pytest.approx(100.0)
+
+
+def test_noise_ladder_strictly_monotone():
+    """More injected noise must score strictly worse on every axis —
+    the property the probe's consumers (SLO floor, bench ladder)
+    actually rely on."""
+    rng = np.random.default_rng(1)
+    y = rng.integers(40, 200, (H, W)).astype(np.uint8)
+    scores = []
+    for sigma in (1.0, 3.0, 6.0, 12.0, 24.0):
+        noise = np.random.default_rng(2).normal(0.0, sigma, y.shape)
+        noisy = np.clip(y.astype(np.float64) + noise, 0, 255).astype(np.uint8)
+        scores.append(score_planes(y, noisy))
+    for a, b in zip(scores, scores[1:]):
+        assert a.psnr_db > b.psnr_db
+        assert a.ssim > b.ssim
+        assert a.vmaf > b.vmaf
+
+
+def test_plane_shape_mismatch_raises():
+    a = np.zeros((32, 32), np.uint8)
+    b = np.zeros((32, 48), np.uint8)
+    with pytest.raises(ValueError):
+        psnr_db(a, b)
+    with pytest.raises(ValueError):
+        ssim(a, b)
+
+
+def test_vmaf_proxy_bounds_and_rank():
+    assert vmaf_proxy(math.inf, 1.0) == pytest.approx(100.0)
+    assert vmaf_proxy(10.0, 0.1) == 0.0
+    assert 0.0 <= vmaf_proxy(35.0, 0.9) <= 100.0
+    assert vmaf_proxy(40.0, 0.95) > vmaf_proxy(35.0, 0.9)
+
+
+# -- decode oracles ----------------------------------------------------------
+
+
+@pytest.mark.skipif(not decoder_available("h264"),
+                    reason="cv2/FFmpeg H.264 oracle not present")
+def test_h264_oracle_round_trips_tpu_stream():
+    from selkies_tpu.models.h264.encoder import TPUH264Encoder
+
+    frames = _trace(6)
+    enc = TPUH264Encoder(W, H, qp=24)
+    try:
+        aus = [enc.encode_frame(f) for f in frames]
+    finally:
+        enc.close()
+    lumas = GopDecoder("h264").decode_all(aus)
+    assert len(lumas) == len(aus)
+    for f, y in zip(frames, lumas):
+        assert y.shape == (H, W)
+        # the oracle's BGR round-trip costs ~2-3 dB on chroma-heavy
+        # content; 26 dB still rules out mis-decoded/mis-aligned frames
+        assert psnr_db(_ref_luma(f), y) > 26.0
+
+
+@pytest.mark.skipif(not decoder_available("vp9"),
+                    reason="libvpx not present")
+def test_vp9_oracle_round_trips_stream():
+    from selkies_tpu.models.libvpx_enc import LibVpxEncoder
+
+    frames = _trace(6)
+    enc = LibVpxEncoder(W, H, fps=30, bitrate_kbps=4000)
+    try:
+        aus = [enc.encode_frame(f) for f in frames]
+    finally:
+        enc.close()
+    lumas = GopDecoder("vp9").decode_all(aus)
+    assert len(lumas) == len(aus)
+    for f, y in zip(frames, lumas):
+        assert psnr_db(_ref_luma(f), y) > 28.0
+
+
+def _libaom_available():
+    from selkies_tpu.models.libaom_enc import libaom_available
+
+    return libaom_available()
+
+
+@pytest.mark.skipif(not decoder_available("av1") or not _libaom_available(),
+                    reason="libaom/libdav1d not present")
+def test_av1_oracle_round_trips_stream():
+    from selkies_tpu.models.libaom_enc import LibAomEncoder
+
+    frames = _trace(6)
+    enc = LibAomEncoder(W, H, fps=30, bitrate_kbps=4000)
+    try:
+        aus = [enc.encode_frame(f) for f in frames]
+    finally:
+        enc.close()
+    lumas = GopDecoder("av1").decode_all(aus)
+    assert len(lumas) == len(aus)
+    for f, y in zip(frames, lumas):
+        assert psnr_db(_ref_luma(f), y) > 28.0
+
+
+def test_decode_last_refuses_held_back_frames():
+    assert GopDecoder("h264").decode_last([]) is None
+    with pytest.raises(ValueError):
+        GopDecoder("h265")
+
+
+# -- the live probe ----------------------------------------------------------
+
+
+def _h264_aus(frames, qp=24):
+    from selkies_tpu.models.h264.encoder import TPUH264Encoder
+
+    enc = TPUH264Encoder(W, H, qp=qp)
+    try:
+        return [enc.encode_frame(f) for f in frames]
+    finally:
+        enc.close()
+
+
+@pytest.mark.skipif(not decoder_available("h264"),
+                    reason="cv2/FFmpeg H.264 oracle not present")
+def test_probe_scores_sampled_frames_and_emits(tele):
+    frames = _trace(6)
+    aus = _h264_aus(frames)
+    slo = SessionSLO(
+        session="7",
+        targets={"unknown": SLOTargets(psnr_floor_db=20.0)},
+        min_quality_samples=1)
+    probe = QualityProbe(session="7", codec="h264", scenario="typing",
+                         sample_every=3, slo=slo, sync=True)
+    for i, (f, au) in enumerate(zip(frames, aus)):
+        probe.note_frame(i, f)
+        probe.note_au(i, au, idr=(i == 0))
+    st = probe.stats()
+    assert st["frames_seen"] == len(frames)
+    assert st["scored"] == 2 and st["errors"] == 0    # frames 3 and 6
+    assert st["mean"]["psnr_db"] > 25.0
+    assert st["last"]["vmaf_kind"] == "proxy"
+    hists = tele.rollup()["histograms"]
+    key = "session=7,scenario=typing"
+    assert hists["selkies_quality_psnr_db"][key]["count"] == 2
+    assert hists["selkies_quality_ssim"][key]["count"] == 2
+    assert hists["selkies_quality_vmaf"][key]["count"] == 2
+    evs = [e for e in tele.recorder.events("7")
+           if e["ev"] == "quality_sample"]
+    assert len(evs) == 2 and evs[-1]["gop_frames"] >= 1
+    assert slo.quality_samples == 2
+    probe.close()
+
+
+@pytest.mark.skipif(not decoder_available("h264"),
+                    reason="cv2/FFmpeg H.264 oracle not present")
+def test_probe_gop_overflow_goes_quiet_until_idr(tele):
+    frames = _trace(8)
+    aus = _h264_aus(frames)
+    probe = QualityProbe(session="0", codec="h264", sample_every=1,
+                         max_gop=3, sync=True)
+    for i, (f, au) in enumerate(zip(frames[:6], aus[:6])):
+        probe.note_frame(i, f)
+        probe.note_au(i, au, idr=(i == 0))
+    st = probe.stats()
+    assert st["dropped_gop"] > 0                      # overflow counted
+    scored_before = st["scored"]
+    # an IDR re-arms the buffer: scoring resumes
+    probe.note_frame(6, frames[6])
+    probe.note_au(6, aus[0], idr=True)
+    assert probe.stats()["scored"] == scored_before + 1
+    probe.close()
+
+
+def test_probe_without_oracle_is_a_noop():
+    probe = QualityProbe(session="0", codec="h266")
+    probe.note_frame(0, np.zeros((H, W, 4), np.uint8))
+    probe.note_au(0, b"\x00\x00\x00\x01", idr=True)
+    assert probe.stats()["oracle"] is False
+    assert probe.stats()["samples"] == 0
+
+
+@pytest.mark.skipif(not decoder_available("h264"),
+                    reason="cv2/FFmpeg H.264 oracle not present")
+def test_quality_off_is_byte_identical():
+    """SELKIES_QUALITY=0 (the default) constructs no probe; with one
+    attached, the probe only READS (ts, frame, au) — the encoded bytes
+    must be sha256-identical either way."""
+    assert not quality_enabled()          # default posture: off
+    frames = _trace(6)
+
+    def run(with_probe: bool) -> str:
+        h = hashlib.sha256()
+        probe = QualityProbe(session="0", codec="h264", sample_every=2,
+                             sync=True) if with_probe else None
+        for i, (f, au) in enumerate(zip(frames, _h264_aus(frames))):
+            if probe is not None:
+                probe.note_frame(i, f)
+            h.update(au)
+            if probe is not None:
+                probe.note_au(i, au, idr=(i == 0))
+        if probe is not None:
+            assert probe.stats()["scored"] > 0   # the probe really ran
+        return h.hexdigest()
+
+    assert run(False) == run(True)
+
+
+# -- the SLO quality objective ----------------------------------------------
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self, dt: float) -> None:
+        self.t += dt
+
+
+def test_slo_quality_burn_and_reset(tele):
+    clock = FakeClock()
+    slo = SessionSLO(
+        session="0",
+        targets={"unknown": SLOTargets(psnr_floor_db=35.0)},
+        fast_s=10.0, slow_s=60.0, clock=clock, min_quality_samples=4)
+    for _ in range(8):
+        clock.tick(0.5)
+        slo.observe_quality(30.0)                 # all below the floor
+    burns = slo._burns(clock(), slo.fast_s)
+    assert burns["quality"] == pytest.approx((8 / 8) / 0.05)
+    # below the sample gate nothing burns
+    slo2 = SessionSLO(
+        session="1",
+        targets={"unknown": SLOTargets(psnr_floor_db=35.0)},
+        clock=clock, min_quality_samples=4)
+    for _ in range(3):
+        slo2.observe_quality(10.0)
+    assert slo2._burns(clock(), slo2.fast_s)["quality"] == 0.0
+    # no floor => the objective never arms, however bad the samples
+    slo3 = SessionSLO(session="2", clock=clock, min_quality_samples=1)
+    for _ in range(8):
+        slo3.observe_quality(5.0)
+    assert slo3._burns(clock(), slo3.fast_s)["quality"] == 0.0
+    # reset clears the windows (lifetime counter survives for /statz)
+    slo.reset()
+    assert slo._burns(clock(), slo.fast_s)["quality"] == 0.0
+    assert slo.stats()["quality_samples"] == 8
+
+
+def test_slo_quality_floor_judged_at_observation_time(tele):
+    clock = FakeClock()
+    targets = {"unknown": SLOTargets(psnr_floor_db=0.0),
+               "video": SLOTargets(psnr_floor_db=35.0)}
+    slo = SessionSLO(session="0", targets=targets, clock=clock,
+                     min_quality_samples=1)
+    for _ in range(4):
+        clock.tick(0.5)
+        slo.observe_quality(30.0)     # floor 0 at observation: not bad
+    slo.set_scenario("video")
+    assert slo._burns(clock(), slo.fast_s)["quality"] == 0.0
+
+
+# -- RC telemetry (frame_done qp / fullness) ---------------------------------
+
+
+def test_frame_done_exports_rc_histograms(tele):
+    tele.frame_done(1, 5000, idr=False, session="3", qp=28,
+                    rc_fullness=0.4)
+    tele.frame_done(2, 5000, idr=False, session="3", qp=31,
+                    rc_fullness=-0.2)
+    hists = tele.rollup()["histograms"]
+    assert hists["selkies_rc_qp"]["session=3"]["count"] == 2
+    assert hists["selkies_rc_fullness"]["session=3"]["count"] == 2
+    # the flight-recorder frame record carries both
+    recs = [e for e in tele.recorder.events("3") if e["ev"] == "frame"]
+    assert recs[-1]["qp"] == 31 and recs[-1]["vbv"] == -0.2
+    # qp=0 (unknown) and fullness None (no RC in the path) stay silent
+    tele.frame_done(3, 5000, idr=False, session="4")
+    hists = tele.rollup()["histograms"]
+    assert "session=4" not in hists.get("selkies_rc_qp", {})
+    assert "session=4" not in hists.get("selkies_rc_fullness", {})
+
+
+def test_rate_controller_exposes_normalized_fullness():
+    from selkies_tpu.models.h264.ratecontrol import CbrRateController
+
+    rc = CbrRateController(bitrate_kbps=2000, fps=60)
+    assert rc.fullness == 0.0
+    rc.update(200_000)                    # massive frame: clamps at 4x
+    assert rc.fullness == pytest.approx(4.0)
+    rc2 = CbrRateController(bitrate_kbps=2000, fps=60)
+    rc2.update(0)                         # under budget: goes negative
+    assert -1.0 <= rc2.fullness < 0.0
+
+
+# -- BD-rate -----------------------------------------------------------------
+
+
+def test_bd_rate_halved_rate_is_minus_fifty():
+    anchor = [(1000.0, 30.0), (2000.0, 35.0), (4000.0, 40.0)]
+    test = [(r / 2.0, q) for r, q in anchor]
+    assert bd_rate(anchor, test) == pytest.approx(-50.0, abs=0.5)
+    assert bd_rate(anchor, anchor) == pytest.approx(0.0, abs=1e-6)
+    # degenerate inputs refuse rather than extrapolate
+    assert bd_rate(anchor, [(1000.0, 30.0)]) is None
+    assert bd_rate(anchor, [(100.0, 80.0), (200.0, 90.0)]) is None
+
+
+# -- the quality ratchet (check_bench_regress --quality) ---------------------
+
+
+def _run_ratchet(args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "check_bench_regress.py"), *args],
+        capture_output=True, text=True, cwd=REPO)
+
+
+def test_check_bench_regress_quality_tolerances(tmp_path):
+    base = tmp_path / "base.jsonl"
+    base.write_text("\n".join(json.dumps(r) for r in [
+        {"bench": "quality", "kind": "point", "scenario": "typing",
+         "encoder": "tpuh264enc", "preset": "qp28",
+         "resolution": "512x288", "rate_kbps": 800.0, "psnr_db": 42.0},
+        {"bench": "quality", "kind": "bdrate", "scenario": "typing",
+         "encoder": "tpuh264enc", "anchor": "x264-ultrafast",
+         "resolution": "512x288", "bd_rate_pct": -15.0},
+    ]) + "\n")
+    ok = tmp_path / "ok.jsonl"
+    ok.write_text("\n".join(json.dumps(r) for r in [
+        {"bench": "quality", "kind": "point", "scenario": "typing",
+         "encoder": "tpuh264enc", "preset": "qp28",
+         "resolution": "512x288", "rate_kbps": 820.0, "psnr_db": 41.0},
+        {"bench": "quality", "kind": "bdrate", "scenario": "typing",
+         "encoder": "tpuh264enc", "anchor": "x264-ultrafast",
+         "resolution": "512x288", "bd_rate_pct": -8.0},
+    ]) + "\n")
+    proc = _run_ratchet(["--quality", "--run-file", str(ok),
+                         "--quality-baseline", str(base)])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("\n".join(json.dumps(r) for r in [
+        {"bench": "quality", "kind": "point", "scenario": "typing",
+         "encoder": "tpuh264enc", "preset": "qp28",
+         "resolution": "512x288", "rate_kbps": 820.0, "psnr_db": 38.0},
+        {"bench": "quality", "kind": "bdrate", "scenario": "typing",
+         "encoder": "tpuh264enc", "anchor": "x264-ultrafast",
+         "resolution": "512x288", "bd_rate_pct": 20.0},
+    ]) + "\n")
+    proc = _run_ratchet(["--quality", "--run-file", str(bad),
+                         "--quality-baseline", str(base)])
+    assert proc.returncode == 1
+    assert "psnr_db" in proc.stdout and "bd_rate_pct" in proc.stdout
+
+    # novel rungs are skipped, not failed
+    novel = tmp_path / "novel.jsonl"
+    novel.write_text(json.dumps(
+        {"bench": "quality", "kind": "point", "scenario": "typing",
+         "encoder": "tpuh264enc", "preset": "qp44",
+         "resolution": "512x288", "rate_kbps": 100.0,
+         "psnr_db": 20.0}) + "\n")
+    proc = _run_ratchet(["--quality", "--run-file", str(novel),
+                         "--quality-baseline", str(base)])
+    assert proc.returncode == 0
+    assert "skip" in proc.stdout
+
+    # a missing baseline is a setup error, not a silent pass
+    proc = _run_ratchet(["--quality", "--run-file", str(ok),
+                         "--quality-baseline",
+                         str(tmp_path / "absent.json")])
+    assert proc.returncode == 2
+
+
+def test_committed_quality_record_parses_and_covers_the_criteria():
+    """BENCH_quality_r01.json must carry per-scenario point rows for
+    tpuh264enc plus a second codec, and BD-rate rows against >= 2 x264
+    preset anchors (the acceptance shape docs/quality.md promises)."""
+    path = os.path.join(REPO, "BENCH_quality_r01.json")
+    rows = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            if line.strip().startswith("{"):
+                rows.append(json.loads(line))
+    points = [r for r in rows if r.get("bench") == "quality"
+              and r.get("kind") == "point"]
+    bdrates = [r for r in rows if r.get("bench") == "quality"
+               and r.get("kind") == "bdrate"]
+    assert points and bdrates
+    encoders = {r["encoder"] for r in points}
+    assert "tpuh264enc" in encoders
+    assert encoders & {"vp9", "av1"}, "a second codec row is required"
+    anchors = {r["anchor"] for r in bdrates if r["encoder"] == "tpuh264enc"}
+    assert len(anchors) >= 2, "BD-rate needs >= 2 x264 preset anchors"
+    for r in points:
+        assert r["vmaf_kind"] in ("cli", "proxy")
+        assert 0 < r["psnr_db"] <= PSNR_CAP_DB
+
+
+@pytest.mark.slow
+def test_bench_quality_ratchet():
+    """The real quality ratchet: a fresh bench.py --quality run over the
+    committed scenarios vs BENCH_quality_r01.json (slow: encodes every
+    ladder rung on CPU)."""
+    proc = _run_ratchet(["--quality"])
+    sys.stdout.write(proc.stdout)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,13 +1,18 @@
 #!/usr/bin/env python3
-"""Perf ratchet: a fresh ``bench.py --scenario`` run vs the committed rows.
+"""Perf ratchet: a fresh ``bench.py`` run vs the committed rows.
 
-The scenario bench rows (BENCH_scenarios_r02.json) are the repo's
-latency/throughput ground truth — PERF.md's cost models and the SLO
-objectives (docs/slo.md) are both derived from them — but nothing
+The committed bench rows are the repo's ground truth — PERF.md's cost
+models, the SLO objectives (docs/slo.md), the cluster's capacity
+promises and the quality floors are all derived from them — but nothing
 re-ran them between PRs, so a regression surfaced only when the next
-perf round happened to look. This ratchet runs the scenario suite and
-compares each row against its committed counterpart (matched on
-scenario + policy + damage + resolution) with **stated tolerances**:
+perf round happened to look. This ratchet re-runs one bench suite and
+compares each row against its committed counterpart with **stated
+tolerances**. All four suites share one runner/comparison core; a mode
+is just a row predicate, a match key, the bench.py argv to refresh the
+rows, and a tolerance table.
+
+Default (scenario) mode, vs ``BENCH_scenarios_r02.json`` — rows match
+on scenario + policy + damage + resolution:
 
 * ``fps`` may drop to ``(1 - tol_fps)`` of the committed value
   (default tol 0.40 — generous because the committed rows were measured
@@ -21,30 +26,40 @@ scenario + policy + damage + resolution) with **stated tolerances**:
   leg arms automatically once a future bench round commits rows that
   carry it; absent baseline fields never fail.)
 
-Scenario rows whose baseline is missing are reported and skipped. The
-frame count defaults to the committed rows' 240 — short runs are NOT
-comparable (an idle pass at 60 frames has ~2 active frames, so its p50
-is just the IDR's latency).
+Rows whose baseline is missing are reported and skipped in every mode.
+The scenario frame count defaults to the committed rows' 240 — short
+runs are NOT comparable (an idle pass at 60 frames has ~2 active
+frames, so its p50 is just the IDR's latency).
 
-``--capacity`` switches the ratchet to the **capacity curve** instead
-(``bench.py --capacity`` vs the committed ``BENCH_capacity_r01.json``):
-rows match on mix + mode + chips + codec + resolution, and each fresh
-``max_sessions_at_slo`` may drop at most ``--tol-sessions`` (default 1
-— the curve is a small integer measured on a shared container) below
-its committed value. A capacity regression means the occupancy
-scheduler (or the serial tick it falls back to) serves fewer sessions
-at SLO than the fleet's routers were told to expect
+``--capacity`` ratchets the **capacity curve** (``bench.py --capacity``
+vs ``BENCH_capacity_r01.json``): rows match on mix + mode + chips +
+codec + resolution, and each fresh ``max_sessions_at_slo`` may drop at
+most ``--tol-sessions`` (default 1 — the curve is a small integer
+measured on a shared container) below its committed value. A capacity
+regression means the occupancy scheduler serves fewer sessions at SLO
+than the fleet's routers were told to expect
 (``SELKIES_CAPACITY_FILE`` → ``measured_max_sessions``,
 cluster/membership.py).
 
 ``--impair`` ratchets the **impairment gauntlet** (``bench.py --impair``
-vs the committed ``BENCH_impair_r01.json``): rows match on profile +
-scenario + resolution; ``recovered_ratio`` may drop at most
-``--tol-recovered`` (absolute, default 0.05) below its committed value
-and ``recovery_ms_p95`` may grow to ``(1 + tol_p95)`` of it (default
-0.75 — the gauntlet clock is simulated so the slack covers ladder-
-tuning drift, not host noise). An impairment regression means frames
-freeze on links the recovery ladder (docs/recovery.md) used to survive.
+vs ``BENCH_impair_r01.json``): rows match on profile + scenario +
+resolution; ``recovered_ratio`` may drop at most ``--tol-recovered``
+(absolute, default 0.05) below its committed value and
+``recovery_ms_p95`` may grow to ``(1 + tol_p95)`` of it (default 0.75 —
+the gauntlet clock is simulated so the slack covers ladder-tuning
+drift, not host noise). An impairment regression means frames freeze on
+links the recovery ladder (docs/recovery.md) used to survive.
+
+``--quality`` ratchets the **rate/quality suite** (``bench.py
+--quality`` vs ``BENCH_quality_r01.json``, docs/quality.md): point rows
+match on scenario + encoder + preset + resolution and their mean
+``psnr_db`` may drop at most ``--tol-psnr`` dB (absolute, default 1.5 —
+the traces and oracles are deterministic, so the slack covers encoder-
+tuning drift, not noise); bdrate rows match on scenario + encoder +
+anchor + resolution and ``bd_rate_pct`` may grow at most ``--tol-bd``
+percentage points (default 10.0) over the committed value. A quality
+regression means the TPU encoder spends more bits for the same PSNR
+against the x264 anchors than the committed record.
 
 Usage:
     python tools/check_bench_regress.py [--scenario idle,typing]
@@ -56,11 +71,15 @@ Usage:
     python tools/check_bench_regress.py --impair [lte_handover,v2x]
         [--impair-baseline BENCH_impair_r01.json] [--tol-recovered 0.05]
         [--tol-p95 0.75]
+    python tools/check_bench_regress.py --quality [typing,video]
+        [--quality-baseline BENCH_quality_r01.json] [--tol-psnr 1.5]
+        [--tol-bd 10.0]
 
 Exit 0 when every matched row is inside tolerance, 1 on regression,
-2 on usage/setup errors. Wired as a ``slow``-marked test
-(tests/test_slo.py::test_bench_regress_ratchet) so the tier-1 run stays
-fast while `-m slow` CI legs get the ratchet.
+2 on usage/setup errors. Wired as ``slow``-marked tests
+(tests/test_slo.py, test_occupancy.py, test_recovery.py,
+test_quality.py) so the tier-1 run stays fast while `-m slow` CI legs
+get the ratchets.
 """
 
 from __future__ import annotations
@@ -70,42 +89,43 @@ import json
 import os
 import subprocess
 import sys
+from typing import Callable
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_BASELINE = "BENCH_scenarios_r02.json"
 DEFAULT_CAPACITY_BASELINE = "BENCH_capacity_r01.json"
 DEFAULT_IMPAIR_BASELINE = "BENCH_impair_r01.json"
+DEFAULT_QUALITY_BASELINE = "BENCH_quality_r01.json"
 
 
-def _key(row: dict) -> tuple:
-    return (row.get("scenario"), int(row.get("policy", 0)),
-            int(row.get("damage", 0)), row.get("resolution"))
+# ---------------------------------------------------------------------------
+# shared core: JSONL row loading, the bench.py runner, and the
+# tolerance-table comparison every mode goes through
+# ---------------------------------------------------------------------------
 
 
-def load_rows(path: str) -> dict[tuple, dict]:
+def load_rows(path: str, match: Callable[[dict], bool],
+              key: Callable[[dict], tuple]) -> dict[tuple, dict]:
+    """Matching rows from a bench JSONL record, keyed for comparison."""
     rows: dict[tuple, dict] = {}
     with open(path, encoding="utf-8") as f:
         for line in f:
             line = line.strip()
-            if not line:
+            if not line.startswith("{"):
                 continue
-            row = json.loads(line)
-            if row.get("scenario"):
-                rows[_key(row)] = row
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if match(row):
+                rows[key(row)] = row
     return rows
 
 
-def run_bench(scenarios: list[str], frames: int, *, policy: int = 0,
-              damage: int = 0,
-              resolution: str = "720p") -> dict[tuple, dict]:
-    """Run bench.py --scenario and parse its stdout JSON lines. The
-    resolution defaults to the committed rows' 720p — rows only match
-    baselines recorded at the same geometry."""
-    cmd = [sys.executable, os.path.join(REPO, "bench.py"),
-           "--scenario", ",".join(scenarios),
-           "--scenario-frames", str(frames),
-           "--resolution", resolution,
-           "--policy", str(policy), "--damage", str(damage)]
+def run_bench(bench_args: list[str], match: Callable[[dict], bool],
+              key: Callable[[dict], tuple]) -> dict[tuple, dict]:
+    """Run bench.py with ``bench_args`` and parse its stdout JSON rows."""
+    cmd = [sys.executable, os.path.join(REPO, "bench.py"), *bench_args]
     env = dict(os.environ, JAX_PLATFORMS=os.environ.get(
         "JAX_PLATFORMS", "cpu"))
     proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
@@ -122,164 +142,69 @@ def run_bench(scenarios: list[str], frames: int, *, policy: int = 0,
             row = json.loads(line)
         except json.JSONDecodeError:
             continue
-        if row.get("scenario"):
-            # bench emits fps as "value"
-            row.setdefault("fps", row.get("value"))
-            rows[_key(row)] = row
+        if match(row):
+            rows[key(row)] = row
     return rows
 
 
-def _cap_key(row: dict) -> tuple:
-    return (row.get("mix"), row.get("mode"), int(row.get("chips", 0) or 0),
-            row.get("codec", "h264"), row.get("resolution"))
+class Check:
+    """One tolerance rule on one row field.
+
+    kind:
+      rel_drop  fail when value < base * (1 - tol)
+      rel_grow  fail when value > base * (1 + tol)
+      abs_drop  fail when value < base - tol
+      abs_grow  fail when value > base + tol
+      zero_base fail when the BASELINE records 0 and the fresh value > 0
+    A check is skipped when the field is absent from either row (mixed
+    row kinds in one baseline — quality point vs bdrate rows — and
+    baselines that predate a field both stay green).
+    """
+
+    def __init__(self, field: str, kind: str, tol_name: str | None = None,
+                 note: str = ""):
+        self.field = field
+        self.kind = kind
+        self.tol_name = tol_name
+        self.note = note
+
+    def evaluate(self, label: str, base: dict, row: dict,
+                 tols: dict[str, float]) -> str | None:
+        if self.field not in base or self.field not in row:
+            return None
+        base_v = float(base.get(self.field, 0) or 0)
+        v = float(row.get(self.field, 0) or 0)
+        note = f" ({self.note})" if self.note else ""
+        if self.kind == "zero_base":
+            if int(v) > 0 and int(base_v) == 0:
+                return (f"{label}: {int(v)} {self.field} in the timed "
+                        f"pass{note}")
+            return None
+        tol = tols[self.tol_name]
+        if self.kind == "rel_drop":
+            if base_v > 0 and v < base_v * (1.0 - tol):
+                return (f"{label}: {self.field} {v:.2f} < {base_v:.2f} * "
+                        f"(1 - {tol}) = {base_v * (1 - tol):.2f}{note}")
+        elif self.kind == "rel_grow":
+            if base_v > 0 and v > base_v * (1.0 + tol):
+                return (f"{label}: {self.field} {v:.2f} > {base_v:.2f} * "
+                        f"(1 + {tol}) = {base_v * (1 + tol):.2f}{note}")
+        elif self.kind == "abs_drop":
+            if v < base_v - tol:
+                return (f"{label}: {self.field} {v:.4g} < committed "
+                        f"{base_v:.4g} - tol {tol}{note}")
+        elif self.kind == "abs_grow":
+            if v > base_v + tol:
+                return (f"{label}: {self.field} {v:.4g} > committed "
+                        f"{base_v:.4g} + tol {tol}{note}")
+        return None
 
 
-def load_capacity(path: str) -> dict[tuple, dict]:
-    """Capacity rows (``bench: capacity``) from a bench JSONL record."""
-    rows: dict[tuple, dict] = {}
-    with open(path, encoding="utf-8") as f:
-        for line in f:
-            line = line.strip()
-            if not line.startswith("{"):
-                continue
-            try:
-                row = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if row.get("bench") == "capacity":
-                rows[_cap_key(row)] = row
-    return rows
-
-
-def run_capacity(mixes: list[str], frames: int, max_sessions: int,
-                 resolution: str) -> dict[tuple, dict]:
-    cmd = [sys.executable, os.path.join(REPO, "bench.py"),
-           "--capacity", ",".join(mixes),
-           "--capacity-frames", str(frames),
-           "--capacity-max", str(max_sessions),
-           "--resolution", resolution]
-    env = dict(os.environ, JAX_PLATFORMS=os.environ.get(
-        "JAX_PLATFORMS", "cpu"))
-    proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
-                          cwd=REPO)
-    if proc.returncode != 0:
-        sys.stderr.write(proc.stderr[-4000:])
-        raise RuntimeError(f"bench.py --capacity failed (rc={proc.returncode})")
-    rows: dict[tuple, dict] = {}
-    for line in proc.stdout.splitlines():
-        line = line.strip()
-        if not line.startswith("{"):
-            continue
-        try:
-            row = json.loads(line)
-        except json.JSONDecodeError:
-            continue
-        if row.get("bench") == "capacity":
-            rows[_cap_key(row)] = row
-    return rows
-
-
-def compare_capacity(baseline: dict[tuple, dict], fresh: dict[tuple, dict],
-                     *, tol_sessions: int) -> list[str]:
-    problems: list[str] = []
-    for key, row in sorted(fresh.items(), key=str):
-        base = baseline.get(key)
-        label = "/".join(str(k) for k in key)
-        if base is None:
-            print(f"  [skip] {label}: no committed capacity row")
-            continue
-        base_n = int(base.get("max_sessions_at_slo", 0) or 0)
-        n = int(row.get("max_sessions_at_slo", 0) or 0)
-        ok = n >= base_n - tol_sessions
-        if not ok:
-            problems.append(
-                f"{label}: max_sessions_at_slo {n} < committed {base_n} "
-                f"- tol {tol_sessions} (routers were promised {base_n})")
-        print(f"  [{'ok' if ok else 'fail'}] {label}: "
-              f"{n} sessions at SLO (committed {base_n})")
-    return problems
-
-
-def _impair_key(row: dict) -> tuple:
-    return (row.get("profile"), row.get("scenario"), row.get("resolution"))
-
-
-def load_impair(path: str) -> dict[tuple, dict]:
-    """Gauntlet rows (``bench: impair``) from a bench JSONL record."""
-    rows: dict[tuple, dict] = {}
-    with open(path, encoding="utf-8") as f:
-        for line in f:
-            line = line.strip()
-            if not line.startswith("{"):
-                continue
-            try:
-                row = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if row.get("bench") == "impair":
-                rows[_impair_key(row)] = row
-    return rows
-
-
-def run_impair(profiles: list[str], scenarios: list[str], frames: int,
-               resolution: str) -> dict[tuple, dict]:
-    cmd = [sys.executable, os.path.join(REPO, "bench.py"),
-           "--impair", ",".join(profiles),
-           "--impair-scenarios", ",".join(scenarios),
-           "--impair-frames", str(frames),
-           "--resolution", resolution]
-    env = dict(os.environ, JAX_PLATFORMS=os.environ.get(
-        "JAX_PLATFORMS", "cpu"))
-    proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
-                          cwd=REPO)
-    if proc.returncode != 0:
-        sys.stderr.write(proc.stderr[-4000:])
-        raise RuntimeError(f"bench.py --impair failed (rc={proc.returncode})")
-    rows: dict[tuple, dict] = {}
-    for line in proc.stdout.splitlines():
-        line = line.strip()
-        if not line.startswith("{"):
-            continue
-        try:
-            row = json.loads(line)
-        except json.JSONDecodeError:
-            continue
-        if row.get("bench") == "impair":
-            rows[_impair_key(row)] = row
-    return rows
-
-
-def compare_impair(baseline: dict[tuple, dict], fresh: dict[tuple, dict],
-                   *, tol_recovered: float, tol_p95: float) -> list[str]:
-    problems: list[str] = []
-    for key, row in sorted(fresh.items(), key=str):
-        base = baseline.get(key)
-        label = "/".join(str(k) for k in key)
-        if base is None:
-            print(f"  [skip] {label}: no committed impairment row")
-            continue
-        base_r = float(base.get("recovered_ratio", 0) or 0)
-        r = float(row.get("recovered_ratio", 0) or 0)
-        if r < base_r - tol_recovered:
-            problems.append(
-                f"{label}: recovered_ratio {r:.4f} < committed {base_r:.4f}"
-                f" - tol {tol_recovered} (frames freeze on a link the "
-                f"ladder used to survive)")
-        base_p95 = float(base.get("recovery_ms_p95", 0) or 0)
-        p95 = float(row.get("recovery_ms_p95", 0) or 0)
-        if base_p95 > 0 and p95 > base_p95 * (1.0 + tol_p95):
-            problems.append(
-                f"{label}: recovery_ms_p95 {p95:.1f} > {base_p95:.1f} * "
-                f"(1 + {tol_p95}) = {base_p95 * (1 + tol_p95):.1f} ms")
-        ok = not problems or not problems[-1].startswith(label)
-        print(f"  [{'ok' if ok else 'fail'}] {label}: recovered "
-              f"{r:.4f} (base {base_r:.4f}), p95 {p95:.1f} ms "
-              f"(base {base_p95:.1f}), frozen {row.get('frames_frozen')}")
-    return problems
-
-
-def compare(baseline: dict[tuple, dict], fresh: dict[tuple, dict], *,
-            tol_fps: float, tol_p50: float) -> list[str]:
+def compare_rows(baseline: dict[tuple, dict], fresh: dict[tuple, dict],
+                 checks: list[Check],
+                 tols: dict[str, float]) -> list[str]:
+    """Every fresh row vs its committed counterpart through the mode's
+    tolerance table; novel rows are skipped (reported), never failed."""
     problems: list[str] = []
     for key, row in sorted(fresh.items(), key=str):
         base = baseline.get(key)
@@ -287,30 +212,107 @@ def compare(baseline: dict[tuple, dict], fresh: dict[tuple, dict], *,
         if base is None:
             print(f"  [skip] {label}: no committed baseline row")
             continue
-        base_fps = float(base.get("value", base.get("fps", 0)) or 0)
-        fps = float(row.get("fps", row.get("value", 0)) or 0)
-        if base_fps > 0 and fps < base_fps * (1.0 - tol_fps):
-            problems.append(
-                f"{label}: fps {fps:.2f} < {base_fps:.2f} * "
-                f"(1 - {tol_fps}) = {base_fps * (1 - tol_fps):.2f}")
-        base_p50 = float(base.get("p50_latency_ms", 0) or 0)
-        p50 = float(row.get("p50_latency_ms", 0) or 0)
-        if base_p50 > 0 and p50 > base_p50 * (1.0 + tol_p50):
-            problems.append(
-                f"{label}: p50 {p50:.1f} ms > {base_p50:.1f} ms * "
-                f"(1 + {tol_p50}) = {base_p50 * (1 + tol_p50):.1f} ms")
-        compiles = int(row.get("compiles", 0) or 0)
-        if ("compiles" in base and compiles > 0
-                and int(base.get("compiles") or 0) == 0):
-            problems.append(
-                f"{label}: {compiles} XLA compiles in the TIMED pass "
-                f"(steady state must reuse executables — see docs/slo.md)")
-        status = "OK" if not problems or not problems[-1].startswith(label) \
-            else "FAIL"
-        print(f"  [{status.lower()}] {label}: fps {fps:.2f} "
-              f"(base {base_fps:.2f}), p50 {p50:.1f} ms "
-              f"(base {base_p50:.1f}), compiles {compiles}")
+        row_problems = [
+            msg for c in checks
+            if (msg := c.evaluate(label, base, row, tols)) is not None]
+        problems.extend(row_problems)
+        fields = ", ".join(
+            f"{c.field} {row[c.field]} (base {base[c.field]})"
+            for c in checks
+            if c.field in row and c.field in base)
+        print(f"  [{'fail' if row_problems else 'ok'}] {label}: {fields}")
     return problems
+
+
+def ratchet(name: str, baseline_path: str, run_file: str | None,
+            match: Callable[[dict], bool], key: Callable[[dict], tuple],
+            bench_args: Callable[[dict[tuple, dict]], list[str]],
+            checks: list[Check], tols: dict[str, float],
+            banner: str) -> int:
+    """One full ratchet pass: load the committed rows, refresh (or load
+    --run-file), compare, report. The shared exit contract: 0 inside
+    tolerance, 1 regression, 2 setup error."""
+    if not os.path.exists(baseline_path):
+        print(f"check_bench_regress: {name} baseline {baseline_path} "
+              f"missing")
+        return 2
+    baseline = load_rows(baseline_path, match, key)
+    if run_file:
+        fresh = load_rows(run_file, match, key)
+    else:
+        argv = bench_args(baseline)
+        print(f"check_bench_regress: running bench.py {' '.join(argv)}")
+        fresh = run_bench(argv, match, key)
+    if not fresh:
+        print(f"check_bench_regress: no {name} rows produced")
+        return 2
+    problems = compare_rows(baseline, fresh, checks, tols)
+    if problems:
+        tol_desc = ", ".join(f"{k} {v}" for k, v in sorted(tols.items()))
+        print(f"\ncheck_bench_regress: {banner} vs "
+              f"{os.path.basename(baseline_path)} (tolerances: "
+              f"{tol_desc}):\n")
+        print("\n".join("  " + p for p in problems))
+        return 1
+    print(f"check_bench_regress: OK ({len(fresh)} {name} rows inside "
+          f"tolerance)")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# mode definitions
+# ---------------------------------------------------------------------------
+
+
+def _scenario_match(row: dict) -> bool:
+    # quality/impair rows also carry a scenario; the plain scenario
+    # suite is the only one without a "bench" discriminator
+    return bool(row.get("scenario")) and not row.get("bench")
+
+
+def _scenario_key(row: dict) -> tuple:
+    return (row.get("scenario"), int(row.get("policy", 0)),
+            int(row.get("damage", 0)), row.get("resolution"))
+
+
+def _cap_key(row: dict) -> tuple:
+    return (row.get("mix"), row.get("mode"), int(row.get("chips", 0) or 0),
+            row.get("codec", "h264"), row.get("resolution"))
+
+
+def _impair_key(row: dict) -> tuple:
+    return (row.get("profile"), row.get("scenario"), row.get("resolution"))
+
+
+def _quality_key(row: dict) -> tuple:
+    # point rows carry a preset, bdrate rows an anchor; both are the
+    # rung axis of their kind
+    return (row.get("kind"), row.get("scenario"), row.get("encoder"),
+            row.get("preset") or row.get("anchor"), row.get("resolution"))
+
+
+SCENARIO_CHECKS = [
+    Check("fps", "rel_drop", "tol_fps"),
+    Check("p50_latency_ms", "rel_grow", "tol_p50"),
+    Check("compiles", "zero_base",
+          note="XLA compiles: steady state must reuse executables — see "
+               "docs/slo.md"),
+]
+CAPACITY_CHECKS = [
+    Check("max_sessions_at_slo", "abs_drop", "tol_sessions",
+          note="routers were promised the committed curve"),
+]
+IMPAIR_CHECKS = [
+    Check("recovered_ratio", "abs_drop", "tol_recovered",
+          note="frames freeze on a link the ladder used to survive"),
+    Check("recovery_ms_p95", "rel_grow", "tol_p95"),
+]
+QUALITY_CHECKS = [
+    Check("psnr_db", "abs_drop", "tol_psnr",
+          note="the stream decodes visibly worse at this rung"),
+    Check("bd_rate_pct", "abs_grow", "tol_bd",
+          note="more bits for the same PSNR vs the x264 anchor"),
+]
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -358,106 +360,99 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--tol-p95", type=float, default=0.75,
                     help="relative recovery_ms_p95 growth allowed over "
                          "the committed row")
+    ap.add_argument("--quality", nargs="?", const="all", default=None,
+                    help="ratchet the rate/quality rows instead "
+                         "(optionally a comma scenario list; default "
+                         "all committed scenarios)")
+    ap.add_argument("--quality-baseline",
+                    default=os.path.join(REPO, DEFAULT_QUALITY_BASELINE))
+    ap.add_argument("--quality-frames", type=int, default=90)
+    ap.add_argument("--tol-psnr", type=float, default=1.5,
+                    help="absolute mean-PSNR dB drop allowed below the "
+                         "committed point row")
+    ap.add_argument("--tol-bd", type=float, default=10.0,
+                    help="absolute bd_rate_pct growth (percentage "
+                         "points) allowed over the committed bdrate row")
     args = ap.parse_args(argv)
 
+    if args.quality:
+        def quality_args(baseline: dict[tuple, dict]) -> list[str]:
+            scens = (sorted({k[1] for k in baseline if k[1]})
+                     if args.quality.strip().lower() == "all"
+                     else [s.strip() for s in args.quality.split(",")
+                           if s.strip()])
+            res = next((k[4] for k in baseline if k[4]), "512x288")
+            return ["--quality", ",".join(scens),
+                    "--quality-frames", str(args.quality_frames),
+                    "--resolution", res]
+
+        return ratchet(
+            "quality", args.quality_baseline, args.run_file,
+            lambda r: r.get("bench") == "quality", _quality_key,
+            quality_args, QUALITY_CHECKS,
+            {"tol_psnr": args.tol_psnr, "tol_bd": args.tol_bd},
+            "QUALITY REGRESSION")
+
     if args.impair:
-        if not os.path.exists(args.impair_baseline):
-            print("check_bench_regress: impairment baseline "
-                  f"{args.impair_baseline} missing")
-            return 2
-        baseline = load_impair(args.impair_baseline)
-        if args.run_file:
-            fresh = load_impair(args.run_file)
-        else:
+        def impair_args(baseline: dict[tuple, dict]) -> list[str]:
             profiles = (sorted({k[0] for k in baseline})
                         if args.impair.strip().lower() == "all"
                         else [p.strip() for p in args.impair.split(",")
                               if p.strip()])
             scenarios = sorted({k[1] for k in baseline if k[1]})
-            base_res = next((k[2] for k in baseline if k[2]), "512x288")
-            print(f"check_bench_regress: running bench.py --impair "
-                  f"{','.join(profiles)} --impair-scenarios "
-                  f"{','.join(scenarios)} --resolution {base_res}")
-            fresh = run_impair(profiles, scenarios, args.impair_frames,
-                               base_res)
-        if not fresh:
-            print("check_bench_regress: no impairment rows produced")
-            return 2
-        problems = compare_impair(baseline, fresh,
-                                  tol_recovered=args.tol_recovered,
-                                  tol_p95=args.tol_p95)
-        if problems:
-            print("\ncheck_bench_regress: RECOVERY REGRESSION vs "
-                  f"{os.path.basename(args.impair_baseline)} (tolerances: "
-                  f"recovered -{args.tol_recovered}, p95 "
-                  f"+{args.tol_p95:.0%}):\n")
-            print("\n".join("  " + p for p in problems))
-            return 1
-        print(f"check_bench_regress: OK ({len(fresh)} impairment rows "
-              f"inside tolerance)")
-        return 0
+            res = next((k[2] for k in baseline if k[2]), "512x288")
+            return ["--impair", ",".join(profiles),
+                    "--impair-scenarios", ",".join(scenarios),
+                    "--impair-frames", str(args.impair_frames),
+                    "--resolution", res]
+
+        return ratchet(
+            "impairment", args.impair_baseline, args.run_file,
+            lambda r: r.get("bench") == "impair", _impair_key,
+            impair_args, IMPAIR_CHECKS,
+            {"tol_recovered": args.tol_recovered, "tol_p95": args.tol_p95},
+            "RECOVERY REGRESSION")
 
     if args.capacity:
-        if not os.path.exists(args.capacity_baseline):
-            print("check_bench_regress: capacity baseline "
-                  f"{args.capacity_baseline} missing")
-            return 2
-        baseline = load_capacity(args.capacity_baseline)
-        if args.run_file:
-            fresh = load_capacity(args.run_file)
-        else:
+        def capacity_args(baseline: dict[tuple, dict]) -> list[str]:
             mixes = (sorted({k[0] for k in baseline})
                      if args.capacity.strip().lower() == "all"
                      else [m.strip() for m in args.capacity.split(",")
                            if m.strip()])
-            base_res = next((k[4] for k in baseline if k[4]), "512x288")
-            print(f"check_bench_regress: running bench.py --capacity "
-                  f"{','.join(mixes)} --resolution {base_res}")
-            fresh = run_capacity(mixes, args.capacity_frames,
-                                 args.capacity_max, base_res)
-        if not fresh:
-            print("check_bench_regress: no capacity rows produced")
-            return 2
-        problems = compare_capacity(baseline, fresh,
-                                    tol_sessions=args.tol_sessions)
-        if problems:
-            print("\ncheck_bench_regress: CAPACITY REGRESSION vs "
-                  f"{os.path.basename(args.capacity_baseline)} "
-                  f"(tolerance: -{args.tol_sessions} sessions):\n")
-            print("\n".join("  " + p for p in problems))
-            return 1
-        print(f"check_bench_regress: OK ({len(fresh)} capacity rows "
-              f"inside tolerance)")
-        return 0
+            res = next((k[4] for k in baseline if k[4]), "512x288")
+            return ["--capacity", ",".join(mixes),
+                    "--capacity-frames", str(args.capacity_frames),
+                    "--capacity-max", str(args.capacity_max),
+                    "--resolution", res]
 
-    if not os.path.exists(args.baseline):
-        print(f"check_bench_regress: baseline {args.baseline} missing")
-        return 2
-    baseline = load_rows(args.baseline)
-    if args.run_file:
-        fresh = load_rows(args.run_file)
-        for row in fresh.values():
-            row.setdefault("fps", row.get("value"))
-    else:
-        scenarios = [s.strip() for s in args.scenario.split(",") if s.strip()]
-        print(f"check_bench_regress: running bench.py --scenario "
-              f"{','.join(scenarios)} --scenario-frames {args.frames} "
-              f"--resolution {args.resolution}")
-        fresh = run_bench(scenarios, max(60, args.frames),
-                          resolution=args.resolution)
-    if not fresh:
-        print("check_bench_regress: no scenario rows produced")
-        return 2
-    problems = compare(baseline, fresh,
-                       tol_fps=args.tol_fps, tol_p50=args.tol_p50)
-    if problems:
-        print("\ncheck_bench_regress: PERF REGRESSION vs "
-              f"{os.path.basename(args.baseline)} (tolerances: fps "
-              f"-{args.tol_fps:.0%}, p50 +{args.tol_p50:.0%}):\n")
-        print("\n".join("  " + p for p in problems))
-        return 1
-    print(f"check_bench_regress: OK ({len(fresh)} rows inside tolerance)")
-    return 0
+        return ratchet(
+            "capacity", args.capacity_baseline, args.run_file,
+            lambda r: r.get("bench") == "capacity", _cap_key,
+            capacity_args, CAPACITY_CHECKS,
+            {"tol_sessions": args.tol_sessions},
+            "CAPACITY REGRESSION")
+
+    def scenario_args(baseline: dict[tuple, dict]) -> list[str]:
+        scenarios = [s.strip() for s in args.scenario.split(",")
+                     if s.strip()]
+        return ["--scenario", ",".join(scenarios),
+                "--scenario-frames", str(max(60, args.frames)),
+                "--resolution", args.resolution,
+                "--policy", "0", "--damage", "0"]
+
+    def scenario_match_norm(row: dict) -> bool:
+        if not _scenario_match(row):
+            return False
+        # bench emits fps as "value"; committed rows carry both
+        row.setdefault("fps", row.get("value"))
+        return True
+
+    return ratchet(
+        "scenario", args.baseline, args.run_file,
+        scenario_match_norm, _scenario_key, scenario_args,
+        SCENARIO_CHECKS,
+        {"tol_fps": args.tol_fps, "tol_p50": args.tol_p50},
+        "PERF REGRESSION")
 
 
 if __name__ == "__main__":
