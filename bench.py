@@ -705,17 +705,22 @@ IMPAIR_FPS = 60.0
 
 
 def _encode_scenario_aus(name: str, n: int, w: int, h: int,
-                         qp: int = 28) -> list[tuple[bytes, bool]]:
+                         qp: int = 28,
+                         entropy_coder: str | None = None,
+                         ) -> list[tuple[bytes, bool]]:
     """Encode the scenario trace once -> [(au, is_idr), ...]; the same
     AUs replay through every impairment profile. The quality suite
-    reuses this with explicit QPs to sweep the tpuh264enc ladder."""
+    reuses this with explicit QPs to sweep the tpuh264enc ladder (and,
+    since ISSUE 20, with an explicit entropy coder to sweep the
+    cavlc-vs-cabac axis on the same rungs)."""
     from selkies_tpu.models.h264.encoder import TPUH264Encoder
     from selkies_tpu.models.registry import (
         default_frame_batch, default_pipeline_depth)
 
     enc = TPUH264Encoder(w, h, qp=qp,
                          frame_batch=min(12, default_frame_batch()),
-                         pipeline_depth=default_pipeline_depth())
+                         pipeline_depth=default_pipeline_depth(),
+                         entropy_coder=entropy_coder)
     aus: dict[int, tuple[bytes, bool]] = {}
     try:
         for i, frame in enumerate(_scenario_trace(name, n, w, h, seed=11)):
@@ -867,7 +872,7 @@ def bench_impair(w: int, h: int, n_frames: int, profiles: list[str],
 # rows carry mean PSNR/SSIM/VMAF (vmaf_kind says proxy vs real CLI);
 # bdrate rows summarise each test curve against each x264 anchor curve
 # with the classic BD-rate integral. Deterministic traces + intra-only
-# oracles => BENCH_quality_r01.json ratchets stably
+# oracles => BENCH_quality_r02.json ratchets stably
 # (check_bench_regress --quality).
 # ---------------------------------------------------------------------------
 
@@ -942,10 +947,16 @@ def bench_quality(scenarios: list[str], w: int, h: int,
                          "scenario": scen, "encoder": encoder,
                          "preset": preset, "codec": codec, **pt})
 
-        for qp in QUALITY_QP_LADDER:
-            aus = [a for a, _ in
-                   _encode_scenario_aus(scen, n_frames, w, h, qp=qp)]
-            point("tpuh264enc", f"qp{qp}", aus, "h264")
+        # both entropy backends sweep the same QP ladder: the structure
+        # pass is shared, so the cabac curve isolates pure coder gain
+        # (encoder name "tpuh264enc" stays the CAVLC row r01 committed)
+        for coder, encoder in (("cavlc", "tpuh264enc"),
+                               ("cabac", "tpuh264enc-cabac")):
+            for qp in QUALITY_QP_LADDER:
+                aus = [a for a, _ in
+                       _encode_scenario_aus(scen, n_frames, w, h, qp=qp,
+                                            entropy_coder=coder)]
+                point(encoder, f"qp{qp}", aus, "h264")
         if x264_available():
             for preset in QUALITY_X264_ANCHORS:
                 for kbps in QUALITY_RATE_LADDER:
@@ -970,11 +981,19 @@ def bench_quality(scenarios: list[str], w: int, h: int,
                               "note": "libvpx unavailable"}),
                   file=sys.stderr)
 
+        # every test curve vs the x264 anchors, PLUS the coder-axis row:
+        # tpuh264enc-cabac anchored on tpuh264enc (same structure pass,
+        # same ladder) is the headline bitrate cut the ratchet holds
         anchors = [e for e in curves if e.startswith("x264-")]
+        if "tpuh264enc" in curves:
+            anchors.append("tpuh264enc")
         for encoder, pts in curves.items():
             if encoder.startswith("x264-"):
                 continue
             for anchor in anchors:
+                if anchor == encoder or (anchor == "tpuh264enc"
+                                         and encoder != "tpuh264enc-cabac"):
+                    continue
                 bd = bd_rate(curves[anchor], pts)
                 if bd is None:
                     continue

@@ -1,0 +1,165 @@
+// CABAC token-stream arithmetic coder (ISO 14496-10 9.3.4.2).
+//
+// The Python/JAX side binarizes syntax elements into a uint16 token IR
+// (see selkies_tpu/models/h264/cabac.py for the format); this engine is
+// the sequential tail: context-state updates, interval arithmetic,
+// outstanding-bit resolution. Byte-identical to cabac.encode_tokens_py
+// (asserted by tests/test_cabac.py with randomized token streams).
+//
+// Exported entry points (ctypes, see native.py):
+//   cabac_encode_tokens(states[276*2] u8, tokens[] u16, n, out, cap)
+//     -> bytes written, or -1 if out too small. `states` is caller-built
+//        (init_states) and is NOT modified; the working copy lives in
+//        thread-local scratch like the CAVLC packer's buffers.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr int kNumStates = 276;
+
+// Table 9-44 rangeTabLPS[pStateIdx][qCodIRangeIdx] and table 9-45
+// transIdxLPS. Values mirror cabac_tables.py (spec-anchored, validated
+// against libavcodec's runtime state trajectory).
+const uint8_t kRangeLPS[64][4] = {
+    {128, 176, 208, 240}, {128, 167, 197, 227}, {128, 158, 187, 216},
+    {123, 150, 178, 205}, {116, 142, 169, 195}, {111, 135, 160, 185},
+    {105, 128, 152, 175}, {100, 122, 144, 166}, {95, 116, 137, 158},
+    {90, 110, 130, 150},  {85, 104, 123, 142},  {81, 99, 117, 135},
+    {77, 94, 111, 128},   {73, 89, 105, 122},   {69, 85, 100, 116},
+    {66, 80, 95, 110},    {62, 76, 90, 104},    {59, 72, 86, 99},
+    {56, 69, 81, 94},     {53, 65, 77, 89},     {51, 62, 73, 85},
+    {48, 59, 69, 80},     {46, 56, 66, 76},     {43, 53, 63, 72},
+    {41, 50, 59, 69},     {39, 48, 56, 65},     {37, 45, 54, 62},
+    {35, 43, 51, 59},     {33, 41, 48, 56},     {32, 39, 46, 53},
+    {30, 37, 43, 50},     {29, 35, 41, 48},     {27, 33, 39, 45},
+    {26, 31, 37, 43},     {24, 30, 35, 41},     {23, 28, 33, 39},
+    {22, 27, 32, 37},     {21, 26, 30, 35},     {20, 24, 29, 33},
+    {19, 23, 27, 31},     {18, 22, 26, 30},     {17, 21, 25, 28},
+    {16, 20, 23, 27},     {15, 19, 22, 25},     {14, 18, 21, 24},
+    {14, 17, 20, 23},     {13, 16, 19, 22},     {12, 15, 18, 21},
+    {12, 14, 17, 20},     {11, 14, 16, 19},     {11, 13, 15, 18},
+    {10, 12, 15, 17},     {10, 12, 14, 16},     {9, 11, 13, 15},
+    {9, 11, 12, 14},      {8, 10, 12, 14},      {8, 9, 11, 13},
+    {7, 9, 11, 12},       {7, 9, 10, 12},       {7, 8, 10, 11},
+    {6, 8, 9, 11},        {6, 7, 9, 10},        {6, 7, 8, 9},
+    {2, 2, 2, 2},
+};
+const uint8_t kTransLPS[64] = {
+    0, 0, 1, 2, 2, 4, 4, 5, 6, 7, 8, 9, 9, 11, 11, 12,
+    13, 13, 15, 15, 16, 16, 18, 18, 19, 19, 21, 21, 22, 22, 23, 24,
+    24, 25, 26, 26, 27, 27, 28, 29, 29, 30, 30, 30, 31, 32, 32, 33,
+    33, 33, 34, 34, 35, 35, 35, 36, 36, 36, 37, 37, 37, 38, 38, 63,
+};
+
+struct Engine {
+    uint8_t st[kNumStates][2];  // [pStateIdx, valMPS]
+    uint32_t low = 0, range = 510;
+    int outstanding = 0;
+    bool first = true;
+    uint8_t *out;
+    int64_t cap, n = 0;
+    uint32_t acc = 0;
+    int nacc = 0;
+    bool overflow = false, flushed = false;
+
+    void emit(int b) {
+        acc = (acc << 1) | (uint32_t)b;
+        if (++nacc == 8) {
+            if (n >= cap) { overflow = true; }
+            else out[n++] = (uint8_t)acc;
+            acc = 0;
+            nacc = 0;
+        }
+    }
+    void put_bit(int b) {
+        if (first) first = false;
+        else emit(b);
+        for (; outstanding; outstanding--) emit(1 - b);
+    }
+    void renorm() {
+        while (range < 256) {
+            if (low < 256) put_bit(0);
+            else if (low >= 512) { low -= 512; put_bit(1); }
+            else { low -= 256; outstanding++; }
+            low <<= 1;
+            range <<= 1;
+        }
+    }
+    void decision(int ctx, int b) {
+        uint8_t s = st[ctx][0], mps = st[ctx][1];
+        uint32_t lps = kRangeLPS[s][(range >> 6) & 3];
+        range -= lps;
+        if (b != mps) {
+            low += range;
+            range = lps;
+            if (s == 0) mps ^= 1;
+            st[ctx][0] = kTransLPS[s];
+            st[ctx][1] = mps;
+        } else {
+            st[ctx][0] = s < 62 ? s + 1 : 62;
+        }
+        renorm();
+    }
+    void bypass(int b) {
+        low <<= 1;
+        if (b) low += range;
+        if (low >= 1024) { put_bit(1); low -= 1024; }
+        else if (low < 512) put_bit(0);
+        else { low -= 512; outstanding++; }
+    }
+    void terminate(int b) {
+        range -= 2;
+        if (b) {
+            low += range;
+            range = 2;
+            renorm();
+            put_bit((low >> 9) & 1);
+            emit((low >> 8) & 1);
+            emit(1);  // rbsp_stop_one_bit
+            flushed = true;
+        } else {
+            renorm();
+        }
+    }
+};
+
+}  // namespace
+
+extern "C" int64_t cabac_encode_tokens(const uint8_t *states,
+                                       const uint16_t *tokens, int64_t ntok,
+                                       uint8_t *out, int64_t cap) {
+    // Engine is ~600 bytes of state; stack-local keeps it trivially
+    // thread-safe (the pack pool runs one coder per session thread) with
+    // no TLS registry to size or reset between geometries.
+    Engine e;
+    std::memcpy(e.st, states, sizeof(e.st));
+    e.out = out;
+    e.cap = cap;
+    for (int64_t i = 0; i < ntok; i++) {
+        uint16_t t = tokens[i];
+        switch (t & 3) {
+            case 0:  // REG
+                e.decision((t >> 3) & 0x3FF, (t >> 2) & 1);
+                break;
+            case 1: {  // RUN: n same-ctx same-value regular bins
+                int ctx = (t >> 3) & 0x3FF, b = (t >> 2) & 1;
+                for (int k = t >> 13; k; k--) e.decision(ctx, b);
+                break;
+            }
+            case 2: {  // BYP: n bypass bins, values MSB-first
+                int nb = (t >> 2) & 0xF;
+                uint32_t v = t >> 6;
+                for (int k = nb - 1; k >= 0; k--) e.bypass((v >> k) & 1);
+                break;
+            }
+            default:  // TERM
+                e.terminate((t >> 2) & 1);
+        }
+        if (e.overflow) return -1;
+    }
+    if (!e.flushed) return -2;  // stream must end in TERM(1)
+    while (e.nacc) e.emit(0);  // zero-pad after the stop bit
+    return e.overflow ? -1 : e.n;
+}

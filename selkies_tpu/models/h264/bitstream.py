@@ -49,12 +49,24 @@ class StreamParams:
     qp: int = 28
     fps: int = 60
     disable_deblocking: bool = True
+    # "cavlc" (Baseline, profile_idc 66, the default — byte-identical to
+    # the pre-CABAC streams) or "cabac" (Main, profile_idc 77,
+    # entropy_coding_mode_flag=1). Selecting the coder here rather than
+    # per-call keeps SPS/PPS/slice-header emission and the entropy
+    # packers agreeing by construction.
+    entropy_coder: str = "cavlc"
 
     def __post_init__(self) -> None:
         if self.width % 2 or self.height % 2:
             raise ValueError(f"{self.width}x{self.height}: 4:2:0 requires even dimensions")
         if self.width <= 0 or self.height <= 0:
             raise ValueError("dimensions must be positive")
+        if self.entropy_coder not in ("cavlc", "cabac"):
+            raise ValueError(f"unknown entropy coder {self.entropy_coder!r}")
+
+    @property
+    def cabac(self) -> bool:
+        return self.entropy_coder == "cabac"
 
     @property
     def mb_width(self) -> int:
@@ -77,8 +89,12 @@ class StreamParams:
 
 def write_sps(p: StreamParams) -> bytes:
     w = BitWriter()
-    w.write_bits(66, 8)  # profile_idc: Baseline
-    w.write_bits(0b11000000, 8)  # constraint_set0+1 (constrained baseline)
+    if p.cabac:
+        w.write_bits(77, 8)  # profile_idc: Main (CABAC requires >= Main)
+        w.write_bits(0b01000000, 8)  # constraint_set1 (Main-conformant)
+    else:
+        w.write_bits(66, 8)  # profile_idc: Baseline
+        w.write_bits(0b11000000, 8)  # constraint_set0+1 (constrained baseline)
     w.write_bits(p.level_idc, 8)
     w.write_ue(0)  # seq_parameter_set_id
     w.write_ue(LOG2_MAX_FRAME_NUM - 4)
@@ -114,7 +130,7 @@ def write_pps(p: StreamParams) -> bytes:
     w = BitWriter()
     w.write_ue(0)  # pic_parameter_set_id
     w.write_ue(0)  # seq_parameter_set_id
-    w.write_bit(0)  # entropy_coding_mode_flag: CAVLC
+    w.write_bit(1 if p.cabac else 0)  # entropy_coding_mode_flag
     w.write_bit(0)  # bottom_field_pic_order_in_frame_present_flag
     w.write_ue(0)  # num_slice_groups_minus1
     w.write_ue(0)  # num_ref_idx_l0_default_active_minus1
@@ -143,8 +159,15 @@ def write_slice_header(
     ltr_ref: int | None = None,
     mark_ltr: int | None = None,
     mmco_evict: tuple = (),
+    cabac_init_idc: int = 0,
 ) -> None:
     """Write the slice header into an open BitWriter (slice data follows).
+
+    When ``p.cabac``, P slice headers carry ``cabac_init_idc`` (7.3.3 —
+    I slices have none) and the caller must byte-align with
+    ``cabac_alignment_one_bit`` (ones) before the arithmetic payload.
+    Each slice initializes its own contexts, so the per-band slice
+    layout needs no cross-band state.
 
     LTR scene-cache syntax (encoder.py's alt-tab optimization):
       * ltr_ref=j — predict this P slice from long-term reference j
@@ -209,6 +232,8 @@ def write_slice_header(
         # dec_ref_pic_marking is present whenever nal_ref_idc != 0 (7.3.3);
         # every slice we emit is a reference (annexb_nal ref_idc=3).
         w.write_bit(0)  # adaptive_ref_pic_marking_mode_flag
+    if p.cabac and slice_type in (SLICE_P, 0):
+        w.write_ue(cabac_init_idc)
     qp = p.qp if slice_qp is None else slice_qp
     w.write_se(qp - p.qp)  # slice_qp_delta relative to pic_init_qp
     if p.disable_deblocking:

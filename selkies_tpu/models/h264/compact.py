@@ -172,13 +172,20 @@ ENTROPY_META16 = 16  # int16 words of the pack_p_sparse_entropy meta prefix
 
 
 def p_sparse_entropy_words(mbh: int, mbw: int, nscap: int, cap_rows: int,
-                           packed: bool, bits_words: int) -> int:
+                           packed: bool, bits_words: int,
+                           entropy_coder: str = "cavlc") -> int:
     """Total int16 length of the entropy-wrapped fused buffer
     (encoder_core.pack_p_sparse_entropy): the 8-int32 meta prefix plus a
-    payload region sized for whichever of the two modes is larger."""
+    payload region sized for whichever of the two modes is larger. With
+    entropy_coder="cabac" the mode-1 payload adds the skip bitmap and
+    the per-coded-MB token-count block ahead of the token words."""
     coeff = (p_sparse_packed_words(mbh, mbw, nscap, cap_rows) if packed
              else p_sparse_var_words(mbh, mbw, nscap, cap_rows))
-    return ENTROPY_META16 + max(coeff, 2 * bits_words)
+    m = mbh * mbw
+    sw = (m + 31) // 32
+    bits = (2 * sw + m + 2 * bits_words if entropy_coder == "cabac"
+            else 2 * bits_words)
+    return ENTROPY_META16 + max(coeff, bits)
 
 
 def p_sparse_entropy_meta(fused16: np.ndarray):

@@ -54,6 +54,7 @@ __all__ = [
     "pack_p_slice_bits_active",
     "bits_buckets",
     "device_entropy_default",
+    "entropy_coder_default",
     "resolve_entropy",
     "BITS_MIN_MBS_DEFAULT",
     "WORD_CAP_DEFAULT",
@@ -87,16 +88,47 @@ def device_entropy_default(explicit=None) -> bool:
         return False
 
 
-def resolve_entropy(m: int, device_entropy=None, bits_min_mbs=None):
+def entropy_coder_default(explicit=None) -> str:
+    """Resolve the entropy-coder knob: an explicit constructor argument
+    wins, then SELKIES_ENTROPY_CODER=cavlc/cabac/auto, else cavlc (the
+    Baseline-profile default every pre-CABAC byte contract was recorded
+    against). ``auto`` picks cabac on real TPU backends and cavlc on
+    CPU — same dispatch discipline as device_entropy_default: the
+    CABAC tail costs a host arithmetic-engine pass per slice, which
+    the Main-profile bitrate win pays for on a TPU-fed stream but not
+    on a CPU backend already contending for the same cores."""
+    coder = explicit
+    if coder is None:
+        import os
+
+        coder = os.environ.get("SELKIES_ENTROPY_CODER", "") or "cavlc"
+    coder = str(coder).lower()
+    if coder == "auto":
+        try:
+            return "cabac" if jax.default_backend() == "tpu" else "cavlc"
+        except Exception:
+            return "cavlc"
+    if coder not in ("cavlc", "cabac"):
+        raise ValueError(
+            f"entropy_coder must be cavlc|cabac|auto, got {coder!r}")
+    return coder
+
+
+def resolve_entropy(m: int, device_entropy=None, bits_min_mbs=None,
+                    entropy_coder=None):
     """One resolver for the device-entropy knobs, shared by the solo and
     banded encoders -> (enabled, min_mbs, bits_words, consts).
 
     `m` is the slice MB count (full grid, or one band). `consts` is the
-    (bits_words, min_mbs, buckets) tuple the jitted
+    (bits_words, min_mbs, buckets, coder) tuple the jitted
     encoder_core.pack_p_sparse_entropy closes over — None when the
-    feature is off. bits_words is the bit-payload cap in uint32 words:
-    ~16 words/MB covers busy desktop residuals, clamped to 256 KB."""
+    feature is off. For CAVLC bits_words is the bit-payload cap in
+    uint32 words (~16 words/MB covers busy desktop residuals, clamped
+    to 256 KB); for CABAC it is the token-word cap
+    (device_cabac.cabac_tok_words) since the payload is the 16-bit
+    token IR, not final bits."""
     enabled = device_entropy_default(device_entropy)
+    coder = entropy_coder_default(entropy_coder)
     if bits_min_mbs is None:
         import os
 
@@ -106,8 +138,14 @@ def resolve_entropy(m: int, device_entropy=None, bits_min_mbs=None):
         except ValueError:
             bits_min_mbs = BITS_MIN_MBS_DEFAULT
     min_mbs = max(0, int(bits_min_mbs))
-    bits_words = min(1 << 16, max(1024, 16 * int(m)))
-    consts = (bits_words, min_mbs, bits_buckets(m)) if enabled else None
+    if coder == "cabac":
+        from selkies_tpu.models.h264.device_cabac import cabac_tok_words
+
+        bits_words = cabac_tok_words(m)
+    else:
+        bits_words = min(1 << 16, max(1024, 16 * int(m)))
+    consts = ((bits_words, min_mbs, bits_buckets(m), coder)
+              if enabled else None)
     return enabled, min_mbs, bits_words, consts
 
 # ---------------------------------------------------------------------------
@@ -677,6 +715,11 @@ def _frame_structure(out):
         "ch_blocks": ch_blocks, "nc_ch": nc_ch, "ch_emit": ch_emit,
         "coded": emit_mb, "trailing": trailing,
         "ns": coded_flat.sum().astype(jnp.int32),
+        # full-grid context grids, consumed by the CABAC emitter
+        # (device_cabac.py) for its neighbour ctx derivation — dead (and
+        # DCE'd by the jit) on the CAVLC path
+        "cbp_luma": cbp_luma, "cbp_chroma": cbp_chroma,
+        "luma_tc_flat": luma_tc_flat, "ch_tc_flat": ch_tc_flat,
     }
 
 
@@ -687,14 +730,16 @@ _COMPACT_KEYS = (
 )
 
 
-def _compact_structure(s, A: int):
+def _compact_structure(s, A: int, keys=_COMPACT_KEYS):
     """Gather the coded MBs of a frame structure into a dense prefix of
     `A` padded slots (raster order preserved; slots past the coded count
     stay all-zero, so their segments emit zero bits and vanish in the
     merge). One row scatter per array — M near-unique updates each, the
     same cheap shape as encoder_core's sparse pair compaction. Coded MBs
     past slot A are DROPPED: the caller must only select this path when
-    ns <= A (pack_p_slice_bits_active's bucket switch guarantees it)."""
+    ns <= A (pack_p_slice_bits_active's bucket switch guarantees it).
+    ``keys`` selects the per-MB arrays to gather (device_cabac passes
+    its own set, which includes the CABAC context columns)."""
     coded = s["coded"]
     pos = jnp.cumsum(coded.astype(jnp.int32)) - 1
     dest = jnp.where(coded & (pos < A), pos, A)  # sentinel row, dropped
@@ -703,7 +748,7 @@ def _compact_structure(s, A: int):
         buf = jnp.zeros((A + 1,) + a.shape[1:], a.dtype)
         return buf.at[dest].set(a)[:A]
 
-    return {k: cp(s[k]) for k in _COMPACT_KEYS}
+    return {k: cp(s[k]) for k in keys}
 
 
 def _emit_slice_bits(s, word_cap: int):

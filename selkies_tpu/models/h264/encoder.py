@@ -47,13 +47,20 @@ from selkies_tpu.models.h264.compact import (
     unpack_i_compact,
     unpack_p_compact,
 )
+from selkies_tpu.models.h264.cabac import pack_slice_cabac, pack_slice_p_cabac
+from selkies_tpu.models.h264.device_cabac import (
+    assemble_p_cabac_nal,
+    pack_p_slice_tokens_active,
+)
 from selkies_tpu.models.h264.device_cavlc import (
     WORD_CAP_DEFAULT as BITS_WORD_CAP,
     assemble_p_nal,
+    entropy_coder_default,
     pack_p_slice_bits_active,
     resolve_entropy,
 )
 from selkies_tpu.models.h264.encoder_core import (
+    _bitpack32,
     encode_frame_p_planes,
     encode_frame_planes,
     fuse_downlink,
@@ -161,6 +168,37 @@ def _p_bits_step(y, u, v, qp, ref_y, ref_u, ref_v):
     return prefix, words, header, buf, out["recon_y"], out["recon_u"], out["recon_v"]
 
 
+# CABAC full-P token downlink: tokens are 16-bit IR slots (two per
+# word), so the cap and prefix double relative to the CAVLC bit path to
+# cover the same slice activity.
+TOK_WORD_CAP = 1 << 18
+TOK_PREFIX_WORDS = 1 << 17
+
+
+def _p_toks_step(y, u, v, qp, ref_y, ref_u, ref_v):
+    """Full-P with ON-DEVICE CABAC binarization (device_cabac.py): the
+    downlink is the 16-bit token IR plus what the host interleave needs
+    — the skip bitmap and the coded MBs' token counts — packed into one
+    uint32 prefix [ntok, ns, nskip] ++ skip_words ++ count pairs ++
+    token words. The sequential arithmetic engine stays on the host
+    (native/cabac_pack.cc)."""
+    out = encode_frame_p_planes(y, u, v, ref_y, ref_u, ref_v, qp)
+    words, ntok, counts, ns = pack_p_slice_tokens_active(out, TOK_WORD_CAP)
+    skip = out["skip"].reshape(-1)
+    nskip = skip.sum().astype(jnp.int32)
+    skip_words = _bitpack32(skip)
+    m = counts.shape[0]
+    cnt16 = jnp.pad(counts.astype(jnp.int16), (0, m & 1))
+    cnt_words = jax.lax.bitcast_convert_type(
+        cnt16.reshape(-1, 2), jnp.int32).reshape(-1)
+    meta = jnp.stack([ntok, ns, nskip])
+    prefix = jnp.concatenate([
+        meta.astype(jnp.uint32), skip_words.astype(jnp.uint32),
+        cnt_words.astype(jnp.uint32), words[:TOK_PREFIX_WORDS]])
+    header, buf = pack_p_compact(out)
+    return prefix, words, header, buf, out["recon_y"], out["recon_u"], out["recon_v"]
+
+
 # Full-frame uploads ride in Y_CHUNKS+2 concurrent device_puts: h2d
 # transfers overlap ~2.5x across Python threads on the relay
 # (tools/profile_upload_chunks.py: 3.1 MB in 175 ms vs 264 serial; more
@@ -177,6 +215,11 @@ def _i_planes_step_chunked(y0, y1, y2, y3, u, v, qp):
 def _p_bits_step_chunked(y0, y1, y2, y3, u, v, qp, ref_y, ref_u, ref_v):
     y = jnp.concatenate([y0, y1, y2, y3], 0)
     return (*_p_bits_step(y, u, v, qp, ref_y, ref_u, ref_v), y, u, v)
+
+
+def _p_toks_step_chunked(y0, y1, y2, y3, u, v, qp, ref_y, ref_u, ref_v):
+    y = jnp.concatenate([y0, y1, y2, y3], 0)
+    return (*_p_toks_step(y, u, v, qp, ref_y, ref_u, ref_v), y, u, v)
 
 
 def _p_planes_step_chunked(y0, y1, y2, y3, u, v, qp, ref_y, ref_u, ref_v):
@@ -213,12 +256,14 @@ def _pack_sparse_p(out, nscap, cap, density, entropy=None):
     rows with that dense-fallback cap (pack_p_sparse_packed). entropy
     (bits_words, min_mbs, buckets) wraps either layout in the
     activity-proportional device-entropy decision (pack_p_sparse_
-    entropy): busy frames then ship final slice bits, quiet frames the
-    sparse rows — same fused-buffer fetch either way."""
+    entropy): busy frames then ship final slice bits (CAVLC) or the
+    binarized token IR (CABAC), quiet frames the sparse rows — same
+    fused-buffer fetch either way."""
     if entropy is not None:
-        bits_words, min_mbs, buckets = entropy
+        bits_words, min_mbs, buckets, coder = entropy
         return pack_p_sparse_entropy(out, nscap, cap, density,
-                                     bits_words, min_mbs, buckets)
+                                     bits_words, min_mbs, buckets,
+                                     entropy_coder=coder)
     if density is None:
         return pack_p_sparse_var(out, nscap, cap)
     return pack_p_sparse_packed(out, nscap, cap, density)
@@ -536,6 +581,7 @@ class TPUH264Encoder:
         scene_qp_boost: int = 0,
         device_entropy: bool | None = None,
         bits_min_mbs: int | None = None,
+        entropy_coder: str | None = None,
         ltr_scenes: bool = True,
         tile_cache: int | None = None,
         packed_downlink: bool | None = None,
@@ -577,7 +623,13 @@ class TPUH264Encoder:
         self.set_qp(qp)
         self.channels = channels
         self.keyframe_interval = int(keyframe_interval)  # 0 = infinite GOP
-        self.params = StreamParams(width=width, height=height, qp=self.qp, fps=fps)
+        # entropy_coder: cavlc (Baseline, the byte-contract default) or
+        # cabac (Main profile). PPS-scoped, so every slice of the stream
+        # uses the same coder; SELKIES_ENTROPY_CODER is the env default,
+        # explicit constructor arguments win.
+        self._coder = entropy_coder_default(entropy_coder)
+        self.params = StreamParams(width=width, height=height, qp=self.qp,
+                                   fps=fps, entropy_coder=self._coder)
         self._headers = write_sps(self.params) + write_pps(self.params)
         self._pad_h = (height + 15) // 16 * 16
         self._pad_w = (width + 15) // 16 * 16
@@ -617,14 +669,17 @@ class TPUH264Encoder:
         (self.device_entropy, self.bits_min_mbs, self._bits_words,
          self._entropy) = resolve_entropy(
             (self._pad_h // 16) * (self._pad_w // 16),
-            device_entropy, bits_min_mbs)
+            device_entropy, bits_min_mbs, entropy_coder=self._coder)
         if self._prep is None:  # device conversion mode: host path only
             self.device_entropy = False
             self._entropy = None
         if self._prep is not None:
             self._step = jax.jit(_i_planes_step_chunked)
             self._step_p = jax.jit(_p_planes_step_chunked, donate_argnums=(7, 8, 9))
-            self._step_pb = jax.jit(_p_bits_step_chunked, donate_argnums=(7, 8, 9))
+            self._step_pb = jax.jit(
+                _p_toks_step_chunked if self._coder == "cabac"
+                else _p_bits_step_chunked,
+                donate_argnums=(7, 8, 9))
             # delta-upload steps: source planes are donated (scatter is
             # in-place) and returned updated; refs donated as usual
             # nscap/cap ride in a partial (not read from module globals
@@ -797,7 +852,8 @@ class TPUH264Encoder:
         if self._entropy is not None:
             self._pfx_total = p_sparse_entropy_words(
                 mbh, mbw, self._nscap, self._cap_delta,
-                self._density is not None, self._bits_words)
+                self._density is not None, self._bits_words,
+                entropy_coder=self._coder)
         elif self._density is not None:
             self._pfx_total = p_sparse_packed_words(mbh, mbw, self._nscap, self._cap_delta)
         else:
@@ -860,6 +916,18 @@ class TPUH264Encoder:
     def force_keyframe(self) -> None:
         self._force_idr = True
 
+    @property
+    def entropy_coder(self) -> str:
+        """Active entropy backend ("cavlc"/"cabac") — telemetry stamps
+        this onto every frame event (frame_done)."""
+        return self._coder
+
+    @property
+    def h264_profile(self) -> str:
+        """Profile the SPS declares ("baseline"/"main") — the WebRTC
+        plane's fmtp profile-level-id must match it (sdp.py)."""
+        return "main" if self._coder == "cabac" else "baseline"
+
     # -- policy actuation (selkies_tpu/policy): runtime-safe retunes ---
 
     def set_tile_cache(self, enabled: bool) -> bool:
@@ -909,7 +977,8 @@ class TPUH264Encoder:
         return True
 
     def retune_entropy(self, device_entropy: bool | None = None,
-                       bits_min_mbs: int | None = None) -> bool:
+                       bits_min_mbs: int | None = None,
+                       entropy_coder: str | None = None) -> bool:
         """Re-resolve the device-entropy downlink decision at runtime
         (policy actuation); returns True when anything changed. Bytes
         are identical either way (tests/test_device_entropy_sparse.py)
@@ -920,13 +989,46 @@ class TPUH264Encoder:
         engine's dwell is what keeps this off the flap path. The
         caller must have NO frames in flight (the in-flight frames'
         completion reads the downlink sizing being replaced); the
-        policy actuator drains the pipeline first."""
+        policy actuator drains the pipeline first.
+
+        entropy_coder="cavlc"/"cabac" additionally switches the stream's
+        entropy backend. Unlike the downlink knobs this changes the
+        BITSTREAM (entropy_coding_mode_flag is PPS-scoped): new SPS/PPS
+        are emitted and an IDR is forced so the decoder reconfigures at
+        a clean boundary."""
         if self._prep is None:  # device-convert mode has no entropy path
             return False
+        coder = self._coder if entropy_coder is None else (
+            entropy_coder_default(entropy_coder))
         de, bm, bw, ent = resolve_entropy(
-            self._mbh * self._mbw, device_entropy, bits_min_mbs)
-        if de == self.device_entropy and bm == self.bits_min_mbs:
+            self._mbh * self._mbw, device_entropy, bits_min_mbs,
+            entropy_coder=coder)
+        if (de == self.device_entropy and bm == self.bits_min_mbs
+                and coder == self._coder):
             return False
+        if coder != self._coder:
+            if self._inflight or self._batch_pend:
+                raise RuntimeError(
+                    "retune_entropy with frames in flight; flush first")
+            from selkies_tpu.monitoring import jitprof
+
+            jitprof.mark("actuation", "entropy-coder-switch")
+            self._coder = coder
+            self.params = StreamParams(
+                width=self.width, height=self.height, qp=self.qp,
+                fps=self.fps, entropy_coder=coder)
+            self._headers = write_sps(self.params) + write_pps(self.params)
+            self._step_pb = jax.jit(
+                _p_toks_step_chunked if coder == "cabac"
+                else _p_bits_step_chunked,
+                donate_argnums=(7, 8, 9))
+            self.device_entropy, self.bits_min_mbs = de, bm
+            self._bits_words, self._entropy = bw, ent
+            self._rebuild_entropy_partials()
+            # decoder must see the new PPS before any slice that uses
+            # the other coder: restart the GOP
+            self.force_keyframe()
+            return True
         if ent == self._entropy and bw == self._bits_words:
             # threshold bookkeeping with the device coder disabled (or
             # consts unchanged): no jitted partial closes over it, so
@@ -944,6 +1046,13 @@ class TPUH264Encoder:
         jitprof.mark("actuation", "entropy-retune")
         self.device_entropy, self.bits_min_mbs = de, bm
         self._bits_words, self._entropy = bw, ent
+        self._rebuild_entropy_partials()
+        return True
+
+    def _rebuild_entropy_partials(self) -> None:
+        """Rebuild the jitted delta-step partials and the downlink
+        sizing after the entropy consts changed (retune_entropy, both
+        the downlink-knob and the coder-switch paths)."""
         _consts = dict(nscap=self._nscap, cap=self._cap_delta,
                        tile_w=self._tile_w, density=self._density,
                        entropy=self._entropy)
@@ -959,7 +1068,8 @@ class TPUH264Encoder:
         if self._entropy is not None:
             self._pfx_total = p_sparse_entropy_words(
                 self._mbh, self._mbw, self._nscap, self._cap_delta,
-                self._density is not None, self._bits_words)
+                self._density is not None, self._bits_words,
+                entropy_coder=self._coder)
         elif self._density is not None:
             self._pfx_total = p_sparse_packed_words(
                 self._mbh, self._mbw, self._nscap, self._cap_delta)
@@ -969,7 +1079,6 @@ class TPUH264Encoder:
         with self._pfx_lock:
             self._pfx_recent.clear()
             self._pfx_hint = min(self._pfx_small, self._pfx_total)
-        return True
 
     # -- frame classification (static / delta / full upload) -----------
 
@@ -1101,6 +1210,10 @@ class TPUH264Encoder:
                 qp=self.qp,
             )
         self._allskip.qp = self.qp
+        if self._coder == "cabac":
+            # the PPS pins entropy_coding_mode_flag for the whole stream
+            return pack_slice_p_cabac(self._allskip, self.params, frame_num,
+                                      mark_ltr=mark_ltr, mmco_evict=mmco_evict)
         return pack_slice_p_fast(self._allskip, self.params, frame_num=frame_num,
                                  mark_ltr=mark_ltr, mmco_evict=mmco_evict)
 
@@ -1644,7 +1757,7 @@ class TPUH264Encoder:
             link_bytes=self.link_bytes, prefix_bytes=fused.nbytes,
             note_need=self._note_need,
             ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
-            mmco_evict=rec.mmco_evict)
+            mmco_evict=rec.mmco_evict, entropy_coder=self._coder)
         return au, skipped, t1, tu, time.perf_counter(), mode
 
     def _complete_batch(self, recs, pfx_slice_d, pfx_rows_d, denses_d, bufs_d):
@@ -2082,6 +2195,8 @@ class TPUH264Encoder:
         fetch_ms) — the unpack/cavlc and upload/step/fetch splits feed
         the stage attribution in FrameStats."""
         if rec.kind == "pb":
+            if self._coder == "cabac":
+                return self._complete_toks(rec)
             return self._complete_bits(rec)
         if rec.kind == "pd":
             step_ms, t_ready = self._wait_step(rec, rec.pfx_slice_d)
@@ -2112,9 +2227,14 @@ class TPUH264Encoder:
             # frame_num counts from the last IDR (7.4.3: gaps are
             # disallowed by our SPS)
             with tracer.span("pack"):
-                slice_nal = pack_slice_fast(
-                    fc, self.params, frame_num=0, idr=True, idr_pic_id=rec.idr_pic_id
-                )
+                if self._coder == "cabac":
+                    slice_nal = pack_slice_cabac(
+                        fc, self.params, frame_num=0, idr=True,
+                        idr_pic_id=rec.idr_pic_id)
+                else:
+                    slice_nal = pack_slice_fast(
+                        fc, self.params, frame_num=0, idr=True,
+                        idr_pic_id=rec.idr_pic_id)
             au = self._headers + slice_nal
         else:
             with tracer.span("unpack"):
@@ -2122,9 +2242,16 @@ class TPUH264Encoder:
             tu = time.perf_counter()
             skipped = int(pfc.skip.sum())
             with tracer.span("pack"):
-                au = pack_slice_p_fast(pfc, self.params, frame_num=rec.frame_num,
-                                       ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
-                                       mmco_evict=rec.mmco_evict)
+                if self._coder == "cabac":
+                    au = pack_slice_p_cabac(
+                        pfc, self.params, rec.frame_num,
+                        ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
+                        mmco_evict=rec.mmco_evict)
+                else:
+                    au = pack_slice_p_fast(
+                        pfc, self.params, frame_num=rec.frame_num,
+                        ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
+                        mmco_evict=rec.mmco_evict)
         # downlink_mode is a P-frame label ("" on the IDR row — keyframes
         # can never ship device bits, so they must not count as "coeff")
         mode = "coeff" if rec.kind != "i" else ""
@@ -2163,6 +2290,56 @@ class TPUH264Encoder:
                             rec.qp, ltr_ref=rec.ltr_ref, mark_ltr=rec.mark_ltr,
                             mmco_evict=rec.mmco_evict)
         return au, skipped, t1, t1, time.perf_counter(), "bits", step_ms, fetch_ms
+
+    def _complete_toks(self, rec: "_Pending"):
+        """Device-CABAC P frame: fetch [meta ++ skip bitmap ++ counts ++
+        token words], interleave the skip/terminate bins and run the
+        host arithmetic engine — no coefficient unpack, no host
+        binarization."""
+        step_ms, t_ready = self._wait_step(rec, rec.prefix_d)
+        arr = np.asarray(rec.prefix_d)  # uint32: ntok, ns, nskip, ...
+        fetch_ms = (time.perf_counter() - t_ready) * 1e3
+        self.link_bytes.add("down_bits", arr.nbytes)
+        ntok, ns, skipped = int(arr[0]), int(arr[1]), int(arr[2])
+        if ntok > 2 * TOK_WORD_CAP:
+            # pathological frame overflowed the token buffer: dense
+            # fallback — still through the host CABAC coder (the PPS
+            # pins entropy_coding_mode_flag for the whole stream)
+            header = np.asarray(rec.hdr_d)
+            data = _fetch_rest(rec.buf_d, int(header[0]), 0)
+            self.link_bytes.add("down_spill", header.nbytes + data.nbytes)
+            t1 = time.perf_counter()
+            pfc = unpack_p_compact(header, data, rec.qp)
+            tu = time.perf_counter()
+            au = pack_slice_p_cabac(pfc, self.params, rec.frame_num,
+                                    ltr_ref=rec.ltr_ref,
+                                    mark_ltr=rec.mark_ltr,
+                                    mmco_evict=rec.mmco_evict)
+            return (au, int(pfc.skip.sum()), t1, tu, time.perf_counter(),
+                    "dense", step_ms, fetch_ms)
+        m = self._mbh * self._mbw
+        sw = (m + 31) // 32
+        cw = (m + 1) // 2
+        skip_words = arr[3:3 + sw].astype(np.int64)
+        skip = (((skip_words[:, None] >> np.arange(32)) & 1)
+                .astype(bool).reshape(-1)[:m].reshape(self._mbh, self._mbw))
+        counts = (np.ascontiguousarray(arr[3 + sw:3 + sw + cw])
+                  .view(np.int16)[:ns].astype(np.int64))
+        base = 3 + sw + cw
+        need = (ntok + 1) // 2
+        words = arr[base:base + min(need, TOK_PREFIX_WORDS)]
+        if need > TOK_PREFIX_WORDS:  # spill: one extra fetch
+            with tracer.span("bits_fetch"):
+                rest = _fetch_rest(rec.words_d, need, TOK_PREFIX_WORDS)
+            self.link_bytes.add("down_bits_spill", rest.nbytes)
+            words = np.concatenate([words, rest])
+        t1 = time.perf_counter()
+        au = assemble_p_cabac_nal(words, ntok, counts, skip, self.params,
+                                  rec.frame_num, rec.qp, ltr_ref=rec.ltr_ref,
+                                  mark_ltr=rec.mark_ltr,
+                                  mmco_evict=rec.mmco_evict)
+        return (au, skipped, t1, t1, time.perf_counter(), "cabac", step_ms,
+                fetch_ms)
 
     def encode_frame(self, frame: np.ndarray, qp: int | None = None) -> bytes:
         """Synchronous encode ((H, W, 4) BGRx or (H, W, 3) RGB uint8 in,

@@ -1150,7 +1150,8 @@ def pack_p_sparse_packed(out, nscap: int, cap_rows: int, density_pct: int = 75):
 
 def pack_p_sparse_entropy(out, nscap: int, cap_rows: int,
                           density_pct: int | None, bits_words: int,
-                          min_mbs: int, buckets: tuple[int, ...]):
+                          min_mbs: int, buckets: tuple[int, ...],
+                          entropy_coder: str = "cavlc"):
     """Activity-proportional entropy downlink: busy frames ship their
     FINAL slice bits, quiet frames ship sparse coefficients — decided
     per frame ON DEVICE, inside the same jit (so it composes with the
@@ -1176,7 +1177,22 @@ def pack_p_sparse_entropy(out, nscap: int, cap_rows: int,
     (fused, dense_header, buf) with the same fallback contract as the
     wrapped sparse packers (dense/buf are coeff-mode-only fetches).
     host half: models/h264/sparse_complete.complete_sparse_slice
-    (device_bits=True)."""
+    (device_bits=True).
+
+    With entropy_coder="cabac" the device half is the token binarizer
+    (device_cabac.pack_p_slice_tokens_active) and mode=1 carries the
+    16-bit token IR instead of final bits — the host still owns the
+    sequential arithmetic engine. Payload layout after the meta prefix
+    (meta2 = [1, ntok, 0, nskip, ns, 0, 0, 0]):
+
+      skip bitmap (2*sw int16, the host interleaves per-MB skip flags)
+      ++ per-coded-MB token counts (first ns of an A_max block, int16)
+      ++ token words at offset 2*sw + ns (the dead counts tail is
+         overwritten, keeping the live fetch contiguous — the same
+         trick as pack_p_sparse_packed's bitmap/value split)."""
+    if entropy_coder == "cabac":
+        return _pack_p_sparse_cabac(out, nscap, cap_rows, density_pct,
+                                    bits_words, min_mbs, buckets)
     from selkies_tpu.models.h264.device_cavlc import pack_p_slice_bits_active
 
     if density_pct is None:
@@ -1206,6 +1222,54 @@ def pack_p_sparse_entropy(out, nscap: int, cap_rows: int,
         return jax.lax.dynamic_update_slice(f, w16, (16,))
 
     fused2 = jax.lax.cond(use_bits, wr_bits, wr_coeff, fused2)
+    fused2 = jax.lax.dynamic_update_slice(fused2, head16, (0,))
+    return fused2, dense, buf
+
+
+def _pack_p_sparse_cabac(out, nscap: int, cap_rows: int,
+                         density_pct: int | None, bits_words: int,
+                         min_mbs: int, buckets: tuple[int, ...]):
+    """CABAC arm of pack_p_sparse_entropy (layout documented there)."""
+    from selkies_tpu.models.h264.device_cabac import (
+        pack_p_slice_tokens_active)
+
+    if density_pct is None:
+        fused, dense, buf = pack_p_sparse_var(out, nscap, cap_rows)
+    else:
+        fused, dense, buf = pack_p_sparse_packed(out, nscap, cap_rows, density_pct)
+    words, ntok, counts, ns = pack_p_slice_tokens_active(
+        out, word_cap=bits_words, buckets=buckets)
+    skip_words = _bitpack32(out["skip"].reshape(-1))
+    sw = skip_words.shape[0]
+    nskip = out["skip"].reshape(-1).sum().astype(jnp.int32)
+    A_max = buckets[-1]
+    use_bits = (
+        (ns >= jnp.int32(min_mbs))
+        & (ns <= jnp.int32(A_max))
+        & (ntok <= jnp.int32(2 * bits_words))
+    )
+    meta2 = jnp.stack([
+        use_bits.astype(jnp.int32), ntok, jnp.int32(0), nskip, ns,
+        jnp.int32(0), jnp.int32(0), jnp.int32(0)])
+    head16 = jax.lax.bitcast_convert_type(meta2, jnp.int16).reshape(-1)
+    base = 16 + 2 * sw
+    total16 = 16 + max(int(fused.shape[0]),
+                       2 * sw + A_max + 2 * bits_words)
+    fused2 = jnp.zeros((total16,), jnp.int16)
+
+    def wr_coeff(f):
+        return jax.lax.dynamic_update_slice(f, fused, (16,))
+
+    def wr_toks(f):
+        sk16 = jax.lax.bitcast_convert_type(skip_words, jnp.int16).reshape(-1)
+        f = jax.lax.dynamic_update_slice(f, sk16, (16,))
+        f = jax.lax.dynamic_update_slice(
+            f, counts.astype(jnp.int16), (base,))
+        w16 = jax.lax.bitcast_convert_type(words, jnp.int16).reshape(-1)
+        return jax.lax.dynamic_update_slice(
+            f, w16, (base + jnp.clip(ns, 0, A_max),))
+
+    fused2 = jax.lax.cond(use_bits, wr_toks, wr_coeff, fused2)
     fused2 = jax.lax.dynamic_update_slice(fused2, head16, (0,))
     return fused2, dense, buf
 

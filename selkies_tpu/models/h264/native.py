@@ -148,6 +148,16 @@ def _load_impl() -> ctypes.CDLL | None:
         ]
     except AttributeError:
         pass  # stale .so; python fallback used
+    try:
+        # CABAC token-stream arithmetic coder (cabac_pack.cc) — absent
+        # from a stale .so; callers gate on cabac_native_available()
+        lib.cabac_encode_tokens.restype = ctypes.c_int64
+        lib.cabac_encode_tokens.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint16),
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+        ]
+    except AttributeError:
+        pass
     return lib
 
 
@@ -322,6 +332,42 @@ def pack_slice_p_native(fc: PFrameCoeffs, p: StreamParams, frame_num: int,
         if cap > (1 << 30):
             raise RuntimeError("pack_slice_p_rbsp overflow beyond 1 GiB")
     return _finish_nal(s, n, NAL_SLICE_NON_IDR)
+
+
+def cabac_native_available() -> bool:
+    """True when libcavlc.so exports the CABAC arithmetic coder (a stale
+    .so lacks it) and SELKIES_CABAC_NATIVE != 0."""
+    if os.environ.get("SELKIES_CABAC_NATIVE", "1") == "0":
+        return False
+    lib = _load()
+    return lib is not None and hasattr(lib, "cabac_encode_tokens")
+
+
+def cabac_encode_tokens(states: np.ndarray, tokens: np.ndarray) -> bytes:
+    """Run the token stream through the native arithmetic engine.
+    Byte-identical to cabac.encode_tokens_py (tests/test_cabac.py)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "cabac_encode_tokens"):
+        raise RuntimeError("libcavlc.so cabac coder unavailable")
+    st = np.ascontiguousarray(states, np.uint8)
+    tok = np.ascontiguousarray(tokens, np.uint16)
+    # worst case ~1.03 bits/bin plus flush; 1 byte per token is generous
+    cap = int(len(tok)) + 64
+    while True:
+        out = np.empty(cap, np.uint8)
+        n = lib.cabac_encode_tokens(
+            st.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            tok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+            len(tok),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), cap,
+        )
+        if n == -2:
+            raise ValueError("token stream did not end in a TERM(1) flush")
+        if n >= 0:
+            return out[:n].tobytes()
+        cap *= 2  # RUN/BYP tokens can expand past 1 byte/token
+        if cap > (1 << 30):
+            raise RuntimeError("cabac_encode_tokens overflow beyond 1 GiB")
 
 
 def sparse_native_available() -> bool:

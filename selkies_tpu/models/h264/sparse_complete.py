@@ -50,6 +50,7 @@ from selkies_tpu.models.h264.compact import (
     unpack_p_sparse_packed,
     unpack_p_sparse_var,
 )
+from selkies_tpu.models.h264.device_cabac import assemble_p_cabac_nal
 from selkies_tpu.models.h264.device_cavlc import assemble_p_nal
 from selkies_tpu.models.h264.native import (
     pack_slice_p_fast,
@@ -59,6 +60,26 @@ from selkies_tpu.models.h264.native import (
 from selkies_tpu.monitoring.tracing import tracer
 
 __all__ = ["complete_sparse_slice", "fetch_rest"]
+
+
+def _settle_device_bits(fused, need, note_need, link_bytes, prefix_bytes,
+                        full_d):
+    """Shared mode=1 completion plumbing — hint feedback, downlink-byte
+    accounting and the hint-too-small refetch are identical for both
+    entropy coders; only the payload parse after this differs. Returns
+    the (possibly refetched) fused buffer."""
+    if note_need is not None:
+        note_need(need)
+    if link_bytes is not None and prefix_bytes:
+        link_bytes.add("down_bits", prefix_bytes)
+    if need > len(fused):  # hint too small: refetch
+        # span marks only the EXTRA transfer (tracing.py contract —
+        # the main prefix fetch rode the caller's "fetch" span)
+        with tracer.span("bits_fetch"):
+            fused = np.asarray(full_d)
+        if link_bytes is not None:
+            link_bytes.add("down_bits_refetch", fused.nbytes)
+    return fused
 
 
 def fetch_rest(buf, n: int, base: int = 4096) -> np.ndarray:
@@ -97,6 +118,8 @@ def complete_sparse_slice(
     ltr_ref: int | None = None,
     mark_ltr: int | None = None,
     mmco_evict: tuple = (),
+    entropy_coder: str = "cavlc",
+    cabac_init_idc: int = 0,
 ) -> tuple[bytes, int, float, str]:
     """One P slice's fused sparse downlink → (nal, skipped_mbs,
     t_unpacked, downlink_mode).
@@ -118,23 +141,43 @@ def complete_sparse_slice(
     """
     off = 0
     if device_bits:
-        mode, nbits, trailing, nskip, _ns = p_sparse_entropy_meta(fused)
+        mode, nbits, trailing, nskip, ns = p_sparse_entropy_meta(fused)
+        if mode == 1 and entropy_coder == "cabac":
+            # device-token payload: interleave skip/terminate bins and
+            # run the host arithmetic engine — no unpack, no host
+            # binarization (the slice's mb token bodies came binarized
+            # and context-indexed from the device)
+            ntok = nbits  # the nbits meta slot carries ntok for cabac
+            m = mbh * mbw
+            sw = (m + 31) // 32
+            nw = (ntok + 1) // 2
+            base = ENTROPY_META16 + 2 * sw
+            need = base + ns + 2 * nw
+            fused = _settle_device_bits(fused, need, note_need,
+                                        link_bytes, prefix_bytes, full_d)
+            skip_words = (np.ascontiguousarray(
+                fused[ENTROPY_META16:base]).view(np.int32)
+                .astype(np.int64) & 0xFFFFFFFF)
+            skip = (((skip_words[:, None] >> np.arange(32)) & 1)
+                    .astype(bool).reshape(-1)[:m].reshape(mbh, mbw))
+            counts = fused[base:base + ns].astype(np.int64)
+            words = np.ascontiguousarray(
+                fused[base + ns:base + ns + 2 * nw]).view(np.uint32)
+            t_unpacked = time.perf_counter()
+            with tracer.span("pack"):
+                nal = assemble_p_cabac_nal(
+                    words, ntok, counts, skip, params, frame_num, qp,
+                    ltr_ref=ltr_ref, mark_ltr=mark_ltr,
+                    mmco_evict=mmco_evict, first_mb=first_mb,
+                    cabac_init_idc=cabac_init_idc)
+            return nal, nskip, t_unpacked, "cabac"
         if mode == 1:
             # device-entropy payload: the words ARE the slice data —
             # splice the header, no unpack, no host CAVLC
             nw = (nbits + 31) // 32
             need = ENTROPY_META16 + 2 * nw
-            if note_need is not None:
-                note_need(need)
-            if link_bytes is not None and prefix_bytes:
-                link_bytes.add("down_bits", prefix_bytes)
-            if need > len(fused):  # hint too small: refetch
-                # span marks only the EXTRA transfer (tracing.py contract
-                # — the main prefix fetch rode the caller's "fetch" span)
-                with tracer.span("bits_fetch"):
-                    fused = np.asarray(full_d)
-                if link_bytes is not None:
-                    link_bytes.add("down_bits_refetch", fused.nbytes)
+            fused = _settle_device_bits(fused, need, note_need,
+                                        link_bytes, prefix_bytes, full_d)
             words = np.ascontiguousarray(
                 fused[ENTROPY_META16:ENTROPY_META16 + 2 * nw]).view(np.uint32)
             t_unpacked = time.perf_counter()
@@ -165,7 +208,8 @@ def complete_sparse_slice(
             if link_bytes is not None:
                 link_bytes.add("down_spill", extra.nbytes)
         wire = pfc = None
-        if ns <= nscap and sparse_native_available():
+        if (ns <= nscap and entropy_coder == "cavlc"
+                and sparse_native_available()):
             wire = p_sparse_wire_views(
                 fused, mbh, mbw, nscap, cap_rows, packed, extra)
         if wire is None:
@@ -188,6 +232,17 @@ def complete_sparse_slice(
                 wire, params, frame_num, qp, ltr_ref=ltr_ref,
                 mark_ltr=mark_ltr, mmco_evict=mmco_evict, first_mb=first_mb)
             skipped = mbh * mbw - wire.ns
+        elif entropy_coder == "cabac":
+            # a Main-profile stream cannot mix in CAVLC slices
+            # (entropy_coding_mode_flag is PPS-scoped) — the coefficient
+            # fallback packs through the host CABAC coder instead
+            from selkies_tpu.models.h264.cabac import pack_slice_p_cabac
+
+            nal = pack_slice_p_cabac(
+                pfc, params, frame_num, ltr_ref=ltr_ref,
+                mark_ltr=mark_ltr, mmco_evict=mmco_evict,
+                first_mb=first_mb, cabac_init_idc=cabac_init_idc)
+            skipped = int(pfc.skip.sum())
         else:
             nal = pack_slice_p_fast(
                 pfc, params, frame_num=frame_num, ltr_ref=ltr_ref,

@@ -168,7 +168,7 @@ def _tpuh264enc(*, width: int, height: int, fps: int = 60, qp: int = 28, **kw):
 
         dropped = set(kw) - {"frame_batch", "pipeline_depth",
                              "keyframe_interval", "device_entropy",
-                             "bits_min_mbs"}
+                             "bits_min_mbs", "entropy_coder"}
         if dropped:
             # the solo encoder's uplink machinery (tile cache, delta
             # paths, LTR scenes, scene QP boost) does not apply to band
@@ -185,6 +185,7 @@ def _tpuh264enc(*, width: int, height: int, fps: int = 60, qp: int = 28, **kw):
             keyframe_interval=kw.get("keyframe_interval", 0),
             device_entropy=kw.get("device_entropy"),
             bits_min_mbs=kw.get("bits_min_mbs"),
+            entropy_coder=kw.get("entropy_coder"),
         )
     kw.setdefault("frame_batch", default_frame_batch())
     kw.setdefault("pipeline_depth", default_pipeline_depth())

@@ -543,16 +543,21 @@ class Telemetry:
                    cavlc_ms: float = 0.0, downlink_mode: str = "",
                    bits_fetch_ms: float = 0.0, classify_ms: float = 0.0,
                    convert_ms: float = 0.0, h2d_ms: float = 0.0,
-                   qp: int = 0, rc_fullness: float | None = None) -> None:
+                   qp: int = 0, rc_fullness: float | None = None,
+                   entropy_coder: str = "") -> None:
         """An encoded access unit left the encoder: fold its size, kind,
         and on-device / entropy-pack milliseconds. unpack/cavlc are the
         completion sub-stages of pack_ms (coefficient prep vs the CAVLC
         bit pack itself); rows that don't attribute them pass 0.
-        downlink_mode ("coeff"/"bits"/"dense", "" = no downlink) counts
-        into selkies_downlink_mode_total; bits_fetch_ms is the d2h
-        transfer of a device-entropy frame's bit words (the "bits_fetch"
-        stage), so bits-mode fetch latency stays separable from the
-        coefficient fetch it replaces. classify/convert/h2d are the
+        downlink_mode ("coeff"/"bits"/"cabac"/"dense", "" = no downlink;
+        "bits" = device CAVLC bit words, "cabac" = device token IR)
+        counts into selkies_downlink_mode_total; bits_fetch_ms is the
+        d2h transfer of a device-entropy frame's bit/token words (the
+        "bits_fetch" stage), so bits-mode fetch latency stays separable
+        from the coefficient fetch it replaces. entropy_coder
+        ("cavlc"/"cabac", "" = unattributed) stamps the stream's active
+        entropy backend onto the frame event so a recorder ring shows
+        which coder produced each AU across a retune. classify/convert/h2d are the
         uplink front-end sub-stages of the frame's upload cost (fused
         dirty scan + hash/split, BGRx->I420 of the upload payload, h2d
         transfer enqueues — ISSUE 12): without this split a regression
@@ -606,6 +611,8 @@ class Telemetry:
                                "unpack_ms": round(unpack_ms, 3),
                                "cavlc_ms": round(cavlc_ms, 3),
                                "mode": downlink_mode, "qp": qp,
+                               **({"coder": entropy_coder}
+                                  if entropy_coder else {}),
                                **({"vbv": round(rc_fullness, 3)}
                                   if rc_fullness is not None else {})})
 

@@ -339,7 +339,9 @@ class Orchestrator:
         )
         # the encoder row decides what the WebRTC plane negotiates
         # (an AV1 row must offer AV1/90000, not H.264)
-        self.webrtc.set_codec(getattr(self.app.encoder, "codec", "h264"))
+        self.webrtc.set_codec(
+            getattr(self.app.encoder, "codec", "h264"),
+            getattr(self.app.encoder, "h264_profile", "baseline"))
         self.audio: AudioPipeline | None = None
         if opus_available():
             self.audio = AudioPipeline(
@@ -626,7 +628,9 @@ class Orchestrator:
                 if self.app._swap_encoder(self.cfg.encoder,
                                           enc.width, enc.height):
                     self.app.encoder_name = self.cfg.encoder
-            self.webrtc.set_codec(getattr(self.app.encoder, "codec", "h264"))
+            self.webrtc.set_codec(
+            getattr(self.app.encoder, "codec", "h264"),
+            getattr(self.app.encoder, "h264_profile", "baseline"))
             # every session start reports its live codec, preference
             # list or not — the gauge means "currently negotiated"
             self._emit_codec_gauge(getattr(self.app.encoder, "codec", "h264"))
@@ -664,7 +668,8 @@ class Orchestrator:
                 logger.warning("negotiated %s encoder swap failed; staying "
                                "on %s", n.codec, current)
         codec = getattr(self.app.encoder, "codec", "h264")
-        self.webrtc.set_codec(codec)
+        self.webrtc.set_codec(
+            codec, getattr(self.app.encoder, "h264_profile", "baseline"))
         logger.info("client negotiated codec %s (%s)", codec, n.reason)
         telemetry.event("codec_negotiated", codec=codec, reason=n.reason,
                         encoder=self.app.encoder_name)

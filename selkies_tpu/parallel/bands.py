@@ -86,7 +86,11 @@ from selkies_tpu.models.h264.compact import (
     split_prefix,
     unpack_i_compact,
 )
-from selkies_tpu.models.h264.device_cavlc import resolve_entropy
+from selkies_tpu.models.h264.cabac import pack_slice_cabac, pack_slice_p_cabac
+from selkies_tpu.models.h264.device_cavlc import (
+    entropy_coder_default,
+    resolve_entropy,
+)
 from selkies_tpu.models.h264.encoder_core import (
     _downsample4,
     _skip_mask,
@@ -303,11 +307,13 @@ def _pack_fused(out, nscap: int, cap_rows: int, entropy):
     if entropy is not None:
         # activity-proportional device entropy per row: a busy row
         # ships its own bit-shifted slice payload (first_mb lives in the
-        # host-written header), a quiet row keeps the sparse rows —
-        # decided per row per frame, inside the shard_map body
-        bits_words, min_mbs, buckets = entropy
+        # host-written header) or, under CABAC, its binarized token IR —
+        # a quiet row keeps the sparse rows — decided per row per frame,
+        # inside the shard_map body
+        bits_words, min_mbs, buckets, coder = entropy
         fused, _dense, buf = pack_p_sparse_entropy(
-            out, nscap, cap_rows, None, bits_words, min_mbs, buckets)
+            out, nscap, cap_rows, None, bits_words, min_mbs, buckets,
+            entropy_coder=coder)
     else:
         fused, _dense, buf = pack_p_sparse_var(out, nscap, cap_rows)
     return fused, buf
@@ -622,7 +628,8 @@ class BandedH264Encoder:
                  devices=None, frame_batch: int = 1, pipeline_depth: int = 1,
                  pack_workers: int | None = None,
                  device_entropy: bool | None = None,
-                 bits_min_mbs: int | None = None):
+                 bits_min_mbs: int | None = None,
+                 entropy_coder: str | None = None):
         if channels != 4:
             raise ValueError("band-parallel encode expects BGRx capture (channels=4)")
         self.width = width
@@ -723,13 +730,17 @@ class BandedH264Encoder:
         # encoder's knobs resolved at per-slice geometry — one shared
         # resolver, device_cavlc.resolve_entropy): a busy band downlinks
         # its final slice bits instead of coefficient rows
+        # PPS-scoped entropy backend: every band slice of the stream
+        # uses the same coder (SELKIES_ENTROPY_CODER; explicit wins)
+        self._coder = entropy_coder_default(entropy_coder)
         (self.device_entropy, self.bits_min_mbs, self._bits_words,
          self._entropy) = resolve_entropy(m_band, device_entropy,
-                                          bits_min_mbs)
+                                          bits_min_mbs,
+                                          entropy_coder=self._coder)
         if self._entropy is not None:
             self._pfx_total = p_sparse_entropy_words(
                 self._band_mbh, self._mbw, self._nscap, self._cap_p,
-                False, self._bits_words)
+                False, self._bits_words, entropy_coder=self._coder)
         else:
             self._pfx_total = p_sparse_var_words(
                 self._band_mbh, self._mbw, self._nscap, self._cap_p)
@@ -741,7 +752,8 @@ class BandedH264Encoder:
 
         chips = self.bands * self.cols
         self.mesh_enabled = chips > 1 and len(devs) >= chips
-        self.params = StreamParams(width=width, height=height, qp=self.qp, fps=fps)
+        self.params = StreamParams(width=width, height=height, qp=self.qp,
+                                   fps=fps, entropy_coder=self._coder)
         self._headers = write_sps(self.params) + write_pps(self.params)
         from selkies_tpu.models.frameprep import FramePrep
 
@@ -855,6 +867,18 @@ class BandedH264Encoder:
     def force_keyframe(self) -> None:
         self._force_idr = True
 
+    @property
+    def entropy_coder(self) -> str:
+        """Active entropy backend ("cavlc"/"cabac") — telemetry stamps
+        this onto every frame event (frame_done)."""
+        return self._coder
+
+    @property
+    def h264_profile(self) -> str:
+        """Profile the SPS declares ("baseline"/"main") — the WebRTC
+        plane's fmtp profile-level-id must match it (sdp.py)."""
+        return "main" if self._coder == "cabac" else "baseline"
+
     # -- device dispatch ------------------------------------------------
 
     def _put_band_planes(self, y: np.ndarray, u: np.ndarray, v: np.ndarray):
@@ -943,9 +967,16 @@ class BandedH264Encoder:
             fc = unpack_i_compact(header, data, self.qp)
         t_u = time.perf_counter()
         with tracer.span("pack"):
-            nal = pack_slice_fast(
-                fc, self.params, frame_num=0, idr=True, idr_pic_id=idr_pic_id,
-                first_mb=self.spans[band][0] * self._mbw)
+            if self._coder == "cabac":
+                nal = pack_slice_cabac(
+                    fc, self.params, frame_num=0, idr=True,
+                    idr_pic_id=idr_pic_id,
+                    first_mb=self.spans[band][0] * self._mbw)
+            else:
+                nal = pack_slice_fast(
+                    fc, self.params, frame_num=0, idr=True,
+                    idr_pic_id=idr_pic_id,
+                    first_mb=self.spans[band][0] * self._mbw)
         return (nal, 0, t_f - t0, t_u - t_f, time.perf_counter() - t_u, t_f,
                 "")  # downlink_mode is a P-frame label — "" on IDR rows
 
@@ -971,7 +1002,8 @@ class BandedH264Encoder:
             full_d=full_d, buf_d=buf_d,
             link_bytes=self.link_bytes, prefix_bytes=fused.nbytes,
             note_need=self._note_need,
-            first_mb=self.spans[band][0] * self._mbw)
+            first_mb=self.spans[band][0] * self._mbw,
+            entropy_coder=self._coder)
         return (nal, skipped, t_f - t0, t_u - t_f,
                 time.perf_counter() - t_u, t_f, mode)
 
@@ -992,6 +1024,12 @@ class BandedH264Encoder:
                 qp=self.qp,
             )
         self._allskip.qp = self.qp
+        if self._coder == "cabac":
+            return b"".join(
+                pack_slice_p_cabac(self._allskip, self.params, frame_num,
+                                   first_mb=mb0 * self._mbw)
+                for mb0, _ in self.spans
+            )
         return b"".join(
             pack_slice_p_fast(self._allskip, self.params, frame_num=frame_num,
                               first_mb=mb0 * self._mbw)
@@ -1189,6 +1227,7 @@ class BandedH264Encoder:
         modes = {r[6] for r in results}
         downlink_mode = ("dense" if "dense" in modes
                          else "bits" if modes == {"bits"}
+                         else "cabac" if modes == {"cabac"}
                          else "coeff" if "coeff" in modes else "")
         band_step = tuple(round((t - t_up) * 1e3, 3) for t in t_ready)
         step_ms = (max(t_ready) - t_up) * 1e3

@@ -285,6 +285,10 @@ class TPUWebRTCApp:
             "dropped_frames": p.dropped_frames, "outbox": len(p._outbox),
             "software_fallback": self.software_fallback,
             "encoder": self._active_encoder_name(),
+            # active entropy backend ("cavlc"/"cabac"; "" for rows
+            # without one, e.g. AV1/VP9) — the /statz view of which
+            # coder the session's PPS pinned
+            "entropy_coder": getattr(self.encoder, "entropy_coder", ""),
         }
 
     # ------------------------------------------------------------------

@@ -540,10 +540,13 @@ class VideoPipeline:
                             convert_ms=ef.convert_ms, h2d_ms=ef.h2d_ms,
                             downlink_mode=ef.downlink_mode,
                             bits_fetch_ms=(ef.fetch_ms
-                                           if ef.downlink_mode == "bits"
+                                           if ef.downlink_mode
+                                           in ("bits", "cabac")
                                            else 0.0),
                             qp=ef.qp,
-                            rc_fullness=getattr(self.rc, "fullness", None))
+                            rc_fullness=getattr(self.rc, "fullness", None),
+                            entropy_coder=getattr(self.encoder,
+                                                  "entropy_coder", ""))
                 failures = 0
                 if self.supervisor is not None:
                     self.supervisor.tick_ok()
@@ -676,9 +679,12 @@ class VideoPipeline:
                     convert_ms=ef.convert_ms, h2d_ms=ef.h2d_ms,
                     downlink_mode=ef.downlink_mode,
                     bits_fetch_ms=(ef.fetch_ms
-                                   if ef.downlink_mode == "bits" else 0.0),
+                                   if ef.downlink_mode
+                                   in ("bits", "cabac") else 0.0),
                     qp=ef.qp,
-                    rc_fullness=getattr(self.rc, "fullness", None))
+                    rc_fullness=getattr(self.rc, "fullness", None),
+                    entropy_coder=getattr(self.encoder,
+                                          "entropy_coder", ""))
             self._policy_drained.append(ef)
 
     async def _send_loop(self) -> None:

@@ -27,7 +27,14 @@ The matrices follow the measured trade-offs of the earlier PRs
   backend AUTO default — the scenario bench measured that forcing it
   on a CPU backend regresses both fps and downlink bytes (the "device"
   coder shares the host's cores and a busy full-P's fixed bits prefix
-  can exceed the hint-sized coefficient fetch).
+  can exceed the hint-sized coefficient fetch). The entropy CODER
+  (cavlc/cabac, PR 20) follows the same negative-result discipline:
+  no preset pins it — it stays at the backend AUTO resolution
+  (device_cavlc.entropy_coder_default: cabac on TPU, cavlc on CPU),
+  because forcing the CABAC token pass onto a CPU backend is exactly
+  the "device work on host cores" regression PR 10 measured, and the
+  coder is PPS-scoped so a mid-stream scenario flip could not retune
+  it without an IDR anyway.
 
 ``latency`` forces batch cap 1 everywhere; ``throughput`` forces full
 groups everywhere; ``balanced`` is the per-scenario matrix above.
@@ -143,7 +150,7 @@ def plan_for(preset: str, scenario: Scenario) -> KnobPlan:
 #   unclassified session never pages on a scenario it isn't in.
 #
 # The quality floors (psnr_floor_db, docs/quality.md) come from the
-# committed rate/quality record BENCH_quality_r01.json (tpuh264enc at
+# committed rate/quality record BENCH_quality_r02.json (tpuh264enc at
 # 512x288 through the QP 24-36 ladder, cv2 decode oracle): each floor
 # sits ~2-3 dB under the scenario's measured QP-36 rung — the worst
 # quality the encoder ships on purpose — so the objective burns on

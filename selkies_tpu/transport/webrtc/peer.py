@@ -52,6 +52,7 @@ class PeerConnection:
     KEYFRAME_MIN_INTERVAL = 0.3
 
     def __init__(self, *, codec: str = "h264", audio: bool = True,
+                 h264_profile: str = "baseline",
                  fec_percentage: int = 20,
                  stun_server=None, turn_server=None,
                  turn_username: str = "", turn_password: str = "",
@@ -59,6 +60,9 @@ class PeerConnection:
                  turn_tls_insecure: bool = False,
                  loop: asyncio.AbstractEventLoop | None = None):
         self.codec = codec
+        # "baseline" or "main" — the CABAC entropy backend's streams
+        # declare Main in the SPS, so the offered fmtp must say so too
+        self.h264_profile = h264_profile
         self.audio = audio
         self._loop = loop or asyncio.get_event_loop()
         self.ice = IceAgent(stun_server=stun_server, turn_server=turn_server,
@@ -155,6 +159,7 @@ class PeerConnection:
             ice_ufrag=self.ice.local_ufrag, ice_pwd=self.ice.local_pwd,
             fingerprint=self.fingerprint, video_ssrc=self.video_ssrc,
             audio_ssrc=self.audio_ssrc, codec=self.codec, audio=self.audio,
+            h264_profile=self.h264_profile,
         )
 
     async def set_answer(self, answer_sdp: str) -> None:

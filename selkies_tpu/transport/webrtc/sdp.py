@@ -24,6 +24,11 @@ PLAYOUT_DELAY_URI = "http://www.webrtc.org/experiments/rtp-hdrext/playout-delay"
 
 H264_FMTP = ("level-asymmetry-allowed=1;packetization-mode=1;"
              "profile-level-id=42e01f;sps-pps-idr-in-keyframe=1")
+# Main profile (profile_idc 77, constraint_set1, level 3.1) — what the
+# CABAC entropy backend's SPS declares (bitstream.py write_sps); the
+# fmtp must match the stream or strict browsers refuse the track
+H264_FMTP_MAIN = ("level-asymmetry-allowed=1;packetization-mode=1;"
+                  "profile-level-id=4d401f;sps-pps-idr-in-keyframe=1")
 VP8_FMTP = ""
 VP9_FMTP = "profile-id=0"
 
@@ -48,7 +53,8 @@ CODEC_FMTP = {"h264": H264_FMTP, "vp8": VP8_FMTP, "vp9": VP9_FMTP,
 
 def build_offer(*, ice_ufrag: str, ice_pwd: str, fingerprint: str,
                 video_ssrc: int, audio_ssrc: int, codec: str = "h264",
-                session_id: str | None = None, audio: bool = True) -> str:
+                session_id: str | None = None, audio: bool = True,
+                h264_profile: str = "baseline") -> str:
     sid = session_id or str(int.from_bytes(secrets.token_bytes(6), "big"))
     cname = "selkies-tpu"
     mids = ["video0"] + (["audio0"] if audio else []) + ["application0"]
@@ -92,6 +98,8 @@ def build_offer(*, ice_ufrag: str, ice_pwd: str, fingerprint: str,
         f"a=ssrc:{video_ssrc} msid:selkies selkies-video",
     ]
     fmtp = CODEC_FMTP[codec]
+    if codec == "h264" and h264_profile == "main":
+        fmtp = H264_FMTP_MAIN
     if fmtp:
         lines.insert(lines.index("a=rtpmap:" + CODEC_RTPMAP[codec]) + 1,
                      f"a=fmtp:{VIDEO_PT} {fmtp}")

@@ -23,6 +23,7 @@ logger = logging.getLogger("transport.webrtc")
 
 class WebRTCTransport:
     def __init__(self, *, codec: str = "h264", audio: bool = True,
+                 h264_profile: str = "baseline",
                  fec_percentage: int = 20,
                  stun_server: tuple[str, int] | None = None,
                  turn_server: tuple[str, int] | None = None,
@@ -30,6 +31,7 @@ class WebRTCTransport:
                  turn_transport: str = "udp",
                  turn_tls_insecure: bool = False):
         self._kw = dict(codec=codec, audio=audio,
+                        h264_profile=h264_profile,
                         fec_percentage=fec_percentage,
                         stun_server=stun_server,
                         turn_server=turn_server, turn_username=turn_username,
@@ -62,11 +64,16 @@ class WebRTCTransport:
     def connected(self) -> bool:
         return self.pc is not None and self.pc.connected
 
-    def set_codec(self, codec: str) -> None:
+    def set_codec(self, codec: str, h264_profile: str | None = None) -> None:
         """Pick the negotiated codec (and thereby the RTP payloader) for
         future sessions — the orchestrator calls this once the encoder
-        row is built, so an AV1 encoder negotiates AV1, not H.264."""
+        row is built, so an AV1 encoder negotiates AV1, not H.264.
+        ``h264_profile`` carries the encoder row's declared profile
+        ("baseline"/"main") into the offered fmtp profile-level-id; a
+        CABAC row's Main-profile SPS must match the signalling."""
         self._kw["codec"] = codec
+        if h264_profile is not None:
+            self._kw["h264_profile"] = h264_profile
 
     def set_ice_servers(self, *, stun_server=None, turn_server=None,
                         turn_username: str = "", turn_password: str = "",

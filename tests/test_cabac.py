@@ -1,0 +1,121 @@
+"""Host CABAC reference coder: tables, token IR, and the arithmetic
+engine (models/h264/cabac.py).
+
+The context-init tables are extracted from the system's libavcodec /
+libx264 rodata and cross-validated at generation time
+(tools/gen_cabac_tables.py); the structural checks here catch a
+regenerated module that silently picked the wrong blob. The native
+engine (native/cabac_pack.cc) must be byte-identical to the pure-Python
+oracle on randomized token streams — it is the per-slice hot loop the
+completion path actually runs.
+"""
+
+import numpy as np
+import pytest
+
+from selkies_tpu.models.h264 import cabac
+from selkies_tpu.models.h264.bitstream import SLICE_I, SLICE_P
+from selkies_tpu.models.h264.cabac_tables import (
+    INIT_I,
+    INIT_PB,
+    RANGE_LPS,
+    TRANS_LPS,
+)
+
+
+def test_init_tables_structure():
+    """Table 9-12 leaves the P/B-only contexts 11..23 undefined — the
+    extractor identifies the I table by exactly that; and ctx 0..10 are
+    slice-type independent, shared by all four tables."""
+    assert all(INIT_I[c] == (0, 0) for c in range(11, 24))
+    for tab in INIT_PB:
+        assert tab[:11] == INIT_I[:11]
+        assert not all(tab[c] == (0, 0) for c in range(11, 24))
+
+
+def test_range_lps_spec_anchors():
+    """Known rows of table 9-44 (the same anchors the extractor
+    validates against, so a re-extraction can't drift silently)."""
+    assert RANGE_LPS[0] == (128, 176, 208, 240)
+    assert RANGE_LPS[62] == (6, 7, 8, 9)
+    assert RANGE_LPS[63] == (2, 2, 2, 2)
+    assert TRANS_LPS[0] == 0 and TRANS_LPS[63] == 63
+
+
+@pytest.mark.parametrize("qp,slice_type,idc", [
+    (26, SLICE_I, 0), (26, SLICE_P, 0), (26, SLICE_P, 1),
+    (26, SLICE_P, 2), (0, SLICE_P, 0), (51, SLICE_I, 0),
+])
+def test_init_states_shape_and_range(qp, slice_type, idc):
+    st = cabac.init_states(qp, slice_type, idc)
+    assert st.shape == (cabac.N_STATES, 2)
+    assert st[:, 0].max() <= 62 and st[:, 1].max() <= 1
+
+
+def _random_tokens(rng, n):
+    """A plausible token stream: regular bins over live contexts, runs,
+    bypass groups, periodic TERM(0), final TERM(1) flush."""
+    toks = []
+    for _ in range(n):
+        kind = rng.integers(0, 10)
+        ctx = int(rng.integers(0, cabac.N_STATES))
+        b = int(rng.integers(0, 2))
+        if kind < 6:
+            toks.append(cabac.tok_reg(ctx, b))
+        elif kind < 8:
+            toks.append(cabac.tok_run(ctx, b, int(rng.integers(1, 8))))
+        elif kind == 8:
+            nb = int(rng.integers(1, 11))
+            v = int(rng.integers(0, 1 << nb))
+            toks.append(cabac.TOK_BYP | (nb << 2) | (v << 6))
+        else:
+            toks.append(cabac.tok_term(0))
+    toks.append(cabac.tok_term(1))
+    return np.asarray(toks, np.uint16)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_native_engine_matches_python(seed):
+    from selkies_tpu.models.h264 import native
+
+    if not native.cabac_native_available():
+        pytest.skip("native CABAC engine not built")
+    rng = np.random.default_rng(seed)
+    toks = _random_tokens(rng, 50 + 400 * seed)
+    states = cabac.init_states(26, SLICE_P, seed % 3)
+    ref = cabac.encode_tokens_py(states.copy(), toks)
+    got = native.cabac_encode_tokens(states, toks)
+    assert got == ref
+
+
+def test_engine_requires_term_flush():
+    states = cabac.init_states(26, SLICE_P)
+    toks = np.asarray([cabac.tok_reg(11, 1)], np.uint16)
+    with pytest.raises(ValueError):
+        cabac.encode_tokens_py(states, toks)
+
+
+def test_token_writer_splits_long_runs_and_bypass():
+    """RUN tokens carry n<=7 and BYP groups <=10 bits; the writer must
+    split bigger requests without changing the decoded bin sequence."""
+    tw = cabac.TokenWriter()
+    for _ in range(20):
+        tw.reg(40, 1)
+    tw.bypass_bits(0x3FFFF, 18)  # > 10 bits: must split
+    tw.term(1)
+    toks = tw.array()
+    n_bins = 0
+    for t in toks:
+        t = int(t)
+        kind = t & 3
+        if kind == cabac.TOK_RUN:
+            assert 1 <= (t >> 13) <= 7
+            n_bins += t >> 13
+        elif kind == cabac.TOK_REG:
+            n_bins += 1
+        elif kind == cabac.TOK_BYP:
+            assert 1 <= ((t >> 2) & 0xF) <= 10
+    assert n_bins == 20
+    # and the stream still encodes (the engine validates structure)
+    states = cabac.init_states(26, SLICE_P)
+    assert len(cabac.encode_tokens_py(states, toks)) > 0

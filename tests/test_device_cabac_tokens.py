@@ -1,0 +1,236 @@
+"""Device CABAC emission (ISSUE 20): the compacted device token coder
+must be bit-exact against the host reference coder at every density,
+bucket boundary, init-table variant, band offset and escape magnitude —
+and the fused ship-tokens-or-coefficients downlink must complete to the
+same bytes end to end."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from selkies_tpu.models.h264.bitstream import StreamParams
+from selkies_tpu.models.h264.cabac import pack_slice_p_cabac
+from selkies_tpu.models.h264.compact import p_sparse_entropy_meta
+from selkies_tpu.models.h264.device_cabac import (
+    assemble_p_cabac_nal,
+    pack_p_slice_tokens,
+    pack_p_slice_tokens_active,
+)
+from selkies_tpu.models.h264.encoder_core import pack_p_sparse_entropy
+from selkies_tpu.models.h264.native import derive_skip_mvs_fast
+from selkies_tpu.models.h264.numpy_ref import PFrameCoeffs
+from selkies_tpu.models.h264.sparse_complete import complete_sparse_slice
+
+MBH, MBW = 6, 8
+M = MBH * MBW
+W, H = MBW * 16, MBH * 16
+LADDER = (4, 16, M)  # forced multi-bucket ladder for a tiny grid
+WORD_CAP = 1 << 16
+
+
+def _fc(seed, live, mag=8, mv=8, mbh=MBH, mbw=MBW, qp=26):
+    """Random coefficients with EXACTLY `live` non-skip MBs. Skip MBs
+    carry the DERIVED skip MV (the sparse wire ships no pairs for skip
+    MBs and the host unpacker re-derives them; coded MBs' mvd
+    prediction reads those neighbours, so the reference arrays must
+    hold the same values the wire reconstructs)."""
+    rng = np.random.default_rng(seed)
+    m = mbh * mbw
+    skip = np.ones(m, bool)
+    if live:
+        skip[rng.choice(m, size=min(live, m), replace=False)] = False
+    skip = skip.reshape(mbh, mbw)
+    mvs = rng.integers(-mv, mv + 1, (mbh, mbw, 2)).astype(np.int32)
+    derive_skip_mvs_fast(mvs, skip)
+
+    def coeffs(shape):
+        c = rng.integers(-mag, mag + 1, shape).astype(np.int32)
+        c[rng.random(shape) < 0.8] = 0
+        return c
+
+    luma = coeffs((mbh, mbw, 4, 4, 4, 4))
+    cac = coeffs((mbh, mbw, 2, 2, 2, 4, 4))
+    cac[..., 0, 0] = 0  # AC blocks: DC position unused
+    cdc = coeffs((mbh, mbw, 2, 2, 2))
+    luma[skip] = 0
+    cac[skip] = 0
+    cdc[skip] = 0  # skip MBs carry no residual (encoder invariant)
+    return PFrameCoeffs(mvs=mvs, skip=skip, luma_ac=luma, chroma_dc=cdc,
+                        chroma_ac=cac, qp=qp)
+
+
+def _out(fc):
+    return {k: jnp.asarray(getattr(fc, k))
+            for k in ("mvs", "skip", "luma_ac", "chroma_dc", "chroma_ac")}
+
+
+_full = jax.jit(lambda o: pack_p_slice_tokens(o, word_cap=WORD_CAP))
+_active = jax.jit(
+    lambda o: pack_p_slice_tokens_active(o, word_cap=WORD_CAP,
+                                         buckets=LADDER))
+
+
+def _assert_matches(fc, active=False, idc=0, first_mb=0,
+                    w=W, h=H, **hdr):
+    p = StreamParams(width=w, height=h, qp=fc.qp, entropy_coder="cabac")
+    ref = pack_slice_p_cabac(fc, p, frame_num=1, cabac_init_idc=idc,
+                             first_mb=first_mb, **hdr)
+    fn = _active if active else _full
+    words, ntok, counts, ns = fn(_out(fc))
+    assert int(ns) == int((~fc.skip).sum())
+    nal = assemble_p_cabac_nal(
+        np.asarray(words), int(ntok), np.asarray(counts)[: int(ns)],
+        fc.skip, p, 1, fc.qp, first_mb=first_mb, cabac_init_idc=idc, **hdr)
+    assert nal == ref, f"device CABAC diverged at ns={int(ns)}"
+
+
+@pytest.mark.parametrize("live", [0, 1, M // 2, M])
+def test_density_sweep(live):
+    """0% / ~2% (one MB) / 50% / 100% live MBs, device == host coder."""
+    _assert_matches(_fc(live * 7 + 1, live))
+
+
+@pytest.mark.parametrize("live", [3, 4, 5, 15, 16, 17])
+def test_bucket_boundaries(live):
+    """ns exactly at / around each ladder rung (4, 16) through the
+    bucketed lax.switch path: padded slots must emit nothing."""
+    _assert_matches(_fc(live + 100, live), active=True)
+
+
+@pytest.mark.parametrize("idc", [0, 1, 2])
+def test_cabac_init_idc_variants(idc):
+    """Each P/B init table produces different context states — device
+    emission is table-independent (contexts resolve at the host engine)
+    but the assembled slice must match the reference per table."""
+    _assert_matches(_fc(40 + idc, M // 2), idc=idc)
+
+
+def test_escape_levels_through_ueg0():
+    """Magnitudes far past the TU prefix exercise the closed-form UEG0
+    suffix (clz-based exp-Golomb) on device."""
+    _assert_matches(_fc(13, 5, mag=5000, qp=2))
+
+
+def test_large_mvd_ueg3():
+    """|mvd| past uCoff 9 exercises the UEG3 escape."""
+    _assert_matches(_fc(17, 8, mv=30))
+
+
+def test_banded_slice_nonzero_first_mb():
+    """A band slice (first_mb_in_slice > 0): slice-local neighbour
+    resets and the header's extra ue field shift the stream phase."""
+    fc = _fc(41, 10, mbh=3)
+    _assert_matches(fc, first_mb=3 * MBW, h=6 * 16, active=False)
+
+
+@pytest.mark.parametrize("hdr", [
+    {"ltr_ref": 1},
+    {"mark_ltr": 0},
+    {"mark_ltr": 1, "mmco_evict": (0, 2)},
+])
+def test_ltr_header_variants(hdr):
+    """LTR flags live in the host-written slice header before the
+    cabac_alignment_one_bits; the payload splice must survive every
+    header-length variant."""
+    _assert_matches(_fc(31, M // 2), **hdr)
+
+
+# -- the fused downlink: meta prefix + skip bitmap + counts + tokens ---
+
+
+def _entropy_fused(fc, tok_words=1 << 14, min_mbs=0, nscap=M,
+                   cap_rows=M * 26):
+    fn = jax.jit(lambda o: pack_p_sparse_entropy(
+        o, nscap, cap_rows, None, tok_words, min_mbs, LADDER,
+        entropy_coder="cabac"))
+    return fn(_out(fc))
+
+
+def _complete(fc, fused_d, buf_d, nscap=M, cap_rows=M * 26, **hdr):
+    p = StreamParams(width=W, height=H, qp=fc.qp, entropy_coder="cabac")
+    nal, skipped, _tu, mode = complete_sparse_slice(
+        np.asarray(fused_d), mbh=MBH, mbw=MBW, nscap=nscap,
+        cap_rows=cap_rows, qp=fc.qp, frame_num=1, params=p,
+        device_bits=True, full_d=fused_d, buf_d=buf_d,
+        entropy_coder="cabac", **hdr)
+    return nal, skipped, mode
+
+
+def test_fused_token_mode_end_to_end():
+    """pack_p_sparse_entropy mode=1 with the cabac coder axis → the
+    host completion reproduces the reference coder's bytes and reports
+    downlink_mode 'cabac'."""
+    fc = _fc(21, M // 2)
+    fused_d, _dense_d, buf_d = _entropy_fused(fc)
+    mode, ntok, _t, nskip, ns = p_sparse_entropy_meta(np.asarray(fused_d))
+    assert mode == 1 and ntok > 0 and ns == int((~fc.skip).sum())
+    nal, skipped, m = _complete(fc, fused_d, buf_d)
+    p = StreamParams(width=W, height=H, qp=fc.qp, entropy_coder="cabac")
+    assert m == "cabac" and skipped == int(fc.skip.sum()) == nskip
+    assert nal == pack_slice_p_cabac(fc, p, frame_num=1)
+
+
+def test_word_cap_overflow_falls_back_to_coeff():
+    """Token buffer too small → the on-device decision ships
+    coefficients; the host coefficient fallback must STILL pack through
+    the CABAC coder (the PPS pins entropy_coding_mode_flag)."""
+    fc = _fc(22, M)
+    fused_d, _dense_d, buf_d = _entropy_fused(fc, tok_words=8)
+    assert p_sparse_entropy_meta(np.asarray(fused_d))[0] == 0
+    nal, _skipped, m = _complete(fc, fused_d, buf_d)
+    p = StreamParams(width=W, height=H, qp=fc.qp, entropy_coder="cabac")
+    assert m == "coeff"
+    assert nal == pack_slice_p_cabac(fc, p, frame_num=1)
+
+
+def test_min_mbs_threshold_coeff_path_is_cabac():
+    """Quiet frame under the bits threshold: coefficient downlink, but
+    the pack is the host CABAC coder — never a CAVLC slice."""
+    fc = _fc(23, 2)
+    fused_d, _dense_d, buf_d = _entropy_fused(fc, min_mbs=10)
+    assert p_sparse_entropy_meta(np.asarray(fused_d))[0] == 0
+    nal, _s, m = _complete(fc, fused_d, buf_d)
+    p = StreamParams(width=W, height=H, qp=fc.qp, entropy_coder="cabac")
+    assert m == "coeff"
+    assert nal == pack_slice_p_cabac(fc, p, frame_num=1)
+
+
+def test_allskip_and_dense_tokens():
+    """The degenerate densities through the fused path: all-skip (only
+    mb_skip_flag + end_of_slice bins) and all-live."""
+    for seed, live in ((51, 0), (52, M)):
+        fc = _fc(seed, live)
+        fused_d, _dense_d, buf_d = _entropy_fused(fc)
+        nal, _s, m = _complete(fc, fused_d, buf_d)
+        p = StreamParams(width=W, height=H, qp=fc.qp, entropy_coder="cabac")
+        assert m == "cabac"
+        assert nal == pack_slice_p_cabac(fc, p, frame_num=1)
+
+
+def test_entropy_coder_resolver():
+    """SELKIES_ENTROPY_CODER resolution: explicit wins, auto maps to
+    cavlc on the CPU backend these tests run on, junk raises."""
+    import os
+
+    from selkies_tpu.models.h264.device_cavlc import entropy_coder_default
+
+    assert entropy_coder_default("cabac") == "cabac"
+    assert entropy_coder_default("CAVLC") == "cavlc"
+    old = os.environ.pop("SELKIES_ENTROPY_CODER", None)
+    try:
+        assert entropy_coder_default() == "cavlc"
+        os.environ["SELKIES_ENTROPY_CODER"] = "cabac"
+        assert entropy_coder_default() == "cabac"
+        os.environ["SELKIES_ENTROPY_CODER"] = "auto"
+        # JAX_PLATFORMS=cpu in the suite: auto must NOT force device
+        # work onto the host cores (the PR 10 discipline)
+        assert entropy_coder_default() == "cavlc"
+        assert entropy_coder_default("auto") == "cavlc"
+    finally:
+        os.environ.pop("SELKIES_ENTROPY_CODER", None)
+        if old is not None:
+            os.environ["SELKIES_ENTROPY_CODER"] = old
+    with pytest.raises(ValueError):
+        entropy_coder_default("huffman")

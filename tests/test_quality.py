@@ -5,7 +5,7 @@ every codec with an oracle in this image, the live QualityProbe's
 sampling/scoring/drop accounting, the SLO ``quality`` burn objective,
 the RC telemetry (selkies_rc_qp / selkies_rc_fullness), BD-rate, the
 ``SELKIES_QUALITY=0`` byte-identity off switch, and the quality ratchet
-(tools/check_bench_regress.py --quality vs BENCH_quality_r01.json)."""
+(tools/check_bench_regress.py --quality vs BENCH_quality_r02.json)."""
 
 from __future__ import annotations
 
@@ -447,10 +447,10 @@ def test_check_bench_regress_quality_tolerances(tmp_path):
 
 
 def test_committed_quality_record_parses_and_covers_the_criteria():
-    """BENCH_quality_r01.json must carry per-scenario point rows for
+    """BENCH_quality_r02.json must carry per-scenario point rows for
     tpuh264enc plus a second codec, and BD-rate rows against >= 2 x264
     preset anchors (the acceptance shape docs/quality.md promises)."""
-    path = os.path.join(REPO, "BENCH_quality_r01.json")
+    path = os.path.join(REPO, "BENCH_quality_r02.json")
     rows = []
     with open(path, encoding="utf-8") as f:
         for line in f:
@@ -466,6 +466,14 @@ def test_committed_quality_record_parses_and_covers_the_criteria():
     assert encoders & {"vp9", "av1"}, "a second codec row is required"
     anchors = {r["anchor"] for r in bdrates if r["encoder"] == "tpuh264enc"}
     assert len(anchors) >= 2, "BD-rate needs >= 2 x264 preset anchors"
+    # the ISSUE 20 coder axis: CABAC rungs on the same QP ladder, with
+    # BD-rate vs the CAVLC curve <= -8% on at least two scenarios (the
+    # committed Main-profile bitrate cut the ratchet holds)
+    assert "tpuh264enc-cabac" in encoders
+    coder_rows = [r for r in bdrates if r["encoder"] == "tpuh264enc-cabac"
+                  and r["anchor"] == "tpuh264enc"]
+    assert len([r for r in coder_rows if r["bd_rate_pct"] <= -8.0]) >= 2, \
+        "CABAC must commit <= -8% BD-rate vs CAVLC on >= 2 scenarios"
     for r in points:
         assert r["vmaf_kind"] in ("cli", "proxy")
         assert 0 < r["psnr_db"] <= PSNR_CAP_DB
@@ -474,7 +482,7 @@ def test_committed_quality_record_parses_and_covers_the_criteria():
 @pytest.mark.slow
 def test_bench_quality_ratchet():
     """The real quality ratchet: a fresh bench.py --quality run over the
-    committed scenarios vs BENCH_quality_r01.json (slow: encodes every
+    committed scenarios vs BENCH_quality_r02.json (slow: encodes every
     ladder rung on CPU)."""
     proc = _run_ratchet(["--quality"])
     sys.stdout.write(proc.stdout)

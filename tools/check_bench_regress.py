@@ -51,7 +51,7 @@ drift, not host noise). An impairment regression means frames freeze on
 links the recovery ladder (docs/recovery.md) used to survive.
 
 ``--quality`` ratchets the **rate/quality suite** (``bench.py
---quality`` vs ``BENCH_quality_r01.json``, docs/quality.md): point rows
+--quality`` vs ``BENCH_quality_r02.json``, docs/quality.md): point rows
 match on scenario + encoder + preset + resolution and their mean
 ``psnr_db`` may drop at most ``--tol-psnr`` dB (absolute, default 1.5 —
 the traces and oracles are deterministic, so the slack covers encoder-
@@ -72,7 +72,7 @@ Usage:
         [--impair-baseline BENCH_impair_r01.json] [--tol-recovered 0.05]
         [--tol-p95 0.75]
     python tools/check_bench_regress.py --quality [typing,video]
-        [--quality-baseline BENCH_quality_r01.json] [--tol-psnr 1.5]
+        [--quality-baseline BENCH_quality_r02.json] [--tol-psnr 1.5]
         [--tol-bd 10.0]
 
 Exit 0 when every matched row is inside tolerance, 1 on regression,
@@ -95,7 +95,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_BASELINE = "BENCH_scenarios_r02.json"
 DEFAULT_CAPACITY_BASELINE = "BENCH_capacity_r01.json"
 DEFAULT_IMPAIR_BASELINE = "BENCH_impair_r01.json"
-DEFAULT_QUALITY_BASELINE = "BENCH_quality_r01.json"
+DEFAULT_QUALITY_BASELINE = "BENCH_quality_r02.json"
 
 
 # ---------------------------------------------------------------------------

@@ -19,8 +19,16 @@ and reports the device-bits vs host-pack crossover per activity level.
 Component rows (_encode_blocks / _pack_pairs / _merge_streams at full
 and compacted sizes) remain for kernel-level attribution.
 
+The ``--coder cabac`` axis (ISSUE 20) swaps the sweep onto the CABAC
+token path: device tokenizer (pack_p_slice_tokens[_active]) + the HOST
+arithmetic engine / splice (assemble_p_cabac_nal) the token downlink
+still pays, against the same sparse-pack / host-pack baselines — the
+crossover moves because the host keeps the sequential engine either
+way, so the device only has to beat host *binarization*.
+
 Run on a chip for PERF rounds; runs on CPU too (slower, same shapes):
     JAX_PLATFORMS=cpu python tools/profile_device_entropy.py [--quick]
+    JAX_PLATFORMS=cpu python tools/profile_device_entropy.py --coder cabac
 """
 import sys
 import time
@@ -41,6 +49,8 @@ from selkies_tpu.models.h264.sparse_complete import (  # noqa: E402
 )
 
 QUICK = "--quick" in sys.argv
+CODER = (sys.argv[sys.argv.index("--coder") + 1]
+         if "--coder" in sys.argv else "cavlc")
 MBH, MBW = 68, 120  # 1080p
 M = MBH * MBW
 NSCAP, CAP = 4096, 4096
@@ -100,7 +110,7 @@ def frame_out(live_mbs: int, seed: int = 0):
     }
 
 
-def host_pack_ms(out, params):
+def host_pack_ms(out, params, entropy_coder="cavlc"):
     """Host completion cost of the sparse downlink (the work the bits
     path deletes): fused buffer -> slice NAL via the shared flow."""
     fused_d, dense_d, buf_d = jax.jit(
@@ -113,9 +123,68 @@ def host_pack_ms(out, params):
         complete_sparse_slice(
             fused, mbh=MBH, mbw=MBW, nscap=NSCAP, cap_rows=CAP, qp=28,
             frame_num=1, params=params, full_d=fused_d, buf_d=buf_d,
-            dense_d=dense_d)
+            dense_d=dense_d, entropy_coder=entropy_coder)
         best = min(best, time.perf_counter() - t0)
     return 1e3 * best
+
+
+def cabac_main() -> int:
+    """--coder cabac: the token-IR sweep. Device binarization replaces
+    the host's, but the sequential arithmetic engine stays on the host —
+    so the win is (host-pack - host-splice) per frame, bought for the
+    'active' device ms."""
+    from selkies_tpu.models.h264 import device_cabac as dcb
+
+    params = StreamParams(width=1920, height=1080, qp=28,
+                          entropy_coder="cabac")
+    full = jax.jit(lambda o: dcb.pack_p_slice_tokens(o))
+    active = jax.jit(
+        lambda o: dcb.pack_p_slice_tokens_active(o, buckets=BUCKETS))
+    sparse = jax.jit(lambda o: pack_p_sparse_var(o, NSCAP, CAP))
+
+    print(f"device CABAC activity sweep  {MBW * 16}x{MBH * 16}  "
+          f"buckets={BUCKETS}  devices={jax.devices()[0].platform}")
+    # the full-grid tokenizer pays for every MB regardless of activity —
+    # one measurement serves the whole sweep (n=1: a CPU run is ~40 s)
+    t_full = timed(full, frame_out(ACTIVITY[0]), n=1)
+    print(f"{'live MBs':>9} {'full-grid':>10} {'active':>10} {'ratio':>6} "
+          f"{'sparse-pack':>11} {'host-splice':>11} {'host-pack':>10} "
+          f"{'AU bytes':>9}")
+    for live in ACTIVITY:
+        out = frame_out(live)
+        t_act = timed(active, out)
+        t_sparse = timed(sparse, out)
+        t_host = host_pack_ms(out, params, entropy_coder="cabac")
+        words, ntok, counts, ns = active(out)
+        w_np = np.asarray(words)
+        if int(ntok) > 2 * len(w_np):
+            # past the token-buffer cap the on-device decision ships
+            # coefficients (pack_p_sparse_entropy mode 0) — there is no
+            # splice to time, the row costs sparse-pack + host-pack
+            print(f"{live:>9} {t_full:>9.2f}m {t_act:>9.2f}m "
+                  f"{t_full / t_act:>5.1f}x {t_sparse:>10.2f}m "
+                  f"{'overflow':>10} {t_host:>9.2f}m "
+                  f"{'-> coeff':>9}  (ntok {int(ntok)} > cap "
+                  f"{2 * len(w_np)})")
+            continue
+        c_np = np.asarray(counts)[: int(ns)]
+        skip_np = np.asarray(out["skip"])
+        n = 2 if QUICK else 5
+        t_splice, nal = float("inf"), b""
+        for _ in range(n):
+            t0 = time.perf_counter()
+            nal = dcb.assemble_p_cabac_nal(
+                w_np, int(ntok), c_np, skip_np, params, 1, 28)
+            t_splice = min(t_splice, time.perf_counter() - t0)
+        print(f"{live:>9} {t_full:>9.2f}m {t_act:>9.2f}m "
+              f"{t_full / t_act:>5.1f}x {t_sparse:>10.2f}m "
+              f"{1e3 * t_splice:>10.2f}m {t_host:>9.2f}m {len(nal):>9}")
+
+    print("\ncrossover: the token mode pays when (active - sparse-pack) "
+          "device ms < (host-pack - host-splice) ms + fetch savings;")
+    print("host-splice (engine + header) rides the completion thread "
+          "either way — the device only displaces host binarization.")
+    return 0
 
 
 def main() -> int:
@@ -172,4 +241,4 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(cabac_main() if CODER == "cabac" else main())
